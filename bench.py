@@ -1,0 +1,115 @@
+"""Benchmark: FedAvg client local-training throughput (the north-star
+"client local steps/sec", BASELINE.md) on the real attached accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline: ratio against a torch-CPU implementation of the same local-SGD
+workload (the reference is torch; no CUDA exists here, so torch-CPU is the
+honest reproducible baseline on this machine — see BASELINE.md: reference
+publishes no numbers of its own).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _bench_fedml_tpu(steps: int, batch_size: int, model_name: str = "cnn") -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.arguments import default_config
+    from fedml_tpu.ml.trainer.local_sgd import epoch_index_array, make_local_train_fn
+    from fedml_tpu.models.model_hub import create
+
+    args = default_config("simulation", model=model_name, dataset="mnist", batch_size=batch_size, epochs=1)
+    model = create(args, 10)
+    local_train = make_local_train_fn(model, args)
+
+    n = steps * batch_size
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, 28, 28, 1)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, n).astype(np.int32))
+    idx, mask = epoch_index_array(n, batch_size, 1, 0)
+    idx, mask = jnp.asarray(idx), jnp.asarray(mask)
+    key = jax.random.PRNGKey(0)
+
+    # warmup/compile
+    jax.block_until_ready(local_train(model.params, x, y, idx, mask, key, None).params)
+    t0 = time.perf_counter()
+    reps = 5
+    params = model.params
+    for i in range(reps):
+        params = local_train(params, x, y, idx, mask, key, None).params
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+    return steps * reps / dt
+
+
+def _bench_torch_cpu(steps: int, batch_size: int) -> float:
+    """Reference-style torch CPU loop: same CNN shape, same workload."""
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    torch.set_num_threads(max(1, torch.get_num_threads()))
+
+    class CNN(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c1 = nn.Conv2d(1, 32, 3)
+            self.c2 = nn.Conv2d(32, 64, 3)
+            self.f1 = nn.Linear(64 * 5 * 5, 128)
+            self.f2 = nn.Linear(128, 10)
+
+        def forward(self, x):
+            x = F.max_pool2d(F.relu(self.c1(x)), 2)
+            x = F.max_pool2d(F.relu(self.c2(x)), 2)
+            x = x.flatten(1)
+            return self.f2(F.relu(self.f1(x)))
+
+    model = CNN()
+    opt = torch.optim.SGD(model.parameters(), lr=0.03)
+    rng = np.random.default_rng(0)
+    x = torch.tensor(rng.normal(size=(steps, batch_size, 1, 28, 28)).astype(np.float32))
+    y = torch.tensor(rng.integers(0, 10, (steps, batch_size)))
+    # warmup
+    for i in range(3):
+        opt.zero_grad()
+        F.cross_entropy(model(x[i]), y[i]).backward()
+        opt.step()
+    t0 = time.perf_counter()
+    n_done = 0
+    while time.perf_counter() - t0 < 5.0:
+        i = n_done % steps
+        opt.zero_grad()
+        F.cross_entropy(model(x[i]), y[i]).backward()
+        opt.step()
+        n_done += 1
+    return n_done / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    steps, batch = 64, 64
+    tpu_rate = _bench_fedml_tpu(steps, batch)
+    try:
+        torch_rate = _bench_torch_cpu(steps, batch)
+    except Exception:
+        torch_rate = None
+    print(
+        json.dumps(
+            {
+                "metric": "fedavg_client_local_steps_per_sec",
+                "value": round(tpu_rate, 2),
+                "unit": "steps/s (CNN-MNIST bs=64)",
+                "vs_baseline": round(tpu_rate / torch_rate, 2) if torch_rate else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

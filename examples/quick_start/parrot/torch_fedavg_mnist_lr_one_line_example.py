@@ -1,0 +1,14 @@
+"""One-line simulation quick start.
+
+Mirror of the reference example
+``examples/federate/quick_start/parrot/torch_fedavg_mnist_lr_one_line_example.py``
+(there torch; here the TPU-native stack). Run:
+
+    python torch_fedavg_mnist_lr_one_line_example.py --cf fedml_config.yaml
+"""
+
+import fedml_tpu as fedml
+
+if __name__ == "__main__":
+    metrics = fedml.run_simulation(args=fedml.load_arguments(training_type="simulation"))
+    print("final metrics:", metrics)

@@ -1,0 +1,20 @@
+from .agg_operator import (
+    FedMLAggOperator,
+    async_fedavg,
+    fedavg,
+    fednova_aggregate,
+    scaffold_aggregate,
+    uniform_average,
+)
+from .server_optimizer import FedOptServer, create_server_optimizer
+
+__all__ = [
+    "FedMLAggOperator",
+    "fedavg",
+    "fednova_aggregate",
+    "scaffold_aggregate",
+    "async_fedavg",
+    "uniform_average",
+    "FedOptServer",
+    "create_server_optimizer",
+]

@@ -1,0 +1,58 @@
+"""Server-side optimizers for FedOpt / Mime.
+
+Reference behavior: FedOpt (Reddi et al. 2021) treats the negated average
+client delta as a pseudo-gradient and applies a stateful server optimizer
+(SGD-momentum / Adam / Yogi). The reference implements this ad hoc inside its
+aggregators; here it is an optax transform so the whole server update is one
+jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import optax
+
+from ...utils.pytree import PyTree, tree_sub
+
+
+def yogi(learning_rate: float, b1: float = 0.9, b2: float = 0.99, eps: float = 1e-3):
+    return optax.yogi(learning_rate=learning_rate, b1=b1, b2=b2, eps=eps)
+
+
+def create_server_optimizer(args: Any) -> optax.GradientTransformation:
+    name = str(getattr(args, "server_optimizer", "sgd")).lower()
+    lr = float(getattr(args, "server_lr", 1.0))
+    momentum = float(getattr(args, "server_momentum", 0.9))
+    if name == "sgd":
+        return optax.sgd(lr, momentum=momentum if momentum > 0 else None)
+    if name == "adam":
+        return optax.adam(lr, b1=0.9, b2=0.99, eps=1e-3)
+    if name == "yogi":
+        return yogi(lr)
+    raise ValueError(f"unknown server optimizer {name!r}")
+
+
+class ServerOptState(NamedTuple):
+    opt_state: Any
+
+
+class FedOptServer:
+    """Holds server optimizer state across rounds; update is jitted."""
+
+    def __init__(self, args: Any, params_template: PyTree):
+        self.tx = create_server_optimizer(args)
+        self.state = self.tx.init(params_template)
+
+        def _step(params: PyTree, avg_params: PyTree, opt_state):
+            pseudo_grad = tree_sub(params, avg_params)  # -delta
+            updates, new_state = self.tx.update(pseudo_grad, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_state
+
+        self._step = jax.jit(_step)
+
+    def apply(self, w_global: PyTree, w_avg: PyTree) -> PyTree:
+        new_params, self.state = self._step(w_global, w_avg, self.state)
+        return new_params

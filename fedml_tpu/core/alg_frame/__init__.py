@@ -1,0 +1,6 @@
+from .client_trainer import ClientTrainer
+from .context import Context
+from .params import Params
+from .server_aggregator import ServerAggregator
+
+__all__ = ["ClientTrainer", "ServerAggregator", "Context", "Params"]

@@ -1,0 +1,94 @@
+"""Abstract client trainer with privacy/security hooks.
+
+Reference: ``python/fedml/core/alg_frame/client_trainer.py:8`` — the hook
+order is preserved exactly (poison-data / poison-model before training; local
+DP noise then FHE encryption after training) so the trust middleware composes
+identically. TPU-native differences: model parameters are JAX pytrees, the
+train loop is a jitted step function, and "device" is a `jax.Device` (or a
+`Mesh` for sharded local training).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional
+
+from .context import Context
+
+
+class ClientTrainer(abc.ABC):
+    """Local trainer run inside each (simulated or real) client.
+
+    Subclasses implement :meth:`train` as a pure-JAX local optimization over
+    the client's shard; parameters move as pytrees of ``jax.Array``.
+    """
+
+    def __init__(self, model: Any, args: Any):
+        self.model = model
+        self.id = 0
+        self.args = args
+        self.local_train_dataset = None
+        self.local_test_dataset = None
+        self.local_sample_number = 0
+        self.rid = 0
+        self.template_model_params = None
+        self.enable_hooks = not getattr(args, "disable_alg_frame_hooks", False)
+
+    def set_id(self, trainer_id: int) -> None:
+        self.id = trainer_id
+
+    def is_main_process(self) -> bool:
+        """Reference: only rank-0 of a silo talks WAN
+        (fedml_client_master_manager.py:67-70). In JAX multi-host terms this
+        is ``jax.process_index() == 0``."""
+        import jax
+
+        return jax.process_index() == 0
+
+    def update_dataset(self, local_train_dataset, local_test_dataset, local_sample_number) -> None:
+        self.local_train_dataset = local_train_dataset
+        self.local_test_dataset = local_test_dataset
+        self.local_sample_number = local_sample_number
+
+    # --- abstract parameter plumbing ------------------------------------
+    @abc.abstractmethod
+    def get_model_params(self):
+        """Return the trainable parameter pytree."""
+
+    @abc.abstractmethod
+    def set_model_params(self, model_parameters) -> None:
+        """Install a parameter pytree received from the server."""
+
+    # --- hook wiring (reference client_trainer.py:37-82) ----------------
+    def on_before_local_training(self, train_data, device, args) -> Any:
+        """Data/model poisoning hooks (reference :37-43)."""
+        if not self.enable_hooks:
+            return train_data
+        from ..security.fedml_attacker import FedMLAttacker
+
+        attacker = FedMLAttacker.get_instance()
+        if attacker.is_data_poisoning_attack() and attacker.is_to_poison_data():
+            return attacker.poison_data(train_data)
+        return train_data
+
+    @abc.abstractmethod
+    def train(self, train_data, device, args) -> None:
+        """Run local optimization; must leave updated params in the model."""
+
+    def on_after_local_training(self, train_data, device, args) -> None:
+        """Local DP noise then FHE encryption (reference :59-82, same order)."""
+        if not self.enable_hooks:
+            return
+        from ..dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+        from ..fhe.fhe_agg import FedMLFHE
+
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if dp.is_local_dp_enabled():
+            self.set_model_params(dp.add_local_noise(self.get_model_params()))
+        fhe = FedMLFHE.get_instance()
+        if fhe.is_fhe_enabled():
+            Context().add("fhe_encrypted", True)
+            self.set_model_params(fhe.fhe_enc("local", self.get_model_params()))
+
+    def test(self, test_data, device, args):  # pragma: no cover - optional
+        return None

@@ -1,0 +1,123 @@
+"""Abstract server aggregator with defense/DP/contribution hooks.
+
+Reference: ``python/fedml/core/alg_frame/server_aggregator.py:14`` — hook
+order preserved: on_before_aggregation (FHE note -> attack injection ->
+defense screening -> global clipping), aggregate (possibly defense-wrapped),
+on_after_aggregation (FHE decrypt -> central DP noise), then contribution
+assessment. Aggregation math itself is the jitted tree-reduction in
+``fedml_tpu.core.aggregation.agg_operator``.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+from typing import Any, Callable, List, Optional, Tuple
+
+from .context import Context
+
+
+class ServerAggregator(abc.ABC):
+    """Aggregates client updates; subclasses implement test()."""
+
+    def __init__(self, model: Any, args: Any):
+        self.model = model
+        self.id = 0
+        self.args = args
+        self.enable_hooks = not getattr(args, "disable_alg_frame_hooks", False)
+
+    def set_id(self, aggregator_id: int) -> None:
+        self.id = aggregator_id
+
+    @abc.abstractmethod
+    def get_model_params(self):
+        ...
+
+    @abc.abstractmethod
+    def set_model_params(self, model_parameters) -> None:
+        ...
+
+    # --- hooks (reference server_aggregator.py:44-134) ------------------
+    def on_before_aggregation(
+        self, raw_client_model_or_grad_list: List[Tuple[float, Any]]
+    ) -> List[Tuple[float, Any]]:
+        if not self.enable_hooks:
+            return raw_client_model_or_grad_list
+        from ..dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+        from ..security.fedml_attacker import FedMLAttacker
+        from ..security.fedml_defender import FedMLDefender
+
+        lst = raw_client_model_or_grad_list
+        attacker = FedMLAttacker.get_instance()
+        if attacker.is_model_attack():
+            lst = attacker.attack_model(lst, extra_auxiliary_info=self.get_model_params())
+            Context().add(Context.KEY_CLIENT_MODEL_LIST, lst)
+        defender = FedMLDefender.get_instance()
+        if defender.is_defense_enabled():
+            lst = defender.defend_before_aggregation(
+                lst, extra_auxiliary_info=self.get_model_params()
+            )
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if dp.is_global_dp_enabled() and dp.is_clipping():
+            lst = dp.global_clip(lst)
+        return lst
+
+    def aggregate(self, raw_client_model_or_grad_list: List[Tuple[float, Any]]):
+        """Defense-wrapped aggregation (reference :75-88)."""
+        from ..aggregation.agg_operator import FedMLAggOperator
+
+        if self.enable_hooks:
+            from ..security.fedml_defender import FedMLDefender
+
+            defender = FedMLDefender.get_instance()
+            if defender.is_defense_enabled():
+                return defender.defend_on_aggregation(
+                    raw_client_model_or_grad_list,
+                    base_aggregation_func=FedMLAggOperator.agg,
+                    extra_auxiliary_info=self.get_model_params(),
+                )
+        return FedMLAggOperator.agg(self.args, raw_client_model_or_grad_list)
+
+    def on_after_aggregation(self, aggregated_model_or_grad: Any) -> Any:
+        if not self.enable_hooks:
+            return aggregated_model_or_grad
+        from ..dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+        from ..fhe.fhe_agg import FedMLFHE
+        from ..security.fedml_defender import FedMLDefender
+
+        fhe = FedMLFHE.get_instance()
+        if fhe.is_fhe_enabled() and Context().get("fhe_encrypted"):
+            aggregated_model_or_grad = fhe.fhe_dec("global", aggregated_model_or_grad)
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if dp.is_central_dp_enabled():
+            logging.info("-----add central DP noise ----")
+            aggregated_model_or_grad = dp.add_global_noise(aggregated_model_or_grad)
+        defender = FedMLDefender.get_instance()
+        if defender.is_defense_enabled():
+            aggregated_model_or_grad = defender.defend_after_aggregation(aggregated_model_or_grad)
+        return aggregated_model_or_grad
+
+    def assess_contribution(self) -> None:
+        """Reference :105-134 — Shapley/LOO valuation after aggregation."""
+        if not self.enable_hooks:
+            return
+        from ..contribution.contribution_assessor_manager import ContributionAssessorManager
+
+        manager = ContributionAssessorManager(self.args)
+        if not manager.is_enabled():
+            return
+        model_list = Context().get(Context.KEY_CLIENT_MODEL_LIST)
+        if model_list is None:
+            return
+        manager.run(
+            model_list,
+            self.get_model_params(),
+            metric_fn=lambda params: self.test(Context().get(Context.KEY_TEST_DATA), None, self.args),
+        )
+
+    @abc.abstractmethod
+    def test(self, test_data, device, args):
+        ...
+
+    def test_all(self, train_data_local_dict, test_data_local_dict, device, args) -> bool:
+        return True

@@ -1,0 +1,36 @@
+"""Message <-> bytes framing shared by the networked backends (gRPC, MQTT).
+
+Layout: ``[4-byte BE header length][header JSON][payload npz bytes]`` where
+the header is the control-plane JSON (Message.to_json) and the payload is
+the ``model_params`` pytree via serialization.py. No pickle anywhere
+(contrast: reference s3/remote_storage.py:81).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Optional
+
+from .message import Message
+from .serialization import deserialize_pytree, serialize_pytree
+
+
+def message_to_bytes(msg: Message) -> bytes:
+    header = msg.to_json().encode()
+    payload = b""
+    params = msg.get_params().get(Message.MSG_ARG_KEY_MODEL_PARAMS)
+    if params is not None:
+        payload = serialize_pytree(params)
+    return struct.pack(">I", len(header)) + header + payload
+
+
+def message_from_bytes(data: bytes) -> Message:
+    (hlen,) = struct.unpack(">I", data[:4])
+    header = json.loads(data[4 : 4 + hlen].decode())
+    msg = Message()
+    msg.init_from_json_object(header)
+    payload = data[4 + hlen :]
+    if payload:
+        msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, deserialize_pytree(payload))
+    return msg

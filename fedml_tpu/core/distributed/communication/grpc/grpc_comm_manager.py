@@ -1,0 +1,147 @@
+"""gRPC communication backend.
+
+Reference: ``communication/grpc/grpc_comm_manager.py:30`` — one streaming
+gRPC server per rank listening on ``base_port + rank``, peers addressed via
+an ip-config CSV (rank -> ip). Re-implemented without protoc: the service is
+a single unary-unary bytes method registered with a generic handler; framing
+via codec.py. Per-message client channels are cached.
+"""
+
+from __future__ import annotations
+
+import csv
+import logging
+import queue
+import threading
+from concurrent import futures
+from typing import Dict, List, Optional
+
+import grpc
+
+from .....constants import GRPC_BASE_PORT
+from ..base_com_manager import BaseCommunicationManager, Observer
+from ..codec import message_from_bytes, message_to_bytes
+from ..message import Message
+
+log = logging.getLogger(__name__)
+
+SERVICE = "fedml_tpu.CommService"
+METHOD = "SendMessage"
+_STOP = object()
+
+_MAX_MSG = 512 * 1024 * 1024
+_OPTIONS = [
+    ("grpc.max_send_message_length", _MAX_MSG),
+    ("grpc.max_receive_message_length", _MAX_MSG),
+]
+
+
+def read_ip_config(path: Optional[str], size: int) -> Dict[int, str]:
+    """CSV ``receiver_id,ip`` (reference: grpc_ipconfig.csv); default all
+    localhost."""
+    table = {i: "127.0.0.1" for i in range(size)}
+    if path:
+        with open(path) as f:
+            for row in csv.reader(f):
+                if len(row) >= 2 and row[0].strip().isdigit():
+                    table[int(row[0])] = row[1].strip()
+    return table
+
+
+class GRPCCommManager(BaseCommunicationManager):
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: Optional[int] = None,
+        ip_config_path: Optional[str] = None,
+        topic: str = "fedml",
+        client_id: int = 0,
+        client_num: int = 0,
+        base_port: int = GRPC_BASE_PORT,
+    ):
+        self.host = host
+        self.rank = client_id
+        self.size = client_num + 1
+        self.base_port = base_port
+        self.port = port if port is not None else base_port + client_id
+        self.ip_table = read_ip_config(ip_config_path, self.size)
+        self._observers: List[Observer] = []
+        self._incoming: "queue.Queue" = queue.Queue()
+        self._channels: Dict[int, grpc.Channel] = {}
+        self._running = False
+        self._server = self._start_server()
+
+    # --- server ----------------------------------------------------------
+    def _start_server(self) -> grpc.Server:
+        incoming = self._incoming
+
+        def handle(request: bytes, context) -> bytes:
+            incoming.put(message_from_bytes(request))
+            return b"ok"
+
+        handler = grpc.method_handlers_generic_handler(
+            SERVICE,
+            {METHOD: grpc.unary_unary_rpc_method_handler(handle, request_deserializer=None, response_serializer=None)},
+        )
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=8), options=_OPTIONS)
+        server.add_generic_rpc_handlers((handler,))
+        server.add_insecure_port(f"{self.host}:{self.port}")
+        server.start()
+        log.info("grpc server rank=%d listening on %s:%d", self.rank, self.host, self.port)
+        return server
+
+    # --- client ----------------------------------------------------------
+    def _stub(self, receiver: int):
+        if receiver not in self._channels:
+            addr = f"{self.ip_table.get(receiver, '127.0.0.1')}:{self.base_port + receiver}"
+            self._channels[receiver] = grpc.insecure_channel(addr, options=_OPTIONS)
+        ch = self._channels[receiver]
+        return ch.unary_unary(f"/{SERVICE}/{METHOD}", request_serializer=None, response_deserializer=None)
+
+    def send_message(self, msg: Message) -> None:
+        """Send with UNAVAILABLE retry: peers may come up in any order (the
+        MQTT broker absorbs this for MQTT_S3; point-to-point gRPC must
+        retry until the receiver's server socket exists)."""
+        import time
+
+        data = message_to_bytes(msg)
+        receiver = msg.get_receiver_id()
+        deadline = time.time() + 120.0
+        delay = 0.2
+        while True:
+            try:
+                self._stub(receiver)(data, timeout=600)
+                return
+            except grpc.RpcError as e:  # pragma: no cover - timing dependent
+                code = e.code() if hasattr(e, "code") else None
+                if code != grpc.StatusCode.UNAVAILABLE or time.time() > deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 5.0)
+
+    # --- loop ------------------------------------------------------------
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        while self._running:
+            try:
+                item = self._incoming.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is _STOP:
+                break
+            for obs in list(self._observers):
+                obs.receive_message(item.get_type(), item)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self._incoming.put(_STOP)
+        self._server.stop(grace=0.5)
+        for ch in self._channels.values():
+            ch.close()

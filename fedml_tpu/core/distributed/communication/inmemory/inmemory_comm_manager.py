@@ -1,0 +1,62 @@
+"""In-memory communication backend.
+
+Same observer contract as every other backend (base_com_manager.py), so the
+full cross-silo client/server manager protocol runs unmodified inside one
+process — either multi-threaded (one thread per party) or sequentially in
+tests. Payload pytrees are passed by reference (zero-copy; they are immutable
+jax arrays), which also makes this the fastest simulation transport.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import List, Optional
+
+from ..base_com_manager import BaseCommunicationManager, Observer
+from ..message import Message
+from .broker import InMemoryBroker
+
+log = logging.getLogger(__name__)
+
+_STOP = object()
+
+
+class InMemoryCommManager(BaseCommunicationManager):
+    def __init__(self, run_id: str, rank: int, size: int):
+        self.run_id = str(run_id)
+        self.rank = rank
+        self.size = size
+        self.broker = InMemoryBroker.get(self.run_id)
+        self._observers: List[Observer] = []
+        self._running = False
+
+    def send_message(self, msg: Message) -> None:
+        receiver = msg.get_receiver_id()
+        log.debug("inmemory send %s", msg)
+        self.broker.publish(receiver, msg)
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        q = self.broker.queue_for(self.rank)
+        while self._running:
+            try:
+                item = q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is _STOP:
+                break
+            for obs in list(self._observers):
+                obs.receive_message(item.get_type(), item)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self.broker.publish(self.rank, _STOP)

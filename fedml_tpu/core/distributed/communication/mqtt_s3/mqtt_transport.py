@@ -1,0 +1,101 @@
+"""MQTT transport abstraction.
+
+Reference: ``communication/mqtt/mqtt_manager.py:14`` (paho wrapper with
+last-will liveness). Two impls behind one interface:
+
+  - ``LocalMqttBroker`` — in-process topic pub/sub with the same semantics
+    (topic strings, per-subscriber callbacks, retained last-will on
+    disconnect). Default; lets the full MQTT_S3 protocol run on one host
+    with zero dependencies.
+  - ``PahoMqttTransport`` — real broker via paho-mqtt, gated on import.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+class LocalMqttBroker:
+    _instances: Dict[str, "LocalMqttBroker"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._subs: Dict[str, List[Callable[[str, bytes], None]]] = defaultdict(list)
+        self._slock = threading.Lock()
+
+    @classmethod
+    def get(cls, broker_id: str = "default") -> "LocalMqttBroker":
+        with cls._lock:
+            if broker_id not in cls._instances:
+                cls._instances[broker_id] = cls()
+            return cls._instances[broker_id]
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._instances.clear()
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        with self._slock:
+            subs = list(self._subs.get(topic, []))
+        for cb in subs:
+            cb(topic, payload)
+
+    def subscribe(self, topic: str, callback: Callable[[str, bytes], None]) -> None:
+        with self._slock:
+            self._subs[topic].append(callback)
+
+    def unsubscribe(self, topic: str, callback: Callable[[str, bytes], None]) -> None:
+        with self._slock:
+            if callback in self._subs.get(topic, []):
+                self._subs[topic].remove(callback)
+
+
+class LocalMqttTransport:
+    """LocalMqttBroker client with the paho-ish surface the comm manager
+    uses (connect/publish/subscribe/last-will)."""
+
+    def __init__(self, broker_id: str = "default", client_id: str = ""):
+        self.broker = LocalMqttBroker.get(broker_id)
+        self.client_id = client_id
+        self._will: Optional[Tuple[str, bytes]] = None
+        self._subscriptions: List[Tuple[str, Callable]] = []
+
+    def set_last_will(self, topic: str, payload: bytes) -> None:
+        self._will = (topic, payload)
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        self.broker.publish(topic, payload)
+
+    def subscribe(self, topic: str, callback: Callable[[str, bytes], None]) -> None:
+        self.broker.subscribe(topic, callback)
+        self._subscriptions.append((topic, callback))
+
+    def disconnect(self, graceful: bool = True) -> None:
+        if not graceful and self._will is not None:
+            self.broker.publish(*self._will)
+        for topic, cb in self._subscriptions:
+            self.broker.unsubscribe(topic, cb)
+        self._subscriptions.clear()
+
+
+def create_mqtt_transport(args, client_id: str):
+    """Prefer a real broker when configured + paho present."""
+    host = getattr(args, "mqtt_host", None) if args is not None else None
+    if host:
+        try:  # pragma: no cover - needs broker
+            from .paho_transport import PahoMqttTransport
+
+            return PahoMqttTransport(
+                host, int(getattr(args, "mqtt_port", 1883)), client_id,
+                user=getattr(args, "mqtt_user", None), password=getattr(args, "mqtt_password", None),
+            )
+        except ImportError:
+            log.warning("mqtt_host configured but paho-mqtt unavailable; using local broker")
+    run_id = str(getattr(args, "run_id", "default")) if args is not None else "default"
+    return LocalMqttTransport(broker_id=run_id, client_id=client_id)

@@ -1,0 +1,62 @@
+"""Payload object store.
+
+Reference: ``communication/s3/remote_storage.py:75-113`` (``S3Storage``,
+pickled payloads). Here the store is an interface with two impls:
+``LocalObjectStore`` (filesystem, file:// urls — default, zero-dependency)
+and ``S3ObjectStore`` (boto3, gated on import). Payloads are npz-framed
+pytrees (serialization.py), never pickle.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import uuid
+from typing import Any, Optional
+
+from ..serialization import deserialize_pytree, serialize_pytree
+
+
+class LocalObjectStore:
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or os.path.join(tempfile.gettempdir(), "fedml_tpu_object_store")
+        os.makedirs(self.root, exist_ok=True)
+
+    def write_model(self, message_key: str, model_params: Any) -> str:
+        key = f"{message_key}_{uuid.uuid4().hex[:8]}.npz"
+        path = os.path.join(self.root, key)
+        with open(path, "wb") as f:
+            f.write(serialize_pytree(model_params))
+        return f"file://{path}"
+
+    def read_model(self, url: str) -> Any:
+        path = url[len("file://") :] if url.startswith("file://") else url
+        with open(path, "rb") as f:
+            return deserialize_pytree(f.read())
+
+
+class S3ObjectStore:  # pragma: no cover - requires boto3 + credentials
+    def __init__(self, bucket: str, prefix: str = "fedml"):
+        import boto3
+
+        self.s3 = boto3.client("s3")
+        self.bucket = bucket
+        self.prefix = prefix
+
+    def write_model(self, message_key: str, model_params: Any) -> str:
+        key = f"{self.prefix}/{message_key}_{uuid.uuid4().hex[:8]}.npz"
+        self.s3.put_object(Bucket=self.bucket, Key=key, Body=serialize_pytree(model_params))
+        return f"s3://{self.bucket}/{key}"
+
+    def read_model(self, url: str) -> Any:
+        _, _, rest = url.partition("s3://")
+        bucket, _, key = rest.partition("/")
+        body = self.s3.get_object(Bucket=bucket, Key=key)["Body"].read()
+        return deserialize_pytree(body)
+
+
+def create_object_store(args: Any):
+    bucket = getattr(args, "s3_bucket", None) if args is not None else None
+    if bucket:
+        return S3ObjectStore(bucket)
+    return LocalObjectStore(getattr(args, "object_store_dir", None) if args is not None else None)

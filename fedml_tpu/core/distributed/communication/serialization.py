@@ -1,0 +1,88 @@
+"""Pytree <-> bytes codec for the WAN boundary.
+
+The reference pickles torch state dicts into S3 objects
+(``s3/remote_storage.py:81``). Pickle is unsafe and engine-bound; here a
+parameter pytree (nested dict/list/tuple of arrays + scalars) is flattened to
+named flat buffers and packed with ``np.savez`` — portable, inspectable, and
+loadable by any engine. DeviceArrays are materialized host-side with
+``jax.device_get`` at this boundary only (SURVEY §2.b).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any, Dict, List, Tuple, Union
+
+import jax
+import numpy as np
+
+SEP = "/"
+_LEAF_TYPES = (np.ndarray, np.generic, int, float, bool)
+
+
+def _flatten(obj: Any, prefix: str, out: Dict[str, np.ndarray], structure: Any):
+    """Returns a JSON-able structure skeleton; arrays land in `out`."""
+    if isinstance(obj, dict):
+        return {k: _flatten(v, f"{prefix}{SEP}{k}", out, structure) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        kind = "list" if isinstance(obj, list) else "tuple"
+        return {
+            "__seq__": kind,
+            "items": [_flatten(v, f"{prefix}{SEP}{i}", out, structure) for i, v in enumerate(obj)],
+        }
+    if obj is None:
+        return {"__none__": True}
+    arr = np.asarray(jax.device_get(obj))
+    key = f"arr{len(out)}"
+    out[key] = arr
+    return {"__leaf__": key}
+
+
+def _unflatten(skel: Any, arrays: Dict[str, np.ndarray]) -> Any:
+    if isinstance(skel, dict):
+        if "__leaf__" in skel:
+            return arrays[skel["__leaf__"]]
+        if "__none__" in skel:
+            return None
+        if "__seq__" in skel:
+            items = [_unflatten(s, arrays) for s in skel["items"]]
+            return items if skel["__seq__"] == "list" else tuple(items)
+        return {k: _unflatten(v, arrays) for k, v in skel.items()}
+    raise ValueError(f"bad skeleton node {skel!r}")
+
+
+def serialize_pytree(tree: Any) -> bytes:
+    arrays: Dict[str, np.ndarray] = {}
+    skel = _flatten(tree, "", arrays, None)
+    buf = io.BytesIO()
+    # bfloat16 has no npz codec -> view as uint16 and record the real dtype
+    meta_dtypes = {}
+    packed = {}
+    for k, a in arrays.items():
+        if a.dtype.name == "bfloat16":
+            meta_dtypes[k] = "bfloat16"
+            packed[k] = a.view(np.uint16)
+        else:
+            packed[k] = a
+    packed["__skeleton__"] = np.frombuffer(
+        json.dumps({"skel": skel, "bf16": meta_dtypes}).encode(), dtype=np.uint8
+    )
+    np.savez(buf, **packed)
+    return buf.getvalue()
+
+
+def deserialize_pytree(data: bytes) -> Any:
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        meta = json.loads(bytes(z["__skeleton__"].tobytes()).decode())
+        arrays = {}
+        import ml_dtypes
+
+        for k in z.files:
+            if k == "__skeleton__":
+                continue
+            a = z[k]
+            if k in meta["bf16"]:
+                a = a.view(ml_dtypes.bfloat16)
+            arrays[k] = a
+    return _unflatten(meta["skel"], arrays)

@@ -1,0 +1,106 @@
+"""Differential-privacy facade.
+
+Reference: ``python/fedml/core/dp/fedml_differential_privacy.py:13`` —
+singleton configured from args, invoked only from the alg-frame hooks:
+``add_local_noise`` (LDP, client-side, client_trainer.py:59), ``global_clip``
++ ``add_global_noise`` (cDP, server-side, server_aggregator.py:90-103).
+
+DP frames supported (args.mechanism_type x args.dp_solution_type):
+  - ``cDP``: server clips each client update to ``clipping_norm`` then adds
+    calibrated noise to the aggregate (frames/cdp.py).
+  - ``LDP``: each client perturbs its own update (frames/ldp.py).
+  - ``NbAFL``: both-sides noising per Wei et al. 2020 (frames/NbAFL.py).
+Privacy budget is tracked with the RDP accountant.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional, Tuple
+
+import jax
+
+from ...utils.pytree import PyTree, tree_clip_by_global_norm
+from .budget_accountant.rdp_accountant import RDPAccountant
+from .mechanisms import create_mechanism
+
+DP_SOLUTION_CDP = "cdp"
+DP_SOLUTION_LDP = "ldp"
+DP_SOLUTION_NBAFL = "nbafl"
+
+
+class FedMLDifferentialPrivacy:
+    _instance: Optional["FedMLDifferentialPrivacy"] = None
+
+    @classmethod
+    def get_instance(cls) -> "FedMLDifferentialPrivacy":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self) -> None:
+        self.is_enabled = False
+        self.dp_solution = None
+        self.mechanism = None
+        self.clipping_norm = None
+        self.accountant = None
+        self._key = jax.random.PRNGKey(0)
+
+    def init(self, args: Any) -> None:
+        self.is_enabled = bool(getattr(args, "enable_dp", False))
+        if not self.is_enabled:
+            return
+        self.dp_solution = str(getattr(args, "dp_solution_type", DP_SOLUTION_CDP)).lower()
+        self.clipping_norm = getattr(args, "clipping_norm", None)
+        self.mechanism = create_mechanism(
+            getattr(args, "mechanism_type", "gaussian"),
+            epsilon=float(getattr(args, "epsilon", 1.0)),
+            delta=float(getattr(args, "delta", 1e-5)),
+            sensitivity=float(getattr(args, "sensitivity", 1.0)),
+        )
+        self.accountant = RDPAccountant()
+        self._key = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)) + 7)
+        logging.info("DP enabled: solution=%s clip=%s", self.dp_solution, self.clipping_norm)
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # --- enable predicates (queried from hooks) -------------------------
+    def is_dp_enabled(self) -> bool:
+        return self.is_enabled
+
+    def is_local_dp_enabled(self) -> bool:
+        return self.is_enabled and self.dp_solution in (DP_SOLUTION_LDP, DP_SOLUTION_NBAFL)
+
+    def is_global_dp_enabled(self) -> bool:
+        return self.is_enabled and self.dp_solution in (DP_SOLUTION_CDP, DP_SOLUTION_NBAFL)
+
+    def is_central_dp_enabled(self) -> bool:
+        return self.is_global_dp_enabled()
+
+    def is_clipping(self) -> bool:
+        return self.is_enabled and self.clipping_norm is not None
+
+    # --- noising (reference :88-103) ------------------------------------
+    def add_local_noise(self, local_grad: PyTree) -> PyTree:
+        if self.clipping_norm is not None:
+            local_grad = tree_clip_by_global_norm(local_grad, float(self.clipping_norm))
+        return self.mechanism.add_noise(local_grad, self._next_key())
+
+    def add_global_noise(self, global_model: PyTree) -> PyTree:
+        return self.mechanism.add_noise(global_model, self._next_key())
+
+    def global_clip(self, raw_client_grad_list: List[Tuple[float, PyTree]]) -> List[Tuple[float, PyTree]]:
+        c = float(self.clipping_norm)
+        return [(n, tree_clip_by_global_norm(g, c)) for n, g in raw_client_grad_list]
+
+    # --- accounting ------------------------------------------------------
+    def account(self, *, sample_rate: float, steps: int = 1) -> None:
+        if self.accountant is not None and self.mechanism is not None:
+            sigma = getattr(self.mechanism, "sigma", None)
+            if sigma:
+                self.accountant.step(noise_multiplier=sigma, sample_rate=sample_rate, steps=steps)
+
+    def get_epsilon(self, delta: float = 1e-5) -> float:
+        return self.accountant.get_epsilon(delta) if self.accountant else float("inf")

@@ -1,0 +1,16 @@
+from .gaussian import Gaussian
+from .laplace import Laplace
+
+
+def create_mechanism(name: str, *, epsilon: float, delta: float = 0.0, sensitivity: float = 1.0):
+    """Factory (reference: core/dp/common/utils.py check_params + per-frame
+    mechanism construction)."""
+    name = str(name).lower()
+    if name == "gaussian":
+        return Gaussian(epsilon=epsilon, delta=delta, sensitivity=sensitivity)
+    if name == "laplace":
+        return Laplace(epsilon=epsilon, sensitivity=sensitivity)
+    raise ValueError(f"unknown DP mechanism {name!r}")
+
+
+__all__ = ["Gaussian", "Laplace", "create_mechanism"]

@@ -1,0 +1,39 @@
+"""Gaussian mechanism over pytrees.
+
+Reference: ``python/fedml/core/dp/mechanisms/gaussian.py``. Noise generation
+is a pure function of a JAX PRNG key so DP-noised training remains
+reproducible and jittable (the reference mutates torch tensors in place with
+global RNG state).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....utils.pytree import PyTree
+
+
+class Gaussian:
+    def __init__(self, *, epsilon: float, delta: float, sensitivity: float = 1.0, sigma: float | None = None):
+        if sigma is not None:
+            self.sigma = float(sigma)
+        else:
+            if not (0 < delta < 1):
+                raise ValueError("Gaussian mechanism requires 0 < delta < 1")
+            # classic analytic bound: sigma >= sqrt(2 ln(1.25/delta)) * S / eps
+            self.sigma = math.sqrt(2.0 * math.log(1.25 / delta)) * sensitivity / epsilon
+        self.epsilon = epsilon
+        self.delta = delta
+        self.sensitivity = sensitivity
+
+    def add_noise(self, tree: PyTree, key: jax.Array) -> PyTree:
+        leaves, treedef = jax.tree.flatten(tree)
+        keys = jax.random.split(key, len(leaves))
+        noised = [
+            l + (self.sigma * jax.random.normal(k, l.shape, dtype=jnp.float32)).astype(l.dtype)
+            for l, k in zip(leaves, keys)
+        ]
+        return jax.tree.unflatten(treedef, noised)

@@ -1,0 +1,26 @@
+"""Laplace mechanism over pytrees (reference: core/dp/mechanisms/laplace.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....utils.pytree import PyTree
+
+
+class Laplace:
+    def __init__(self, *, epsilon: float, sensitivity: float = 1.0):
+        if epsilon <= 0:
+            raise ValueError("epsilon must be > 0")
+        self.scale = sensitivity / epsilon
+        self.epsilon = epsilon
+        self.sensitivity = sensitivity
+
+    def add_noise(self, tree: PyTree, key: jax.Array) -> PyTree:
+        leaves, treedef = jax.tree.flatten(tree)
+        keys = jax.random.split(key, len(leaves))
+        noised = [
+            l + (self.scale * jax.random.laplace(k, l.shape, dtype=jnp.float32)).astype(l.dtype)
+            for l, k in zip(leaves, keys)
+        ]
+        return jax.tree.unflatten(treedef, noised)

@@ -1,0 +1,108 @@
+"""Homomorphic-encryption aggregation seam.
+
+Reference: ``python/fedml/core/fhe/fhe_agg.py:10`` (``FedMLFHE``), which uses
+a TenSEAL CKKS context to encrypt client updates so the server aggregates
+ciphertexts. TenSEAL is CUDA/C++-bound and not available here, so this module
+keeps the exact facade/hook contract (``is_fhe_enabled``, ``fhe_enc``,
+``fhe_dec`` at client_trainer.py:60-77 / fedml_aggregator hooks) with a
+pluggable scheme registry. The built-in scheme is additively-homomorphic
+fixed-point masking (pad-sum): ciphertext = fixed_point(x) + PRF(key, shape);
+summation of ciphertexts is decrypted by subtracting the summed masks. A real
+CKKS backend can be registered via :func:`register_scheme` without touching
+the hook sites.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.pytree import PyTree
+
+_SCALE = 1 << 16  # fixed-point scale
+
+
+class AdditiveMaskScheme:
+    """Additively homomorphic masking in int64 fixed point."""
+
+    def __init__(self, secret: bytes):
+        self.secret = secret
+
+    def _mask(self, name: str, shape, nonce: int) -> np.ndarray:
+        seed = int.from_bytes(
+            hashlib.sha256(self.secret + name.encode() + nonce.to_bytes(8, "little")).digest()[:8], "little"
+        )
+        rng = np.random.default_rng(seed)
+        return rng.integers(-(1 << 40), 1 << 40, size=shape, dtype=np.int64)
+
+    def encrypt(self, tree: PyTree, nonce: int) -> PyTree:
+        def enc(path, x):
+            x = np.asarray(jax.device_get(x))
+            fixed = np.round(x.astype(np.float64) * _SCALE).astype(np.int64)
+            return fixed + self._mask(path, x.shape, nonce)
+
+        return _map_with_path(tree, enc)
+
+    def decrypt_sum(self, tree: PyTree, nonces, weights) -> PyTree:
+        """Decrypt a weighted sum of ciphertexts given contributing nonces."""
+
+        def dec(path, x):
+            x = np.asarray(x, dtype=np.float64)
+            total_mask = np.zeros(x.shape, dtype=np.float64)
+            for nonce, w in zip(nonces, weights):
+                total_mask += w * self._mask(path, x.shape, nonce).astype(np.float64)
+            return ((x - total_mask) / _SCALE).astype(np.float32)
+
+        return _map_with_path(tree, dec)
+
+
+def _map_with_path(tree: PyTree, fn: Callable[[str, Any], Any]) -> PyTree:
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = [fn(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+    return jax.tree.unflatten(treedef, out)
+
+
+_SCHEMES: Dict[str, Callable[..., Any]] = {"additive_mask": AdditiveMaskScheme}
+
+
+def register_scheme(name: str, factory: Callable[..., Any]) -> None:
+    _SCHEMES[name] = factory
+
+
+class FedMLFHE:
+    _instance: Optional["FedMLFHE"] = None
+
+    @classmethod
+    def get_instance(cls) -> "FedMLFHE":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self) -> None:
+        self.is_enabled = False
+        self.scheme = None
+        self._nonce = 0
+
+    def init(self, args: Any) -> None:
+        self.is_enabled = bool(getattr(args, "enable_fhe", False))
+        if not self.is_enabled:
+            return
+        name = str(getattr(args, "fhe_scheme", "additive_mask"))
+        secret = str(getattr(args, "fhe_secret", "fedml_tpu")).encode()
+        self.scheme = _SCHEMES[name](secret)
+
+    def is_fhe_enabled(self) -> bool:
+        return self.is_enabled
+
+    def fhe_enc(self, enc_type: str, model_params: PyTree) -> PyTree:
+        self._nonce += 1
+        return self.scheme.encrypt(model_params, self._nonce)
+
+    def fhe_dec(self, dec_type: str, model_params: PyTree, nonces=None, weights=None) -> PyTree:
+        nonces = nonces if nonces is not None else [self._nonce]
+        weights = weights if weights is not None else [1.0]
+        return self.scheme.decrypt_sum(model_params, nonces, weights)

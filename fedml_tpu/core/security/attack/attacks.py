@@ -1,0 +1,113 @@
+"""Attack implementations.
+
+Covers the reference's ``core/security/attack/{byzantine_attack,
+label_flipping_attack,model_replacement_backdoor_attack,lazy_worker}.py``
+as pure pytree/array transforms. Gradient-inversion style attacks (DLG,
+InvertGradient, RevealLabels) live in ``gradient_inversion.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....utils.pytree import PyTree, tree_scale, tree_sub, tree_add
+
+GradList = List[Tuple[float, PyTree]]
+
+
+class ByzantineAttack:
+    """Replace the first `byzantine_client_num` updates with zeros, random
+    noise, or sign-flipped updates (reference: byzantine_attack.py modes)."""
+
+    def __init__(self, config: Any):
+        self.byzantine_client_num = int(getattr(config, "byzantine_client_num", 1))
+        self.attack_mode = str(getattr(config, "attack_mode", "random"))  # zero|random|flip
+        self._key = jax.random.PRNGKey(int(getattr(config, "random_seed", 0)) + 101)
+
+    def attack_model(self, raw_client_grad_list: GradList, extra_auxiliary_info=None) -> GradList:
+        out = list(raw_client_grad_list)
+        k = min(self.byzantine_client_num, len(out))
+        for i in range(k):
+            n, w = out[i]
+            if self.attack_mode == "zero":
+                w = jax.tree.map(jnp.zeros_like, w)
+            elif self.attack_mode == "flip":
+                w = tree_scale(w, -1.0)
+            else:  # random
+                self._key, sub = jax.random.split(self._key)
+                leaves, treedef = jax.tree.flatten(w)
+                keys = jax.random.split(sub, len(leaves))
+                leaves = [jax.random.normal(kk, l.shape, jnp.float32).astype(l.dtype) for l, kk in zip(leaves, keys)]
+                w = jax.tree.unflatten(treedef, leaves)
+            out[i] = (n, w)
+        return out
+
+
+class LabelFlippingAttack:
+    """Flip labels class1 -> class2 in the poisoned clients' data
+    (reference: label_flipping_attack.py)."""
+
+    def __init__(self, config: Any):
+        self.original_class = int(getattr(config, "original_class_list", [1])[0]) if hasattr(
+            config, "original_class_list"
+        ) else int(getattr(config, "original_class", 1))
+        self.target_class = int(getattr(config, "target_class_list", [7])[0]) if hasattr(
+            config, "target_class_list"
+        ) else int(getattr(config, "target_class", 7))
+
+    def poison_data(self, dataset):
+        """dataset: (x, y) arrays; flips labels of the original class."""
+        x, y = dataset
+        y = np.asarray(y).copy()
+        y[y == self.original_class] = self.target_class
+        return x, y
+
+
+class ModelReplacementBackdoorAttack:
+    """Scale a malicious update so it survives averaging
+    (reference: model_replacement_backdoor_attack.py; Bagdasaryan et al.)."""
+
+    def __init__(self, config: Any):
+        self.scale = float(getattr(config, "attack_scale", 0.0))  # 0 => auto (= cohort size)
+
+    def attack_model(self, raw_client_grad_list: GradList, extra_auxiliary_info=None) -> GradList:
+        w_global = extra_auxiliary_info
+        out = list(raw_client_grad_list)
+        if not out or w_global is None:
+            return out
+        gamma = self.scale if self.scale > 0 else float(len(out))
+        n, w = out[0]
+        boosted = tree_add(w_global, tree_scale(tree_sub(w, w_global), gamma))
+        out[0] = (n, boosted)
+        return out
+
+
+class LazyWorkerAttack:
+    """Lazy workers resubmit (a noisy copy of) the previous global model
+    instead of training (reference: lazy_worker.py)."""
+
+    def __init__(self, config: Any):
+        self.lazy_worker_num = int(getattr(config, "lazy_worker_num", 1))
+        self.noise = float(getattr(config, "lazy_noise", 1e-3))
+        self._key = jax.random.PRNGKey(int(getattr(config, "random_seed", 0)) + 211)
+
+    def attack_model(self, raw_client_grad_list: GradList, extra_auxiliary_info=None) -> GradList:
+        w_global = extra_auxiliary_info
+        if w_global is None:
+            return raw_client_grad_list
+        out = list(raw_client_grad_list)
+        for i in range(min(self.lazy_worker_num, len(out))):
+            n, _ = out[i]
+            self._key, sub = jax.random.split(self._key)
+            leaves, treedef = jax.tree.flatten(w_global)
+            keys = jax.random.split(sub, len(leaves))
+            leaves = [
+                l + (self.noise * jax.random.normal(kk, l.shape, jnp.float32)).astype(l.dtype)
+                for l, kk in zip(leaves, keys)
+            ]
+            out[i] = (n, jax.tree.unflatten(treedef, leaves))
+        return out

@@ -1,0 +1,37 @@
+"""Base class for defenses (reference: core/security/defense/defense_base.py).
+
+A defense may act at three points (mirroring the server hooks):
+  - ``defend_before_aggregation``: screen/re-weight the client list;
+  - ``defend_on_aggregation``: replace the aggregation rule itself;
+  - ``defend_after_aggregation``: post-process the global model.
+All tensor math is pure-JAX over stacked client pytrees — defenses that work
+in flat space use ``tree_flatten_to_vector`` and are jit-compatible.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+from ...alg_frame.params import Params
+
+PyTree = Any
+GradList = List[Tuple[float, PyTree]]
+
+
+class BaseDefenseMethod:
+    def __init__(self, config: Any):
+        self.config = config
+
+    def defend_before_aggregation(self, raw_client_grad_list: GradList, extra_auxiliary_info: Any = None) -> GradList:
+        return raw_client_grad_list
+
+    def defend_on_aggregation(
+        self,
+        raw_client_grad_list: GradList,
+        base_aggregation_func: Callable = None,
+        extra_auxiliary_info: Any = None,
+    ) -> PyTree:
+        return base_aggregation_func(self.config, raw_client_grad_list)
+
+    def defend_after_aggregation(self, global_model: PyTree) -> PyTree:
+        return global_model

@@ -1,0 +1,167 @@
+"""Screening / re-weighting defenses.
+
+Covers reference ``core/security/defense/{norm_diff_clipping,weak_dp,
+foolsgold,three_sigma,slsgd}_defense.py`` re-expressed as jittable stacked
+ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....utils.pytree import (
+    tree_clip_by_global_norm,
+    tree_flatten_to_vector,
+    tree_sub,
+    tree_unflatten_from_vector,
+    tree_add,
+)
+from .defense_base import BaseDefenseMethod, GradList
+from .robust_aggregation import _stack_flat
+
+
+class NormDiffClippingDefense(BaseDefenseMethod):
+    """Clip ||w_client - w_global|| to a bound (reference:
+    norm_diff_clipping_defense.py; Sun et al. 2019 "Can you really backdoor
+    FL?")."""
+
+    def __init__(self, config: Any):
+        super().__init__(config)
+        self.norm_bound = float(getattr(config, "norm_bound", 5.0))
+
+    def defend_before_aggregation(self, raw_client_grad_list: GradList, extra_auxiliary_info=None) -> GradList:
+        w_global = extra_auxiliary_info
+        out = []
+        for n, w in raw_client_grad_list:
+            diff = tree_sub(w, w_global)
+            clipped = tree_clip_by_global_norm(diff, self.norm_bound)
+            out.append((n, tree_add(w_global, clipped)))
+        return out
+
+
+class WeakDPDefense(BaseDefenseMethod):
+    """Add small Gaussian noise to each client update (reference:
+    weak_dp_defense.py)."""
+
+    def __init__(self, config: Any):
+        super().__init__(config)
+        self.stddev = float(getattr(config, "stddev", 0.001))
+        self._key = jax.random.PRNGKey(int(getattr(config, "random_seed", 0)) + 13)
+
+    def defend_before_aggregation(self, raw_client_grad_list: GradList, extra_auxiliary_info=None) -> GradList:
+        out = []
+        for n, w in raw_client_grad_list:
+            self._key, sub = jax.random.split(self._key)
+            leaves, treedef = jax.tree.flatten(w)
+            keys = jax.random.split(sub, len(leaves))
+            noised = [
+                l + (self.stddev * jax.random.normal(k, l.shape, jnp.float32)).astype(l.dtype)
+                for l, k in zip(leaves, keys)
+            ]
+            out.append((n, jax.tree.unflatten(treedef, noised)))
+        return out
+
+
+@jax.jit
+def foolsgold_weights(grads: jnp.ndarray) -> jnp.ndarray:
+    """FoolsGold (Fung et al. 2020): down-weight clients with high pairwise
+    cosine similarity of historical updates. [K, D] -> [K] learning rates."""
+    norms = jnp.linalg.norm(grads, axis=1, keepdims=True) + 1e-9
+    cs = (grads / norms) @ (grads / norms).T
+    cs = cs - jnp.eye(cs.shape[0])
+    maxcs = jnp.max(cs, axis=1)
+    # pardoning: rescale similarity by ratio of max similarities
+    pardon = maxcs[None, :] / (maxcs[:, None] + 1e-9)
+    cs = cs * jnp.minimum(1.0, pardon)
+    wv = 1.0 - jnp.max(cs, axis=1)
+    wv = jnp.clip(wv, 0.0, 1.0)
+    wv = wv / (jnp.max(wv) + 1e-9)
+    # logit re-scaling
+    wv = jnp.clip(wv, 1e-6, 1 - 1e-6)
+    wv = jnp.log(wv / (1 - wv)) + 0.5
+    return jnp.clip(wv, 0.0, 1.0)
+
+
+class FoolsGoldDefense(BaseDefenseMethod):
+    def __init__(self, config: Any):
+        super().__init__(config)
+        # historical aggregate of flat updates, keyed by *client id* (slot
+        # position changes every round under client sampling). Ids come from
+        # Context "client_indexes_of_round" when the caller provides them;
+        # otherwise slot position is used (correct only without sampling).
+        self.memory: dict = {}
+
+    def defend_before_aggregation(self, raw_client_grad_list: GradList, extra_auxiliary_info=None) -> GradList:
+        from ...alg_frame.context import Context
+
+        x, _ = _stack_flat(raw_client_grad_list)
+        ids = Context().get("client_indexes_of_round")
+        if ids is None or len(ids) != len(raw_client_grad_list):
+            ids = list(range(len(raw_client_grad_list)))
+        for i, cid in enumerate(ids):
+            cid = int(cid)
+            self.memory[cid] = x[i] if cid not in self.memory else self.memory[cid] + x[i]
+        hist = jnp.stack([self.memory[int(cid)] for cid in ids])
+        wv = np.asarray(foolsgold_weights(hist))
+        return [(float(wv[i]) * n if wv[i] > 0 else 1e-9, g) for i, (n, g) in enumerate(raw_client_grad_list)]
+
+
+class ThreeSigmaDefense(BaseDefenseMethod):
+    """Drop clients whose update norm deviates >3 sigma from the cohort
+    median (reference: three_sigma_defense.py family)."""
+
+    def defend_before_aggregation(self, raw_client_grad_list: GradList, extra_auxiliary_info=None) -> GradList:
+        x, _ = _stack_flat(raw_client_grad_list)
+        norms = np.asarray(jnp.linalg.norm(x, axis=1))
+        med, std = float(np.median(norms)), float(np.std(norms) + 1e-9)
+        keep = [i for i, v in enumerate(norms) if abs(v - med) <= 3.0 * std]
+        if not keep:
+            keep = list(range(len(raw_client_grad_list)))
+        return [raw_client_grad_list[i] for i in keep]
+
+
+class SLSGDDefense(BaseDefenseMethod):
+    """Trimmed-mean + moving-average mixing with the previous global model
+    (reference: slsgd_defense.py; Xie et al. 2019)."""
+
+    def __init__(self, config: Any):
+        super().__init__(config)
+        self.alpha = float(getattr(config, "alpha", 0.1))
+        self.b = int(getattr(config, "trim_param_b", 1))
+
+    def defend_on_aggregation(self, raw_client_grad_list, base_aggregation_func=None, extra_auxiliary_info=None):
+        from .robust_aggregation import trimmed_mean
+
+        x, spec = _stack_flat(raw_client_grad_list)
+        b = min(self.b, (x.shape[0] - 1) // 2)
+        agg = tree_unflatten_from_vector(trimmed_mean(x, b), spec)
+        w_global = extra_auxiliary_info
+        if w_global is None:
+            return agg
+        return jax.tree.map(lambda g, a: (1 - self.alpha) * g + self.alpha * a, w_global, agg)
+
+
+class CRFLDefense(BaseDefenseMethod):
+    """Clip the aggregated model and smooth with noise each round
+    (reference: crfl_defense.py; Xie et al. 2021)."""
+
+    def __init__(self, config: Any):
+        super().__init__(config)
+        self.clip = float(getattr(config, "clip_threshold", 15.0))
+        self.sigma = float(getattr(config, "crfl_sigma", 0.01))
+        self._key = jax.random.PRNGKey(int(getattr(config, "random_seed", 0)) + 29)
+
+    def defend_after_aggregation(self, global_model):
+        clipped = tree_clip_by_global_norm(global_model, self.clip)
+        self._key, sub = jax.random.split(self._key)
+        leaves, treedef = jax.tree.flatten(clipped)
+        keys = jax.random.split(sub, len(leaves))
+        noised = [
+            l + (self.sigma * jax.random.normal(k, l.shape, jnp.float32)).astype(l.dtype)
+            for l, k in zip(leaves, keys)
+        ]
+        return jax.tree.unflatten(treedef, noised)

@@ -1,0 +1,89 @@
+"""Attacker facade (reference: core/security/fedml_attacker.py:14).
+
+Singleton configured from args (``enable_attack`` + ``attack_type``);
+dispatches to the attack implementations. Queried from the alg-frame hooks.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional, Tuple
+
+ATTACK_METHOD_BYZANTINE = "byzantine"
+ATTACK_METHOD_LABEL_FLIP = "label_flipping"
+ATTACK_METHOD_MODEL_REPLACEMENT = "model_replacement"
+ATTACK_METHOD_LAZY_WORKER = "lazy_worker"
+ATTACK_METHOD_DLG = "dlg"
+ATTACK_METHOD_INVERT_GRADIENT = "invert_gradient"
+
+MODEL_ATTACKS = {ATTACK_METHOD_BYZANTINE, ATTACK_METHOD_MODEL_REPLACEMENT, ATTACK_METHOD_LAZY_WORKER}
+DATA_ATTACKS = {ATTACK_METHOD_LABEL_FLIP}
+RECONSTRUCT_ATTACKS = {ATTACK_METHOD_DLG, ATTACK_METHOD_INVERT_GRADIENT}
+
+
+class FedMLAttacker:
+    _instance: Optional["FedMLAttacker"] = None
+
+    @classmethod
+    def get_instance(cls) -> "FedMLAttacker":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self) -> None:
+        self.is_enabled = False
+        self.attack_type = None
+        self.attacker = None
+
+    def init(self, args: Any) -> None:
+        self.is_enabled = bool(getattr(args, "enable_attack", False))
+        if not self.is_enabled:
+            self.attack_type, self.attacker = None, None
+            return
+        self.attack_type = str(getattr(args, "attack_type", ATTACK_METHOD_BYZANTINE)).strip().lower()
+        from .attack.attacks import (
+            ByzantineAttack,
+            LabelFlippingAttack,
+            LazyWorkerAttack,
+            ModelReplacementBackdoorAttack,
+        )
+
+        if self.attack_type == ATTACK_METHOD_BYZANTINE:
+            self.attacker = ByzantineAttack(args)
+        elif self.attack_type == ATTACK_METHOD_LABEL_FLIP:
+            self.attacker = LabelFlippingAttack(args)
+        elif self.attack_type == ATTACK_METHOD_MODEL_REPLACEMENT:
+            self.attacker = ModelReplacementBackdoorAttack(args)
+        elif self.attack_type == ATTACK_METHOD_LAZY_WORKER:
+            self.attacker = LazyWorkerAttack(args)
+        elif self.attack_type in RECONSTRUCT_ATTACKS:
+            from .attack.gradient_inversion import DLGAttack
+
+            self.attacker = DLGAttack(args)
+        else:
+            raise ValueError(f"unknown attack type {self.attack_type!r}")
+        logging.info("attack enabled: %s", self.attack_type)
+
+    # --- predicates (reference naming) ----------------------------------
+    def is_model_attack(self) -> bool:
+        return self.is_enabled and self.attack_type in MODEL_ATTACKS
+
+    def is_data_poisoning_attack(self) -> bool:
+        return self.is_enabled and self.attack_type in DATA_ATTACKS
+
+    def is_reconstruct_data_attack(self) -> bool:
+        return self.is_enabled and self.attack_type in RECONSTRUCT_ATTACKS
+
+    def is_to_poison_data(self) -> bool:
+        # per-round/per-client gating could be added; poison whenever enabled
+        return self.is_enabled
+
+    # --- dispatch --------------------------------------------------------
+    def attack_model(self, raw_client_grad_list: List[Tuple[float, Any]], extra_auxiliary_info: Any = None):
+        return self.attacker.attack_model(raw_client_grad_list, extra_auxiliary_info=extra_auxiliary_info)
+
+    def poison_data(self, dataset):
+        return self.attacker.poison_data(dataset)
+
+    def reconstruct_data(self, a_gradient, extra_auxiliary_info: Any = None):
+        return self.attacker.reconstruct_data(a_gradient, extra_auxiliary_info=extra_auxiliary_info)

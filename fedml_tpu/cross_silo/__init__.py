@@ -1,0 +1,4 @@
+from .fedml_client import Client, FedMLCrossSiloClient
+from .fedml_server import FedMLCrossSiloServer, Server
+
+__all__ = ["Client", "Server", "FedMLCrossSiloClient", "FedMLCrossSiloServer"]

@@ -1,0 +1,91 @@
+"""Cross-silo client manager (the WAN state machine, client side).
+
+Reference: ``cross_silo/client/fedml_client_master_manager.py:22`` — ONLINE
+report (:178), handle_message_init (:100), __train (:232), model upload
+(:164, only rank-0 of the silo talks WAN — here `jax.process_index()==0`
+via ClientTrainer.is_main_process).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from ... import mlops
+from ...core.distributed.communication.message import Message
+from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ..message_define import MyMessage
+
+log = logging.getLogger(__name__)
+
+
+class ClientMasterManager(FedMLCommManager):
+    def __init__(self, args: Any, trainer_dist_adapter, comm=None, rank=0, size=0, backend="INMEMORY"):
+        super().__init__(args, comm, rank, size, backend)
+        self.trainer_dist_adapter = trainer_dist_adapter
+        self.num_rounds = int(getattr(args, "comm_round", 10))
+        self.args.round_idx = 0
+        self.rank = rank
+        self.client_real_id = rank
+        self.has_sent_online_msg = False
+        self.is_inited = False
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(MyMessage.MSG_TYPE_CONNECTION_IS_READY, self.handle_message_connection_ready)
+        self.register_message_receive_handler(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.handle_message_receive_model_from_server
+        )
+        self.register_message_receive_handler(MyMessage.MSG_TYPE_S2C_FINISH, self.handle_message_finish)
+
+    def handle_message_connection_ready(self, msg_params: Message) -> None:
+        if not self.has_sent_online_msg:
+            self.has_sent_online_msg = True
+            self.send_client_status(0, MyMessage.MSG_CLIENT_STATUS_ONLINE)
+            mlops.log_training_status("INITIALIZING", str(getattr(self.args, "run_id", "0")))
+
+    def handle_message_init(self, msg_params: Message) -> None:
+        if self.is_inited:
+            return
+        self.is_inited = True
+        global_model_params = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        data_silo_index = msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
+        self.trainer_dist_adapter.update_dataset(int(data_silo_index))
+        self.trainer_dist_adapter.update_model(global_model_params)
+        self.args.round_idx = 0
+        self.__train()
+
+    def handle_message_receive_model_from_server(self, msg_params: Message) -> None:
+        model_params = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        client_index = msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
+        self.trainer_dist_adapter.update_dataset(int(client_index))
+        self.trainer_dist_adapter.update_model(model_params)
+        self.args.round_idx += 1
+        self.__train()
+
+    def handle_message_finish(self, msg_params: Message) -> None:
+        log.info("====== training finished ======")
+        mlops.log_training_status("FINISHED", str(getattr(self.args, "run_id", "0")))
+        self.finish()
+
+    def send_client_status(self, receive_id: int, status: str) -> None:
+        import platform
+
+        message = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.client_real_id, receive_id)
+        message.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, status)
+        message.add_params(MyMessage.MSG_ARG_KEY_CLIENT_OS, platform.system())
+        self.send_message(message)
+
+    def send_model_to_server(self, receive_id: int, weights, local_sample_num) -> None:
+        mlops.event("comm_c2s", event_started=True, event_value=str(self.args.round_idx))
+        message = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.client_real_id, receive_id)
+        message.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
+        message.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, int(local_sample_num))
+        self.send_message(message)
+
+    def __train(self) -> None:
+        log.info("====== training on round %d ======", self.args.round_idx)
+        mlops.event("train", event_started=True, event_value=str(self.args.round_idx))
+        weights, local_sample_num = self.trainer_dist_adapter.train(self.args.round_idx)
+        mlops.event("train", event_started=False, event_value=str(self.args.round_idx))
+        self.send_model_to_server(0, weights, local_sample_num)

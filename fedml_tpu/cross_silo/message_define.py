@@ -1,0 +1,38 @@
+"""Cross-silo message vocabulary.
+
+Reference: ``cross_silo/client/message_define.py`` + ``server/message_define.py``
+(MyMessage). Same protocol constants so the §3.2 state machine is
+recognizable: ONLINE -> INIT -> (MODEL <-> SYNC)* -> FINISH.
+"""
+
+
+class MyMessage:
+    # connection
+    MSG_TYPE_CONNECTION_IS_READY = 0
+
+    # server -> client
+    MSG_TYPE_S2C_INIT_CONFIG = 1
+    MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = 2
+    MSG_TYPE_S2C_FINISH = 7
+
+    # client -> server
+    MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = 3
+    MSG_TYPE_C2S_CLIENT_STATUS = 5
+
+    # arg keys
+    MSG_ARG_KEY_TYPE = "msg_type"
+    MSG_ARG_KEY_SENDER = "sender"
+    MSG_ARG_KEY_RECEIVER = "receiver"
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+    MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+    MSG_ARG_KEY_CLIENT_OS = "client_os"
+    MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+    MSG_ARG_KEY_LOCAL_TRAINING_DATA_SIZE = "local_sample_num"
+    MSG_ARG_KEY_ROUND_IDX = "round_idx"
+
+    # statuses
+    MSG_CLIENT_STATUS_OFFLINE = "OFFLINE"
+    MSG_CLIENT_STATUS_IDLE = "IDLE"
+    MSG_CLIENT_STATUS_ONLINE = "ONLINE"
+    MSG_CLIENT_STATUS_FINISHED = "FINISHED"

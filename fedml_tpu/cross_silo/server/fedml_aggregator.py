@@ -1,0 +1,108 @@
+"""Server-side aggregation state for cross-silo FL.
+
+Reference: ``cross_silo/server/fedml_aggregator.py:13`` (add_local_trained_
+result, check_whether_all_receive, aggregate:78, client sampling + test).
+The aggregation itself delegates to the alg-frame hooks + jitted agg
+operator.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ... import mlops
+from ...core.alg_frame.context import Context
+
+log = logging.getLogger(__name__)
+
+
+class FedMLAggregator:
+    def __init__(
+        self,
+        train_global,
+        test_global,
+        all_train_data_num,
+        train_data_local_dict,
+        test_data_local_dict,
+        train_data_local_num_dict,
+        client_num: int,
+        device,
+        args: Any,
+        server_aggregator,
+    ):
+        self.aggregator = server_aggregator
+        self.args = args
+        self.train_global = train_global
+        self.test_global = test_global
+        self.all_train_data_num = all_train_data_num
+        self.train_data_local_dict = train_data_local_dict
+        self.test_data_local_dict = test_data_local_dict
+        self.train_data_local_num_dict = train_data_local_num_dict
+        self.client_num = client_num
+        self.device = device
+        self.model_dict: Dict[int, Any] = {}
+        self.sample_num_dict: Dict[int, float] = {}
+        self.flag_client_model_uploaded_dict = {i: False for i in range(client_num)}
+        Context().add(Context.KEY_TEST_DATA, test_global)
+
+    def get_global_model_params(self):
+        return self.aggregator.get_model_params()
+
+    def set_global_model_params(self, model_parameters) -> None:
+        self.aggregator.set_model_params(model_parameters)
+
+    def add_local_trained_result(self, index: int, model_params, sample_num) -> None:
+        log.info("add_model. index = %d", index)
+        self.model_dict[index] = model_params
+        self.sample_num_dict[index] = sample_num
+        self.flag_client_model_uploaded_dict[index] = True
+
+    def check_whether_all_receive(self) -> bool:
+        if all(self.flag_client_model_uploaded_dict.get(i, False) for i in range(self.client_num)):
+            for i in range(self.client_num):
+                self.flag_client_model_uploaded_dict[i] = False
+            return True
+        return False
+
+    def aggregate(self):
+        start = time.time()
+        Context().add("client_indexes_of_round", sorted(self.model_dict))
+        model_list = [(self.sample_num_dict[i], self.model_dict[i]) for i in sorted(self.model_dict)]
+        model_list = self.aggregator.on_before_aggregation(model_list)
+        Context().add(Context.KEY_CLIENT_MODEL_LIST, model_list)
+        averaged = self.aggregator.aggregate(model_list)
+        averaged = self.aggregator.on_after_aggregation(averaged)
+        self.set_global_model_params(averaged)
+        self.aggregator.assess_contribution()
+        self.model_dict.clear()
+        log.info("aggregate time cost: %.3fs", time.time() - start)
+        return averaged
+
+    def data_silo_selection(self, round_idx: int, client_num_in_total: int, client_num_per_round: int) -> List[int]:
+        """reference fedml_aggregator.py data_silo_selection — sample which
+        data silos the online clients should train on this round."""
+        if client_num_in_total == client_num_per_round:
+            return list(range(client_num_in_total))
+        np.random.seed(round_idx)
+        return list(np.random.choice(range(client_num_in_total), client_num_per_round, replace=False))
+
+    def client_selection(self, round_idx: int, client_id_list_in_total: List[int], client_num_per_round: int) -> List[int]:
+        if client_num_per_round == len(client_id_list_in_total):
+            return list(client_id_list_in_total)
+        np.random.seed(round_idx)
+        return list(np.random.choice(client_id_list_in_total, client_num_per_round, replace=False))
+
+    def test_on_server_for_all_clients(self, round_idx: int) -> Optional[Dict[str, float]]:
+        freq = int(getattr(self.args, "frequency_of_the_test", 5))
+        comm_round = int(getattr(self.args, "comm_round", 10))
+        if round_idx % max(freq, 1) != 0 and round_idx != comm_round - 1:
+            return None
+        metrics = self.aggregator.test(self.test_global, self.device, self.args)
+        metrics["round"] = round_idx
+        mlops.log({"round_idx": round_idx, **{k: float(v) for k, v in metrics.items()}}, step=round_idx)
+        log.info("server test round %d: %s", round_idx, metrics)
+        return metrics

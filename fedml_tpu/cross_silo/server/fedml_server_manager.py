@@ -1,0 +1,131 @@
+"""Cross-silo server manager (the WAN state machine, server side).
+
+Reference: ``cross_silo/server/fedml_server_manager.py:15`` — gate on all
+clients ONLINE (:124-144), send_init_msg (:48-67), per-model receive ->
+aggregate -> sync (steps 3-8 of SURVEY §3.2).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from ... import mlops
+from ...core.distributed.communication.message import Message
+from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ..message_define import MyMessage
+
+log = logging.getLogger(__name__)
+
+
+class FedMLServerManager(FedMLCommManager):
+    def __init__(self, args: Any, aggregator, comm=None, client_rank=0, client_num=0, backend="INMEMORY"):
+        super().__init__(args, comm, client_rank, client_num + 1, backend)
+        self.aggregator = aggregator
+        self.round_num = int(getattr(args, "comm_round", 10))
+        self.args.round_idx = 0
+        self.client_online_status: Dict[int, bool] = {}
+        self.client_id_list_in_this_round = None
+        self.data_silo_index_list = None
+        self.is_initialized = False
+        self.final_metrics: Optional[Dict[str, float]] = None
+
+    def run(self) -> None:
+        mlops.log_aggregation_status("INITIALIZING", str(getattr(self.args, "run_id", "0")))
+        super().run()
+
+    # --- round bootstrap --------------------------------------------------
+    def send_init_msg(self) -> None:
+        global_model_params = self.aggregator.get_global_model_params()
+        for idx, client_id in enumerate(self.client_id_list_in_this_round):
+            self.send_message_init_config(
+                client_id, global_model_params, self.data_silo_index_list[idx]
+            )
+        mlops.event("server.wait", event_started=True, event_value=str(self.args.round_idx))
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(MyMessage.MSG_TYPE_CONNECTION_IS_READY, self.handle_message_connection_ready)
+        self.register_message_receive_handler(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.handle_message_client_status_update)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.handle_message_receive_model_from_client
+        )
+
+    # --- handlers ---------------------------------------------------------
+    def handle_message_connection_ready(self, msg_params: Message) -> None:
+        if self.is_initialized:
+            return
+        self.client_id_list_in_this_round = self.aggregator.client_selection(
+            self.args.round_idx,
+            list(range(1, self.size)),
+            int(getattr(self.args, "client_num_per_round", self.size - 1)),
+        )
+        self.data_silo_index_list = self.aggregator.data_silo_selection(
+            self.args.round_idx,
+            int(getattr(self.args, "client_num_in_total", self.size - 1)),
+            len(self.client_id_list_in_this_round),
+        )
+
+    def handle_message_client_status_update(self, msg_params: Message) -> None:
+        status = msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
+        sender = msg_params.get_sender_id()
+        if status == MyMessage.MSG_CLIENT_STATUS_ONLINE:
+            self.client_online_status[sender] = True
+            log.info("client %d online (%d/%d)", sender, len(self.client_online_status), self.size - 1)
+        all_online = all(self.client_online_status.get(cid, False) for cid in range(1, self.size))
+        if all_online and not self.is_initialized:
+            mlops.log_aggregation_status("RUNNING", str(getattr(self.args, "run_id", "0")))
+            self.is_initialized = True
+            self.send_init_msg()
+
+    def handle_message_receive_model_from_client(self, msg_params: Message) -> None:
+        sender_id = msg_params.get_sender_id()
+        model_params = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        local_sample_number = msg_params.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        self.aggregator.add_local_trained_result(sender_id - 1, model_params, local_sample_number)
+        if not self.aggregator.check_whether_all_receive():
+            return
+        mlops.event("server.wait", event_started=False, event_value=str(self.args.round_idx))
+        mlops.event("server.agg_and_eval", event_started=True, event_value=str(self.args.round_idx))
+        global_model_params = self.aggregator.aggregate()
+        metrics = self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+        if metrics is not None:
+            self.final_metrics = metrics
+        mlops.event("server.agg_and_eval", event_started=False, event_value=str(self.args.round_idx))
+        mlops.log_round_info(self.round_num, self.args.round_idx)
+
+        self.args.round_idx += 1
+        if self.args.round_idx >= self.round_num:
+            mlops.log_aggregation_status("FINISHED", str(getattr(self.args, "run_id", "0")))
+            self.send_finish_to_all()
+            self.finish()
+            return
+        self.client_id_list_in_this_round = self.aggregator.client_selection(
+            self.args.round_idx, list(range(1, self.size)), int(getattr(self.args, "client_num_per_round", self.size - 1))
+        )
+        self.data_silo_index_list = self.aggregator.data_silo_selection(
+            self.args.round_idx,
+            int(getattr(self.args, "client_num_in_total", self.size - 1)),
+            len(self.client_id_list_in_this_round),
+        )
+        for idx, receiver_id in enumerate(self.client_id_list_in_this_round):
+            self.send_message_sync_model_to_client(receiver_id, global_model_params, self.data_silo_index_list[idx])
+        mlops.event("server.wait", event_started=True, event_value=str(self.args.round_idx))
+
+    # --- senders ----------------------------------------------------------
+    def send_message_init_config(self, receive_id: int, global_model_params, datasilo_index) -> None:
+        message = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.get_sender_id(), receive_id)
+        message.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model_params)
+        message.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(datasilo_index))
+        self.send_message(message)
+
+    def send_message_sync_model_to_client(self, receive_id: int, global_model_params, client_index) -> None:
+        message = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.get_sender_id(), receive_id)
+        message.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model_params)
+        message.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(client_index))
+        message.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.args.round_idx)
+        self.send_message(message)
+
+    def send_finish_to_all(self) -> None:
+        for client_id in range(1, self.size):
+            message = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.get_sender_id(), client_id)
+            self.send_message(message)

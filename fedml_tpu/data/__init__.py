@@ -1,0 +1,14 @@
+"""Data zoo facade: ``fedml_tpu.data.load(args)`` (reference:
+``fedml.data.load`` at data/data_loader.py:234). Returns
+``(dataset_tuple, class_num)``."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def load(args: Any):
+    from .data_loader import load as _load
+
+    dataset = _load(args)
+    return dataset, dataset[-1]

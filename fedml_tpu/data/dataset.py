@@ -1,0 +1,60 @@
+"""Array-backed dataset shards.
+
+TPU-native replacement for the reference's per-client ``torch.DataLoader``
+dicts (``data/data_loader.py``): a client shard is a pair of contiguous
+numpy arrays. Trainers device_put the whole shard once and run the batch
+loop inside ``lax.scan`` — no host-side iterator in the hot loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ArrayDataset:
+    """One shard: features [N, ...] + labels [N] (or [N, ...])."""
+
+    x: np.ndarray
+    y: np.ndarray
+
+    def __post_init__(self):
+        assert len(self.x) == len(self.y), (self.x.shape, self.y.shape)
+
+    def __len__(self) -> int:
+        return len(self.x)
+
+    def batches(self, batch_size: int, *, shuffle: bool = False, seed: int = 0, drop_last: bool = False
+                ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        idx = np.arange(len(self.x))
+        if shuffle:
+            np.random.default_rng(seed).shuffle(idx)
+        n = len(idx)
+        end = n - (n % batch_size) if drop_last and n >= batch_size else n
+        for start in range(0, end, batch_size):
+            sel = idx[start : start + batch_size]
+            yield self.x[sel], self.y[sel]
+
+    def padded_batches_array(self, batch_size: int, *, seed: int = 0) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Shuffle + pad to a whole number of batches; returns
+        (x [num_batches, B, ...], y [num_batches, B, ...], mask [num_batches, B]).
+
+        This is the lax.scan-friendly layout: static shapes, a validity mask
+        instead of a ragged tail.
+        """
+        idx = np.arange(len(self.x))
+        np.random.default_rng(seed).shuffle(idx)
+        n = len(idx)
+        num_batches = max(1, -(-n // batch_size))
+        pad = num_batches * batch_size - n
+        idx_padded = np.concatenate([idx, idx[: pad]]) if pad else idx
+        mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+        x = self.x[idx_padded].reshape((num_batches, batch_size) + self.x.shape[1:])
+        y = self.y[idx_padded].reshape((num_batches, batch_size) + self.y.shape[1:])
+        return x, y, mask.reshape(num_batches, batch_size)
+
+    def subset(self, indices: np.ndarray) -> "ArrayDataset":
+        return ArrayDataset(self.x[indices], self.y[indices])

@@ -1,0 +1,133 @@
+"""Raw dataset sources.
+
+Reference: ``python/fedml/data/`` downloads each dataset (wget/S3) into
+``data_cache_dir``. This environment has no egress, so each source first
+looks for canonical local files in ``data_cache_dir`` and otherwise
+synthesizes a deterministic surrogate with the real dataset's shapes, class
+count, and a non-trivial learnable structure (class-dependent means) so FL
+algorithms train and accuracy is meaningful. The surrogate path is logged
+loudly; dropping real files into ``data_cache_dir`` switches to them without
+code changes.
+
+Canonical local files recognized:
+  - mnist:   ``{cache}/mnist.npz``       (keys x_train,y_train,x_test,y_test)
+  - cifar10: ``{cache}/cifar10.npz``     (same keys, NHWC uint8)
+  - cifar100:``{cache}/cifar100.npz``
+  - femnist: ``{cache}/femnist.npz``     (+ optional writer ids)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def _synthetic_classification(
+    n: int, shape: Tuple[int, ...], classes: int, proto_seed: int, sample_seed: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Class-conditional Gaussian images: learnable but not trivial.
+
+    Class prototypes depend only on ``proto_seed`` so train/test splits share
+    the same class structure; ``sample_seed`` draws the samples."""
+    dim = int(np.prod(shape))
+    protos = np.random.default_rng(proto_seed).normal(0.0, 1.0, size=(classes, dim)).astype(np.float32)
+    rng = np.random.default_rng(sample_seed)
+    y = rng.integers(0, classes, size=n)
+    x = protos[y] * 0.35 + rng.normal(0, 1.0, size=(n, dim)).astype(np.float32)
+    return x.reshape((n,) + shape).astype(np.float32), y.astype(np.int64)
+
+
+def _load_npz(path: str):
+    with np.load(path) as z:
+        return (
+            z["x_train"].astype(np.float32),
+            z["y_train"].astype(np.int64),
+            z["x_test"].astype(np.float32),
+            z["y_test"].astype(np.int64),
+        )
+
+
+def load_image_dataset(name: str, cache_dir: str, seed: int = 0):
+    """-> (x_train, y_train, x_test, y_test, num_classes)."""
+    specs = {
+        "mnist": ((28, 28, 1), 10, 60000, 10000),
+        "femnist": ((28, 28, 1), 62, 40000, 8000),
+        "fashion_mnist": ((28, 28, 1), 10, 60000, 10000),
+        "cifar10": ((32, 32, 3), 10, 50000, 10000),
+        "cifar100": ((32, 32, 3), 100, 50000, 10000),
+        "cinic10": ((32, 32, 3), 10, 90000, 9000),
+        "fed_cifar100": ((32, 32, 3), 100, 50000, 10000),
+    }
+    shape, classes, n_train, n_test = specs[name]
+    path = os.path.join(cache_dir or "", f"{name}.npz")
+    if cache_dir and os.path.exists(path):
+        x_tr, y_tr, x_te, y_te = _load_npz(path)
+        if x_tr.max() > 2.0:
+            x_tr, x_te = x_tr / 255.0, x_te / 255.0
+        if x_tr.ndim == 3 and len(shape) == 3:
+            x_tr, x_te = x_tr[..., None], x_te[..., None]
+        return x_tr, y_tr, x_te, y_te, classes
+    log.warning("dataset %s: no local file at %s — using deterministic synthetic surrogate", name, path)
+    # keep surrogate sizes small enough for fast simulation
+    n_train, n_test = min(n_train, 12000), min(n_test, 2000)
+    x_tr, y_tr = _synthetic_classification(n_train, shape, classes, seed, seed + 1)
+    x_te, y_te = _synthetic_classification(n_test, shape, classes, seed, seed + 2)
+    return x_tr, y_tr, x_te, y_te, classes
+
+
+def load_text_dataset(name: str, cache_dir: str, seed: int = 0):
+    """-> (x_train [N,T] int, y_train [N,T] int, x_test, y_test, vocab).
+
+    Next-token targets: y[t] = x[t+1] shape convention (shifted inside)."""
+    specs = {
+        "shakespeare": (80, 90, 8000, 1000),
+        "fed_shakespeare": (80, 90, 8000, 1000),
+        "stackoverflow_nwp": (20, 10004, 8000, 1000),
+    }
+    T, vocab, n_train, n_test = specs[name]
+    path = os.path.join(cache_dir or "", f"{name}.npz")
+    if cache_dir and os.path.exists(path):
+        with np.load(path) as z:
+            return z["x_train"], z["y_train"], z["x_test"], z["y_test"], vocab
+    log.warning("dataset %s: no local file — synthetic markov text surrogate", name)
+    rng = np.random.default_rng(seed)
+    # order-1 markov chain so there is real next-token signal
+    trans = rng.dirichlet(np.ones(vocab) * 0.05, size=vocab)
+
+    def sample(n):
+        seqs = np.zeros((n, T + 1), np.int64)
+        seqs[:, 0] = rng.integers(0, vocab, n)
+        for t in range(T):
+            p = trans[seqs[:, t]]
+            cum = p.cumsum(axis=1)
+            r = rng.random((n, 1))
+            seqs[:, t + 1] = (cum < r).sum(axis=1)
+        return seqs[:, :T], seqs[:, 1 : T + 1]
+
+    x_tr, y_tr = sample(n_train)
+    x_te, y_te = sample(n_test)
+    return x_tr, y_tr, x_te, y_te, vocab
+
+
+def load_synthetic_lr(alpha: float, beta: float, n_clients: int, seed: int = 0, dim: int = 60, classes: int = 10):
+    """LEAF synthetic(alpha,beta) (reference: data/synthetic_1_1/). Returns
+    per-client (x, y) lists with client-specific model/feature drift."""
+    rng = np.random.default_rng(seed)
+    out = []
+    B = rng.normal(0, beta, n_clients)
+    for k in range(n_clients):
+        n_k = int(np.clip(rng.lognormal(4, 2), 50, 1000))
+        u_k = rng.normal(B[k], 1, 1)
+        mean_x = rng.normal(B[k], 1, dim)
+        W = rng.normal(u_k, alpha, (dim, classes))
+        b = rng.normal(u_k, alpha, classes)
+        x = rng.normal(mean_x, 1.0, (n_k, dim)).astype(np.float32)
+        logits = x @ W + b
+        y = np.argmax(logits + rng.gumbel(size=logits.shape), axis=1).astype(np.int64)
+        out.append((x, y))
+    return out, classes

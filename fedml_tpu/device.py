@@ -1,0 +1,34 @@
+"""Device selection (reference: python/fedml/device/device.py:42 +
+ml/engine/ml_engine_adapter.py:176-229).
+
+In the reference this maps (platform, gpu ids, engine) to torch/tf/jax
+devices; here JAX is the engine so the job is simpler: pick the accelerator
+if present, else CPU, and expose mesh construction for sharded paths
+(see fedml_tpu.parallel.mesh)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+def get_device(args: Optional[Any] = None):
+    """Return the default compute device for this process."""
+    using_gpu = bool(getattr(args, "using_gpu", True)) if args is not None else True
+    devices = jax.devices()
+    accel = [d for d in devices if d.platform != "cpu"]
+    dev = (accel[0] if accel else devices[0]) if using_gpu else jax.devices("cpu")[0]
+    if args is not None:
+        gpu_id = int(getattr(args, "gpu_id", 0) or 0)
+        pool = accel if (using_gpu and accel) else devices
+        dev = pool[gpu_id % len(pool)]
+    log.info("device = %s", dev)
+    return dev
+
+
+def get_local_device_count() -> int:
+    return jax.local_device_count()

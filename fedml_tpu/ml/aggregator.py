@@ -1,0 +1,51 @@
+"""Default server aggregator (reference: ml/aggregator/default_aggregator.py
++ aggregator_creator.py:13). One class covers classification/nwp/prediction
+because evaluation dispatches on label shape (see local_sgd.make_eval_fn)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..core.alg_frame.server_aggregator import ServerAggregator
+from ..data.dataset import ArrayDataset
+from ..models.model_hub import FedModel
+from .trainer.local_sgd import make_eval_fn
+
+log = logging.getLogger(__name__)
+
+
+class DefaultServerAggregator(ServerAggregator):
+    def __init__(self, model: FedModel, args: Any):
+        super().__init__(model, args)
+        self._eval_batch = make_eval_fn(model)
+
+    def get_model_params(self):
+        return self.model.params
+
+    def set_model_params(self, model_parameters) -> None:
+        self.model = self.model.clone_with(model_parameters)
+
+    def test(self, test_data: ArrayDataset, device=None, args: Any = None):
+        args = args or self.args
+        if test_data is None:
+            return {"test_loss": 0.0, "test_acc": 0.0, "test_total": 0.0, "test_correct": 0.0}
+        batch_size = int(getattr(args, "batch_size", 32))
+        loss_sum = correct = count = 0.0
+        for bx, by in test_data.batches(batch_size):
+            l, c, n = self._eval_batch(self.model.params, jnp.asarray(bx), jnp.asarray(by))
+            loss_sum += float(l)
+            correct += float(c)
+            count += float(n)
+        return {
+            "test_loss": loss_sum / max(count, 1.0),
+            "test_correct": correct,
+            "test_total": count,
+            "test_acc": correct / max(count, 1.0),
+        }
+
+
+def create_server_aggregator(model: FedModel, args: Any) -> DefaultServerAggregator:
+    return DefaultServerAggregator(model, args)

@@ -1,0 +1,70 @@
+"""Generic classification/nwp client trainer.
+
+Reference: ``ml/trainer/my_model_trainer_classification.py`` (and the nwp/tag
+variants — in JAX one trainer covers all three because the loss fn dispatches
+on label shape/dtype). The whole local round is one jitted call (see
+local_sgd.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.alg_frame.client_trainer import ClientTrainer
+from ...data.dataset import ArrayDataset
+from ...models.model_hub import FedModel
+from .local_sgd import epoch_index_array, make_eval_fn, make_local_train_fn
+
+log = logging.getLogger(__name__)
+
+
+class ClassificationTrainer(ClientTrainer):
+    def __init__(self, model: FedModel, args: Any):
+        super().__init__(model, args)
+        self._local_train = make_local_train_fn(model, args)
+        self._eval_batch = make_eval_fn(model)
+        self._round = 0
+
+    # --- params ----------------------------------------------------------
+    def get_model_params(self):
+        return self.model.params
+
+    def set_model_params(self, model_parameters) -> None:
+        self.model = self.model.clone_with(model_parameters)
+
+    # --- training --------------------------------------------------------
+    def train(self, train_data: ArrayDataset, device=None, args: Any = None) -> None:
+        args = args or self.args
+        batch_size = int(getattr(args, "batch_size", 32))
+        epochs = int(getattr(args, "epochs", 1))
+        seed = int(getattr(args, "random_seed", 0)) * 100003 + self.id * 131 + self._round
+        idx, mask = epoch_index_array(len(train_data), batch_size, epochs, seed)
+        x_all = jnp.asarray(train_data.x)
+        y_all = jnp.asarray(train_data.y)
+        rng = jax.random.PRNGKey(seed)
+        result = self._local_train(self.model.params, x_all, y_all, jnp.asarray(idx), jnp.asarray(mask), rng, None)
+        self.set_model_params(result.params)
+        self._round += 1
+        log.debug("client %s local loss %.4f (%d steps)", self.id, float(result.loss), int(result.num_steps))
+
+    # --- evaluation -------------------------------------------------------
+    def test(self, test_data: ArrayDataset, device=None, args: Any = None):
+        args = args or self.args
+        batch_size = int(getattr(args, "batch_size", 32))
+        loss_sum = correct = count = 0.0
+        for bx, by in test_data.batches(batch_size):
+            l, c, n = self._eval_batch(self.model.params, jnp.asarray(bx), jnp.asarray(by))
+            loss_sum += float(l)
+            correct += float(c)
+            count += float(n)
+        return {
+            "test_loss": loss_sum / max(count, 1.0),
+            "test_correct": correct,
+            "test_total": count,
+            "test_acc": correct / max(count, 1.0),
+        }

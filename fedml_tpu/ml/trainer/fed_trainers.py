@@ -1,0 +1,224 @@
+"""Algorithm-specific client trainers.
+
+Reference: ``ml/trainer/{fedprox,fednova,scaffold,feddyn,mime}_trainer.py``.
+Each variant reuses the scan-based local loop (local_sgd.py) with a gradient
+transform and/or structured round payload:
+
+  - FedProx  — proximal term in the loss (mu), payload = plain weights.
+  - FedNova  — payload ``(a_i, d_i)`` with normalized direction d_i.
+  - SCAFFOLD — control variates; payload ``(delta_w, delta_c)``.
+  - FedDyn   — per-client dual variable folded into the gradient.
+  - Mime     — server momentum applied statelessly + full-batch grad payload.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.model_hub import FedModel
+from ...utils.pytree import PyTree, tree_scale, tree_sub, tree_zeros_like, tree_add
+from .classification_trainer import ClassificationTrainer
+from .local_sgd import epoch_index_array, make_local_train_fn, make_loss_fn
+
+log = logging.getLogger(__name__)
+
+
+class FedProxTrainer(ClassificationTrainer):
+    """mu is consumed inside the jitted loss (local_sgd.make_local_train_fn)."""
+
+    def __init__(self, model: FedModel, args: Any):
+        if not getattr(args, "fedprox_mu", None):
+            args.fedprox_mu = 0.1
+        super().__init__(model, args)
+
+
+def _num_steps(n: int, batch_size: int, epochs: int) -> int:
+    return max(1, -(-n // batch_size)) * epochs
+
+
+class FedNovaTrainer(ClassificationTrainer):
+    """Returns (a_i, d_i): local-step scale + normalized direction.
+
+    For SGD with momentum rho over tau steps:
+      a_i = (tau - rho (1 - rho^tau) / (1 - rho)) / (1 - rho); rho=0 -> tau.
+    d_i = (w_global - w_local) / a_i  (lr folded into the server rule via
+    agg_operator.fednova_aggregate).
+    """
+
+    def train(self, train_data, device=None, args: Any = None):
+        args = args or self.args
+        w_global = self.get_model_params()
+        super().train(train_data, device, args)
+        tau = _num_steps(len(train_data), int(getattr(args, "batch_size", 32)), int(getattr(args, "epochs", 1)))
+        rho = float(getattr(args, "momentum", 0.0))
+        if rho > 0:
+            a_i = (tau - rho * (1 - rho**tau) / (1 - rho)) / (1 - rho)
+        else:
+            a_i = float(tau)
+        d_i = tree_scale(tree_sub(w_global, self.get_model_params()), 1.0 / a_i)
+        self.round_payload = (a_i, d_i)
+        return self.round_payload
+
+
+class ScaffoldTrainer(ClassificationTrainer):
+    """SCAFFOLD control-variate trainer (Karimireddy et al. 2020).
+
+    Gradient correction g + c - c_i runs inside the jitted scan; c_i update
+    uses option II of the paper: c_i+ = c_i - c + (w_g - w_l) / (K * lr).
+    """
+
+    def __init__(self, model: FedModel, args: Any):
+        super().__init__(model, args)
+
+        def correct(grads, params, global_params, extras):
+            c_global, c_local = extras
+            return jax.tree.map(lambda g, c, ci: g + c - ci, grads, c_global, c_local)
+
+        self._local_train = make_local_train_fn(model, args, grad_transform=correct)
+        # per-client control variates keyed by trainer id: in simulation one
+        # trainer instance serves many clients (set_id swaps the active one)
+        self._c_local_by_client = {}
+        self.c_global = tree_zeros_like(model.params)
+
+    @property
+    def c_local(self) -> PyTree:
+        if self.id not in self._c_local_by_client:
+            self._c_local_by_client[self.id] = tree_zeros_like(self.model.params)
+        return self._c_local_by_client[self.id]
+
+    @c_local.setter
+    def c_local(self, value: PyTree) -> None:
+        self._c_local_by_client[self.id] = value
+
+    def set_control_variate(self, c_global: PyTree) -> None:
+        self.c_global = c_global
+
+    def train(self, train_data, device=None, args: Any = None):
+        args = args or self.args
+        batch_size = int(getattr(args, "batch_size", 32))
+        epochs = int(getattr(args, "epochs", 1))
+        seed = int(getattr(args, "random_seed", 0)) * 100003 + self.id * 131 + self._round
+        w_global = self.get_model_params()
+        idx, mask = epoch_index_array(len(train_data), batch_size, epochs, seed)
+        result = self._local_train(
+            w_global,
+            jnp.asarray(train_data.x),
+            jnp.asarray(train_data.y),
+            jnp.asarray(idx),
+            jnp.asarray(mask),
+            jax.random.PRNGKey(seed),
+            (self.c_global, self.c_local),
+        )
+        self.set_model_params(result.params)
+        self._round += 1
+        K = float(int(result.num_steps))
+        lr = float(getattr(args, "learning_rate", 0.03))
+        new_c_local = jax.tree.map(
+            lambda ci, c, wg, wl: ci - c + (wg - wl) / (K * lr),
+            self.c_local, self.c_global, w_global, result.params,
+        )
+        delta_w = tree_sub(result.params, w_global)
+        delta_c = tree_sub(new_c_local, self.c_local)
+        self.c_local = new_c_local
+        self.round_payload = (delta_w, delta_c)
+        return self.round_payload
+
+
+class FedDynTrainer(ClassificationTrainer):
+    """FedDyn (Acar et al. 2021): dynamic regularizer via per-client dual.
+
+    Gradient: g - lambda_i + alpha (w - w_global); after the round:
+    lambda_i <- lambda_i - alpha (w_local - w_global).
+    """
+
+    def __init__(self, model: FedModel, args: Any):
+        super().__init__(model, args)
+        self.alpha = float(getattr(args, "feddyn_alpha", 0.01))
+        a = self.alpha
+
+        def correct(grads, params, global_params, extras):
+            lam = extras
+            return jax.tree.map(lambda g, l, w, wg: g - l + a * (w - wg), grads, lam, params, global_params)
+
+        self._local_train = make_local_train_fn(model, args, grad_transform=correct)
+        self._lam_by_client = {}
+
+    @property
+    def lam(self) -> PyTree:
+        if self.id not in self._lam_by_client:
+            self._lam_by_client[self.id] = tree_zeros_like(self.model.params)
+        return self._lam_by_client[self.id]
+
+    @lam.setter
+    def lam(self, value: PyTree) -> None:
+        self._lam_by_client[self.id] = value
+
+    def train(self, train_data, device=None, args: Any = None):
+        args = args or self.args
+        batch_size = int(getattr(args, "batch_size", 32))
+        epochs = int(getattr(args, "epochs", 1))
+        seed = int(getattr(args, "random_seed", 0)) * 100003 + self.id * 131 + self._round
+        w_global = self.get_model_params()
+        idx, mask = epoch_index_array(len(train_data), batch_size, epochs, seed)
+        result = self._local_train(
+            w_global,
+            jnp.asarray(train_data.x),
+            jnp.asarray(train_data.y),
+            jnp.asarray(idx),
+            jnp.asarray(mask),
+            jax.random.PRNGKey(seed),
+            self.lam,
+        )
+        self.set_model_params(result.params)
+        self._round += 1
+        self.lam = jax.tree.map(lambda l, wl, wg: l - self.alpha * (wl - wg), self.lam, result.params, w_global)
+        return result.params
+
+
+class MimeTrainer(ClassificationTrainer):
+    """MimeLite (Karimireddy et al. 2021): apply the *server* momentum
+    statelessly during local steps; ship back a full-batch gradient at the
+    received weights for the server's momentum update."""
+
+    def __init__(self, model: FedModel, args: Any):
+        super().__init__(model, args)
+        self.beta = float(getattr(args, "mime_beta", 0.9))
+        b = self.beta
+
+        def correct(grads, params, global_params, extras):
+            s = extras  # server momentum
+            return jax.tree.map(lambda g, m: (1.0 - b) * g + b * m, grads, s)
+
+        self._local_train = make_local_train_fn(model, args, grad_transform=correct)
+        self.server_momentum = tree_zeros_like(model.params)
+        self._loss_fn = make_loss_fn(model)
+        self._full_grad = jax.jit(
+            lambda p, x, y, m, r: jax.grad(self._loss_fn)(p, x, y, m, r)
+        )
+
+    def set_server_momentum(self, s: PyTree) -> None:
+        self.server_momentum = s
+
+    def train(self, train_data, device=None, args: Any = None):
+        args = args or self.args
+        batch_size = int(getattr(args, "batch_size", 32))
+        epochs = int(getattr(args, "epochs", 1))
+        seed = int(getattr(args, "random_seed", 0)) * 100003 + self.id * 131 + self._round
+        w_global = self.get_model_params()
+        x = jnp.asarray(train_data.x)
+        y = jnp.asarray(train_data.y)
+        # full-batch gradient at the received model (for server momentum)
+        full_grad = self._full_grad(w_global, x, y, jnp.ones(len(train_data), jnp.float32), jax.random.PRNGKey(seed))
+        idx, mask = epoch_index_array(len(train_data), batch_size, epochs, seed)
+        result = self._local_train(
+            w_global, x, y, jnp.asarray(idx), jnp.asarray(mask), jax.random.PRNGKey(seed), self.server_momentum
+        )
+        self.set_model_params(result.params)
+        self._round += 1
+        self.round_payload = (result.params, full_grad)
+        return self.round_payload

@@ -1,0 +1,140 @@
+"""MLOps observability: metrics, events (spans), run status, sys perf.
+
+Reference: ``python/fedml/core/mlops/__init__.py:96-1460`` — the public
+surface (``log``, ``event``, ``log_round_info``, status fns) backed by MQTT+
+REST uploaders. Here the runtime is local-first: metrics/events are kept
+in-process, appended as JSONL under ``run_dir``, and optionally bridged to
+wandb when available. The WAN uploaders can be attached via the message
+plane later without changing call sites.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class MLOpsProfilerEvent:
+    """Named span logger (reference: mlops_profiler_event.py).
+
+    Spans wrap jit dispatch / comm phases; ``to_list`` exposes them for
+    tests and for the log daemon."""
+
+    def __init__(self, runtime: "MLOpsRuntime"):
+        self._runtime = runtime
+        self._open: Dict[str, float] = {}
+
+    def log_event_started(self, event_name: str, event_value: Optional[str] = None) -> None:
+        self._open[event_name] = time.time()
+        self._runtime.append_record({"type": "event_started", "name": event_name, "value": event_value, "t": self._open[event_name]})
+
+    def log_event_ended(self, event_name: str, event_value: Optional[str] = None) -> None:
+        t0 = self._open.pop(event_name, None)
+        t1 = time.time()
+        self._runtime.append_record(
+            {"type": "event_ended", "name": event_name, "value": event_value, "t": t1, "duration": (t1 - t0) if t0 else None}
+        )
+
+
+class MLOpsRuntime:
+    _instance: Optional["MLOpsRuntime"] = None
+
+    @classmethod
+    def get_instance(cls) -> "MLOpsRuntime":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self.run_dir: Optional[str] = None
+        self.records: List[Dict[str, Any]] = []
+        self.metrics: List[Dict[str, Any]] = []
+        self._wandb = None
+        self.profiler = MLOpsProfilerEvent(self)
+
+    def init(self, args: Any) -> None:
+        self.enabled = bool(getattr(args, "using_mlops", False)) or bool(getattr(args, "enable_tracking", False))
+        run_id = str(getattr(args, "run_id", "0"))
+        base = os.path.join(os.path.expanduser(getattr(args, "log_file_dir", "~/.fedml_tpu/logs")))
+        self.run_dir = os.path.join(base, f"run_{run_id}")
+        if self.enabled:
+            os.makedirs(self.run_dir, exist_ok=True)
+        if getattr(args, "enable_wandb", False):  # reference: __init__.py:250-281
+            try:
+                import wandb
+
+                self._wandb = wandb
+                wandb.init(project=getattr(args, "wandb_project", "fedml_tpu"), config=vars(args))
+            except Exception:  # pragma: no cover - wandb optional
+                log.warning("wandb requested but unavailable")
+
+    def append_record(self, rec: Dict[str, Any]) -> None:
+        self.records.append(rec)
+        if self.enabled and self.run_dir:
+            with open(os.path.join(self.run_dir, "events.jsonl"), "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+
+def log(metrics: Dict[str, Any], step: Optional[int] = None, commit: bool = True) -> None:
+    """Reference: mlops.log at core/mlops/__init__.py:175."""
+    rt = MLOpsRuntime.get_instance()
+    rec = {"type": "metric", "step": step, **{k: float(v) if isinstance(v, (int, float)) else v for k, v in metrics.items()}}
+    rt.metrics.append(rec)
+    rt.append_record(rec)
+    if rt._wandb is not None:
+        rt._wandb.log(metrics, step=step, commit=commit)
+
+
+def event(event_name: str, event_started: bool = True, event_value: Optional[str] = None) -> None:
+    """Reference: mlops.event at core/mlops/__init__.py:158."""
+    rt = MLOpsRuntime.get_instance()
+    if event_started:
+        rt.profiler.log_event_started(event_name, event_value)
+    else:
+        rt.profiler.log_event_ended(event_name, event_value)
+
+
+def log_round_info(total_rounds: int, round_index: int) -> None:
+    """Reference: mlops.log_round_info at core/mlops/__init__.py:1001."""
+    log({"round_index": round_index, "total_rounds": total_rounds}, step=round_index)
+
+
+def log_training_status(status: str, run_id: Optional[str] = None) -> None:
+    MLOpsRuntime.get_instance().append_record({"type": "status", "role": "client", "status": status, "run_id": run_id})
+
+
+def log_aggregation_status(status: str, run_id: Optional[str] = None) -> None:
+    MLOpsRuntime.get_instance().append_record({"type": "status", "role": "server", "status": status, "run_id": run_id})
+
+
+def log_sys_perf(args: Any = None) -> None:
+    """System perf sampling (reference: mlops_device_perfs.py). Samples
+    psutil counters once per call; TPU utilization comes from jax device
+    memory stats when exposed."""
+    try:
+        import psutil
+
+        rec = {
+            "type": "sys_perf",
+            "cpu_pct": psutil.cpu_percent(interval=None),
+            "mem_pct": psutil.virtual_memory().percent,
+            "t": time.time(),
+        }
+    except Exception:  # pragma: no cover
+        rec = {"type": "sys_perf", "t": time.time()}
+    try:
+        import jax
+
+        d = jax.devices()[0]
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats:
+            rec["device_bytes_in_use"] = stats.get("bytes_in_use")
+    except Exception:  # pragma: no cover
+        pass
+    MLOpsRuntime.get_instance().append_record(rec)

@@ -1,0 +1,55 @@
+"""CNNs from the FedAvg paper family.
+
+Reference: ``python/fedml/model/cv/cnn.py`` (CNN_DropOut used for
+MNIST/FEMNIST, the "CNN (FedAvg original)" of McMahan et al. 2017). NHWC
+layout throughout — the TPU-native convolution layout.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CNNDropOut(nn.Module):
+    """McMahan et al. CNN: 2x(conv3x3 + maxpool) + dense, with dropout.
+
+    Matches the reference's CNN_DropOut shape for 28x28x1 inputs
+    (``model/cv/cnn.py`` CNN_DropOut: conv 32, conv 64, fc 128, fc classes).
+    """
+
+    num_classes: int = 10
+    only_digits: bool = True
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class CNNCifar(nn.Module):
+    """Simple CIFAR CNN (reference: model/cv/cnn.py CNN_CIFAR-style)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = nn.relu(nn.Conv(32, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3))(x))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(64)(x))
+        return nn.Dense(self.num_classes)(x)

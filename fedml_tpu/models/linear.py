@@ -1,0 +1,35 @@
+"""Linear models (reference: python/fedml/model/linear/lr.py)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LogisticRegression(nn.Module):
+    """LR as used by the quick-start configs (model="lr").
+
+    Reference: ``model/linear/lr.py`` (torch ``nn.Linear``; sigmoid/softmax
+    folded into the loss). Input is flattened; logits returned.
+    """
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, name="linear")(x)
+
+
+class TwoNN(nn.Module):
+    """2-hidden-layer MLP baseline (reference: MNIST MLP examples)."""
+
+    hidden: int = 200
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,114 @@
+"""Model factory keyed on (model_name, dataset).
+
+Reference: ``python/fedml/model/model_hub.py:19-90`` (``create``). Returns a
+:class:`FedModel` — the framework's model handle: a flax module plus its
+parameter pytree and the input spec needed to (re)initialize it. Parameters
+are plain pytrees so the rest of the stack (aggregation, DP, compression,
+comm) never touches framework objects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .cnn import CNNCifar, CNNDropOut
+from .linear import LogisticRegression, TwoNN
+from .rnn import RNNOriginalFedAvg, RNNStackOverflow
+from .resnet import ResNet18GN, resnet20, resnet56
+
+
+@dataclasses.dataclass
+class FedModel:
+    """Model handle: flax module + parameter pytree + input spec."""
+
+    module: nn.Module
+    params: Any
+    input_shape: Tuple[int, ...]
+    input_dtype: Any = jnp.float32
+    name: str = "model"
+
+    def apply(self, params, x, train: bool = False, rngs=None):
+        return self.module.apply({"params": params}, x, train=train, rngs=rngs)
+
+    def init_params(self, seed: int = 0):
+        key = jax.random.PRNGKey(seed)
+        dummy = jnp.zeros(self.input_shape, self.input_dtype)
+        variables = self.module.init({"params": key, "dropout": key}, dummy, train=False)
+        return variables["params"]
+
+    def clone_with(self, params) -> "FedModel":
+        return dataclasses.replace(self, params=params)
+
+    @property
+    def num_params(self) -> int:
+        return sum(int(x.size) for x in jax.tree.leaves(self.params))
+
+
+_INPUT_SPECS = {
+    # dataset -> (example input shape [B=1], int input?)
+    "mnist": ((1, 28, 28, 1), jnp.float32),
+    "femnist": ((1, 28, 28, 1), jnp.float32),
+    "fashion_mnist": ((1, 28, 28, 1), jnp.float32),
+    "cifar10": ((1, 32, 32, 3), jnp.float32),
+    "cifar100": ((1, 32, 32, 3), jnp.float32),
+    "cinic10": ((1, 32, 32, 3), jnp.float32),
+    "fed_cifar100": ((1, 32, 32, 3), jnp.float32),
+    "synthetic": ((1, 60), jnp.float32),
+    "shakespeare": ((1, 80), jnp.int32),
+    "fed_shakespeare": ((1, 80), jnp.int32),
+    "stackoverflow_nwp": ((1, 20), jnp.int32),
+    "stackoverflow_lr": ((1, 10000), jnp.float32),
+}
+
+
+def input_spec_for(dataset: str) -> Tuple[Tuple[int, ...], Any]:
+    return _INPUT_SPECS.get(dataset, ((1, 28, 28, 1), jnp.float32))
+
+
+def create(args: Any, output_dim: Optional[int] = None, seed: Optional[int] = None) -> FedModel:
+    """Mirror of reference ``fedml.model.create`` dispatch (model_hub.py:19)."""
+    model_name = str(getattr(args, "model", "lr")).lower()
+    dataset = str(getattr(args, "dataset", "mnist")).lower()
+    num_classes = int(output_dim or getattr(args, "output_dim", 10))
+    seed = int(seed if seed is not None else getattr(args, "random_seed", 0))
+    in_shape, in_dtype = input_spec_for(dataset)
+
+    if model_name in ("lr", "logistic_regression"):
+        module: nn.Module = LogisticRegression(num_classes=num_classes)
+    elif model_name in ("mlp", "two_nn"):
+        module = TwoNN(num_classes=num_classes)
+    elif model_name in ("cnn", "cnn_dropout"):
+        module = CNNDropOut(num_classes=num_classes) if in_shape[1] == 28 else CNNCifar(num_classes=num_classes)
+    elif model_name == "cnn_cifar":
+        module = CNNCifar(num_classes=num_classes)
+    elif model_name in ("rnn", "rnn_fedavg"):
+        module = RNNOriginalFedAvg()
+    elif model_name in ("rnn_stackoverflow", "rnn_nwp"):
+        module = RNNStackOverflow()
+    elif model_name in ("resnet56", "resnet"):
+        module = resnet56(num_classes=num_classes)
+    elif model_name == "resnet20":
+        module = resnet20(num_classes=num_classes)
+    elif model_name in ("resnet18", "resnet18_gn"):
+        module = ResNet18GN(num_classes=num_classes)
+    elif model_name in ("mobilenet", "mobilenet_v3"):
+        from .mobilenet import MobileNetV3Small
+
+        module = MobileNetV3Small(num_classes=num_classes)
+    elif model_name in ("llama", "gpt", "transformer"):
+        from .transformer import TransformerLM, TransformerConfig
+
+        cfg = TransformerConfig.from_args(args)
+        module = TransformerLM(cfg)
+        in_shape, in_dtype = (1, int(getattr(args, "seq_len", 128))), jnp.int32
+    else:
+        raise ValueError(f"unknown model {model_name!r}")
+
+    model = FedModel(module=module, params=None, input_shape=in_shape, input_dtype=in_dtype, name=model_name)
+    model.params = model.init_params(seed)
+    return model

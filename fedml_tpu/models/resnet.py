@@ -1,0 +1,89 @@
+"""ResNets for CIFAR/ImageNet-scale federated vision.
+
+Reference: ``python/fedml/model/cv/resnet56.py`` (ResNet-56, the Octopus
+benchmark model) and ``model/cv/resnet_gn.py`` (ResNet-18 with GroupNorm —
+BatchNorm is known-bad under non-IID FL, the reference swaps in GN; we do the
+same). NHWC, bfloat16-friendly; BN replaced by GroupNorm everywhere so client
+updates carry no running statistics (pure parameter pytrees).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    norm: ModuleDef = nn.GroupNorm
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), use_bias=False)(y)
+        y = self.norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), self.strides, use_bias=False, name="proj")(residual)
+            residual = self.norm(name="proj_norm")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNetCifar(nn.Module):
+    """6n+2 CIFAR ResNet (n=9 -> ResNet-56). Reference: resnet56.py."""
+
+    depth: int = 56
+    num_classes: int = 10
+    width: int = 16
+    group_norm_groups: int = 8
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        n = (self.depth - 2) // 6
+        norm = partial(nn.GroupNorm, num_groups=self.group_norm_groups)
+        x = nn.Conv(self.width, (3, 3), use_bias=False)(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        for stage, filters in enumerate([self.width, 2 * self.width, 4 * self.width]):
+            for block in range(n):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = BasicBlock(filters, strides, norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class ResNet18GN(nn.Module):
+    """ImageNet-style ResNet-18 with GroupNorm (reference: resnet_gn.py)."""
+
+    num_classes: int = 1000
+    group_norm_groups: int = 32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        norm = partial(nn.GroupNorm, num_groups=self.group_norm_groups)
+        x = nn.Conv(64, (7, 7), (2, 2), use_bias=False)(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, filters in enumerate([64, 128, 256, 512]):
+            for block in range(2):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = BasicBlock(filters, strides, norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def resnet56(num_classes: int = 10) -> ResNetCifar:
+    return ResNetCifar(depth=56, num_classes=num_classes)
+
+
+def resnet20(num_classes: int = 10) -> ResNetCifar:
+    return ResNetCifar(depth=20, num_classes=num_classes)

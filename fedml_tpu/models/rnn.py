@@ -1,0 +1,51 @@
+"""Recurrent models for the federated text tasks.
+
+Reference: ``python/fedml/model/nlp/rnn.py`` — RNN_OriginalFedAvg (2-layer
+LSTM for fed_shakespeare next-char) and RNN_StackOverFlow (next-word
+prediction). Recurrence runs under ``nn.RNN`` (lax.scan inside), static
+shapes, so the whole unroll compiles to one XLA while-loop.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class RNNOriginalFedAvg(nn.Module):
+    """Char-LSTM for Shakespeare (embedding 8, 2x LSTM(256), dense vocab).
+
+    Matches reference RNN_OriginalFedAvg (model/nlp/rnn.py:6-39).
+    """
+
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        emb = nn.Embed(self.vocab_size, self.embedding_dim)(x)  # [B, T, E]
+        h = nn.RNN(nn.LSTMCell(self.hidden_size))(emb)
+        h = nn.RNN(nn.LSTMCell(self.hidden_size))(h)
+        return nn.Dense(self.vocab_size)(h)  # [B, T, V] logits
+
+
+class RNNStackOverflow(nn.Module):
+    """Next-word-prediction LSTM for stackoverflow_nwp.
+
+    Matches reference RNN_StackOverFlow (model/nlp/rnn.py:42-77):
+    vocab 10k (+special), embed 96, LSTM 670, two projections.
+    """
+
+    vocab_size: int = 10000
+    num_oov_buckets: int = 1
+    embedding_size: int = 96
+    latent_size: int = 670
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        extended_vocab = self.vocab_size + 3 + self.num_oov_buckets  # pad/bos/eos + oov
+        emb = nn.Embed(extended_vocab, self.embedding_size)(x)
+        h = nn.RNN(nn.LSTMCell(self.latent_size))(emb)
+        h = nn.Dense(self.embedding_size)(h)
+        return nn.Dense(extended_vocab)(h)

@@ -1,0 +1,48 @@
+"""Device-mesh construction helpers.
+
+The intra-silo parallel plane (SURVEY §2.b): where the reference builds
+NCCL/Gloo process groups (``torch_process_group_manager.py:26-34``), the TPU
+framework builds a ``jax.sharding.Mesh`` over local (or pod-wide) devices
+and lets pjit/shard_map insert ICI collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def create_mesh(axis_shapes: Sequence[int], axis_names: Sequence[str], devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    n = int(np.prod(axis_shapes))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(axis_shapes)
+    return Mesh(arr, tuple(axis_names))
+
+
+def dp_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D data-parallel mesh over local devices (DDP analogue)."""
+    devices = devices if devices is not None else jax.devices()
+    n = n_devices or len(devices)
+    return create_mesh((n,), ("dp",), devices)
+
+
+def fsdp_mesh(dp: int, fsdp: int, devices=None) -> Mesh:
+    return create_mesh((dp, fsdp), ("dp", "fsdp"), devices)
+
+
+def tp_mesh(dp: int, fsdp: int, tp: int, devices=None) -> Mesh:
+    """3-D mesh for the LLM path: data x fully-sharded x tensor."""
+    return create_mesh((dp, fsdp, tp), ("dp", "fsdp", "tp"), devices)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))

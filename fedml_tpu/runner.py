@@ -1,0 +1,89 @@
+"""Runner dispatch: (training_type, backend, role) -> concrete runner.
+
+Reference: ``python/fedml/runner.py:19-185`` (``FedMLRunner``). Same
+dispatch vocabulary; simulation backends map to the TPU-native simulators
+(simulation/simulator.py), cross-silo to the manager pair in cross_silo/.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from .constants import (
+    FEDML_SIMULATION_TYPE_MPI,
+    FEDML_SIMULATION_TYPE_NCCL,
+    FEDML_SIMULATION_TYPE_SP,
+    FEDML_SIMULATION_TYPE_VMAP,
+    FEDML_TRAINING_PLATFORM_CROSS_DEVICE,
+    FEDML_TRAINING_PLATFORM_CROSS_SILO,
+    FEDML_TRAINING_PLATFORM_CROSS_CLOUD,
+    FEDML_TRAINING_PLATFORM_SIMULATION,
+)
+
+log = logging.getLogger(__name__)
+
+
+class FedMLRunner:
+    def __init__(
+        self,
+        args: Any,
+        device: Any,
+        dataset,
+        model,
+        client_trainer: Optional[Any] = None,
+        server_aggregator: Optional[Any] = None,
+    ):
+        self.args = args
+        if args.training_type == FEDML_TRAINING_PLATFORM_SIMULATION:
+            self.runner = self._init_simulation_runner(args, device, dataset, model, client_trainer, server_aggregator)
+        elif args.training_type == FEDML_TRAINING_PLATFORM_CROSS_SILO:
+            self.runner = self._init_cross_silo_runner(args, device, dataset, model, client_trainer, server_aggregator)
+        elif args.training_type == FEDML_TRAINING_PLATFORM_CROSS_CLOUD:
+            self.runner = self._init_cross_cloud_runner(args, device, dataset, model, client_trainer, server_aggregator)
+        elif args.training_type == FEDML_TRAINING_PLATFORM_CROSS_DEVICE:
+            self.runner = self._init_cross_device_runner(args, device, dataset, model, server_aggregator)
+        else:
+            raise ValueError(f"unknown training_type {args.training_type!r}")
+
+    @staticmethod
+    def _init_simulation_runner(args, device, dataset, model, client_trainer, server_aggregator):
+        from .simulation.simulator import SimulatorMPI, SimulatorSingleProcess, SimulatorVmap
+
+        backend = getattr(args, "backend", FEDML_SIMULATION_TYPE_SP)
+        if backend == FEDML_SIMULATION_TYPE_SP:
+            return SimulatorSingleProcess(args, device, dataset, model, client_trainer, server_aggregator)
+        if backend == FEDML_SIMULATION_TYPE_VMAP or backend == FEDML_SIMULATION_TYPE_NCCL:
+            # NCCL-sim's role (collective-backed parallel clients) is played
+            # by the vmapped simulator on TPU (SURVEY §2.a)
+            return SimulatorVmap(args, device, dataset, model, client_trainer, server_aggregator)
+        if backend == FEDML_SIMULATION_TYPE_MPI:
+            return SimulatorMPI(args, device, dataset, model, client_trainer, server_aggregator)
+        raise ValueError(f"unknown simulation backend {backend!r}")
+
+    @staticmethod
+    def _init_cross_silo_runner(args, device, dataset, model, client_trainer, server_aggregator):
+        role = getattr(args, "role", "client")
+        if role == "client":
+            from .cross_silo.fedml_client import FedMLCrossSiloClient
+
+            return FedMLCrossSiloClient(args, device, dataset, model, client_trainer)
+        if role == "server":
+            from .cross_silo.fedml_server import FedMLCrossSiloServer
+
+            return FedMLCrossSiloServer(args, device, dataset, model, server_aggregator)
+        raise ValueError(f"unknown role {role!r}")
+
+    @staticmethod
+    def _init_cross_cloud_runner(args, device, dataset, model, client_trainer, server_aggregator):
+        # Cheetah shares the cross-silo manager shape (reference runner.py:118)
+        return FedMLRunner._init_cross_silo_runner(args, device, dataset, model, client_trainer, server_aggregator)
+
+    @staticmethod
+    def _init_cross_device_runner(args, device, dataset, model, server_aggregator):
+        from .cross_device.server import ServerEdge
+
+        return ServerEdge(args, device, dataset, model, server_aggregator)
+
+    def run(self):
+        return self.runner.run()

@@ -1,0 +1,75 @@
+"""Multi-process / multi-worker distributed simulation (Parrot-MPI analogue).
+
+Reference: ``simulation/mpi/fedavg/FedAvgAPI.py:13`` — ``mpirun -np N``
+launches rank 0 as server and ranks 1..N-1 as clients. Here the same
+client/server managers as cross-silo (they implement the identical round
+protocol) run over the message plane:
+
+  - launched as N OS processes (each with ``--rank r``): every process runs
+    its own manager over GRPC — the mpirun-equivalent;
+  - launched as one process (no external launcher): all managers run as
+    threads over the INMEMORY backend — the zero-dependency default.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+from typing import Any, List, Optional
+
+from ..constants import COMM_BACKEND_INMEMORY
+from ..cross_silo.fedml_client import FedMLCrossSiloClient
+from ..cross_silo.fedml_server import FedMLCrossSiloServer
+
+log = logging.getLogger(__name__)
+
+
+class FedMLDistributedRunner:
+    def __init__(self, args: Any, device, dataset, model, client_trainer=None, server_aggregator=None):
+        self.args = args
+        self.device = device
+        self.dataset = dataset
+        self.model = model
+        self.client_trainer = client_trainer
+        self.server_aggregator = server_aggregator
+        self.n_clients = int(getattr(args, "client_num_per_round", getattr(args, "client_num_in_total", 1)))
+        self.launched_externally = bool(getattr(args, "process_group_launched", False)) or (
+            str(getattr(args, "backend", "")).upper() == "GRPC" and int(getattr(args, "rank", -1)) >= 0
+            and getattr(args, "role", None) in ("client", "server")
+        )
+
+    def _run_single_rank(self):
+        if str(getattr(self.args, "role", "client")) == "server" or int(getattr(self.args, "rank", 0)) == 0:
+            self.args.role = "server"
+            self.args.rank = 0
+            return FedMLCrossSiloServer(self.args, self.device, self.dataset, self.model, self.server_aggregator).run()
+        self.args.role = "client"
+        return FedMLCrossSiloClient(self.args, self.device, self.dataset, self.model, self.client_trainer).run()
+
+    def _run_threaded(self):
+        results = {}
+
+        def server():
+            args = copy.copy(self.args)
+            args.rank, args.role, args.backend = 0, "server", COMM_BACKEND_INMEMORY
+            results["server"] = FedMLCrossSiloServer(args, self.device, self.dataset, self.model, self.server_aggregator).run()
+
+        def client(rank: int):
+            args = copy.copy(self.args)
+            args.rank, args.role, args.backend = rank, "client", COMM_BACKEND_INMEMORY
+            FedMLCrossSiloClient(args, self.device, self.dataset, self.model, self.client_trainer).run()
+
+        threads = [threading.Thread(target=server, daemon=True)]
+        threads += [threading.Thread(target=client, args=(r,), daemon=True) for r in range(1, self.n_clients + 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return results.get("server")
+
+    def run(self):
+        if self.launched_externally:
+            return self._run_single_rank()
+        log.info("MPI-style simulation in one process: server + %d clients over INMEMORY", self.n_clients)
+        return self._run_threaded()

@@ -1,0 +1,45 @@
+"""Simulator facade (reference: simulation/simulator.py:27,70,218).
+
+``SimulatorSingleProcess`` wraps the sp FedAvg-family API;
+``SimulatorVmap`` is the TPU-native massive-parallel simulator (vmap over
+the client dimension — a capability the reference lacks, SURVEY §7.5);
+``SimulatorMPI`` runs one process per client over the message plane.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class SimulatorSingleProcess:
+    def __init__(self, args: Any, device: Any, dataset, model, client_trainer=None, server_aggregator=None):
+        from .sp.fedavg_api import FedAvgAPI
+
+        self.fl_trainer = FedAvgAPI(args, device, dataset, model, client_trainer, server_aggregator)
+
+    def run(self):
+        return self.fl_trainer.train()
+
+
+class SimulatorVmap:
+    def __init__(self, args: Any, device: Any, dataset, model, client_trainer=None, server_aggregator=None):
+        from .vmapped.vmap_fedavg import VmapFedAvgAPI
+
+        self.fl_trainer = VmapFedAvgAPI(args, device, dataset, model)
+
+    def run(self):
+        return self.fl_trainer.train()
+
+
+class SimulatorMPI:
+    """Multi-process simulation over the message plane (reference Parrot-MPI,
+    simulation/simulator.py:70). Each rank runs a client manager; rank 0 the
+    server manager. Works over INMEMORY (threads), GRPC, or MQTT backends."""
+
+    def __init__(self, args: Any, device: Any, dataset, model, client_trainer=None, server_aggregator=None):
+        from .mpi_sim import FedMLDistributedRunner
+
+        self.runner = FedMLDistributedRunner(args, device, dataset, model, client_trainer, server_aggregator)
+
+    def run(self):
+        return self.runner.run()

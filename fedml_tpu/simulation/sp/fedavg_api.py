@@ -1,0 +1,235 @@
+"""Single-process FL simulation driving all federated optimizers.
+
+Reference: ``simulation/sp/fedavg/fedavg_api.py:14`` (FedAvgAPI.train:66,
+_client_sampling:127, _aggregate:144) plus the sibling per-algorithm APIs
+(fedopt/fedprox/fednova/scaffold/feddyn/mime). Here one simulator covers
+them all: the trainer factory picks the local algorithm and this class
+applies the matching server rule. Client sampling reproduces the reference's
+seeding exactly (``np.random.seed(round_idx)`` at fedavg_api.py:132) so runs
+are comparable across frameworks.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...constants import (
+    FEDML_FEDERATED_OPTIMIZER_FEDDYN,
+    FEDML_FEDERATED_OPTIMIZER_FEDNOVA,
+    FEDML_FEDERATED_OPTIMIZER_FEDOPT,
+    FEDML_FEDERATED_OPTIMIZER_MIME,
+    FEDML_FEDERATED_OPTIMIZER_SCAFFOLD,
+)
+from ...core.aggregation.agg_operator import fednova_aggregate, scaffold_aggregate, uniform_average
+from ...core.aggregation.server_optimizer import FedOptServer
+from ...core.alg_frame.context import Context
+from ...ml.aggregator import create_server_aggregator
+from ...ml.trainer.trainer_creator import create_model_trainer
+from ...utils.pytree import tree_sub, tree_zeros_like
+from ..sp.client import Client
+import jax
+
+log = logging.getLogger(__name__)
+
+
+class FedAvgAPI:
+    def __init__(self, args: Any, device: Any, dataset, model, client_trainer=None, server_aggregator=None):
+        self.device = device
+        self.args = args
+        [
+            train_data_num,
+            test_data_num,
+            train_data_global,
+            test_data_global,
+            train_data_local_num_dict,
+            train_data_local_dict,
+            test_data_local_dict,
+            class_num,
+        ] = dataset
+        self.train_global = train_data_global
+        self.test_global = test_data_global
+        self.train_data_num_in_total = train_data_num
+        self.test_data_num_in_total = test_data_num
+        self.train_data_local_num_dict = train_data_local_num_dict
+        self.train_data_local_dict = train_data_local_dict
+        self.test_data_local_dict = test_data_local_dict
+        self.class_num = class_num
+        self.fed_opt = str(getattr(args, "federated_optimizer", "FedAvg"))
+
+        self.model_trainer = client_trainer or create_model_trainer(model, args)
+        self.aggregator = server_aggregator or create_server_aggregator(copy.copy(model), args)
+        Context().add(Context.KEY_TEST_DATA, self.test_global)
+
+        self.client_list: List[Client] = []
+        self._setup_clients(train_data_local_num_dict, train_data_local_dict, test_data_local_dict)
+
+        # server-side algorithm state
+        self._fedopt_server: Optional[FedOptServer] = None
+        if self.fed_opt == FEDML_FEDERATED_OPTIMIZER_FEDOPT:
+            self._fedopt_server = FedOptServer(args, self.model_trainer.get_model_params())
+        self._scaffold_c = tree_zeros_like(self.model_trainer.get_model_params())
+        self._feddyn_h = tree_zeros_like(self.model_trainer.get_model_params())
+        self._mime_s = tree_zeros_like(self.model_trainer.get_model_params())
+        self.metrics_history: List[Dict[str, float]] = []
+
+    def _setup_clients(self, train_data_local_num_dict, train_data_local_dict, test_data_local_dict) -> None:
+        """One Client object per sampled slot, reused across rounds
+        (reference fedavg_api.py:76-97: client objects are per-slot, local
+        datasets swapped in per round)."""
+        for client_idx in range(int(self.args.client_num_per_round)):
+            c = Client(
+                client_idx,
+                train_data_local_dict[client_idx],
+                test_data_local_dict[client_idx],
+                train_data_local_num_dict[client_idx],
+                self.args,
+                self.device,
+                self.model_trainer,
+            )
+            self.client_list.append(c)
+
+    def _client_sampling(self, round_idx: int, client_num_in_total: int, client_num_per_round: int) -> List[int]:
+        """Bit-exact mirror of reference _client_sampling (fedavg_api.py:127)."""
+        if client_num_in_total == client_num_per_round:
+            client_indexes = [i for i in range(client_num_in_total)]
+        else:
+            num_clients = min(client_num_per_round, client_num_in_total)
+            np.random.seed(round_idx)
+            client_indexes = np.random.choice(range(client_num_in_total), num_clients, replace=False)
+        log.info("client_indexes = %s", client_indexes)
+        return list(client_indexes)
+
+    # ------------------------------------------------------------------
+    def train(self) -> Dict[str, float]:
+        w_global = self.model_trainer.get_model_params()
+        comm_round = int(getattr(self.args, "comm_round", 10))
+        for round_idx in range(comm_round):
+            log.info("================ Communication round : %d", round_idx)
+            client_indexes = self._client_sampling(
+                round_idx, int(self.args.client_num_in_total), int(self.args.client_num_per_round)
+            )
+            Context().add("client_indexes_of_round", client_indexes)
+            w_locals: List[Tuple[float, Any]] = []
+            for idx, client in enumerate(self.client_list):
+                client_idx = client_indexes[idx]
+                client.update_local_dataset(
+                    client_idx,
+                    self.train_data_local_dict[client_idx],
+                    self.test_data_local_dict[client_idx],
+                    self.train_data_local_num_dict[client_idx],
+                )
+                if self.fed_opt == FEDML_FEDERATED_OPTIMIZER_SCAFFOLD:
+                    self.model_trainer.set_control_variate(self._scaffold_c)
+                elif self.fed_opt == FEDML_FEDERATED_OPTIMIZER_MIME:
+                    self.model_trainer.set_server_momentum(self._mime_s)
+                w = client.train(w_global)
+                payload = getattr(self.model_trainer, "round_payload", None)
+                if self.fed_opt in (
+                    FEDML_FEDERATED_OPTIMIZER_FEDNOVA,
+                    FEDML_FEDERATED_OPTIMIZER_SCAFFOLD,
+                    FEDML_FEDERATED_OPTIMIZER_MIME,
+                ) and payload is not None:
+                    w_locals.append((client.get_sample_number(), payload))
+                else:
+                    w_locals.append((client.get_sample_number(), w))
+            w_global = self._server_update(w_global, w_locals)
+            self.model_trainer.set_model_params(w_global)
+            self.aggregator.set_model_params(w_global)
+
+            freq = int(getattr(self.args, "frequency_of_the_test", 5))
+            if round_idx == comm_round - 1 or (freq > 0 and round_idx % freq == 0):
+                metrics = self._test_global(round_idx)
+                self.metrics_history.append(metrics)
+        return self.metrics_history[-1] if self.metrics_history else {}
+
+    # ------------------------------------------------------------------
+    def _server_update(self, w_global, w_locals):
+        """Apply the per-algorithm server rule with the alg-frame hooks
+        around it (reference fedavg_api._aggregate + per-alg APIs)."""
+        agg = self.aggregator
+        # Structured payloads (FedNova (a_i, d_i); SCAFFOLD (dw, dc)) must not
+        # pass through the weight-space on_before hooks (defenses / cDP clip
+        # assume plain weight pytrees) — they get their dedicated server rules.
+        if self.fed_opt == FEDML_FEDERATED_OPTIMIZER_FEDNOVA:
+            # d_i = (w_global - w_local)/a_i already carries lr (the local
+            # steps applied it); no further scaling.
+            new_w = fednova_aggregate(w_global, w_locals)
+            new_w = agg.on_after_aggregation(new_w)
+        elif self.fed_opt == FEDML_FEDERATED_OPTIMIZER_SCAFFOLD:
+            new_w, self._scaffold_c = scaffold_aggregate(
+                w_global,
+                self._scaffold_c,
+                w_locals,
+                int(self.args.client_num_in_total),
+                float(getattr(self.args, "server_lr", 1.0)),
+            )
+        elif self.fed_opt == FEDML_FEDERATED_OPTIMIZER_MIME:
+            weight_payloads = [(n, p[0]) for n, p in w_locals]
+            grad_payloads = [p[1] for _, p in w_locals]
+            lst = agg.on_before_aggregation(weight_payloads)
+            new_w = agg.aggregate(lst)
+            new_w = agg.on_after_aggregation(new_w)
+            beta = float(getattr(self.args, "mime_beta", 0.9))
+            avg_grad = uniform_average(grad_payloads)
+            self._mime_s = jax.tree.map(lambda s, g: beta * s + (1 - beta) * g, self._mime_s, avg_grad)
+        elif self.fed_opt == FEDML_FEDERATED_OPTIMIZER_FEDDYN:
+            lst = agg.on_before_aggregation(w_locals)
+            alpha = float(getattr(self.args, "feddyn_alpha", 0.01))
+            avg_w = uniform_average([w for _, w in lst])
+            m = int(self.args.client_num_in_total)
+            delta = uniform_average([tree_sub(w, w_global) for _, w in lst])
+            frac = len(lst) / float(m)
+            self._feddyn_h = jax.tree.map(lambda h, d: h - alpha * frac * d, self._feddyn_h, delta)
+            new_w = jax.tree.map(lambda w, h: w - h / alpha, avg_w, self._feddyn_h)
+            new_w = agg.on_after_aggregation(new_w)
+        else:
+            lst = agg.on_before_aggregation(w_locals)
+            new_w = agg.aggregate(lst)
+            if self._fedopt_server is not None:
+                new_w = self._fedopt_server.apply(w_global, new_w)
+            new_w = agg.on_after_aggregation(new_w)
+        agg.assess_contribution()
+        return new_w
+
+    # ------------------------------------------------------------------
+    def _test_global(self, round_idx: int) -> Dict[str, float]:
+        metrics = self.aggregator.test(self.test_global, self.device, self.args)
+        metrics["round"] = round_idx
+        log.info("round %d: %s", round_idx, {k: round(float(v), 4) for k, v in metrics.items()})
+        return metrics
+
+    def _local_test_on_all_clients(self, round_idx: int) -> Dict[str, float]:
+        """reference fedavg_api.py:176 — average local test metrics."""
+        train_metrics = {"num_samples": [], "num_correct": [], "losses": []}
+        test_metrics = {"num_samples": [], "num_correct": [], "losses": []}
+        client = self.client_list[0]
+        for client_idx in range(int(self.args.client_num_in_total)):
+            if self.test_data_local_dict.get(client_idx) is None:
+                continue
+            client.update_local_dataset(
+                client_idx,
+                self.train_data_local_dict[client_idx],
+                self.test_data_local_dict[client_idx],
+                self.train_data_local_num_dict[client_idx],
+            )
+            tm = client.local_test(False)
+            train_metrics["num_samples"].append(tm["test_total"])
+            train_metrics["num_correct"].append(tm["test_correct"])
+            train_metrics["losses"].append(tm["test_loss"] * tm["test_total"])
+            sm = client.local_test(True)
+            test_metrics["num_samples"].append(sm["test_total"])
+            test_metrics["num_correct"].append(sm["test_correct"])
+            test_metrics["losses"].append(sm["test_loss"] * sm["test_total"])
+        out = {
+            "round": round_idx,
+            "train_acc": sum(train_metrics["num_correct"]) / max(sum(train_metrics["num_samples"]), 1),
+            "train_loss": sum(train_metrics["losses"]) / max(sum(train_metrics["num_samples"]), 1),
+            "test_acc": sum(test_metrics["num_correct"]) / max(sum(test_metrics["num_samples"]), 1),
+            "test_loss": sum(test_metrics["losses"]) / max(sum(test_metrics["num_samples"]), 1),
+        }
+        log.info("local test round %d: %s", round_idx, out)
+        return out

@@ -1,0 +1,47 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+Multi-chip hardware is not available in CI; per the build instructions all
+sharding logic is validated on a virtual CPU mesh
+(``xla_force_host_platform_device_count=8``), and the driver separately
+dry-runs the multi-chip path via ``__graft_entry__.dryrun_multichip``.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_compile_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# This image's sitecustomize registers a remote-TPU ("axon") PJRT plugin in
+# every interpreter and force-selects it via jax.config, overriding the
+# JAX_PLATFORMS env var. Tests must run on the local virtual-CPU mesh, so
+# re-select cpu explicitly after jax import.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    """Middleware singletons are process-wide; reset between tests."""
+    yield
+    from fedml_tpu.core.alg_frame.context import Context
+    from fedml_tpu.core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+    from fedml_tpu.core.fhe.fhe_agg import FedMLFHE
+    from fedml_tpu.core.security.fedml_attacker import FedMLAttacker
+    from fedml_tpu.core.security.fedml_defender import FedMLDefender
+
+    FedMLAttacker._instance = None
+    FedMLDefender._instance = None
+    FedMLDifferentialPrivacy._instance = None
+    FedMLFHE._instance = None
+    Context._instance = None

@@ -1,0 +1,102 @@
+"""Numerical tests of aggregation math (the unit layer the reference lacks,
+SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core.aggregation.agg_operator import (
+    FedMLAggOperator,
+    async_fedavg,
+    fedavg,
+    fednova_aggregate,
+    scaffold_aggregate,
+    uniform_average,
+)
+from fedml_tpu.utils.pytree import (
+    tree_global_norm,
+    tree_clip_by_global_norm,
+    tree_flatten_to_vector,
+    tree_unflatten_from_vector,
+    tree_stack,
+    weighted_average,
+)
+
+
+def _tree(val, shape=(3, 2)):
+    return {"w": jnp.full(shape, float(val)), "b": jnp.full((shape[0],), float(val))}
+
+
+class TestWeightedAverage:
+    def test_fedavg_weighting(self):
+        out = fedavg([(1.0, _tree(0.0)), (3.0, _tree(4.0))])
+        np.testing.assert_allclose(out["w"], 3.0, rtol=1e-6)
+        np.testing.assert_allclose(out["b"], 3.0, rtol=1e-6)
+
+    def test_matches_manual_sum(self):
+        rng = np.random.default_rng(0)
+        trees = [{"a": jnp.asarray(rng.normal(size=(4, 5)).astype(np.float32))} for _ in range(5)]
+        ns = [1.0, 2.0, 3.0, 4.0, 5.0]
+        out = fedavg(list(zip(ns, trees)))
+        expected = sum(n * np.asarray(t["a"]) for n, t in zip(ns, trees)) / sum(ns)
+        np.testing.assert_allclose(np.asarray(out["a"]), expected, rtol=1e-5)
+
+    def test_fold_path_matches_stack_path(self):
+        rng = np.random.default_rng(1)
+        trees = [{"a": jnp.asarray(rng.normal(size=(3,)).astype(np.float32))} for _ in range(70)]
+        pairs = [(float(i + 1), t) for i, t in enumerate(trees)]
+        folded = weighted_average(pairs)  # >64 clients -> fold path
+        stacked = fedavg(pairs[:64] + pairs[64:])
+        np.testing.assert_allclose(np.asarray(folded["a"]), np.asarray(stacked["a"]), rtol=1e-4)
+
+    def test_agg_operator_dispatch(self):
+        class A:
+            federated_optimizer = "FedAvg"
+
+        out = FedMLAggOperator.agg(A(), [(1.0, _tree(2.0)), (1.0, _tree(4.0))])
+        np.testing.assert_allclose(out["w"], 3.0, rtol=1e-6)
+
+
+class TestFedNova:
+    def test_equal_taus_reduce_to_fedavg(self):
+        w_global = _tree(1.0)
+        # d_i = (w_global - w_i) / tau with tau=1 -> update == fedavg of w_i
+        w1, w2 = _tree(0.0), _tree(2.0)
+        d1 = jax.tree.map(lambda g, w: g - w, w_global, w1)
+        d2 = jax.tree.map(lambda g, w: g - w, w_global, w2)
+        out = fednova_aggregate(w_global, [(1.0, (1.0, d1)), (1.0, (1.0, d2))])
+        np.testing.assert_allclose(out["w"], 1.0, rtol=1e-6)  # avg of 0 and 2
+
+
+class TestScaffold:
+    def test_server_update(self):
+        w = _tree(0.0)
+        c = _tree(0.0)
+        dw = _tree(1.0)
+        dc = _tree(0.5)
+        new_w, new_c = scaffold_aggregate(w, c, [(1.0, (dw, dc))], total_clients=4, server_lr=1.0)
+        np.testing.assert_allclose(new_w["w"], 1.0, rtol=1e-6)
+        np.testing.assert_allclose(new_c["w"], 0.125, rtol=1e-6)  # (1/4)*0.5
+
+
+class TestAsync:
+    def test_staleness_discount(self):
+        out = async_fedavg(_tree(0.0), _tree(1.0), staleness=1.0, alpha=0.5)
+        np.testing.assert_allclose(out["w"], 0.25, rtol=1e-6)
+
+
+class TestTreeOps:
+    def test_flatten_roundtrip(self):
+        t = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3), "b": jnp.ones((4,), jnp.bfloat16)}
+        flat, spec = tree_flatten_to_vector(t)
+        back = tree_unflatten_from_vector(flat, spec)
+        np.testing.assert_allclose(np.asarray(back["a"]), np.asarray(t["a"]))
+        assert back["b"].dtype == jnp.bfloat16
+
+    def test_clip_by_global_norm(self):
+        t = {"a": jnp.full((4,), 3.0)}  # norm 6
+        clipped = tree_clip_by_global_norm(t, 3.0)
+        np.testing.assert_allclose(float(tree_global_norm(clipped)), 3.0, rtol=1e-5)
+        not_clipped = tree_clip_by_global_norm(t, 100.0)
+        np.testing.assert_allclose(np.asarray(not_clipped["a"]), 3.0, rtol=1e-6)
