@@ -1,0 +1,69 @@
+"""LoRA adapter pytree plumbing.
+
+Reference: ``train/llm/peft_utils.py`` (HF PEFT integration). Adapters are
+ordinary parameters named ``lora_a``/``lora_b`` inside the transformer
+(models/transformer.LoRALinear); these helpers split/merge them so that
+
+  - the optimizer trains only adapters (``optax.masked`` via lora_mask), and
+  - federated rounds ship only the adapter subtree over the WAN
+    (SURVEY §7.7: "only adapters cross the WAN in federated mode").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+
+PyTree = Any
+
+
+def is_lora_path(path: Tuple) -> bool:
+    return any(getattr(p, "key", None) in ("lora_a", "lora_b") for p in path)
+
+
+def lora_mask(params: PyTree) -> PyTree:
+    """True where the leaf is a LoRA adapter (for optax.masked)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    return jax.tree.unflatten(
+        jax.tree.structure(params), [is_lora_path(path) for path, _ in flat]
+    )
+
+
+def split_lora(params: PyTree) -> Tuple[Dict, Dict]:
+    """-> (adapters_subtree, base_subtree) as nested dicts with the same
+    paths (missing branches pruned)."""
+
+    def walk(node, select_lora: bool, in_lora_branch=False):
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                child = walk(v, select_lora, in_lora_branch or k in ("lora_a", "lora_b"))
+                if child is not None and (not isinstance(child, dict) or child):
+                    out[k] = child
+            return out
+        return node if (in_lora_branch == select_lora) else None
+
+    return walk(params, True), walk(params, False)
+
+
+def merge_lora(base: Dict, adapters: Dict) -> Dict:
+    """Graft the adapter subtree back onto the base tree."""
+
+    def walk(b, a):
+        if isinstance(a, dict):
+            out = dict(b) if isinstance(b, dict) else {}
+            for k, v in a.items():
+                out[k] = walk(out.get(k, {}), v)
+            return out
+        return a
+
+    return walk(base, adapters)
+
+
+def count_lora_params(params: PyTree) -> Tuple[int, int]:
+    """(adapter_params, total_params)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    lora = sum(int(leaf.size) for path, leaf in flat if is_lora_path(path))
+    total = sum(int(leaf.size) for _, leaf in flat)
+    return lora, total

@@ -1,0 +1,205 @@
+"""Llama-family causal transformer for the FedLLM path.
+
+Reference: ``train/llm/models/modeling_gpt_neox.py`` + ``models/attention.py``
+(HF GPT-NeoX with a flash-attn flag; Llama-2 via model_name_or_path). This is
+the TPU-native re-design: RMSNorm + rotary + GQA + SwiGLU in flax, bfloat16
+activations, per-layer ``jax.checkpoint`` (remat), and a pluggable attention
+impl — XLA einsum, Pallas flash kernel (ops/flash_attention.py), or ring
+attention over an 'sp' mesh axis (parallel/ring_attention.py) for
+long-context sequence parallelism the reference lacks (SURVEY §5).
+
+Sharding is applied from outside by path rules (parallel/fsdp.py) so the
+module stays pure; LoRA adapters are parameters named ``lora_a``/``lora_b``
+inside each projection, split from the base tree by
+``models.lora.split_lora`` — in federated mode only adapters cross the WAN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_ff: int = 1376
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attention_impl: str = "xla"  # xla | pallas | ring
+    lora_rank: int = 0           # 0 = no adapters
+    lora_alpha: float = 16.0
+    lora_targets: Tuple[str, ...] = ("q_proj", "k_proj", "v_proj", "o_proj")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def from_args(cls, args: Any) -> "TransformerConfig":
+        return cls(
+            vocab_size=int(getattr(args, "vocab_size", 32000)),
+            d_model=int(getattr(args, "d_model", 512)),
+            n_layers=int(getattr(args, "n_layers", 4)),
+            n_heads=int(getattr(args, "n_heads", 8)),
+            n_kv_heads=int(getattr(args, "n_kv_heads", getattr(args, "n_heads", 8))),
+            d_ff=int(getattr(args, "d_ff", 1376)),
+            max_seq_len=int(getattr(args, "seq_len", 2048)),
+            attention_impl=str(getattr(args, "attention_impl", "xla")),
+            lora_rank=int(getattr(args, "lora_rank", 0) or 0),
+            lora_alpha=float(getattr(args, "lora_alpha", 16.0)),
+            remat=bool(getattr(args, "remat", True)),
+        )
+
+    @classmethod
+    def llama2_7b(cls, **over) -> "TransformerConfig":
+        """Llama-2-7B geometry (the Cheetah/FedLLM benchmark model)."""
+        base = dict(
+            vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=32,
+            d_ff=11008, max_seq_len=4096,
+        )
+        base.update(over)
+        return cls(**base)
+
+
+def rotary_embedding(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Apply RoPE to [B, T, H, D] given positions [B, T]."""
+    d = x.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, T, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + self.eps)
+        return (normed * scale).astype(x.dtype)
+
+
+class LoRALinear(nn.Module):
+    """Dense with optional low-rank adapter (W + (alpha/r) A B)."""
+
+    features: int
+    cfg: TransformerConfig
+    use_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        in_dim = x.shape[-1]
+        kernel = self.param("kernel", nn.initializers.lecun_normal(), (in_dim, self.features))
+        y = x @ kernel.astype(x.dtype)
+        r = self.cfg.lora_rank
+        if r > 0 and _lora_target(self.name, self.cfg):
+            a = self.param("lora_a", nn.initializers.normal(0.02), (in_dim, r))
+            b = self.param("lora_b", nn.initializers.zeros, (r, self.features))
+            y = y + (self.cfg.lora_alpha / r) * ((x @ a.astype(x.dtype)) @ b.astype(x.dtype))
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros, (self.features,)).astype(x.dtype)
+        return y
+
+
+def _lora_target(name: Optional[str], cfg: TransformerConfig) -> bool:
+    return name is not None and any(t in name for t in cfg.lora_targets)
+
+
+def xla_attention(q, k, v, causal: bool = True):
+    """Plain einsum attention; XLA fuses + tiles this well for short T."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), jnp.bool_), tk - tq)
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        B, T, _ = x.shape
+        hd = cfg.head_dim
+        q = LoRALinear(cfg.n_heads * hd, cfg, name="q_proj")(x).reshape(B, T, cfg.n_heads, hd)
+        k = LoRALinear(cfg.n_kv_heads * hd, cfg, name="k_proj")(x).reshape(B, T, cfg.n_kv_heads, hd)
+        v = LoRALinear(cfg.n_kv_heads * hd, cfg, name="v_proj")(x).reshape(B, T, cfg.n_kv_heads, hd)
+        q = rotary_embedding(q, positions, cfg.rope_theta)
+        k = rotary_embedding(k, positions, cfg.rope_theta)
+        if cfg.n_kv_heads != cfg.n_heads:  # GQA: repeat kv heads
+            rep = cfg.n_heads // cfg.n_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        if cfg.attention_impl == "pallas":
+            from ..ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=True)
+        elif cfg.attention_impl == "ring":
+            from ..parallel.ring_attention import ring_attention_inner
+
+            out = ring_attention_inner(q, k, v)
+        else:
+            out = xla_attention(q, k, v, causal=True)
+        out = out.reshape(B, T, cfg.n_heads * hd)
+        return LoRALinear(cfg.d_model, cfg, name="o_proj")(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        gate = LoRALinear(cfg.d_ff, cfg, name="gate_proj")(x)
+        up = LoRALinear(cfg.d_ff, cfg, name="up_proj")(x)
+        return LoRALinear(cfg.d_model, cfg, name="down_proj")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+        x = x + Attention(self.cfg, name="attn")(RMSNorm(name="attn_norm")(x), positions)
+        x = x + MLP(self.cfg, name="mlp")(RMSNorm(name="mlp_norm")(x))
+        return x
+
+
+class TransformerLM(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, name="embed")(tokens).astype(cfg.dtype)
+        positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = block(cfg, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(name="final_norm")(x)
+        # tied-untied head: separate projection (llama style)
+        logits = LoRALinear(cfg.vocab_size, cfg, name="lm_head")(x)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,133 @@
+"""Pallas TPU flash attention (forward).
+
+The hot op of the LLM path (per /opt/skills/guides/pallas_guide.md). Design:
+grid over (batch*heads, query blocks); each program holds one q block in
+VMEM and streams the full K/V for that head through the MXU in k-blocks —
+the [T, T] score matrix never exists in HBM. Compute in fp32, output in the
+input dtype. Causal masking by global row/col index.
+
+Backward uses XLA autodiff via a custom_vjp that recomputes attention with
+the einsum path (flash backward kernel is future work; recompute-in-bwd is
+the standard memory/compute trade here, same as jax.checkpoint).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas import kept soft so CPU-only environments can import the module
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int, causal: bool, scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+    T = k_ref.shape[1]
+    D = q.shape[-1]
+
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, D), jnp.float32)
+
+    row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(start, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k_blk.T  # [block_q, block_k] on the MXU
+        col = start * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        if causal:
+            s = jnp.where(col <= row, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(col <= row, p, 0.0)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[:, None] + p @ v_blk
+        return m_new, l_new, acc_new
+
+    num_k = T // block_k
+    if causal:
+        # only stream k-blocks that can contain unmasked entries
+        num_k_eff = jnp.minimum(num_k, (qi + 1) * block_q // block_k + 1)
+    else:
+        num_k_eff = num_k
+    m, l, acc = jax.lax.fori_loop(0, num_k_eff, body, (m, l, acc))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_raw(q, k, v, *, causal: bool, block_q: int, block_k: int):
+    B, T, H, D = q.shape
+    scale = D ** -0.5
+    qr = jnp.transpose(q, (0, 2, 1, 3)).reshape(B * H, T, D)
+    kr = jnp.transpose(k, (0, 2, 1, 3)).reshape(B * H, T, D)
+    vr = jnp.transpose(v, (0, 2, 1, 3)).reshape(B * H, T, D)
+    bq = min(block_q, T)
+    bk = min(block_k, T)
+    grid = (B * H, T // bq)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, block_q=bq, block_k=bk, causal=causal, scale=scale),
+        out_shape=jax.ShapeDtypeStruct(qr.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, T, D), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda i, j: (i, j, 0)),
+        interpret=jax.default_backend() != "tpu",  # CPU tests run interpreted
+    )(qr, kr, vr)
+    return jnp.transpose(out.reshape(B, H, T, D), (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    return _flash_fwd_raw(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    return _flash(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, res, g):
+    q, k, v = res
+    from ..models.transformer import xla_attention
+
+    _, vjp = jax.vjp(lambda q, k, v: xla_attention(q, k, v, causal=causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jnp.ndarray:
+    """[B, T, H, D] x3 -> [B, T, H, D]. Falls back to the einsum path when
+    pallas is unavailable or shapes don't tile (T % block != 0)."""
+    T = q.shape[1]
+    bq, bk = min(block_q, T), min(block_k, T)
+    if not _HAS_PALLAS or T % bq or T % bk:
+        from ..models.transformer import xla_attention
+
+        return xla_attention(q, k, v, causal=causal)
+    return _flash(q, k, v, causal, bq, bk)

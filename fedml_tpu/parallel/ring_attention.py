@@ -1,0 +1,113 @@
+"""Ring attention: sequence/context parallelism over the ICI ring.
+
+The reference has NO sequence parallelism (SURVEY §5 "Long-context —
+absent"); this is the TPU-native extension the build plan calls for: the
+sequence axis is sharded over an 'sp' mesh axis, each device holds one
+query/KV block, and KV blocks rotate around the ring via
+``jax.lax.ppermute`` while an online-softmax accumulator keeps the result
+exact (Liu et al. 2023, blockwise ring attention).
+
+Causality across blocks: device i's queries attend KV block j fully when
+j < i, causally when j == i, not at all when j > i — enforced with masks so
+the rotation count is uniform (no data-dependent control flow).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+# --- active mesh context (set by train-step builders so model code can find
+# the 'sp' axis without threading the mesh through flax modules) -----------
+_ACTIVE_MESH: Optional[Mesh] = None
+
+
+class active_mesh:
+    def __init__(self, mesh: Optional[Mesh]):
+        self.mesh = mesh
+
+    def __enter__(self):
+        global _ACTIVE_MESH
+        self._prev = _ACTIVE_MESH
+        _ACTIVE_MESH = self.mesh
+        return self.mesh
+
+    def __exit__(self, *exc):
+        global _ACTIVE_MESH
+        _ACTIVE_MESH = self._prev
+
+
+def get_active_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH
+
+
+def _ring_block(q, k, v, axis_name: str):
+    """Per-device ring attention body. q/k/v: [B, T_local, H, D]."""
+    idx = jax.lax.axis_index(axis_name)
+    n = jax.lax.axis_size(axis_name)
+    scale = q.shape[-1] ** -0.5
+    B, Tl, H, D = q.shape
+
+    q32 = q.astype(jnp.float32) * scale
+    # initial accumulators must be marked device-varying for the scan carry
+    pvary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
+    m = pvary(jnp.full((B, H, Tl), NEG_INF, jnp.float32))
+    l = pvary(jnp.zeros((B, H, Tl), jnp.float32))
+    acc = pvary(jnp.zeros((B, H, Tl, D), jnp.float32))
+
+    row_ids = jnp.arange(Tl)
+
+    def body(step, carry):
+        m, l, acc, k_cur, v_cur = carry
+        j = (idx - step) % n  # block index currently held
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k_cur.astype(jnp.float32))
+        # mask: j < idx -> full block; j == idx -> causal; j > idx -> none
+        intra = row_ids[:, None] >= row_ids[None, :]  # [Tl, Tl]
+        allow2d = jnp.where(j == idx, intra, j < idx)  # scalar conds broadcast
+        allow = jnp.broadcast_to(allow2d[None, None], logits.shape)
+        logits = jnp.where(allow, logits, NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[..., None]) * allow.astype(jnp.float32)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32))
+        # rotate kv to the next device
+        perm = [(d, (d + 1) % n) for d in range(n)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return m_new, l_new, acc_new, k_next, v_next
+
+    m, l, acc, _, _ = jax.lax.fori_loop(0, n, body, (m, l, acc, k, v))
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B, Tl, H, D]
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp"):
+    """Shard the sequence axis over `axis_name` and run blockwise ring
+    attention. q/k/v: [B, T, H, D] (global view)."""
+    spec = P(None, axis_name, None, None)
+    return shard_map(
+        functools.partial(_ring_block, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)
+
+
+def ring_attention_inner(q, k, v):
+    """Model-facing entry (transformer.Attention attention_impl='ring'):
+    uses the active mesh's 'sp' axis; falls back to exact XLA attention when
+    no mesh/axis is active (single-device runs, tests)."""
+    mesh = get_active_mesh()
+    if mesh is not None and "sp" in mesh.axis_names:
+        return ring_attention(q, k, v, mesh)
+    from ..models.transformer import xla_attention
+
+    return xla_attention(q, k, v, causal=True)

@@ -1,0 +1,83 @@
+"""LLM experiment configuration.
+
+Reference: ``train/llm/configurations.py:32`` (ExperimentArguments),
+``:141`` (ModelArguments), ``:376`` (DatasetArguments) — HF TrainingArguments
+subclasses there; plain dataclasses here with the same role: one object per
+concern, buildable from the flat Arguments namespace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+
+@dataclasses.dataclass
+class ModelArguments:
+    model_name: str = "llama"          # llama | gpt | transformer preset
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_ff: int = 1376
+    seq_len: int = 512
+    attention_impl: str = "xla"        # xla | pallas | ring
+    lora_rank: int = 8
+    lora_alpha: float = 16.0
+    remat: bool = True
+
+    @classmethod
+    def from_args(cls, args: Any) -> "ModelArguments":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: getattr(args, k) for k in fields if hasattr(args, k)})
+
+
+@dataclasses.dataclass
+class DatasetArguments:
+    dataset_name: str = "synthetic_text"
+    dataset_path: Optional[str] = None
+    max_seq_length: int = 512
+    num_train_samples: int = 2048
+
+    @classmethod
+    def from_args(cls, args: Any) -> "DatasetArguments":
+        return cls(
+            dataset_name=str(getattr(args, "llm_dataset", "synthetic_text")),
+            dataset_path=getattr(args, "llm_dataset_path", None),
+            max_seq_length=int(getattr(args, "seq_len", 512)),
+            num_train_samples=int(getattr(args, "num_train_samples", 2048)),
+        )
+
+
+@dataclasses.dataclass
+class ExperimentArguments:
+    learning_rate: float = 1e-4
+    weight_decay: float = 0.0
+    warmup_steps: int = 10
+    max_steps: int = 100
+    per_device_batch_size: int = 4
+    grad_clip: float = 1.0
+    seed: int = 0
+    output_dir: str = "/tmp/fedml_tpu_llm"
+    save_steps: int = 0                 # 0 = only final
+    # mesh geometry (ZeRO/TP/SP replacement surface)
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @classmethod
+    def from_args(cls, args: Any) -> "ExperimentArguments":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        out = cls(**{k: getattr(args, k) for k in fields if hasattr(args, k)})
+        out.learning_rate = float(getattr(args, "learning_rate", out.learning_rate))
+        return out
+
+    def mesh_shape(self) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+        axes, names = [], []
+        for n, name in ((self.dp, "dp"), (self.fsdp, "fsdp"), (self.tp, "tp"), (self.sp, "sp")):
+            if n > 1 or name in ("dp", "fsdp"):
+                axes.append(n)
+                names.append(name)
+        return tuple(axes), tuple(names)

@@ -1,0 +1,69 @@
+"""Federated LLM client trainer: only LoRA adapters cross the WAN.
+
+Reference: the FedLLM spotlight project (``python/spotlight_prj/fedllm``)
+fine-tunes with PEFT and exchanges adapter weights. Here the client holds
+the full (frozen) base model sharded on its silo's mesh; get/set_model_params
+operate on the adapter subtree only, so a 7B base ships ~0.1% of its bytes
+per round (SURVEY §7.7).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from ...core.alg_frame.client_trainer import ClientTrainer
+from ...models.lora import merge_lora, split_lora
+from .configurations import DatasetArguments, ExperimentArguments, ModelArguments
+from .llm_trainer import LLMTrainer, synthetic_token_batches
+
+log = logging.getLogger(__name__)
+
+
+class LLMClientTrainer(ClientTrainer):
+    def __init__(self, args: Any):
+        self.llm = LLMTrainer(
+            ModelArguments.from_args(args), DatasetArguments.from_args(args), ExperimentArguments.from_args(args)
+        )
+        if self.llm.cfg.lora_rank <= 0:
+            raise ValueError("federated LLM requires lora_rank > 0 (only adapters cross the WAN)")
+        super().__init__(self.llm, args)
+        self.llm._build(self.llm.init_params())
+
+    # --- adapter-only exchange -------------------------------------------
+    def get_model_params(self):
+        adapters, _ = split_lora(__import__("jax").device_get(self.llm.params))
+        return adapters
+
+    def set_model_params(self, model_parameters) -> None:
+        import jax
+
+        from ...parallel.fsdp import param_shardings
+
+        merged = merge_lora(jax.device_get(self.llm.params), model_parameters)
+        self.llm.params = jax.device_put(merged, param_shardings(merged, self.llm.mesh))
+
+    def train(self, train_data, device=None, args: Any = None) -> None:
+        args = args or self.args
+        steps = int(getattr(args, "local_steps", self.llm.exp_args.max_steps))
+        if train_data is not None and hasattr(train_data, "x"):
+            import numpy as np
+
+            bs = self.llm.exp_args.per_device_batch_size * max(1, self.llm.mesh.devices.size)
+            x = np.asarray(train_data.x)
+            batches = (
+                (x[i % max(1, len(x) // bs) * bs : i % max(1, len(x) // bs) * bs + bs], None)
+                for i in range(steps)
+            )
+            batches = ((b, __import__("numpy").ones_like(b, dtype="float32")) for b, _ in batches)
+        else:
+            batches = synthetic_token_batches(
+                self.llm.cfg.vocab_size,
+                self.llm.model_args.seq_len,
+                self.llm.exp_args.per_device_batch_size * max(1, self.llm.mesh.devices.size),
+                steps,
+                seed=self.id,
+            )
+        self.llm.exp_args.max_steps = steps
+        metrics = self.llm.train(batches)
+        log.info("client %s LLM round: %s", self.id, metrics)
