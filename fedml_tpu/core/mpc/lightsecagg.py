@@ -1,0 +1,132 @@
+"""LightSecAgg: one-shot-reconstruction secure aggregation.
+
+Protocol (reference: python/fedml/core/mpc/lightsecagg.py:83-146 and the
+managers in python/fedml/cross_silo/lightsecagg/): each client encodes its
+random mask with Lagrange-coded computing so that the *sum* of masks over any
+U active clients can be reconstructed from U encoded-mask aggregates, with
+T-privacy. Server never sees an individual mask.
+
+Geometry (mask_encoding, reference lightsecagg.py:97-123):
+  - alpha = N+1..N+U       (U interpolation points holding the payload rows)
+  - beta = 1..N            (one evaluation point per client, share index)
+  - payload = [mask chunks (U-T rows of size d/(U-T)) ; T rows of noise]
+  - client i's share for client j = the payload polynomial (defined by its
+    values at the alpha points) evaluated at beta_j
+
+This implementation is pytree-native (flat int64 vectors from
+finite_field.flatten_finite) and batches all Lagrange algebra through numpy
+matmuls.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from .finite_field import (
+    DEFAULT_PRIME,
+    lcc_decode,
+    lcc_encode,
+)
+
+
+def _pad_to_chunks(d: int, n_chunks: int) -> int:
+    """Smallest padded dim divisible by n_chunks."""
+    return ((d + n_chunks - 1) // n_chunks) * n_chunks
+
+
+@dataclass
+class LightSecAggConfig:
+    num_clients: int  # N
+    target_active: int  # U: #clients needed to reconstruct
+    privacy_guarantee: int  # T: collusion tolerance, T < U <= N
+    prime: int = DEFAULT_PRIME
+
+    def __post_init__(self) -> None:
+        if not (0 < self.privacy_guarantee < self.target_active <= self.num_clients):
+            raise ValueError("need 0 < T < U <= N")
+
+    @property
+    def beta(self) -> np.ndarray:
+        return np.arange(1, self.num_clients + 1, dtype=np.int64)
+
+    @property
+    def alpha(self) -> np.ndarray:
+        return np.arange(self.num_clients + 1, self.num_clients + self.target_active + 1, dtype=np.int64)
+
+
+@dataclass
+class ClientMaskState:
+    local_mask: np.ndarray  # (d_pad,) this client's additive mask
+    encoded_shares: np.ndarray  # (N, chunk) row j goes to client j
+    received: Dict[int, np.ndarray] = field(default_factory=dict)  # sender -> share
+
+
+def encode_mask(cfg: LightSecAggConfig, d: int, rng: np.random.Generator) -> ClientMaskState:
+    """Offline phase: draw a uniform mask over GF(p) and LCC-encode it into N
+    shares (reference mask_encoding lightsecagg.py:97-123; here the reshape is
+    chunked explicitly and noise rows give T-privacy)."""
+    p = cfg.prime
+    n_data = cfg.target_active - cfg.privacy_guarantee  # U - T payload rows
+    d_pad = _pad_to_chunks(d, n_data)
+    chunk = d_pad // n_data
+
+    local_mask = rng.integers(0, p, size=d_pad, dtype=np.int64)
+    noise = rng.integers(0, p, size=(cfg.privacy_guarantee, chunk), dtype=np.int64)
+    payload = np.concatenate([local_mask.reshape(n_data, chunk), noise], axis=0)  # (U, chunk)
+    encoded = lcc_encode(payload, cfg.beta, cfg.alpha, p)  # (N, chunk)
+    return ClientMaskState(local_mask=local_mask, encoded_shares=encoded)
+
+
+def mask_vector(cfg: LightSecAggConfig, x_finite: np.ndarray, state: ClientMaskState) -> np.ndarray:
+    """Online phase, client side: upload x + z mod p (reference model_masking
+    lightsecagg.py:83-95, flattened)."""
+    d = x_finite.size
+    y = np.mod(np.asarray(x_finite, np.int64) + state.local_mask[:d], cfg.prime)
+    return y
+
+
+def aggregate_encoded_mask(cfg: LightSecAggConfig, state: ClientMaskState, active: Sequence[int]) -> np.ndarray:
+    """Online phase, client side: sum the encoded shares received from the
+    active set (reference compute_aggregate_encoded_mask lightsecagg.py:126-132)."""
+    agg = np.zeros_like(state.encoded_shares[0])
+    for sender in active:
+        agg = np.mod(agg + state.received[sender], cfg.prime)
+    return agg
+
+
+def decode_aggregate_mask(
+    cfg: LightSecAggConfig, agg_shares: Dict[int, np.ndarray], d: int
+) -> np.ndarray:
+    """Server side: from U clients' aggregate-encoded-masks (keyed by 0-based
+    client id), interpolate back to the alpha points and read off the summed
+    mask (first U-T rows). One matmul via lcc_decode."""
+    p = cfg.prime
+    ids = sorted(agg_shares.keys())[: cfg.target_active]
+    if len(ids) < cfg.target_active:
+        raise ValueError(f"need {cfg.target_active} aggregate shares, got {len(ids)}")
+    f_eval = np.stack([agg_shares[i] for i in ids], axis=0)  # (U, chunk)
+    eval_points = cfg.beta[np.asarray(ids)]
+    decoded = lcc_decode(f_eval, eval_points, cfg.alpha, p)  # (U, chunk)
+    n_data = cfg.target_active - cfg.privacy_guarantee
+    return decoded[:n_data].reshape(-1)[:d]
+
+
+def unmask_aggregate(
+    cfg: LightSecAggConfig,
+    masked_sum: np.ndarray,
+    agg_shares: Dict[int, np.ndarray],
+) -> np.ndarray:
+    """Server side: sum_i (x_i + z_i) - sum_i z_i mod p."""
+    d = masked_sum.size
+    agg_mask = decode_aggregate_mask(cfg, agg_shares, d)
+    return np.mod(np.asarray(masked_sum, np.int64) - agg_mask, cfg.prime)
+
+
+def exchange_shares(states: Dict[int, ClientMaskState]) -> None:
+    """Simulate the share-exchange round: client i's row j → client j."""
+    for i, si in states.items():
+        for j, sj in states.items():
+            sj.received[i] = si.encoded_shares[j]

@@ -88,15 +88,24 @@ def weighted_average(pairs: Sequence[Tuple[float, PyTree]]) -> PyTree:
     return acc
 
 
-def tree_flatten_to_vector(a: PyTree) -> Tuple[jax.Array, Any]:
-    """Flatten a pytree to one contiguous fp32 vector (+ recover spec).
+def tree_flatten_to_vector(a: PyTree, dtype=jnp.float32) -> Tuple[jax.Array, Any]:
+    """Flatten a pytree to one contiguous vector of ``dtype`` (+ recover spec).
 
     Used at the WAN comm boundary and by defenses that work in flat space
-    (Krum distances, geometric median)."""
+    (Krum distances, geometric median). Integer dtypes stay on host as exact
+    numpy (core/mpc needs int64 beyond fp32's 2^24 mantissa; jnp would also
+    truncate int64 without x64 mode)."""
     leaves, treedef = jax.tree.flatten(a)
-    shapes = [l.shape for l in leaves]
-    dtypes = [l.dtype for l in leaves]
-    flat = jnp.concatenate([jnp.ravel(l).astype(jnp.float32) for l in leaves]) if leaves else jnp.zeros((0,))
+    shapes = [np.shape(l) for l in leaves]
+    dtypes = [np.asarray(l).dtype for l in leaves]
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        flat = (
+            np.concatenate([np.ravel(np.asarray(l)).astype(dtype) for l in leaves])
+            if leaves
+            else np.zeros((0,), dtype)
+        )
+    else:
+        flat = jnp.concatenate([jnp.ravel(l).astype(dtype) for l in leaves]) if leaves else jnp.zeros((0,), dtype)
     return flat, (treedef, shapes, dtypes)
 
 
