@@ -1,0 +1,45 @@
+"""Executor: the per-party state holder for the flow DSL.
+
+Reference: core/distributed/flow/fedml_executor.py:4-33. A party (client or
+server process) subclasses this, holds its model/data, and exposes task
+methods that the flow sequence names. Params flow between tasks via
+set_params/get_params.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ...alg_frame.params import Params
+
+
+class FedMLExecutor:
+    def __init__(self, id: int, neighbor_id_list: List[int]):
+        self.id = id
+        self.neighbor_id_list = list(neighbor_id_list)
+        self.params: Optional[Params] = None
+        self.context: Any = None
+
+    def get_id(self) -> int:
+        return self.id
+
+    def set_id(self, id: int) -> None:
+        self.id = id
+
+    def get_neighbor_id_list(self) -> List[int]:
+        return self.neighbor_id_list
+
+    def set_neighbor_id_list(self, neighbor_id_list: List[int]) -> None:
+        self.neighbor_id_list = list(neighbor_id_list)
+
+    def get_params(self) -> Optional[Params]:
+        return self.params
+
+    def set_params(self, params: Optional[Params]) -> None:
+        self.params = params
+
+    def get_context(self) -> Any:
+        return self.context
+
+    def set_context(self, context: Any) -> None:
+        self.context = context

@@ -1,0 +1,156 @@
+"""FedMLAlgorithmFlow: declarative multi-step algorithm DSL over the message
+plane.
+
+Reference: core/distributed/flow/fedml_flow.py:20-247. An algorithm is a
+linear sequence of named tasks, each owned by an executor class (Client or
+Server); loops are unrolled by re-adding flows per round (reference
+test_fedml_flow.py:102-107). After a party runs its task, the returned
+Params are routed to whoever owns the next flow: locally if it is the same
+executor class, else as one message per neighbor. A task returning None
+terminates propagation (the fan-in gate: e.g. the server's aggregate task
+returns None until all clients have reported). The final flow triggers a
+FINISH broadcast.
+
+Differences from the reference: flow names are auto-uniquified (the
+reference's dict-by-name silently collapses re-added flows so its unrolled
+loops execute only via name collision); handlers work on any backend
+(in-memory threads in tests, gRPC/MQTT in deployment).
+"""
+
+from __future__ import annotations
+
+import logging
+from time import sleep
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ...alg_frame.params import Params
+from ..communication.message import Message
+from ..fedml_comm_manager import FedMLCommManager
+
+log = logging.getLogger(__name__)
+
+MSG_TYPE_CONNECTION_IS_READY = 0
+MSG_TYPE_FLOW_FINISH = "flow_finish"
+
+PARAMS_KEY_SENDER_ID = "__flow_sender_id"
+PARAMS_KEY_RECEIVER_ID = "__flow_receiver_id"
+
+FlowEntry = Tuple[str, Callable, str, str]  # (unique_name, task, owner_cls, tag)
+
+
+class FedMLAlgorithmFlow(FedMLCommManager):
+    ONCE = "FLOW_TAG_ONCE"
+    FINISH = "FLOW_TAG_FINISH"
+
+    def __init__(self, args: Any, executor, backend: Optional[str] = None, rank: Optional[int] = None,
+                 size: Optional[int] = None):
+        self.executor = executor
+        self.executor_cls_name = type(executor).__name__
+        self.flow_sequence: List[FlowEntry] = []
+        self.flow_by_name: Dict[str, FlowEntry] = {}
+        self.flow_next: Dict[str, Optional[FlowEntry]] = {}
+        self.flow_executed: List[str] = []
+        self._name_counts: Dict[str, int] = {}
+        super().__init__(
+            args,
+            rank=int(rank if rank is not None else getattr(args, "rank", executor.get_id())),
+            size=int(size if size is not None else getattr(args, "worker_num", 0) + 1 if hasattr(args, "worker_num") else 0),
+            backend=backend or getattr(args, "backend", "INMEMORY"),
+        )
+
+    # -- construction (reference add_flow:66, build:77) --------------------
+    def add_flow(self, flow_name: str, executor_task: Callable) -> "FedMLAlgorithmFlow":
+        owner_cls = executor_task.__qualname__.split(".")[0]
+        k = self._name_counts.get(flow_name, 0)
+        self._name_counts[flow_name] = k + 1
+        unique = flow_name if k == 0 else f"{flow_name}#{k}"
+        self.flow_sequence.append((unique, executor_task, owner_cls, self.ONCE))
+        return self
+
+    def build(self) -> None:
+        if not self.flow_sequence:
+            raise ValueError("empty flow sequence")
+        name, task, owner, _ = self.flow_sequence[-1]
+        self.flow_sequence[-1] = (name, task, owner, self.FINISH)
+        self.flow_by_name = {e[0]: e for e in self.flow_sequence}
+        self.flow_next = {
+            e[0]: (self.flow_sequence[i + 1] if i + 1 < len(self.flow_sequence) else None)
+            for i, e in enumerate(self.flow_sequence)
+        }
+        log.info("flow sequence: %s", [(e[0], e[2]) for e in self.flow_sequence])
+
+    # -- message wiring ----------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(MSG_TYPE_CONNECTION_IS_READY, self._on_ready_to_run_flow)
+        self.register_message_receive_handler(MSG_TYPE_FLOW_FINISH, self._handle_flow_finish)
+        for name in self.flow_by_name:
+            self.register_message_receive_handler(name, self._handle_message_received)
+
+    def _on_ready_to_run_flow(self, _msg: Message) -> None:
+        first = self.flow_sequence[0]
+        if first[2] == self.executor_cls_name:
+            self._execute_flow(None, first)
+
+    def _handle_message_received(self, msg: Message) -> None:
+        """A message typed with a *completed* flow's name: run its successor
+        here (reference _handle_message_received:129-142)."""
+        completed = msg.get_type()
+        nxt = self.flow_next[completed]
+        if nxt is None:
+            return
+        params = Params()
+        for key, value in msg.get_params().items():
+            if key != Message.MSG_ARG_KEY_TYPE:
+                params.add(key, value)
+        self._execute_flow(params, nxt)
+
+    # -- execution (reference _execute_flow:143-184) -----------------------
+    def _execute_flow(self, flow_params: Optional[Params], entry: FlowEntry) -> None:
+        name, task, owner_cls, tag = entry
+        if owner_cls != self.executor_cls_name:
+            raise RuntimeError(
+                f"flow {name!r} owned by {owner_cls} cannot run on {self.executor_cls_name}; "
+                f"executed so far: {self.flow_executed}"
+            )
+        log.info("executing flow %s (%s)", name, owner_cls)
+        self.executor.set_params(flow_params)
+        params = task(self.executor)
+        self.flow_executed.append(name)
+
+        nxt = self.flow_next[name]
+        if nxt is None:
+            log.info("flow FINISHED at %s", name)
+            self._shutdown()
+            return
+        if params is None:
+            log.debug("flow %s terminated propagation", name)
+            return
+        params.add(PARAMS_KEY_SENDER_ID, self.executor.get_id())
+        if nxt[2] == self.executor_cls_name:
+            # successor runs on this same party: short-circuit locally
+            msg = self._params_to_message(name, params, self.executor.get_id())
+            self._handle_message_received(msg)
+        else:
+            for rid in self.executor.get_neighbor_id_list():
+                self.send_message(self._params_to_message(name, params, rid))
+
+    def _params_to_message(self, flow_name: str, params: Params, receiver_id: int) -> Message:
+        msg = Message(flow_name, self.executor.get_id(), receiver_id)
+        for key, value in params.items():
+            msg.add_params(key, value)
+        return msg
+
+    # -- teardown ----------------------------------------------------------
+    def _handle_flow_finish(self, _msg: Message) -> None:
+        self._finish_once()
+
+    def _shutdown(self) -> None:
+        for rid in self.executor.get_neighbor_id_list():
+            self.send_message(Message(MSG_TYPE_FLOW_FINISH, self.executor.get_id(), rid))
+        sleep(0.05)  # let outbound finish messages drain before closing
+        self._finish_once()
+
+    def _finish_once(self) -> None:
+        if not getattr(self, "_finished", False):
+            self._finished = True
+            self.finish()

@@ -1,0 +1,18 @@
+"""Federated analytics (reference: python/fedml/fa/)."""
+
+from . import constants
+from .aggregators import create_global_aggregator
+from .analyzers import create_client_analyzer
+from .base_frame import FAClientAnalyzer, FAServerAggregator
+from .runner import FARunner
+from .simulation import FASimulatorSingleProcess
+
+__all__ = [
+    "constants",
+    "create_global_aggregator",
+    "create_client_analyzer",
+    "FAClientAnalyzer",
+    "FAServerAggregator",
+    "FARunner",
+    "FASimulatorSingleProcess",
+]

@@ -1,0 +1,198 @@
+"""FA server-side aggregators.
+
+Reference: python/fedml/fa/aggregator/{avg,union,intersection,
+k_percentile_element,heavy_hitter_triehh}_aggregator.py +
+global_analyzer_creator.py.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter
+from typing import Any, List, Tuple
+
+import numpy as np
+
+from .base_frame import FAServerAggregator
+
+
+class AverageAggregatorFA(FAServerAggregator):
+    """Weighted mean of local means (reference avg_aggregator.py)."""
+
+    def __init__(self, args, train_data_num: int = 0):
+        super().__init__(args)
+        self.set_server_data(0.0)
+
+    def aggregate(self, local_submissions: List[Tuple[float, Any]]):
+        nums = np.asarray([n for n, _ in local_submissions], dtype=np.float64)
+        vals = np.asarray([v for _, v in local_submissions], dtype=np.float64)
+        self.server_data = float((nums * vals).sum() / max(nums.sum(), 1.0))
+        return self.server_data
+
+
+class FrequencyEstimationAggregatorFA(FAServerAggregator):
+    """Counter merge; server_data = global {value: count}."""
+
+    def __init__(self, args, train_data_num: int = 0):
+        super().__init__(args)
+        self.set_server_data({})
+
+    def aggregate(self, local_submissions: List[Tuple[float, Any]]):
+        total: Counter = Counter(self.server_data or {})
+        for _, counts in local_submissions:
+            total.update(counts)
+        self.server_data = dict(total)
+        return self.server_data
+
+
+class UnionAggregatorFA(FAServerAggregator):
+    def __init__(self, args, train_data_num: int = 0):
+        super().__init__(args)
+        self.set_server_data(set())
+
+    def aggregate(self, local_submissions: List[Tuple[float, Any]]):
+        u = set(self.server_data or set())
+        for _, s in local_submissions:
+            u |= set(s)
+        self.server_data = u
+        return u
+
+
+class IntersectionAggregatorFA(FAServerAggregator):
+    def __init__(self, args, train_data_num: int = 0):
+        super().__init__(args)
+        self.set_server_data(None)
+
+    def aggregate(self, local_submissions: List[Tuple[float, Any]]):
+        inter = None
+        for _, s in local_submissions:
+            inter = set(s) if inter is None else inter & set(s)
+        if self.server_data is not None:
+            inter = (inter if inter is not None else set()) & self.server_data
+        self.server_data = inter if inter is not None else set()
+        return self.server_data
+
+
+class CardinalityAggregatorFA(UnionAggregatorFA):
+    def aggregate(self, local_submissions):
+        return len(super().aggregate(local_submissions))
+
+
+class KPercentileElementAggregatorFA(FAServerAggregator):
+    """Find the value v s.t. k% of all samples are >= v, by interval
+    bisection on the broadcast flag. The reference
+    (k_percentile_element_aggregator.py:18-81) walks the flag by
+    doubling/halving with ad-hoc bookkeeping and often fails to converge
+    (its own TODO); this keeps explicit [lo, hi] bounds so each round
+    halves the interval."""
+
+    def __init__(self, args, train_data_num: int):
+        super().__init__(args)
+        self.percentage = float(args.k) / 100.0
+        self.train_data_num_in_total = train_data_num
+        flag = float(getattr(args, "flag", 100.0))
+        self.server_data = flag
+        self.lo = None  # flag known too low (too many satisfied)
+        self.hi = None  # flag known too high (too few satisfied)
+        self.step = max(1.0, abs(flag))  # doubling expansion step; crosses zero
+        self.quit = False
+
+    def aggregate(self, local_submissions: List[Tuple[float, Any]]):
+        if self.quit:
+            return self.server_data
+        total = sum(n for n, _ in local_submissions)
+        satisfied = sum(c for _, c in local_submissions)
+        target = total * self.percentage
+        if satisfied == int(target):
+            self.quit = True
+            return self.server_data
+        if satisfied > target:  # too many values >= flag: raise it
+            self.lo = self.server_data
+            if self.hi is not None:
+                self.server_data = (self.lo + self.hi) / 2
+            else:
+                self.server_data += self.step
+                self.step *= 2
+        else:  # too few: lower it
+            self.hi = self.server_data
+            if self.lo is not None:
+                self.server_data = (self.lo + self.hi) / 2
+            else:
+                self.server_data -= self.step
+                self.step *= 2
+        return self.server_data
+
+
+class HeavyHitterTriehhAggregatorFA(FAServerAggregator):
+    """TrieHH (Zhu et al., 'Federated Heavy Hitters Discovery with
+    Differential Privacy'): grow a prefix trie one character per round,
+    keeping prefixes with >= theta votes. Theta and the per-round sample
+    batch are set from (epsilon, delta) exactly as the reference
+    (heavy_hitter_triehh_aggregator.py:14-81)."""
+
+    def __init__(self, args, train_data_num: int):
+        super().__init__(args)
+        self.MAX_L = int(getattr(args, "max_word_len", 10))
+        self.epsilon = float(getattr(args, "epsilon", 1.0))
+        self.delta = float(getattr(args, "delta", 2.3e-12))
+        self.round_counter = 1
+        self.quit_sign = False
+        self.theta = self._set_theta()
+        grow = math.e ** (self.epsilon / self.MAX_L) - 1
+        batch_size = int(train_data_num * grow / (self.theta * math.e ** (self.epsilon / self.MAX_L)))
+        self.init_msg = max(1, int(math.ceil(batch_size / max(1, args.client_num_per_round))))
+        self.w_global: dict = {}
+        self.set_server_data(self.w_global)
+
+    def _set_theta(self) -> int:
+        theta = 5
+        delta_inverse = 1.0 / self.delta
+        while ((theta - 3) / (theta - 2)) * math.factorial(theta) < delta_inverse:
+            theta += 1
+        while theta < math.e ** (self.epsilon / self.MAX_L) - 1:
+            theta += 1
+        return theta
+
+    def aggregate(self, local_submissions: List[Tuple[float, Any]]):
+        votes: Counter = Counter()
+        for _, vote_dict in local_submissions:
+            votes.update(vote_dict)
+        if not (self.quit_sign or self.round_counter > self.MAX_L):
+            kept = {pfx: c for pfx, c in votes.items() if c >= self.theta and len(pfx) == self.round_counter}
+            if kept:
+                self.w_global.update(kept)
+            else:
+                self.quit_sign = True
+            self.round_counter += 1
+        self.set_server_data(self.w_global)
+        return self.w_global
+
+    def heavy_hitters(self) -> List[str]:
+        """Full-length discovered strings (leaves of the trie at MAX depth or
+        prefixes with no surviving extension)."""
+        out = []
+        for pfx in self.w_global:
+            if not any(other != pfx and other.startswith(pfx) for other in self.w_global):
+                out.append(pfx)
+        return sorted(out)
+
+
+def create_global_aggregator(args, train_data_num: int) -> FAServerAggregator:
+    """Factory keyed on args.fa_task (reference
+    aggregator/global_analyzer_creator.py)."""
+    from . import constants as C
+
+    table = {
+        C.FA_TASK_AVG: AverageAggregatorFA,
+        C.FA_TASK_FREQ: FrequencyEstimationAggregatorFA,
+        C.FA_TASK_HISTOGRAM: FrequencyEstimationAggregatorFA,
+        C.FA_TASK_UNION: UnionAggregatorFA,
+        C.FA_TASK_INTERSECTION: IntersectionAggregatorFA,
+        C.FA_TASK_CARDINALITY: CardinalityAggregatorFA,
+        C.FA_TASK_K_PERCENTILE_ELEMENT: KPercentileElementAggregatorFA,
+        C.FA_TASK_HEAVY_HITTER_TRIEHH: HeavyHitterTriehhAggregatorFA,
+    }
+    task = args.fa_task
+    if task not in table:
+        raise ValueError(f"unknown FA task {task!r}")
+    return table[task](args, train_data_num)

@@ -1,0 +1,119 @@
+"""FA client-side local analyzers.
+
+Reference: python/fedml/fa/local_analyzer/{avg,frequency_estimation,union,
+intersection,k_percentage_element,heavy_hitter_triehh}.py. Numeric analyzers
+are vectorized with numpy (the reference loops in Python); the TrieHH voter
+keeps the same prefix-voting semantics as the reference (client_vote
+heavy_hitter_triehh.py:27-47).
+"""
+
+from __future__ import annotations
+
+from collections import Counter, defaultdict
+from typing import Any
+
+import numpy as np
+
+from .base_frame import FAClientAnalyzer
+
+
+class AverageClientAnalyzer(FAClientAnalyzer):
+    """submission = local mean (server recombines by sample counts)."""
+
+    def local_analyze(self, train_data, args) -> None:
+        arr = np.asarray(train_data, dtype=np.float64)
+        self.set_client_submission(float(arr.mean()) if arr.size else 0.0)
+
+
+class FrequencyEstimationClientAnalyzer(FAClientAnalyzer):
+    """submission = {value: count} over the local shard."""
+
+    def local_analyze(self, train_data, args) -> None:
+        self.set_client_submission(dict(Counter(train_data)))
+
+
+class UnionClientAnalyzer(FAClientAnalyzer):
+    def local_analyze(self, train_data, args) -> None:
+        self.set_client_submission(set(train_data))
+
+
+class IntersectionClientAnalyzer(FAClientAnalyzer):
+    def local_analyze(self, train_data, args) -> None:
+        self.set_client_submission(set(train_data))
+
+
+class CardinalityClientAnalyzer(FAClientAnalyzer):
+    def local_analyze(self, train_data, args) -> None:
+        self.set_client_submission(set(train_data))
+
+
+class KPercentileElementClientAnalyzer(FAClientAnalyzer):
+    """submission = #local values >= the server's current flag
+    (reference k_percentage_element.py:5-11), one vectorized compare."""
+
+    def local_analyze(self, train_data, args) -> None:
+        flag = self.get_server_data()
+        arr = np.asarray(train_data, dtype=np.float64)
+        self.set_client_submission(int((arr >= flag).sum()))
+
+
+class TrieHHClientAnalyzer(FAClientAnalyzer):
+    """Vote for prefixes of length ``round_counter`` whose parent prefix is
+    already in the server trie (reference heavy_hitter_triehh.py:7-47).
+    init_msg = per-client sample batch size chosen by the server for the DP
+    guarantee."""
+
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.batch_size = -1
+        self.rng = np.random.default_rng(getattr(args, "random_seed", 0))
+
+    def set_init_msg(self, init_msg: Any) -> None:
+        self.init_msg = init_msg
+        self.batch_size = int(init_msg)
+
+    def local_analyze(self, train_data, args) -> None:
+        n = len(train_data)
+        bs = min(self.batch_size, n) if self.batch_size > 0 else n
+        idxs = self.rng.choice(n, size=bs, replace=False)
+        sample = [train_data[i] for i in idxs]
+        self.set_client_submission(self._vote(sample))
+
+    def _vote(self, sample) -> dict:
+        # The voting depth is derived from the broadcast trie (deepest kept
+        # prefix + 1) rather than a local round counter — under partial
+        # participation a client may skip rounds, and a local counter
+        # (reference heavy_hitter_triehh.py:29 round_counter) desyncs from
+        # the server, voting at depths the aggregator discards.
+        trie = self.get_server_data()
+        r = 1 + max((len(p) for p in trie), default=0) if trie else 1
+        votes: dict = defaultdict(int)
+        for word in sample:
+            if len(word) < r:
+                continue
+            prefix = word[: r - 1]
+            if trie and prefix and prefix not in trie:
+                continue
+            votes[word[:r]] += 1
+        return dict(votes)
+
+
+def create_client_analyzer(args, dataset_size: int = 0) -> FAClientAnalyzer:
+    """Factory keyed on args.fa_task (reference
+    local_analyzer/client_analyzer_creator.py)."""
+    from . import constants as C
+
+    task = args.fa_task
+    table = {
+        C.FA_TASK_AVG: AverageClientAnalyzer,
+        C.FA_TASK_FREQ: FrequencyEstimationClientAnalyzer,
+        C.FA_TASK_HISTOGRAM: FrequencyEstimationClientAnalyzer,
+        C.FA_TASK_UNION: UnionClientAnalyzer,
+        C.FA_TASK_INTERSECTION: IntersectionClientAnalyzer,
+        C.FA_TASK_CARDINALITY: CardinalityClientAnalyzer,
+        C.FA_TASK_K_PERCENTILE_ELEMENT: KPercentileElementClientAnalyzer,
+        C.FA_TASK_HEAVY_HITTER_TRIEHH: TrieHHClientAnalyzer,
+    }
+    if task not in table:
+        raise ValueError(f"unknown FA task {task!r}")
+    return table[task](args)

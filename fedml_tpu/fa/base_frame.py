@@ -1,0 +1,80 @@
+"""FA base frame: client analyzer + server aggregator protocols.
+
+Reference: python/fedml/fa/base_frame/client_analyzer.py:5 and
+server_aggregator.py:5. The round contract: server holds ``server_data``
+(broadcast each round, e.g. the current trie or percentile flag); each client
+runs ``local_analyze(train_data, args)`` and deposits its result via
+``set_client_submission``; the server folds the (sample_num, submission)
+pairs in ``aggregate``.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, List, Optional, Tuple
+
+
+class FAClientAnalyzer(abc.ABC):
+    def __init__(self, args: Any):
+        self.args = args
+        self.client_submission: Any = 0
+        self.id = 0
+        self.local_train_dataset = None
+        self.local_sample_number = 0
+        self.server_data: Any = None
+        self.init_msg: Any = None
+
+    def set_id(self, analyzer_id: int) -> None:
+        self.id = analyzer_id
+
+    def set_init_msg(self, init_msg: Any) -> None:
+        self.init_msg = init_msg
+
+    def get_init_msg(self) -> Any:
+        return self.init_msg
+
+    def get_client_submission(self) -> Any:
+        return self.client_submission
+
+    def set_client_submission(self, client_submission: Any) -> None:
+        self.client_submission = client_submission
+
+    def get_server_data(self) -> Any:
+        return self.server_data
+
+    def set_server_data(self, server_data: Any) -> None:
+        self.server_data = server_data
+
+    def update_dataset(self, local_train_dataset, local_sample_number: int) -> None:
+        self.local_train_dataset = local_train_dataset
+        self.local_sample_number = local_sample_number
+
+    @abc.abstractmethod
+    def local_analyze(self, train_data, args) -> None: ...
+
+
+class FAServerAggregator(abc.ABC):
+    def __init__(self, args: Any):
+        self.args = args
+        self.id = 0
+        self.eval_data = None
+        self.server_data: Any = None
+        self.init_msg: Any = None
+
+    def set_id(self, aggregator_id: int) -> None:
+        self.id = aggregator_id
+
+    def get_init_msg(self) -> Any:
+        return self.init_msg
+
+    def set_init_msg(self, init_msg: Any) -> None:
+        self.init_msg = init_msg
+
+    def get_server_data(self) -> Any:
+        return self.server_data
+
+    def set_server_data(self, server_data: Any) -> None:
+        self.server_data = server_data
+
+    @abc.abstractmethod
+    def aggregate(self, local_submissions: List[Tuple[float, Any]]) -> Any: ...

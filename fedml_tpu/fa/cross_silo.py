@@ -1,0 +1,128 @@
+"""FA over the cross-silo WAN runtime.
+
+Reference: python/fedml/fa/cross_silo/{fa_client.py,fa_server.py} and the
+manager pair under fa/cross_silo/{client,server}/. The reference duplicates
+the whole FL manager stack for FA; here the FL managers are payload-agnostic,
+so FA rides them through two small adapters: the "model params" slot carries
+(server_data, init_msg) downstream and the analytics submission upstream.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..cross_silo.client.fedml_client_master_manager import ClientMasterManager
+from ..cross_silo.server.fedml_server_manager import FedMLServerManager
+from .aggregators import create_global_aggregator
+from .analyzers import create_client_analyzer
+from .base_frame import FAClientAnalyzer, FAServerAggregator
+
+log = logging.getLogger(__name__)
+
+
+class _FAServerAdapter:
+    """Duck-types the FL FedMLAggregator interface
+    (cross_silo/server/fedml_aggregator.py) around an FAServerAggregator."""
+
+    def __init__(self, args: Any, aggregator: FAServerAggregator, client_num: int):
+        self.args = args
+        self.aggregator = aggregator
+        self.client_num = client_num
+        self.submissions: Dict[int, Tuple[int, Any]] = {}
+        self.flags: Dict[int, bool] = {}
+
+    def get_global_model_params(self):
+        return (self.aggregator.get_server_data(), self.aggregator.get_init_msg())
+
+    def set_global_model_params(self, params) -> None:
+        self.aggregator.set_server_data(params[0] if isinstance(params, tuple) else params)
+
+    def add_local_trained_result(self, index: int, submission, sample_num) -> None:
+        self.submissions[index] = (sample_num, submission)
+        self.flags[index] = True
+
+    def check_whether_all_receive(self) -> bool:
+        return len(self.flags) >= self.client_num
+
+    def aggregate(self):
+        subs = [self.submissions[i] for i in sorted(self.submissions)]
+        self.flags.clear()
+        self.submissions.clear()
+        self.aggregator.aggregate(subs)
+        return self.get_global_model_params()
+
+    def data_silo_selection(self, round_idx: int, client_num_in_total: int, client_num_per_round: int) -> List[int]:
+        if client_num_in_total == client_num_per_round:
+            return list(range(client_num_in_total))
+        np.random.seed(round_idx)
+        return np.random.choice(range(client_num_in_total), client_num_per_round, replace=False).tolist()
+
+    def client_selection(self, round_idx: int, client_id_list_in_total: List[int], client_num_per_round: int) -> List[int]:
+        if client_num_per_round >= len(client_id_list_in_total):
+            return list(client_id_list_in_total)
+        np.random.seed(round_idx)
+        return np.random.choice(client_id_list_in_total, client_num_per_round, replace=False).tolist()
+
+    def test_on_server_for_all_clients(self, round_idx: int) -> Optional[Dict[str, Any]]:
+        return {"fa_result": self.aggregator.get_server_data(), "round": round_idx}
+
+
+class _FAClientAdapter:
+    """Duck-types TrainerDistAdapter (cross_silo/client/
+    fedml_trainer_dist_adapter.py) around an FAClientAnalyzer."""
+
+    def __init__(self, args: Any, analyzer: FAClientAnalyzer, local_data):
+        self.args = args
+        self.analyzer = analyzer
+        self.local_data = local_data  # {silo_index: rows} or flat list
+
+    def update_dataset(self, data_silo_index: int) -> None:
+        if isinstance(self.local_data, dict):
+            shard = self.local_data[data_silo_index]
+        else:
+            shard = self.local_data
+        self.analyzer.update_dataset(list(shard), len(shard))
+
+    def update_model(self, params) -> None:
+        if isinstance(params, tuple):
+            server_data, init_msg = params
+            if init_msg is not None and self.analyzer.get_init_msg() is None:
+                self.analyzer.set_init_msg(init_msg)
+            self.analyzer.set_server_data(server_data)
+        else:
+            self.analyzer.set_server_data(params)
+
+    def train(self, round_idx: int):
+        self.analyzer.local_analyze(self.analyzer.local_train_dataset, self.args)
+        return self.analyzer.get_client_submission(), self.analyzer.local_sample_number
+
+
+class FACrossSiloServer:
+    def __init__(self, args: Any, dataset, server_aggregator: Optional[FAServerAggregator] = None):
+        train_data_num = len(dataset) if dataset is not None else int(getattr(args, "train_data_num", 0))
+        aggregator = server_aggregator or create_global_aggregator(args, train_data_num)
+        adapter = _FAServerAdapter(args, aggregator, int(args.client_num_per_round))
+        self.manager = FedMLServerManager(
+            args, adapter, client_rank=0, client_num=int(args.worker_num), backend=args.backend
+        )
+        self.aggregator = aggregator
+
+    def run(self):
+        self.manager.run()
+        return self.aggregator.get_server_data()
+
+
+class FACrossSiloClient:
+    def __init__(self, args: Any, dataset, client_analyzer: Optional[FAClientAnalyzer] = None):
+        analyzer = client_analyzer or create_client_analyzer(args)
+        adapter = _FAClientAdapter(args, analyzer, dataset)
+        self.manager = ClientMasterManager(
+            args, adapter, rank=int(args.rank), size=int(args.worker_num) + 1, backend=args.backend
+        )
+        self.analyzer = analyzer
+
+    def run(self):
+        self.manager.run()

@@ -1,0 +1,38 @@
+"""FARunner: platform dispatch for federated analytics.
+
+Reference: python/fedml/fa/runner.py:5-49. Simulation runs the sp simulator;
+cross-silo reuses the FL client/server managers with the analyzer in place
+of the trainer (the message protocol is identical — only the payload is an
+analytics submission instead of model params).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..constants import (
+    FEDML_TRAINING_PLATFORM_CROSS_SILO as TRAINING_PLATFORM_CROSS_SILO,
+    FEDML_TRAINING_PLATFORM_SIMULATION as TRAINING_PLATFORM_SIMULATION,
+)
+from .simulation import FASimulatorSingleProcess
+
+
+class FARunner:
+    def __init__(self, args: Any, dataset, client_analyzer=None, server_aggregator=None):
+        training_type = getattr(args, "training_type", TRAINING_PLATFORM_SIMULATION)
+        if training_type == TRAINING_PLATFORM_SIMULATION:
+            self.runner = FASimulatorSingleProcess(args, dataset)
+        elif training_type == TRAINING_PLATFORM_CROSS_SILO:
+            from .cross_silo import FACrossSiloClient, FACrossSiloServer
+
+            if args.role == "client":
+                self.runner = FACrossSiloClient(args, dataset, client_analyzer)
+            elif args.role == "server":
+                self.runner = FACrossSiloServer(args, dataset, server_aggregator)
+            else:
+                raise ValueError(f"unknown role {args.role!r}")
+        else:
+            raise ValueError(f"FA does not support training_type {training_type!r}")
+
+    def run(self) -> Any:
+        return self.runner.run()

@@ -1,0 +1,69 @@
+"""Single-process FA simulator.
+
+Reference: python/fedml/fa/simulation/sp/simulator.py (FASimulatorSingleProcess)
+driving the round loop: sample clients -> broadcast server_data/init_msg ->
+local_analyze -> aggregate. Client sampling is seeded per round with the same
+np.random.seed(round) discipline as the FL simulators
+(simulation/sp/fedavg/fedavg_api.py:132).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from .aggregators import create_global_aggregator
+from .analyzers import create_client_analyzer
+
+log = logging.getLogger(__name__)
+
+
+class FASimulatorSingleProcess:
+    def __init__(self, args: Any, dataset: Sequence):
+        """dataset: either a flat list (partitioned uniformly here) or a
+        dict {client_idx: local_data}."""
+        self.args = args
+        self.client_num_in_total = int(args.client_num_in_total)
+        self.client_num_per_round = int(args.client_num_per_round)
+        self.comm_round = int(args.comm_round)
+
+        if isinstance(dataset, dict):
+            self.local_data: Dict[int, List] = {int(k): list(v) for k, v in dataset.items()}
+        else:
+            data = list(dataset)
+            per = max(1, len(data) // self.client_num_in_total)
+            self.local_data = {
+                c: data[c * per : (c + 1) * per] if c < self.client_num_in_total - 1 else data[c * per :]
+                for c in range(self.client_num_in_total)
+            }
+        self.train_data_num = sum(len(v) for v in self.local_data.values())
+        self.aggregator = create_global_aggregator(args, self.train_data_num)
+        self.analyzers = {c: create_client_analyzer(args) for c in self.local_data}
+        for c, a in self.analyzers.items():
+            a.set_id(c)
+            a.update_dataset(self.local_data[c], len(self.local_data[c]))
+            a.set_init_msg(self.aggregator.get_init_msg())
+
+    def _client_sampling(self, round_idx: int) -> List[int]:
+        if self.client_num_in_total == self.client_num_per_round:
+            return list(range(self.client_num_in_total))
+        np.random.seed(round_idx)
+        return sorted(
+            np.random.choice(range(self.client_num_in_total), self.client_num_per_round, replace=False).tolist()
+        )
+
+    def run(self) -> Any:
+        for round_idx in range(self.comm_round):
+            sampled = self._client_sampling(round_idx)
+            log.info("FA round %d clients=%s", round_idx, sampled)
+            submissions = []
+            for c in sampled:
+                analyzer = self.analyzers[c]
+                analyzer.set_server_data(self.aggregator.get_server_data())
+                analyzer.local_analyze(analyzer.local_train_dataset, self.args)
+                submissions.append((analyzer.local_sample_number, analyzer.get_client_submission()))
+            result = self.aggregator.aggregate(submissions)
+            log.info("FA round %d result=%s", round_idx, str(result)[:200])
+        return self.aggregator.get_server_data()

@@ -97,7 +97,8 @@ def tree_flatten_to_vector(a: PyTree, dtype=jnp.float32) -> Tuple[jax.Array, Any
     truncate int64 without x64 mode)."""
     leaves, treedef = jax.tree.flatten(a)
     shapes = [np.shape(l) for l in leaves]
-    dtypes = [np.asarray(l).dtype for l in leaves]
+    # getattr avoids np.asarray's device->host copy just to read a dtype
+    dtypes = [getattr(l, "dtype", None) or np.asarray(l).dtype for l in leaves]
     if np.issubdtype(np.dtype(dtype), np.integer):
         flat = (
             np.concatenate([np.ravel(np.asarray(l)).astype(dtype) for l in leaves])

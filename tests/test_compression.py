@@ -1,0 +1,91 @@
+"""Compression kernel tests: round trips, error feedback accumulation,
+QSGD unbiasedness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.utils.compression import (
+    EFTopKCompressor,
+    QSGDCompressor,
+    TopKCompressor,
+    compressors,
+    naive_quantize,
+    qsgd_quantize,
+    topk_compress,
+    topk_decompress,
+    tree_topk_compress,
+    tree_topk_decompress,
+)
+
+
+def test_topk_keeps_largest():
+    x = jnp.asarray([0.1, -5.0, 0.2, 3.0, -0.05])
+    values, idx = topk_compress(x, 2)
+    assert set(np.asarray(idx).tolist()) == {1, 3}
+    dense = topk_decompress(values, idx, 5)
+    np.testing.assert_allclose(np.asarray(dense), [0, -5.0, 0, 3.0, 0], rtol=1e-6)
+
+
+def test_topk_compressor_facade_roundtrip():
+    c = TopKCompressor()
+    x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    _, idx, values = c.compress(x, name="w", ratio=0.25)
+    dense = c.decompress_new(values, idx, name="w")
+    assert dense.shape == (4, 8)
+    kept = np.count_nonzero(np.asarray(dense))
+    assert kept == 8  # 25% of 32
+
+
+def test_ef_topk_error_feedback_recovers_dropped_mass():
+    c = EFTopKCompressor()
+    x = np.array([1.0, 0.5, 0.4, 0.3], dtype=np.float32)
+    # round 1: keeps index 0, residual holds the rest
+    _, idx1, _ = c.compress(x, name="g", ratio=0.25)
+    assert np.asarray(idx1).tolist() == [0]
+    # round 2 with zero input: residual dominates, largest residual (0.5+0.5)
+    _, idx2, v2 = c.compress(np.zeros(4, np.float32) + x, name="g", ratio=0.25)
+    # corrected = residual(0,.5,.4,.3) + x = (1.0, 1.0, .8, .6): keeps idx 0 or 1
+    assert np.asarray(idx2).tolist() in ([0], [1])
+    assert float(np.abs(np.asarray(v2))[0]) >= 0.99
+
+
+def test_qsgd_unbiased_in_expectation():
+    x = jnp.asarray(np.random.default_rng(1).normal(size=64).astype(np.float32))
+    keys = jax.random.split(jax.random.PRNGKey(0), 256)
+    outs = jnp.stack([qsgd_quantize(k, x, 4, False) for k in keys])
+    mean = outs.mean(axis=0)
+    err = float(jnp.abs(mean - x).mean() / jnp.abs(x).mean())
+    assert err < 0.15  # stochastic rounding is unbiased; MC error only
+
+
+def test_qsgd_biased_applies_variance_bound_scale():
+    x = jnp.asarray(np.random.default_rng(1).normal(size=64).astype(np.float32))
+    key = jax.random.PRNGKey(3)
+    unb = qsgd_quantize(key, x, 4, False)
+    b = qsgd_quantize(key, x, 4, True)
+    scale = 1.0 / (1.0 + min(64 / 16, 8 / 4))
+    np.testing.assert_allclose(np.asarray(b), np.asarray(unb) * scale, rtol=1e-6)
+
+
+def test_naive_quantize_bounded_error():
+    x = jnp.asarray(np.linspace(-1, 1, 33).astype(np.float32))
+    q = naive_quantize(x, 127)
+    assert float(jnp.abs(q - x).max()) <= float(jnp.linalg.norm(x)) / 127 + 1e-6
+
+
+def test_tree_compress_roundtrip():
+    tree = {
+        "a": jnp.asarray(np.random.default_rng(2).normal(size=(10,)).astype(np.float32)),
+        "b": jnp.asarray(np.random.default_rng(3).normal(size=(3, 4)).astype(np.float32)),
+    }
+    comp = tree_topk_compress(tree, ratio=0.5)
+    back = tree_topk_decompress(comp, tree)
+    assert back["b"].shape == (3, 4)
+    # kept entries match original exactly
+    mask = np.asarray(back["a"]) != 0
+    np.testing.assert_allclose(np.asarray(back["a"])[mask], np.asarray(tree["a"])[mask], rtol=1e-6)
+
+
+def test_registry():
+    assert set(compressors) == {"no", "topk", "eftopk", "quantize", "qsgd"}

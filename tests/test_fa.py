@@ -1,0 +1,143 @@
+"""Federated analytics tests: every analyzer/aggregator pair end-to-end in
+the sp simulator, plus the cross-silo FA path over the in-memory backend."""
+
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from fedml_tpu.fa import FARunner, FASimulatorSingleProcess, constants as C
+from fedml_tpu.fa.aggregators import HeavyHitterTriehhAggregatorFA
+from fedml_tpu.core.distributed.communication.inmemory.broker import InMemoryBroker
+
+
+def _args(**kw):
+    base = dict(
+        training_type="simulation",
+        backend="sp",
+        client_num_in_total=4,
+        client_num_per_round=4,
+        comm_round=1,
+        random_seed=0,
+    )
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+def test_fa_avg_matches_global_mean():
+    data = list(np.arange(100, dtype=np.float64))
+    sim = FASimulatorSingleProcess(_args(fa_task=C.FA_TASK_AVG), data)
+    result = sim.run()
+    assert abs(result - np.mean(data)) < 1e-9
+
+
+def test_fa_frequency_counts():
+    data = ["a"] * 10 + ["b"] * 5 + ["c"]
+    sim = FASimulatorSingleProcess(_args(fa_task=C.FA_TASK_FREQ), data)
+    result = sim.run()
+    assert result["a"] == 10 and result["b"] == 5 and result["c"] == 1
+
+
+def test_fa_union_intersection_cardinality():
+    shards = {0: [1, 2, 3], 1: [2, 3, 4], 2: [3, 4, 5], 3: [3, 9]}
+    union = FASimulatorSingleProcess(_args(fa_task=C.FA_TASK_UNION), shards).run()
+    assert union == {1, 2, 3, 4, 5, 9}
+    inter = FASimulatorSingleProcess(_args(fa_task=C.FA_TASK_INTERSECTION), shards).run()
+    assert inter == {3}
+    sim = FASimulatorSingleProcess(_args(fa_task=C.FA_TASK_CARDINALITY), shards)
+    sim.run()
+    assert len(sim.aggregator.get_server_data()) == 6
+
+
+def test_fa_k_percentile_converges():
+    rng = np.random.default_rng(0)
+    data = list(rng.uniform(0, 200, size=400))
+    args = _args(fa_task=C.FA_TASK_K_PERCENTILE_ELEMENT, k=50, comm_round=40, flag=100.0)
+    result = FASimulatorSingleProcess(args, data).run()
+    # flag should approach the median
+    assert abs(result - np.median(data)) < 10.0
+
+
+def test_fa_k_percentile_crosses_zero():
+    # all-negative data with a positive starting flag: bracket expansion must
+    # cross zero instead of asymptoting at 0
+    rng = np.random.default_rng(1)
+    data = list(rng.uniform(-200, -100, size=400))
+    args = _args(fa_task=C.FA_TASK_K_PERCENTILE_ELEMENT, k=50, comm_round=60, flag=100.0)
+    result = FASimulatorSingleProcess(args, data).run()
+    assert abs(result - np.median(data)) < 10.0
+
+
+def test_fa_triehh_partial_participation_stays_synced():
+    words = ["hello"] * 400 + ["spam", "ham"] * 4
+    args = _args(
+        fa_task=C.FA_TASK_HEAVY_HITTER_TRIEHH,
+        comm_round=8,
+        max_word_len=5,
+        epsilon=5.0,
+        delta=1e-6,
+        client_num_in_total=4,
+        client_num_per_round=2,  # partial participation
+    )
+    sim = FASimulatorSingleProcess(args, words)
+    sim.run()
+    assert "hello" in sim.aggregator.heavy_hitters()
+
+
+def test_fa_triehh_finds_heavy_hitter():
+    # one dominant word among noise; epsilon high so theta small
+    words = ["hello"] * 300 + ["spam", "ham", "eggs"] * 5
+    args = _args(
+        fa_task=C.FA_TASK_HEAVY_HITTER_TRIEHH,
+        comm_round=6,
+        max_word_len=5,
+        epsilon=5.0,
+        delta=1e-6,
+        client_num_in_total=4,
+        client_num_per_round=4,
+    )
+    sim = FASimulatorSingleProcess(args, words)
+    trie = sim.run()
+    agg: HeavyHitterTriehhAggregatorFA = sim.aggregator
+    assert "hello" in agg.heavy_hitters()
+    assert all(not w.startswith("spam"[:2]) for w in trie)  # noise below theta
+
+
+def test_fa_runner_dispatch_simulation():
+    runner = FARunner(_args(fa_task=C.FA_TASK_AVG), [1.0, 2.0, 3.0, 4.0])
+    assert runner.run() == 2.5
+
+
+def test_fa_cross_silo_inmemory():
+    """2 FA clients + server over the real message plane (INMEMORY)."""
+    run_id = "fa_cs_1"
+    InMemoryBroker.reset(run_id)
+    data = {0: [1.0, 2.0, 3.0], 1: [5.0, 7.0]}
+    common = dict(
+        fa_task=C.FA_TASK_AVG,
+        training_type="cross_silo",
+        backend="INMEMORY",
+        run_id=run_id,
+        worker_num=2,
+        client_num_in_total=2,
+        client_num_per_round=2,
+        comm_round=2,
+    )
+    from fedml_tpu.fa.cross_silo import FACrossSiloClient, FACrossSiloServer
+
+    server = FACrossSiloServer(_args(role="server", rank=0, **common), [v for s in data.values() for v in s])
+    clients = [FACrossSiloClient(_args(role="client", rank=r, **common), data) for r in (1, 2)]
+
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    sthread = threading.Thread(target=server.run, daemon=True)
+    for t in threads:
+        t.start()
+    sthread.start()
+    sthread.join(timeout=30)
+    for t in threads:
+        t.join(timeout=10)
+    assert not sthread.is_alive()
+    # weighted mean of all 5 values
+    expected = np.mean([1, 2, 3, 5, 7])
+    assert abs(server.aggregator.get_server_data() - expected) < 1e-9

@@ -1,0 +1,125 @@
+"""Tests for the topology managers and the declarative algorithm flow DSL.
+
+The flow test mirrors the reference's canonical example
+(core/distributed/flow/test_fedml_flow.py): server init -> clients train ->
+server aggregate (fan-in) -> loop -> final eval, run as real threads over the
+in-memory backend.
+"""
+
+import threading
+
+import numpy as np
+
+from fedml_tpu.core.alg_frame.params import Params
+from fedml_tpu.core.distributed.communication.inmemory.broker import InMemoryBroker
+from fedml_tpu.core.distributed.flow import FedMLAlgorithmFlow, FedMLExecutor
+from fedml_tpu.core.distributed.topology import (
+    AsymmetricTopologyManager,
+    SymmetricTopologyManager,
+)
+
+
+def test_symmetric_topology_row_stochastic():
+    tm = SymmetricTopologyManager(8, neighbor_num=4)
+    tm.generate_topology()
+    W = tm.mixing_matrix()
+    np.testing.assert_allclose(W.sum(axis=1), np.ones(8), rtol=1e-6)
+    np.testing.assert_array_equal((W > 0), (W.T > 0))  # symmetric support
+    # ring links present
+    assert W[0, 1] > 0 and W[0, 7] > 0 and W[0, 0] > 0
+    out = tm.get_out_neighbor_idx_list(0)
+    assert 1 in out and 7 in out and 0 not in out
+
+
+def test_asymmetric_topology_shapes_and_weights():
+    tm = AsymmetricTopologyManager(10, undirected_neighbor_num=4, out_directed_neighbor=2, seed=3)
+    tm.generate_topology()
+    W = tm.mixing_matrix()
+    np.testing.assert_allclose(W.sum(axis=1), np.ones(10), rtol=1e-6)
+    # directed: some in/out neighbor sets differ
+    diff = any(
+        set(tm.get_in_neighbor_idx_list(i)) != set(tm.get_out_neighbor_idx_list(i)) for i in range(10)
+    )
+    assert diff
+    assert len(tm.get_in_neighbor_weights(3)) == 10
+
+
+class _Args:
+    def __init__(self, rank, run_id):
+        self.rank = rank
+        self.run_id = run_id
+        self.worker_num = 2
+        self.backend = "INMEMORY"
+
+
+class FlowServer(FedMLExecutor):
+    def __init__(self, args):
+        super().__init__(id=0, neighbor_id_list=[1, 2])
+        self.args = args
+        self.model = np.zeros(4, dtype=np.float32)
+        self.received = []
+        self.rounds_done = 0
+        self.final = None
+
+    def init_global_model(self):
+        return Params(model=self.model)
+
+    def server_aggregate(self):
+        p = self.get_params()
+        self.received.append(np.asarray(p.get("model")))
+        if len(self.received) < 2:
+            return None  # fan-in gate
+        agg = np.mean(self.received, axis=0)
+        self.received = []
+        self.model = agg
+        self.rounds_done += 1
+        return Params(model=agg)
+
+    def final_eval(self):
+        self.final = self.model.copy()
+        return None
+
+
+class FlowClient(FedMLExecutor):
+    def __init__(self, args):
+        super().__init__(id=args.rank, neighbor_id_list=[0])
+        self.args = args
+
+    def handle_init(self):
+        return Params(model=self.get_params().get("model"))
+
+    def local_training(self):
+        m = np.asarray(self.get_params().get("model"))
+        return Params(model=m + self.id)  # deterministic "training"
+
+
+def _build_flow(args, executor, rounds):
+    flow = FedMLAlgorithmFlow(args, executor, backend="INMEMORY", rank=args.rank, size=3)
+    flow.add_flow("init_global_model", FlowServer.init_global_model)
+    flow.add_flow("handle_init", FlowClient.handle_init)
+    for _ in range(rounds):
+        flow.add_flow("local_training", FlowClient.local_training)
+        flow.add_flow("server_aggregate", FlowServer.server_aggregate)
+    flow.add_flow("final_eval", FlowServer.final_eval)
+    flow.build()
+    return flow
+
+
+def test_flow_two_clients_two_rounds():
+    run_id = "flowtest1"
+    InMemoryBroker.reset(run_id)
+    server = FlowServer(_Args(0, run_id))
+    flows = [_build_flow(_Args(0, run_id), server, rounds=2)]
+    for r in (1, 2):
+        flows.append(_build_flow(_Args(r, run_id), FlowClient(_Args(r, run_id)), rounds=2))
+
+    threads = [threading.Thread(target=f.run, daemon=True) for f in flows]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "flow party did not terminate"
+
+    assert server.rounds_done == 2
+    # round 1: mean(0+1, 0+2) = 1.5 ; round 2: mean(1.5+1, 1.5+2) = 3.0
+    np.testing.assert_allclose(server.final, np.full(4, 3.0), rtol=1e-6)
