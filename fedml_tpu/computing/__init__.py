@@ -1,0 +1,1 @@
+"""Compute scheduling (reference: python/fedml/computing/)."""

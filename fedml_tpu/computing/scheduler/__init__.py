@@ -1,0 +1,14 @@
+from .agents import FedMLClientRunner, FedMLServerRunner, RunStatus
+from .job_config import FedMLJobConfig
+from .launch_manager import FedMLLaunchManager
+from .package import build_job_package, retrieve_and_unzip_package
+
+__all__ = [
+    "FedMLClientRunner",
+    "FedMLServerRunner",
+    "RunStatus",
+    "FedMLJobConfig",
+    "FedMLLaunchManager",
+    "build_job_package",
+    "retrieve_and_unzip_package",
+]

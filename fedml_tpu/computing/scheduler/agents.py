@@ -1,0 +1,145 @@
+"""Device agents: slave (client) job runner + master dispatcher.
+
+Reference: computing/scheduler/slave/client_runner.py:61 (FedMLClientRunner:
+callback_start_train:909, retrieve_and_unzip_package:255, bootstrap:394,
+execute_job_task:619) and master/server_runner.py:70 (dispatch per edge
+:1383-1404). The reference runs these as always-on MQTT daemons against the
+Nexus cloud; this build keeps the same request/handler shape over the
+in-process message plane (any FedMLCommManager backend plugs in) and runs
+jobs as local subprocesses.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shlex
+import subprocess
+import tempfile
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .package import retrieve_and_unzip_package
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class RunStatus:
+    run_id: str
+    edge_id: int
+    status: str = "IDLE"  # IDLE/PROVISIONING/RUNNING/FINISHED/FAILED/KILLED
+    returncode: Optional[int] = None
+    log_path: Optional[str] = None
+    detail: str = ""
+
+
+class FedMLClientRunner:
+    """Slave agent: receives a start-train request, provisions the package,
+    runs bootstrap then the job command, and reports status."""
+
+    def __init__(self, edge_id: int, base_dir: Optional[str] = None,
+                 status_callback: Optional[Callable[[RunStatus], None]] = None):
+        self.edge_id = edge_id
+        self.base_dir = base_dir or os.path.join(tempfile.gettempdir(), "fedml_tpu_agent")
+        self.status_callback = status_callback or (lambda s: None)
+        self.runs: Dict[str, RunStatus] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+
+    def _report(self, st: RunStatus) -> None:
+        self.runs[st.run_id] = st
+        self.status_callback(st)
+
+    def callback_start_train(self, request: Dict[str, Any], wait: bool = True) -> RunStatus:
+        """request: {run_id, package_path, job_cmd, bootstrap_cmd?, env?}."""
+        run_id = str(request.get("run_id") or uuid.uuid4().hex[:8])
+        st = RunStatus(run_id=run_id, edge_id=self.edge_id, status="PROVISIONING")
+        self._report(st)
+
+        run_dir = os.path.join(self.base_dir, f"run_{run_id}_edge_{self.edge_id}")
+        try:
+            retrieve_and_unzip_package(request["package_path"], run_dir)
+        except Exception as e:  # noqa: BLE001 - provisioning boundary
+            st.status, st.detail = "FAILED", f"package: {e!r}"
+            self._report(st)
+            return st
+
+        env = dict(os.environ)
+        env.update({k: str(v) for k, v in (request.get("env") or {}).items()})
+        env["FEDML_RUN_ID"] = run_id
+        env["FEDML_EDGE_ID"] = str(self.edge_id)
+        # jobs must be able to `import fedml_tpu` wherever the agent unpacks
+        # them (the reference gets this from the pip-installed fedml package)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        st.log_path = os.path.join(run_dir, "job.log")
+
+        bootstrap = request.get("bootstrap_cmd")
+        if bootstrap:
+            rc = subprocess.run(["bash", "-c", bootstrap], cwd=run_dir, env=env,
+                                capture_output=True, text=True)
+            if rc.returncode != 0:
+                st.status, st.detail = "FAILED", f"bootstrap rc={rc.returncode}: {rc.stderr[-500:]}"
+                self._report(st)
+                return st
+
+        st.status = "RUNNING"
+        self._report(st)
+        logf = open(st.log_path, "w")
+        proc = subprocess.Popen(["bash", "-c", request["job_cmd"]], cwd=run_dir, env=env,
+                                stdout=logf, stderr=subprocess.STDOUT)
+        self._procs[run_id] = proc
+
+        def _wait():
+            rc = proc.wait()
+            logf.close()
+            st.returncode = rc
+            st.status = "FINISHED" if rc == 0 else "FAILED"
+            self._report(st)
+
+        if wait:
+            _wait()
+        else:
+            threading.Thread(target=_wait, daemon=True).start()
+        return st
+
+    def callback_stop_train(self, run_id: str) -> None:
+        proc = self._procs.get(run_id)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            st = self.runs[run_id]
+            st.status = "KILLED"
+            self._report(st)
+
+
+class FedMLServerRunner:
+    """Master agent: fan a start-train request out to edge agents and gate on
+    their completion (reference master/server_runner.py dispatch :1383)."""
+
+    def __init__(self, edges: Dict[int, FedMLClientRunner]):
+        self.edges = edges
+        self.statuses: Dict[str, Dict[int, RunStatus]] = {}
+
+    def dispatch(self, request: Dict[str, Any], edge_ids: Optional[List[int]] = None,
+                 timeout_s: float = 600.0) -> Dict[int, RunStatus]:
+        run_id = str(request.get("run_id") or uuid.uuid4().hex[:8])
+        request = dict(request, run_id=run_id)
+        targets = edge_ids if edge_ids is not None else sorted(self.edges)
+        self.statuses[run_id] = {}
+        threads = []
+        for eid in targets:
+            t = threading.Thread(
+                target=lambda e=eid: self.statuses[run_id].__setitem__(
+                    e, self.edges[e].callback_start_train(request)
+                ),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        deadline = time.time() + timeout_s
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.time()))
+        return self.statuses[run_id]

@@ -1,0 +1,51 @@
+"""Launch job configuration (the job.yaml schema).
+
+Reference: computing/scheduler/scheduler_entry/launch_manager.py:399
+(FedMLJobConfig). Easy-mode schema kept: workspace, job (command string),
+bootstrap, optional server_job, fedml_env (project_name), computing
+resources. Expert mode's explicit interpreter/entry-file split is collapsed
+into the same fields.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any, Dict, Optional
+
+import yaml
+
+
+def load_yaml_config(path: str) -> Dict[str, Any]:
+    with open(path, "r") as f:
+        return yaml.safe_load(f) or {}
+
+
+class FedMLJobConfig:
+    def __init__(self, job_yaml_file: str):
+        self.job_yaml_file = job_yaml_file
+        self.job_config_dict = load_yaml_config(job_yaml_file)
+        self.base_dir = os.path.dirname(os.path.abspath(job_yaml_file))
+
+        env = self.job_config_dict.get("fedml_env", {}) or {}
+        self.project_name: Optional[str] = env.get("project_name")
+        self.job_name: str = self.job_config_dict.get("job_name", f"job-{uuid.uuid4().hex[:8]}")
+
+        workspace = self.job_config_dict.get("workspace")
+        self.workspace = (
+            os.path.normpath(os.path.join(self.base_dir, workspace)) if workspace else self.base_dir
+        )
+        self.job: str = self.job_config_dict.get("job", "") or ""
+        self.bootstrap: Optional[str] = self.job_config_dict.get("bootstrap")
+        self.server_job: Optional[str] = self.job_config_dict.get("server_job")
+
+        computing = self.job_config_dict.get("computing", {}) or {}
+        self.minimum_num_gpus = int(computing.get("minimum_num_gpus", 0))
+        self.maximum_cost_per_hour = computing.get("maximum_cost_per_hour")
+        self.resource_type = computing.get("resource_type", "")
+
+    def validate(self) -> None:
+        if not self.job.strip():
+            raise ValueError(f"{self.job_yaml_file}: 'job' section is empty")
+        if not os.path.isdir(self.workspace):
+            raise ValueError(f"workspace {self.workspace!r} does not exist")

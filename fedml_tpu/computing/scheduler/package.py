@@ -1,0 +1,42 @@
+"""Job package build/extract.
+
+Reference: the launch path zips the workspace + config and the slave agent
+unzips it (scheduler_entry build-package assets; slave/client_runner.py:255
+retrieve_and_unzip_package). Local-first here: "retrieve" is a file copy,
+but the zip format keeps parity so packages could travel any transport.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zipfile
+from typing import Any, Dict, Optional
+
+
+PACKAGE_META = "fedml_job_meta.json"
+
+
+def build_job_package(workspace: str, out_path: str, meta: Optional[Dict[str, Any]] = None) -> str:
+    """Zip the workspace (plus a meta manifest) into out_path."""
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with zipfile.ZipFile(out_path, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, _dirs, files in os.walk(workspace):
+            for fn in files:
+                full = os.path.join(root, fn)
+                rel = os.path.relpath(full, workspace)
+                z.write(full, rel)
+        z.writestr(PACKAGE_META, json.dumps(meta or {}))
+    return out_path
+
+
+def retrieve_and_unzip_package(package_path: str, dest_dir: str) -> Dict[str, Any]:
+    """Extract a package and return its meta manifest."""
+    os.makedirs(dest_dir, exist_ok=True)
+    with zipfile.ZipFile(package_path, "r") as z:
+        z.extractall(dest_dir)
+    meta_path = os.path.join(dest_dir, PACKAGE_META)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            return json.load(f)
+    return {}

@@ -33,7 +33,10 @@ MSG_TYPE_CONNECTION_IS_READY = 0
 MSG_TYPE_FLOW_FINISH = "flow_finish"
 
 PARAMS_KEY_SENDER_ID = "__flow_sender_id"
-PARAMS_KEY_RECEIVER_ID = "__flow_receiver_id"
+# message-transport fields that must never collide with user Params keys
+_RESERVED_KEYS = frozenset(
+    {Message.MSG_ARG_KEY_TYPE, Message.MSG_ARG_KEY_SENDER, Message.MSG_ARG_KEY_RECEIVER, PARAMS_KEY_SENDER_ID}
+)
 
 FlowEntry = Tuple[str, Callable, str, str]  # (unique_name, task, owner_cls, tag)
 
@@ -100,7 +103,7 @@ class FedMLAlgorithmFlow(FedMLCommManager):
             return
         params = Params()
         for key, value in msg.get_params().items():
-            if key != Message.MSG_ARG_KEY_TYPE:
+            if key not in _RESERVED_KEYS:
                 params.add(key, value)
         self._execute_flow(params, nxt)
 
@@ -125,7 +128,6 @@ class FedMLAlgorithmFlow(FedMLCommManager):
         if params is None:
             log.debug("flow %s terminated propagation", name)
             return
-        params.add(PARAMS_KEY_SENDER_ID, self.executor.get_id())
         if nxt[2] == self.executor_cls_name:
             # successor runs on this same party: short-circuit locally
             msg = self._params_to_message(name, params, self.executor.get_id())
@@ -137,7 +139,10 @@ class FedMLAlgorithmFlow(FedMLCommManager):
     def _params_to_message(self, flow_name: str, params: Params, receiver_id: int) -> Message:
         msg = Message(flow_name, self.executor.get_id(), receiver_id)
         for key, value in params.items():
-            msg.add_params(key, value)
+            if key in _RESERVED_KEYS and key != PARAMS_KEY_SENDER_ID:
+                raise ValueError(f"Params key {key!r} collides with a reserved message field")
+            if key != PARAMS_KEY_SENDER_ID:
+                msg.add_params(key, value)
         return msg
 
     # -- teardown ----------------------------------------------------------

@@ -33,9 +33,11 @@ class AsymmetricTopologyManager(BaseTopologyManager):
         directed_added = set()
         for i in range(n):
             zeros = np.nonzero(adj[i] == 0)[0]
-            picks = rng.integers(0, 2, size=len(zeros))
-            for j, take in zip(zeros, picks):
-                if take and (int(j), i) not in directed_added:
+            if len(zeros) == 0:
+                continue
+            k = min(self.out_directed_neighbor, len(zeros))
+            for j in rng.choice(zeros, size=k, replace=False):
+                if (int(j), i) not in directed_added:
                     adj[i, int(j)] = 1
                     directed_added.add((i, int(j)))
 

@@ -85,16 +85,10 @@ class FedMLAggregator:
     def data_silo_selection(self, round_idx: int, client_num_in_total: int, client_num_per_round: int) -> List[int]:
         """reference fedml_aggregator.py data_silo_selection — sample which
         data silos the online clients should train on this round."""
-        if client_num_in_total == client_num_per_round:
-            return list(range(client_num_in_total))
-        np.random.seed(round_idx)
-        return list(np.random.choice(range(client_num_in_total), client_num_per_round, replace=False))
+        return select_data_silos(round_idx, client_num_in_total, client_num_per_round)
 
     def client_selection(self, round_idx: int, client_id_list_in_total: List[int], client_num_per_round: int) -> List[int]:
-        if client_num_per_round == len(client_id_list_in_total):
-            return list(client_id_list_in_total)
-        np.random.seed(round_idx)
-        return list(np.random.choice(client_id_list_in_total, client_num_per_round, replace=False))
+        return select_clients(round_idx, client_id_list_in_total, client_num_per_round)
 
     def test_on_server_for_all_clients(self, round_idx: int) -> Optional[Dict[str, float]]:
         freq = int(getattr(self.args, "frequency_of_the_test", 5))
@@ -106,3 +100,21 @@ class FedMLAggregator:
         mlops.log({"round_idx": round_idx, **{k: float(v) for k, v in metrics.items()}}, step=round_idx)
         log.info("server test round %d: %s", round_idx, metrics)
         return metrics
+
+
+def select_data_silos(round_idx: int, client_num_in_total: int, client_num_per_round: int) -> List[int]:
+    """Round-seeded silo sampling (reference fedml_aggregator.py
+    data_silo_selection; np.random.seed(round_idx) keeps runs reproducible
+    and bit-comparable with the reference's sampling discipline). Shared by
+    the FL aggregator, the FA adapters and the sp simulators."""
+    if client_num_in_total == client_num_per_round:
+        return list(range(client_num_in_total))
+    np.random.seed(round_idx)
+    return list(np.random.choice(range(client_num_in_total), client_num_per_round, replace=False))
+
+
+def select_clients(round_idx: int, client_id_list_in_total: List[int], client_num_per_round: int) -> List[int]:
+    if client_num_per_round >= len(client_id_list_in_total):
+        return list(client_id_list_in_total)
+    np.random.seed(round_idx)
+    return list(np.random.choice(client_id_list_in_total, client_num_per_round, replace=False))

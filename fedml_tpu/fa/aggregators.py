@@ -31,14 +31,16 @@ class AverageAggregatorFA(FAServerAggregator):
 
 
 class FrequencyEstimationAggregatorFA(FAServerAggregator):
-    """Counter merge; server_data = global {value: count}."""
+    """Counter merge; server_data = {value: count} over the clients sampled
+    THIS round (clients resubmit their full shard each round, so carrying
+    counts across rounds would multiply them by comm_round)."""
 
     def __init__(self, args, train_data_num: int = 0):
         super().__init__(args)
         self.set_server_data({})
 
     def aggregate(self, local_submissions: List[Tuple[float, Any]]):
-        total: Counter = Counter(self.server_data or {})
+        total: Counter = Counter()
         for _, counts in local_submissions:
             total.update(counts)
         self.server_data = dict(total)

@@ -55,16 +55,14 @@ class _FAServerAdapter:
         return self.get_global_model_params()
 
     def data_silo_selection(self, round_idx: int, client_num_in_total: int, client_num_per_round: int) -> List[int]:
-        if client_num_in_total == client_num_per_round:
-            return list(range(client_num_in_total))
-        np.random.seed(round_idx)
-        return np.random.choice(range(client_num_in_total), client_num_per_round, replace=False).tolist()
+        from ..cross_silo.server.fedml_aggregator import select_data_silos
+
+        return select_data_silos(round_idx, client_num_in_total, client_num_per_round)
 
     def client_selection(self, round_idx: int, client_id_list_in_total: List[int], client_num_per_round: int) -> List[int]:
-        if client_num_per_round >= len(client_id_list_in_total):
-            return list(client_id_list_in_total)
-        np.random.seed(round_idx)
-        return np.random.choice(client_id_list_in_total, client_num_per_round, replace=False).tolist()
+        from ..cross_silo.server.fedml_aggregator import select_clients
+
+        return select_clients(round_idx, client_id_list_in_total, client_num_per_round)
 
     def test_on_server_for_all_clients(self, round_idx: int) -> Optional[Dict[str, Any]]:
         return {"fa_result": self.aggregator.get_server_data(), "round": round_idx}

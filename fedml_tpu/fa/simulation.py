@@ -47,12 +47,9 @@ class FASimulatorSingleProcess:
             a.set_init_msg(self.aggregator.get_init_msg())
 
     def _client_sampling(self, round_idx: int) -> List[int]:
-        if self.client_num_in_total == self.client_num_per_round:
-            return list(range(self.client_num_in_total))
-        np.random.seed(round_idx)
-        return sorted(
-            np.random.choice(range(self.client_num_in_total), self.client_num_per_round, replace=False).tolist()
-        )
+        from ..cross_silo.server.fedml_aggregator import select_data_silos
+
+        return sorted(select_data_silos(round_idx, self.client_num_in_total, self.client_num_per_round))
 
     def run(self) -> Any:
         for round_idx in range(self.comm_round):

@@ -138,3 +138,83 @@ def log_sys_perf(args: Any = None) -> None:
     except Exception:  # pragma: no cover
         pass
     MLOpsRuntime.get_instance().append_record(rec)
+
+
+def log_metric(metrics: Dict[str, Any], step: Optional[int] = None, commit: bool = True) -> None:
+    """Alias surface (reference: mlops.log_metric core/mlops/__init__.py:760)."""
+    log(metrics, step=step, commit=commit)
+
+
+def log_artifact(artifact_path: str, artifact_name: Optional[str] = None, artifact_type: str = "general") -> None:
+    """Register an artifact file with the run (reference:
+    mlops.log_artifact core/mlops/__init__.py:800 — uploads to S3; here the
+    path is recorded and copied into the run dir when tracking is on)."""
+    rt = MLOpsRuntime.get_instance()
+    name = artifact_name or os.path.basename(artifact_path)
+    rec = {"type": "artifact", "name": name, "artifact_type": artifact_type, "path": os.path.abspath(artifact_path)}
+    if rt.enabled and rt.run_dir and os.path.isfile(artifact_path):
+        import shutil
+
+        dst = os.path.join(rt.run_dir, "artifacts")
+        os.makedirs(dst, exist_ok=True)
+        shutil.copy2(artifact_path, os.path.join(dst, name))
+        rec["stored"] = os.path.join(dst, name)
+    rt.append_record(rec)
+
+
+def log_model(model_name: str, model_file_path: str, version: Optional[str] = None) -> None:
+    """Reference: mlops.log_model core/mlops/__init__.py:840."""
+    log_artifact(model_file_path, artifact_name=model_name, artifact_type="model")
+    MLOpsRuntime.get_instance().append_record({"type": "model", "name": model_name, "version": version})
+
+
+def log_llm_record(prompts: Any, completions: Any, run_id: Optional[str] = None) -> None:
+    """Reference: mlops.log_llm_record core/mlops/__init__.py:870 — LLM
+    prompt/completion pairs for the FedLLM path."""
+    MLOpsRuntime.get_instance().append_record(
+        {"type": "llm_record", "prompts": prompts, "completions": completions, "run_id": run_id}
+    )
+
+
+def log_endpoint(endpoint_name: str, status: str, url: Optional[str] = None) -> None:
+    """Reference: mlops.log_endpoint — serving endpoint lifecycle records."""
+    MLOpsRuntime.get_instance().append_record(
+        {"type": "endpoint", "name": endpoint_name, "status": status, "url": url}
+    )
+
+
+class MLOpsMetrics:
+    """Status/metric sender facade (reference: mlops_metrics.py
+    MLOpsMetrics). Methods mirror the run status state machine; records land
+    in the runtime (and any attached sink) instead of raw MQTT."""
+
+    def __init__(self, runtime: Optional[MLOpsRuntime] = None):
+        self.rt = runtime or MLOpsRuntime.get_instance()
+
+    def report_client_training_status(self, edge_id: int, status: str, run_id: Optional[str] = None) -> None:
+        self.rt.append_record(
+            {"type": "status", "role": "client", "edge_id": edge_id, "status": status, "run_id": run_id}
+        )
+
+    def report_server_training_status(self, run_id: str, status: str) -> None:
+        self.rt.append_record({"type": "status", "role": "server", "status": status, "run_id": run_id})
+
+    def report_client_id_status(self, run_id: str, edge_id: int, status: str) -> None:
+        self.report_client_training_status(edge_id, status, run_id)
+
+    def report_training_metric(self, metrics: Dict[str, Any]) -> None:
+        log(metrics)
+
+
+def start_log_daemon(args: Any = None, rank: int = 0):
+    """Wire MLOpsRuntimeLog + MLOpsRuntimeLogDaemon for the current run and
+    start shipping; returns the daemon (caller stops it)."""
+    from .runtime_log import MLOpsRuntimeLog, MLOpsRuntimeLogDaemon
+
+    rt = MLOpsRuntime.get_instance()
+    run_id = str(getattr(args, "run_id", "0")) if args is not None else "0"
+    run_dir = rt.run_dir or os.path.join(os.path.expanduser("~/.fedml_tpu/logs"), f"run_{run_id}")
+    path = MLOpsRuntimeLog.init(run_dir, run_id, rank)
+    daemon = MLOpsRuntimeLogDaemon(path, run_id, rank)
+    daemon.start()
+    return daemon

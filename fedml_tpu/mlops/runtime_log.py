@@ -1,0 +1,156 @@
+"""Per-run log capture + upload daemon.
+
+Reference: core/mlops/mlops_runtime_log.py (redirect python logging into
+~/.fedml/.../logs per run) and mlops_runtime_log_daemon.py (tail the file and
+POST chunks to the MLOps backend). The TPU build keeps the same two pieces
+but the uploader is a pluggable sink — default spools chunks to a local
+directory; a MQTT/REST sink can be attached in deployment without touching
+call sites.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+class MLOpsRuntimeLog:
+    """Attach a per-run FileHandler to the root logger."""
+
+    _handlers = {}
+
+    @classmethod
+    def init(cls, run_dir: str, run_id: str, rank: int = 0) -> str:
+        os.makedirs(run_dir, exist_ok=True)
+        path = os.path.join(run_dir, f"fedml-run-{run_id}-rank-{rank}.log")
+        key = (run_id, rank)
+        if key not in cls._handlers:
+            h = logging.FileHandler(path)
+            h.setFormatter(logging.Formatter("[FedML-TPU] %(asctime)s %(levelname)s %(name)s: %(message)s"))
+            logging.getLogger().addHandler(h)
+            cls._handlers[key] = h
+        return path
+
+    @classmethod
+    def detach(cls, run_id: str, rank: int = 0) -> None:
+        h = cls._handlers.pop((run_id, rank), None)
+        if h is not None:
+            logging.getLogger().removeHandler(h)
+            h.close()
+
+
+class MLOpsRuntimeLogDaemon:
+    """Tails a log file and ships new chunks to a sink callable.
+
+    Reference: mlops_runtime_log_daemon.py — chunked POST of rotated log
+    lines. Sink signature: sink(run_id, rank, lines: List[str]) -> None.
+    """
+
+    def __init__(
+        self,
+        log_path: str,
+        run_id: str,
+        rank: int = 0,
+        sink: Optional[Callable[[str, int, List[str]], None]] = None,
+        interval_s: float = 0.5,
+        spool_dir: Optional[str] = None,
+    ):
+        self.log_path = log_path
+        self.run_id = run_id
+        self.rank = rank
+        self.interval_s = interval_s
+        self.spool_dir = spool_dir or os.path.join(os.path.dirname(log_path), "spool")
+        self.sink = sink or self._spool_sink
+        self._pos = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.chunks_shipped = 0
+
+    def _spool_sink(self, run_id: str, rank: int, lines: List[str]) -> None:
+        os.makedirs(self.spool_dir, exist_ok=True)
+        path = os.path.join(self.spool_dir, f"{run_id}-{rank}-{self.chunks_shipped:06d}.log")
+        with open(path, "w") as f:
+            f.writelines(lines)
+
+    def poll_once(self) -> int:
+        """Ship any new lines; returns count (exposed for tests)."""
+        if not os.path.exists(self.log_path):
+            return 0
+        with open(self.log_path, "r") as f:
+            f.seek(self._pos)
+            lines = f.readlines()
+            self._pos = f.tell()
+        if lines:
+            self.sink(self.run_id, self.rank, lines)
+            self.chunks_shipped += 1
+        return len(lines)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.poll_once()
+            self._stop.wait(self.interval_s)
+        self.poll_once()  # final drain
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True, name="mlops-log-daemon")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class SysPerfSampler:
+    """Continuous CPU/mem/device sampling thread (reference:
+    mlops_device_perfs.py + system_stats.py, psutil-based)."""
+
+    def __init__(self, record_fn: Callable[[dict], None], interval_s: float = 10.0):
+        self.record_fn = record_fn
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample_once(self) -> dict:
+        rec = {"type": "sys_perf", "t": time.time()}
+        try:
+            import psutil
+
+            rec["cpu_pct"] = psutil.cpu_percent(interval=None)
+            rec["mem_pct"] = psutil.virtual_memory().percent
+            net = psutil.net_io_counters()
+            rec["net_sent"] = net.bytes_sent
+            rec["net_recv"] = net.bytes_recv
+        except Exception:  # pragma: no cover
+            pass
+        try:
+            import jax
+
+            stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
+            if stats:
+                rec["device_bytes_in_use"] = stats.get("bytes_in_use")
+        except Exception:  # pragma: no cover
+            pass
+        self.record_fn(rec)
+        return rec
+
+    def start(self) -> None:
+        if self._thread is None:
+            def _loop():
+                while not self._stop.is_set():
+                    self.sample_once()
+                    self._stop.wait(self.interval_s)
+
+            self._thread = threading.Thread(target=_loop, daemon=True, name="mlops-sys-perf")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,15 @@
+"""Model serving (reference: python/fedml/serving/ + model_scheduler/)."""
+
+from .endpoint import Endpoint, EndpointManager, ModelCard, ModelDB
+from .fedml_inference_runner import FedMLInferenceRunner
+from .fedml_predictor import FedMLPredictor, JaxPredictor
+
+__all__ = [
+    "Endpoint",
+    "EndpointManager",
+    "ModelCard",
+    "ModelDB",
+    "FedMLInferenceRunner",
+    "FedMLPredictor",
+    "JaxPredictor",
+]

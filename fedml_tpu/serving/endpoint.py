@@ -1,0 +1,137 @@
+"""Endpoint lifecycle: model cards, replica control, inference gateway.
+
+Reference: computing/scheduler/model_scheduler/ — device_model_deployment.py
+start_deployment:68 (docker/Triton there; in-process replicas here),
+device_replica_controller.py (replica scale-up/down), device_model_inference.py
+(gateway forwarding), device_model_db.py (model card persistence — sqlite
+there, JSON here). A deployed endpoint = N FedMLInferenceRunner replicas with
+a round-robin gateway; scale_to() adds/removes replicas live.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .fedml_inference_runner import FedMLInferenceRunner
+from .fedml_predictor import FedMLPredictor
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ModelCard:
+    name: str
+    version: str
+    model_path: str
+    created_at: float = field(default_factory=time.time)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+
+class ModelDB:
+    """Local model-card store (reference device_model_db.py, sqlite->JSON)."""
+
+    def __init__(self, db_path: str):
+        self.db_path = db_path
+        self.cards: Dict[str, ModelCard] = {}
+        if os.path.exists(db_path):
+            with open(db_path) as f:
+                for rec in json.load(f):
+                    self.cards[f"{rec['name']}:{rec['version']}"] = ModelCard(**rec)
+
+    def save(self) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(self.db_path)), exist_ok=True)
+        with open(self.db_path, "w") as f:
+            json.dump([vars(c) for c in self.cards.values()], f)
+
+    def add(self, card: ModelCard) -> None:
+        self.cards[f"{card.name}:{card.version}"] = card
+        self.save()
+
+    def get(self, name: str, version: str = "latest") -> Optional[ModelCard]:
+        if version == "latest":
+            matches = [c for c in self.cards.values() if c.name == name]
+            return max(matches, key=lambda c: c.created_at) if matches else None
+        return self.cards.get(f"{name}:{version}")
+
+
+class Endpoint:
+    """N replicas + round-robin gateway."""
+
+    def __init__(self, name: str, predictor_factory: Callable[[], FedMLPredictor], num_replicas: int = 1):
+        self.name = name
+        self.predictor_factory = predictor_factory
+        self.replicas: List[FedMLInferenceRunner] = []
+        self._rr = itertools.count()
+        self._lock = threading.Lock()
+        self.scale_to(num_replicas)
+
+    def scale_to(self, n: int) -> None:
+        with self._lock:
+            while len(self.replicas) < n:
+                runner = FedMLInferenceRunner(self.predictor_factory(), port=0)
+                runner.start()
+                self.replicas.append(runner)
+                log.info("endpoint %s: replica up on port %d", self.name, runner.port)
+            while len(self.replicas) > n:
+                runner = self.replicas.pop()
+                runner.stop()
+                log.info("endpoint %s: replica down", self.name)
+
+    @property
+    def urls(self) -> List[str]:
+        return [f"http://{r.host}:{r.port}" for r in self.replicas]
+
+    def ready(self) -> bool:
+        return all(r.client_predictor.ready() for r in self.replicas)
+
+    def predict(self, payload: Dict[str, Any], timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Gateway: forward to the next replica over real HTTP (reference
+        device_model_inference.py forwards to the container)."""
+        with self._lock:
+            if not self.replicas:
+                raise RuntimeError(f"endpoint {self.name} has no replicas")
+            idx = next(self._rr) % len(self.replicas)
+            url = self.urls[idx]
+        req = urllib.request.Request(
+            url + "/predict", data=json.dumps(payload).encode(), headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return json.loads(resp.read())
+
+    def shutdown(self) -> None:
+        self.scale_to(0)
+
+
+class EndpointManager:
+    """Deploy/undeploy endpoints by model card (reference
+    model_scheduler master runner surface)."""
+
+    def __init__(self, db: Optional[ModelDB] = None):
+        self.db = db
+        self.endpoints: Dict[str, Endpoint] = {}
+
+    def deploy(self, name: str, predictor_factory: Callable[[], FedMLPredictor], num_replicas: int = 1) -> Endpoint:
+        if name in self.endpoints:
+            raise ValueError(f"endpoint {name!r} already deployed")
+        ep = Endpoint(name, predictor_factory, num_replicas)
+        self.endpoints[name] = ep
+        try:
+            from .. import mlops
+
+            mlops.log_endpoint(name, "DEPLOYED", ep.urls[0] if ep.urls else None)
+        except Exception:  # pragma: no cover
+            pass
+        return ep
+
+    def undeploy(self, name: str) -> None:
+        ep = self.endpoints.pop(name, None)
+        if ep is not None:
+            ep.shutdown()

@@ -1,0 +1,34 @@
+"""FastAPI flavor of the inference app (used only when fastapi/uvicorn are
+installed; reference serving/fedml_inference_runner.py:12-50 route contract)."""
+
+from __future__ import annotations
+
+import asyncio
+
+from fastapi import FastAPI, Request, Response, status  # type: ignore
+
+
+def build_fastapi_app(predictor) -> "FastAPI":
+    api = FastAPI()
+
+    @api.post("/predict")
+    async def predict(request: Request):
+        input_json = await request.json()
+        resp = predictor.predict(input_json)
+        if asyncio.iscoroutine(resp):
+            resp = await resp
+        return resp
+
+    @api.get("/ready")
+    async def ready():
+        if predictor.ready():
+            return {"status": "Success"}
+        return Response(status_code=status.HTTP_202_ACCEPTED)
+
+    return api
+
+
+def run_fastapi(predictor, host: str, port: int) -> None:
+    import uvicorn  # type: ignore
+
+    uvicorn.run(build_fastapi_app(predictor), host=host, port=port)

@@ -1,0 +1,104 @@
+"""HTTP inference runner: POST /predict, GET /ready.
+
+Reference: python/fedml/serving/fedml_inference_runner.py:8-50 (FastAPI +
+uvicorn). This environment has no FastAPI, so the same two routes are served
+by a stdlib ThreadingHTTPServer; when FastAPI is importable the FastAPI app
+is used instead (build_fastapi_app), keeping the reference's exact route
+contract either way.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from .fedml_predictor import FedMLPredictor
+
+log = logging.getLogger(__name__)
+
+
+class FedMLInferenceRunner:
+    def __init__(self, client_predictor: FedMLPredictor, port: int = 2345, host: str = "127.0.0.1"):
+        self.client_predictor = client_predictor
+        self.port = port
+        self.host = host
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- stdlib path -------------------------------------------------------
+    def _make_handler(self):
+        predictor = self.client_predictor
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route to logging, not stderr
+                log.debug("inference http: " + fmt, *args)
+
+            def _send_json(self, obj: Any, code: int = 200) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/ready":
+                    if predictor.ready():
+                        self._send_json({"status": "Success"})
+                    else:
+                        self._send_json({"status": "Initializing"}, code=202)
+                else:
+                    self._send_json({"error": "not found"}, code=404)
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._send_json({"error": "not found"}, code=404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    input_json = json.loads(self.rfile.read(length) or b"{}")
+                    try:
+                        resp = predictor.predict(input_json)
+                    except NotImplementedError:
+                        # predictor implements only async_predict (allowed by
+                        # the FedMLPredictor contract)
+                        resp = predictor.async_predict(input_json)
+                    if asyncio.iscoroutine(resp):
+                        resp = asyncio.run(resp)
+                    self._send_json(resp)
+                except Exception as e:  # noqa: BLE001 - request boundary
+                    log.exception("predict failed")
+                    self._send_json({"error": repr(e)}, code=500)
+
+        return Handler
+
+    def start(self) -> int:
+        """Non-blocking start; returns the bound port (0 picks a free one)."""
+        self._server = ThreadingHTTPServer((self.host, self.port), self._make_handler())
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def run(self) -> None:
+        """Blocking serve (reference run() semantics)."""
+        try:
+            from .fastapi_app import run_fastapi  # noqa: F401
+
+            run_fastapi(self.client_predictor, self.host, self.port)
+            return
+        except ImportError:
+            pass
+        self.start()
+        assert self._thread is not None
+        self._thread.join()

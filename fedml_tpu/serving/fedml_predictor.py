@@ -1,0 +1,63 @@
+"""User-facing predictor contract for model serving.
+
+Reference: python/fedml/serving/fedml_predictor.py:4-22 — subclasses must
+implement predict() (or async_predict); ready() gates the readiness probe.
+Includes a JaxPredictor convenience that jits a pure forward function once
+and serves it (the TPU-native hot path: one compiled XLA executable per
+endpoint, inputs batched to fixed shapes to avoid recompiles).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Optional
+
+
+class FedMLPredictor(abc.ABC):
+    def __init__(self):
+        if type(self).predict is FedMLPredictor.predict and type(self).async_predict is FedMLPredictor.async_predict:
+            raise NotImplementedError("At least one of the predict methods must be implemented.")
+
+    def predict(self, *args, **kwargs):
+        raise NotImplementedError
+
+    async def async_predict(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def ready(self) -> bool:
+        return True
+
+
+class JaxPredictor(FedMLPredictor):
+    """Serve a jitted forward fn over JSON: {"inputs": [[...]]} -> {"outputs": ...}."""
+
+    def __init__(self, forward_fn: Callable, params: Any, preprocess: Optional[Callable] = None,
+                 postprocess: Optional[Callable] = None):
+        import jax
+
+        self._fn = jax.jit(forward_fn)
+        self._params = params
+        self._pre = preprocess
+        self._post = postprocess
+        self._ready = False
+
+    def warmup(self, example: Any) -> None:
+        import jax
+
+        jax.block_until_ready(self._fn(self._params, example))
+        self._ready = True
+
+    def ready(self) -> bool:
+        return self._ready
+
+    def predict(self, request: dict, *args, **kwargs):
+        import jax.numpy as jnp
+        import numpy as np
+
+        x = request["inputs"]
+        if self._pre is not None:
+            x = self._pre(x)
+        out = self._fn(self._params, jnp.asarray(np.asarray(x, dtype=np.float32)))
+        if self._post is not None:
+            return self._post(out)
+        return {"outputs": np.asarray(out).tolist()}

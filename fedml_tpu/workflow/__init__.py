@@ -1,0 +1,4 @@
+from .jobs import CallableJob, Job, JobStatus, NullJob, ProcessJob
+from .workflow import Workflow
+
+__all__ = ["CallableJob", "Job", "JobStatus", "NullJob", "ProcessJob", "Workflow"]

@@ -1,0 +1,106 @@
+"""Workflow jobs: status model + Job ABC + concrete runners.
+
+Reference: python/fedml/workflow/jobs.py (JobStatus:9, Job:42). The
+reference's concrete jobs wrap MLOps launch runs; here the built-ins are a
+CallableJob (in-process python fn — the common case when chaining FL
+simulations) and a ProcessJob (spawn a command, mirroring launch's
+execute_job_task semantics, computing/scheduler/slave/client_runner.py:619).
+"""
+
+from __future__ import annotations
+
+import abc
+import subprocess
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional
+
+
+class JobStatus(Enum):
+    PROVISIONING = "PROVISIONING"
+    RUNNING = "RUNNING"
+    FINISHED = "FINISHED"
+    FAILED = "FAILED"
+    UNDETERMINED = "UNDETERMINED"
+
+
+class Job(abc.ABC):
+    def __init__(self, name: str):
+        self.name = name
+        self.input: Dict[str, Any] = {}
+        self.output: Dict[str, Any] = {}
+        self._status = JobStatus.PROVISIONING
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}(name={self.name!r}, status={self.status().value})"
+
+    @abc.abstractmethod
+    def run(self) -> None: ...
+
+    def status(self) -> JobStatus:
+        return self._status
+
+    def kill(self) -> None:
+        self._status = JobStatus.UNDETERMINED
+
+    def append_input(self, input_job_name: str, input: Dict[str, Any]) -> None:
+        self.input[input_job_name] = input
+
+    def get_outputs(self) -> Dict[str, Any]:
+        return self.output
+
+
+class NullJob(Job):
+    def run(self) -> None:
+        self._status = JobStatus.FINISHED
+
+
+class CallableJob(Job):
+    """Run a python callable; its return value becomes the job output."""
+
+    def __init__(self, name: str, fn: Callable[..., Any], pass_inputs: bool = True):
+        super().__init__(name)
+        self.fn = fn
+        self.pass_inputs = pass_inputs
+
+    def run(self) -> None:
+        self._status = JobStatus.RUNNING
+        try:
+            result = self.fn(self.input) if self.pass_inputs else self.fn()
+            self.output = result if isinstance(result, dict) else {"result": result}
+            self._status = JobStatus.FINISHED
+        except Exception as e:  # noqa: BLE001 - job boundary
+            self.output = {"error": repr(e)}
+            self._status = JobStatus.FAILED
+
+
+class ProcessJob(Job):
+    """Run a shell command; stdout becomes the job output."""
+
+    def __init__(self, name: str, cmd: List[str], timeout_s: float = 600.0, cwd: Optional[str] = None):
+        super().__init__(name)
+        self.cmd = cmd
+        self.timeout_s = timeout_s
+        self.cwd = cwd
+        self._proc: Optional[subprocess.Popen] = None
+
+    def run(self) -> None:
+        self._status = JobStatus.RUNNING
+        self._proc = subprocess.Popen(
+            self.cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=self.cwd
+        )
+        try:
+            stdout, stderr = self._proc.communicate(timeout=self.timeout_s)
+            self.output = {"stdout": stdout, "stderr": stderr, "returncode": self._proc.returncode}
+            if self._status == JobStatus.UNDETERMINED:  # killed mid-run
+                return
+            self._status = JobStatus.FINISHED if self._proc.returncode == 0 else JobStatus.FAILED
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            self._proc.communicate()
+            self.output = {"error": "timeout"}
+            self._status = JobStatus.FAILED
+
+    def kill(self) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.kill()
+        super().kill()

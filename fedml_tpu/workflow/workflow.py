@@ -1,0 +1,149 @@
+"""Workflow: DAG of jobs with dependency-ordered execution.
+
+Reference: python/fedml/workflow/workflow.py:16-230 (toposort-based levels,
+loop mode, per-job status/output surfacing, input chaining). Kahn's
+algorithm is inlined here (the reference depends on the `toposort` package);
+jobs within one topological level run on a thread pool since they are
+independent by construction.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import namedtuple
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+from .jobs import Job, JobStatus
+
+log = logging.getLogger(__name__)
+
+Metadata = namedtuple("Metadata", ["nodes", "topological_order", "graph"])
+
+
+class Workflow:
+    _registry: Dict[str, "Workflow"] = {}
+
+    def __init__(self, name: str, loop: bool = False, max_loops: int = 1_000):
+        self.name = name
+        self.loop = loop
+        self.max_loops = max_loops
+        self.jobs: Dict[str, Dict[str, Any]] = {}
+        self.input: Dict[str, Any] = {}
+        self._metadata: Optional[Metadata] = None
+        Workflow._registry[name] = self
+
+    @classmethod
+    def get_workflow(cls, workflow_name: Optional[str] = None) -> Optional["Workflow"]:
+        if workflow_name is None and cls._registry:
+            return next(reversed(cls._registry.values()))
+        return cls._registry.get(workflow_name)
+
+    @property
+    def metadata(self) -> Optional[Metadata]:
+        return self._metadata
+
+    def add_job(self, job: Job, dependencies: Optional[List[Job]] = None) -> None:
+        if not isinstance(job, Job):
+            raise TypeError("Only Job instances can be added to the workflow.")
+        deps = dependencies or []
+        for d in deps:
+            if not isinstance(d, Job):
+                raise TypeError("Dependencies must be Job instances.")
+            if d.name not in self.jobs:
+                raise ValueError(f"dependency {d.name!r} not yet added")
+        if job.name in self.jobs:
+            raise ValueError(f"duplicate job name {job.name!r}")
+        self.jobs[job.name] = {"job": job, "dependencies": [d.name for d in deps]}
+
+    # -- topo order (Kahn) -------------------------------------------------
+    def _topological_levels(self) -> List[List[str]]:
+        indeg = {n: len(meta["dependencies"]) for n, meta in self.jobs.items()}
+        children: Dict[str, List[str]] = {n: [] for n in self.jobs}
+        for n, meta in self.jobs.items():
+            for d in meta["dependencies"]:
+                children[d].append(n)
+        level = [n for n, k in indeg.items() if k == 0]
+        levels = []
+        seen = 0
+        while level:
+            levels.append(sorted(level))
+            seen += len(level)
+            nxt = []
+            for n in level:
+                for c in children[n]:
+                    indeg[c] -= 1
+                    if indeg[c] == 0:
+                        nxt.append(c)
+            level = nxt
+        if seen != len(self.jobs):
+            raise ValueError("cyclic dependency detected in workflow")
+        return levels
+
+    # -- execution ---------------------------------------------------------
+    def run(self) -> None:
+        levels = self._topological_levels()
+        self._metadata = Metadata(
+            nodes=list(self.jobs), topological_order=levels,
+            graph={n: m["dependencies"] for n, m in self.jobs.items()},
+        )
+        iterations = self.max_loops if self.loop else 1
+        for it in range(iterations):
+            log.info("workflow %s iteration %d: levels=%s", self.name, it, levels)
+            for level in levels:
+                self._execute_and_wait([self.jobs[n]["job"] for n in level])
+                for n in level:
+                    job = self.jobs[n]["job"]
+                    if job.status() == JobStatus.FAILED:
+                        self._kill_jobs([m["job"] for m in self.jobs.values()])
+                        raise RuntimeError(f"workflow {self.name}: job {n} failed: {job.output}")
+                    # chain outputs into dependents' inputs
+                    for child, meta in self.jobs.items():
+                        if n in meta["dependencies"]:
+                            meta["job"].append_input(n, job.get_outputs())
+            if not self.loop:
+                break
+
+    def _execute_and_wait(self, jobs: List[Job]) -> None:
+        for j in jobs:
+            if not j.input and self.input:
+                j.append_input("__workflow__", self.input)
+        if len(jobs) == 1:
+            jobs[0].run()
+            return
+        with ThreadPoolExecutor(max_workers=max(1, len(jobs))) as pool:
+            list(pool.map(lambda j: j.run(), jobs))
+
+    def _kill_jobs(self, jobs: List[Job]) -> None:
+        for j in jobs:
+            if j.status() == JobStatus.RUNNING:
+                j.kill()
+
+    # -- introspection (reference :165-222) --------------------------------
+    def get_job_dependencies(self, job_name: str) -> List[str]:
+        return self.jobs[job_name]["dependencies"]
+
+    def get_job_status(self, job_name: str) -> JobStatus:
+        return self.jobs[job_name]["job"].status()
+
+    def get_workflow_status(self) -> JobStatus:
+        statuses = [m["job"].status() for m in self.jobs.values()]
+        if any(s == JobStatus.FAILED for s in statuses):
+            return JobStatus.FAILED
+        if all(s == JobStatus.FINISHED for s in statuses):
+            return JobStatus.FINISHED
+        if any(s == JobStatus.RUNNING for s in statuses):
+            return JobStatus.RUNNING
+        return JobStatus.PROVISIONING
+
+    def set_workflow_input(self, input: Dict[str, Any]) -> None:
+        self.input = dict(input)
+
+    def get_workflow_output(self) -> Dict[str, Any]:
+        if not self._metadata:
+            return {}
+        last_level = self._metadata.topological_order[-1]
+        return {n: self.jobs[n]["job"].get_outputs() for n in last_level}
+
+    def get_all_jobs_outputs(self) -> Dict[str, Any]:
+        return {n: m["job"].get_outputs() for n, m in self.jobs.items()}

@@ -1,0 +1,95 @@
+"""MLOps observability tests: metrics/events/status records, artifact
+logging, per-run log capture + upload daemon, sys perf sampler."""
+
+import logging
+import os
+import time
+import types
+
+from fedml_tpu import mlops
+from fedml_tpu.mlops import MLOpsMetrics, MLOpsRuntime
+from fedml_tpu.mlops.runtime_log import MLOpsRuntimeLog, MLOpsRuntimeLogDaemon, SysPerfSampler
+
+
+def _fresh_runtime(tmp_path, enabled=True):
+    MLOpsRuntime._instance = None
+    rt = MLOpsRuntime.get_instance()
+    args = types.SimpleNamespace(
+        using_mlops=enabled, run_id="t1", log_file_dir=str(tmp_path), enable_wandb=False
+    )
+    rt.init(args)
+    return rt
+
+
+def test_log_and_event_records(tmp_path):
+    rt = _fresh_runtime(tmp_path)
+    mlops.log({"acc": 0.9}, step=1)
+    mlops.event("train", event_started=True, event_value="0")
+    mlops.event("train", event_started=False, event_value="0")
+    mlops.log_round_info(10, 1)
+    types_seen = [r["type"] for r in rt.records]
+    assert "metric" in types_seen and "event_started" in types_seen and "event_ended" in types_seen
+    ended = [r for r in rt.records if r["type"] == "event_ended"][0]
+    assert ended["duration"] is not None and ended["duration"] >= 0
+    # jsonl persisted
+    assert os.path.exists(os.path.join(rt.run_dir, "events.jsonl"))
+
+
+def test_status_and_metrics_facade(tmp_path):
+    rt = _fresh_runtime(tmp_path)
+    m = MLOpsMetrics(rt)
+    m.report_client_training_status(3, "TRAINING", "t1")
+    m.report_server_training_status("t1", "RUNNING")
+    statuses = [r for r in rt.records if r["type"] == "status"]
+    assert {s["role"] for s in statuses} == {"client", "server"}
+
+
+def test_artifact_and_model_logging(tmp_path):
+    rt = _fresh_runtime(tmp_path)
+    f = tmp_path / "weights.bin"
+    f.write_bytes(b"abc")
+    mlops.log_model("m1", str(f), version="1")
+    arts = [r for r in rt.records if r["type"] == "artifact"]
+    assert arts and os.path.exists(arts[0]["stored"])
+    assert any(r["type"] == "model" for r in rt.records)
+
+
+def test_runtime_log_capture_and_daemon(tmp_path):
+    run_dir = str(tmp_path / "run")
+    path = MLOpsRuntimeLog.init(run_dir, "r9", rank=0)
+    logger = logging.getLogger("fedml_tpu.test_daemon")
+    shipped = []
+    daemon = MLOpsRuntimeLogDaemon(path, "r9", 0, sink=lambda rid, rank, lines: shipped.extend(lines))
+    logger.warning("hello-from-run")
+    for h in logging.getLogger().handlers:
+        h.flush()
+    n = daemon.poll_once()
+    MLOpsRuntimeLog.detach("r9", 0)
+    assert n >= 1
+    assert any("hello-from-run" in l for l in shipped)
+
+
+def test_log_daemon_thread_lifecycle(tmp_path):
+    p = tmp_path / "x.log"
+    p.write_text("line1\n")
+    shipped = []
+    d = MLOpsRuntimeLogDaemon(str(p), "r", 0, sink=lambda *a: shipped.append(a[2]), interval_s=0.05)
+    d.start()
+    time.sleep(0.15)
+    with open(p, "a") as f:
+        f.write("line2\n")
+    time.sleep(0.2)
+    d.stop()
+    flat = [l for chunk in shipped for l in chunk]
+    assert "line1\n" in flat and "line2\n" in flat
+
+
+def test_sys_perf_sampler():
+    recs = []
+    s = SysPerfSampler(recs.append, interval_s=0.05)
+    rec = s.sample_once()
+    assert rec["type"] == "sys_perf" and "t" in rec
+    s.start()
+    time.sleep(0.12)
+    s.stop()
+    assert len(recs) >= 2

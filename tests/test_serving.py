@@ -1,0 +1,96 @@
+"""Serving tests: predictor contract, HTTP runner routes, endpoint replica
+control + gateway over real localhost HTTP."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fedml_tpu.serving import (
+    Endpoint,
+    EndpointManager,
+    FedMLInferenceRunner,
+    FedMLPredictor,
+    JaxPredictor,
+    ModelCard,
+    ModelDB,
+)
+
+
+class EchoPredictor(FedMLPredictor):
+    def __init__(self):
+        super().__init__()
+        self._ready = True
+
+    def predict(self, request, *args, **kwargs):
+        return {"echo": request.get("inputs"), "who": id(self) % 1000}
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_predictor_must_implement_predict():
+    with pytest.raises(NotImplementedError):
+        FedMLPredictor()
+
+
+def test_inference_runner_routes():
+    runner = FedMLInferenceRunner(EchoPredictor(), port=0)
+    port = runner.start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/ready", timeout=10) as r:
+            assert json.loads(r.read())["status"] == "Success"
+        out = _post(f"http://127.0.0.1:{port}/predict", {"inputs": [1, 2, 3]})
+        assert out["echo"] == [1, 2, 3]
+    finally:
+        runner.stop()
+
+
+def test_jax_predictor_serves_jitted_forward():
+    import jax.numpy as jnp
+
+    params = {"w": jnp.asarray([[2.0], [3.0]])}
+    pred = JaxPredictor(lambda p, x: x @ p["w"], params)
+    assert not pred.ready()
+    pred.warmup(jnp.zeros((1, 2)))
+    assert pred.ready()
+    out = pred.predict({"inputs": [[1.0, 1.0]]})
+    assert out["outputs"] == [[5.0]]
+
+
+def test_endpoint_round_robin_and_scaling():
+    ep = Endpoint("e1", EchoPredictor, num_replicas=2)
+    try:
+        whos = {ep.predict({"inputs": [i]})["who"] for i in range(4)}
+        assert len(whos) == 2  # round robin hit both replicas
+        ep.scale_to(1)
+        assert len(ep.replicas) == 1
+        assert ep.predict({"inputs": [9]})["echo"] == [9]
+    finally:
+        ep.shutdown()
+
+
+def test_endpoint_manager_and_model_db(tmp_path):
+    db = ModelDB(str(tmp_path / "models.json"))
+    db.add(ModelCard(name="m", version="1", model_path="/tmp/x"))
+    db.add(ModelCard(name="m", version="2", model_path="/tmp/y"))
+    assert db.get("m", "latest").version == "2"
+    # reload from disk
+    db2 = ModelDB(str(tmp_path / "models.json"))
+    assert db2.get("m", "1").model_path == "/tmp/x"
+
+    mgr = EndpointManager(db)
+    ep = mgr.deploy("demo", EchoPredictor, num_replicas=1)
+    try:
+        assert ep.predict({"inputs": "x"})["echo"] == "x"
+        with pytest.raises(ValueError):
+            mgr.deploy("demo", EchoPredictor)
+    finally:
+        mgr.undeploy("demo")
+    assert "demo" not in mgr.endpoints

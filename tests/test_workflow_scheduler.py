@@ -1,0 +1,158 @@
+"""Workflow DAG + Launch scheduler + seq-train scheduler tests."""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from fedml_tpu.computing.scheduler import (
+    FedMLJobConfig,
+    FedMLLaunchManager,
+    build_job_package,
+    retrieve_and_unzip_package,
+)
+from fedml_tpu.core.schedule import SeqTrainScheduler, linear_fit, t_sample_fit
+from fedml_tpu.workflow import CallableJob, JobStatus, ProcessJob, Workflow
+
+
+# --- workflow -------------------------------------------------------------
+
+
+def test_workflow_dag_order_and_output_chaining():
+    trace = []
+    a = CallableJob("a", lambda inp: trace.append("a") or {"x": 1})
+    b = CallableJob("b", lambda inp: trace.append("b") or {"y": inp["a"]["x"] + 1})
+    c = CallableJob("c", lambda inp: trace.append("c") or {"z": inp["b"]["y"] * 10})
+    wf = Workflow("wf1")
+    wf.add_job(a)
+    wf.add_job(b, dependencies=[a])
+    wf.add_job(c, dependencies=[b])
+    wf.run()
+    assert trace == ["a", "b", "c"]
+    assert wf.get_workflow_output() == {"c": {"z": 20}}
+    assert wf.get_workflow_status() == JobStatus.FINISHED
+
+
+def test_workflow_parallel_level_and_failure():
+    ok = CallableJob("ok", lambda inp: {"v": 1})
+    bad = CallableJob("bad", lambda inp: 1 / 0)
+    after = CallableJob("after", lambda inp: {"v": 2})
+    wf = Workflow("wf2")
+    wf.add_job(ok)
+    wf.add_job(bad)
+    wf.add_job(after, dependencies=[bad])
+    with pytest.raises(RuntimeError, match="bad failed"):
+        wf.run()
+    assert wf.get_job_status("bad") == JobStatus.FAILED
+    assert wf.get_job_status("after") == JobStatus.PROVISIONING  # never ran
+
+
+def test_workflow_cycle_detection():
+    a = CallableJob("a", lambda inp: {})
+    b = CallableJob("b", lambda inp: {})
+    wf = Workflow("wf3")
+    wf.add_job(a)
+    wf.add_job(b, dependencies=[a])
+    wf.jobs["a"]["dependencies"] = ["b"]  # force a cycle
+    with pytest.raises(ValueError, match="cyclic"):
+        wf.run()
+
+
+def test_process_job():
+    j = ProcessJob("echo", ["python", "-c", "print(6*7)"])
+    j.run()
+    assert j.status() == JobStatus.FINISHED
+    assert "42" in j.output["stdout"]
+
+
+# --- package + launch -----------------------------------------------------
+
+
+def test_package_roundtrip(tmp_path):
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    (ws / "main.py").write_text("print('hi')\n")
+    pkg = build_job_package(str(ws), str(tmp_path / "p.zip"), meta={"job_name": "j"})
+    dest = tmp_path / "out"
+    meta = retrieve_and_unzip_package(pkg, str(dest))
+    assert meta["job_name"] == "j"
+    assert (dest / "main.py").read_text() == "print('hi')\n"
+
+
+def test_launch_job_end_to_end(tmp_path):
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    (ws / "main.py").write_text("import os\nprint('RUN', os.environ['FEDML_RUN_ID'])\n")
+    job_yaml = tmp_path / "job.yaml"
+    job_yaml.write_text(
+        textwrap.dedent(
+            """
+            fedml_env:
+              project_name: demo
+            job_name: smoke
+            workspace: ws
+            bootstrap: echo bootstrapped > boot.txt
+            job: python main.py
+            """
+        )
+    )
+    mgr = FedMLLaunchManager(num_edges=2, base_dir=str(tmp_path / "agent"))
+    statuses = mgr.launch_job(str(job_yaml), timeout_s=120)
+    assert set(statuses) == {0, 1}
+    for st in statuses.values():
+        assert st.status == "FINISHED", st
+        logtxt = open(st.log_path).read()
+        assert "RUN" in logtxt
+        assert os.path.exists(os.path.join(os.path.dirname(st.log_path), "boot.txt"))
+
+
+def test_launch_job_failure_reported(tmp_path):
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    job_yaml = tmp_path / "job.yaml"
+    job_yaml.write_text("workspace: ws\njob: exit 3\n")
+    mgr = FedMLLaunchManager(num_edges=1, base_dir=str(tmp_path / "agent"))
+    st = mgr.launch_job(str(job_yaml))[0]
+    assert st.status == "FAILED" and st.returncode == 3
+
+
+def test_job_config_validation(tmp_path):
+    f = tmp_path / "bad.yaml"
+    f.write_text("workspace: nope_dir\njob: ''\n")
+    with pytest.raises(ValueError):
+        FedMLJobConfig(str(f)).validate()
+
+
+# --- seq-train scheduler --------------------------------------------------
+
+
+def test_linear_fit_and_t_sample_fit():
+    sizes = {0: 100, 1: 200, 2: 300}
+    hist = {0: {c: [0.01 * sizes[c] + 1.0] * 3 for c in sizes}}
+    params, funcs, errors = t_sample_fit(1, 3, hist, sizes, uniform_client=True, uniform_gpu=True)
+    a, b = params[0][0]
+    assert abs(a - 0.01) < 1e-6 and abs(b - 1.0) < 1e-6
+    assert errors[0][0] < 1e-9
+
+
+def test_seq_train_scheduler_balances_makespan():
+    workloads = [100, 90, 80, 30, 20, 10]
+    # two identical resources, cost = samples
+    cost = [[lambda n: float(n)]]
+    sched = SeqTrainScheduler(workloads, [1.0, 1.0], [16, 16], cost,
+                              uniform_client=True, uniform_gpu=True)
+    assign, loads = sched.DP_schedule()
+    assert sorted(c for group in assign for c in group) == list(range(6))
+    assert max(loads) <= 170  # optimal 165; LPT bound well under naive 330
+
+
+def test_seq_train_scheduler_heterogeneous_resources():
+    workloads = [50, 50, 50, 50]
+    # resource 1 is 10x slower
+    cost = [[lambda n: float(n)], [lambda n: 10.0 * float(n)]]
+    sched = SeqTrainScheduler(workloads, [1.0, 0.1], [16, 16], cost,
+                              uniform_client=True, uniform_gpu=False)
+    assign, loads = sched.DP_schedule()
+    # fast resource should take most clients
+    assert len(assign[0]) >= 3
