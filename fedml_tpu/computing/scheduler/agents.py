@@ -97,8 +97,9 @@ class FedMLClientRunner:
             rc = proc.wait()
             logf.close()
             st.returncode = rc
-            st.status = "FINISHED" if rc == 0 else "FAILED"
-            self._report(st)
+            if st.status != "KILLED":  # stop_train already reported the verdict
+                st.status = "FINISHED" if rc == 0 else "FAILED"
+                self._report(st)
 
         if wait:
             _wait()
@@ -142,4 +143,10 @@ class FedMLServerRunner:
         deadline = time.time() + timeout_s
         for t in threads:
             t.join(timeout=max(0.0, deadline - time.time()))
+        # edges still working at the deadline get a RUNNING placeholder so the
+        # returned dict always has one entry per dispatched edge
+        for eid in targets:
+            if eid not in self.statuses[run_id]:
+                self.statuses[run_id][eid] = RunStatus(run_id=run_id, edge_id=eid, status="RUNNING",
+                                                       detail="dispatch timeout; job still running")
         return self.statuses[run_id]

@@ -139,10 +139,9 @@ class FedMLAlgorithmFlow(FedMLCommManager):
     def _params_to_message(self, flow_name: str, params: Params, receiver_id: int) -> Message:
         msg = Message(flow_name, self.executor.get_id(), receiver_id)
         for key, value in params.items():
-            if key in _RESERVED_KEYS and key != PARAMS_KEY_SENDER_ID:
+            if key in _RESERVED_KEYS:
                 raise ValueError(f"Params key {key!r} collides with a reserved message field")
-            if key != PARAMS_KEY_SENDER_ID:
-                msg.add_params(key, value)
+            msg.add_params(key, value)
         return msg
 
     # -- teardown ----------------------------------------------------------

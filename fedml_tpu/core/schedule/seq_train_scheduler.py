@@ -27,9 +27,9 @@ class SeqTrainScheduler:
         uniform_client: bool = True,
         uniform_gpu: bool = False,
     ):
-        """workloads: per-client sample counts; constraints: per-resource
-        capacity weights (unused by LPT but kept for API parity); memory:
-        per-resource memory (gates assignment when provided); cost_funcs:
+        """workloads: per-client sample counts; constraints / memory:
+        per-resource capacity weights and memory sizes (both unused by the
+        LPT policy — accepted for reference API parity only); cost_funcs:
         [resource][client] -> callable(num_samples) -> seconds (axes may be
         collapsed per the uniform flags)."""
         self.workloads = np.asarray(workloads, dtype=np.float64)

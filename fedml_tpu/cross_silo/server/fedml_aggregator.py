@@ -107,7 +107,7 @@ def select_data_silos(round_idx: int, client_num_in_total: int, client_num_per_r
     data_silo_selection; np.random.seed(round_idx) keeps runs reproducible
     and bit-comparable with the reference's sampling discipline). Shared by
     the FL aggregator, the FA adapters and the sp simulators."""
-    if client_num_in_total == client_num_per_round:
+    if client_num_per_round >= client_num_in_total:
         return list(range(client_num_in_total))
     np.random.seed(round_idx)
     return list(np.random.choice(range(client_num_in_total), client_num_per_round, replace=False))

@@ -82,7 +82,11 @@ class MLOpsRuntimeLogDaemon:
         with open(self.log_path, "r") as f:
             f.seek(self._pos)
             lines = f.readlines()
-            self._pos = f.tell()
+            # never ship a partially-written final line: leave it for the next
+            # poll so line-oriented sinks see whole records
+            if lines and not lines[-1].endswith("\n"):
+                lines.pop()
+            self._pos += sum(len(line.encode("utf-8", "surrogatepass")) for line in lines)
         if lines:
             self.sink(self.run_id, self.rank, lines)
             self.chunks_shipped += 1

@@ -1,0 +1,139 @@
+"""DARTS-style differentiable-architecture network for FedNAS.
+
+Reference: ``python/fedml/model/cv/darts/{model_search,operations,genotypes}.py``
+used by ``simulation/mpi/fednas``. TPU-first re-design: the mixed op is a
+softmax-weighted sum over a fixed op bank evaluated with ``jnp.einsum`` over a
+stacked op output — fully static shapes, no Python data-dependent branching, so
+the whole supernet jits. Architecture parameters ("alphas") live in a separate
+parameter collection path (params['arch']) so FedNAS can average weights and
+alphas independently (reference FedNASAggregator averages both).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+OP_NAMES: Sequence[str] = ("none", "skip", "conv3", "conv5", "maxpool", "avgpool", "sepconv3", "dilconv3")
+
+
+class _Op(nn.Module):
+    kind: str
+    filters: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        norm = partial(nn.GroupNorm, num_groups=4)
+        if self.kind == "none":
+            return jnp.zeros_like(x)
+        if self.kind == "skip":
+            return x
+        if self.kind == "maxpool":
+            return nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        if self.kind == "avgpool":
+            return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        if self.kind == "conv3":
+            y = nn.Conv(self.filters, (3, 3), use_bias=False)(nn.relu(x))
+            return norm()(y)
+        if self.kind == "conv5":
+            y = nn.Conv(self.filters, (5, 5), use_bias=False)(nn.relu(x))
+            return norm()(y)
+        if self.kind == "sepconv3":
+            in_ch = x.shape[-1]
+            y = nn.Conv(in_ch, (3, 3), feature_group_count=in_ch, use_bias=False)(nn.relu(x))
+            y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
+            return norm()(y)
+        if self.kind == "dilconv3":
+            y = nn.Conv(self.filters, (3, 3), kernel_dilation=(2, 2), use_bias=False)(nn.relu(x))
+            return norm()(y)
+        raise ValueError(self.kind)
+
+
+class MixedOp(nn.Module):
+    """Softmax(alpha)-weighted sum of the op bank — einsum over a stacked
+    (num_ops, B, H, W, C) tensor keeps it one fused XLA op."""
+
+    filters: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, alpha: jnp.ndarray) -> jnp.ndarray:
+        outs = jnp.stack([_Op(kind, self.filters)(x) for kind in OP_NAMES])
+        w = nn.softmax(alpha)
+        return jnp.einsum("o,obhwc->bhwc", w, outs)
+
+
+class Cell(nn.Module):
+    """DARTS cell: ``steps`` intermediate nodes, each summing mixed ops over
+    all prior states; output = concat of intermediate nodes."""
+
+    filters: int
+    steps: int = 3
+
+    @nn.compact
+    def __call__(self, s0: jnp.ndarray, s1: jnp.ndarray, alphas: jnp.ndarray) -> jnp.ndarray:
+        # 1x1 preprocessing normalizes both inputs to `filters` channels so
+        # every op in the bank (incl. skip/pool) emits the same shape
+        norm = partial(nn.GroupNorm, num_groups=4)
+        s0 = norm(name="pre0_norm")(nn.Conv(self.filters, (1, 1), use_bias=False, name="pre0")(nn.relu(s0)))
+        s1 = norm(name="pre1_norm")(nn.Conv(self.filters, (1, 1), use_bias=False, name="pre1")(nn.relu(s1)))
+        states = [s0, s1]
+        edge = 0
+        for _ in range(self.steps):
+            node = sum(
+                MixedOp(self.filters)(h, alphas[(edge := edge + 1) - 1]) for h in states
+            )
+            states.append(node)
+        return jnp.concatenate(states[2:], axis=-1)
+
+
+def num_edges(steps: int = 3) -> int:
+    return sum(2 + i for i in range(steps))
+
+
+class DARTSNetwork(nn.Module):
+    """Supernet: stem + ``layers`` cells + classifier. Alphas are a single
+    (num_cells_types=1, num_edges, num_ops) parameter under params['arch'].
+    """
+
+    num_classes: int = 10
+    width: int = 16
+    layers: int = 3
+    steps: int = 3
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        alphas = self.param(
+            "arch", lambda key: 1e-3 * jnp.ones((num_edges(self.steps), len(OP_NAMES)), jnp.float32)
+        )
+        x = nn.Conv(self.width, (3, 3), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=4)(x)
+        s0 = s1 = x
+        for layer in range(self.layers):
+            s0, s1 = s1, Cell(self.width, self.steps)(s0, s1, alphas)
+            # reduce spatial dims between cells to keep compute bounded
+            if layer != self.layers - 1:
+                s0 = nn.avg_pool(s0, (2, 2), strides=(2, 2))
+                s1 = nn.avg_pool(s1, (2, 2), strides=(2, 2))
+        x = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def derive_genotype(alphas: jnp.ndarray, steps: int = 3) -> Tuple[Tuple[int, str], ...]:
+    """Argmax discretization of the searched architecture (reference
+    model_search.py genotype())."""
+    geno = []
+    edge = 0
+    for i in range(steps):
+        n_in = 2 + i
+        block = alphas[edge : edge + n_in]
+        edge += n_in
+        # best non-'none' op per input edge, keep top-2 edges
+        best_op = jnp.argmax(block[:, 1:], axis=-1) + 1
+        strength = jnp.max(block[:, 1:], axis=-1)
+        top2 = jnp.argsort(-strength)[:2]
+        for j in top2:
+            geno.append((int(j), OP_NAMES[int(best_op[j])]))
+    return tuple(geno)

@@ -1,0 +1,84 @@
+"""EfficientNet-lite for federated vision.
+
+Reference: ``python/fedml/model/cv/efficientnet*.py`` (EfficientNet family in
+``model_hub.py``). We build the lite-B0 trunk (no SE in lite variants, relu6)
+with GroupNorm so federated payloads stay pure parameter pytrees; depthwise
+stages use ``feature_group_count`` for MXU-friendly lowering.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .mobilenet import _gn
+
+
+class MBConv(nn.Module):
+    expand_ratio: int
+    filters: int
+    kernel: int
+    strides: Tuple[int, int]
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        in_ch = x.shape[-1]
+        mid = in_ch * self.expand_ratio
+        residual = x
+        y = x
+        if self.expand_ratio != 1:
+            y = nn.Conv(mid, (1, 1), use_bias=False)(y)
+            y = nn.GroupNorm(num_groups=_gn(mid))(y)
+            y = nn.relu6(y)
+        y = nn.Conv(mid, (self.kernel, self.kernel), self.strides, feature_group_count=mid, use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=_gn(mid))(y)
+        y = nn.relu6(y)
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=_gn(self.filters))(y)
+        if self.strides == (1, 1) and in_ch == self.filters:
+            y = y + residual
+        return y
+
+
+# (expand, filters, kernel, stride, repeats) — B0 trunk
+_B0: Sequence[Tuple[int, int, int, int, int]] = (
+    (1, 16, 3, 1, 1),
+    (6, 24, 3, 2, 2),
+    (6, 40, 5, 2, 2),
+    (6, 80, 3, 2, 3),
+    (6, 112, 5, 1, 3),
+    (6, 192, 5, 2, 4),
+    (6, 320, 3, 1, 1),
+)
+
+
+class EfficientNetLite(nn.Module):
+    num_classes: int = 10
+    width_mult: float = 1.0
+    depth_mult: float = 1.0
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        def w(c: int) -> int:
+            return max(8, int(c * self.width_mult + 4) // 8 * 8)
+
+        x = nn.Conv(32, (3, 3), (2, 2), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = nn.relu6(x)
+        for expand, filters, kernel, stride, repeats in _B0:
+            reps = int(math.ceil(repeats * self.depth_mult))
+            for i in range(reps):
+                s = (stride, stride) if i == 0 else (1, 1)
+                x = MBConv(expand, w(filters), kernel, s)(x)
+        x = nn.Conv(1280, (1, 1), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = nn.relu6(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def efficientnet_lite0(num_classes: int = 10) -> EfficientNetLite:
+    return EfficientNetLite(num_classes=num_classes)

@@ -1,0 +1,75 @@
+"""Generator/discriminator pair for federated GAN training (FedGAN).
+
+Reference: ``python/fedml/model/cv/cgan.py`` and the FedGAN MPI simulation
+(``simulation/mpi/fedgan/``). DCGAN-shaped but with GroupNorm (client payloads
+stay pure pytrees) and NHWC; sized for 28x28 or 32x32 federated image sets.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Generator(nn.Module):
+    """z -> image. Dense project + two transposed-conv upsampling stages."""
+
+    image_hw: int = 28
+    channels: int = 1
+    latent_dim: int = 64
+    base_width: int = 64
+
+    @nn.compact
+    def __call__(self, z: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        s = self.image_hw // 4
+        x = nn.Dense(s * s * self.base_width * 2)(z)
+        x = x.reshape((z.shape[0], s, s, self.base_width * 2))
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = nn.relu(x)
+        x = nn.ConvTranspose(self.base_width, (4, 4), (2, 2))(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = nn.relu(x)
+        x = nn.ConvTranspose(self.channels, (4, 4), (2, 2))(x)
+        return nn.tanh(x)
+
+
+class Discriminator(nn.Module):
+    """image -> real/fake logit."""
+
+    base_width: int = 64
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = nn.Conv(self.base_width, (4, 4), (2, 2))(x)
+        x = nn.leaky_relu(x, 0.2)
+        x = nn.Conv(self.base_width * 2, (4, 4), (2, 2))(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = nn.leaky_relu(x, 0.2)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(1)(x)
+
+
+class GANPair(nn.Module):
+    """Bundles G and D so the federated payload is one pytree
+    {'generator': ..., 'discriminator': ...} (mirrors fedgan's joint sync)."""
+
+    image_hw: int = 28
+    channels: int = 1
+    latent_dim: int = 64
+
+    def setup(self):
+        self.generator = Generator(self.image_hw, self.channels, self.latent_dim)
+        self.discriminator = Discriminator()
+
+    def __call__(self, z: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        # init path: run G then D so both parameter subtrees materialize
+        fake = self.generator(z, train=train)
+        return self.discriminator(fake, train=train)
+
+    def generate(self, z: jnp.ndarray) -> jnp.ndarray:
+        return self.generator(z)
+
+    def discriminate(self, x: jnp.ndarray) -> jnp.ndarray:
+        return self.discriminator(x)

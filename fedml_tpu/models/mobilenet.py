@@ -1,0 +1,148 @@
+"""MobileNet v1 / v3-small for federated vision on edge-class budgets.
+
+Reference: ``python/fedml/model/cv/mobilenet.py`` (v1) and
+``model/cv/mobilenet_v3.py`` (v3, used via ``model_hub.py:19-90``). TPU-first
+choices: NHWC layout, GroupNorm instead of BatchNorm (no running stats in the
+federated payload; non-IID-safe), depthwise convs expressed via
+``feature_group_count`` so XLA lowers them onto the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _gn(width: int) -> int:
+    """Pick a GroupNorm group count that divides width."""
+    for g in (8, 4, 2, 1):
+        if width % g == 0:
+            return g
+    return 1
+
+
+class DepthwiseSeparable(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        in_ch = x.shape[-1]
+        x = nn.Conv(in_ch, (3, 3), self.strides, feature_group_count=in_ch, use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=_gn(in_ch))(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=_gn(self.filters))(x)
+        return nn.relu(x)
+
+
+class MobileNetV1(nn.Module):
+    """Reference mobilenet.py architecture at width multiplier alpha."""
+
+    num_classes: int = 10
+    alpha: float = 1.0
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        def c(w: int) -> int:
+            return max(8, int(w * self.alpha))
+
+        x = nn.Conv(c(32), (3, 3), (2, 2), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=_gn(c(32)))(x)
+        x = nn.relu(x)
+        plan = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2)] + [(512, 1)] * 5 + [(1024, 2), (1024, 1)]
+        for filters, stride in plan:
+            x = DepthwiseSeparable(c(filters), (stride, stride))(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def _hard_sigmoid(x: jnp.ndarray) -> jnp.ndarray:
+    return nn.relu6(x + 3.0) / 6.0
+
+
+def _hard_swish(x: jnp.ndarray) -> jnp.ndarray:
+    return x * _hard_sigmoid(x)
+
+
+class SqueezeExcite(nn.Module):
+    reduce: int = 4
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        ch = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.relu(nn.Dense(max(8, ch // self.reduce))(s))
+        s = _hard_sigmoid(nn.Dense(ch)(s))
+        return x * s
+
+
+class InvertedResidual(nn.Module):
+    expand: int
+    filters: int
+    kernel: int = 3
+    strides: Tuple[int, int] = (1, 1)
+    se: bool = False
+    swish: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        act = _hard_swish if self.swish else nn.relu
+        residual = x
+        in_ch = x.shape[-1]
+        y = x
+        if self.expand != in_ch:
+            y = nn.Conv(self.expand, (1, 1), use_bias=False)(y)
+            y = nn.GroupNorm(num_groups=_gn(self.expand))(y)
+            y = act(y)
+        y = nn.Conv(
+            self.expand, (self.kernel, self.kernel), self.strides, feature_group_count=self.expand, use_bias=False
+        )(y)
+        y = nn.GroupNorm(num_groups=_gn(self.expand))(y)
+        y = act(y)
+        if self.se:
+            y = SqueezeExcite()(y)
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=_gn(self.filters))(y)
+        if self.strides == (1, 1) and in_ch == self.filters:
+            y = y + residual
+        return y
+
+
+# (expand, filters, kernel, stride, SE, hard-swish) — mobilenet_v3 small trunk
+_V3_SMALL: Sequence[Tuple[int, int, int, int, bool, bool]] = (
+    (16, 16, 3, 2, True, False),
+    (72, 24, 3, 2, False, False),
+    (88, 24, 3, 1, False, False),
+    (96, 40, 5, 2, True, True),
+    (240, 40, 5, 1, True, True),
+    (240, 40, 5, 1, True, True),
+    (120, 48, 5, 1, True, True),
+    (144, 48, 5, 1, True, True),
+    (288, 96, 5, 2, True, True),
+    (576, 96, 5, 1, True, True),
+    (576, 96, 5, 1, True, True),
+)
+
+
+class MobileNetV3Small(nn.Module):
+    """Reference mobilenet_v3.py 'small' variant."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = nn.Conv(16, (3, 3), (2, 2), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = _hard_swish(x)
+        for expand, filters, kernel, stride, se, swish in _V3_SMALL:
+            x = InvertedResidual(expand, filters, kernel, (stride, stride), se, swish)(x)
+        x = nn.Conv(576, (1, 1), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = _hard_swish(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = _hard_swish(nn.Dense(1024)(x))
+        return nn.Dense(self.num_classes)(x)

@@ -96,10 +96,29 @@ def create(args: Any, output_dim: Optional[int] = None, seed: Optional[int] = No
         module = resnet20(num_classes=num_classes)
     elif model_name in ("resnet18", "resnet18_gn"):
         module = ResNet18GN(num_classes=num_classes)
-    elif model_name in ("mobilenet", "mobilenet_v3"):
+    elif model_name == "mobilenet":
+        from .mobilenet import MobileNetV1
+
+        module = MobileNetV1(num_classes=num_classes)
+    elif model_name == "mobilenet_v3":
         from .mobilenet import MobileNetV3Small
 
         module = MobileNetV3Small(num_classes=num_classes)
+    elif model_name.startswith("efficientnet"):
+        from .efficientnet import efficientnet_lite0
+
+        module = efficientnet_lite0(num_classes=num_classes)
+    elif model_name in ("gan", "cgan", "dcgan"):
+        from .gan import GANPair
+
+        hw = in_shape[1] if len(in_shape) == 4 else 28
+        ch = in_shape[-1] if len(in_shape) == 4 else 1
+        module = GANPair(image_hw=hw, channels=ch)
+        in_shape, in_dtype = (1, 64), jnp.float32  # latent z
+    elif model_name in ("darts", "nas", "fednas"):
+        from .darts import DARTSNetwork
+
+        module = DARTSNetwork(num_classes=num_classes)
     elif model_name in ("llama", "gpt", "transformer"):
         from .transformer import TransformerLM, TransformerConfig
 
@@ -112,3 +131,22 @@ def create(args: Any, output_dim: Optional[int] = None, seed: Optional[int] = No
     model = FedModel(module=module, params=None, input_shape=in_shape, input_dtype=in_dtype, name=model_name)
     model.params = model.init_params(seed)
     return model
+
+
+def create_split(args: Any, output_dim: Optional[int] = None, seed: int = 0):
+    """FedGKT / split-NN pair (reference model_hub.py:54-57 returns
+    [client_model, server_model]). Server half's input spec is the client
+    half's feature map shape."""
+    from .split_model import create_split_pair
+
+    dataset = str(getattr(args, "dataset", "cifar10")).lower()
+    num_classes = int(output_dim or getattr(args, "output_dim", 10))
+    in_shape, in_dtype = input_spec_for(dataset)
+    client_mod, server_mod = create_split_pair(num_classes=num_classes)
+
+    client = FedModel(module=client_mod, params=None, input_shape=in_shape, input_dtype=in_dtype, name="split_client")
+    client.params = client.init_params(seed)
+    feats, _ = client.apply(client.params, jnp.zeros(in_shape, in_dtype))
+    server = FedModel(module=server_mod, params=None, input_shape=tuple(feats.shape), name="split_server")
+    server.params = server.init_params(seed)
+    return client, server

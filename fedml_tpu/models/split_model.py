@@ -1,0 +1,78 @@
+"""Split models for split-NN / FedGKT (group knowledge transfer).
+
+Reference: ``python/fedml/model/model_hub.py:54-57`` (``create`` returns
+``[client_model, server_model]`` for FedGKT), ``model/cv/resnet56_gkt/``
+(resnet8 client feature extractor + resnet55 server head) and
+``simulation/mpi/split_nn``. The split point is the activation boundary:
+the client half emits features (and, for GKT, local logits); the server half
+consumes features. Each half is an independent flax module, so the two sides
+jit independently and exchange only activation arrays over the message plane.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .resnet import BasicBlock
+
+
+class SplitClientNet(nn.Module):
+    """Client-side feature extractor (resnet8-ish: stem + n blocks at width).
+
+    For FedGKT it also produces logits from its own pooled features so the
+    client can be trained locally against labels + server-distilled soft
+    targets (reference resnet_client).
+    """
+
+    num_classes: int = 10
+    width: int = 16
+    blocks: int = 3
+    group_norm_groups: int = 8
+    with_logits: bool = True
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False):
+        norm = partial(nn.GroupNorm, num_groups=self.group_norm_groups)
+        x = nn.Conv(self.width, (3, 3), use_bias=False)(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        for _ in range(self.blocks):
+            x = BasicBlock(self.width, (1, 1), norm)(x)
+        features = x
+        if not self.with_logits:
+            return features
+        pooled = jnp.mean(features, axis=(1, 2))
+        logits = nn.Dense(self.num_classes, name="client_head")(pooled)
+        return features, logits
+
+
+class SplitServerNet(nn.Module):
+    """Server-side head consuming client features (resnet55-ish remainder)."""
+
+    num_classes: int = 10
+    width: int = 16
+    blocks_per_stage: int = 3
+    group_norm_groups: int = 8
+
+    @nn.compact
+    def __call__(self, features: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        norm = partial(nn.GroupNorm, num_groups=self.group_norm_groups)
+        x = features
+        for stage, filters in enumerate([2 * self.width, 4 * self.width]):
+            for block in range(self.blocks_per_stage):
+                strides = (2, 2) if block == 0 else (1, 1)
+                x = BasicBlock(filters, strides, norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def create_split_pair(num_classes: int = 10, width: int = 16) -> Tuple[SplitClientNet, SplitServerNet]:
+    """FedGKT pair (reference model_hub.py:54-57)."""
+    return (
+        SplitClientNet(num_classes=num_classes, width=width),
+        SplitServerNet(num_classes=num_classes, width=width),
+    )

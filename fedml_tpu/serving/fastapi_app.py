@@ -14,7 +14,12 @@ def build_fastapi_app(predictor) -> "FastAPI":
     @api.post("/predict")
     async def predict(request: Request):
         input_json = await request.json()
-        resp = predictor.predict(input_json)
+        try:
+            resp = predictor.predict(input_json)
+        except NotImplementedError:
+            # predictor implements only async_predict (allowed by the
+            # FedMLPredictor contract; same fallback as the stdlib runner)
+            resp = predictor.async_predict(input_json)
         if asyncio.iscoroutine(resp):
             resp = await resp
         return resp
