@@ -110,10 +110,12 @@ class FedMLClientRunner:
     def callback_stop_train(self, run_id: str) -> None:
         proc = self._procs.get(run_id)
         if proc is not None and proc.poll() is None:
-            proc.kill()
+            # mark + report KILLED before the kill so the _wait thread (which
+            # wakes the moment the process dies) sees the verdict and stays quiet
             st = self.runs[run_id]
             st.status = "KILLED"
             self._report(st)
+            proc.kill()
 
 
 class FedMLServerRunner:
@@ -144,9 +146,11 @@ class FedMLServerRunner:
         for t in threads:
             t.join(timeout=max(0.0, deadline - time.time()))
         # edges still working at the deadline get a RUNNING placeholder so the
-        # returned dict always has one entry per dispatched edge
+        # returned dict always has one entry per dispatched edge (setdefault:
+        # a worker thread finishing concurrently must win over the placeholder)
         for eid in targets:
-            if eid not in self.statuses[run_id]:
-                self.statuses[run_id][eid] = RunStatus(run_id=run_id, edge_id=eid, status="RUNNING",
-                                                       detail="dispatch timeout; job still running")
+            self.statuses[run_id].setdefault(
+                eid, RunStatus(run_id=run_id, edge_id=eid, status="RUNNING",
+                               detail="dispatch timeout; job still running")
+            )
         return self.statuses[run_id]

@@ -75,18 +75,23 @@ class MLOpsRuntimeLogDaemon:
         with open(path, "w") as f:
             f.writelines(lines)
 
-    def poll_once(self) -> int:
-        """Ship any new lines; returns count (exposed for tests)."""
+    def poll_once(self, final: bool = False) -> int:
+        """Ship any new lines; returns count (exposed for tests).
+
+        Binary reads keep ``_pos`` an exact byte offset (text-mode newline
+        translation would make arithmetic offsets drift on CRLF content)."""
         if not os.path.exists(self.log_path):
             return 0
-        with open(self.log_path, "r") as f:
+        with open(self.log_path, "rb") as f:
             f.seek(self._pos)
-            lines = f.readlines()
+            raw = f.readlines()
             # never ship a partially-written final line: leave it for the next
-            # poll so line-oriented sinks see whole records
-            if lines and not lines[-1].endswith("\n"):
-                lines.pop()
-            self._pos += sum(len(line.encode("utf-8", "surrogatepass")) for line in lines)
+            # poll so line-oriented sinks see whole records — except on the
+            # final drain, where holding it back would lose it forever
+            if raw and not final and not raw[-1].endswith(b"\n"):
+                raw.pop()
+            self._pos += sum(len(b) for b in raw)
+        lines = [b.decode("utf-8", "replace") for b in raw]
         if lines:
             self.sink(self.run_id, self.rank, lines)
             self.chunks_shipped += 1
@@ -96,7 +101,7 @@ class MLOpsRuntimeLogDaemon:
         while not self._stop.is_set():
             self.poll_once()
             self._stop.wait(self.interval_s)
-        self.poll_once()  # final drain
+        self.poll_once(final=True)  # final drain ships an unterminated tail too
 
     def start(self) -> None:
         if self._thread is None:

@@ -13,9 +13,23 @@ from typing import Any
 
 class SimulatorSingleProcess:
     def __init__(self, args: Any, device: Any, dataset, model, client_trainer=None, server_aggregator=None):
-        from .sp.fedavg_api import FedAvgAPI
+        from ..constants import (
+            FEDML_FEDERATED_OPTIMIZER_ASYNC_FEDAVG,
+            FEDML_FEDERATED_OPTIMIZER_HIERACHICAL_FL,
+            FEDML_FEDERATED_OPTIMIZER_TURBO_AGGREGATE,
+        )
 
-        self.fl_trainer = FedAvgAPI(args, device, dataset, model, client_trainer, server_aggregator)
+        opt = str(getattr(args, "federated_optimizer", "FedAvg"))
+        if opt == FEDML_FEDERATED_OPTIMIZER_HIERACHICAL_FL:
+            from .sp.hierarchical_fl import HierarchicalTrainer as API
+        elif opt == FEDML_FEDERATED_OPTIMIZER_TURBO_AGGREGATE:
+            from .sp.turboaggregate import TurboAggregateTrainer as API
+        elif opt == FEDML_FEDERATED_OPTIMIZER_ASYNC_FEDAVG:
+            from .sp.async_fedavg import AsyncFedAvgAPI as API
+        else:
+            from .sp.fedavg_api import FedAvgAPI as API
+
+        self.fl_trainer = API(args, device, dataset, model, client_trainer, server_aggregator)
 
     def run(self):
         return self.fl_trainer.train()

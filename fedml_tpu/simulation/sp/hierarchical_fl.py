@@ -1,0 +1,100 @@
+"""Hierarchical FL: group-level FedAvg between global aggregations.
+
+Reference: ``simulation/sp/hierarchical_fl/{trainer,group,client}.py`` —
+clients are assigned to groups (``group_method='random'`` over
+``group_num`` groups); each global round, every group runs
+``group_comm_round`` intra-group FedAvg rounds starting from the global
+weights, then groups are averaged sample-weighted into the new global model
+(two-level averaging). On TPU pods the intra-group level maps to ICI
+all-reduce within a slice and the global level to WAN FedAvg across slices
+(SURVEY §2.a hierarchical row); in this single-process simulator both levels
+are the same jitted weighted tree-average.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from .fedavg_api import FedAvgAPI
+from .client import Client
+
+log = logging.getLogger(__name__)
+
+
+class HierarchicalTrainer(FedAvgAPI):
+    """Two-level FedAvg (reference hierarchical_fl/trainer.py)."""
+
+    def _setup_clients(self, train_data_local_num_dict, train_data_local_dict, test_data_local_dict) -> None:
+        args = self.args
+        group_method = str(getattr(args, "group_method", "random"))
+        group_num = int(getattr(args, "group_num", 2))
+        n_total = int(args.client_num_in_total)
+        if group_method != "random":
+            raise ValueError(f"unsupported group_method {group_method!r}")
+        # reference seeds np.random globally before this (fedml.init); mirror
+        # determinism by seeding from random_seed
+        rng = np.random.RandomState(int(getattr(args, "random_seed", 0)))
+        self.group_indexes = rng.randint(0, group_num, n_total)
+        self.group_to_clients: Dict[int, List[int]] = {}
+        for client_idx, gidx in enumerate(self.group_indexes):
+            self.group_to_clients.setdefault(int(gidx), []).append(client_idx)
+        log.info("group assignment: %s", self.group_to_clients)
+        # one reusable Client slot (datasets swapped per sampled client)
+        self.client_list = [
+            Client(0, train_data_local_dict[0], test_data_local_dict[0],
+                   train_data_local_num_dict[0], args, self.device, self.model_trainer)
+        ]
+
+    def _sample_groups(self, round_idx: int) -> Dict[int, List[int]]:
+        sampled = self._client_sampling(
+            round_idx, int(self.args.client_num_in_total), int(self.args.client_num_per_round)
+        )
+        group_to_sampled: Dict[int, List[int]] = {}
+        for client_idx in sampled:
+            group_to_sampled.setdefault(int(self.group_indexes[client_idx]), []).append(client_idx)
+        log.info("client_indexes of each group = %s", group_to_sampled)
+        return group_to_sampled
+
+    def _train_one_client(self, client_idx: int, w) -> Tuple[int, Any]:
+        client = self.client_list[0]
+        client.update_local_dataset(
+            client_idx,
+            self.train_data_local_dict[client_idx],
+            self.test_data_local_dict[client_idx],
+            self.train_data_local_num_dict[client_idx],
+        )
+        w_local = client.train(w)
+        return client.get_sample_number(), w_local
+
+    def _group_train(self, group_clients: List[int], w_global):
+        """group_comm_round rounds of FedAvg inside the group
+        (reference group.py Group.train)."""
+        w_group = w_global
+        for group_round in range(int(getattr(self.args, "group_comm_round", 1))):
+            w_locals = [self._train_one_client(ci, w_group) for ci in group_clients]
+            lst = self.aggregator.on_before_aggregation(w_locals)
+            w_group = self.aggregator.aggregate(lst)
+        n_group = sum(self.train_data_local_num_dict[ci] for ci in group_clients)
+        return n_group, w_group
+
+    def train(self) -> Dict[str, float]:
+        w_global = self.model_trainer.get_model_params()
+        comm_round = int(getattr(self.args, "comm_round", 10))
+        for round_idx in range(comm_round):
+            log.info("================ Global Communication round : %d", round_idx)
+            group_to_sampled = self._sample_groups(round_idx)
+            w_groups = [
+                self._group_train(clients, w_global)
+                for _, clients in sorted(group_to_sampled.items())
+            ]
+            lst = self.aggregator.on_before_aggregation(w_groups)
+            w_global = self.aggregator.on_after_aggregation(self.aggregator.aggregate(lst))
+            self.model_trainer.set_model_params(w_global)
+            self.aggregator.set_model_params(w_global)
+            freq = int(getattr(self.args, "frequency_of_the_test", 5))
+            if round_idx == comm_round - 1 or (freq > 0 and round_idx % freq == 0):
+                self.metrics_history.append(self._test_global(round_idx))
+        return self.metrics_history[-1] if self.metrics_history else {}

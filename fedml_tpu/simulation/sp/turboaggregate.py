@@ -1,0 +1,86 @@
+"""Turbo-Aggregate: multi-group ring aggregation with additive masking.
+
+Reference: ``simulation/sp/turboaggregate/{TA_trainer,TA_client,
+mpc_function}.py`` — after normal local training, clients are arranged into
+L groups on a ring; aggregation proceeds group-by-group, each group adding
+its (secret-shared) models to the running partial sum so no single party
+sees another's plaintext model (So et al., Turbo-Aggregate, 2021). The
+reference's finite-field primitives (additive sharing, Lagrange coding) live
+in mpc_function.py; here they come from ``core/mpc/finite_field`` (shared
+with SecAgg/LightSecAgg).
+
+Simulation shape: local training reuses the FedAvg client loop; the ring
+protocol then replaces the plain ``_aggregate``. Models are quantized to the
+field, masked with additive shares that cancel over each group, summed along
+the ring in field arithmetic, de-quantized, and weight-averaged.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ...core.mpc.finite_field import (
+    additive_shares,
+    flatten_finite,
+    tree_from_finite,
+    tree_to_finite,
+    unflatten_finite,
+)
+from ...utils.pytree import tree_scale
+from .fedavg_api import FedAvgAPI
+
+log = logging.getLogger(__name__)
+
+_P = 2**31 - 1
+_Q_BITS = 16
+
+
+class TurboAggregateTrainer(FedAvgAPI):
+    """FedAvg with the Turbo-Aggregate ring replacing plain aggregation."""
+
+    def _ring_aggregate(self, w_locals: List[Tuple[float, Any]]):
+        """Group clients on a ring; each group's masked contributions are
+        added to the running field-sum. Additive shares cancel within each
+        group, so the final sum equals the plain (unweighted) sum — which we
+        then turn into the sample-weighted average in float space."""
+        ta_group_num = max(1, int(getattr(self.args, "ta_group_num", 2)))
+        n = len(w_locals)
+        groups = [list(range(g, n, ta_group_num)) for g in range(ta_group_num)]
+        rng = np.random.default_rng(int(getattr(self.args, "random_seed", 0)))
+
+        total_weight = float(sum(num for num, _ in w_locals))
+        # scale each model by its weight fraction BEFORE quantization so the
+        # ring only ever adds (weighted) contributions
+        scaled = [tree_scale(w, num / total_weight) for num, w in w_locals]
+
+        finite = [tree_to_finite(w, _Q_BITS, _P) for w in scaled]
+        flat0, treedef, shapes = flatten_finite(finite[0])
+        d = flat0.shape[0]
+
+        partial = np.zeros(d, dtype=np.int64)  # running ring sum (field)
+        for gi, group in enumerate(groups):
+            if not group:
+                continue
+            # additive masks cancelling within the group: sum_j m_j = 0
+            masks = additive_shares(d, len(group), _P, rng)
+            masked_sum = np.zeros(d, dtype=np.int64)
+            for slot, ci in enumerate(group):
+                flat, _, _ = flatten_finite(finite[ci])
+                masked = (flat + masks[slot]) % _P
+                masked_sum = (masked_sum + masked) % _P
+            partial = (partial + masked_sum) % _P
+            log.debug("TA ring: group %d of %d added %d members", gi, ta_group_num, len(group))
+
+        summed_tree = unflatten_finite(partial.astype(np.int64), treedef, shapes)
+        return tree_from_finite(summed_tree, _Q_BITS, _P)
+
+    def _server_update(self, w_global, w_locals):
+        agg = self.aggregator
+        lst = agg.on_before_aggregation(w_locals)
+        new_w = self._ring_aggregate(lst)
+        new_w = agg.on_after_aggregation(new_w)
+        agg.assess_contribution()
+        return new_w

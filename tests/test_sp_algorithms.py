@@ -1,0 +1,113 @@
+"""SP algorithm-family tests: hierarchical, TurboAggregate, async,
+decentralized, vertical FL (reference: simulation/sp/{hierarchical_fl,
+turboaggregate,decentralized,classical_vertical_fl} + mpi/async_fedavg)."""
+
+import numpy as np
+import pytest
+
+import fedml_tpu as fedml
+from fedml_tpu.arguments import default_config
+
+
+def _run(optimizer, rounds=2, **over):
+    base = dict(
+        backend="sp",
+        model="lr",
+        federated_optimizer=optimizer,
+        comm_round=rounds,
+        client_num_in_total=4,
+        client_num_per_round=4,
+        epochs=1,
+        batch_size=16,
+        frequency_of_the_test=1,
+    )
+    base.update(over)
+    args = default_config("simulation", **base)
+    args = fedml.init(args)
+    device = fedml.device.get_device(args)
+    dataset, output_dim = fedml.data.load(args)
+    model_obj = fedml.model.create(args, output_dim)
+    return fedml.FedMLRunner(args, device, dataset, model_obj).run()
+
+
+def test_hierarchical_fl_learns():
+    m = _run("HierarchicalFL", rounds=3, group_num=2, group_comm_round=2)
+    assert m["test_acc"] > 0.3
+    assert np.isfinite(m["test_loss"])
+
+
+def test_turboaggregate_matches_fedavg_closely():
+    """The ring's additive masks cancel exactly, so TA differs from plain
+    FedAvg only by fixed-point quantization error."""
+    m_ta = _run("TA", rounds=2, ta_group_num=2)
+    m_avg = _run("FedAvg", rounds=2)
+    assert abs(m_ta["test_acc"] - m_avg["test_acc"]) < 0.05
+    assert abs(m_ta["test_loss"] - m_avg["test_loss"]) < 0.05
+
+
+def test_async_fedavg_learns():
+    m = _run("Async_FedAvg", rounds=4, client_num_per_round=2)
+    assert m["test_acc"] > 0.3
+
+
+def test_decentralized_dsgd_converges():
+    import jax.numpy as jnp
+
+    from fedml_tpu.simulation.sp.decentralized import FedML_decentralized_fl
+
+    n_clients, N, d = 6, 40, 5
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(d)
+    x = rng.randn(n_clients, N, d).astype(np.float32)
+    y = (x @ w_true > 0).astype(np.float32)
+
+    def loss_fn(params, xb, yb):
+        logit = xb @ params["w"]
+        return jnp.mean(jnp.maximum(logit, 0) - logit * yb + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    params0 = {"w": jnp.zeros((d,), jnp.float32)}
+    args = type("A", (), {"b_symmetric": True, "iteration_number": 60, "learning_rate": 0.5, "batch_size": 4})()
+    out = FedML_decentralized_fl(n_clients, (x, y), params0, loss_fn, args)
+    assert out["loss_history"][-1] < out["loss_history"][0]
+    # consensus: client params should be close to each other after mixing
+    w_stack = np.asarray(out["params"]["w"])
+    assert np.max(np.std(w_stack, axis=0)) < 0.2
+
+
+def test_decentralized_pushsum_runs():
+    import jax.numpy as jnp
+
+    from fedml_tpu.simulation.sp.decentralized import FedML_decentralized_fl
+
+    n_clients, N, d = 5, 20, 4
+    rng = np.random.RandomState(1)
+    x = rng.randn(n_clients, N, d).astype(np.float32)
+    y = (x.sum(-1) > 0).astype(np.float32)
+
+    def loss_fn(params, xb, yb):
+        logit = xb @ params["w"]
+        return jnp.mean((logit - yb) ** 2)
+
+    params0 = {"w": jnp.zeros((d,), jnp.float32)}
+    args = type("A", (), {"b_symmetric": False, "iteration_number": 30, "learning_rate": 0.1, "batch_size": 2})()
+    out = FedML_decentralized_fl(n_clients, (x, y), params0, loss_fn, args)
+    assert np.all(np.isfinite(np.asarray(out["params"]["w"])))
+    assert out["loss_history"][-1] < out["loss_history"][0]
+
+
+def test_vertical_fl_learns():
+    from fedml_tpu.simulation.sp.classical_vertical_fl import VerticalFederatedLearning, VflFixture
+
+    rng = np.random.RandomState(0)
+    n, d_host, d_guest = 400, 4, 6
+    x_host = rng.randn(n, d_host).astype(np.float32)
+    x_guest = rng.randn(n, d_guest).astype(np.float32)
+    w_h, w_g = rng.randn(d_host), rng.randn(d_guest)
+    y = ((x_host @ w_h + x_guest @ w_g) > 0).astype(np.float32)
+
+    vfl = VerticalFederatedLearning([d_host, d_guest], learning_rate=0.5)
+    fixture = VflFixture(vfl)
+    m = fixture.fit(
+        [x_host[:300], x_guest[:300]], y[:300], [x_host[300:], x_guest[300:]], y[300:], epochs=10, batch_size=32
+    )
+    assert m["test_acc"] > 0.8
