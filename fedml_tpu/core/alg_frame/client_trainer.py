@@ -64,8 +64,14 @@ class ClientTrainer(abc.ABC):
         """Data/model poisoning hooks (reference :37-43)."""
         if not self.enable_hooks:
             return train_data
+        from ..dp.fedml_differential_privacy import FedMLDifferentialPrivacy
         from ..security.fedml_attacker import FedMLAttacker
 
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if dp.is_local_dp_enabled():
+            # remember the round's incoming global model: DP-Clip needs it as
+            # the anchor for delta clipping after local training.
+            self._dp_global_params = self.get_model_params()
         attacker = FedMLAttacker.get_instance()
         if attacker.is_data_poisoning_attack() and attacker.is_to_poison_data():
             return attacker.poison_data(train_data)
@@ -84,7 +90,11 @@ class ClientTrainer(abc.ABC):
 
         dp = FedMLDifferentialPrivacy.get_instance()
         if dp.is_local_dp_enabled():
-            self.set_model_params(dp.add_local_noise(self.get_model_params()))
+            extra = {
+                "global_model_params": getattr(self, "_dp_global_params", None),
+                "local_sample_num": self.local_sample_number or None,
+            }
+            self.set_model_params(dp.add_local_noise(self.get_model_params(), extra))
         fhe = FedMLFHE.get_instance()
         if fhe.is_fhe_enabled():
             Context().add("fhe_encrypted", True)

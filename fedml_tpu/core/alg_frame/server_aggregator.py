@@ -58,7 +58,10 @@ class ServerAggregator(abc.ABC):
                 lst, extra_auxiliary_info=self.get_model_params()
             )
         dp = FedMLDifferentialPrivacy.get_instance()
-        if dp.is_global_dp_enabled() and dp.is_clipping():
+        if dp.is_dp_enabled():
+            # always routed through the frame: feeds round statistics
+            # (NbAFL's m, DPClip's qW), steps the per-round LDP accountant,
+            # and clips only if a norm is configured.
             lst = dp.global_clip(lst)
         return lst
 

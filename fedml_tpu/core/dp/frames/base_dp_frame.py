@@ -1,0 +1,56 @@
+"""Base DP frame.
+
+Reference: ``python/fedml/core/dp/frames/base_dp_solution.py`` — a frame owns
+an optional local (client-side) and central (server-side) mechanism and
+exposes the three hook entry points the alg-frame calls:
+``add_local_noise`` / ``global_clip`` / ``add_global_noise``, plus
+``set_params_for_dp`` for frames that need round statistics (NbAFL).
+
+All noising here is a pure function of a JAX PRNG key over pytrees (the
+reference mutates torch OrderedDicts in place with global RNG state).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+
+from ....utils.pytree import PyTree, tree_clip_by_global_norm
+
+GradList = List[Tuple[float, PyTree]]
+
+
+class BaseDPFrame:
+    def __init__(self, args: Any = None):
+        self.args = args
+        self.cdp = None  # central mechanism
+        self.ldp = None  # local mechanism
+        self.max_grad_norm = getattr(args, "max_grad_norm", None)
+
+    def set_cdp(self, mechanism) -> None:
+        self.cdp = mechanism
+
+    def set_ldp(self, mechanism) -> None:
+        self.ldp = mechanism
+
+    def add_local_noise(self, local_grad: PyTree, key: jax.Array, extra_auxiliary_info: Any = None) -> PyTree:
+        return self.ldp.add_noise(local_grad, key)
+
+    def add_global_noise(self, global_model: PyTree, key: jax.Array) -> PyTree:
+        return self.cdp.add_noise(global_model, key)
+
+    def global_clip(self, raw_client_grad_list: GradList) -> GradList:
+        """Per-client L2 clip of the whole update (reference
+        base_dp_solution.py:43-57, minus its redundant inner loop)."""
+        if self.max_grad_norm is None:
+            return raw_client_grad_list
+        c = float(self.max_grad_norm)
+        return [(n, tree_clip_by_global_norm(g, c)) for n, g in raw_client_grad_list]
+
+    def set_params_for_dp(self, raw_client_grad_list: GradList) -> None:
+        pass
+
+    def get_rdp_scale(self) -> Optional[float]:
+        mech = self.cdp if self.cdp is not None else self.ldp
+        return getattr(mech, "sigma", None) if mech is not None else None

@@ -1,0 +1,30 @@
+"""Central (global) DP frame.
+
+Reference: ``python/fedml/core/dp/frames/cdp.py`` ``GlobalDP`` — the server
+clips each client update (``max_grad_norm``) and adds calibrated noise to the
+aggregate.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..mechanisms import create_mechanism
+from .base_dp_frame import BaseDPFrame
+
+
+class GlobalDP(BaseDPFrame):
+    """Accounting note: the reference keeps a second RDP accountant inside
+    this frame (cdp.py:13-17); here the facade owns the single accountant and
+    steps it on every ``add_global_noise``."""
+
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.set_cdp(
+            create_mechanism(
+                getattr(args, "mechanism_type", "gaussian"),
+                epsilon=float(getattr(args, "epsilon", 1.0)),
+                delta=float(getattr(args, "delta", 1e-5)),
+                sensitivity=float(getattr(args, "sensitivity", 1.0)),
+            )
+        )

@@ -1,0 +1,77 @@
+"""DP-FedAvg clip frame.
+
+Reference: ``python/fedml/core/dp/frames/dp_clip.py`` ``DP_Clip``,
+implementing McMahan et al. ICLR 2018, "Learning Differentially Private
+Recurrent Language Models":
+
+  * client: L2-clip the *update* delta = w_local - w_global to
+    ``clipping_norm`` (flat clipping, eq. 2 of the paper) and send
+    w_global + clipped_delta — still a model, so the server's weighted
+    averaging stays protocol-compatible (avg(g + d_i) = g + avg(d_i));
+  * server: average, then add Gaussian noise with std
+    ``clipping_norm * noise_multiplier / qW`` where qW is the expected
+    weighted fraction of participating data.
+
+Everything is a jitted pytree op; clipping is the standard
+clip-by-global-norm (the reference reimplements torch's).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import jax
+
+from ..mechanisms.gaussian import add_gaussian_noise
+from ....utils.pytree import PyTree, tree_add, tree_clip_by_global_norm, tree_sub
+from .base_dp_frame import BaseDPFrame, GradList
+
+
+class DPClip(BaseDPFrame):
+    def __init__(self, args: Any):
+        super().__init__(args)
+        self.clipping_norm = float(getattr(args, "clipping_norm", 1.0) or 1.0)
+        self.noise_multiplier = float(getattr(args, "noise_multiplier", 1.0))
+        self.train_data_num_in_total = int(getattr(args, "train_data_num_in_total", 0))
+        self.client_num_per_round = int(getattr(args, "client_num_per_round", 1))
+        self.client_num_in_total = int(getattr(args, "client_num_in_total", 1))
+        self._qw_round = None  # observed sum of per-round sample weights
+        self._warned_no_anchor = False
+
+    def set_params_for_dp(self, raw_client_grad_list: GradList) -> None:
+        """qW = expected weighted participation. The round's own sample
+        weights sum to exactly q*W in expectation, so derive it from the
+        aggregation list the server already has (args.train_data_num_in_total
+        is only a fallback — nothing in the framework wires it)."""
+        if raw_client_grad_list:
+            self._qw_round = float(sum(n for n, _ in raw_client_grad_list))
+
+    def _qw(self) -> float:
+        if self._qw_round:
+            return max(1.0, self._qw_round)
+        q = self.client_num_per_round / max(1, self.client_num_in_total)
+        return max(1.0, self.train_data_num_in_total * q)
+
+    def get_rdp_scale(self) -> float:
+        return self.noise_multiplier
+
+    def add_local_noise(self, local_grad: PyTree, key: jax.Array, extra_auxiliary_info: Any = None) -> PyTree:
+        """Clip the local update around the round's global model, passed as
+        ``extra_auxiliary_info['global_model_params']`` (reference
+        dp_clip.py:33-37 takes it as the bare extra arg). Without the anchor
+        there is no delta to clip, so the model passes through untouched."""
+        anchor = extra_auxiliary_info
+        if isinstance(extra_auxiliary_info, dict):
+            anchor = extra_auxiliary_info.get("global_model_params")
+        if anchor is None:
+            if not self._warned_no_anchor:
+                logging.warning("DPClip: no global-model anchor provided; skipping delta clip")
+                self._warned_no_anchor = True
+            return local_grad
+        delta = tree_clip_by_global_norm(tree_sub(local_grad, anchor), self.clipping_norm)
+        return tree_add(anchor, delta)
+
+    def add_global_noise(self, global_model: PyTree, key: jax.Array) -> PyTree:
+        sigma = self.clipping_norm * self.noise_multiplier / self._qw()
+        return add_gaussian_noise(global_model, key, sigma)

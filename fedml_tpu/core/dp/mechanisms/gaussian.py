@@ -16,6 +16,18 @@ import jax.numpy as jnp
 from ....utils.pytree import PyTree
 
 
+def add_gaussian_noise(tree: PyTree, key: jax.Array, sigma: float) -> PyTree:
+    """Per-leaf N(0, sigma^2) noise, one split key per leaf — the single
+    noising primitive shared by every DP frame."""
+    leaves, treedef = jax.tree.flatten(tree)
+    keys = jax.random.split(key, len(leaves))
+    noised = [
+        l + (sigma * jax.random.normal(k, l.shape, dtype=jnp.float32)).astype(l.dtype)
+        for l, k in zip(leaves, keys)
+    ]
+    return jax.tree.unflatten(treedef, noised)
+
+
 class Gaussian:
     def __init__(self, *, epsilon: float, delta: float, sensitivity: float = 1.0, sigma: float | None = None):
         if sigma is not None:
@@ -30,10 +42,4 @@ class Gaussian:
         self.sensitivity = sensitivity
 
     def add_noise(self, tree: PyTree, key: jax.Array) -> PyTree:
-        leaves, treedef = jax.tree.flatten(tree)
-        keys = jax.random.split(key, len(leaves))
-        noised = [
-            l + (self.sigma * jax.random.normal(k, l.shape, dtype=jnp.float32)).astype(l.dtype)
-            for l, k in zip(leaves, keys)
-        ]
-        return jax.tree.unflatten(treedef, noised)
+        return add_gaussian_noise(tree, key, self.sigma)

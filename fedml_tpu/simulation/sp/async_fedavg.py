@@ -71,9 +71,16 @@ class AsyncFedAvgAPI(FedAvgAPI):
                 self.train_data_local_num_dict[client_idx],
             )
             w_local = client.train(dispatched_w.pop(ev_seq))
+            # each arrival is one aggregation event: run the alg-frame hooks
+            # (defense screening / DP clip before; central noise / FHE after)
+            # exactly like the synchronous loop does per round.
+            sample_num = float(self.train_data_local_num_dict[client_idx])
+            hooked = self.aggregator.on_before_aggregation([(sample_num, w_local)])
+            w_local = hooked[0][1]
             staleness = version - started_version
             a_t = alpha * (staleness + 1.0) ** (-poly_a)
             w_global = jax.tree.map(lambda g, l: (1.0 - a_t) * g + a_t * l, w_global, w_local)
+            w_global = self.aggregator.on_after_aggregation(w_global)
             version += 1
             processed += 1
             if processed % in_flight == 0:

@@ -59,8 +59,7 @@ class VerticalFederatedLearning:
             # logistic loss; y in {0,1}
             return jnp.mean(jnp.maximum(logit, 0) - logit * y + jnp.log1p(jnp.exp(-jnp.abs(logit))))
 
-        self._loss = jax.jit(joint_loss)
-        self._grads = jax.jit(jax.grad(joint_loss))
+        self._loss_and_grads = jax.jit(jax.value_and_grad(joint_loss))
 
         def predict(all_params, xs):
             logit = sum(_party_apply(p, x) for p, x in zip(all_params, xs))[:, 0]
@@ -71,8 +70,7 @@ class VerticalFederatedLearning:
     def fit_batch(self, party_xs: Sequence[np.ndarray], y: np.ndarray) -> float:
         xs = [jnp.asarray(x) for x in party_xs]
         y = jnp.asarray(y, jnp.float32)
-        loss = self._loss(self.party_params, xs, y)
-        grads = self._grads(self.party_params, xs, y)
+        loss, grads = self._loss_and_grads(self.party_params, xs, y)
         # each party applies only ITS gradient slice (the protocol boundary)
         self.party_params = [
             jax.tree.map(lambda p, g: p - self.lr * g, pp, gg) for pp, gg in zip(self.party_params, grads)

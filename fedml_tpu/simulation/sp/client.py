@@ -14,6 +14,7 @@ class Client:
         self.args = args
         self.device = device
         self.model_trainer = model_trainer
+        self.model_trainer.local_sample_number = local_sample_number
 
     def update_local_dataset(self, client_idx, local_training_data, local_test_data, local_sample_number):
         self.client_idx = client_idx
@@ -21,6 +22,8 @@ class Client:
         self.local_test_data = local_test_data
         self.local_sample_number = local_sample_number
         self.model_trainer.set_id(client_idx)
+        # the alg-frame hooks (NbAFL's m) read the size off the trainer
+        self.model_trainer.local_sample_number = local_sample_number
 
     def get_sample_number(self):
         return self.local_sample_number
