@@ -123,7 +123,10 @@ class FedMLDifferentialPrivacy:
         configured."""
         self.frame.set_params_for_dp(raw_client_grad_list)
         if isinstance(self.frame, LocalDP):
+            # LDP clips client-side *before* noising; re-clipping the noised
+            # models here would rescale signal+noise and break calibration.
             self._account_step()
+            return raw_client_grad_list
         return self.frame.global_clip(raw_client_grad_list)
 
     # --- accounting ------------------------------------------------------

@@ -86,6 +86,69 @@ class ModelReplacementBackdoorAttack:
         return out
 
 
+class BackdoorAttack:
+    """"A little is enough" (Baruch et al. 2019; reference:
+    backdoor_attack.py): malicious workers place their parameters inside the
+    benign distribution — at mean +/- z*std per coordinate — so the poisoned
+    update survives statistical defenses while steering the model."""
+
+    def __init__(self, config: Any):
+        self.backdoor_client_num = int(getattr(config, "backdoor_client_num", 1))
+        self.client_num = int(getattr(config, "client_num_per_round", 4))
+        # z: reference computes it from the tolerated-corruption quantile when
+        # unset (backdoor_attack.py s computation); a fixed default keeps it pure
+        self.num_std = float(getattr(config, "num_std", 1.5))
+
+    def attack_model(self, raw_client_grad_list: GradList, extra_auxiliary_info=None) -> GradList:
+        out = list(raw_client_grad_list)
+        k = min(self.backdoor_client_num, len(out))
+        if k == 0 or len(out) < 2:
+            return out
+        stacked = jax.tree.map(lambda *ws: jnp.stack(ws), *[w for _, w in out])
+        mean = jax.tree.map(lambda s: jnp.mean(s, axis=0), stacked)
+        std = jax.tree.map(lambda s: jnp.std(s, axis=0), stacked)
+        z = self.num_std
+        for i in range(k):
+            n, w = out[i]
+            # clamp the malicious params into [mean - z*std, mean + z*std]
+            poisoned = jax.tree.map(
+                lambda wi, m, s: jnp.clip(wi, m - z * s, m + z * s), w, mean, std
+            )
+            out[i] = (n, poisoned)
+        return out
+
+
+class EdgeCaseBackdoorAttack:
+    """Edge-case ("tail") backdoor (Wang et al. 2020; reference:
+    edge_case_backdoor_attack.py): poisoned clients mix a percentage of
+    rare edge-case samples labeled with the attacker's target class into
+    their local data."""
+
+    def __init__(self, config: Any, backdoor_dataset=None):
+        self.sample_pct = float(getattr(config, "backdoor_sample_percentage", 0.1))
+        self.target_class = int(getattr(config, "target_class", 0))
+        self.backdoor_dataset = backdoor_dataset or getattr(config, "backdoor_dataset", None)
+        self._rng = np.random.RandomState(int(getattr(config, "random_seed", 0)) + 307)
+
+    def poison_data(self, dataset):
+        x, y = dataset
+        x, y = np.asarray(x), np.asarray(y).copy()
+        n_poison = max(1, int(len(y) * self.sample_pct))
+        if self.backdoor_dataset is not None:
+            bx, _ = self.backdoor_dataset
+            bx = np.asarray(bx)
+            pick = self._rng.randint(0, len(bx), n_poison)
+            slots = self._rng.choice(len(y), n_poison, replace=False)
+            x = x.copy()
+            x[slots] = bx[pick][: len(slots)].reshape(x[slots].shape)
+            y[slots] = self.target_class
+        else:
+            # no edge-case pool provided: relabel the tail of the local data
+            slots = self._rng.choice(len(y), n_poison, replace=False)
+            y[slots] = self.target_class
+        return x, y
+
+
 class LazyWorkerAttack:
     """Lazy workers resubmit (a noisy copy of) the previous global model
     instead of training (reference: lazy_worker.py)."""

@@ -73,6 +73,25 @@ def reveal_labels_from_gradients(last_layer_grad: jnp.ndarray) -> jnp.ndarray:
     return row_signal < 0
 
 
+class RevealingLabelsFromGradientsAttack:
+    """Facade-compatible wrapper over :func:`reveal_labels_from_gradients`
+    (reference: revealing_labels_from_gradients_attack.py)."""
+
+    def __init__(self, config: Any):
+        self.config = config
+
+    def reconstruct_data(self, a_gradient, extra_auxiliary_info=None):
+        last_layer_grad = a_gradient
+        if not isinstance(a_gradient, jnp.ndarray):
+            # last weight *matrix* (flax sorts bias before kernel, so a
+            # positional [-2] pick would land on the bias vector)
+            leaves = jax.tree.leaves(a_gradient)
+            last_layer_grad = next(
+                (l for l in reversed(leaves) if hasattr(l, "ndim") and l.ndim >= 2), leaves[-1]
+            )
+        return reveal_labels_from_gradients(jnp.asarray(last_layer_grad))
+
+
 class DLGAttack:
     """Facade-compatible wrapper: reconstruct_data(a_gradient, aux)."""
 

@@ -53,16 +53,12 @@ class WeakDPDefense(BaseDefenseMethod):
         self._key = jax.random.PRNGKey(int(getattr(config, "random_seed", 0)) + 13)
 
     def defend_before_aggregation(self, raw_client_grad_list: GradList, extra_auxiliary_info=None) -> GradList:
+        from ...dp.mechanisms.gaussian import add_gaussian_noise
+
         out = []
         for n, w in raw_client_grad_list:
             self._key, sub = jax.random.split(self._key)
-            leaves, treedef = jax.tree.flatten(w)
-            keys = jax.random.split(sub, len(leaves))
-            noised = [
-                l + (self.stddev * jax.random.normal(k, l.shape, jnp.float32)).astype(l.dtype)
-                for l, k in zip(leaves, keys)
-            ]
-            out.append((n, jax.tree.unflatten(treedef, noised)))
+            out.append((n, add_gaussian_noise(w, sub, self.stddev)))
         return out
 
 
@@ -112,15 +108,39 @@ class FoolsGoldDefense(BaseDefenseMethod):
 
 class ThreeSigmaDefense(BaseDefenseMethod):
     """Drop clients whose update norm deviates >3 sigma from the cohort
-    median (reference: three_sigma_defense.py family)."""
+    median (reference: three_sigma_defense.py family).
+
+    ``set_potential_malicious_clients`` narrows screening to a suspect set
+    (fed by CrossRoundDefense inside OutlierDetection,
+    reference outlier_detection.py:22)."""
+
+    def __init__(self, config: Any):
+        super().__init__(config)
+        self._suspects = None
+        self._malicious: list = []
+
+    def set_potential_malicious_clients(self, suspect_idxs) -> None:
+        self._suspects = None if suspect_idxs is None else set(int(i) for i in suspect_idxs)
+
+    def get_malicious_client_idxs(self) -> list:
+        return self._malicious
 
     def defend_before_aggregation(self, raw_client_grad_list: GradList, extra_auxiliary_info=None) -> GradList:
         x, _ = _stack_flat(raw_client_grad_list)
         norms = np.asarray(jnp.linalg.norm(x, axis=1))
-        med, std = float(np.median(norms)), float(np.std(norms) + 1e-9)
-        keep = [i for i, v in enumerate(norms) if abs(v - med) <= 3.0 * std]
+        med = float(np.median(norms))
+        # robust sigma (MAD * 1.4826): plain np.std is inflated by the very
+        # outlier being screened and masks it in small cohorts
+        std = float(np.median(np.abs(norms - med)) * 1.4826 + 1e-6 * (abs(med) + 1.0))
+        outlier = {
+            i for i, v in enumerate(norms)
+            if abs(v - med) > 3.0 * std and (self._suspects is None or i in self._suspects)
+        }
+        self._malicious = sorted(outlier)
+        keep = [i for i in range(len(raw_client_grad_list)) if i not in outlier]
         if not keep:
             keep = list(range(len(raw_client_grad_list)))
+            self._malicious = []
         return [raw_client_grad_list[i] for i in keep]
 
 
@@ -156,12 +176,8 @@ class CRFLDefense(BaseDefenseMethod):
         self._key = jax.random.PRNGKey(int(getattr(config, "random_seed", 0)) + 29)
 
     def defend_after_aggregation(self, global_model):
+        from ...dp.mechanisms.gaussian import add_gaussian_noise
+
         clipped = tree_clip_by_global_norm(global_model, self.clip)
         self._key, sub = jax.random.split(self._key)
-        leaves, treedef = jax.tree.flatten(clipped)
-        keys = jax.random.split(sub, len(leaves))
-        noised = [
-            l + (self.sigma * jax.random.normal(k, l.shape, jnp.float32)).astype(l.dtype)
-            for l, k in zip(leaves, keys)
-        ]
-        return jax.tree.unflatten(treedef, noised)
+        return add_gaussian_noise(clipped, sub, self.sigma)

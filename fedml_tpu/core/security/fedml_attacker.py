@@ -15,10 +15,18 @@ ATTACK_METHOD_MODEL_REPLACEMENT = "model_replacement"
 ATTACK_METHOD_LAZY_WORKER = "lazy_worker"
 ATTACK_METHOD_DLG = "dlg"
 ATTACK_METHOD_INVERT_GRADIENT = "invert_gradient"
+ATTACK_METHOD_BACKDOOR = "backdoor"
+ATTACK_METHOD_EDGE_CASE_BACKDOOR = "edge_case_backdoor"
+ATTACK_METHOD_REVEAL_LABELS = "revealing_labels"
 
-MODEL_ATTACKS = {ATTACK_METHOD_BYZANTINE, ATTACK_METHOD_MODEL_REPLACEMENT, ATTACK_METHOD_LAZY_WORKER}
-DATA_ATTACKS = {ATTACK_METHOD_LABEL_FLIP}
-RECONSTRUCT_ATTACKS = {ATTACK_METHOD_DLG, ATTACK_METHOD_INVERT_GRADIENT}
+MODEL_ATTACKS = {
+    ATTACK_METHOD_BYZANTINE,
+    ATTACK_METHOD_MODEL_REPLACEMENT,
+    ATTACK_METHOD_LAZY_WORKER,
+    ATTACK_METHOD_BACKDOOR,
+}
+DATA_ATTACKS = {ATTACK_METHOD_LABEL_FLIP, ATTACK_METHOD_EDGE_CASE_BACKDOOR}
+RECONSTRUCT_ATTACKS = {ATTACK_METHOD_DLG, ATTACK_METHOD_INVERT_GRADIENT, ATTACK_METHOD_REVEAL_LABELS}
 
 
 class FedMLAttacker:
@@ -42,7 +50,9 @@ class FedMLAttacker:
             return
         self.attack_type = str(getattr(args, "attack_type", ATTACK_METHOD_BYZANTINE)).strip().lower()
         from .attack.attacks import (
+            BackdoorAttack,
             ByzantineAttack,
+            EdgeCaseBackdoorAttack,
             LabelFlippingAttack,
             LazyWorkerAttack,
             ModelReplacementBackdoorAttack,
@@ -56,6 +66,14 @@ class FedMLAttacker:
             self.attacker = ModelReplacementBackdoorAttack(args)
         elif self.attack_type == ATTACK_METHOD_LAZY_WORKER:
             self.attacker = LazyWorkerAttack(args)
+        elif self.attack_type == ATTACK_METHOD_BACKDOOR:
+            self.attacker = BackdoorAttack(args)
+        elif self.attack_type == ATTACK_METHOD_EDGE_CASE_BACKDOOR:
+            self.attacker = EdgeCaseBackdoorAttack(args)
+        elif self.attack_type == ATTACK_METHOD_REVEAL_LABELS:
+            from .attack.gradient_inversion import RevealingLabelsFromGradientsAttack
+
+            self.attacker = RevealingLabelsFromGradientsAttack(args)
         elif self.attack_type in RECONSTRUCT_ATTACKS:
             from .attack.gradient_inversion import DLGAttack
 

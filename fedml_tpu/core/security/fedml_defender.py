@@ -21,6 +21,16 @@ DEFENSE_FOOLSGOLD = "foolsgold"
 DEFENSE_THREE_SIGMA = "3sigma"
 DEFENSE_SLSGD = "slsgd"
 DEFENSE_CRFL = "crfl"
+DEFENSE_BULYAN = "bulyan"
+DEFENSE_CCLIP = "cclip"
+DEFENSE_CROSS_ROUND = "cross_round"
+DEFENSE_OUTLIER_DETECTION = "outlier_detection"
+DEFENSE_RESIDUAL_REWEIGHT = "residual_reweight"
+DEFENSE_ROBUST_LEARNING_RATE = "robust_learning_rate"
+DEFENSE_SOTERIA = "soteria"
+DEFENSE_WBC = "wbc"
+DEFENSE_THREE_SIGMA_FOOLSGOLD = "3sigma_foolsgold"
+DEFENSE_THREE_SIGMA_GEOMEDIAN = "3sigma_geomedian"
 
 
 class FedMLDefender:
@@ -58,6 +68,18 @@ class FedMLDefender:
             ThreeSigmaDefense,
             WeakDPDefense,
         )
+        from .defense.advanced import (
+            BulyanDefense,
+            CClipDefense,
+            CrossRoundDefense,
+            OutlierDetection,
+            ResidualBasedReweightingDefense,
+            RobustLearningRateDefense,
+            SoteriaDefense,
+            ThreeSigmaFoolsGoldDefense,
+            ThreeSigmaGeoMedianDefense,
+            WbcDefense,
+        )
 
         table = {
             DEFENSE_KRUM: KrumDefense,
@@ -72,6 +94,16 @@ class FedMLDefender:
             DEFENSE_THREE_SIGMA: ThreeSigmaDefense,
             DEFENSE_SLSGD: SLSGDDefense,
             DEFENSE_CRFL: CRFLDefense,
+            DEFENSE_BULYAN: BulyanDefense,
+            DEFENSE_CCLIP: CClipDefense,
+            DEFENSE_CROSS_ROUND: CrossRoundDefense,
+            DEFENSE_OUTLIER_DETECTION: OutlierDetection,
+            DEFENSE_RESIDUAL_REWEIGHT: ResidualBasedReweightingDefense,
+            DEFENSE_ROBUST_LEARNING_RATE: RobustLearningRateDefense,
+            DEFENSE_SOTERIA: SoteriaDefense,
+            DEFENSE_WBC: WbcDefense,
+            DEFENSE_THREE_SIGMA_FOOLSGOLD: ThreeSigmaFoolsGoldDefense,
+            DEFENSE_THREE_SIGMA_GEOMEDIAN: ThreeSigmaGeoMedianDefense,
         }
         if self.defense_type not in table:
             raise ValueError(f"unknown defense type {self.defense_type!r}")

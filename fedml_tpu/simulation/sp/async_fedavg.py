@@ -31,6 +31,23 @@ log = logging.getLogger(__name__)
 
 
 class AsyncFedAvgAPI(FedAvgAPI):
+    _warned_agg_defense = False
+
+    def _warn_on_aggregation_defense_unsupported(self) -> None:
+        if AsyncFedAvgAPI._warned_agg_defense:
+            return
+        from ...core.security.fedml_defender import FedMLDefender
+        from ...core.security.defense.defense_base import BaseDefenseMethod
+
+        defender = FedMLDefender.get_instance()
+        if defender.is_defense_enabled() and type(defender.defender).defend_on_aggregation is not BaseDefenseMethod.defend_on_aggregation:
+            log.warning(
+                "async FedAvg mixes one update at a time: %s's defend_on_aggregation "
+                "(cohort aggregation rule) is NOT applied; only before/after hooks run",
+                type(defender.defender).__name__,
+            )
+        AsyncFedAvgAPI._warned_agg_defense = True
+
     def train(self) -> Dict[str, float]:
         args = self.args
         w_global = self.model_trainer.get_model_params()
@@ -71,11 +88,17 @@ class AsyncFedAvgAPI(FedAvgAPI):
                 self.train_data_local_num_dict[client_idx],
             )
             w_local = client.train(dispatched_w.pop(ev_seq))
-            # each arrival is one aggregation event: run the alg-frame hooks
-            # (defense screening / DP clip before; central noise / FHE after)
-            # exactly like the synchronous loop does per round.
+            # each arrival is one aggregation event: run the before/after
+            # alg-frame hooks (screening, DP clip, central noise, FHE).
+            # defend_on_aggregation defenses (median/trimmed-mean/...) need a
+            # cohort and cannot apply to a single async arrival — warn once.
+            self._warn_on_aggregation_defense_unsupported()
             sample_num = float(self.train_data_local_num_dict[client_idx])
             hooked = self.aggregator.on_before_aggregation([(sample_num, w_local)])
+            if not hooked:
+                # screening rejected this update; keep the worker busy
+                dispatch(int(rng.randint(n_total)), now)
+                continue
             w_local = hooked[0][1]
             staleness = version - started_version
             a_t = alpha * (staleness + 1.0) ** (-poly_a)
