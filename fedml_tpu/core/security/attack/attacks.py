@@ -102,18 +102,19 @@ class BackdoorAttack:
     def attack_model(self, raw_client_grad_list: GradList, extra_auxiliary_info=None) -> GradList:
         out = list(raw_client_grad_list)
         k = min(self.backdoor_client_num, len(out))
-        if k == 0 or len(out) < 2:
+        if k == 0 or len(out) <= k:
             return out
-        stacked = jax.tree.map(lambda *ws: jnp.stack(ws), *[w for _, w in out])
+        # benign statistics only — the attacker estimates the honest
+        # distribution, then submits mean - z*std: maximally harmful while
+        # staying inside the band statistical defenses treat as plausible
+        benign = [w for _, w in out[k:]]
+        stacked = jax.tree.map(lambda *ws: jnp.stack(ws), *benign)
         mean = jax.tree.map(lambda s: jnp.mean(s, axis=0), stacked)
         std = jax.tree.map(lambda s: jnp.std(s, axis=0), stacked)
         z = self.num_std
+        poisoned = jax.tree.map(lambda m, s: m - z * s, mean, std)
         for i in range(k):
-            n, w = out[i]
-            # clamp the malicious params into [mean - z*std, mean + z*std]
-            poisoned = jax.tree.map(
-                lambda wi, m, s: jnp.clip(wi, m - z * s, m + z * s), w, mean, std
-            )
+            n, _ = out[i]
             out[i] = (n, poisoned)
         return out
 

@@ -25,7 +25,7 @@ from ....utils.pytree import (
 )
 from .defense_base import BaseDefenseMethod, GradList, PyTree
 from .robust_aggregation import _stack_flat, geometric_median, krum_scores
-from .screening import ThreeSigmaDefense, foolsgold_weights
+from .screening import ThreeSigmaDefense
 
 log = logging.getLogger(__name__)
 
@@ -212,6 +212,11 @@ class CrossRoundDefense(BaseDefenseMethod):
             elif client_score > self.upperbound:
                 self.lazy_worker_list.append(slot)
         self.training_round += 1
+        # refresh the per-client history with this round's clean features so
+        # the standalone defense builds cross-round state; OutlierDetection
+        # re-calls renew_cache afterwards with the 3-sigma-confirmed set,
+        # which simply overwrites with better information.
+        self.renew_cache(self.potentially_poisoned_worker_list)
         return raw_client_grad_list
 
 
@@ -418,13 +423,20 @@ class WbcDefense(BaseDefenseMethod):
 
 class ThreeSigmaFoolsGoldDefense(ThreeSigmaDefense):
     """3-sigma screening, then FoolsGold similarity reweighting of the
-    survivors (reference three_sigma_defense_foolsgold.py)."""
+    survivors (reference three_sigma_defense_foolsgold.py). Delegates the
+    reweighting to FoolsGoldDefense so its *historical* per-client memory is
+    used — single-round cosine similarity would punish a near-identical
+    benign (IID) cluster and reward a lone attacker."""
+
+    def __init__(self, config: Any):
+        super().__init__(config)
+        from .screening import FoolsGoldDefense
+
+        self._foolsgold = FoolsGoldDefense(config)
 
     def defend_before_aggregation(self, raw_client_grad_list: GradList, extra_auxiliary_info=None) -> GradList:
         kept = super().defend_before_aggregation(raw_client_grad_list, extra_auxiliary_info)
-        x, _ = _stack_flat(kept)
-        wv = np.asarray(foolsgold_weights(x))
-        return [(float(wv[i]) * n if wv[i] > 0 else 1e-9, g) for i, (n, g) in enumerate(kept)]
+        return self._foolsgold.defend_before_aggregation(kept, extra_auxiliary_info)
 
 
 class ThreeSigmaGeoMedianDefense(BaseDefenseMethod):

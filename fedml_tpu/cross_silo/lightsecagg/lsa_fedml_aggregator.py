@@ -1,0 +1,123 @@
+"""LightSecAgg server-side aggregator.
+
+Reference: ``cross_silo/lightsecagg/lsa_fedml_aggregator.py:18`` —
+collects masked finite-field models (add_local_trained_result :72) and
+aggregate-encoded masks (:80), reconstructs the summed mask from U of them
+(aggregate_mask_reconstruction :101) and unmasks + dequantizes the model sum
+(aggregate_model_reconstruction :132). The Lagrange algebra lives in
+``core/mpc/lightsecagg.py``; everything here is bookkeeping.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ...core.mpc.finite_field import (
+    DEFAULT_PRIME,
+    tree_from_finite,
+    unflatten_finite,
+)
+from ...core.mpc.lightsecagg import LightSecAggConfig, decode_aggregate_mask
+
+log = logging.getLogger(__name__)
+
+
+class LightSecAggAggregator:
+    def __init__(self, test_global, train_data_num, client_num, device, args, server_aggregator):
+        self.test_global = test_global
+        self.train_data_num = train_data_num
+        self.client_num = client_num
+        self.device = device
+        self.args = args
+        self.aggregator = server_aggregator
+        self.q_bits = int(getattr(args, "quantize_bits", 16))
+        self.prime = int(getattr(args, "mpc_prime", DEFAULT_PRIME))
+        self.cfg = LightSecAggConfig(
+            num_clients=client_num,
+            target_active=int(getattr(args, "lsa_target_active", client_num)),
+            privacy_guarantee=int(getattr(args, "lsa_privacy_guarantee", max(1, client_num // 2))),
+            prime=self.prime,
+        )
+        self.masked_models: Dict[int, np.ndarray] = {}
+        self.sample_nums: Dict[int, int] = {}
+        self.aggregate_masks: Dict[int, np.ndarray] = {}
+        self.flag_client_model_uploaded: Dict[int, bool] = {}
+        self.flag_client_mask_uploaded: Dict[int, bool] = {}
+
+    # --- model plumbing ---------------------------------------------------
+    def get_global_model_params(self):
+        return self.aggregator.get_model_params()
+
+    def set_global_model_params(self, model_parameters) -> None:
+        self.aggregator.set_model_params(model_parameters)
+
+    # --- first phase: masked model uploads (reference :72-99) ------------
+    def add_local_trained_result(self, index: int, masked_flat, sample_num) -> None:
+        self.masked_models[index] = np.asarray(masked_flat, np.int64)
+        self.sample_nums[index] = int(sample_num)
+        self.flag_client_model_uploaded[index] = True
+
+    def check_whether_all_receive(self) -> bool:
+        return len(self.masked_models) >= self.client_num
+
+    # --- second phase: aggregate-encoded masks (reference :80-99) --------
+    def add_local_aggregate_encoded_mask(self, index: int, aggregate_encoded_mask) -> None:
+        self.aggregate_masks[index] = np.asarray(aggregate_encoded_mask, np.int64)
+        self.flag_client_mask_uploaded[index] = True
+
+    def check_whether_all_aggregate_encoded_mask_receive(self) -> bool:
+        return len(self.aggregate_masks) >= self.cfg.target_active
+
+    # --- reconstruction (reference :101-170) ------------------------------
+    def aggregate_model_reconstruction(self) -> Any:
+        active = sorted(self.masked_models.keys())
+        masked_sum = np.zeros_like(next(iter(self.masked_models.values())))
+        for i in active:
+            masked_sum = np.mod(masked_sum + self.masked_models[i], self.prime)
+        d = masked_sum.size
+        agg_mask = decode_aggregate_mask(self.cfg, self.aggregate_masks, d)
+        x_sum = np.mod(masked_sum - agg_mask, self.prime)
+        template = self.get_global_model_params()
+        leaves, treedef = jax.tree.flatten(template)
+        shapes = [np.shape(l) for l in leaves]
+        assert sum(int(np.prod(s)) for s in shapes) == d, (shapes, d)
+        # unflatten while still in GF(p) (unflatten_finite is int64-typed),
+        # then dequantize the sum per leaf and divide by the active count
+        # (the reference divides each dequantized tensor by active_num, :158)
+        finite_tree = unflatten_finite(x_sum, treedef, shapes)
+        avg_tree = tree_from_finite(finite_tree, self.q_bits, self.prime)
+        new_global = jax.tree.map(
+            lambda t, a: (np.asarray(a, np.float32) / float(len(active))).reshape(np.shape(t)),
+            template,
+            avg_tree,
+        )
+        self.set_global_model_params(new_global)
+        self.masked_models.clear()
+        self.aggregate_masks.clear()
+        self.sample_nums.clear()
+        return new_global
+
+    # --- selection + eval (same shape as FedMLAggregator) -----------------
+    def data_silo_selection(self, round_idx: int, client_num_in_total: int, client_num_per_round: int) -> List[int]:
+        from ..server.fedml_aggregator import select_data_silos
+
+        return select_data_silos(round_idx, client_num_in_total, client_num_per_round)
+
+    def client_selection(self, round_idx: int, client_id_list_in_total: List[int], client_num_per_round: int) -> List[int]:
+        from ..server.fedml_aggregator import select_clients
+
+        return select_clients(round_idx, client_id_list_in_total, client_num_per_round)
+
+    def test_on_server_for_all_clients(self, round_idx: int) -> Optional[Dict[str, float]]:
+        if self.test_global is None:
+            return None
+        metrics = self.aggregator.test(self.test_global, self.device, self.args)
+        if metrics is not None:
+            metrics = dict(metrics)
+            metrics["round"] = round_idx
+            log.info("LSA round %d: %s", round_idx, metrics)
+        return metrics

@@ -1,0 +1,118 @@
+"""SecAgg server-side aggregator.
+
+Reference: ``cross_silo/secagg/sa_fedml_aggregator.py`` — wraps
+``core/mpc/secagg.SecAggServer`` per round: collects masked GF(p) uploads,
+reconstructs the survivor sum from the reveal shares, dequantizes and
+installs the average.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ...core.mpc.finite_field import (
+    DEFAULT_PRIME,
+    tree_dimensions,
+    tree_from_finite,
+    unflatten_finite,
+)
+from ...core.mpc.secagg import SecAggConfig, SecAggServer
+
+log = logging.getLogger(__name__)
+
+
+class SecAggAggregator:
+    def __init__(self, test_global, train_data_num, client_num, device, args, server_aggregator):
+        self.test_global = test_global
+        self.train_data_num = train_data_num
+        self.client_num = client_num
+        self.device = device
+        self.args = args
+        self.aggregator = server_aggregator
+        self.q_bits = int(getattr(args, "quantize_bits", 16))
+        self.cfg = SecAggConfig(
+            num_clients=client_num,
+            threshold=int(getattr(args, "secagg_threshold", max(1, client_num // 2))),
+            prime=int(getattr(args, "mpc_prime", DEFAULT_PRIME)),
+        )
+        self.server = SecAggServer(self.cfg)
+        self.sample_nums: Dict[int, int] = {}
+        self.reveals: Dict[int, Any] = {}
+
+    def new_round(self) -> None:
+        self.server = SecAggServer(self.cfg)
+        self.sample_nums.clear()
+        self.reveals.clear()
+
+    # --- model plumbing ---------------------------------------------------
+    def get_global_model_params(self):
+        return self.aggregator.get_model_params()
+
+    def set_global_model_params(self, model_parameters) -> None:
+        self.aggregator.set_model_params(model_parameters)
+
+    # --- phase bookkeeping ------------------------------------------------
+    def register_key(self, cid: int, pk: int) -> None:
+        self.server.register_key(cid, pk)
+
+    def all_keys_received(self) -> bool:
+        return len(self.server.public_keys) >= self.client_num
+
+    def add_masked_model(self, cid: int, y, sample_num) -> None:
+        self.server.submit(cid, np.asarray(y, np.int64))
+        self.sample_nums[cid] = int(sample_num)
+
+    def all_models_received(self) -> bool:
+        return len(self.server.masked) >= self.client_num
+
+    def add_reveal(self, cid: int, reveal) -> None:
+        self.reveals[cid] = reveal
+
+    def all_reveals_received(self) -> bool:
+        return len(self.reveals) >= len(self.server.masked)
+
+    # --- reconstruction ---------------------------------------------------
+    def aggregate_model_reconstruction(self):
+        x_sum = self.server.unmask(self.reveals)
+        n_active = len(self.server.masked)
+        template = self.get_global_model_params()
+        _, d = tree_dimensions(template)
+        assert x_sum.size == d, (x_sum.size, d)
+        leaves, treedef = jax.tree.flatten(template)
+        shapes = [np.shape(l) for l in leaves]
+        # unflatten while still in GF(p) (unflatten_finite is int64-typed),
+        # then dequantize the sum per leaf and divide by the active count
+        finite_tree = unflatten_finite(x_sum, treedef, shapes)
+        avg_tree = tree_from_finite(finite_tree, self.q_bits, self.cfg.prime)
+        new_global = jax.tree.map(
+            lambda t, a: (np.asarray(a, np.float32) / float(n_active)).reshape(np.shape(t)),
+            template,
+            avg_tree,
+        )
+        self.set_global_model_params(new_global)
+        return new_global
+
+    # --- selection + eval -------------------------------------------------
+    def data_silo_selection(self, round_idx: int, client_num_in_total: int, client_num_per_round: int) -> List[int]:
+        from ..server.fedml_aggregator import select_data_silos
+
+        return select_data_silos(round_idx, client_num_in_total, client_num_per_round)
+
+    def client_selection(self, round_idx: int, client_id_list_in_total: List[int], client_num_per_round: int) -> List[int]:
+        from ..server.fedml_aggregator import select_clients
+
+        return select_clients(round_idx, client_id_list_in_total, client_num_per_round)
+
+    def test_on_server_for_all_clients(self, round_idx: int) -> Optional[Dict[str, float]]:
+        if self.test_global is None:
+            return None
+        metrics = self.aggregator.test(self.test_global, self.device, self.args)
+        if metrics is not None:
+            metrics = dict(metrics)
+            metrics["round"] = round_idx
+            log.info("SecAgg round %d: %s", round_idx, metrics)
+        return metrics

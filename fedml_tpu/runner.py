@@ -64,6 +64,21 @@ class FedMLRunner:
     @staticmethod
     def _init_cross_silo_runner(args, device, dataset, model, client_trainer, server_aggregator):
         role = getattr(args, "role", "client")
+        secure = str(getattr(args, "secure_aggregation", "") or "").lower()
+        if secure in ("lightsecagg", "lsa"):
+            # reference: cross_silo/lightsecagg/lsa_fedml_api.py FedML_LSA_Horizontal
+            from .cross_silo import lightsecagg as lsa
+
+            if role == "client":
+                return lsa.Client(args, device, dataset, model, model_trainer=client_trainer)
+            return lsa.Server(args, device, dataset, model, server_aggregator=server_aggregator)
+        if secure in ("secagg", "sa"):
+            # reference: cross_silo/secagg/sa_fedml_api.py FedML_SA_Horizontal
+            from .cross_silo import secagg as sa
+
+            if role == "client":
+                return sa.Client(args, device, dataset, model, model_trainer=client_trainer)
+            return sa.Server(args, device, dataset, model, server_aggregator=server_aggregator)
         if role == "client":
             from .cross_silo.fedml_client import FedMLCrossSiloClient
 

@@ -33,17 +33,34 @@ log = logging.getLogger(__name__)
 class AsyncFedAvgAPI(FedAvgAPI):
     _warned_agg_defense = False
 
-    def _warn_on_aggregation_defense_unsupported(self) -> None:
+    class _defender_disabled:
+        """Cohort defenses (aggregation rules, paired before/after
+        re-centering like CClip) are undefined on a single async arrival —
+        applying them would silently no-op or diverge. Disable the defender
+        around the per-arrival hooks; DP/FHE/attacker hooks still run."""
+
+        def __enter__(self):
+            from ...core.security.fedml_defender import FedMLDefender
+
+            self.defender = FedMLDefender.get_instance()
+            self.was_enabled = self.defender.is_enabled
+            self.defender.is_enabled = False
+            return self
+
+        def __exit__(self, *exc):
+            self.defender.is_enabled = self.was_enabled
+            return False
+
+    def _warn_defenses_unsupported(self) -> None:
         if AsyncFedAvgAPI._warned_agg_defense:
             return
         from ...core.security.fedml_defender import FedMLDefender
-        from ...core.security.defense.defense_base import BaseDefenseMethod
 
         defender = FedMLDefender.get_instance()
-        if defender.is_defense_enabled() and type(defender.defender).defend_on_aggregation is not BaseDefenseMethod.defend_on_aggregation:
+        if defender.is_defense_enabled():
             log.warning(
-                "async FedAvg mixes one update at a time: %s's defend_on_aggregation "
-                "(cohort aggregation rule) is NOT applied; only before/after hooks run",
+                "async FedAvg mixes one update at a time: cohort defense %s "
+                "cannot apply to single arrivals and is DISABLED for this run",
                 type(defender.defender).__name__,
             )
         AsyncFedAvgAPI._warned_agg_defense = True
@@ -89,21 +106,20 @@ class AsyncFedAvgAPI(FedAvgAPI):
             )
             w_local = client.train(dispatched_w.pop(ev_seq))
             # each arrival is one aggregation event: run the before/after
-            # alg-frame hooks (screening, DP clip, central noise, FHE).
-            # defend_on_aggregation defenses (median/trimmed-mean/...) need a
-            # cohort and cannot apply to a single async arrival — warn once.
-            self._warn_on_aggregation_defense_unsupported()
+            # alg-frame hooks for DP clip / central noise / FHE. Cohort
+            # defenses are disabled (see _defender_disabled).
+            self._warn_defenses_unsupported()
             sample_num = float(self.train_data_local_num_dict[client_idx])
-            hooked = self.aggregator.on_before_aggregation([(sample_num, w_local)])
-            if not hooked:
-                # screening rejected this update; keep the worker busy
-                dispatch(int(rng.randint(n_total)), now)
-                continue
-            w_local = hooked[0][1]
-            staleness = version - started_version
-            a_t = alpha * (staleness + 1.0) ** (-poly_a)
-            w_global = jax.tree.map(lambda g, l: (1.0 - a_t) * g + a_t * l, w_global, w_local)
-            w_global = self.aggregator.on_after_aggregation(w_global)
+            with self._defender_disabled():
+                hooked = self.aggregator.on_before_aggregation([(sample_num, w_local)])
+                if not hooked:
+                    dispatch(int(rng.randint(n_total)), now)
+                    continue
+                w_local = hooked[0][1]
+                staleness = version - started_version
+                a_t = alpha * (staleness + 1.0) ** (-poly_a)
+                w_global = jax.tree.map(lambda g, l: (1.0 - a_t) * g + a_t * l, w_global, w_local)
+                w_global = self.aggregator.on_after_aggregation(w_global)
             version += 1
             processed += 1
             if processed % in_flight == 0:

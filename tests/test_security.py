@@ -144,14 +144,17 @@ def test_wbc_perturbs_only_flat_space():
     assert np.all(np.isfinite(np.asarray(agg2["w"])))
 
 
-def test_backdoor_attack_stays_within_band():
+def test_backdoor_attack_submits_in_band_harmful_update():
     lst, _ = _cohort(k=6, outlier_scale=1.0)
-    # attacker initially far outside the benign band
-    lst[0] = (10.0, jax.tree.map(lambda x: x + 100.0, lst[0][1]))
     out = BackdoorAttack(_cfg(backdoor_client_num=1, num_std=1.5)).attack_model(lst)
-    stacked = jnp.stack([w["w"] for _, w in lst])
-    mean, std = jnp.mean(stacked, axis=0), jnp.std(stacked, axis=0)
-    assert bool(jnp.all(out[0][1]["w"] <= mean + 1.5 * std + 1e-5))
+    benign = jnp.stack([w["w"] for _, w in lst[1:]])
+    mean, std = jnp.mean(benign, axis=0), jnp.std(benign, axis=0)
+    atk = out[0][1]["w"]
+    # exactly mean - z*std: inside the plausible band but not the mean
+    np.testing.assert_allclose(np.asarray(atk), np.asarray(mean - 1.5 * std), rtol=1e-5)
+    assert not np.allclose(np.asarray(atk), np.asarray(mean))
+    # benign updates untouched
+    np.testing.assert_allclose(np.asarray(out[1][1]["w"]), np.asarray(lst[1][1]["w"]))
 
 
 def test_edge_case_backdoor_poisons_percentage():
