@@ -1,0 +1,189 @@
+"""Programmatic API surface.
+
+Reference: ``python/fedml/api/__init__.py:29-283`` — the stable functions the
+CLI (and user scripts) call: job launch/status/stop, package build, env
+collection, model build. Cloud-only verbs (cluster marketplace, storage
+upload to MLOps S3) are represented by their local-scheduler equivalents;
+anything that would need WAN egress raises a clear error instead of
+half-working.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import sys
+from typing import Any, Dict, List, Optional
+
+
+# --- launch (reference api/__init__.py:43 launch_job) ----------------------
+
+def _launch_manager(num_edges: int = 1):
+    """Singleton manager: launch and stop must see the SAME edge runners or
+    job_stop has no process table to act on."""
+    from ..computing.scheduler.launch_manager import FedMLLaunchManager
+
+    manager = FedMLLaunchManager.get_instance()
+    while len(manager.edges) < num_edges:
+        # grow the local pool on demand
+        import os
+
+        from ..computing.scheduler.agents import FedMLClientRunner
+
+        i = len(manager.edges)
+        manager.edges[i] = FedMLClientRunner(i, base_dir=os.path.join(manager.base_dir, f"edge_{i}"))
+    return manager
+
+
+def launch_job(yaml_file: str, num_edges: int = 1, timeout_s: float = 600.0) -> Dict[int, Any]:
+    """Parse job yaml, build its package, dispatch onto local edge agents and
+    wait for completion statuses (reference launch_job -> FedMLLaunchManager)."""
+    return _launch_manager(num_edges).launch_job(yaml_file, timeout_s=timeout_s)
+
+
+def job_stop(run_id: str) -> None:
+    for edge in _launch_manager().edges.values():
+        edge.callback_stop_train(run_id)
+
+
+# --- build (reference api/__init__.py fedml_build / train build) -----------
+
+def build(workspace: str, dest_package: str, meta: Optional[Dict[str, Any]] = None) -> str:
+    """Zip a training workspace into a dispatchable package (reference:
+    scheduler_entry/build-package flow)."""
+    from ..computing.scheduler.package import build_job_package
+
+    return build_job_package(workspace, dest_package, meta)
+
+
+# --- run a config locally ---------------------------------------------------
+
+def run_config(config_file: str, training_type: Optional[str] = None) -> Any:
+    """`fedml run -cf config.yaml` equivalent: load the YAML and drive the
+    matching runner in this process (reference cli/modules/run.py ultimately
+    spawns exactly this)."""
+    import argparse
+
+    import fedml_tpu as fedml
+
+    # simulation default backend is sp, like fedml.run_simulation()
+    comm_backend = "sp" if (training_type or "simulation") == "simulation" else None
+    ns = argparse.Namespace(
+        yaml_config_file=config_file, rank=0, role="client", run_id="0", local_rank=0, node_rank=0
+    )
+    args = fedml.load_arguments(training_type=training_type, comm_backend=comm_backend, args=ns)
+    args = fedml.init(args)
+    device = fedml.device.get_device(args)
+    dataset, output_dim = fedml.data.load(args)
+    model = fedml.model.create(args, output_dim)
+    return fedml.FedMLRunner(args, device, dataset, model).run()
+
+
+# --- env (reference computing/scheduler/env/collect_env.py) ----------------
+
+def collect_env() -> Dict[str, Any]:
+    info: Dict[str, Any] = {
+        "fedml_tpu_version": _version(),
+        "python": sys.version.split()[0],
+        "platform": platform.platform(),
+        "machine": platform.machine(),
+        "cpu_count": os.cpu_count(),
+    }
+    try:
+        import jax
+
+        info["jax"] = jax.__version__
+        info["jax_backend"] = jax.default_backend()
+        info["devices"] = [str(d) for d in jax.devices()]
+    except Exception as e:  # pragma: no cover - env specific
+        info["jax_error"] = str(e)
+    for mod in ("flax", "optax", "numpy"):
+        try:
+            info[mod] = __import__(mod).__version__
+        except Exception:
+            info[mod] = None
+    return info
+
+
+def _version() -> str:
+    import fedml_tpu
+
+    return getattr(fedml_tpu, "__version__", "0.1.0")
+
+
+# --- diagnosis (reference cli/modules/diagnosis.py) ------------------------
+
+def diagnose(check_backend: bool = True) -> Dict[str, bool]:
+    """Connectivity/function checks that make sense with zero egress: jit a
+    kernel on the default device, round-trip the in-memory broker, round-trip
+    the message codec."""
+    results: Dict[str, bool] = {}
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        out = jax.jit(lambda x: (x @ x.T).sum())(jnp.ones((8, 8)))
+        results["jax_jit"] = bool(out == 64.0 * 8)
+    except Exception:
+        results["jax_jit"] = False
+    if check_backend:
+        try:
+            from ..core.distributed.communication.inmemory.broker import InMemoryBroker
+            from ..core.distributed.communication.message import Message
+
+            InMemoryBroker.reset("diag")
+            broker = InMemoryBroker.get("diag")
+            broker.publish(0, Message(1, 1, 0))
+            results["inmemory_broker"] = broker.queue_for(0).get(timeout=1.0) is not None
+            InMemoryBroker.reset("diag")
+        except Exception:
+            results["inmemory_broker"] = False
+        try:
+            from ..core.distributed.communication.codec import message_from_bytes, message_to_bytes
+            from ..core.distributed.communication.message import Message
+
+            m = Message(2, 0, 1)
+            m.add_params("k", 1)
+            results["message_codec"] = message_from_bytes(message_to_bytes(m)).get("k") == 1
+        except Exception:
+            results["message_codec"] = False
+    return results
+
+
+# --- model helpers (reference api model_* subset) ---------------------------
+
+MODEL_NAMES = [
+    "lr", "mlp", "cnn", "cnn_cifar", "rnn", "rnn_stackoverflow", "resnet56",
+    "resnet20", "resnet18_gn", "mobilenet", "mobilenet_v3", "efficientnet",
+    "gan", "darts", "transformer",
+]
+
+
+def model_list() -> List[str]:
+    """Model zoo names (the `create` dispatch table in models/model_hub.py:73)."""
+    return sorted(MODEL_NAMES)
+
+
+_DATASET_CLASSES = {
+    "mnist": 10, "fashion_mnist": 10, "femnist": 62, "cifar10": 10, "cinic10": 10,
+    "cifar100": 100, "fed_cifar100": 100, "shakespeare": 90, "fed_shakespeare": 90,
+    "stackoverflow_nwp": 10004,
+}
+
+
+def model_create(model_name: str, dataset: str = "mnist", output_path: Optional[str] = None) -> str:
+    """Instantiate a zoo model and write its parameter pytree checkpoint
+    (reference: `fedml model create` + local cards)."""
+    import numpy as np
+
+    import fedml_tpu as fedml
+    from ..arguments import default_config
+
+    args = default_config("simulation", model=model_name, dataset=dataset)
+    model = fedml.model.create(args, _DATASET_CLASSES.get(dataset.lower(), 10))
+    out = output_path or f"{model_name}.npz"
+    import jax
+
+    leaves = {f"p{i}": np.asarray(l) for i, l in enumerate(jax.tree.leaves(model.params))}
+    np.savez(out, **leaves)
+    return out

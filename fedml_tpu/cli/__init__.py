@@ -1,0 +1,5 @@
+"""CLI package (reference: python/fedml/cli/)."""
+
+from .cli import cli
+
+__all__ = ["cli"]

@@ -1,0 +1,175 @@
+"""`fedml_tpu` CLI.
+
+Reference: ``python/fedml/cli/cli.py:11-77`` — a click group whose
+subcommands call only the api layer. Cloud-bound subcommands (login, cluster
+marketplace, storage) exist with an explicit offline message instead of a
+broken half-implementation: this environment has zero egress, and the local
+scheduler covers the launch/run/build/logs paths end-to-end.
+
+Invoke as ``python -m fedml_tpu.cli <cmd>`` (or the console script when the
+package is installed).
+"""
+
+from __future__ import annotations
+
+import json
+
+import click
+
+from .. import api
+
+
+@click.group()
+@click.help_option("--help", "-h")
+def cli() -> None:
+    """fedml_tpu: TPU-native federated/distributed ML."""
+
+
+# --- launch (reference cli/modules/launch.py) -------------------------------
+
+@cli.command("launch", help="Launch a job.yaml onto local edge agents")
+@click.argument("yaml_file", type=click.Path(exists=True))
+@click.option("--edges", "-e", default=1, type=int, help="number of local edge agents")
+@click.option("--timeout", "-t", default=600.0, type=float)
+def fedml_launch(yaml_file: str, edges: int, timeout: float) -> None:
+    statuses = api.launch_job(yaml_file, num_edges=edges, timeout_s=timeout)
+    for edge_id, st in sorted(statuses.items()):
+        click.echo(f"edge {edge_id}: {getattr(st, 'status', st)}")
+
+
+# --- run (reference cli/modules/run.py) -------------------------------------
+
+@cli.command("run", help="Run a training config in this process")
+@click.option("--cf", "config_file", required=True, type=click.Path(exists=True))
+@click.option("--training-type", default=None, help="simulation|cross_silo|cross_device|cross_cloud")
+def fedml_run(config_file: str, training_type: str) -> None:
+    out = api.run_config(config_file, training_type=training_type)
+    click.echo(json.dumps(out, default=str))
+
+
+# --- build (reference cli/modules/build.py) ---------------------------------
+
+@cli.command("build", help="Package a workspace into a dispatchable zip")
+@click.option("--source", "-s", "workspace", required=True, type=click.Path(exists=True))
+@click.option("--dest", "-d", "dest_package", required=True, type=click.Path())
+def fedml_build(workspace: str, dest_package: str) -> None:
+    click.echo(api.build(workspace, dest_package))
+
+
+@cli.command("train", help="Alias of `build` for training workspaces (reference cli/modules/train.py)")
+@click.option("--source", "-s", "workspace", required=True, type=click.Path(exists=True))
+@click.option("--dest", "-d", "dest_package", required=True, type=click.Path())
+def fedml_train(workspace: str, dest_package: str) -> None:
+    click.echo(api.build(workspace, dest_package, meta={"job_type": "train"}))
+
+
+@cli.command("federate", help="Alias of `build` for federated workspaces (reference cli/modules/federate.py)")
+@click.option("--source", "-s", "workspace", required=True, type=click.Path(exists=True))
+@click.option("--dest", "-d", "dest_package", required=True, type=click.Path())
+def fedml_federate(workspace: str, dest_package: str) -> None:
+    click.echo(api.build(workspace, dest_package, meta={"job_type": "federate"}))
+
+
+# --- env / version / diagnosis ---------------------------------------------
+
+@cli.command("env", help="Show versions, hardware and accelerator info")
+def fedml_env() -> None:
+    click.echo(json.dumps(api.collect_env(), indent=2))
+
+
+@cli.command("version", help="Show library version")
+def fedml_version() -> None:
+    click.echo(f"fedml_tpu version: {api._version()}")
+
+
+@cli.command("diagnosis", help="Check jit, broker, and codec health")
+@click.option("--no-backend", is_flag=True, default=False)
+def fedml_diagnosis(no_backend: bool) -> None:
+    results = api.diagnose(check_backend=not no_backend)
+    for k, ok in results.items():
+        click.echo(f"{k}: {'OK' if ok else 'FAILED'}")
+    if not all(results.values()):
+        raise SystemExit(1)
+
+
+# --- model (reference cli/modules/model.py subset) --------------------------
+
+@cli.group("model", help="Model zoo helpers")
+def fedml_model() -> None:
+    pass
+
+
+@fedml_model.command("list", help="List model zoo entries")
+def model_list_cmd() -> None:
+    for name in api.model_list():
+        click.echo(name)
+
+
+@fedml_model.command("create", help="Instantiate a zoo model and save its params")
+@click.option("--name", "-n", required=True)
+@click.option("--dataset", default="mnist")
+@click.option("--output", "-o", "output_path", default=None, type=click.Path())
+def model_create_cmd(name: str, dataset: str, output_path: str) -> None:
+    click.echo(api.model_create(name, dataset=dataset, output_path=output_path))
+
+
+# --- logs (reference cli/modules/logs.py) -----------------------------------
+
+@cli.command("logs", help="Show the tail of a run's log file")
+@click.option("--run-id", default="0")
+@click.option("--lines", "-n", default=50, type=int)
+def fedml_logs(run_id: str, lines: int) -> None:
+    from ..mlops.runtime_log import log_file_path
+
+    path = log_file_path(run_id)
+    try:
+        with open(path, "r") as f:
+            for line in f.readlines()[-lines:]:
+                click.echo(line.rstrip())
+    except FileNotFoundError:
+        click.echo(f"no log file at {path}")
+
+
+# --- cloud-only verbs: explicit offline stubs -------------------------------
+
+_OFFLINE_MSG = (
+    "this deployment is offline-first: the MLOps cloud backend is not "
+    "configured. The local scheduler covers launch/run/build/logs."
+)
+
+
+@cli.command("login", help="(cloud) bind this device to the MLOps platform")
+@click.argument("api_key", required=False)
+def fedml_login(api_key: str) -> None:
+    raise click.ClickException(_OFFLINE_MSG)
+
+
+@cli.command("logout", help="(cloud) unbind this device")
+def fedml_logout() -> None:
+    raise click.ClickException(_OFFLINE_MSG)
+
+
+@cli.command("cluster", help="(cloud) manage GPU/TPU clusters")
+def fedml_cluster() -> None:
+    raise click.ClickException(_OFFLINE_MSG)
+
+
+@cli.command("storage", help="(cloud) manage remote storage")
+def fedml_storage() -> None:
+    raise click.ClickException(_OFFLINE_MSG)
+
+
+@cli.command("device", help="Bind/unbind local edge agents")
+@click.option("--bind", "action", flag_value="bind", default=True)
+@click.option("--unbind", "action", flag_value="unbind")
+def fedml_device(action: str) -> None:
+    # local agents need no registration; report their ids for parity with
+    # `fedml device bind` output
+    from ..computing.scheduler.launch_manager import FedMLLaunchManager
+
+    manager = FedMLLaunchManager.get_instance()
+    click.echo(f"{action}: local edges {sorted(manager.edges)}")
+
+
+if __name__ == "__main__":
+    cli()

@@ -17,6 +17,16 @@ import time
 from typing import Callable, List, Optional
 
 
+def default_run_dir(run_id: str) -> str:
+    """~/.fedml_tpu/logs/run_<id> — the layout start_log_daemon writes
+    (mlops/__init__.py:216); the local analogue of ~/.fedml/.../logs."""
+    return os.path.join(os.path.expanduser("~"), ".fedml_tpu", "logs", f"run_{run_id}")
+
+
+def log_file_path(run_id: str, rank: int = 0, run_dir: Optional[str] = None) -> str:
+    return os.path.join(run_dir or default_run_dir(run_id), f"fedml-run-{run_id}-rank-{rank}.log")
+
+
 class MLOpsRuntimeLog:
     """Attach a per-run FileHandler to the root logger."""
 
@@ -25,7 +35,7 @@ class MLOpsRuntimeLog:
     @classmethod
     def init(cls, run_dir: str, run_id: str, rank: int = 0) -> str:
         os.makedirs(run_dir, exist_ok=True)
-        path = os.path.join(run_dir, f"fedml-run-{run_id}-rank-{rank}.log")
+        path = log_file_path(run_id, rank, run_dir)
         key = (run_id, rank)
         if key not in cls._handlers:
             h = logging.FileHandler(path)

@@ -1,0 +1,100 @@
+"""CLI + api surface tests (reference: cli/cli.py registers the subcommands;
+its CI only smoke-runs them — here each local-capable verb is executed)."""
+
+import json
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from fedml_tpu import api
+from fedml_tpu.cli import cli
+
+
+@pytest.fixture()
+def runner():
+    return CliRunner()
+
+
+def test_version_and_env(runner):
+    out = runner.invoke(cli, ["version"])
+    assert out.exit_code == 0 and "fedml_tpu version" in out.output
+    out = runner.invoke(cli, ["env"])
+    assert out.exit_code == 0
+    info = json.loads(out.output)
+    assert info["python"] and info["cpu_count"] >= 1
+
+
+def test_diagnosis(runner):
+    out = runner.invoke(cli, ["diagnosis"])
+    assert out.exit_code == 0, out.output
+    assert "jax_jit: OK" in out.output
+    assert "inmemory_broker: OK" in out.output
+
+
+def test_model_list_and_create(runner, tmp_path):
+    out = runner.invoke(cli, ["model", "list"])
+    assert out.exit_code == 0 and "lr" in out.output and "transformer" in out.output
+    dest = tmp_path / "lr.npz"
+    out = runner.invoke(cli, ["model", "create", "-n", "lr", "-o", str(dest)])
+    assert out.exit_code == 0, out.output
+    arrs = np.load(dest)
+    assert len(arrs.files) >= 2
+
+
+def test_build_and_launch(runner, tmp_path):
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    (ws / "main.py").write_text("print('hello from job')\n")
+    pkg = tmp_path / "pkg.zip"
+    out = runner.invoke(cli, ["build", "-s", str(ws), "-d", str(pkg)])
+    assert out.exit_code == 0 and pkg.exists()
+
+    job_yaml = tmp_path / "job.yaml"
+    job_yaml.write_text(f"workspace: ws\njob: python main.py\n")
+    out = runner.invoke(cli, ["launch", str(job_yaml), "--timeout", "120"])
+    assert out.exit_code == 0, out.output
+    assert "edge 0" in out.output
+
+
+def test_run_config(runner, tmp_path):
+    cf = tmp_path / "fedml_config.yaml"
+    cf.write_text(
+        """
+common_args:
+  training_type: simulation
+  random_seed: 0
+data_args:
+  dataset: mnist
+model_args:
+  model: lr
+train_args:
+  federated_optimizer: FedAvg
+  client_num_in_total: 2
+  client_num_per_round: 2
+  comm_round: 1
+  epochs: 1
+  batch_size: 32
+  learning_rate: 0.03
+validation_args:
+  frequency_of_the_test: 1
+"""
+    )
+    out = runner.invoke(cli, ["run", "--cf", str(cf), "--training-type", "simulation"])
+    assert out.exit_code == 0, out.output
+    result = json.loads(out.output.splitlines()[-1])
+    assert "test_acc" in result
+
+
+def test_offline_verbs_fail_clearly(runner):
+    for verb in ("login", "logout", "cluster", "storage"):
+        out = runner.invoke(cli, [verb])
+        assert out.exit_code != 0
+        assert "offline" in out.output
+
+
+def test_api_collect_env_and_diagnose():
+    info = api.collect_env()
+    assert "jax" in info
+    checks = api.diagnose()
+    assert all(checks.values()), checks
