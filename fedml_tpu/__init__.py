@@ -123,6 +123,26 @@ def run_cross_silo_client(args: Optional[Any] = None):
     return _run_cross_silo("client", args)
 
 
+def _run_cross_cloud(role: str, args: Optional[Any] = None):
+    """Reference: launch_cross_cloud.py:8 — Cheetah entry."""
+    args = args or load_arguments(training_type=constants.FEDML_TRAINING_PLATFORM_CROSS_CLOUD)
+    args.training_type = constants.FEDML_TRAINING_PLATFORM_CROSS_CLOUD
+    args.role = role
+    args = init(args)
+    dev = device.get_device(args)
+    dataset, output_dim = data.load(args)
+    mdl = model.create(args, output_dim)
+    return FedMLRunner(args, dev, dataset, mdl).run()
+
+
+def run_cross_cloud_server(args: Optional[Any] = None):
+    return _run_cross_cloud("server", args)
+
+
+def run_cross_cloud_client(args: Optional[Any] = None):
+    return _run_cross_cloud("client", args)
+
+
 def run_hierarchical_cross_silo_server(args: Optional[Any] = None):
     """Reference: launch_cross_silo_hi.py — same managers, hierarchical scenario."""
     if args is not None:
@@ -141,6 +161,8 @@ __all__ = [
     "run_simulation",
     "run_cross_silo_server",
     "run_cross_silo_client",
+    "run_cross_cloud_server",
+    "run_cross_cloud_client",
     "run_hierarchical_cross_silo_server",
     "run_hierarchical_cross_silo_client",
     "FedMLRunner",

@@ -66,12 +66,17 @@ def run_config(config_file: str, training_type: Optional[str] = None) -> Any:
 
     import fedml_tpu as fedml
 
-    # simulation default backend is sp, like fedml.run_simulation()
-    comm_backend = "sp" if (training_type or "simulation") == "simulation" else None
     ns = argparse.Namespace(
         yaml_config_file=config_file, rank=0, role="client", run_id="0", local_rank=0, node_rank=0
     )
-    args = fedml.load_arguments(training_type=training_type, comm_backend=comm_backend, args=ns)
+    args = fedml.load_arguments(training_type=training_type, args=ns)
+    if training_type:
+        # the YAML's common_args.training_type loads after the kwarg; the
+        # explicit flag wins (same re-assert the run_* entry points do)
+        args.training_type = training_type
+    if (getattr(args, "training_type", None) or "simulation") == "simulation" and not getattr(args, "backend", None):
+        # simulation default backend is sp, like fedml.run_simulation()
+        args.backend = "sp"
     args = fedml.init(args)
     device = fedml.device.get_device(args)
     dataset, output_dim = fedml.data.load(args)

@@ -1,0 +1,5 @@
+"""Centralized (non-FL) baseline trainer (reference: python/fedml/centralized/)."""
+
+from .centralized_trainer import CentralizedTrainer
+
+__all__ = ["CentralizedTrainer"]

@@ -118,10 +118,12 @@ def model_create_cmd(name: str, dataset: str, output_path: str) -> None:
 @cli.command("logs", help="Show the tail of a run's log file")
 @click.option("--run-id", default="0")
 @click.option("--lines", "-n", default=50, type=int)
-def fedml_logs(run_id: str, lines: int) -> None:
+@click.option("--log-dir", default=None, type=click.Path(),
+              help="override when the run used tracking_args log_file_dir")
+def fedml_logs(run_id: str, lines: int, log_dir: str) -> None:
     from ..mlops.runtime_log import log_file_path
 
-    path = log_file_path(run_id)
+    path = log_file_path(run_id, run_dir=log_dir)
     try:
         with open(path, "r") as f:
             for line in f.readlines()[-lines:]:

@@ -1,0 +1,37 @@
+"""Cross-cloud (Cheetah) runtime.
+
+Reference: ``python/fedml/cross_cloud/`` — structurally a clone of the
+cross-silo manager pair with its own message defines, aimed at distributed
+training across cloud regions (including the FedLLM fine-tune path,
+``train/llm/``). The TPU build composes rather than clones: the managers are
+the cross-silo ones (same WAN state machine; DCN/WAN transport is chosen by
+``args.backend``).
+
+For federated LLM fine-tuning (reference spotlight_prj/fedllm), pass
+``train.llm.fed_llm_trainer.LLMClientTrainer`` explicitly as the client
+trainer *and* an adapter-aware server aggregator — adapter-only pytrees and
+full zoo-model pytrees are not interchangeable, so there is deliberately no
+automatic routing here (half of it on one side would crash the first
+broadcast). ``tests/test_llm.py`` shows the wiring.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..cross_silo.fedml_client import FedMLCrossSiloClient
+from ..cross_silo.fedml_server import FedMLCrossSiloServer
+
+
+class FedMLCrossCloudClient(FedMLCrossSiloClient):
+    """Reference: cross_cloud/fedml_client.py:5 (same manager stack)."""
+
+
+class FedMLCrossCloudServer(FedMLCrossSiloServer):
+    """Reference: cross_cloud/fedml_server.py:5 (same manager stack)."""
+
+
+Client = FedMLCrossCloudClient
+Server = FedMLCrossCloudServer
+
+__all__ = ["Client", "Server", "FedMLCrossCloudClient", "FedMLCrossCloudServer"]

@@ -91,8 +91,21 @@ class FedMLRunner:
 
     @staticmethod
     def _init_cross_cloud_runner(args, device, dataset, model, client_trainer, server_aggregator):
-        # Cheetah shares the cross-silo manager shape (reference runner.py:118)
-        return FedMLRunner._init_cross_silo_runner(args, device, dataset, model, client_trainer, server_aggregator)
+        # Cheetah: cross-silo manager shape (reference runner.py:118
+        # _init_cheetah_runner); secure-aggregation routing shared with
+        # cross-silo so secagg/lightsecagg apply across clouds too
+        if str(getattr(args, "secure_aggregation", "") or ""):
+            return FedMLRunner._init_cross_silo_runner(
+                args, device, dataset, model, client_trainer, server_aggregator
+            )
+        from . import cross_cloud
+
+        role = getattr(args, "role", "client")
+        if role == "client":
+            return cross_cloud.Client(args, device, dataset, model, client_trainer)
+        if role == "server":
+            return cross_cloud.Server(args, device, dataset, model, server_aggregator)
+        raise ValueError(f"unknown role {role!r}")
 
     @staticmethod
     def _init_cross_device_runner(args, device, dataset, model, server_aggregator):
