@@ -103,15 +103,20 @@ def run_simulation(backend: str = constants.FEDML_SIMULATION_TYPE_SP, args: Opti
     return runner.run()
 
 
-def _run_cross_silo(role: str, args: Optional[Any] = None):
-    args = args or load_arguments(training_type=FEDML_TRAINING_PLATFORM_CROSS_SILO)
-    args.training_type = FEDML_TRAINING_PLATFORM_CROSS_SILO
+def _run_platform(training_type: str, role: str, args: Optional[Any] = None):
+    """Shared launch body for the role-based platforms (cross-silo/cloud)."""
+    args = args or load_arguments(training_type=training_type)
+    args.training_type = training_type
     args.role = role
     args = init(args)
     dev = device.get_device(args)
     dataset, output_dim = data.load(args)
     mdl = model.create(args, output_dim)
     return FedMLRunner(args, dev, dataset, mdl).run()
+
+
+def _run_cross_silo(role: str, args: Optional[Any] = None):
+    return _run_platform(FEDML_TRAINING_PLATFORM_CROSS_SILO, role, args)
 
 
 def run_cross_silo_server(args: Optional[Any] = None):
@@ -125,14 +130,7 @@ def run_cross_silo_client(args: Optional[Any] = None):
 
 def _run_cross_cloud(role: str, args: Optional[Any] = None):
     """Reference: launch_cross_cloud.py:8 — Cheetah entry."""
-    args = args or load_arguments(training_type=constants.FEDML_TRAINING_PLATFORM_CROSS_CLOUD)
-    args.training_type = constants.FEDML_TRAINING_PLATFORM_CROSS_CLOUD
-    args.role = role
-    args = init(args)
-    dev = device.get_device(args)
-    dataset, output_dim = data.load(args)
-    mdl = model.create(args, output_dim)
-    return FedMLRunner(args, dev, dataset, mdl).run()
+    return _run_platform(constants.FEDML_TRAINING_PLATFORM_CROSS_CLOUD, role, args)
 
 
 def run_cross_cloud_server(args: Optional[Any] = None):

@@ -1,0 +1,13 @@
+"""Beehive: cross-device FL (reference: python/fedml/cross_device/).
+
+Server in Python (``ServerEdge`` ~ reference ``ServerMNN``), clients are the
+native C++ edge engine under ``native/edge`` (~ reference MobileNN), bridged
+via ctypes instead of JNI. Model exchange uses the dense-model blob
+(codec.py) in place of serialized MNN graphs.
+"""
+
+from .server import EdgeAggregator, ServerEdge
+
+ServerMNN = ServerEdge  # reference-compatible alias (mnn_server.py:6)
+
+__all__ = ["ServerEdge", "ServerMNN", "EdgeAggregator"]

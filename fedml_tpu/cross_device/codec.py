@@ -1,0 +1,95 @@
+"""Dense-model blob codec.
+
+The wire format Beehive edges and the server share (reference analogue: the
+.mnn model file read/averaged/written by
+``cross_device/server_mnn/fedml_aggregator.py:200-243``). Layout documented
+in ``native/edge/include/fedml_edge/dense_model.h``:
+
+  int32 magic "FEDT" | int32 n_layers | per layer int32 in,out |
+  float32 W0 (in x out row-major), b0, W1, b1, ...
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+MAGIC = 0x46454454
+
+
+def params_to_blob(params: List[Dict[str, np.ndarray]]) -> bytes:
+    """params: [{"w": [in, out], "b": [out]}, ...] -> blob bytes."""
+    header = [struct.pack("<ii", MAGIC, len(params))]
+    payload = []
+    for layer in params:
+        w, b = np.asarray(layer["w"], np.float32), np.asarray(layer["b"], np.float32)
+        assert w.ndim == 2 and b.shape == (w.shape[1],), (w.shape, b.shape)
+        header.append(struct.pack("<ii", w.shape[0], w.shape[1]))
+        payload.append(w.tobytes(order="C"))
+        payload.append(b.tobytes())
+    return b"".join(header + payload)
+
+
+def blob_to_params(blob: bytes) -> List[Dict[str, np.ndarray]]:
+    magic, n_layers = struct.unpack_from("<ii", blob, 0)
+    if magic != MAGIC:
+        raise ValueError(f"bad model blob magic {magic:#x}")
+    dims: List[Tuple[int, int]] = []
+    off = 8
+    for _ in range(n_layers):
+        in_dim, out_dim = struct.unpack_from("<ii", blob, off)
+        off += 8
+        dims.append((in_dim, out_dim))
+    layers = []
+    for in_dim, out_dim in dims:
+        w = np.frombuffer(blob, np.float32, in_dim * out_dim, off).reshape(in_dim, out_dim)
+        off += 4 * in_dim * out_dim
+        b = np.frombuffer(blob, np.float32, out_dim, off)
+        off += 4 * out_dim
+        layers.append({"w": w.copy(), "b": b.copy()})
+    return layers
+
+
+def params_to_flat(params: List[Dict[str, np.ndarray]]) -> np.ndarray:
+    """Flat order must match DenseModel::flatten (W0, b0, W1, b1, ...)."""
+    pieces = []
+    for layer in params:
+        pieces.append(np.asarray(layer["w"], np.float32).reshape(-1))
+        pieces.append(np.asarray(layer["b"], np.float32).reshape(-1))
+    return np.concatenate(pieces)
+
+
+def flat_to_params(flat: np.ndarray, template: List[Dict[str, np.ndarray]]) -> List[Dict[str, np.ndarray]]:
+    out, off = [], 0
+    for layer in template:
+        w = np.asarray(layer["w"])
+        b = np.asarray(layer["b"])
+        nw, nb = w.size, b.size
+        out.append({
+            "w": np.asarray(flat[off : off + nw], np.float32).reshape(w.shape),
+            "b": np.asarray(flat[off + nw : off + nw + nb], np.float32).reshape(b.shape),
+        })
+        off += nw + nb
+    return out
+
+
+def dense_forward(params: List[Dict[str, np.ndarray]], x: np.ndarray) -> np.ndarray:
+    """Numpy forward pass matching FedMLDenseTrainer (ReLU hidden, linear head)
+    — the server-side eval of aggregated edge models (reference
+    test_on_server_for_all_clients_mnn, server_mnn/fedml_aggregator.py:222)."""
+    h = np.asarray(x, np.float32).reshape(len(x), -1)
+    for i, layer in enumerate(params):
+        h = h @ np.asarray(layer["w"], np.float32) + np.asarray(layer["b"], np.float32)
+        if i + 1 < len(params):
+            h = np.maximum(h, 0.0)
+    return h
+
+
+def dataset_to_bytes(x: np.ndarray, y: np.ndarray, num_classes: int) -> bytes:
+    """Binary data file for the native engine (DataSet::load)."""
+    x = np.asarray(x, np.float32).reshape(len(x), -1)
+    y = np.asarray(y, np.int32).reshape(-1)
+    header = struct.pack("<iii", len(x), x.shape[1], num_classes)
+    return header + x.tobytes(order="C") + y.tobytes()

@@ -1,0 +1,225 @@
+"""Beehive: cross-device FL server + native edge clients.
+
+Reference: ``python/fedml/cross_device/`` — ``ServerMNN`` (mnn_server.py:6)
+runs a Python server whose clients are native mobile apps exchanging
+serialized model files; ``server_mnn/fedml_aggregator.py`` reads the files,
+averages, writes back, and evaluates on the server's test set (:200-243).
+
+Here the serialized artifact is the dense-model blob (codec.py) and the
+native client is the C++ engine driven over ctypes (native_bridge.py), so
+one process can host a full server + N on-device trainers — the in-process
+seam the reference only gets with real phones. The same `EdgeAggregator` is
+the server half when blobs arrive over a WAN backend instead.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .codec import (
+    blob_to_params,
+    dataset_to_bytes,
+    dense_forward,
+    flat_to_params,
+    params_to_blob,
+    params_to_flat,
+)
+
+log = logging.getLogger(__name__)
+
+
+class EdgeAggregator:
+    """Aggregate serialized edge models (reference
+    server_mnn/fedml_aggregator.py:17 FedMLAggregator)."""
+
+    def __init__(self, template_params: List[Dict[str, np.ndarray]], args: Any):
+        self.template = template_params
+        self.args = args
+        self.blobs: Dict[int, bytes] = {}
+        self.sample_nums: Dict[int, int] = {}
+
+    def add_local_trained_result(self, index: int, blob: bytes, sample_num: int) -> None:
+        self.blobs[index] = blob
+        self.sample_nums[index] = int(sample_num)
+
+    def check_whether_all_receive(self, expected: int) -> bool:
+        return len(self.blobs) >= expected
+
+    def aggregate(self) -> List[Dict[str, np.ndarray]]:
+        """Weighted average in flat space (reference :200-220 reads each MNN
+        file and averages parameter tensors)."""
+        if not self.blobs:
+            raise ValueError("aggregate() with no received edge models; gate on check_whether_all_receive")
+        total = float(sum(self.sample_nums.values())) or 1.0
+        agg = None
+        for idx, blob in self.blobs.items():
+            flat = params_to_flat(blob_to_params(blob))
+            w = self.sample_nums[idx] / total
+            agg = flat * w if agg is None else agg + flat * w
+        self.blobs.clear()
+        self.sample_nums.clear()
+        self.template = flat_to_params(agg, self.template)
+        return self.template
+
+    def test_on_server(self, x: np.ndarray, y: np.ndarray) -> Dict[str, float]:
+        """Reference test_on_server_for_all_clients_mnn (:222-243)."""
+        logits = dense_forward(self.template, x)
+        pred = np.argmax(logits, axis=-1)
+        y = np.asarray(y).reshape(-1)
+        # stable log-softmax cross-entropy
+        mx = logits.max(axis=-1, keepdims=True)
+        logp = logits - mx - np.log(np.exp(logits - mx).sum(axis=-1, keepdims=True))
+        loss = float(-logp[np.arange(len(y)), y].mean())
+        return {
+            "test_acc": float((pred == y).mean()),
+            "test_loss": loss,
+            "test_total": float(len(y)),
+        }
+
+
+class ServerEdge:
+    """Cross-device FL driver: Python server + N native C++ edge trainers.
+
+    Reference: ``ServerMNN`` + the Android clients (§3.5 of the survey). The
+    runner instantiates this for training_type="cross_device"; each round it
+    ships the current blob to every sampled edge, lets the native engine run
+    local SGD on its shard, and aggregates the returned blobs.
+    """
+
+    def __init__(self, args: Any, device, dataset, model, server_aggregator=None):
+        from .native_bridge import NativeEdgeEngine, native_engine_available
+
+        if not native_engine_available():
+            raise RuntimeError(
+                "cross_device requires the native edge engine (make -C native/edge)"
+            )
+        [
+            _train_num, _test_num, _train_global, test_global,
+            train_data_local_num_dict, train_data_local_dict, _test_local, class_num,
+        ] = dataset
+        self.args = args
+        self.class_num = int(class_num)
+        self.test_global = test_global
+        self.rounds = int(getattr(args, "comm_round", 5))
+        self.client_num = int(getattr(args, "client_num_in_total", 2))
+        self.per_round = int(getattr(args, "client_num_per_round", self.client_num))
+        self.epochs = int(getattr(args, "epochs", 1))
+        self.batch_size = int(getattr(args, "batch_size", 32))
+        self.lr = float(getattr(args, "learning_rate", 0.05))
+
+        self._tmpdir = tempfile.TemporaryDirectory(prefix="fedml_tpu_edge_")
+        shards: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        feat_dim: Optional[int] = None
+        for cid in range(self.client_num):
+            data = train_data_local_dict[cid]
+            x, y = (data.x, data.y) if hasattr(data, "x") else data
+            x = np.asarray(x, np.float32).reshape(len(x), -1)
+            feat_dim = x.shape[1]
+            shards[cid] = (x, y)
+        template = self._template_from_model(model, feat_dim)
+        # engine architecture mirrors the template exactly (the model's own
+        # hidden widths, not the edge_hidden_dim knob)
+        dims = [template[0]["w"].shape[0]] + [layer["w"].shape[1] for layer in template]
+        self.engines: Dict[int, NativeEdgeEngine] = {}
+        for cid, (x, y) in shards.items():
+            path = os.path.join(self._tmpdir.name, f"edge_{cid}.bin")
+            with open(path, "wb") as f:
+                f.write(dataset_to_bytes(x, y, self.class_num))
+            self.engines[cid] = NativeEdgeEngine(
+                data_path=path, dataset=str(getattr(args, "dataset", "synthetic")),
+                train_size=len(x), batch_size=self.batch_size,
+                learning_rate=self.lr, epochs=self.epochs,
+                dims=dims, seed=int(getattr(args, "random_seed", 0)),
+            )
+        self.aggregator = EdgeAggregator(template, args)
+        self.sample_nums = {cid: int(train_data_local_num_dict[cid]) for cid in range(self.client_num)}
+        self.final_metrics: Optional[Dict[str, float]] = None
+
+    def run(self) -> Optional[Dict[str, float]]:
+        tx, ty = self._test_arrays()
+        try:
+            for round_idx in range(self.rounds):
+                sampled = self._sample(round_idx)
+                global_flat = params_to_flat(self.aggregator.template)
+                for cid in sampled:
+                    eng = self.engines[cid]
+                    eng.set_model_flat(global_flat)
+                    eng.train()
+                    blob = params_to_blob(flat_to_params(eng.get_model_flat(), self.aggregator.template))
+                    self.aggregator.add_local_trained_result(cid, blob, self.sample_nums[cid])
+                assert self.aggregator.check_whether_all_receive(len(sampled))
+                self.aggregator.aggregate()
+                metrics = self.aggregator.test_on_server(tx, ty)
+                metrics["round"] = round_idx
+                self.final_metrics = metrics
+                log.info("beehive round %d: %s", round_idx, metrics)
+        finally:
+            # shards are resident in the engines after the first epoch
+            self._tmpdir.cleanup()
+        return self.final_metrics
+
+    # --- helpers ----------------------------------------------------------
+    def _template_from_model(self, model, feat_dim: int) -> List[Dict[str, np.ndarray]]:
+        """Honor the model the runner built: a dense-compatible zoo model
+        (lr/mlp — Dense kernels only) seeds the global template with its
+        actual weights. Anything with non-dense layers cannot run on the edge
+        engine — fail loudly instead of silently substituting a random net."""
+        params = getattr(model, "params", None)
+        if params is None:
+            return _init_dense_params(self._dims(feat_dim), seed=int(getattr(self.args, "random_seed", 0)))
+        import jax
+
+        leaves_with_path = jax.tree_util.tree_flatten_with_path(params)[0]
+        kernels = [(p, l) for p, l in leaves_with_path if getattr(l, "ndim", 0) == 2]
+        biases = {str(p): l for p, l in leaves_with_path if getattr(l, "ndim", 0) == 1}
+        if not kernels or any(getattr(l, "ndim", 0) > 2 for _, l in leaves_with_path):
+            raise ValueError(
+                f"cross_device edge engine supports dense models (lr/mlp); "
+                f"model {getattr(model, 'name', type(model).__name__)!r} has non-dense layers"
+            )
+        template = []
+        for path, k in kernels:
+            bias_key = str(path).replace("kernel", "bias")
+            b = biases.get(bias_key)
+            k = np.asarray(k, np.float32)
+            template.append({
+                "w": k,
+                "b": np.asarray(b, np.float32) if b is not None else np.zeros(k.shape[1], np.float32),
+            })
+        if template[0]["w"].shape[0] != feat_dim:
+            raise ValueError(
+                f"model input dim {template[0]['w'].shape[0]} != data dim {feat_dim}"
+            )
+        return template
+
+    def _dims(self, feat_dim: int) -> List[int]:
+        hidden = int(getattr(self.args, "edge_hidden_dim", 0))
+        return [feat_dim, hidden, self.class_num] if hidden > 0 else [feat_dim, self.class_num]
+
+    def _sample(self, round_idx: int) -> List[int]:
+        if self.per_round >= self.client_num:
+            return list(range(self.client_num))
+        rng = np.random.RandomState(round_idx)  # reference seeding (fedavg_api.py:132)
+        return sorted(rng.choice(self.client_num, self.per_round, replace=False).tolist())
+
+    def _test_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        data = self.test_global
+        x, y = (data.x, data.y) if hasattr(data, "x") else data
+        return np.asarray(x, np.float32).reshape(len(x), -1), np.asarray(y).reshape(-1)
+
+
+def _init_dense_params(dims: List[int], seed: int) -> List[Dict[str, np.ndarray]]:
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(len(dims) - 1):
+        scale = np.sqrt(2.0 / dims[i])
+        out.append({
+            "w": (rng.uniform(-1, 1, (dims[i], dims[i + 1])) * scale).astype(np.float32),
+            "b": np.zeros(dims[i + 1], np.float32),
+        })
+    return out

@@ -1,0 +1,68 @@
+// Edge-client training engine: base trainer interface.
+//
+// Reference: android/fedmlsdk/MobileNN/includes/train/FedMLBaseTrainer.h:14-24
+// — same init/train/getEpochAndLoss/stopTraining surface so a client manager
+// written against the reference SDK maps 1:1. The backends differ: the
+// reference drives MNN or libtorch graph executors; this engine is a
+// dependency-free dense SGD core (edge devices train tiny models; the TPU
+// side of the framework handles the server/aggregation plane).
+
+#ifndef FEDML_EDGE_BASE_TRAINER_H
+#define FEDML_EDGE_BASE_TRAINER_H
+
+#include <cstdint>
+#include <functional>
+#include <string>
+#include <vector>
+
+namespace fedml_edge {
+
+using ProgressCallback = std::function<void(float)>;
+using AccuracyCallback = std::function<void(int, float)>;
+using LossCallback = std::function<void(int, float)>;
+
+class FedMLBaseTrainer {
+ public:
+  virtual ~FedMLBaseTrainer() = default;
+
+  // Mirrors FedMLBaseTrainer::init (reference :17-22). modelCachePath /
+  // dataCachePath name the serialized model blob and the training data file.
+  void init(const char *model_cache_path, const char *data_cache_path,
+            const char *dataset, int train_size, int test_size,
+            int batch_size, double learning_rate, int epoch_num,
+            ProgressCallback progress_cb = nullptr,
+            AccuracyCallback accuracy_cb = nullptr,
+            LossCallback loss_cb = nullptr);
+
+  // Run local training; returns the path of the updated model blob
+  // (reference returns the MNN output path).
+  virtual std::string train() = 0;
+
+  // "epoch,loss" of the most recent step (reference :26).
+  std::string get_epoch_and_loss() const;
+
+  // Request cooperative stop; returns true (reference :28).
+  bool stop_training();
+
+ protected:
+  std::string model_cache_path_;
+  std::string data_cache_path_;
+  std::string dataset_;
+  int train_size_ = 0;
+  int test_size_ = 0;
+  int batch_size_ = 32;
+  double learning_rate_ = 0.01;
+  int epoch_num_ = 1;
+
+  int cur_epoch_ = 0;
+  float cur_loss_ = 0.0f;
+  bool stop_flag_ = false;
+
+  ProgressCallback progress_cb_;
+  AccuracyCallback accuracy_cb_;
+  LossCallback loss_cb_;
+};
+
+}  // namespace fedml_edge
+
+#endif  // FEDML_EDGE_BASE_TRAINER_H

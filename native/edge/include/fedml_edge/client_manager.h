@@ -1,0 +1,34 @@
+// Reference: android/fedmlsdk/MobileNN/includes/FedMLClientManager.h:6.
+
+#ifndef FEDML_EDGE_CLIENT_MANAGER_H
+#define FEDML_EDGE_CLIENT_MANAGER_H
+
+#include "fedml_edge/dense_trainer.h"
+
+namespace fedml_edge {
+
+class FedMLClientManager {
+ public:
+  FedMLClientManager();
+  ~FedMLClientManager();
+
+  void init(const char *model_cache_path, const char *data_cache_path,
+            const char *dataset, int train_size, int test_size,
+            int batch_size, double learning_rate, int epoch_num,
+            ProgressCallback progress_cb = nullptr,
+            AccuracyCallback accuracy_cb = nullptr,
+            LossCallback loss_cb = nullptr);
+
+  std::string train();
+  std::string get_epoch_and_loss() const;
+  bool stop_training();
+
+  FedMLDenseTrainer *trainer();
+
+ private:
+  FedMLDenseTrainer *trainer_;
+};
+
+}  // namespace fedml_edge
+
+#endif  // FEDML_EDGE_CLIENT_MANAGER_H

@@ -1,0 +1,54 @@
+// Dense model blob: the wire format edge clients and the Python server share.
+//
+// Layout (little-endian):
+//   int32 magic = 0x46454454 ("FEDT")
+//   int32 n_layers
+//   per layer: int32 in_dim, int32 out_dim
+//   then all float32 weights layer-major: W0 (in*out, row-major in-dim x
+//   out-dim), b0 (out), W1, b1, ...
+//
+// The Python side maps this directly onto a flax Dense pytree
+// (fedml_tpu/cross_device/codec.py). Reference analogue: the .mnn model file
+// exchanged by Beehive (cross_device/server_mnn/fedml_aggregator.py:200-243
+// reads/averages/writes MNN files); a flat self-describing blob replaces the
+// opaque MNN graph.
+
+#ifndef FEDML_EDGE_DENSE_MODEL_H
+#define FEDML_EDGE_DENSE_MODEL_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace fedml_edge {
+
+constexpr int32_t kModelMagic = 0x46454454;
+
+struct DenseLayer {
+  int32_t in_dim = 0;
+  int32_t out_dim = 0;
+  std::vector<float> w;  // in_dim * out_dim, row-major
+  std::vector<float> b;  // out_dim
+};
+
+struct DenseModel {
+  std::vector<DenseLayer> layers;
+
+  int input_dim() const { return layers.empty() ? 0 : layers.front().in_dim; }
+  int output_dim() const { return layers.empty() ? 0 : layers.back().out_dim; }
+  size_t num_params() const;
+
+  // flat view in blob order (W0, b0, W1, b1, ...)
+  std::vector<float> flatten() const;
+  void unflatten(const std::vector<float> &flat);
+
+  bool save(const std::string &path) const;
+  bool load(const std::string &path);
+
+  // Kaiming-ish deterministic init for standalone runs.
+  static DenseModel create(const std::vector<int> &dims, uint64_t seed);
+};
+
+}  // namespace fedml_edge
+
+#endif  // FEDML_EDGE_DENSE_MODEL_H

@@ -1,0 +1,53 @@
+// Dense SGD trainer (the MNN/torch-trainer analogue).
+//
+// Reference: android/fedmlsdk/MobileNN/src/train/FedMLMNNTrainer.cpp (graph
+// SGD loop with per-epoch loss/accuracy callbacks) — here the "graph" is a
+// dense MLP (hidden ReLU layers + softmax cross-entropy head), which covers
+// the reference mobile zoo's LR/LeNet-class workloads for tabular/flattened
+// image data.
+
+#ifndef FEDML_EDGE_DENSE_TRAINER_H
+#define FEDML_EDGE_DENSE_TRAINER_H
+
+#include "fedml_edge/base_trainer.h"
+#include "fedml_edge/dense_model.h"
+
+namespace fedml_edge {
+
+struct DataSet {
+  int n = 0;
+  int dim = 0;
+  int num_classes = 0;
+  std::vector<float> x;    // n * dim
+  std::vector<int32_t> y;  // n
+
+  // Binary file: int32 n, dim, num_classes; float32 x[n*dim]; int32 y[n].
+  bool load(const std::string &path);
+  // Deterministic synthetic fallback (same spirit as the Python data zoo's
+  // surrogate loaders under zero egress).
+  static DataSet synthetic(int n, int dim, int num_classes, uint64_t seed);
+};
+
+class FedMLDenseTrainer : public FedMLBaseTrainer {
+ public:
+  std::string train() override;
+
+  // One epoch over the loaded data; returns mean loss.
+  float train_epoch(DenseModel &model, const DataSet &data, int epoch);
+  // Accuracy over [0, limit) rows.
+  float evaluate(const DenseModel &model, const DataSet &data, int limit) const;
+
+  DenseModel &model() { return model_; }
+  DataSet &data() { return data_; }
+
+ private:
+  DenseModel model_;
+  DataSet data_;
+  bool loaded_ = false;
+
+  void ensure_loaded();
+};
+
+}  // namespace fedml_edge
+
+#endif  // FEDML_EDGE_DENSE_TRAINER_H

@@ -1,0 +1,63 @@
+// LightSecAgg masking on the edge, GF(p) exact.
+//
+// Reference: android/fedmlsdk/MobileNN/src/security/LightSecAgg.cpp — the
+// same offline/online mask protocol as the Python core
+// (fedml_tpu/core/mpc/lightsecagg.py), but the reference's C++ does Lagrange
+// algebra in float with std::fmod, which loses exactness for large p. This
+// implementation keeps everything in int64 with a proper modular inverse, so
+// the server-side Python decoder (lcc_decode) reconstructs edge masks
+// bit-exactly.
+
+#ifndef FEDML_EDGE_LIGHT_SECAGG_H
+#define FEDML_EDGE_LIGHT_SECAGG_H
+
+#include <cstdint>
+#include <vector>
+
+namespace fedml_edge {
+
+// Matches fedml_tpu.core.mpc.finite_field.DEFAULT_PRIME.
+constexpr int64_t kDefaultPrime = 2147483647;  // 2^31 - 1
+
+int64_t mod_pow(int64_t base, int64_t exp, int64_t p);
+int64_t mod_inverse(int64_t a, int64_t p);
+
+// Lagrange coefficient matrix: coeffs[i][j] = l_j(alpha_i) over GF(p),
+// evaluation points beta (the share holders), target points alpha.
+std::vector<std::vector<int64_t>> lagrange_coeffs(
+    const std::vector<int64_t> &eval_points,
+    const std::vector<int64_t> &interp_points, int64_t p);
+
+// Encode payload rows (U x chunk) into one share per client (N x chunk):
+// the polynomial through (alpha_i, payload_i) evaluated at each beta_j.
+std::vector<std::vector<int64_t>> lcc_encode(
+    const std::vector<std::vector<int64_t>> &payload,
+    const std::vector<int64_t> &beta, const std::vector<int64_t> &alpha,
+    int64_t p);
+
+// Quantize float weights into GF(p) (two's-complement style wrap), matching
+// finite_field.quantize / dequantize in the Python core.
+std::vector<int64_t> quantize(const std::vector<float> &x, int q_bits, int64_t p);
+std::vector<float> dequantize(const std::vector<int64_t> &xq, int q_bits, int64_t p);
+
+struct MaskState {
+  std::vector<int64_t> local_mask;                    // d_pad
+  std::vector<std::vector<int64_t>> encoded_shares;   // N x chunk
+};
+
+// Offline phase (reference LightSecAgg.cpp mask_encoding / Python
+// lightsecagg.encode_mask): draw a uniform mask, LCC-encode into N shares.
+MaskState encode_mask(int d, int num_clients, int target_active,
+                      int privacy_guarantee, int64_t p, uint64_t seed);
+
+// Online phase: y = x + z mod p.
+std::vector<int64_t> mask_vector(const std::vector<int64_t> &x_finite,
+                                 const MaskState &state, int64_t p);
+
+// Sum received shares over the active set mod p.
+std::vector<int64_t> aggregate_encoded_mask(
+    const std::vector<std::vector<int64_t>> &received_shares, int64_t p);
+
+}  // namespace fedml_edge
+
+#endif  // FEDML_EDGE_LIGHT_SECAGG_H

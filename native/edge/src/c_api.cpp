@@ -1,0 +1,151 @@
+// C ABI for the edge engine.
+//
+// The reference bridges MobileNN to the app layer via JNI
+// (android/fedmlsdk/src/main/jni/JniFedMLClientManager.cpp); here the host
+// is Python, so the bridge is a plain C ABI consumed with ctypes
+// (fedml_tpu/cross_device/native_bridge.py). Memory contract: the library
+// owns every buffer it returns; buffers stay valid until the next call on
+// the same handle or edge_destroy.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "fedml_edge/client_manager.h"
+#include "fedml_edge/light_secagg.h"
+
+using fedml_edge::FedMLClientManager;
+
+namespace {
+struct EdgeHandle {
+  FedMLClientManager manager;
+  std::string last_string;
+  std::vector<float> float_buf;
+  std::vector<int64_t> mask_buf;
+  fedml_edge::MaskState mask_state;
+};
+}  // namespace
+
+extern "C" {
+
+void *edge_create() { return new EdgeHandle(); }
+
+void edge_destroy(void *h) { delete static_cast<EdgeHandle *>(h); }
+
+void edge_init(void *h, const char *model_path, const char *data_path,
+               const char *dataset, int train_size, int test_size,
+               int batch_size, double lr, int epochs) {
+  static_cast<EdgeHandle *>(h)->manager.init(model_path, data_path, dataset,
+                                             train_size, test_size, batch_size,
+                                             lr, epochs);
+}
+
+const char *edge_train(void *h) {
+  auto *e = static_cast<EdgeHandle *>(h);
+  e->last_string = e->manager.train();
+  return e->last_string.c_str();
+}
+
+const char *edge_get_epoch_and_loss(void *h) {
+  auto *e = static_cast<EdgeHandle *>(h);
+  e->last_string = e->manager.get_epoch_and_loss();
+  return e->last_string.c_str();
+}
+
+int edge_stop_training(void *h) {
+  return static_cast<EdgeHandle *>(h)->manager.stop_training() ? 1 : 0;
+}
+
+double edge_evaluate(void *h, int limit) {
+  auto *e = static_cast<EdgeHandle *>(h);
+  auto *t = e->manager.trainer();
+  return t->evaluate(t->model(), t->data(), limit);
+}
+
+// --- model blob access ------------------------------------------------------
+
+// Define the model architecture up front (layer dims, e.g. [60, 10] for LR)
+// so the host can push weights before the first train() call.
+int edge_configure_model(void *h, const int32_t *dims, int ndims, uint64_t seed) {
+  if (ndims < 2) return -1;
+  std::vector<int> d(dims, dims + ndims);
+  static_cast<EdgeHandle *>(h)->manager.trainer()->model() =
+      fedml_edge::DenseModel::create(d, seed);
+  return 0;
+}
+
+int64_t edge_num_params(void *h) {
+  return static_cast<int64_t>(
+      static_cast<EdgeHandle *>(h)->manager.trainer()->model().num_params());
+}
+
+// Copies the flat float32 params into out (caller allocates n floats).
+int edge_get_model(void *h, float *out, int64_t n) {
+  auto flat = static_cast<EdgeHandle *>(h)->manager.trainer()->model().flatten();
+  if (static_cast<int64_t>(flat.size()) != n) return -1;
+  std::memcpy(out, flat.data(), sizeof(float) * flat.size());
+  return 0;
+}
+
+int edge_set_model(void *h, const float *in, int64_t n) {
+  auto &model = static_cast<EdgeHandle *>(h)->manager.trainer()->model();
+  if (static_cast<int64_t>(model.num_params()) != n) return -1;
+  std::vector<float> flat(in, in + n);
+  model.unflatten(flat);
+  return 0;
+}
+
+// --- LightSecAgg ------------------------------------------------------------
+
+// Offline phase: draw + encode this client's mask. Returns chunk length
+// (elements per peer share) or -1.
+int64_t edge_lsa_encode_mask(void *h, int num_clients, int target_active,
+                             int privacy_guarantee, int64_t prime, uint64_t seed) {
+  auto *e = static_cast<EdgeHandle *>(h);
+  int d = static_cast<int>(e->manager.trainer()->model().num_params());
+  try {
+    e->mask_state = fedml_edge::encode_mask(d, num_clients, target_active,
+                                            privacy_guarantee, prime, seed);
+  } catch (...) {
+    return -1;
+  }
+  return e->mask_state.encoded_shares.empty()
+             ? 0
+             : static_cast<int64_t>(e->mask_state.encoded_shares[0].size());
+}
+
+// Copy the encoded share destined for peer j (chunk int64s).
+int edge_lsa_get_share(void *h, int peer, int64_t *out, int64_t chunk) {
+  auto *e = static_cast<EdgeHandle *>(h);
+  const auto &shares = e->mask_state.encoded_shares;
+  if (peer < 0 || peer >= static_cast<int>(shares.size())) return -1;
+  if (static_cast<int64_t>(shares[peer].size()) != chunk) return -1;
+  std::memcpy(out, shares[peer].data(), sizeof(int64_t) * chunk);
+  return 0;
+}
+
+// Online phase: quantize the current model and add the mask; writes d int64s.
+int edge_lsa_masked_model(void *h, int q_bits, int64_t prime, int64_t *out, int64_t d) {
+  auto *e = static_cast<EdgeHandle *>(h);
+  auto flat = e->manager.trainer()->model().flatten();
+  if (static_cast<int64_t>(flat.size()) != d) return -1;
+  auto xq = fedml_edge::quantize(flat, q_bits, prime);
+  auto y = fedml_edge::mask_vector(xq, e->mask_state, prime);
+  std::memcpy(out, y.data(), sizeof(int64_t) * d);
+  return 0;
+}
+
+// Aggregate the active peers' shares: in = n_active concatenated chunks.
+int edge_lsa_aggregate_shares(void *h, const int64_t *in, int n_active,
+                              int64_t chunk, int64_t prime, int64_t *out) {
+  std::vector<std::vector<int64_t>> received(n_active, std::vector<int64_t>(chunk));
+  for (int i = 0; i < n_active; ++i)
+    std::memcpy(received[i].data(), in + static_cast<int64_t>(i) * chunk,
+                sizeof(int64_t) * chunk);
+  auto agg = fedml_edge::aggregate_encoded_mask(received, prime);
+  std::memcpy(out, agg.data(), sizeof(int64_t) * chunk);
+  return 0;
+}
+
+}  // extern "C"

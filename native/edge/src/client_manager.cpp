@@ -1,0 +1,34 @@
+// FedMLClientManager: the object the mobile/Java (or Python-ctypes) layer
+// drives. Reference: android/fedmlsdk/MobileNN/src/FedMLClientManager.cpp and
+// includes/FedMLClientManager.h:6 — owns a trainer, forwards
+// init/train/getEpochAndLoss/stopTraining.
+
+#include "fedml_edge/client_manager.h"
+
+namespace fedml_edge {
+
+FedMLClientManager::FedMLClientManager() : trainer_(new FedMLDenseTrainer()) {}
+
+FedMLClientManager::~FedMLClientManager() { delete trainer_; }
+
+void FedMLClientManager::init(const char *model_cache_path, const char *data_cache_path,
+                              const char *dataset, int train_size, int test_size,
+                              int batch_size, double learning_rate, int epoch_num,
+                              ProgressCallback progress_cb, AccuracyCallback accuracy_cb,
+                              LossCallback loss_cb) {
+  trainer_->init(model_cache_path, data_cache_path, dataset, train_size, test_size,
+                 batch_size, learning_rate, epoch_num, std::move(progress_cb),
+                 std::move(accuracy_cb), std::move(loss_cb));
+}
+
+std::string FedMLClientManager::train() { return trainer_->train(); }
+
+std::string FedMLClientManager::get_epoch_and_loss() const {
+  return trainer_->get_epoch_and_loss();
+}
+
+bool FedMLClientManager::stop_training() { return trainer_->stop_training(); }
+
+FedMLDenseTrainer *FedMLClientManager::trainer() { return trainer_; }
+
+}  // namespace fedml_edge

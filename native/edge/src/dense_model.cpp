@@ -1,0 +1,113 @@
+#include "fedml_edge/dense_model.h"
+
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+namespace fedml_edge {
+
+namespace {
+// splitmix64: tiny deterministic PRNG for init + synthetic data.
+uint64_t splitmix64(uint64_t &state) {
+  uint64_t z = (state += 0x9E3779B97F4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+float uniform01(uint64_t &state) {
+  return (splitmix64(state) >> 11) * (1.0f / 9007199254740992.0f);
+}
+}  // namespace
+
+size_t DenseModel::num_params() const {
+  size_t n = 0;
+  for (const auto &l : layers) n += l.w.size() + l.b.size();
+  return n;
+}
+
+std::vector<float> DenseModel::flatten() const {
+  std::vector<float> flat;
+  flat.reserve(num_params());
+  for (const auto &l : layers) {
+    flat.insert(flat.end(), l.w.begin(), l.w.end());
+    flat.insert(flat.end(), l.b.begin(), l.b.end());
+  }
+  return flat;
+}
+
+void DenseModel::unflatten(const std::vector<float> &flat) {
+  size_t off = 0;
+  for (auto &l : layers) {
+    std::memcpy(l.w.data(), flat.data() + off, l.w.size() * sizeof(float));
+    off += l.w.size();
+    std::memcpy(l.b.data(), flat.data() + off, l.b.size() * sizeof(float));
+    off += l.b.size();
+  }
+}
+
+bool DenseModel::save(const std::string &path) const {
+  FILE *f = std::fopen(path.c_str(), "wb");
+  if (!f) return false;
+  int32_t magic = kModelMagic, n = static_cast<int32_t>(layers.size());
+  std::fwrite(&magic, 4, 1, f);
+  std::fwrite(&n, 4, 1, f);
+  for (const auto &l : layers) {
+    std::fwrite(&l.in_dim, 4, 1, f);
+    std::fwrite(&l.out_dim, 4, 1, f);
+  }
+  for (const auto &l : layers) {
+    std::fwrite(l.w.data(), sizeof(float), l.w.size(), f);
+    std::fwrite(l.b.data(), sizeof(float), l.b.size(), f);
+  }
+  std::fclose(f);
+  return true;
+}
+
+bool DenseModel::load(const std::string &path) {
+  FILE *f = std::fopen(path.c_str(), "rb");
+  if (!f) return false;
+  int32_t magic = 0, n = 0;
+  if (std::fread(&magic, 4, 1, f) != 1 || magic != kModelMagic ||
+      std::fread(&n, 4, 1, f) != 1 || n <= 0 || n > 64) {
+    std::fclose(f);
+    return false;
+  }
+  layers.assign(n, DenseLayer{});
+  for (auto &l : layers) {
+    if (std::fread(&l.in_dim, 4, 1, f) != 1 || std::fread(&l.out_dim, 4, 1, f) != 1 ||
+        l.in_dim <= 0 || l.out_dim <= 0) {
+      std::fclose(f);
+      return false;
+    }
+  }
+  for (auto &l : layers) {
+    l.w.assign(static_cast<size_t>(l.in_dim) * l.out_dim, 0.0f);
+    l.b.assign(l.out_dim, 0.0f);
+    if (std::fread(l.w.data(), sizeof(float), l.w.size(), f) != l.w.size() ||
+        std::fread(l.b.data(), sizeof(float), l.b.size(), f) != l.b.size()) {
+      std::fclose(f);
+      return false;
+    }
+  }
+  std::fclose(f);
+  return true;
+}
+
+DenseModel DenseModel::create(const std::vector<int> &dims, uint64_t seed) {
+  DenseModel m;
+  uint64_t state = seed * 0x9E3779B97F4A7C15ULL + 1;
+  for (size_t i = 0; i + 1 < dims.size(); ++i) {
+    DenseLayer l;
+    l.in_dim = dims[i];
+    l.out_dim = dims[i + 1];
+    l.w.resize(static_cast<size_t>(l.in_dim) * l.out_dim);
+    l.b.assign(l.out_dim, 0.0f);
+    float scale = std::sqrt(2.0f / static_cast<float>(l.in_dim));
+    for (auto &w : l.w) w = (uniform01(state) * 2.0f - 1.0f) * scale;
+    m.layers.push_back(std::move(l));
+  }
+  return m;
+}
+
+}  // namespace fedml_edge

@@ -1,0 +1,224 @@
+#include "fedml_edge/dense_trainer.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <numeric>
+#include <random>
+
+namespace fedml_edge {
+
+void FedMLBaseTrainer::init(const char *model_cache_path, const char *data_cache_path,
+                            const char *dataset, int train_size, int test_size,
+                            int batch_size, double learning_rate, int epoch_num,
+                            ProgressCallback progress_cb, AccuracyCallback accuracy_cb,
+                            LossCallback loss_cb) {
+  model_cache_path_ = model_cache_path ? model_cache_path : "";
+  data_cache_path_ = data_cache_path ? data_cache_path : "";
+  dataset_ = dataset ? dataset : "";
+  train_size_ = train_size;
+  test_size_ = test_size;
+  batch_size_ = batch_size > 0 ? batch_size : 32;
+  learning_rate_ = learning_rate;
+  epoch_num_ = epoch_num > 0 ? epoch_num : 1;
+  progress_cb_ = std::move(progress_cb);
+  accuracy_cb_ = std::move(accuracy_cb);
+  loss_cb_ = std::move(loss_cb);
+  cur_epoch_ = 0;
+  cur_loss_ = 0.0f;
+  stop_flag_ = false;
+}
+
+std::string FedMLBaseTrainer::get_epoch_and_loss() const {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%d,%.6f", cur_epoch_, cur_loss_);
+  return buf;
+}
+
+bool FedMLBaseTrainer::stop_training() {
+  stop_flag_ = true;
+  return true;
+}
+
+bool DataSet::load(const std::string &path) {
+  FILE *f = std::fopen(path.c_str(), "rb");
+  if (!f) return false;
+  int32_t hdr[3];
+  if (std::fread(hdr, 4, 3, f) != 3 || hdr[0] <= 0 || hdr[1] <= 0 || hdr[2] <= 0) {
+    std::fclose(f);
+    return false;
+  }
+  n = hdr[0];
+  dim = hdr[1];
+  num_classes = hdr[2];
+  x.assign(static_cast<size_t>(n) * dim, 0.0f);
+  y.assign(n, 0);
+  bool ok = std::fread(x.data(), sizeof(float), x.size(), f) == x.size() &&
+            std::fread(y.data(), sizeof(int32_t), y.size(), f) == y.size();
+  std::fclose(f);
+  return ok;
+}
+
+DataSet DataSet::synthetic(int n, int dim, int num_classes, uint64_t seed) {
+  // Deterministic linearly-separable-ish blobs: class centers on coordinate
+  // axes + gaussian noise (mirrors the Python synthetic surrogate).
+  DataSet d;
+  d.n = n;
+  d.dim = dim;
+  d.num_classes = num_classes;
+  d.x.resize(static_cast<size_t>(n) * dim);
+  d.y.resize(n);
+  std::mt19937_64 rng(seed);
+  std::normal_distribution<float> noise(0.0f, 0.4f);
+  for (int i = 0; i < n; ++i) {
+    int c = static_cast<int>(rng() % static_cast<uint64_t>(num_classes));
+    d.y[i] = c;
+    for (int j = 0; j < dim; ++j) {
+      float center = (j % num_classes == c) ? 1.5f : 0.0f;
+      d.x[static_cast<size_t>(i) * dim + j] = center + noise(rng);
+    }
+  }
+  return d;
+}
+
+void FedMLDenseTrainer::ensure_loaded() {
+  if (loaded_) return;
+  if (!model_.layers.empty()) {
+    // architecture already configured / weights already installed
+  } else if (!model_cache_path_.empty() && model_.load(model_cache_path_)) {
+    // loaded serialized model from the server
+  } else {
+    model_ = DenseModel::create({60, 10}, 0);
+  }
+  if (data_cache_path_.empty() || !data_.load(data_cache_path_)) {
+    int n = train_size_ > 0 ? train_size_ + std::max(test_size_, 0) : 512;
+    data_ = DataSet::synthetic(n, model_.input_dim(), model_.output_dim(), 7);
+  }
+  if (train_size_ <= 0 || train_size_ > data_.n) train_size_ = data_.n;
+  loaded_ = true;
+}
+
+float FedMLDenseTrainer::train_epoch(DenseModel &model, const DataSet &data, int epoch) {
+  const int n = std::min(train_size_ > 0 ? train_size_ : data.n, data.n);
+  const int nl = static_cast<int>(model.layers.size());
+  std::vector<int> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::mt19937_64 rng(static_cast<uint64_t>(epoch) * 0x9E37ULL + 13);
+  std::shuffle(order.begin(), order.end(), rng);
+
+  // per-layer activation buffers for one sample
+  std::vector<std::vector<float>> acts(nl + 1);
+  std::vector<std::vector<float>> deltas(nl);
+  double loss_sum = 0.0;
+  int steps = 0;
+
+  for (int start = 0; start < n && !stop_flag_; start += batch_size_) {
+    int bsz = std::min(batch_size_, n - start);
+    // accumulate gradients over the batch (SGD on the mean loss)
+    std::vector<std::vector<float>> gw(nl), gb(nl);
+    for (int l = 0; l < nl; ++l) {
+      gw[l].assign(model.layers[l].w.size(), 0.0f);
+      gb[l].assign(model.layers[l].b.size(), 0.0f);
+    }
+    for (int bi = 0; bi < bsz; ++bi) {
+      int i = order[start + bi];
+      // forward
+      acts[0].assign(data.x.begin() + static_cast<size_t>(i) * data.dim,
+                     data.x.begin() + static_cast<size_t>(i + 1) * data.dim);
+      for (int l = 0; l < nl; ++l) {
+        const auto &L = model.layers[l];
+        acts[l + 1].assign(L.out_dim, 0.0f);
+        for (int o = 0; o < L.out_dim; ++o) {
+          float s = L.b[o];
+          const float *wcol = L.w.data() + static_cast<size_t>(o);
+          for (int in = 0; in < L.in_dim; ++in)
+            s += acts[l][in] * L.w[static_cast<size_t>(in) * L.out_dim + o];
+          (void)wcol;
+          acts[l + 1][o] = (l + 1 < nl) ? std::max(s, 0.0f) : s;  // ReLU hidden
+        }
+      }
+      // softmax cross-entropy on the head
+      auto &logits = acts[nl];
+      float mx = *std::max_element(logits.begin(), logits.end());
+      double denom = 0.0;
+      for (float v : logits) denom += std::exp(v - mx);
+      int label = data.y[i];
+      loss_sum += -(logits[label] - mx - std::log(denom));
+      // backward
+      deltas[nl - 1].assign(logits.size(), 0.0f);
+      for (size_t o = 0; o < logits.size(); ++o) {
+        float p = static_cast<float>(std::exp(logits[o] - mx) / denom);
+        deltas[nl - 1][o] = p - (static_cast<int>(o) == label ? 1.0f : 0.0f);
+      }
+      for (int l = nl - 1; l >= 0; --l) {
+        const auto &L = model.layers[l];
+        for (int o = 0; o < L.out_dim; ++o) {
+          float d = deltas[l][o];
+          gb[l][o] += d;
+          for (int in = 0; in < L.in_dim; ++in)
+            gw[l][static_cast<size_t>(in) * L.out_dim + o] += acts[l][in] * d;
+        }
+        if (l > 0) {
+          deltas[l - 1].assign(L.in_dim, 0.0f);
+          for (int in = 0; in < L.in_dim; ++in) {
+            float s = 0.0f;
+            for (int o = 0; o < L.out_dim; ++o)
+              s += model.layers[l].w[static_cast<size_t>(in) * L.out_dim + o] * deltas[l][o];
+            // ReLU derivative
+            deltas[l - 1][in] = acts[l][in] > 0.0f ? s : 0.0f;
+          }
+        }
+      }
+      ++steps;
+    }
+    float lr = static_cast<float>(learning_rate_) / static_cast<float>(bsz);
+    for (int l = 0; l < nl; ++l) {
+      auto &L = model.layers[l];
+      for (size_t k = 0; k < L.w.size(); ++k) L.w[k] -= lr * gw[l][k];
+      for (size_t k = 0; k < L.b.size(); ++k) L.b[k] -= lr * gb[l][k];
+    }
+    if (progress_cb_) progress_cb_(100.0f * (start + bsz) / static_cast<float>(n));
+  }
+  return steps > 0 ? static_cast<float>(loss_sum / steps) : 0.0f;
+}
+
+float FedMLDenseTrainer::evaluate(const DenseModel &model, const DataSet &data, int limit) const {
+  int n = std::min(limit > 0 ? limit : data.n, data.n);
+  if (n == 0) return 0.0f;
+  int correct = 0;
+  const int nl = static_cast<int>(model.layers.size());
+  std::vector<float> cur, next;
+  for (int i = 0; i < n; ++i) {
+    cur.assign(data.x.begin() + static_cast<size_t>(i) * data.dim,
+               data.x.begin() + static_cast<size_t>(i + 1) * data.dim);
+    for (int l = 0; l < nl; ++l) {
+      const auto &L = model.layers[l];
+      next.assign(L.out_dim, 0.0f);
+      for (int o = 0; o < L.out_dim; ++o) {
+        float s = L.b[o];
+        for (int in = 0; in < L.in_dim; ++in)
+          s += cur[in] * L.w[static_cast<size_t>(in) * L.out_dim + o];
+        next[o] = (l + 1 < nl) ? std::max(s, 0.0f) : s;
+      }
+      cur.swap(next);
+    }
+    int pred = static_cast<int>(std::max_element(cur.begin(), cur.end()) - cur.begin());
+    if (pred == data.y[i]) ++correct;
+  }
+  return static_cast<float>(correct) / static_cast<float>(n);
+}
+
+std::string FedMLDenseTrainer::train() {
+  ensure_loaded();
+  for (int e = 0; e < epoch_num_ && !stop_flag_; ++e) {
+    cur_loss_ = train_epoch(model_, data_, e);
+    cur_epoch_ = e;
+    if (loss_cb_) loss_cb_(e, cur_loss_);
+    if (accuracy_cb_) accuracy_cb_(e, evaluate(model_, data_, train_size_));
+  }
+  if (!model_cache_path_.empty()) model_.save(model_cache_path_);
+  return model_cache_path_;
+}
+
+}  // namespace fedml_edge

@@ -1,0 +1,148 @@
+#include "fedml_edge/light_secagg.h"
+
+#include <cmath>
+#include <random>
+#include <stdexcept>
+
+namespace fedml_edge {
+
+namespace {
+// Multiplication mod p via __int128 (p < 2^31 so products fit easily, but
+// keep it general for larger primes).
+inline int64_t mod_mul(int64_t a, int64_t b, int64_t p) {
+  return static_cast<int64_t>((static_cast<__int128>(a) * b) % p);
+}
+
+inline int64_t mod_norm(int64_t a, int64_t p) {
+  int64_t r = a % p;
+  return r < 0 ? r + p : r;
+}
+}  // namespace
+
+int64_t mod_pow(int64_t base, int64_t exp, int64_t p) {
+  int64_t result = 1;
+  base = mod_norm(base, p);
+  while (exp > 0) {
+    if (exp & 1) result = mod_mul(result, base, p);
+    base = mod_mul(base, base, p);
+    exp >>= 1;
+  }
+  return result;
+}
+
+int64_t mod_inverse(int64_t a, int64_t p) {
+  // Fermat: p prime, a != 0 mod p.
+  a = mod_norm(a, p);
+  if (a == 0) throw std::invalid_argument("mod_inverse of 0");
+  return mod_pow(a, p - 2, p);
+}
+
+std::vector<std::vector<int64_t>> lagrange_coeffs(
+    const std::vector<int64_t> &eval_points,
+    const std::vector<int64_t> &interp_points, int64_t p) {
+  // coeffs[i][j] = prod_{k != j} (eval_i - interp_k) / (interp_j - interp_k)
+  const size_t ne = eval_points.size(), ni = interp_points.size();
+  std::vector<std::vector<int64_t>> coeffs(ne, std::vector<int64_t>(ni, 0));
+  for (size_t i = 0; i < ne; ++i) {
+    for (size_t j = 0; j < ni; ++j) {
+      int64_t num = 1, den = 1;
+      for (size_t k = 0; k < ni; ++k) {
+        if (k == j) continue;
+        num = mod_mul(num, mod_norm(eval_points[i] - interp_points[k], p), p);
+        den = mod_mul(den, mod_norm(interp_points[j] - interp_points[k], p), p);
+      }
+      coeffs[i][j] = mod_mul(num, mod_inverse(den, p), p);
+    }
+  }
+  return coeffs;
+}
+
+std::vector<std::vector<int64_t>> lcc_encode(
+    const std::vector<std::vector<int64_t>> &payload,
+    const std::vector<int64_t> &beta, const std::vector<int64_t> &alpha,
+    int64_t p) {
+  auto coeffs = lagrange_coeffs(beta, alpha, p);  // N x U
+  const size_t n = beta.size(), u = alpha.size();
+  const size_t chunk = payload.empty() ? 0 : payload[0].size();
+  std::vector<std::vector<int64_t>> shares(n, std::vector<int64_t>(chunk, 0));
+  for (size_t i = 0; i < n; ++i)
+    for (size_t j = 0; j < u; ++j) {
+      int64_t c = coeffs[i][j];
+      if (c == 0) continue;
+      for (size_t t = 0; t < chunk; ++t)
+        shares[i][t] = mod_norm(shares[i][t] + mod_mul(c, payload[j][t], p), p);
+    }
+  return shares;
+}
+
+std::vector<int64_t> quantize(const std::vector<float> &x, int q_bits, int64_t p) {
+  std::vector<int64_t> out(x.size());
+  const double scale = static_cast<double>(1LL << q_bits);
+  for (size_t i = 0; i < x.size(); ++i) {
+    int64_t v = static_cast<int64_t>(std::llround(static_cast<double>(x[i]) * scale));
+    out[i] = mod_norm(v, p);
+  }
+  return out;
+}
+
+std::vector<float> dequantize(const std::vector<int64_t> &xq, int q_bits, int64_t p) {
+  std::vector<float> out(xq.size());
+  const double inv_scale = 1.0 / static_cast<double>(1LL << q_bits);
+  const int64_t half = (p - 1) / 2;
+  for (size_t i = 0; i < xq.size(); ++i) {
+    int64_t v = mod_norm(xq[i], p);
+    if (v > half) v -= p;
+    out[i] = static_cast<float>(v * inv_scale);
+  }
+  return out;
+}
+
+MaskState encode_mask(int d, int num_clients, int target_active,
+                      int privacy_guarantee, int64_t p, uint64_t seed) {
+  if (!(0 < privacy_guarantee && privacy_guarantee < target_active &&
+        target_active <= num_clients))
+    throw std::invalid_argument("need 0 < T < U <= N");
+  const int n_data = target_active - privacy_guarantee;
+  const int d_pad = ((d + n_data - 1) / n_data) * n_data;
+  const int chunk = d_pad / n_data;
+
+  std::mt19937_64 rng(seed);
+  std::uniform_int_distribution<int64_t> dist(0, p - 1);
+
+  MaskState st;
+  st.local_mask.resize(d_pad);
+  for (auto &v : st.local_mask) v = dist(rng);
+
+  std::vector<std::vector<int64_t>> payload(target_active, std::vector<int64_t>(chunk));
+  for (int r = 0; r < n_data; ++r)
+    for (int t = 0; t < chunk; ++t) payload[r][t] = st.local_mask[static_cast<size_t>(r) * chunk + t];
+  for (int r = n_data; r < target_active; ++r)
+    for (int t = 0; t < chunk; ++t) payload[r][t] = dist(rng);  // T-privacy noise rows
+
+  // beta = 1..N (client points), alpha = N+1..N+U (payload points) — same
+  // geometry as fedml_tpu/core/mpc/lightsecagg.py LightSecAggConfig.
+  std::vector<int64_t> beta(num_clients), alpha(target_active);
+  for (int i = 0; i < num_clients; ++i) beta[i] = i + 1;
+  for (int i = 0; i < target_active; ++i) alpha[i] = num_clients + 1 + i;
+  st.encoded_shares = lcc_encode(payload, beta, alpha, p);
+  return st;
+}
+
+std::vector<int64_t> mask_vector(const std::vector<int64_t> &x_finite,
+                                 const MaskState &state, int64_t p) {
+  std::vector<int64_t> y(x_finite.size());
+  for (size_t i = 0; i < x_finite.size(); ++i)
+    y[i] = mod_norm(x_finite[i] + state.local_mask[i], p);
+  return y;
+}
+
+std::vector<int64_t> aggregate_encoded_mask(
+    const std::vector<std::vector<int64_t>> &received_shares, int64_t p) {
+  if (received_shares.empty()) return {};
+  std::vector<int64_t> agg(received_shares[0].size(), 0);
+  for (const auto &share : received_shares)
+    for (size_t t = 0; t < share.size(); ++t) agg[t] = mod_norm(agg[t] + share[t], p);
+  return agg;
+}
+
+}  // namespace fedml_edge

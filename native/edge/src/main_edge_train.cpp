@@ -1,0 +1,29 @@
+// CLI smoke binary (reference: MobileNN/src/main_MNN_train.cpp — "demo.out
+// mnist <model> <data> ..."). Trains the dense engine on synthetic or file
+// data and prints per-epoch loss/accuracy; exit 0 iff final accuracy clears
+// a sanity bar, so this doubles as the native test.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "fedml_edge/client_manager.h"
+
+int main(int argc, char **argv) {
+  const char *dataset = argc > 1 ? argv[1] : "synthetic";
+  const char *model_path = argc > 2 ? argv[2] : "";
+  const char *data_path = argc > 3 ? argv[3] : "";
+  int epochs = argc > 4 ? std::atoi(argv[4]) : 5;
+
+  fedml_edge::FedMLClientManager manager;
+  manager.init(model_path, data_path, dataset, /*train_size=*/512,
+               /*test_size=*/128, /*batch_size=*/32, /*lr=*/0.1, epochs,
+               nullptr,
+               [](int epoch, float acc) { std::printf("epoch %d acc %.4f\n", epoch, acc); },
+               [](int epoch, float loss) { std::printf("epoch %d loss %.4f\n", epoch, loss); });
+  manager.train();
+  auto *t = manager.trainer();
+  float acc = t->evaluate(t->model(), t->data(), 0);
+  std::printf("final accuracy: %.4f (%s)\n", acc, manager.get_epoch_and_loss().c_str());
+  return acc > 0.6f ? 0 : 1;
+}
