@@ -25,12 +25,22 @@ from ..core.data.noniid_partition import (
     record_data_stats,
 )
 from .dataset import ArrayDataset
-from .sources import load_image_dataset, load_synthetic_lr, load_text_dataset
+from .sources import (
+    load_image_dataset,
+    load_stackoverflow_lr,
+    load_synthetic_lr,
+    load_tabular_dataset,
+    load_text_dataset,
+)
 
 log = logging.getLogger(__name__)
 
-IMAGE_DATASETS = {"mnist", "femnist", "fashion_mnist", "cifar10", "cifar100", "cinic10", "fed_cifar100"}
-TEXT_DATASETS = {"shakespeare", "fed_shakespeare", "stackoverflow_nwp"}
+IMAGE_DATASETS = {
+    "mnist", "femnist", "fashion_mnist", "cifar10", "cifar100", "cinic10",
+    "fed_cifar100", "imagenet", "gld23k", "landmarks",
+}
+TEXT_DATASETS = {"shakespeare", "fed_shakespeare", "stackoverflow_nwp", "reddit"}
+TABULAR_DATASETS = {"lending_club", "uci"}
 
 FedDataset = Tuple[int, int, ArrayDataset, ArrayDataset, Dict[int, int], Dict[int, ArrayDataset], Dict[int, ArrayDataset], int]
 
@@ -66,13 +76,17 @@ def load(args: Any) -> FedDataset:
         class_num = vocab
     elif dataset in IMAGE_DATASETS:
         x_tr, y_tr, x_te, y_te, class_num = load_image_dataset(dataset, cache, seed)
+    elif dataset in TABULAR_DATASETS:
+        x_tr, y_tr, x_te, y_te, class_num = load_tabular_dataset(dataset, cache, seed)
+    elif dataset == "stackoverflow_lr":
+        x_tr, y_tr, x_te, y_te, class_num = load_stackoverflow_lr(cache, seed)
     else:
         raise ValueError(f"unknown dataset {dataset!r}")
 
     label_for_partition = y_tr if y_tr.ndim == 1 else y_tr[:, 0]
-    if method == "hetero" and y_tr.ndim == 1:
+    if method == "hetero" and y_tr.ndim == 1 and y_tr.dtype.kind in "iu":
         net_map = non_iid_partition_with_dirichlet_distribution(
-            label_for_partition, client_num, class_num if y_tr.ndim == 1 else 0, alpha, seed
+            label_for_partition, client_num, class_num, alpha, seed
         )
     else:
         net_map = homo_partition(len(x_tr), client_num, seed)

@@ -62,6 +62,11 @@ def load_image_dataset(name: str, cache_dir: str, seed: int = 0):
         "cifar100": ((32, 32, 3), 100, 50000, 10000),
         "cinic10": ((32, 32, 3), 10, 90000, 9000),
         "fed_cifar100": ((32, 32, 3), 100, 50000, 10000),
+        # reference data/ImageNet (downsampled surrogate shape) and
+        # data/gld (Google Landmarks gld23k: 203 classes)
+        "imagenet": ((64, 64, 3), 1000, 20000, 2000),
+        "gld23k": ((64, 64, 3), 203, 23000, 2000),
+        "landmarks": ((64, 64, 3), 203, 23000, 2000),
     }
     shape, classes, n_train, n_test = specs[name]
     path = os.path.join(cache_dir or "", f"{name}.npz")
@@ -88,6 +93,7 @@ def load_text_dataset(name: str, cache_dir: str, seed: int = 0):
         "shakespeare": (80, 90, 8000, 1000),
         "fed_shakespeare": (80, 90, 8000, 1000),
         "stackoverflow_nwp": (20, 10004, 8000, 1000),
+        "reddit": (20, 10000, 8000, 1000),  # reference data/reddit
     }
     T, vocab, n_train, n_test = specs[name]
     path = os.path.join(cache_dir or "", f"{name}.npz")
@@ -112,6 +118,99 @@ def load_text_dataset(name: str, cache_dir: str, seed: int = 0):
     x_tr, y_tr = sample(n_train)
     x_te, y_te = sample(n_test)
     return x_tr, y_tr, x_te, y_te, vocab
+
+
+def load_tabular_dataset(name: str, cache_dir: str, seed: int = 0):
+    """Binary tabular sets (reference: data/lending_club_loan/ and data/UCI/
+    loaders) -> (x_train, y_train, x_test, y_test, 2). Local file:
+    ``{cache}/{name}.npz`` with the standard four keys; otherwise a
+    deterministic surrogate with a planted linear decision boundary."""
+    specs = {
+        "lending_club": (90, 40000, 5000),
+        "uci": (105, 30000, 4000),  # one-hot-encoded adult-census width
+    }
+    dim, n_train, n_test = specs[name]
+    path = os.path.join(cache_dir or "", f"{name}.npz")
+    if cache_dir and os.path.exists(path):
+        return (*_load_npz(path), 2)
+    log.warning("dataset %s: no local file at %s — synthetic tabular surrogate", name, path)
+    n_train, n_test = min(n_train, 10000), min(n_test, 2000)
+    rng = np.random.default_rng(seed)
+    w = rng.normal(0, 1, dim).astype(np.float32)
+
+    def sample(n, s):
+        r = np.random.default_rng(s)
+        x = r.normal(0, 1, (n, dim)).astype(np.float32)
+        logit = x @ w + 0.5 * r.normal(0, 1, n)
+        return x, (logit > 0).astype(np.int64)
+
+    x_tr, y_tr = sample(n_train, seed + 1)
+    x_te, y_te = sample(n_test, seed + 2)
+    return x_tr, y_tr, x_te, y_te, 2
+
+
+def load_stackoverflow_lr(cache_dir: str, seed: int = 0, n_train: int = 8000, n_test: int = 1000):
+    """StackOverflow tag prediction (reference: data/stackoverflow_lr/) —
+    bag-of-words features, multi-hot tag labels. -> (x, y float multi-hot,
+    ..., n_tags)."""
+    dim, n_tags = 10000, 500
+    path = os.path.join(cache_dir or "", "stackoverflow_lr.npz")
+    if cache_dir and os.path.exists(path):
+        with np.load(path) as z:
+            return (
+                z["x_train"].astype(np.float32), z["y_train"].astype(np.float32),
+                z["x_test"].astype(np.float32), z["y_test"].astype(np.float32), n_tags,
+            )
+    log.warning("dataset stackoverflow_lr: no local file — synthetic BoW surrogate")
+    rng = np.random.default_rng(seed)
+    # each tag fires on a sparse subset of words
+    tag_words = (rng.random((n_tags, dim)) < 0.002).astype(np.float32)
+
+    def sample(n, s):
+        r = np.random.default_rng(s)
+        tags = (r.random((n, n_tags)) < 3.0 / n_tags).astype(np.float32)
+        x = (tags @ tag_words) + r.poisson(0.01, (n, dim))
+        x = np.minimum(x, 3.0).astype(np.float32)
+        return x, tags
+
+    x_tr, y_tr = sample(n_train, seed + 1)
+    x_te, y_te = sample(n_test, seed + 2)
+    return x_tr, y_tr, x_te, y_te, n_tags
+
+
+def load_nus_wide_vertical(cache_dir: str, n_parties: int = 2, seed: int = 0, n: int = 4000):
+    """NUS-WIDE style vertical-FL source (reference: data/NUS_WIDE/
+    nus_wide_dataset.py feeds classical_vertical_fl): the SAME samples'
+    features split across parties (image features vs text tags). Returns
+    (party_xs: list of [n, d_i], y [n] binary)."""
+    party_dims = [634, 1000] + [128] * max(0, n_parties - 2)
+    party_dims = party_dims[:n_parties]
+    path = os.path.join(cache_dir or "", "nus_wide.npz")
+    if cache_dir and os.path.exists(path):
+        with np.load(path) as z:
+            xs = [z[f"x{i}"].astype(np.float32) for i in range(n_parties)]
+            return xs, z["y"].astype(np.int64)
+    log.warning("dataset nus_wide: no local file — synthetic vertical surrogate")
+    rng = np.random.default_rng(seed)
+    latent = rng.normal(0, 1, (n, 16)).astype(np.float32)
+    y = (latent @ rng.normal(0, 1, 16) > 0).astype(np.int64)
+    xs = []
+    for i, d in enumerate(party_dims):
+        proj = rng.normal(0, 1, (16, d)).astype(np.float32)
+        xs.append((latent @ proj + 0.5 * rng.normal(0, 1, (n, d))).astype(np.float32))
+    return xs, y
+
+
+def load_edge_case_examples(seed: int = 0, n: int = 256, shape=(28, 28, 1), target_class: int = 0):
+    """Edge-case backdoor pool (reference: data/edge_case_examples/ — rare
+    tail samples relabeled to the attacker's target, Wang et al. 2020).
+    Surrogate: high-contrast corner-patch patterns far from the benign
+    manifold, all labeled ``target_class``."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 0.1, (n,) + tuple(shape)).astype(np.float32)
+    x[:, : shape[0] // 4, : shape[1] // 4, ...] = 3.0  # trigger patch
+    y = np.full(n, target_class, np.int64)
+    return x, y
 
 
 def load_synthetic_lr(alpha: float, beta: float, n_clients: int, seed: int = 0, dim: int = 60, classes: int = 10):

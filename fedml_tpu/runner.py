@@ -48,15 +48,22 @@ class FedMLRunner:
 
     @staticmethod
     def _init_simulation_runner(args, device, dataset, model, client_trainer, server_aggregator):
-        from .simulation.simulator import SimulatorMPI, SimulatorSingleProcess, SimulatorVmap
+        from .simulation.simulator import (
+            SimulatorCollective,
+            SimulatorMPI,
+            SimulatorSingleProcess,
+            SimulatorVmap,
+        )
 
         backend = getattr(args, "backend", FEDML_SIMULATION_TYPE_SP)
         if backend == FEDML_SIMULATION_TYPE_SP:
             return SimulatorSingleProcess(args, device, dataset, model, client_trainer, server_aggregator)
-        if backend == FEDML_SIMULATION_TYPE_VMAP or backend == FEDML_SIMULATION_TYPE_NCCL:
-            # NCCL-sim's role (collective-backed parallel clients) is played
-            # by the vmapped simulator on TPU (SURVEY §2.a)
+        if backend == FEDML_SIMULATION_TYPE_VMAP:
             return SimulatorVmap(args, device, dataset, model, client_trainer, server_aggregator)
+        if backend == FEDML_SIMULATION_TYPE_NCCL:
+            # device-collective sim: clients sharded over the mesh, XLA
+            # all-reduce replaces dist.broadcast/reduce (SURVEY §2.b)
+            return SimulatorCollective(args, device, dataset, model, client_trainer, server_aggregator)
         if backend == FEDML_SIMULATION_TYPE_MPI:
             return SimulatorMPI(args, device, dataset, model, client_trainer, server_aggregator)
         raise ValueError(f"unknown simulation backend {backend!r}")

@@ -1,0 +1,5 @@
+"""Collective (device-sharded) FL simulation (reference: simulation/nccl/)."""
+
+from .collective_sim import CollectiveSimulator, FedML_Collective_init
+
+__all__ = ["CollectiveSimulator", "FedML_Collective_init"]

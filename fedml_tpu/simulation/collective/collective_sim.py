@@ -1,0 +1,68 @@
+"""Device-collective FL simulation — the Parrot-NCCL equivalent.
+
+Reference: ``simulation/nccl/base_framework/`` — one process per GPU, the
+server ``dist.broadcast``s parameters to local aggregators, each trains its
+subset of clients and ``dist.reduce``s the weighted sum back
+(LocalAggregator.py:15, Server.py:15, collectives common.py:185-228).
+
+TPU-native redesign: there are no processes and no explicit send/recv.
+Clients are stacked and **sharded across the device mesh along the client
+axis** (`P("agg")`); parameters stay replicated. One jitted call then runs
+every device's client group as a vmapped local-SGD batch and the weighted
+average contracts the sharded client axis — XLA inserts the all-reduce over
+ICI automatically, which IS the broadcast+reduce of the reference, chosen by
+the compiler instead of hand-scheduled (SURVEY §2.b: NCCL plane -> ICI
+collectives under jit).
+
+Builds on the vmap simulator (one-device client batching); this class adds
+the multi-chip dimension.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..vmapped.vmap_fedavg import VmapFedAvgAPI
+
+log = logging.getLogger(__name__)
+
+
+class CollectiveSimulator(VmapFedAvgAPI):
+    def __init__(self, args: Any, device: Any, dataset, model, devices: Optional[List] = None):
+        super().__init__(args, device, dataset, model)
+        devices = devices or jax.devices()
+        n = len(devices)
+        per_round = int(getattr(args, "client_num_per_round", 1))
+        # client axis must divide the mesh: shrink to the largest divisor
+        while n > 1 and per_round % n != 0:
+            n -= 1
+        self.mesh = Mesh(np.asarray(devices[:n]), ("agg",))
+        self._client_sharding = NamedSharding(self.mesh, P("agg"))
+        self._replicated = NamedSharding(self.mesh, P())
+        log.info("collective sim: %d clients/round over %d devices", per_round, n)
+
+    def _stack_clients(self, client_indexes: List[int]):
+        """Stage the stacked client batch sharded over the mesh; parameters
+        are placed replicated by the caller (train below)."""
+        x, y, idx, mask = super()._stack_clients(client_indexes)
+        put = lambda a: jax.device_put(a, self._client_sharding)
+        return put(x), put(y), put(idx), put(mask)
+
+    def train(self):
+        # replicate the starting params once; the per-round aggregate output
+        # is already replicated by XLA's all-reduce
+        self.model = self.model.clone_with(
+            jax.device_put(self.model.params, self._replicated)
+        )
+        self.aggregator.set_model_params(self.model.params)
+        return super().train()
+
+
+def FedML_Collective_init(args, device, dataset, model):
+    """Reference: ``FedML_NCCL_Similulation_init`` (fedml/__init__.py:130)."""
+    return CollectiveSimulator(args, device, dataset, model)

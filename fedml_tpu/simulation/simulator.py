@@ -45,6 +45,19 @@ class SimulatorVmap:
         return self.fl_trainer.train()
 
 
+class SimulatorCollective:
+    """Parrot-NCCL equivalent: clients sharded over the device mesh
+    (simulation/collective/collective_sim.py)."""
+
+    def __init__(self, args: Any, device: Any, dataset, model, client_trainer=None, server_aggregator=None):
+        from .collective import CollectiveSimulator
+
+        self.fl_trainer = CollectiveSimulator(args, device, dataset, model)
+
+    def run(self):
+        return self.fl_trainer.train()
+
+
 class SimulatorMPI:
     """Multi-process simulation over the message plane (reference Parrot-MPI,
     simulation/simulator.py:70). Each rank runs a client manager; rank 0 the

@@ -1,0 +1,60 @@
+"""Device-collective simulator tests on the virtual 8-device CPU mesh.
+
+Reference coverage model: the NCCL simulator has no tests in the reference
+repo at all; its semantics (broadcast + weighted reduce across local
+aggregators) are verified here against the single-device vmap simulator —
+sharding the client axis across the mesh must not change the numbers.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import fedml_tpu as fedml
+from fedml_tpu.arguments import default_config
+
+
+@pytest.fixture(autouse=True)
+def _needs_multi_device():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh (conftest sets 8)")
+
+
+def _run(backend, clients=8, rounds=2):
+    args = default_config(
+        "simulation", backend=backend, model="lr", dataset="mnist",
+        comm_round=rounds, epochs=1, batch_size=32, learning_rate=0.03,
+        client_num_in_total=clients, client_num_per_round=clients,
+        frequency_of_the_test=1, random_seed=0,
+    )
+    return fedml.run_simulation(backend=backend, args=args)
+
+
+def test_collective_matches_vmap_numerics():
+    m_vmap = _run("vmap")
+    m_coll = _run("NCCL")
+    # identical sampling/seeds -> the sharded run must reproduce the
+    # single-placement run up to float reduction order
+    assert abs(m_vmap["test_acc"] - m_coll["test_acc"]) < 1e-3
+    assert abs(m_vmap["test_loss"] - m_coll["test_loss"]) < 1e-3
+
+
+def test_collective_shards_client_axis():
+    from fedml_tpu.simulation.collective import CollectiveSimulator
+
+    args = default_config(
+        "simulation", backend="NCCL", model="lr", dataset="mnist",
+        comm_round=2, epochs=1, batch_size=32, frequency_of_the_test=1,
+        client_num_in_total=8, client_num_per_round=8, random_seed=0,
+    )
+    args = fedml.init(args)
+    device = fedml.device.get_device(args)
+    dataset, out_dim = fedml.data.load(args)
+    model = fedml.model.create(args, out_dim)
+    sim = CollectiveSimulator(args, device, dataset, model)
+    assert sim.mesh.devices.size > 1
+    x, *_ = sim._stack_clients(list(range(8)))
+    # the client axis is actually split across devices
+    assert len(x.sharding.device_set) == sim.mesh.devices.size
+    m = sim.train()
+    assert m["test_acc"] > 0.9, m
