@@ -29,6 +29,8 @@ COMM_BACKEND_GRPC = "GRPC"
 COMM_BACKEND_MQTT_S3 = "MQTT_S3"
 COMM_BACKEND_MPI = "MPI"
 COMM_BACKEND_TRPC = "TRPC"
+COMM_BACKEND_MQTT_WEB3 = "MQTT_WEB3"
+COMM_BACKEND_MQTT_THETASTORE = "MQTT_THETASTORE"
 
 # --- federated optimizers (reference: ml/aggregator/agg_operator.py) ---
 FEDML_FEDERATED_OPTIMIZER_FEDAVG = "FedAvg"

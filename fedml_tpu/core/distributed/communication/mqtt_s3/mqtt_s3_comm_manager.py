@@ -43,11 +43,18 @@ class MqttS3MultiClientsCommManager(BaseCommunicationManager):
         self.server_id = server_id
         self.is_server = client_rank == server_id
         self.mqtt = create_mqtt_transport(args, client_id=f"{self.topic_prefix}_{self.rank}")
-        self.store = create_object_store(args)
+        # store must exist before _subscribe: the local broker flushes
+        # backlogged messages synchronously on subscribe, and on_message
+        # resolves payload urls through self.store
+        self.store = self._create_store(args)
         self._observers: List[Observer] = []
         self._incoming: "queue.Queue" = queue.Queue()
         self._running = False
         self._subscribe()
+
+    def _create_store(self, args):
+        """Payload-store hook; web3/theta subclasses return a CAS store."""
+        return create_object_store(args)
 
     # --- topics (reference scheme) ---------------------------------------
     def _topic_server_to_client(self, client_id: int) -> str:

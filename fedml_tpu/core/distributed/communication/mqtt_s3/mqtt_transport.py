@@ -26,6 +26,11 @@ class LocalMqttBroker:
 
     def __init__(self) -> None:
         self._subs: Dict[str, List[Callable[[str, bytes], None]]] = defaultdict(list)
+        # messages published before anyone subscribed: real MQTT drops these,
+        # which races party startup (a client's ONLINE can beat the server's
+        # subscribe and deadlock the round). The in-process broker retains
+        # them and flushes on first subscribe.
+        self._backlog: Dict[str, List[bytes]] = defaultdict(list)
         self._slock = threading.Lock()
 
     @classmethod
@@ -36,19 +41,38 @@ class LocalMqttBroker:
             return cls._instances[broker_id]
 
     @classmethod
-    def reset(cls) -> None:
+    def reset(cls, broker_id: Optional[str] = None) -> None:
+        """Drop one broker (end of a run_id's lifecycle — prevents stale
+        message replay when a run_id is reused) or all of them."""
         with cls._lock:
-            cls._instances.clear()
+            if broker_id is None:
+                cls._instances.clear()
+            else:
+                cls._instances.pop(broker_id, None)
+
+    _BACKLOG_CAP = 256  # per topic; topics that never gain a subscriber
+    # (e.g. the last-will topic) must not grow unboundedly
 
     def publish(self, topic: str, payload: bytes) -> None:
         with self._slock:
             subs = list(self._subs.get(topic, []))
+            if not subs:
+                bl = self._backlog[topic]
+                bl.append(payload)
+                if len(bl) > self._BACKLOG_CAP:
+                    del bl[0]
+                return
         for cb in subs:
             cb(topic, payload)
 
     def subscribe(self, topic: str, callback: Callable[[str, bytes], None]) -> None:
+        # flush the backlog while holding the lock: releasing first would let
+        # a concurrent publish overtake older backlogged messages
         with self._slock:
             self._subs[topic].append(callback)
+            pending = self._backlog.pop(topic, [])
+            for payload in pending:
+                callback(topic, payload)
 
     def unsubscribe(self, topic: str, callback: Callable[[str, bytes], None]) -> None:
         with self._slock:

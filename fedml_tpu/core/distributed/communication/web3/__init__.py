@@ -1,0 +1,14 @@
+"""Decentralized-storage comm backends (reference: communication/mqtt_web3,
+mqtt_thetastore + core/distributed/distributed_storage/)."""
+
+from .distributed_storage import LocalCASStore, ThetaStorage, Web3Storage, create_cas_store
+from .mqtt_web3_comm_manager import MqttThetastoreCommManager, MqttWeb3CommManager
+
+__all__ = [
+    "LocalCASStore",
+    "Web3Storage",
+    "ThetaStorage",
+    "create_cas_store",
+    "MqttWeb3CommManager",
+    "MqttThetastoreCommManager",
+]

@@ -1,0 +1,35 @@
+"""MQTT + decentralized-storage comm managers.
+
+Reference: ``communication/mqtt_web3/mqtt_web3_comm_manager.py`` and
+``mqtt_thetastore/mqtt_thetastore_comm_manager.py`` — identical control
+plane to MQTT_S3, payloads in a decentralized content-addressed store
+instead of S3. Here that is literally the MQTT_S3 manager with the object
+store swapped for a CAS store, so the whole topic scheme / last-will /
+queueing logic stays in one place.
+"""
+
+from __future__ import annotations
+
+from ..mqtt_s3.mqtt_s3_comm_manager import MqttS3MultiClientsCommManager
+from .distributed_storage import create_cas_store
+
+
+class MqttWeb3CommManager(MqttS3MultiClientsCommManager):
+    """Reference: mqtt_web3_comm_manager.py MqttWeb3CommManager."""
+
+    def _create_store(self, args):
+        return create_cas_store(args)
+
+
+class MqttThetastoreCommManager(MqttS3MultiClientsCommManager):
+    """Reference: mqtt_thetastore_comm_manager.py MqttThetastoreCommManager.
+    Without a configured theta endpoint the content-addressed local store
+    stands in (same cid semantics)."""
+
+    def _create_store(self, args):
+        kind = getattr(args, "distributed_storage", None) if args is not None else None
+        if not kind:
+            from .distributed_storage import LocalCASStore
+
+            return LocalCASStore(getattr(args, "cas_root", None) if args is not None else None)
+        return create_cas_store(args)

@@ -16,6 +16,8 @@ from ...constants import (
     COMM_BACKEND_INMEMORY,
     COMM_BACKEND_MPI,
     COMM_BACKEND_MQTT_S3,
+    COMM_BACKEND_MQTT_THETASTORE,
+    COMM_BACKEND_MQTT_WEB3,
     COMM_BACKEND_TRPC,
 )
 from .communication.base_com_manager import BaseCommunicationManager, Observer
@@ -97,6 +99,18 @@ class FedMLCommManager(Observer):
             from .communication.mqtt_s3.mqtt_s3_comm_manager import MqttS3MultiClientsCommManager
 
             self.com_manager = MqttS3MultiClientsCommManager(
+                self.args, client_rank=self.rank, client_num=self.size - 1, server_id=0
+            )
+        elif self.backend == COMM_BACKEND_MQTT_WEB3:
+            from .communication.web3.mqtt_web3_comm_manager import MqttWeb3CommManager
+
+            self.com_manager = MqttWeb3CommManager(
+                self.args, client_rank=self.rank, client_num=self.size - 1, server_id=0
+            )
+        elif self.backend == COMM_BACKEND_MQTT_THETASTORE:
+            from .communication.web3.mqtt_web3_comm_manager import MqttThetastoreCommManager
+
+            self.com_manager = MqttThetastoreCommManager(
                 self.args, client_rank=self.rank, client_num=self.size - 1, server_id=0
             )
         else:
