@@ -48,6 +48,8 @@ FEDML_FEDERATED_OPTIMIZER_TURBO_AGGREGATE = "TA"
 FEDML_FEDERATED_OPTIMIZER_DECENTRALIZED_FL = "decentralized_fl"
 FEDML_FEDERATED_OPTIMIZER_VERTICAL_FL = "classical_vertical"
 FEDML_FEDERATED_OPTIMIZER_SPLIT_NN = "split_nn"
+FEDML_FEDERATED_OPTIMIZER_FEDGKT = "FedGKT"
+FEDML_FEDERATED_OPTIMIZER_FEDNAS = "FedNAS"
 
 # --- roles ---
 ROLE_SERVER = "server"

@@ -15,7 +15,11 @@ class SimulatorSingleProcess:
     def __init__(self, args: Any, device: Any, dataset, model, client_trainer=None, server_aggregator=None):
         from ..constants import (
             FEDML_FEDERATED_OPTIMIZER_ASYNC_FEDAVG,
+            FEDML_FEDERATED_OPTIMIZER_FEDGAN,
+            FEDML_FEDERATED_OPTIMIZER_FEDGKT,
+            FEDML_FEDERATED_OPTIMIZER_FEDNAS,
             FEDML_FEDERATED_OPTIMIZER_HIERACHICAL_FL,
+            FEDML_FEDERATED_OPTIMIZER_SPLIT_NN,
             FEDML_FEDERATED_OPTIMIZER_TURBO_AGGREGATE,
         )
 
@@ -26,6 +30,14 @@ class SimulatorSingleProcess:
             from .sp.turboaggregate import TurboAggregateTrainer as API
         elif opt == FEDML_FEDERATED_OPTIMIZER_ASYNC_FEDAVG:
             from .sp.async_fedavg import AsyncFedAvgAPI as API
+        elif opt == FEDML_FEDERATED_OPTIMIZER_FEDGAN:
+            from .sp.fedgan import FedGANAPI as API
+        elif opt == FEDML_FEDERATED_OPTIMIZER_FEDGKT:
+            from .sp.fedgkt import FedGKTAPI as API
+        elif opt == FEDML_FEDERATED_OPTIMIZER_FEDNAS:
+            from .sp.fednas import FedNASAPI as API
+        elif opt == FEDML_FEDERATED_OPTIMIZER_SPLIT_NN:
+            from .sp.split_nn import SplitNNAPI as API
         else:
             from .sp.fedavg_api import FedAvgAPI as API
 

@@ -1,0 +1,140 @@
+"""Federated GAN simulation.
+
+Reference: ``simulation/mpi/fedgan/`` — each client runs local GAN steps
+(discriminator on real local data vs generated, generator against the
+discriminator), the server FedAvg-averages BOTH subtrees
+({'generator','discriminator'} — the joint sync the GANPair pytree mirrors).
+
+TPU-first: one client's whole local phase is a single jitted ``lax.scan``
+over (D step, G step) pairs; the non-saturating loss keeps G gradients
+useful early.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ...utils.pytree import stacked_weighted_average, tree_stack
+
+log = logging.getLogger(__name__)
+
+
+class FedGANAPI:
+    def __init__(self, args: Any, device, dataset, model, client_trainer=None, server_aggregator=None):
+        self.args = args
+        [
+            _tr_num, _te_num, _tr_g, self.test_global,
+            self.train_num_dict, self.train_local, _te_local, _cn,
+        ] = dataset
+        self.model = model  # FedModel over GANPair
+        self.latent_dim = int(getattr(model.module, "latent_dim", 64))
+        lr = float(getattr(args, "learning_rate", 2e-4))
+        self.tx = optax.adam(lr, b1=0.5)
+        self._build()
+
+        self.metrics_history: List[Dict[str, float]] = []
+
+    def _build(self) -> None:
+        apply = self.model.module.apply
+        latent = self.latent_dim
+        tx = self.tx
+
+        def d_loss(params, x_real, z, rng):
+            fake = apply({"params": params}, z, method="generate")
+            d_real = apply({"params": params}, x_real, method="discriminate")
+            d_fake = apply({"params": params}, fake, method="discriminate")
+            return (
+                optax.sigmoid_binary_cross_entropy(d_real, jnp.ones_like(d_real)).mean()
+                + optax.sigmoid_binary_cross_entropy(d_fake, jnp.zeros_like(d_fake)).mean()
+            )
+
+        def g_loss(params, z):
+            fake = apply({"params": params}, z, method="generate")
+            d_fake = apply({"params": params}, fake, method="discriminate")
+            # non-saturating: maximize log D(G(z))
+            return optax.sigmoid_binary_cross_entropy(d_fake, jnp.ones_like(d_fake)).mean()
+
+        def _masked(grads, params, subtree):
+            # only update the named subtree; the other half stays fixed
+            return jax.tree_util.tree_map_with_path(
+                lambda path, g: g if subtree in str(path[0]) else jnp.zeros_like(g), grads
+            )
+
+        @jax.jit
+        def local_train(params, x_all, batches_idx, rng):
+            opt_state = tx.init(params)
+
+            def step(carry, batch_idx):
+                params, opt_state, rng = carry
+                rng, zd, zg = jax.random.split(rng, 3)
+                x_real = jnp.take(x_all, batch_idx, axis=0)
+                b = x_real.shape[0]
+                # D step
+                dl, grads = jax.value_and_grad(d_loss)(
+                    params, x_real, jax.random.normal(zd, (b, latent)), rng
+                )
+                updates, opt_state = tx.update(_masked(grads, params, "discriminator"), opt_state, params)
+                params = optax.apply_updates(params, updates)
+                # G step
+                gl, grads = jax.value_and_grad(g_loss)(params, jax.random.normal(zg, (b, latent)))
+                updates, opt_state = tx.update(_masked(grads, params, "generator"), opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state, rng), (dl, gl)
+
+            (params, _, _), (dls, gls) = jax.lax.scan(step, (params, opt_state, rng), batches_idx)
+            return params, dls.mean(), gls.mean()
+
+        self._local_train = local_train
+
+    def _client_batches(self, cid: int, seed: int) -> jnp.ndarray:
+        data = self.train_local[cid]
+        bs = int(getattr(self.args, "batch_size", 32))
+        epochs = int(getattr(self.args, "epochs", 1))
+        rng = np.random.default_rng(seed)
+        n = len(data)
+        nb = max(1, n // bs)
+        idx = np.stack([rng.permutation(n)[: nb * bs].reshape(nb, bs) for _ in range(epochs)])
+        return jnp.asarray(idx.reshape(epochs * nb, bs))
+
+    def train(self) -> Dict[str, float]:
+        args = self.args
+        w_global = self.model.params
+        rounds = int(getattr(args, "comm_round", 2))
+        n_total = int(getattr(args, "client_num_in_total", len(self.train_local)))
+        per_round = min(int(getattr(args, "client_num_per_round", n_total)), n_total)
+        for round_idx in range(rounds):
+            np.random.seed(round_idx)  # reference sampling seed (fedavg_api.py:132)
+            sampled = (
+                list(range(n_total)) if per_round == n_total
+                else list(np.random.choice(range(n_total), per_round, replace=False))
+            )
+            locals_, weights, dl_m, gl_m = [], [], [], []
+            for cid in sampled:
+                x_all = jnp.asarray(self.train_local[cid].x)
+                idx = self._client_batches(cid, round_idx * 1000 + cid)
+                params, dl, gl = self._local_train(w_global, x_all, idx, jax.random.PRNGKey(cid + round_idx))
+                locals_.append(params)
+                weights.append(float(self.train_num_dict[cid]))
+                dl_m.append(float(dl))
+                gl_m.append(float(gl))
+            w = jnp.asarray(weights)
+            w_global = stacked_weighted_average(tree_stack(locals_), w / w.sum())
+            metrics = {
+                "round": round_idx,
+                "d_loss": float(np.mean(dl_m)),
+                "g_loss": float(np.mean(gl_m)),
+            }
+            self.metrics_history.append(metrics)
+            log.info("fedgan round %d: %s", round_idx, metrics)
+        self.model = self.model.clone_with(w_global)
+        return self.metrics_history[-1]
+
+    def generate(self, n: int, seed: int = 0) -> np.ndarray:
+        z = jax.random.normal(jax.random.PRNGKey(seed), (n, self.latent_dim))
+        return np.asarray(self.model.module.apply({"params": self.model.params}, z, method="generate"))

@@ -1,0 +1,185 @@
+"""FedGKT — group knowledge transfer.
+
+Reference: ``simulation/mpi/fedgkt/`` (GKTTrainer client / GKTServerTrainer):
+clients train a small feature extractor + local head with CE plus KL
+distillation from server logits; they upload (features, labels, local
+logits); the server trains the big head on those features with CE plus KL
+from the client logits, and returns per-sample server logits for the next
+round's distillation. Only features/logits cross the boundary — never raw
+data or the big model.
+
+TPU-first: each side's epoch is one jitted scan; the transfer set is a
+static-shaped array batch.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ...models.split_model import SplitClientNet, SplitServerNet
+
+log = logging.getLogger(__name__)
+
+
+def _kl_soft(student_logits, teacher_logits, temperature):
+    s = jax.nn.log_softmax(student_logits / temperature)
+    t = jax.nn.softmax(teacher_logits / temperature)
+    return (t * (jnp.log(jnp.clip(t, 1e-8)) - s)).sum(-1).mean() * temperature**2
+
+
+class FedGKTAPI:
+    def __init__(self, args: Any, device, dataset, model=None, client_trainer=None, server_aggregator=None):
+        self.args = args
+        [
+            _tr_num, _te_num, _tr_g, self.test_global,
+            self.train_num_dict, self.train_local, _te_local, class_num,
+        ] = dataset
+        self.class_num = int(class_num)
+        width = int(getattr(args, "gkt_width", 8))
+        self.temperature = float(getattr(args, "gkt_temperature", 3.0))
+        self.alpha = float(getattr(args, "gkt_alpha", 1.0))  # KD weight
+
+        self.client_net = SplitClientNet(num_classes=self.class_num, width=width, with_logits=True)
+        self.server_net = SplitServerNet(num_classes=self.class_num, width=width, blocks_per_stage=1)
+        key = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        sample = jnp.asarray(self.train_local[0].x[:1])
+        n_clients = int(getattr(args, "client_num_in_total", len(self.train_local)))
+        self.client_params = {
+            cid: self.client_net.init(jax.random.fold_in(key, cid), sample)["params"]
+            for cid in range(n_clients)
+        }
+        feats, _ = self.client_net.apply({"params": self.client_params[0]}, sample)
+        self.server_params = self.server_net.init(jax.random.fold_in(key, 999), feats)["params"]
+
+        lr = float(getattr(args, "learning_rate", 0.01))
+        self.tx_c, self.tx_s = optax.sgd(lr, momentum=0.9), optax.sgd(lr, momentum=0.9)
+        self.opt_s = self.tx_s.init(self.server_params)
+        self._build()
+        self.metrics_history: List[Dict[str, float]] = []
+
+    def _build(self) -> None:
+        c_apply, s_apply = self.client_net.apply, self.server_net.apply
+        T, alpha = self.temperature, self.alpha
+        tx_c, tx_s = self.tx_c, self.tx_s
+
+        @jax.jit
+        def client_epoch(cp, x_all, y_all, server_logits, batches_idx):
+            """CE + KD-from-server on the client's small net."""
+            opt = tx_c.init(cp)
+
+            def loss_fn(cp_, x, y, t_logits):
+                _, logits = c_apply({"params": cp_}, x)
+                ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+                kd = _kl_soft(logits, t_logits, T)
+                return ce + alpha * kd
+
+            def step(carry, bidx):
+                cp_, opt_ = carry
+                x = jnp.take(x_all, bidx, axis=0)
+                y = jnp.take(y_all, bidx, axis=0)
+                tl = jnp.take(server_logits, bidx, axis=0)
+                loss, grads = jax.value_and_grad(loss_fn)(cp_, x, y, tl)
+                updates, opt_ = tx_c.update(grads, opt_, cp_)
+                return (optax.apply_updates(cp_, updates), opt_), loss
+
+            (cp, _), losses = jax.lax.scan(step, (cp, opt), batches_idx)
+            return cp, losses.mean()
+
+        @jax.jit
+        def client_extract(cp, x_all):
+            feats, logits = c_apply({"params": cp}, x_all)
+            return feats, logits
+
+        @jax.jit
+        def server_epoch(sp, opt_s, feats_all, y_all, client_logits, batches_idx):
+            """CE + KD-from-client on the big head over transferred features."""
+
+            def loss_fn(sp_, f, y, t_logits):
+                logits = s_apply({"params": sp_}, f)
+                ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+                kd = _kl_soft(logits, t_logits, T)
+                return ce + alpha * kd
+
+            def step(carry, bidx):
+                sp_, opt_ = carry
+                f = jnp.take(feats_all, bidx, axis=0)
+                y = jnp.take(y_all, bidx, axis=0)
+                tl = jnp.take(client_logits, bidx, axis=0)
+                loss, grads = jax.value_and_grad(loss_fn)(sp_, f, y, tl)
+                updates, opt_ = tx_s.update(grads, opt_, sp_)
+                return (optax.apply_updates(sp_, updates), opt_), loss
+
+            (sp, opt_s), losses = jax.lax.scan(step, (sp, opt_s), batches_idx)
+            return sp, opt_s, losses.mean()
+
+        @jax.jit
+        def server_logits_for(sp, feats_all):
+            return s_apply({"params": sp}, feats_all)
+
+        self._client_epoch = client_epoch
+        self._client_extract = client_extract
+        self._server_epoch = server_epoch
+        self._server_logits_for = server_logits_for
+
+    def _batches(self, n: int, seed: int) -> jnp.ndarray:
+        bs = int(getattr(self.args, "batch_size", 32))
+        epochs = int(getattr(self.args, "epochs", 1))
+        rng = np.random.default_rng(seed)
+        nb = max(1, n // bs)
+        idx = np.stack([rng.permutation(n)[: nb * bs].reshape(nb, bs) for _ in range(epochs)])
+        return jnp.asarray(idx.reshape(epochs * nb, bs))
+
+    def train(self) -> Dict[str, float]:
+        args = self.args
+        rounds = int(getattr(args, "comm_round", 2))
+        n_clients = int(getattr(args, "client_num_in_total", len(self.train_local)))
+        server_logits: Dict[int, Optional[jnp.ndarray]] = {c: None for c in range(n_clients)}
+        for round_idx in range(rounds):
+            feats_bank, labels_bank, logit_bank = [], [], []
+            c_losses = []
+            for cid in range(n_clients):
+                data = self.train_local[cid]
+                x_all, y_all = jnp.asarray(data.x), jnp.asarray(data.y)
+                t_logits = server_logits[cid]
+                if t_logits is None:
+                    t_logits = jnp.zeros((len(data), self.class_num), jnp.float32)
+                cp, loss = self._client_epoch(
+                    self.client_params[cid], x_all, y_all, t_logits, self._batches(len(data), round_idx * 97 + cid)
+                )
+                self.client_params[cid] = cp
+                c_losses.append(float(loss))
+                feats, logits = self._client_extract(cp, x_all)
+                feats_bank.append((cid, feats, y_all, logits))
+            # ── boundary: only (features, labels, logits) reach the server ──
+            s_losses = []
+            for cid, feats, y_all, logits in feats_bank:
+                self.server_params, self.opt_s, s_loss = self._server_epoch(
+                    self.server_params, self.opt_s, feats, y_all, logits,
+                    self._batches(feats.shape[0], round_idx * 131 + cid),
+                )
+                s_losses.append(float(s_loss))
+            for cid, feats, _, _ in feats_bank:
+                server_logits[cid] = self._server_logits_for(self.server_params, feats)
+            metrics = self._test()
+            metrics.update(round=round_idx, client_loss=float(np.mean(c_losses)), server_loss=float(np.mean(s_losses)))
+            self.metrics_history.append(metrics)
+            log.info("fedgkt round %d: %s", round_idx, metrics)
+        return self.metrics_history[-1]
+
+    def _test(self) -> Dict[str, float]:
+        """Edge + server pipeline on the global test set (client 0's
+        extractor, as the reference evaluates the deployed pair)."""
+        cp = self.client_params[0]
+        correct = total = 0.0
+        for bx, by in self.test_global.batches(64):
+            feats, _ = self._client_extract(cp, jnp.asarray(bx))
+            logits = self._server_logits_for(self.server_params, feats)
+            correct += float((jnp.argmax(logits, -1) == jnp.asarray(by)).sum())
+            total += len(by)
+        return {"test_acc": correct / max(total, 1.0), "test_total": total}

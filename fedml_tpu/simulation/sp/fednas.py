@@ -1,0 +1,146 @@
+"""FedNAS — federated neural architecture search (DARTS supernet).
+
+Reference: ``simulation/mpi/fednas/`` — each client alternates DARTS
+bi-level steps: architecture parameters (alphas) update on its validation
+split, operation weights update on its training split; the server FedAvg
+averages weights AND alphas, and the final architecture is the argmax
+genotype of the averaged alphas.
+
+TPU-first: alphas live inside the same pytree (params['arch'],
+models/darts.py:96), so the alternation is two masked optimizer steps in one
+jitted scan, and federated averaging needs no special casing.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ...models.darts import derive_genotype
+from ...utils.pytree import stacked_weighted_average, tree_stack
+
+log = logging.getLogger(__name__)
+
+
+def _mask(tree, arch: bool):
+    """Zero out either the arch subtree (weights step) or everything else
+    (alphas step)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, g: g if (("arch" in str(path[0])) == arch) else jnp.zeros_like(g), tree
+    )
+
+
+class FedNASAPI:
+    def __init__(self, args: Any, device, dataset, model, client_trainer=None, server_aggregator=None):
+        self.args = args
+        [
+            _tr_num, _te_num, _tr_g, self.test_global,
+            self.train_num_dict, self.train_local, _te_local, self.class_num,
+        ] = dataset
+        self.model = model  # FedModel over DARTSNetwork
+        w_lr = float(getattr(args, "learning_rate", 0.025))
+        a_lr = float(getattr(args, "arch_learning_rate", 3e-3))
+        self.tx_w = optax.sgd(w_lr, momentum=0.9)
+        self.tx_a = optax.adam(a_lr)
+        self._build()
+        self.metrics_history: List[Dict[str, float]] = []
+
+    def _build(self) -> None:
+        apply = self.model.module.apply
+        tx_w, tx_a = self.tx_w, self.tx_a
+
+        def ce(params, x, y):
+            logits = apply({"params": params}, x)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+        @jax.jit
+        def local_search(params, x_tr, y_tr, x_val, y_val, tr_idx, val_idx):
+            opt_w = tx_w.init(params)
+            opt_a = tx_a.init(params)
+
+            def step(carry, inputs):
+                params, opt_w, opt_a = carry
+                bi_tr, bi_val = inputs
+                # 1) alpha step on the validation batch (bi-level outer)
+                loss_a, grads = jax.value_and_grad(ce)(
+                    params, jnp.take(x_val, bi_val, axis=0), jnp.take(y_val, bi_val, axis=0)
+                )
+                updates, opt_a = tx_a.update(_mask(grads, arch=True), opt_a, params)
+                params = optax.apply_updates(params, updates)
+                # 2) weight step on the training batch (inner)
+                loss_w, grads = jax.value_and_grad(ce)(
+                    params, jnp.take(x_tr, bi_tr, axis=0), jnp.take(y_tr, bi_tr, axis=0)
+                )
+                updates, opt_w = tx_w.update(_mask(grads, arch=False), opt_w, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_w, opt_a), (loss_w, loss_a)
+
+            (params, _, _), (lw, la) = jax.lax.scan(step, (params, opt_w, opt_a), (tr_idx, val_idx))
+            return params, lw.mean(), la.mean()
+
+        @jax.jit
+        def predict(params, x):
+            return apply({"params": params}, x)
+
+        self._local_search = local_search
+        self._predict = predict
+
+    def _split_batches(self, cid: int, seed: int):
+        """Client data halved into train/val (reference fednas data split)."""
+        data = self.train_local[cid]
+        n = len(data)
+        half = max(1, n // 2)
+        bs = min(int(getattr(self.args, "batch_size", 32)), half)
+        epochs = int(getattr(self.args, "epochs", 1))
+        rng = np.random.default_rng(seed)
+        nb = max(1, half // bs)
+
+        def idx(offset):
+            return jnp.asarray(
+                np.stack([
+                    offset + rng.permutation(half)[: nb * bs].reshape(nb, bs) for _ in range(epochs)
+                ]).reshape(epochs * nb, bs)
+            )
+
+        x, y = jnp.asarray(data.x), jnp.asarray(data.y)
+        return x[:half], y[:half], x[half : 2 * half], y[half : 2 * half], idx(0), idx(0)
+
+    def train(self) -> Dict[str, float]:
+        args = self.args
+        w_global = self.model.params
+        rounds = int(getattr(args, "comm_round", 2))
+        n_clients = int(getattr(args, "client_num_in_total", len(self.train_local)))
+        for round_idx in range(rounds):
+            locals_, weights, lw_m, la_m = [], [], [], []
+            for cid in range(n_clients):
+                x_tr, y_tr, x_val, y_val, tr_idx, val_idx = self._split_batches(cid, round_idx * 31 + cid)
+                params, lw, la = self._local_search(w_global, x_tr, y_tr, x_val, y_val, tr_idx, val_idx)
+                locals_.append(params)
+                weights.append(float(self.train_num_dict[cid]))
+                lw_m.append(float(lw))
+                la_m.append(float(la))
+            w = jnp.asarray(weights)
+            w_global = stacked_weighted_average(tree_stack(locals_), w / w.sum())
+            metrics = self._test(w_global)
+            metrics.update(round=round_idx, weight_loss=float(np.mean(lw_m)), arch_loss=float(np.mean(la_m)))
+            self.metrics_history.append(metrics)
+            log.info("fednas round %d: %s", round_idx, metrics)
+        self.model = self.model.clone_with(w_global)
+        return self.metrics_history[-1]
+
+    def genotype(self):
+        """Discretized searched architecture from the averaged alphas."""
+        return derive_genotype(np.asarray(self.model.params["arch"]))
+
+    def _test(self, params) -> Dict[str, float]:
+        correct = total = 0.0
+        for bx, by in self.test_global.batches(64):
+            logits = self._predict(params, jnp.asarray(bx))
+            correct += float((jnp.argmax(logits, -1) == jnp.asarray(by)).sum())
+            total += len(by)
+        return {"test_acc": correct / max(total, 1.0), "test_total": total}
