@@ -26,6 +26,18 @@ from .communication.message import Message
 log = logging.getLogger(__name__)
 
 
+def _run_id_offset(run_id: Any) -> int:
+    """Stable small port offset from a run id (which may be a string —
+    reference run ids are MLOps-assigned strings, ``fedml_comm_manager.py:193``
+    derives ports from them the same way)."""
+    try:
+        return int(run_id or 0) % 1000
+    except (TypeError, ValueError):
+        import zlib
+
+        return zlib.crc32(str(run_id).encode()) % 1000
+
+
 class FedMLCommManager(Observer):
     def __init__(self, args: Any, comm=None, rank: int = 0, size: int = 0, backend: str = COMM_BACKEND_INMEMORY):
         self.args = args
@@ -93,7 +105,7 @@ class FedMLCommManager(Observer):
                 ip_config_path=getattr(self.args, "grpc_ipconfig_path", None),
                 client_id=self.rank,
                 client_num=self.size - 1,
-                base_port=int(getattr(self.args, "grpc_base_port", 8890)) + int(getattr(self.args, "run_id", 0) or 0) % 1000,
+                base_port=int(getattr(self.args, "grpc_base_port", 8890)) + _run_id_offset(getattr(self.args, "run_id", 0)),
             )
         elif self.backend == COMM_BACKEND_MQTT_S3:
             from .communication.mqtt_s3.mqtt_s3_comm_manager import MqttS3MultiClientsCommManager
