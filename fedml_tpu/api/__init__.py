@@ -35,9 +35,19 @@ def _launch_manager(num_edges: int = 1):
     return manager
 
 
-def launch_job(yaml_file: str, num_edges: int = 1, timeout_s: float = 600.0) -> Dict[int, Any]:
-    """Parse job yaml, build its package, dispatch onto local edge agents and
-    wait for completion statuses (reference launch_job -> FedMLLaunchManager)."""
+def launch_job(
+    yaml_file: str, num_edges: int = 1, timeout_s: float = 600.0, backend: str = "local"
+) -> Dict[int, Any]:
+    """Parse job yaml, build its package, dispatch onto edge agents and wait
+    for completion statuses (reference launch_job -> FedMLLaunchManager).
+
+    backend="local": in-process edge runners. backend="MQTT": persistent
+    agents speaking the reference's flserver_agent/... topics over the
+    broker, package shipped through the object store."""
+    if backend.upper() == "MQTT":
+        from ..computing.scheduler.launch_manager import launch_job_over_mqtt
+
+        return launch_job_over_mqtt(yaml_file, num_edges=num_edges, timeout_s=timeout_s)
     return _launch_manager(num_edges).launch_job(yaml_file, timeout_s=timeout_s)
 
 

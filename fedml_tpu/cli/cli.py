@@ -31,8 +31,10 @@ def cli() -> None:
 @click.argument("yaml_file", type=click.Path(exists=True))
 @click.option("--edges", "-e", default=1, type=int, help="number of local edge agents")
 @click.option("--timeout", "-t", default=600.0, type=float)
-def fedml_launch(yaml_file: str, edges: int, timeout: float) -> None:
-    statuses = api.launch_job(yaml_file, num_edges=edges, timeout_s=timeout)
+@click.option("--backend", "-b", default="local", type=click.Choice(["local", "mqtt"], case_sensitive=False),
+              help="dispatch plane: in-process runners or persistent MQTT agents")
+def fedml_launch(yaml_file: str, edges: int, timeout: float, backend: str) -> None:
+    statuses = api.launch_job(yaml_file, num_edges=edges, timeout_s=timeout, backend=backend)
     for edge_id, st in sorted(statuses.items()):
         click.echo(f"edge {edge_id}: {getattr(st, 'status', st)}")
 
@@ -173,5 +175,10 @@ def fedml_device(action: str) -> None:
     click.echo(f"{action}: local edges {sorted(manager.edges)}")
 
 
-if __name__ == "__main__":
+def main() -> None:
+    """Console-script entry (pyproject [project.scripts])."""
     cli()
+
+
+if __name__ == "__main__":
+    main()

@@ -73,3 +73,47 @@ class FedMLLaunchManager:
             edge_ids=edge_ids,
             timeout_s=timeout_s,
         )
+
+
+def launch_job_over_mqtt(
+    job_yaml_path: str, *, num_edges: int = 1, timeout_s: float = 600.0, args=None
+) -> Dict[int, "RunStatus"]:
+    """Launch a job.yaml through persistent MQTT agents (reference topics +
+    object-store package plane) and block for terminal statuses. The agents
+    and a JobMonitor live for the call; in a deployment they run as daemons
+    (``fedml-tpu launch --backend mqtt`` / devops manifests)."""
+    from .job_config import FedMLJobConfig
+    from .mqtt_agents import JobMonitor, MqttClientAgent, MqttServerAgent
+
+    config = FedMLJobConfig(job_yaml_path)
+    config.validate()
+    agents: list = []
+    monitor = None
+    server = None
+    try:
+        agents = [MqttClientAgent(eid, args) for eid in range(num_edges)]
+        monitor = JobMonitor(agents)
+        monitor.start()
+        server = MqttServerAgent(list(range(num_edges)), args)
+        run_id = server.dispatch_workspace(
+            config.workspace, config.job, bootstrap_cmd=config.bootstrap
+        )
+        raw = server.wait_for_run(run_id, timeout_s=timeout_s)
+        return {
+            eid: RunStatus(
+                run_id=str(doc.get("run_id", run_id)),
+                edge_id=eid,
+                status=str(doc.get("status", "TIMEOUT")),
+                returncode=doc.get("returncode"),
+                log_path=doc.get("log_path"),
+                detail=str(doc.get("detail", "")),
+            )
+            for eid, doc in raw.items()
+        }
+    finally:
+        if monitor is not None:
+            monitor.stop()
+        if server is not None:
+            server.stop()
+        for a in agents:
+            a.stop()

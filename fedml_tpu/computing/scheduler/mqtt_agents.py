@@ -1,0 +1,242 @@
+"""Persistent device agents over the MQTT message plane.
+
+Reference: ``computing/scheduler/slave/client_runner.py:61``
+(FedMLClientRunner — topic handler ``callback_start_train:909``, package
+download ``retrieve_and_unzip_package:255``, job exec ``execute_job_task:619``,
+``ota_upgrade:866``) and ``master/server_runner.py:70`` (dispatch to
+``flserver_agent/<edge>/start_train`` at ``:1383``), plus the job monitor
+(``comm_utils/job_monitor.py:37``).
+
+Topic scheme (kept verbatim from the reference so dashboards/tools match):
+
+    flserver_agent/{edge_id}/start_train   server -> edge   job request
+    flserver_agent/{edge_id}/stop_train    server -> edge   kill request
+    flclient_agent/{edge_id}/ota           server -> edge   agent upgrade
+    fl_client/flclient_agent_{edge_id}/status  edge -> server  run status
+
+Job packages travel through the object store (zip blob + url in the MQTT
+json), exactly the reference's MQTT+S3 split. Agents are long-lived: they
+subscribe once and serve any number of runs; the JobMonitor thread detects
+processes that die without reporting and publishes the lost status.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+import uuid
+from dataclasses import asdict
+from typing import Any, Callable, Dict, List, Optional
+
+from ...core.distributed.communication.mqtt_s3.mqtt_transport import create_mqtt_transport
+from ...core.distributed.communication.mqtt_s3.object_store import LocalObjectStore
+from .agents import FedMLClientRunner, RunStatus
+from .package import build_job_package
+
+log = logging.getLogger(__name__)
+
+AGENT_VERSION = "0.2.0"
+
+TOPIC_START = "flserver_agent/{edge_id}/start_train"
+TOPIC_STOP = "flserver_agent/{edge_id}/stop_train"
+TOPIC_OTA = "flclient_agent/{edge_id}/ota"
+TOPIC_STATUS = "fl_client/flclient_agent_{edge_id}/status"
+
+TERMINAL = {"FINISHED", "FAILED", "KILLED"}
+
+
+class MqttClientAgent:
+    """Always-on slave agent: subscribes to its start/stop/OTA topics and
+    executes job packages as subprocesses, streaming status back."""
+
+    def __init__(
+        self,
+        edge_id: int,
+        args: Any = None,
+        *,
+        base_dir: Optional[str] = None,
+        store: Optional[LocalObjectStore] = None,
+    ):
+        self.edge_id = int(edge_id)
+        self.version = AGENT_VERSION
+        self.transport = create_mqtt_transport(args, client_id=f"edge_agent_{edge_id}")
+        self.store = store or LocalObjectStore()
+        self.runner = FedMLClientRunner(
+            self.edge_id,
+            base_dir=base_dir or os.path.join(tempfile.gettempdir(), f"fedml_tpu_mqtt_edge_{edge_id}"),
+            status_callback=self._publish_status,
+        )
+        self.transport.subscribe(TOPIC_START.format(edge_id=self.edge_id), self._on_start)
+        self.transport.subscribe(TOPIC_STOP.format(edge_id=self.edge_id), self._on_stop)
+        self.transport.subscribe(TOPIC_OTA.format(edge_id=self.edge_id), self._on_ota)
+        log.info("edge agent %d online (v%s)", self.edge_id, self.version)
+
+    # --- topic handlers --------------------------------------------------
+    def _on_start(self, _topic: str, payload: bytes) -> None:
+        request = json.loads(payload)
+        run_id = str(request.get("run_id") or uuid.uuid4().hex[:8])
+        package_url = request.get("package_url")
+        local_pkg = os.path.join(self.runner.base_dir, "packages", f"{run_id}.zip")
+        try:
+            self.store.fetch_file(package_url, local_pkg)
+        except Exception as e:  # noqa: BLE001 - download boundary
+            self._publish_status(
+                RunStatus(run_id=run_id, edge_id=self.edge_id, status="FAILED", detail=f"download: {e!r}")
+            )
+            return
+        request = dict(request, run_id=run_id, package_path=local_pkg)
+        # non-blocking: the agent must keep serving its topics during the job
+        self.runner.callback_start_train(request, wait=False)
+
+    def _on_stop(self, _topic: str, payload: bytes) -> None:
+        run_id = str(json.loads(payload).get("run_id", ""))
+        self.runner.callback_stop_train(run_id)
+
+    def _on_ota(self, _topic: str, payload: bytes) -> None:
+        """OTA upgrade (reference client_runner.py:866): adopt the announced
+        version and confirm over the status topic."""
+        target = str(json.loads(payload).get("version", self.version))
+        old, self.version = self.version, target
+        self.transport.publish(
+            TOPIC_STATUS.format(edge_id=self.edge_id),
+            json.dumps({"type": "ota", "edge_id": self.edge_id, "from": old, "to": target}).encode(),
+        )
+
+    def _publish_status(self, st: RunStatus) -> None:
+        doc = asdict(st)
+        doc["type"] = "run_status"
+        self.transport.publish(TOPIC_STATUS.format(edge_id=self.edge_id), json.dumps(doc).encode())
+
+    def stop(self) -> None:
+        self.transport.disconnect()
+
+
+class MqttServerAgent:
+    """Master agent: packages the workspace, ships it through the object
+    store, fans start_train out over MQTT, and gates on status messages."""
+
+    def __init__(self, edge_ids: List[int], args: Any = None, *, store: Optional[LocalObjectStore] = None):
+        self.edge_ids = [int(e) for e in edge_ids]
+        self.transport = create_mqtt_transport(args, client_id="server_agent")
+        self.store = store or LocalObjectStore()
+        self.statuses: Dict[str, Dict[int, Dict[str, Any]]] = {}
+        self.ota_acks: List[Dict[str, Any]] = []
+        self._cv = threading.Condition()
+        for eid in self.edge_ids:
+            self.transport.subscribe(TOPIC_STATUS.format(edge_id=eid), self._on_status)
+
+    def _on_status(self, _topic: str, payload: bytes) -> None:
+        doc = json.loads(payload)
+        with self._cv:
+            if doc.get("type") == "ota":
+                self.ota_acks.append(doc)
+            else:
+                self.statuses.setdefault(str(doc["run_id"]), {})[int(doc["edge_id"])] = doc
+            self._cv.notify_all()
+
+    # --- dispatch --------------------------------------------------------
+    def dispatch_workspace(
+        self,
+        workspace: str,
+        job_cmd: str,
+        *,
+        bootstrap_cmd: Optional[str] = None,
+        env: Optional[Dict[str, str]] = None,
+        edge_ids: Optional[List[int]] = None,
+        run_id: Optional[str] = None,
+    ) -> str:
+        run_id = run_id or uuid.uuid4().hex[:8]
+        pkg_local = os.path.join(tempfile.gettempdir(), f"fedml_pkg_{run_id}.zip")
+        build_job_package(workspace, pkg_local, meta={"run_id": run_id})
+        url = self.store.write_file(f"job_package_{run_id}", pkg_local)
+        request = {
+            "run_id": run_id,
+            "package_url": url,
+            "job_cmd": job_cmd,
+            "bootstrap_cmd": bootstrap_cmd,
+            "env": env or {},
+        }
+        for eid in edge_ids if edge_ids is not None else self.edge_ids:
+            self.transport.publish(TOPIC_START.format(edge_id=eid), json.dumps(request).encode())
+        return run_id
+
+    def stop_run(self, run_id: str, edge_ids: Optional[List[int]] = None) -> None:
+        for eid in edge_ids if edge_ids is not None else self.edge_ids:
+            self.transport.publish(
+                TOPIC_STOP.format(edge_id=eid), json.dumps({"run_id": run_id}).encode()
+            )
+
+    def push_ota(self, version: str, edge_ids: Optional[List[int]] = None) -> None:
+        for eid in edge_ids if edge_ids is not None else self.edge_ids:
+            self.transport.publish(
+                TOPIC_OTA.format(edge_id=eid), json.dumps({"version": version}).encode()
+            )
+
+    def wait_for_run(
+        self, run_id: str, *, edge_ids: Optional[List[int]] = None, timeout_s: float = 600.0
+    ) -> Dict[int, Dict[str, Any]]:
+        """Block until every dispatched edge reports a terminal status."""
+        targets = set(edge_ids if edge_ids is not None else self.edge_ids)
+        deadline = time.time() + timeout_s
+        with self._cv:
+            while True:
+                got = self.statuses.get(run_id, {})
+                done = {e for e, d in got.items() if d.get("status") in TERMINAL}
+                if targets <= done:
+                    return {e: got[e] for e in targets}
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return {e: got.get(e, {"status": "TIMEOUT", "edge_id": e}) for e in targets}
+                self._cv.wait(timeout=min(remaining, 1.0))
+
+    def stop(self) -> None:
+        self.transport.disconnect()
+
+
+class JobMonitor:
+    """Liveness loop (reference comm_utils/job_monitor.py:37): polls agents'
+    running jobs; a process that died without a terminal report gets one."""
+
+    def __init__(self, agents: List[MqttClientAgent], poll_s: float = 1.0):
+        self.agents = agents
+        self.poll_s = poll_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.repairs: List[str] = []
+
+    def check_once(self) -> List[str]:
+        fixed = []
+        for agent in self.agents:
+            for run_id, proc in list(agent.runner._procs.items()):
+                st = agent.runner.runs.get(run_id)
+                if st is None or st.status in TERMINAL:
+                    continue
+                rc = proc.poll()
+                if rc is not None and st.status == "RUNNING":
+                    # give the runner's own waiter a beat to report first
+                    time.sleep(0.2)
+                    if agent.runner.runs[run_id].status == "RUNNING":
+                        st.returncode = rc
+                        st.status = "FINISHED" if rc == 0 else "FAILED"
+                        st.detail = "recovered by job monitor"
+                        agent._publish_status(st)
+                        fixed.append(run_id)
+        self.repairs.extend(fixed)
+        return fixed
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.poll_s):
+                self.check_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5.0)

@@ -34,6 +34,24 @@ class LocalObjectStore:
         with open(path, "rb") as f:
             return deserialize_pytree(f.read())
 
+    # raw blobs (job packages, model bundles — reference S3Storage also
+    # ships zip packages, slave/client_runner.py:255 downloads them)
+    def write_file(self, message_key: str, src_path: str) -> str:
+        import shutil
+
+        key = f"{message_key}_{uuid.uuid4().hex[:8]}{os.path.splitext(src_path)[1]}"
+        dst = os.path.join(self.root, key)
+        shutil.copyfile(src_path, dst)  # constant-memory (packages can be GBs)
+        return f"file://{dst}"
+
+    def fetch_file(self, url: str, dst_path: str) -> str:
+        import shutil
+
+        path = url[len("file://") :] if url.startswith("file://") else url
+        os.makedirs(os.path.dirname(os.path.abspath(dst_path)), exist_ok=True)
+        shutil.copyfile(path, dst_path)
+        return dst_path
+
 
 class S3ObjectStore:  # pragma: no cover - requires boto3 + credentials
     def __init__(self, bucket: str, prefix: str = "fedml"):

@@ -71,6 +71,15 @@ def load(args: Any) -> FedDataset:
         args.output_dim = class_num
         return (len(train_g), len(test_g), train_g, test_g, train_num_dict, train_local, test_local, class_num)
 
+    from .formats import detect_format_files, load_native_format
+
+    if detect_format_files(dataset, cache):
+        # real reference-format files present (LEAF json / TFF h5): use them
+        # with the file's own client partition
+        fed = load_native_format(dataset, cache, client_num)
+        args.output_dim = fed[-1]
+        return fed
+
     if dataset in TEXT_DATASETS:
         x_tr, y_tr, x_te, y_te, vocab = load_text_dataset(dataset, cache, seed)
         class_num = vocab

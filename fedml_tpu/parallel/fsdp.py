@@ -135,15 +135,28 @@ def make_fsdp_train_step(
 
 def _opt_state_shardings(tx, sharded_params, mesh, rules):
     """Optimizer-state leaves that mirror a param take its sharding (ZeRO);
-    scalars replicate."""
+    scalars replicate.
+
+    Moment leaves are matched to their param by PATH, not by shape: optax
+    state trees (e.g. adam's mu/nu) embed the full param path as a suffix of
+    the state leaf's path, and two same-shaped params can carry different
+    PartitionSpecs (q_proj vs o_proj), so shape-keyed lookup would silently
+    mis-shard one of them."""
     shape_state = jax.eval_shape(tx.init, sharded_params)
     p_shardings = param_shardings(sharded_params, mesh, rules)
-    flat_params = {leaf.shape for leaf in jax.tree.leaves(sharded_params)}
-    by_shape = {}
-    for leaf, sh in zip(jax.tree.leaves(sharded_params), jax.tree.leaves(p_shardings)):
-        by_shape.setdefault(leaf.shape, sh)
+    by_path = {
+        _path_str(path): (sh, leaf.shape)
+        for (path, sh), leaf in zip(
+            jax.tree_util.tree_flatten_with_path(p_shardings)[0],
+            jax.tree.leaves(sharded_params),
+        )
+    }
 
-    def pick(leaf):
-        return by_shape.get(leaf.shape, NamedSharding(mesh, P()))
+    def pick(path, leaf):
+        s = _path_str(path)
+        for p_path, (sh, p_shape) in by_path.items():
+            if (s == p_path or s.endswith("/" + p_path)) and leaf.shape == p_shape:
+                return sh
+        return NamedSharding(mesh, P())
 
-    return jax.tree.map(pick, shape_state)
+    return jax.tree_util.tree_map_with_path(pick, shape_state)

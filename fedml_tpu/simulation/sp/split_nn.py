@@ -36,7 +36,7 @@ class SplitNNAPI:
             self.train_num_dict, self.train_local, _te_local, class_num,
         ] = dataset
         self.class_num = int(class_num)
-        width = int(getattr(args, "split_width", 8))
+        width = int(getattr(args, "split_width", 16))
         self.client_net = SplitClientNet(num_classes=self.class_num, width=width, with_logits=False)
         self.server_net = SplitServerNet(num_classes=self.class_num, width=width, blocks_per_stage=1)
 
@@ -46,9 +46,10 @@ class SplitNNAPI:
         feats = self.client_net.apply({"params": self.client_params}, sample)
         self.server_params = self.server_net.init(jax.random.fold_in(key, 1), feats)["params"]
 
-        lr = float(getattr(args, "learning_rate", 0.01))
         # adam: the split boundary decouples the two halves' gradient scales,
-        # which plain SGD handles poorly on the narrow client stem
+        # which plain SGD handles poorly on the narrow client stem. The config
+        # learning_rate is tuned for SGD; adam needs its own (capped) scale.
+        lr = float(getattr(args, "split_learning_rate", min(float(getattr(args, "learning_rate", 1e-3)), 1e-3)))
         self.tx_c = optax.adam(lr)
         self.tx_s = optax.adam(lr)
         self.opt_c = self.tx_c.init(self.client_params)

@@ -15,6 +15,10 @@ from typing import Any, Optional, Tuple
 @dataclasses.dataclass
 class ModelArguments:
     model_name: str = "llama"          # llama | gpt | transformer preset
+    # Local HF checkpoint dir (config.json + *.safetensors [+ tokenizer.json]).
+    # When set, geometry comes from its config.json and weights are imported
+    # (reference configurations.py:141 model_name_or_path).
+    model_name_or_path: Optional[str] = None
     vocab_size: int = 32000
     d_model: int = 512
     n_layers: int = 4
@@ -22,6 +26,7 @@ class ModelArguments:
     n_kv_heads: int = 8
     d_ff: int = 1376
     seq_len: int = 512
+    rope_theta: float = 10000.0
     attention_impl: str = "xla"        # xla | pallas | ring
     lora_rank: int = 8
     lora_alpha: float = 16.0
@@ -32,11 +37,27 @@ class ModelArguments:
         fields = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: getattr(args, k) for k in fields if hasattr(args, k)})
 
+    def resolve_pretrained(self) -> "ModelArguments":
+        """Overwrite geometry from the local checkpoint's config.json."""
+        if not self.model_name_or_path:
+            return self
+        from .checkpoint_import import config_from_hf
+
+        cfg = config_from_hf(self.model_name_or_path)
+        return dataclasses.replace(
+            self,
+            vocab_size=cfg.vocab_size, d_model=cfg.d_model, n_layers=cfg.n_layers,
+            n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
+            rope_theta=cfg.rope_theta,
+        )
+
 
 @dataclasses.dataclass
 class DatasetArguments:
     dataset_name: str = "synthetic_text"
-    dataset_path: Optional[str] = None
+    dataset_path: Optional[str] = None  # local .txt/.jsonl file or dir
+    tokenizer_path: Optional[str] = None  # tokenizer.json or checkpoint dir
+    text_key: str = "text"              # jsonl field holding the text
     max_seq_length: int = 512
     num_train_samples: int = 2048
 
@@ -45,6 +66,8 @@ class DatasetArguments:
         return cls(
             dataset_name=str(getattr(args, "llm_dataset", "synthetic_text")),
             dataset_path=getattr(args, "llm_dataset_path", None),
+            tokenizer_path=getattr(args, "llm_tokenizer_path", None),
+            text_key=str(getattr(args, "llm_text_key", "text")),
             max_seq_length=int(getattr(args, "seq_len", 512)),
             num_train_samples=int(getattr(args, "num_train_samples", 2048)),
         )

@@ -32,7 +32,9 @@ class LLMClientTrainer(ClientTrainer):
 
     # --- adapter-only exchange -------------------------------------------
     def get_model_params(self):
-        adapters, _ = split_lora(__import__("jax").device_get(self.llm.params))
+        import jax
+
+        adapters, _ = split_lora(jax.device_get(self.llm.params))
         return adapters
 
     def set_model_params(self, model_parameters) -> None:
@@ -44,25 +46,40 @@ class LLMClientTrainer(ClientTrainer):
         self.llm.params = jax.device_put(merged, param_shardings(merged, self.llm.mesh))
 
     def train(self, train_data, device=None, args: Any = None) -> None:
+        """One federated round of local steps.
+
+        train_data: an ArrayDataset whose .x is an [N, seq_len] int token
+        array (the FL data plane ships packed token blocks), a TextDataset,
+        or None -> synthetic stream. Shards smaller than one global batch
+        wrap around (TextDataset.batches) instead of yielding short batches."""
+        import numpy as np
+
+        from .data import TextDataset
+
         args = args or self.args
         steps = int(getattr(args, "local_steps", self.llm.exp_args.max_steps))
-        if train_data is not None and hasattr(train_data, "x"):
-            import numpy as np
-
-            bs = self.llm.exp_args.per_device_batch_size * max(1, self.llm.mesh.devices.size)
-            x = np.asarray(train_data.x)
-            batches = (
-                (x[i % max(1, len(x) // bs) * bs : i % max(1, len(x) // bs) * bs + bs], None)
-                for i in range(steps)
-            )
-            batches = ((b, __import__("numpy").ones_like(b, dtype="float32")) for b, _ in batches)
+        bs = self.llm.exp_args.per_device_batch_size * max(1, self.llm.mesh.devices.size)
+        # distinct data each round: seed mixes the round counter, else every
+        # round would replay the shard's same first steps*bs blocks
+        self._round = getattr(self, "_round", 0) + 1
+        seed = int(self.id or 0) * 100003 + self._round
+        if isinstance(train_data, TextDataset):
+            batches = train_data.batches(bs, steps, seed=seed)
+        elif train_data is not None and hasattr(train_data, "x"):
+            blocks = np.asarray(train_data.x, np.int32)
+            if blocks.ndim != 2 or blocks.shape[1] != self.llm.model_args.seq_len:
+                raise ValueError(
+                    f"LLM client data must be [N, seq_len={self.llm.model_args.seq_len}] "
+                    f"token blocks, got {blocks.shape}"
+                )
+            batches = TextDataset(blocks).batches(bs, steps, seed=seed)
         else:
             batches = synthetic_token_batches(
                 self.llm.cfg.vocab_size,
                 self.llm.model_args.seq_len,
-                self.llm.exp_args.per_device_batch_size * max(1, self.llm.mesh.devices.size),
+                bs,
                 steps,
-                seed=self.id,
+                seed=seed,
             )
         self.llm.exp_args.max_steps = steps
         metrics = self.llm.train(batches)

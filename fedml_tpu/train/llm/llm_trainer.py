@@ -47,6 +47,19 @@ def synthetic_token_batches(
         yield toks, np.ones_like(toks, np.float32)
 
 
+def _overlay(base: dict, new: dict) -> dict:
+    """Recursively overwrite matching leaves of `base` with `new` (shape-checked)."""
+    out = dict(base)
+    for k, v in new.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _overlay(dict(out[k]), v)
+        else:
+            if k in out and hasattr(out[k], "shape") and tuple(out[k].shape) != tuple(np.shape(v)):
+                raise ValueError(f"shape mismatch for {k}: {out[k].shape} vs {np.shape(v)}")
+            out[k] = jnp.asarray(v)
+    return out
+
+
 class LLMTrainer:
     def __init__(
         self,
@@ -55,7 +68,7 @@ class LLMTrainer:
         exp_args: ExperimentArguments,
         devices=None,
     ):
-        self.model_args = model_args
+        self.model_args = model_args = model_args.resolve_pretrained()
         self.data_args = data_args
         self.exp_args = exp_args
         self.cfg = TransformerConfig(
@@ -66,6 +79,7 @@ class LLMTrainer:
             n_kv_heads=model_args.n_kv_heads,
             d_ff=model_args.d_ff,
             max_seq_len=model_args.seq_len,
+            rope_theta=model_args.rope_theta,
             attention_impl=model_args.attention_impl,
             lora_rank=model_args.lora_rank,
             lora_alpha=model_args.lora_alpha,
@@ -94,6 +108,14 @@ class LLMTrainer:
         key = jax.random.PRNGKey(seed if seed is not None else self.exp_args.seed)
         dummy = jnp.zeros((1, 8), jnp.int32)
         params = self.model.init(key, dummy)["params"]
+        if self.model_args.model_name_or_path:
+            # overlay pretrained base weights; freshly-initialized LoRA
+            # adapter leaves (and anything the checkpoint lacks) survive
+            from .checkpoint_import import import_hf_checkpoint
+
+            pretrained = import_hf_checkpoint(self.model_args.model_name_or_path, self.cfg)
+            params = _overlay(dict(params), pretrained)
+            log.info("loaded pretrained weights from %s", self.model_args.model_name_or_path)
         return params
 
     def _build(self, params):
@@ -123,9 +145,12 @@ class LLMTrainer:
         exp = self.exp_args
         if batches is None:
             global_batch = exp.per_device_batch_size * max(1, self.mesh.devices.size)
-            batches = synthetic_token_batches(
-                self.cfg.vocab_size, self.model_args.seq_len, global_batch, exp.max_steps, exp.seed
-            )
+            if self.data_args.dataset_path:
+                batches = self.text_batches(global_batch, exp.max_steps)
+            else:
+                batches = synthetic_token_batches(
+                    self.cfg.vocab_size, self.model_args.seq_len, global_batch, exp.max_steps, exp.seed
+                )
         losses, t0, tokens_seen = [], time.perf_counter(), 0
         step = 0
         for step, (toks, mask) in enumerate(batches):
@@ -149,6 +174,29 @@ class LLMTrainer:
         log.info("LLM train done: %s", metrics)
         self.save(step + 1)
         return metrics
+
+    def text_batches(self, global_batch: int, steps: Optional[int] = None, *, seed: Optional[int] = None):
+        """Real-text pipeline (reference DatasetArguments path): tokenize
+        data_args.dataset_path, pack to seq_len, yield (tokens, mask)."""
+        import os
+
+        from .data import TextDataset, load_or_train_tokenizer
+
+        da = self.data_args
+        tok_path = da.tokenizer_path
+        if tok_path is None and self.model_args.model_name_or_path:
+            cand = os.path.join(self.model_args.model_name_or_path, "tokenizer.json")
+            if os.path.exists(cand):
+                tok_path = cand
+        tok = load_or_train_tokenizer(da.dataset_path, tok_path, vocab_size=min(self.cfg.vocab_size, 4096))
+        if tok.vocab_size > self.cfg.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab {tok.vocab_size} exceeds model vocab {self.cfg.vocab_size}"
+            )
+        ds = TextDataset.from_path(
+            da.dataset_path, tok, self.model_args.seq_len, text_key=da.text_key
+        )
+        return ds.batches(global_batch, steps, seed=self.exp_args.seed if seed is None else seed)
 
     # --- checkpointing ----------------------------------------------------
     def save(self, step: int) -> None:

@@ -1,0 +1,105 @@
+"""Minimal self-contained safetensors reader/writer (numpy, zero deps).
+
+The reference loads pretrained checkpoints through HF transformers
+(``train/llm/hf_trainer.py:28``, ``configurations.py:141``
+``ModelArguments.model_name_or_path``); the on-disk format for modern HF
+checkpoints is safetensors. Format: 8-byte LE u64 header length, JSON header
+mapping tensor name -> {dtype, shape, data_offsets}, then one raw byte
+buffer. Implemented directly so checkpoint import never depends on torch or
+the safetensors package being importable on a TPU host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+try:  # bf16 numpy dtype ships with jax
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+_DTYPE_BY_NAME: Dict[str, Any] = {
+    "F64": np.dtype(np.float64),
+    "F32": np.dtype(np.float32),
+    "F16": np.dtype(np.float16),
+    "I64": np.dtype(np.int64),
+    "I32": np.dtype(np.int32),
+    "I16": np.dtype(np.int16),
+    "I8": np.dtype(np.int8),
+    "U8": np.dtype(np.uint8),
+    "BOOL": np.dtype(np.bool_),
+}
+if _BF16 is not None:
+    _DTYPE_BY_NAME["BF16"] = _BF16
+_NAME_BY_DTYPE = {v: k for k, v in _DTYPE_BY_NAME.items()}
+
+
+def load_safetensors(path: str) -> Dict[str, np.ndarray]:
+    """Read one .safetensors file into {name: ndarray}."""
+    with open(path, "rb") as f:
+        (header_len,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(header_len))
+        buf = f.read()
+    out: Dict[str, np.ndarray] = {}
+    for name, info in header.items():
+        if name == "__metadata__":
+            continue
+        dtype = _DTYPE_BY_NAME[info["dtype"]]
+        start, end = info["data_offsets"]
+        arr = np.frombuffer(buf[start:end], dtype=dtype)
+        out[name] = arr.reshape(info["shape"])
+    return out
+
+
+def save_safetensors(
+    tensors: Dict[str, np.ndarray], path: str, metadata: Optional[Dict[str, str]] = None
+) -> None:
+    """Write {name: ndarray} as a .safetensors file."""
+    header: Dict[str, Any] = {}
+    if metadata:
+        header["__metadata__"] = metadata
+    blobs = []
+    offset = 0
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        dt = _NAME_BY_DTYPE.get(arr.dtype)
+        if dt is None:
+            raise ValueError(f"{name}: unsupported dtype {arr.dtype}")
+        raw = arr.tobytes()
+        header[name] = {"dtype": dt, "shape": list(arr.shape), "data_offsets": [offset, offset + len(raw)]}
+        blobs.append(raw)
+        offset += len(raw)
+    hjson = json.dumps(header, separators=(",", ":")).encode()
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hjson)))
+        f.write(hjson)
+        for raw in blobs:
+            f.write(raw)
+
+
+def load_checkpoint_tensors(model_dir: str) -> Dict[str, np.ndarray]:
+    """Load all tensors from an HF-style checkpoint directory: either a single
+    ``model.safetensors`` or a sharded ``model.safetensors.index.json``."""
+    index = os.path.join(model_dir, "model.safetensors.index.json")
+    single = os.path.join(model_dir, "model.safetensors")
+    if os.path.exists(index):
+        with open(index) as f:
+            weight_map: Dict[str, str] = json.load(f)["weight_map"]
+        out: Dict[str, np.ndarray] = {}
+        for shard in sorted(set(weight_map.values())):
+            out.update(load_safetensors(os.path.join(model_dir, shard)))
+        return out
+    if os.path.exists(single):
+        return load_safetensors(single)
+    # any lone *.safetensors file
+    cands = [f for f in os.listdir(model_dir) if f.endswith(".safetensors")]
+    if len(cands) == 1:
+        return load_safetensors(os.path.join(model_dir, cands[0]))
+    raise FileNotFoundError(f"no safetensors checkpoint found in {model_dir}")

@@ -1,0 +1,154 @@
+"""Reference on-disk federated dataset formats: LEAF json, TFF h5.
+
+Fixtures are generated in-test (tiny but byte-for-byte the formats the
+reference's loaders read: ``data/MNIST/data_loader.py:32`` read_data,
+``data/fed_shakespeare/data_loader.py``, ``data/fed_cifar100/data_loader.py``,
+``data/stackoverflow_nwp/data_loader.py``)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fedml_tpu.data.formats import (
+    clients_to_fed_dataset,
+    detect_format_files,
+    load_leaf_json,
+    load_native_format,
+    load_stackoverflow_nwp,
+    load_tff_cifar100,
+    load_tff_shakespeare,
+    preprocess_snippets,
+    shakespeare_vocab_size,
+)
+
+
+def _write_leaf(root, split, users):
+    d = root / split
+    d.mkdir(parents=True, exist_ok=True)
+    doc = {
+        "users": list(users),
+        "num_samples": [len(users[u]["y"]) for u in users],
+        "user_data": users,
+    }
+    (d / "all_data_0.json").write_text(json.dumps(doc))
+
+
+def test_leaf_json_femnist_layout(tmp_path):
+    rng = np.random.default_rng(0)
+    users_tr = {
+        f"f_{i:04d}": {
+            "x": rng.random((5, 784)).tolist(),
+            "y": rng.integers(0, 62, 5).tolist(),
+        }
+        for i in range(3)
+    }
+    users_te = {u: {"x": rng.random((2, 784)).tolist(), "y": rng.integers(0, 62, 2).tolist()}
+                for u in users_tr}
+    _write_leaf(tmp_path, "train", users_tr)
+    _write_leaf(tmp_path, "test", users_te)
+
+    train, test, classes = load_leaf_json(str(tmp_path), image_shape=(28, 28, 1))
+    assert set(train) == set(users_tr) and set(test) == set(users_te)
+    x, y = train["f_0000"]
+    assert x.shape == (5, 28, 28, 1) and y.shape == (5,)
+    assert classes <= 62
+
+    fed = clients_to_fed_dataset(train, test, classes, client_num=2)
+    (n_tr, n_te, tr_g, te_g, num_dict, tr_local, te_local, cn) = fed
+    assert n_tr == 15 and len(tr_local) == 2 and sum(num_dict.values()) == 15
+    assert cn == classes
+
+
+def test_tff_shakespeare_h5(tmp_path):
+    h5py = pytest.importorskip("h5py")
+    snippets = {
+        "THE_TRAGEDY_CLIENT_1": ["To be, or not to be", "that is the question"],
+        "CLIENT_2": ["All the world's a stage"],
+    }
+    for fname, data in [("shakespeare_train.h5", snippets), ("shakespeare_test.h5", snippets)]:
+        with h5py.File(tmp_path / fname, "w") as h5:
+            for cid, sents in data.items():
+                h5.create_dataset(
+                    f"examples/{cid}/snippets",
+                    data=np.array([s.encode("utf8") for s in sents], dtype="S100"),
+                )
+    train, test, vocab = load_tff_shakespeare(str(tmp_path))
+    assert vocab == shakespeare_vocab_size()
+    x, y = train["THE_TRAGEDY_CLIENT_1"]
+    assert x.shape[1] == 80 and y.shape[1] == 80
+    np.testing.assert_array_equal(x[:, 1:], y[:, :-1])  # y is x shifted by one
+    from fedml_tpu.data.formats import CHAR_VOCAB
+
+    assert x[0, 0] == 1 + len(CHAR_VOCAB)  # <bos> opens every snippet
+
+
+def test_preprocess_snippets_padding():
+    rows = preprocess_snippets(["abc"], seq_len=8)
+    assert rows.shape == (1, 9)
+    assert rows[0, -1] == 0  # padded with <pad>=0
+
+
+def test_tff_cifar100_h5(tmp_path):
+    h5py = pytest.importorskip("h5py")
+    rng = np.random.default_rng(1)
+    for fname in ("fed_cifar100_train.h5", "fed_cifar100_test.h5"):
+        with h5py.File(tmp_path / fname, "w") as h5:
+            for cid in ("0", "1"):
+                h5.create_dataset(f"examples/{cid}/image", data=rng.integers(0, 255, (4, 32, 32, 3), dtype=np.uint8))
+                h5.create_dataset(f"examples/{cid}/label", data=rng.integers(0, 100, (4,), dtype=np.int64))
+    train, test, classes = load_tff_cifar100(str(tmp_path))
+    assert classes == 100 and set(train) == {"0", "1"}
+    x, y = train["0"]
+    assert x.shape == (4, 32, 32, 3) and x.max() <= 1.0 and y.shape == (4,)
+
+
+def test_stackoverflow_nwp_h5(tmp_path):
+    h5py = pytest.importorskip("h5py")
+    sents = {
+        "user_a": ["how do i sort a list in python", "python list sort question"],
+        "user_b": ["what is a segfault"],
+    }
+    for fname in ("stackoverflow_train.h5", "stackoverflow_test.h5"):
+        with h5py.File(tmp_path / fname, "w") as h5:
+            for cid, ss in sents.items():
+                h5.create_dataset(
+                    f"examples/{cid}/tokens",
+                    data=np.array([s.encode("utf8") for s in ss], dtype="S100"),
+                )
+    train, test, vocab = load_stackoverflow_nwp(str(tmp_path), seq_len=10, vocab_size=50)
+    assert vocab <= 50
+    x, y = train["user_a"]
+    assert x.shape == (2, 10) and y.shape == (2, 10)
+    assert x[0, 0] == 2  # <bos>
+
+
+def test_data_loader_dispatches_native_format(tmp_path):
+    """fedml.data.load uses the real files when present (no surrogate)."""
+    import fedml_tpu as fedml
+    from fedml_tpu.arguments import default_config
+
+    rng = np.random.default_rng(0)
+    root = tmp_path / "femnist"
+    users = {
+        f"w{i}": {"x": rng.random((6, 784)).tolist(), "y": rng.integers(0, 62, 6).tolist()}
+        for i in range(4)
+    }
+    _write_leaf(root, "train", users)
+    _write_leaf(root, "test", users)
+
+    assert detect_format_files("femnist", str(tmp_path)) == "femnist"
+    args = default_config(
+        "simulation", dataset="femnist", client_num_in_total=2, data_cache_dir=str(tmp_path)
+    )
+    dataset, out_dim = fedml.data.load(args)
+    (n_tr, _n_te, _tr_g, _te_g, num_dict, tr_local, _te_local, cn) = dataset
+    assert n_tr == 24 and len(tr_local) == 2
+    assert tr_local[0].x.shape[1:] == (28, 28, 1)
+    assert out_dim == cn
+
+
+def test_detect_format_files_absent(tmp_path):
+    assert detect_format_files("femnist", str(tmp_path)) is None
+    assert detect_format_files("fed_shakespeare", "") is None

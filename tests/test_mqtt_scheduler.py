@@ -1,0 +1,164 @@
+"""Launch scheduler over the MQTT message plane.
+
+Reference parity: ``slave/client_runner.py:61,909,255,619``,
+``master/server_runner.py:70,1383``, ``comm_utils/job_monitor.py:37`` — the
+job request travels as json over the flserver_agent topics, the package as a
+zip through the object store, the job runs as a real subprocess, and
+FINISHED status flows back over the broker.
+"""
+
+import json
+import os
+import textwrap
+import time
+
+import pytest
+
+from fedml_tpu.computing.scheduler.mqtt_agents import (
+    TOPIC_STATUS,
+    JobMonitor,
+    MqttClientAgent,
+    MqttServerAgent,
+)
+from fedml_tpu.core.distributed.communication.mqtt_s3.mqtt_transport import LocalMqttBroker
+from fedml_tpu.core.distributed.communication.mqtt_s3.object_store import LocalObjectStore
+
+
+@pytest.fixture(autouse=True)
+def _fresh_broker():
+    LocalMqttBroker.reset()
+    yield
+    LocalMqttBroker.reset()
+
+
+def _workspace(tmp_path, script: str):
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    (ws / "main.py").write_text(textwrap.dedent(script))
+    return str(ws)
+
+
+def test_job_package_executes_and_reports_finished(tmp_path):
+    ws = _workspace(
+        tmp_path,
+        """
+        import os
+        print("run", os.environ["FEDML_RUN_ID"], "edge", os.environ["FEDML_EDGE_ID"])
+        open("proof.txt", "w").write("done")
+        """,
+    )
+    store = LocalObjectStore(str(tmp_path / "store"))
+    agents = [MqttClientAgent(e, base_dir=str(tmp_path / f"edge{e}"), store=store) for e in (0, 1)]
+    server = MqttServerAgent([0, 1], store=store)
+    try:
+        run_id = server.dispatch_workspace(ws, "python main.py")
+        statuses = server.wait_for_run(run_id, timeout_s=60)
+        assert {d["status"] for d in statuses.values()} == {"FINISHED"}
+        for e, d in statuses.items():
+            run_dir = os.path.join(str(tmp_path / f"edge{e}"), f"run_{run_id}_edge_{e}")
+            assert open(os.path.join(run_dir, "proof.txt")).read() == "done"
+            assert "run " + run_id in open(d["log_path"]).read()
+    finally:
+        server.stop()
+        for a in agents:
+            a.stop()
+
+
+def test_failing_job_reports_failed_with_detail(tmp_path):
+    ws = _workspace(tmp_path, "import sys; sys.exit(3)\n")
+    store = LocalObjectStore(str(tmp_path / "store"))
+    agent = MqttClientAgent(0, base_dir=str(tmp_path / "edge0"), store=store)
+    server = MqttServerAgent([0], store=store)
+    try:
+        run_id = server.dispatch_workspace(ws, "python main.py")
+        statuses = server.wait_for_run(run_id, timeout_s=60)
+        assert statuses[0]["status"] == "FAILED" and statuses[0]["returncode"] == 3
+    finally:
+        server.stop()
+        agent.stop()
+
+
+def test_stop_train_kills_running_job(tmp_path):
+    ws = _workspace(tmp_path, "import time; time.sleep(300)\n")
+    store = LocalObjectStore(str(tmp_path / "store"))
+    agent = MqttClientAgent(0, base_dir=str(tmp_path / "edge0"), store=store)
+    server = MqttServerAgent([0], store=store)
+    try:
+        run_id = server.dispatch_workspace(ws, "python main.py")
+        deadline = time.time() + 30
+        while run_id not in agent.runner._procs and time.time() < deadline:
+            time.sleep(0.05)
+        server.stop_run(run_id)
+        statuses = server.wait_for_run(run_id, timeout_s=30)
+        assert statuses[0]["status"] == "KILLED"
+    finally:
+        server.stop()
+        agent.stop()
+
+
+def test_ota_roundtrip(tmp_path):
+    agent = MqttClientAgent(0, base_dir=str(tmp_path / "edge0"))
+    server = MqttServerAgent([0])
+    try:
+        server.push_ota("9.9.9")
+        deadline = time.time() + 10
+        while not server.ota_acks and time.time() < deadline:
+            time.sleep(0.05)
+        assert server.ota_acks and server.ota_acks[0]["to"] == "9.9.9"
+        assert agent.version == "9.9.9"
+    finally:
+        server.stop()
+        agent.stop()
+
+
+def test_job_monitor_recovers_silent_death(tmp_path):
+    """A job process that dies while the agent's waiter is wedged still gets
+    a terminal status via the monitor."""
+    ws = _workspace(tmp_path, "print('ok')\n")
+    store = LocalObjectStore(str(tmp_path / "store"))
+    agent = MqttClientAgent(0, base_dir=str(tmp_path / "edge0"), store=store)
+    server = MqttServerAgent([0], store=store)
+    monitor = JobMonitor([agent], poll_s=0.2)
+    try:
+        run_id = server.dispatch_workspace(ws, "python main.py")
+        deadline = time.time() + 30
+        while run_id not in agent.runner._procs and time.time() < deadline:
+            time.sleep(0.05)
+        proc = agent.runner._procs[run_id]
+        proc.wait()
+        # let the agent's own waiter report first, then simulate the
+        # lost-report case by forcing the status back to RUNNING
+        while agent.runner.runs[run_id].status != "FINISHED" and time.time() < deadline:
+            time.sleep(0.05)
+        agent.runner.runs[run_id].status = "RUNNING"
+        fixed = monitor.check_once()
+        assert run_id in fixed
+        assert agent.runner.runs[run_id].status == "FINISHED"
+        statuses = server.wait_for_run(run_id, timeout_s=10)
+        assert statuses[0]["status"] == "FINISHED"
+    finally:
+        monitor.stop()
+        server.stop()
+        agent.stop()
+
+
+def test_cli_launch_mqtt_backend(tmp_path):
+    """`fedml-tpu launch job.yaml --backend mqtt` end to end (VERDICT item 5
+    'Done' criterion): job yaml -> package -> broker -> subprocess ->
+    FINISHED back over the broker."""
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli.cli import cli
+
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    (ws / "main.py").write_text("print('tiny fl run ok')\n")
+    job = tmp_path / "job.yaml"
+    job.write_text(
+        json.dumps(
+            {"job_name": "smoke", "workspace": "ws", "job": "python main.py"}
+        )  # yaml is a superset of json
+    )
+    result = CliRunner().invoke(cli, ["launch", str(job), "--backend", "mqtt", "-t", "120"])
+    assert result.exit_code == 0, result.output
+    assert "FINISHED" in result.output
