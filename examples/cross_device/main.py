@@ -1,0 +1,31 @@
+"""Cross-device (Beehive) quick start: Python server + C++ edge clients.
+
+    python main.py
+
+The native engine builds from native/edge on first use (cmake/g++); clients
+train in C++ on blob-serialized models and the server aggregates — the
+reference's MNN-mobile round (server_mnn/fedml_aggregator.py) without a
+phone attached.
+"""
+
+import fedml_tpu as fedml
+from fedml_tpu.arguments import default_config
+
+if __name__ == "__main__":
+    from fedml_tpu.cross_device import native_bridge
+
+    if not native_bridge.native_engine_available():
+        raise SystemExit("native edge engine not available (needs cmake/g++)")
+    args = default_config(
+        "cross_device", dataset="mnist", model="mlp",
+        client_num_in_total=2, client_num_per_round=2, comm_round=2,
+        epochs=1, batch_size=32, learning_rate=0.05,
+    )
+    args = fedml.init(args)
+    device = fedml.device.get_device(args)
+    dataset, output_dim = fedml.data.load(args)
+    model = fedml.model.create(args, output_dim)
+    from fedml_tpu.cross_device.server import ServerEdge
+
+    server = ServerEdge(args, device, dataset, model)
+    print("cross-device result:", server.run())

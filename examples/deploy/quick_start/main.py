@@ -1,0 +1,37 @@
+"""Deploy quick start: subprocess-isolated replicas + gateway + autoscale.
+
+    python main.py
+
+Reference flow: `fedml model deploy` -> containers + inference gateway
+(model_scheduler). Here: EndpointManager.deploy_isolated spawns OS-process
+replicas of a predictor factory, probes readiness, round-robins requests,
+survives replica death, and scales on load.
+"""
+
+import time
+
+from fedml_tpu.serving.endpoint import EndpointManager
+
+if __name__ == "__main__":
+    mgr = EndpointManager()
+    gw = mgr.deploy_isolated(
+        "echo-demo",
+        "fedml_tpu.serving.replica_controller:create_echo_predictor",
+        num_replicas=2,
+        autoscale=True,
+        target_qps_per_replica=50.0,
+        max_replicas=3,
+        cooldown_s=5.0,
+    )
+    print("replicas:", [r.url for r in gw.replica_set.healthy()])
+    for i in range(10):
+        out = gw.predict({"inputs": [i]})
+        print(f"request {i} -> pid {out['pid']}")
+    t0 = time.time()
+    n = 0
+    while time.time() - t0 < 2.0:  # burst to trigger the autoscaler
+        gw.predict({"n": n})
+        n += 1
+    print(f"burst: {n} requests in 2s; desired replicas = {gw.replica_set.desired}")
+    mgr.undeploy("echo-demo")
+    print("undeployed")

@@ -1,0 +1,23 @@
+"""Federated analytics quick start: frequency + TrieHH heavy hitters.
+
+    python main.py --cf fedml_config.yaml
+"""
+
+import numpy as np
+
+import fedml_tpu as fedml
+from fedml_tpu.fa import FASimulatorSingleProcess, constants as C
+
+if __name__ == "__main__":
+    args = fedml.load_arguments(training_type="simulation")
+    rng = np.random.default_rng(0)
+    words = ["tpu", "jax", "mesh", "pjit", "pallas", "fsdp", "ring", "ici"]
+    weights = np.array([8, 7, 6, 2, 2, 1, 1, 1], float)
+    shards = {
+        cid: list(rng.choice(words, size=40, p=weights / weights.sum()))
+        for cid in range(int(getattr(args, "client_num_in_total", 10)))
+    }
+    args.fa_task = C.FA_TASK_FREQ
+    print("frequency:", FASimulatorSingleProcess(args, shards).run())
+    args.fa_task = C.FA_TASK_HEAVY_HITTER_TRIEHH
+    print("heavy hitters:", FASimulatorSingleProcess(args, shards).run())

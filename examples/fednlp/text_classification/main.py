@@ -1,0 +1,32 @@
+"""FedNLP quick start: run server+clients as threads over INMEMORY.
+
+    python main.py --cf fedml_config.yaml
+"""
+
+import threading
+
+import fedml_tpu as fedml
+
+if __name__ == "__main__":
+    base = fedml.load_arguments(training_type="cross_silo")
+    results = {}
+
+    def party(rank, role):
+        import copy
+
+        args = copy.deepcopy(base)
+        args.rank, args.role = rank, role
+        args = fedml.init(args)
+        device = fedml.device.get_device(args)
+        dataset, output_dim = fedml.data.load(args)
+        model = fedml.model.create(args, output_dim)
+        results[f"{role}{rank}"] = fedml.FedMLRunner(args, device, dataset, model).run()
+
+    n = int(getattr(base, "client_num_in_total", 2))
+    threads = [threading.Thread(target=party, args=(0, "server"), daemon=True)]
+    threads += [threading.Thread(target=party, args=(r, "client"), daemon=True) for r in range(1, n + 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    print("server metrics:", results.get("server0"))

@@ -1,0 +1,5 @@
+"""Job payload for the launch quick start."""
+
+import os
+
+print(f"hello from run {os.environ.get('FEDML_RUN_ID')} on edge {os.environ.get('FEDML_EDGE_ID')}")

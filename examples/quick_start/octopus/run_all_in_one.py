@@ -1,0 +1,36 @@
+"""Octopus all-in-one: server + 2 clients as threads over the INMEMORY
+backend (the deterministic test seam, SURVEY §4) — handy for a first run
+without multiple terminals.
+
+    python run_all_in_one.py
+"""
+
+import threading
+
+import fedml_tpu as fedml
+from fedml_tpu.arguments import default_config
+
+
+def party(rank, role, results):
+    args = default_config(
+        "cross_silo", run_id="octopus_all_in_one", rank=rank, role=role,
+        backend="INMEMORY", dataset="mnist", model="lr",
+        client_num_in_total=2, client_num_per_round=2, comm_round=5,
+        epochs=1, batch_size=16, frequency_of_the_test=1,
+    )
+    args = fedml.init(args)
+    device = fedml.device.get_device(args)
+    dataset, output_dim = fedml.data.load(args)
+    model = fedml.model.create(args, output_dim)
+    results[role + str(rank)] = fedml.FedMLRunner(args, device, dataset, model).run()
+
+
+if __name__ == "__main__":
+    results = {}
+    threads = [threading.Thread(target=party, args=(r, role, results), daemon=True)
+               for r, role in [(0, "server"), (1, "client"), (2, "client")]]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    print("server metrics:", results.get("server0"))

@@ -1,0 +1,18 @@
+"""Octopus client (reference run_client.sh).
+
+    python run_client.py --cf fedml_config.yaml --rank 1 --role client
+    python run_client.py --cf fedml_config.yaml --rank 2 --role client
+"""
+
+import fedml_tpu as fedml
+
+if __name__ == "__main__":
+    args = fedml.load_arguments(training_type="cross_silo")
+    args.role = "client"
+    args.rank = int(getattr(args, "rank", 1) or 1)
+    args = fedml.init(args)
+    device = fedml.device.get_device(args)
+    dataset, output_dim = fedml.data.load(args)
+    model = fedml.model.create(args, output_dim)
+    fedml.FedMLRunner(args, device, dataset, model).run()
+    print(f"client rank={args.rank} DONE")

@@ -1,0 +1,15 @@
+"""Octopus server (reference run_server.sh / server entry).
+
+    python run_server.py --cf fedml_config.yaml --rank 0 --role server
+"""
+
+import fedml_tpu as fedml
+
+if __name__ == "__main__":
+    args = fedml.load_arguments(training_type="cross_silo")
+    args.role, args.rank = "server", int(getattr(args, "rank", 0))
+    args = fedml.init(args)
+    device = fedml.device.get_device(args)
+    dataset, output_dim = fedml.data.load(args)
+    model = fedml.model.create(args, output_dim)
+    print("server result:", fedml.FedMLRunner(args, device, dataset, model).run())

@@ -1,0 +1,10 @@
+"""Vmapped FL simulation quick start.
+
+    python main.py --cf fedml_config.yaml
+"""
+
+import fedml_tpu as fedml
+
+if __name__ == "__main__":
+    args = fedml.load_arguments(training_type="simulation")
+    print(fedml.run_simulation(backend=str(getattr(args, "backend", "vmap")), args=args))

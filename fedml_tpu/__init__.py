@@ -69,6 +69,17 @@ def init(args: Optional[Any] = None, override: Optional[Dict[str, Any]] = None) 
         for k, v in override.items():
             setattr(args, k, v)
 
+    # multi-host slices must attach BEFORE the first JAX backend touch
+    # (jax.distributed cannot initialize later); no-op when single-process
+    from .parallel.multihost import init_distributed
+
+    _pid = getattr(args, "process_id", None)
+    init_distributed(
+        coordinator_address=getattr(args, "coordinator_address", None),
+        num_processes=int(getattr(args, "num_processes", 0)) or None,
+        process_id=int(_pid) if _pid is not None else None,
+    )
+
     logging.basicConfig(
         level=logging.INFO, format="[fedml_tpu] %(asctime)s %(levelname)s %(name)s: %(message)s"
     )

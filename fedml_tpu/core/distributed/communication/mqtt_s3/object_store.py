@@ -23,18 +23,26 @@ class LocalObjectStore:
         os.makedirs(self.root, exist_ok=True)
 
     def write_model(self, message_key: str, model_params: Any) -> str:
-        key = f"{message_key}_{uuid.uuid4().hex[:8]}.npz"
-        path = os.path.join(self.root, key)
-        with open(path, "wb") as f:
-            f.write(serialize_pytree(model_params))
-        return f"file://{path}"
+        return self.write_blob(message_key, serialize_pytree(model_params), ext=".npz")
 
     def read_model(self, url: str) -> Any:
+        return deserialize_pytree(self.read_blob(url))
+
+    # raw blobs (edge model files — reference remote_storage_mnn.py ships
+    # .mnn files the same way)
+    def write_blob(self, message_key: str, blob: bytes, ext: str = ".bin") -> str:
+        key = f"{message_key}_{uuid.uuid4().hex[:8]}{ext}"
+        path = os.path.join(self.root, key)
+        with open(path, "wb") as f:
+            f.write(blob)
+        return f"file://{path}"
+
+    def read_blob(self, url: str) -> bytes:
         path = url[len("file://") :] if url.startswith("file://") else url
         with open(path, "rb") as f:
-            return deserialize_pytree(f.read())
+            return f.read()
 
-    # raw blobs (job packages, model bundles — reference S3Storage also
+    # raw files (job packages, model bundles — reference S3Storage also
     # ships zip packages, slave/client_runner.py:255 downloads them)
     def write_file(self, message_key: str, src_path: str) -> str:
         import shutil
@@ -62,15 +70,33 @@ class S3ObjectStore:  # pragma: no cover - requires boto3 + credentials
         self.prefix = prefix
 
     def write_model(self, message_key: str, model_params: Any) -> str:
-        key = f"{self.prefix}/{message_key}_{uuid.uuid4().hex[:8]}.npz"
-        self.s3.put_object(Bucket=self.bucket, Key=key, Body=serialize_pytree(model_params))
-        return f"s3://{self.bucket}/{key}"
+        return self.write_blob(message_key, serialize_pytree(model_params), ext=".npz")
 
     def read_model(self, url: str) -> Any:
+        return deserialize_pytree(self.read_blob(url))
+
+    def write_blob(self, message_key: str, blob: bytes, ext: str = ".bin") -> str:
+        key = f"{self.prefix}/{message_key}_{uuid.uuid4().hex[:8]}{ext}"
+        self.s3.put_object(Bucket=self.bucket, Key=key, Body=blob)
+        return f"s3://{self.bucket}/{key}"
+
+    def read_blob(self, url: str) -> bytes:
         _, _, rest = url.partition("s3://")
         bucket, _, key = rest.partition("/")
-        body = self.s3.get_object(Bucket=bucket, Key=key)["Body"].read()
-        return deserialize_pytree(body)
+        return self.s3.get_object(Bucket=bucket, Key=key)["Body"].read()
+
+    def write_file(self, message_key: str, src_path: str) -> str:
+        # streaming multipart transfer — packages can be GBs
+        key = f"{self.prefix}/{message_key}_{uuid.uuid4().hex[:8]}{os.path.splitext(src_path)[1]}"
+        self.s3.upload_file(src_path, self.bucket, key)
+        return f"s3://{self.bucket}/{key}"
+
+    def fetch_file(self, url: str, dst_path: str) -> str:
+        _, _, rest = url.partition("s3://")
+        bucket, _, key = rest.partition("/")
+        os.makedirs(os.path.dirname(os.path.abspath(dst_path)), exist_ok=True)
+        self.s3.download_file(bucket, key, dst_path)
+        return dst_path
 
 
 def create_object_store(args: Any):

@@ -5,11 +5,16 @@ a TenSEAL CKKS context to encrypt client updates so the server aggregates
 ciphertexts. TenSEAL is CUDA/C++-bound and not available here, so this module
 keeps the exact facade/hook contract (``is_fhe_enabled``, ``fhe_enc``,
 ``fhe_dec`` at client_trainer.py:60-77 / fedml_aggregator hooks) with a
-pluggable scheme registry. The built-in scheme is additively-homomorphic
-fixed-point masking (pad-sum): ciphertext = fixed_point(x) + PRF(key, shape);
-summation of ciphertexts is decrypted by subtracting the summed masks. A real
-CKKS backend can be registered via :func:`register_scheme` without touching
-the hook sites.
+pluggable scheme registry. Two built-in schemes:
+
+  * ``rlwe`` (default) — a REAL lattice scheme (core/fhe/rlwe.py): RLWE
+    ciphertexts in Z_q[X]/(X^N+1), homomorphic add + plaintext-scalar
+    multiply, matching the reference's CKKS security model (the server
+    aggregates ciphertexts it cannot read without the secret key).
+  * ``additive_mask`` — additively-homomorphic fixed-point PRF masking.
+    Much faster, but the masking secret is shared (trusted-dealer model),
+    so it does NOT meet the no-trusted-dealer security claim of CKKS;
+    choose it only when the threat model allows.
 """
 
 from __future__ import annotations
@@ -66,7 +71,17 @@ def _map_with_path(tree: PyTree, fn: Callable[[str, Any], Any]) -> PyTree:
     return jax.tree.unflatten(treedef, out)
 
 
-_SCHEMES: Dict[str, Callable[..., Any]] = {"additive_mask": AdditiveMaskScheme}
+def _rlwe_factory(secret: bytes):
+    from .rlwe import RLWEScheme
+
+    return RLWEScheme(secret)
+
+
+_SCHEMES: Dict[str, Callable[..., Any]] = {
+    "additive_mask": AdditiveMaskScheme,
+    "rlwe": _rlwe_factory,
+    "ckks": _rlwe_factory,  # reference config name
+}
 
 
 def register_scheme(name: str, factory: Callable[..., Any]) -> None:
@@ -91,7 +106,18 @@ class FedMLFHE:
         self.is_enabled = bool(getattr(args, "enable_fhe", False))
         if not self.is_enabled:
             return
-        name = str(getattr(args, "fhe_scheme", "additive_mask"))
+        name = getattr(args, "fhe_scheme", None)
+        if name is None:
+            name = "rlwe"
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "enable_fhe defaults to the REAL lattice scheme ('rlwe'): "
+                "O(N^2) ring products make encryption seconds-per-MB of "
+                "params. Set fhe_scheme='additive_mask' for the fast "
+                "trusted-dealer masking scheme if your threat model allows."
+            )
+        name = str(name)
         secret = str(getattr(args, "fhe_secret", "fedml_tpu")).encode()
         self.scheme = _SCHEMES[name](secret)
 

@@ -1,0 +1,219 @@
+"""RLWE additively-homomorphic encryption (CKKS-style, coefficient packing).
+
+Reference: ``python/fedml/core/fhe/fhe_agg.py`` encrypts client updates with
+a TenSEAL CKKS context so the server aggregates ciphertexts it cannot read.
+TenSEAL is unavailable here; this is a from-the-math lattice scheme with the
+same algebra the FedAvg path needs:
+
+  * Ring R_q = Z_q[X]/(X^N + 1), q = prod of word-size primes (RNS — every
+    operation is int64 per-prime; exact, no bignum in the hot path).
+  * Keys: ternary secret s; public key (b, a) with b = -(a*s) + e.
+  * Enc(m): u ternary, (c0, c1) = (b*u + e1 + m, a*u + e2);
+    Dec: m ~= c0 + c1*s (noise decays below the encoding scale).
+  * Homomorphic ops: ct + ct and fixed-point plaintext scalar ct * w —
+    exactly the weighted average FedAvg computes over client updates.
+  * Encoding: fixed-point COEFFICIENT packing (values / DELTA into poly
+    coefficients). Slot-wise ct*ct multiplication is not needed for
+    aggregation, so no canonical embedding / rescaling machinery.
+
+Security: defaults N=4096, log2(q) ~= 80 with ternary secret and sigma=3.2
+discrete gaussian noise — inside the homomorphicencryption.org standard's
+128-bit classical bound for N=4096 (log q <= 109). Negacyclic products are
+exact int64 via np.convolve per RNS prime (inputs < 2^20, accumulators
+< N * 2^40 < 2^63).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_SIGMA = 3.2
+_WEIGHT_SCALE = 1 << 16  # fixed-point scale for plaintext scalar weights
+
+
+def _is_prime(n: int) -> bool:
+    if n < 2:
+        return False
+    i = 2
+    while i * i <= n:
+        if n % i == 0:
+            return False
+        i += 1
+    return True
+
+
+def _find_primes(count: int, bits: int = 20) -> List[int]:
+    out: List[int] = []
+    n = (1 << bits) - 1
+    while len(out) < count:
+        if _is_prime(n):
+            out.append(n)
+        n -= 2
+    return out
+
+
+def _negacyclic_mul(a: np.ndarray, b: np.ndarray, p: int) -> np.ndarray:
+    """Exact (a*b mod X^N+1 mod p) for int64 residue vectors."""
+    full = np.convolve(a, b)  # len 2N-1, max coeff < N * p^2 < 2^63
+    n = a.shape[-1]
+    out = full[:n].copy()
+    out[: n - 1] -= full[n:]
+    return np.mod(out, p)
+
+
+@dataclasses.dataclass
+class RLWEParams:
+    n: int = 4096
+    n_primes: int = 4
+    prime_bits: int = 20
+    delta: int = 1 << 30  # message fixed-point scale
+    sigma: float = _SIGMA
+
+    def __post_init__(self):
+        self.primes = _find_primes(self.n_primes, self.prime_bits)
+        self.q = 1
+        for p in self.primes:
+            self.q *= p
+
+
+class Ciphertext:
+    """One encrypted tensor: RNS residues [n_primes, n_chunks, N] for c0/c1.
+
+    Supports the two homomorphic ops aggregation needs via operator
+    overloads, so generic pytree folds (utils.pytree.weighted_average's
+    object-leaf path) aggregate ciphertexts transparently."""
+
+    __slots__ = ("c0", "c1", "shape", "size", "scale", "params")
+
+    def __init__(self, c0, c1, shape, size, scale, params: RLWEParams):
+        self.c0, self.c1 = c0, c1
+        self.shape, self.size = shape, size
+        self.scale = scale
+        self.params = params
+
+    def __add__(self, other: "Ciphertext") -> "Ciphertext":
+        if not isinstance(other, Ciphertext):
+            return NotImplemented
+        assert self.scale == other.scale, "adding ciphertexts at different scales"
+        primes = np.asarray(self.params.primes, np.int64)[:, None, None]
+        return Ciphertext(
+            (self.c0 + other.c0) % primes, (self.c1 + other.c1) % primes,
+            self.shape, self.size, self.scale, self.params,
+        )
+
+    __radd__ = __add__
+
+    def __mul__(self, w) -> "Ciphertext":
+        """Plaintext fixed-point scalar multiply (the FedAvg weight)."""
+        k = int(round(float(w) * _WEIGHT_SCALE))
+        primes = np.asarray(self.params.primes, np.int64)[:, None, None]
+        ks = np.asarray([k % p for p in self.params.primes], np.int64)[:, None, None]
+        return Ciphertext(
+            (self.c0 * ks) % primes, (self.c1 * ks) % primes,
+            self.shape, self.size, self.scale * _WEIGHT_SCALE, self.params,
+        )
+
+    __rmul__ = __mul__
+
+
+class RLWEContext:
+    """Keygen + enc/dec. The server holding only ciphertexts and the public
+    key learns nothing about individual updates (RLWE hardness); decryption
+    requires the secret key (held by the key authority / clients)."""
+
+    def __init__(self, params: Optional[RLWEParams] = None, seed: int = 0):
+        self.params = params or RLWEParams()
+        P = self.params
+        rng = np.random.default_rng(seed)
+        # ENCRYPTION randomness must be fresh OS entropy, never the shared
+        # key-derivation seed: parties seeding identically would emit
+        # identical (u, e1, e2) streams and c0_A - c0_B would reveal exact
+        # plaintext differences to the server
+        self._rng = np.random.default_rng()
+        # ternary secret, one residue vector per prime
+        s = rng.integers(-1, 2, P.n).astype(np.int64)
+        self.s = np.stack([s % p for p in P.primes])  # [n_primes, N]
+        a = np.stack([rng.integers(0, p, P.n, dtype=np.int64) for p in P.primes])
+        e = np.rint(rng.normal(0, P.sigma, P.n)).astype(np.int64)
+        b = np.stack(
+            [(-_negacyclic_mul(a[i], self.s[i], p) - e) % p for i, p in enumerate(P.primes)]
+        )
+        self.pk = (b, a)
+        del rng  # key-derivation stream must not leak into encryption
+
+    # --- encoding --------------------------------------------------------
+    def _encode(self, x: np.ndarray) -> Tuple[np.ndarray, tuple, int]:
+        flat = np.asarray(x, np.float64).ravel()
+        fixed = np.rint(flat * self.params.delta).astype(np.int64)
+        n = self.params.n
+        n_chunks = max(1, -(-len(fixed) // n))
+        padded = np.zeros(n_chunks * n, np.int64)
+        padded[: len(fixed)] = fixed
+        return padded.reshape(n_chunks, n), x.shape, flat.size
+
+    def encrypt(self, x: np.ndarray) -> Ciphertext:
+        P = self.params
+        chunks, shape, size = self._encode(x)
+        n_chunks = chunks.shape[0]
+        b, a = self.pk
+        rng = self._rng
+        c0 = np.empty((P.n_primes, n_chunks, P.n), np.int64)
+        c1 = np.empty_like(c0)
+        for j in range(n_chunks):
+            u = rng.integers(-1, 2, P.n).astype(np.int64)
+            e1 = np.rint(rng.normal(0, P.sigma, P.n)).astype(np.int64)
+            e2 = np.rint(rng.normal(0, P.sigma, P.n)).astype(np.int64)
+            for i, p in enumerate(P.primes):
+                c0[i, j] = (_negacyclic_mul(b[i], u % p, p) + e1 + chunks[j]) % p
+                c1[i, j] = (_negacyclic_mul(a[i], u % p, p) + e2) % p
+        return Ciphertext(c0, c1, shape, size, P.delta, P)
+
+    def decrypt(self, ct: Ciphertext) -> np.ndarray:
+        P = self.params
+        n_chunks = ct.c0.shape[1]
+        # m residues per prime, then CRT -> centered integers -> / scale
+        res = np.empty((P.n_primes, n_chunks, P.n), np.int64)
+        for i, p in enumerate(P.primes):
+            for j in range(n_chunks):
+                res[i, j] = (ct.c0[i, j] + _negacyclic_mul(ct.c1[i, j], self.s[i], p)) % p
+        centered = _crt_center(res, P.primes, P.q)  # object array of python ints
+        vals = centered.astype(np.float64) / float(ct.scale)
+        return vals.reshape(-1)[: ct.size].reshape(ct.shape).astype(np.float32)
+
+
+def _crt_center(res: np.ndarray, primes: Sequence[int], q: int) -> np.ndarray:
+    """Garner-free CRT: combine residues into centered representatives."""
+    x = np.zeros(res.shape[1:], dtype=object)
+    for i, p in enumerate(primes):
+        qi = q // p
+        inv = pow(qi % p, -1, p)
+        x = x + (res[i].astype(object) * ((qi * inv) % q))
+    x = x % q
+    half = q // 2
+    return np.where(x > half, x - q, x)
+
+
+class RLWEScheme:
+    """fhe_agg scheme adapter: pytree encrypt / decrypt (see fhe_agg.py's
+    scheme registry). The secret is derived deterministically from the shared
+    FHE secret string, mirroring the reference's shared TenSEAL context file
+    (all clients + the decrypting authority load the same context)."""
+
+    def __init__(self, secret: bytes, params: Optional[RLWEParams] = None):
+        seed = int.from_bytes(__import__("hashlib").sha256(secret).digest()[:8], "little")
+        self.ctx = RLWEContext(params, seed=seed)
+
+    def encrypt(self, tree: Any, nonce: int) -> Any:
+        import jax
+
+        return jax.tree.map(lambda x: self.ctx.encrypt(np.asarray(jax.device_get(x))), tree)
+
+    def decrypt_sum(self, tree: Any, nonces=None, weights=None) -> Any:
+        import jax
+
+        return jax.tree.map(
+            lambda ct: self.ctx.decrypt(ct), tree, is_leaf=lambda x: isinstance(x, Ciphertext)
+        )

@@ -14,6 +14,7 @@ from typing import Any, Dict, Optional
 from ... import mlops
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ...parallel.multihost import broadcast_model_params, broadcast_round_metadata, process_count
 from ..message_define import MyMessage
 
 log = logging.getLogger(__name__)
@@ -50,6 +51,7 @@ class ClientMasterManager(FedMLCommManager):
         self.is_inited = True
         global_model_params = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         data_silo_index = msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
+        self.client_index = int(data_silo_index)
         self.trainer_dist_adapter.update_dataset(int(data_silo_index))
         self.trainer_dist_adapter.update_model(global_model_params)
         self.args.round_idx = 0
@@ -58,6 +60,7 @@ class ClientMasterManager(FedMLCommManager):
     def handle_message_receive_model_from_server(self, msg_params: Message) -> None:
         model_params = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         client_index = msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
+        self.client_index = int(client_index)
         self.trainer_dist_adapter.update_dataset(int(client_index))
         self.trainer_dist_adapter.update_model(model_params)
         self.args.round_idx += 1
@@ -65,6 +68,10 @@ class ClientMasterManager(FedMLCommManager):
 
     def handle_message_finish(self, msg_params: Message) -> None:
         log.info("====== training finished ======")
+        if process_count() > 1:
+            # release the silo's slave processes (they block in
+            # await_sync_process_group)
+            broadcast_round_metadata({"finished": True})
         mlops.log_training_status("FINISHED", str(getattr(self.args, "run_id", "0")))
         self.finish()
 
@@ -85,6 +92,20 @@ class ClientMasterManager(FedMLCommManager):
 
     def __train(self) -> None:
         log.info("====== training on round %d ======", self.args.round_idx)
+        if process_count() > 1:
+            # sync slaves BEFORE dispatching the jitted step: every process
+            # in the slice must run the same program or the ICI collectives
+            # deadlock (reference sync_process_group :200-212). The sync
+            # carries BOTH metadata and the fresh global params — slaves have
+            # no WAN connection, this broadcast is their only model source.
+            broadcast_round_metadata(
+                {
+                    "model_version": int(self.args.round_idx),
+                    "client_index": int(getattr(self, "client_index", self.rank)),
+                    "finished": False,
+                }
+            )
+            broadcast_model_params(self.trainer_dist_adapter.get_model_params(), is_source=True)
         mlops.event("train", event_started=True, event_value=str(self.args.round_idx))
         weights, local_sample_num = self.trainer_dist_adapter.train(self.args.round_idx)
         mlops.event("train", event_started=False, event_value=str(self.args.round_idx))

@@ -1,0 +1,57 @@
+"""Cross-silo client SLAVE manager: non-main processes of a silo's slice.
+
+Reference: ``cross_silo/client/fedml_client_slave_manager.py`` — torchrun
+slave ranks block in ``await_sync_process_group`` for the round metadata the
+master broadcasts (``fedml_client_master_manager.py:200-212``), then run the
+same local training step so DDP collectives line up. TPU-native: the silo is
+a jax.distributed slice; slaves loop on ``broadcast_round_metadata(None)``
+(a device broadcast over ICI/DCN) and execute the identical jitted train
+step — XLA's collectives require every process to dispatch the same program,
+which this loop guarantees. Only the master (process_index 0) talks WAN.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from ...parallel.multihost import broadcast_model_params, broadcast_round_metadata
+
+log = logging.getLogger(__name__)
+
+
+class ClientSlaveManager:
+    def __init__(self, args: Any, trainer_dist_adapter):
+        self.args = args
+        self.trainer_dist_adapter = trainer_dist_adapter
+        self.round_idx = 0
+        self.finished = False
+
+    def await_sync_process_group(self):
+        """Block for the master's round metadata (reference slave manager)."""
+        meta = broadcast_round_metadata(None)
+        log.debug("slave got round metadata: %s", meta)
+        return meta
+
+    def train(self, meta) -> None:
+        if meta.get("model_version") is not None:
+            self.round_idx = int(meta["model_version"])
+        if meta.get("client_index") is not None:
+            self.trainer_dist_adapter.update_dataset(int(meta["client_index"]))
+        # receive the round's global params from the master (slaves have no
+        # WAN connection; training on stale weights would silently corrupt
+        # the lock-stepped collective program)
+        params = broadcast_model_params(
+            self.trainer_dist_adapter.get_model_params(), is_source=False
+        )
+        self.trainer_dist_adapter.update_model(params)
+        self.trainer_dist_adapter.train(self.round_idx)
+
+    def run(self) -> None:
+        while not self.finished:
+            meta = self.await_sync_process_group()
+            if meta.get("finished"):
+                self.finished = True
+                log.info("slave finished")
+                break
+            self.train(meta)

@@ -79,6 +79,9 @@ class TrainerDistAdapter:
     def update_model(self, model_params) -> None:
         self.trainer.update_model(model_params)
 
+    def get_model_params(self):
+        return self.trainer.trainer.get_model_params()
+
     def update_dataset(self, client_index: Optional[int] = None) -> None:
         self.trainer.update_dataset(int(client_index if client_index is not None else self.trainer.client_index))
 

@@ -1,15 +1,26 @@
-"""Cross-silo client entry (reference: cross_silo/fedml_client.py:5)."""
+"""Cross-silo client entry (reference: cross_silo/fedml_client.py:5).
+
+Multi-host silos: ``fedml_tpu.parallel.multihost.init_distributed`` joins
+the slice's processes; exactly ONE (process_index 0) becomes the WAN-talking
+ClientMasterManager, the rest run ClientSlaveManager loops that receive
+round metadata over the device broadcast and execute the same jitted train
+step (reference rank-0 gating, fedml_client_master_manager.py:67-70)."""
 
 from __future__ import annotations
 
 from typing import Any
 
+from ..parallel.multihost import init_distributed, is_main_process
 from .client.fedml_client_master_manager import ClientMasterManager
+from .client.fedml_client_slave_manager import ClientSlaveManager
 from .client.fedml_trainer_dist_adapter import TrainerDistAdapter
 
 
 class FedMLCrossSiloClient:
     def __init__(self, args: Any, device, dataset, model, model_trainer=None):
+        # fedml.init() already ran init_distributed (it must precede any JAX
+        # use); this is the idempotent late safety-net for direct construction
+        init_distributed()
         [
             train_data_num,
             test_data_num,
@@ -34,9 +45,13 @@ class FedMLCrossSiloClient:
             test_data_local_dict,
             model_trainer,
         )
-        self.client_manager = ClientMasterManager(
-            args, trainer_dist_adapter, rank=client_rank, size=size, backend=backend
-        )
+        if is_main_process():
+            self.client_manager = ClientMasterManager(
+                args, trainer_dist_adapter, rank=client_rank, size=size, backend=backend
+            )
+        else:
+            # slave processes never open a WAN connection
+            self.client_manager = ClientSlaveManager(args, trainer_dist_adapter)
 
     def run(self) -> None:
         self.client_manager.run()

@@ -30,6 +30,7 @@ from .sources import (
     load_stackoverflow_lr,
     load_synthetic_lr,
     load_tabular_dataset,
+    load_text_classification_dataset,
     load_text_dataset,
 )
 
@@ -40,6 +41,7 @@ IMAGE_DATASETS = {
     "fed_cifar100", "imagenet", "gld23k", "landmarks",
 }
 TEXT_DATASETS = {"shakespeare", "fed_shakespeare", "stackoverflow_nwp", "reddit"}
+TEXT_CLS_DATASETS = {"20news", "agnews", "sst2", "semeval_2010_task8"}  # FedNLP family
 TABULAR_DATASETS = {"lending_club", "uci"}
 
 FedDataset = Tuple[int, int, ArrayDataset, ArrayDataset, Dict[int, int], Dict[int, ArrayDataset], Dict[int, ArrayDataset], int]
@@ -80,7 +82,9 @@ def load(args: Any) -> FedDataset:
         args.output_dim = fed[-1]
         return fed
 
-    if dataset in TEXT_DATASETS:
+    if dataset in TEXT_CLS_DATASETS:
+        x_tr, y_tr, x_te, y_te, class_num = load_text_classification_dataset(dataset, cache, seed)
+    elif dataset in TEXT_DATASETS:
         x_tr, y_tr, x_te, y_te, vocab = load_text_dataset(dataset, cache, seed)
         class_num = vocab
     elif dataset in IMAGE_DATASETS:

@@ -120,6 +120,45 @@ def load_text_dataset(name: str, cache_dir: str, seed: int = 0):
     return x_tr, y_tr, x_te, y_te, vocab
 
 
+def load_text_classification_dataset(name: str, cache_dir: str, seed: int = 0):
+    """Text classification (FedNLP family, reference ``data/fednlp/`` —
+    20news is BASELINE config 3's DistilBERT task) ->
+    (x_train [N,T] int tokens, y_train [N] labels, x_test, y_test, classes).
+
+    Local file: ``{cache}/{name}.npz``; surrogate: class-conditional unigram
+    token distributions (each class reweights the vocab) — learnable by any
+    text encoder, non-trivial for a bag-of-one feature."""
+    specs = {
+        # name: (seq_len, vocab, classes, n_train, n_test)
+        "20news": (128, 5000, 20, 11314, 2000),  # real 20news train size
+        "agnews": (64, 5000, 4, 12000, 2000),
+        "sst2": (32, 3000, 2, 8000, 1000),
+        "semeval_2010_task8": (64, 4000, 19, 8000, 1000),
+    }
+    T, vocab, classes, n_train, n_test = specs[name]
+    path = os.path.join(cache_dir or "", f"{name}.npz")
+    if cache_dir and os.path.exists(path):
+        x_tr, y_tr, x_te, y_te = _load_npz(path)
+        return x_tr.astype(np.int64), y_tr, x_te.astype(np.int64), y_te, classes
+    log.warning("dataset %s: no local file at %s — synthetic text-cls surrogate", name, path)
+    n_train, n_test = min(n_train, 8000), min(n_test, 2000)
+    base = np.random.default_rng(seed).dirichlet(np.ones(vocab) * 0.02, size=classes)
+
+    def sample(n, s):
+        r = np.random.default_rng(s)
+        y = r.integers(0, classes, n)
+        x = np.empty((n, T), np.int64)
+        for c in range(classes):  # one vectorized draw per class, not per sample
+            idx = np.nonzero(y == c)[0]
+            if len(idx):
+                x[idx] = r.choice(vocab, size=(len(idx), T), p=base[c])
+        return x, y.astype(np.int64)
+
+    x_tr, y_tr = sample(n_train, seed + 2)
+    x_te, y_te = sample(n_test, seed + 3)
+    return x_tr, y_tr, x_te, y_te, classes
+
+
 def load_tabular_dataset(name: str, cache_dir: str, seed: int = 0):
     """Binary tabular sets (reference: data/lending_club_loan/ and data/UCI/
     loaders) -> (x_train, y_train, x_test, y_test, 2). Local file:

@@ -56,6 +56,8 @@ class MLOpsRuntime:
         self.records: List[Dict[str, Any]] = []
         self.metrics: List[Dict[str, Any]] = []
         self._wandb = None
+        self.uplink = None  # MQTT telemetry plane (backend.py), opt-in
+        self.api_url: Optional[str] = None  # REST log collector, opt-in
         self.profiler = MLOpsProfilerEvent(self)
 
     def init(self, args: Any) -> None:
@@ -73,12 +75,31 @@ class MLOpsRuntime:
                 wandb.init(project=getattr(args, "wandb_project", "fedml_tpu"), config=vars(args))
             except Exception:  # pragma: no cover - wandb optional
                 log.warning("wandb requested but unavailable")
+        # backend connectivity (reference mlops_metrics.py MQTT + REST): an
+        # uplink when the run asks for it, a collector url for log upload
+        self.api_url = getattr(args, "mlops_api_url", None)
+        if self.enabled and bool(getattr(args, "mlops_backend_mqtt", False)):
+            try:
+                from .backend import MLOpsUplink
+
+                self.uplink = MLOpsUplink(args)
+            except Exception:
+                # optional telemetry must never abort a training run
+                logging.getLogger(__name__).warning(
+                    "mlops MQTT uplink unavailable; continuing without it", exc_info=True
+                )
 
     def append_record(self, rec: Dict[str, Any]) -> None:
         self.records.append(rec)
         if self.enabled and self.run_dir:
             with open(os.path.join(self.run_dir, "events.jsonl"), "a") as f:
                 f.write(json.dumps(rec) + "\n")
+        if self.uplink is not None:
+            try:
+                self.uplink.publish(rec)
+            except Exception:  # telemetry must never kill a run
+                # NB: module-level `log` is the public API function, not a logger
+                logging.getLogger(__name__).exception("mlops uplink publish failed")
 
 
 def log(metrics: Dict[str, Any], step: Optional[int] = None, commit: bool = True) -> None:
@@ -215,6 +236,11 @@ def start_log_daemon(args: Any = None, rank: int = 0):
     run_id = str(getattr(args, "run_id", "0")) if args is not None else "0"
     run_dir = rt.run_dir or os.path.join(os.path.expanduser("~/.fedml_tpu/logs"), f"run_{run_id}")
     path = MLOpsRuntimeLog.init(run_dir, run_id, rank)
-    daemon = MLOpsRuntimeLogDaemon(path, run_id, rank)
+    sink = None
+    if rt.api_url:  # chunked POST to the collector (reference log daemon)
+        from .backend import http_log_sink
+
+        sink = http_log_sink(rt.api_url)
+    daemon = MLOpsRuntimeLogDaemon(path, run_id, rank, sink=sink)
     daemon.start()
     return daemon

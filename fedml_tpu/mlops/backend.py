@@ -1,0 +1,135 @@
+"""MLOps backend connectivity: metric/status/event uplink + log upload.
+
+Reference: ``core/mlops/mlops_metrics.py`` publishes run telemetry over MQTT
+topics (``fedml_slave/fedml_master/metrics``, ``fl_run/fl_client/mlops/status``,
+``mlops/events``) and ``mlops_runtime_log_daemon.py`` POSTs chunked log
+lines to the MLOps REST endpoint (``/fedmlLogsServer/logs/update``). Zero
+egress here, so both planes target configurable LOCAL endpoints: the MQTT
+transport (local broker or a real paho broker via args) and any HTTP
+collector — ``LocalMLOpsCollector`` is the in-repo one, usable in tests and
+as a single-box dashboard sink (VERDICT r1 missing #7).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+from urllib import request as urlrequest
+
+from ..core.distributed.communication.mqtt_s3.mqtt_transport import create_mqtt_transport
+
+log = logging.getLogger(__name__)
+
+TOPIC_METRICS = "fedml_slave/fedml_master/metrics"
+TOPIC_STATUS = "fl_run/fl_client/mlops/status"
+TOPIC_EVENTS = "mlops/events"
+LOGS_ROUTE = "/fedmlLogsServer/logs/update"
+
+
+class MLOpsUplink:
+    """Publishes runtime records to the MLOps message plane by type."""
+
+    _TOPIC_BY_TYPE = {"metric": TOPIC_METRICS, "status": TOPIC_STATUS, "event": TOPIC_EVENTS}
+
+    def __init__(self, args: Any = None, run_id: Optional[str] = None):
+        self.run_id = str(run_id if run_id is not None else getattr(args, "run_id", "0"))
+        self.transport = create_mqtt_transport(args, client_id=f"mlops_uplink_{self.run_id}")
+        self.published = 0
+
+    def publish(self, rec: Dict[str, Any]) -> None:
+        topic = self._TOPIC_BY_TYPE.get(str(rec.get("type")), TOPIC_EVENTS)
+        doc = dict(rec, run_id=rec.get("run_id") or self.run_id)
+        self.transport.publish(topic, json.dumps(doc).encode())
+        self.published += 1
+
+    def stop(self) -> None:
+        self.transport.disconnect()
+
+
+def http_log_sink(api_url: str, timeout_s: float = 10.0):
+    """Sink for MLOpsRuntimeLogDaemon: chunked POST, reference endpoint
+    shape (mlops_runtime_log_daemon.py chunked upload)."""
+
+    def sink(run_id: str, rank: int, lines: List[str]) -> None:
+        body = json.dumps(
+            {"run_id": run_id, "edge_id": rank, "logs": lines, "line_count": len(lines)}
+        ).encode()
+        req = urlrequest.Request(
+            api_url.rstrip("/") + LOGS_ROUTE,
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urlrequest.urlopen(req, timeout=timeout_s) as resp:
+            if resp.status >= 300:
+                raise RuntimeError(f"log upload failed: HTTP {resp.status}")
+
+    return sink
+
+
+class LocalMLOpsCollector:
+    """Single-box MLOps backend: HTTP log receiver + MQTT telemetry
+    subscriber, spooling everything to JSONL under ``root``."""
+
+    def __init__(self, root: str, args: Any = None, http_port: int = 0):
+        import os
+
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.metrics: List[Dict[str, Any]] = []
+        self.statuses: List[Dict[str, Any]] = []
+        self.events: List[Dict[str, Any]] = []
+        self.log_chunks: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+        self.transport = create_mqtt_transport(args, client_id="mlops_collector")
+        self.transport.subscribe(TOPIC_METRICS, self._on(self.metrics, "metrics"))
+        self.transport.subscribe(TOPIC_STATUS, self._on(self.statuses, "status"))
+        self.transport.subscribe(TOPIC_EVENTS, self._on(self.events, "events"))
+
+        collector = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args_):
+                log.debug("collector http: " + fmt, *args_)
+
+            def do_POST(self):
+                if self.path != LOGS_ROUTE:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(length) or b"{}")
+                collector._record(collector.log_chunks, "logs", doc)
+                body = b'{"code": "SUCCESS"}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", http_port), Handler)
+        self.http_port = self._server.server_address[1]
+        self.api_url = f"http://127.0.0.1:{self.http_port}"
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def _on(self, bucket: List[Dict[str, Any]], name: str):
+        def cb(_topic: str, payload: bytes) -> None:
+            self._record(bucket, name, json.loads(payload))
+
+        return cb
+
+    def _record(self, bucket: List[Dict[str, Any]], name: str, doc: Dict[str, Any]) -> None:
+        import os
+
+        with self._lock:
+            bucket.append(doc)
+            with open(os.path.join(self.root, f"{name}.jsonl"), "a") as f:
+                f.write(json.dumps(doc) + "\n")
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self.transport.disconnect()

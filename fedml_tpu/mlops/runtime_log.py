@@ -100,11 +100,20 @@ class MLOpsRuntimeLogDaemon:
             # final drain, where holding it back would lose it forever
             if raw and not final and not raw[-1].endswith(b"\n"):
                 raw.pop()
-            self._pos += sum(len(b) for b in raw)
         lines = [b.decode("utf-8", "replace") for b in raw]
         if lines:
-            self.sink(self.run_id, self.rank, lines)
+            try:
+                self.sink(self.run_id, self.rank, lines)
+            except Exception:
+                # transient sink failure (collector briefly unreachable) must
+                # not kill the daemon or drop the chunk: offset is only
+                # advanced on success, so the next poll retries it
+                logging.getLogger(__name__).warning(
+                    "log sink failed; will retry chunk of %d lines", len(lines), exc_info=True
+                )
+                return 0
             self.chunks_shipped += 1
+        self._pos += sum(len(b) for b in raw)
         return len(lines)
 
     def _loop(self) -> None:

@@ -63,7 +63,15 @@ _INPUT_SPECS = {
     "fed_shakespeare": ((1, 80), jnp.int32),
     "stackoverflow_nwp": ((1, 20), jnp.int32),
     "stackoverflow_lr": ((1, 10000), jnp.float32),
+    # FedNLP text classification (BASELINE config 3)
+    "20news": ((1, 128), jnp.int32),
+    "agnews": ((1, 64), jnp.int32),
+    "sst2": ((1, 32), jnp.int32),
+    "semeval_2010_task8": ((1, 64), jnp.int32),
 }
+
+# vocab sizes matching data/sources.py load_text_classification_dataset specs
+_TEXT_CLS_VOCAB = {"20news": 5000, "agnews": 5000, "sst2": 3000, "semeval_2010_task8": 4000}
 
 
 def input_spec_for(dataset: str) -> Tuple[Tuple[int, ...], Any]:
@@ -86,6 +94,18 @@ def create(args: Any, output_dim: Optional[int] = None, seed: Optional[int] = No
         module = CNNDropOut(num_classes=num_classes) if in_shape[1] == 28 else CNNCifar(num_classes=num_classes)
     elif model_name == "cnn_cifar":
         module = CNNCifar(num_classes=num_classes)
+    elif model_name in ("distilbert", "bert", "text_classifier", "transformer_cls"):
+        from .text_classifier import distilbert_shape
+
+        module = distilbert_shape(
+            num_classes=num_classes,
+            vocab_size=int(getattr(args, "vocab_size", 0) or _TEXT_CLS_VOCAB.get(dataset, 5000)),
+            max_seq_len=in_shape[1],
+            d_model=int(getattr(args, "text_d_model", 256)),
+            n_layers=int(getattr(args, "text_n_layers", 4)),
+            n_heads=int(getattr(args, "text_n_heads", 4)),
+            d_ff=int(getattr(args, "text_d_ff", 1024)),
+        )
     elif model_name in ("rnn", "rnn_fedavg"):
         module = RNNOriginalFedAvg()
     elif model_name in ("rnn_stackoverflow", "rnn_nwp"):

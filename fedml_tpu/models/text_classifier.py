@@ -1,0 +1,85 @@
+"""Transformer text classifier (the FedNLP / DistilBERT-task model).
+
+Reference: BASELINE config 3 — DistilBERT text classification on 20news via
+cross-silo FedOpt (``data/fednlp/``, the reference fine-tunes HF
+DistilBERT). TPU-native re-design rather than a HF port: a compact
+bidirectional transformer encoder in flax — token+position embeddings, N
+pre-LayerNorm self-attention blocks (GELU FFN), masked mean pooling, linear
+head. Static shapes, bf16-friendly matmuls, entirely jit-compatible; the FL
+trainers treat it like any other (params, tokens)->logits module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TextClassifierConfig:
+    vocab_size: int = 5000
+    num_classes: int = 20
+    d_model: int = 256
+    n_layers: int = 4
+    n_heads: int = 4
+    d_ff: int = 1024
+    max_seq_len: int = 128
+    dropout: float = 0.1
+    pad_id: int = 0
+
+
+class EncoderBlock(nn.Module):
+    cfg: TextClassifierConfig
+
+    @nn.compact
+    def __call__(self, x, mask, *, deterministic: bool = True):
+        cfg = self.cfg
+        h = nn.LayerNorm()(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=cfg.n_heads,
+            dropout_rate=cfg.dropout,
+            deterministic=deterministic,
+        )(h, h, mask=mask)
+        x = x + h
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(cfg.d_ff)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model)(h)
+        h = nn.Dropout(cfg.dropout, deterministic=deterministic)(h)
+        return x + h
+
+
+class TransformerTextClassifier(nn.Module):
+    cfg: TextClassifierConfig
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray, train: bool = False, rngs=None):
+        cfg = self.cfg
+        tokens = tokens.astype(jnp.int32)
+        B, T = tokens.shape
+        pad_mask = tokens != cfg.pad_id  # [B, T]
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, name="tok_embed")(tokens)
+        pos = nn.Embed(cfg.max_seq_len, cfg.d_model, name="pos_embed")(
+            jnp.broadcast_to(jnp.arange(T), (B, T))
+        )
+        x = x + pos
+        attn_mask = nn.make_attention_mask(pad_mask, pad_mask)  # [B,1,T,T]
+        for i in range(cfg.n_layers):
+            x = EncoderBlock(cfg, name=f"block_{i}")(x, attn_mask, deterministic=not train)
+        x = nn.LayerNorm(name="final_norm")(x)
+        # masked mean pool (CLS-free: surrogate/native data has no CLS token)
+        denom = jnp.maximum(pad_mask.sum(-1, keepdims=True), 1).astype(x.dtype)
+        pooled = (x * pad_mask[..., None]).sum(axis=1) / denom
+        return nn.Dense(cfg.num_classes, name="classifier")(pooled)
+
+
+def distilbert_shape(num_classes: int, vocab_size: int = 5000, max_seq_len: int = 128,
+                     **over) -> TransformerTextClassifier:
+    """DistilBERT-proportioned config scaled to the federated task."""
+    cfg = TextClassifierConfig(
+        vocab_size=vocab_size, num_classes=num_classes, max_seq_len=max_seq_len, **over
+    )
+    return TransformerTextClassifier(cfg)

@@ -1,0 +1,130 @@
+"""Multi-host runtime: jax.distributed wiring + intra-silo host broadcast.
+
+Reference: the hierarchical cross-silo client spawns N torchrun ranks per
+silo; rank 0 talks WAN and syncs round metadata to slave ranks with
+``dist.broadcast_object_list`` (``cross_silo/client/fedml_client_master_manager.py:67,200-212``,
+``fedml_client_slave_manager.py``). TPU-native: the silo is a pod slice, its
+processes are joined by ``jax.distributed.initialize`` (one process per
+host), and round metadata travels as a device all-gather over the slice's
+ICI/DCN via ``multihost_utils.broadcast_one_to_all`` — exactly one process
+(process_index 0) opens the WAN connection.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+_MAX_META_BYTES = 1 << 16
+
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join this process to the slice's jax.distributed job.
+
+    Args fall back to the env vars the launcher exports
+    (FEDML_COORDINATOR_ADDRESS / FEDML_NUM_PROCESSES / FEDML_PROCESS_ID —
+    the torchrun-env analogue). No-ops (returns False) when single-process.
+
+    MUST run before any other JAX use (jax.distributed.initialize cannot
+    attach once the backend is up) — ``fedml_tpu.init()`` calls this first
+    for exactly that reason. Idempotent: later calls are no-ops."""
+    global _initialized
+    if _initialized:
+        return True
+
+    coordinator_address = coordinator_address or os.environ.get("FEDML_COORDINATOR_ADDRESS")
+    num_processes = num_processes if num_processes is not None else int(
+        os.environ.get("FEDML_NUM_PROCESSES", "1")
+    )
+    process_id = process_id if process_id is not None else int(os.environ.get("FEDML_PROCESS_ID", "0"))
+    if not coordinator_address or num_processes <= 1:
+        return False
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info("jax.distributed up: process %d/%d via %s", process_id, num_processes, coordinator_address)
+    return True
+
+
+def is_main_process() -> bool:
+    """True on exactly one process per slice — the only WAN talker
+    (reference fedml_client_master_manager.py:67-70 rank-0 gating)."""
+    import jax
+
+    return jax.process_index() == 0
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def broadcast_round_metadata(meta: Optional[Any], *, is_source: Optional[bool] = None) -> Any:
+    """Broadcast a small json-serializable object from the main process to
+    every process in the slice (reference ``dist.broadcast_object_list`` at
+    fedml_client_master_manager.py:200-212; here a fixed-size uint8 device
+    broadcast so it rides ICI/DCN, not a side channel).
+
+    Non-source processes pass meta=None and receive the source's object."""
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() <= 1:
+        return meta
+
+    if is_source is None:
+        is_source = is_main_process()
+    buf = np.zeros(_MAX_META_BYTES, np.uint8)
+    if is_source:
+        raw = json.dumps(meta).encode()
+        if len(raw) + 4 > _MAX_META_BYTES:
+            raise ValueError(f"round metadata too large: {len(raw)} bytes")
+        buf[:4] = np.frombuffer(np.uint32(len(raw)).tobytes(), np.uint8)
+        buf[4 : 4 + len(raw)] = np.frombuffer(raw, np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf, is_source=is_source))
+    n = int(np.frombuffer(out[:4].tobytes(), np.uint32)[0])
+    return json.loads(out[4 : 4 + n].tobytes().decode())
+
+
+def broadcast_model_params(params, *, is_source: Optional[bool] = None):
+    """Broadcast the global model pytree from the main process to every
+    process in the slice (the reference broadcasts params in the same
+    ``broadcast_object_list`` sync it sends metadata with). Non-source
+    processes pass their CURRENT params (same treedef/shapes) and receive
+    the source's values."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() <= 1:
+        return params
+    if is_source is None:
+        is_source = is_main_process()
+    return multihost_utils.broadcast_one_to_all(params, is_source=is_source)
+
+
+def sync_process_group() -> None:
+    """Barrier across the slice's processes (reference sync_process_group)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() > 1:
+        multihost_utils.sync_global_devices("fedml_round_barrier")

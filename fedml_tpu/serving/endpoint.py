@@ -131,7 +131,46 @@ class EndpointManager:
             pass
         return ep
 
+    def deploy_isolated(
+        self,
+        name: str,
+        predictor_spec: str,
+        num_replicas: int = 1,
+        *,
+        model_path: Optional[str] = None,
+        autoscale: bool = False,
+        **scaler_kw,
+    ):
+        """Deploy with subprocess-isolated replicas + health-evicting gateway
+        (+ optional autoscaler) — the container-deployment analogue
+        (reference device_model_deployment.py:68). predictor_spec is a
+        'module:factory' string importable by the replica child."""
+        from .replica_controller import AutoScaler, InferenceGateway, ReplicaSet
+
+        if name in self.endpoints:
+            raise ValueError(f"endpoint {name!r} already deployed")
+        rs = ReplicaSet(predictor_spec, num_replicas, model_path=model_path)
+        try:
+            gw = InferenceGateway(rs)
+            scaler = None
+            if autoscale:
+                scaler = AutoScaler(gw, **scaler_kw)
+                scaler.start()
+        except Exception:
+            rs.shutdown()  # don't orphan live replica subprocesses
+            raise
+        self.endpoints[name] = gw  # gateway exposes predict() like Endpoint
+        gw.replica_set_scaler = scaler
+        return gw
+
     def undeploy(self, name: str) -> None:
         ep = self.endpoints.pop(name, None)
-        if ep is not None:
+        if ep is None:
+            return
+        scaler = getattr(ep, "replica_set_scaler", None)
+        if scaler is not None:
+            scaler.stop()
+        if hasattr(ep, "replica_set"):
+            ep.replica_set.shutdown()
+        else:
             ep.shutdown()

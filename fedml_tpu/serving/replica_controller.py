@@ -1,0 +1,298 @@
+"""Subprocess replica set, health-evicting gateway, and autoscaler.
+
+Reference: ``model_scheduler/device_replica_controller.py`` (replica
+diff/rollback control), ``device_model_deployment.py:576`` (readiness
+probing of freshly started containers), ``device_model_inference.py``
+(gateway forwarding + endpoint liveness). Containers are unavailable in this
+environment, so the isolation unit is an OS subprocess per replica; the
+controller keeps the desired count, the gateway retries across replicas and
+evicts ones that fail, and the autoscaler maps observed QPS/latency to a
+desired replica count.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class SubprocessReplica:
+    """One replica = one child python process serving /predict + /ready."""
+
+    def __init__(self, predictor_spec: str, *, model_path: Optional[str] = None,
+                 startup_timeout_s: float = 60.0):
+        self.id = uuid.uuid4().hex[:8]
+        self.predictor_spec = predictor_spec
+        self._port_file = os.path.join(tempfile.gettempdir(), f"fedml_replica_{self.id}.port")
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        cmd = [sys.executable, "-m", "fedml_tpu.serving.replica_main",
+               "--predictor", predictor_spec, "--port-file", self._port_file]
+        if model_path:
+            cmd += ["--model-path", model_path]
+        self.proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        self.port = self._await_port(startup_timeout_s)
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.consecutive_failures = 0
+
+    def _await_port(self, timeout_s: float) -> int:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if os.path.exists(self._port_file):
+                try:
+                    return int(open(self._port_file).read())
+                except ValueError:
+                    pass
+            if self.proc.poll() is not None:
+                raise RuntimeError(f"replica {self.id} died during startup (rc={self.proc.returncode})")
+            time.sleep(0.05)
+        self.proc.kill()
+        raise TimeoutError(f"replica {self.id} did not report a port within {timeout_s}s")
+
+    def ready(self, timeout_s: float = 2.0) -> bool:
+        """Readiness probe (reference device_model_deployment.py:576)."""
+        try:
+            with urllib.request.urlopen(self.url + "/ready", timeout=timeout_s) as resp:
+                return resp.status == 200
+        except (urllib.error.URLError, OSError):
+            return False
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        try:
+            os.unlink(self._port_file)
+        except OSError:
+            pass
+
+
+class ReplicaSet:
+    """Keep `desired` healthy subprocess replicas (reference
+    device_replica_controller.py diff logic: add missing, remove extra,
+    replace dead)."""
+
+    def __init__(self, predictor_spec: str, desired: int = 1, *, model_path: Optional[str] = None,
+                 max_consecutive_failures: int = 3):
+        self.predictor_spec = predictor_spec
+        self.model_path = model_path
+        self.desired = 0
+        self.replicas: List[SubprocessReplica] = []
+        self.max_consecutive_failures = max_consecutive_failures
+        self._lock = threading.RLock()
+        try:
+            self.scale_to(desired)
+        except Exception:
+            # a replica failing mid-construction must not leak the ones
+            # already serving — nobody holds a handle to shut them down
+            self.shutdown()
+            raise
+
+    def scale_to(self, n: int) -> None:
+        with self._lock:
+            self.desired = int(n)
+            self.reconcile()
+
+    def reconcile(self) -> None:
+        """Converge actual replicas to the desired count, replacing dead ones."""
+        with self._lock:
+            self.replicas = [r for r in self.replicas if self._evict_if_dead(r)]
+            while len(self.replicas) < self.desired:
+                self.replicas.append(
+                    SubprocessReplica(self.predictor_spec, model_path=self.model_path)
+                )
+                log.info("replica set: started %s on %s", self.replicas[-1].id, self.replicas[-1].url)
+            while len(self.replicas) > self.desired:
+                victim = self.replicas.pop()
+                victim.stop()
+                log.info("replica set: stopped %s", victim.id)
+
+    def _evict_if_dead(self, r: SubprocessReplica) -> bool:
+        if not r.alive() or r.consecutive_failures >= self.max_consecutive_failures:
+            log.warning("replica set: evicting %s (alive=%s failures=%d)",
+                        r.id, r.alive(), r.consecutive_failures)
+            r.stop()
+            return False
+        return True
+
+    def healthy(self) -> List[SubprocessReplica]:
+        with self._lock:
+            return [r for r in self.replicas if r.alive()]
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self.desired = 0
+            for r in self.replicas:
+                r.stop()
+            self.replicas = []
+
+
+@dataclass
+class GatewayStats:
+    requests: int = 0
+    errors: int = 0
+    window_start: float = 0.0
+    window_requests: int = 0
+    latency_ewma_s: float = 0.0
+
+    def qps(self) -> float:
+        dt = time.time() - self.window_start
+        return self.window_requests / dt if dt > 0 else 0.0
+
+
+class InferenceGateway:
+    """Round-robin over healthy replicas with retry + failure eviction
+    (reference device_model_inference.py)."""
+
+    def __init__(self, replica_set: ReplicaSet):
+        self.replica_set = replica_set
+        self.stats = GatewayStats(window_start=time.time())
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def reset_window(self) -> None:
+        with self._lock:
+            self.stats.window_start = time.time()
+            self.stats.window_requests = 0
+
+    def predict(self, payload: Dict[str, Any], *, timeout_s: float = 30.0, retries: int = 3) -> Dict[str, Any]:
+        data = json.dumps(payload).encode()
+        last_err: Optional[Exception] = None
+        for _ in range(max(1, retries)):
+            healthy = self.replica_set.healthy()
+            if not healthy:
+                self.replica_set.reconcile()
+                healthy = self.replica_set.healthy()
+                if not healthy:
+                    raise RuntimeError("no healthy replicas")
+            with self._lock:
+                r = healthy[self._rr % len(healthy)]
+                self._rr += 1
+            t0 = time.perf_counter()
+            try:
+                req = urllib.request.Request(
+                    r.url + "/predict", data=data, headers={"Content-Type": "application/json"}
+                )
+                with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                    out = json.loads(resp.read())
+                dt = time.perf_counter() - t0
+                with self._lock:
+                    r.consecutive_failures = 0
+                    s = self.stats
+                    s.requests += 1
+                    s.window_requests += 1
+                    s.latency_ewma_s = dt if s.latency_ewma_s == 0 else 0.9 * s.latency_ewma_s + 0.1 * dt
+                return out
+            except (urllib.error.URLError, OSError, ConnectionError) as e:
+                last_err = e
+                with self._lock:
+                    r.consecutive_failures += 1
+                    self.stats.errors += 1
+                # replace the failed replica before retrying on another
+                self.replica_set.reconcile()
+        raise RuntimeError(f"predict failed after {retries} retries: {last_err!r}")
+
+
+class AutoScaler:
+    """QPS/latency -> replica count policy (reference
+    device_replica_controller autoscale surface).
+
+    desired = ceil(observed_qps / target_qps_per_replica), clamped to
+    [min_replicas, max_replicas]; scale-down only after `cooldown_s` of
+    sustained low load, scale-up immediate."""
+
+    def __init__(
+        self,
+        gateway: InferenceGateway,
+        *,
+        target_qps_per_replica: float = 50.0,
+        min_replicas: int = 1,
+        max_replicas: int = 8,
+        cooldown_s: float = 30.0,
+    ):
+        self.gateway = gateway
+        self.target = float(target_qps_per_replica)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.cooldown_s = float(cooldown_s)
+        self._low_since: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def desired_replicas(self) -> int:
+        qps = self.gateway.stats.qps()
+        want = max(1, math.ceil(qps / self.target)) if qps > 0 else self.min_replicas
+        return max(self.min_replicas, min(self.max_replicas, want))
+
+    def tick(self, now: Optional[float] = None) -> int:
+        now = now if now is not None else time.time()
+        rs = self.gateway.replica_set
+        want = self.desired_replicas()
+        have = rs.desired
+        if want > have:
+            self._low_since = None
+            rs.scale_to(want)
+        elif want < have:
+            if self._low_since is None:
+                self._low_since = now
+            elif now - self._low_since >= self.cooldown_s:
+                rs.scale_to(want)
+                self._low_since = None
+        else:
+            self._low_since = None
+        self.gateway.reset_window()
+        return rs.desired
+
+    def start(self, period_s: float = 5.0) -> None:
+        def loop():
+            while not self._stop.wait(period_s):
+                try:
+                    self.tick()
+                except Exception:  # pragma: no cover - keep the loop alive
+                    log.exception("autoscaler tick failed")
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5.0)
+
+
+def create_echo_predictor(model_path: Optional[str] = None):
+    """Builtin demo predictor factory (tests + quick starts)."""
+    from .fedml_predictor import FedMLPredictor
+
+    class EchoPredictor(FedMLPredictor):
+        def __init__(self):
+            pass
+
+        def predict(self, request: Dict[str, Any]) -> Dict[str, Any]:
+            return {"echo": request, "pid": os.getpid()}
+
+        def ready(self) -> bool:
+            return True
+
+    return EchoPredictor()

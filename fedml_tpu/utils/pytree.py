@@ -80,6 +80,14 @@ def weighted_average(pairs: Sequence[Tuple[float, PyTree]]) -> PyTree:
     weights = np.asarray([float(w) for w, _ in pairs], dtype=np.float32)
     weights = weights / weights.sum()
     trees = [t for _, t in pairs]
+    if any(not isinstance(l, (np.ndarray, jnp.ndarray, np.generic, float, int))
+           for l in jax.tree.leaves(trees[0])):
+        # object leaves (e.g. homomorphic ciphertexts, core/fhe/rlwe.py):
+        # fold with the leaves' own +/* — they define the algebra
+        acc = jax.tree.map(lambda x: x * float(weights[0]), trees[0])
+        for w, t in zip(weights[1:], trees[1:]):
+            acc = jax.tree.map(lambda a, x, w=w: a + x * float(w), acc, t)
+        return acc
     if len(trees) <= 64:
         return stacked_weighted_average(tree_stack(trees), jnp.asarray(weights))
     acc = tree_scale(trees[0], weights[0])

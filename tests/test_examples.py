@@ -1,0 +1,96 @@
+"""Every example config parses and its platform entry runs (VERDICT item 10).
+
+Mirrors the reference's CI model (SURVEY §4: smoke tests run the quick-start
+examples). Config-parse coverage is exhaustive over examples/**/ *.yaml;
+runnable coverage executes the cheap entries end to end."""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _configs():
+    return sorted(
+        p for p in glob.glob(os.path.join(EXAMPLES, "**", "*.yaml"), recursive=True)
+        if "job.yaml" not in p
+    )
+
+
+def test_found_all_platform_examples():
+    expected = [
+        "quick_start/parrot/fedml_config.yaml",
+        "quick_start/octopus/fedml_config.yaml",
+        "simulation/vmap_fedavg/fedml_config.yaml",
+        "train/llm_finetune/fedml_config.yaml",
+        "fednlp/text_classification/fedml_config.yaml",
+        "federated_analytics/heavy_hitter/fedml_config.yaml",
+        "deploy/quick_start/main.py",
+        "cross_device/main.py",
+        "launch/hello_job/job.yaml",
+    ]
+    missing = [p for p in expected if not os.path.exists(os.path.join(EXAMPLES, p))]
+    assert not missing, missing
+
+
+@pytest.mark.parametrize("cfg", _configs(), ids=lambda p: os.path.relpath(p, EXAMPLES))
+def test_example_config_parses(cfg):
+    import argparse
+
+    import fedml_tpu as fedml
+
+    ns = argparse.Namespace(yaml_config_file=cfg)
+    args = fedml.load_arguments(args=ns)
+    assert getattr(args, "training_type", None) in ("simulation", "cross_silo", "cross_device")
+
+
+def _run(script, *argv, timeout=600):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, os.path.basename(script), *argv],
+        cwd=os.path.dirname(script), env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+@pytest.mark.slow
+def test_fa_example_runs():
+    s = os.path.join(EXAMPLES, "federated_analytics", "heavy_hitter", "main.py")
+    r = _run(s, "--cf", "fedml_config.yaml")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "heavy hitters:" in r.stdout
+
+
+@pytest.mark.slow
+def test_launch_example_runs():
+    s = os.path.join(EXAMPLES, "launch", "hello_job", "job.yaml")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "fedml_tpu.cli", "launch", "job.yaml", "--backend", "mqtt"],
+        cwd=os.path.dirname(s), env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "FINISHED" in r.stdout
+
+
+@pytest.mark.slow
+def test_llm_finetune_example_runs():
+    s = os.path.join(EXAMPLES, "train", "llm_finetune", "main.py")
+    r = _run(s, "--cf", "fedml_config.yaml", timeout=900)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "federated LoRA fine-tune complete" in r.stdout
+
+
+@pytest.mark.slow
+def test_deploy_example_runs():
+    s = os.path.join(EXAMPLES, "deploy", "quick_start", "main.py")
+    r = _run(s, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "undeployed" in r.stdout

@@ -1,0 +1,100 @@
+"""MLOps backend connectivity: MQTT telemetry uplink + REST log upload.
+
+Reference parity: ``core/mlops/mlops_metrics.py`` (metric/status topics) and
+``mlops_runtime_log_daemon.py`` (chunked POST) — here against the in-repo
+LocalMLOpsCollector (VERDICT r1 missing #7)."""
+
+import time
+
+import pytest
+
+import fedml_tpu.mlops as mlops
+from fedml_tpu.core.distributed.communication.mqtt_s3.mqtt_transport import LocalMqttBroker
+from fedml_tpu.mlops.backend import LocalMLOpsCollector, MLOpsUplink, http_log_sink
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    LocalMqttBroker.reset()
+    mlops.MLOpsRuntime._instance = None
+    yield
+    mlops.MLOpsRuntime._instance = None
+    LocalMqttBroker.reset()
+
+
+class _Args:
+    run_id = "mlops_test"
+    using_mlops = True
+    mlops_backend_mqtt = True
+    log_file_dir = None
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while not cond() and time.time() < deadline:
+        time.sleep(0.02)
+    assert cond()
+
+
+def test_metrics_status_events_reach_collector(tmp_path):
+    args = _Args()
+    # transport broker is keyed by run_id: collector must join the same one
+    collector = LocalMLOpsCollector(str(tmp_path / "mlops"), args)
+    try:
+        args.log_file_dir = str(tmp_path / "logs")
+        rt = mlops.MLOpsRuntime.get_instance()
+        rt.init(args)
+        assert rt.uplink is not None
+
+        mlops.log({"test_acc": 0.91}, step=3)
+        mlops.log_training_status("RUNNING", run_id="mlops_test")
+        mlops.event("train", event_started=True, event_value="0")
+        mlops.event("train", event_started=False, event_value="0")
+
+        _wait(lambda: len(collector.metrics) >= 1 and len(collector.statuses) >= 1
+              and len(collector.events) >= 2)
+        assert collector.metrics[0]["test_acc"] == 0.91
+        assert collector.metrics[0]["run_id"] == "mlops_test"
+        assert collector.statuses[0]["status"] == "RUNNING"
+        spans = {(e["name"], e["type"]) for e in collector.events if "name" in e}
+        assert ("train", "event_started") in spans and ("train", "event_ended") in spans
+        # spooled to jsonl for the dashboard
+        assert (tmp_path / "mlops" / "metrics.jsonl").exists()
+    finally:
+        collector.stop()
+
+
+def test_log_daemon_uploads_chunks_over_http(tmp_path):
+    collector = LocalMLOpsCollector(str(tmp_path / "mlops"))
+    try:
+        log_path = tmp_path / "run.log"
+        log_path.write_text("line one\nline two\n")
+        from fedml_tpu.mlops.runtime_log import MLOpsRuntimeLogDaemon
+
+        daemon = MLOpsRuntimeLogDaemon(
+            str(log_path), "mlops_test", rank=1, sink=http_log_sink(collector.api_url)
+        )
+        assert daemon.poll_once() == 2
+        with open(log_path, "a") as f:
+            f.write("line three\n")
+        assert daemon.poll_once() == 1
+        assert len(collector.log_chunks) == 2
+        first = collector.log_chunks[0]
+        assert first["run_id"] == "mlops_test" and first["edge_id"] == 1
+        assert first["logs"] == ["line one\n", "line two\n"]
+    finally:
+        collector.stop()
+
+
+def test_uplink_failure_never_kills_the_run(tmp_path):
+    args = _Args()
+    args.log_file_dir = str(tmp_path / "logs")
+    rt = mlops.MLOpsRuntime.get_instance()
+    rt.init(args)
+    rt.uplink.transport.publish = _raise  # sabotage
+    mlops.log({"x": 1.0})  # must not raise
+    assert rt.metrics
+
+
+def _raise(*a, **k):
+    raise ConnectionError("broker gone")

@@ -1,0 +1,107 @@
+"""Multi-host gating: jax.distributed 2-process slice, one WAN talker.
+
+Reference parity: the hierarchical silo's rank-0-only WAN gating + round
+metadata broadcast (``fedml_client_master_manager.py:67-70,200-212``,
+``fedml_client_slave_manager.py``). Two REAL processes join via
+``jax.distributed.initialize`` on localhost; process 0 "opens the WAN"
+(writes a token file) and broadcasts round metadata; process 1 must receive
+the metadata and must NOT open a WAN connection."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.slow  # spawns 2 jax.distributed processes
+
+WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.environ["REPO_ROOT"])
+    from fedml_tpu.parallel.multihost import (
+        broadcast_round_metadata, init_distributed, is_main_process, process_count,
+        sync_process_group,
+    )
+
+    rank = int(sys.argv[1]); port = sys.argv[2]; out_dir = sys.argv[3]
+    assert init_distributed(f"127.0.0.1:{port}", 2, rank)
+    assert process_count() == 2
+
+    wan_token = os.path.join(out_dir, f"wan_opened_by_{rank}")
+    if is_main_process():
+        # exactly one process opens the WAN connection
+        open(wan_token, "w").write("connected")
+        for r in range(3):
+            broadcast_round_metadata({"model_version": r, "client_index": 7, "finished": False})
+        broadcast_round_metadata({"finished": True})
+        got = {"role": "master"}
+    else:
+        got = {"role": "slave", "rounds": []}
+        while True:
+            meta = broadcast_round_metadata(None)
+            if meta["finished"]:
+                break
+            got["rounds"].append(meta)
+    sync_process_group()
+    with open(os.path.join(out_dir, f"result_{rank}.json"), "w") as f:
+        json.dump(got, f)
+    print("DONE", rank)
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_slice_one_wan_talker(tmp_path):
+    import json
+
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    env = dict(os.environ)
+    env["REPO_ROOT"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env.pop("XLA_FLAGS", None)  # single virtual device per process is fine
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(r), str(port), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for r in (0, 1)
+    ]
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+
+    # exactly one process opened the WAN
+    assert os.path.exists(tmp_path / "wan_opened_by_0")
+    assert not os.path.exists(tmp_path / "wan_opened_by_1")
+
+    slave = json.loads((tmp_path / "result_1.json").read_text())
+    assert slave["role"] == "slave"
+    assert [m["model_version"] for m in slave["rounds"]] == [0, 1, 2]
+    assert all(m["client_index"] == 7 for m in slave["rounds"])
+
+
+def test_single_process_fallbacks():
+    """Without a coordinator the helpers degrade to single-process behavior
+    (the path every existing test exercises implicitly)."""
+    from fedml_tpu.parallel.multihost import (
+        broadcast_round_metadata,
+        init_distributed,
+        is_main_process,
+    )
+
+    assert init_distributed() is False
+    assert is_main_process() is True
+    meta = {"model_version": 3, "finished": False}
+    assert broadcast_round_metadata(meta) == meta
