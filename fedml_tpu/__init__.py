@@ -41,10 +41,10 @@ from . import data  # noqa: E402  (fedml.data.load lives on the subpackage)
 
 class _ModelNS:
     @staticmethod
-    def create(args, output_dim=None):
+    def create(args, output_dim=None, seed=None):
         from .models.model_hub import create as _create
 
-        return _create(args, output_dim)
+        return _create(args, output_dim, seed)
 
 
 model = _ModelNS()

@@ -47,6 +47,7 @@ class FedMLClientRunner:
         self.base_dir = base_dir or os.path.join(tempfile.gettempdir(), "fedml_tpu_agent")
         self.status_callback = status_callback or (lambda s: None)
         self.runs: Dict[str, RunStatus] = {}
+        self.requests: Dict[str, Dict[str, Any]] = {}  # last request per run (restart source)
         self._procs: Dict[str, subprocess.Popen] = {}
 
     def _report(self, st: RunStatus) -> None:
@@ -56,6 +57,7 @@ class FedMLClientRunner:
     def callback_start_train(self, request: Dict[str, Any], wait: bool = True) -> RunStatus:
         """request: {run_id, package_path, job_cmd, bootstrap_cmd?, env?}."""
         run_id = str(request.get("run_id") or uuid.uuid4().hex[:8])
+        self.requests[run_id] = dict(request, run_id=run_id)
         st = RunStatus(run_id=run_id, edge_id=self.edge_id, status="PROVISIONING")
         self._report(st)
 

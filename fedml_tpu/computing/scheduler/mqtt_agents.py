@@ -70,6 +70,7 @@ class MqttClientAgent:
             base_dir=base_dir or os.path.join(tempfile.gettempdir(), f"fedml_tpu_mqtt_edge_{edge_id}"),
             status_callback=self._publish_status,
         )
+        self.raw_requests: Dict[str, Dict[str, Any]] = {}
         self.transport.subscribe(TOPIC_START.format(edge_id=self.edge_id), self._on_start)
         self.transport.subscribe(TOPIC_STOP.format(edge_id=self.edge_id), self._on_stop)
         self.transport.subscribe(TOPIC_OTA.format(edge_id=self.edge_id), self._on_ota)
@@ -79,18 +80,29 @@ class MqttClientAgent:
     def _on_start(self, _topic: str, payload: bytes) -> None:
         request = json.loads(payload)
         run_id = str(request.get("run_id") or uuid.uuid4().hex[:8])
+        # keep the ORIGINAL wire request so the job monitor can replay the
+        # full download+exec cycle (a download failure must be restartable)
+        self.raw_requests[run_id] = dict(request, run_id=run_id)
         package_url = request.get("package_url")
         local_pkg = os.path.join(self.runner.base_dir, "packages", f"{run_id}.zip")
         try:
             self.store.fetch_file(package_url, local_pkg)
         except Exception as e:  # noqa: BLE001 - download boundary
-            self._publish_status(
-                RunStatus(run_id=run_id, edge_id=self.edge_id, status="FAILED", detail=f"download: {e!r}")
-            )
+            st = RunStatus(run_id=run_id, edge_id=self.edge_id, status="FAILED", detail=f"download: {e!r}")
+            self.runner.runs[run_id] = st  # visible to the job monitor
+            self._publish_status(st)
             return
         request = dict(request, run_id=run_id, package_path=local_pkg)
         # non-blocking: the agent must keep serving its topics during the job
         self.runner.callback_start_train(request, wait=False)
+
+    def replay_request(self, run_id: str) -> bool:
+        """Re-run a stored wire request (job monitor elastic restart)."""
+        raw = self.raw_requests.get(run_id)
+        if raw is None:
+            return False
+        self._on_start("", json.dumps(raw).encode())
+        return True
 
     def _on_stop(self, _topic: str, payload: bytes) -> None:
         run_id = str(json.loads(payload).get("run_id", ""))
@@ -199,18 +211,37 @@ class MqttServerAgent:
 
 class JobMonitor:
     """Liveness loop (reference comm_utils/job_monitor.py:37): polls agents'
-    running jobs; a process that died without a terminal report gets one."""
+    running jobs; a process that died without a terminal report gets one.
+    With ``restart_failed`` the monitor is the elastic-recovery loop: FAILED
+    runs are re-executed from their stored request up to ``max_restarts``
+    times (the reference JobMonitor's container-restart behavior)."""
 
-    def __init__(self, agents: List[MqttClientAgent], poll_s: float = 1.0):
+    def __init__(
+        self,
+        agents: List[MqttClientAgent],
+        poll_s: float = 1.0,
+        *,
+        restart_failed: bool = False,
+        max_restarts: int = 2,
+    ):
         self.agents = agents
         self.poll_s = poll_s
+        self.restart_failed = restart_failed
+        self.max_restarts = max_restarts
+        self._restarts: Dict[str, int] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.repairs: List[str] = []
+        self.restarts: List[str] = []
 
     def check_once(self) -> List[str]:
         fixed = []
         for agent in self.agents:
+            # terminal statuses first (covers runs that FAILED before a
+            # process ever spawned: download / bootstrap failures)
+            for run_id, st in list(agent.runner.runs.items()):
+                if st.status in TERMINAL:
+                    self._maybe_restart(agent, run_id, st)
             for run_id, proc in list(agent.runner._procs.items()):
                 st = agent.runner.runs.get(run_id)
                 if st is None or st.status in TERMINAL:
@@ -227,6 +258,27 @@ class JobMonitor:
                         fixed.append(run_id)
         self.repairs.extend(fixed)
         return fixed
+
+    def _maybe_restart(self, agent: MqttClientAgent, run_id: str, st: RunStatus) -> None:
+        if not self.restart_failed or st.status != "FAILED":
+            return
+        key = f"{agent.edge_id}:{run_id}"
+        if self._restarts.get(key, 0) >= self.max_restarts:
+            return
+        if run_id not in agent.raw_requests and agent.runner.requests.get(run_id) is None:
+            return
+        self._restarts[key] = self._restarts.get(key, 0) + 1
+        self.restarts.append(run_id)
+        log.warning("job monitor: restarting failed run %s on edge %d (attempt %d/%d)",
+                    run_id, agent.edge_id, self._restarts[key], self.max_restarts)
+
+        def _dispatch():
+            # off the monitor thread: provisioning/bootstrap can take minutes
+            # and must not stall liveness polling of the other agents
+            if not agent.replay_request(run_id):
+                agent.runner.callback_start_train(agent.runner.requests[run_id], wait=False)
+
+        threading.Thread(target=_dispatch, daemon=True).start()
 
     def start(self) -> None:
         def loop():

@@ -16,17 +16,41 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
-MAGIC = 0x46454454
+MAGIC = 0x46454454      # v1 "FEDT": dense-only
+MAGIC_V2 = 0x46454443   # v2 "FEDC": mixed conv/dense (dense_model.h)
+
+
+def _is_conv(layer: Dict[str, np.ndarray]) -> bool:
+    return np.asarray(layer["w"]).ndim == 4
 
 
 def params_to_blob(params: List[Dict[str, np.ndarray]]) -> bytes:
-    """params: [{"w": [in, out], "b": [out]}, ...] -> blob bytes."""
-    header = [struct.pack("<ii", MAGIC, len(params))]
+    """params -> blob. Dense layers: {"w": [in, out], "b": [out]}; conv
+    layers: {"w": [3, 3, in_c, out_c] HWIO, "b": [out_c], "in_h", "in_w"}.
+    Dense-only models use the v1 format (older peers stay compatible)."""
+    has_conv = any(_is_conv(l) for l in params)
+    header = [struct.pack("<ii", MAGIC_V2 if has_conv else MAGIC, len(params))]
     payload = []
     for layer in params:
         w, b = np.asarray(layer["w"], np.float32), np.asarray(layer["b"], np.float32)
-        assert w.ndim == 2 and b.shape == (w.shape[1],), (w.shape, b.shape)
-        header.append(struct.pack("<ii", w.shape[0], w.shape[1]))
+        if _is_conv(layer):
+            kh, kw, ic, oc = w.shape
+            assert (kh, kw) == (3, 3) and b.shape == (oc,), (w.shape, b.shape)
+            in_h, in_w = int(layer["in_h"]), int(layer["in_w"])
+            if in_h % 2 or in_w % 2:
+                raise ValueError(
+                    f"conv layer spatial dims must be even (2x2 pool): {in_h}x{in_w}"
+                )
+            header.append(struct.pack(
+                "<7i", 1, in_h * in_w * ic, (in_h // 2) * (in_w // 2) * oc,
+                in_h, in_w, ic, oc,
+            ))
+        else:
+            assert w.ndim == 2 and b.shape == (w.shape[1],), (w.shape, b.shape)
+            if has_conv:
+                header.append(struct.pack("<7i", 0, w.shape[0], w.shape[1], 0, 0, 0, 0))
+            else:
+                header.append(struct.pack("<ii", w.shape[0], w.shape[1]))
         payload.append(w.tobytes(order="C"))
         payload.append(b.tobytes())
     return b"".join(header + payload)
@@ -34,21 +58,33 @@ def params_to_blob(params: List[Dict[str, np.ndarray]]) -> bytes:
 
 def blob_to_params(blob: bytes) -> List[Dict[str, np.ndarray]]:
     magic, n_layers = struct.unpack_from("<ii", blob, 0)
-    if magic != MAGIC:
+    if magic not in (MAGIC, MAGIC_V2):
         raise ValueError(f"bad model blob magic {magic:#x}")
-    dims: List[Tuple[int, int]] = []
+    metas = []
     off = 8
     for _ in range(n_layers):
-        in_dim, out_dim = struct.unpack_from("<ii", blob, off)
-        off += 8
-        dims.append((in_dim, out_dim))
+        if magic == MAGIC:
+            in_dim, out_dim = struct.unpack_from("<ii", blob, off)
+            off += 8
+            metas.append((0, in_dim, out_dim, 0, 0, 0, 0))
+        else:
+            metas.append(struct.unpack_from("<7i", blob, off))
+            off += 28
     layers = []
-    for in_dim, out_dim in dims:
-        w = np.frombuffer(blob, np.float32, in_dim * out_dim, off).reshape(in_dim, out_dim)
-        off += 4 * in_dim * out_dim
-        b = np.frombuffer(blob, np.float32, out_dim, off)
-        off += 4 * out_dim
-        layers.append({"w": w.copy(), "b": b.copy()})
+    for kind, in_dim, out_dim, in_h, in_w, in_c, out_c in metas:
+        if kind == 1:
+            nw = 9 * in_c * out_c
+            w = np.frombuffer(blob, np.float32, nw, off).reshape(3, 3, in_c, out_c)
+            off += 4 * nw
+            b = np.frombuffer(blob, np.float32, out_c, off)
+            off += 4 * out_c
+            layers.append({"w": w.copy(), "b": b.copy(), "in_h": in_h, "in_w": in_w})
+        else:
+            w = np.frombuffer(blob, np.float32, in_dim * out_dim, off).reshape(in_dim, out_dim)
+            off += 4 * in_dim * out_dim
+            b = np.frombuffer(blob, np.float32, out_dim, off)
+            off += 4 * out_dim
+            layers.append({"w": w.copy(), "b": b.copy()})
     return layers
 
 
@@ -76,15 +112,41 @@ def flat_to_params(flat: np.ndarray, template: List[Dict[str, np.ndarray]]) -> L
 
 
 def dense_forward(params: List[Dict[str, np.ndarray]], x: np.ndarray) -> np.ndarray:
-    """Numpy forward pass matching FedMLDenseTrainer (ReLU hidden, linear head)
-    — the server-side eval of aggregated edge models (reference
-    test_on_server_for_all_clients_mnn, server_mnn/fedml_aggregator.py:222)."""
+    """Numpy forward pass matching FedMLDenseTrainer (conv3x3+ReLU+pool for
+    conv layers, ReLU-hidden dense, linear head) — the server-side eval of
+    aggregated edge models (reference test_on_server_for_all_clients_mnn,
+    server_mnn/fedml_aggregator.py:222)."""
     h = np.asarray(x, np.float32).reshape(len(x), -1)
     for i, layer in enumerate(params):
-        h = h @ np.asarray(layer["w"], np.float32) + np.asarray(layer["b"], np.float32)
-        if i + 1 < len(params):
-            h = np.maximum(h, 0.0)
+        if _is_conv(layer):
+            h = _conv_pool_forward(layer, h)
+        else:
+            h = h @ np.asarray(layer["w"], np.float32) + np.asarray(layer["b"], np.float32)
+            if i + 1 < len(params):
+                h = np.maximum(h, 0.0)
     return h
+
+
+def _conv_pool_forward(layer: Dict[str, np.ndarray], h: np.ndarray) -> np.ndarray:
+    """Conv3x3 SAME + ReLU + 2x2 maxpool, HWC — mirrors the C++ engine's
+    conv_pool_forward (dense_trainer.cpp) for cross-language parity tests."""
+    w = np.asarray(layer["w"], np.float32)
+    b = np.asarray(layer["b"], np.float32)
+    in_h, in_w = int(layer["in_h"]), int(layer["in_w"])
+    _, _, ic, oc = w.shape
+    x = h.reshape(len(h), in_h, in_w, ic)
+    padded = np.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    out = np.zeros((len(h), in_h, in_w, oc), np.float32)
+    for ky in range(3):
+        for kx in range(3):
+            out += np.einsum(
+                "bhwc,co->bhwo",
+                padded[:, ky : ky + in_h, kx : kx + in_w, :],
+                w[ky, kx],
+            )
+    out = np.maximum(out + b, 0.0)
+    pooled = out.reshape(len(h), in_h // 2, 2, in_w // 2, 2, oc).max(axis=(2, 4))
+    return pooled.reshape(len(h), -1)
 
 
 def dataset_to_bytes(x: np.ndarray, y: np.ndarray, num_classes: int) -> bytes:

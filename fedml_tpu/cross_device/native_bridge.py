@@ -46,6 +46,11 @@ def _load() -> ctypes.CDLL:
             if not os.path.exists(_LIB_PATH):
                 _build_library()
             lib = ctypes.CDLL(_LIB_PATH)
+            if not hasattr(lib, "edge_configure_conv_model"):
+                # stale prebuilt library from before conv support: rebuild
+                del lib
+                _build_library()
+                lib = ctypes.CDLL(_LIB_PATH)
         except Exception as e:
             _build_error = f"native edge engine unavailable: {e}"
             raise RuntimeError(_build_error) from e
@@ -66,6 +71,10 @@ def _load() -> ctypes.CDLL:
         lib.edge_num_params.restype = ctypes.c_int64
         i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
         lib.edge_configure_model.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int, ctypes.c_uint64]
+        lib.edge_configure_conv_model.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            i32p, ctypes.c_int, i32p, ctypes.c_int, ctypes.c_uint64,
+        ]
         f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
         i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
         lib.edge_get_model.argtypes = [ctypes.c_void_p, f32p, ctypes.c_int64]
@@ -115,6 +124,22 @@ class NativeEdgeEngine:
         d = np.ascontiguousarray(dims, np.int32)
         if self._lib.edge_configure_model(self._h, d, len(d), seed) != 0:
             raise ValueError(f"bad model dims {list(dims)}")
+
+    def configure_conv_model(self, in_h: int, in_w: int, in_c: int,
+                             conv_channels, dense_dims, seed: int = 0) -> None:
+        """LeNet-style conv graph: conv3x3+ReLU+maxpool2 per entry of
+        conv_channels, then dense layers ending in num_classes (reference
+        mobile engine LeNet training, FedMLMNNTrainer.cpp). Every conv
+        stage's input dims must be even (2x2 pool halves them)."""
+        cc = np.ascontiguousarray(conv_channels, np.int32)
+        dd = np.ascontiguousarray(dense_dims, np.int32)
+        rc = self._lib.edge_configure_conv_model(
+            self._h, in_h, in_w, in_c, cc, len(cc), dd, len(dd), seed
+        )
+        if rc != 0:
+            raise ValueError(
+                f"bad conv model spec ({in_h}x{in_w}x{in_c}, conv {list(cc)}, dense {list(dd)})"
+            )
 
     def __del__(self):  # pragma: no cover - gc timing
         try:

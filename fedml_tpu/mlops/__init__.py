@@ -134,6 +134,58 @@ def log_aggregation_status(status: str, run_id: Optional[str] = None) -> None:
     MLOpsRuntime.get_instance().append_record({"type": "status", "role": "server", "status": status, "run_id": run_id})
 
 
+def start_profiler_trace(logdir: Optional[str] = None) -> bool:
+    """Capture an XLA/TPU profiler trace (reference MLOpsProfilerEvent wraps
+    wandb spans; the TPU-native equivalent is a jax.profiler trace viewable
+    in TensorBoard/XProf). Returns False if a trace is already running."""
+    rt = MLOpsRuntime.get_instance()
+    if getattr(rt, "_trace_dir", None):
+        return False
+    import jax
+
+    logdir = logdir or os.path.join(rt.run_dir or "/tmp/fedml_tpu", "jax_trace")
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    rt._trace_dir = logdir
+    rt.append_record({"type": "event_started", "name": "jax_profiler_trace", "value": logdir})
+    return True
+
+
+def stop_profiler_trace() -> Optional[str]:
+    """Stop the running trace; returns the trace dir (or None if not running)."""
+    rt = MLOpsRuntime.get_instance()
+    logdir = getattr(rt, "_trace_dir", None)
+    if not logdir:
+        return None
+    import jax
+
+    jax.profiler.stop_trace()
+    rt._trace_dir = None
+    rt.append_record({"type": "event_ended", "name": "jax_profiler_trace", "value": logdir})
+    return logdir
+
+
+class profile_span:
+    """Span combining an MLOps profiler event with a jax.profiler
+    TraceAnnotation (shows up in both the event log and XProf timelines)."""
+
+    def __init__(self, name: str, value: Optional[str] = None):
+        self.name, self.value = name, value
+
+    def __enter__(self):
+        import jax
+
+        event(self.name, event_started=True, event_value=self.value)
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ann.__exit__(*exc)
+        event(self.name, event_started=False, event_value=self.value)
+        return False
+
+
 def log_sys_perf(args: Any = None) -> None:
     """System perf sampling (reference: mlops_device_perfs.py). Samples
     psutil counters once per call; TPU utilization comes from jax device

@@ -150,7 +150,61 @@ def create(args: Any, output_dim: Optional[int] = None, seed: Optional[int] = No
 
     model = FedModel(module=module, params=None, input_shape=in_shape, input_dtype=in_dtype, name=model_name)
     model.params = model.init_params(seed)
+    pretrained = getattr(args, "pretrained_path", None)
+    if pretrained:
+        model.params = load_pretrained(model.params, str(pretrained))
     return model
+
+
+def load_pretrained(template_params: Any, path: str) -> Any:
+    """Load pretrained weights into an initialized param pytree.
+
+    Accepts: an orbax checkpoint dir (utils/checkpoint.py layout), a flat
+    ``.npz`` keyed by '/'-joined tree paths, or an HF llama safetensors dir
+    (routed through train/llm/checkpoint_import). Reference analogue:
+    ``model/model_hub.py`` loading torchvision/HF pretrained weights."""
+    import os
+
+    import numpy as np
+
+    if os.path.isdir(path):
+        if os.path.exists(os.path.join(path, "config.json")):
+            from ..train.llm.checkpoint_import import config_from_hf, import_hf_checkpoint
+
+            return import_hf_checkpoint(path, config_from_hf(path))
+        from ..utils.checkpoint import CheckpointManager
+
+        restored = CheckpointManager(path).restore(template=jax.device_get(template_params))
+        if restored is None:
+            raise FileNotFoundError(f"no checkpoint found under {path}")
+        return restored
+    if path.endswith(".npz"):
+        flat, treedef = jax.tree_util.tree_flatten_with_path(template_params)
+        with np.load(path) as z:
+            leaves = []
+            for kpath, leaf in flat:
+                key = "/".join(str(getattr(k, "key", k)) for k in kpath)
+                if key not in z:
+                    raise KeyError(f"pretrained npz missing {key!r}")
+                arr = z[key]
+                if tuple(arr.shape) != tuple(leaf.shape):
+                    raise ValueError(f"{key}: shape {arr.shape} != {leaf.shape}")
+                leaves.append(jnp.asarray(arr))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+    raise ValueError(f"unsupported pretrained weight source {path!r}")
+
+
+def save_pretrained_npz(params: Any, path: str) -> str:
+    """Inverse of the .npz branch of load_pretrained."""
+    import numpy as np
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    arrays = {
+        "/".join(str(getattr(k, "key", k)) for k in kpath): np.asarray(jax.device_get(leaf))
+        for kpath, leaf in flat
+    }
+    np.savez(path, **arrays)
+    return path
 
 
 def create_split(args: Any, output_dim: Optional[int] = None, seed: int = 0):

@@ -57,8 +57,16 @@ class FedGKTAPI:
         feats, _ = self.client_net.apply({"params": self.client_params[0]}, sample)
         self.server_params = self.server_net.init(jax.random.fold_in(key, 999), feats)["params"]
 
-        lr = float(getattr(args, "learning_rate", 0.01))
-        self.tx_c, self.tx_s = optax.sgd(lr, momentum=0.9), optax.sgd(lr, momentum=0.9)
+        # adam default: the narrow split stems learn far faster than with
+        # SGD-momentum at FL-tuned lrs (same finding as split_nn.py); the
+        # config lr is SGD-scaled, so adam gets its own capped scale
+        opt_name = str(getattr(args, "gkt_optimizer", "adam")).lower()
+        if opt_name == "adam":
+            lr = float(getattr(args, "gkt_learning_rate", min(float(getattr(args, "learning_rate", 1e-3)), 1e-3)))
+            self.tx_c, self.tx_s = optax.adam(lr), optax.adam(lr)
+        else:
+            lr = float(getattr(args, "learning_rate", 0.01))
+            self.tx_c, self.tx_s = optax.sgd(lr, momentum=0.9), optax.sgd(lr, momentum=0.9)
         self.opt_s = self.tx_s.init(self.server_params)
         self._build()
         self.metrics_history: List[Dict[str, float]] = []
@@ -69,15 +77,18 @@ class FedGKTAPI:
         tx_c, tx_s = self.tx_c, self.tx_s
 
         @jax.jit
-        def client_epoch(cp, x_all, y_all, server_logits, batches_idx):
-            """CE + KD-from-server on the client's small net."""
+        def client_epoch(cp, x_all, y_all, server_logits, batches_idx, kd_alpha):
+            """CE + KD-from-server on the client's small net. kd_alpha is 0
+            on the first round: there are no server logits yet, and
+            distilling toward the zero-logit uniform would fight CE
+            (reference GKTTrainer only distills once server logits exist)."""
             opt = tx_c.init(cp)
 
             def loss_fn(cp_, x, y, t_logits):
                 _, logits = c_apply({"params": cp_}, x)
                 ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
                 kd = _kl_soft(logits, t_logits, T)
-                return ce + alpha * kd
+                return ce + kd_alpha * kd
 
             def step(carry, bidx):
                 cp_, opt_ = carry
@@ -147,10 +158,12 @@ class FedGKTAPI:
                 data = self.train_local[cid]
                 x_all, y_all = jnp.asarray(data.x), jnp.asarray(data.y)
                 t_logits = server_logits[cid]
+                kd_alpha = self.alpha if t_logits is not None else 0.0
                 if t_logits is None:
                     t_logits = jnp.zeros((len(data), self.class_num), jnp.float32)
                 cp, loss = self._client_epoch(
-                    self.client_params[cid], x_all, y_all, t_logits, self._batches(len(data), round_idx * 97 + cid)
+                    self.client_params[cid], x_all, y_all, t_logits,
+                    self._batches(len(data), round_idx * 97 + cid), jnp.float32(kd_alpha),
                 )
                 self.client_params[cid] = cp
                 c_losses.append(float(loss))

@@ -1,17 +1,26 @@
-// Dense model blob: the wire format edge clients and the Python server share.
+// Edge model blob: the wire format edge clients and the Python server share.
 //
-// Layout (little-endian):
-//   int32 magic = 0x46454454 ("FEDT")
+// v1 layout ("FEDT", dense-only, little-endian):
+//   int32 magic = 0x46454454
 //   int32 n_layers
 //   per layer: int32 in_dim, int32 out_dim
-//   then all float32 weights layer-major: W0 (in*out, row-major in-dim x
-//   out-dim), b0 (out), W1, b1, ...
+//   then float32 weights layer-major: W0 (in x out row-major), b0, W1, b1...
 //
-// The Python side maps this directly onto a flax Dense pytree
+// v2 layout ("FEDC", mixed conv/dense):
+//   int32 magic = 0x46454443
+//   int32 n_layers
+//   per layer: int32 kind, in_dim, out_dim, in_h, in_w, in_c, out_c
+//     kind 0 = dense (in_dim x out_dim weights, out_dim bias)
+//     kind 1 = conv3x3 SAME + ReLU + 2x2 maxpool (stride 2); weights HWIO
+//              [3,3,in_c,out_c], bias [out_c]; in_dim/out_dim are the
+//              flattened activation sizes (h*w*c), HWC row-major
+//   then float32 weights layer-major as in v1.
+//
+// The Python side maps this onto a flax pytree
 // (fedml_tpu/cross_device/codec.py). Reference analogue: the .mnn model file
-// exchanged by Beehive (cross_device/server_mnn/fedml_aggregator.py:200-243
-// reads/averages/writes MNN files); a flat self-describing blob replaces the
-// opaque MNN graph.
+// exchanged by Beehive (cross_device/server_mnn/fedml_aggregator.py:200-243);
+// conv support mirrors the reference mobile engine training LeNet/ResNet20
+// graphs (MobileNN/src/train/FedMLMNNTrainer.cpp).
 
 #ifndef FEDML_EDGE_DENSE_MODEL_H
 #define FEDML_EDGE_DENSE_MODEL_H
@@ -22,13 +31,22 @@
 
 namespace fedml_edge {
 
-constexpr int32_t kModelMagic = 0x46454454;
+constexpr int32_t kModelMagic = 0x46454454;    // v1 "FEDT"
+constexpr int32_t kModelMagicV2 = 0x46454443;  // v2 "FEDC"
+
+enum LayerKind : int32_t { kDense = 0, kConv3x3Pool = 1 };
 
 struct DenseLayer {
-  int32_t in_dim = 0;
-  int32_t out_dim = 0;
-  std::vector<float> w;  // in_dim * out_dim, row-major
-  std::vector<float> b;  // out_dim
+  int32_t kind = kDense;
+  int32_t in_dim = 0;   // flattened input size
+  int32_t out_dim = 0;  // flattened output size
+  // conv-only geometry (0 for dense):
+  int32_t in_h = 0, in_w = 0, in_c = 0, out_c = 0;
+  std::vector<float> w;  // dense: in*out row-major; conv: 3*3*in_c*out_c HWIO
+  std::vector<float> b;  // dense: out_dim; conv: out_c
+
+  int out_h() const { return in_h / 2; }  // SAME conv then 2x2 pool
+  int out_w() const { return in_w / 2; }
 };
 
 struct DenseModel {
@@ -37,6 +55,7 @@ struct DenseModel {
   int input_dim() const { return layers.empty() ? 0 : layers.front().in_dim; }
   int output_dim() const { return layers.empty() ? 0 : layers.back().out_dim; }
   size_t num_params() const;
+  bool has_conv() const;
 
   // flat view in blob order (W0, b0, W1, b1, ...)
   std::vector<float> flatten() const;
@@ -45,8 +64,13 @@ struct DenseModel {
   bool save(const std::string &path) const;
   bool load(const std::string &path);
 
-  // Kaiming-ish deterministic init for standalone runs.
+  // Kaiming-ish deterministic init for standalone runs (dense MLP).
   static DenseModel create(const std::vector<int> &dims, uint64_t seed);
+  // LeNet-style: conv3x3+pool stages over (in_h, in_w, in_c), then dense
+  // layers (hidden dims..., num_classes).
+  static DenseModel create_conv(int in_h, int in_w, int in_c,
+                                const std::vector<int> &conv_channels,
+                                const std::vector<int> &dense_dims, uint64_t seed);
 };
 
 }  // namespace fedml_edge

@@ -75,6 +75,22 @@ int edge_configure_model(void *h, const int32_t *dims, int ndims, uint64_t seed)
   return 0;
 }
 
+
+// LeNet-style conv model: conv3x3+ReLU+maxpool2 stages over (in_h, in_w,
+// in_c), then dense layers (reference mobile engine trains LeNet-class
+// conv graphs, MobileNN/src/train/FedMLMNNTrainer.cpp).
+int edge_configure_conv_model(void *h, int in_h, int in_w, int in_c,
+                              const int32_t *conv_channels, int n_conv,
+                              const int32_t *dense_dims, int n_dense, uint64_t seed) {
+  if (in_h <= 0 || in_w <= 0 || in_c <= 0 || n_conv < 1 || n_dense < 1) return -1;
+  std::vector<int> cc(conv_channels, conv_channels + n_conv);
+  std::vector<int> dd(dense_dims, dense_dims + n_dense);
+  auto model = fedml_edge::DenseModel::create_conv(in_h, in_w, in_c, cc, dd, seed);
+  if (model.layers.empty()) return -1;  // invalid spec (e.g. odd spatial dim)
+  static_cast<EdgeHandle *>(h)->manager.trainer()->model() = std::move(model);
+  return 0;
+}
+
 int64_t edge_num_params(void *h) {
   return static_cast<int64_t>(
       static_cast<EdgeHandle *>(h)->manager.trainer()->model().num_params());

@@ -18,12 +18,23 @@ uint64_t splitmix64(uint64_t &state) {
 float uniform01(uint64_t &state) {
   return (splitmix64(state) >> 11) * (1.0f / 9007199254740992.0f);
 }
+
+void init_weights(DenseLayer &l, uint64_t &state, int fan_in) {
+  float scale = std::sqrt(2.0f / static_cast<float>(fan_in));
+  for (auto &w : l.w) w = (uniform01(state) * 2.0f - 1.0f) * scale;
+}
 }  // namespace
 
 size_t DenseModel::num_params() const {
   size_t n = 0;
   for (const auto &l : layers) n += l.w.size() + l.b.size();
   return n;
+}
+
+bool DenseModel::has_conv() const {
+  for (const auto &l : layers)
+    if (l.kind == kConv3x3Pool) return true;
+  return false;
 }
 
 std::vector<float> DenseModel::flatten() const {
@@ -49,12 +60,23 @@ void DenseModel::unflatten(const std::vector<float> &flat) {
 bool DenseModel::save(const std::string &path) const {
   FILE *f = std::fopen(path.c_str(), "wb");
   if (!f) return false;
-  int32_t magic = kModelMagic, n = static_cast<int32_t>(layers.size());
-  std::fwrite(&magic, 4, 1, f);
-  std::fwrite(&n, 4, 1, f);
-  for (const auto &l : layers) {
-    std::fwrite(&l.in_dim, 4, 1, f);
-    std::fwrite(&l.out_dim, 4, 1, f);
+  // dense-only models keep the v1 format so older peers stay compatible
+  if (!has_conv()) {
+    int32_t magic = kModelMagic, n = static_cast<int32_t>(layers.size());
+    std::fwrite(&magic, 4, 1, f);
+    std::fwrite(&n, 4, 1, f);
+    for (const auto &l : layers) {
+      std::fwrite(&l.in_dim, 4, 1, f);
+      std::fwrite(&l.out_dim, 4, 1, f);
+    }
+  } else {
+    int32_t magic = kModelMagicV2, n = static_cast<int32_t>(layers.size());
+    std::fwrite(&magic, 4, 1, f);
+    std::fwrite(&n, 4, 1, f);
+    for (const auto &l : layers) {
+      int32_t hdr[7] = {l.kind, l.in_dim, l.out_dim, l.in_h, l.in_w, l.in_c, l.out_c};
+      std::fwrite(hdr, 4, 7, f);
+    }
   }
   for (const auto &l : layers) {
     std::fwrite(l.w.data(), sizeof(float), l.w.size(), f);
@@ -68,22 +90,61 @@ bool DenseModel::load(const std::string &path) {
   FILE *f = std::fopen(path.c_str(), "rb");
   if (!f) return false;
   int32_t magic = 0, n = 0;
-  if (std::fread(&magic, 4, 1, f) != 1 || magic != kModelMagic ||
+  if (std::fread(&magic, 4, 1, f) != 1 ||
+      (magic != kModelMagic && magic != kModelMagicV2) ||
       std::fread(&n, 4, 1, f) != 1 || n <= 0 || n > 64) {
     std::fclose(f);
     return false;
   }
   layers.assign(n, DenseLayer{});
   for (auto &l : layers) {
-    if (std::fread(&l.in_dim, 4, 1, f) != 1 || std::fread(&l.out_dim, 4, 1, f) != 1 ||
-        l.in_dim <= 0 || l.out_dim <= 0) {
-      std::fclose(f);
-      return false;
+    if (magic == kModelMagic) {
+      if (std::fread(&l.in_dim, 4, 1, f) != 1 || std::fread(&l.out_dim, 4, 1, f) != 1 ||
+          l.in_dim <= 0 || l.out_dim <= 0) {
+        std::fclose(f);
+        return false;
+      }
+      l.kind = kDense;
+    } else {
+      int32_t hdr[7];
+      if (std::fread(hdr, 4, 7, f) != 7 || hdr[1] <= 0 || hdr[2] <= 0) {
+        std::fclose(f);
+        return false;
+      }
+      l.kind = hdr[0];
+      l.in_dim = hdr[1];
+      l.out_dim = hdr[2];
+      l.in_h = hdr[3];
+      l.in_w = hdr[4];
+      l.in_c = hdr[5];
+      l.out_c = hdr[6];
+      // wire data is untrusted: geometry must be internally consistent or
+      // conv_pool_forward would read out of bounds
+      bool ok;
+      if (l.kind == kConv3x3Pool) {
+        ok = l.in_h > 0 && l.in_w > 0 && l.in_c > 0 && l.out_c > 0 &&
+             l.in_h % 2 == 0 && l.in_w % 2 == 0 &&
+             static_cast<int64_t>(l.in_h) * l.in_w * l.in_c == l.in_dim &&
+             static_cast<int64_t>(l.in_h / 2) * (l.in_w / 2) * l.out_c == l.out_dim &&
+             static_cast<int64_t>(9) * l.in_c * l.out_c < (1 << 28);
+      } else {
+        ok = l.kind == kDense &&
+             static_cast<int64_t>(l.in_dim) * l.out_dim < (1 << 28);
+      }
+      if (!ok) {
+        std::fclose(f);
+        return false;
+      }
     }
   }
   for (auto &l : layers) {
-    l.w.assign(static_cast<size_t>(l.in_dim) * l.out_dim, 0.0f);
-    l.b.assign(l.out_dim, 0.0f);
+    size_t wsize = l.kind == kConv3x3Pool
+                       ? static_cast<size_t>(9) * l.in_c * l.out_c
+                       : static_cast<size_t>(l.in_dim) * l.out_dim;
+    size_t bsize = l.kind == kConv3x3Pool ? static_cast<size_t>(l.out_c)
+                                          : static_cast<size_t>(l.out_dim);
+    l.w.assign(wsize, 0.0f);
+    l.b.assign(bsize, 0.0f);
     if (std::fread(l.w.data(), sizeof(float), l.w.size(), f) != l.w.size() ||
         std::fread(l.b.data(), sizeof(float), l.b.size(), f) != l.b.size()) {
       std::fclose(f);
@@ -99,13 +160,53 @@ DenseModel DenseModel::create(const std::vector<int> &dims, uint64_t seed) {
   uint64_t state = seed * 0x9E3779B97F4A7C15ULL + 1;
   for (size_t i = 0; i + 1 < dims.size(); ++i) {
     DenseLayer l;
+    l.kind = kDense;
     l.in_dim = dims[i];
     l.out_dim = dims[i + 1];
     l.w.resize(static_cast<size_t>(l.in_dim) * l.out_dim);
     l.b.assign(l.out_dim, 0.0f);
-    float scale = std::sqrt(2.0f / static_cast<float>(l.in_dim));
-    for (auto &w : l.w) w = (uniform01(state) * 2.0f - 1.0f) * scale;
+    init_weights(l, state, l.in_dim);
     m.layers.push_back(std::move(l));
+  }
+  return m;
+}
+
+DenseModel DenseModel::create_conv(int in_h, int in_w, int in_c,
+                                   const std::vector<int> &conv_channels,
+                                   const std::vector<int> &dense_dims, uint64_t seed) {
+  DenseModel m;
+  uint64_t state = seed * 0x9E3779B97F4A7C15ULL + 5;
+  int h = in_h, w = in_w, c = in_c;
+  for (int oc : conv_channels) {
+    if (h % 2 || w % 2 || h <= 0 || w <= 0 || oc <= 0)
+      return m;  // empty model = invalid spec (caller checks layers.empty())
+    DenseLayer l;
+    l.kind = kConv3x3Pool;
+    l.in_h = h;
+    l.in_w = w;
+    l.in_c = c;
+    l.out_c = oc;
+    l.in_dim = h * w * c;
+    l.out_dim = (h / 2) * (w / 2) * oc;
+    l.w.resize(static_cast<size_t>(9) * c * oc);
+    l.b.assign(oc, 0.0f);
+    init_weights(l, state, 9 * c);
+    m.layers.push_back(std::move(l));
+    h /= 2;
+    w /= 2;
+    c = oc;
+  }
+  int prev = h * w * c;
+  for (int d : dense_dims) {
+    DenseLayer l;
+    l.kind = kDense;
+    l.in_dim = prev;
+    l.out_dim = d;
+    l.w.resize(static_cast<size_t>(prev) * d);
+    l.b.assign(d, 0.0f);
+    init_weights(l, state, prev);
+    m.layers.push_back(std::move(l));
+    prev = d;
   }
   return m;
 }

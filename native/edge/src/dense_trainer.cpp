@@ -99,6 +99,109 @@ void FedMLDenseTrainer::ensure_loaded() {
   loaded_ = true;
 }
 
+namespace {
+
+// Conv3x3 SAME + ReLU, then 2x2 maxpool (stride 2).
+// conv_out: [in_h, in_w, out_c] post-ReLU; out: [h/2, w/2, out_c];
+// argmax: per pooled cell, flat index into conv_out chosen by the max.
+void conv_pool_forward(const DenseLayer &L, const float *in, std::vector<float> &conv_out,
+                       std::vector<float> &out, std::vector<int32_t> *argmax) {
+  const int H = L.in_h, W = L.in_w, IC = L.in_c, OC = L.out_c;
+  conv_out.assign(static_cast<size_t>(H) * W * OC, 0.0f);
+  for (int oy = 0; oy < H; ++oy) {
+    for (int ox = 0; ox < W; ++ox) {
+      for (int oc = 0; oc < OC; ++oc) {
+        float s = L.b[oc];
+        for (int ky = -1; ky <= 1; ++ky) {
+          int iy = oy + ky;
+          if (iy < 0 || iy >= H) continue;
+          for (int kx = -1; kx <= 1; ++kx) {
+            int ix = ox + kx;
+            if (ix < 0 || ix >= W) continue;
+            const float *in_px = in + (static_cast<size_t>(iy) * W + ix) * IC;
+            const float *w_k = L.w.data() +
+                ((static_cast<size_t>(ky + 1) * 3 + (kx + 1)) * IC) * OC + oc;
+            for (int ic = 0; ic < IC; ++ic) s += in_px[ic] * w_k[static_cast<size_t>(ic) * OC];
+          }
+        }
+        conv_out[(static_cast<size_t>(oy) * W + ox) * OC + oc] = std::max(s, 0.0f);
+      }
+    }
+  }
+  const int OH = H / 2, OW = W / 2;
+  out.assign(static_cast<size_t>(OH) * OW * OC, 0.0f);
+  if (argmax) argmax->assign(out.size(), 0);
+  for (int py = 0; py < OH; ++py) {
+    for (int px = 0; px < OW; ++px) {
+      for (int oc = 0; oc < OC; ++oc) {
+        float best = -1.0f;  // conv_out >= 0 post-ReLU
+        int32_t best_idx = 0;
+        for (int dy = 0; dy < 2; ++dy) {
+          for (int dx = 0; dx < 2; ++dx) {
+            int32_t idx = ((py * 2 + dy) * W + (px * 2 + dx)) * OC + oc;
+            if (conv_out[idx] > best) {
+              best = conv_out[idx];
+              best_idx = idx;
+            }
+          }
+        }
+        size_t o = (static_cast<size_t>(py) * OW + px) * OC + oc;
+        out[o] = best;
+        if (argmax) (*argmax)[o] = best_idx;
+      }
+    }
+  }
+}
+
+// Backward through pool + ReLU + conv. delta_out: [h/2, w/2, out_c];
+// fills gw/gb (accumulated) and delta_in [in_h, in_w, in_c] (if non-null).
+void conv_pool_backward(const DenseLayer &L, const float *in, const std::vector<float> &conv_out,
+                        const std::vector<int32_t> &argmax, const std::vector<float> &delta_out,
+                        std::vector<float> &gw, std::vector<float> &gb,
+                        std::vector<float> *delta_in) {
+  const int H = L.in_h, W = L.in_w, IC = L.in_c, OC = L.out_c;
+  // unpool + ReLU mask -> delta at conv positions (sparse: one per pooled cell)
+  if (delta_in) delta_in->assign(static_cast<size_t>(H) * W * IC, 0.0f);
+  for (size_t o = 0; o < delta_out.size(); ++o) {
+    float d = delta_out[o];
+    if (d == 0.0f) continue;
+    int32_t ci = argmax[o];
+    if (conv_out[ci] <= 0.0f) continue;  // ReLU gate
+    int oc = ci % OC;
+    int pos = ci / OC;
+    int ox = pos % W, oy = pos / W;
+    gb[oc] += d;
+    for (int ky = -1; ky <= 1; ++ky) {
+      int iy = oy + ky;
+      if (iy < 0 || iy >= H) continue;
+      for (int kx = -1; kx <= 1; ++kx) {
+        int ix = ox + kx;
+        if (ix < 0 || ix >= W) continue;
+        const float *in_px = in + (static_cast<size_t>(iy) * W + ix) * IC;
+        size_t wbase = ((static_cast<size_t>(ky + 1) * 3 + (kx + 1)) * IC) * OC + oc;
+        for (int ic = 0; ic < IC; ++ic) {
+          gw[wbase + static_cast<size_t>(ic) * OC] += in_px[ic] * d;
+          if (delta_in)
+            (*delta_in)[(static_cast<size_t>(iy) * W + ix) * IC + ic] +=
+                L.w[wbase + static_cast<size_t>(ic) * OC] * d;
+        }
+      }
+    }
+  }
+}
+
+void dense_forward_layer(const DenseLayer &L, const float *in, std::vector<float> &out, bool relu) {
+  out.assign(L.out_dim, 0.0f);
+  for (int o = 0; o < L.out_dim; ++o) {
+    float s = L.b[o];
+    for (int i = 0; i < L.in_dim; ++i)
+      s += in[i] * L.w[static_cast<size_t>(i) * L.out_dim + o];
+    out[o] = relu ? std::max(s, 0.0f) : s;
+  }
+}
+
+}  // namespace
+
 float FedMLDenseTrainer::train_epoch(DenseModel &model, const DataSet &data, int epoch) {
   const int n = std::min(train_size_ > 0 ? train_size_ : data.n, data.n);
   const int nl = static_cast<int>(model.layers.size());
@@ -107,15 +210,14 @@ float FedMLDenseTrainer::train_epoch(DenseModel &model, const DataSet &data, int
   std::mt19937_64 rng(static_cast<uint64_t>(epoch) * 0x9E37ULL + 13);
   std::shuffle(order.begin(), order.end(), rng);
 
-  // per-layer activation buffers for one sample
-  std::vector<std::vector<float>> acts(nl + 1);
-  std::vector<std::vector<float>> deltas(nl);
+  // per-layer buffers for one sample
+  std::vector<std::vector<float>> acts(nl + 1), conv_outs(nl), deltas(nl);
+  std::vector<std::vector<int32_t>> argmaxes(nl);
   double loss_sum = 0.0;
   int steps = 0;
 
   for (int start = 0; start < n && !stop_flag_; start += batch_size_) {
     int bsz = std::min(batch_size_, n - start);
-    // accumulate gradients over the batch (SGD on the mean loss)
     std::vector<std::vector<float>> gw(nl), gb(nl);
     for (int l = 0; l < nl; ++l) {
       gw[l].assign(model.layers[l].w.size(), 0.0f);
@@ -123,19 +225,15 @@ float FedMLDenseTrainer::train_epoch(DenseModel &model, const DataSet &data, int
     }
     for (int bi = 0; bi < bsz; ++bi) {
       int i = order[start + bi];
-      // forward
       acts[0].assign(data.x.begin() + static_cast<size_t>(i) * data.dim,
                      data.x.begin() + static_cast<size_t>(i + 1) * data.dim);
+      // forward
       for (int l = 0; l < nl; ++l) {
         const auto &L = model.layers[l];
-        acts[l + 1].assign(L.out_dim, 0.0f);
-        for (int o = 0; o < L.out_dim; ++o) {
-          float s = L.b[o];
-          const float *wcol = L.w.data() + static_cast<size_t>(o);
-          for (int in = 0; in < L.in_dim; ++in)
-            s += acts[l][in] * L.w[static_cast<size_t>(in) * L.out_dim + o];
-          (void)wcol;
-          acts[l + 1][o] = (l + 1 < nl) ? std::max(s, 0.0f) : s;  // ReLU hidden
+        if (L.kind == kConv3x3Pool) {
+          conv_pool_forward(L, acts[l].data(), conv_outs[l], acts[l + 1], &argmaxes[l]);
+        } else {
+          dense_forward_layer(L, acts[l].data(), acts[l + 1], l + 1 < nl);
         }
       }
       // softmax cross-entropy on the head
@@ -145,28 +243,43 @@ float FedMLDenseTrainer::train_epoch(DenseModel &model, const DataSet &data, int
       for (float v : logits) denom += std::exp(v - mx);
       int label = data.y[i];
       loss_sum += -(logits[label] - mx - std::log(denom));
-      // backward
       deltas[nl - 1].assign(logits.size(), 0.0f);
       for (size_t o = 0; o < logits.size(); ++o) {
         float p = static_cast<float>(std::exp(logits[o] - mx) / denom);
         deltas[nl - 1][o] = p - (static_cast<int>(o) == label ? 1.0f : 0.0f);
       }
+      // backward
       for (int l = nl - 1; l >= 0; --l) {
         const auto &L = model.layers[l];
-        for (int o = 0; o < L.out_dim; ++o) {
-          float d = deltas[l][o];
-          gb[l][o] += d;
-          for (int in = 0; in < L.in_dim; ++in)
-            gw[l][static_cast<size_t>(in) * L.out_dim + o] += acts[l][in] * d;
-        }
-        if (l > 0) {
-          deltas[l - 1].assign(L.in_dim, 0.0f);
-          for (int in = 0; in < L.in_dim; ++in) {
-            float s = 0.0f;
-            for (int o = 0; o < L.out_dim; ++o)
-              s += model.layers[l].w[static_cast<size_t>(in) * L.out_dim + o] * deltas[l][o];
-            // ReLU derivative
-            deltas[l - 1][in] = acts[l][in] > 0.0f ? s : 0.0f;
+        std::vector<float> *din = l > 0 ? &deltas[l - 1] : nullptr;
+        if (L.kind == kConv3x3Pool) {
+          conv_pool_backward(L, acts[l].data(), conv_outs[l], argmaxes[l], deltas[l],
+                             gw[l], gb[l], din);
+          // delta_in is pre-activation of the PREVIOUS layer's output; apply
+          // the previous layer's ReLU gate below (dense case handles it)
+          if (din && l > 0 && model.layers[l - 1].kind == kDense) {
+            for (int in = 0; in < L.in_dim; ++in)
+              if (acts[l][in] <= 0.0f) (*din)[in] = 0.0f;
+          }
+        } else {
+          for (int o = 0; o < L.out_dim; ++o) {
+            float d = deltas[l][o];
+            gb[l][o] += d;
+            for (int in = 0; in < L.in_dim; ++in)
+              gw[l][static_cast<size_t>(in) * L.out_dim + o] += acts[l][in] * d;
+          }
+          if (l > 0) {
+            deltas[l - 1].assign(L.in_dim, 0.0f);
+            for (int in = 0; in < L.in_dim; ++in) {
+              float s = 0.0f;
+              for (int o = 0; o < L.out_dim; ++o)
+                s += L.w[static_cast<size_t>(in) * L.out_dim + o] * deltas[l][o];
+              // gate by the previous layer's ReLU (dense hidden) — conv
+              // outputs are post-pool-of-ReLU, their gate lives inside
+              // conv_pool_backward of that layer
+              deltas[l - 1][in] =
+                  (model.layers[l - 1].kind == kDense && acts[l][in] <= 0.0f) ? 0.0f : s;
+            }
           }
         }
       }
@@ -188,18 +301,16 @@ float FedMLDenseTrainer::evaluate(const DenseModel &model, const DataSet &data, 
   if (n == 0) return 0.0f;
   int correct = 0;
   const int nl = static_cast<int>(model.layers.size());
-  std::vector<float> cur, next;
+  std::vector<float> cur, next, conv_scratch;
   for (int i = 0; i < n; ++i) {
     cur.assign(data.x.begin() + static_cast<size_t>(i) * data.dim,
                data.x.begin() + static_cast<size_t>(i + 1) * data.dim);
     for (int l = 0; l < nl; ++l) {
       const auto &L = model.layers[l];
-      next.assign(L.out_dim, 0.0f);
-      for (int o = 0; o < L.out_dim; ++o) {
-        float s = L.b[o];
-        for (int in = 0; in < L.in_dim; ++in)
-          s += cur[in] * L.w[static_cast<size_t>(in) * L.out_dim + o];
-        next[o] = (l + 1 < nl) ? std::max(s, 0.0f) : s;
+      if (L.kind == kConv3x3Pool) {
+        conv_pool_forward(L, cur.data(), conv_scratch, next, nullptr);
+      } else {
+        dense_forward_layer(L, cur.data(), next, l + 1 < nl);
       }
       cur.swap(next);
     }

@@ -66,21 +66,41 @@ def test_fedgan_trains_both_subtrees():
     assert imgs.shape[0] == 4 and np.all(np.isfinite(imgs))
 
 
+def _spatial_blob_data(n, classes=10, hw=28, seed=0):
+    """Class-at-a-position blobs: signal a conv stem actually sees (the
+    iid-pixel surrogate's linear signal is near-invisible to a narrow
+    GroupNorm resnet stem in a CI-sized step budget)."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, classes, n)
+    x = rng.normal(0, 0.3, (n, hw, hw, 1)).astype(np.float32)
+    for i, c in enumerate(y):
+        cy, cx = (c // 4) * 8 + 2, (c % 4) * 6 + 2
+        x[i, cy : cy + 4, cx : cx + 4, 0] += 2.0
+    return x, y.astype(np.int64)
+
+
+@pytest.mark.slow
 def test_fedgkt_distills_across_feature_boundary():
+    from fedml_tpu.data.dataset import ArrayDataset
     from fedml_tpu.simulation.sp.fedgkt import FedGKTAPI
 
     args = default_config(
         "simulation", federated_optimizer="FedGKT", dataset="mnist", model="cnn",
-        client_num_in_total=2, comm_round=2, epochs=1, batch_size=32, learning_rate=0.03,
+        client_num_in_total=2, comm_round=2, epochs=3, batch_size=32, learning_rate=0.03,
     )
-    args, device, dataset, _ = _dataset(args)
-    api = FedGKTAPI(args, device, dataset)
+    args = fedml.init(args)
+    tr = {cid: ArrayDataset(*_spatial_blob_data(512, seed=cid)) for cid in range(2)}
+    test_g = ArrayDataset(*_spatial_blob_data(512, seed=99))
+    dataset = [1024, 512, None, test_g, {0: 512, 1: 512}, tr, {0: tr[0], 1: tr[1]}, 10]
+    api = FedGKTAPI(args, None, dataset)
     m = api.train()
     assert m["test_acc"] > 0.6, m
-    # second round distills: server loss should not explode
+    # the second round's distillation must IMPROVE the deployed pair
+    assert m["test_acc"] > api.metrics_history[0]["test_acc"]
     assert np.isfinite(m["server_loss"]) and np.isfinite(m["client_loss"])
 
 
+@pytest.mark.slow
 def test_fednas_search_moves_alphas_and_derives_genotype():
     from fedml_tpu.simulation.sp.fednas import FedNASAPI
 
@@ -89,6 +109,11 @@ def test_fednas_search_moves_alphas_and_derives_genotype():
         client_num_in_total=2, comm_round=1, epochs=1, batch_size=16, learning_rate=0.025,
     )
     args, device, dataset, out_dim = _dataset(args)
+    # cap per-client volume: the DARTS supernet's bilevel steps are heavy on
+    # the CI CPU; alphas move just as surely on a few hundred samples
+    for cid in list(dataset[5]):
+        dataset[5][cid] = dataset[5][cid].subset(np.arange(min(256, len(dataset[5][cid]))))
+        dataset[4][cid] = len(dataset[5][cid])
     model = fedml.model.create(args, out_dim)
     a0 = np.asarray(model.params["arch"]).copy()
     api = FedNASAPI(args, device, dataset, model)
@@ -100,6 +125,7 @@ def test_fednas_search_moves_alphas_and_derives_genotype():
     assert len(geno) > 0 and all(isinstance(op, str) for _, op in geno)
 
 
+@pytest.mark.slow
 def test_runner_dispatches_new_optimizers():
     """run_simulation routes the new optimizer names (smoke, tiny)."""
     args = default_config(

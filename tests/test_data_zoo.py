@@ -18,6 +18,7 @@ from fedml_tpu.data.sources import (
     "name,classes",
     [("imagenet", 1000), ("gld23k", 203), ("reddit", 10000), ("lending_club", 2), ("uci", 2)],
 )
+@pytest.mark.slow
 def test_new_datasets_load_and_partition(name, classes):
     args = default_config("simulation", dataset=name, client_num_in_total=4)
     dataset, out_dim = fedml.data.load(args)
@@ -27,6 +28,7 @@ def test_new_datasets_load_and_partition(name, classes):
     assert len(train_local) == 4 and all(len(s) > 0 for s in train_local.values())
 
 
+@pytest.mark.slow
 def test_stackoverflow_lr_multilabel_trains():
     args = default_config(
         "simulation", dataset="stackoverflow_lr", model="lr",

@@ -98,3 +98,31 @@ def test_uplink_failure_never_kills_the_run(tmp_path):
 
 def _raise(*a, **k):
     raise ConnectionError("broker gone")
+
+
+def test_jax_profiler_trace_capture(tmp_path):
+    """SURVEY §5 tracing: a real jax.profiler trace is captured around a jit
+    dispatch and lands on disk for XProf/TensorBoard."""
+    import jax
+    import jax.numpy as jnp
+
+    args = _Args()
+    args.mlops_backend_mqtt = False
+    args.log_file_dir = str(tmp_path / "logs")
+    rt = mlops.MLOpsRuntime.get_instance()
+    rt.init(args)
+
+    logdir = str(tmp_path / "trace")
+    assert mlops.start_profiler_trace(logdir) is True
+    assert mlops.start_profiler_trace(logdir) is False  # already running
+    with mlops.profile_span("bench_matmul"):
+        x = jnp.ones((64, 64))
+        jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    out = mlops.stop_profiler_trace()
+    assert out == logdir
+    assert mlops.stop_profiler_trace() is None
+    import glob
+
+    assert glob.glob(logdir + "/**/*.xplane.pb", recursive=True), "no trace file captured"
+    names = [r.get("name") for r in rt.records]
+    assert "jax_profiler_trace" in names and "bench_matmul" in names

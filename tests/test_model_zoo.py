@@ -29,6 +29,7 @@ def _args(model, dataset="mnist", **kw):
         ("darts", "cifar10"),
     ],
 )
+@pytest.mark.slow
 def test_vision_models_forward(name, dataset):
     model = model_hub.create(_args(name, dataset))
     x = jnp.zeros((2,) + model.input_shape[1:], model.input_dtype)
@@ -36,6 +37,7 @@ def test_vision_models_forward(name, dataset):
     assert out.shape == (2, 10)
 
 
+@pytest.mark.slow
 def test_gan_pair_forward():
     model = model_hub.create(_args("gan", "mnist"))
     z = jnp.zeros((2, 64))
@@ -46,6 +48,7 @@ def test_gan_pair_forward():
     assert {"generator", "discriminator"} <= set(model.params.keys())
 
 
+@pytest.mark.slow
 def test_split_pair():
     client, server = model_hub.create_split(_args("split", "cifar10"))
     x = jnp.zeros((2, 32, 32, 3))
@@ -63,3 +66,33 @@ def test_darts_has_arch_params():
     geno = derive_genotype(model.params["arch"])
     assert len(geno) == 6  # top-2 edges per each of 3 steps
     assert all(op in OP_NAMES for _, op in geno)
+
+
+def test_pretrained_npz_roundtrip(tmp_path):
+    """CV pretrained-weight loading (model zoo parity: the reference loads
+    torchvision weights; here any trained pytree ships as flat npz)."""
+    import jax
+    import numpy as np
+
+    import fedml_tpu as fedml
+    from fedml_tpu.arguments import default_config
+    from fedml_tpu.models.model_hub import load_pretrained, save_pretrained_npz
+
+    args = default_config("simulation", model="resnet20", dataset="cifar10")
+    m1 = fedml.model.create(args, 10, seed=1)
+    path = save_pretrained_npz(m1.params, str(tmp_path / "resnet20.npz"))
+
+    args2 = default_config("simulation", model="resnet20", dataset="cifar10",
+                           pretrained_path=path)
+    m2 = fedml.model.create(args2, 10, seed=2)  # different seed: must not matter
+    for a, b in zip(jax.tree.leaves(m1.params), jax.tree.leaves(m2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # wrong-shape guard
+    args3 = default_config("simulation", model="resnet56", dataset="cifar10",
+                           pretrained_path=path)
+    try:
+        fedml.model.create(args3, 10)
+        raise AssertionError("shape mismatch must raise")
+    except (KeyError, ValueError):
+        pass

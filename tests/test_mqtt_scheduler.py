@@ -162,3 +162,40 @@ def test_cli_launch_mqtt_backend(tmp_path):
     result = CliRunner().invoke(cli, ["launch", str(job), "--backend", "mqtt", "-t", "120"])
     assert result.exit_code == 0, result.output
     assert "FINISHED" in result.output
+
+
+def test_job_monitor_elastic_restart(tmp_path):
+    """Elastic recovery (reference job_monitor container restarts): a job
+    that fails transiently is re-executed from its stored request and
+    eventually FINISHES."""
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    # fails on the first run of each fresh run_dir attempt until a marker
+    # accumulates 2 failures, then succeeds
+    marker = tmp_path / "attempts.txt"
+    (ws / "main.py").write_text(
+        "import os, sys\n"
+        f"p = {str(marker)!r}\n"
+        "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(n + 1))\n"
+        "sys.exit(0 if n >= 2 else 1)\n"
+    )
+    store = LocalObjectStore(str(tmp_path / "store"))
+    agent = MqttClientAgent(0, base_dir=str(tmp_path / "edge0"), store=store)
+    server = MqttServerAgent([0], store=store)
+    monitor = JobMonitor([agent], poll_s=0.2, restart_failed=True, max_restarts=3)
+    monitor.start()
+    try:
+        run_id = server.dispatch_workspace(ws, "python main.py")
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            st = agent.runner.runs.get(run_id)
+            if st is not None and st.status == "FINISHED":
+                break
+            time.sleep(0.1)
+        assert agent.runner.runs[run_id].status == "FINISHED"
+        assert len(monitor.restarts) == 2  # failed twice, third attempt succeeded
+    finally:
+        monitor.stop()
+        server.stop()
+        agent.stop()
