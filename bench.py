@@ -168,12 +168,18 @@ def _bench_llm_tpu(reps: int = 10):
     }
 
 
-def _bench_llm_torch_cpu(shape, budget_s: float = 90.0) -> float | None:
-    """Same-workload torch-CPU train step; returns tokens/sec or None."""
+def _bench_llm_torch_cpu(shape, budget_s: float = 150.0) -> float | None:
+    """Same-model torch-CPU train step; returns tokens/sec or None.
+
+    Runs at bs=1 (per-token throughput on CPU is batch-insensitive at
+    seq 1024 — the matmul shapes stay large — while bs=8 would take
+    ~20 min/chain on this image's single core). The first step is warmup;
+    the ratio comes from the warm step, which favors the baseline."""
     import torch
     import torch.nn as nn
 
-    d, L, vocab, seq, bs = shape["d_model"], shape["n_layers"], shape["vocab"], shape["seq"], shape["bs"]
+    d, L, vocab, seq = shape["d_model"], shape["n_layers"], shape["vocab"], shape["seq"]
+    bs = 1
 
     ff = 2752
     norm_cls = getattr(nn, "RMSNorm", nn.LayerNorm)
@@ -231,14 +237,20 @@ def _bench_llm_torch_cpu(shape, budget_s: float = 90.0) -> float | None:
             loss.backward()
             opt.step()
 
-        one_step()  # warmup/alloc
-        t0 = time.perf_counter()
-        n = 0
-        while n < 3 and time.perf_counter() - t0 < budget_s:
+        times = []
+        t_start = time.perf_counter()
+        for _ in range(2):
+            t0 = time.perf_counter()
             one_step()
-            n += 1
-        dt = time.perf_counter() - t0
-        return bs * seq * n / dt if n else None
+            times.append(time.perf_counter() - t0)
+            if time.perf_counter() - t_start > budget_s:
+                break
+        if len(times) < 2:
+            # only the cold step fit the budget: a cold-biased baseline would
+            # overstate vs_baseline, so refuse to publish a ratio instead
+            print("warning: torch-CPU LLM baseline got only a cold step; skipping ratio", file=sys.stderr)
+            return None
+        return bs * seq / min(times[1:])
     except Exception as e:
         print(f"warning: torch-CPU LLM baseline failed: {e}", file=sys.stderr)
         return None
@@ -311,7 +323,10 @@ def _bench_resnet_tpu(reps: int = 10, bs: int = 128):
     return {"steps_per_sec": 1.0 / dt_step, "mfu": mfu, "bs": bs}
 
 
-def _bench_resnet_torch_cpu(bs: int = 128, budget_s: float = 60.0) -> float | None:
+def _bench_resnet_torch_cpu(bs: int = 32, budget_s: float = 60.0) -> float | None:
+    """Same-model torch-CPU train step; returns IMAGES/sec (per-image
+    normalization lets the CPU run a smaller batch than the TPU side —
+    bs=128 on this image's single core would blow the bench budget)."""
     import torch
     import torch.nn as nn
     import torch.nn.functional as F
@@ -363,10 +378,10 @@ def _bench_resnet_torch_cpu(bs: int = 128, budget_s: float = 60.0) -> float | No
         one_step()
         t0 = time.perf_counter()
         n = 0
-        while (n < 5 or time.perf_counter() - t0 < 3.0) and time.perf_counter() - t0 < budget_s:
+        while (n < 3 or time.perf_counter() - t0 < 3.0) and time.perf_counter() - t0 < budget_s:
             one_step()
             n += 1
-        return n / (time.perf_counter() - t0)
+        return bs * n / (time.perf_counter() - t0)
     except Exception as e:
         print(f"warning: torch-CPU resnet baseline failed: {e}", file=sys.stderr)
         return None
@@ -376,8 +391,9 @@ def main() -> None:
     llm = _bench_llm_tpu()
     resnet = _bench_resnet_tpu()
     llm_cpu_tokens = _bench_llm_torch_cpu(llm["shape"])
-    resnet_cpu_rate = _bench_resnet_torch_cpu()
+    resnet_cpu_images = _bench_resnet_torch_cpu()
 
+    resnet_images_per_sec = resnet["steps_per_sec"] * resnet["bs"]
     out = {
         "metric": "llm_train_tokens_per_sec",
         "value": round(llm["tokens_per_sec"], 1),
@@ -388,7 +404,7 @@ def main() -> None:
         "resnet56_steps_per_sec": round(resnet["steps_per_sec"], 2),
         "resnet56_mfu": round(resnet["mfu"], 4),
         "resnet56_vs_torch_cpu": (
-            round(resnet["steps_per_sec"] / resnet_cpu_rate, 2) if resnet_cpu_rate else None
+            round(resnet_images_per_sec / resnet_cpu_images, 2) if resnet_cpu_images else None
         ),
     }
     print(json.dumps(out))

@@ -57,3 +57,4 @@ ROLE_CLIENT = "client"
 
 # --- message-plane defaults (reference: communication/constants.py) ---
 GRPC_BASE_PORT = 8890
+TRPC_BASE_PORT = 9890

@@ -21,50 +21,65 @@ SEP = "/"
 _LEAF_TYPES = (np.ndarray, np.generic, int, float, bool)
 
 
-def _flatten(obj: Any, prefix: str, out: Dict[str, np.ndarray], structure: Any):
-    """Returns a JSON-able structure skeleton; arrays land in `out`."""
+def flatten_tree(obj: Any, out: List[np.ndarray]) -> Any:
+    """Returns a JSON-able structure skeleton; contiguous host arrays are
+    appended to ``out`` in pytree order and referenced by index. Shared by
+    the npz codec below and the TRPC raw-frame codec."""
     if isinstance(obj, dict):
-        return {k: _flatten(v, f"{prefix}{SEP}{k}", out, structure) for k, v in obj.items()}
+        return {k: flatten_tree(v, out) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         kind = "list" if isinstance(obj, list) else "tuple"
-        return {
-            "__seq__": kind,
-            "items": [_flatten(v, f"{prefix}{SEP}{i}", out, structure) for i, v in enumerate(obj)],
-        }
+        return {"__seq__": kind, "items": [flatten_tree(v, out) for v in obj]}
     if obj is None:
         return {"__none__": True}
-    arr = np.asarray(jax.device_get(obj))
-    key = f"arr{len(out)}"
-    out[key] = arr
-    return {"__leaf__": key}
+    arr = np.ascontiguousarray(np.asarray(jax.device_get(obj)))
+    out.append(arr)
+    return {"__leaf__": len(out) - 1}
 
 
-def _unflatten(skel: Any, arrays: Dict[str, np.ndarray]) -> Any:
+def unflatten_tree(skel: Any, arrays: List[np.ndarray]) -> Any:
     if isinstance(skel, dict):
         if "__leaf__" in skel:
-            return arrays[skel["__leaf__"]]
+            ref = skel["__leaf__"]
+            if isinstance(ref, str):  # pre-TRPC format: "arrN" string refs
+                ref = int(ref[3:])
+            return arrays[ref]
         if "__none__" in skel:
             return None
         if "__seq__" in skel:
-            items = [_unflatten(s, arrays) for s in skel["items"]]
+            items = [unflatten_tree(s, arrays) for s in skel["items"]]
             return items if skel["__seq__"] == "list" else tuple(items)
-        return {k: _unflatten(v, arrays) for k, v in skel.items()}
+        return {k: unflatten_tree(v, arrays) for k, v in skel.items()}
     raise ValueError(f"bad skeleton node {skel!r}")
 
 
+def to_wire_dtype(arr: np.ndarray) -> Tuple[np.ndarray, str]:
+    """(codec-safe array, recorded dtype name): bf16 has no npz/raw codec, so
+    it travels bit-exactly as uint16 with the real dtype recorded."""
+    if arr.dtype.name == "bfloat16":
+        return arr.view(np.uint16), "bfloat16"
+    return arr, arr.dtype.name
+
+
+def from_wire_dtype(buf: np.ndarray, dtype_name: str, shape) -> np.ndarray:
+    if dtype_name == "bfloat16":
+        import ml_dtypes
+
+        return buf.view(ml_dtypes.bfloat16).reshape(shape)
+    return buf.view(np.dtype(dtype_name)).reshape(shape)
+
+
 def serialize_pytree(tree: Any) -> bytes:
-    arrays: Dict[str, np.ndarray] = {}
-    skel = _flatten(tree, "", arrays, None)
+    flat: List[np.ndarray] = []
+    skel = flatten_tree(tree, flat)
     buf = io.BytesIO()
-    # bfloat16 has no npz codec -> view as uint16 and record the real dtype
     meta_dtypes = {}
     packed = {}
-    for k, a in arrays.items():
-        if a.dtype.name == "bfloat16":
-            meta_dtypes[k] = "bfloat16"
-            packed[k] = a.view(np.uint16)
-        else:
-            packed[k] = a
+    for i, a in enumerate(flat):
+        w, dname = to_wire_dtype(a)
+        if dname != w.dtype.name:
+            meta_dtypes[f"arr{i}"] = dname
+        packed[f"arr{i}"] = w
     packed["__skeleton__"] = np.frombuffer(
         json.dumps({"skel": skel, "bf16": meta_dtypes}).encode(), dtype=np.uint8
     )
@@ -75,14 +90,12 @@ def serialize_pytree(tree: Any) -> bytes:
 def deserialize_pytree(data: bytes) -> Any:
     with np.load(io.BytesIO(data), allow_pickle=False) as z:
         meta = json.loads(bytes(z["__skeleton__"].tobytes()).decode())
-        arrays = {}
-        import ml_dtypes
-
-        for k in z.files:
-            if k == "__skeleton__":
-                continue
-            a = z[k]
-            if k in meta["bf16"]:
-                a = a.view(ml_dtypes.bfloat16)
-            arrays[k] = a
-    return _unflatten(meta["skel"], arrays)
+        arrays: List[np.ndarray] = []
+        i = 0
+        while f"arr{i}" in z.files:
+            a = z[f"arr{i}"]
+            if f"arr{i}" in meta["bf16"]:
+                a = from_wire_dtype(a, meta["bf16"][f"arr{i}"], a.shape)
+            arrays.append(a)
+            i += 1
+    return unflatten_tree(meta["skel"], arrays)

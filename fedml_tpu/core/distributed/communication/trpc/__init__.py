@@ -1,0 +1,3 @@
+from .trpc_comm_manager import TRPCCommManager
+
+__all__ = ["TRPCCommManager"]

@@ -1,0 +1,298 @@
+"""TRPC backend: tensor-native RPC over raw TCP.
+
+Reference: ``communication/trpc/trpc_comm_manager.py:21`` — torch.distributed.rpc
+with optional CUDA-RPC so tensors travel device-native instead of being
+pickled. The TPU-native analogue keeps the *property* that matters — tensors
+cross the host boundary as raw flat buffers with zero serialization overhead —
+without torch.rpc: each rank runs a TCP listener at ``base_port + rank``;
+a message is one length-prefixed frame
+
+    [u32 header_len][header JSON][tensor_0 bytes][tensor_1 bytes]...
+
+where the header carries the control-plane message dict plus a tensor
+manifest (dtype/shape/nbytes per leaf, in pytree order). Array payloads are
+written straight from the numpy buffer with ``sendall(memoryview)`` and read
+back with ``recv_into`` into preallocated arrays — no npz container, no
+base64, no pickle. Inside a pod slice ICI collectives remain the truly
+device-native plane (SURVEY §2.b); this backend is the *host* tensor plane
+for cross-process tensor exchange, e.g. split-NN activations.
+
+Peer addressing mirrors the gRPC backend: optional CSV ``rank,ip`` table
+(reference trpc master config file), default localhost.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .....constants import TRPC_BASE_PORT
+from ..base_com_manager import BaseCommunicationManager, Observer
+from ..grpc.grpc_comm_manager import read_ip_config
+from ..message import Message
+from ..serialization import flatten_tree, from_wire_dtype, to_wire_dtype, unflatten_tree
+
+log = logging.getLogger(__name__)
+
+_STOP = object()
+
+
+def _hard_close(sock: socket.socket) -> None:
+    """Teardown that actually releases the port. shutdown() first: close()
+    alone neither wakes a thread blocked in recv/accept on the fd nor (while
+    that syscall holds the fd's refcount) destroys the kernel socket.
+    SO_LINGER(0) avoids FIN_WAIT lingering that would block an
+    elastic-restart rebind; the peer's cached socket becomes observably dead
+    (readable) at once."""
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0))
+    except OSError:
+        pass
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+# --- tensor-native framing ---------------------------------------------------
+
+def encode_frame(msg: Message) -> Tuple[bytes, List[np.ndarray]]:
+    """Header bytes + the list of raw arrays to follow (unserialized)."""
+    arrays: List[np.ndarray] = []
+    params = msg.get_params().get(Message.MSG_ARG_KEY_MODEL_PARAMS)
+    skel = None
+    if params is not None:
+        skel = flatten_tree(params, arrays)
+    manifest = []
+    wire: List[np.ndarray] = []
+    for a in arrays:
+        w, dname = to_wire_dtype(a)
+        manifest.append({"dtype": dname, "shape": list(a.shape), "nbytes": int(w.nbytes)})
+        wire.append(w)
+    header = json.dumps(
+        {"msg": json.loads(msg.to_json()), "skel": skel, "tensors": manifest}
+    ).encode()
+    return struct.pack(">I", len(header)) + header, wire
+
+
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    while len(view):
+        n = sock.recv_into(view)
+        if n == 0:
+            raise ConnectionError("peer closed mid-frame")
+        view = view[n:]
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf))
+    return bytes(buf)
+
+
+_MAX_HEADER = 256 * 1024 * 1024
+_MAX_TENSOR = 16 * 1024 * 1024 * 1024
+
+
+def recv_frame(sock: socket.socket) -> Message:
+    (hlen,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if hlen > _MAX_HEADER:
+        raise ValueError(f"frame header {hlen} bytes exceeds cap (corrupt/hostile peer)")
+    header = json.loads(_recv_exact(sock, hlen).decode())
+    arrays: List[np.ndarray] = []
+    for spec in header["tensors"]:
+        if not (0 <= int(spec["nbytes"]) <= _MAX_TENSOR):
+            raise ValueError(f"tensor of {spec['nbytes']} bytes exceeds cap")
+        flat = np.empty(spec["nbytes"], dtype=np.uint8)
+        _recv_exact_into(sock, memoryview(flat))
+        arrays.append(from_wire_dtype(flat, spec["dtype"], spec["shape"]))
+    msg = Message()
+    msg.init_from_json_object(header["msg"])
+    if header["skel"] is not None:
+        msg.add_params(
+            Message.MSG_ARG_KEY_MODEL_PARAMS, unflatten_tree(header["skel"], arrays)
+        )
+    return msg
+
+
+# --- comm manager ------------------------------------------------------------
+
+class TRPCCommManager(BaseCommunicationManager):
+    def __init__(
+        self,
+        ip_config_path: Optional[str] = None,
+        client_id: int = 0,
+        client_num: int = 0,
+        base_port: int = TRPC_BASE_PORT,
+    ):
+        self.rank = client_id
+        self.size = client_num + 1
+        self.base_port = base_port
+        self.ip_table = read_ip_config(ip_config_path, self.size)
+        self._observers: List[Observer] = []
+        self._incoming: "queue.Queue" = queue.Queue()
+        self._out_socks: Dict[int, socket.socket] = {}
+        self._out_locks: Dict[int, threading.Lock] = {}
+        self._connect_lock = threading.Lock()
+        self._accepted: List[socket.socket] = []
+        self._running = False
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", base_port + self.rank))
+        self._listener.listen(self.size + 4)
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+        log.info("trpc rank=%d listening on :%d", self.rank, base_port + self.rank)
+
+    # --- server side -----------------------------------------------------
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # accepted sockets share the listener's port; without REUSEADDR a
+            # lingering FIN_WAIT accepted socket blocks an elastic-restart rebind
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._accepted.append(conn)
+            threading.Thread(target=self._reader_loop, args=(conn,), daemon=True).start()
+
+    def _reader_loop(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                self._incoming.put(recv_frame(conn))
+        except (ConnectionError, OSError):
+            pass  # peer closed / manager stopped: normal end of stream
+        except Exception:
+            # malformed frame (stray connection, version-mismatched peer,
+            # hostile nbytes): drop the connection, keep the manager alive
+            log.exception("trpc rank=%d dropping connection after bad frame", self.rank)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            try:
+                self._accepted.remove(conn)
+            except ValueError:
+                pass
+
+    # --- client side -----------------------------------------------------
+    def _connect(self, receiver: int) -> socket.socket:
+        """Connect-with-retry (peers come up in any order, mirroring the gRPC
+        backend's UNAVAILABLE retry). The lock is created under _connect_lock
+        BEFORE the socket is published so concurrent first senders never see
+        a socket without its lock."""
+        import time
+
+        import select
+
+        with self._connect_lock:
+            sock = self._out_socks.get(receiver)
+            if sock is not None:
+                # liveness probe: this side never receives on outgoing
+                # sockets, so readability can only mean EOF/RST (peer
+                # restarted). A silent first-write-after-FIN would otherwise
+                # lose the frame without raising.
+                readable, _, _ = select.select([sock], [], [], 0)
+                if not readable:
+                    return sock
+                del self._out_socks[receiver]
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._out_locks.setdefault(receiver, threading.Lock())
+        addr = (self.ip_table.get(receiver, "127.0.0.1"), self.base_port + receiver)
+        deadline = time.time() + 120.0
+        delay = 0.1
+        while True:
+            try:
+                sock = socket.create_connection(addr, timeout=10)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.settimeout(None)
+                with self._connect_lock:
+                    if receiver in self._out_socks:  # lost a connect race
+                        sock.close()
+                    else:
+                        self._out_socks[receiver] = sock
+                    return self._out_socks[receiver]
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+
+    def _drop(self, receiver: int, sock: socket.socket) -> None:
+        with self._connect_lock:
+            if self._out_socks.get(receiver) is sock:
+                del self._out_socks[receiver]
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def send_message(self, msg: Message) -> None:
+        """A dead cached socket (peer restarted — elastic jobs do) is dropped
+        and the send retried on a fresh connection; a mid-frame failure always
+        abandons the socket, so the peer never sees a misaligned stream."""
+        receiver = msg.get_receiver_id()
+        header, tensors = encode_frame(msg)
+        for attempt in range(2):
+            sock = self._connect(receiver)
+            try:
+                with self._out_locks[receiver]:
+                    sock.sendall(header)
+                    for t in tensors:
+                        sock.sendall(memoryview(t).cast("B"))
+                return
+            except OSError:
+                self._drop(receiver, sock)
+                if attempt == 1:
+                    raise
+
+    # --- loop ------------------------------------------------------------
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        while self._running:
+            try:
+                item = self._incoming.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is _STOP:
+                break
+            for obs in list(self._observers):
+                obs.receive_message(item.get_type(), item)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self._incoming.put(_STOP)
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)  # wakes the accept loop
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for sock in list(self._out_socks.values()) + list(self._accepted):
+            _hard_close(sock)
+        self._accept_thread.join(timeout=5)

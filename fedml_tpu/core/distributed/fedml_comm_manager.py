@@ -2,8 +2,9 @@
 
 Reference: ``core/distributed/fedml_comm_manager.py:11`` (run:25, handler
 registry :34-51, ``_init_manager``:131-209 incl. the "self-defined backend"
-seam at :204-207). Backends: INMEMORY (test seam), GRPC, MQTT_S3; MPI/TRPC
-map onto GRPC-locally / ICI respectively (SURVEY §2.b).
+seam at :204-207). Backends: INMEMORY (test seam), GRPC, MQTT_S3, TRPC
+(tensor-native TCP, communication/trpc/); MPI maps onto GRPC locally
+(SURVEY §2.b; single-host semantics proven in tests/test_mpi_semantics.py).
 """
 
 from __future__ import annotations
@@ -98,7 +99,17 @@ class FedMLCommManager(Observer):
             from .communication.inmemory.inmemory_comm_manager import InMemoryCommManager
 
             self.com_manager = InMemoryCommManager(str(getattr(self.args, "run_id", "0")), self.rank, self.size)
-        elif self.backend in (COMM_BACKEND_GRPC, COMM_BACKEND_MPI, COMM_BACKEND_TRPC):
+        elif self.backend == COMM_BACKEND_TRPC:
+            from ...constants import TRPC_BASE_PORT
+            from .communication.trpc.trpc_comm_manager import TRPCCommManager
+
+            self.com_manager = TRPCCommManager(
+                ip_config_path=getattr(self.args, "trpc_ipconfig_path", None),
+                client_id=self.rank,
+                client_num=self.size - 1,
+                base_port=int(getattr(self.args, "trpc_base_port", TRPC_BASE_PORT)) + _run_id_offset(getattr(self.args, "run_id", 0)),
+            )
+        elif self.backend in (COMM_BACKEND_GRPC, COMM_BACKEND_MPI):
             from .communication.grpc.grpc_comm_manager import GRPCCommManager
 
             self.com_manager = GRPCCommManager(
