@@ -1,0 +1,143 @@
+"""Message-plane transport microbenchmark.
+
+Reference parity: ``python/tests/grpc_benchmark/`` (gRPC vs torch.rpc
+transfer benchmarks; the reference ships only pre-rendered plots). Here the
+comparison is the backends this framework actually ships — INMEMORY, GRPC
+(npz-framed), TRPC (tensor-native raw frames) — measured as ping-pong
+round-trip latency and one-way payload throughput between two in-process
+manager instances, so the numbers isolate serialization + transport cost
+from scheduling noise.
+
+Run: ``python -m fedml_tpu.core.distributed.communication.comm_bench``
+(prints one JSON line per backend × payload size).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from .message import Message
+
+PING, PONG = 101, 102
+
+
+def _mk_payload(nbytes: int) -> Dict[str, np.ndarray]:
+    n = max(1, nbytes // 4)
+    return {"w": np.arange(n, dtype=np.float32)}
+
+
+class _Echo:
+    """Observer that pongs every ping back through its manager."""
+
+    def __init__(self, manager, me: int, peer: int):
+        self.manager = manager
+        self.me, self.peer = me, peer
+
+    def receive_message(self, msg_type, msg):
+        if msg_type == PING:
+            reply = Message(PONG, self.me, self.peer)
+            reply.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, msg.get(Message.MSG_ARG_KEY_MODEL_PARAMS))
+            try:
+                self.manager.send_message(reply)
+            except Exception:
+                pass  # peer tearing down between reps; bench ignores late pongs
+
+
+class _Collector:
+    def __init__(self):
+        self.q: "queue.Queue" = queue.Queue()
+
+    def receive_message(self, msg_type, msg):
+        if msg_type == PONG:
+            self.q.put(msg)
+
+
+def _make_pair(backend: str, base_port: int):
+    """Two connected managers (rank0 -> rank1 echo) + teardown fn."""
+    if backend == "INMEMORY":
+        from .inmemory.broker import InMemoryBroker
+        from .inmemory.inmemory_comm_manager import InMemoryCommManager
+
+        InMemoryBroker.reset()
+        m0 = InMemoryCommManager("commbench", 0, 2)
+        m1 = InMemoryCommManager("commbench", 1, 2)
+    elif backend == "GRPC":
+        from .grpc.grpc_comm_manager import GRPCCommManager
+
+        m0 = GRPCCommManager(client_id=0, client_num=1, base_port=base_port)
+        m1 = GRPCCommManager(client_id=1, client_num=1, base_port=base_port)
+    elif backend == "TRPC":
+        from .trpc.trpc_comm_manager import TRPCCommManager
+
+        m0 = TRPCCommManager(client_id=0, client_num=1, base_port=base_port)
+        m1 = TRPCCommManager(client_id=1, client_num=1, base_port=base_port)
+    else:
+        raise ValueError(backend)
+
+    def teardown():
+        m0.stop_receive_message()
+        m1.stop_receive_message()
+
+    return m0, m1, teardown
+
+
+def bench_backend(backend: str, payload_bytes: int, reps: int = 20, base_port: int = 28600) -> Dict:
+    m0, m1, teardown = _make_pair(backend, base_port)
+    collector = _Collector()
+    m0.add_observer(collector)
+    m1.add_observer(_Echo(m1, 1, 0))
+    t0 = threading.Thread(target=m0.handle_receive_message, daemon=True)
+    t1 = threading.Thread(target=m1.handle_receive_message, daemon=True)
+    t0.start()
+    t1.start()
+    try:
+        payload = _mk_payload(payload_bytes)
+
+        def rt_once() -> float:
+            msg = Message(PING, 0, 1)
+            msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, payload)
+            t = time.perf_counter()
+            m0.send_message(msg)
+            back = collector.q.get(timeout=60)
+            dt = time.perf_counter() - t
+            got = back.get(Message.MSG_ARG_KEY_MODEL_PARAMS)["w"]
+            assert got.nbytes == payload["w"].nbytes, "payload corrupted in flight"
+            return dt
+
+        rt_once()  # warmup (connection setup, first-path costs)
+        times = sorted(rt_once() for _ in range(reps))
+        median = times[len(times) // 2]
+        return {
+            "backend": backend,
+            "payload_mb": round(payload_bytes / 1e6, 3),
+            "rtt_ms_median": round(median * 1e3, 3),
+            "rtt_ms_min_max": [round(times[0] * 1e3, 3), round(times[-1] * 1e3, 3)],
+            # ping + pong both carry the payload -> 2x payload per RTT
+            "mb_per_sec": round(2 * payload_bytes / median / 1e6, 1),
+        }
+    finally:
+        teardown()
+        t0.join(timeout=5)
+        t1.join(timeout=5)
+
+
+def main(backends: List[str] | None = None, sizes: List[int] | None = None) -> List[Dict]:
+    out = []
+    port = 28600
+    for backend in backends or ["INMEMORY", "GRPC", "TRPC"]:
+        for size in sizes or [1_000, 1_000_000, 16_000_000]:
+            port += 10  # fresh ports: RTT measurement must not reuse half-torn sockets
+            res = bench_backend(backend, size, base_port=port)
+            print(json.dumps(res))
+            out.append(res)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,133 @@
+"""Mixture-of-Experts layer with expert parallelism (``ep`` mesh axis).
+
+Beyond-reference capability (SURVEY §2.a lists expert parallelism absent in
+the reference). Switch-Transformer-style top-1 routing implemented the
+MXU-friendly way: fixed expert capacity C and DENSE dispatch/combine
+einsums (no scatter/gather, no dynamic shapes — everything tiles onto the
+systolic array and stays jit-compatible).
+
+Expert parallelism is expressed through GSPMD, not hand-written
+collectives: expert weights carry a leading expert dim sharded
+``P('ep')`` and the dispatched activations are constrained to
+``P('ep', ...)``, so under jit on a mesh with an ``ep`` axis XLA inserts
+the all-to-all between the token-sharded and expert-sharded layouts.
+
+Load balancing: the Switch aux loss E * sum_e(fraction_e * prob_e), scaled
+by ``aux_loss_weight`` and returned alongside the output; trainers add the
+sown values to the task loss directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.lax import with_sharding_constraint as _wsc
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    d_model: int = 512
+    d_ff: int = 1376
+    aux_loss_weight: float = 0.01
+    dtype: Any = jnp.bfloat16
+    ep_axis: Optional[str] = None  # None = no sharding constraint (single host)
+
+
+def _maybe_constrain(x: jnp.ndarray, spec: P, enabled: bool) -> jnp.ndarray:
+    if not enabled:
+        return x
+    try:
+        return _wsc(x, spec)
+    except (ValueError, RuntimeError):
+        # no mesh in scope (e.g. model.init outside the mesh context):
+        # the constraint is advisory, skip it
+        return x
+
+
+def moe_dispatch(router_logits: jnp.ndarray, capacity: int) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(dispatch [N,E,C], combine [N,E,C], aux_loss) from router logits [N,E].
+
+    Top-1 routing with per-expert capacity; overflowing tokens are dropped
+    (their combine weight is 0 -> they pass through the residual only),
+    matching Switch Transformer semantics."""
+    N, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [N]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]  # [N]
+
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [N,E]
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [N,E], value at (n,e)=rank
+    pos_in_expert = jnp.sum(pos, axis=-1)  # [N]
+    keep = pos_in_expert < capacity
+
+    dispatch = (
+        onehot[:, :, None]
+        * keep[:, None, None]
+        * jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)[:, None, :]
+    )  # [N,E,C]
+    combine = dispatch * gate[:, None, None]
+
+    # Switch aux loss: E * sum_e mean_n(onehot) * mean_n(probs)
+    fraction = jnp.mean(onehot, axis=0)
+    prob_mean = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(fraction * prob_mean)
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for the dense SwiGLU MLP."""
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        cfg = self.cfg
+        E, D, F = cfg.n_experts, cfg.d_model, cfg.d_ff
+        orig_shape = x.shape
+        tokens = x.reshape(-1, D)  # [N, D]
+        N = tokens.shape[0]
+        capacity = max(1, int(N / E * cfg.capacity_factor))
+
+        router = self.param("router", nn.initializers.lecun_normal(), (D, E), jnp.float32)
+        w_gate = self.param("w_gate", nn.initializers.lecun_normal(), (E, D, F), jnp.float32)
+        w_up = self.param("w_up", nn.initializers.lecun_normal(), (E, D, F), jnp.float32)
+        w_down = self.param("w_down", nn.initializers.lecun_normal(), (E, F, D), jnp.float32)
+
+        ep = cfg.ep_axis is not None
+        ax = cfg.ep_axis
+
+        logits = tokens.astype(jnp.float32) @ router  # [N, E]
+        dispatch, combine, aux = moe_dispatch(logits, capacity)
+
+        # [N,E,C] x [N,D] -> [E,C,D]; GSPMD turns the E-dim constraint into
+        # the token->expert all-to-all over ICI
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(cfg.dtype), tokens.astype(cfg.dtype))
+        expert_in = _maybe_constrain(expert_in, P(ax, None, None), ep)
+
+        def ffn(w_g, w_u, w_d, h):
+            return (nn.silu(h @ w_g.astype(cfg.dtype)) * (h @ w_u.astype(cfg.dtype))) @ w_d.astype(cfg.dtype)
+
+        expert_out = jax.vmap(ffn)(w_gate, w_up, w_down, expert_in)  # [E,C,D]
+        expert_out = _maybe_constrain(expert_out, P(ax, None, None), ep)
+
+        out = jnp.einsum("nec,ecd->nd", combine.astype(cfg.dtype), expert_out)
+        # pre-weighted: trainers add the sown aux losses to the task loss as-is
+        return out.reshape(orig_shape), (cfg.aux_loss_weight * aux).astype(jnp.float32)
+
+
+def moe_param_spec(ep_axis: str = "ep") -> dict:
+    """PartitionSpec rules for MoE params (merge into the fsdp rule table)."""
+    return {
+        "router": P(),
+        "w_gate": P(ep_axis, None, None),
+        "w_up": P(ep_axis, None, None),
+        "w_down": P(ep_axis, None, None),
+    }

@@ -41,6 +41,9 @@ class TransformerConfig:
     lora_rank: int = 0           # 0 = no adapters
     lora_alpha: float = 16.0
     lora_targets: Tuple[str, ...] = ("q_proj", "k_proj", "v_proj", "o_proj")
+    moe_experts: int = 0         # 0 = dense MLP; >0 = Switch-style MoE MLP
+    moe_capacity_factor: float = 1.25
+    moe_ep_axis: Any = None      # mesh axis name for expert parallelism
 
     @property
     def head_dim(self) -> int:
@@ -181,8 +184,26 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
-        x = x + Attention(self.cfg, name="attn")(RMSNorm(name="attn_norm")(x), positions)
-        x = x + MLP(self.cfg, name="mlp")(RMSNorm(name="mlp_norm")(x))
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(RMSNorm(name="attn_norm")(x), positions)
+        h = RMSNorm(name="mlp_norm")(x)
+        if cfg.moe_experts > 0:
+            from .moe import MoEConfig, MoEMLP
+
+            moe_cfg = MoEConfig(
+                n_experts=cfg.moe_experts,
+                capacity_factor=cfg.moe_capacity_factor,
+                d_model=cfg.d_model,
+                d_ff=cfg.d_ff,
+                dtype=cfg.dtype,
+                ep_axis=cfg.moe_ep_axis,
+            )
+            y, aux = MoEMLP(moe_cfg, name="moe_mlp")(h)
+            # visible via apply(..., mutable=["losses"]); no-op otherwise
+            self.sow("losses", "moe_aux", aux)
+            x = x + y
+        else:
+            x = x + MLP(cfg, name="mlp")(h)
         return x
 
 

@@ -1,0 +1,97 @@
+"""MoE + expert parallelism: dense-dispatch numerics and ep sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fedml_tpu.models.moe import MoEConfig, MoEMLP, moe_dispatch
+from fedml_tpu.parallel.mesh import create_mesh
+
+E, D, F, B, T = 4, 16, 32, 4, 8
+
+
+def _init(cfg, key):
+    model = MoEMLP(cfg)
+    x = jax.random.normal(key, (B, T, D), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    return model, params, x
+
+
+def test_moe_matches_direct_expert_selection():
+    # capacity ample -> no token drops -> output must equal routing each
+    # token through its argmax expert directly
+    cfg = MoEConfig(n_experts=E, capacity_factor=float(E), d_model=D, d_ff=F, dtype=jnp.float32)
+    model, params, x = _init(cfg, jax.random.PRNGKey(3))
+    out, aux = model.apply({"params": params}, x)
+
+    tokens = x.reshape(-1, D)
+    logits = tokens @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    def one(tok, e, g):
+        h = tok[None, :]
+        y = (jax.nn.silu(h @ params["w_gate"][e]) * (h @ params["w_up"][e])) @ params["w_down"][e]
+        return (g * y)[0]
+
+    direct = jax.vmap(one)(tokens, expert, gate).reshape(out.shape)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(direct), rtol=1e-4, atol=1e-5)
+    assert float(aux) > 0
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    # capacity 1 with N=32 tokens: most tokens dropped -> output rows are 0
+    cfg = MoEConfig(n_experts=E, capacity_factor=E / (B * T), d_model=D, d_ff=F, dtype=jnp.float32)
+    model, params, x = _init(cfg, jax.random.PRNGKey(4))
+    out, _ = model.apply({"params": params}, x)
+    flat = np.asarray(out.reshape(-1, D))
+    zero_rows = np.sum(np.all(np.abs(flat) < 1e-9, axis=-1))
+    assert zero_rows >= B * T - E * 1  # at most E tokens (capacity 1 each) kept
+
+
+def test_moe_ep_sharded_matches_unsharded():
+    cfg = MoEConfig(n_experts=8, capacity_factor=8.0, d_model=D, d_ff=F, dtype=jnp.float32)
+    model, params, x = _init(cfg, jax.random.PRNGKey(5))
+    ref, _ = model.apply({"params": params}, x)
+
+    mesh = create_mesh((8,), ("ep",))
+    cfg_ep = MoEConfig(n_experts=8, capacity_factor=8.0, d_model=D, d_ff=F, dtype=jnp.float32, ep_axis="ep")
+    model_ep = MoEMLP(cfg_ep)
+    shardings = {
+        "router": NamedSharding(mesh, P()),
+        "w_gate": NamedSharding(mesh, P("ep")),
+        "w_up": NamedSharding(mesh, P("ep")),
+        "w_down": NamedSharding(mesh, P("ep")),
+    }
+    params_ep = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+
+    @jax.jit
+    def fwd(p, x):
+        return model_ep.apply({"params": p}, x)
+
+    with mesh:
+        out, aux = fwd(params_ep, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    # grads flow through dispatch/combine and the sharded experts
+    @jax.jit
+    def loss(p, x):
+        y, aux = model_ep.apply({"params": p}, x)
+        return jnp.sum(y**2) + aux  # aux is pre-weighted
+
+    with mesh:
+        g = jax.grad(loss)(params_ep, x)
+    for leaf in jax.tree.leaves(g):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_aux_loss_uniform_router_is_one():
+    # perfectly uniform probs with balanced assignment -> aux == 1
+    N = 64
+    logits = jnp.zeros((N, E))
+    _, _, aux = moe_dispatch(logits, capacity=N)
+    # argmax of uniform logits is expert 0 for every token: fraction=(1,0,0,0),
+    # probs uniform -> aux = E * (1*1/E) = 1
+    np.testing.assert_allclose(float(aux), 1.0, rtol=1e-6)
