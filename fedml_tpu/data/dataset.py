@@ -58,3 +58,51 @@ class ArrayDataset:
 
     def subset(self, indices: np.ndarray) -> "ArrayDataset":
         return ArrayDataset(self.x[indices], self.y[indices])
+
+    # --- native data plane (shards too big to device_put whole) ----------
+    def save_shards(self, prefix: str) -> Tuple[str, str]:
+        """Write (x, y) as mmap-able binary shards for the C++ prefetcher
+        (native/dataplane). Use for datasets streamed from disk rather than
+        held resident; small shards should stay on the lax.scan path."""
+        from .native_loader import write_shard
+
+        xp, yp = f"{prefix}.x.fdlp", f"{prefix}.y.fdlp"
+        write_shard(xp, self.x)
+        write_shard(yp, self.y)
+        return xp, yp
+
+    @staticmethod
+    def stream(paths: Tuple[str, str], batch_size: int, *, seed: int = 0,
+               epochs: Optional[int] = None) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Shuffled (x, y) batches gathered by the background C++ thread;
+        falls back to numpy memmap gather when no toolchain exists."""
+        from .native_loader import NativeBatchLoader, shard_info
+
+        if NativeBatchLoader.available():
+            loader = NativeBatchLoader(list(paths), batch_size, seed=seed)
+            try:
+                e = 0
+                while epochs is None or e < epochs:
+                    for bx, by in loader.epoch():
+                        yield bx, by
+                    e += 1
+            finally:
+                loader.close()
+            return
+        # fallback: memmap + numpy gather (same format, no prefetch overlap)
+        specs = [shard_info(p) for p in paths]
+        maps = [
+            np.memmap(p, dtype=dt, mode="r", shape=dims, offset=16 + 8 * len(dims))
+            for p, (dt, dims) in zip(paths, specs)
+        ]
+        n = specs[0][1][0]
+        if batch_size > n:
+            raise ValueError(f"batch size {batch_size} > {n} samples")  # native path raises too
+        rng = np.random.default_rng(seed)
+        e = 0
+        while epochs is None or e < epochs:
+            idx = rng.permutation(n)
+            for s in range(0, n - n % batch_size, batch_size):
+                sel = idx[s : s + batch_size]
+                yield maps[0][sel], maps[1][sel]
+            e += 1

@@ -121,13 +121,5 @@ class MoEMLP(nn.Module):
         out = jnp.einsum("nec,ecd->nd", combine.astype(cfg.dtype), expert_out)
         # pre-weighted: trainers add the sown aux losses to the task loss as-is
         return out.reshape(orig_shape), (cfg.aux_loss_weight * aux).astype(jnp.float32)
-
-
-def moe_param_spec(ep_axis: str = "ep") -> dict:
-    """PartitionSpec rules for MoE params (merge into the fsdp rule table)."""
-    return {
-        "router": P(),
-        "w_gate": P(ep_axis, None, None),
-        "w_up": P(ep_axis, None, None),
-        "w_down": P(ep_axis, None, None),
-    }
+# sharding rules for these params live in parallel/fsdp.py DEFAULT_RULES
+# (moe_mlp/w_* entries) — single source of truth

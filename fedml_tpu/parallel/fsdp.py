@@ -29,6 +29,11 @@ DEFAULT_RULES: Sequence[Tuple[str, P]] = (
     (r"lm_head/kernel$", P("fsdp", "tp")),
     (r"lora_a$", P("fsdp", None)),
     (r"lora_b$", P(None, "tp")),
+    # MoE expert weights [E, D, F] / [E, F, D]: experts over 'ep', the
+    # per-expert matrices over fsdp/tp as usual (axes the mesh lacks drop)
+    (r"moe_mlp/(w_gate|w_up)$", P("ep", "fsdp", "tp")),
+    (r"moe_mlp/w_down$", P("ep", "tp", "fsdp")),
+    (r"moe_mlp/router$", P()),
     (r"(scale|bias)$", P()),
     (r".*", P()),
 )
@@ -100,8 +105,10 @@ def make_fsdp_train_step(
     `rules`. Returns (train_step, init_fn)."""
 
     def loss_fn(params, tokens, mask):
-        logits = model_apply(params, tokens)
-        return causal_lm_loss(logits, tokens, mask)
+        out = model_apply(params, tokens)
+        # MoE models return (logits, pre-weighted aux load-balancing loss)
+        logits, aux = out if isinstance(out, tuple) else (out, 0.0)
+        return causal_lm_loss(logits, tokens, mask) + aux
 
     def step(params, opt_state, tokens, mask):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, mask)

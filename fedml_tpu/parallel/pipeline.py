@@ -100,6 +100,12 @@ def pipeline_loss_fn(
     )
     def loss_fn(params, tokens, targets):
         embed_params, stage_params, head_params = params
+        for leaf in jax.tree.leaves(stage_params):
+            if leaf.shape[0] != 1:
+                raise ValueError(
+                    f"stage count {leaf.shape[0] * S} != mesh '{pp_axis}' size {S}; "
+                    "split_blocks_into_stages must use the mesh's pp size"
+                )
         stage_params = jax.tree.map(lambda x: x[0], stage_params)  # [1,Ls,...] -> [Ls,...]
         stage_id = jax.lax.axis_index(pp_axis)
 
@@ -113,7 +119,9 @@ def pipeline_loss_fn(
         # only stage 0 consumes it — masked injection below keeps SPMD flow
         h_in = embed_fn(embed_params, tok_mb)  # [M, mb, T, D]
         state = jnp.zeros_like(h_in[0])
-        loss_acc = jnp.zeros((), h_in.dtype)
+        # f32 carry regardless of activation dtype (bf16 activations with an
+        # f32 loss would otherwise change the scan carry dtype mid-trace)
+        loss_acc = jnp.zeros((), jnp.float32)
 
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
 
@@ -127,7 +135,7 @@ def pipeline_loss_fn(
             out_idx = jnp.maximum(t - (S - 1), 0)
             mb_loss = head_loss_fn(head_params, state, tgt_mb[jnp.minimum(out_idx, M - 1)])
             take = jnp.logical_and(stage_id == S - 1, t >= S - 1)
-            loss_acc = loss_acc + jnp.where(take, mb_loss, 0.0)
+            loss_acc = loss_acc + jnp.where(take, mb_loss.astype(jnp.float32), 0.0)
             state = jax.lax.ppermute(state, pp_axis, fwd_perm)
             return (state, loss_acc), None
 

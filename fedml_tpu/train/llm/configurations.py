@@ -31,6 +31,8 @@ class ModelArguments:
     lora_rank: int = 8
     lora_alpha: float = 16.0
     remat: bool = True
+    moe_experts: int = 0               # 0 = dense MLP; >0 = Switch MoE
+    moe_capacity_factor: float = 1.25
 
     @classmethod
     def from_args(cls, args: Any) -> "ModelArguments":
@@ -89,6 +91,7 @@ class ExperimentArguments:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
+    ep: int = 1                         # expert parallelism (MoE models)
 
     @classmethod
     def from_args(cls, args: Any) -> "ExperimentArguments":
@@ -99,7 +102,10 @@ class ExperimentArguments:
 
     def mesh_shape(self) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
         axes, names = [], []
-        for n, name in ((self.dp, "dp"), (self.fsdp, "fsdp"), (self.tp, "tp"), (self.sp, "sp")):
+        for n, name in (
+            (self.dp, "dp"), (self.fsdp, "fsdp"), (self.tp, "tp"),
+            (self.sp, "sp"), (self.ep, "ep"),
+        ):
             if n > 1 or name in ("dp", "fsdp"):
                 axes.append(n)
                 names.append(name)

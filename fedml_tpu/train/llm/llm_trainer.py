@@ -84,6 +84,9 @@ class LLMTrainer:
             lora_rank=model_args.lora_rank,
             lora_alpha=model_args.lora_alpha,
             remat=model_args.remat,
+            moe_experts=model_args.moe_experts,
+            moe_capacity_factor=model_args.moe_capacity_factor,
+            moe_ep_axis="ep" if exp_args.ep > 1 else None,
         )
         self.model = TransformerLM(self.cfg)
         axes, names = exp_args.mesh_shape()
@@ -126,9 +129,16 @@ class LLMTrainer:
             labels = jax.tree.map(lambda m: "train" if m else "freeze", lora_mask(params))
             tx = optax.multi_transform({"train": self._full_tx, "freeze": optax.set_to_zero()}, labels)
 
-        def apply_fn(p, tokens):
-            with active_mesh(self.mesh):
-                return self.model.apply({"params": p}, tokens)
+        if self.cfg.moe_experts > 0:
+            def apply_fn(p, tokens):
+                with active_mesh(self.mesh):
+                    logits, state = self.model.apply({"params": p}, tokens, mutable=["losses"])
+                aux = sum(jnp.sum(a) for a in jax.tree.leaves(state["losses"]))
+                return logits, aux  # aux pre-weighted by MoEConfig.aux_loss_weight
+        else:
+            def apply_fn(p, tokens):
+                with active_mesh(self.mesh):
+                    return self.model.apply({"params": p}, tokens)
 
         seq_axis = "sp" if "sp" in self.mesh.axis_names else None
         batch_axes = tuple(a for a in ("dp", "fsdp") if a in self.mesh.axis_names)

@@ -1,0 +1,67 @@
+// Double-buffered batch prefetcher over parallel shards.
+//
+// N shards with equal n_samples (e.g. images + labels) are batched with one
+// shared shuffled permutation per epoch. A background thread gathers the
+// next batches into a ring of preassembled buffers while the consumer
+// (Python / the trainer) processes the current one — IO and gather overlap
+// with device compute, the classic input-pipeline shape tf.data/grain
+// provide and the reference's torch DataLoader workers approximate.
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "fedml_dataplane/shard.h"
+
+namespace fedml_dataplane {
+
+class Prefetcher {
+ public:
+  Prefetcher(std::vector<std::shared_ptr<Shard>> shards, uint64_t batch,
+             uint64_t seed, int slots = 3, bool drop_last = true);
+  ~Prefetcher();
+
+  // Copy the next ready batch into outs[k] (caller-allocated, batch *
+  // sample_bytes(k) each). Returns false at end of epoch; the next call
+  // starts the next epoch with a fresh permutation.
+  bool next(void** outs);
+
+  uint64_t batches_per_epoch() const { return batches_per_epoch_; }
+  uint64_t batch() const { return batch_; }
+  size_t n_arrays() const { return shards_.size(); }
+  size_t batch_bytes(size_t k) const { return batch_ * shards_[k]->sample_bytes(); }
+
+ private:
+  struct Slot {
+    std::vector<std::vector<uint8_t>> bufs;  // one per shard
+    bool ready = false;
+    bool epoch_end = false;
+  };
+
+  void worker();
+  void fill_slot(Slot& slot, uint64_t batch_idx);
+  void reshuffle(uint64_t epoch);
+
+  std::vector<std::shared_ptr<Shard>> shards_;
+  uint64_t batch_;
+  uint64_t seed_;
+  uint64_t n_;
+  uint64_t batches_per_epoch_;
+  std::vector<uint64_t> perm_;
+
+  std::vector<Slot> ring_;
+  size_t head_ = 0;  // consumer position
+  size_t tail_ = 0;  // producer position
+  uint64_t produced_ = 0;  // batch index within epoch (producer side)
+  uint64_t epoch_ = 0;
+  bool stop_ = false;
+  std::mutex mu_;
+  std::condition_variable cv_producer_, cv_consumer_;
+  std::thread thread_;
+};
+
+}  // namespace fedml_dataplane

@@ -1,0 +1,57 @@
+// Binary sample-shard format + mmap reader.
+//
+// TPU-native counterpart of the reference's Python data loaders
+// (python/fedml/data/data_loader.py): the hot path of host-side input
+// pipelines is gather + copy, which Python does per-batch with the GIL
+// held. Here shards are mmap'd (zero read syscalls after open) and batch
+// gather runs in C++ worker threads (prefetcher.h).
+//
+// Layout (little-endian):
+//   magic   "FDLP"                u8[4]
+//   version u32 (=1)
+//   dtype   u32 (1=f32, 2=i32, 3=u8, 4=i64)
+//   ndim    u32   (includes the leading sample dim)
+//   dims    u64[ndim]
+//   data    raw row-major payload
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace fedml_dataplane {
+
+enum class DType : uint32_t { f32 = 1, i32 = 2, u8 = 3, i64 = 4 };
+
+size_t dtype_size(DType d);
+
+class Shard {
+ public:
+  // mmap the file; throws std::runtime_error on format errors.
+  explicit Shard(const std::string& path);
+  ~Shard();
+  Shard(const Shard&) = delete;
+  Shard& operator=(const Shard&) = delete;
+
+  uint64_t n_samples() const { return dims_.empty() ? 0 : dims_[0]; }
+  size_t sample_bytes() const { return sample_bytes_; }
+  const std::vector<uint64_t>& dims() const { return dims_; }
+  DType dtype() const { return dtype_; }
+
+  // pointer to sample i's bytes (mmap'd, read-only)
+  const uint8_t* sample(uint64_t i) const { return data_ + i * sample_bytes_; }
+
+  static void write(const std::string& path, DType dtype,
+                    const std::vector<uint64_t>& dims, const void* data);
+
+ private:
+  int fd_ = -1;
+  const uint8_t* base_ = nullptr;  // whole mapping
+  const uint8_t* data_ = nullptr;  // payload start
+  size_t map_len_ = 0;
+  size_t sample_bytes_ = 0;
+  DType dtype_ = DType::f32;
+  std::vector<uint64_t> dims_;
+};
+
+}  // namespace fedml_dataplane

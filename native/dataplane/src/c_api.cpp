@@ -1,0 +1,90 @@
+// C ABI for the data plane (ctypes bridge; same pattern as the edge
+// engine's c_api.cpp — no pybind11 in this image).
+#include <cstring>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "fedml_dataplane/prefetcher.h"
+#include "fedml_dataplane/shard.h"
+
+using fedml_dataplane::DType;
+using fedml_dataplane::Prefetcher;
+using fedml_dataplane::Shard;
+
+namespace {
+thread_local std::string g_error;
+
+struct PrefetcherHandle {
+  std::vector<std::shared_ptr<Shard>> shards;
+  std::unique_ptr<Prefetcher> pf;
+};
+
+template <typename F>
+int guarded(F&& f) {
+  try {
+    f();
+    return 0;
+  } catch (const std::exception& e) {
+    g_error = e.what();
+    return -1;
+  }
+}
+}  // namespace
+
+extern "C" {
+
+const char* fdlp_last_error() { return g_error.c_str(); }
+
+int fdlp_write_shard(const char* path, uint32_t dtype, uint32_t ndim,
+                     const uint64_t* dims, const void* data) {
+  return guarded([&] {
+    Shard::write(path, static_cast<DType>(dtype),
+                 std::vector<uint64_t>(dims, dims + ndim), data);
+  });
+}
+
+// Returns ndim and fills dims (caller provides space for >=8), or -1.
+int fdlp_shard_info(const char* path, uint32_t* dtype, uint64_t* dims) {
+  int ndim = -1;
+  int rc = guarded([&] {
+    Shard s(path);
+    *dtype = static_cast<uint32_t>(s.dtype());
+    ndim = static_cast<int>(s.dims().size());
+    for (size_t i = 0; i < s.dims().size(); ++i) dims[i] = s.dims()[i];
+  });
+  return rc == 0 ? ndim : -1;
+}
+
+void* fdlp_prefetcher_create(const char** paths, uint32_t n_arrays,
+                             uint64_t batch, uint64_t seed, int slots) {
+  PrefetcherHandle* h = nullptr;
+  int rc = guarded([&] {
+    auto holder = std::make_unique<PrefetcherHandle>();
+    for (uint32_t i = 0; i < n_arrays; ++i)
+      holder->shards.push_back(std::make_shared<Shard>(paths[i]));
+    holder->pf = std::make_unique<Prefetcher>(holder->shards, batch, seed, slots);
+    h = holder.release();
+  });
+  return rc == 0 ? h : nullptr;
+}
+
+uint64_t fdlp_batches_per_epoch(void* handle) {
+  return static_cast<PrefetcherHandle*>(handle)->pf->batches_per_epoch();
+}
+
+// Copies the next batch into outs[k]; returns 1 mid-epoch, 0 at epoch end,
+// -1 on error.
+int fdlp_prefetcher_next(void* handle, void** outs) {
+  int more = -1;
+  int rc = guarded([&] {
+    more = static_cast<PrefetcherHandle*>(handle)->pf->next(outs) ? 1 : 0;
+  });
+  return rc == 0 ? more : -1;
+}
+
+void fdlp_prefetcher_destroy(void* handle) {
+  delete static_cast<PrefetcherHandle*>(handle);
+}
+
+}  // extern "C"

@@ -1,0 +1,118 @@
+#include "fedml_dataplane/prefetcher.h"
+
+#include <cstring>
+#include <stdexcept>
+
+namespace fedml_dataplane {
+
+namespace {
+// splitmix64: deterministic, seedable, good enough for shuffling
+uint64_t splitmix64(uint64_t& s) {
+  uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+}  // namespace
+
+Prefetcher::Prefetcher(std::vector<std::shared_ptr<Shard>> shards,
+                       uint64_t batch, uint64_t seed, int slots,
+                       bool drop_last)
+    : shards_(std::move(shards)), batch_(batch), seed_(seed) {
+  if (shards_.empty()) throw std::runtime_error("prefetcher needs >=1 shard");
+  n_ = shards_[0]->n_samples();
+  for (auto& s : shards_)
+    if (s->n_samples() != n_)
+      throw std::runtime_error("parallel shards disagree on n_samples");
+  if (batch_ == 0 || batch_ > n_) throw std::runtime_error("bad batch size");
+  batches_per_epoch_ = drop_last ? n_ / batch_ : (n_ + batch_ - 1) / batch_;
+  if (!drop_last && n_ % batch_ != 0)
+    throw std::runtime_error("drop_last=false with ragged tail unsupported");
+
+  perm_.resize(n_);
+  reshuffle(0);
+
+  ring_.resize(slots);
+  for (auto& slot : ring_) {
+    slot.bufs.resize(shards_.size());
+    for (size_t k = 0; k < shards_.size(); ++k)
+      slot.bufs[k].resize(batch_ * shards_[k]->sample_bytes());
+  }
+  thread_ = std::thread(&Prefetcher::worker, this);
+}
+
+Prefetcher::~Prefetcher() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    stop_ = true;
+  }
+  cv_producer_.notify_all();
+  cv_consumer_.notify_all();
+  if (thread_.joinable()) thread_.join();
+}
+
+void Prefetcher::reshuffle(uint64_t epoch) {
+  for (uint64_t i = 0; i < n_; ++i) perm_[i] = i;
+  uint64_t s = seed_ ^ (0xa5a5a5a5ULL + epoch * 0x9e3779b9ULL);
+  for (uint64_t i = n_ - 1; i > 0; --i) {
+    uint64_t j = splitmix64(s) % (i + 1);
+    std::swap(perm_[i], perm_[j]);
+  }
+}
+
+void Prefetcher::fill_slot(Slot& slot, uint64_t batch_idx) {
+  uint64_t start = batch_idx * batch_;
+  for (size_t k = 0; k < shards_.size(); ++k) {
+    const auto& sh = *shards_[k];
+    size_t sb = sh.sample_bytes();
+    uint8_t* dst = slot.bufs[k].data();
+    for (uint64_t b = 0; b < batch_; ++b)
+      std::memcpy(dst + b * sb, sh.sample(perm_[start + b]), sb);
+  }
+}
+
+void Prefetcher::worker() {
+  for (;;) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_producer_.wait(lk, [&] { return stop_ || !ring_[tail_].ready; });
+    if (stop_) return;
+    Slot& slot = ring_[tail_];
+    uint64_t idx = produced_;
+    bool epoch_end = idx + 1 >= batches_per_epoch_;
+    lk.unlock();
+
+    fill_slot(slot, idx);  // gather outside the lock
+
+    lk.lock();
+    slot.ready = true;
+    slot.epoch_end = epoch_end;
+    tail_ = (tail_ + 1) % ring_.size();
+    if (epoch_end) {
+      produced_ = 0;
+      ++epoch_;
+      reshuffle(epoch_);
+    } else {
+      ++produced_;
+    }
+    lk.unlock();
+    cv_consumer_.notify_one();
+  }
+}
+
+bool Prefetcher::next(void** outs) {
+  std::unique_lock<std::mutex> lk(mu_);
+  cv_consumer_.wait(lk, [&] { return stop_ || ring_[head_].ready; });
+  if (stop_) return false;
+  Slot& slot = ring_[head_];
+  for (size_t k = 0; k < shards_.size(); ++k)
+    std::memcpy(outs[k], slot.bufs[k].data(), slot.bufs[k].size());
+  bool epoch_end = slot.epoch_end;
+  slot.ready = false;
+  slot.epoch_end = false;
+  head_ = (head_ + 1) % ring_.size();
+  lk.unlock();
+  cv_producer_.notify_one();
+  return !epoch_end;
+}
+
+}  // namespace fedml_dataplane

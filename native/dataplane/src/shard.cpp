@@ -1,0 +1,92 @@
+#include "fedml_dataplane/shard.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <stdexcept>
+
+namespace fedml_dataplane {
+
+namespace {
+constexpr char kMagic[4] = {'F', 'D', 'L', 'P'};
+constexpr uint32_t kVersion = 1;
+}  // namespace
+
+size_t dtype_size(DType d) {
+  switch (d) {
+    case DType::f32:
+    case DType::i32:
+      return 4;
+    case DType::u8:
+      return 1;
+    case DType::i64:
+      return 8;
+  }
+  throw std::runtime_error("bad dtype");
+}
+
+Shard::Shard(const std::string& path) {
+  fd_ = ::open(path.c_str(), O_RDONLY);
+  if (fd_ < 0) throw std::runtime_error("shard open failed: " + path);
+  struct stat st;
+  if (fstat(fd_, &st) != 0) {
+    ::close(fd_);
+    throw std::runtime_error("shard stat failed: " + path);
+  }
+  map_len_ = static_cast<size_t>(st.st_size);
+  void* m = mmap(nullptr, map_len_, PROT_READ, MAP_PRIVATE, fd_, 0);
+  if (m == MAP_FAILED) {
+    ::close(fd_);
+    throw std::runtime_error("shard mmap failed: " + path);
+  }
+  base_ = static_cast<const uint8_t*>(m);
+
+  const uint8_t* p = base_;
+  if (map_len_ < 16 || std::memcmp(p, kMagic, 4) != 0)
+    throw std::runtime_error("bad shard magic: " + path);
+  p += 4;
+  uint32_t version, dtype, ndim;
+  std::memcpy(&version, p, 4); p += 4;
+  std::memcpy(&dtype, p, 4); p += 4;
+  std::memcpy(&ndim, p, 4); p += 4;
+  if (version != kVersion) throw std::runtime_error("bad shard version");
+  if (ndim == 0 || ndim > 8) throw std::runtime_error("bad shard ndim");
+  if (map_len_ < 16 + size_t(ndim) * 8) throw std::runtime_error("truncated shard header");
+  dims_.resize(ndim);
+  std::memcpy(dims_.data(), p, size_t(ndim) * 8);
+  p += size_t(ndim) * 8;
+  dtype_ = static_cast<DType>(dtype);
+
+  sample_bytes_ = dtype_size(dtype_);
+  for (uint32_t i = 1; i < ndim; ++i) sample_bytes_ *= dims_[i];
+  data_ = p;
+  size_t expect = size_t(p - base_) + n_samples() * sample_bytes_;
+  if (map_len_ < expect) throw std::runtime_error("truncated shard payload");
+}
+
+Shard::~Shard() {
+  if (base_) munmap(const_cast<uint8_t*>(base_), map_len_);
+  if (fd_ >= 0) ::close(fd_);
+}
+
+void Shard::write(const std::string& path, DType dtype,
+                  const std::vector<uint64_t>& dims, const void* data) {
+  FILE* f = fopen(path.c_str(), "wb");
+  if (!f) throw std::runtime_error("shard write open failed: " + path);
+  uint32_t version = kVersion, dt = static_cast<uint32_t>(dtype),
+           ndim = static_cast<uint32_t>(dims.size());
+  size_t total = dtype_size(dtype);
+  for (auto d : dims) total *= d;
+  bool ok = fwrite(kMagic, 1, 4, f) == 4 && fwrite(&version, 4, 1, f) == 1 &&
+            fwrite(&dt, 4, 1, f) == 1 && fwrite(&ndim, 4, 1, f) == 1 &&
+            fwrite(dims.data(), 8, dims.size(), f) == dims.size() &&
+            (total == 0 || fwrite(data, 1, total, f) == total);
+  fclose(f);
+  if (!ok) throw std::runtime_error("shard write failed: " + path);
+}
+
+}  // namespace fedml_dataplane
