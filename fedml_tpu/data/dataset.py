@@ -96,6 +96,10 @@ class ArrayDataset:
             for p, (dt, dims) in zip(paths, specs)
         ]
         n = specs[0][1][0]
+        if any(dims[0] != n for _, dims in specs):
+            raise ValueError(  # native path rejects this too
+                f"parallel shards disagree on n_samples: {[d[0] for _, d in specs]}"
+            )
         if batch_size > n:
             raise ValueError(f"batch size {batch_size} > {n} samples")  # native path raises too
         rng = np.random.default_rng(seed)

@@ -44,15 +44,20 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _build_error is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            proc = subprocess.run(
-                ["make", "-C", _DP_DIR], capture_output=True, text=True
-            )
-            if proc.returncode != 0:
-                _build_error = proc.stderr[-2000:]
-                log.warning("native dataplane build failed; python fallback only")
-                return None
-        lib = ctypes.CDLL(_LIB_PATH)
+        try:
+            if not os.path.exists(_LIB_PATH):
+                proc = subprocess.run(
+                    ["make", "-C", _DP_DIR], capture_output=True, text=True
+                )
+                if proc.returncode != 0:
+                    _build_error = proc.stderr[-2000:]
+                    log.warning("native dataplane build failed; python fallback only")
+                    return None
+            lib = ctypes.CDLL(_LIB_PATH)
+        except Exception as e:  # no make on PATH, stale/partial .so, ...
+            _build_error = f"{type(e).__name__}: {e}"
+            log.warning("native dataplane unavailable (%s); python fallback only", _build_error)
+            return None
         lib.fdlp_last_error.restype = ctypes.c_char_p
         lib.fdlp_write_shard.argtypes = [
             ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32,

@@ -85,7 +85,10 @@ def rotary_embedding(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jn
     sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = x[..., ::2], x[..., 1::2]
     out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
-    return out.reshape(x.shape)
+    # rotation math in f32, activations back to the input dtype — without
+    # this, f32 cos/sin silently promote q/k (and everything downstream of
+    # attention) to f32, doubling MXU time and activation bytes on TPU
+    return out.reshape(x.shape).astype(x.dtype)
 
 
 class RMSNorm(nn.Module):

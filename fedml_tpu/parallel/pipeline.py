@@ -56,7 +56,9 @@ def _stage_apply(block_fn: Callable, stage_params: PyTree, h: jnp.ndarray) -> jn
     """Apply this stage's L//S blocks sequentially (scan over the block dim)."""
 
     def body(carry, blk):
-        return block_fn(blk, carry), None
+        # dtype-stable carry: a block that internally upcasts must not
+        # change the scan carry (or the ppermute'd activation) dtype
+        return block_fn(blk, carry).astype(carry.dtype), None
 
     out, _ = jax.lax.scan(body, h, stage_params)
     return out

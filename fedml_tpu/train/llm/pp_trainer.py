@@ -1,0 +1,100 @@
+"""Pipeline-parallel training for the real TransformerLM.
+
+Connects parallel/pipeline.py (the generic GPipe schedule) to the flagship
+llama-family model: the per-layer param subtrees (``layer_i``) are stacked
+into the [S, L//S, ...] stage layout, and the three pipeline callbacks are
+built from the model's own flax modules, so the pipelined computation is
+EXACTLY the TransformerLM forward (verified equal in
+tests/test_pp_llm.py). This is the 7B-on-a-pod memory shape the reference
+reaches for DeepSpeed for (``train/llm/distributed.py``): per-device
+params drop to L/S layers + embed/head.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ...models.transformer import Block, RMSNorm, TransformerConfig, TransformerLM
+from ...parallel.fsdp import causal_lm_loss
+from ...parallel.pipeline import pipeline_loss_fn, pp_param_shardings, stack_stage_params
+
+PyTree = Any
+
+
+def split_lm_params(params: Dict, cfg: TransformerConfig, n_stages: int) -> Tuple[Dict, PyTree, Dict]:
+    """Named TransformerLM params -> (embed, stacked stages [S,L//S,...], head).
+
+    The named layout is what init / checkpoint import produce; this is the
+    bridge into the pipeline's stacked layout."""
+    L = cfg.n_layers
+    if L % n_stages:
+        raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+    per_layer = [params[f"layer_{i}"] for i in range(L)]
+    stacked = stack_stage_params(per_layer)  # [L, ...]
+    stages = jax.tree.map(
+        lambda x: x.reshape(n_stages, L // n_stages, *x.shape[1:]), stacked
+    )
+    embed = {"embed": params["embed"]}
+    head = {"final_norm": params["final_norm"], "lm_head": params["lm_head"]}
+    return embed, stages, head
+
+
+def merge_lm_params(embed: Dict, stages: PyTree, head: Dict, cfg: TransformerConfig) -> Dict:
+    """Inverse of split_lm_params (for checkpoint export / aggregation)."""
+    L = cfg.n_layers
+    flat = jax.tree.map(lambda x: x.reshape(L, *x.shape[2:]), stages)
+    out = {"embed": embed["embed"], "final_norm": head["final_norm"], "lm_head": head["lm_head"]}
+    for i in range(L):
+        out[f"layer_{i}"] = jax.tree.map(lambda x: x[i], flat)
+    return out
+
+
+def make_pp_loss_fn(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    n_microbatches: int,
+    pp_axis: str = "pp",
+    dp_axis: str | None = "dp",
+) -> Callable:
+    """Pipelined loss(params=(embed, stages, head), tokens, targets_mask_ignored).
+
+    The callbacks reuse the model's own modules so numerics match
+    TransformerLM.apply exactly."""
+    if cfg.moe_experts > 0:
+        # block_fn applies Block without mutable collections, which would
+        # silently drop the sown MoE aux loss — refuse rather than mistrain
+        raise NotImplementedError(
+            "pipeline parallelism does not yet thread the MoE aux loss; "
+            "use the fsdp/ep path for moe_experts > 0"
+        )
+    block_mod = Block(cfg, name=None)
+    norm_mod = RMSNorm()
+
+    def embed_fn(embed_params, tok_mb):
+        # tok_mb: [M, mb, T] -> [M, mb, T, D]
+        table = embed_params["embed"]["embedding"]
+        return table[tok_mb].astype(cfg.dtype)
+
+    def block_fn(blk, h):
+        B, T = h.shape[0], h.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+        return block_mod.apply({"params": blk}, h, positions)
+
+    def head_loss_fn(head_params, h, tgt):
+        h = norm_mod.apply({"params": head_params["final_norm"]}, h)
+        kernel = head_params["lm_head"]["kernel"]
+        logits = (h @ kernel.astype(h.dtype)).astype(jnp.float32)
+        return causal_lm_loss(logits, tgt)
+
+    return pipeline_loss_fn(
+        block_fn, embed_fn, head_loss_fn, mesh,
+        n_microbatches=n_microbatches, pp_axis=pp_axis, dp_axis=dp_axis,
+    )
+
+
+def shard_pp_params(params3: Tuple, mesh: Mesh, pp_axis: str = "pp") -> Tuple:
+    return jax.device_put(params3, pp_param_shardings(mesh, params3, pp_axis))

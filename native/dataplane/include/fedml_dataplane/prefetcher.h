@@ -27,7 +27,9 @@ class Prefetcher {
 
   // Copy the next ready batch into outs[k] (caller-allocated, batch *
   // sample_bytes(k) each). Returns false at end of epoch; the next call
-  // starts the next epoch with a fresh permutation.
+  // starts the next epoch with a fresh permutation. Single consumer: the
+  // batch copy runs outside the lock, which is only safe when one thread
+  // calls next().
   bool next(void** outs);
 
   uint64_t batches_per_epoch() const { return batches_per_epoch_; }

@@ -104,8 +104,13 @@ bool Prefetcher::next(void** outs) {
   cv_consumer_.wait(lk, [&] { return stop_ || ring_[head_].ready; });
   if (stop_) return false;
   Slot& slot = ring_[head_];
+  // copy outside the lock: the producer never touches a slot whose ready
+  // flag is still set, and holding mu_ through a multi-MB memcpy would
+  // stall the worker's slot publication (the overlap this ring exists for)
+  lk.unlock();
   for (size_t k = 0; k < shards_.size(); ++k)
     std::memcpy(outs[k], slot.bufs[k].data(), slot.bufs[k].size());
+  lk.lock();
   bool epoch_end = slot.epoch_end;
   slot.ready = false;
   slot.epoch_end = false;

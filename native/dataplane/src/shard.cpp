@@ -45,27 +45,35 @@ Shard::Shard(const std::string& path) {
   }
   base_ = static_cast<const uint8_t*>(m);
 
-  const uint8_t* p = base_;
-  if (map_len_ < 16 || std::memcmp(p, kMagic, 4) != 0)
-    throw std::runtime_error("bad shard magic: " + path);
-  p += 4;
-  uint32_t version, dtype, ndim;
-  std::memcpy(&version, p, 4); p += 4;
-  std::memcpy(&dtype, p, 4); p += 4;
-  std::memcpy(&ndim, p, 4); p += 4;
-  if (version != kVersion) throw std::runtime_error("bad shard version");
-  if (ndim == 0 || ndim > 8) throw std::runtime_error("bad shard ndim");
-  if (map_len_ < 16 + size_t(ndim) * 8) throw std::runtime_error("truncated shard header");
-  dims_.resize(ndim);
-  std::memcpy(dims_.data(), p, size_t(ndim) * 8);
-  p += size_t(ndim) * 8;
-  dtype_ = static_cast<DType>(dtype);
+  // validation throws leave the object unconstructed (~Shard never runs),
+  // so release the mapping + fd here before rethrowing
+  try {
+    const uint8_t* p = base_;
+    if (map_len_ < 16 || std::memcmp(p, kMagic, 4) != 0)
+      throw std::runtime_error("bad shard magic: " + path);
+    p += 4;
+    uint32_t version, dtype, ndim;
+    std::memcpy(&version, p, 4); p += 4;
+    std::memcpy(&dtype, p, 4); p += 4;
+    std::memcpy(&ndim, p, 4); p += 4;
+    if (version != kVersion) throw std::runtime_error("bad shard version");
+    if (ndim == 0 || ndim > 8) throw std::runtime_error("bad shard ndim");
+    if (map_len_ < 16 + size_t(ndim) * 8) throw std::runtime_error("truncated shard header");
+    dims_.resize(ndim);
+    std::memcpy(dims_.data(), p, size_t(ndim) * 8);
+    p += size_t(ndim) * 8;
+    dtype_ = static_cast<DType>(dtype);
 
-  sample_bytes_ = dtype_size(dtype_);
-  for (uint32_t i = 1; i < ndim; ++i) sample_bytes_ *= dims_[i];
-  data_ = p;
-  size_t expect = size_t(p - base_) + n_samples() * sample_bytes_;
-  if (map_len_ < expect) throw std::runtime_error("truncated shard payload");
+    sample_bytes_ = dtype_size(dtype_);
+    for (uint32_t i = 1; i < ndim; ++i) sample_bytes_ *= dims_[i];
+    data_ = p;
+    size_t expect = size_t(p - base_) + n_samples() * sample_bytes_;
+    if (map_len_ < expect) throw std::runtime_error("truncated shard payload");
+  } catch (...) {
+    munmap(const_cast<uint8_t*>(base_), map_len_);
+    ::close(fd_);
+    throw;
+  }
 }
 
 Shard::~Shard() {

@@ -1,0 +1,96 @@
+"""Pipeline parallelism on the real TransformerLM: pipelined == plain apply."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.models.transformer import TransformerConfig, TransformerLM
+from fedml_tpu.parallel.fsdp import causal_lm_loss
+from fedml_tpu.parallel.mesh import create_mesh
+from fedml_tpu.train.llm.pp_trainer import (
+    make_pp_loss_fn,
+    merge_lm_params,
+    shard_pp_params,
+    split_lm_params,
+)
+
+CFG = TransformerConfig(
+    vocab_size=97, d_model=32, n_layers=4, n_heads=4, n_kv_heads=4, d_ff=64,
+    max_seq_len=16, dtype=jnp.float32, remat=False, lora_rank=0,
+)
+
+
+def _setup():
+    model = TransformerLM(CFG)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, 97, (8, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    return model, params, tokens
+
+
+def test_split_merge_roundtrip():
+    _, params, _ = _setup()
+    embed, stages, head = split_lm_params(params, CFG, n_stages=2)
+    back = merge_lm_params(embed, stages, head, CFG)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(back)[0],
+    ):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("pp,dp,M", [(4, 2, 2), (2, 2, 4)])
+def test_pp_llm_loss_and_grads_match_plain_apply(pp, dp, M):
+    model, params, tokens = _setup()
+
+    def ref_loss(p, toks):
+        return causal_lm_loss(model.apply({"params": p}, toks), toks)
+
+    ref, ref_g = jax.value_and_grad(ref_loss)(params, tokens)
+
+    mesh = create_mesh((dp, pp), ("dp", "pp"))
+    p3 = split_lm_params(params, CFG, pp)
+    p3 = shard_pp_params(p3, mesh)
+    loss_fn = make_pp_loss_fn(CFG, mesh, n_microbatches=M)
+    got, got_g = jax.jit(jax.value_and_grad(loss_fn))(p3, tokens, tokens)
+
+    np.testing.assert_allclose(float(got), float(ref), rtol=2e-5)
+
+    # gradients: merge back to the named layout and compare every leaf
+    ge, gs, gh = got_g
+    merged = merge_lm_params(ge, gs, gh, CFG)
+    for (path, leaf), (_, ref_leaf) in zip(
+        jax.tree_util.tree_flatten_with_path(merged)[0],
+        jax.tree_util.tree_flatten_with_path(ref_g)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(ref_leaf), rtol=1e-3, atol=2e-5,
+            err_msg=str(path),
+        )
+
+
+def test_pp_llm_7b_shapes_lower():
+    """7B-geometry stage split lowers on an 8-device pp mesh (eval_shape +
+    lower only — no 7B memory needed)."""
+    cfg = TransformerConfig.llama2_7b(max_seq_len=128, remat=True, lora_rank=0)
+    mesh = create_mesh((1, 8), ("dp", "pp"))
+    model = TransformerLM(cfg)
+    tokens_shape = jax.ShapeDtypeStruct((2, 128), jnp.int32)
+    params_shape = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32))["params"],
+        jax.random.PRNGKey(0),
+    )
+    p3_shape = jax.eval_shape(lambda p: split_lm_params(p, cfg, 8), params_shape)
+    from fedml_tpu.parallel.pipeline import pp_param_shardings
+
+    shardings = pp_param_shardings(mesh, p3_shape)
+    # stage params keep 'pp' on the leading (stage) dim
+    _, stage_sh, _ = shardings
+    q_sh = stage_sh["attn"]["q_proj"]["kernel"]
+    assert "pp" in str(q_sh.spec)
+    loss_fn = make_pp_loss_fn(cfg, mesh, n_microbatches=2)
+    lowered = jax.jit(
+        loss_fn, in_shardings=(shardings, None, None)
+    ).lower(p3_shape, tokens_shape, tokens_shape)
+    assert lowered.as_text()  # 7B stage split lowers cleanly at scale
