@@ -1,0 +1,32 @@
+"""Semantic-segmentation model for the FedSeg family.
+
+Reference: ``simulation/mpi/fedseg/`` trains DeepLabV3+/UNet heads on
+Pascal-VOC/COCO. TPU-native stand-in: a small UNet-style encoder-decoder —
+strided convs down, transpose convs up, skip connections — all
+MXU-friendly NHWC convolutions with static shapes.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SegNetLite(nn.Module):
+    """[B, H, W, C_in] -> per-pixel logits [B, H, W, num_classes]."""
+
+    num_classes: int
+    width: int = 16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        w = self.width
+        e0 = nn.relu(nn.Conv(w, (3, 3), name="enc0")(x))
+        e1 = nn.relu(nn.Conv(2 * w, (3, 3), strides=(2, 2), name="enc1")(e0))
+        e2 = nn.relu(nn.Conv(4 * w, (3, 3), strides=(2, 2), name="enc2")(e1))
+        b = nn.relu(nn.Conv(4 * w, (3, 3), name="bottleneck")(e2))
+        d1 = nn.relu(nn.ConvTranspose(2 * w, (3, 3), strides=(2, 2), name="dec1")(b))
+        d1 = jnp.concatenate([d1, e1], axis=-1)
+        d0 = nn.relu(nn.ConvTranspose(w, (3, 3), strides=(2, 2), name="dec0")(d1))
+        d0 = jnp.concatenate([d0, e0], axis=-1)
+        return nn.Conv(self.num_classes, (1, 1), name="head")(d0)
