@@ -1,0 +1,35 @@
+"""Switch-MoE LLM quick start.
+
+    python main.py --cf fedml_config.yaml
+
+Trains a small MoE transformer (top-1 routing, fixed capacity, aux
+load-balancing loss) with the same LLMTrainer the dense path uses; set
+device_args.ep > 1 on a multi-chip mesh to shard experts (GSPMD inserts
+the token all-to-all). See docs/architecture.md for the axis vocabulary.
+"""
+
+import sys
+
+import fedml_tpu as fedml
+from fedml_tpu.train.llm.configurations import (
+    DatasetArguments,
+    ExperimentArguments,
+    ModelArguments,
+)
+from fedml_tpu.train.llm.llm_trainer import LLMTrainer
+
+
+def main() -> None:
+    args = fedml.load_arguments(training_type="cross_silo")
+    trainer = LLMTrainer(
+        ModelArguments.from_args(args),
+        DatasetArguments.from_args(args),
+        ExperimentArguments.from_args(args),
+    )
+    metrics = trainer.train()
+    print(f"moe train done: {metrics}")
+    assert metrics["final_loss"] == metrics["final_loss"], "loss is NaN"
+
+
+if __name__ == "__main__":
+    sys.exit(main())

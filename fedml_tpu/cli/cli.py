@@ -115,6 +115,42 @@ def model_create_cmd(name: str, dataset: str, output_path: str) -> None:
     click.echo(api.model_create(name, dataset=dataset, output_path=output_path))
 
 
+@fedml_model.command(
+    "deploy",
+    help="Deploy an inference endpoint with subprocess-isolated replicas "
+         "(reference cli/modules/model.py deploy -> device_model_deployment)",
+)
+@click.option("--name", "-n", default="default", help="endpoint name")
+@click.option("--predictor", "-p", "predictor_spec", required=True,
+              help="'module:factory' producing a FedMLPredictor")
+@click.option("--model-path", default=None, type=click.Path())
+@click.option("--replicas", "-r", default=1, type=int)
+@click.option("--smoke", default=None,
+              help="JSON payload: send one request, print the reply, undeploy, exit")
+def model_deploy_cmd(name: str, predictor_spec: str, model_path: str, replicas: int, smoke: str) -> None:
+    import json as _json
+    import time as _time
+
+    from ..serving.endpoint import EndpointManager
+
+    mgr = EndpointManager()
+    gw = mgr.deploy_isolated(name, predictor_spec, replicas, model_path=model_path)
+    try:
+        click.echo(f"endpoint {name!r}: {replicas} replica(s) ready")
+        if smoke is not None:
+            reply = gw.predict(_json.loads(smoke))
+            click.echo(_json.dumps(reply))
+            return
+        click.echo("serving; Ctrl-C to undeploy")
+        while True:  # pragma: no cover - interactive serve loop
+            _time.sleep(1)
+    except KeyboardInterrupt:  # pragma: no cover
+        pass
+    finally:
+        mgr.undeploy(name)
+        click.echo(f"endpoint {name!r} undeployed")
+
+
 # --- logs (reference cli/modules/logs.py) -----------------------------------
 
 @cli.command("logs", help="Show the tail of a run's log file")

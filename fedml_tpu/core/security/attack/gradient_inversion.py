@@ -28,10 +28,13 @@ def dlg_reconstruct(
     iters: int = 300,
     lr: float = 0.1,
     match: str = "l2",
+    tv_weight: float = 0.0,
     key: Optional[jax.Array] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Recover (x, y) from a gradient. ``grad_fn(params, x, y_soft)`` must
-    return the parameter gradient for soft labels ``y_soft`` [B, C]."""
+    return the parameter gradient for soft labels ``y_soft`` [B, C].
+    ``tv_weight`` adds the total-variation image prior of the
+    Inverting-Gradients attack (Geiping et al. 2020) for 4-D x."""
     key = key if key is not None else jax.random.PRNGKey(0)
     kx, ky = jax.random.split(key)
     dummy_x = jax.random.normal(kx, x_shape, jnp.float32)
@@ -45,9 +48,13 @@ def dlg_reconstruct(
         if match == "cosine":
             num = tree_dot(g, observed_grad)
             den = tree_global_norm(g) * tree_global_norm(observed_grad) + 1e-12
-            return 1.0 - num / den
-        diff = tree_sub(g, observed_grad)
-        return tree_dot(diff, diff)
+            loss = 1.0 - num / den
+        else:
+            diff = tree_sub(g, observed_grad)
+            loss = tree_dot(diff, diff)
+        if tv_weight > 0.0 and len(x_shape) == 4:
+            loss = loss + tv_weight * total_variation(dx)
+        return loss
 
     @jax.jit
     def run(dummy, opt_state):
@@ -63,6 +70,14 @@ def dlg_reconstruct(
 
     (dummy_x, dummy_y), _losses = run((dummy_x, dummy_y), opt_state)
     return dummy_x, jnp.argmax(dummy_y, axis=-1)
+
+
+def total_variation(x: jnp.ndarray) -> jnp.ndarray:
+    """Anisotropic TV over [B, H, W, C] — the image prior that separates the
+    Inverting-Gradients attack from plain DLG."""
+    dh = jnp.abs(x[:, 1:, :, :] - x[:, :-1, :, :]).mean()
+    dw = jnp.abs(x[:, :, 1:, :] - x[:, :, :-1, :]).mean()
+    return dh + dw
 
 
 def reveal_labels_from_gradients(last_layer_grad: jnp.ndarray) -> jnp.ndarray:
@@ -95,13 +110,28 @@ class RevealingLabelsFromGradientsAttack:
 class DLGAttack:
     """Facade-compatible wrapper: reconstruct_data(a_gradient, aux)."""
 
+    match = "l2"
+    tv_weight = 0.0
+
     def __init__(self, config: Any):
         self.iters = int(getattr(config, "attack_iters", 300))
         self.lr = float(getattr(config, "attack_lr", 0.1))
-        self.match = "cosine" if str(getattr(config, "attack_type", "dlg")).lower() == "invert_gradient" else "l2"
 
     def reconstruct_data(self, a_gradient, extra_auxiliary_info=None):
         grad_fn, params, x_shape, num_classes = extra_auxiliary_info
         return dlg_reconstruct(
-            grad_fn, params, a_gradient, x_shape, num_classes, iters=self.iters, lr=self.lr, match=self.match
+            grad_fn, params, a_gradient, x_shape, num_classes,
+            iters=self.iters, lr=self.lr, match=self.match, tv_weight=self.tv_weight,
         )
+
+
+class InvertGradientAttack(DLGAttack):
+    """Inverting Gradients (Geiping et al. 2020): cosine gradient matching
+    plus a total-variation image prior (reference:
+    invert_gradient_attack.py)."""
+
+    match = "cosine"
+
+    def __init__(self, config: Any):
+        super().__init__(config)
+        self.tv_weight = float(getattr(config, "attack_tv_weight", 0.01))

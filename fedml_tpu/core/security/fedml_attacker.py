@@ -74,6 +74,10 @@ class FedMLAttacker:
             from .attack.gradient_inversion import RevealingLabelsFromGradientsAttack
 
             self.attacker = RevealingLabelsFromGradientsAttack(args)
+        elif self.attack_type == ATTACK_METHOD_INVERT_GRADIENT:
+            from .attack.gradient_inversion import InvertGradientAttack
+
+            self.attacker = InvertGradientAttack(args)
         elif self.attack_type in RECONSTRUCT_ATTACKS:
             from .attack.gradient_inversion import DLGAttack
 

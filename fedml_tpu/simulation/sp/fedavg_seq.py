@@ -20,8 +20,6 @@ import time
 from typing import Any, Dict, List, Tuple
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 
 from ...core.alg_frame.context import Context
 from ...core.schedule.runtime_estimate import t_sample_fit

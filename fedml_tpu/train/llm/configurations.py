@@ -92,6 +92,8 @@ class ExperimentArguments:
     tp: int = 1
     sp: int = 1
     ep: int = 1                         # expert parallelism (MoE models)
+    pp: int = 1                         # pipeline stages (GPipe schedule)
+    pp_microbatches: int = 2
 
     @classmethod
     def from_args(cls, args: Any) -> "ExperimentArguments":
@@ -101,6 +103,12 @@ class ExperimentArguments:
         return out
 
     def mesh_shape(self) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+        if self.pp > 1:
+            # pipeline mode: ('dp','pp') mesh; other axes must be 1 (stage
+            # params could additionally shard over fsdp/tp in the future)
+            if any(n > 1 for n in (self.fsdp, self.tp, self.sp, self.ep)):
+                raise ValueError("pp>1 currently composes only with dp")
+            return (self.dp, self.pp), ("dp", "pp")
         axes, names = [], []
         for n, name in (
             (self.dp, "dp"), (self.fsdp, "fsdp"), (self.tp, "tp"),

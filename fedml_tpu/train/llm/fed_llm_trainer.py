@@ -31,19 +31,19 @@ class LLMClientTrainer(ClientTrainer):
         self.llm._build(self.llm.init_params())
 
     # --- adapter-only exchange -------------------------------------------
+    # the named layout is the WAN wire layout regardless of parallel mode
+    # (pp mode keeps params as the (embed, stages, head) stage tuple)
     def get_model_params(self):
         import jax
 
-        adapters, _ = split_lora(jax.device_get(self.llm.params))
+        adapters, _ = split_lora(jax.device_get(self.llm.named_params()))
         return adapters
 
     def set_model_params(self, model_parameters) -> None:
         import jax
 
-        from ...parallel.fsdp import param_shardings
-
-        merged = merge_lora(jax.device_get(self.llm.params), model_parameters)
-        self.llm.params = jax.device_put(merged, param_shardings(merged, self.llm.mesh))
+        merged = merge_lora(jax.device_get(self.llm.named_params()), model_parameters)
+        self.llm.set_named_params(merged)
 
     def train(self, train_data, device=None, args: Any = None) -> None:
         """One federated round of local steps.

@@ -122,6 +122,8 @@ class LLMTrainer:
         return params
 
     def _build(self, params):
+        if self.exp_args.pp > 1:
+            return self._build_pp(params)
         tx = self._full_tx
         if self.cfg.lora_rank > 0:
             # freeze base weights: adapters get the real optimizer, the rest
@@ -147,6 +149,61 @@ class LLMTrainer:
         )
         self.params, self.opt_state = init_fn(params)
         self._step_fn = compile_step(self.params, self.opt_state)
+
+    def _build_pp(self, params):
+        """GPipe pipeline mode (ExperimentArguments.pp > 1): params live in
+        the (embed, stages [S,L//S,...], head) layout sharded over 'pp';
+        the step is jax.grad through the microbatch schedule."""
+        import optax as _optax
+
+        from .pp_trainer import make_pp_loss_fn, shard_pp_params, split_lm_params
+
+        p3 = split_lm_params(params, self.cfg, self.exp_args.pp)
+        tx = self._full_tx
+        if self.cfg.lora_rank > 0:
+            labels3 = jax.tree.map(lambda m: "train" if m else "freeze", lora_mask(p3))
+            tx = _optax.multi_transform(
+                {"train": self._full_tx, "freeze": _optax.set_to_zero()}, labels3
+            )
+        p3 = shard_pp_params(p3, self.mesh)
+        loss_fn = make_pp_loss_fn(
+            self.cfg, self.mesh, n_microbatches=self.exp_args.pp_microbatches
+        )
+        opt_state = tx.init(p3)
+
+        @jax.jit
+        def step(params3, opt_state, tokens, mask):
+            # mask is accepted for step-signature parity; the pipelined loss
+            # packs full microbatches so no padding mask is needed
+            loss, grads = jax.value_and_grad(loss_fn)(params3, tokens, tokens)
+            updates, opt_state = tx.update(grads, opt_state, params3)
+            return _optax.apply_updates(params3, updates), opt_state, loss
+
+        self.params = p3
+        self.opt_state = opt_state
+        self._step_fn = step
+        self._pp_mode = True
+
+    def named_params(self):
+        """Params in the named layer_i layout regardless of parallel mode."""
+        if getattr(self, "_pp_mode", False):
+            from .pp_trainer import merge_lm_params
+
+            e, s, h = self.params
+            return merge_lm_params(e, s, h, self.cfg)
+        return self.params
+
+    def set_named_params(self, named) -> None:
+        """Install named-layout params, converting to the active parallel
+        layout (pp stage tuple or fsdp-sharded named tree)."""
+        if getattr(self, "_pp_mode", False):
+            from .pp_trainer import shard_pp_params, split_lm_params
+
+            self.params = shard_pp_params(
+                split_lm_params(named, self.cfg, self.exp_args.pp), self.mesh
+            )
+        else:
+            self.params = jax.device_put(named, param_shardings(named, self.mesh))
 
     # --- loop ------------------------------------------------------------
     def train(self, batches: Optional[Iterator] = None) -> Dict[str, float]:
@@ -210,13 +267,17 @@ class LLMTrainer:
 
     # --- checkpointing ----------------------------------------------------
     def save(self, step: int) -> None:
-        self.ckpt.save(step, jax.device_get(self.params))
+        # checkpoints always use the named layout so they are loadable
+        # regardless of the parallel mode that produced them
+        self.ckpt.save(step, jax.device_get(self.named_params()))
 
     def restore(self, step: Optional[int] = None) -> bool:
         if self.params is None:
             self._build(self.init_params())
-        restored = self.ckpt.restore(step, template=jax.device_get(self.params))
+        # checkpoints are always named-layout (save()); restore with the
+        # matching template, then convert to the active parallel layout
+        restored = self.ckpt.restore(step, template=jax.device_get(self.named_params()))
         if restored is None:
             return False
-        self.params = jax.device_put(restored, param_shardings(restored, self.mesh))
+        self.set_named_params(restored)
         return True

@@ -10,6 +10,13 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Child processes (example runs, scheduler jobs, serving replicas) must
+# never touch the remote-TPU tunnel: the axon sitecustomize only activates
+# when PALLAS_AXON_POOL_IPS is set, so dropping it here gives every
+# subprocess a clean CPU interpreter even when the tunnel is stalled.
+# (This process itself already imported the sitecustomize; the in-process
+# fix is the jax.config.update below.)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_compile_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")

@@ -98,3 +98,18 @@ def test_api_collect_env_and_diagnose():
     assert "jax" in info
     checks = api.diagnose()
     assert all(checks.values()), checks
+
+
+def test_model_deploy_smoke(runner):
+    out = runner.invoke(
+        cli,
+        [
+            "model", "deploy",
+            "-p", "fedml_tpu.serving.replica_controller:create_echo_predictor",
+            "-r", "2",
+            "--smoke", '{"x": [1, 2]}',
+        ],
+    )
+    assert out.exit_code == 0, out.output
+    assert '"echo"' in out.output
+    assert "undeployed" in out.output

@@ -28,6 +28,7 @@ def test_found_all_platform_examples():
         "quick_start/octopus/fedml_config.yaml",
         "simulation/vmap_fedavg/fedml_config.yaml",
         "train/llm_finetune/fedml_config.yaml",
+        "train/llm_moe/fedml_config.yaml",
         "fednlp/text_classification/fedml_config.yaml",
         "federated_analytics/heavy_hitter/fedml_config.yaml",
         "deploy/quick_start/main.py",
@@ -53,6 +54,10 @@ def _run(script, *argv, timeout=600):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
+    # without this the axon sitecustomize force-selects the remote-TPU
+    # backend in the child (ignoring JAX_PLATFORMS) and a stalled tunnel
+    # hangs the example forever
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     return subprocess.run(
         [sys.executable, os.path.basename(script), *argv],
         cwd=os.path.dirname(script), env=env, capture_output=True, text=True, timeout=timeout,
@@ -86,6 +91,14 @@ def test_llm_finetune_example_runs():
     r = _run(s, "--cf", "fedml_config.yaml", timeout=900)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "federated LoRA fine-tune complete" in r.stdout
+
+
+@pytest.mark.slow
+def test_llm_moe_example_runs():
+    s = os.path.join(EXAMPLES, "train", "llm_moe", "main.py")
+    r = _run(s, "--cf", "fedml_config.yaml", timeout=900)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "moe train done" in r.stdout
 
 
 @pytest.mark.slow

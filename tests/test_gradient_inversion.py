@@ -1,0 +1,76 @@
+"""Gradient-inversion attacks: DLG (L2) and Inverting-Gradients (cosine+TV)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core.security.attack.gradient_inversion import (
+    DLGAttack,
+    InvertGradientAttack,
+    reveal_labels_from_gradients,
+    total_variation,
+)
+
+
+def _lr_setup(x_shape, num_classes, seed=0):
+    """Tiny linear softmax model + its grad_fn and one observed gradient."""
+    rng = np.random.default_rng(seed)
+    d = int(np.prod(x_shape[1:]))
+    W = jnp.asarray(rng.normal(0, 0.3, (d, num_classes)), jnp.float32)
+    x_true = jnp.asarray(rng.normal(size=x_shape), jnp.float32)
+    y_true = jnp.asarray(rng.integers(0, num_classes, x_shape[0]))
+
+    def grad_fn(params, x, y_soft):
+        def loss(p):
+            logits = x.reshape(x.shape[0], -1) @ p
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.sum(y_soft * logp, axis=-1))
+
+        return jax.grad(loss)(params)
+
+    observed = grad_fn(W, x_true, jax.nn.one_hot(y_true, num_classes))
+    return W, grad_fn, observed, x_true, y_true
+
+
+class _Cfg:
+    attack_iters = 400
+    attack_lr = 0.1
+    attack_tv_weight = 1e-4
+
+
+def test_dlg_reconstruction_matches_gradient_and_input_direction():
+    # B=1: the classic DLG setting. A linear-softmax gradient has an exact
+    # mirror solution (-x with the complementary soft label), so the honest
+    # assertions are (a) the recovered pair reproduces the observed
+    # gradient and (b) x is recovered up to sign.
+    x_shape, C = (1, 8), 4
+    W, grad_fn, observed, x_true, y_true = _lr_setup(x_shape, C)
+    rx, ry = DLGAttack(_Cfg()).reconstruct_data(observed, (grad_fn, W, x_shape, C))
+    corr = np.corrcoef(np.asarray(rx).ravel(), np.asarray(x_true).ravel())[0, 1]
+    # |corr| ~ 1 means the private input leaked up to sign — the attack's
+    # privacy-relevant success criterion (the optimized soft label is not
+    # returned, so the gradient itself can't be re-evaluated here)
+    assert abs(corr) > 0.9, corr
+
+
+def test_invert_gradient_image_with_tv_prior():
+    x_shape, C = (1, 6, 6, 1), 3
+    W, grad_fn, observed, x_true, y_true = _lr_setup(x_shape, C, seed=1)
+    atk = InvertGradientAttack(_Cfg())
+    assert atk.match == "cosine" and atk.tv_weight > 0
+    rx, ry = atk.reconstruct_data(observed, (grad_fn, W, x_shape, C))
+    corr = np.corrcoef(np.asarray(rx).ravel(), np.asarray(x_true).ravel())[0, 1]
+    assert abs(corr) > 0.4, corr  # sign ambiguity as in the DLG test
+
+
+def test_total_variation_zero_for_constant_image():
+    assert float(total_variation(jnp.ones((2, 5, 5, 3)))) == 0.0
+    assert float(total_variation(jnp.arange(50.0).reshape(1, 5, 10, 1))) > 0
+
+
+def test_reveal_labels_mask():
+    # class-present rows of the final-layer gradient are negative
+    g = jnp.asarray([[-0.5, -0.2], [0.3, 0.1], [-0.1, -0.4]])
+    mask = reveal_labels_from_gradients(g)
+    np.testing.assert_array_equal(np.asarray(mask), [True, False, True])
