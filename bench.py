@@ -387,7 +387,31 @@ def _bench_resnet_torch_cpu(bs: int = 32, budget_s: float = 60.0) -> float | Non
         return None
 
 
+def _probe_backend(timeout_s: int = 180) -> None:
+    """Fail fast if the remote TPU tunnel is stalled: jax.devices() on the
+    axon backend blocks forever in native code when the tunnel is down
+    (uninterruptible by SIGALRM), which would eat the driver's whole bench
+    timeout with no diagnostic. Probe in a killable subprocess BEFORE this
+    process imports jax."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; d=jax.devices()[0]; print(getattr(d,'device_kind',d))"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        raise TimeoutError(
+            f"jax backend init did not complete within {timeout_s}s — the "
+            "remote TPU tunnel is stalled; rerun when it recovers"
+        )
+    if proc.returncode != 0:
+        raise RuntimeError(f"jax backend init failed:\n{proc.stderr[-1000:]}")
+    print(f"benching on {proc.stdout.strip().splitlines()[-1]}", file=sys.stderr)
+
+
 def main() -> None:
+    _probe_backend()
     llm = _bench_llm_tpu()
     resnet = _bench_resnet_tpu()
     llm_cpu_tokens = _bench_llm_torch_cpu(llm["shape"])

@@ -50,6 +50,7 @@ FEDML_FEDERATED_OPTIMIZER_VERTICAL_FL = "classical_vertical"
 FEDML_FEDERATED_OPTIMIZER_SPLIT_NN = "split_nn"
 FEDML_FEDERATED_OPTIMIZER_FEDGKT = "FedGKT"
 FEDML_FEDERATED_OPTIMIZER_FEDNAS = "FedNAS"
+FEDML_FEDERATED_OPTIMIZER_FEDSEG = "FedSeg"
 
 # --- roles ---
 ROLE_SERVER = "server"

@@ -15,9 +15,11 @@ class SimulatorSingleProcess:
     def __init__(self, args: Any, device: Any, dataset, model, client_trainer=None, server_aggregator=None):
         from ..constants import (
             FEDML_FEDERATED_OPTIMIZER_ASYNC_FEDAVG,
+            FEDML_FEDERATED_OPTIMIZER_FEDAVG_SEQ,
             FEDML_FEDERATED_OPTIMIZER_FEDGAN,
             FEDML_FEDERATED_OPTIMIZER_FEDGKT,
             FEDML_FEDERATED_OPTIMIZER_FEDNAS,
+            FEDML_FEDERATED_OPTIMIZER_FEDSEG,
             FEDML_FEDERATED_OPTIMIZER_HIERACHICAL_FL,
             FEDML_FEDERATED_OPTIMIZER_SPLIT_NN,
             FEDML_FEDERATED_OPTIMIZER_TURBO_AGGREGATE,
@@ -38,6 +40,10 @@ class SimulatorSingleProcess:
             from .sp.fednas import FedNASAPI as API
         elif opt == FEDML_FEDERATED_OPTIMIZER_SPLIT_NN:
             from .sp.split_nn import SplitNNAPI as API
+        elif opt == FEDML_FEDERATED_OPTIMIZER_FEDSEG:
+            from .sp.fedseg import FedSegAPI as API
+        elif opt == FEDML_FEDERATED_OPTIMIZER_FEDAVG_SEQ:
+            from .sp.fedavg_seq import FedAvgSeqAPI as API
         else:
             from .sp.fedavg_api import FedAvgAPI as API
 

@@ -32,8 +32,9 @@ log = logging.getLogger(__name__)
 class FedAvgSeqAPI(FedAvgAPI):
     """FedAvgAPI + makespan-optimized per-round client->worker schedules."""
 
-    def __init__(self, args: Any, device: Any, dataset, model, **kw):
-        super().__init__(args, device, dataset, model, **kw)
+    def __init__(self, args: Any, device: Any, dataset, model,
+                 client_trainer=None, server_aggregator=None):
+        super().__init__(args, device, dataset, model, client_trainer, server_aggregator)
         from ...constants import (
             FEDML_FEDERATED_OPTIMIZER_FEDNOVA,
             FEDML_FEDERATED_OPTIMIZER_MIME,

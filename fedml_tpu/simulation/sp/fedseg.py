@@ -86,7 +86,12 @@ class FedSegAPI:
     """FedAvg rounds over segmentation clients; returns the reference's
     EvaluationMetricsKeeper fields per round."""
 
-    def __init__(self, args: Any, num_classes: int = 3):
+    def __init__(self, args: Any, device: Any = None, dataset=None, model=None,
+                 client_trainer=None, server_aggregator=None, num_classes: int = 3):
+        """Accepts the simulator's uniform (args, device, dataset, model, ...)
+        signature; FedSeg generates its own segmentation data and model (the
+        reference fedseg package ships its own loaders/DeepLab the same way),
+        so those positional args are unused."""
         self.args = args
         self.num_classes = num_classes
         n_clients = int(getattr(args, "client_num_in_total", 4))

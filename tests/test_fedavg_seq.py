@@ -59,3 +59,15 @@ def test_queue_balance_with_heterogeneous_workloads():
     queues, _ = api._schedule([0, 1, 2, 3, 4, 5])
     big = [next(w for w, q in enumerate(queues) if pos in q) for pos in (0, 5)]
     assert big[0] != big[1], queues
+
+
+def test_fedavg_seq_dispatches_from_simulator():
+    from fedml_tpu.simulation.simulator import SimulatorSingleProcess
+
+    args = fedml.init(_args(comm_round=1, federated_optimizer="FedAvg_seq"))
+    device = fedml.device.get_device(args)
+    dataset, output_dim = fedml.data.load(args)
+    model = fedml.model.create(args, output_dim)
+    sim = SimulatorSingleProcess(args, device, dataset, model, None, None)
+    metrics = sim.run()
+    assert "makespan" in metrics

@@ -58,3 +58,20 @@ def test_fedseg_learns():
     assert metrics["mIoU"] > 0.5, metrics
     assert metrics["pixel_acc"] > 0.7, metrics
     assert np.isfinite(metrics["test_loss"])
+
+
+def test_fedseg_dispatches_from_simulator():
+    from fedml_tpu.simulation.simulator import SimulatorSingleProcess
+
+    class Args:
+        federated_optimizer = "FedSeg"
+        client_num_in_total = 2
+        comm_round = 1
+        epochs = 1
+        batch_size = 8
+        learning_rate = 0.05
+        random_seed = 0
+
+    sim = SimulatorSingleProcess(Args(), None, None, None)
+    metrics = sim.run()
+    assert "mIoU" in metrics
