@@ -44,6 +44,7 @@ class TransformerConfig:
     moe_experts: int = 0         # 0 = dense MLP; >0 = Switch-style MoE MLP
     moe_capacity_factor: float = 1.25
     moe_ep_axis: Any = None      # mesh axis name for expert parallelism
+    decode: bool = False         # KV-cache autoregressive decode mode (serving)
 
     @property
     def head_dim(self) -> int:
@@ -128,14 +129,27 @@ def _lora_target(name: Optional[str], cfg: TransformerConfig) -> bool:
     return name is not None and any(t in name for t in cfg.lora_targets)
 
 
-def xla_attention(q, k, v, causal: bool = True):
-    """Plain einsum attention; XLA fuses + tiles this well for short T."""
+def repeat_kv(k: jnp.ndarray, v: jnp.ndarray, n_heads: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """GQA: repeat kv heads up to n_heads (no-op when already equal)."""
+    n_kv = k.shape[2]
+    if n_kv != n_heads:
+        rep = n_heads // n_kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+def xla_attention(q, k, v, causal: bool = True, mask: Optional[jnp.ndarray] = None):
+    """Plain einsum attention; XLA fuses + tiles this well for short T.
+    ``mask`` overrides the causal triangle (decode path: [T_q, T_k] valid
+    positions); both paths share this one body so they cannot diverge."""
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
+    if mask is None and causal:
         tq, tk = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((tq, tk), jnp.bool_), tk - tq)
-        logits = jnp.where(mask, logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask[None, None] if mask.ndim == 2 else mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -153,10 +167,9 @@ class Attention(nn.Module):
         v = LoRALinear(cfg.n_kv_heads * hd, cfg, name="v_proj")(x).reshape(B, T, cfg.n_kv_heads, hd)
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
-        if cfg.n_kv_heads != cfg.n_heads:  # GQA: repeat kv heads
-            rep = cfg.n_heads // cfg.n_kv_heads
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        if cfg.decode:
+            return self._decode_attention(q, k, v, B, T)
+        k, v = repeat_kv(k, v, cfg.n_heads)
         if cfg.attention_impl == "pallas":
             from ..ops.flash_attention import flash_attention
 
@@ -167,6 +180,30 @@ class Attention(nn.Module):
             out = ring_attention_inner(q, k, v)
         else:
             out = xla_attention(q, k, v, causal=True)
+        out = out.reshape(B, T, cfg.n_heads * hd)
+        return LoRALinear(cfg.d_model, cfg, name="o_proj")(out)
+
+    def _decode_attention(self, q, k, v, B: int, T: int) -> jnp.ndarray:
+        """KV-cache attention for autoregressive decode (flax 'cache'
+        collection). Supports prefill (T = prompt length) and single-token
+        steps (T = 1): new k/v are written at the running cache index and
+        queries attend to everything written so far. Static shapes: the
+        cache is [B, max_seq_len, kv, hd] with an index mask."""
+        cfg = self.cfg
+        hd = cfg.head_dim
+        S = cfg.max_seq_len
+        ck = self.variable("cache", "k", jnp.zeros, (B, S, cfg.n_kv_heads, hd), q.dtype)
+        cv = self.variable("cache", "v", jnp.zeros, (B, S, cfg.n_kv_heads, hd), q.dtype)
+        cidx = self.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
+        idx = cidx.value
+        if self.is_mutable_collection("cache"):
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k.astype(ck.value.dtype), (0, idx, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v.astype(cv.value.dtype), (0, idx, 0, 0))
+            cidx.value = idx + T
+        k_all, v_all = repeat_kv(ck.value, cv.value, cfg.n_heads)  # [B, S, h, hd]
+        q_pos = idx + jnp.arange(T)  # absolute position of each query
+        valid = jnp.arange(S)[None, :] <= q_pos[:, None]  # [T, S] causal+written
+        out = xla_attention(q, k_all, v_all, mask=valid)
         out = out.reshape(B, T, cfg.n_heads * hd)
         return LoRALinear(cfg.d_model, cfg, name="o_proj")(out)
 
@@ -214,10 +251,12 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+    def __call__(self, tokens: jnp.ndarray, train: bool = False,
+                 positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model, name="embed")(tokens).astype(cfg.dtype)
-        positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
         block = Block
         if cfg.remat:
             block = nn.remat(Block, static_argnums=())

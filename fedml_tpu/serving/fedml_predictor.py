@@ -61,3 +61,45 @@ class JaxPredictor(FedMLPredictor):
         if self._post is not None:
             return self._post(out)
         return {"outputs": np.asarray(out).tolist()}
+
+
+class LLMPredictor(FedMLPredictor):
+    """LLM text-generation endpoint (BASELINE config 5 shape): KV-cache
+    decode via train/llm/generation.py. Request: {"prompt": str,
+    "max_new_tokens": int?, "temperature": float?} -> {"text": str}.
+
+    Build from a checkpoint dir (HF llama safetensors + tokenizer.json) or
+    pass (params, cfg, tokenizer) directly."""
+
+    def __init__(self, params, cfg, tokenizer, default_max_new_tokens: int = 64):
+        self._params = params
+        self._cfg = cfg
+        self._tok = tokenizer
+        self._max_new = int(default_max_new_tokens)
+
+    @classmethod
+    def from_checkpoint(cls, path: str, **kw) -> "LLMPredictor":
+        from ..train.llm.checkpoint_import import config_from_hf, import_hf_checkpoint
+        from ..train.llm.data import load_or_train_tokenizer
+        import os
+
+        cfg = config_from_hf(path)
+        params = import_hf_checkpoint(path, cfg)
+        tok = load_or_train_tokenizer(None, os.path.join(path, "tokenizer.json"))
+        return cls(params, cfg, tok, **kw)
+
+    def predict(self, request: dict, *args, **kwargs):
+        import jax
+
+        from ..train.llm.generation import generate_text
+
+        text = generate_text(
+            self._params,
+            self._cfg,
+            self._tok,
+            str(request["prompt"]),
+            max_new_tokens=int(request.get("max_new_tokens", self._max_new)),
+            temperature=float(request.get("temperature", 0.0)),
+            key=jax.random.PRNGKey(int(request.get("seed", 0))),
+        )
+        return {"text": text}

@@ -1,0 +1,102 @@
+"""KV-cache decode: stepped logits == full forward; generation shapes/EOS."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.models.transformer import TransformerConfig, TransformerLM
+from fedml_tpu.train.llm.generation import decode_model, generate
+
+CFG = TransformerConfig(
+    vocab_size=89, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+    max_seq_len=32, dtype=jnp.float32, remat=False, lora_rank=0,
+)
+
+
+def _params(cfg=CFG):
+    model = TransformerLM(cfg)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"]
+
+
+def test_kv_cache_decode_matches_full_forward():
+    """The keystone: per-step cached logits equal the plain causal forward
+    at every position (same params, GQA config included)."""
+    params = _params()
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 89, (2, 10)), jnp.int32)
+    full_logits = TransformerLM(CFG).apply({"params": params}, toks)
+
+    dm = decode_model(CFG)
+    # prefill the first 4 tokens, then step one token at a time
+    positions = jnp.broadcast_to(jnp.arange(4), (2, 4))
+    logits, state = dm.apply({"params": params}, toks[:, :4], positions=positions, mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full_logits[:, :4]), rtol=2e-4, atol=2e-4)
+    cache = state["cache"]
+    for t in range(4, 10):
+        pos = jnp.full((2, 1), t, jnp.int32)
+        step_logits, state = dm.apply(
+            {"params": params, "cache": cache}, toks[:, t : t + 1], positions=pos, mutable=["cache"]
+        )
+        cache = state["cache"]
+        np.testing.assert_allclose(
+            np.asarray(step_logits[:, 0]), np.asarray(full_logits[:, t]),
+            rtol=2e-4, atol=2e-4, err_msg=f"position {t}",
+        )
+
+
+def test_generate_greedy_deterministic():
+    params = _params()
+    prompt = jnp.asarray([[3, 14, 15], [9, 2, 6]], jnp.int32)
+    a = generate(params, CFG, prompt, 8)
+    b = generate(params, CFG, prompt, 8)
+    assert a.shape == (2, 8)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.all((np.asarray(a) >= 0) & (np.asarray(a) < CFG.vocab_size))
+
+
+def test_generate_sampled_varies_with_key():
+    params = _params()
+    prompt = jnp.asarray([[3, 14, 15]], jnp.int32)
+    a = generate(params, CFG, prompt, 12, temperature=1.0, key=jax.random.PRNGKey(1))
+    b = generate(params, CFG, prompt, 12, temperature=1.0, key=jax.random.PRNGKey(2))
+    assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_generate_eos_fills_tail():
+    params = _params()
+    prompt = jnp.asarray([[5, 6]], jnp.int32)
+    # force a guaranteed EOS: use whatever greedy emits first as the eos id,
+    # so the fill-after-EOS contract is always exercised (never vacuous)
+    first = int(np.asarray(generate(params, CFG, prompt, 1))[0, 0])
+    out = np.asarray(generate(params, CFG, prompt, 16, eos_id=first))
+    hits = np.where(out[0] == first)[0]
+    assert len(hits) > 0
+    assert np.all(out[0, hits[0]:] == first)
+
+
+def test_generate_rejects_nonpositive_max_new():
+    params = _params()
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(params, CFG, jnp.zeros((1, 4), jnp.int32), 0)
+
+
+def test_generate_rejects_overflow():
+    params = _params()
+    with pytest.raises(ValueError, match="max_seq_len"):
+        generate(params, CFG, jnp.zeros((1, 30), jnp.int32), 8)
+
+
+def test_llm_predictor_serves_text():
+    from fedml_tpu.serving.fedml_predictor import LLMPredictor
+    from fedml_tpu.train.llm.tokenizer import train_bpe
+
+    tok = train_bpe(["the quick brown fox jumps over the lazy dog"] * 4, vocab_size=260)
+    cfg = dataclasses.replace(CFG, vocab_size=tok.vocab_size)
+    params = _params(cfg)
+    pred = LLMPredictor(params, cfg, tok, default_max_new_tokens=8)
+    out = pred.predict({"prompt": "the quick"})
+    assert isinstance(out["text"], str) and len(out["text"]) > 0
+    # greedy: same prompt, same reply
+    assert pred.predict({"prompt": "the quick"})["text"] == out["text"]
