@@ -1,0 +1,54 @@
+"""LLM inference endpoint quick start (BASELINE config 5 shape).
+
+    python main.py            # tiny random model, greedy decode
+    python main.py /path/to/hf_llama_checkpoint   # real weights
+
+Deploys an LLMPredictor (KV-cache decode, one compiled executable per
+request shape) behind the endpoint manager and sends a few requests. With
+a local HF llama checkpoint dir (config.json + *.safetensors +
+tokenizer.json) the same script serves the real model.
+"""
+
+import sys
+
+import jax
+
+
+def main() -> None:
+    import jax.numpy as jnp
+
+    from fedml_tpu.serving.endpoint import EndpointManager
+    from fedml_tpu.serving.fedml_predictor import LLMPredictor
+
+    if len(sys.argv) > 1:
+        predictor = LLMPredictor.from_checkpoint(sys.argv[1])
+    else:
+        from fedml_tpu.models.transformer import TransformerConfig, TransformerLM
+        from fedml_tpu.train.llm.tokenizer import train_bpe
+
+        tok = train_bpe(
+            ["the quick brown fox jumps over the lazy dog"] * 4, vocab_size=260
+        )
+        cfg = TransformerConfig(
+            vocab_size=tok.vocab_size, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=4, d_ff=128, max_seq_len=64, dtype=jnp.float32,
+            remat=False, lora_rank=0,
+        )
+        params = TransformerLM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+        )["params"]
+        predictor = LLMPredictor(params, cfg, tok, default_max_new_tokens=8)
+
+    mgr = EndpointManager()
+    ep = mgr.deploy("llm", lambda: predictor)
+    try:
+        for prompt in ("the quick", "lazy dog"):
+            reply = ep.predict({"prompt": prompt})
+            print(f"prompt={prompt!r} -> {reply['text']!r}")
+    finally:
+        mgr.undeploy("llm")
+    print("llm endpoint example done")
+
+
+if __name__ == "__main__":
+    main()

@@ -76,6 +76,9 @@ class LLMPredictor(FedMLPredictor):
         self._cfg = cfg
         self._tok = tokenizer
         self._max_new = int(default_max_new_tokens)
+        # stop at the tokenizer's end-of-sequence token when it defines one
+        self._eos_id = getattr(tokenizer, "special_tokens", {}).get("</s>")
+        self._ready = True  # flips False->True around warmup() when used
 
     @classmethod
     def from_checkpoint(cls, path: str, **kw) -> "LLMPredictor":
@@ -87,6 +90,18 @@ class LLMPredictor(FedMLPredictor):
         params = import_hf_checkpoint(path, cfg)
         tok = load_or_train_tokenizer(None, os.path.join(path, "tokenizer.json"))
         return cls(params, cfg, tok, **kw)
+
+    def warmup(self, example_prompt: str = "warmup") -> None:
+        """Compile the default request shape before readiness is reported
+        (mirrors JaxPredictor.warmup: without this, the first real request
+        pays the full prefill+scan compile and can exceed the gateway's
+        timeout / trip health eviction)."""
+        self._ready = False
+        self.predict({"prompt": example_prompt})
+        self._ready = True
+
+    def ready(self) -> bool:
+        return self._ready
 
     def predict(self, request: dict, *args, **kwargs):
         import jax
@@ -101,5 +116,6 @@ class LLMPredictor(FedMLPredictor):
             max_new_tokens=int(request.get("max_new_tokens", self._max_new)),
             temperature=float(request.get("temperature", 0.0)),
             key=jax.random.PRNGKey(int(request.get("seed", 0))),
+            eos_id=self._eos_id,
         )
         return {"text": text}

@@ -6,8 +6,10 @@ TPU-native — the transformer runs in ``decode=True`` mode (flax "cache"
 collection holding [B, max_seq_len, kv, hd] key/value buffers written at a
 running index), prefill is one batched pass over the prompt, and the
 per-token loop is a single jitted ``lax.scan`` carrying (cache, token,
-position, rng). Static shapes throughout: prompts are right-aligned into a
-fixed window, the scan length is max_new_tokens.
+position, rng). Compilation is split so serving stays warm: prefill
+compiles per prompt length (one cheap forward), the token-loop executable
+is shared across ALL prompt lengths (start position is a runtime value)
+and bucketed over max_new_tokens; both caches are LRU-bounded.
 
 Correctness keystone (tests/test_generation.py): stepped KV-cache logits
 equal the full non-cached forward bit-for-bit positions.
@@ -29,61 +31,86 @@ def decode_model(cfg: TransformerConfig) -> TransformerLM:
     return TransformerLM(dataclasses.replace(cfg, decode=True, remat=False, attention_impl="xla"))
 
 
-# one compiled executable per (cfg, shapes, sampling mode): serving must not
-# re-trace per request
-_COMPILED: dict = {}
+# Two compile units, LRU-bounded:
+#   prefill — keyed by (cfg, B, P): one forward pass, cheap to compile;
+#   decode scan — keyed by (cfg, B, max_new bucket, greedy?, eos?): the
+#     expensive unit, SHARED across all prompt lengths because the cache
+#     shape is static [B, max_seq_len, ...] and the start position is a
+#     runtime value. Temperature is a runtime scalar (only greedy-vs-
+#     sampled changes the program). max_new is bucketed to multiples of 16
+#     and the output sliced, so sweeping max_new doesn't grow the cache.
+_MAX_CACHED = 32
+_COMPILED: "dict" = {}
 
 
-def _compiled_generate(cfg: TransformerConfig, P: int, max_new: int,
-                       temperature: float, eos_id: Optional[int]):
-    cache_key = (cfg, P, max_new, round(float(temperature), 6), eos_id)
-    fn = _COMPILED.get(cache_key)
-    if fn is not None:
-        return fn
-    model = decode_model(cfg)
-
-    def sample(logits, key):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1)
-        return jax.random.categorical(key, logits / temperature, axis=-1)
-
-    def run(params, prompt, key):
-        B = prompt.shape[0]
-        # prefill: one batched pass over the prompt builds the cache
-        positions = jnp.broadcast_to(jnp.arange(P), (B, P))
-        logits, state = model.apply(
-            {"params": params}, prompt, positions=positions, mutable=["cache"]
-        )
-        cache = state["cache"]
-        first = sample(logits[:, -1], key)
-
-        def step(carry, _):
-            cache, tok, pos, key, done = carry
-            key, sub = jax.random.split(key)
-            logits, state = model.apply(
-                {"params": params, "cache": cache},
-                tok[:, None],
-                positions=pos[:, None],
-                mutable=["cache"],
-            )
-            nxt = sample(logits[:, -1], sub)
-            if eos_id is not None:
-                nxt = jnp.where(done, eos_id, nxt)
-                done = jnp.logical_or(done, nxt == eos_id)
-            return (state["cache"], nxt, pos + 1, key, done), tok
-
-        done0 = jnp.zeros((B,), bool) if eos_id is None else (first == eos_id)
-        (_, last, _, _, _), toks = jax.lax.scan(
-            step,
-            (cache, first, jnp.full((B,), P, jnp.int32), key, done0),
-            None,
-            length=max_new - 1,
-        )
-        return jnp.concatenate([toks.swapaxes(0, 1), last[:, None]], axis=1)
-
-    fn = jax.jit(run)
-    _COMPILED[cache_key] = fn
+def _lru_get(key_, build):
+    fn = _COMPILED.get(key_)
+    if fn is None:
+        fn = build()
+        _COMPILED[key_] = fn
+        while len(_COMPILED) > _MAX_CACHED:
+            _COMPILED.pop(next(iter(_COMPILED)))
+    else:
+        _COMPILED[key_] = _COMPILED.pop(key_)  # refresh LRU order
     return fn
+
+
+def _sample(logits, key, temperature):
+    greedy = jnp.argmax(logits, axis=-1)
+    sampled = jax.random.categorical(key, logits / jnp.maximum(temperature, 1e-6), axis=-1)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
+def _prefill_fn(cfg: TransformerConfig, B: int, P: int):
+    def build():
+        model = decode_model(cfg)
+
+        def run(params, prompt):
+            positions = jnp.broadcast_to(jnp.arange(P), (B, P))
+            logits, state = model.apply(
+                {"params": params}, prompt, positions=positions, mutable=["cache"]
+            )
+            return state["cache"], logits[:, -1]
+
+        return jax.jit(run)
+
+    return _lru_get(("prefill", cfg, B, P), build)
+
+
+def _decode_fn(cfg: TransformerConfig, B: int, max_new: int, sampled: bool,
+               eos_id: Optional[int]):
+    def build():
+        model = decode_model(cfg)
+
+        def run(params, cache, first_logits, pos0, key, temperature):
+            key, sub = jax.random.split(key)
+            temp = temperature if sampled else jnp.float32(0.0)
+            first = _sample(first_logits, sub, temp)
+
+            def step(carry, _):
+                cache, tok, pos, key, done = carry
+                key, sub = jax.random.split(key)
+                logits, state = model.apply(
+                    {"params": params, "cache": cache},
+                    tok[:, None],
+                    positions=pos[:, None],
+                    mutable=["cache"],
+                )
+                nxt = _sample(logits[:, -1], sub, temp)
+                if eos_id is not None:
+                    nxt = jnp.where(done, eos_id, nxt)
+                    done = jnp.logical_or(done, nxt == eos_id)
+                return (state["cache"], nxt, pos + 1, key, done), tok
+
+            done0 = jnp.zeros((B,), bool) if eos_id is None else (first == eos_id)
+            (_, last, _, _, _), toks = jax.lax.scan(
+                step, (cache, first, pos0, key, done0), None, length=max_new - 1
+            )
+            return jnp.concatenate([toks.swapaxes(0, 1), last[:, None]], axis=1)
+
+        return jax.jit(run)
+
+    return _lru_get(("decode", cfg, B, max_new, sampled, eos_id), build)
 
 
 def generate(
@@ -99,10 +126,12 @@ def generate(
     """Generate [B, max_new_tokens] continuations of ``prompt`` [B, P].
 
     temperature 0 = greedy; otherwise categorical sampling at the given
-    temperature. When ``eos_id`` is set, positions after a sampled EOS are
-    filled with EOS (the scan still runs to full length — static shapes).
-    Compiled once per (cfg, P, max_new_tokens, sampling mode) and cached."""
+    temperature (a runtime scalar — no recompile per value). When
+    ``eos_id`` is set, positions after a sampled EOS are filled with EOS
+    (the scan still runs to full length — static shapes)."""
     B, P = prompt.shape
+    if P < 1:
+        raise ValueError("prompt must contain at least one token")
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if P + max_new_tokens > cfg.max_seq_len:
@@ -110,9 +139,15 @@ def generate(
             f"prompt {P} + new {max_new_tokens} exceeds max_seq_len {cfg.max_seq_len}"
         )
     key = key if key is not None else jax.random.PRNGKey(0)
-    return _compiled_generate(cfg, P, max_new_tokens, temperature, eos_id)(
-        params, prompt, key
+    # bucket the scan length so distinct max_new values share an executable
+    bucket = min(-(-max_new_tokens // 16) * 16, cfg.max_seq_len - P)
+    bucket = max(bucket, max_new_tokens)
+    cache, first_logits = _prefill_fn(cfg, B, P)(params, prompt)
+    out = _decode_fn(cfg, B, bucket, temperature > 0.0, eos_id)(
+        params, cache, first_logits, jnp.full((B,), P, jnp.int32), key,
+        jnp.float32(temperature),
     )
+    return out[:, :max_new_tokens]
 
 
 def generate_text(

@@ -32,6 +32,7 @@ def test_found_all_platform_examples():
         "fednlp/text_classification/fedml_config.yaml",
         "federated_analytics/heavy_hitter/fedml_config.yaml",
         "deploy/quick_start/main.py",
+        "deploy/llm_endpoint/main.py",
         "cross_device/main.py",
         "launch/hello_job/job.yaml",
     ]
@@ -99,6 +100,14 @@ def test_llm_moe_example_runs():
     r = _run(s, "--cf", "fedml_config.yaml", timeout=900)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "moe train done" in r.stdout
+
+
+@pytest.mark.slow
+def test_llm_endpoint_example_runs():
+    s = os.path.join(EXAMPLES, "deploy", "llm_endpoint", "main.py")
+    r = _run(s, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "llm endpoint example done" in r.stdout
 
 
 @pytest.mark.slow

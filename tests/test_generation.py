@@ -100,3 +100,39 @@ def test_llm_predictor_serves_text():
     assert isinstance(out["text"], str) and len(out["text"]) > 0
     # greedy: same prompt, same reply
     assert pred.predict({"prompt": "the quick"})["text"] == out["text"]
+
+
+def test_decode_executable_shared_across_prompt_lengths():
+    """The expensive decode scan compiles once and is reused for different
+    prompt lengths (only prefill is per-P)."""
+    from fedml_tpu.train.llm import generation
+
+    generation._COMPILED.clear()
+    params = _params()
+    generate(params, CFG, jnp.zeros((1, 3), jnp.int32), 5)
+    decode_keys = [k for k in generation._COMPILED if k[0] == "decode"]
+    assert len(decode_keys) == 1
+    generate(params, CFG, jnp.zeros((1, 7), jnp.int32), 5)  # new P, same bucket
+    decode_keys = [k for k in generation._COMPILED if k[0] == "decode"]
+    assert len(decode_keys) == 1  # shared executable
+    prefill_keys = [k for k in generation._COMPILED if k[0] == "prefill"]
+    assert len(prefill_keys) == 2
+
+
+def test_temperature_is_runtime_no_recompile():
+    from fedml_tpu.train.llm import generation
+
+    generation._COMPILED.clear()
+    params = _params()
+    prompt = jnp.asarray([[3, 4, 5]], jnp.int32)
+    a = generate(params, CFG, prompt, 5, temperature=0.7, key=jax.random.PRNGKey(0))
+    b = generate(params, CFG, prompt, 5, temperature=1.3, key=jax.random.PRNGKey(0))
+    decode_keys = [k for k in generation._COMPILED if k[0] == "decode"]
+    assert len(decode_keys) == 1  # temperature did not key a new executable
+    assert a.shape == b.shape
+
+
+def test_empty_prompt_rejected():
+    params = _params()
+    with pytest.raises(ValueError, match="at least one token"):
+        generate(params, CFG, jnp.zeros((1, 0), jnp.int32), 4)
