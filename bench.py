@@ -165,6 +165,7 @@ def _bench_llm_tpu(reps: int = 10):
         "n_params": n_params,
         "device": getattr(dev, "device_kind", str(dev)),
         "shape": dict(d_model=d_model, n_layers=n_layers, vocab=vocab, seq=seq, bs=bs),
+        "cfg_params": (cfg, params),  # reused by the decode bench (not printed)
     }
 
 
@@ -254,6 +255,31 @@ def _bench_llm_torch_cpu(shape, budget_s: float = 150.0) -> float | None:
     except Exception as e:
         print(f"warning: torch-CPU LLM baseline failed: {e}", file=sys.stderr)
         return None
+
+
+def _bench_llm_decode_tpu(params_holder, reps: int = 4):
+    """Autoregressive decode throughput (serving path): tokens/sec of the
+    KV-cache scan on the same llama model the train bench builds. Each rep
+    uses a distinct prompt so the platform cannot dedupe executions."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.train.llm.generation import generate
+
+    cfg, params = params_holder
+    bs, P, new = 4, 64, 128
+    rng = np.random.default_rng(1)
+    prompts = [
+        jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, P)).astype(np.int32))
+        for _ in range(reps + 1)
+    ]
+    # warmup compiles prefill + the shared decode scan
+    jax.block_until_ready(generate(params, cfg, prompts[0], new))
+    t0 = time.perf_counter()
+    outs = [generate(params, cfg, p, new) for p in prompts[1:]]
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+    return {"decode_tokens_per_sec": bs * new * reps / dt, "bs": bs, "new": new}
 
 
 # --- workload A: ResNet-56 / CIFAR-10 local SGD ------------------------------
@@ -413,6 +439,7 @@ def _probe_backend(timeout_s: int = 180) -> None:
 def main() -> None:
     _probe_backend()
     llm = _bench_llm_tpu()
+    decode = _bench_llm_decode_tpu(llm.pop("cfg_params"))
     resnet = _bench_resnet_tpu()
     llm_cpu_tokens = _bench_llm_torch_cpu(llm["shape"])
     resnet_cpu_images = _bench_resnet_torch_cpu()
@@ -430,6 +457,7 @@ def main() -> None:
         "resnet56_vs_torch_cpu": (
             round(resnet_images_per_sec / resnet_cpu_images, 2) if resnet_cpu_images else None
         ),
+        "decode_tokens_per_sec": round(decode["decode_tokens_per_sec"], 1),
     }
     print(json.dumps(out))
 

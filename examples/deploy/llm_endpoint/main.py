@@ -39,6 +39,7 @@ def main() -> None:
         )["params"]
         predictor = LLMPredictor(params, cfg, tok, default_max_new_tokens=8)
 
+    predictor.warmup()  # compile before serving so no request pays it
     mgr = EndpointManager()
     ep = mgr.deploy("llm", lambda: predictor)
     try:

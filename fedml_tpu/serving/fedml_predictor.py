@@ -71,24 +71,37 @@ class LLMPredictor(FedMLPredictor):
     Build from a checkpoint dir (HF llama safetensors + tokenizer.json) or
     pass (params, cfg, tokenizer) directly."""
 
-    def __init__(self, params, cfg, tokenizer, default_max_new_tokens: int = 64):
+    def __init__(self, params, cfg, tokenizer, default_max_new_tokens: int = 64,
+                 eos_id: "int | None" = None):
         self._params = params
         self._cfg = cfg
         self._tok = tokenizer
         self._max_new = int(default_max_new_tokens)
-        # stop at the tokenizer's end-of-sequence token when it defines one
-        self._eos_id = getattr(tokenizer, "special_tokens", {}).get("</s>")
+        # stop token: explicit id wins (from_checkpoint reads config.json's
+        # eos_token_id); else fall back to a '</s>' special if defined
+        self._eos_id = eos_id if eos_id is not None else getattr(
+            tokenizer, "special_tokens", {}
+        ).get("</s>")
         self._ready = True  # flips False->True around warmup() when used
 
     @classmethod
     def from_checkpoint(cls, path: str, **kw) -> "LLMPredictor":
+        import json
+        import os
+
         from ..train.llm.checkpoint_import import config_from_hf, import_hf_checkpoint
         from ..train.llm.data import load_or_train_tokenizer
-        import os
 
         cfg = config_from_hf(path)
         params = import_hf_checkpoint(path, cfg)
         tok = load_or_train_tokenizer(None, os.path.join(path, "tokenizer.json"))
+        if "eos_id" not in kw:
+            # config.json's eos_token_id is authoritative (token STRINGS
+            # vary across llama generations; the id does not lie)
+            with open(os.path.join(path, "config.json")) as f:
+                eos = json.load(f).get("eos_token_id")
+            if isinstance(eos, int):
+                kw["eos_id"] = eos
         return cls(params, cfg, tok, **kw)
 
     def warmup(self, example_prompt: str = "warmup") -> None:

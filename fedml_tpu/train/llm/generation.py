@@ -41,18 +41,24 @@ def decode_model(cfg: TransformerConfig) -> TransformerLM:
 #     and the output sliced, so sweeping max_new doesn't grow the cache.
 _MAX_CACHED = 32
 _COMPILED: "dict" = {}
+_CACHE_LOCK = __import__("threading").Lock()
 
 
 def _lru_get(key_, build):
-    fn = _COMPILED.get(key_)
-    if fn is None:
-        fn = build()
-        _COMPILED[key_] = fn
+    # serving runs under ThreadingHTTPServer: eviction/refresh pops race
+    # without the lock (build() itself runs outside it — compiling under a
+    # lock would serialize unrelated requests)
+    with _CACHE_LOCK:
+        fn = _COMPILED.get(key_)
+        if fn is not None:
+            _COMPILED[key_] = _COMPILED.pop(key_)  # refresh LRU order
+            return fn
+    fn = build()
+    with _CACHE_LOCK:
+        _COMPILED.setdefault(key_, fn)
         while len(_COMPILED) > _MAX_CACHED:
             _COMPILED.pop(next(iter(_COMPILED)))
-    else:
-        _COMPILED[key_] = _COMPILED.pop(key_)  # refresh LRU order
-    return fn
+        return _COMPILED.get(key_, fn)
 
 
 def _sample(logits, key, temperature):
@@ -140,8 +146,8 @@ def generate(
         )
     key = key if key is not None else jax.random.PRNGKey(0)
     # bucket the scan length so distinct max_new values share an executable
+    # (the validation above guarantees the min is still >= max_new_tokens)
     bucket = min(-(-max_new_tokens // 16) * 16, cfg.max_seq_len - P)
-    bucket = max(bucket, max_new_tokens)
     cache, first_logits = _prefill_fn(cfg, B, P)(params, prompt)
     out = _decode_fn(cfg, B, bucket, temperature > 0.0, eos_id)(
         params, cache, first_logits, jnp.full((B,), P, jnp.int32), key,
