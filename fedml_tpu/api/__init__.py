@@ -202,3 +202,143 @@ def model_create(model_name: str, dataset: str = "mnist", output_path: Optional[
     leaves = {f"p{i}": np.asarray(l) for i, l in enumerate(jax.tree.leaves(model.params))}
     np.savez(out, **leaves)
     return out
+
+
+# --- run inspection (reference api run_list/run_status/run_logs) ------------
+
+def run_list() -> Dict[str, Dict[int, str]]:
+    """All runs this process launched: {run_id: {edge_id: status}}. Reads
+    the master runner's live status table (single source of truth)."""
+    statuses = _launch_manager().master.statuses
+    return {
+        rid: {e: st.status for e, st in per_edge.items()}
+        for rid, per_edge in statuses.items()
+    }
+
+
+def run_status(run_id: str) -> Dict[int, Any]:
+    """Per-edge RunStatus records for one run (reference run_status)."""
+    statuses = _launch_manager().master.statuses
+    if run_id not in statuses:
+        raise KeyError(f"unknown run {run_id!r}; known: {sorted(statuses)}")
+    return statuses[run_id]
+
+
+def run_logs(run_id: str, edge_id: int = 0, tail_lines: int = 100) -> str:
+    """Tail of one edge's log for a run (reference run_logs; local files
+    instead of the MLOps log service)."""
+    st = run_status(run_id).get(edge_id)
+    if st is None or not st.log_path or not os.path.exists(st.log_path):
+        return ""
+    with open(st.log_path, errors="replace") as f:
+        return "".join(f.readlines()[-tail_lines:])
+
+
+# --- storage (reference upload/download/list_storage_objects/delete over R2;
+# here the local object store is the backend) --------------------------------
+
+def _storage_index_path(store) -> str:
+    return os.path.join(store.root, "_storage_index.json")
+
+
+def _storage():
+    from ..core.distributed.communication.mqtt_s3.object_store import LocalObjectStore
+
+    return LocalObjectStore()
+
+
+def _load_index(store) -> Dict[str, str]:
+    import json
+
+    p = _storage_index_path(store)
+    if os.path.exists(p):
+        with open(p) as f:
+            return json.load(f)
+    return {}
+
+
+def _save_index(store, index: Dict[str, str]) -> None:
+    import json
+
+    with open(_storage_index_path(store), "w") as f:
+        json.dump(index, f)
+
+
+def storage_upload(data_path: str, name: Optional[str] = None) -> str:
+    """Store a file under a name; returns the name (reference api.upload)."""
+    store = _storage()
+    name = name or os.path.basename(data_path)
+    url = store.write_file(name, data_path)
+    index = _load_index(store)
+    old = index.get(name)
+    if old:  # re-upload under the same name: drop the orphaned blob
+        old_path = old[len("file://"):] if old.startswith("file://") else old
+        if os.path.exists(old_path):
+            os.remove(old_path)
+    index[name] = url
+    _save_index(store, index)
+    return name
+
+def storage_download(name: str, dest_path: Optional[str] = None) -> str:
+    store = _storage()
+    index = _load_index(store)
+    if name not in index:
+        raise KeyError(f"no stored object named {name!r}")
+    return store.fetch_file(index[name], dest_path or name)
+
+
+def storage_list() -> List[str]:
+    return sorted(_load_index(_storage()))
+
+
+def storage_delete(name: str) -> None:
+    store = _storage()
+    index = _load_index(store)
+    url = index.pop(name, None)
+    if url is None:
+        raise KeyError(f"no stored object named {name!r}")
+    path = url[len("file://"):] if url.startswith("file://") else url
+    if os.path.exists(path):
+        os.remove(path)
+    _save_index(store, index)
+
+
+# --- model serving (reference model_deploy/model_run/endpoint_delete) -------
+
+_ENDPOINT_MANAGER = None
+
+
+def _endpoints():
+    global _ENDPOINT_MANAGER
+    if _ENDPOINT_MANAGER is None:
+        from ..serving.endpoint import EndpointManager
+
+        _ENDPOINT_MANAGER = EndpointManager()
+    return _ENDPOINT_MANAGER
+
+
+def model_deploy(endpoint_name: str, predictor_spec: str, num_replicas: int = 1,
+                 model_path: Optional[str] = None, isolated: bool = True):
+    """Deploy an inference endpoint (reference api.model_deploy ->
+    device_model_deployment). isolated=True runs subprocess replicas."""
+    mgr = _endpoints()
+    if isolated:
+        return mgr.deploy_isolated(endpoint_name, predictor_spec, num_replicas, model_path=model_path)
+    from ..serving.replica_main import resolve_factory
+
+    factory = resolve_factory(predictor_spec)
+    if model_path:  # same contract as replica_main: factory(model_path)
+        return mgr.deploy(endpoint_name, lambda: factory(model_path), num_replicas)
+    return mgr.deploy(endpoint_name, factory, num_replicas)
+
+
+def model_run(endpoint_name: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Send one inference request to a deployed endpoint (reference model_run)."""
+    ep = _endpoints().endpoints.get(endpoint_name)
+    if ep is None:
+        raise KeyError(f"no endpoint {endpoint_name!r}; deployed: {sorted(_endpoints().endpoints)}")
+    return ep.predict(payload)
+
+
+def endpoint_delete(endpoint_name: str) -> None:
+    _endpoints().undeploy(endpoint_name)

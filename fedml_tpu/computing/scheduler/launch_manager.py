@@ -63,6 +63,7 @@ class FedMLLaunchManager:
             meta={"job_name": config.job_name, "project": config.project_name},
         )
         log.info("launching job %s run=%s on edges %s", config.job_name, run_id, edge_ids)
+        # run history lives in master.statuses (api.run_list/run_status)
         return self.master.dispatch(
             {
                 "run_id": run_id,

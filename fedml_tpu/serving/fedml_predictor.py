@@ -100,6 +100,8 @@ class LLMPredictor(FedMLPredictor):
             # vary across llama generations; the id does not lie)
             with open(os.path.join(path, "config.json")) as f:
                 eos = json.load(f).get("eos_token_id")
+            if isinstance(eos, list) and eos:  # llama-3 style multi-EOS
+                eos = eos[0]
             if isinstance(eos, int):
                 kw["eos_id"] = eos
         return cls(params, cfg, tok, **kw)

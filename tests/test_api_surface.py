@@ -1,0 +1,59 @@
+"""Programmatic api surface: run inspection, storage, serving verbs."""
+
+import os
+
+import numpy as np
+import pytest
+
+from fedml_tpu import api
+
+
+def test_storage_roundtrip(tmp_path):
+    src = tmp_path / "blob.bin"
+    src.write_bytes(b"hello storage" * 100)
+    name = api.storage_upload(str(src), name="test_blob_api")
+    try:
+        assert name in api.storage_list()
+        dest = tmp_path / "back.bin"
+        api.storage_download(name, str(dest))
+        assert dest.read_bytes() == src.read_bytes()
+    finally:
+        api.storage_delete(name)
+    assert name not in api.storage_list()
+    with pytest.raises(KeyError):
+        api.storage_download(name, str(tmp_path / "x"))
+
+
+def test_model_deploy_run_delete():
+    api.model_deploy(
+        "api_test_ep",
+        "fedml_tpu.serving.replica_controller:create_echo_predictor",
+        num_replicas=1,
+    )
+    try:
+        out = api.model_run("api_test_ep", {"x": [1, 2, 3]})
+        assert out["echo"] == {"x": [1, 2, 3]}
+    finally:
+        api.endpoint_delete("api_test_ep")
+    with pytest.raises(KeyError):
+        api.model_run("api_test_ep", {})
+
+
+@pytest.mark.slow
+def test_run_list_status_logs(tmp_path):
+    # launch the hello_job example through the api, then inspect it
+    job = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "launch", "hello_job", "job.yaml",
+    )
+    statuses = api.launch_job(job, timeout_s=300)
+    runs = api.run_list()
+    assert runs, "run history empty after launch"
+    run_id = next(iter(runs))
+    assert runs[run_id][0] == "FINISHED"
+    st = api.run_status(run_id)[0]
+    assert st.status == "FINISHED"
+    logs = api.run_logs(run_id, 0)
+    assert isinstance(logs, str)
+    with pytest.raises(KeyError):
+        api.run_status("nonexistent")
