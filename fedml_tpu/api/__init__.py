@@ -247,6 +247,28 @@ def _storage():
     return LocalObjectStore()
 
 
+class _IndexLock:
+    """Cross-process read-modify-write guard for the shared name index
+    (the store root is a fixed tempdir shared by every process on the box);
+    atomic replace on save so readers never see a torn file."""
+
+    def __init__(self, store):
+        self.path = _storage_index_path(store) + ".lock"
+
+    def __enter__(self):
+        import fcntl
+
+        self._f = open(self.path, "w")
+        fcntl.flock(self._f, fcntl.LOCK_EX)
+        return self
+
+    def __exit__(self, *exc):
+        import fcntl
+
+        fcntl.flock(self._f, fcntl.LOCK_UN)
+        self._f.close()
+
+
 def _load_index(store) -> Dict[str, str]:
     import json
 
@@ -259,9 +281,13 @@ def _load_index(store) -> Dict[str, str]:
 
 def _save_index(store, index: Dict[str, str]) -> None:
     import json
+    import tempfile
 
-    with open(_storage_index_path(store), "w") as f:
+    p = _storage_index_path(store)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(p))
+    with os.fdopen(fd, "w") as f:
         json.dump(index, f)
+    os.replace(tmp, p)  # atomic: readers never see a partial index
 
 
 def storage_upload(data_path: str, name: Optional[str] = None) -> str:
@@ -269,14 +295,13 @@ def storage_upload(data_path: str, name: Optional[str] = None) -> str:
     store = _storage()
     name = name or os.path.basename(data_path)
     url = store.write_file(name, data_path)
-    index = _load_index(store)
-    old = index.get(name)
-    if old:  # re-upload under the same name: drop the orphaned blob
-        old_path = old[len("file://"):] if old.startswith("file://") else old
-        if os.path.exists(old_path):
-            os.remove(old_path)
-    index[name] = url
-    _save_index(store, index)
+    with _IndexLock(store):
+        index = _load_index(store)
+        old = index.get(name)
+        if old:  # re-upload under the same name: drop the orphaned blob
+            store.delete(old)
+        index[name] = url
+        _save_index(store, index)
     return name
 
 def storage_download(name: str, dest_path: Optional[str] = None) -> str:
@@ -293,14 +318,13 @@ def storage_list() -> List[str]:
 
 def storage_delete(name: str) -> None:
     store = _storage()
-    index = _load_index(store)
-    url = index.pop(name, None)
-    if url is None:
-        raise KeyError(f"no stored object named {name!r}")
-    path = url[len("file://"):] if url.startswith("file://") else url
-    if os.path.exists(path):
-        os.remove(path)
-    _save_index(store, index)
+    with _IndexLock(store):
+        index = _load_index(store)
+        url = index.pop(name, None)
+        if url is None:
+            raise KeyError(f"no stored object named {name!r}")
+        store.delete(url)
+        _save_index(store, index)
 
 
 # --- model serving (reference model_deploy/model_run/endpoint_delete) -------

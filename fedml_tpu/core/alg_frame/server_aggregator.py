@@ -106,7 +106,11 @@ class ServerAggregator(abc.ABC):
             return
         from ..contribution.contribution_assessor_manager import ContributionAssessorManager
 
-        manager = ContributionAssessorManager(self.args)
+        # one manager for the aggregator's lifetime: the multi-round
+        # accumulation (get_final_contribution) needs cross-round history
+        manager = getattr(self, "_contribution_manager", None)
+        if manager is None:
+            manager = self._contribution_manager = ContributionAssessorManager(self.args)
         if not manager.is_enabled():
             return
         model_list = Context().get(Context.KEY_CLIENT_MODEL_LIST)

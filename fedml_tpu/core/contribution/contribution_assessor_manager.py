@@ -69,11 +69,63 @@ def gtg_shapley(
     return list(phi / counts)
 
 
+def exact_shapley(
+    model_list: Sequence[Tuple[float, PyTree]],
+    metric_fn: Callable[[PyTree], float],
+    empty_metric: float = 0.0,
+) -> List[float]:
+    """Exact Shapley value over all 2^K subsets (reference:
+    mr_shapley_value.py's per-round SV; Song et al. 2019). Feasible for the
+    per-round K (sampled clients), since subset models are one jitted
+    weighted average each."""
+    k = len(model_list)
+    v: Dict[frozenset, float] = {frozenset(): empty_metric}
+    for r in range(1, k + 1):
+        for subset in itertools.combinations(range(k), r):
+            v[frozenset(subset)] = metric_fn(
+                weighted_average([model_list[i] for i in subset])
+            )
+    phi = [0.0] * k
+    for i in range(k):
+        others = [j for j in range(k) if j != i]
+        for r in range(k):
+            w = math.factorial(r) * math.factorial(k - r - 1) / math.factorial(k)
+            for subset in itertools.combinations(others, r):
+                s = frozenset(subset)
+                phi[i] += w * (v[s | {i}] - v[s])
+    return phi
+
+
+def multi_round_shapley(
+    per_round_values: Sequence[Dict[Any, float]], mode: str = "sum"
+) -> Dict[Any, float]:
+    """Accumulate per-round Shapley values into one valuation per CLIENT ID
+    (reference mr_shapley_value.py aggregation modes). Rounds sample
+    different client subsets, so values are keyed by client id — positional
+    accumulation would mix different clients across rounds. 'sum' adds
+    rounds; 'last_round_weighted' discounts early rounds linearly toward
+    the end (later rounds move the final model most)."""
+    if not per_round_values:
+        return {}
+    n = len(per_round_values)
+    if mode == "sum":
+        weights = [1.0] * n
+    elif mode == "last_round_weighted":
+        weights = [2.0 * (r + 1) / (n * (n + 1)) for r in range(n)]
+    else:
+        raise ValueError(f"unknown multi-round mode {mode!r}")
+    out: Dict[Any, float] = {}
+    for w, round_vals in zip(weights, per_round_values):
+        for cid, v in round_vals.items():
+            out[cid] = out.get(cid, 0.0) + w * v
+    return out
+
+
 class ContributionAssessorManager:
     def __init__(self, args: Any):
         self.args = args
         self.metric = str(getattr(args, "contribution_alg", "")).lower()
-        self._history: List[List[float]] = []
+        self._history: List[Dict[Any, float]] = []
 
     def is_enabled(self) -> bool:
         return bool(getattr(self.args, "enable_contribution", False))
@@ -89,12 +141,34 @@ class ContributionAssessorManager:
             return None
         if self.metric in ("loo", "leave_one_out"):
             vals = leave_one_out(model_list, metric_fn)
+        elif self.metric in ("shapley", "mr_shapley", "multi_round"):
+            if len(model_list) > 12:
+                # 2^K subset evaluations: unguarded exact SV would hang the
+                # round; GTG's permutation sampling bounds the work instead
+                logging.warning(
+                    "exact Shapley over %d clients is 2^%d subsets; using GTG",
+                    len(model_list), len(model_list),
+                )
+                vals = gtg_shapley(model_list, metric_fn, last_round_metric)
+            else:
+                vals = exact_shapley(model_list, metric_fn, last_round_metric)
         else:
             vals = gtg_shapley(model_list, metric_fn, last_round_metric)
-        self._history.append(vals)
-        logging.info("contribution values: %s", vals)
+        # key by client id (Context carries this round's sampled ids) so
+        # multi-round accumulation never mixes different clients
+        from ..alg_frame.context import Context
+
+        ids = Context().get("client_indexes_of_round")
+        if ids is None or len(ids) != len(vals):
+            ids = list(range(len(vals)))
+        self._history.append({cid: v for cid, v in zip(ids, vals)})
+        logging.info("contribution values: %s", self._history[-1])
         return vals
 
-    def get_history(self) -> List[List[float]]:
-        """Multi-round accumulated valuations (reference: multi-round Shapley)."""
+    def get_history(self) -> List[Dict[Any, float]]:
+        """Per-round valuations keyed by client id."""
         return self._history
+
+    def get_final_contribution(self, mode: str = "sum") -> Dict[Any, float]:
+        """Cross-round accumulated valuation (reference mr_shapley_value.py)."""
+        return multi_round_shapley(self._history, mode)

@@ -55,10 +55,21 @@ class LocalObjectStore:
     def fetch_file(self, url: str, dst_path: str) -> str:
         import shutil
 
-        path = url[len("file://") :] if url.startswith("file://") else url
+        path = self.local_path(url)
         os.makedirs(os.path.dirname(os.path.abspath(dst_path)), exist_ok=True)
         shutil.copyfile(path, dst_path)
         return dst_path
+
+    @staticmethod
+    def local_path(url: str) -> str:
+        """The filesystem path behind a store url (single place that knows
+        the scheme)."""
+        return url[len("file://") :] if url.startswith("file://") else url
+
+    def delete(self, url: str) -> None:
+        path = self.local_path(url)
+        if os.path.exists(path):
+            os.remove(path)
 
 
 class S3ObjectStore:  # pragma: no cover - requires boto3 + credentials

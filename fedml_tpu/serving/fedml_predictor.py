@@ -72,7 +72,7 @@ class LLMPredictor(FedMLPredictor):
     pass (params, cfg, tokenizer) directly."""
 
     def __init__(self, params, cfg, tokenizer, default_max_new_tokens: int = 64,
-                 eos_id: "int | None" = None):
+                 eos_id: "int | tuple | None" = None):
         self._params = params
         self._cfg = cfg
         self._tok = tokenizer
@@ -100,9 +100,10 @@ class LLMPredictor(FedMLPredictor):
             # vary across llama generations; the id does not lie)
             with open(os.path.join(path, "config.json")) as f:
                 eos = json.load(f).get("eos_token_id")
-            if isinstance(eos, list) and eos:  # llama-3 style multi-EOS
-                eos = eos[0]
-            if isinstance(eos, int):
+            if isinstance(eos, list) and eos:
+                # llama-3 style multi-EOS: generation stops on ANY of them
+                kw["eos_id"] = tuple(int(e) for e in eos)
+            elif isinstance(eos, int):
                 kw["eos_id"] = eos
         return cls(params, cfg, tok, **kw)
 

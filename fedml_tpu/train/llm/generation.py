@@ -84,9 +84,12 @@ def _prefill_fn(cfg: TransformerConfig, B: int, P: int):
 
 
 def _decode_fn(cfg: TransformerConfig, B: int, max_new: int, sampled: bool,
-               eos_id: Optional[int]):
+               eos_ids: Optional[Tuple[int, ...]]):
     def build():
         model = decode_model(cfg)
+
+        def is_eos(tok):
+            return jnp.isin(tok, jnp.asarray(eos_ids))
 
         def run(params, cache, first_logits, pos0, key, temperature):
             key, sub = jax.random.split(key)
@@ -103,12 +106,12 @@ def _decode_fn(cfg: TransformerConfig, B: int, max_new: int, sampled: bool,
                     mutable=["cache"],
                 )
                 nxt = _sample(logits[:, -1], sub, temp)
-                if eos_id is not None:
-                    nxt = jnp.where(done, eos_id, nxt)
-                    done = jnp.logical_or(done, nxt == eos_id)
+                if eos_ids is not None:
+                    nxt = jnp.where(done, eos_ids[0], nxt)
+                    done = jnp.logical_or(done, is_eos(nxt))
                 return (state["cache"], nxt, pos + 1, key, done), tok
 
-            done0 = jnp.zeros((B,), bool) if eos_id is None else (first == eos_id)
+            done0 = jnp.zeros((B,), bool) if eos_ids is None else is_eos(first)
             (_, last, _, _, _), toks = jax.lax.scan(
                 step, (cache, first, pos0, key, done0), None, length=max_new - 1
             )
@@ -116,7 +119,7 @@ def _decode_fn(cfg: TransformerConfig, B: int, max_new: int, sampled: bool,
 
         return jax.jit(run)
 
-    return _lru_get(("decode", cfg, B, max_new, sampled, eos_id), build)
+    return _lru_get(("decode", cfg, B, max_new, sampled, eos_ids), build)
 
 
 def generate(
@@ -132,10 +135,14 @@ def generate(
     """Generate [B, max_new_tokens] continuations of ``prompt`` [B, P].
 
     temperature 0 = greedy; otherwise categorical sampling at the given
-    temperature (a runtime scalar — no recompile per value). When
-    ``eos_id`` is set, positions after a sampled EOS are filled with EOS
-    (the scan still runs to full length — static shapes)."""
+    temperature (a runtime scalar — no recompile per value). ``eos_id``
+    may be one id or a sequence (llama-3 instruct models stop on
+    <|eot_id|> while config.json lists several); positions after any EOS
+    are filled (the scan still runs to full length — static shapes)."""
     B, P = prompt.shape
+    eos_ids: Optional[Tuple[int, ...]] = None
+    if eos_id is not None:
+        eos_ids = tuple(eos_id) if isinstance(eos_id, (list, tuple)) else (int(eos_id),)
     if P < 1:
         raise ValueError("prompt must contain at least one token")
     if max_new_tokens < 1:
@@ -149,7 +156,7 @@ def generate(
     # (the validation above guarantees the min is still >= max_new_tokens)
     bucket = min(-(-max_new_tokens // 16) * 16, cfg.max_seq_len - P)
     cache, first_logits = _prefill_fn(cfg, B, P)(params, prompt)
-    out = _decode_fn(cfg, B, bucket, temperature > 0.0, eos_id)(
+    out = _decode_fn(cfg, B, bucket, temperature > 0.0, eos_ids)(
         params, cache, first_logits, jnp.full((B,), P, jnp.int32), key,
         jnp.float32(temperature),
     )

@@ -136,3 +136,15 @@ def test_empty_prompt_rejected():
     params = _params()
     with pytest.raises(ValueError, match="at least one token"):
         generate(params, CFG, jnp.zeros((1, 0), jnp.int32), 4)
+
+
+def test_multi_eos_stops_on_any():
+    params = _params()
+    prompt = jnp.asarray([[5, 6]], jnp.int32)
+    # greedy first two tokens; declare BOTH as eos ids -> tail fills with
+    # the first id after the earliest hit
+    two = np.asarray(generate(params, CFG, prompt, 2))[0]
+    eos_ids = (int(two[0]), int(two[1]))
+    out = np.asarray(generate(params, CFG, prompt, 12, eos_id=eos_ids))[0]
+    assert out[0] == eos_ids[0]  # first token is an eos -> done immediately
+    assert np.all(out[1:] == eos_ids[0])
