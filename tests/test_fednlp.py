@@ -53,6 +53,7 @@ def _run_party(args, results, key):
     results[key] = fedml.FedMLRunner(args, device, dataset, model).run()
 
 
+@pytest.mark.slow
 def test_text_classifier_shapes_and_learns_centrally():
     """The model itself: int tokens in, [B, 20] logits out, pad-mask pooling;
     a few SGD steps reduce loss on the class-conditional surrogate."""

@@ -89,6 +89,7 @@ class TestAttentionImpls:
 
 
 class TestFSDPTrainStep:
+    @pytest.mark.slow
     def test_llm_trainer_loss_decreases_on_mesh(self, tmp_path):
         from fedml_tpu.train.llm.configurations import DatasetArguments, ExperimentArguments, ModelArguments
         from fedml_tpu.train.llm.llm_trainer import LLMTrainer

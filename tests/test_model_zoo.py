@@ -58,6 +58,7 @@ def test_split_pair():
     assert out.shape == (2, 10)
 
 
+@pytest.mark.slow
 def test_darts_has_arch_params():
     model = model_hub.create(_args("darts", "cifar10"))
     assert "arch" in model.params
