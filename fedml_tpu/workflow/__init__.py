@@ -1,4 +1,8 @@
+from .customized_jobs import ModelDeployJob, ModelInferenceJob, TrainJob
 from .jobs import CallableJob, Job, JobStatus, NullJob, ProcessJob
 from .workflow import Workflow
 
-__all__ = ["CallableJob", "Job", "JobStatus", "NullJob", "ProcessJob", "Workflow"]
+__all__ = [
+    "CallableJob", "Job", "JobStatus", "ModelDeployJob", "ModelInferenceJob",
+    "NullJob", "ProcessJob", "TrainJob", "Workflow",
+]

@@ -1,0 +1,134 @@
+"""Customized workflow jobs: train -> deploy -> inference chains.
+
+Reference: ``workflow/customized_jobs/{train_job,model_deploy_job,
+model_inference_job}.py`` — workflow nodes that wrap the MLOps launch/
+deploy/inference verbs. Here they wrap the local api surface, so a DAG can
+train a model, stand up an endpoint on the result, and query it, with each
+node's output feeding the next (the reference driver_example flow).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .jobs import Job, JobStatus
+
+
+class TrainJob(Job):
+    """Launch a job.yaml onto local edge agents (reference train_job.py).
+
+    ``model_output_path``: where the job's config says it saves the trained
+    model; included in this job's outputs (as "model_path") once the file
+    exists, so a downstream ModelDeployJob serves the just-trained model."""
+
+    def __init__(self, name: str, job_yaml: str, timeout_s: float = 600.0,
+                 model_output_path: Optional[str] = None):
+        super().__init__(name)
+        self.job_yaml = job_yaml
+        self.timeout_s = timeout_s
+        self.model_output_path = model_output_path
+
+    def run(self) -> None:
+        import os
+
+        from .. import api
+
+        self._status = JobStatus.RUNNING
+        try:
+            statuses = api.launch_job(self.job_yaml, timeout_s=self.timeout_s)
+            per_edge = {e: st.status for e, st in statuses.items()}
+            self.output = {"statuses": per_edge, "run_id": next(iter(statuses.values())).run_id}
+            if self.model_output_path and os.path.exists(self.model_output_path):
+                self.output["model_path"] = self.model_output_path
+            ok = all(s == "FINISHED" for s in per_edge.values())
+            self._status = JobStatus.FINISHED if ok else JobStatus.FAILED
+        except Exception as e:  # noqa: BLE001 - job boundary
+            self.output = {"error": repr(e)}
+            self._status = JobStatus.FAILED
+
+
+class ModelDeployJob(Job):
+    """Stand up an inference endpoint (reference model_deploy_job.py).
+
+    model_path may come from an upstream job's output (key "model_path")."""
+
+    def __init__(self, name: str, endpoint_name: str, predictor_spec: str,
+                 num_replicas: int = 1, model_path: Optional[str] = None,
+                 isolated: bool = True):
+        super().__init__(name)
+        self.endpoint_name = endpoint_name
+        self.predictor_spec = predictor_spec
+        self.num_replicas = num_replicas
+        self.model_path = model_path
+        self.isolated = isolated
+
+    def _resolve_model_path(self) -> Optional[str]:
+        if self.model_path:
+            return self.model_path
+        for upstream in self.input.values():
+            if isinstance(upstream, dict) and upstream.get("model_path"):
+                return upstream["model_path"]
+        return None
+
+    def run(self) -> None:
+        from .. import api
+
+        self._status = JobStatus.RUNNING
+        try:
+            api.model_deploy(
+                self.endpoint_name, self.predictor_spec, self.num_replicas,
+                model_path=self._resolve_model_path(), isolated=self.isolated,
+            )
+            self.output = {"endpoint_name": self.endpoint_name}
+            self._status = JobStatus.FINISHED
+        except Exception as e:  # noqa: BLE001 - job boundary
+            self.output = {"error": repr(e)}
+            self._status = JobStatus.FAILED
+
+    def cleanup(self) -> None:
+        from .. import api
+
+        try:
+            api.endpoint_delete(self.endpoint_name)
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+
+    def kill(self) -> None:
+        self.cleanup()
+        super().kill()
+
+
+class ModelInferenceJob(Job):
+    """Send request(s) to a deployed endpoint (reference
+    model_inference_job.py). The endpoint name may come from an upstream
+    ModelDeployJob's output."""
+
+    def __init__(self, name: str, payloads: List[Dict[str, Any]],
+                 endpoint_name: Optional[str] = None):
+        super().__init__(name)
+        self.payloads = payloads
+        self.endpoint_name = endpoint_name
+
+    def _resolve_endpoint(self) -> Optional[str]:
+        if self.endpoint_name:
+            return self.endpoint_name
+        for upstream in self.input.values():
+            if isinstance(upstream, dict) and upstream.get("endpoint_name"):
+                return upstream["endpoint_name"]
+        return None
+
+    def run(self) -> None:
+        from .. import api
+
+        self._status = JobStatus.RUNNING
+        endpoint = self._resolve_endpoint()
+        if endpoint is None:
+            self.output = {"error": "no endpoint_name given or inherited"}
+            self._status = JobStatus.FAILED
+            return
+        try:
+            self.output = {"replies": [api.model_run(endpoint, p) for p in self.payloads]}
+            self._status = JobStatus.FINISHED
+        except Exception as e:  # noqa: BLE001 - job boundary
+            self.output = {"error": repr(e)}
+            self._status = JobStatus.FAILED

@@ -42,6 +42,11 @@ class Job(abc.ABC):
     def kill(self) -> None:
         self._status = JobStatus.UNDETERMINED
 
+    def cleanup(self) -> None:
+        """Release external resources (endpoints, processes) when the
+        workflow aborts. Unlike kill(), this runs for jobs in ANY state —
+        a deploy job that already FINISHED still holds live replicas."""
+
     def append_input(self, input_job_name: str, input: Dict[str, Any]) -> None:
         self.input[input_job_name] = input
 

@@ -95,7 +95,10 @@ class Workflow:
                 for n in level:
                     job = self.jobs[n]["job"]
                     if job.status() == JobStatus.FAILED:
-                        self._kill_jobs([m["job"] for m in self.jobs.values()])
+                        all_jobs = [m["job"] for m in self.jobs.values()]
+                        self._kill_jobs(all_jobs)
+                        for j in all_jobs:  # finished jobs may hold live resources
+                            j.cleanup()
                         raise RuntimeError(f"workflow {self.name}: job {n} failed: {job.output}")
                     # chain outputs into dependents' inputs
                     for child, meta in self.jobs.items():

@@ -1,0 +1,87 @@
+"""Customized workflow jobs: train -> deploy -> inference chains."""
+
+import os
+
+import pytest
+
+from fedml_tpu.workflow.customized_jobs import (
+    ModelDeployJob,
+    ModelInferenceJob,
+    TrainJob,
+)
+from fedml_tpu.workflow.jobs import JobStatus
+from fedml_tpu.workflow.workflow import Workflow
+
+ECHO = "fedml_tpu.serving.replica_controller:create_echo_predictor"
+
+
+def test_deploy_then_inference_chain():
+    wf = Workflow("deploy_infer_chain")
+    deploy = ModelDeployJob("deploy", "wfjob_ep", ECHO, num_replicas=1)
+    infer = ModelInferenceJob("infer", [{"x": 1}, {"x": 2}])
+    wf.add_job(deploy)
+    wf.add_job(infer, dependencies=[deploy])
+    try:
+        wf.run()
+        assert deploy.status() == JobStatus.FINISHED
+        assert infer.status() == JobStatus.FINISHED
+        replies = infer.get_outputs()["replies"]
+        assert [r["echo"] for r in replies] == [{"x": 1}, {"x": 2}]
+    finally:
+        from fedml_tpu import api
+
+        api.endpoint_delete("wfjob_ep")
+
+
+def test_inference_without_endpoint_fails_cleanly():
+    job = ModelInferenceJob("lonely", [{"x": 1}])
+    job.run()
+    assert job.status() == JobStatus.FAILED
+    assert "endpoint" in job.get_outputs()["error"]
+
+
+@pytest.mark.slow
+def test_full_train_deploy_infer_workflow():
+    job_yaml = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "launch", "hello_job", "job.yaml",
+    )
+    wf = Workflow("train_deploy_infer")
+    train = TrainJob("train", job_yaml, timeout_s=300)
+    deploy = ModelDeployJob("deploy", "wfjob_full_ep", ECHO)
+    infer = ModelInferenceJob("infer", [{"q": "ping"}])
+    wf.add_job(train)
+    wf.add_job(deploy, dependencies=[train])
+    wf.add_job(infer, dependencies=[deploy])
+    try:
+        wf.run()
+        assert train.status() == JobStatus.FINISHED
+        assert train.get_outputs()["statuses"][0] == "FINISHED"
+        assert infer.get_outputs()["replies"][0]["echo"] == {"q": "ping"}
+    finally:
+        from fedml_tpu import api
+
+        api.endpoint_delete("wfjob_full_ep")
+
+
+def test_failed_downstream_cleans_up_deployed_endpoint():
+    """A deploy that FINISHED still holds replicas; workflow failure must
+    tear it down via cleanup() (kill() alone never fires post-finish)."""
+    from fedml_tpu import api
+
+    wf = Workflow("cleanup_chain")
+    deploy = ModelDeployJob("deploy", "wfjob_cleanup_ep", ECHO)
+    bad = ModelInferenceJob("bad", [{"x": 1}], endpoint_name="no_such_endpoint")
+    wf.add_job(deploy)
+    wf.add_job(bad, dependencies=[deploy])
+    with pytest.raises(RuntimeError, match="bad"):
+        wf.run()
+    # the endpoint must be gone without any manual teardown
+    with pytest.raises(KeyError):
+        api.model_run("wfjob_cleanup_ep", {"x": 1})
+
+
+def test_exports():
+    from fedml_tpu.workflow import ModelDeployJob as A, ModelInferenceJob as B, TrainJob as C
+
+    assert A and B and C
