@@ -1,0 +1,41 @@
+"""Workflow quick start: train -> deploy -> inference as one DAG.
+
+    python main.py
+
+Mirrors the reference's workflow/driver_example: a TrainJob launches the
+hello_job package onto a local edge agent, a ModelDeployJob stands up a
+subprocess-replica endpoint, and a ModelInferenceJob queries it — each
+node's outputs feeding the next.
+"""
+
+import os
+
+from fedml_tpu import api
+from fedml_tpu.workflow import ModelDeployJob, ModelInferenceJob, TrainJob, Workflow
+
+
+def main() -> None:
+    job_yaml = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "..", "launch", "hello_job", "job.yaml"
+    )
+    wf = Workflow("quick_start_chain")
+    train = TrainJob("train", os.path.normpath(job_yaml), timeout_s=300)
+    deploy = ModelDeployJob(
+        "deploy", "wf_quickstart_ep",
+        "fedml_tpu.serving.replica_controller:create_echo_predictor",
+    )
+    infer = ModelInferenceJob("infer", [{"prompt": "hello workflow"}])
+    wf.add_job(train)
+    wf.add_job(deploy, dependencies=[train])
+    wf.add_job(infer, dependencies=[deploy])
+    try:
+        wf.run()
+        print("train:", train.get_outputs()["statuses"])
+        print("reply:", infer.get_outputs()["replies"][0])
+    finally:
+        api.endpoint_delete("wf_quickstart_ep")
+    print("workflow example done")
+
+
+if __name__ == "__main__":
+    main()

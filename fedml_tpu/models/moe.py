@@ -71,7 +71,7 @@ def moe_dispatch(router_logits: jnp.ndarray, capacity: int) -> Tuple[jnp.ndarray
     dispatch = (
         onehot[:, :, None]
         * keep[:, None, None]
-        * jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)[:, None, :]
+        * jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity, dtype=jnp.float32)[:, None, :]
     )  # [N,E,C]
     combine = dispatch * gate[:, None, None]
 

@@ -35,6 +35,7 @@ def test_found_all_platform_examples():
         "deploy/llm_endpoint/main.py",
         "cross_device/main.py",
         "launch/hello_job/job.yaml",
+        "workflow/train_deploy_infer/main.py",
     ]
     missing = [p for p in expected if not os.path.exists(os.path.join(EXAMPLES, p))]
     assert not missing, missing
@@ -108,6 +109,14 @@ def test_llm_endpoint_example_runs():
     r = _run(s, timeout=600)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "llm endpoint example done" in r.stdout
+
+
+@pytest.mark.slow
+def test_workflow_example_runs():
+    s = os.path.join(EXAMPLES, "workflow", "train_deploy_infer", "main.py")
+    r = _run(s, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "workflow example done" in r.stdout
 
 
 @pytest.mark.slow
