@@ -45,16 +45,19 @@ def _run_party(args, results, key):
     results[key] = runner.run()
 
 
-@pytest.mark.parametrize("scenario", ["horizontal", "hierarchical"])
-def test_cross_silo_round_trip(scenario):
-    run_id = f"test_cs_{scenario}"
-    InMemoryBroker.reset()
-    n_clients, rounds = 2, 2
+def _run_cluster(run_id, scenario, backend, n_clients=2, rounds=2):
+    """Server + N clients as threads over any backend; returns server metrics."""
+    if backend == "INMEMORY":
+        InMemoryBroker.reset()
+    elif backend == "MQTT_S3":
+        from fedml_tpu.core.distributed.communication.mqtt_s3.mqtt_transport import LocalMqttBroker
+
+        LocalMqttBroker.reset(run_id)  # stale retained messages replay on subscribe
     results = {}
     threads = [
         threading.Thread(
             target=_run_party,
-            args=(_make_args(run_id, 0, "server", n_clients, rounds, scenario), results, "server"),
+            args=(_make_args(run_id, 0, "server", n_clients, rounds, scenario, backend), results, "server"),
             daemon=True,
         )
     ]
@@ -62,7 +65,7 @@ def test_cross_silo_round_trip(scenario):
         threads.append(
             threading.Thread(
                 target=_run_party,
-                args=(_make_args(run_id, rank, "client", n_clients, rounds, scenario), results, f"client{rank}"),
+                args=(_make_args(run_id, rank, "client", n_clients, rounds, scenario, backend), results, f"client{rank}"),
                 daemon=True,
             )
         )
@@ -70,11 +73,17 @@ def test_cross_silo_round_trip(scenario):
         t.start()
     for t in threads:
         t.join(timeout=600)
-        assert not t.is_alive(), "cross-silo run deadlocked"
+        assert not t.is_alive(), f"cross-silo over {backend} deadlocked"
     metrics = results["server"]
     assert metrics is not None and "test_acc" in metrics
     assert metrics["round"] == rounds - 1
     assert np.isfinite(metrics["test_loss"])
+    return metrics
+
+
+@pytest.mark.parametrize("scenario", ["horizontal", "hierarchical"])
+def test_cross_silo_round_trip(scenario):
+    _run_cluster(f"test_cs_{scenario}", scenario, "INMEMORY")
 
 
 def test_message_codec_roundtrip():
@@ -95,3 +104,10 @@ def test_message_codec_roundtrip():
     assert got["layer"]["w"].dtype.name == "bfloat16"
     np.testing.assert_allclose(np.asarray(got["layer"]["w"], dtype=np.float32), 1.0)
     assert got["meta"][1] is None
+
+
+@pytest.mark.slow
+def test_cross_silo_over_mqtt_s3():
+    """Full round over the reference's DEFAULT backend: MQTT control plane
+    (local broker) + object-store payloads — the octopus production path."""
+    _run_cluster("test_cs_mqtt", "horizontal", "MQTT_S3")
