@@ -91,9 +91,14 @@ def _timed_chain(step_once, reps_small: int = 2, reps_large: int = 12) -> float:
     return (t_large - t_small) / (reps_large - reps_small)
 
 
+class BenchIntegrityError(RuntimeError):
+    """A measurement failed its own sanity guard — never retried, never
+    published."""
+
+
 def _check_mfu(name: str, mfu: float) -> None:
     if not (0.0 < mfu < 1.0):
-        raise RuntimeError(
+        raise BenchIntegrityError(
             f"{name}: implied MFU {mfu:.3f} is not in (0,1) — measurement is "
             "broken (platform short-circuit or wrong FLOP count); refusing to publish"
         )
@@ -436,11 +441,30 @@ def _probe_backend(timeout_s: int = 180) -> None:
     print(f"benching on {proc.stdout.strip().splitlines()[-1]}", file=sys.stderr)
 
 
+def _retry_once(fn, *args, **kw):
+    """The remote tunnel occasionally drops a single request mid-compile
+    ('response body closed'); one retry rides out a transient flake.
+    Integrity-guard failures (BenchIntegrityError) stay fatal — a broken
+    measurement must not get a second roll of the dice — and the retry runs
+    OUTSIDE the except block so the failed attempt's traceback (which pins
+    its device buffers) is released first."""
+    flaked = False
+    try:
+        return fn(*args, **kw)
+    except BenchIntegrityError:
+        raise
+    except Exception as e:
+        print(f"warning: {fn.__name__} failed ({e}); retrying once", file=sys.stderr)
+        flaked = True
+    if flaked:
+        return fn(*args, **kw)
+
+
 def main() -> None:
     _probe_backend()
-    llm = _bench_llm_tpu()
-    decode = _bench_llm_decode_tpu(llm.pop("cfg_params"))
-    resnet = _bench_resnet_tpu()
+    llm = _retry_once(_bench_llm_tpu)
+    decode = _retry_once(_bench_llm_decode_tpu, llm.pop("cfg_params"))
+    resnet = _retry_once(_bench_resnet_tpu)
     llm_cpu_tokens = _bench_llm_torch_cpu(llm["shape"])
     resnet_cpu_images = _bench_resnet_torch_cpu()
 
