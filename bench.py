@@ -448,20 +448,21 @@ def _retry_once(fn, *args, **kw):
     measurement must not get a second roll of the dice — and the retry runs
     OUTSIDE the except block so the failed attempt's traceback (which pins
     its device buffers) is released first."""
-    flaked = False
     try:
         return fn(*args, **kw)
-    except BenchIntegrityError:
+    except (BenchIntegrityError, TimeoutError):
+        # integrity failures must not get a second roll of the dice; a
+        # 3-minute probe timeout means the tunnel is down, not flaky
         raise
     except Exception as e:
         print(f"warning: {fn.__name__} failed ({e}); retrying once", file=sys.stderr)
-        flaked = True
-    if flaked:
-        return fn(*args, **kw)
+    # retry OUTSIDE the except block: the failed attempt's traceback (which
+    # pins its device buffers) is released before the second run
+    return fn(*args, **kw)
 
 
 def main() -> None:
-    _probe_backend()
+    _retry_once(_probe_backend)
     llm = _retry_once(_bench_llm_tpu)
     decode = _retry_once(_bench_llm_decode_tpu, llm.pop("cfg_params"))
     resnet = _retry_once(_bench_resnet_tpu)

@@ -111,3 +111,13 @@ def test_cross_silo_over_mqtt_s3():
     """Full round over the reference's DEFAULT backend: MQTT control plane
     (local broker) + object-store payloads — the octopus production path."""
     _run_cluster("test_cs_mqtt", "horizontal", "MQTT_S3")
+
+
+@pytest.mark.slow
+def test_backend_choice_does_not_change_numerics():
+    """The transport must be semantically invisible: the same seeded run
+    over INMEMORY and MQTT_S3 produces bit-identical final metrics."""
+    a = _run_cluster("test_cs_det_a", "horizontal", "INMEMORY")
+    b = _run_cluster("test_cs_det_b", "horizontal", "MQTT_S3")
+    assert a["test_loss"] == b["test_loss"], (a, b)
+    assert a["test_acc"] == b["test_acc"]
