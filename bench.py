@@ -96,6 +96,13 @@ class BenchIntegrityError(RuntimeError):
     published."""
 
 
+class BenchProbeTimeout(TimeoutError):
+    """The 3-minute backend probe timed out: the tunnel is DOWN, not flaky
+    — never retried (socket read timeouts inside a bench ARE retried; on
+    py>=3.10 socket.timeout is TimeoutError, so the probe needs its own
+    class to stay distinguishable)."""
+
+
 def _check_mfu(name: str, mfu: float) -> None:
     if not (0.0 < mfu < 1.0):
         raise BenchIntegrityError(
@@ -432,7 +439,7 @@ def _probe_backend(timeout_s: int = 180) -> None:
             capture_output=True, text=True, timeout=timeout_s,
         )
     except subprocess.TimeoutExpired:
-        raise TimeoutError(
+        raise BenchProbeTimeout(
             f"jax backend init did not complete within {timeout_s}s — the "
             "remote TPU tunnel is stalled; rerun when it recovers"
         )
@@ -450,9 +457,10 @@ def _retry_once(fn, *args, **kw):
     its device buffers) is released first."""
     try:
         return fn(*args, **kw)
-    except (BenchIntegrityError, TimeoutError):
+    except (BenchIntegrityError, BenchProbeTimeout):
         # integrity failures must not get a second roll of the dice; a
         # 3-minute probe timeout means the tunnel is down, not flaky
+        # (transient socket timeouts inside a bench fn ARE retried)
         raise
     except Exception as e:
         print(f"warning: {fn.__name__} failed ({e}); retrying once", file=sys.stderr)

@@ -43,6 +43,7 @@ IMAGE_DATASETS = {
 TEXT_DATASETS = {"shakespeare", "fed_shakespeare", "stackoverflow_nwp", "reddit"}
 TEXT_CLS_DATASETS = {"20news", "agnews", "sst2", "semeval_2010_task8"}  # FedNLP family
 TABULAR_DATASETS = {"lending_club", "uci"}
+SEGMENTATION_DATASETS = {"pascal_voc", "coco_seg", "cityscapes"}  # FedSeg family
 
 FedDataset = Tuple[int, int, ArrayDataset, ArrayDataset, Dict[int, int], Dict[int, ArrayDataset], Dict[int, ArrayDataset], int]
 
@@ -93,6 +94,21 @@ def load(args: Any) -> FedDataset:
         x_tr, y_tr, x_te, y_te, class_num = load_tabular_dataset(dataset, cache, seed)
     elif dataset == "stackoverflow_lr":
         x_tr, y_tr, x_te, y_te, class_num = load_stackoverflow_lr(cache, seed)
+    elif dataset in SEGMENTATION_DATASETS:
+        # reference fedseg consumes pascal_voc/coco; the deterministic
+        # shapes surrogate stands in under zero egress (sp/fedseg.py)
+        from ..simulation.sp.fedseg import make_segmentation_data
+
+        clients, (x_te, y_te) = make_segmentation_data(client_num, seed=seed)
+        train_local = {cid: ArrayDataset(x, y) for cid, (x, y) in clients.items()}
+        test_local = {cid: ArrayDataset(x_te, y_te) for cid in clients}
+        train_num = {cid: len(ds) for cid, ds in train_local.items()}
+        xg = np.concatenate([c[0] for c in clients.values()])
+        yg = np.concatenate([c[1] for c in clients.values()])
+        class_num = int(yg.max()) + 1  # derived, not duplicated from the generator
+        args.output_dim = class_num
+        return (len(xg), len(x_te), ArrayDataset(xg, yg), ArrayDataset(x_te, y_te),
+                train_num, train_local, test_local, class_num)
     else:
         raise ValueError(f"unknown dataset {dataset!r}")
 

@@ -139,6 +139,11 @@ def create(args: Any, output_dim: Optional[int] = None, seed: Optional[int] = No
         from .darts import DARTSNetwork
 
         module = DARTSNetwork(num_classes=num_classes)
+    elif model_name in ("unet", "segnet", "deeplab"):
+        from .segmentation import SegNetLite
+
+        module = SegNetLite(num_classes=num_classes)
+        in_shape, in_dtype = (1, 32, 32, 3), jnp.float32
     elif model_name in ("llama", "gpt", "transformer"):
         from .transformer import TransformerLM, TransformerConfig
 

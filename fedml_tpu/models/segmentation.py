@@ -19,7 +19,8 @@ class SegNetLite(nn.Module):
     width: int = 16
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        # train is part of the zoo-wide FedModel contract; no dropout here
         w = self.width
         e0 = nn.relu(nn.Conv(w, (3, 3), name="enc0")(x))
         e1 = nn.relu(nn.Conv(2 * w, (3, 3), strides=(2, 2), name="enc1")(e0))

@@ -47,6 +47,15 @@ class SimulatorSingleProcess:
         else:
             from .sp.fedavg_api import FedAvgAPI as API
 
+        if opt != FEDML_FEDERATED_OPTIMIZER_FEDSEG and dataset is not None:
+            y = getattr(dataset[2], "y", None)  # train_global labels
+            if y is not None and getattr(y, "ndim", 0) >= 3:
+                # per-pixel labels through the classification trainers would
+                # die in an obscure broadcast; fail with the actual cause
+                raise ValueError(
+                    "segmentation dataset (per-pixel labels) requires "
+                    'federated_optimizer: "FedSeg"'
+                )
         self.fl_trainer = API(args, device, dataset, model, client_trainer, server_aggregator)
 
     def run(self):

@@ -89,18 +89,29 @@ class FedSegAPI:
     def __init__(self, args: Any, device: Any = None, dataset=None, model=None,
                  client_trainer=None, server_aggregator=None, num_classes: int = 3):
         """Accepts the simulator's uniform (args, device, dataset, model, ...)
-        signature; FedSeg generates its own segmentation data and model (the
-        reference fedseg package ships its own loaders/DeepLab the same way),
-        so those positional args are unused."""
+        signature. When the runner supplies a loaded dataset/model (the
+        pascal_voc/unet path), they are used directly; standalone callers get
+        the self-generated surrogate + model."""
         self.args = args
+        seed = int(getattr(args, "random_seed", 0))
+        if dataset is not None:
+            # runner FedDataset tuple: (..., train_local, test_local, class_num)
+            train_local, _test_local, class_num = dataset[5], dataset[6], dataset[7]
+            test_g = dataset[3]
+            self.clients = {cid: (np.asarray(ds.x), np.asarray(ds.y)) for cid, ds in train_local.items()}
+            self.test_set = (np.asarray(test_g.x), np.asarray(test_g.y))
+            num_classes = int(class_num)
+        else:
+            n_clients = int(getattr(args, "client_num_in_total", 4))
+            self.clients, self.test_set = make_segmentation_data(n_clients, seed=seed)
         self.num_classes = num_classes
-        n_clients = int(getattr(args, "client_num_in_total", 4))
-        self.clients, self.test_set = make_segmentation_data(
-            n_clients, seed=int(getattr(args, "random_seed", 0))
-        )
-        self.model = SegNetLite(num_classes=num_classes)
         x0 = jnp.asarray(self.clients[0][0][:1])
-        self.params = self.model.init(jax.random.PRNGKey(0), x0)["params"]
+        if model is not None and hasattr(model, "module"):
+            self.model = model.module  # runner-built FedModel (seeded by args)
+            self.params = model.params
+        else:
+            self.model = SegNetLite(num_classes=num_classes)
+            self.params = self.model.init(jax.random.PRNGKey(seed), x0)["params"]
         lr = float(getattr(args, "learning_rate", 0.05))
         self.tx = optax.sgd(lr, momentum=0.9)
 

@@ -75,3 +75,21 @@ def test_fedseg_dispatches_from_simulator():
     sim = SimulatorSingleProcess(Args(), None, None, None)
     metrics = sim.run()
     assert "mIoU" in metrics
+
+
+def test_segmentation_data_with_wrong_optimizer_fails_loudly():
+    import pytest as _pytest
+
+    import fedml_tpu as fedml
+    from fedml_tpu.arguments import default_config
+    from fedml_tpu.simulation.simulator import SimulatorSingleProcess
+
+    args = fedml.init(default_config(
+        "simulation", dataset="pascal_voc", model="unet",
+        federated_optimizer="FedAvg", client_num_in_total=2, random_seed=0,
+    ))
+    device = fedml.device.get_device(args)
+    dataset, output_dim = fedml.data.load(args)
+    model = fedml.model.create(args, output_dim)
+    with _pytest.raises(ValueError, match="FedSeg"):
+        SimulatorSingleProcess(args, device, dataset, model, None, None)
