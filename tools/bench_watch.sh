@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Opportunistic bench watcher (VERDICT r2 next #1a).
+#
+# The remote TPU tunnel stalls for hours at a time, so a single capture at
+# round end is likely to be red. This loop probes the tunnel cheaply; whenever
+# it is up it runs bench.py (which writes a timestamped BENCH_MEASURED_*.json
+# artifact on success) and commits the artifact immediately, so a verified
+# number exists in git no matter what the tunnel is doing at capture time.
+#
+# Usage: nohup tools/bench_watch.sh >/tmp/bench_watch.log 2>&1 &
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-90}
+BENCH_TIMEOUT=${BENCH_TIMEOUT:-2400}
+SLEEP_DOWN=${SLEEP_DOWN:-600}     # tunnel down: re-probe every 10 min
+SLEEP_UP=${SLEEP_UP:-3600}        # after a good measurement: hourly is plenty
+
+log() { echo "[$(date -u +%FT%TZ)] $*"; }
+
+while true; do
+  if timeout "$PROBE_TIMEOUT" python -c "import jax; print(jax.devices()[0])" >/dev/null 2>&1; then
+    log "tunnel up — running bench.py"
+    if timeout "$BENCH_TIMEOUT" python bench.py >/tmp/bench_watch_last.json 2>/tmp/bench_watch_last.err; then
+      log "bench ok: $(cat /tmp/bench_watch_last.json)"
+      # commit ONLY the artifact paths so a concurrent interactive commit's
+      # staged files are never swept into this commit
+      if compgen -G "BENCH_MEASURED_*.json" >/dev/null; then
+        git add BENCH_MEASURED_*.json
+        git commit -q -m "Record measured bench artifact from live chip" -- BENCH_MEASURED_*.json \
+          && log "artifact committed" || log "nothing new to commit"
+      fi
+      sleep "$SLEEP_UP"
+    else
+      log "bench failed (rc=$?): $(tail -c 400 /tmp/bench_watch_last.err)"
+      sleep "$SLEEP_DOWN"
+    fi
+  else
+    log "tunnel down"
+    sleep "$SLEEP_DOWN"
+  fi
+done
