@@ -121,7 +121,7 @@ def _check_mfu(name: str, mfu: float) -> None:
 
 # --- workload B: llama-350M full train step ----------------------------------
 
-def _bench_llm_tpu(reps: int = 10):
+def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas"):
     import jax
     import jax.numpy as jnp
     import optax
@@ -133,6 +133,7 @@ def _bench_llm_tpu(reps: int = 10):
     cfg = TransformerConfig(
         vocab_size=vocab, d_model=d_model, n_layers=n_layers, n_heads=n_heads,
         n_kv_heads=n_heads, d_ff=d_ff, max_seq_len=seq, remat=True, lora_rank=0,
+        attention_impl=attention_impl,
     )
     model = TransformerLM(cfg)
     key = jax.random.PRNGKey(0)
@@ -179,6 +180,7 @@ def _bench_llm_tpu(reps: int = 10):
     return {
         "tokens_per_sec": tokens_per_step / dt_step,
         "mfu": mfu,
+        "attention_impl": attention_impl,
         "step_flops": analytic_step_flops,
         "n_params": n_params,
         "device": getattr(dev, "device_kind", str(dev)),
@@ -522,7 +524,10 @@ def main() -> None:
             "last_measured": _last_measured(),
         }))
         sys.exit(1)
-    llm = _retry_once(_bench_llm_tpu)
+    llm = _retry_once(_bench_llm_tpu)  # headline: Pallas flash attention
+    # same model, einsum attention: the before/after the kernel buys
+    llm_xla = _retry_once(_bench_llm_tpu, reps=6, attention_impl="xla")
+    llm_xla.pop("cfg_params", None)
     decode = _retry_once(_bench_llm_decode_tpu, llm.pop("cfg_params"))
     resnet = _retry_once(_bench_resnet_tpu)
     llm_cpu_tokens = _bench_llm_torch_cpu(llm["shape"])
@@ -536,6 +541,9 @@ def main() -> None:
                 f"seq{llm['shape']['seq']} bs{llm['shape']['bs']}, 1x {llm['device']})",
         "vs_baseline": round(llm["tokens_per_sec"] / llm_cpu_tokens, 2) if llm_cpu_tokens else None,
         "mfu": round(llm["mfu"], 4),
+        "attention_impl": llm["attention_impl"],
+        "mfu_xla_attention": round(llm_xla["mfu"], 4),
+        "tokens_per_sec_xla_attention": round(llm_xla["tokens_per_sec"], 1),
         "resnet56_steps_per_sec": round(resnet["steps_per_sec"], 2),
         "resnet56_mfu": round(resnet["mfu"], 4),
         "resnet56_vs_torch_cpu": (

@@ -37,7 +37,7 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    attention_impl: str = "xla"  # xla | pallas | ring
+    attention_impl: str = "auto"  # auto (pallas on TPU, xla elsewhere) | xla | pallas | ring
     lora_rank: int = 0           # 0 = no adapters
     lora_alpha: float = 16.0
     lora_targets: Tuple[str, ...] = ("q_proj", "k_proj", "v_proj", "o_proj")
@@ -60,7 +60,7 @@ class TransformerConfig:
             n_kv_heads=int(getattr(args, "n_kv_heads", getattr(args, "n_heads", 8))),
             d_ff=int(getattr(args, "d_ff", 1376)),
             max_seq_len=int(getattr(args, "seq_len", 2048)),
-            attention_impl=str(getattr(args, "attention_impl", "xla")),
+            attention_impl=str(getattr(args, "attention_impl", "auto")),
             lora_rank=int(getattr(args, "lora_rank", 0) or 0),
             lora_alpha=float(getattr(args, "lora_alpha", 16.0)),
             remat=bool(getattr(args, "remat", True)),
@@ -170,11 +170,17 @@ class Attention(nn.Module):
         if cfg.decode:
             return self._decode_attention(q, k, v, B, T)
         k, v = repeat_kv(k, v, cfg.n_heads)
-        if cfg.attention_impl == "pallas":
+        impl = cfg.attention_impl
+        if impl == "auto":
+            # pallas only where it runs compiled: interpret-mode flash on CPU
+            # would be pure overhead, and numerics should not change under
+            # a platform fallback the user never asked for
+            impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        if impl == "pallas":
             from ..ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True)
-        elif cfg.attention_impl == "ring":
+        elif impl == "ring":
             from ..parallel.ring_attention import ring_attention_inner
 
             out = ring_attention_inner(q, k, v)

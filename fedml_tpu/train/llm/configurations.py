@@ -27,7 +27,7 @@ class ModelArguments:
     d_ff: int = 1376
     seq_len: int = 512
     rope_theta: float = 10000.0
-    attention_impl: str = "xla"        # xla | pallas | ring
+    attention_impl: str = "auto"       # auto (pallas on TPU) | xla | pallas | ring
     lora_rank: int = 8
     lora_alpha: float = 16.0
     remat: bool = True

@@ -264,4 +264,6 @@ def test_llama2_7b_shapes_lower_on_8dev_mesh():
         out_shardings=(sh, o_sh, NamedSharding(mesh, P())),
     )
     lowered = jitted.lower(shapes, opt_shapes, toks, mask)
-    assert "sharding" in lowered.as_text()[:100000] or True  # lowering succeeded
+    # the 7B-geometry step must both lower AND carry real shardings: an
+    # unsharded lowering would mean the in_shardings silently degenerated
+    assert "sharding" in lowered.as_text()[:100000]

@@ -28,12 +28,22 @@ while true; do
       # staged files are never swept into this commit
       if compgen -G "BENCH_MEASURED_*.json" >/dev/null; then
         git add BENCH_MEASURED_*.json
-        git commit -q -m "Record measured bench artifact from live chip" -- BENCH_MEASURED_*.json \
-          && log "artifact committed" || log "nothing new to commit"
+        if git diff --cached --quiet -- BENCH_MEASURED_*.json; then
+          log "no new artifact to commit"
+        elif git commit -q -m "Record measured bench artifact from live chip" -- BENCH_MEASURED_*.json 2>/tmp/bench_watch_commit.err; then
+          log "artifact committed"
+        else
+          log "COMMIT FAILED: $(tail -c 400 /tmp/bench_watch_commit.err)"
+        fi
       fi
       sleep "$SLEEP_UP"
     else
-      log "bench failed (rc=$?): $(tail -c 400 /tmp/bench_watch_last.err)"
+      rc=$?
+      if grep -q '"skipped": *"tunnel_stalled"' /tmp/bench_watch_last.json 2>/dev/null; then
+        log "tunnel stalled mid-run (structured skip, rc=$rc)"
+      else
+        log "bench CRASHED (rc=$rc): $(tail -c 400 /tmp/bench_watch_last.err)"
+      fi
       sleep "$SLEEP_DOWN"
     fi
   else
