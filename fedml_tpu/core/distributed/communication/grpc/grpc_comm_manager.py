@@ -58,11 +58,16 @@ class GRPCCommManager(BaseCommunicationManager):
         client_id: int = 0,
         client_num: int = 0,
         base_port: int = GRPC_BASE_PORT,
+        wire: str = "native",
     ):
         self.host = host
         self.rank = client_id
         self.size = client_num + 1
         self.base_port = base_port
+        # wire="fedml": speak the reference's protocol (proto CommRequest +
+        # pickled Message, service gRPCCommManager) so real reference peers
+        # interoperate — see ref_wire.py. "native" is our own framing.
+        self.wire = wire
         self.port = port if port is not None else base_port + client_id
         self.ip_table = read_ip_config(ip_config_path, self.size)
         self._observers: List[Observer] = []
@@ -85,6 +90,25 @@ class GRPCCommManager(BaseCommunicationManager):
         )
         server = grpc.server(futures.ThreadPoolExecutor(max_workers=8), options=_OPTIONS)
         server.add_generic_rpc_handlers((handler,))
+        if self.wire == "fedml":
+            from . import ref_wire
+
+            def handle_ref(request: bytes, context) -> bytes:
+                incoming.put(ref_wire.decode_ref_message(request))
+                return b""  # empty CommResponse
+
+            ref_handler = grpc.method_handlers_generic_handler(
+                ref_wire.REF_SERVICE,
+                {
+                    ref_wire.REF_METHOD_SEND: grpc.unary_unary_rpc_method_handler(
+                        handle_ref, request_deserializer=None, response_serializer=None
+                    ),
+                    "handleReceiveMessage": grpc.unary_unary_rpc_method_handler(
+                        lambda req, ctx: b"", request_deserializer=None, response_serializer=None
+                    ),
+                },
+            )
+            server.add_generic_rpc_handlers((ref_handler,))
         server.add_insecure_port(f"{self.host}:{self.port}")
         server.start()
         log.info("grpc server rank=%d listening on %s:%d", self.rank, self.host, self.port)
@@ -96,7 +120,13 @@ class GRPCCommManager(BaseCommunicationManager):
             addr = f"{self.ip_table.get(receiver, '127.0.0.1')}:{self.base_port + receiver}"
             self._channels[receiver] = grpc.insecure_channel(addr, options=_OPTIONS)
         ch = self._channels[receiver]
-        return ch.unary_unary(f"/{SERVICE}/{METHOD}", request_serializer=None, response_deserializer=None)
+        if self.wire == "fedml":
+            from . import ref_wire
+
+            method = f"/{ref_wire.REF_SERVICE}/{ref_wire.REF_METHOD_SEND}"
+        else:
+            method = f"/{SERVICE}/{METHOD}"
+        return ch.unary_unary(method, request_serializer=None, response_deserializer=None)
 
     def send_message(self, msg: Message) -> None:
         """Send with UNAVAILABLE retry: peers may come up in any order (the
@@ -104,7 +134,12 @@ class GRPCCommManager(BaseCommunicationManager):
         retry until the receiver's server socket exists)."""
         import time
 
-        data = message_to_bytes(msg)
+        if self.wire == "fedml":
+            from . import ref_wire
+
+            data = ref_wire.encode_ref_message(msg, self.rank)
+        else:
+            data = message_to_bytes(msg)
         receiver = msg.get_receiver_id()
         deadline = time.time() + 120.0
         delay = 0.2

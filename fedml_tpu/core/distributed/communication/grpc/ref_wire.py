@@ -1,0 +1,267 @@
+"""Reference-FedML wire compatibility for the gRPC backend.
+
+The reference's gRPC protocol (``core/distributed/communication/grpc/
+grpc_comm_manager.py:78-108`` + ``proto/grpc_comm_manager.proto``) is:
+
+    service gRPCCommManager { rpc sendMessage(CommRequest) returns (CommResponse) }
+    message CommRequest { int32 client_id = 1; bytes message = 2; }
+
+where ``message`` is ``pickle.dumps`` of its ``Message`` object (msg_params
+dict carrying torch state_dicts). This module implements that wire format
+natively — a hand-rolled two-field protobuf codec (no protoc dependency) and
+a *restricted* pickle bridge — so a fedml_tpu endpoint can serve real
+reference clients (tests/test_reference_interop.py runs the reference's own
+``ClientMasterManager`` against our server).
+
+Pickle policy: pickle is the REFERENCE's choice, not ours (our native wire
+is codec.py: JSON control plane + raw tensor buffers). In ref-wire mode we
+accept it for interop but load through an allowlisting Unpickler limited to
+tensor/array reconstruction globals — arbitrary callables are refused.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import sys
+import types
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ..message import Message
+
+REF_SERVICE = "gRPCCommManager"
+REF_METHOD_SEND = "sendMessage"
+REF_MESSAGE_MODULE = "fedml.core.distributed.communication.message"
+
+
+# --- minimal protobuf codec (CommRequest / CommResponse) ---------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(data: bytes, i: int) -> Tuple[int, int]:
+    shift = 0
+    val = 0
+    while True:
+        b = data[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def encode_comm_request(client_id: int, message: bytes) -> bytes:
+    out = b""
+    if client_id:
+        out += b"\x08" + _varint(client_id)  # field 1, varint
+    out += b"\x12" + _varint(len(message)) + message  # field 2, bytes
+    return out
+
+
+def decode_comm_request(data: bytes) -> Tuple[int, bytes]:
+    client_id, message = 0, b""
+    i = 0
+    while i < len(data):
+        tag, i = _read_varint(data, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:  # varint
+            val, i = _read_varint(data, i)
+            if field == 1:
+                client_id = val
+        elif wire == 2:  # length-delimited
+            ln, i = _read_varint(data, i)
+            if field == 2:
+                message = data[i:i + ln]
+            i += ln
+        elif wire == 5:  # 32-bit
+            i += 4
+        elif wire == 1:  # 64-bit
+            i += 8
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wire}")
+    return client_id, message
+
+
+# --- the reference Message class (real if importable, shim otherwise) --------
+
+def _ensure_ref_message_class() -> type:
+    """The class pickled messages resolve to. If the actual reference
+    package is importable (interop test envs), use its class so pickles are
+    bit-identical; otherwise install a structural shim under the same module
+    path — the reference ``Message`` is a plain-attribute object, so default
+    NEWOBJ pickling round-trips either way."""
+    try:
+        mod = __import__(REF_MESSAGE_MODULE, fromlist=["Message"])
+        return mod.Message
+    except Exception:
+        pass
+    if REF_MESSAGE_MODULE in sys.modules:
+        return sys.modules[REF_MESSAGE_MODULE].Message
+
+    class Message:  # matches reference message.py:5 attribute layout
+        def __init__(self, type="default", sender_id=0, receiver_id=0):
+            self.type = str(type)
+            self.sender_id = sender_id
+            self.receiver_id = receiver_id
+            self.msg_params = {"msg_type": type, "sender": sender_id, "receiver": receiver_id}
+
+    # register the module chain so pickle's save_global/find_class resolve
+    parts = REF_MESSAGE_MODULE.split(".")
+    for i in range(1, len(parts) + 1):
+        name = ".".join(parts[:i])
+        if name not in sys.modules:
+            m = types.ModuleType(name)
+            m.__path__ = []
+            sys.modules[name] = m
+            if i > 1:
+                setattr(sys.modules[".".join(parts[:i - 1])], parts[i - 1], m)
+    Message.__module__ = REF_MESSAGE_MODULE
+    Message.__qualname__ = "Message"
+    sys.modules[REF_MESSAGE_MODULE].Message = Message
+    return Message
+
+
+# --- payload tree conversion -------------------------------------------------
+
+def _np_to_torch(arr: np.ndarray):
+    """torch.from_numpy with bf16 support: torch rejects ml_dtypes.bfloat16
+    ndarrays (our default model dtype), so bitcast through uint16."""
+    import torch
+
+    arr = np.ascontiguousarray(arr)
+    try:
+        import ml_dtypes
+
+        if arr.dtype == ml_dtypes.bfloat16:
+            return torch.from_numpy(arr.view(np.uint16)).view(torch.bfloat16)
+    except ImportError:  # pragma: no cover
+        pass
+    return torch.from_numpy(arr)
+
+
+def _torch_to_np(t) -> np.ndarray:
+    """tensor.numpy() with bf16 support (torch refuses .numpy() on bf16)."""
+    t = t.detach().cpu()
+    if str(t.dtype) == "torch.bfloat16":
+        import ml_dtypes
+
+        return t.view(__import__("torch").uint16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def _to_torch_tree(obj: Any) -> Any:
+    """numpy / jax leaves -> torch tensors (what reference trainers expect)."""
+    if isinstance(obj, dict):
+        return {k: _to_torch_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_torch_tree(v) for v in obj)
+    if isinstance(obj, np.ndarray):
+        return _np_to_torch(obj)
+    if obj.__class__.__module__.startswith("jax"):
+        return _np_to_torch(np.asarray(obj))
+    return obj
+
+
+def _to_numpy_tree(obj: Any) -> Any:
+    """torch leaves -> numpy (what our aggregators consume)."""
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy_tree(v) for v in obj)
+    if obj.__class__.__module__.partition(".")[0] == "torch":
+        return _torch_to_np(obj)
+    return obj
+
+
+# --- restricted unpickler ----------------------------------------------------
+
+# Exact reconstruction globals that pickles of tensor/array payloads need
+# (verified empirically against torch state_dicts incl. bf16/f16, numpy
+# arrays/scalars/dtypes). NOT prefix-wide: torch.hub.load / torch.load /
+# numpy.lib gadget callables stay refused.
+_ALLOWED_GLOBALS = {
+    ("collections", "OrderedDict"),
+    ("numpy", "dtype"),
+    ("numpy", "ndarray"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy.core.multiarray", "_reconstruct"),  # pre-numpy-2 peers
+    ("numpy.core.multiarray", "scalar"),
+    ("torch._utils", "_rebuild_tensor_v2"),
+    ("torch._utils", "_rebuild_tensor"),
+    ("torch._utils", "_rebuild_parameter"),
+    ("torch.storage", "_load_from_bytes"),
+    ("torch.serialization", "_get_layout"),
+    ("_codecs", "encode"),
+}
+_ALLOWED_BUILTINS = {
+    "int", "float", "complex", "bool", "str", "bytes", "bytearray",
+    "list", "tuple", "dict", "set", "frozenset", "slice", "range",
+}
+# torch dtype/size objects pickle as plain attribute globals of the torch
+# module itself (e.g. torch.bfloat16, torch.Size) — data, not callables
+_ALLOWED_TORCH_ATTRS = {
+    "Size", "device",
+    "float32", "float64", "float16", "bfloat16",
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "bool",
+    "FloatStorage", "DoubleStorage", "HalfStorage", "BFloat16Storage",
+    "LongStorage", "IntStorage", "ShortStorage", "CharStorage",
+    "ByteStorage", "BoolStorage",
+}
+
+
+class _RefUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str):
+        if module == REF_MESSAGE_MODULE and name == "Message":
+            return _ensure_ref_message_class()
+        if (module, name) in _ALLOWED_GLOBALS:
+            return super().find_class(module, name)
+        if module == "torch" and name in _ALLOWED_TORCH_ATTRS:
+            return super().find_class(module, name)
+        if module == "builtins" and name in _ALLOWED_BUILTINS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"ref-wire refuses global {module}.{name} (not tensor/message data)"
+        )
+
+
+# --- Message <-> wire --------------------------------------------------------
+
+def encode_ref_message(msg: Message, sender_id: int) -> bytes:
+    """Our Message -> CommRequest bytes the reference servicer accepts."""
+    RefMessage = _ensure_ref_message_class()
+    ref = RefMessage.__new__(RefMessage)
+    params = dict(msg.get_params())
+    if Message.MSG_ARG_KEY_MODEL_PARAMS in params:
+        params[Message.MSG_ARG_KEY_MODEL_PARAMS] = _to_torch_tree(
+            params[Message.MSG_ARG_KEY_MODEL_PARAMS]
+        )
+    ref.__dict__.update(
+        type=str(msg.get_type()),
+        sender_id=msg.get_sender_id(),
+        receiver_id=msg.get_receiver_id(),
+        msg_params=params,
+    )
+    return encode_comm_request(sender_id, pickle.dumps(ref))
+
+
+def decode_ref_message(data: bytes) -> Message:
+    """CommRequest bytes from a reference peer -> our Message."""
+    _, payload = decode_comm_request(data)
+    ref = _RefUnpickler(io.BytesIO(payload)).load()
+    params: Dict[str, Any] = _to_numpy_tree(dict(ref.msg_params))
+    msg = Message()
+    msg.init_from_json_object(params)
+    return msg

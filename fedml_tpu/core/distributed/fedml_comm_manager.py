@@ -117,6 +117,7 @@ class FedMLCommManager(Observer):
                 client_id=self.rank,
                 client_num=self.size - 1,
                 base_port=int(getattr(self.args, "grpc_base_port", 8890)) + _run_id_offset(getattr(self.args, "run_id", 0)),
+                wire=str(getattr(self.args, "grpc_wire", "native")),
             )
         elif self.backend == COMM_BACKEND_MQTT_S3:
             from .communication.mqtt_s3.mqtt_s3_comm_manager import MqttS3MultiClientsCommManager

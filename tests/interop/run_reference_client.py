@@ -1,0 +1,123 @@
+"""Run the REFERENCE FedML cross-silo client against a fedml_tpu server.
+
+This script executes the reference's own code — ``ClientMasterManager``
+(cross_silo/client/fedml_client_master_manager.py), ``TrainerDistAdapter``,
+``ModelTrainerCLS`` and ``GRPCCommManager`` — unmodified, as a subprocess of
+tests/test_reference_interop.py. Only third-party libraries missing from
+this image are stubbed (ref_stubs) and the gRPC base port is pointed at the
+test's server.
+
+Env: INTEROP_BASE_PORT, INTEROP_IPCONFIG, INTEROP_COMM_ROUND, INTEROP_OUT.
+"""
+
+import json
+import os
+import sys
+import types
+import warnings
+
+warnings.filterwarnings("ignore")
+
+from tests.interop.ref_stubs import install  # noqa: E402
+
+install()
+sys.path.insert(0, os.environ.get("REFERENCE_PATH", "/root/reference/python"))
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+from fedml.core.distributed.communication.constants import CommunicationConstants  # noqa: E402
+
+CommunicationConstants.GRPC_BASE_PORT = int(os.environ["INTEROP_BASE_PORT"])
+
+from fedml.cross_silo.client.fedml_client_master_manager import ClientMasterManager  # noqa: E402
+from fedml.cross_silo.client.fedml_trainer_dist_adapter import TrainerDistAdapter  # noqa: E402
+
+# Disable the MLOps telemetry facade: it phones the MLOps cloud (zero egress
+# here) and its mqtt sidecar, and crashes when no agent config was fetched
+# (core/mlops/__init__.py:529 assumes mlops_log_mqtt_mgr). Telemetry only —
+# the FL round state machine and wire protocol under test are untouched.
+import fedml.mlops as _ref_mlops  # noqa: E402
+
+for _name in list(vars(_ref_mlops)):
+    _obj = getattr(_ref_mlops, _name)
+    if isinstance(_obj, types.FunctionType) and not _name.startswith("_"):
+        setattr(_ref_mlops, _name, lambda *a, **k: None)
+
+from fedml.core.mlops.mlops_profiler_event import MLOpsProfilerEvent  # noqa: E402
+
+MLOpsProfilerEvent.log_to_wandb = staticmethod(lambda *a, **k: None)
+
+
+def build_args():
+    return types.SimpleNamespace(
+        # round / identity
+        comm_round=int(os.environ["INTEROP_COMM_ROUND"]),
+        client_id_list="[1]",
+        run_id="0",
+        rank=1,
+        client_num_in_total=1,
+        client_num_per_round=1,
+        # comm
+        backend="GRPC",
+        grpc_ipconfig_path=os.environ["INTEROP_IPCONFIG"],
+        scenario="horizontal",
+        # trainer
+        dataset="synthetic_interop",
+        data_cache_dir="",
+        model="lr",
+        ml_engine="torch",
+        epochs=1,
+        batch_size=16,
+        client_optimizer="sgd",
+        learning_rate=0.5,
+        weight_decay=0.0,
+        federated_optimizer="FedAvg",
+        test_on_clients="no",
+        using_mlops=False,
+        enable_wandb=False,
+    )
+
+
+def build_data(n=64, d=10, classes=2, seed=7):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, classes)).astype(np.float32)
+    y = np.argmax(x @ w + 0.1 * rng.normal(size=(n, classes)), axis=1)
+    ds = torch.utils.data.TensorDataset(torch.from_numpy(x), torch.from_numpy(y))
+    return torch.utils.data.DataLoader(ds, batch_size=16, shuffle=False), n
+
+
+def main():
+    args = build_args()
+    device = torch.device("cpu")
+    torch.manual_seed(0)
+    model = torch.nn.Linear(10, 2)
+    loader, n = build_data()
+
+    adapter = TrainerDistAdapter(
+        args,
+        device,
+        client_rank=1,
+        model=model,
+        train_data_num=n,
+        train_data_local_num_dict={0: n},
+        train_data_local_dict={0: loader},
+        test_data_local_dict={0: loader},
+        model_trainer=None,
+    )
+    manager = ClientMasterManager(args, adapter, rank=1, size=2, backend="GRPC")
+    manager.run()  # blocks until the server's FINISH message
+
+    final = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+    out = {
+        "rounds_completed": manager.round_idx,
+        "final": {k: v.tolist() for k, v in final.items()},
+    }
+    with open(os.environ["INTEROP_OUT"], "w") as f:
+        json.dump(out, f)
+    print("REFERENCE CLIENT DONE", out["rounds_completed"])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,251 @@
+"""Heterogeneous interop: the REFERENCE FedML client against OUR server.
+
+SURVEY §7 hard part (d) / VERDICT r2 missing #1: prove the round/state
+machine and wire protocol are reproduced exactly enough that the reference's
+own implementation completes FedAvg rounds against a fedml_tpu endpoint.
+
+The client subprocess runs the reference's unmodified ``ClientMasterManager``
++ ``TrainerDistAdapter`` + ``ModelTrainerCLS`` + ``GRPCCommManager``
+(see tests/interop/run_reference_client.py); the server here is our
+``FedMLServerManager`` over our gRPC backend in reference-wire mode
+(proto CommRequest + pickled Message — ref_wire.py). Also unit-tests the
+wire codec round-trip against the reference's own generated protobuf.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = "/root/reference/python"
+BASE_PORT = 19890
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE), reason="reference checkout not mounted"
+)
+
+
+class _NumpyDictAggregator:
+    """Minimal alg-frame server aggregator over torch-style state dicts
+    (dict[str, np.ndarray]) — what reference clients upload."""
+
+    def __init__(self, params, args):
+        self.model = params
+        self.args = args
+        self.id = 0
+
+    def get_model_params(self):
+        return self.model
+
+    def set_model_params(self, p):
+        self.model = p
+
+    def on_before_aggregation(self, model_list):
+        return model_list
+
+    def aggregate(self, model_list):
+        total = float(sum(n for n, _ in model_list))
+        keys = model_list[0][1].keys()
+        return {
+            k: sum((n / total) * np.asarray(p[k], np.float64) for n, p in model_list).astype(np.float32)
+            for k in keys
+        }
+
+    def on_after_aggregation(self, p):
+        return p
+
+    def assess_contribution(self):
+        pass
+
+    def test(self, test_data, device, args):
+        return {}
+
+
+def _server_args(comm_round: int, ipconfig: str):
+    return types.SimpleNamespace(
+        comm_round=comm_round,
+        client_num_in_total=1,
+        client_num_per_round=1,
+        run_id=0,
+        backend="GRPC",
+        grpc_wire="fedml",
+        grpc_base_port=BASE_PORT,
+        grpc_ipconfig_path=ipconfig,
+        frequency_of_the_test=100,
+        disable_alg_frame_hooks=True,
+    )
+
+
+@pytest.mark.slow
+def test_reference_client_completes_rounds_against_our_server(tmp_path):
+    from fedml_tpu.cross_silo.server.fedml_aggregator import FedMLAggregator
+    from fedml_tpu.cross_silo.server.fedml_server_manager import FedMLServerManager
+
+    comm_round = 2
+    ipconfig = tmp_path / "grpc_ipconfig.csv"
+    ipconfig.write_text("receiver_id,receiver_ip\n0,127.0.0.1\n1,127.0.0.1\n")
+    out_path = tmp_path / "client_out.json"
+
+    # deterministic initial global model (torch Linear(10,2) layout)
+    init_params = {
+        "weight": np.zeros((2, 10), np.float32),
+        "bias": np.zeros((2,), np.float32),
+    }
+    args = _server_args(comm_round, str(ipconfig))
+    aggregator = FedMLAggregator(
+        train_global=None, test_global=None, all_train_data_num=64,
+        train_data_local_dict={0: None}, test_data_local_dict={0: None},
+        train_data_local_num_dict={0: 64}, client_num=1, device=None,
+        args=args, server_aggregator=_NumpyDictAggregator(dict(init_params), args),
+    )
+
+    class LingeringServerManager(FedMLServerManager):
+        # the reference client sends a FINISHED status right after S2C_FINISH;
+        # keep the socket open briefly so that send cannot race our shutdown
+        def finish(self):
+            time.sleep(2.0)
+            super().finish()
+
+    server = LingeringServerManager(args, aggregator, client_rank=0, client_num=1, backend="GRPC")
+
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION="python",
+        INTEROP_BASE_PORT=str(BASE_PORT),
+        INTEROP_IPCONFIG=str(ipconfig),
+        INTEROP_COMM_ROUND=str(comm_round),
+        INTEROP_OUT=str(out_path),
+        REFERENCE_PATH=REFERENCE,
+        JAX_PLATFORMS="cpu",
+    )
+    # server socket is already open (manager construction starts gRPC);
+    # run() drains the queue in a thread so a failing client can't hang us
+    server_exc: list = []
+    server_done = threading.Event()
+
+    def _run_server():
+        try:
+            server.run()  # blocks until all rounds aggregated + FINISH sent
+        except Exception as e:  # pragma: no cover
+            server_exc.append(e)
+        finally:
+            server_done.set()
+
+    threading.Thread(target=_run_server, daemon=True).start()
+
+    client = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests", "interop", "run_reference_client.py")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        client_out, _ = client.communicate(timeout=240)
+    except subprocess.TimeoutExpired:
+        client.kill()
+        client_out = client.communicate()[0] or ""
+    finally:
+        if not server_done.wait(timeout=30):
+            server.com_manager.stop_receive_message()
+            server_done.wait(timeout=10)
+
+    assert not server_exc, f"server raised: {server_exc}"
+
+    assert client.returncode == 0, f"reference client failed:\n{client_out[-4000:]}"
+    assert "REFERENCE CLIENT DONE" in client_out
+
+    result = json.loads(out_path.read_text())
+    # the reference client's round counter reached the configured rounds
+    assert result["rounds_completed"] == comm_round
+    # our server's final global equals the (single-client) reference upload
+    final_client = {k: np.asarray(v, np.float32) for k, v in result["final"].items()}
+    final_server = aggregator.get_global_model_params()
+    for k in final_client:
+        np.testing.assert_allclose(final_server[k], final_client[k], atol=1e-6, err_msg=k)
+    # training actually moved the model
+    assert float(np.abs(final_client["weight"]).sum()) > 0.0
+
+
+def test_ref_wire_codec_roundtrip_against_reference_proto(tmp_path):
+    """Byte-level check of the hand-rolled CommRequest codec against the
+    reference's own generated protobuf module (golden-message fallback of
+    VERDICT r2 missing #1, kept even now the live test exists)."""
+    from tests.interop.ref_stubs import install
+
+    install()
+    sys.path.insert(0, REFERENCE)
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    try:
+        from fedml.core.distributed.communication.grpc import grpc_comm_manager_pb2 as pb2
+    except Exception as e:  # pragma: no cover
+        pytest.skip(f"reference pb2 unusable here: {e}")
+    finally:
+        sys.path.remove(REFERENCE)
+
+    from fedml_tpu.core.distributed.communication.grpc import ref_wire
+
+    payload = b"\x00\x01binary\xffpayload" * 100
+    ours = ref_wire.encode_comm_request(17, payload)
+    theirs = pb2.CommRequest()
+    theirs.client_id = 17
+    theirs.message = payload
+    assert ours == theirs.SerializeToString()
+
+    cid, msg = ref_wire.decode_comm_request(theirs.SerializeToString())
+    assert cid == 17 and msg == payload
+
+
+def test_ref_message_pickle_bridge_roundtrip():
+    """Our encode -> restricted decode round-trips a torch-tensor payload
+    without the reference package on the path (shim module branch)."""
+    import torch
+
+    from fedml_tpu.core.distributed.communication.grpc import ref_wire
+    from fedml_tpu.core.distributed.communication.message import Message
+
+    msg = Message(3, sender_id=1, receiver_id=0)
+    msg.add_params("num_samples", 64)
+    msg.add_params(
+        Message.MSG_ARG_KEY_MODEL_PARAMS,
+        {"weight": np.arange(6, dtype=np.float32).reshape(2, 3)},
+    )
+    wire = ref_wire.encode_ref_message(msg, sender_id=1)
+    back = ref_wire.decode_ref_message(wire)
+    assert back.get_type() == 3
+    assert back.get_sender_id() == 1
+    assert back.get("num_samples") == 64
+    np.testing.assert_array_equal(
+        back.get(Message.MSG_ARG_KEY_MODEL_PARAMS)["weight"],
+        np.arange(6, dtype=np.float32).reshape(2, 3),
+    )
+
+    # bf16 payloads (our default model dtype) survive both conversions
+    import ml_dtypes
+
+    bf = Message(3, 1, 0)
+    bf.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS,
+                  {"w": np.ones((4, 2), ml_dtypes.bfloat16)})
+    back_bf = bf16 = ref_wire.decode_ref_message(ref_wire.encode_ref_message(bf, 1))
+    got = back_bf.get(Message.MSG_ARG_KEY_MODEL_PARAMS)["w"]
+    assert got.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(got.astype(np.float32), np.ones((4, 2), np.float32))
+
+    # malicious globals are refused by the restricted unpickler — including
+    # torch-namespace gadget callables, not just os.system
+    import pickle
+
+    import torch
+
+    for gadget in (os.system, torch.load, torch.hub.load):
+        with pytest.raises(pickle.UnpicklingError):
+            ref_wire.decode_ref_message(
+                ref_wire.encode_comm_request(1, pickle.dumps(gadget))
+            )
