@@ -124,6 +124,8 @@ def _ensure_ref_message_class() -> type:
         if name not in sys.modules:
             m = types.ModuleType(name)
             m.__path__ = []
+            m.__fedml_tpu_shim__ = True  # purgeable marker: tests that later
+            # want the REAL reference package can drop these shims
             sys.modules[name] = m
             if i > 1:
                 setattr(sys.modules[".".join(parts[:i - 1])], parts[i - 1], m)
@@ -202,10 +204,22 @@ _ALLOWED_GLOBALS = {
     ("torch._utils", "_rebuild_tensor_v2"),
     ("torch._utils", "_rebuild_tensor"),
     ("torch._utils", "_rebuild_parameter"),
-    ("torch.storage", "_load_from_bytes"),
     ("torch.serialization", "_get_layout"),
     ("_codecs", "encode"),
 }
+
+
+def _safe_load_from_bytes(b: bytes):
+    """Replacement for ``torch.storage._load_from_bytes``: the real one is
+    ``torch.load(weights_only=False)`` — an UNRESTRICTED inner unpickle that
+    would void this module's allowlist (nested-gadget RCE). weights_only
+    mode uses torch's own restricted unpickler and still loads every
+    legitimate tensor payload."""
+    import io as _io
+
+    import torch
+
+    return torch.load(_io.BytesIO(b), weights_only=True)
 _ALLOWED_BUILTINS = {
     "int", "float", "complex", "bool", "str", "bytes", "bytearray",
     "list", "tuple", "dict", "set", "frozenset", "slice", "range",
@@ -226,6 +240,8 @@ class _RefUnpickler(pickle.Unpickler):
     def find_class(self, module: str, name: str):
         if module == REF_MESSAGE_MODULE and name == "Message":
             return _ensure_ref_message_class()
+        if (module, name) == ("torch.storage", "_load_from_bytes"):
+            return _safe_load_from_bytes
         if (module, name) in _ALLOWED_GLOBALS:
             return super().find_class(module, name)
         if module == "torch" and name in _ALLOWED_TORCH_ATTRS:

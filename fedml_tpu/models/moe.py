@@ -38,6 +38,10 @@ class MoEConfig:
     aux_loss_weight: float = 0.01
     dtype: Any = jnp.bfloat16
     ep_axis: Optional[str] = None  # None = no sharding constraint (single host)
+    # shard_map path only: experts held locally per ep rank (n_experts/ep).
+    # Param declarations use this so flax's shape check matches the
+    # ep-sharded leaves the pipeline's in_specs deliver. None = all experts.
+    local_experts: Optional[int] = None
 
 
 def _maybe_constrain(x: jnp.ndarray, spec: P, enabled: bool) -> jnp.ndarray:
@@ -49,6 +53,19 @@ def _maybe_constrain(x: jnp.ndarray, spec: P, enabled: bool) -> jnp.ndarray:
         # no mesh in scope (e.g. model.init outside the mesh context):
         # the constraint is advisory, skip it
         return x
+
+
+def _axis_is_bound(ax: Optional[str]) -> bool:
+    """True when ``ax`` is a bound named axis, i.e. we are INSIDE a
+    shard_map/pmap body (the pipeline path) rather than under plain jit
+    (the GSPMD path). Inside jit mesh axis names are not bound."""
+    if ax is None:
+        return False
+    try:
+        jax.lax.axis_index(ax)
+        return True
+    except (NameError, KeyError, ValueError):
+        return False
 
 
 def moe_dispatch(router_logits: jnp.ndarray, capacity: int) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -96,10 +113,11 @@ class MoEMLP(nn.Module):
         N = tokens.shape[0]
         capacity = max(1, int(N / E * cfg.capacity_factor))
 
+        E_decl = cfg.local_experts or E  # router is always full-width
         router = self.param("router", nn.initializers.lecun_normal(), (D, E), jnp.float32)
-        w_gate = self.param("w_gate", nn.initializers.lecun_normal(), (E, D, F), jnp.float32)
-        w_up = self.param("w_up", nn.initializers.lecun_normal(), (E, D, F), jnp.float32)
-        w_down = self.param("w_down", nn.initializers.lecun_normal(), (E, F, D), jnp.float32)
+        w_gate = self.param("w_gate", nn.initializers.lecun_normal(), (E_decl, D, F), jnp.float32)
+        w_up = self.param("w_up", nn.initializers.lecun_normal(), (E_decl, D, F), jnp.float32)
+        w_down = self.param("w_down", nn.initializers.lecun_normal(), (E_decl, F, D), jnp.float32)
 
         ep = cfg.ep_axis is not None
         ax = cfg.ep_axis
@@ -107,18 +125,32 @@ class MoEMLP(nn.Module):
         logits = tokens.astype(jnp.float32) @ router  # [N, E]
         dispatch, combine, aux = moe_dispatch(logits, capacity)
 
-        # [N,E,C] x [N,D] -> [E,C,D]; GSPMD turns the E-dim constraint into
-        # the token->expert all-to-all over ICI
-        expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(cfg.dtype), tokens.astype(cfg.dtype))
-        expert_in = _maybe_constrain(expert_in, P(ax, None, None), ep)
-
         def ffn(w_g, w_u, w_d, h):
             return (nn.silu(h @ w_g.astype(cfg.dtype)) * (h @ w_u.astype(cfg.dtype))) @ w_d.astype(cfg.dtype)
 
-        expert_out = jax.vmap(ffn)(w_gate, w_up, w_down, expert_in)  # [E,C,D]
-        expert_out = _maybe_constrain(expert_out, P(ax, None, None), ep)
-
-        out = jnp.einsum("nec,ecd->nd", combine.astype(cfg.dtype), expert_out)
+        if ep and _axis_is_bound(ax):
+            # shard_map path (pipeline parallelism): expert weights arrive
+            # pre-sliced over the bound 'ep' axis ([E/ep, D, F] locally —
+            # pp_trainer.stage_specs shards the expert dim), so each rank
+            # computes its own experts from the full dispatch and the
+            # partial combines are psum'd. Router stays replicated: routing
+            # needs all-expert logits.
+            e_local = w_gate.shape[0]
+            e0 = jax.lax.axis_index(ax) * e_local
+            disp_l = jax.lax.dynamic_slice_in_dim(dispatch, e0, e_local, axis=1)
+            comb_l = jax.lax.dynamic_slice_in_dim(combine, e0, e_local, axis=1)
+            expert_in = jnp.einsum("nec,nd->ecd", disp_l.astype(cfg.dtype), tokens.astype(cfg.dtype))
+            expert_out = jax.vmap(ffn)(w_gate, w_up, w_down, expert_in)  # [E/ep,C,D]
+            out = jnp.einsum("nec,ecd->nd", comb_l.astype(cfg.dtype), expert_out)
+            out = jax.lax.psum(out, ax)
+        else:
+            # GSPMD path (jit): [N,E,C] x [N,D] -> [E,C,D]; the E-dim
+            # constraint turns into the token->expert all-to-all over ICI
+            expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(cfg.dtype), tokens.astype(cfg.dtype))
+            expert_in = _maybe_constrain(expert_in, P(ax, None, None), ep)
+            expert_out = jax.vmap(ffn)(w_gate, w_up, w_down, expert_in)  # [E,C,D]
+            expert_out = _maybe_constrain(expert_out, P(ax, None, None), ep)
+            out = jnp.einsum("nec,ecd->nd", combine.astype(cfg.dtype), expert_out)
         # pre-weighted: trainers add the sown aux losses to the task loss as-is
         return out.reshape(orig_shape), (cfg.aux_loss_weight * aux).astype(jnp.float32)
 # sharding rules for these params live in parallel/fsdp.py DEFAULT_RULES

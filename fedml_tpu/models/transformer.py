@@ -44,6 +44,7 @@ class TransformerConfig:
     moe_experts: int = 0         # 0 = dense MLP; >0 = Switch-style MoE MLP
     moe_capacity_factor: float = 1.25
     moe_ep_axis: Any = None      # mesh axis name for expert parallelism
+    moe_local_experts: Any = None  # shard_map pp path: experts per ep rank
     decode: bool = False         # KV-cache autoregressive decode mode (serving)
 
     @property
@@ -243,6 +244,7 @@ class Block(nn.Module):
                 d_ff=cfg.d_ff,
                 dtype=cfg.dtype,
                 ep_axis=cfg.moe_ep_axis,
+                local_experts=cfg.moe_local_experts,
             )
             y, aux = MoEMLP(moe_cfg, name="moe_mlp")(h)
             # visible via apply(..., mutable=["losses"]); no-op otherwise

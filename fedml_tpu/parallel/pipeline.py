@@ -26,7 +26,14 @@ from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+try:
+    from jax import shard_map  # jax >= 0.8
+
+    _SHARD_MAP_NO_CHECK = {"check_vma": False}
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+    _SHARD_MAP_NO_CHECK = {"check_rep": False}
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 PyTree = Any
@@ -52,16 +59,21 @@ def split_blocks_into_stages(block_params: PyTree, n_stages: int) -> PyTree:
     return jax.tree.map(fix, block_params)
 
 
-def _stage_apply(block_fn: Callable, stage_params: PyTree, h: jnp.ndarray) -> jnp.ndarray:
-    """Apply this stage's L//S blocks sequentially (scan over the block dim)."""
+def _stage_apply(block_fn: Callable, stage_params: PyTree, h: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Apply this stage's L//S blocks sequentially (scan over the block dim).
+
+    ``block_fn`` may return either ``h`` or ``(h, aux_loss)`` (MoE blocks
+    sow a load-balancing aux); returns (h_out, summed aux across blocks)."""
 
     def body(carry, blk):
+        out = block_fn(blk, carry)
+        out, aux = out if isinstance(out, tuple) else (out, jnp.zeros((), jnp.float32))
         # dtype-stable carry: a block that internally upcasts must not
         # change the scan carry (or the ppermute'd activation) dtype
-        return block_fn(blk, carry).astype(carry.dtype), None
+        return out.astype(carry.dtype), aux.astype(jnp.float32)
 
-    out, _ = jax.lax.scan(body, h, stage_params)
-    return out
+    out, auxs = jax.lax.scan(body, h, stage_params)
+    return out, jnp.sum(auxs)
 
 
 def pipeline_loss_fn(
@@ -72,6 +84,8 @@ def pipeline_loss_fn(
     n_microbatches: int,
     pp_axis: str = "pp",
     dp_axis: str | None = "dp",
+    ep_axis: str | None = None,
+    stage_specs: PyTree | None = None,
 ) -> Callable:
     """Build loss(params, tokens, targets) -> scalar, pipelined over pp_axis.
 
@@ -83,12 +97,22 @@ def pipeline_loss_fn(
 
     tokens/targets: [B, T] int arrays, B divisible by n_microbatches (and by
     the dp axis size when dp_axis is set).
+
+    ``block_fn`` may return (h, aux_loss); per-microbatch aux (e.g. the MoE
+    load-balancing loss) is accumulated over valid pipeline ticks only and
+    added to the task loss as its microbatch mean — the same value
+    gradient-accumulated microbatch training produces.
+
+    ``stage_specs``: per-leaf PartitionSpec pytree for stage params (e.g.
+    expert dims over ``ep_axis`` — see pp_trainer.stage_specs); defaults to
+    everything P(pp_axis). ``ep_axis`` names the expert axis so the loss is
+    pmean'd over it (replicated-compute transpose correctness).
     """
     S = mesh.shape[pp_axis]
     M = n_microbatches
 
     in_axes = (
-        (P(), P(pp_axis), P()),  # embed (repl) / stages (sharded) / head (repl)
+        (P(), stage_specs if stage_specs is not None else P(pp_axis), P()),
         P(dp_axis) if dp_axis else P(),  # tokens: batch over dp
         P(dp_axis) if dp_axis else P(),
     )
@@ -98,7 +122,7 @@ def pipeline_loss_fn(
         mesh=mesh,
         in_specs=in_axes,
         out_specs=P(),
-        check_rep=False,
+        **_SHARD_MAP_NO_CHECK,
     )
     def loss_fn(params, tokens, targets):
         embed_params, stage_params, head_params = params
@@ -124,44 +148,78 @@ def pipeline_loss_fn(
         # f32 carry regardless of activation dtype (bf16 activations with an
         # f32 loss would otherwise change the scan carry dtype mid-trace)
         loss_acc = jnp.zeros((), jnp.float32)
+        aux_acc = jnp.zeros((), jnp.float32)
 
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
 
         def tick(carry, t):
-            state, loss_acc = carry
+            state, loss_acc, aux_acc = carry
             # inject the next microbatch on stage 0 (t < M)
             inject = jnp.where(t < M, h_in[jnp.minimum(t, M - 1)], state)
             state = jnp.where(stage_id == 0, inject, state)
-            state = _stage_apply(block_fn, stage_params, state)
+            state, stage_aux = _stage_apply(block_fn, stage_params, state)
+            # stage s does real work on microbatch t-s at ticks s..s+M-1;
+            # aux from fill/drain bubble ticks is garbage — mask it out
+            valid = jnp.logical_and(t >= stage_id, t <= stage_id + M - 1)
+            aux_acc = aux_acc + jnp.where(valid, stage_aux, 0.0)
             # collect on the last stage once the pipe is full (t >= S-1)
             out_idx = jnp.maximum(t - (S - 1), 0)
             mb_loss = head_loss_fn(head_params, state, tgt_mb[jnp.minimum(out_idx, M - 1)])
             take = jnp.logical_and(stage_id == S - 1, t >= S - 1)
             loss_acc = loss_acc + jnp.where(take, mb_loss.astype(jnp.float32), 0.0)
             state = jax.lax.ppermute(state, pp_axis, fwd_perm)
-            return (state, loss_acc), None
+            return (state, loss_acc, aux_acc), None
 
-        (state, loss_acc), _ = jax.lax.scan(
-            tick, (state, loss_acc), jnp.arange(M + S - 1)
+        (state, loss_acc, aux_acc), _ = jax.lax.scan(
+            tick, (state, loss_acc, aux_acc), jnp.arange(M + S - 1)
         )
-        # loss lives on the last stage only -> share across pp; mean over dp
-        loss = jax.lax.psum(loss_acc, pp_axis) / M
+        # task loss lives on the last stage, aux on each owning stage ->
+        # share across pp; microbatch mean; then mean over dp
+        loss = (jax.lax.psum(loss_acc, pp_axis) + jax.lax.psum(aux_acc, pp_axis)) / M
         if dp_axis:
             loss = jax.lax.pmean(loss, dp_axis)
+        if ep_axis:
+            # value is replicated across ep (aux/router identical on every
+            # rank); the pmean makes the replicated-compute transpose put
+            # correctly-scaled cotangents on embed/head/router grads
+            loss = jax.lax.pmean(loss, ep_axis)
         return loss
 
     return loss_fn
 
 
-def pp_param_shardings(mesh: Mesh, params_shape: PyTree, pp_axis: str = "pp") -> PyTree:
-    """NamedShardings for (embed, stages, head): stages over pp, rest replicated."""
+def stage_specs(stages: PyTree, pp_axis: str = "pp", ep_axis: str | None = None) -> PyTree:
+    """Per-leaf PartitionSpecs for a stacked stage tree: everything over
+    ``pp`` on dim 0; expert-weight leaves (path contains ``moe_mlp``, name
+    w_gate/w_up/w_down — shape [S, Ls, E, ...]) additionally shard the
+    expert dim over ``ep``. The router stays replicated over ep — routing
+    needs all-expert logits (models/moe.py shard_map path)."""
+
+    def spec(path, leaf):
+        keys = [getattr(p, "key", None) for p in path]
+        if ep_axis and "moe_mlp" in keys and keys[-1] in ("w_gate", "w_up", "w_down"):
+            return P(pp_axis, None, ep_axis)
+        return P(pp_axis)
+
+    return jax.tree_util.tree_map_with_path(spec, stages)
+
+
+def pp_param_shardings(mesh: Mesh, params_shape: PyTree, pp_axis: str = "pp",
+                       ep_axis: str | None = None) -> PyTree:
+    """NamedShardings for (embed, stages, head): stages over pp (MoE expert
+    dims additionally over ep when given), embed/head replicated."""
     embed_s, stage_s, head_s = params_shape
 
     def named(spec):
         return lambda _leaf: NamedSharding(mesh, spec)
 
+    stage_sh = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), stage_specs(stage_s, pp_axis, ep_axis),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
     return (
         jax.tree.map(named(P()), embed_s),
-        jax.tree.map(named(P(pp_axis)), stage_s),
+        stage_sh,
         jax.tree.map(named(P()), head_s),
     )

@@ -104,10 +104,12 @@ class ExperimentArguments:
 
     def mesh_shape(self) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
         if self.pp > 1:
-            # pipeline mode: ('dp','pp') mesh; other axes must be 1 (stage
-            # params could additionally shard over fsdp/tp in the future)
-            if any(n > 1 for n in (self.fsdp, self.tp, self.sp, self.ep)):
-                raise ValueError("pp>1 currently composes only with dp")
+            # pipeline mode: ('dp','pp'[,'ep']) mesh; fsdp/tp/sp must be 1
+            # (stage params could additionally shard over fsdp/tp in future)
+            if any(n > 1 for n in (self.fsdp, self.tp, self.sp)):
+                raise ValueError("pp>1 composes only with dp and ep")
+            if self.ep > 1:
+                return (self.dp, self.pp, self.ep), ("dp", "pp", "ep")
             return (self.dp, self.pp), ("dp", "pp")
         axes, names = [], []
         for n, name in (

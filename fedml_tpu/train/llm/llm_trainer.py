@@ -165,9 +165,12 @@ class LLMTrainer:
             tx = _optax.multi_transform(
                 {"train": self._full_tx, "freeze": _optax.set_to_zero()}, labels3
             )
-        p3 = shard_pp_params(p3, self.mesh)
+        from .pp_trainer import pp_ep_axis
+
+        p3 = shard_pp_params(p3, self.mesh, ep_axis=pp_ep_axis(self.cfg, self.mesh))
         loss_fn = make_pp_loss_fn(
-            self.cfg, self.mesh, n_microbatches=self.exp_args.pp_microbatches
+            self.cfg, self.mesh, n_microbatches=self.exp_args.pp_microbatches,
+            stages_like=p3[1],
         )
         opt_state = tx.init(p3)
 
@@ -197,10 +200,11 @@ class LLMTrainer:
         """Install named-layout params, converting to the active parallel
         layout (pp stage tuple or fsdp-sharded named tree)."""
         if getattr(self, "_pp_mode", False):
-            from .pp_trainer import shard_pp_params, split_lm_params
+            from .pp_trainer import pp_ep_axis, shard_pp_params, split_lm_params
 
             self.params = shard_pp_params(
-                split_lm_params(named, self.cfg, self.exp_args.pp), self.mesh
+                split_lm_params(named, self.cfg, self.exp_args.pp), self.mesh,
+                ep_axis=pp_ep_axis(self.cfg, self.mesh),
             )
         else:
             self.params = jax.device_put(named, param_shardings(named, self.mesh))
