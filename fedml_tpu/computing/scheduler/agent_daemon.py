@@ -1,0 +1,65 @@
+"""Always-on edge agent daemon (a real OS process).
+
+Reference: ``computing/scheduler/slave/client_daemon.py`` + ``client_login``
+— the login CLI leaves a daemon running that serves start/stop/OTA topics
+forever. Run one with:
+
+    python -m fedml_tpu.computing.scheduler.agent_daemon \
+        --edge-id 3 --base-dir /var/fedml/edge3 --broker 127.0.0.1:18999
+
+State is journaled (agent_db.py): kill -9 this process mid-run, start it
+again, and the run is recovered (FAILED + elastic replay by the JobMonitor),
+matching the reference's sqlite-backed resume. An OTA request with
+``restart: true`` re-execs the process in place (reference
+``client_runner.py:866`` ``ota_upgrade``) — the new process announces the
+adopted version with a fresh pid and the journal intact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="fedml_tpu edge agent daemon")
+    p.add_argument("--edge-id", type=int, required=True)
+    p.add_argument("--base-dir", required=True)
+    p.add_argument("--broker", required=True, help="socket broker host:port")
+    p.add_argument("--store-root", default=None, help="object store root dir")
+    p.add_argument("--poll-s", type=float, default=0.5)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    os.environ["FEDML_MQTT_SOCKET"] = args.broker
+    os.environ["FEDML_AGENT_DAEMON"] = "1"
+
+    from fedml_tpu.core.distributed.communication.mqtt_s3.object_store import LocalObjectStore
+    from fedml_tpu.computing.scheduler.mqtt_agents import JobMonitor, MqttClientAgent
+
+    store = LocalObjectStore(args.store_root) if args.store_root else None
+    agent = MqttClientAgent(args.edge_id, base_dir=args.base_dir, store=store)
+    monitor = JobMonitor([agent], poll_s=args.poll_s, restart_failed=True)
+    monitor.start()
+    agent.announce()
+
+    while True:
+        if agent.restart_requested:
+            # OTA: replace this process in place; the journal carries the
+            # adopted version and all run state into the new incarnation.
+            # Jobs are killed un-reported — exec would orphan them while the
+            # reborn agent replays the same runs (duplicate execution)
+            monitor.stop()
+            agent.runner.kill_all_running()
+            agent.stop()
+            os.execv(sys.executable, [sys.executable, "-m",
+                                      "fedml_tpu.computing.scheduler.agent_daemon",
+                                      *(argv if argv is not None else sys.argv[1:])])
+        time.sleep(0.2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,161 @@
+"""Durable agent state (sqlite).
+
+Reference: ``computing/scheduler/slave/client_data_interface.py`` — the
+reference agent journals every job/run to sqlite under the agent's home dir
+so a restarted agent resumes monitoring and can replay elastic restarts.
+Same role here: runs, their originating wire requests, restart budgets and
+agent metadata (version) survive the agent process.
+
+Thread-safe: the MQTT callbacks, the job waiter threads and the JobMonitor
+all write; one connection with a lock (WAL) keeps it simple and correct.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from dataclasses import asdict
+from typing import Any, Dict, Optional
+
+from .agents import RunStatus
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS runs (
+    run_id TEXT NOT NULL,
+    edge_id INTEGER NOT NULL,
+    status TEXT NOT NULL,
+    returncode INTEGER,
+    log_path TEXT,
+    detail TEXT,
+    updated_at REAL,
+    PRIMARY KEY (run_id, edge_id)
+);
+CREATE TABLE IF NOT EXISTS requests (
+    run_id TEXT NOT NULL,
+    edge_id INTEGER NOT NULL,
+    source TEXT NOT NULL,          -- 'wire' (raw MQTT json) or 'local'
+    request_json TEXT NOT NULL,
+    PRIMARY KEY (run_id, source)   -- wire and local coexist: wire is the
+                                   -- replay source, local the fallback
+);
+CREATE TABLE IF NOT EXISTS restarts (
+    key TEXT PRIMARY KEY,
+    count INTEGER NOT NULL
+);
+CREATE TABLE IF NOT EXISTS meta (
+    key TEXT PRIMARY KEY,
+    value TEXT NOT NULL
+);
+"""
+
+
+class AgentDatabase:
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self.path = path
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.executescript(_SCHEMA)
+            self._migrate_locked()
+            self._conn.commit()
+
+    def _migrate_locked(self) -> None:
+        """Schema migrations for journals written by older agents (sqlite
+        cannot alter a PK in place — rebuild + copy)."""
+        cols = self._conn.execute("PRAGMA table_info(requests)").fetchall()
+        pk_cols = [c[1] for c in cols if c[5] > 0]
+        if pk_cols == ["run_id"]:  # pre-(run_id, source) composite key
+            self._conn.executescript(
+                "ALTER TABLE requests RENAME TO requests_v0;"
+                "CREATE TABLE requests ("
+                " run_id TEXT NOT NULL, edge_id INTEGER NOT NULL,"
+                " source TEXT NOT NULL, request_json TEXT NOT NULL,"
+                " PRIMARY KEY (run_id, source));"
+                "INSERT OR IGNORE INTO requests"
+                " SELECT run_id, edge_id, source, request_json FROM requests_v0;"
+                "DROP TABLE requests_v0;"
+            )
+
+    # --- runs ------------------------------------------------------------
+    def upsert_run(self, st: RunStatus) -> None:
+        d = asdict(st)
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO runs (run_id, edge_id, status, returncode, log_path, detail, updated_at)"
+                " VALUES (?,?,?,?,?,?,?)"
+                " ON CONFLICT(run_id, edge_id) DO UPDATE SET status=excluded.status,"
+                " returncode=excluded.returncode, log_path=excluded.log_path,"
+                " detail=excluded.detail, updated_at=excluded.updated_at",
+                (d["run_id"], d["edge_id"], d["status"], d["returncode"],
+                 d["log_path"], d["detail"], time.time()),
+            )
+            self._conn.commit()
+
+    def load_runs(self, edge_id: int) -> Dict[str, RunStatus]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT run_id, edge_id, status, returncode, log_path, detail"
+                " FROM runs WHERE edge_id=?", (edge_id,),
+            ).fetchall()
+        return {
+            r[0]: RunStatus(run_id=r[0], edge_id=r[1], status=r[2],
+                            returncode=r[3], log_path=r[4], detail=r[5] or "")
+            for r in rows
+        }
+
+    # --- requests --------------------------------------------------------
+    def save_request(self, run_id: str, edge_id: int, request: Dict[str, Any],
+                     source: str = "local") -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO requests (run_id, edge_id, source, request_json) VALUES (?,?,?,?)"
+                " ON CONFLICT(run_id, source) DO UPDATE SET"
+                " edge_id=excluded.edge_id, request_json=excluded.request_json",
+                (run_id, edge_id, source, json.dumps(request)),
+            )
+            self._conn.commit()
+
+    def load_requests(self, edge_id: int, source: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
+        q = "SELECT run_id, request_json FROM requests WHERE edge_id=?"
+        params: tuple = (edge_id,)
+        if source is not None:
+            q += " AND source=?"
+            params += (source,)
+        with self._lock:
+            rows = self._conn.execute(q, params).fetchall()
+        return {r[0]: json.loads(r[1]) for r in rows}
+
+    # --- restart budget --------------------------------------------------
+    def get_restart_count(self, key: str) -> int:
+        with self._lock:
+            row = self._conn.execute("SELECT count FROM restarts WHERE key=?", (key,)).fetchone()
+        return int(row[0]) if row else 0
+
+    def bump_restart_count(self, key: str) -> int:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO restarts (key, count) VALUES (?, 1)"
+                " ON CONFLICT(key) DO UPDATE SET count=count+1", (key,),
+            )
+            self._conn.commit()
+            return int(self._conn.execute("SELECT count FROM restarts WHERE key=?", (key,)).fetchone()[0])
+
+    # --- meta ------------------------------------------------------------
+    def set_meta(self, key: str, value: str) -> None:
+        with self._lock:
+            self._conn.execute("INSERT OR REPLACE INTO meta (key, value) VALUES (?,?)", (key, value))
+            self._conn.commit()
+
+    def get_meta(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        with self._lock:
+            row = self._conn.execute("SELECT value FROM meta WHERE key=?", (key,)).fetchone()
+        return row[0] if row else default
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

@@ -26,6 +26,8 @@ from .package import retrieve_and_unzip_package
 
 log = logging.getLogger(__name__)
 
+TERMINAL = {"FINISHED", "FAILED", "KILLED"}
+
 
 @dataclass
 class RunStatus:
@@ -42,22 +44,42 @@ class FedMLClientRunner:
     runs bootstrap then the job command, and reports status."""
 
     def __init__(self, edge_id: int, base_dir: Optional[str] = None,
-                 status_callback: Optional[Callable[[RunStatus], None]] = None):
+                 status_callback: Optional[Callable[[RunStatus], None]] = None,
+                 db: Optional[Any] = None):
         self.edge_id = edge_id
         self.base_dir = base_dir or os.path.join(tempfile.gettempdir(), "fedml_tpu_agent")
         self.status_callback = status_callback or (lambda s: None)
+        self.db = db  # AgentDatabase: run/request state survives this process
         self.runs: Dict[str, RunStatus] = {}
         self.requests: Dict[str, Dict[str, Any]] = {}  # last request per run (restart source)
         self._procs: Dict[str, subprocess.Popen] = {}
+        self.recovered_runs: list = []
+        if db is not None:
+            # reference client_data_interface.py: a restarted agent resumes
+            # from journaled state. Subprocesses did not survive us, so any
+            # journaled non-terminal run is dead — surface it as FAILED so
+            # the JobMonitor's elastic restart can replay it.
+            self.runs = db.load_runs(self.edge_id)
+            self.requests = db.load_requests(self.edge_id, source="local")
+            for run_id, st in self.runs.items():
+                if st.status not in TERMINAL:
+                    st.status = "FAILED"
+                    st.detail = "agent died mid-run; recovered from journal on restart"
+                    self.recovered_runs.append(run_id)
+                    self._report(st)
 
     def _report(self, st: RunStatus) -> None:
         self.runs[st.run_id] = st
+        if self.db is not None:
+            self.db.upsert_run(st)
         self.status_callback(st)
 
     def callback_start_train(self, request: Dict[str, Any], wait: bool = True) -> RunStatus:
         """request: {run_id, package_path, job_cmd, bootstrap_cmd?, env?}."""
         run_id = str(request.get("run_id") or uuid.uuid4().hex[:8])
         self.requests[run_id] = dict(request, run_id=run_id)
+        if self.db is not None:
+            self.db.save_request(run_id, self.edge_id, self.requests[run_id], source="local")
         st = RunStatus(run_id=run_id, edge_id=self.edge_id, status="PROVISIONING")
         self._report(st)
 
@@ -108,6 +130,15 @@ class FedMLClientRunner:
         else:
             threading.Thread(target=_wait, daemon=True).start()
         return st
+
+    def kill_all_running(self) -> None:
+        """Kill job subprocesses WITHOUT reporting (OTA re-exec path: the
+        process image is about to be replaced, so the journal keeps these
+        runs non-terminal and the reborn agent recovers + replays them —
+        leaving the children alive would double-execute each run)."""
+        for proc in list(self._procs.values()):
+            if proc.poll() is None:
+                proc.kill()
 
     def callback_stop_train(self, run_id: str) -> None:
         proc = self._procs.get(run_id)

@@ -34,7 +34,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ...core.distributed.communication.mqtt_s3.mqtt_transport import create_mqtt_transport
 from ...core.distributed.communication.mqtt_s3.object_store import LocalObjectStore
-from .agents import FedMLClientRunner, RunStatus
+from .agent_db import AgentDatabase
+from .agents import TERMINAL, FedMLClientRunner, RunStatus
 from .package import build_job_package
 
 log = logging.getLogger(__name__)
@@ -45,8 +46,6 @@ TOPIC_START = "flserver_agent/{edge_id}/start_train"
 TOPIC_STOP = "flserver_agent/{edge_id}/stop_train"
 TOPIC_OTA = "flclient_agent/{edge_id}/ota"
 TOPIC_STATUS = "fl_client/flclient_agent_{edge_id}/status"
-
-TERMINAL = {"FINISHED", "FAILED", "KILLED"}
 
 
 class MqttClientAgent:
@@ -62,19 +61,37 @@ class MqttClientAgent:
         store: Optional[LocalObjectStore] = None,
     ):
         self.edge_id = int(edge_id)
-        self.version = AGENT_VERSION
         self.transport = create_mqtt_transport(args, client_id=f"edge_agent_{edge_id}")
         self.store = store or LocalObjectStore()
+        self.base_dir = base_dir or os.path.join(tempfile.gettempdir(), f"fedml_tpu_mqtt_edge_{edge_id}")
+        # durable state (reference client_data_interface.py): runs, wire
+        # requests, restart budgets and the adopted version live in sqlite
+        # under the agent home, so an agent restart resumes where it died
+        self.db = AgentDatabase(os.path.join(self.base_dir, "agent.db"))
+        self.version = self.db.get_meta("version", AGENT_VERSION)
+        self.restart_requested = False
         self.runner = FedMLClientRunner(
             self.edge_id,
-            base_dir=base_dir or os.path.join(tempfile.gettempdir(), f"fedml_tpu_mqtt_edge_{edge_id}"),
+            base_dir=self.base_dir,
             status_callback=self._publish_status,
+            db=self.db,
         )
-        self.raw_requests: Dict[str, Dict[str, Any]] = {}
+        self.raw_requests: Dict[str, Dict[str, Any]] = self.db.load_requests(self.edge_id, source="wire")
         self.transport.subscribe(TOPIC_START.format(edge_id=self.edge_id), self._on_start)
         self.transport.subscribe(TOPIC_STOP.format(edge_id=self.edge_id), self._on_stop)
         self.transport.subscribe(TOPIC_OTA.format(edge_id=self.edge_id), self._on_ota)
         log.info("edge agent %d online (v%s)", self.edge_id, self.version)
+
+    def announce(self) -> None:
+        """Publish agent liveness (daemon startup / post-OTA re-exec)."""
+        self.transport.publish(
+            TOPIC_STATUS.format(edge_id=self.edge_id),
+            json.dumps({
+                "type": "agent_online", "edge_id": self.edge_id,
+                "version": self.version, "pid": os.getpid(),
+                "recovered_runs": list(self.runner.recovered_runs),
+            }).encode(),
+        )
 
     # --- topic handlers --------------------------------------------------
     def _on_start(self, _topic: str, payload: bytes) -> None:
@@ -82,15 +99,18 @@ class MqttClientAgent:
         run_id = str(request.get("run_id") or uuid.uuid4().hex[:8])
         # keep the ORIGINAL wire request so the job monitor can replay the
         # full download+exec cycle (a download failure must be restartable)
+        # — journaled, so replay survives an agent restart
         self.raw_requests[run_id] = dict(request, run_id=run_id)
+        self.db.save_request(run_id, self.edge_id, self.raw_requests[run_id], source="wire")
         package_url = request.get("package_url")
         local_pkg = os.path.join(self.runner.base_dir, "packages", f"{run_id}.zip")
         try:
             self.store.fetch_file(package_url, local_pkg)
         except Exception as e:  # noqa: BLE001 - download boundary
             st = RunStatus(run_id=run_id, edge_id=self.edge_id, status="FAILED", detail=f"download: {e!r}")
-            self.runner.runs[run_id] = st  # visible to the job monitor
-            self._publish_status(st)
+            # through _report: journals + publishes + visible to the monitor
+            # (a bare runs[] write would make this failure vanish on restart)
+            self.runner._report(st)
             return
         request = dict(request, run_id=run_id, package_path=local_pkg)
         # non-blocking: the agent must keep serving its topics during the job
@@ -109,14 +129,25 @@ class MqttClientAgent:
         self.runner.callback_stop_train(run_id)
 
     def _on_ota(self, _topic: str, payload: bytes) -> None:
-        """OTA upgrade (reference client_runner.py:866): adopt the announced
-        version and confirm over the status topic."""
-        target = str(json.loads(payload).get("version", self.version))
+        """OTA upgrade (reference client_runner.py:866 ``ota_upgrade``):
+        persist the announced version, confirm over the status topic, and —
+        when the request says restart — flag the hosting daemon to re-exec
+        itself (agent_daemon.py), proving state survival across the upgrade.
+        The reference additionally pip-installs the new wheel before its
+        restart; package installation is env-blocked here (zero egress), so
+        the upgrade is version adoption + full process replacement."""
+        doc = json.loads(payload)
+        target = str(doc.get("version", self.version))
         old, self.version = self.version, target
+        self.db.set_meta("version", target)
         self.transport.publish(
             TOPIC_STATUS.format(edge_id=self.edge_id),
-            json.dumps({"type": "ota", "edge_id": self.edge_id, "from": old, "to": target}).encode(),
+            json.dumps({"type": "ota", "edge_id": self.edge_id, "from": old,
+                        "to": target, "pid": os.getpid(),
+                        "restart": bool(doc.get("restart"))}).encode(),
         )
+        if doc.get("restart"):
+            self.restart_requested = True
 
     def _publish_status(self, st: RunStatus) -> None:
         doc = asdict(st)
@@ -137,6 +168,7 @@ class MqttServerAgent:
         self.store = store or LocalObjectStore()
         self.statuses: Dict[str, Dict[int, Dict[str, Any]]] = {}
         self.ota_acks: List[Dict[str, Any]] = []
+        self.agent_events: List[Dict[str, Any]] = []  # agent_online announcements
         self._cv = threading.Condition()
         for eid in self.edge_ids:
             self.transport.subscribe(TOPIC_STATUS.format(edge_id=eid), self._on_status)
@@ -146,6 +178,8 @@ class MqttServerAgent:
         with self._cv:
             if doc.get("type") == "ota":
                 self.ota_acks.append(doc)
+            elif doc.get("type") == "agent_online":
+                self.agent_events.append(doc)
             else:
                 self.statuses.setdefault(str(doc["run_id"]), {})[int(doc["edge_id"])] = doc
             self._cv.notify_all()
@@ -182,10 +216,14 @@ class MqttServerAgent:
                 TOPIC_STOP.format(edge_id=eid), json.dumps({"run_id": run_id}).encode()
             )
 
-    def push_ota(self, version: str, edge_ids: Optional[List[int]] = None) -> None:
+    def push_ota(self, version: str, edge_ids: Optional[List[int]] = None,
+                 restart: bool = False) -> None:
+        """restart=True additionally asks daemon-hosted agents to re-exec
+        (real upgrade path — reference client_runner.py:866)."""
         for eid in edge_ids if edge_ids is not None else self.edge_ids:
             self.transport.publish(
-                TOPIC_OTA.format(edge_id=eid), json.dumps({"version": version}).encode()
+                TOPIC_OTA.format(edge_id=eid),
+                json.dumps({"version": version, "restart": restart}).encode(),
             )
 
     def wait_for_run(
@@ -263,11 +301,18 @@ class JobMonitor:
         if not self.restart_failed or st.status != "FAILED":
             return
         key = f"{agent.edge_id}:{run_id}"
-        if self._restarts.get(key, 0) >= self.max_restarts:
+        # restart budget is journaled with the agent: the elastic-restart
+        # guarantee must hold exactly when the agent itself died (r2 weak #8)
+        db = getattr(agent, "db", None)
+        count = db.get_restart_count(key) if db is not None else self._restarts.get(key, 0)
+        if count >= self.max_restarts:
             return
         if run_id not in agent.raw_requests and agent.runner.requests.get(run_id) is None:
             return
-        self._restarts[key] = self._restarts.get(key, 0) + 1
+        if db is not None:
+            self._restarts[key] = db.bump_restart_count(key)
+        else:
+            self._restarts[key] = self._restarts.get(key, 0) + 1
         self.restarts.append(run_id)
         log.warning("job monitor: restarting failed run %s on edge %d (attempt %d/%d)",
                     run_id, agent.edge_id, self._restarts[key], self.max_restarts)

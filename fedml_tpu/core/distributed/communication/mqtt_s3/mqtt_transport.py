@@ -109,7 +109,11 @@ class LocalMqttTransport:
 
 
 def create_mqtt_transport(args, client_id: str):
-    """Prefer a real broker when configured + paho present."""
+    """Transport selection: real broker (mqtt_host + paho) > cross-process
+    socket broker (mqtt_socket arg or FEDML_MQTT_SOCKET env — agent daemons
+    as real processes) > in-process local broker."""
+    import os
+
     host = getattr(args, "mqtt_host", None) if args is not None else None
     if host:
         try:  # pragma: no cover - needs broker
@@ -121,5 +125,11 @@ def create_mqtt_transport(args, client_id: str):
             )
         except ImportError:
             log.warning("mqtt_host configured but paho-mqtt unavailable; using local broker")
+    sock_addr = (getattr(args, "mqtt_socket", None) if args is not None else None) \
+        or os.environ.get("FEDML_MQTT_SOCKET")
+    if sock_addr:
+        from .socket_broker import SocketMqttTransport
+
+        return SocketMqttTransport(sock_addr, client_id=client_id)
     run_id = str(getattr(args, "run_id", "default")) if args is not None else "default"
     return LocalMqttTransport(broker_id=run_id, client_id=client_id)

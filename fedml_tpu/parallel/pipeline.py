@@ -84,7 +84,6 @@ def pipeline_loss_fn(
     n_microbatches: int,
     pp_axis: str = "pp",
     dp_axis: str | None = "dp",
-    ep_axis: str | None = None,
     stage_specs: PyTree | None = None,
 ) -> Callable:
     """Build loss(params, tokens, targets) -> scalar, pipelined over pp_axis.
@@ -104,9 +103,9 @@ def pipeline_loss_fn(
     gradient-accumulated microbatch training produces.
 
     ``stage_specs``: per-leaf PartitionSpec pytree for stage params (e.g.
-    expert dims over ``ep_axis`` — see pp_trainer.stage_specs); defaults to
-    everything P(pp_axis). ``ep_axis`` names the expert axis so the loss is
-    pmean'd over it (replicated-compute transpose correctness).
+    expert dims over an 'ep' axis — see stage_specs()); defaults to
+    everything P(pp_axis). Any mesh axis beyond pp/dp gets a loss pmean so
+    replicated-compute transposes scale gradients correctly.
     """
     S = mesh.shape[pp_axis]
     M = n_microbatches
@@ -178,11 +177,14 @@ def pipeline_loss_fn(
         loss = (jax.lax.psum(loss_acc, pp_axis) + jax.lax.psum(aux_acc, pp_axis)) / M
         if dp_axis:
             loss = jax.lax.pmean(loss, dp_axis)
-        if ep_axis:
-            # value is replicated across ep (aux/router identical on every
-            # rank); the pmean makes the replicated-compute transpose put
-            # correctly-scaled cotangents on embed/head/router grads
-            loss = jax.lax.pmean(loss, ep_axis)
+        # pmean over EVERY other mesh axis ('ep', or any axis the computation
+        # is merely replicated over): identity on the value, but it scales
+        # the shard_map transpose's psum of replicated-param cotangents
+        # correctly — without it a dense model on a ('dp','pp','ep') mesh
+        # would silently train with gradients multiplied by the ep size
+        for ax in mesh.axis_names:
+            if ax != pp_axis and ax != dp_axis:
+                loss = jax.lax.pmean(loss, ax)
         return loss
 
     return loss_fn

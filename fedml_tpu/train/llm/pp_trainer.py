@@ -123,7 +123,7 @@ def make_pp_loss_fn(
     return pipeline_loss_fn(
         block_fn, embed_fn, head_loss_fn, mesh,
         n_microbatches=n_microbatches, pp_axis=pp_axis, dp_axis=dp_axis,
-        ep_axis=ep_axis, stage_specs=specs,
+        stage_specs=specs,
     )
 
 
