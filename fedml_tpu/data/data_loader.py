@@ -74,9 +74,16 @@ def load(args: Any) -> FedDataset:
         args.output_dim = class_num
         return (len(train_g), len(test_g), train_g, test_g, train_num_dict, train_local, test_local, class_num)
 
+    from .downloads import maybe_download
     from .formats import detect_format_files, load_native_format
 
-    if detect_format_files(dataset, cache):
+    fmt = detect_format_files(dataset, cache)
+    if not fmt and maybe_download(dataset, cache, bool(getattr(args, "allow_download", False))):
+        # guarded fetch (no-op without allow_download + egress) just landed
+        # real files — re-detect so they are used (docs/datasets.md)
+        fmt = detect_format_files(dataset, cache)
+
+    if fmt:
         # real reference-format files present (LEAF json / TFF h5): use them
         # with the file's own client partition
         fed = load_native_format(dataset, cache, client_num)

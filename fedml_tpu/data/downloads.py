@@ -1,0 +1,119 @@
+"""Guarded real-dataset downloads (reference URL registry).
+
+Reference: ``data/MNIST/data_loader.py:20-30`` (wget + unzip per dataset),
+``data/data_loader.py:247`` (download_data branch), ``constants.py:34``.
+This environment has zero egress, so downloads NEVER run by default — the
+zoo falls back to deterministic synthetic surrogates and format parsers
+(formats.py) for files already on disk. When egress exists, set
+``args.allow_download = True`` (or ``FEDML_ALLOW_DOWNLOAD=1``) and the
+loader fetches the reference's own archives into ``data_cache_dir``, after
+which format auto-detection picks the real data up exactly as if the user
+had placed the files there.
+
+See docs/datasets.md for the per-dataset parity matrix.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import tarfile
+import urllib.parse
+import urllib.request
+import zipfile
+from typing import Dict, List, Tuple
+
+log = logging.getLogger(__name__)
+
+# dataset name -> archive urls. URLs are the reference's own (constants.py /
+# per-dataset data_loader.py files). ONLY datasets with a native-format
+# parser (formats.py) are registered — downloading bytes no loader consumes
+# would waste the user's bandwidth and still train on the surrogate.
+DATASET_URLS: Dict[str, List[str]] = {
+    "mnist": ["https://fedcv.s3.us-west-1.amazonaws.com/MNIST.zip"],
+    "fed_cifar100": ["https://fedml.s3-us-west-1.amazonaws.com/fed_cifar100.tar.bz2"],
+    "femnist": ["https://fedml.s3-us-west-1.amazonaws.com/fed_emnist.tar.bz2"],
+    "fed_shakespeare": ["https://fedml.s3-us-west-1.amazonaws.com/shakespeare.tar.bz2"],
+    "stackoverflow_nwp": ["https://fedml.s3-us-west-1.amazonaws.com/stackoverflow.tar.bz2"],
+}
+
+
+def egress_available(url: str, timeout_s: float = 3.0) -> bool:
+    """Cheap TCP probe of the archive host — a zero-egress box must fail in
+    seconds, not hang a multi-minute HTTP timeout."""
+    host = urllib.parse.urlparse(url).netloc
+    try:
+        with socket.create_connection((host, 443), timeout=timeout_s):
+            return True
+    except OSError:
+        return False
+
+
+def _extract(archive: str, dest: str, name_hint: str | None = None) -> None:
+    kind = name_hint or archive
+    if kind.endswith(".zip"):
+        with zipfile.ZipFile(archive) as z:
+            z.extractall(dest)
+    elif kind.endswith((".tar.bz2", ".tar.gz", ".tgz")):
+        with tarfile.open(archive) as t:
+            t.extractall(dest, filter="data")
+    # bare files (.csv/.pkl) need no extraction
+
+
+def maybe_download(dataset: str, cache_dir: str, allow_download: bool = False) -> bool:
+    """Fetch `dataset`'s reference archives into ``{cache_dir}/{dataset}``.
+
+    Returns True if anything was downloaded. No-op (False) unless the
+    download gate is open AND the dataset has a registered source AND the
+    host is reachable."""
+    allow = allow_download or os.environ.get("FEDML_ALLOW_DOWNLOAD", "") == "1"
+    urls = DATASET_URLS.get(dataset)
+    if not (allow and urls and cache_dir):
+        return False
+    dest = os.path.join(cache_dir, dataset)
+    os.makedirs(dest, exist_ok=True)
+    if not egress_available(urls[0]):
+        log.warning("allow_download set but %s is unreachable (no egress?); "
+                    "falling back to surrogate for %s", urls[0], dataset)
+        return False
+    fetched = False
+    for url in urls:
+        fname = os.path.join(dest, os.path.basename(urllib.parse.urlparse(url).path))
+        if os.path.exists(fname):
+            continue
+        log.info("downloading %s -> %s", url, fname)
+        tmp = fname + ".part"
+        try:
+            urllib.request.urlretrieve(url, tmp)
+            # extract from the .part, THEN rename: the final archive name on
+            # disk means "downloaded AND extracted", so a crash mid-extract
+            # retries next run instead of wedging on the surrogate forever
+            _extract(tmp, dest, name_hint=fname)
+            os.replace(tmp, fname)
+            fetched = True
+        except Exception as e:  # noqa: BLE001 - download is best-effort:
+            # 404/403/reset/corrupt archive must fall back to the surrogate,
+            # not crash the training run (the guard's contract)
+            log.warning("download of %s failed (%r); using surrogate for %s",
+                        url, e, dataset)
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            return False
+    if fetched:
+        _flatten_single_dir(dest)
+    return fetched
+
+
+def _flatten_single_dir(dest: str) -> None:
+    """Archives like MNIST.zip wrap everything in one top-level directory;
+    format detection expects the files directly under ``{cache}/{dataset}``,
+    so hoist a lone wrapper dir's contents up."""
+    import shutil
+
+    entries = [e for e in os.listdir(dest) if not e.endswith((".zip", ".tar.bz2", ".tar.gz", ".part"))]
+    if len(entries) == 1 and os.path.isdir(os.path.join(dest, entries[0])):
+        inner = os.path.join(dest, entries[0])
+        for item in os.listdir(inner):
+            shutil.move(os.path.join(inner, item), os.path.join(dest, item))
+        os.rmdir(inner)

@@ -138,7 +138,12 @@ def create(args: Any, output_dim: Optional[int] = None, seed: Optional[int] = No
     elif model_name in ("darts", "nas", "fednas"):
         from .darts import DARTSNetwork
 
-        module = DARTSNetwork(num_classes=num_classes)
+        module = DARTSNetwork(
+            num_classes=num_classes,
+            width=int(getattr(args, "darts_width", 16)),
+            layers=int(getattr(args, "darts_layers", 3)),
+            steps=int(getattr(args, "darts_steps", 3)),
+        )
     elif model_name in ("unet", "segnet", "deeplab"):
         from .segmentation import SegNetLite
 

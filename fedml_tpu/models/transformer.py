@@ -170,7 +170,6 @@ class Attention(nn.Module):
         k = rotary_embedding(k, positions, cfg.rope_theta)
         if cfg.decode:
             return self._decode_attention(q, k, v, B, T)
-        k, v = repeat_kv(k, v, cfg.n_heads)
         impl = cfg.attention_impl
         if impl == "auto":
             # pallas only where it runs compiled: interpret-mode flash on CPU
@@ -178,14 +177,18 @@ class Attention(nn.Module):
             # a platform fallback the user never asked for
             impl = "pallas" if jax.default_backend() == "tpu" else "xla"
         if impl == "pallas":
+            # GQA-native: the kernel maps query heads to kv heads itself —
+            # repeat_kv here would materialize G copies of K/V in HBM
             from ..ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True)
         elif impl == "ring":
             from ..parallel.ring_attention import ring_attention_inner
 
+            k, v = repeat_kv(k, v, cfg.n_heads)
             out = ring_attention_inner(q, k, v)
         else:
+            k, v = repeat_kv(k, v, cfg.n_heads)
             out = xla_attention(q, k, v, causal=True)
         out = out.reshape(B, T, cfg.n_heads * hd)
         return LoRALinear(cfg.d_model, cfg, name="o_proj")(out)

@@ -1,17 +1,22 @@
-"""Pallas TPU flash attention — forward AND backward kernels.
+"""Pallas TPU flash attention — forward AND backward kernels, GQA-native.
 
 The hot op of the LLM path (per /opt/skills/guides/pallas_guide.md). Design:
 
-* forward: grid over (batch*heads, query blocks); each program holds one q
-  block in VMEM and streams K/V for that head through the MXU in k-blocks.
+* forward: grid over (batch*q_heads, query blocks); each program holds one q
+  block in VMEM and streams K/V for its KV head through the MXU in k-blocks.
   The [T, T] score matrix never exists in HBM. Saves the per-row logsumexp
   so the backward can rebuild probabilities without a second softmax pass.
-* backward: two kernels, both streaming — dQ over (BH, q blocks) consuming
-  K/V blocks, and dK/dV over (BH, k blocks) consuming Q/dO blocks. Each
-  recomputes its score tile from the saved logsumexp (p = exp(s - lse)),
-  so the backward is O(T) memory too: this is what lets training peak
-  memory drop vs the einsum path, whose [B, H, T, T] probs tensor sits in
-  HBM exactly where the step peaks (VERDICT r2 weak #2).
+* backward: two kernels, both streaming — dQ over (BHq, q blocks) consuming
+  K/V blocks, and dK/dV over (BHkv, k blocks) consuming the Q/dO blocks of
+  every query head in its group. Each recomputes its score tile from the
+  saved logsumexp (p = exp(s - lse)), so the backward is O(T) memory too:
+  this is what lets training peak memory drop vs the einsum path, whose
+  [B, H, T, T] probs tensor sits in HBM exactly where the step peaks
+  (VERDICT r2 weak #2).
+* GQA (n_kv_heads < n_heads) is native: K/V are NEVER repeated to the query
+  head count — the kernels map each query head to its KV head through the
+  BlockSpec index maps, cutting K/V HBM traffic by the group size G
+  (``repeat_kv`` in the einsum path materializes G copies).
 
 Compute is fp32 in-kernel, outputs in the input dtype. Causal masking by
 global row/col index, with block-level skipping on both sides of the
@@ -87,23 +92,35 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int, block_k: i
     lse_ref[0] = m + jnp.log(l_safe)
 
 
-def _fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int):
-    """[BH, T, D] x3 -> (out [BH, T, D], lse [BH, T] f32)."""
-    BH, T, D = q.shape
+def _kv_index(Hq: int, Hkv: int):
+    """Program index over [B*Hq] -> block index into [B*Hkv]: query head h
+    attends to kv head h // (Hq//Hkv)."""
+    G = Hq // Hkv
+
+    def index(i, j):
+        return ((i // Hq) * Hkv + (i % Hq) // G, 0, 0)
+
+    return index
+
+
+def _fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int, Hq: int, Hkv: int):
+    """q [B*Hq, T, D]; k/v [B*Hkv, T, D] -> (out [B*Hq, T, D], lse f32)."""
+    BHq, T, D = q.shape
     scale = D ** -0.5
-    grid = (BH, T // block_q)
+    grid = (BHq, T // block_q)
+    kv_idx = _kv_index(Hq, Hkv)
     return pl.pallas_call(
         functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
                           causal=causal, scale=scale),
         out_shape=(
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+            jax.ShapeDtypeStruct((BHq, T), jnp.float32),
         ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, T, D), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, T, D), kv_idx),
+            pl.BlockSpec((1, T, D), kv_idx),
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, D), lambda i, j: (i, j, 0)),
@@ -149,13 +166,21 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, block_q: int, block_k: int,
                     causal: bool, scale: float):
+    """Grid over (B*Hkv, k blocks, G): the group dim is a GRID axis, not a
+    VMEM block axis — q/do arrive one query head at a time (index-mapped
+    ``i*G + g``), so VMEM stays O(T*D) regardless of the GQA group size.
+    g varies fastest, so the (i, j)-indexed dk/dv output blocks are
+    revisited consecutively and accumulate across the group in f32."""
     ki = pl.program_id(1)
+    g = pl.program_id(2)
     k = k_ref[0].astype(jnp.float32)          # [block_k, D]
     v = v_ref[0].astype(jnp.float32)          # [block_k, D]
     T = q_ref.shape[1]
-    D = k.shape[-1]
 
     col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    num_q = T // block_q
+    # q-blocks strictly above the diagonal band see only masked entries
+    start_q = (ki * block_k) // block_q if causal else 0
 
     def body(start, carry):
         dk, dv = carry
@@ -174,37 +199,42 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_new = dk + (ds.T @ q_blk) * scale
         return dk_new, dv_new
 
-    num_q = T // block_q
-    if causal:
-        # q-blocks strictly above the diagonal band see only masked entries
-        start_q = (ki * block_k) // block_q
-    else:
-        start_q = 0
+    D = k.shape[-1]
     dk, dv = jax.lax.fori_loop(
         start_q, num_q, body,
         (jnp.zeros((block_k, D), jnp.float32), jnp.zeros((block_k, D), jnp.float32)),
     )
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+    @pl.when(g == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    dk_ref[0] = dk_ref[0] + dk
+    dv_ref[0] = dv_ref[0] + dv
 
 
-def _bwd_impl(q, k, v, do, o, lse, *, causal: bool, block_q: int, block_k: int):
-    BH, T, D = q.shape
+def _bwd_impl(q, k, v, do, o, lse, *, causal: bool, block_q: int, block_k: int,
+              Hq: int, Hkv: int):
+    BHq, T, D = q.shape
+    BHkv = k.shape[0]
+    G = Hq // Hkv
     scale = D ** -0.5
     # delta = rowsum(dO * O): tiny elementwise reduce, XLA fuses it; feeding
     # it in precomputed keeps both kernels single-pass
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, T]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BHq, T]
     interpret = jax.default_backend() != "tpu"
+    kv_idx = _kv_index(Hq, Hkv)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
                           causal=causal, scale=scale),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        grid=(BH, T // block_q),
+        grid=(BHq, T // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, T, D), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, T, D), kv_idx),
+            pl.BlockSpec((1, T, D), kv_idx),
             pl.BlockSpec((1, block_q, D), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
             pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
@@ -213,48 +243,58 @@ def _bwd_impl(q, k, v, do, o, lse, *, causal: bool, block_q: int, block_k: int):
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
+    # group dim as a grid axis (g fastest -> consecutive output revisits);
+    # query head for program (i, j, g) is i*G + g
+    def q_idx(i, j, g):
+        return (i * G + g, 0, 0)
+
+    def q_row_idx(i, j, g):
+        return (i * G + g, 0)
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
                           causal=causal, scale=scale),
         out_shape=(
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct(k.shape, jnp.float32),
+            jax.ShapeDtypeStruct(v.shape, jnp.float32),
         ),
-        grid=(BH, T // block_k),
+        grid=(BHkv, T // block_k, G),
         in_specs=[
-            pl.BlockSpec((1, T, D), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_k, D), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, T, D), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, T), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, T), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, T, D), q_idx),
+            pl.BlockSpec((1, block_k, D), lambda i, j, g: (i, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda i, j, g: (i, j, 0)),
+            pl.BlockSpec((1, T, D), q_idx),
+            pl.BlockSpec((1, T), q_row_idx),
+            pl.BlockSpec((1, T), q_row_idx),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_k, D), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda i, j, g: (i, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda i, j, g: (i, j, 0)),
         ),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 # --- custom_vjp wiring (on the [BH, T, D] layout) ----------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_r(q, k, v, causal, block_q, block_k):
-    out, _ = _fwd_impl(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_r(q, k, v, causal, block_q, block_k, Hq, Hkv):
+    out, _ = _fwd_impl(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+                       Hq=Hq, Hkv=Hkv)
     return out
 
 
-def _flash_r_fwd(q, k, v, causal, block_q, block_k):
-    out, lse = _fwd_impl(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+def _flash_r_fwd(q, k, v, causal, block_q, block_k, Hq, Hkv):
+    out, lse = _fwd_impl(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+                         Hq=Hq, Hkv=Hkv)
     return out, (q, k, v, out, lse)
 
 
-def _flash_r_bwd(causal, block_q, block_k, res, g):
+def _flash_r_bwd(causal, block_q, block_k, Hq, Hkv, res, g):
     q, k, v, o, lse = res
     return _bwd_impl(q, k, v, g, o, lse, causal=causal,
-                     block_q=block_q, block_k=block_k)
+                     block_q=block_q, block_k=block_k, Hq=Hq, Hkv=Hkv)
 
 
 _flash_r.defvjp(_flash_r_fwd, _flash_r_bwd)
@@ -269,17 +309,22 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
 ) -> jnp.ndarray:
-    """[B, T, H, D] x3 -> [B, T, H, D]. Falls back to the einsum path when
-    pallas is unavailable or shapes don't tile (T % block != 0)."""
-    T = q.shape[1]
+    """[B, T, Hq, D], [B, T, Hkv, D] x2 -> [B, T, Hq, D]. GQA-native: Hkv may
+    divide Hq; K/V are consumed at their own head count (no repeat). Falls
+    back to the einsum path when pallas is unavailable or shapes don't tile
+    (T % block != 0)."""
+    B, T, Hq, D = q.shape
+    Hkv = k.shape[2]
+    if Hq % Hkv:
+        raise ValueError(f"q heads {Hq} not a multiple of kv heads {Hkv}")
     bq, bk = min(block_q, T), min(block_k, T)
     if not _HAS_PALLAS or T % bq or T % bk:
-        from ..models.transformer import xla_attention
+        from ..models.transformer import repeat_kv, xla_attention
 
+        k, v = repeat_kv(k, v, Hq)
         return xla_attention(q, k, v, causal=causal)
-    B, _, H, D = q.shape
-    qr = jnp.transpose(q, (0, 2, 1, 3)).reshape(B * H, T, D)
-    kr = jnp.transpose(k, (0, 2, 1, 3)).reshape(B * H, T, D)
-    vr = jnp.transpose(v, (0, 2, 1, 3)).reshape(B * H, T, D)
-    out = _flash_r(qr, kr, vr, causal, bq, bk)
-    return jnp.transpose(out.reshape(B, H, T, D), (0, 2, 1, 3))
+    qr = jnp.transpose(q, (0, 2, 1, 3)).reshape(B * Hq, T, D)
+    kr = jnp.transpose(k, (0, 2, 1, 3)).reshape(B * Hkv, T, D)
+    vr = jnp.transpose(v, (0, 2, 1, 3)).reshape(B * Hkv, T, D)
+    out = _flash_r(qr, kr, vr, causal, bq, bk, Hq, Hkv)
+    return jnp.transpose(out.reshape(B, Hq, T, D), (0, 2, 1, 3))

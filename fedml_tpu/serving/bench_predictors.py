@@ -1,0 +1,56 @@
+"""Predictor factories for the endpoint-level serving benchmark.
+
+Importable by replica child processes
+(``python -m fedml_tpu.serving.replica_main --predictor
+fedml_tpu.serving.bench_predictors:llm_bench_predictor``) so the serving
+bench (BASELINE config 5: gateway -> subprocess replicas -> KV-cache
+decode) measures the REAL deployment topology, not an in-process shortcut.
+Reference role: the model package a reference replica container would load
+(``model_scheduler/device_model_deployment.py``).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def llm_bench_predictor():
+    """Small llama-family model + BPE tokenizer, deterministic init, warmed
+    up before the replica reports ready. Size picked so two replicas fit one
+    chip comfortably and compile stays in the tens of seconds."""
+    import jax
+
+    platform = os.environ.get("FEDML_REPLICA_PLATFORM")
+    if platform:  # tests force cpu; the bench leaves the attached TPU
+        jax.config.update("jax_platforms", platform)
+
+    import jax.numpy as jnp
+
+    from ..models.transformer import TransformerConfig, TransformerLM
+    from ..train.llm.tokenizer import train_bpe
+    from .fedml_predictor import LLMPredictor
+
+    tiny = os.environ.get("FEDML_BENCH_TINY") == "1"
+    tok = train_bpe(
+        ["federated benchmark serving endpoint throughput measure " * 4] * 8,
+        vocab_size=512,
+    )
+    cfg = TransformerConfig(
+        vocab_size=tok.vocab_size,
+        d_model=64 if tiny else 512,
+        n_layers=2 if tiny else 8,
+        n_heads=4 if tiny else 8,
+        n_kv_heads=4 if tiny else 8,
+        d_ff=128 if tiny else 1376,
+        max_seq_len=64 if tiny else 256,
+        dtype=jnp.float32 if tiny else jnp.bfloat16,
+        remat=False,
+        lora_rank=0,
+    )
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    predictor = LLMPredictor(params, cfg, tok,
+                             default_max_new_tokens=16 if tiny else 64)
+    predictor.warmup()
+    return predictor

@@ -94,12 +94,15 @@ class ReplicaSet:
     replace dead)."""
 
     def __init__(self, predictor_spec: str, desired: int = 1, *, model_path: Optional[str] = None,
-                 max_consecutive_failures: int = 3):
+                 max_consecutive_failures: int = 3, startup_timeout_s: float = 60.0):
         self.predictor_spec = predictor_spec
         self.model_path = model_path
         self.desired = 0
         self.replicas: List[SubprocessReplica] = []
         self.max_consecutive_failures = max_consecutive_failures
+        # predictors that compile a model in warmup (LLM) need far more than
+        # the echo-predictor default before the port file appears
+        self.startup_timeout_s = float(startup_timeout_s)
         self._lock = threading.RLock()
         try:
             self.scale_to(desired)
@@ -120,7 +123,8 @@ class ReplicaSet:
             self.replicas = [r for r in self.replicas if self._evict_if_dead(r)]
             while len(self.replicas) < self.desired:
                 self.replicas.append(
-                    SubprocessReplica(self.predictor_spec, model_path=self.model_path)
+                    SubprocessReplica(self.predictor_spec, model_path=self.model_path,
+                                      startup_timeout_s=self.startup_timeout_s)
                 )
                 log.info("replica set: started %s on %s", self.replicas[-1].id, self.replicas[-1].url)
             while len(self.replicas) > self.desired:

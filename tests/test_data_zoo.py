@@ -81,3 +81,48 @@ def test_tabular_local_file_roundtrip(tmp_path):
     x_tr, y_tr, x_te, y_te, c = load_tabular_dataset("lending_club", str(tmp_path))
     assert len(x_tr) == 100 and len(x_te) == 20 and c == 2
     np.testing.assert_array_equal(y_tr, y)
+
+
+class TestDownloadGate:
+    """Guarded downloads (docs/datasets.md): never fetch by default, never
+    hang offline, and a successful fetch feeds format auto-detection."""
+
+    def test_noop_without_flag_or_registry(self, tmp_path, monkeypatch):
+        from fedml_tpu.data import downloads
+
+        # gate closed
+        assert downloads.maybe_download("mnist", str(tmp_path), allow_download=False) is False
+        # unknown dataset, gate open
+        assert downloads.maybe_download("nope", str(tmp_path), allow_download=True) is False
+        # gate open but no egress: fast False, no exception
+        monkeypatch.setattr(downloads, "egress_available", lambda url, timeout_s=3.0: False)
+        assert downloads.maybe_download("mnist", str(tmp_path), allow_download=True) is False
+
+    def test_fetch_extract_flatten_feeds_detection(self, tmp_path, monkeypatch):
+        import io
+        import json as _json
+        import zipfile
+
+        from fedml_tpu.data import downloads
+        from fedml_tpu.data.formats import detect_format_files
+
+        # fake the reference MNIST.zip: a wrapper dir containing LEAF json
+        blob = io.BytesIO()
+        leaf = {"users": ["u0"], "num_samples": [1],
+                "user_data": {"u0": {"x": [[0.0] * 784], "y": [1]}}}
+        with zipfile.ZipFile(blob, "w") as z:
+            z.writestr("MNIST/train/all_data_0.json", _json.dumps(leaf))
+            z.writestr("MNIST/test/all_data_0.json", _json.dumps(leaf))
+
+        def fake_retrieve(url, tmp):
+            with open(tmp, "wb") as f:
+                f.write(blob.getvalue())
+
+        monkeypatch.setattr(downloads, "egress_available", lambda url, timeout_s=3.0: True)
+        monkeypatch.setattr(downloads.urllib.request, "urlretrieve", fake_retrieve)
+
+        assert downloads.maybe_download("mnist", str(tmp_path), allow_download=True) is True
+        # wrapper dir was flattened so the format parser sees it
+        assert detect_format_files("mnist", str(tmp_path)) == "mnist"
+        # idempotent: archive cached, nothing re-fetched
+        assert downloads.maybe_download("mnist", str(tmp_path), allow_download=True) is False

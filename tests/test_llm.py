@@ -77,6 +77,36 @@ class TestAttentionImpls:
         out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
+    def test_flash_gqa_matches_repeated_xla(self):
+        # GQA-native kernel: 8 query heads over 2 kv heads, fwd + grads vs
+        # the einsum path on repeat_kv'd tensors
+        from fedml_tpu.models.transformer import repeat_kv
+        from fedml_tpu.ops.flash_attention import flash_attention
+
+        B, T, Hq, Hkv, D = 2, 64, 8, 2, 16
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = jax.random.normal(ks[0], (B, T, Hq, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, T, Hkv, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, T, Hkv, D), jnp.float32)
+        g = jax.random.normal(jax.random.PRNGKey(5), (B, T, Hq, D), jnp.float32)
+
+        def f_flash(q, k, v):
+            return (flash_attention(q, k, v, causal=True, block_q=16, block_k=16) * g).sum()
+
+        def f_xla(q, k, v):
+            kr, vr = repeat_kv(k, v, Hq)
+            return (xla_attention(q, kr, vr, causal=True) * g).sum()
+
+        out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        kr, vr = repeat_kv(k, v, Hq)
+        ref = xla_attention(q, kr, vr, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+        got = jax.grad(f_flash, (0, 1, 2))(q, k, v)
+        want = jax.grad(f_xla, (0, 1, 2))(q, k, v)
+        for name, a, b in zip("dq dk dv".split(), got, want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, err_msg=name)
+
     def test_flash_grads_match_xla(self):
         # the Pallas backward kernels (dq + dkv) against einsum autodiff,
         # causal and dense, with uneven q/k block sizes to exercise the
