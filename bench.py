@@ -316,6 +316,11 @@ def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: i
 
     from fedml_tpu.serving.replica_controller import InferenceGateway, ReplicaSet
 
+    # the warm-up/measured prompts rely on single-digit fields tokenizing to
+    # the same length (and 'req 9' being reserved for warm-up)
+    if clients > 10 or reqs_per_client > 9:
+        raise ValueError("serving bench supports clients <= 10 and reqs_per_client <= 9")
+
     # matches bench_predictors' default_max_new_tokens (tiny mode is the
     # CPU test harness for this path)
     new_tokens = 16 if os.environ.get("FEDML_BENCH_TINY") == "1" else 64

@@ -85,7 +85,13 @@ def maybe_download(dataset: str, cache_dir: str, allow_download: bool = False) -
         log.info("downloading %s -> %s", url, fname)
         tmp = fname + ".part"
         try:
-            urllib.request.urlretrieve(url, tmp)
+            # per-read socket timeout: a transfer that stalls mid-stream
+            # (this environment's signature failure) raises in 60s instead
+            # of hanging training at dataset load forever
+            with urllib.request.urlopen(url, timeout=60) as resp, open(tmp, "wb") as out:
+                import shutil as _shutil
+
+                _shutil.copyfileobj(resp, out)
             # extract from the .part, THEN rename: the final archive name on
             # disk means "downloaded AND extracted", so a crash mid-extract
             # retries next run instead of wedging on the surrogate forever

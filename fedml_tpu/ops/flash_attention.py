@@ -42,6 +42,20 @@ try:  # pallas import kept soft so CPU-only environments can import the module
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
+
+def _compiler_params(dimension_semantics):
+    """Mosaic grid semantics ('parallel' dims can be pipelined/partitioned
+    freely; 'arbitrary' preserves iteration order — required for the dkv
+    kernel's accumulating revisits). None off-TPU (interpret ignores it)."""
+    if jax.default_backend() != "tpu":
+        return None
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        return pltpu.CompilerParams(dimension_semantics=dimension_semantics)
+    except Exception:  # pragma: no cover - older pallas
+        return None
+
 NEG_INF = -1e30
 
 
@@ -126,6 +140,7 @@ def _fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int, Hq: int, Hkv
             pl.BlockSpec((1, block_q, D), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
         ),
+        compiler_params=_compiler_params(("parallel", "parallel")),
         interpret=jax.default_backend() != "tpu",  # CPU tests run interpreted
     )(q, k, v)
 
@@ -240,6 +255,7 @@ def _bwd_impl(q, k, v, do, o, lse, *, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda i, j: (i, j, 0)),
+        compiler_params=_compiler_params(("parallel", "parallel")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
@@ -271,6 +287,8 @@ def _bwd_impl(q, k, v, do, o, lse, *, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, block_k, D), lambda i, j, g: (i, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda i, j, g: (i, j, 0)),
         ),
+        # g accumulates into revisited output blocks -> must stay ordered
+        compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)

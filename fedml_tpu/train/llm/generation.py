@@ -67,20 +67,41 @@ def _sample(logits, key, temperature):
     return jnp.where(temperature > 0.0, sampled, greedy)
 
 
-def _prefill_fn(cfg: TransformerConfig, B: int, P: int):
+def _rewind_cache(cache, true_len):
+    """Set every layer's KV write index to the TRUE prompt length. Prompts
+    are right-padded to a bucket before prefill; the padded slots' garbage
+    keys/values sit at positions >= true_len, and with the index rewound
+    each of those slots is OVERWRITTEN by a real decoded token before any
+    query position can attend to it — so bucketed prefill is exact."""
+
+    def fix(path, x):
+        if getattr(path[-1], "key", None) == "idx":
+            return jnp.full_like(x, true_len)
+        return x
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def _prefill_fn(cfg: TransformerConfig, B: int, P_bucket: int):
+    """Compiled per PROMPT-LENGTH BUCKET (multiples of 16), not per exact
+    length: serving traffic with varied prompt lengths shares executables
+    (a fresh compile per length was the old behavior's latency cliff).
+    ``true_len`` is a runtime scalar."""
+
     def build():
         model = decode_model(cfg)
 
-        def run(params, prompt):
-            positions = jnp.broadcast_to(jnp.arange(P), (B, P))
+        def run(params, prompt_padded, true_len):
+            positions = jnp.broadcast_to(jnp.arange(P_bucket), (B, P_bucket))
             logits, state = model.apply(
-                {"params": params}, prompt, positions=positions, mutable=["cache"]
+                {"params": params}, prompt_padded, positions=positions, mutable=["cache"]
             )
-            return state["cache"], logits[:, -1]
+            first = logits[jnp.arange(B), true_len - 1]
+            return _rewind_cache(state["cache"], true_len), first
 
         return jax.jit(run)
 
-    return _lru_get(("prefill", cfg, B, P), build)
+    return _lru_get(("prefill", cfg, B, P_bucket), build)
 
 
 def _decode_fn(cfg: TransformerConfig, B: int, max_new: int, sampled: bool,
@@ -155,7 +176,14 @@ def generate(
     # bucket the scan length so distinct max_new values share an executable
     # (the validation above guarantees the min is still >= max_new_tokens)
     bucket = min(-(-max_new_tokens // 16) * 16, cfg.max_seq_len - P)
-    cache, first_logits = _prefill_fn(cfg, B, P)(params, prompt)
+    # bucket the PROMPT length too (right-pad + runtime true length): all
+    # lengths in a 16-bucket share one prefill executable; see _rewind_cache
+    # for why the padding is exact
+    P_b = min(-(-P // 16) * 16, cfg.max_seq_len)
+    prompt_padded = jnp.pad(prompt, ((0, 0), (0, P_b - P))) if P_b != P else prompt
+    cache, first_logits = _prefill_fn(cfg, B, P_b)(
+        params, prompt_padded, jnp.int32(P)
+    )
     out = _decode_fn(cfg, B, bucket, temperature > 0.0, eos_ids)(
         params, cache, first_logits, jnp.full((B,), P, jnp.int32), key,
         jnp.float32(temperature),

@@ -114,12 +114,11 @@ class TestDownloadGate:
             z.writestr("MNIST/train/all_data_0.json", _json.dumps(leaf))
             z.writestr("MNIST/test/all_data_0.json", _json.dumps(leaf))
 
-        def fake_retrieve(url, tmp):
-            with open(tmp, "wb") as f:
-                f.write(blob.getvalue())
+        def fake_urlopen(url, timeout=None):
+            return io.BytesIO(blob.getvalue())  # context-manager + readable
 
         monkeypatch.setattr(downloads, "egress_available", lambda url, timeout_s=3.0: True)
-        monkeypatch.setattr(downloads.urllib.request, "urlretrieve", fake_retrieve)
+        monkeypatch.setattr(downloads.urllib.request, "urlopen", fake_urlopen)
 
         assert downloads.maybe_download("mnist", str(tmp_path), allow_download=True) is True
         # wrapper dir was flattened so the format parser sees it
