@@ -37,6 +37,10 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # full: save nothing per block (lowest memory, ~1/3 extra fwd FLOPs);
+    # dots: save matmul outputs, recompute elementwise only (the classic
+    # MFU/memory middle ground — jax.checkpoint_policies)
+    remat_policy: str = "full"   # full | dots
     attention_impl: str = "auto"  # auto (pallas on TPU, xla elsewhere) | xla | pallas | ring
     lora_rank: int = 0           # 0 = no adapters
     lora_alpha: float = 16.0
@@ -65,6 +69,7 @@ class TransformerConfig:
             lora_rank=int(getattr(args, "lora_rank", 0) or 0),
             lora_alpha=float(getattr(args, "lora_alpha", 16.0)),
             remat=bool(getattr(args, "remat", True)),
+            remat_policy=str(getattr(args, "remat_policy", "full")),
         )
 
     @classmethod
@@ -270,7 +275,14 @@ class TransformerLM(nn.Module):
             positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, static_argnums=())
+            if cfg.remat_policy not in ("full", "dots"):
+                raise ValueError(
+                    f"remat_policy must be 'full' or 'dots', got {cfg.remat_policy!r}"
+                )
+            policy = None
+            if cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            block = nn.remat(Block, static_argnums=(), policy=policy)
         for i in range(cfg.n_layers):
             x = block(cfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(name="final_norm")(x)

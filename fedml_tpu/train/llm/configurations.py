@@ -31,6 +31,7 @@ class ModelArguments:
     lora_rank: int = 8
     lora_alpha: float = 16.0
     remat: bool = True
+    remat_policy: str = "full"         # full | dots (see TransformerConfig)
     moe_experts: int = 0               # 0 = dense MLP; >0 = Switch MoE
     moe_capacity_factor: float = 1.25
 

@@ -7,9 +7,11 @@ collection holding [B, max_seq_len, kv, hd] key/value buffers written at a
 running index), prefill is one batched pass over the prompt, and the
 per-token loop is a single jitted ``lax.scan`` carrying (cache, token,
 position, rng). Compilation is split so serving stays warm: prefill
-compiles per prompt length (one cheap forward), the token-loop executable
-is shared across ALL prompt lengths (start position is a runtime value)
-and bucketed over max_new_tokens; both caches are LRU-bounded.
+compiles once per 16-token PROMPT-LENGTH BUCKET (right-padding + a runtime
+true length — see ``_rewind_cache`` for the exactness argument), the
+token-loop executable is shared across ALL prompt lengths (start position
+is a runtime value) and bucketed over max_new_tokens; both caches are
+LRU-bounded.
 
 Correctness keystone (tests/test_generation.py): stepped KV-cache logits
 equal the full non-cached forward bit-for-bit positions.
@@ -32,7 +34,7 @@ def decode_model(cfg: TransformerConfig) -> TransformerLM:
 
 
 # Two compile units, LRU-bounded:
-#   prefill — keyed by (cfg, B, P): one forward pass, cheap to compile;
+#   prefill — keyed by (cfg, B, 16-token length bucket): one forward pass;
 #   decode scan — keyed by (cfg, B, max_new bucket, greedy?, eos?): the
 #     expensive unit, SHARED across all prompt lengths because the cache
 #     shape is static [B, max_seq_len, ...] and the start position is a

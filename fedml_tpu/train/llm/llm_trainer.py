@@ -84,6 +84,7 @@ class LLMTrainer:
             lora_rank=model_args.lora_rank,
             lora_alpha=model_args.lora_alpha,
             remat=model_args.remat,
+            remat_policy=model_args.remat_policy,
             moe_experts=model_args.moe_experts,
             moe_capacity_factor=model_args.moe_capacity_factor,
             moe_ep_axis="ep" if exp_args.ep > 1 else None,

@@ -131,6 +131,33 @@ class TestAttentionImpls:
                         err_msg=f"{name} causal={causal} bq={bq} bk={bk}",
                     )
 
+    def test_remat_policies_agree(self):
+        # remat is a memory/compute trade, never a numerics change: loss and
+        # grads identical across none / full / dots policies
+        from fedml_tpu.models.transformer import TransformerConfig, TransformerLM
+        from fedml_tpu.parallel.fsdp import causal_lm_loss
+
+        toks = jnp.asarray(np.random.default_rng(0).integers(0, 61, (2, 16)), jnp.int32)
+        results = []
+        for remat, policy in ((False, "full"), (True, "full"), (True, "dots")):
+            cfg = TransformerConfig(
+                vocab_size=61, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+                d_ff=64, max_seq_len=16, dtype=jnp.float32, remat=remat,
+                remat_policy=policy, lora_rank=0,
+            )
+            model = TransformerLM(cfg)
+            params = model.init(jax.random.PRNGKey(0), toks)["params"]
+
+            def loss(p, model=model):
+                return causal_lm_loss(model.apply({"params": p}, toks), toks)
+
+            l, g = jax.value_and_grad(loss)(params)
+            results.append((float(l), g))
+        for l, g in results[1:]:
+            assert l == results[0][0]
+            for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(results[0][1])):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
     def test_ring_matches_xla(self):
         from fedml_tpu.parallel.mesh import create_mesh
         from fedml_tpu.parallel.ring_attention import ring_attention
