@@ -308,13 +308,20 @@ def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: i
     deployment topology (gateway retry/eviction + HTTP + per-replica
     KV-cache decode), unlike the in-process decode bench.
 
-    No cross-request batching: the gateway round-robins whole requests to
-    replicas (reference device_model_inference.py does the same); request
-    concurrency is absorbed by replica parallelism. Distinct prompts per
+    The gateway round-robins whole requests to replicas (reference
+    device_model_inference.py does the same); each replica additionally
+    runs server-side DYNAMIC BATCHING (10ms window, max 4 — the
+    _MicroBatcher the reference lacks), so concurrency is absorbed by both
+    replica parallelism and in-replica batch decode. Distinct prompts per
     request so the platform cannot dedupe executions."""
     import threading
 
     from fedml_tpu.serving.replica_controller import InferenceGateway, ReplicaSet
+
+    saved_env = {k: os.environ.get(k) for k in
+                 ("FEDML_SERVE_MAX_BATCH", "FEDML_SERVE_BATCH_WINDOW_MS")}
+    os.environ["FEDML_SERVE_MAX_BATCH"] = "4"  # inherited by replica children
+    os.environ["FEDML_SERVE_BATCH_WINDOW_MS"] = "10"
 
     # the warm-up/measured prompts rely on single-digit fields tokenizing to
     # the same length (and 'req 9' being reserved for warm-up)
@@ -324,11 +331,12 @@ def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: i
     # matches bench_predictors' default_max_new_tokens (tiny mode is the
     # CPU test harness for this path)
     new_tokens = 16 if os.environ.get("FEDML_BENCH_TINY") == "1" else 64
-    rs = ReplicaSet(
-        "fedml_tpu.serving.bench_predictors:llm_bench_predictor",
-        desired=n_replicas, startup_timeout_s=900.0,
-    )
+    rs = None
     try:
+        rs = ReplicaSet(
+            "fedml_tpu.serving.bench_predictors:llm_bench_predictor",
+            desired=n_replicas, startup_timeout_s=900.0,
+        )
         deadline = time.time() + 900.0
         while time.time() < deadline:
             rs.reconcile()  # replace replicas that died during startup
@@ -374,10 +382,16 @@ def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: i
             "endpoint_decode_tokens_per_sec": total_new / dt,
             "endpoint_replicas": n_replicas,
             "endpoint_requests": len(results),
-            "endpoint_batching": "none (round-robin whole requests; concurrency via replicas)",
+            "endpoint_batching": "dynamic (per-replica micro-batch, window 10ms, max 4)",
         }
     finally:
-        rs.shutdown()
+        if rs is not None:
+            rs.shutdown()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 # --- workload A: ResNet-56 / CIFAR-10 local SGD ------------------------------

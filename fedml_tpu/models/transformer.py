@@ -164,7 +164,8 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, positions: jnp.ndarray,
+                 attn_start: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.cfg
         B, T, _ = x.shape
         hd = cfg.head_dim
@@ -174,7 +175,7 @@ class Attention(nn.Module):
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
         if cfg.decode:
-            return self._decode_attention(q, k, v, B, T)
+            return self._decode_attention(q, k, v, B, T, attn_start)
         impl = cfg.attention_impl
         if impl == "auto":
             # pallas only where it runs compiled: interpret-mode flash on CPU
@@ -198,12 +199,17 @@ class Attention(nn.Module):
         out = out.reshape(B, T, cfg.n_heads * hd)
         return LoRALinear(cfg.d_model, cfg, name="o_proj")(out)
 
-    def _decode_attention(self, q, k, v, B: int, T: int) -> jnp.ndarray:
+    def _decode_attention(self, q, k, v, B: int, T: int,
+                          attn_start: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         """KV-cache attention for autoregressive decode (flax 'cache'
         collection). Supports prefill (T = prompt length) and single-token
         steps (T = 1): new k/v are written at the running cache index and
         queries attend to everything written so far. Static shapes: the
-        cache is [B, max_seq_len, kv, hd] with an index mask."""
+        cache is [B, max_seq_len, kv, hd] with an index mask.
+
+        ``attn_start`` [B] (optional): first VALID cache slot per row —
+        batched serving LEFT-pads shorter prompts so all rows share the
+        write index, and each row masks out its pad prefix."""
         cfg = self.cfg
         hd = cfg.head_dim
         S = cfg.max_seq_len
@@ -218,6 +224,12 @@ class Attention(nn.Module):
         k_all, v_all = repeat_kv(ck.value, cv.value, cfg.n_heads)  # [B, S, h, hd]
         q_pos = idx + jnp.arange(T)  # absolute position of each query
         valid = jnp.arange(S)[None, :] <= q_pos[:, None]  # [T, S] causal+written
+        if attn_start is not None:
+            # [B, 1, T, S]: rows additionally exclude their pad prefix
+            valid = jnp.logical_and(
+                valid[None],
+                jnp.arange(S)[None, None, :] >= attn_start[:, None, None],
+            )[:, None]
         out = xla_attention(q, k_all, v_all, mask=valid)
         out = out.reshape(B, T, cfg.n_heads * hd)
         return LoRALinear(cfg.d_model, cfg, name="o_proj")(out)
@@ -238,9 +250,10 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, positions: jnp.ndarray,
+                 attn_start: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.cfg
-        x = x + Attention(cfg, name="attn")(RMSNorm(name="attn_norm")(x), positions)
+        x = x + Attention(cfg, name="attn")(RMSNorm(name="attn_norm")(x), positions, attn_start)
         h = RMSNorm(name="mlp_norm")(x)
         if cfg.moe_experts > 0:
             from .moe import MoEConfig, MoEMLP
@@ -268,7 +281,8 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray, train: bool = False,
-                 positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 positions: Optional[jnp.ndarray] = None,
+                 attn_start: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model, name="embed")(tokens).astype(cfg.dtype)
         if positions is None:
@@ -284,7 +298,7 @@ class TransformerLM(nn.Module):
                 policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
             block = nn.remat(Block, static_argnums=(), policy=policy)
         for i in range(cfg.n_layers):
-            x = block(cfg, name=f"layer_{i}")(x, positions)
+            x = block(cfg, name=f"layer_{i}")(x, positions, attn_start)
         x = RMSNorm(name="final_norm")(x)
         # tied-untied head: separate projection (llama style)
         logits = LoRALinear(cfg.vocab_size, cfg, name="lm_head")(x)

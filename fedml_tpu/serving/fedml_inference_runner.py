@@ -12,7 +12,10 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
+import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
@@ -21,17 +24,89 @@ from .fedml_predictor import FedMLPredictor
 log = logging.getLogger(__name__)
 
 
+class _MicroBatcher:
+    """Server-side dynamic batching: concurrent /predict requests within a
+    short window coalesce into one ``predictor.predict_many`` call (the
+    LLM predictor decodes them as a single left-padded batch). Beyond the
+    reference, whose gateway forwards requests one at a time
+    (``device_model_inference.py``)."""
+
+    def __init__(self, predictor, max_batch: int, window_s: float):
+        import collections
+
+        self.predictor = predictor
+        self.max_batch = max_batch
+        self.window_s = window_s
+        # observability (tests/metrics); bounded — replicas are long-lived
+        self.batch_sizes = collections.deque(maxlen=1024)
+        self._q: "queue.Queue" = queue.Queue()
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def submit(self, request: dict, timeout_s: float = 600.0) -> dict:
+        ev = threading.Event()
+        slot: dict = {}
+        self._q.put((request, ev, slot))
+        if not ev.wait(timeout=timeout_s):
+            raise TimeoutError("batched predict timed out")
+        if "exc" in slot:
+            raise slot["exc"]
+        return slot["resp"]
+
+    def _loop(self) -> None:
+        while True:
+            batch = [self._q.get()]  # block for the first request
+            deadline = time.time() + self.window_s
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            self.batch_sizes.append(len(batch))
+            try:
+                resps = self.predictor.predict_many([b[0] for b in batch])
+                if len(resps) != len(batch):
+                    raise RuntimeError(
+                        f"predict_many returned {len(resps)} responses for {len(batch)} requests"
+                    )
+            except Exception:  # noqa: BLE001 - one bad request must not
+                # 500 its co-batched neighbors: fall back to per-request
+                for req, ev, slot in batch:
+                    try:
+                        slot["resp"] = self.predictor.predict(req)
+                    except Exception as e:  # noqa: BLE001
+                        slot["exc"] = e
+                    ev.set()
+                continue
+            for (_, ev, slot), resp in zip(batch, resps):
+                slot["resp"] = resp
+                ev.set()
+
+
 class FedMLInferenceRunner:
-    def __init__(self, client_predictor: FedMLPredictor, port: int = 2345, host: str = "127.0.0.1"):
+    def __init__(self, client_predictor: FedMLPredictor, port: int = 2345, host: str = "127.0.0.1",
+                 max_batch: Optional[int] = None, batch_window_ms: Optional[float] = None):
         self.client_predictor = client_predictor
         self.port = port
         self.host = host
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # dynamic batching: explicit args win; env seam lets subprocess
+        # replicas opt in (FEDML_SERVE_MAX_BATCH / FEDML_SERVE_BATCH_WINDOW_MS)
+        if max_batch is None:
+            max_batch = int(os.environ.get("FEDML_SERVE_MAX_BATCH", "1"))
+        if batch_window_ms is None:
+            batch_window_ms = float(os.environ.get("FEDML_SERVE_BATCH_WINDOW_MS", "10"))
+        self.batcher: Optional[_MicroBatcher] = None
+        if max_batch > 1 and hasattr(client_predictor, "predict_many"):
+            self.batcher = _MicroBatcher(client_predictor, max_batch, batch_window_ms / 1000.0)
 
     # -- stdlib path -------------------------------------------------------
     def _make_handler(self):
         predictor = self.client_predictor
+        batcher = self.batcher
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # route to logging, not stderr
@@ -61,6 +136,9 @@ class FedMLInferenceRunner:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     input_json = json.loads(self.rfile.read(length) or b"{}")
+                    if batcher is not None:
+                        self._send_json(batcher.submit(input_json))
+                        return
                     try:
                         resp = predictor.predict(input_json)
                     except NotImplementedError:

@@ -135,3 +135,37 @@ class LLMPredictor(FedMLPredictor):
             eos_id=self._eos_id,
         )
         return {"text": text}
+
+    def predict_many(self, requests: list) -> list:
+        """Dynamic-batching entry (FedMLInferenceRunner micro-batcher):
+        requests with identical generation settings decode as ONE batched
+        call (variable prompt lengths welcome — generation.generate_batch
+        left-pads); mixed settings fall into per-setting groups. Greedy
+        numerics equal per-request predict exactly."""
+        import jax
+
+        from ..train.llm.generation import generate_batch
+
+        out: list = [None] * len(requests)
+        groups: dict = {}
+        for i, r in enumerate(requests):
+            temp = float(r.get("temperature", 0.0))
+            if temp > 0.0:
+                # sampled requests are NOT co-batched: rows of one batch
+                # share a PRNG stream, so a fixed seed's output would depend
+                # on batch composition — reproducibility wins over batching
+                out[i] = self.predict(r)
+                continue
+            # greedy output is seed-independent: don't let client seeds
+            # split what could be one batch
+            k = int(r.get("max_new_tokens", self._max_new))
+            groups.setdefault(k, []).append(i)
+        for max_new, idxs in groups.items():
+            prompts = [self._tok.encode(str(requests[i]["prompt"])) for i in idxs]
+            toks = generate_batch(
+                self._params, self._cfg, prompts, max_new,
+                temperature=0.0, key=jax.random.PRNGKey(0), eos_id=self._eos_id,
+            )
+            for i, t in zip(idxs, toks):
+                out[i] = {"text": self._tok.decode([int(x) for x in t])}
+        return out
