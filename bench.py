@@ -318,15 +318,17 @@ def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: i
 
     from fedml_tpu.serving.replica_controller import InferenceGateway, ReplicaSet
 
-    saved_env = {k: os.environ.get(k) for k in
-                 ("FEDML_SERVE_MAX_BATCH", "FEDML_SERVE_BATCH_WINDOW_MS")}
-    os.environ["FEDML_SERVE_MAX_BATCH"] = "4"  # inherited by replica children
-    os.environ["FEDML_SERVE_BATCH_WINDOW_MS"] = "10"
-
     # the warm-up/measured prompts rely on single-digit fields tokenizing to
     # the same length (and 'req 9' being reserved for warm-up)
     if clients > 10 or reqs_per_client > 9:
         raise ValueError("serving bench supports clients <= 10 and reqs_per_client <= 9")
+
+    # env mutation only after all validation: a raise must not leak batching
+    # settings into the process
+    saved_env = {k: os.environ.get(k) for k in
+                 ("FEDML_SERVE_MAX_BATCH", "FEDML_SERVE_BATCH_WINDOW_MS")}
+    os.environ["FEDML_SERVE_MAX_BATCH"] = "4"  # inherited by replica children
+    os.environ["FEDML_SERVE_BATCH_WINDOW_MS"] = "10"
 
     # matches bench_predictors' default_max_new_tokens (tiny mode is the
     # CPU test harness for this path)

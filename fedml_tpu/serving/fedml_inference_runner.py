@@ -40,7 +40,11 @@ class _MicroBatcher:
         # observability (tests/metrics); bounded — replicas are long-lived
         self.batch_sizes = collections.deque(maxlen=1024)
         self._q: "queue.Queue" = queue.Queue()
+        self._stop = object()  # sentinel: shutdown() unblocks + ends the loop
         threading.Thread(target=self._loop, daemon=True).start()
+
+    def shutdown(self) -> None:
+        self._q.put(self._stop)
 
     def submit(self, request: dict, timeout_s: float = 600.0) -> dict:
         ev = threading.Event()
@@ -54,16 +58,23 @@ class _MicroBatcher:
 
     def _loop(self) -> None:
         while True:
-            batch = [self._q.get()]  # block for the first request
+            first = self._q.get()  # block for the first request
+            if first is self._stop:
+                return
+            batch = [first]
             deadline = time.time() + self.window_s
             while len(batch) < self.max_batch:
                 remaining = deadline - time.time()
                 if remaining <= 0:
                     break
                 try:
-                    batch.append(self._q.get(timeout=remaining))
+                    item = self._q.get(timeout=remaining)
                 except queue.Empty:
                     break
+                if item is self._stop:
+                    self._q.put(item)  # serve this batch, then exit next loop
+                    break
+                batch.append(item)
             self.batch_sizes.append(len(batch))
             try:
                 resps = self.predictor.predict_many([b[0] for b in batch])
@@ -81,7 +92,10 @@ class _MicroBatcher:
                     ev.set()
                 continue
             for (_, ev, slot), resp in zip(batch, resps):
-                slot["resp"] = resp
+                if isinstance(resp, dict) and "__error__" in resp:
+                    slot["exc"] = RuntimeError(resp["__error__"])
+                else:
+                    slot["resp"] = resp
                 ev.set()
 
 
@@ -163,6 +177,10 @@ class FedMLInferenceRunner:
         return self.port
 
     def stop(self) -> None:
+        if self.batcher is not None:
+            # end the batcher thread: it holds the predictor (and its model
+            # params) and would otherwise outlive this runner forever
+            self.batcher.shutdown()
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
@@ -170,13 +188,17 @@ class FedMLInferenceRunner:
 
     def run(self) -> None:
         """Blocking serve (reference run() semantics)."""
-        try:
-            from .fastapi_app import run_fastapi  # noqa: F401
+        if self.batcher is None:
+            # the FastAPI path serves the raw predictor; silently dropping a
+            # REQUESTED micro-batcher would change behavior by installed
+            # packages, so batched runners always use the stdlib server
+            try:
+                from .fastapi_app import run_fastapi  # noqa: F401
 
-            run_fastapi(self.client_predictor, self.host, self.port)
-            return
-        except ImportError:
-            pass
+                run_fastapi(self.client_predictor, self.host, self.port)
+                return
+            except ImportError:
+                pass
         self.start()
         assert self._thread is not None
         self._thread.join()

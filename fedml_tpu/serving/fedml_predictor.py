@@ -161,11 +161,20 @@ class LLMPredictor(FedMLPredictor):
             k = int(r.get("max_new_tokens", self._max_new))
             groups.setdefault(k, []).append(i)
         for max_new, idxs in groups.items():
-            prompts = [self._tok.encode(str(requests[i]["prompt"])) for i in idxs]
-            toks = generate_batch(
-                self._params, self._cfg, prompts, max_new,
-                temperature=0.0, key=jax.random.PRNGKey(0), eos_id=self._eos_id,
-            )
-            for i, t in zip(idxs, toks):
-                out[i] = {"text": self._tok.decode([int(x) for x in t])}
+            try:
+                prompts = [self._tok.encode(str(requests[i]["prompt"])) for i in idxs]
+                toks = generate_batch(
+                    self._params, self._cfg, prompts, max_new,
+                    temperature=0.0, key=jax.random.PRNGKey(0), eos_id=self._eos_id,
+                )
+                for i, t in zip(idxs, toks):
+                    out[i] = {"text": self._tok.decode([int(x) for x in t])}
+            except Exception:  # noqa: BLE001 - one bad group must not void
+                # the other groups' finished decodes: retry ITS members only,
+                # flagging individual failures for the micro-batcher to 500
+                for i in idxs:
+                    try:
+                        out[i] = self.predict(requests[i])
+                    except Exception as e:  # noqa: BLE001
+                        out[i] = {"__error__": repr(e)}
         return out

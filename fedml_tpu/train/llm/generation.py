@@ -290,6 +290,8 @@ def generate_batch(
     lens = [len(p) for p in prompts]
     if min(lens) < 1:
         raise ValueError("every prompt must contain at least one token")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     P_max = max(lens)
     if P_max + max_new_tokens > cfg.max_seq_len:
         raise ValueError(

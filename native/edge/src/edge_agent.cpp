@@ -1,0 +1,295 @@
+// Native always-on edge FL client over the cross-process message plane.
+//
+// Reference: the Android client (android/fedmlsdk) is a real NETWORK
+// participant — it subscribes MQTT topics, downloads the model file, trains
+// with the native engine and uploads the result. This binary is that
+// participant for this framework: it speaks the socket-broker protocol
+// (core/distributed/communication/mqtt_s3/socket_broker.py — JSON lines,
+// base64 payloads), consumes the shared blob format (dense_model.h), and
+// runs the cross-device WAN round (cross_device/wan.py topic scheme:
+//   server->edge  fedml_<run>_<server>_<edge>   {type:init|sync|finish,
+//                                                round, model_url}
+//   edge->server  fedml_<run>_<edge>            {type:model_upload, ...}
+// ), so a federation can mix python edges and this native edge freely
+// (tests/test_native_edge_agent.py proves exactly that).
+//
+// Usage:
+//   edge_agent <broker_host> <broker_port> <run_id> <edge_id> <server_id>
+//              <store_dir> [data=synthetic|/path/to/data.bin] [train_size=256]
+//              [batch=32] [lr=0.1] [epochs=1] [sample_num=256]
+//
+// "data": the literal string "synthetic" trains on the deterministic
+// surrogate; any other value is a dataset blob path (codec.py
+// dataset_to_bytes format) loaded by the native trainer.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "fedml_edge/client_manager.h"
+#include "fedml_edge/dense_model.h"
+
+namespace {
+
+// --- minimal base64 (the broker frames payloads with it) --------------------
+
+const char kB64[] = "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+std::string b64_encode(const std::string &in) {
+  std::string out;
+  out.reserve((in.size() + 2) / 3 * 4);
+  size_t i = 0;
+  while (i + 2 < in.size()) {
+    uint32_t v = (uint8_t(in[i]) << 16) | (uint8_t(in[i + 1]) << 8) | uint8_t(in[i + 2]);
+    out += kB64[(v >> 18) & 63];
+    out += kB64[(v >> 12) & 63];
+    out += kB64[(v >> 6) & 63];
+    out += kB64[v & 63];
+    i += 3;
+  }
+  if (i + 1 == in.size()) {
+    uint32_t v = uint8_t(in[i]) << 16;
+    out += kB64[(v >> 18) & 63];
+    out += kB64[(v >> 12) & 63];
+    out += "==";
+  } else if (i + 2 == in.size()) {
+    uint32_t v = (uint8_t(in[i]) << 16) | (uint8_t(in[i + 1]) << 8);
+    out += kB64[(v >> 18) & 63];
+    out += kB64[(v >> 12) & 63];
+    out += kB64[(v >> 6) & 63];
+    out += '=';
+  }
+  return out;
+}
+
+int b64_val(char c) {
+  if (c >= 'A' && c <= 'Z') return c - 'A';
+  if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+  if (c >= '0' && c <= '9') return c - '0' + 52;
+  if (c == '+') return 62;
+  if (c == '/') return 63;
+  return -1;
+}
+
+std::string b64_decode(const std::string &in) {
+  std::string out;
+  uint32_t buf = 0;
+  int bits = 0;
+  for (char c : in) {
+    int v = b64_val(c);
+    if (v < 0) continue;  // '=', whitespace
+    buf = (buf << 6) | uint32_t(v);
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out += char((buf >> bits) & 0xFF);
+    }
+  }
+  return out;
+}
+
+// --- minimal JSON field extraction (controlled, framework-generated docs) ---
+
+bool json_find_key(const std::string &doc, const std::string &key, size_t *pos) {
+  std::string needle = "\"" + key + "\"";
+  size_t p = doc.find(needle);
+  if (p == std::string::npos) return false;
+  p = doc.find(':', p + needle.size());
+  if (p == std::string::npos) return false;
+  ++p;
+  while (p < doc.size() && (doc[p] == ' ' || doc[p] == '\t')) ++p;
+  *pos = p;
+  return true;
+}
+
+bool json_string(const std::string &doc, const std::string &key, std::string *out) {
+  size_t p;
+  if (!json_find_key(doc, key, &p) || p >= doc.size() || doc[p] != '"') return false;
+  size_t e = p + 1;
+  std::string s;
+  while (e < doc.size() && doc[e] != '"') {
+    if (doc[e] == '\\' && e + 1 < doc.size()) ++e;  // framework urls: rare
+    s += doc[e++];
+  }
+  *out = s;
+  return true;
+}
+
+bool json_int(const std::string &doc, const std::string &key, long *out) {
+  size_t p;
+  if (!json_find_key(doc, key, &p)) return false;
+  *out = std::strtol(doc.c_str() + p, nullptr, 10);
+  return true;
+}
+
+std::string json_escape(const std::string &s) {
+  std::string o;
+  for (char c : s) {
+    if (c == '"' || c == '\\') o += '\\';
+    o += c;
+  }
+  return o;
+}
+
+// --- broker client ----------------------------------------------------------
+
+class BrokerClient {
+ public:
+  bool connect_to(const std::string &host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) return false;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(uint16_t(port));
+    hostent *he = gethostbyname(host.c_str());
+    if (he == nullptr) return false;
+    std::memcpy(&addr.sin_addr, he->h_addr, size_t(he->h_length));
+    return ::connect(fd_, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) == 0;
+  }
+
+  bool send_line(const std::string &line) {
+    std::string framed = line + "\n";
+    const char *p = framed.data();
+    size_t left = framed.size();
+    while (left > 0) {
+      ssize_t n = ::send(fd_, p, left, 0);
+      if (n <= 0) return false;
+      p += n;
+      left -= size_t(n);
+    }
+    return true;
+  }
+
+  // Blocking read of the next newline-terminated line.
+  bool read_line(std::string *line) {
+    while (true) {
+      size_t nl = buf_.find('\n');
+      if (nl != std::string::npos) {
+        *line = buf_.substr(0, nl);
+        buf_.erase(0, nl + 1);
+        return true;
+      }
+      char chunk[4096];
+      ssize_t n = ::recv(fd_, chunk, sizeof(chunk), 0);
+      if (n <= 0) return false;
+      buf_.append(chunk, size_t(n));
+    }
+  }
+
+  bool subscribe(const std::string &topic) {
+    return send_line("{\"op\": \"sub\", \"topic\": \"" + json_escape(topic) + "\"}");
+  }
+
+  bool publish(const std::string &topic, const std::string &payload) {
+    return send_line("{\"op\": \"pub\", \"topic\": \"" + json_escape(topic) +
+                     "\", \"payload\": \"" + b64_encode(payload) + "\"}");
+  }
+
+  ~BrokerClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+ private:
+  int fd_ = -1;
+  std::string buf_;
+};
+
+std::string strip_file_url(const std::string &url) {
+  const std::string scheme = "file://";
+  return url.rfind(scheme, 0) == 0 ? url.substr(scheme.size()) : url;
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc < 7) {
+    std::fprintf(stderr,
+                 "usage: edge_agent <host> <port> <run_id> <edge_id> <server_id>"
+                 " <store_dir> [dataset] [train_size] [batch] [lr] [epochs] [sample_num]\n");
+    return 2;
+  }
+  const std::string host = argv[1];
+  const int port = std::atoi(argv[2]);
+  const std::string run_id = argv[3];
+  const int edge_id = std::atoi(argv[4]);
+  const int server_id = std::atoi(argv[5]);
+  const std::string store_dir = argv[6];
+  const std::string data_spec = argc > 7 ? argv[7] : "synthetic";
+  // non-"synthetic" means a dataset blob path — wire it where the trainer
+  // actually looks (data_cache_path); a bare dataset NAME would silently
+  // fall back to synthetic
+  const std::string data_path = data_spec == "synthetic" ? "" : data_spec;
+  const int train_size = argc > 8 ? std::atoi(argv[8]) : 256;
+  const int batch = argc > 9 ? std::atoi(argv[9]) : 32;
+  const double lr = argc > 10 ? std::atof(argv[10]) : 0.1;
+  const int epochs = argc > 11 ? std::atoi(argv[11]) : 1;
+  const int sample_num = argc > 12 ? std::atoi(argv[12]) : train_size;
+
+  fedml_edge::FedMLClientManager manager;
+  manager.init("", data_path.c_str(), "synthetic", train_size, /*test_size=*/64,
+               batch, lr, epochs);
+
+  BrokerClient broker;
+  if (!broker.connect_to(host, port)) {
+    std::fprintf(stderr, "edge_agent: cannot reach broker %s:%d\n", host.c_str(), port);
+    return 1;
+  }
+  const std::string s2c = "fedml_" + run_id + "_" + std::to_string(server_id) +
+                          "_" + std::to_string(edge_id);
+  const std::string c2s = "fedml_" + run_id + "_" + std::to_string(edge_id);
+  if (!broker.subscribe(s2c)) return 1;
+  std::printf("edge_agent %d online (run %s, broker %s:%d)\n", edge_id,
+              run_id.c_str(), host.c_str(), port);
+  std::fflush(stdout);
+
+  std::string line;
+  while (broker.read_line(&line)) {
+    std::string op;
+    if (!json_string(line, "op", &op) || op != "msg") continue;
+    std::string payload_b64;
+    if (!json_string(line, "payload", &payload_b64)) continue;
+    const std::string doc = b64_decode(payload_b64);
+
+    std::string type;
+    if (!json_string(doc, "type", &type)) continue;
+    if (type == "finish") {
+      std::printf("edge_agent %d: finish\n", edge_id);
+      return 0;
+    }
+    if (type != "init" && type != "sync") continue;
+    long round = 0;
+    std::string url;
+    if (!json_int(doc, "round", &round) || !json_string(doc, "model_url", &url)) continue;
+
+    auto &model = manager.trainer()->model();
+    if (!model.load(strip_file_url(url))) {
+      std::fprintf(stderr, "edge_agent %d: bad model blob %s\n", edge_id, url.c_str());
+      continue;
+    }
+    manager.train();
+
+    const std::string out_path = store_dir + "/edge_" + std::to_string(edge_id) +
+                                 "_round_" + std::to_string(round) + "_native.bin";
+    if (!model.save(out_path)) {
+      std::fprintf(stderr, "edge_agent %d: cannot write %s\n", edge_id, out_path.c_str());
+      continue;
+    }
+    const std::string upload =
+        "{\"type\": \"model_upload\", \"edge_id\": " + std::to_string(edge_id) +
+        ", \"round\": " + std::to_string(round) +
+        ", \"model_url\": \"file://" + json_escape(out_path) +
+        "\", \"sample_num\": " + std::to_string(sample_num) + "}";
+    if (!broker.publish(c2s, upload)) return 1;
+    std::printf("edge_agent %d: round %ld trained + uploaded\n", edge_id, round);
+    std::fflush(stdout);
+  }
+  return 0;
+}
