@@ -41,12 +41,16 @@ class _MicroBatcher:
         self.batch_sizes = collections.deque(maxlen=1024)
         self._q: "queue.Queue" = queue.Queue()
         self._stop = object()  # sentinel: shutdown() unblocks + ends the loop
+        self._stopped = False
         threading.Thread(target=self._loop, daemon=True).start()
 
     def shutdown(self) -> None:
+        self._stopped = True
         self._q.put(self._stop)
 
     def submit(self, request: dict, timeout_s: float = 600.0) -> dict:
+        if self._stopped:
+            raise RuntimeError("inference runner is shutting down")
         ev = threading.Event()
         slot: dict = {}
         self._q.put((request, ev, slot))
@@ -56,10 +60,25 @@ class _MicroBatcher:
             raise slot["exc"]
         return slot["resp"]
 
+    def _drain_on_stop(self) -> None:
+        """Fail any request that raced the shutdown sentinel — hanging its
+        client for the submit timeout would be the alternative."""
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if item is self._stop:
+                continue
+            _, ev, slot = item
+            slot["exc"] = RuntimeError("inference runner is shutting down")
+            ev.set()
+
     def _loop(self) -> None:
         while True:
             first = self._q.get()  # block for the first request
             if first is self._stop:
+                self._drain_on_stop()
                 return
             batch = [first]
             deadline = time.time() + self.window_s

@@ -160,7 +160,9 @@ class BrokerClient {
     const char *p = framed.data();
     size_t left = framed.size();
     while (left > 0) {
-      ssize_t n = ::send(fd_, p, left, 0);
+      // MSG_NOSIGNAL: a dead broker must surface as send()==-1 (our error
+      // path), not SIGPIPE process death
+      ssize_t n = ::send(fd_, p, left, MSG_NOSIGNAL);
       if (n <= 0) return false;
       p += n;
       left -= size_t(n);
@@ -291,5 +293,9 @@ int main(int argc, char **argv) {
     std::printf("edge_agent %d: round %ld trained + uploaded\n", edge_id, round);
     std::fflush(stdout);
   }
-  return 0;
+  // read loop ended WITHOUT a finish message: the broker connection dropped.
+  // Exit nonzero so a supervisor restarts this participant rather than
+  // mistaking it for a clean shutdown.
+  std::fprintf(stderr, "edge_agent %d: broker connection lost\n", edge_id);
+  return 3;
 }

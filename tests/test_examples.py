@@ -125,3 +125,12 @@ def test_deploy_example_runs():
     r = _run(s, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "undeployed" in r.stdout
+
+
+@pytest.mark.slow
+def test_native_edge_federation_example_runs():
+    s = os.path.join(EXAMPLES, "cross_device", "native_edge", "main.py")
+    r = _run(s, "2", "2")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "native edge federation example done" in r.stdout
+    assert "rc=[0, 0]" in r.stdout
