@@ -151,8 +151,9 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
         return optax.apply_updates(params, updates), opt_state, loss
 
     rng = np.random.default_rng(0)
-    # one distinct batch per rep: no two dispatches see the same inputs
-    batches = [jnp.asarray(rng.integers(0, vocab, (bs, seq)).astype(np.int32)) for _ in range(reps + 2)]
+    # one distinct batch per rep (+1 for the profile step): no two
+    # dispatches see the same inputs
+    batches = [jnp.asarray(rng.integers(0, vocab, (bs, seq)).astype(np.int32)) for _ in range(reps + 3)]
 
     xla_flops = _cost_analysis_flops(step.lower(params, opt_state, batches[0]).compile())
     float(step(params, opt_state, batches[0])[2])  # warmup (excluded)
@@ -160,6 +161,17 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
     def step_once(state, r):
         p, o = (params, opt_state) if state is None else (state[0], state[1])
         return step(p, o, batches[r])
+
+    if os.environ.get("FEDML_BENCH_PROFILE") == "1":
+        # capture an xplane trace for kernel-level analysis (tensorboard-
+        # loadable); excluded from the timed chain. DISTINCT batch: the
+        # warmup's exact dispatch would be deduped by the remote platform
+        # (see module docstring) and trace no device execution
+        trace_dir = os.path.join(_REPO, "bench_traces")
+        with jax.profiler.trace(trace_dir):
+            s = step(params, opt_state, batches[reps + 2])
+            float(s[2])
+        print(f"profile trace written to {trace_dir}", file=sys.stderr)
 
     dt_step = _timed_chain(step_once, 2, reps + 2)
 

@@ -1,0 +1,251 @@
+"""LightSecAgg over the cross-device WAN plane.
+
+Reference: ``core/mpc/lightsecagg/`` drives secure aggregation for
+cross-SILO runs (our ``cross_silo/lightsecagg/`` managers); the reference's
+cross-DEVICE (Beehive) path uploads plaintext model files. This module goes
+beyond: the WAN round itself runs masked — the server NEVER sees an
+individual update, only sum(quantized models) recovered LightSecAgg-style.
+
+Protocol per round (topics from wan.py; server relays shares, as in the
+reference's silo flow where comm goes through the server):
+
+    server -> edge   {type: sync, round, model_url,
+                      lsa: {N, U, T, prime, q_bits}}
+    edge   -> server {type: lsa_shares, round, edge_id, shares_url}
+                      # blob: [N, chunk] int64 — row j is FOR edge j
+    server -> edge   {type: lsa_shares_dist, round, shares_url}
+                      # blob: [N, chunk] int64 — row i is FROM edge i
+    edge   -> server {type: lsa_masked_model, round, edge_id, model_url}
+                      # blob: [d] int64 = quantize(flat) + mask mod p
+    server -> edge   {type: lsa_active, round, active: [...]}
+    edge   -> server {type: lsa_agg_share, round, edge_id, share_url}
+    server: masked_sum - decode(agg shares) -> dequantize -> mean -> next round
+
+Edges plug in ANY engine with the set_model_flat/train/get_model_flat
+contract — including the native C++ engine, whose LightSecAgg math is the
+C++ implementation (light_secagg.cpp) proven share-compatible with the
+python decoder (tests/test_cross_device.py)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.distributed.communication.mqtt_s3.mqtt_transport import create_mqtt_transport
+from ..core.distributed.communication.mqtt_s3.object_store import LocalObjectStore
+from ..core.mpc.finite_field import DEFAULT_PRIME, dequantize, quantize
+from ..core.mpc.lightsecagg import (
+    LightSecAggConfig,
+    aggregate_encoded_mask,
+    encode_mask,
+    mask_vector,
+    unmask_aggregate,
+)
+from .codec import blob_to_params, flat_to_params, params_to_blob, params_to_flat
+from .wan import MSG_FINISH, _c2s_topic, _s2c_topic
+
+log = logging.getLogger(__name__)
+
+
+def _i64_blob(a: np.ndarray) -> bytes:
+    return np.ascontiguousarray(a, dtype="<i8").tobytes()
+
+
+def _i64_from(blob: bytes, shape=None) -> np.ndarray:
+    a = np.frombuffer(blob, dtype="<i8").astype(np.int64)
+    return a.reshape(shape) if shape is not None else a
+
+
+class SecureEdgeDeviceAgent:
+    """Edge side: trains, masks, and only ever uploads masked vectors."""
+
+    def __init__(self, edge_id: int, engine, args: Any = None, *,
+                 server_id: int = 0, store: Optional[LocalObjectStore] = None,
+                 seed: Optional[int] = None):
+        self.edge_id = int(edge_id)
+        self.engine = engine
+        self.server_id = server_id
+        self.run_id = str(getattr(args, "run_id", "0") if args is not None else "0")
+        self.store = store or LocalObjectStore()
+        self.rng = np.random.default_rng(seed if seed is not None else 1000 + self.edge_id)
+        self.transport = create_mqtt_transport(args, client_id=f"sec_edge_{edge_id}")
+        self.finished = threading.Event()
+        self.rounds_trained = 0
+        self._state = None  # ClientMaskState for the in-flight round
+        self._cfg: Optional[LightSecAggConfig] = None
+        self._q_bits = 16
+        self.transport.subscribe(_s2c_topic(self.run_id, server_id, self.edge_id), self._on_message)
+
+    def _publish(self, doc: dict) -> None:
+        self.transport.publish(_c2s_topic(self.run_id, self.edge_id), json.dumps(doc).encode())
+
+    def _on_message(self, _topic: str, payload: bytes) -> None:
+        doc = json.loads(payload)
+        mtype = doc.get("type")
+        if mtype == MSG_FINISH:
+            self.finished.set()
+            return
+        if mtype == "sync":
+            self._on_sync(doc)
+        elif mtype == "lsa_shares_dist":
+            self._on_shares_dist(doc)
+        elif mtype == "lsa_active":
+            self._on_active(doc)
+
+    def _on_sync(self, doc: dict) -> None:
+        lsa = doc["lsa"]
+        self._cfg = LightSecAggConfig(
+            num_clients=int(lsa["N"]), target_active=int(lsa["U"]),
+            privacy_guarantee=int(lsa["T"]), prime=int(lsa.get("prime", DEFAULT_PRIME)),
+        )
+        self._q_bits = int(lsa.get("q_bits", 16))
+        rnd = int(doc["round"])
+
+        # install the global model, train locally
+        template = blob_to_params(self.store.read_blob(doc["model_url"]))
+        self.engine.set_model_flat(params_to_flat(template))
+        self.engine.train()
+        flat = self.engine.get_model_flat()
+
+        # offline phase: mask shares out to the cohort (server relays)
+        self._state = encode_mask(self._cfg, flat.size, self.rng)
+        shares_url = self.store.write_blob(
+            f"lsa_shares_{self.edge_id}_r{rnd}", _i64_blob(self._state.encoded_shares)
+        )
+        self._publish({"type": "lsa_shares", "round": rnd, "edge_id": self.edge_id,
+                       "shares_url": shares_url})
+
+        # online phase: the ONLY model material that leaves this device is
+        # quantize(x) + z mod p
+        y = mask_vector(self._cfg, quantize(flat, self._q_bits, self._cfg.prime), self._state)
+        y_url = self.store.write_blob(f"lsa_masked_{self.edge_id}_r{rnd}", _i64_blob(y))
+        self.rounds_trained += 1
+        self._publish({"type": "lsa_masked_model", "round": rnd, "edge_id": self.edge_id,
+                       "model_url": y_url})
+
+    def _on_shares_dist(self, doc: dict) -> None:
+        assert self._cfg is not None and self._state is not None
+        incoming = _i64_from(self.store.read_blob(doc["shares_url"]),
+                             (self._cfg.num_clients, -1))
+        self._state.received = {i: incoming[i] for i in range(self._cfg.num_clients)}
+
+    def _on_active(self, doc: dict) -> None:
+        assert self._cfg is not None and self._state is not None
+        rnd = int(doc["round"])
+        agg = aggregate_encoded_mask(self._cfg, self._state, [int(a) for a in doc["active"]])
+        url = self.store.write_blob(f"lsa_aggshare_{self.edge_id}_r{rnd}", _i64_blob(agg))
+        self._publish({"type": "lsa_agg_share", "round": rnd, "edge_id": self.edge_id,
+                       "share_url": url})
+
+    def stop(self) -> None:
+        self.transport.disconnect()
+
+
+class SecureServerEdgeWAN:
+    """Server side: orchestrates the phases; reconstructs ONLY the sum."""
+
+    def __init__(self, template_params: List[Dict[str, np.ndarray]], edge_ids: List[int],
+                 args: Any = None, *, server_id: int = 0,
+                 store: Optional[LocalObjectStore] = None,
+                 privacy_guarantee: int = 1, q_bits: int = 16,
+                 test_fn: Optional[Callable] = None):
+        self.template = template_params
+        self.edge_ids = [int(e) for e in edge_ids]
+        self.server_id = server_id
+        self.run_id = str(getattr(args, "run_id", "0") if args is not None else "0")
+        self.store = store or LocalObjectStore()
+        self.transport = create_mqtt_transport(args, client_id=f"sec_server_{server_id}")
+        n = len(self.edge_ids)
+        self.cfg = LightSecAggConfig(num_clients=n, target_active=n,
+                                     privacy_guarantee=privacy_guarantee)
+        self.q_bits = q_bits
+        self.test_fn = test_fn
+        self._inbox: Dict[str, Dict[int, dict]] = {}
+        self._cv = threading.Condition()
+        for eid in self.edge_ids:
+            self.transport.subscribe(_c2s_topic(self.run_id, eid), self._on_message)
+
+    def _on_message(self, _topic: str, payload: bytes) -> None:
+        doc = json.loads(payload)
+        key = f"{doc.get('type')}:{doc.get('round')}"
+        with self._cv:
+            self._inbox.setdefault(key, {})[int(doc.get("edge_id", -1))] = doc
+            self._cv.notify_all()
+
+    def _gather(self, mtype: str, rnd: int, n: int, timeout_s: float) -> Dict[int, dict]:
+        import time as _time
+
+        key = f"{mtype}:{rnd}"
+        deadline = _time.time() + timeout_s
+        with self._cv:
+            while len(self._inbox.get(key, {})) < n:
+                remaining = deadline - _time.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"{mtype} round {rnd}: {len(self._inbox.get(key, {}))}/{n} within {timeout_s}s"
+                    )
+                self._cv.wait(timeout=min(remaining, 1.0))
+            return dict(self._inbox[key])
+
+    def _broadcast(self, doc: dict, per_edge: Optional[Dict[int, dict]] = None) -> None:
+        for eid in self.edge_ids:
+            payload = dict(doc, **(per_edge or {}).get(eid, {}))
+            self.transport.publish(
+                _s2c_topic(self.run_id, self.server_id, eid), json.dumps(payload).encode()
+            )
+
+    def run(self, rounds: int = 1, timeout_s: float = 120.0) -> Optional[Dict[str, float]]:
+        metrics = None
+        n = len(self.edge_ids)
+        idx_of = {eid: i for i, eid in enumerate(self.edge_ids)}
+        for rnd in range(rounds):
+            model_url = self.store.write_blob(
+                f"lsa_global_r{rnd}", params_to_blob(self.template)
+            )
+            self._broadcast({"type": "sync", "round": rnd, "model_url": model_url,
+                             "lsa": {"N": n, "U": self.cfg.target_active,
+                                     "T": self.cfg.privacy_guarantee,
+                                     "prime": self.cfg.prime, "q_bits": self.q_bits}})
+
+            # relay phase: collect every edge's share matrix, hand edge j the
+            # column of shares addressed to it (row j of each sender)
+            shares = self._gather("lsa_shares", rnd, n, timeout_s)
+            mats = {eid: _i64_from(self.store.read_blob(d["shares_url"]), (n, -1))
+                    for eid, d in shares.items()}
+            per_edge = {}
+            for eid in self.edge_ids:
+                j = idx_of[eid]
+                incoming = np.stack([mats[sender][j] for sender in self.edge_ids])
+                url = self.store.write_blob(f"lsa_dist_{eid}_r{rnd}", _i64_blob(incoming))
+                per_edge[eid] = {"shares_url": url}
+            self._broadcast({"type": "lsa_shares_dist", "round": rnd}, per_edge)
+
+            # masked uploads: the server only ever sums them
+            masked = self._gather("lsa_masked_model", rnd, n, timeout_s)
+            d = params_to_flat(self.template).size
+            masked_sum = np.zeros(d, np.int64)
+            for doc in masked.values():
+                masked_sum = (masked_sum + _i64_from(self.store.read_blob(doc["model_url"]))) \
+                    % self.cfg.prime
+
+            active = list(range(n))
+            self._broadcast({"type": "lsa_active", "round": rnd, "active": active})
+            agg = self._gather("lsa_agg_share", rnd, self.cfg.target_active, timeout_s)
+            agg_shares = {idx_of[eid]: _i64_from(self.store.read_blob(doc["share_url"]))
+                          for eid, doc in agg.items()}
+
+            x_sum = unmask_aggregate(self.cfg, masked_sum, agg_shares)
+            mean_flat = (dequantize(x_sum, self.q_bits, self.cfg.prime) / n).astype(np.float32)
+            self.template = flat_to_params(mean_flat, self.template)
+            if self.test_fn is not None:
+                metrics = dict(self.test_fn(self.template), round=rnd)
+                log.info("secure WAN round %d: %s", rnd, metrics)
+        self._broadcast({"type": MSG_FINISH})
+        return metrics
+
+    def stop(self) -> None:
+        self.transport.disconnect()

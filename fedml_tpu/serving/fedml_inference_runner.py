@@ -42,18 +42,24 @@ class _MicroBatcher:
         self._q: "queue.Queue" = queue.Queue()
         self._stop = object()  # sentinel: shutdown() unblocks + ends the loop
         self._stopped = False
+        # serializes submit's check+enqueue against shutdown's set+sentinel:
+        # without it a submit could pass the check, lose the race, and
+        # enqueue onto a drained queue nobody will ever service
+        self._submit_lock = threading.Lock()
         threading.Thread(target=self._loop, daemon=True).start()
 
     def shutdown(self) -> None:
-        self._stopped = True
-        self._q.put(self._stop)
+        with self._submit_lock:
+            self._stopped = True
+            self._q.put(self._stop)
 
     def submit(self, request: dict, timeout_s: float = 600.0) -> dict:
-        if self._stopped:
-            raise RuntimeError("inference runner is shutting down")
         ev = threading.Event()
         slot: dict = {}
-        self._q.put((request, ev, slot))
+        with self._submit_lock:
+            if self._stopped:
+                raise RuntimeError("inference runner is shutting down")
+            self._q.put((request, ev, slot))
         if not ev.wait(timeout=timeout_s):
             raise TimeoutError("batched predict timed out")
         if "exc" in slot:

@@ -31,11 +31,13 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <random>
 #include <string>
 #include <vector>
 
 #include "fedml_edge/client_manager.h"
 #include "fedml_edge/dense_model.h"
+#include "fedml_edge/light_secagg.h"
 
 namespace {
 
@@ -209,6 +211,47 @@ std::string strip_file_url(const std::string &url) {
   return url.rfind(scheme, 0) == 0 ? url.substr(scheme.size()) : url;
 }
 
+// --- int64 blob IO (little-endian; matches numpy '<i8' tobytes) -------------
+
+bool write_i64(const std::string &path, const std::vector<int64_t> &flat) {
+  FILE *f = std::fopen(path.c_str(), "wb");
+  if (f == nullptr) return false;
+  size_t n = std::fwrite(flat.data(), sizeof(int64_t), flat.size(), f);
+  std::fclose(f);
+  return n == flat.size();
+}
+
+bool read_i64(const std::string &path, std::vector<int64_t> *out) {
+  FILE *f = std::fopen(path.c_str(), "rb");
+  if (f == nullptr) return false;
+  std::fseek(f, 0, SEEK_END);
+  long bytes = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  out->assign(size_t(bytes) / sizeof(int64_t), 0);
+  size_t n = std::fread(out->data(), sizeof(int64_t), out->size(), f);
+  std::fclose(f);
+  return n == out->size();
+}
+
+bool json_int_array(const std::string &doc, const std::string &key,
+                    std::vector<long> *out) {
+  size_t p;
+  if (!json_find_key(doc, key, &p) || p >= doc.size() || doc[p] != '[') return false;
+  ++p;
+  out->clear();
+  while (p < doc.size() && doc[p] != ']') {
+    char *end = nullptr;
+    long v = std::strtol(doc.c_str() + p, &end, 10);
+    if (end == doc.c_str() + p) {
+      ++p;
+      continue;
+    }
+    out->push_back(v);
+    p = size_t(end - doc.c_str());
+  }
+  return true;
+}
+
 }  // namespace
 
 int main(int argc, char **argv) {
@@ -252,6 +295,14 @@ int main(int argc, char **argv) {
               run_id.c_str(), host.c_str(), port);
   std::fflush(stdout);
 
+  // LightSecAgg per-round state (secure mode: the sync message carries an
+  // "lsa" config; protocol in cross_device/lsa_wan.py — this agent never
+  // uploads a plaintext model in that mode)
+  fedml_edge::MaskState mask_state;
+  std::vector<int64_t> received_flat;  // N*chunk relayed shares, sender-major
+  long received_round = -1;            // which round received_flat belongs to
+  long lsa_N = 0, lsa_prime = 0, lsa_qbits = 16;
+
   std::string line;
   while (broker.read_line(&line)) {
     std::string op;
@@ -266,10 +317,55 @@ int main(int argc, char **argv) {
       std::printf("edge_agent %d: finish\n", edge_id);
       return 0;
     }
-    if (type != "init" && type != "sync") continue;
     long round = 0;
+    json_int(doc, "round", &round);
+
+    if (type == "lsa_shares_dist") {
+      // server relayed every sender's share addressed to us: keep rows
+      std::string url;
+      std::vector<int64_t> flat;
+      if (!json_string(doc, "shares_url", &url) ||
+          !read_i64(strip_file_url(url), &flat) || lsa_N <= 0) {
+        std::fprintf(stderr, "edge_agent %d: bad shares dist (round %ld)\n",
+                     edge_id, round);
+        continue;
+      }
+      received_flat = flat;
+      received_round = round;
+      continue;
+    }
+
+    if (type == "lsa_active") {
+      std::vector<long> active;
+      if (!json_int_array(doc, "active", &active) || lsa_N <= 0) continue;
+      if (received_flat.empty() || received_round != round) {
+        // answering with another round's shares would silently corrupt the
+        // server's reconstructed aggregate — refuse loudly instead
+        std::fprintf(stderr, "edge_agent %d: no shares for round %ld (have %ld)\n",
+                     edge_id, round, received_round);
+        continue;
+      }
+      size_t chunk = received_flat.size() / size_t(lsa_N);
+      std::vector<std::vector<int64_t>> rows;
+      for (long a : active) {
+        auto begin = received_flat.begin() + long(chunk) * a;
+        rows.emplace_back(begin, begin + long(chunk));
+      }
+      auto agg = fedml_edge::aggregate_encoded_mask(rows, lsa_prime);
+      const std::string path = store_dir + "/lsa_aggshare_native_" +
+                               std::to_string(edge_id) + "_r" + std::to_string(round) + ".bin";
+      if (!write_i64(path, agg)) continue;
+      const std::string msg =
+          "{\"type\": \"lsa_agg_share\", \"round\": " + std::to_string(round) +
+          ", \"edge_id\": " + std::to_string(edge_id) +
+          ", \"share_url\": \"file://" + json_escape(path) + "\"}";
+      if (!broker.publish(c2s, msg)) return 1;
+      continue;
+    }
+
+    if (type != "init" && type != "sync") continue;
     std::string url;
-    if (!json_int(doc, "round", &round) || !json_string(doc, "model_url", &url)) continue;
+    if (!json_string(doc, "model_url", &url)) continue;
 
     auto &model = manager.trainer()->model();
     if (!model.load(strip_file_url(url))) {
@@ -277,6 +373,52 @@ int main(int argc, char **argv) {
       continue;
     }
     manager.train();
+
+    long N = 0;
+    if (json_int(doc, "N", &N) && N > 0) {
+      // SECURE round: shares out, masked model out, plaintext stays here
+      long U = N, T = 1;
+      lsa_prime = fedml_edge::kDefaultPrime;
+      json_int(doc, "U", &U);
+      json_int(doc, "T", &T);
+      json_int(doc, "prime", &lsa_prime);
+      json_int(doc, "q_bits", &lsa_qbits);
+      lsa_N = N;
+      received_flat.clear();  // round-scoped: stale shares must never be
+      received_round = -1;    // aggregated for a later round
+      auto flat = model.flatten();
+      // CSPRNG seed: a seed computable from public values (edge id, round)
+      // would let the server regenerate the mask and unmask this edge's
+      // individual model — the exact thing LightSecAgg exists to prevent
+      std::random_device rd;
+      const uint64_t seed = (uint64_t(rd()) << 32) ^ uint64_t(rd());
+      mask_state = fedml_edge::encode_mask(
+          int(flat.size()), int(N), int(U), int(T), lsa_prime, seed);
+
+      std::vector<int64_t> shares_flat;
+      for (const auto &row : mask_state.encoded_shares)
+        shares_flat.insert(shares_flat.end(), row.begin(), row.end());
+      const std::string sp = store_dir + "/lsa_shares_native_" +
+                             std::to_string(edge_id) + "_r" + std::to_string(round) + ".bin";
+      if (!write_i64(sp, shares_flat)) continue;
+      std::string msg = "{\"type\": \"lsa_shares\", \"round\": " + std::to_string(round) +
+                        ", \"edge_id\": " + std::to_string(edge_id) +
+                        ", \"shares_url\": \"file://" + json_escape(sp) + "\"}";
+      if (!broker.publish(c2s, msg)) return 1;
+
+      auto y = fedml_edge::mask_vector(
+          fedml_edge::quantize(flat, int(lsa_qbits), lsa_prime), mask_state, lsa_prime);
+      const std::string yp = store_dir + "/lsa_masked_native_" +
+                             std::to_string(edge_id) + "_r" + std::to_string(round) + ".bin";
+      if (!write_i64(yp, y)) continue;
+      msg = "{\"type\": \"lsa_masked_model\", \"round\": " + std::to_string(round) +
+            ", \"edge_id\": " + std::to_string(edge_id) +
+            ", \"model_url\": \"file://" + json_escape(yp) + "\"}";
+      if (!broker.publish(c2s, msg)) return 1;
+      std::printf("edge_agent %d: round %ld trained + MASKED upload\n", edge_id, round);
+      std::fflush(stdout);
+      continue;
+    }
 
     const std::string out_path = store_dir + "/edge_" + std::to_string(edge_id) +
                                  "_round_" + std::to_string(round) + "_native.bin";
