@@ -71,7 +71,10 @@ class SecureEdgeDeviceAgent:
         self.server_id = server_id
         self.run_id = str(getattr(args, "run_id", "0") if args is not None else "0")
         self.store = store or LocalObjectStore()
-        self.rng = np.random.default_rng(seed if seed is not None else 1000 + self.edge_id)
+        # OS entropy by default: a mask seed computable from public values
+        # (edge id) would let the server regenerate the mask and unmask this
+        # edge's individual model. Explicit seeds are for tests only.
+        self.rng = np.random.default_rng(seed)  # seed=None -> OS entropy
         self.transport = create_mqtt_transport(args, client_id=f"sec_edge_{edge_id}")
         self.finished = threading.Event()
         self.rounds_trained = 0
@@ -111,16 +114,21 @@ class SecureEdgeDeviceAgent:
         self.engine.train()
         flat = self.engine.get_model_flat()
 
-        # offline phase: mask shares out to the cohort (server relays)
         self._state = encode_mask(self._cfg, flat.size, self.rng)
+        self._send_shares(rnd)
+        self._send_masked_model(rnd, flat)
+
+    def _send_shares(self, rnd: int) -> None:
+        """Offline phase: mask shares out to the cohort (server relays)."""
         shares_url = self.store.write_blob(
             f"lsa_shares_{self.edge_id}_r{rnd}", _i64_blob(self._state.encoded_shares)
         )
         self._publish({"type": "lsa_shares", "round": rnd, "edge_id": self.edge_id,
                        "shares_url": shares_url})
 
-        # online phase: the ONLY model material that leaves this device is
-        # quantize(x) + z mod p
+    def _send_masked_model(self, rnd: int, flat: np.ndarray) -> None:
+        """Online phase: the ONLY model material that leaves this device is
+        quantize(x) + z mod p."""
         y = mask_vector(self._cfg, quantize(flat, self._q_bits, self._cfg.prime), self._state)
         y_url = self.store.write_blob(f"lsa_masked_{self.edge_id}_r{rnd}", _i64_blob(y))
         self.rounds_trained += 1
@@ -152,6 +160,7 @@ class SecureServerEdgeWAN:
                  args: Any = None, *, server_id: int = 0,
                  store: Optional[LocalObjectStore] = None,
                  privacy_guarantee: int = 1, q_bits: int = 16,
+                 target_active: Optional[int] = None,
                  test_fn: Optional[Callable] = None):
         self.template = template_params
         self.edge_ids = [int(e) for e in edge_ids]
@@ -160,7 +169,10 @@ class SecureServerEdgeWAN:
         self.store = store or LocalObjectStore()
         self.transport = create_mqtt_transport(args, client_id=f"sec_server_{server_id}")
         n = len(self.edge_ids)
-        self.cfg = LightSecAggConfig(num_clients=n, target_active=n,
+        # U < N is the dropout budget: the round completes as long as U
+        # cohort members survive the online phase
+        self.cfg = LightSecAggConfig(num_clients=n,
+                                     target_active=int(target_active or n),
                                      privacy_guarantee=privacy_guarantee)
         self.q_bits = q_bits
         self.test_fn = test_fn
@@ -176,17 +188,23 @@ class SecureServerEdgeWAN:
             self._inbox.setdefault(key, {})[int(doc.get("edge_id", -1))] = doc
             self._cv.notify_all()
 
-    def _gather(self, mtype: str, rnd: int, n: int, timeout_s: float) -> Dict[int, dict]:
+    def _gather(self, mtype: str, rnd: int, want: int, timeout_s: float,
+                min_n: Optional[int] = None) -> Dict[int, dict]:
+        """Wait for ``want`` responses; at the deadline accept >= ``min_n``
+        (the LSA online-phase dropout budget) or raise."""
         import time as _time
 
         key = f"{mtype}:{rnd}"
         deadline = _time.time() + timeout_s
         with self._cv:
-            while len(self._inbox.get(key, {})) < n:
+            while len(self._inbox.get(key, {})) < want:
                 remaining = deadline - _time.time()
                 if remaining <= 0:
+                    got = len(self._inbox.get(key, {}))
+                    if min_n is not None and got >= min_n:
+                        break
                     raise TimeoutError(
-                        f"{mtype} round {rnd}: {len(self._inbox.get(key, {}))}/{n} within {timeout_s}s"
+                        f"{mtype} round {rnd}: {got}/{want} within {timeout_s}s"
                     )
                 self._cv.wait(timeout=min(remaining, 1.0))
             return dict(self._inbox[key])
@@ -199,6 +217,14 @@ class SecureServerEdgeWAN:
             )
 
     def run(self, rounds: int = 1, timeout_s: float = 120.0) -> Optional[Dict[str, float]]:
+        try:
+            return self._run_rounds(rounds, timeout_s)
+        finally:
+            # edges (incl. standalone C++ agents blocking on the socket)
+            # must ALWAYS get the finish, even when a round aborts
+            self._broadcast({"type": MSG_FINISH})
+
+    def _run_rounds(self, rounds: int, timeout_s: float) -> Optional[Dict[str, float]]:
         metrics = None
         n = len(self.edge_ids)
         idx_of = {eid: i for i, eid in enumerate(self.edge_ids)}
@@ -224,27 +250,30 @@ class SecureServerEdgeWAN:
                 per_edge[eid] = {"shares_url": url}
             self._broadcast({"type": "lsa_shares_dist", "round": rnd}, per_edge)
 
-            # masked uploads: the server only ever sums them
-            masked = self._gather("lsa_masked_model", rnd, n, timeout_s)
+            # masked uploads: the server only ever sums them. Edges that
+            # drop here are tolerated as long as >= U survive — the
+            # aggregate mask is reconstructed for exactly the active set
+            masked = self._gather("lsa_masked_model", rnd, n, timeout_s,
+                                  min_n=self.cfg.target_active)
             d = params_to_flat(self.template).size
             masked_sum = np.zeros(d, np.int64)
             for doc in masked.values():
                 masked_sum = (masked_sum + _i64_from(self.store.read_blob(doc["model_url"]))) \
                     % self.cfg.prime
 
-            active = list(range(n))
+            active = sorted(idx_of[eid] for eid in masked)
             self._broadcast({"type": "lsa_active", "round": rnd, "active": active})
             agg = self._gather("lsa_agg_share", rnd, self.cfg.target_active, timeout_s)
             agg_shares = {idx_of[eid]: _i64_from(self.store.read_blob(doc["share_url"]))
                           for eid, doc in agg.items()}
 
             x_sum = unmask_aggregate(self.cfg, masked_sum, agg_shares)
-            mean_flat = (dequantize(x_sum, self.q_bits, self.cfg.prime) / n).astype(np.float32)
+            mean_flat = (dequantize(x_sum, self.q_bits, self.cfg.prime)
+                         / len(active)).astype(np.float32)
             self.template = flat_to_params(mean_flat, self.template)
             if self.test_fn is not None:
                 metrics = dict(self.test_fn(self.template), round=rnd)
                 log.info("secure WAN round %d: %s", rnd, metrics)
-        self._broadcast({"type": MSG_FINISH})
         return metrics
 
     def stop(self) -> None:

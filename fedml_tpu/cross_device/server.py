@@ -141,6 +141,8 @@ class ServerEdge:
         self.final_metrics: Optional[Dict[str, float]] = None
 
     def run(self) -> Optional[Dict[str, float]]:
+        if bool(getattr(self.args, "enable_secure_agg", False)):
+            return self._run_secure()
         tx, ty = self._test_arrays()
         try:
             for round_idx in range(self.rounds):
@@ -162,6 +164,51 @@ class ServerEdge:
             # shards are resident in the engines after the first epoch
             self._tmpdir.cleanup()
         return self.final_metrics
+
+    def _run_secure(self) -> Optional[Dict[str, float]]:
+        """``enable_secure_agg: true``: rounds run LightSecAgg-masked over
+        the WAN plane (lsa_wan.py) — the aggregator only ever reconstructs
+        the SUM of quantized models. All clients participate each round
+        (LSA's cohort is fixed; dropout tolerance comes from U < N, not
+        per-round sampling)."""
+        from ..core.distributed.communication.mqtt_s3.object_store import LocalObjectStore
+        from .lsa_wan import SecureEdgeDeviceAgent, SecureServerEdgeWAN
+
+        tx, ty = self._test_arrays()
+
+        def test_fn(params):
+            self.aggregator.template = params
+            return self.aggregator.test_on_server(tx, ty)
+
+        store = LocalObjectStore(os.path.join(self._tmpdir.name, "store"))
+        agents: List[Any] = []
+        server = None
+        try:
+            # construction INSIDE the try: a config error (e.g. T >= N) in
+            # the server constructor must still unsubscribe the agents and
+            # clean the shard tmpdir
+            for cid in range(self.client_num):
+                agents.append(
+                    SecureEdgeDeviceAgent(cid, self.engines[cid], self.args, store=store)
+                )
+            server = SecureServerEdgeWAN(
+                self.aggregator.template, list(range(self.client_num)), self.args,
+                store=store,
+                privacy_guarantee=int(getattr(self.args, "lsa_privacy_guarantee", 1)),
+                q_bits=int(getattr(self.args, "lsa_q_bits", 16)),
+                target_active=getattr(self.args, "lsa_target_active", None),
+                test_fn=test_fn,
+            )
+            metrics = server.run(rounds=self.rounds,
+                                 timeout_s=float(getattr(self.args, "lsa_timeout_s", 300.0)))
+            self.final_metrics = metrics
+            return metrics
+        finally:
+            if server is not None:
+                server.stop()
+            for a in agents:
+                a.stop()
+            self._tmpdir.cleanup()
 
     # --- helpers ----------------------------------------------------------
     def _template_from_model(self, model, feat_dim: int) -> List[Dict[str, np.ndarray]]:

@@ -346,6 +346,16 @@ int main(int argc, char **argv) {
         continue;
       }
       size_t chunk = received_flat.size() / size_t(lsa_N);
+      bool bad_index = false;
+      for (long a : active) {
+        if (a < 0 || a >= lsa_N) bad_index = true;  // untrusted input: an
+        // out-of-range cohort index would read past received_flat
+      }
+      if (bad_index) {
+        std::fprintf(stderr, "edge_agent %d: active set out of range (N=%ld)\n",
+                     edge_id, lsa_N);
+        continue;
+      }
       std::vector<std::vector<int64_t>> rows;
       for (long a : active) {
         auto begin = received_flat.begin() + long(chunk) * a;

@@ -193,3 +193,79 @@ def test_secure_heterogeneous_cpp_and_python_edges(tmp_path):
         broker.stop()
         print("cpp secure edge output:", (out or "")[-1200:])
     assert cpp.returncode == 0
+
+
+@pytest.mark.slow
+def test_runner_enable_secure_agg_flag(tmp_path):
+    """Config-driven: cross_device runs with ``enable_secure_agg: true``
+    route every round through the masked WAN protocol."""
+    import fedml_tpu as fedml
+    from fedml_tpu.arguments import default_config
+
+    LocalMqttBroker.reset()
+    # hyperparameters of test_cross_device_fl_via_runner, which clears 0.8
+    # on the PLAIN path — the secure path must learn just as well
+    args = default_config(
+        "cross_device", model="lr", dataset="mnist", comm_round=3, epochs=1,
+        client_num_in_total=3, client_num_per_round=3, batch_size=32,
+        learning_rate=0.1, random_seed=0,
+    )
+    args.enable_secure_agg = True
+    args.run_id = "lsa_runner_test"
+    args = fedml.init(args)
+    device = fedml.device.get_device(args)
+    dataset, out_dim = fedml.data.load(args)
+    model = fedml.model.create(args, out_dim)
+    metrics = fedml.FedMLRunner(args, device, dataset, model).run()
+    assert metrics is not None and metrics["round"] == 2
+    assert metrics["test_acc"] > 0.8, metrics
+    LocalMqttBroker.reset()
+
+
+def test_dropout_tolerance_u_less_than_n(tmp_path):
+    """LSA's online-phase dropout budget: with U=2 of N=3, an edge that dies
+    AFTER the share exchange (before its masked upload) does not abort the
+    round — the server reconstructs the mask sum for the surviving active
+    set and averages over the survivors."""
+    LocalMqttBroker.reset()
+    rng = np.random.RandomState(17)
+    dim, classes = 8, 2
+    store = LocalObjectStore(str(tmp_path / "store"))
+
+    class Args:
+        run_id = "lsa_dropout"
+
+    class DiesBeforeUpload(SecureEdgeDeviceAgent):
+        def _send_masked_model(self, rnd, flat):  # simulated mid-phase death
+            pass
+
+    engines, agents = [], []
+    for eid in range(3):
+        n = 48
+        y = rng.randint(0, classes, n)
+        x = rng.randn(n, dim).astype(np.float32)
+        x[np.arange(n), y * (dim // classes)] += 2.0
+        p = tmp_path / f"d{eid}.bin"
+        p.write_bytes(dataset_to_bytes(x, y, classes))
+        eng = NativeEdgeEngine(data_path=str(p), train_size=n, batch_size=16,
+                               learning_rate=0.1, epochs=1, dims=[dim, classes])
+        engines.append(eng)
+        cls = DiesBeforeUpload if eid == 2 else SecureEdgeDeviceAgent
+        agents.append(cls(eid, eng, Args(), store=store, seed=30 + eid))
+
+    template = [{"w": np.zeros((dim, classes), np.float32),
+                 "b": np.zeros(classes, np.float32)}]
+    server = SecureServerEdgeWAN(template, [0, 1, 2], Args(), store=store,
+                                 privacy_guarantee=1, target_active=2)
+    try:
+        server.run(rounds=1, timeout_s=6.0)
+        from fedml_tpu.cross_device.codec import params_to_flat
+
+        # aggregate == mean of the TWO survivors' models, exactly
+        plain_mean = np.mean([engines[i].get_model_flat() for i in (0, 1)], axis=0)
+        np.testing.assert_allclose(params_to_flat(server.template), plain_mean, atol=2e-4)
+    finally:
+        server.stop()
+        for a in agents:
+            a.stop()
+        LocalMqttBroker.reset()
