@@ -65,9 +65,10 @@ class SecureEdgeDeviceAgent:
 
     def __init__(self, edge_id: int, engine, args: Any = None, *,
                  server_id: int = 0, store: Optional[LocalObjectStore] = None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None, sample_num: int = 1):
         self.edge_id = int(edge_id)
         self.engine = engine
+        self.sample_num = int(sample_num)
         self.server_id = server_id
         self.run_id = str(getattr(args, "run_id", "0") if args is not None else "0")
         self.store = store or LocalObjectStore()
@@ -81,6 +82,8 @@ class SecureEdgeDeviceAgent:
         self._state = None  # ClientMaskState for the in-flight round
         self._cfg: Optional[LightSecAggConfig] = None
         self._q_bits = 16
+        self._weighted = False
+        self._weight_scale = 1024.0
         self.transport.subscribe(_s2c_topic(self.run_id, server_id, self.edge_id), self._on_message)
 
     def _publish(self, doc: dict) -> None:
@@ -106,6 +109,11 @@ class SecureEdgeDeviceAgent:
             privacy_guarantee=int(lsa["T"]), prime=int(lsa.get("prime", DEFAULT_PRIME)),
         )
         self._q_bits = int(lsa.get("q_bits", 16))
+        # weighted mode: the normalized sample weight rides as ONE extra
+        # masked element, so the server recovers sum(w*x) and sum(w) —
+        # exact sample-weighted FedAvg without seeing any individual weight
+        self._weighted = bool(lsa.get("weighted", False))
+        self._weight_scale = float(lsa.get("weight_scale", 1024.0))
         rnd = int(doc["round"])
 
         # install the global model, train locally
@@ -113,6 +121,9 @@ class SecureEdgeDeviceAgent:
         self.engine.set_model_flat(params_to_flat(template))
         self.engine.train()
         flat = self.engine.get_model_flat()
+        if self._weighted:
+            w_norm = np.float32(self.sample_num / self._weight_scale)
+            flat = np.concatenate([flat * w_norm, [w_norm]]).astype(np.float32)
 
         self._state = encode_mask(self._cfg, flat.size, self.rng)
         self._send_shares(rnd)
@@ -161,6 +172,7 @@ class SecureServerEdgeWAN:
                  store: Optional[LocalObjectStore] = None,
                  privacy_guarantee: int = 1, q_bits: int = 16,
                  target_active: Optional[int] = None,
+                 weighted: bool = False, weight_scale: float = 1024.0,
                  test_fn: Optional[Callable] = None):
         self.template = template_params
         self.edge_ids = [int(e) for e in edge_ids]
@@ -175,6 +187,8 @@ class SecureServerEdgeWAN:
                                      target_active=int(target_active or n),
                                      privacy_guarantee=privacy_guarantee)
         self.q_bits = q_bits
+        self.weighted = bool(weighted)
+        self.weight_scale = float(weight_scale)
         self.test_fn = test_fn
         self._inbox: Dict[str, Dict[int, dict]] = {}
         self._cv = threading.Condition()
@@ -229,51 +243,81 @@ class SecureServerEdgeWAN:
         n = len(self.edge_ids)
         idx_of = {eid: i for i, eid in enumerate(self.edge_ids)}
         for rnd in range(rounds):
-            model_url = self.store.write_blob(
-                f"lsa_global_r{rnd}", params_to_blob(self.template)
-            )
-            self._broadcast({"type": "sync", "round": rnd, "model_url": model_url,
-                             "lsa": {"N": n, "U": self.cfg.target_active,
-                                     "T": self.cfg.privacy_guarantee,
-                                     "prime": self.cfg.prime, "q_bits": self.q_bits}})
+            try:
+                metrics = self._one_round(rnd, n, idx_of, timeout_s, metrics)
+            except TimeoutError as e:
+                # below the dropout budget: keep the PREVIOUS rounds' model
+                # and metrics rather than discarding completed training
+                log.warning("secure WAN round %d aborted (%s); stopping early", rnd, e)
+                break
+        return metrics
 
-            # relay phase: collect every edge's share matrix, hand edge j the
-            # column of shares addressed to it (row j of each sender)
-            shares = self._gather("lsa_shares", rnd, n, timeout_s)
-            mats = {eid: _i64_from(self.store.read_blob(d["shares_url"]), (n, -1))
-                    for eid, d in shares.items()}
-            per_edge = {}
-            for eid in self.edge_ids:
-                j = idx_of[eid]
-                incoming = np.stack([mats[sender][j] for sender in self.edge_ids])
-                url = self.store.write_blob(f"lsa_dist_{eid}_r{rnd}", _i64_blob(incoming))
-                per_edge[eid] = {"shares_url": url}
-            self._broadcast({"type": "lsa_shares_dist", "round": rnd}, per_edge)
+    def _one_round(self, rnd: int, n: int, idx_of: Dict[int, int],
+                   timeout_s: float, metrics) -> Optional[Dict[str, float]]:
+        model_url = self.store.write_blob(
+            f"lsa_global_r{rnd}", params_to_blob(self.template)
+        )
+        self._broadcast({"type": "sync", "round": rnd, "model_url": model_url,
+                         "lsa": {"N": n, "U": self.cfg.target_active,
+                                 "T": self.cfg.privacy_guarantee,
+                                 "prime": self.cfg.prime, "q_bits": self.q_bits,
+                                 "weighted": self.weighted,
+                                 "weight_scale": self.weight_scale}})
 
-            # masked uploads: the server only ever sums them. Edges that
-            # drop here are tolerated as long as >= U survive — the
-            # aggregate mask is reconstructed for exactly the active set
-            masked = self._gather("lsa_masked_model", rnd, n, timeout_s,
-                                  min_n=self.cfg.target_active)
-            d = params_to_flat(self.template).size
-            masked_sum = np.zeros(d, np.int64)
-            for doc in masked.values():
-                masked_sum = (masked_sum + _i64_from(self.store.read_blob(doc["model_url"]))) \
-                    % self.cfg.prime
+        # relay phase: collect every edge's share matrix, hand edge j the
+        # column of shares addressed to it (row j of each sender). An edge
+        # that is already dead here is tolerated down to U senders — its
+        # rows stay zero and it can never enter the active set
+        shares = self._gather("lsa_shares", rnd, n, timeout_s,
+                              min_n=self.cfg.target_active)
+        mats = {eid: _i64_from(self.store.read_blob(d["shares_url"]), (n, -1))
+                for eid, d in shares.items()}
+        per_edge = {}
+        for eid in self.edge_ids:
+            j = idx_of[eid]
+            incoming = np.stack([
+                mats[sender][j] if sender in mats
+                else np.zeros_like(next(iter(mats.values()))[j])
+                for sender in self.edge_ids
+            ])
+            url = self.store.write_blob(f"lsa_dist_{eid}_r{rnd}", _i64_blob(incoming))
+            per_edge[eid] = {"shares_url": url}
+        self._broadcast({"type": "lsa_shares_dist", "round": rnd}, per_edge)
 
-            active = sorted(idx_of[eid] for eid in masked)
-            self._broadcast({"type": "lsa_active", "round": rnd, "active": active})
-            agg = self._gather("lsa_agg_share", rnd, self.cfg.target_active, timeout_s)
-            agg_shares = {idx_of[eid]: _i64_from(self.store.read_blob(doc["share_url"]))
-                          for eid, doc in agg.items()}
+        # masked uploads. Dropout here is tolerated down to U survivors.
+        masked = self._gather("lsa_masked_model", rnd, n, timeout_s,
+                              min_n=self.cfg.target_active)
+        # active = edges whose shares AND masked model arrived: the summed
+        # masked vectors and the reconstructed aggregate mask must cover
+        # EXACTLY the same senders
+        active_eids = [eid for eid in masked if eid in mats]
+        active = sorted(idx_of[eid] for eid in active_eids)
 
-            x_sum = unmask_aggregate(self.cfg, masked_sum, agg_shares)
-            mean_flat = (dequantize(x_sum, self.q_bits, self.cfg.prime)
-                         / len(active)).astype(np.float32)
-            self.template = flat_to_params(mean_flat, self.template)
-            if self.test_fn is not None:
-                metrics = dict(self.test_fn(self.template), round=rnd)
-                log.info("secure WAN round %d: %s", rnd, metrics)
+        d = params_to_flat(self.template).size
+        d_up = d + 1 if self.weighted else d  # +1: the masked weight
+        masked_sum = np.zeros(d_up, np.int64)
+        for eid in active_eids:
+            masked_sum = (masked_sum +
+                          _i64_from(self.store.read_blob(masked[eid]["model_url"]))) \
+                % self.cfg.prime
+
+        self._broadcast({"type": "lsa_active", "round": rnd, "active": active})
+        agg = self._gather("lsa_agg_share", rnd, self.cfg.target_active, timeout_s)
+        agg_shares = {idx_of[eid]: _i64_from(self.store.read_blob(doc["share_url"]))
+                      for eid, doc in agg.items()}
+
+        x_sum = unmask_aggregate(self.cfg, masked_sum, agg_shares)
+        s = dequantize(x_sum, self.q_bits, self.cfg.prime)
+        if self.weighted:
+            # s = [sum(w_i * x_i), sum(w_i)] -> exact weighted FedAvg; no
+            # individual weight or model was ever visible
+            mean_flat = (s[:d] / max(s[d], 1e-12)).astype(np.float32)
+        else:
+            mean_flat = (s / len(active)).astype(np.float32)
+        self.template = flat_to_params(mean_flat, self.template)
+        if self.test_fn is not None:
+            metrics = dict(self.test_fn(self.template), round=rnd)
+            log.info("secure WAN round %d: %s", rnd, metrics)
         return metrics
 
     def stop(self) -> None:

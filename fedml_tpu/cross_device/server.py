@@ -174,6 +174,13 @@ class ServerEdge:
         from ..core.distributed.communication.mqtt_s3.object_store import LocalObjectStore
         from .lsa_wan import SecureEdgeDeviceAgent, SecureServerEdgeWAN
 
+        if self.per_round < self.client_num:
+            log.warning(
+                "enable_secure_agg: client_num_per_round=%d is ignored — the LSA "
+                "cohort is fixed, all %d clients participate each round "
+                "(dropout tolerance comes from lsa_target_active < N)",
+                self.per_round, self.client_num,
+            )
         tx, ty = self._test_arrays()
 
         def test_fn(params):
@@ -189,7 +196,8 @@ class ServerEdge:
             # clean the shard tmpdir
             for cid in range(self.client_num):
                 agents.append(
-                    SecureEdgeDeviceAgent(cid, self.engines[cid], self.args, store=store)
+                    SecureEdgeDeviceAgent(cid, self.engines[cid], self.args, store=store,
+                                          sample_num=self.sample_nums[cid])
                 )
             server = SecureServerEdgeWAN(
                 self.aggregator.template, list(range(self.client_num)), self.args,
@@ -197,6 +205,10 @@ class ServerEdge:
                 privacy_guarantee=int(getattr(self.args, "lsa_privacy_guarantee", 1)),
                 q_bits=int(getattr(self.args, "lsa_q_bits", 16)),
                 target_active=getattr(self.args, "lsa_target_active", None),
+                # default True: the PLAIN path sample-weights its FedAvg, so
+                # flipping enable_secure_agg must not silently change the
+                # aggregation semantics on unequal shards
+                weighted=bool(getattr(self.args, "lsa_weighted", True)),
                 test_fn=test_fn,
             )
             metrics = server.run(rounds=self.rounds,

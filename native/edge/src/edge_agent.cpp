@@ -132,6 +132,21 @@ bool json_int(const std::string &doc, const std::string &key, long *out) {
   return true;
 }
 
+bool json_double(const std::string &doc, const std::string &key, double *out) {
+  size_t p;
+  if (!json_find_key(doc, key, &p)) return false;
+  *out = std::strtod(doc.c_str() + p, nullptr);
+  return true;
+}
+
+bool json_bool(const std::string &doc, const std::string &key, bool *out) {
+  size_t p;
+  if (!json_find_key(doc, key, &p) || p >= doc.size()) return false;
+  if (doc.compare(p, 4, "true") == 0) { *out = true; return true; }
+  if (doc.compare(p, 5, "false") == 0) { *out = false; return true; }
+  return false;
+}
+
 std::string json_escape(const std::string &s) {
   std::string o;
   for (char c : s) {
@@ -397,6 +412,18 @@ int main(int argc, char **argv) {
       received_flat.clear();  // round-scoped: stale shares must never be
       received_round = -1;    // aggregated for a later round
       auto flat = model.flatten();
+      bool weighted = false;
+      json_bool(doc, "weighted", &weighted);
+      if (weighted) {
+        // normalized sample weight rides as one extra masked element:
+        // the server recovers sum(w*x) and sum(w), never this w.
+        // strtod, not strtol: the python side sends a FLOAT scale
+        double ws = 1024.0;
+        json_double(doc, "weight_scale", &ws);
+        const float w_norm = float(double(sample_num) / ws);
+        for (auto &v : flat) v *= w_norm;
+        flat.push_back(w_norm);
+      }
       // CSPRNG seed: a seed computable from public values (edge id, round)
       // would let the server regenerate the mask and unmask this edge's
       // individual model — the exact thing LightSecAgg exists to prevent

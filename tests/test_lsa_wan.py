@@ -258,12 +258,62 @@ def test_dropout_tolerance_u_less_than_n(tmp_path):
     server = SecureServerEdgeWAN(template, [0, 1, 2], Args(), store=store,
                                  privacy_guarantee=1, target_active=2)
     try:
-        server.run(rounds=1, timeout_s=6.0)
+        # TWO rounds: a permanently dead edge must not stall later rounds
+        # either (every phase tolerates down to U survivors)
+        server.run(rounds=2, timeout_s=6.0)
         from fedml_tpu.cross_device.codec import params_to_flat
 
         # aggregate == mean of the TWO survivors' models, exactly
         plain_mean = np.mean([engines[i].get_model_flat() for i in (0, 1)], axis=0)
         np.testing.assert_allclose(params_to_flat(server.template), plain_mean, atol=2e-4)
+    finally:
+        server.stop()
+        for a in agents:
+            a.stop()
+        LocalMqttBroker.reset()
+
+
+def test_weighted_secure_aggregation_exact(tmp_path):
+    """Weighted mode: the normalized sample weight rides as one extra masked
+    element; the recovered aggregate equals the sample-weighted FedAvg of
+    the edges' trained models to quantization precision — with no individual
+    weight or model ever visible to the server."""
+    LocalMqttBroker.reset()
+    rng = np.random.RandomState(23)
+    dim, classes = 8, 2
+    store = LocalObjectStore(str(tmp_path / "store"))
+
+    class Args:
+        run_id = "lsa_weighted"
+
+    sample_nums = {0: 48, 1: 144}  # 1:3 weights
+    engines, agents = [], []
+    for eid in range(2):
+        n = sample_nums[eid]
+        y = rng.randint(0, classes, n)
+        x = rng.randn(n, dim).astype(np.float32)
+        x[np.arange(n), y * (dim // classes)] += 2.0
+        p = tmp_path / f"w{eid}.bin"
+        p.write_bytes(dataset_to_bytes(x, y, classes))
+        eng = NativeEdgeEngine(data_path=str(p), train_size=n, batch_size=16,
+                               learning_rate=0.1, epochs=1, dims=[dim, classes])
+        engines.append(eng)
+        agents.append(SecureEdgeDeviceAgent(eid, eng, Args(), store=store,
+                                            seed=40 + eid, sample_num=n))
+
+    template = [{"w": np.zeros((dim, classes), np.float32),
+                 "b": np.zeros(classes, np.float32)}]
+    server = SecureServerEdgeWAN(template, [0, 1], Args(), store=store,
+                                 privacy_guarantee=1, weighted=True)
+    try:
+        server.run(rounds=1, timeout_s=60)
+        from fedml_tpu.cross_device.codec import params_to_flat
+
+        flats = [e.get_model_flat() for e in engines]
+        w = np.asarray([sample_nums[0], sample_nums[1]], np.float64)
+        weighted_mean = (w[0] * flats[0] + w[1] * flats[1]) / w.sum()
+        np.testing.assert_allclose(params_to_flat(server.template), weighted_mean,
+                                   atol=5e-3)
     finally:
         server.stop()
         for a in agents:
