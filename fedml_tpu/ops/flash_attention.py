@@ -74,8 +74,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int, block_k: i
     T = k_ref.shape[1]
     D = q.shape[-1]
 
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
+    # row stats kept 2D [block_q, 1]: Mosaic vectorizes (sublane, lane) tiles;
+    # 1D vectors lower poorly, and the lse residual is stored with a trailing
+    # singleton lane dim for the same reason (see _fwd_impl out_specs)
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
     acc = jnp.zeros((block_q, D), jnp.float32)
 
     row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -88,13 +91,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int, block_k: i
         col = start * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         if causal:
             s = jnp.where(col <= row, s, NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
+        p = jnp.exp(s - m_new)
         if causal:
             p = jnp.where(col <= row, p, 0.0)
-        l_new = l * corr + p.sum(axis=-1)
-        acc_new = acc * corr[:, None] + p @ v_blk
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * corr + p @ v_blk
         return m_new, l_new, acc_new
 
     num_k = T // block_k
@@ -102,7 +105,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int, block_k: i
     num_k_eff = _causal_num_k(qi, num_k, block_q, block_k) if causal else num_k
     m, l, acc = jax.lax.fori_loop(0, num_k_eff, body, (m, l, acc))
     l_safe = jnp.maximum(l, 1e-20)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(l_safe)
 
 
@@ -128,7 +131,11 @@ def _fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int, Hq: int, Hkv
                           causal=causal, scale=scale),
         out_shape=(
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((BHq, T), jnp.float32),
+            # trailing singleton lane dim: Mosaic requires the last two block
+            # dims be (8k, 128k) or equal the array dims — (block_q, 1) with
+            # an array whose last dim IS 1 satisfies that at zero HBM cost
+            # (the official jax kernel broadcasts over 128 lanes instead)
+            jax.ShapeDtypeStruct((BHq, T, 1), jnp.float32),
         ),
         grid=grid,
         in_specs=[
@@ -138,7 +145,7 @@ def _fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int, Hq: int, Hkv
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, D), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
         ),
         compiler_params=_compiler_params(("parallel", "parallel")),
         interpret=jax.default_backend() != "tpu",  # CPU tests run interpreted
@@ -152,8 +159,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)          # [block_q, D]
     do = do_ref[0].astype(jnp.float32)        # [block_q, D]
-    lse = lse_ref[0]                          # [block_q]
-    delta = delta_ref[0]                      # [block_q] = rowsum(dO * O)
+    lse = lse_ref[0]                          # [block_q, 1]
+    delta = delta_ref[0]                      # [block_q, 1] = rowsum(dO * O)
     T = k_ref.shape[1]
 
     row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -163,11 +170,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         v_blk = v_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
         s = (q @ k_blk.T) * scale
         col = start * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         if causal:
             p = jnp.where(col <= row, p, 0.0)
         dp = do @ v_blk.T                      # [block_q, block_k]
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         return dq + (ds @ k_blk) * scale
 
     num_k = T // block_k
@@ -201,16 +208,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q_blk = q_ref[0, pl.ds(start * block_q, block_q), :].astype(jnp.float32)
         do_blk = do_ref[0, pl.ds(start * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(start * block_q, block_q)]
-        delta_blk = delta_ref[0, pl.ds(start * block_q, block_q)]
+        lse_blk = lse_ref[0, pl.ds(start * block_q, block_q), :]      # [block_q, 1]
+        delta_blk = delta_ref[0, pl.ds(start * block_q, block_q), :]  # [block_q, 1]
         s = (q_blk @ k.T) * scale              # [block_q, block_k]
         row = start * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        p = jnp.exp(s - lse_blk[:, None])
+        p = jnp.exp(s - lse_blk)
         if causal:
             p = jnp.where(col <= row, p, 0.0)
         dv_new = dv + p.T @ do_blk
         dp = do_blk @ v.T
-        ds = p * (dp - delta_blk[:, None])
+        ds = p * (dp - delta_blk)
         dk_new = dk + (ds.T @ q_blk) * scale
         return dk_new, dv_new
 
@@ -236,8 +243,11 @@ def _bwd_impl(q, k, v, do, o, lse, *, causal: bool, block_q: int, block_k: int,
     G = Hq // Hkv
     scale = D ** -0.5
     # delta = rowsum(dO * O): tiny elementwise reduce, XLA fuses it; feeding
-    # it in precomputed keeps both kernels single-pass
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BHq, T]
+    # it in precomputed keeps both kernels single-pass. Trailing singleton
+    # lane dim for the same Mosaic block-tiling reason as lse (see _fwd_impl).
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [BHq, T, 1]
     interpret = jax.default_backend() != "tpu"
     kv_idx = _kv_index(Hq, Hkv)
 
@@ -251,8 +261,8 @@ def _bwd_impl(q, k, v, do, o, lse, *, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, T, D), kv_idx),
             pl.BlockSpec((1, T, D), kv_idx),
             pl.BlockSpec((1, block_q, D), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda i, j: (i, j, 0)),
         compiler_params=_compiler_params(("parallel", "parallel")),
@@ -265,7 +275,7 @@ def _bwd_impl(q, k, v, do, o, lse, *, causal: bool, block_q: int, block_k: int,
         return (i * G + g, 0, 0)
 
     def q_row_idx(i, j, g):
-        return (i * G + g, 0)
+        return (i * G + g, 0, 0)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
@@ -280,8 +290,8 @@ def _bwd_impl(q, k, v, do, o, lse, *, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, block_k, D), lambda i, j, g: (i, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda i, j, g: (i, j, 0)),
             pl.BlockSpec((1, T, D), q_idx),
-            pl.BlockSpec((1, T), q_row_idx),
-            pl.BlockSpec((1, T), q_row_idx),
+            pl.BlockSpec((1, T, 1), q_row_idx),
+            pl.BlockSpec((1, T, 1), q_row_idx),
         ],
         out_specs=(
             pl.BlockSpec((1, block_k, D), lambda i, j, g: (i, j, 0)),
