@@ -3,10 +3,25 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu", ...}.
 
 Headline metric: LLM full train-step throughput (tokens/sec) on a llama-family
-~350M-parameter model, bf16, seq 1024 — the single-chip proxy for BASELINE
+~268M-parameter model, bf16, seq 1024 — the single-chip proxy for BASELINE
 config 4 (Llama-2-7B LoRA; 7B itself does not fit one v5e chip's HBM, the
 multi-chip sharding for it is validated by __graft_entry__.dryrun_multichip).
 Secondary: ResNet-56/CIFAR-10 client local-SGD steps/sec (BASELINE config 2).
+
+ARCHITECTURE (round 4, VERDICT r3 item 1): every stage runs in its OWN
+subprocess that prints one JSON line —
+    python bench.py --stage llm_pallas     (headline, runs FIRST)
+    python bench.py --stage llm_xla
+    python bench.py --stage decode
+    python bench.py --stage resnet
+    python bench.py --stage cpu_llm / cpu_resnet   (host-only baselines)
+    python bench.py --stage serving        (runs LAST)
+so chip HBM is truly released between stages (the process exits) and one
+stage's OOM cannot void the others. The orchestrator itself NEVER imports
+jax: it only spawns stages, merges their JSON, and records failures into
+``stages_failed``. rc is 0 whenever the headline stage produced a number.
+A BENCH_MEASURED_* artifact is (re)written after EVERY successful stage,
+so a mid-run tunnel death still leaves the completed stages in git.
 
 Honesty guards (VERDICT round 1 found the old bench measured a platform
 artifact — repeated identical dispatches were short-circuited; and on this
@@ -29,6 +44,7 @@ and publishes no numbers of its own — BASELINE.md; no CUDA exists here).
 
 from __future__ import annotations
 
+import argparse
 import datetime
 import glob
 import json
@@ -52,6 +68,10 @@ _PEAK_BF16_TFLOPS = {
     "v6 lite": 918.0,   # trillium
     "v6e": 918.0,
 }
+
+# flagship single-chip proxy geometry, shared by train/decode/serving stages
+_LLM_SHAPE = dict(d_model=1024, n_layers=16, n_heads=16, d_ff=2752,
+                  vocab=32000, seq=1024, bs=8)
 
 
 def _chip_peak_tflops(device, dtype_bits: int) -> float:
@@ -119,25 +139,37 @@ def _check_mfu(name: str, mfu: float) -> None:
         print(f"warning: {name} MFU {mfu:.3f} outside typical 0.05-0.6 band", file=sys.stderr)
 
 
-# --- workload B: llama-350M full train step ----------------------------------
+# --- workload B: llama-268M full train step ----------------------------------
+
+def _build_llm(attention_impl: str, remat: bool):
+    """Flagship model + init params (shared by train/decode stages)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    s = _LLM_SHAPE
+    cfg = TransformerConfig(
+        vocab_size=s["vocab"], d_model=s["d_model"], n_layers=s["n_layers"],
+        n_heads=s["n_heads"], n_kv_heads=s["n_heads"], d_ff=s["d_ff"],
+        max_seq_len=s["seq"], remat=remat, lora_rank=0,
+        attention_impl=attention_impl,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, cfg, params
+
 
 def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool = False):
     import jax
     import jax.numpy as jnp
     import optax
 
-    from fedml_tpu.models.transformer import TransformerConfig, TransformerLM
     from fedml_tpu.parallel.fsdp import causal_lm_loss
 
-    d_model, n_layers, n_heads, d_ff, vocab, seq, bs = 1024, 16, 16, 2752, 32000, 1024, 8
-    cfg = TransformerConfig(
-        vocab_size=vocab, d_model=d_model, n_layers=n_layers, n_heads=n_heads,
-        n_kv_heads=n_heads, d_ff=d_ff, max_seq_len=seq, remat=remat, lora_rank=0,
-        attention_impl=attention_impl,
-    )
-    model = TransformerLM(cfg)
-    key = jax.random.PRNGKey(0)
-    params = model.init(key, jnp.zeros((1, 8), jnp.int32))["params"]
+    model, cfg, params = _build_llm(attention_impl, remat)
+    s = _LLM_SHAPE
+    vocab, seq, bs = s["vocab"], s["seq"], s["bs"]
     n_params = sum(x.size for x in jax.tree.leaves(params))
     tx = optax.adamw(1e-4)
     opt_state = tx.init(params)
@@ -169,8 +201,8 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
         # (see module docstring) and trace no device execution
         trace_dir = os.path.join(_REPO, "bench_traces")
         with jax.profiler.trace(trace_dir):
-            s = step(params, opt_state, batches[reps + 2])
-            float(s[2])
+            st = step(params, opt_state, batches[reps + 2])
+            float(st[2])
         print(f"profile trace written to {trace_dir}", file=sys.stderr)
 
     dt_step = _timed_chain(step_once, 2, reps + 2)
@@ -178,7 +210,9 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
     tokens_per_step = bs * seq
     # analytic train FLOPs/token: 6*N_params (fwd 2N + bwd 4N) + causal
     # attention 12*L*d*seq*0.5 (QK^T + AV fwd, x3 with bwd, halved by masking)
-    analytic_step_flops = tokens_per_step * (6.0 * n_params + 6.0 * n_layers * d_model * seq)
+    analytic_step_flops = tokens_per_step * (
+        6.0 * n_params + 6.0 * s["n_layers"] * s["d_model"] * seq
+    )
     if xla_flops is not None and not (0.3 <= xla_flops / analytic_step_flops <= 3.0):
         print(
             f"warning: XLA cost_analysis flops {xla_flops:.3e} disagrees with "
@@ -196,8 +230,7 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
         "step_flops": analytic_step_flops,
         "n_params": n_params,
         "device": getattr(dev, "device_kind", str(dev)),
-        "shape": dict(d_model=d_model, n_layers=n_layers, vocab=vocab, seq=seq, bs=bs),
-        "cfg_params": (cfg, params),  # reused by the decode bench (not printed)
+        "shape": dict(s),
     }
 
 
@@ -214,7 +247,7 @@ def _bench_llm_torch_cpu(shape, budget_s: float = 150.0) -> float | None:
     d, L, vocab, seq = shape["d_model"], shape["n_layers"], shape["vocab"], shape["seq"]
     bs = 1
 
-    ff = 2752
+    ff = shape["d_ff"]
     norm_cls = getattr(nn, "RMSNorm", nn.LayerNorm)
 
     class SwiGLU(nn.Module):
@@ -289,7 +322,7 @@ def _bench_llm_torch_cpu(shape, budget_s: float = 150.0) -> float | None:
         return None
 
 
-def _bench_llm_decode_tpu(params_holder, reps: int = 4):
+def _bench_llm_decode_tpu(reps: int = 4):
     """Autoregressive decode throughput (serving path): tokens/sec of the
     KV-cache scan on the same llama model the train bench builds. Each rep
     uses a distinct prompt so the platform cannot dedupe executions."""
@@ -298,7 +331,7 @@ def _bench_llm_decode_tpu(params_holder, reps: int = 4):
 
     from fedml_tpu.train.llm.generation import generate
 
-    cfg, params = params_holder
+    _, cfg, params = _build_llm("pallas", remat=False)
     bs, P, new = 4, 64, 128
     rng = np.random.default_rng(1)
     prompts = [
@@ -320,6 +353,13 @@ def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: i
     deployment topology (gateway retry/eviction + HTTP + per-replica
     KV-cache decode), unlike the in-process decode bench.
 
+    Round 4: the replicas serve the FLAGSHIP 268M llama proxy (VERDICT r3
+    missing #4 — the old bench served a ~30M toy), each replica pinned to a
+    fixed HBM fraction via XLA_PYTHON_CLIENT_MEM_FRACTION so two replicas
+    coexist deterministically. If the full replica count can never become
+    ready inside the budget, the bench degrades to however many replicas ARE
+    ready (>=1) and reports the actual count, rather than dying.
+
     The gateway round-robins whole requests to replicas (reference
     device_model_inference.py does the same); each replica additionally
     runs server-side DYNAMIC BATCHING (10ms window, max 4 — the
@@ -338,37 +378,59 @@ def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: i
     # env mutation only after all validation: a raise must not leak batching
     # settings into the process
     saved_env = {k: os.environ.get(k) for k in
-                 ("FEDML_SERVE_MAX_BATCH", "FEDML_SERVE_BATCH_WINDOW_MS")}
+                 ("FEDML_SERVE_MAX_BATCH", "FEDML_SERVE_BATCH_WINDOW_MS",
+                  "FEDML_REPLICA_MEM_FRACTION", "FEDML_BENCH_FLAGSHIP")}
     os.environ["FEDML_SERVE_MAX_BATCH"] = "4"  # inherited by replica children
     os.environ["FEDML_SERVE_BATCH_WINDOW_MS"] = "10"
+    tiny = os.environ.get("FEDML_BENCH_TINY") == "1"
+    if not tiny:
+        os.environ["FEDML_BENCH_FLAGSHIP"] = "1"  # 268M predictor geometry
+        # ~0.5GB bf16 params + KV caches per replica; 0.35 of a 16G v5e each
+        # leaves headroom for compile scratch while keeping 2 replicas co-resident
+        os.environ.setdefault("FEDML_REPLICA_MEM_FRACTION", "0.35")
 
     # matches bench_predictors' default_max_new_tokens (tiny mode is the
     # CPU test harness for this path)
-    new_tokens = 16 if os.environ.get("FEDML_BENCH_TINY") == "1" else 64
+    new_tokens = 16 if tiny else 64
+    # round 4: startup budget capped (VERDICT r3 weak #2 — 2x900s startup ate
+    # most of a capture window); flagship compile lands well under this. The
+    # orchestrator's serving stage budget must stay above the serial sum of
+    # these (see _STAGES).
+    startup_budget_s = 60.0 if tiny else 300.0
+    predict_timeout_s = 60.0 if tiny else 240.0
     rs = None
     try:
         rs = ReplicaSet(
             "fedml_tpu.serving.bench_predictors:llm_bench_predictor",
-            desired=n_replicas, startup_timeout_s=900.0,
+            desired=n_replicas, startup_timeout_s=startup_budget_s,
         )
-        deadline = time.time() + 900.0
+        deadline = time.time() + startup_budget_s
         while time.time() < deadline:
-            rs.reconcile()  # replace replicas that died during startup
             if len([r for r in rs.healthy() if r.ready()]) >= n_replicas:
                 break
             time.sleep(1.0)
-        else:
-            raise RuntimeError("serving bench: replicas never became ready")
+            rs.reconcile()  # replace replicas that died during startup
+        ready = [r for r in rs.healthy() if r.ready()]
+        if not ready:
+            raise RuntimeError("serving bench: no replica became ready in budget")
+        n_ready = len(ready)
+        if n_ready < n_replicas:
+            print(f"warning: only {n_ready}/{n_replicas} replicas ready; "
+                  "measuring with what we have", file=sys.stderr)
+            # degrade to the replicas that ARE ready — prune BY READINESS
+            # (scale_to would pop the newest replica, ready or not)
+            rs.retain(ready)
         gw = InferenceGateway(rs)
         # warm EVERY replica with the measured prompt SHAPE: generate()
         # compiles per prompt token-length, so the warm prompts must
         # tokenize to the same length as the measured ones ('measure
         # endpoint run {c} req {r}') or the timed window absorbs a fresh
         # prefill compile on each replica; round-robin spreads these
-        for w in range(n_replicas):
+        for w in range(n_ready):
             # single-digit fields keep the token length identical to the
             # measured prompts; 'req 9' never occurs in the measured set
-            gw.predict({"prompt": f"measure endpoint run {w % 10} req 9"}, timeout_s=600.0)
+            gw.predict({"prompt": f"measure endpoint run {w % 10} req 9"},
+                       timeout_s=predict_timeout_s)
 
         results: list = []
         errors: list = []
@@ -377,7 +439,7 @@ def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: i
             try:
                 for r in range(reqs_per_client):
                     out = gw.predict({"prompt": f"measure endpoint run {cid} req {r}"},
-                                     timeout_s=600.0)
+                                     timeout_s=predict_timeout_s)
                     results.append(out)
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
@@ -394,8 +456,9 @@ def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: i
         total_new = new_tokens * len(results)
         return {
             "endpoint_decode_tokens_per_sec": total_new / dt,
-            "endpoint_replicas": n_replicas,
+            "endpoint_replicas": n_ready,
             "endpoint_requests": len(results),
+            "endpoint_model": "tiny" if tiny else "llama-268M flagship proxy (bf16)",
             "endpoint_batching": "dynamic (per-replica micro-batch, window 10ms, max 4)",
         }
     finally:
@@ -543,10 +606,8 @@ def _probe_backend(timeout_s: int = 180) -> None:
     """Fail fast if the remote TPU tunnel is stalled: jax.devices() on the
     axon backend blocks forever in native code when the tunnel is down
     (uninterruptible by SIGALRM), which would eat the driver's whole bench
-    timeout with no diagnostic. Probe in a killable subprocess BEFORE this
-    process imports jax."""
-    import subprocess
-
+    timeout with no diagnostic. Probe in a killable subprocess BEFORE any
+    stage subprocess is spawned."""
     try:
         proc = subprocess.run(
             [sys.executable, "-c", "import jax; d=jax.devices()[0]; print(getattr(d,'device_kind',d))"],
@@ -562,31 +623,10 @@ def _probe_backend(timeout_s: int = 180) -> None:
     print(f"benching on {proc.stdout.strip().splitlines()[-1]}", file=sys.stderr)
 
 
-def _retry_once(fn, *args, **kw):
-    """The remote tunnel occasionally drops a single request mid-compile
-    ('response body closed'); one retry rides out a transient flake.
-    Integrity-guard failures (BenchIntegrityError) stay fatal — a broken
-    measurement must not get a second roll of the dice — and the retry runs
-    OUTSIDE the except block so the failed attempt's traceback (which pins
-    its device buffers) is released first."""
-    try:
-        return fn(*args, **kw)
-    except (BenchIntegrityError, BenchProbeTimeout):
-        # integrity failures must not get a second roll of the dice; a
-        # 3-minute probe timeout means the tunnel is down, not flaky
-        # (transient socket timeouts inside a bench fn ARE retried)
-        raise
-    except Exception as e:
-        print(f"warning: {fn.__name__} failed ({e}); retrying once", file=sys.stderr)
-    # retry OUTSIDE the except block: the failed attempt's traceback (which
-    # pins its device buffers) is released before the second run
-    return fn(*args, **kw)
-
-
 def _last_measured() -> dict | None:
     """Newest committed BENCH_MEASURED_*.json artifact, or None. These are
-    written by every successful run (see main) precisely so a tunnel stall at
-    capture time still leaves an auditable, timestamped number in git."""
+    written after every successful stage (see main) precisely so a tunnel
+    stall mid-run still leaves an auditable, timestamped number in git."""
     paths = sorted(glob.glob(os.path.join(_REPO, "BENCH_MEASURED_*.json")))
     if not paths:
         return None
@@ -597,11 +637,11 @@ def _last_measured() -> dict | None:
         return None
 
 
-def _write_measured_artifact(out: dict) -> str:
-    """Persist a successful measurement as BENCH_MEASURED_<utc>.json with
-    provenance (timestamp + git HEAD), so perf evidence survives later
-    tunnel stalls (VERDICT r2 weak #1)."""
-    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+def _write_measured_artifact(out: dict, stamp: str) -> str:
+    """Persist the measurement-so-far as BENCH_MEASURED_<utc>.json with
+    provenance (timestamp + git HEAD). Called after EVERY successful stage
+    (same stamp → same file, progressively refined), so perf evidence
+    survives a later stage's death (VERDICT r3 weak #1/#2)."""
     try:
         head = subprocess.run(
             ["git", "-C", _REPO, "rev-parse", "--short", "HEAD"],
@@ -616,9 +656,186 @@ def _write_measured_artifact(out: dict) -> str:
     return path
 
 
-def main() -> None:
+# --- stage runners (each runs in its own subprocess) -------------------------
+
+def _round_floats(d: dict, nd: int = 4) -> dict:
+    return {k: (round(v, nd) if isinstance(v, float) else v) for k, v in d.items()}
+
+
+def _retry_transient(fn, *args, **kw):
+    """The remote tunnel occasionally drops a single request mid-compile
+    ('response body closed'); one SAME-CONFIG retry rides out the flake so
+    it is never misread as OOM (which would silently degrade the headline
+    to remat). Integrity-guard failures stay fatal — a broken measurement
+    must not get a second roll of the dice — and genuine OOM raises again
+    identically on the retry, landing in the caller's fallback. The retry
+    runs OUTSIDE the except block so the failed attempt's traceback (which
+    pins its device buffers) is released first."""
     try:
-        _retry_once(_probe_backend)
+        return fn(*args, **kw)
+    except BenchIntegrityError:
+        raise
+    except Exception as e:
+        print(f"warning: {getattr(fn, '__name__', fn)} failed ({e!r}); "
+              "retrying same config once", file=sys.stderr)
+    return fn(*args, **kw)
+
+
+def _run_stage(name: str) -> None:
+    """Entry point for `python bench.py --stage NAME`: run ONE measurement in
+    this process and print exactly one JSON line. The process exits afterward,
+    releasing every device buffer it held — the orchestrator's isolation
+    guarantee."""
+    if name == "llm_pallas":
+        # headline: Pallas flash attention, NO remat — with the [T,T]-free
+        # kernel the 268M proxy's activations fit HBM, and skipping recompute
+        # is pure throughput; a memory-limited chip falls back to remat
+        try:
+            out = _retry_transient(_bench_llm_tpu, remat=False)
+            out["remat"] = False
+        except BenchIntegrityError:
+            raise
+        except Exception as e:  # noqa: BLE001 - twice-reproduced: OOM-shaped
+            print(f"warning: no-remat LLM bench failed ({e!r}); retrying with remat",
+                  file=sys.stderr)
+            out = _bench_llm_tpu(remat=True)
+            out["remat"] = True
+    elif name == "llm_xla":
+        try:
+            out = _retry_transient(_bench_llm_tpu, reps=6, attention_impl="xla", remat=False)
+            out["remat"] = False
+        except BenchIntegrityError:
+            raise
+        except Exception as e:  # noqa: BLE001 - the einsum path keeps [T,T]
+            # score tensors for the backward, so no-remat can OOM where the
+            # flash run fit
+            print(f"warning: xla-attention bench failed ({e!r}); retrying with remat",
+                  file=sys.stderr)
+            out = _bench_llm_tpu(reps=6, attention_impl="xla", remat=True)
+            out["remat"] = True
+    elif name == "decode":
+        out = _retry_transient(_bench_llm_decode_tpu)
+    elif name == "resnet":
+        out = _retry_transient(_bench_resnet_tpu)
+    elif name == "cpu_llm":
+        out = {"cpu_llm_tokens_per_sec": _bench_llm_torch_cpu(_LLM_SHAPE)}
+    elif name == "cpu_resnet":
+        out = {"cpu_resnet_images_per_sec": _bench_resnet_torch_cpu()}
+    elif name == "serving":
+        out = _bench_llm_serving()
+    else:
+        raise SystemExit(f"unknown stage {name!r}")
+    print(json.dumps(_round_floats(out)))
+
+
+# (stage, per-stage wall budget seconds). Headline FIRST; serving LAST so its
+# replica children can never leave a chip half-full under a later stage.
+_STAGES: list[tuple[str, int]] = [
+    ("llm_pallas", 1500),
+    ("llm_xla", 1200),
+    ("decode", 900),
+    ("resnet", 900),
+    ("cpu_llm", 400),
+    ("cpu_resnet", 200),
+    # must exceed the stage's own internal worst case: 2x300s serial replica
+    # startup + 300s ready-wait + 2x240s warm + measured requests
+    ("serving", 1800),
+]
+
+
+_CURRENT_STAGE_PROC: subprocess.Popen | None = None
+
+
+def _kill_stage_group(proc: subprocess.Popen) -> None:
+    """SIGKILL the stage's whole process GROUP: a serving stage's replica
+    grandchildren hold HBM, and killing only the stage process would leave
+    them alive on the chip — exactly the r03 failure mode. Stages are
+    spawned with start_new_session=True, and their own children (replicas)
+    inherit that group, so one killpg reaps the whole tree."""
+    import signal
+
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        if proc.poll() is None:
+            proc.kill()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        pass
+
+
+def _handle_term(signum, frame):  # noqa: ARG001
+    """bench_watch's outer `timeout` (and the driver) signal only THIS
+    orchestrator; forward the death to the in-flight stage's process group
+    so no replica grandchild outlives the bench holding HBM."""
+    if _CURRENT_STAGE_PROC is not None:
+        _kill_stage_group(_CURRENT_STAGE_PROC)
+    sys.exit(128 + signum)
+
+
+def _spawn_stage(name: str, budget_s: int) -> tuple[dict | None, str | None]:
+    """Run one stage subprocess; returns (parsed_json, None) or
+    (None, "stage: failure summary"). Output goes through temp files, not
+    PIPE, so a timeout kill still leaves the partial stderr readable for
+    the failure record."""
+    global _CURRENT_STAGE_PROC
+    import tempfile
+
+    t0 = time.perf_counter()
+    with tempfile.TemporaryFile(mode="w+") as f_out, \
+         tempfile.TemporaryFile(mode="w+") as f_err:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--stage", name],
+            stdout=f_out, stderr=f_err, text=True, cwd=_REPO,
+            start_new_session=True,  # one killpg reaps replica grandchildren
+        )
+        _CURRENT_STAGE_PROC = proc
+        timed_out = False
+        try:
+            proc.wait(timeout=budget_s)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            _kill_stage_group(proc)
+        finally:
+            _CURRENT_STAGE_PROC = None
+        f_out.seek(0)
+        f_err.seek(0)
+        stdout, stderr = f_out.read(), f_err.read()
+    dt = time.perf_counter() - t0
+    for line in stderr.splitlines():
+        print(f"[{name}] {line}", file=sys.stderr)
+    if timed_out:
+        tail = stderr.strip().splitlines()
+        where = tail[-1][:200] if tail else "no output"
+        return None, f"{name}: timeout after {budget_s}s (last stderr: {where})"
+    if proc.returncode != 0:
+        # summarize the failure class (RESOURCE_EXHAUSTED etc.) from the tail
+        tail = (stderr or stdout).strip().splitlines()
+        summary = next(
+            (ln.strip() for ln in reversed(tail)
+             if any(t in ln for t in ("Error", "RESOURCE_EXHAUSTED", "Exception", "error:"))),
+            tail[-1] if tail else "no output",
+        )
+        return None, f"{name}: rc={proc.returncode} {summary[:300]}"
+    last = stdout.strip().splitlines()
+    if not last:
+        return None, f"{name}: rc=0 but no JSON line"
+    try:
+        parsed = json.loads(last[-1])
+    except json.JSONDecodeError:
+        return None, f"{name}: unparseable stage output {last[-1][:200]!r}"
+    print(f"[{name}] done in {dt:.0f}s", file=sys.stderr)
+    return parsed, None
+
+
+def main() -> None:
+    import signal
+
+    signal.signal(signal.SIGTERM, _handle_term)
+    signal.signal(signal.SIGINT, _handle_term)
+    try:
+        _probe_backend()
     except BenchProbeTimeout as e:
         # Structured skip record (VERDICT r2 weak #7): the driver/judge can
         # mechanically tell "tunnel down, code fine" from "bench crashed",
@@ -630,68 +847,69 @@ def main() -> None:
             "last_measured": _last_measured(),
         }))
         sys.exit(1)
-    # serving bench FIRST: its replicas are subprocesses that each open the
-    # backend themselves; running before this parent process touches jax
-    # means at worst the two replicas contend with each other — never with a
-    # parent that already holds the chip (child failure degrades gracefully)
-    try:
-        serving = _retry_once(_bench_llm_serving)
-    except Exception as e:  # noqa: BLE001 - endpoint bench is additive; a
-        # replica-spawn failure must not void the verified train numbers
-        print(f"warning: serving bench failed ({e!r}); reporting without it", file=sys.stderr)
-        serving = {"endpoint_decode_tokens_per_sec": None}
 
-    # headline: Pallas flash attention, NO remat — with the [T,T]-free
-    # kernel the 268M proxy's activations fit HBM, and skipping recompute
-    # is pure throughput; a memory-limited chip falls back to remat
-    try:
-        llm = _retry_once(_bench_llm_tpu, remat=False)
-        llm["remat"] = False
-    except (BenchIntegrityError, BenchProbeTimeout):
-        raise
-    except Exception as e:  # noqa: BLE001 - assume OOM-shaped failure
-        print(f"warning: no-remat LLM bench failed ({e!r}); retrying with remat", file=sys.stderr)
-        llm = _retry_once(_bench_llm_tpu, remat=True)
-        llm["remat"] = True
-    # same model, einsum attention: the before/after the kernel buys. The
-    # einsum path keeps [T,T] score tensors for the backward, so no-remat
-    # can OOM where the flash run fit — same fallback as the headline
-    try:
-        llm_xla = _retry_once(_bench_llm_tpu, reps=6, attention_impl="xla", remat=llm["remat"])
-    except (BenchIntegrityError, BenchProbeTimeout):
-        raise
-    except Exception as e:  # noqa: BLE001
-        print(f"warning: xla-attention bench failed ({e!r}); retrying with remat", file=sys.stderr)
-        llm_xla = _retry_once(_bench_llm_tpu, reps=6, attention_impl="xla", remat=True)
-    llm_xla.pop("cfg_params", None)
-    decode = _retry_once(_bench_llm_decode_tpu, llm.pop("cfg_params"))
-    resnet = _retry_once(_bench_resnet_tpu)
-    llm_cpu_tokens = _bench_llm_torch_cpu(llm["shape"])
-    resnet_cpu_images = _bench_resnet_torch_cpu()
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    stage_out: dict[str, dict] = {}
+    failed: list[str] = []
+    merged: dict = {"stages_failed": failed}
+    for stage_name, budget in _STAGES:
+        result, err = _spawn_stage(stage_name, budget)
+        if err is not None:
+            print(f"warning: {err}", file=sys.stderr)
+            failed.append(err)
+            continue
+        stage_out[stage_name] = result
+        merged.update({f"_{stage_name}": result})
+        _write_measured_artifact(merged, stamp)  # incremental: survives later deaths
 
-    resnet_images_per_sec = resnet["steps_per_sec"] * resnet["bs"]
-    out = {
-        "metric": "llm_train_tokens_per_sec",
-        "value": round(llm["tokens_per_sec"], 1),
-        "unit": f"tokens/s (llama-{llm['n_params'] / 1e6:.0f}M full train step, bf16, "
-                f"seq{llm['shape']['seq']} bs{llm['shape']['bs']}, 1x {llm['device']})",
-        "vs_baseline": round(llm["tokens_per_sec"] / llm_cpu_tokens, 2) if llm_cpu_tokens else None,
-        "mfu": round(llm["mfu"], 4),
-        "attention_impl": llm["attention_impl"],
-        "remat": llm["remat"],
-        "mfu_xla_attention": round(llm_xla["mfu"], 4),
-        "tokens_per_sec_xla_attention": round(llm_xla["tokens_per_sec"], 1),
-        "resnet56_steps_per_sec": round(resnet["steps_per_sec"], 2),
-        "resnet56_mfu": round(resnet["mfu"], 4),
-        "resnet56_vs_torch_cpu": (
-            round(resnet_images_per_sec / resnet_cpu_images, 2) if resnet_cpu_images else None
-        ),
-        "decode_tokens_per_sec": round(decode["decode_tokens_per_sec"], 1),
-        **{k: (round(v, 1) if isinstance(v, float) else v) for k, v in serving.items()},
-    }
-    _write_measured_artifact(out)
+    llm = stage_out.get("llm_pallas")
+    llm_xla = stage_out.get("llm_xla")
+    decode = stage_out.get("decode")
+    resnet = stage_out.get("resnet")
+    serving = stage_out.get("serving") or {"endpoint_decode_tokens_per_sec": None}
+    cpu_llm = (stage_out.get("cpu_llm") or {}).get("cpu_llm_tokens_per_sec")
+    cpu_resnet = (stage_out.get("cpu_resnet") or {}).get("cpu_resnet_images_per_sec")
+
+    out: dict = {"metric": "llm_train_tokens_per_sec", "stages_failed": failed}
+    if llm is not None:
+        out.update({
+            "value": round(llm["tokens_per_sec"], 1),
+            "unit": f"tokens/s (llama-{llm['n_params'] / 1e6:.0f}M full train step, bf16, "
+                    f"seq{llm['shape']['seq']} bs{llm['shape']['bs']}, 1x {llm['device']})",
+            "vs_baseline": round(llm["tokens_per_sec"] / cpu_llm, 2) if cpu_llm else None,
+            "mfu": round(llm["mfu"], 4),
+            "attention_impl": llm["attention_impl"],
+            "remat": llm["remat"],
+        })
+    else:
+        out.update({"value": None, "unit": "tokens/s", "vs_baseline": None, "mfu": None})
+    if llm_xla is not None:
+        out["mfu_xla_attention"] = round(llm_xla["mfu"], 4)
+        out["tokens_per_sec_xla_attention"] = round(llm_xla["tokens_per_sec"], 1)
+    if resnet is not None:
+        out["resnet56_steps_per_sec"] = round(resnet["steps_per_sec"], 2)
+        out["resnet56_mfu"] = round(resnet["mfu"], 4)
+        if cpu_resnet:
+            out["resnet56_vs_torch_cpu"] = round(
+                resnet["steps_per_sec"] * resnet["bs"] / cpu_resnet, 2)
+    if decode is not None:
+        out["decode_tokens_per_sec"] = round(decode["decode_tokens_per_sec"], 1)
+    out.update({k: (round(v, 1) if isinstance(v, float) else v)
+                for k, v in serving.items()})
+
+    if stage_out:
+        _write_measured_artifact(dict(out, _stages=merged), stamp)
     print(json.dumps(out))
+    # rc contract: 0 whenever the HEADLINE number exists — secondary-stage
+    # failures are recorded in stages_failed, not fatal (VERDICT r3 item 1)
+    sys.exit(0 if llm is not None else 1)
 
 
 if __name__ == "__main__":
-    main()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--stage", help="run one measurement stage and print its JSON")
+    ns = parser.parse_args()
+    if ns.stage:
+        _run_stage(ns.stage)
+    else:
+        main()

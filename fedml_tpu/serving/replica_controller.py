@@ -41,6 +41,18 @@ class SubprocessReplica:
         env = dict(os.environ)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        # best-effort allocator cap for backends that honor it (the
+        # XLA_PYTHON_CLIENT_* knobs configure the GPU/CPU PJRT BFC
+        # allocator; TPU runtimes allocate on demand and ignore them).
+        # The HARD guarantee against r03-style HBM exhaustion is
+        # structural, not this env var: the bench runs serving LAST in its
+        # own process group, so replica memory can never sit under a later
+        # measurement, and a stage timeout killpg-reaps the whole tree
+        # (bench.py _spawn_stage).
+        mem_frac = os.environ.get("FEDML_REPLICA_MEM_FRACTION")
+        if mem_frac:
+            env["XLA_PYTHON_CLIENT_MEM_FRACTION"] = mem_frac
+            env.setdefault("XLA_PYTHON_CLIENT_PREALLOCATE", "false")
         cmd = [sys.executable, "-m", "fedml_tpu.serving.replica_main",
                "--predictor", predictor_spec, "--port-file", self._port_file]
         if model_path:
@@ -116,6 +128,22 @@ class ReplicaSet:
         with self._lock:
             self.desired = int(n)
             self.reconcile()
+
+    def retain(self, keep: List["SubprocessReplica"]) -> None:
+        """Shrink to exactly `keep`, stopping every other replica.
+
+        scale_to() trims BY LIST POSITION (newest first), which is wrong
+        when the caller has readiness information — degrading a bench to
+        "the replicas that are ready" must not stop a ready replica while
+        keeping one that is still compiling."""
+        with self._lock:
+            keep_ids = {r.id for r in keep}
+            for r in self.replicas:
+                if r.id not in keep_ids:
+                    r.stop()
+                    log.info("replica set: retained-out %s", r.id)
+            self.replicas = [r for r in self.replicas if r.id in keep_ids]
+            self.desired = len(self.replicas)
 
     def reconcile(self) -> None:
         """Converge actual replicas to the desired count, replacing dead ones."""
