@@ -261,8 +261,8 @@ def encode_ref_message(msg: Message, sender_id: int) -> bytes:
     ref = RefMessage.__new__(RefMessage)
     params = dict(msg.get_params())
     if Message.MSG_ARG_KEY_MODEL_PARAMS in params:
-        params[Message.MSG_ARG_KEY_MODEL_PARAMS] = _to_torch_tree(
-            params[Message.MSG_ARG_KEY_MODEL_PARAMS]
+        params[Message.MSG_ARG_KEY_MODEL_PARAMS] = _as_ref_state_dict(
+            _to_torch_tree(params[Message.MSG_ARG_KEY_MODEL_PARAMS])
         )
     ref.__dict__.update(
         type=str(msg.get_type()),
@@ -281,3 +281,32 @@ def decode_ref_message(data: bytes) -> Message:
     msg = Message()
     msg.init_from_json_object(params)
     return msg
+
+
+# --- raw payload-tree bridge (shared with the MQTT_S3 ref-wire store) --------
+
+def _as_ref_state_dict(obj: Any) -> Any:
+    """Top-level model params must be an OrderedDict, as torch state_dicts
+    are: the reference's FedMLAggregator.aggregate treats a PLAIN dict as a
+    per-client-index personalized-model map and indexes it by client number
+    (``fedml_aggregator.py:90-97``) — a plain-dict state_dict KeyErrors
+    there. OrderedDict (what reference clients themselves upload) takes the
+    state-dict path."""
+    import collections
+
+    if type(obj) is dict:
+        return collections.OrderedDict(obj)
+    return obj
+
+
+def pickle_ref_tree(params: Any) -> bytes:
+    """Parameter pytree -> the reference's S3 payload format: ``pickle.dumps``
+    of a torch-tensor tree (``s3/remote_storage.py:75-113`` write_model —
+    reference clients unpickle this and feed load_state_dict)."""
+    return pickle.dumps(_as_ref_state_dict(_to_torch_tree(params)))
+
+
+def unpickle_ref_tree(data: bytes) -> Any:
+    """Reference S3 payload bytes -> numpy tree, through the SAME restricted
+    unpickler the gRPC bridge uses (arbitrary callables refused)."""
+    return _to_numpy_tree(_RefUnpickler(io.BytesIO(data)).load())

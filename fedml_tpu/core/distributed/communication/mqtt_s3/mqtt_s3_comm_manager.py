@@ -42,6 +42,12 @@ class MqttS3MultiClientsCommManager(BaseCommunicationManager):
         self.client_num = client_num
         self.server_id = server_id
         self.is_server = client_rank == server_id
+        # reference-wire mode (interop with the reference's own
+        # MqttS3MultiClientsCommManager): payload is a pickled torch-tree in
+        # a shared bucket addressed BY KEY, and the control JSON carries the
+        # key in model_params — exactly the reference's contract
+        # (mqtt_s3_multi_clients_comm_manager.py:248,283)
+        self.ref_wire = str(getattr(args, "mqtt_s3_wire", "native")) == "fedml"
         self.mqtt = create_mqtt_transport(args, client_id=f"{self.topic_prefix}_{self.rank}")
         # store must exist before _subscribe: the local broker flushes
         # backlogged messages synchronously on subscribe, and on_message
@@ -54,6 +60,13 @@ class MqttS3MultiClientsCommManager(BaseCommunicationManager):
 
     def _create_store(self, args):
         """Payload-store hook; web3/theta subclasses return a CAS store."""
+        if self.ref_wire:
+            from .ref_bucket import RefBucketStore
+
+            root = getattr(args, "mqtt_s3_bucket_dir", None)
+            if not root:
+                raise ValueError("mqtt_s3_wire='fedml' requires mqtt_s3_bucket_dir")
+            return RefBucketStore(root)
         return create_object_store(args)
 
     # --- topics (reference scheme) ---------------------------------------
@@ -71,9 +84,19 @@ class MqttS3MultiClientsCommManager(BaseCommunicationManager):
             obj = json.loads(payload.decode())
             msg = Message()
             msg.init_from_json_object(obj)
-            url = msg.get(Message.MSG_ARG_KEY_MODEL_PARAMS_URL)
-            if url:
-                msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, self.store.read_model(url))
+            if self.ref_wire:
+                # reference peers put the S3 KEY in model_params
+                # (mqtt_s3_multi_clients_comm_manager.py:_on_message_impl)
+                key = obj.get(Message.MSG_ARG_KEY_MODEL_PARAMS, "")
+                if isinstance(key, str) and key.strip():
+                    msg.add_params(
+                        Message.MSG_ARG_KEY_MODEL_PARAMS,
+                        self.store.read_model(key.strip()),
+                    )
+            else:
+                url = msg.get(Message.MSG_ARG_KEY_MODEL_PARAMS_URL)
+                if url:
+                    msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, self.store.read_model(url))
             self._incoming.put(msg)
 
         if self.is_server:
@@ -89,14 +112,32 @@ class MqttS3MultiClientsCommManager(BaseCommunicationManager):
     def send_message(self, msg: Message) -> None:
         receiver = msg.get_receiver_id()
         params = msg.get_params().get(Message.MSG_ARG_KEY_MODEL_PARAMS)
+        topic = (
+            self._topic_server_to_client(receiver) if self.is_server else self._topic_client_to_server(self.rank)
+        )
+        if self.ref_wire:
+            self._send_ref_wire(msg, topic, params)
+            return
         if params is not None:
             key = f"{self.topic_prefix}_{msg.get_sender_id()}_{receiver}_{msg.get_type()}"
             url = self.store.write_model(key, params)
             msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS_URL, url)
-        topic = (
-            self._topic_server_to_client(receiver) if self.is_server else self._topic_client_to_server(self.rank)
-        )
         self.mqtt.publish(topic, msg.to_json().encode())
+
+    def _send_ref_wire(self, msg: Message, topic: str, params) -> None:
+        """Reference contract: upload pickled payload under
+        ``<topic>_<uuid>``, publish JSON whose model_params IS that key
+        (mqtt_s3_multi_clients_comm_manager.py:248 server / :283 client)."""
+        import uuid as _uuid
+
+        payload = {k: v for k, v in msg.get_params().items()
+                   if k != Message.MSG_ARG_KEY_MODEL_PARAMS}
+        if params is not None:
+            key = f"{topic}_{_uuid.uuid4()}"
+            url = self.store.write_model(key, params)
+            payload[Message.MSG_ARG_KEY_MODEL_PARAMS] = key
+            payload[Message.MSG_ARG_KEY_MODEL_PARAMS_URL] = url
+        self.mqtt.publish(topic, json.dumps(payload).encode())
 
     # --- loop ------------------------------------------------------------
     def add_observer(self, observer: Observer) -> None:

@@ -1,0 +1,51 @@
+"""Reference-compatible S3 "bucket" on the local filesystem.
+
+The reference's default cross-silo transport is MQTT + S3: the control JSON
+carries the *object key*, and the payload is ``pickle.dumps`` of a torch
+state_dict uploaded under that key
+(``mqtt_s3/mqtt_s3_multi_clients_comm_manager.py:248`` send path,
+``s3/remote_storage.py:75-113`` write, ``:215`` read-by-key). This store
+reproduces that contract over a shared directory standing in for the
+bucket, so a reference peer whose boto3 points at the same directory reads
+our objects byte-for-byte (and vice versa):
+
+  * write: ``pickle.dumps(torch-tree)`` at ``<root>/<quoted key>``;
+  * read: BY KEY (the reference resolves ``model_params`` to a key string,
+    never the URL), through the gRPC bridge's restricted unpickler —
+    arbitrary callables in a peer's pickle are refused;
+  * URL: ``file://`` path, playing the presigned-URL role
+    (``generate_presigned_url`` in the reference) — carried in the JSON for
+    parity but not needed to read.
+
+Our native object store (object_store.py) stays pickle-free; this store
+exists only for ``mqtt_s3_wire='fedml'`` interop.
+"""
+
+from __future__ import annotations
+
+import os
+import urllib.parse
+from typing import Any
+
+from ..grpc.ref_wire import pickle_ref_tree, unpickle_ref_tree
+
+
+class RefBucketStore:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        # the reference's keys are "<topic>_<uuid>" (no slashes), but quote
+        # defensively so a hostile key cannot escape the bucket dir
+        return os.path.join(self.root, urllib.parse.quote(key, safe=""))
+
+    def write_model(self, key: str, params: Any) -> str:
+        path = self._path(key)
+        with open(path, "wb") as f:
+            f.write(pickle_ref_tree(params))
+        return f"file://{path}"
+
+    def read_model(self, key: str) -> Any:
+        with open(self._path(key), "rb") as f:
+            return unpickle_ref_tree(f.read())

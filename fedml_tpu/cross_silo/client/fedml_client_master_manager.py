@@ -33,6 +33,9 @@ class ClientMasterManager(FedMLCommManager):
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(MyMessage.MSG_TYPE_CONNECTION_IS_READY, self.handle_message_connection_ready)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.handle_message_check_status
+        )
         self.register_message_receive_handler(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init)
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.handle_message_receive_model_from_server
@@ -44,6 +47,13 @@ class ClientMasterManager(FedMLCommManager):
             self.has_sent_online_msg = True
             self.send_client_status(0, MyMessage.MSG_CLIENT_STATUS_ONLINE)
             mlops.log_training_status("INITIALIZING", str(getattr(self.args, "run_id", "0")))
+
+    def handle_message_check_status(self, msg_params: Message) -> None:
+        """A server probing liveness before init (reference server
+        fedml_server_manager.py:113-121 sends CHECK_CLIENT_STATUS to clients
+        that may have started earlier; reference client :97 answers with its
+        status). Answering keeps us interoperable with the reference server."""
+        self.send_client_status(0, MyMessage.MSG_CLIENT_STATUS_ONLINE)
 
     def handle_message_init(self, msg_params: Message) -> None:
         if self.is_inited:
@@ -63,8 +73,26 @@ class ClientMasterManager(FedMLCommManager):
         self.client_index = int(client_index)
         self.trainer_dist_adapter.update_dataset(int(client_index))
         self.trainer_dist_adapter.update_model(model_params)
-        self.args.round_idx += 1
-        self.__train()
+        if self.args.round_idx + 1 < self.num_rounds:
+            self.args.round_idx += 1
+            self.__train()
+        else:
+            # The CLIENT gates round completion in the reference protocol:
+            # its server always syncs the final aggregate back and waits for
+            # every client's FINISHED status before exiting
+            # (fedml_client_master_manager.py:143-152, server
+            # process_finished_status:147-165). Our own server instead sends
+            # S2C_FINISH after the last aggregation (handled above), so this
+            # branch only fires against a reference server — without it the
+            # pair would train forever.
+            self.args.round_idx += 1
+            if process_count() > 1:
+                # release the silo's slave processes (they block in
+                # await_sync_process_group for the next round's metadata)
+                broadcast_round_metadata({"finished": True})
+            self.send_client_status(0, MyMessage.MSG_CLIENT_STATUS_FINISHED)
+            mlops.log_training_status("FINISHED", str(getattr(self.args, "run_id", "0")))
+            self.finish()
 
     def handle_message_finish(self, msg_params: Message) -> None:
         log.info("====== training finished ======")

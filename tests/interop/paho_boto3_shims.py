@@ -1,0 +1,306 @@
+"""Functional paho-mqtt + boto3 shims for the MQTT_S3 interop test.
+
+The reference's default cross-silo backend is MQTT_S3: ``MqttManager``
+drives ``paho.mqtt.client.Client`` and ``S3Storage`` drives
+``boto3.client("s3")``. Neither library is installed here and there is no
+external broker or S3 (zero egress), so this module installs REAL —
+not hollow — substitutes:
+
+  * ``paho.mqtt.client.Client`` speaks our ``SocketMqttBroker`` JSON-lines
+    protocol (fedml_tpu/.../mqtt_s3/socket_broker.py), preserving paho's
+    async callback contract: ``connect()`` only dials; CONNACK
+    (``on_connect``) fires when the network loop starts, exactly when real
+    paho would deliver it — the reference's subscribe-on-connect and
+    connection-ready notification depend on that ordering.
+  * ``boto3.client("s3")`` maps Bucket/Key onto a shared local directory
+    (env ``INTEROP_BUCKET_DIR``), implementing just the surface
+    ``S3Storage`` uses: upload_fileobj / download_fileobj / head_object /
+    generate_presigned_url.
+
+Everything above these seams — MqttManager, S3Storage, the topic scheme,
+the pickle payload — is the reference's own unmodified code.
+
+Call ``install_functional_shims()`` BEFORE ``ref_stubs.install()`` (the
+sys.modules entries win over the hollow-stub meta-path finder).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import os
+import socket
+import sys
+import threading
+import types
+import urllib.parse
+import uuid
+
+
+# --- paho ---------------------------------------------------------------------
+
+class MQTTMessage:
+    def __init__(self, topic: str, payload: bytes, retain: bool = False):
+        self.topic = topic
+        self.payload = payload
+        self.retain = retain
+        self.qos = 2
+        self.mid = 0
+
+
+class _MQTTMessageInfo:
+    def __init__(self):
+        self.rc = 0
+        self.mid = 0
+
+    def is_published(self) -> bool:
+        return True
+
+    def wait_for_publish(self, timeout=None) -> None:
+        pass
+
+
+class Client:
+    """paho.mqtt.client.Client over the SocketMqttBroker line protocol."""
+
+    def __init__(self, client_id: str = "", clean_session: bool = True,
+                 userdata=None, protocol: int = 4, transport: str = "tcp"):
+        self._client_id = client_id
+        self._userdata = userdata
+        self._sock: socket.socket | None = None
+        self._wlock = threading.Lock()
+        self._will: tuple[str, bytes] | None = None
+        self._host = self._port = None
+        self._connected = False
+        self._stop = threading.Event()
+        self._mid = 0
+        self._connect_timeout = 15
+        # callback slots (MqttManager assigns these)
+        self.on_connect = None
+        self.on_message = None
+        self.on_publish = None
+        self.on_disconnect = None
+        self.on_subscribe = None
+        self.on_log = None
+
+    # config surface MqttManager touches
+    def username_pw_set(self, username, password=None):
+        pass
+
+    def disable_logger(self):
+        pass
+
+    def will_set(self, topic, payload=None, qos=0, retain=False):
+        data = payload.encode() if isinstance(payload, str) else (payload or b"")
+        self._will = (topic, data)
+
+    # wire
+    def _send(self, doc: dict) -> None:
+        if self._sock is None:
+            raise ConnectionError("not connected")
+        with self._wlock:
+            self._sock.sendall((json.dumps(doc) + "\n").encode())
+
+    def connect(self, host, port=1883, keepalive=60):
+        self._host, self._port = host, int(port)
+        self._sock = socket.create_connection((host, int(port)), timeout=self._connect_timeout)
+        self._sock.settimeout(None)
+        if self._will is not None:
+            topic, payload = self._will
+            self._send({"op": "will", "topic": topic,
+                        "payload": base64.b64encode(payload).decode()})
+        self._connected = True
+        return 0
+
+    def reconnect(self):
+        return self.connect(self._host, self._port)
+
+    def is_connected(self) -> bool:
+        return self._connected
+
+    def subscribe(self, topic, qos=0):
+        self._mid += 1
+        self._send({"op": "sub", "topic": topic})
+        if callable(self.on_subscribe):
+            self.on_subscribe(self, self._userdata, self._mid, (qos,))
+        return (0, self._mid)
+
+    def unsubscribe(self, topic):
+        self._mid += 1
+        self._send({"op": "unsub", "topic": topic})
+        return (0, self._mid)
+
+    def publish(self, topic, payload=None, qos=0, retain=False):
+        data = payload.encode() if isinstance(payload, str) else (payload or b"")
+        self._send({"op": "pub", "topic": topic,
+                    "payload": base64.b64encode(data).decode()})
+        info = _MQTTMessageInfo()
+        self._mid += 1
+        info.mid = self._mid
+        if callable(self.on_publish):
+            self.on_publish(self, self._userdata, info.mid)
+        return info
+
+    # network loops — CONNACK is delivered here, not in connect(): the
+    # reference registers observers AFTER construction, and real paho's
+    # on_connect also only fires once a loop processes the ack
+    def _deliver_connack(self):
+        if callable(self.on_connect):
+            self.on_connect(self, self._userdata, {}, 0)
+
+    def _read_loop(self):
+        assert self._sock is not None
+        f = self._sock.makefile("rb")
+        try:
+            for line in f:
+                if self._stop.is_set():
+                    break
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if doc.get("op") != "msg":
+                    continue
+                msg = MQTTMessage(doc["topic"], base64.b64decode(doc.get("payload", "")))
+                if callable(self.on_message):
+                    self.on_message(self, self._userdata, msg)
+        except (OSError, ValueError):
+            pass
+        finally:
+            self._connected = False
+            if callable(self.on_disconnect) and not self._stop.is_set():
+                self.on_disconnect(self, self._userdata, 0)
+
+    def loop_forever(self, timeout=1.0, retry_first_connection=False):
+        self._deliver_connack()
+        self._read_loop()
+
+    def loop_start(self):
+        self._deliver_connack()
+        threading.Thread(target=self._read_loop, daemon=True).start()
+
+    def loop_stop(self):
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def disconnect(self):
+        self._connected = False
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+                self._sock.close()
+            except OSError:
+                pass
+
+
+def base62(num: int, base: str = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz",
+           padding: int = 1) -> str:
+    out = ""
+    while num:
+        num, rem = divmod(num, len(base))
+        out = base[rem] + out
+    return base[0] * max(0, padding - len(out)) + out
+
+
+def _single(topic, payload=None, qos=0, retain=False, hostname="localhost",
+            port=1883, client_id="", keepalive=60, auth=None, **kw):
+    c = Client(client_id=client_id)
+    c.connect(hostname, port, keepalive)
+    c.publish(topic, payload, qos=qos, retain=retain)
+    c.disconnect()
+
+
+# --- boto3 --------------------------------------------------------------------
+
+class _S3DirClient:
+    """The S3Storage surface over a shared local directory."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, urllib.parse.quote(str(key), safe=""))
+
+    def upload_fileobj(self, Fileobj=None, Bucket=None, Key=None, Callback=None, **kw):
+        data = Fileobj.read()
+        with open(self._path(Key), "wb") as f:
+            f.write(data)
+        if Callback:
+            Callback(len(data))
+
+    def download_fileobj(self, Bucket=None, Key=None, Fileobj=None, Callback=None, **kw):
+        with open(self._path(Key), "rb") as f:
+            data = f.read()
+        Fileobj.write(data)
+        if Callback:
+            Callback(len(data))
+
+    def head_object(self, Bucket=None, Key=None, **kw):
+        return {"ContentLength": os.path.getsize(self._path(Key))}
+
+    def put_object(self, Bucket=None, Key=None, Body=b"", **kw):
+        with open(self._path(Key), "wb") as f:
+            f.write(Body if isinstance(Body, bytes) else Body.read())
+
+    def get_object(self, Bucket=None, Key=None, **kw):
+        return {"Body": io.BytesIO(open(self._path(Key), "rb").read())}
+
+    def generate_presigned_url(self, op, ExpiresIn=0, Params=None, **kw):
+        return "file://" + self._path((Params or {}).get("Key", ""))
+
+    def delete_object(self, Bucket=None, Key=None, **kw):
+        try:
+            os.remove(self._path(Key))
+        except OSError:
+            pass
+
+
+class _S3Resource:
+    def __init__(self, root):
+        self._root = root
+
+    def Bucket(self, name):
+        class _B:
+            creation_date = "1970-01-01"
+        return _B()
+
+    def create_bucket(self, Bucket=None, **kw):
+        pass
+
+
+def install_functional_shims() -> None:
+    """Register paho.* and boto3 into sys.modules (wins over ref_stubs'
+    hollow-stub meta-path finder, which only serves missing roots)."""
+    bucket_dir = os.environ.get("INTEROP_BUCKET_DIR",
+                                os.path.join("/tmp", f"interop_bucket_{uuid.uuid4().hex[:6]}"))
+
+    paho = types.ModuleType("paho")
+    paho.__path__ = []
+    mqtt_pkg = types.ModuleType("paho.mqtt")
+    mqtt_pkg.__path__ = []
+    client_mod = types.ModuleType("paho.mqtt.client")
+    client_mod.Client = Client
+    client_mod.MQTTMessage = MQTTMessage
+    client_mod.base62 = base62
+    client_mod.MQTT_ERR_SUCCESS = 0
+    publish_mod = types.ModuleType("paho.mqtt.publish")
+    publish_mod.single = _single
+    paho.mqtt = mqtt_pkg
+    mqtt_pkg.client = client_mod
+    mqtt_pkg.publish = publish_mod
+    sys.modules["paho"] = paho
+    sys.modules["paho.mqtt"] = mqtt_pkg
+    sys.modules["paho.mqtt.client"] = client_mod
+    sys.modules["paho.mqtt.publish"] = publish_mod
+
+    boto3 = types.ModuleType("boto3")
+    boto3.client = lambda service, **kw: _S3DirClient(bucket_dir)
+    boto3.resource = lambda service, **kw: _S3Resource(bucket_dir)
+    sys.modules["boto3"] = boto3
