@@ -86,8 +86,15 @@ def load(args: Any) -> FedDataset:
     if fmt:
         # real reference-format files present (LEAF json / TFF h5): use them
         # with the file's own client partition
-        fed = load_native_format(dataset, cache, client_num)
+        fed = load_native_format(
+            dataset, cache, client_num,
+            partition_method=getattr(args, "fednlp_partition_method", None),
+        )
         args.output_dim = fed[-1]
+        # real files may carry a smaller feature space than the dataset's
+        # canonical preset (e.g. a truncated word_count sidecar); record the
+        # ACTUAL shape so model_hub builds a matching input layer
+        args.input_shape = (1,) + tuple(np.asarray(fed[2].x).shape[1:])
         return fed
 
     if dataset in TEXT_CLS_DATASETS:

@@ -36,6 +36,24 @@ DATASET_URLS: Dict[str, List[str]] = {
     "femnist": ["https://fedml.s3-us-west-1.amazonaws.com/fed_emnist.tar.bz2"],
     "fed_shakespeare": ["https://fedml.s3-us-west-1.amazonaws.com/shakespeare.tar.bz2"],
     "stackoverflow_nwp": ["https://fedml.s3-us-west-1.amazonaws.com/stackoverflow.tar.bz2"],
+    # tag-prediction variant reads the same TFF archive plus the word/tag
+    # count sidecars (reference stackoverflow_lr/utils.py:7-8; sidecars are
+    # published by TFF alongside the dataset)
+    "stackoverflow_lr": [
+        "https://fedml.s3-us-west-1.amazonaws.com/stackoverflow.tar.bz2",
+        "https://storage.googleapis.com/tff-datasets-public/stackoverflow.word_count.tar.bz2",
+        "https://storage.googleapis.com/tff-datasets-public/stackoverflow.tag_count.tar.bz2",
+    ],
+    # CIFAR python batches — the reference fetches the canonical Krizhevsky
+    # archives (data/cifar10/download_cifar10.sh, data_loader.py:79)
+    "cifar10": ["https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"],
+    "cifar100": ["https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz"],
+    # FedNLP h5 pair (reference fednlp data_manager consumes
+    # <name>_data.h5 + <name>_partition.h5; FedNLP's published S3 bucket)
+    "20news": [
+        "https://fednlp.s3-us-west-1.amazonaws.com/data_files/20news_data.h5",
+        "https://fednlp.s3-us-west-1.amazonaws.com/partition_files/20news_partition.h5",
+    ],
 }
 
 
@@ -79,8 +97,25 @@ def maybe_download(dataset: str, cache_dir: str, allow_download: bool = False) -
         return False
     fetched = False
     for url in urls:
-        fname = os.path.join(dest, os.path.basename(urllib.parse.urlparse(url).path))
+        base = os.path.basename(urllib.parse.urlparse(url).path)
+        fname = os.path.join(dest, base)
         if os.path.exists(fname):
+            continue
+        # another dataset may share the same archive (stackoverflow_nwp and
+        # stackoverflow_lr both read stackoverflow.tar.bz2): reuse its copy
+        # instead of re-fetching gigabytes
+        sibling = _sibling_archive(cache_dir, dataset, base)
+        if sibling:
+            log.info("reusing %s from %s", base, sibling)
+            try:
+                os.link(sibling, fname + ".part")
+            except OSError:
+                import shutil as _shutil
+
+                _shutil.copyfile(sibling, fname + ".part")
+            _extract(fname + ".part", dest, name_hint=fname)
+            os.replace(fname + ".part", fname)
+            fetched = True
             continue
         log.info("downloading %s -> %s", url, fname)
         tmp = fname + ".part"
@@ -109,6 +144,22 @@ def maybe_download(dataset: str, cache_dir: str, allow_download: bool = False) -
     if fetched:
         _flatten_single_dir(dest)
     return fetched
+
+
+def _sibling_archive(cache_dir: str, dataset: str, basename: str) -> "str | None":
+    """A fully-downloaded copy of `basename` under another dataset's dir
+    (final name on disk means downloaded AND extracted — see maybe_download)."""
+    try:
+        entries = os.listdir(cache_dir)
+    except OSError:
+        return None
+    for entry in entries:
+        if entry == dataset:
+            continue
+        cand = os.path.join(cache_dir, entry, basename)
+        if os.path.isfile(cand):
+            return cand
+    return None
 
 
 def _flatten_single_dir(dest: str) -> None:

@@ -263,6 +263,15 @@ def detect_format_files(dataset: str, cache: str) -> Optional[str]:
         "fed_shakespeare": lambda: os.path.exists(os.path.join(d, "shakespeare_train.h5")),
         "fed_cifar100": lambda: os.path.exists(os.path.join(d, "fed_cifar100_train.h5")),
         "stackoverflow_nwp": lambda: os.path.exists(os.path.join(d, "stackoverflow_train.h5")),
+        "stackoverflow_lr": lambda: all(
+            os.path.exists(os.path.join(d, f))
+            for f in ("stackoverflow_train.h5", "stackoverflow.word_count", "stackoverflow.tag_count")
+        ),
+        **{
+            name: (lambda d=d, name=name: os.path.exists(os.path.join(d, f"{name}_data.h5"))
+                   and os.path.exists(os.path.join(d, f"{name}_partition.h5")))
+            for name in ("20news", "agnews", "sst2", "semeval_2010_task8")
+        },
     }
     fn = checks.get(dataset)
     try:
@@ -271,7 +280,8 @@ def detect_format_files(dataset: str, cache: str) -> Optional[str]:
         return None
 
 
-def load_native_format(dataset: str, cache: str, client_num: Optional[int] = None):
+def load_native_format(dataset: str, cache: str, client_num: Optional[int] = None,
+                       partition_method: Optional[str] = None):
     """Load `dataset` from its reference-format files under ``{cache}/{dataset}``."""
     d = os.path.join(cache, dataset)
     if dataset in ("femnist", "mnist"):
@@ -283,7 +293,174 @@ def load_native_format(dataset: str, cache: str, client_num: Optional[int] = Non
         train, test, classes = load_tff_cifar100(d)
     elif dataset == "stackoverflow_nwp":
         train, test, classes = load_stackoverflow_nwp(d)
+    elif dataset == "stackoverflow_lr":
+        train, test, classes = load_stackoverflow_lr(d)
+    elif dataset in ("20news", "agnews", "sst2", "semeval_2010_task8"):
+        train, test, classes = load_fednlp_text_clf(d, dataset, partition_method=partition_method)
     else:
         raise ValueError(f"no native-format loader for {dataset!r}")
     log.info("dataset %s: loaded NATIVE format files from %s (%d clients)", dataset, d, len(train))
     return clients_to_fed_dataset(train, test, classes, client_num)
+
+
+# --- TFF stackoverflow tag-prediction (stackoverflow_lr) ---------------------
+
+SO_LR_VOCAB = 10000
+SO_LR_TAGS = 500
+
+
+def _read_word_count(path: str, vocab_size: int) -> "OrderedDict[str, int]":
+    """``stackoverflow.word_count``: one "word count" line per word, already
+    frequency-sorted (reference stackoverflow_lr/utils.py:35-39 takes the
+    first `vocab_size` lines)."""
+    out: "OrderedDict[str, int]" = OrderedDict()
+    with open(path) as f:
+        for i, line in enumerate(f):
+            if i >= vocab_size:
+                break
+            out[line.split()[0]] = i
+    return out
+
+
+def _read_tag_count(path: str, tag_size: int) -> "OrderedDict[str, int]":
+    """``stackoverflow.tag_count``: a JSON dict whose first `tag_size` keys
+    are the kept tags (reference utils.py:42-45)."""
+    with open(path) as f:
+        tags = json.load(f)
+    return OrderedDict((t, i) for i, t in enumerate(list(tags)[:tag_size]))
+
+
+def load_stackoverflow_lr(
+    data_dir: str, vocab_size: int = SO_LR_VOCAB, tag_size: int = SO_LR_TAGS,
+    max_clients: int = 1000,
+) -> Tuple[ClientData, ClientData, int]:
+    """StackOverflow tag prediction from the reference's own on-disk trio:
+    ``stackoverflow_{train,test}.h5`` (TFF layout:
+    ``examples/<client>/{tokens,tags}``) + ``stackoverflow.word_count`` +
+    ``stackoverflow.tag_count``.
+
+    Feature/label math matches ``data/stackoverflow_lr/utils.py`` exactly:
+    input = mean of per-token one-hots over (vocab+1) with OOV in the
+    denominator, sliced to [:vocab]; target = SUM of tag one-hots sliced to
+    [:tag_size] (multi-hot float). Reference dataset/model:
+    ``data_loader.py:23`` + LogisticRegression(10000, 500)."""
+    import h5py
+
+    words = _read_word_count(os.path.join(data_dir, "stackoverflow.word_count"), vocab_size)
+    tags = _read_tag_count(os.path.join(data_dir, "stackoverflow.tag_count"), tag_size)
+    # sidecar files shorter than the requested caps shrink the feature/label
+    # spaces (the reference indexes through the same dicts, utils.py:49-66)
+    vocab_size = len(words)
+    tag_size = len(tags)
+
+    def encode_client(g) -> Tuple[np.ndarray, np.ndarray]:
+        sent_rows, tag_rows = [], []
+        raw_tokens = [t.decode("utf-8") for t in g["tokens"][()]]
+        raw_tags = [t.decode("utf-8") for t in g["tags"][()]]
+        for sentence, tagstr in zip(raw_tokens, raw_tags):
+            toks = sentence.split(" ")
+            ids = np.fromiter((words.get(t, vocab_size) for t in toks), np.int64, len(toks))
+            counts = np.bincount(ids, minlength=vocab_size + 1).astype(np.float32)
+            sent_rows.append((counts / max(len(toks), 1))[:vocab_size])
+            tids = [tags.get(t, tag_size) for t in tagstr.split("|")]
+            y = np.zeros(tag_size + 1, np.float32)
+            for t in tids:
+                y[t] += 1.0  # reference SUMS one-hots (duplicate tags add)
+            tag_rows.append(y[:tag_size])
+        return np.stack(sent_rows), np.stack(tag_rows)
+
+    def read(path: str) -> ClientData:
+        # the real TFF archive has ~342k train clients whose dense BoW rows
+        # would not fit host memory; cap the client count (NOT silently —
+        # logged below) the way reference experiments subsample silos
+        out: "OrderedDict[str, Tuple[np.ndarray, np.ndarray]]" = OrderedDict()
+        with h5py.File(path, "r") as f:
+            cids = list(f["examples"])
+            if len(cids) > max_clients:
+                log.warning(
+                    "stackoverflow_lr: capping %d clients in %s to max_clients=%d "
+                    "(dense BoW rows for every client would not fit memory; raise "
+                    "max_clients to widen)", len(cids), path, max_clients,
+                )
+                cids = cids[:max_clients]
+            for cid in cids:
+                out[cid] = encode_client(f["examples"][cid])
+        return out
+
+    train = read(os.path.join(data_dir, "stackoverflow_train.h5"))
+    test = read(os.path.join(data_dir, "stackoverflow_test.h5"))
+    return train, test, tag_size
+
+
+# --- FedNLP text classification h5 (20news et al.) ---------------------------
+
+FEDNLP_SEQ_LEN = 128
+FEDNLP_HASH_VOCAB = 30000
+
+
+def _hash_tokenize(text: str, seq_len: int, vocab: int) -> np.ndarray:
+    """Deterministic hash-vocab tokenizer: whitespace split, crc32 into
+    [1, vocab), zero-pad/truncate. The reference pipeline tokenizes with the
+    model's HF tokenizer (DistilBERT for BASELINE config 3); a hash vocab is
+    the model-free equivalent that keeps the parser self-contained."""
+    import zlib
+
+    ids = np.zeros(seq_len, np.int64)
+    for i, tok in enumerate(text.split()[:seq_len]):
+        ids[i] = zlib.crc32(tok.lower().encode()) % (vocab - 1) + 1
+    return ids
+
+
+def load_fednlp_text_clf(
+    data_dir: str,
+    name: str,
+    *,
+    seq_len: int = FEDNLP_SEQ_LEN,
+    vocab: int = FEDNLP_HASH_VOCAB,
+    partition_method: Optional[str] = None,
+) -> Tuple[ClientData, ClientData, int]:
+    """FedNLP text-classification pair ``<name>_data.h5`` +
+    ``<name>_partition.h5`` (reference layout:
+    ``fednlp/base/data_manager/base_data_manager.py:106-126`` — data file
+    has ``X/<idx>`` utf-8 text and ``Y/<idx>`` label strings; partition file
+    has ``<method>/partition_data/<client>/{train,test}`` index arrays and
+    ``<method>/n_clients``; instance decode per
+    ``text_classification_data_manager.py:19-25``)."""
+    import h5py
+
+    data_path = os.path.join(data_dir, f"{name}_data.h5")
+    part_path = os.path.join(data_dir, f"{name}_partition.h5")
+    with h5py.File(data_path, "r") as df, h5py.File(part_path, "r") as pf:
+        methods = list(pf.keys())
+        # real FedNLP partition files carry several method groups (uniform +
+        # kmeans/niid variants); alphabetical-first would silently pick a
+        # skewed niid split, so default to 'uniform' when present and LOG
+        # the choice either way
+        if partition_method:
+            method = partition_method
+        elif "uniform" in methods:
+            method = "uniform"
+        else:
+            method = methods[0]
+        log.info("fednlp %s: partition method %r (available: %s)", name, method, methods)
+        if method not in pf:
+            raise KeyError(f"partition method {method!r} not in {methods}")
+        labels = sorted({df["Y"][k][()].decode("utf-8") for k in df["Y"]})
+        label_id = {s: i for i, s in enumerate(labels)}
+
+        def gather(idxs) -> Tuple[np.ndarray, np.ndarray]:
+            xs = np.stack(
+                [_hash_tokenize(df["X"][str(i)][()].decode("utf-8"), seq_len, vocab) for i in idxs]
+            ) if len(idxs) else np.zeros((0, seq_len), np.int64)
+            ys = np.asarray(
+                [label_id[df["Y"][str(i)][()].decode("utf-8")] for i in idxs], np.int64
+            )
+            return xs, ys
+
+        train: "OrderedDict[str, Tuple[np.ndarray, np.ndarray]]" = OrderedDict()
+        test: "OrderedDict[str, Tuple[np.ndarray, np.ndarray]]" = OrderedDict()
+        part = pf[method]["partition_data"]
+        for cid in sorted(part.keys(), key=lambda s: int(s) if s.isdigit() else s):
+            train[cid] = gather(part[cid]["train"][()])
+            test[cid] = gather(part[cid]["test"][()])
+    return train, test, len(labels)

@@ -52,6 +52,65 @@ def _load_npz(path: str):
         )
 
 
+# --- CIFAR python-batch binaries (the reference's native on-disk layout) -----
+
+def _cifar_batch_dir(name: str, cache_dir: str) -> Optional[str]:
+    """Locate the extracted CIFAR archive dir (``cifar-10-batches-py`` /
+    ``cifar-100-python`` — what the reference's torchvision-backed loaders
+    read after ``download_cifar10.sh``), under the cache root or the
+    dataset's subdir."""
+    sub = "cifar-10-batches-py" if name == "cifar10" else "cifar-100-python"
+    probe = "data_batch_1" if name == "cifar10" else "train"
+    candidates = [
+        os.path.join(cache_dir, sub),
+        os.path.join(cache_dir, name, sub),
+        # downloads._flatten_single_dir hoists a lone wrapper dir, leaving
+        # the batch files directly under {cache}/{name}
+        os.path.join(cache_dir, name),
+    ]
+    for d in candidates:
+        if os.path.exists(os.path.join(d, probe)):
+            return d
+    return None
+
+
+def _read_cifar_pickle(path: str) -> dict:
+    """CIFAR batches are pickles; load through the restricted unpickler
+    (numpy/builtins allowlist — a hostile 'dataset' file must not execute)."""
+    from ..core.distributed.communication.grpc.ref_wire import unpickle_ref_tree
+
+    with open(path, "rb") as f:
+        return unpickle_ref_tree(f.read())
+
+
+def load_cifar_batches(name: str, batch_dir: str):
+    """Parse the reference CIFAR binary layout: ``data_batch_1..5`` +
+    ``test_batch`` (cifar10, key b'labels') or ``train``/``test`` (cifar100,
+    key b'fine_labels'); rows are [3072] uint8 CHW
+    (reference ``data/cifar10/datasets.py:45-57`` via torchvision CIFAR10,
+    same files)."""
+    if name == "cifar10":
+        train_files = [f"data_batch_{i}" for i in range(1, 6)]
+        test_files, label_key, classes = ["test_batch"], b"labels", 10
+    else:
+        train_files, test_files, label_key, classes = ["train"], ["test"], b"fine_labels", 100
+
+    def read(files):
+        xs, ys = [], []
+        for fname in files:
+            d = _read_cifar_pickle(os.path.join(batch_dir, fname))
+            xs.append(np.asarray(d[b"data"], np.uint8))
+            ys.append(np.asarray(d[label_key], np.int64))
+        x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return x.astype(np.float32) / 255.0, np.concatenate(ys)
+
+    x_tr, y_tr = read(train_files)
+    x_te, y_te = read(test_files)
+    log.info("dataset %s: loaded NATIVE binary batches from %s (%d train / %d test)",
+             name, batch_dir, len(x_tr), len(x_te))
+    return x_tr, y_tr, x_te, y_te, classes
+
+
 def load_image_dataset(name: str, cache_dir: str, seed: int = 0):
     """-> (x_train, y_train, x_test, y_test, num_classes)."""
     specs = {
@@ -69,6 +128,10 @@ def load_image_dataset(name: str, cache_dir: str, seed: int = 0):
         "landmarks": ((64, 64, 3), 203, 23000, 2000),
     }
     shape, classes, n_train, n_test = specs[name]
+    if name in ("cifar10", "cifar100") and cache_dir:
+        batch_dir = _cifar_batch_dir(name, cache_dir)
+        if batch_dir:
+            return load_cifar_batches(name, batch_dir)
     path = os.path.join(cache_dir or "", f"{name}.npz")
     if cache_dir and os.path.exists(path):
         x_tr, y_tr, x_te, y_te = _load_npz(path)

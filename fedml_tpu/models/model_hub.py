@@ -85,6 +85,12 @@ def create(args: Any, output_dim: Optional[int] = None, seed: Optional[int] = No
     num_classes = int(output_dim or getattr(args, "output_dim", 10))
     seed = int(seed if seed is not None else getattr(args, "random_seed", 0))
     in_shape, in_dtype = input_spec_for(dataset)
+    # the data loader records the loaded files' ACTUAL feature shape (native
+    # formats can be narrower than the canonical preset); prefer it so the
+    # input layer matches the data
+    loaded_shape = getattr(args, "input_shape", None)
+    if loaded_shape:
+        in_shape = tuple(loaded_shape)
 
     if model_name in ("lr", "logistic_regression"):
         module: nn.Module = LogisticRegression(num_classes=num_classes)

@@ -152,3 +152,183 @@ def test_data_loader_dispatches_native_format(tmp_path):
 def test_detect_format_files_absent(tmp_path):
     assert detect_format_files("femnist", str(tmp_path)) is None
     assert detect_format_files("fed_shakespeare", "") is None
+
+
+# --- round 4: stackoverflow_lr, CIFAR binary batches, FedNLP 20news h5 -------
+
+
+def _write_stackoverflow_lr(root, n_clients=3, vocab=12, tags=5):
+    """The reference trio: TFF h5 (examples/<cid>/{tokens,tags}) +
+    stackoverflow.word_count + stackoverflow.tag_count."""
+    import h5py
+
+    root.mkdir(parents=True, exist_ok=True)
+    words = [f"w{i}" for i in range(vocab)]
+    (root / "stackoverflow.word_count").write_text(
+        "".join(f"{w} {1000 - i}\n" for i, w in enumerate(words))
+    )
+    tag_names = [f"t{i}" for i in range(tags)]
+    (root / "stackoverflow.tag_count").write_text(
+        json.dumps({t: 500 - i for i, t in enumerate(tag_names)})
+    )
+    rng = np.random.default_rng(0)
+    for split in ("train", "test"):
+        with h5py.File(root / f"stackoverflow_{split}.h5", "w") as f:
+            ex = f.create_group("examples")
+            for c in range(n_clients):
+                g = ex.create_group(f"client_{c}")
+                sents = [
+                    " ".join(rng.choice(words + ["oovword"], size=rng.integers(3, 7)))
+                    for _ in range(4)
+                ]
+                tg = ["|".join(rng.choice(tag_names, size=rng.integers(1, 3), replace=False)) for _ in range(4)]
+                g.create_dataset("tokens", data=np.array([s.encode() for s in sents]))
+                g.create_dataset("tags", data=np.array([t.encode() for t in tg]))
+    return words, tag_names
+
+
+def test_stackoverflow_lr_h5_matches_reference_math(tmp_path):
+    from fedml_tpu.data.formats import load_stackoverflow_lr
+
+    d = tmp_path / "stackoverflow_lr"
+    _write_stackoverflow_lr(d, vocab=12, tags=5)
+    train, test, classes = load_stackoverflow_lr(str(d), vocab_size=12, tag_size=5)
+    assert classes == 5
+    assert len(train) == 3 and len(test) == 3
+    x, y = train["client_0"]
+    assert x.shape == (4, 12) and y.shape == (4, 5)
+    # inputs: mean one-hot with OOV in the denominator -> row sums <= 1,
+    # strictly < 1 whenever a sentence contained the OOV token
+    assert (x.sum(axis=1) <= 1.0 + 1e-6).all()
+    assert x.min() >= 0.0
+    # targets: multi-hot over known tags
+    assert set(np.unique(y)).issubset({0.0, 1.0})
+    assert (y.sum(axis=1) >= 1.0).all()
+    assert detect_format_files("stackoverflow_lr", str(tmp_path)) == "stackoverflow_lr"
+
+
+def test_stackoverflow_lr_end_to_end_training(tmp_path):
+    """data.load -> partition -> multi-label trainer on the native files."""
+    import fedml_tpu as fedml
+    from fedml_tpu.arguments import default_config
+
+    _write_stackoverflow_lr(tmp_path / "stackoverflow_lr", vocab=12, tags=5)
+    args = default_config(
+        "simulation", dataset="stackoverflow_lr", client_num_in_total=2,
+        client_num_per_round=2, comm_round=1, epochs=1, batch_size=4, model="lr",
+        data_cache_dir=str(tmp_path), frequency_of_the_test=1,
+    )
+    args = fedml.init(args)
+    device = fedml.device.get_device(args)
+    dataset, out_dim = fedml.data.load(args)
+    assert out_dim == 5
+    model = fedml.model.create(args, out_dim)
+    metrics = fedml.FedMLRunner(args, device, dataset, model).run()
+    assert metrics is not None and np.isfinite(metrics["test_loss"])
+
+
+def _write_cifar10_batches(root):
+    import pickle
+
+    d = root / "cifar-10-batches-py"
+    d.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(0)
+    for i in range(1, 6):
+        batch = {
+            b"data": rng.integers(0, 256, (20, 3072), dtype=np.uint8),
+            b"labels": rng.integers(0, 10, 20).tolist(),
+        }
+        (d / f"data_batch_{i}").write_bytes(pickle.dumps(batch))
+    (d / "test_batch").write_bytes(pickle.dumps({
+        b"data": rng.integers(0, 256, (10, 3072), dtype=np.uint8),
+        b"labels": rng.integers(0, 10, 10).tolist(),
+    }))
+
+
+def test_cifar10_binary_batches(tmp_path):
+    from fedml_tpu.data.sources import load_image_dataset
+
+    _write_cifar10_batches(tmp_path)
+    x_tr, y_tr, x_te, y_te, classes = load_image_dataset("cifar10", str(tmp_path))
+    assert x_tr.shape == (100, 32, 32, 3) and x_te.shape == (10, 32, 32, 3)
+    assert classes == 10
+    assert 0.0 <= x_tr.min() and x_tr.max() <= 1.0
+    assert y_tr.dtype == np.int64
+
+
+def test_cifar10_hostile_batch_refused(tmp_path):
+    """A pickle 'dataset' carrying a gadget must raise, not execute."""
+    import pickle
+
+    from fedml_tpu.data.sources import load_image_dataset
+
+    d = tmp_path / "cifar-10-batches-py"
+    d.mkdir(parents=True)
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        (d / name).write_bytes(pickle.dumps(os.system))
+    with pytest.raises(Exception):
+        load_image_dataset("cifar10", str(tmp_path))
+
+
+def _write_20news_h5(root, n_clients=3, n_train=12, n_test=6):
+    import h5py
+
+    root.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(0)
+    labels = ["alt.atheism", "sci.space", "rec.autos"]
+    n = n_train + n_test
+    with h5py.File(root / "20news_data.h5", "w") as f:
+        f.create_dataset("attributes", data=json.dumps({"task_type": "text_classification"}))
+        X = f.create_group("X")
+        Y = f.create_group("Y")
+        for i in range(n):
+            lab = labels[i % len(labels)]
+            X.create_dataset(str(i), data=f"{lab.split('.')[-1]} document number {i} body text".encode())
+            Y.create_dataset(str(i), data=lab.encode())
+    with h5py.File(root / "20news_partition.h5", "w") as f:
+        g = f.create_group("uniform")
+        g.create_dataset("n_clients", data=n_clients)
+        pd = g.create_group("partition_data")
+        tr_idx = np.arange(n_train)
+        te_idx = np.arange(n_train, n)
+        for c in range(n_clients):
+            cg = pd.create_group(str(c))
+            cg.create_dataset("train", data=tr_idx[c::n_clients])
+            cg.create_dataset("test", data=te_idx[c::n_clients])
+    return labels
+
+
+def test_20news_fednlp_h5(tmp_path):
+    from fedml_tpu.data.formats import load_fednlp_text_clf
+
+    d = tmp_path / "20news"
+    labels = _write_20news_h5(d)
+    train, test, classes = load_fednlp_text_clf(str(d), "20news", seq_len=16, vocab=100)
+    assert classes == len(labels)
+    assert len(train) == 3 and len(test) == 3
+    x, y = train["0"]
+    assert x.shape == (4, 16) and x.dtype == np.int64
+    assert (x >= 0).all() and (x < 100).all()
+    assert set(y.tolist()).issubset(set(range(classes)))
+    assert detect_format_files("20news", str(tmp_path)) == "20news"
+
+
+def test_20news_end_to_end_training(tmp_path):
+    """data.load -> file's own client partition -> trainer, on the FedNLP
+    h5 pair (BASELINE config 3's dataset)."""
+    import fedml_tpu as fedml
+    from fedml_tpu.arguments import default_config
+
+    _write_20news_h5(tmp_path / "20news")
+    args = default_config(
+        "simulation", dataset="20news", client_num_in_total=2,
+        client_num_per_round=2, comm_round=1, epochs=1, batch_size=4,
+        data_cache_dir=str(tmp_path), frequency_of_the_test=1,
+    )
+    args = fedml.init(args)
+    device = fedml.device.get_device(args)
+    dataset, out_dim = fedml.data.load(args)
+    assert out_dim == 3
+    model = fedml.model.create(args, out_dim)
+    metrics = fedml.FedMLRunner(args, device, dataset, model).run()
+    assert metrics is not None and np.isfinite(metrics["test_loss"])
