@@ -306,7 +306,11 @@ def pickle_ref_tree(params: Any) -> bytes:
     return pickle.dumps(_as_ref_state_dict(_to_torch_tree(params)))
 
 
-def unpickle_ref_tree(data: bytes) -> Any:
+def unpickle_ref_tree(data: bytes, encoding: str = "ASCII") -> Any:
     """Reference S3 payload bytes -> numpy tree, through the SAME restricted
-    unpickler the gRPC bridge uses (arbitrary callables refused)."""
-    return _to_numpy_tree(_RefUnpickler(io.BytesIO(data)).load())
+    unpickler the gRPC bridge uses (arbitrary callables refused).
+
+    ``encoding='bytes'`` is required for Python-2-era pickles (the canonical
+    CIFAR archives): their string payloads are raw image bytes that the
+    default ASCII decode rejects."""
+    return _to_numpy_tree(_RefUnpickler(io.BytesIO(data), encoding=encoding).load())

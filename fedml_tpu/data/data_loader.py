@@ -95,6 +95,13 @@ def load(args: Any) -> FedDataset:
         # canonical preset (e.g. a truncated word_count sidecar); record the
         # ACTUAL shape so model_hub builds a matching input layer
         args.input_shape = (1,) + tuple(np.asarray(fed[2].x).shape[1:])
+        if dataset in TEXT_CLS_DATASETS:
+            # the hash tokenizer emits ids in [0, FEDNLP_HASH_VOCAB); the
+            # text model's embedding must cover them or out-of-range gathers
+            # silently clamp onto the last row
+            from .formats import FEDNLP_HASH_VOCAB
+
+            args.vocab_size = FEDNLP_HASH_VOCAB
         return fed
 
     if dataset in TEXT_CLS_DATASETS:

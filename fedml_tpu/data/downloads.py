@@ -108,14 +108,22 @@ def maybe_download(dataset: str, cache_dir: str, allow_download: bool = False) -
         if sibling:
             log.info("reusing %s from %s", base, sibling)
             try:
-                os.link(sibling, fname + ".part")
-            except OSError:
-                import shutil as _shutil
+                try:
+                    os.link(sibling, fname + ".part")
+                except OSError:
+                    import shutil as _shutil
 
-                _shutil.copyfile(sibling, fname + ".part")
-            _extract(fname + ".part", dest, name_hint=fname)
-            os.replace(fname + ".part", fname)
-            fetched = True
+                    _shutil.copyfile(sibling, fname + ".part")
+                _extract(fname + ".part", dest, name_hint=fname)
+                os.replace(fname + ".part", fname)
+                fetched = True
+            except Exception as e:  # noqa: BLE001 - a corrupt/truncated
+                # sibling copy must fall back to the surrogate, exactly like
+                # a corrupt download (the guard's contract)
+                log.warning("reuse of %s failed (%r); using surrogate for %s",
+                            sibling, e, dataset)
+                if os.path.exists(fname + ".part"):
+                    os.remove(fname + ".part")
             continue
         log.info("downloading %s -> %s", url, fname)
         tmp = fname + ".part"

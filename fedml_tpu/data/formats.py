@@ -294,7 +294,7 @@ def load_native_format(dataset: str, cache: str, client_num: Optional[int] = Non
     elif dataset == "stackoverflow_nwp":
         train, test, classes = load_stackoverflow_nwp(d)
     elif dataset == "stackoverflow_lr":
-        train, test, classes = load_stackoverflow_lr(d)
+        train, test, classes = load_stackoverflow_lr_h5(d)
     elif dataset in ("20news", "agnews", "sst2", "semeval_2010_task8"):
         train, test, classes = load_fednlp_text_clf(d, dataset, partition_method=partition_method)
     else:
@@ -330,7 +330,7 @@ def _read_tag_count(path: str, tag_size: int) -> "OrderedDict[str, int]":
     return OrderedDict((t, i) for i, t in enumerate(list(tags)[:tag_size]))
 
 
-def load_stackoverflow_lr(
+def load_stackoverflow_lr_h5(
     data_dir: str, vocab_size: int = SO_LR_VOCAB, tag_size: int = SO_LR_TAGS,
     max_clients: int = 1000,
 ) -> Tuple[ClientData, ClientData, int]:

@@ -76,11 +76,13 @@ def _cifar_batch_dir(name: str, cache_dir: str) -> Optional[str]:
 
 def _read_cifar_pickle(path: str) -> dict:
     """CIFAR batches are pickles; load through the restricted unpickler
-    (numpy/builtins allowlist — a hostile 'dataset' file must not execute)."""
+    (numpy/builtins allowlist — a hostile 'dataset' file must not execute).
+    encoding='bytes' because the canonical Krizhevsky archives are
+    Python-2 pickles whose payload strings are raw image bytes."""
     from ..core.distributed.communication.grpc.ref_wire import unpickle_ref_tree
 
     with open(path, "rb") as f:
-        return unpickle_ref_tree(f.read())
+        return unpickle_ref_tree(f.read(), encoding="bytes")
 
 
 def load_cifar_batches(name: str, batch_dir: str):
@@ -99,8 +101,11 @@ def load_cifar_batches(name: str, batch_dir: str):
         xs, ys = [], []
         for fname in files:
             d = _read_cifar_pickle(os.path.join(batch_dir, fname))
-            xs.append(np.asarray(d[b"data"], np.uint8))
-            ys.append(np.asarray(d[label_key], np.int64))
+            # py2-era archives give bytes keys; a py3 re-pickle gives str
+            data = d.get(b"data", d.get("data"))
+            labels = d.get(label_key, d.get(label_key.decode()))
+            xs.append(np.asarray(data, np.uint8))
+            ys.append(np.asarray(labels, np.int64))
         x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
         return x.astype(np.float32) / 255.0, np.concatenate(ys)
 

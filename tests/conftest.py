@@ -10,6 +10,10 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# must be set before ANY protobuf import (grpc pulls in the C upb runtime,
+# after which the reference's older generated pb2 modules refuse to load —
+# this was the suite's one perpetual, order-dependent skip)
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
 # Child processes (example runs, scheduler jobs, serving replicas) must
 # never touch the remote-TPU tunnel: the axon sitecustomize only activates
 # when PALLAS_AXON_POOL_IPS is set, so dropping it here gives every
@@ -33,6 +37,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# the JAX_COMPILATION_CACHE_DIR env var is ignored by this image's jax build
+# (the axon sitecustomize re-initializes config), so enable the persistent
+# compilation cache explicitly — compile-heavy tests share executables
+# across runs, which is most of the fast tier's wall time on one core
+jax.config.update("jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import pytest  # noqa: E402
 

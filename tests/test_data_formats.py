@@ -188,11 +188,11 @@ def _write_stackoverflow_lr(root, n_clients=3, vocab=12, tags=5):
 
 
 def test_stackoverflow_lr_h5_matches_reference_math(tmp_path):
-    from fedml_tpu.data.formats import load_stackoverflow_lr
+    from fedml_tpu.data.formats import load_stackoverflow_lr_h5
 
     d = tmp_path / "stackoverflow_lr"
     _write_stackoverflow_lr(d, vocab=12, tags=5)
-    train, test, classes = load_stackoverflow_lr(str(d), vocab_size=12, tag_size=5)
+    train, test, classes = load_stackoverflow_lr_h5(str(d), vocab_size=12, tag_size=5)
     assert classes == 5
     assert len(train) == 3 and len(test) == 3
     x, y = train["client_0"]
