@@ -689,7 +689,11 @@ def _run_stage(name: str) -> None:
     if name == "llm_pallas":
         # headline: Pallas flash attention, NO remat — with the [T,T]-free
         # kernel the 268M proxy's activations fit HBM, and skipping recompute
-        # is pure throughput; a memory-limited chip falls back to remat
+        # is pure throughput; a memory-limited chip falls back to remat, and
+        # a Mosaic-rejected kernel (ADVICE r3: the lane-1 block layout has
+        # never met the real compiler) falls back to einsum attention —
+        # a measured einsum headline beats a dead stage, and the JSON's
+        # attention_impl field keeps the substitution visible
         try:
             out = _retry_transient(_bench_llm_tpu, remat=False)
             out["remat"] = False
@@ -698,8 +702,17 @@ def _run_stage(name: str) -> None:
         except Exception as e:  # noqa: BLE001 - twice-reproduced: OOM-shaped
             print(f"warning: no-remat LLM bench failed ({e!r}); retrying with remat",
                   file=sys.stderr)
-            out = _bench_llm_tpu(remat=True)
-            out["remat"] = True
+            try:
+                out = _bench_llm_tpu(remat=True)
+                out["remat"] = True
+            except BenchIntegrityError:
+                raise
+            except Exception as e2:  # noqa: BLE001
+                print(f"warning: pallas LLM bench failed under remat too ({e2!r}); "
+                      "falling back to xla attention for the headline",
+                      file=sys.stderr)
+                out = _bench_llm_tpu(attention_impl="xla", remat=True)
+                out["remat"] = True
     elif name == "llm_xla":
         try:
             out = _retry_transient(_bench_llm_tpu, reps=6, attention_impl="xla", remat=False)
