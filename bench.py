@@ -711,7 +711,7 @@ def _run_stage(name: str) -> None:
                 print(f"warning: pallas LLM bench failed under remat too ({e2!r}); "
                       "falling back to xla attention for the headline",
                       file=sys.stderr)
-                out = _bench_llm_tpu(attention_impl="xla", remat=True)
+                out = _retry_transient(_bench_llm_tpu, attention_impl="xla", remat=True)
                 out["remat"] = True
     elif name == "llm_xla":
         try:

@@ -1,0 +1,55 @@
+"""Shared interop test fixtures (both interop test files import these)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class NumpyLRTrainer:
+    """Minimal numpy client trainer over the torch Linear(10,2) layout
+    ("weight" [2,10], "bias" [2]) so a reference server's FedAvg +
+    load_state_dict consume our uploads unchanged. Implements the
+    ClientTrainer surface TrainerDistAdapter/FedMLTrainer drive."""
+
+    def __init__(self, n=64, d=10, classes=2, seed=7, lr=0.5, steps=4):
+        rng = np.random.default_rng(seed)
+        self.x = rng.normal(size=(n, d)).astype(np.float32)
+        w = rng.normal(size=(d, classes)).astype(np.float32)
+        self.y = np.argmax(self.x @ w + 0.1 * rng.normal(size=(n, classes)), axis=1)
+        self.n, self.lr, self.steps = n, lr, steps
+        self.params = {"weight": np.zeros((classes, d), np.float32),
+                       "bias": np.zeros((classes,), np.float32)}
+
+    def set_id(self, trainer_id):
+        self.id = trainer_id
+
+    def is_main_process(self):
+        return True
+
+    def update_dataset(self, train_data, test_data, sample_num):
+        pass
+
+    def get_model_params(self):
+        return {k: v.copy() for k, v in self.params.items()}
+
+    def set_model_params(self, p):
+        self.params = {k: np.asarray(v, np.float32) for k, v in p.items()}
+
+    def on_before_local_training(self, train_data, device, args):
+        return train_data
+
+    def on_after_local_training(self, train_data, device, args):
+        pass
+
+    def train(self, train_data, device, args):
+        for _ in range(self.steps):
+            logits = self.x @ self.params["weight"].T + self.params["bias"]
+            z = logits - logits.max(axis=1, keepdims=True)
+            p = np.exp(z) / np.exp(z).sum(axis=1, keepdims=True)
+            p[np.arange(self.n), self.y] -= 1.0
+            p /= self.n
+            self.params["weight"] -= self.lr * (p.T @ self.x)
+            self.params["bias"] -= self.lr * p.sum(axis=0)
+
+    def test(self, test_data, device, args):
+        return {}

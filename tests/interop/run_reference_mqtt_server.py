@@ -1,0 +1,124 @@
+"""Run the REFERENCE FedML SERVER over MQTT_S3 against a fedml_tpu client.
+
+Completes the interop matrix (both directions x both wires): the reference's
+unmodified ``FedMLServerManager`` + ``FedMLAggregator`` + ``ServerAggregator``
++ ``MqttS3MultiClientsCommManager`` + ``MqttManager`` + ``S3Storage`` run
+here, gating every round on OUR client's messages over its DEFAULT backend.
+Same functional paho/boto3 seams as run_reference_mqtt_client.py; everything
+above them is reference code.
+
+Env: INTEROP_BROKER (host:port), INTEROP_BUCKET_DIR, INTEROP_COMM_ROUND,
+INTEROP_OUT.
+"""
+
+import json
+import os
+import sys
+import types
+import warnings
+
+warnings.filterwarnings("ignore")
+
+from tests.interop.paho_boto3_shims import install_functional_shims  # noqa: E402
+
+install_functional_shims()
+
+from tests.interop.ref_stubs import install  # noqa: E402
+
+install()
+sys.path.insert(0, os.environ.get("REFERENCE_PATH", "/root/reference/python"))
+
+import torch  # noqa: E402
+
+# Disable the MLOps telemetry facade (zero egress; telemetry only).
+import fedml.mlops as _ref_mlops  # noqa: E402
+
+for _name in list(vars(_ref_mlops)):
+    _obj = getattr(_ref_mlops, _name)
+    if isinstance(_obj, types.FunctionType) and not _name.startswith("_"):
+        setattr(_ref_mlops, _name, lambda *a, **k: None)
+
+from fedml.core.mlops.mlops_profiler_event import MLOpsProfilerEvent  # noqa: E402
+
+MLOpsProfilerEvent.log_to_wandb = staticmethod(lambda *a, **k: None)
+
+from fedml.core.alg_frame.server_aggregator import ServerAggregator  # noqa: E402
+from fedml.cross_silo.server.fedml_aggregator import FedMLAggregator  # noqa: E402
+from fedml.cross_silo.server.fedml_server_manager import FedMLServerManager  # noqa: E402
+
+
+class TorchLRAggregator(ServerAggregator):
+    def get_model_params(self):
+        return self.model.cpu().state_dict()
+
+    def set_model_params(self, model_parameters):
+        self.model.load_state_dict(model_parameters)
+
+    def test(self, test_data, device, args):
+        return {}
+
+    def test_all(self, train_data_local_dict, test_data_local_dict, device, args) -> bool:
+        return True
+
+
+def build_args():
+    broker_host, _, broker_port = os.environ["INTEROP_BROKER"].rpartition(":")
+    return types.SimpleNamespace(
+        comm_round=int(os.environ["INTEROP_COMM_ROUND"]),
+        client_id_list="[1]",
+        run_id="0",
+        rank=0,
+        client_num_in_total=1,
+        client_num_per_round=1,
+        backend="MQTT_S3",
+        customized_training_mqtt_config={
+            "BROKER_HOST": broker_host or "127.0.0.1",
+            "BROKER_PORT": int(broker_port),
+            "MQTT_USER": "interop",
+            "MQTT_PWD": "interop",
+            "MQTT_KEEPALIVE": 60,
+        },
+        customized_training_s3_config={
+            "BUCKET_NAME": "fedml-interop",
+            "CN_S3_AKI": "local",
+            "CN_S3_SAK": "local",
+            "CN_REGION_NAME": "local",
+        },
+        scenario="horizontal",
+        dataset="synthetic_interop",
+        model="lr",
+        ml_engine="torch",
+        federated_optimizer="FedAvg",
+        frequency_of_the_test=100,
+        using_mlops=False,
+        enable_wandb=False,
+        skip_log_model_net=True,
+    )
+
+
+def main():
+    args = build_args()
+    device = torch.device("cpu")
+    torch.manual_seed(0)
+    model = torch.nn.Linear(10, 2)
+    with torch.no_grad():
+        model.weight.zero_()
+        model.bias.zero_()
+
+    server_aggregator = TorchLRAggregator(model, args)
+    server_aggregator.set_id(0)
+    aggregator = FedMLAggregator(
+        None, None, 64, {0: None}, {0: None}, {0: 64},
+        1, device, args, server_aggregator,
+    )
+    manager = FedMLServerManager(args, aggregator, None, 0, 1, backend="MQTT_S3")
+    manager.run()  # blocks until every client reported FINISHED
+
+    final = {k: v.detach().cpu().numpy().tolist() for k, v in model.state_dict().items()}
+    with open(os.environ["INTEROP_OUT"], "w") as f:
+        json.dump({"rounds_completed": args.round_idx, "final": final}, f)
+    print("REFERENCE MQTT_S3 SERVER DONE", args.round_idx)
+
+
+if __name__ == "__main__":
+    main()

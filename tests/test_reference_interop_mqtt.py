@@ -194,3 +194,91 @@ def test_reference_mqtt_s3_client_completes_rounds_against_our_server(tmp_path):
     for k in final_client:
         np.testing.assert_allclose(final_server[k], final_client[k], atol=1e-6, err_msg=k)
     assert float(np.abs(final_client["weight"]).sum()) > 0.0
+
+
+@pytest.mark.slow
+def test_our_client_completes_rounds_against_reference_mqtt_server(tmp_path):
+    """Fourth quadrant of the interop matrix: OUR client drives the
+    reference's unmodified FedMLServerManager over its DEFAULT backend
+    (MQTT + S3-pickled payloads) — their server gates every round on our
+    messages arriving over their own topic scheme and bucket contract."""
+    from fedml_tpu.core.distributed.communication.mqtt_s3.socket_broker import SocketMqttBroker
+    from fedml_tpu.cross_silo.client.fedml_client_master_manager import ClientMasterManager
+    from fedml_tpu.cross_silo.client.fedml_trainer_dist_adapter import TrainerDistAdapter
+
+    comm_round = 2
+    broker = SocketMqttBroker()
+    bucket = tmp_path / "bucket"
+    out_path = tmp_path / "server_out.json"
+
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        INTEROP_BROKER=broker.address,
+        INTEROP_BUCKET_DIR=str(bucket),
+        INTEROP_COMM_ROUND=str(comm_round),
+        INTEROP_OUT=str(out_path),
+        REFERENCE_PATH=REFERENCE,
+        JAX_PLATFORMS="cpu",
+    )
+    server = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests", "interop", "run_reference_mqtt_server.py")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+    args = types.SimpleNamespace(
+        comm_round=comm_round,
+        run_id=0,
+        backend="MQTT_S3",
+        mqtt_s3_wire="fedml",
+        mqtt_socket=broker.address,
+        mqtt_s3_bucket_dir=str(bucket),
+        scenario="horizontal",
+        client_num_in_total=1,
+        client_num_per_round=1,
+    )
+    from tests.interop.fixtures import NumpyLRTrainer
+    trainer = NumpyLRTrainer()
+    adapter = TrainerDistAdapter(
+        args, device=None, client_rank=1, model=None,
+        train_data_num=64, train_data_local_num_dict={0: 64},
+        train_data_local_dict={0: None}, test_data_local_dict={0: None},
+        model_trainer=trainer,
+    )
+    client = ClientMasterManager(args, adapter, rank=1, size=2, backend="MQTT_S3")
+
+    client_exc: list = []
+    client_done = threading.Event()
+
+    def _run_client():
+        try:
+            client.run()
+        except Exception as e:  # pragma: no cover
+            client_exc.append(e)
+        finally:
+            client_done.set()
+
+    threading.Thread(target=_run_client, daemon=True).start()
+
+    try:
+        server_out, _ = server.communicate(timeout=240)
+    except subprocess.TimeoutExpired:
+        server.kill()
+        server_out = server.communicate()[0] or ""
+    finally:
+        if not client_done.wait(timeout=30):
+            client.com_manager.stop_receive_message()
+            client_done.wait(timeout=10)
+        broker.stop()
+
+    assert not client_exc, f"our client raised: {client_exc}"
+    assert server.returncode == 0, f"reference MQTT_S3 server failed:\n{server_out[-4000:]}"
+    assert "REFERENCE MQTT_S3 SERVER DONE" in server_out
+
+    result = json.loads(out_path.read_text())
+    assert result["rounds_completed"] == comm_round
+    final_server = {k: np.asarray(v, np.float32) for k, v in result["final"].items()}
+    final_client = trainer.get_model_params()
+    for k in final_server:
+        np.testing.assert_allclose(final_server[k], final_client[k], atol=1e-6, err_msg=k)
+    assert float(np.abs(final_server["weight"]).sum()) > 0.0
