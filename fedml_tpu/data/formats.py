@@ -35,7 +35,15 @@ ClientData = Dict[str, Tuple[np.ndarray, np.ndarray]]
 # --- LEAF json ---------------------------------------------------------------
 
 
-def _read_leaf_dir(data_dir: str) -> ClientData:
+def _read_leaf_dir(data_dir: str, encode=None) -> ClientData:
+    """Walk a LEAF split dir, merging users that span files. ``encode``
+    maps one user_data record to (x, y) arrays; default: float features +
+    int labels (MNIST/femnist layout)."""
+    if encode is None:
+        def encode(ud):
+            return (np.asarray(ud["x"], dtype=np.float32),
+                    np.asarray(ud["y"], dtype=np.int64))
+
     out: "OrderedDict[str, Tuple[np.ndarray, np.ndarray]]" = OrderedDict()
     files = sorted(f for f in os.listdir(data_dir) if f.endswith(".json"))
     if not files:
@@ -44,9 +52,7 @@ def _read_leaf_dir(data_dir: str) -> ClientData:
         with open(os.path.join(data_dir, fname)) as f:
             doc = json.load(f)
         for uid in doc["users"]:
-            ud = doc["user_data"][uid]
-            x = np.asarray(ud["x"], dtype=np.float32)
-            y = np.asarray(ud["y"], dtype=np.int64)
+            x, y = encode(doc["user_data"][uid])
             if uid in out:  # users may span files
                 px, py = out[uid]
                 x, y = np.concatenate([px, x]), np.concatenate([py, y])
@@ -260,6 +266,7 @@ def detect_format_files(dataset: str, cache: str) -> Optional[str]:
     checks = {
         "femnist": lambda: os.path.isdir(os.path.join(d, "train")),
         "mnist": lambda: os.path.isdir(os.path.join(d, "train")),
+        "shakespeare": lambda: os.path.isdir(os.path.join(d, "train")),
         "fed_shakespeare": lambda: os.path.exists(os.path.join(d, "shakespeare_train.h5")),
         "fed_cifar100": lambda: os.path.exists(os.path.join(d, "fed_cifar100_train.h5")),
         "stackoverflow_nwp": lambda: os.path.exists(os.path.join(d, "stackoverflow_train.h5")),
@@ -287,6 +294,8 @@ def load_native_format(dataset: str, cache: str, client_num: Optional[int] = Non
     if dataset in ("femnist", "mnist"):
         shape = (28, 28, 1) if dataset == "femnist" else None
         train, test, classes = load_leaf_json(d, image_shape=shape)
+    elif dataset == "shakespeare":
+        train, test, classes = load_leaf_shakespeare(d)
     elif dataset == "fed_shakespeare":
         train, test, classes = load_tff_shakespeare(d)
     elif dataset == "fed_cifar100":
@@ -464,3 +473,30 @@ def load_fednlp_text_clf(
             train[cid] = gather(part[cid]["train"][()])
             test[cid] = gather(part[cid]["test"][()])
     return train, test, len(labels)
+
+
+# --- LEAF shakespeare (string features) --------------------------------------
+
+
+def load_leaf_shakespeare(data_dir: str) -> Tuple[ClientData, ClientData, int]:
+    """LEAF shakespeare json: ``user_data[uid].x`` is a list of 80-char
+    context strings and ``.y`` the single next character (reference
+    ``data/shakespeare/language_utils.py`` word_to_indices/letter_to_index
+    over the same CHAR_VOCAB table this module uses for the TFF variant).
+    Encodes to (x [N, 80] int64 char ids, y [N] next-char ids); class_num is
+    the shared shakespeare vocab size. Zero-sample users (possible in LEAF
+    split shards) yield well-shaped (0, seq) arrays so cross-file merges
+    still concatenate."""
+    table = _char_table()
+    oov = len(table)
+
+    def encode(ud):
+        rows = [[table.get(c, oov) for c in s] for s in ud["x"]]
+        seq = len(rows[0]) if rows else 80
+        x = np.asarray(rows, np.int64).reshape(-1, seq)
+        y = np.asarray([table.get(s[0], oov) for s in ud["y"]], np.int64)
+        return x, y
+
+    train = _read_leaf_dir(os.path.join(data_dir, "train"), encode)
+    test = _read_leaf_dir(os.path.join(data_dir, "test"), encode)
+    return train, test, shakespeare_vocab_size()

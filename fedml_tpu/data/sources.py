@@ -239,7 +239,13 @@ def load_tabular_dataset(name: str, cache_dir: str, seed: int = 0):
     dim, n_train, n_test = specs[name]
     path = os.path.join(cache_dir or "", f"{name}.npz")
     if cache_dir and os.path.exists(path):
+        # the documented npz override wins over a raw csv in the same cache
         return (*_load_npz(path), 2)
+    if name == "lending_club" and cache_dir:
+        for csv_path in (os.path.join(cache_dir, "lending_club", "loan.csv"),
+                         os.path.join(cache_dir, "loan.csv")):
+            if os.path.exists(csv_path):
+                return load_lending_club_csv(csv_path, seed)
     log.warning("dataset %s: no local file at %s — synthetic tabular surrogate", name, path)
     n_train, n_test = min(n_train, 10000), min(n_test, 2000)
     rng = np.random.default_rng(seed)
@@ -337,3 +343,81 @@ def load_synthetic_lr(alpha: float, beta: float, n_clients: int, seed: int = 0, 
         y = np.argmax(logits + rng.gumbel(size=logits.shape), axis=1).astype(np.int64)
         out.append((x, y))
     return out, classes
+
+
+# --- lending club loan.csv (the reference's native tabular source) -----------
+
+# loan_status values the reference labels "Bad Loan"
+# (data/lending_club_loan/lending_club_dataset.py:121-133)
+_BAD_LOAN_STATUS = {
+    "Charged Off",
+    "Default",
+    "Does not meet the credit policy. Status:Charged Off",
+    "In Grace Period",
+    "Late (16-30 days)",
+    "Late (31-120 days)",
+}
+
+
+# the reference's curated numeric feature columns
+# (data/lending_club_loan/lending_club_feature_group.py — union of its
+# qualification/loan/debt/repayment/multi-acc/malicious-behavior groups,
+# numeric members only; NOTE the reference's own list includes post-outcome
+# repayment columns like recoveries/total_pymnt — its vertical-FL design
+# models the repayment party explicitly)
+_LOAN_NUMERIC_FEATURES = (
+    "annual_inc_comp", "total_rev_hi_lim", "tot_hi_cred_lim", "total_bc_limit",
+    "total_il_high_credit_limit", "loan_amnt", "int_rate", "installment",
+    "revol_bal", "revol_util", "out_prncp", "recoveries", "dti", "dti_joint",
+    "tot_coll_amt", "mths_since_rcnt_il", "total_bal_il", "il_util",
+    "max_bal_bc", "all_util", "bc_util", "total_bal_ex_mort",
+    "revol_bal_joint", "mo_sin_old_il_acct", "mo_sin_old_rev_tl_op",
+    "mo_sin_rcnt_rev_tl_op", "mort_acc", "num_rev_tl_bal_gt_0",
+    "percent_bc_gt_75", "num_sats", "num_bc_sats", "pct_tl_nvr_dlq",
+    "bc_open_to_buy", "last_pymnt_amnt", "total_pymnt", "total_pymnt_inv",
+    "total_rec_prncp", "total_rec_int", "total_rec_late_fee", "tot_cur_bal",
+    "avg_cur_bal", "num_il_tl", "num_op_rev_tl", "num_rev_accts",
+    "num_actv_rev_tl", "num_tl_op_past_12m", "open_rv_12m", "open_rv_24m",
+    "open_acc_6m", "open_act_il", "open_il_12m", "open_il_24m", "total_acc",
+    "inq_last_6mths", "open_acc", "inq_fi", "inq_last_12m",
+    "acc_open_past_24mths", "num_tl_120dpd_2m", "num_tl_30dpd",
+    "num_tl_90g_dpd_24m", "pub_rec_bankruptcies",
+    "mths_since_recent_revol_delinq", "num_accts_ever_120_pd",
+    "mths_since_recent_bc_dlq", "chargeoff_within_12_mths",
+)
+
+
+def load_lending_club_csv(csv_path: str, seed: int = 0, test_frac: float = 0.1):
+    """Parse the reference's ``loan.csv`` with the reference's own
+    preprocessing (``lending_club_dataset.py:190-204``): binary good/bad
+    target from loan_status, the curated feature columns (numeric members of
+    its feature groups), issue_year==2018 filter when issue_d parses, NaN
+    filled with -99 (their choice), then column-standardized. Returns
+    (x_train, y_train, x_test, y_test, 2)."""
+    import pandas as pd
+
+    df = pd.read_csv(csv_path, low_memory=False)
+    if "loan_status" not in df.columns:
+        raise ValueError(f"{csv_path} has no loan_status column")
+    if "issue_d" in df.columns:
+        # reference filters to the 2018 vintage (lending_club_dataset.py:198)
+        years = pd.to_datetime(df["issue_d"], format="%b-%Y", errors="coerce").dt.year
+        if (years == 2018).any():
+            df = df[years == 2018]
+    y = df["loan_status"].isin(_BAD_LOAN_STATUS).to_numpy().astype(np.int64)
+    cols = [c for c in _LOAN_NUMERIC_FEATURES if c in df.columns]
+    if not cols:
+        # tiny/toy csvs: fall back to whatever numeric columns exist
+        feats = df.drop(columns=["loan_status"]).select_dtypes(include=[np.number])
+    else:
+        feats = df[cols].apply(pd.to_numeric, errors="coerce")
+    x = feats.fillna(-99).to_numpy(np.float32)  # reference fillna(-99), :204
+    std = x.std(axis=0)
+    std[std == 0] = 1.0
+    x = (x - x.mean(axis=0)) / std
+    order = np.random.default_rng(seed).permutation(len(x))
+    x, y = x[order], y[order]
+    n_test = max(1, int(len(x) * test_frac))
+    log.info("dataset lending_club: parsed %s (%d rows, %d features)",
+             csv_path, len(x), x.shape[1])
+    return x[n_test:], y[n_test:], x[:n_test], y[:n_test], 2

@@ -141,3 +141,19 @@ def install() -> None:
 
         pkgr.parse_version = parse_version
         sys.modules["pkg_resources"] = pkgr
+
+
+def neuter_reference_mlops() -> None:
+    """Silence the reference's MLOps telemetry facade (it phones the MLOps
+    cloud — zero egress here — and crashes when no agent config was
+    fetched). Telemetry only; the FL state machine and wire protocol are
+    untouched. Call AFTER ``install()`` + putting the reference on
+    ``sys.path`` (this imports ``fedml``)."""
+    import fedml.mlops as _ref_mlops
+    from fedml.core.mlops.mlops_profiler_event import MLOpsProfilerEvent
+
+    for _name in list(vars(_ref_mlops)):
+        _obj = getattr(_ref_mlops, _name)
+        if isinstance(_obj, types.FunctionType) and not _name.startswith("_"):
+            setattr(_ref_mlops, _name, lambda *a, **k: None)
+    MLOpsProfilerEvent.log_to_wandb = staticmethod(lambda *a, **k: None)

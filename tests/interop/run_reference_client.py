@@ -33,20 +33,9 @@ CommunicationConstants.GRPC_BASE_PORT = int(os.environ["INTEROP_BASE_PORT"])
 from fedml.cross_silo.client.fedml_client_master_manager import ClientMasterManager  # noqa: E402
 from fedml.cross_silo.client.fedml_trainer_dist_adapter import TrainerDistAdapter  # noqa: E402
 
-# Disable the MLOps telemetry facade: it phones the MLOps cloud (zero egress
-# here) and its mqtt sidecar, and crashes when no agent config was fetched
-# (core/mlops/__init__.py:529 assumes mlops_log_mqtt_mgr). Telemetry only —
-# the FL round state machine and wire protocol under test are untouched.
-import fedml.mlops as _ref_mlops  # noqa: E402
+from tests.interop.ref_stubs import neuter_reference_mlops  # noqa: E402
 
-for _name in list(vars(_ref_mlops)):
-    _obj = getattr(_ref_mlops, _name)
-    if isinstance(_obj, types.FunctionType) and not _name.startswith("_"):
-        setattr(_ref_mlops, _name, lambda *a, **k: None)
-
-from fedml.core.mlops.mlops_profiler_event import MLOpsProfilerEvent  # noqa: E402
-
-MLOpsProfilerEvent.log_to_wandb = staticmethod(lambda *a, **k: None)
+neuter_reference_mlops()
 
 
 def build_args():

@@ -30,17 +30,9 @@ sys.path.insert(0, os.environ.get("REFERENCE_PATH", "/root/reference/python"))
 
 import torch  # noqa: E402
 
-# Disable the MLOps telemetry facade (zero egress; telemetry only).
-import fedml.mlops as _ref_mlops  # noqa: E402
+from tests.interop.ref_stubs import neuter_reference_mlops  # noqa: E402
 
-for _name in list(vars(_ref_mlops)):
-    _obj = getattr(_ref_mlops, _name)
-    if isinstance(_obj, types.FunctionType) and not _name.startswith("_"):
-        setattr(_ref_mlops, _name, lambda *a, **k: None)
-
-from fedml.core.mlops.mlops_profiler_event import MLOpsProfilerEvent  # noqa: E402
-
-MLOpsProfilerEvent.log_to_wandb = staticmethod(lambda *a, **k: None)
+neuter_reference_mlops()
 
 from fedml.core.alg_frame.server_aggregator import ServerAggregator  # noqa: E402
 from fedml.cross_silo.server.fedml_aggregator import FedMLAggregator  # noqa: E402

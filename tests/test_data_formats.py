@@ -332,3 +332,50 @@ def test_20news_end_to_end_training(tmp_path):
     model = fedml.model.create(args, out_dim)
     metrics = fedml.FedMLRunner(args, device, dataset, model).run()
     assert metrics is not None and np.isfinite(metrics["test_loss"])
+
+
+def test_leaf_shakespeare_string_features(tmp_path):
+    from fedml_tpu.data.formats import load_leaf_shakespeare, shakespeare_vocab_size
+
+    root = tmp_path / "shakespeare"
+    ctx = "to be or not to be that is the question whether tis nobler in the minds to suff"
+    ctx = ctx.ljust(79)
+    assert len(ctx) == 79
+    users = {
+        f"p{i}": {"x": [ctx + "e", ctx + "a"], "y": ["r", "n"]}
+        for i in range(3)
+    }
+    _write_leaf(root, "train", users)
+    _write_leaf(root, "test", users)
+    train, test, classes = load_leaf_shakespeare(str(root))
+    assert classes == shakespeare_vocab_size()
+    x, y = train["p0"]
+    assert x.shape == (2, 80) and x.dtype == np.int64
+    assert y.shape == (2,)
+    assert (x < classes).all() and (y < classes).all()
+    assert detect_format_files("shakespeare", str(tmp_path)) == "shakespeare"
+
+
+def test_lending_club_csv(tmp_path):
+    from fedml_tpu.data.sources import load_tabular_dataset
+
+    import csv
+
+    d = tmp_path / "lending_club"
+    d.mkdir()
+    with open(d / "loan.csv", "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["loan_amnt", "int_rate", "grade", "loan_status"])
+        for i in range(40):
+            status = "Charged Off" if i % 4 == 0 else "Fully Paid"
+            w.writerow([1000 + i * 10, 5.0 + (i % 7), "ABCDEFG"[i % 7], status])
+    x_tr, y_tr, x_te, y_te, classes = load_tabular_dataset("lending_club", str(tmp_path))
+    assert classes == 2
+    # only the numeric columns survive (grade is a string column)
+    assert x_tr.shape[1] == 2
+    assert set(np.unique(np.concatenate([y_tr, y_te]))) == {0, 1}
+    # bad-loan fraction ~ 1/4
+    frac = float(np.concatenate([y_tr, y_te]).mean())
+    assert 0.15 < frac < 0.35
+    # standardized features
+    assert abs(float(np.concatenate([x_tr, x_te]).mean())) < 0.2
