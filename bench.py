@@ -787,11 +787,12 @@ def _handle_term(signum, frame):  # noqa: ARG001
     sys.exit(128 + signum)
 
 
-def _spawn_stage(name: str, budget_s: int) -> tuple[dict | None, str | None]:
+def _spawn_stage(name: str, budget_s: int, argv: list[str] | None = None) -> tuple[dict | None, str | None]:
     """Run one stage subprocess; returns (parsed_json, None) or
     (None, "stage: failure summary"). Output goes through temp files, not
     PIPE, so a timeout kill still leaves the partial stderr readable for
-    the failure record."""
+    the failure record. ``argv`` overrides the stage command (test seam for
+    the kill-the-whole-tree contract)."""
     global _CURRENT_STAGE_PROC
     import tempfile
 
@@ -799,7 +800,7 @@ def _spawn_stage(name: str, budget_s: int) -> tuple[dict | None, str | None]:
     with tempfile.TemporaryFile(mode="w+") as f_out, \
          tempfile.TemporaryFile(mode="w+") as f_err:
         proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--stage", name],
+            argv or [sys.executable, os.path.abspath(__file__), "--stage", name],
             stdout=f_out, stderr=f_err, text=True, cwd=_REPO,
             start_new_session=True,  # one killpg reaps replica grandchildren
         )

@@ -483,19 +483,24 @@ def load_leaf_shakespeare(data_dir: str) -> Tuple[ClientData, ClientData, int]:
     context strings and ``.y`` the single next character (reference
     ``data/shakespeare/language_utils.py`` word_to_indices/letter_to_index
     over the same CHAR_VOCAB table this module uses for the TFF variant).
-    Encodes to (x [N, 80] int64 char ids, y [N] next-char ids); class_num is
-    the shared shakespeare vocab size. Zero-sample users (possible in LEAF
-    split shards) yield well-shaped (0, seq) arrays so cross-file merges
-    still concatenate."""
+    Encodes to next-char SEQ-TO-SEQ pairs — x = chars[:-1], y = chars[1:]
+    of the 81-char (context + next char) window — the same [N, 80]/[N, 80]
+    convention our TFF fed_shakespeare loader and RNN/LM models use
+    (per-timestep logits; a [N] single-label y would not match their
+    [B, T, V] output). class_num is the shared shakespeare vocab size.
+    Zero-sample users (possible in LEAF split shards) yield well-shaped
+    (0, seq) arrays so cross-file merges still concatenate."""
     table = _char_table()
     oov = len(table)
 
     def encode(ud):
-        rows = [[table.get(c, oov) for c in s] for s in ud["x"]]
-        seq = len(rows[0]) if rows else 80
-        x = np.asarray(rows, np.int64).reshape(-1, seq)
-        y = np.asarray([table.get(s[0], oov) for s in ud["y"]], np.int64)
-        return x, y
+        rows = [
+            [table.get(c, oov) for c in ctx] + [table.get(nxt[0], oov)]
+            for ctx, nxt in zip(ud["x"], ud["y"])
+        ]
+        seq = (len(rows[0]) - 1) if rows else 80
+        full = np.asarray(rows, np.int64).reshape(-1, seq + 1)
+        return full[:, :-1], full[:, 1:]
 
     train = _read_leaf_dir(os.path.join(data_dir, "train"), encode)
     test = _read_leaf_dir(os.path.join(data_dir, "test"), encode)

@@ -396,7 +396,10 @@ def load_lending_club_csv(csv_path: str, seed: int = 0, test_frac: float = 0.1):
     (x_train, y_train, x_test, y_test, 2)."""
     import pandas as pd
 
-    df = pd.read_csv(csv_path, low_memory=False)
+    # restrict the read to the needed columns: the real corpus is ~2 GB with
+    # 145 columns, most of them high-cardinality strings we would discard
+    needed = set(_LOAN_NUMERIC_FEATURES) | {"loan_status", "issue_d"}
+    df = pd.read_csv(csv_path, usecols=lambda c: c in needed, low_memory=False)
     if "loan_status" not in df.columns:
         raise ValueError(f"{csv_path} has no loan_status column")
     if "issue_d" in df.columns:

@@ -1,0 +1,104 @@
+"""The bench orchestrator's isolation contract (VERDICT r3 item 1).
+
+The r03 bench died because replica grandchildren kept HBM across stages.
+The round-4 rearchitecture guarantees: a stage that exceeds its budget is
+SIGKILLed as a whole process GROUP (grandchildren included), its partial
+stderr survives into the failure record, and a healthy stage's one JSON
+line is parsed. These tests drive bench._spawn_stage through its test seam
+on CPU — the only way to verify the contract without a chip.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+import time
+
+import bench
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:  # pragma: no cover
+        return True
+
+
+def test_stage_timeout_kills_grandchildren(tmp_path):
+    """A stage spawning its own child (the serving stage's replica shape):
+    on budget exhaustion BOTH processes must die — the child holds the
+    chip's memory in the real topology."""
+    pid_file = tmp_path / "child.pid"
+    script = textwrap.dedent(f"""
+        import subprocess, sys, time
+        child = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(300)"])
+        open({str(pid_file)!r}, "w").write(str(child.pid))
+        print("stage spawned child", child.pid, file=sys.stderr, flush=True)
+        time.sleep(300)
+    """)
+    result, err = bench._spawn_stage(
+        "fake", budget_s=3, argv=[sys.executable, "-c", script]
+    )
+    assert result is None
+    assert err is not None and "timeout after 3s" in err
+    # partial stderr made it into the failure record
+    assert "stage spawned child" in err
+    child_pid = int(pid_file.read_text())
+    deadline = time.time() + 5
+    while time.time() < deadline and _alive(child_pid):
+        time.sleep(0.1)
+    assert not _alive(child_pid), "grandchild survived the stage killpg"
+
+
+def test_stage_failure_summarizes_error_tail():
+    script = "import sys; print('boom', file=sys.stderr); raise RuntimeError('RESOURCE_EXHAUSTED: fake')"
+    result, err = bench._spawn_stage(
+        "fake", budget_s=30, argv=[sys.executable, "-c", script]
+    )
+    assert result is None
+    assert "RESOURCE_EXHAUSTED" in err
+
+
+def test_stage_success_parses_last_json_line():
+    script = "print('noise'); print('{\"metric\": 1.5}')"
+    result, err = bench._spawn_stage(
+        "fake", budget_s=30, argv=[sys.executable, "-c", script]
+    )
+    assert err is None
+    assert result == {"metric": 1.5}
+
+
+def test_sigterm_forwarding_kills_inflight_stage(tmp_path):
+    """bench_watch's outer timeout signals only the orchestrator; the
+    handler must forward death to the stage's process group."""
+    pid_file = tmp_path / "stage.pid"
+    script = textwrap.dedent(f"""
+        import os, time
+        open({str(pid_file)!r}, "w").write(str(os.getpid()))
+        time.sleep(300)
+    """)
+    import threading
+
+    # run _spawn_stage in a thread, then deliver the handler by hand the way
+    # the signal would (raising SystemExit in the main thread is the
+    # handler's job; here we only verify the group kill side effect)
+    done = threading.Event()
+
+    def run():
+        bench._spawn_stage("fake", budget_s=30, argv=[sys.executable, "-c", script])
+        done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not pid_file.exists():
+        time.sleep(0.05)
+    stage_pid = int(pid_file.read_text())
+    assert bench._CURRENT_STAGE_PROC is not None
+    bench._kill_stage_group(bench._CURRENT_STAGE_PROC)
+    assert done.wait(timeout=10)
+    assert not _alive(stage_pid)

@@ -350,10 +350,35 @@ def test_leaf_shakespeare_string_features(tmp_path):
     train, test, classes = load_leaf_shakespeare(str(root))
     assert classes == shakespeare_vocab_size()
     x, y = train["p0"]
-    assert x.shape == (2, 80) and x.dtype == np.int64
-    assert y.shape == (2,)
+    # seq-to-seq next-char pairs (matching the TFF loader's convention)
+    assert x.shape == (2, 80) and y.shape == (2, 80)
+    assert x.dtype == np.int64
+    np.testing.assert_array_equal(x[:, 1:], y[:, :-1])  # shifted window
     assert (x < classes).all() and (y < classes).all()
     assert detect_format_files("shakespeare", str(tmp_path)) == "shakespeare"
+
+
+def test_leaf_shakespeare_end_to_end_training(tmp_path):
+    """data.load -> file's own partition -> per-timestep RNN trainer."""
+    import fedml_tpu as fedml
+    from fedml_tpu.arguments import default_config
+
+    root = tmp_path / "shakespeare"
+    ctx = "to be or not to be that is the question whether tis nobler in mind".ljust(79)
+    users = {f"p{i}": {"x": [ctx + "e", ctx + "a"] * 4, "y": ["r", "n"] * 4} for i in range(3)}
+    _write_leaf(root, "train", users)
+    _write_leaf(root, "test", users)
+    args = default_config(
+        "simulation", dataset="shakespeare", model="rnn", client_num_in_total=2,
+        client_num_per_round=2, comm_round=1, epochs=1, batch_size=4,
+        data_cache_dir=str(tmp_path), frequency_of_the_test=1,
+    )
+    args = fedml.init(args)
+    device = fedml.device.get_device(args)
+    dataset, out_dim = fedml.data.load(args)
+    model = fedml.model.create(args, out_dim)
+    metrics = fedml.FedMLRunner(args, device, dataset, model).run()
+    assert metrics is not None and np.isfinite(metrics["test_loss"])
 
 
 def test_lending_club_csv(tmp_path):
