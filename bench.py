@@ -160,7 +160,8 @@ def _build_llm(attention_impl: str, remat: bool):
     return model, cfg, params
 
 
-def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool = False):
+def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool = False,
+                   bs: int | None = None):
     import jax
     import jax.numpy as jnp
     import optax
@@ -169,7 +170,8 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
 
     model, cfg, params = _build_llm(attention_impl, remat)
     s = _LLM_SHAPE
-    vocab, seq, bs = s["vocab"], s["seq"], s["bs"]
+    vocab, seq = s["vocab"], s["seq"]
+    bs = int(bs or s["bs"])
     n_params = sum(x.size for x in jax.tree.leaves(params))
     tx = optax.adamw(1e-4)
     opt_state = tx.init(params)
@@ -230,7 +232,7 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
         "step_flops": analytic_step_flops,
         "n_params": n_params,
         "device": getattr(dev, "device_kind", str(dev)),
-        "shape": dict(s),
+        "shape": dict(s, bs=bs),
     }
 
 
@@ -686,6 +688,7 @@ def _run_stage(name: str) -> None:
     this process and print exactly one JSON line. The process exits afterward,
     releasing every device buffer it held — the orchestrator's isolation
     guarantee."""
+    _STAGE_T0 = time.monotonic()
     if name == "llm_pallas":
         # headline: Pallas flash attention, NO remat — with the [T,T]-free
         # kernel the 268M proxy's activations fit HBM, and skipping recompute
@@ -713,6 +716,30 @@ def _run_stage(name: str) -> None:
                       file=sys.stderr)
                 out = _retry_transient(_bench_llm_tpu, attention_impl="xla", remat=True)
                 out["remat"] = True
+        # larger batches usually raise MFU (bigger matmuls per dispatch);
+        # tunnel windows are rare, so try bs=2x in the SAME window and ship
+        # whichever measured faster — both results stay in the output. Only
+        # probe while well inside the stage budget (1500s): overrunning it
+        # would killpg the stage and discard the SUCCESSFUL 1x headline
+        if (out["attention_impl"] == "pallas"
+                and out["shape"]["bs"] == _LLM_SHAPE["bs"]
+                and time.monotonic() - _STAGE_T0 < 600.0):
+            try:
+                out2 = _bench_llm_tpu(reps=6, remat=out["remat"],
+                                      bs=2 * _LLM_SHAPE["bs"])
+                out2["remat"] = out["remat"]
+                out["bs2x_tokens_per_sec"] = round(out2["tokens_per_sec"], 1)
+                out["bs2x_mfu"] = round(out2["mfu"], 4)
+                if out2["mfu"] > out["mfu"]:
+                    out2["bs1x_tokens_per_sec"] = round(out["tokens_per_sec"], 1)
+                    out2["bs1x_mfu"] = round(out["mfu"], 4)
+                    out = out2
+            except BenchIntegrityError:
+                raise
+            except Exception as e3:  # noqa: BLE001 - bigger batch may OOM;
+                # the bs=1x headline already succeeded, keep it
+                print(f"note: bs=2x probe failed ({e3!r}); keeping bs=1x headline",
+                      file=sys.stderr)
     elif name == "llm_xla":
         try:
             out = _retry_transient(_bench_llm_tpu, reps=6, attention_impl="xla", remat=False)

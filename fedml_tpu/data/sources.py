@@ -396,10 +396,16 @@ def load_lending_club_csv(csv_path: str, seed: int = 0, test_frac: float = 0.1):
     (x_train, y_train, x_test, y_test, 2)."""
     import pandas as pd
 
-    # restrict the read to the needed columns: the real corpus is ~2 GB with
-    # 145 columns, most of them high-cardinality strings we would discard
-    needed = set(_LOAN_NUMERIC_FEATURES) | {"loan_status", "issue_d"}
-    df = pd.read_csv(csv_path, usecols=lambda c: c in needed, low_memory=False)
+    header = pd.read_csv(csv_path, nrows=0).columns
+    curated = [c for c in _LOAN_NUMERIC_FEATURES if c in header]
+    if curated:
+        # restrict the read to the needed columns: the real corpus is ~2 GB
+        # with 145 columns, most of them high-cardinality strings we discard
+        needed = set(curated) | {"loan_status", "issue_d"}
+        df = pd.read_csv(csv_path, usecols=lambda c: c in needed, low_memory=False)
+    else:
+        # toy/non-curated csvs: full read, numeric-column fallback below
+        df = pd.read_csv(csv_path, low_memory=False)
     if "loan_status" not in df.columns:
         raise ValueError(f"{csv_path} has no loan_status column")
     if "issue_d" in df.columns:
@@ -409,7 +415,7 @@ def load_lending_club_csv(csv_path: str, seed: int = 0, test_frac: float = 0.1):
             df = df[years == 2018]
     y = df["loan_status"].isin(_BAD_LOAN_STATUS).to_numpy().astype(np.int64)
     cols = [c for c in _LOAN_NUMERIC_FEATURES if c in df.columns]
-    if not cols:
+    if not cols:  # reachable only via the full-read branch above
         # tiny/toy csvs: fall back to whatever numeric columns exist
         feats = df.drop(columns=["loan_status"]).select_dtypes(include=[np.number])
     else:

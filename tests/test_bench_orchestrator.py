@@ -36,7 +36,9 @@ def test_stage_timeout_kills_grandchildren(tmp_path):
     script = textwrap.dedent(f"""
         import subprocess, sys, time
         child = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(300)"])
-        open({str(pid_file)!r}, "w").write(str(child.pid))
+        import os as _os
+        open({str(pid_file)!r} + ".tmp", "w").write(str(child.pid))
+        _os.replace({str(pid_file)!r} + ".tmp", {str(pid_file)!r})
         print("stage spawned child", child.pid, file=sys.stderr, flush=True)
         time.sleep(300)
     """)
@@ -78,7 +80,8 @@ def test_sigterm_forwarding_kills_inflight_stage(tmp_path):
     pid_file = tmp_path / "stage.pid"
     script = textwrap.dedent(f"""
         import os, time
-        open({str(pid_file)!r}, "w").write(str(os.getpid()))
+        open({str(pid_file)!r} + ".tmp", "w").write(str(os.getpid()))
+        os.replace({str(pid_file)!r} + ".tmp", {str(pid_file)!r})
         time.sleep(300)
     """)
     import threading
