@@ -63,3 +63,32 @@ def _reset_singletons():
     FedMLDifferentialPrivacy._instance = None
     FedMLFHE._instance = None
     Context._instance = None
+
+
+def spawn_to_logs(cmds, tmp_path, env=None, timeout=600, names=None):
+    """Run N subprocesses with FILE-backed stdout/stderr and wait for all.
+
+    Multi-process federation tests must never use stdout=PIPE with
+    sequential communicate(): a party whose pipe fills before its turn
+    blocks in write() and deadlocks the whole federation (the persistent
+    compile cache's AOT-load warnings alone exceed the 64KB pipe buffer).
+    Returns (procs, outs). On timeout, every survivor is killed first so one
+    hung party cannot cascade into N sequential timeouts.
+    """
+    import subprocess
+
+    names = names or [f"proc{i}" for i in range(len(cmds))]
+    logs = [tmp_path / f"{n}.log" for n in names]
+    procs = []
+    for cmd, log_path in zip(cmds, logs):
+        with open(log_path, "w") as log_f:
+            procs.append(subprocess.Popen(
+                cmd, env=env, stdout=log_f, stderr=subprocess.STDOUT, text=True))
+    try:
+        for p in procs:
+            p.communicate(timeout=timeout)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return procs, [log.read_text() for log in logs]

@@ -51,17 +51,15 @@ def test_mpirun_style_multiprocess_grpc(tmp_path):
     env["REPO_ROOT"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     run_id = f"mpi_sem_{os.getpid()}"
 
+    from tests.conftest import spawn_to_logs
+
     # clients first, then server — exactly the mpirun rank layout; the gRPC
     # sender retries absorb startup ordering
-    procs = [
-        subprocess.Popen([sys.executable, str(script), str(rank), role, run_id],
-                         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for rank, role in [(1, "client"), (2, "client"), (0, "server")]
-    ]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=600)
-        outs.append(out)
+    ranks = [(1, "client"), (2, "client"), (0, "server")]
+    procs, outs = spawn_to_logs(
+        [[sys.executable, str(script), str(rank), role, run_id] for rank, role in ranks],
+        tmp_path, env=env, timeout=600, names=[f"rank{r}" for r, _ in ranks],
+    )
     assert all(p.returncode == 0 for p in procs), "\n\n".join(outs)
     assert sum("DONE rank=" in o for o in outs) == 3
     server_out = outs[2]

@@ -72,14 +72,12 @@ def test_two_process_slice_one_wan_talker(tmp_path):
     env = dict(os.environ)
     env["REPO_ROOT"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env.pop("XLA_FLAGS", None)  # single virtual device per process is fine
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(r), str(port), str(tmp_path)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        )
-        for r in (0, 1)
-    ]
-    outs = [p.communicate(timeout=180)[0] for p in procs]
+    from tests.conftest import spawn_to_logs
+
+    procs, outs = spawn_to_logs(
+        [[sys.executable, str(script), str(r), str(port), str(tmp_path)] for r in (0, 1)],
+        tmp_path, env=env, timeout=180, names=["worker0", "worker1"],
+    )
     assert all(p.returncode == 0 for p in procs), outs
 
     # exactly one process opened the WAN
