@@ -10,12 +10,26 @@ on CPU — the only way to verify the contract without a chip.
 
 from __future__ import annotations
 
+import glob
+import json
 import os
+import signal
 import sys
 import textwrap
 import time
 
 import bench
+import pytest
+
+
+@pytest.fixture
+def _restore_signals():
+    """bench.main() installs SIGTERM/SIGINT handlers; a pytest process must
+    get its own back or Ctrl-C/outer timeouts bypass normal teardown."""
+    saved = {sig: signal.getsignal(sig) for sig in (signal.SIGINT, signal.SIGTERM)}
+    yield
+    for sig, handler in saved.items():
+        signal.signal(sig, handler)
 
 
 def _alive(pid: int) -> bool:
@@ -105,3 +119,95 @@ def test_sigterm_forwarding_kills_inflight_stage(tmp_path):
     bench._kill_stage_group(bench._CURRENT_STAGE_PROC)
     assert done.wait(timeout=10)
     assert not _alive(stage_pid)
+
+
+# --- main() merge/artifact/rc contract (runs exactly once per capture) -------
+
+
+def _canned_stages(monkeypatch, tmp_path, results):
+    """Patch the orchestrator's seams: no backend probe, canned stage
+    results, artifacts under tmp_path."""
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+
+    def fake_spawn(name, budget_s, argv=None):
+        return results.get(name, (None, f"{name}: canned failure"))
+
+    monkeypatch.setattr(bench, "_spawn_stage", fake_spawn)
+
+
+_LLM_OK = ({
+    "tokens_per_sec": 50000.0, "mfu": 0.41, "attention_impl": "pallas",
+    "step_flops": 1e12, "n_params": 268000000, "device": "TPU v5 lite",
+    "shape": {"d_model": 1024, "n_layers": 16, "n_heads": 16, "d_ff": 2752,
+              "vocab": 32000, "seq": 1024, "bs": 8},
+    "remat": False,
+}, None)
+
+
+def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _restore_signals):
+    _canned_stages(monkeypatch, tmp_path, {
+        "llm_pallas": _LLM_OK,
+        "llm_xla": ({"tokens_per_sec": 30000.0, "mfu": 0.23, "remat": False,
+                     "attention_impl": "xla", "n_params": 268000000,
+                     "shape": _LLM_OK[0]["shape"], "device": "TPU v5 lite",
+                     "step_flops": 1e12}, None),
+        "decode": ({"decode_tokens_per_sec": 900.0, "bs": 4, "new": 128}, None),
+        "resnet": ({"steps_per_sec": 20.0, "mfu": 0.2, "bs": 128}, None),
+        "cpu_llm": ({"cpu_llm_tokens_per_sec": 100.0}, None),
+        "cpu_resnet": ({"cpu_resnet_images_per_sec": 80.0}, None),
+        "serving": ({"endpoint_decode_tokens_per_sec": 700.0,
+                     "endpoint_replicas": 2, "endpoint_requests": 12,
+                     "endpoint_model": "llama-268M flagship proxy (bf16)",
+                     "endpoint_batching": "dynamic"}, None),
+    })
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["metric"] == "llm_train_tokens_per_sec"
+    assert out["value"] == 50000.0
+    assert out["mfu"] == 0.41
+    assert out["mfu_xla_attention"] == 0.23
+    assert out["remat_xla_attention"] is False
+    assert out["vs_baseline"] == 500.0  # 50000 / 100
+    assert out["resnet56_vs_torch_cpu"] == 32.0  # 20*128 / 80
+    assert out["endpoint_replicas"] == 2
+    assert out["stages_failed"] == []
+    # incremental artifacts landed (one per stage + final, same stamp file)
+    arts = glob.glob(str(tmp_path / "BENCH_MEASURED_*.json"))
+    assert len(arts) == 1
+    with open(arts[0]) as f:
+        doc = json.loads(f.read())
+    assert "_stages" in doc and doc["value"] == 50000.0
+
+
+def test_main_headline_failure_records_and_exits_nonzero(monkeypatch, tmp_path, capsys, _restore_signals):
+    _canned_stages(monkeypatch, tmp_path, {
+        "llm_pallas": (None, "llm_pallas: rc=1 RESOURCE_EXHAUSTED: fake"),
+        "resnet": ({"steps_per_sec": 20.0, "mfu": 0.2, "bs": 128}, None),
+    })
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    # rc contract: nonzero only because the HEADLINE is missing
+    assert exc.value.code == 1
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] is None
+    assert any("RESOURCE_EXHAUSTED" in f for f in out["stages_failed"])
+    # the resnet number still shipped despite the headline failure
+    assert out["resnet56_steps_per_sec"] == 20.0
+
+
+def test_main_probe_timeout_prints_structured_skip(monkeypatch, tmp_path, capsys, _restore_signals):
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+
+    def raise_timeout(*a, **k):
+        raise bench.BenchProbeTimeout("tunnel stalled")
+
+    monkeypatch.setattr(bench, "_probe_backend", raise_timeout)
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 1
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["skipped"] == "tunnel_stalled"
