@@ -1,0 +1,87 @@
+"""Mixed-federation demo: our server + the reference's unmodified MQTT_S3
+client complete two FedAvg rounds (see README.md).
+
+Requires the reference checkout at /root/reference (or REFERENCE_PATH).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import types
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+REFERENCE = os.environ.get("REFERENCE_PATH", "/root/reference/python")
+
+
+def main():
+    if not os.path.isdir(REFERENCE):
+        raise SystemExit(f"reference checkout not found at {REFERENCE}")
+
+    from fedml_tpu.core.distributed.communication.mqtt_s3.socket_broker import SocketMqttBroker
+    from fedml_tpu.cross_silo.server.fedml_aggregator import FedMLAggregator
+    from fedml_tpu.cross_silo.server.fedml_server_manager import FedMLServerManager
+    from tests.test_reference_interop_mqtt import _NumpyDictAggregator
+
+    comm_round = 2
+    broker = SocketMqttBroker()
+    workdir = tempfile.mkdtemp(prefix="interop_demo_")
+    bucket = os.path.join(workdir, "bucket")
+    out_path = os.path.join(workdir, "client_out.json")
+
+    args = types.SimpleNamespace(
+        comm_round=comm_round, client_num_in_total=1, client_num_per_round=1,
+        run_id=0, backend="MQTT_S3", mqtt_s3_wire="fedml",
+        mqtt_socket=broker.address, mqtt_s3_bucket_dir=bucket,
+        frequency_of_the_test=100, disable_alg_frame_hooks=True,
+    )
+    init = {"weight": np.zeros((2, 10), np.float32), "bias": np.zeros((2,), np.float32)}
+    aggregator = FedMLAggregator(
+        None, None, 64, {0: None}, {0: None}, {0: 64}, 1, None, args,
+        server_aggregator=_NumpyDictAggregator(dict(init), args),
+    )
+
+    class Lingering(FedMLServerManager):
+        def finish(self):
+            time.sleep(2.0)
+            super().finish()
+
+    server = Lingering(args, aggregator, client_rank=0, client_num=1, backend="MQTT_S3")
+    threading.Thread(target=server.run, daemon=True).start()
+    print(f"[demo] our server up: broker {broker.address}, bucket {bucket}")
+
+    env = dict(os.environ, PYTHONPATH=REPO, INTEROP_BROKER=broker.address,
+               INTEROP_BUCKET_DIR=bucket, INTEROP_COMM_ROUND=str(comm_round),
+               INTEROP_OUT=out_path, REFERENCE_PATH=REFERENCE, JAX_PLATFORMS="cpu")
+    print("[demo] starting the REFERENCE MQTT_S3 client (unmodified stack)...")
+    client = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests", "interop", "run_reference_mqtt_client.py")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=240,
+    )
+    broker.stop()
+    if client.returncode != 0:
+        print(client.stdout[-2000:])
+        raise SystemExit("reference client failed")
+
+    result = json.loads(open(out_path).read())
+    print(f"[demo] reference client completed {result['rounds_completed']} rounds")
+    ours = aggregator.get_global_model_params()
+    theirs = {k: np.asarray(v, np.float32) for k, v in result["final"].items()}
+    for k in theirs:
+        np.testing.assert_allclose(ours[k], theirs[k], atol=1e-6)
+    print("[demo] final models IDENTICAL on both sides — mixed federation works")
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,30 @@ from __future__ import annotations
 import os
 
 
+def bench_predictor_config(tiny: bool, flagship: bool, tok_vocab: int):
+    """Geometry selection for the serving-bench predictor (pure — testable
+    without building params). Flagship keeps the train bench's 32000-entry
+    embedding/head (the BPE tokenizer only emits ids < tok_vocab, a valid
+    subset) so the param count matches the headline model, not a shrunken
+    cousin."""
+    import jax.numpy as jnp
+
+    from ..models.transformer import TransformerConfig
+
+    return TransformerConfig(
+        vocab_size=32000 if flagship else tok_vocab,
+        d_model=64 if tiny else (1024 if flagship else 512),
+        n_layers=2 if tiny else (16 if flagship else 8),
+        n_heads=4 if tiny else (16 if flagship else 8),
+        n_kv_heads=4 if tiny else (16 if flagship else 8),
+        d_ff=128 if tiny else (2752 if flagship else 1376),
+        max_seq_len=64 if tiny else 256,
+        dtype=jnp.float32 if tiny else jnp.bfloat16,
+        remat=False,
+        lora_rank=0,
+    )
+
+
 def llm_bench_predictor():
     """Llama-family model + BPE tokenizer, deterministic init, warmed up
     before the replica reports ready.
@@ -37,7 +61,7 @@ def llm_bench_predictor():
 
     import jax.numpy as jnp
 
-    from ..models.transformer import TransformerConfig, TransformerLM
+    from ..models.transformer import TransformerLM
     from ..train.llm.tokenizer import train_bpe
     from .fedml_predictor import LLMPredictor
 
@@ -47,21 +71,7 @@ def llm_bench_predictor():
         ["federated benchmark serving endpoint throughput measure " * 4] * 8,
         vocab_size=512,
     )
-    # flagship keeps the train bench's 32000-entry embedding/head (the BPE
-    # tokenizer only emits ids < 512, which is a valid subset) so the param
-    # count matches the headline model, not a shrunken cousin
-    cfg = TransformerConfig(
-        vocab_size=32000 if flagship else tok.vocab_size,
-        d_model=64 if tiny else (1024 if flagship else 512),
-        n_layers=2 if tiny else (16 if flagship else 8),
-        n_heads=4 if tiny else (16 if flagship else 8),
-        n_kv_heads=4 if tiny else (16 if flagship else 8),
-        d_ff=128 if tiny else (2752 if flagship else 1376),
-        max_seq_len=64 if tiny else 256,
-        dtype=jnp.float32 if tiny else jnp.bfloat16,
-        remat=False,
-        lora_rank=0,
-    )
+    cfg = bench_predictor_config(tiny, flagship, tok.vocab_size)
     params = TransformerLM(cfg).init(
         jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
     )["params"]

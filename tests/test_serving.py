@@ -223,3 +223,22 @@ def test_micro_batcher_isolates_bad_requests():
         assert results[1].get("code") == 500 or "error" in results[1]
     finally:
         runner.stop()
+
+
+def test_flagship_predictor_geometry_matches_headline_model():
+    """The serving bench's flagship mode must serve the SAME model class the
+    train bench measures (BASELINE config 5 / VERDICT r3 missing #4) — a
+    silent geometry drift would make the endpoint number incomparable."""
+    import bench
+    from fedml_tpu.serving.bench_predictors import bench_predictor_config
+
+    cfg = bench_predictor_config(tiny=False, flagship=True, tok_vocab=512)
+    s = bench._LLM_SHAPE
+    assert cfg.vocab_size == s["vocab"]
+    assert cfg.d_model == s["d_model"]
+    assert cfg.n_layers == s["n_layers"]
+    assert cfg.n_heads == s["n_heads"]
+    assert cfg.d_ff == s["d_ff"]
+
+    tiny = bench_predictor_config(tiny=True, flagship=False, tok_vocab=512)
+    assert tiny.d_model == 64 and tiny.n_layers == 2  # CPU harness stays tiny
