@@ -57,6 +57,14 @@ import numpy as np
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
+
+def _p(msg: str) -> None:
+    """Stage progress marker: a timed-out stage's killpg leaves only its
+    stderr tail behind, so every expensive phase announces itself — the
+    orchestrator's failure record then pins WHERE the hang was (array
+    upload vs compile vs measurement), not just that 1500s elapsed."""
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
 # --- chip peak table (dense TFLOPS; bf16, f32≈bf16/2) ------------------------
 _PEAK_BF16_TFLOPS = {
     "v2": 45.0,
@@ -168,11 +176,13 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
 
     from fedml_tpu.parallel.fsdp import causal_lm_loss
 
+    _p(f"llm bench: building model (attention={attention_impl} remat={remat})")
     model, cfg, params = _build_llm(attention_impl, remat)
     s = _LLM_SHAPE
     vocab, seq = s["vocab"], s["seq"]
     bs = int(bs or s["bs"])
     n_params = sum(x.size for x in jax.tree.leaves(params))
+    _p(f"llm bench: {n_params/1e6:.0f}M params initialized")
     tx = optax.adamw(1e-4)
     opt_state = tx.init(params)
 
@@ -188,9 +198,13 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
     # one distinct batch per rep (+1 for the profile step): no two
     # dispatches see the same inputs
     batches = [jnp.asarray(rng.integers(0, vocab, (bs, seq)).astype(np.int32)) for _ in range(reps + 3)]
+    _p(f"llm bench: {len(batches)} batches of ({bs},{seq}) on device; compiling step")
 
-    xla_flops = _cost_analysis_flops(step.lower(params, opt_state, batches[0]).compile())
+    compiled = step.lower(params, opt_state, batches[0]).compile()
+    xla_flops = _cost_analysis_flops(compiled)
+    _p("llm bench: compile done; warmup step")
     float(step(params, opt_state, batches[0])[2])  # warmup (excluded)
+    _p("llm bench: warmup done; timing chain")
 
     def step_once(state, r):
         p, o = (params, opt_state) if state is None else (state[0], state[1])
@@ -609,10 +623,16 @@ def _probe_backend(timeout_s: int = 180) -> None:
     axon backend blocks forever in native code when the tunnel is down
     (uninterruptible by SIGALRM), which would eat the driver's whole bench
     timeout with no diagnostic. Probe in a killable subprocess BEFORE any
-    stage subprocess is spawned."""
+    stage subprocess is spawned.
+
+    The probe (tools/tpu_probe.py, shared with bench_watch.sh) EXECUTES a
+    jitted op and fetches the result — listing devices only exercises the
+    tunnel's control plane, and a window where metadata answers but compute
+    stalls (observed: every stage of a run hung while jax.devices() kept
+    succeeding) must read as DOWN, not up."""
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", "import jax; d=jax.devices()[0]; print(getattr(d,'device_kind',d))"],
+            [sys.executable, os.path.join(_REPO, "tools", "tpu_probe.py")],
             capture_output=True, text=True, timeout=timeout_s,
         )
     except subprocess.TimeoutExpired:
@@ -905,6 +925,15 @@ def main() -> None:
 
     llm = stage_out.get("llm_pallas")
     llm_xla = stage_out.get("llm_xla")
+    if llm is None and llm_xla is not None:
+        # The pallas stage's in-process fallback ladder handles exceptions,
+        # but a HANG (e.g. a Mosaic compile that never returns over the
+        # tunnel) ends in killpg — no ladder runs. Promote the measured xla
+        # stage to the headline rather than shipping value:null next to a
+        # perfectly good number; attention_impl="xla" keeps it honest.
+        print("warning: llm_pallas stage produced nothing; promoting llm_xla "
+              "measurement to the headline", file=sys.stderr)
+        llm = llm_xla
     decode = stage_out.get("decode")
     resnet = stage_out.get("resnet")
     serving = stage_out.get("serving") or {"endpoint_decode_tokens_per_sec": None}

@@ -32,7 +32,7 @@ def main():
     from fedml_tpu.core.distributed.communication.mqtt_s3.socket_broker import SocketMqttBroker
     from fedml_tpu.cross_silo.server.fedml_aggregator import FedMLAggregator
     from fedml_tpu.cross_silo.server.fedml_server_manager import FedMLServerManager
-    from tests.test_reference_interop_mqtt import _NumpyDictAggregator
+    from tests.interop.fixtures import NumpyDictAggregator
 
     comm_round = 2
     broker = SocketMqttBroker()
@@ -49,7 +49,7 @@ def main():
     init = {"weight": np.zeros((2, 10), np.float32), "bias": np.zeros((2,), np.float32)}
     aggregator = FedMLAggregator(
         None, None, 64, {0: None}, {0: None}, {0: 64}, 1, None, args,
-        server_aggregator=_NumpyDictAggregator(dict(init), args),
+        server_aggregator=NumpyDictAggregator(dict(init), args),
     )
 
     class Lingering(FedMLServerManager):
@@ -72,6 +72,7 @@ def main():
     broker.stop()
     if client.returncode != 0:
         print(client.stdout[-2000:])
+        print(client.stderr[-2000:], file=sys.stderr)  # the traceback lives here
         raise SystemExit("reference client failed")
 
     result = json.loads(open(out_path).read())

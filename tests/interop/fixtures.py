@@ -53,3 +53,40 @@ class NumpyLRTrainer:
 
     def test(self, test_data, device, args):
         return {}
+
+
+class NumpyDictAggregator:
+    """Minimal alg-frame server aggregator over torch-style state dicts
+    (dict[str, np.ndarray]) — what reference clients upload. Shared by both
+    interop test files and examples/interop/run_mixed_demo.py."""
+
+    def __init__(self, params, args):
+        self.model = params
+        self.args = args
+        self.id = 0
+
+    def get_model_params(self):
+        return self.model
+
+    def set_model_params(self, p):
+        self.model = p
+
+    def on_before_aggregation(self, model_list):
+        return model_list
+
+    def aggregate(self, model_list):
+        total = float(sum(n for n, _ in model_list))
+        keys = model_list[0][1].keys()
+        return {
+            k: sum((n / total) * np.asarray(p[k], np.float64) for n, p in model_list).astype(np.float32)
+            for k in keys
+        }
+
+    def on_after_aggregation(self, p):
+        return p
+
+    def assess_contribution(self):
+        pass
+
+    def test(self, test_data, device, args):
+        return {}

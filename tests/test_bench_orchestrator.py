@@ -199,6 +199,30 @@ def test_main_headline_failure_records_and_exits_nonzero(monkeypatch, tmp_path, 
     assert out["resnet56_steps_per_sec"] == 20.0
 
 
+def test_main_promotes_xla_stage_when_pallas_stage_dies(monkeypatch, tmp_path, capsys, _restore_signals):
+    """A HANG in the pallas stage ends in killpg — the in-process fallback
+    ladder never runs. With a measured llm_xla stage in hand the orchestrator
+    must ship IT as the headline (attention_impl keeps the substitution
+    honest) rather than value:null with rc=1."""
+    _canned_stages(monkeypatch, tmp_path, {
+        "llm_pallas": (None, "llm_pallas: timeout after 1500s (last stderr: compiling step)"),
+        "llm_xla": ({"tokens_per_sec": 30000.0, "mfu": 0.23, "remat": False,
+                     "attention_impl": "xla", "n_params": 268000000,
+                     "shape": _LLM_OK[0]["shape"], "device": "TPU v5 lite",
+                     "step_flops": 1e12}, None),
+        "cpu_llm": ({"cpu_llm_tokens_per_sec": 100.0}, None),
+    })
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0  # a verified headline number exists
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 30000.0
+    assert out["attention_impl"] == "xla"
+    assert out["mfu"] == 0.23
+    assert out["vs_baseline"] == 300.0
+    assert any("llm_pallas: timeout" in f for f in out["stages_failed"])
+
+
 def test_main_probe_timeout_prints_structured_skip(monkeypatch, tmp_path, capsys, _restore_signals):
     monkeypatch.setattr(bench, "_REPO", str(tmp_path))
 

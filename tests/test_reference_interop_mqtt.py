@@ -24,6 +24,8 @@ import types
 import numpy as np
 import pytest
 
+from tests.interop.fixtures import NumpyDictAggregator
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 REFERENCE = "/root/reference/python"
 
@@ -65,41 +67,6 @@ def test_ref_bucket_store_matches_reference_payload_format(tmp_path):
         store.read_model("evil")
 
 
-class _NumpyDictAggregator:
-    """Minimal alg-frame server aggregator over torch-style state dicts
-    (dict[str, np.ndarray]) — what reference clients upload."""
-
-    def __init__(self, params, args):
-        self.model = params
-        self.args = args
-        self.id = 0
-
-    def get_model_params(self):
-        return self.model
-
-    def set_model_params(self, p):
-        self.model = p
-
-    def on_before_aggregation(self, model_list):
-        return model_list
-
-    def aggregate(self, model_list):
-        total = float(sum(n for n, _ in model_list))
-        keys = model_list[0][1].keys()
-        return {
-            k: sum((n / total) * np.asarray(p[k], np.float64) for n, p in model_list).astype(np.float32)
-            for k in keys
-        }
-
-    def on_after_aggregation(self, p):
-        return p
-
-    def assess_contribution(self):
-        pass
-
-    def test(self, test_data, device, args):
-        return {}
-
 
 @pytest.mark.slow
 def test_reference_mqtt_s3_client_completes_rounds_against_our_server(tmp_path):
@@ -132,7 +99,7 @@ def test_reference_mqtt_s3_client_completes_rounds_against_our_server(tmp_path):
         train_global=None, test_global=None, all_train_data_num=64,
         train_data_local_dict={0: None}, test_data_local_dict={0: None},
         train_data_local_num_dict={0: 64}, client_num=1, device=None,
-        args=args, server_aggregator=_NumpyDictAggregator(dict(init_params), args),
+        args=args, server_aggregator=NumpyDictAggregator(dict(init_params), args),
     )
 
     class LingeringServerManager(FedMLServerManager):

@@ -44,7 +44,12 @@ commit_artifacts() {
 }
 
 while true; do
-  if timeout "$PROBE_TIMEOUT" python -c "import jax; print(jax.devices()[0])" >/dev/null 2>&1; then
+  # tpu_probe.py EXECUTES a jitted op (shared with bench.py's _probe_backend
+  # — one definition): jax.devices() alone only proves the tunnel's control
+  # plane, and windows exist where metadata answers while every
+  # compile/execute RPC stalls (2026-07-31: a whole bench run of stage
+  # timeouts behind a "green" devices() probe)
+  if timeout "$PROBE_TIMEOUT" python tools/tpu_probe.py >/dev/null 2>&1; then
     if [ ! -f "$SMOKE_STAMP" ]; then
       log "tunnel up — running pallas TPU smoke"
       if timeout "$SMOKE_TIMEOUT" python tools/tpu_smoke_flash.py >/tmp/smoke_tpu.log 2>&1; then

@@ -1,0 +1,19 @@
+"""Tunnel liveness probe, shared by bench.py's _probe_backend and
+tools/bench_watch.sh — ONE definition so a future probe hardening cannot
+land in one caller and not the other.
+
+EXECUTES a jitted op and fetches the result: jax.devices() alone only
+exercises the tunnel's control plane, and windows exist where metadata
+answers while every compile/execute RPC stalls (2026-07-31: a whole bench
+run of stage timeouts behind a "green" devices() probe).
+
+Prints the device kind and exits 0 when compute works; any hang is the
+CALLER's job to bound with a timeout (the stall is uninterruptible native
+code, so the probe must run as a killable subprocess).
+"""
+import jax
+
+device = jax.devices()[0]
+value = float(jax.jit(lambda x: x * 2.0 + 1.0)(20.5))
+assert value == 42.0, f"compute returned {value}, expected 42.0"
+print(getattr(device, "device_kind", device))

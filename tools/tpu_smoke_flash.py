@@ -16,20 +16,21 @@ def main():
     key = jax.random.PRNGKey(0)
     B, Hq, Hkv, T, D = 2, 8, 2, 512, 64
     kq, kk, kv, kg = jax.random.split(key, 4)
-    q = jax.random.normal(kq, (B, Hq, T, D), jnp.bfloat16)
-    k = jax.random.normal(kk, (B, Hkv, T, D), jnp.bfloat16)
-    v = jax.random.normal(kv, (B, Hkv, T, D), jnp.bfloat16)
-    do = jax.random.normal(kg, (B, Hq, T, D), jnp.bfloat16)
+    # flash_attention's layout is [B, T, H, D] (flash_attention.py:340)
+    q = jax.random.normal(kq, (B, T, Hq, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, T, Hkv, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, T, Hkv, D), jnp.bfloat16)
+    do = jax.random.normal(kg, (B, T, Hq, D), jnp.bfloat16)
 
     def ref(q, k, v):
         G = Hq // Hkv
-        kk_ = jnp.repeat(k, G, axis=1)
-        vv = jnp.repeat(v, G, axis=1)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), kk_.astype(jnp.float32)) / (D ** 0.5)
+        kk_ = jnp.repeat(k, G, axis=2)
+        vv = jnp.repeat(v, G, axis=2)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), kk_.astype(jnp.float32)) / (D ** 0.5)
         mask = jnp.tril(jnp.ones((T, T), bool))
         s = jnp.where(mask, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhqk,bhkd->bhqd", p, vv.astype(jnp.float32)).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, vv.astype(jnp.float32)).astype(q.dtype)
 
     out_p = flash_attention(q, k, v, causal=True)
     out_r = ref(q, k, v)
