@@ -54,15 +54,36 @@ DATASET_URLS: Dict[str, List[str]] = {
         "https://fednlp.s3-us-west-1.amazonaws.com/data_files/20news_data.h5",
         "https://fednlp.s3-us-west-1.amazonaws.com/partition_files/20news_partition.h5",
     ],
+    # idx-ubyte quadruplet — the canonical fashion-mnist distribution (the
+    # reference fetches the same files via torchvision FashionMNIST)
+    "fashion_mnist": [
+        "http://fashion-mnist.s3-website.eu-central-1.amazonaws.com/train-images-idx3-ubyte.gz",
+        "http://fashion-mnist.s3-website.eu-central-1.amazonaws.com/train-labels-idx1-ubyte.gz",
+        "http://fashion-mnist.s3-website.eu-central-1.amazonaws.com/t10k-images-idx3-ubyte.gz",
+        "http://fashion-mnist.s3-website.eu-central-1.amazonaws.com/t10k-labels-idx1-ubyte.gz",
+    ],
+    # user-split mapping csvs + image archive (reference
+    # Landmarks/download_from_aws_s3.sh)
+    "landmarks": [
+        "https://fedcv.s3-us-west-1.amazonaws.com/landmark/data_user_dict.zip",
+        "https://fedcv.s3-us-west-1.amazonaws.com/landmark/images.zip",
+    ],
+    # UCI streaming sources (reference data/UCI/*/download_*.sh)
+    "uci": [
+        "http://archive.ics.uci.edu/ml/machine-learning-databases/00279/SUSY.csv.gz",
+        "https://archive.ics.uci.edu/ml/machine-learning-databases/00357/occupancy_data.zip",
+    ],
 }
+DATASET_URLS["gld23k"] = DATASET_URLS["landmarks"]
 
 
 def egress_available(url: str, timeout_s: float = 3.0) -> bool:
     """Cheap TCP probe of the archive host — a zero-egress box must fail in
     seconds, not hang a multi-minute HTTP timeout."""
-    host = urllib.parse.urlparse(url).netloc
+    parsed = urllib.parse.urlparse(url)
+    port = parsed.port or (80 if parsed.scheme == "http" else 443)
     try:
-        with socket.create_connection((host, 443), timeout=timeout_s):
+        with socket.create_connection((parsed.hostname, port), timeout=timeout_s):
             return True
     except OSError:
         return False
@@ -76,6 +97,16 @@ def _extract(archive: str, dest: str, name_hint: str | None = None) -> None:
     elif kind.endswith((".tar.bz2", ".tar.gz", ".tgz")):
         with tarfile.open(archive) as t:
             t.extractall(dest, filter="data")
+    elif kind.endswith(".gz") and not kind.endswith(".tar.gz"):
+        # single-file gzip (SUSY.csv.gz): decompress beside the archive for
+        # loaders that read plain text; idx .gz files are ALSO consumed
+        # compressed, so keeping the original around is harmless either way
+        import gzip
+        import shutil as _shutil
+
+        out = os.path.join(dest, os.path.basename(kind)[:-3])
+        with gzip.open(archive, "rb") as src, open(out, "wb") as dst:
+            _shutil.copyfileobj(src, dst)
     # bare files (.csv/.pkl) need no extraction
 
 

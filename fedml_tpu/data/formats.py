@@ -279,6 +279,11 @@ def detect_format_files(dataset: str, cache: str) -> Optional[str]:
                    and os.path.exists(os.path.join(d, f"{name}_partition.h5")))
             for name in ("20news", "agnews", "sst2", "semeval_2010_task8")
         },
+        **{
+            name: (lambda d=d: _find_landmarks_csv(d, "train") is not None
+                   and os.path.isdir(os.path.join(d, "images")))
+            for name in ("landmarks", "gld23k")
+        },
     }
     fn = checks.get(dataset)
     try:
@@ -306,6 +311,8 @@ def load_native_format(dataset: str, cache: str, client_num: Optional[int] = Non
         train, test, classes = load_stackoverflow_lr_h5(d)
     elif dataset in ("20news", "agnews", "sst2", "semeval_2010_task8"):
         train, test, classes = load_fednlp_text_clf(d, dataset, partition_method=partition_method)
+    elif dataset in ("landmarks", "gld23k"):
+        train, test, classes = load_landmarks_csv(d)
     else:
         raise ValueError(f"no native-format loader for {dataset!r}")
     log.info("dataset %s: loaded NATIVE format files from %s (%d clients)", dataset, d, len(train))
@@ -505,3 +512,90 @@ def load_leaf_shakespeare(data_dir: str) -> Tuple[ClientData, ClientData, int]:
     train = _read_leaf_dir(os.path.join(data_dir, "train"), encode)
     test = _read_leaf_dir(os.path.join(data_dir, "test"), encode)
     return train, test, shakespeare_vocab_size()
+
+# --- Google Landmarks (gld23k/gld160k) user-split csv + images ----------------
+
+def _find_landmarks_csv(d: str, split: str) -> Optional[str]:
+    """The reference's mapping files live at
+    ``data_user_dict/gld{23k,160k}_user_dict_{train,test}.csv``
+    (reference Landmarks data_loader.py:329-340); accept them at the dataset
+    root too for hand-placed drops."""
+    for sub in ("data_user_dict", "."):
+        for scale in ("23k", "160k"):
+            p = os.path.join(d, sub, f"gld{scale}_user_dict_{split}.csv")
+            if os.path.exists(p):
+                return p
+    return None
+
+
+def load_landmarks_csv(
+    data_dir: str, image_size: Tuple[int, int] = (64, 64),
+    max_per_user: Optional[int] = None,
+) -> Tuple[ClientData, ClientData, int]:
+    """Google Landmarks from the reference's own on-disk pair: a
+    ``user_id,image_id,class`` mapping csv (the file's NATIVE per-user
+    federation — reference Landmarks data_loader.py:123-151 builds
+    mapping_per_user from exactly these columns) + ``images/<image_id>.jpg``
+    (datasets.py:48-51). Images resized to ``image_size``; rows whose jpg is
+    missing are skipped with a count so a partial image drop still trains.
+    ``max_per_user`` bounds host RAM (arrays are in-memory, unlike the
+    reference's lazy ImageFolder); defaults to FEDML_MAX_IMAGES_PER_USER
+    (200), truncation is counted and logged."""
+    import csv as _csv
+
+    from PIL import Image
+
+    if max_per_user is None:
+        max_per_user = int(os.environ.get("FEDML_MAX_IMAGES_PER_USER", 200))
+    train_csv = _find_landmarks_csv(data_dir, "train")
+    if train_csv is None:
+        raise FileNotFoundError(f"{data_dir}: no gld user_dict train csv")
+    test_csv = _find_landmarks_csv(data_dir, "test")
+    images_dir = os.path.join(data_dir, "images")
+
+    def read(path: str, per_user_cap: Optional[int]) -> Tuple[ClientData, int]:
+        rows_per_user: Dict[str, List[Tuple[str, int]]] = {}
+        max_class = -1
+        with open(path) as f:
+            for row in _csv.DictReader(f):
+                cls = int(row["class"])
+                max_class = max(max_class, cls)
+                rows_per_user.setdefault(row["user_id"], []).append((row["image_id"], cls))
+        out: ClientData = {}
+        missing = truncated = 0
+        for uid, rows in rows_per_user.items():
+            if per_user_cap and len(rows) > per_user_cap:
+                truncated += len(rows) - per_user_cap
+                rows = rows[:per_user_cap]
+            xs: List[np.ndarray] = []
+            ys: List[int] = []
+            for image_id, cls in rows:
+                p = os.path.join(images_dir, f"{image_id}.jpg")
+                if not os.path.exists(p):
+                    missing += 1
+                    continue
+                img = Image.open(p).convert("RGB")
+                if img.size != image_size:
+                    img = img.resize(image_size)
+                xs.append(np.asarray(img, np.uint8))
+                ys.append(cls)
+            if xs:
+                out[uid] = (np.stack(xs).astype(np.float32) / 255.0,
+                            np.asarray(ys, np.int64))
+        if missing:
+            log.warning("landmarks: %d mapping rows had no jpg under %s (skipped)",
+                        missing, images_dir)
+        if truncated:
+            log.warning("landmarks: capped at %d images/user (%d rows skipped) — "
+                        "raise FEDML_MAX_IMAGES_PER_USER to parse more",
+                        per_user_cap, truncated)
+        return out, max_class + 1
+
+    train, n_train_classes = read(train_csv, max_per_user)
+    if test_csv:
+        test, n_test_classes = read(test_csv, max_per_user)
+    else:
+        test, n_test_classes = {}, 0
+    if not train:
+        raise FileNotFoundError(f"{data_dir}: mapping csv present but no images resolved")
+    return train, test, max(n_train_classes, n_test_classes)

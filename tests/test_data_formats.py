@@ -404,3 +404,225 @@ def test_lending_club_csv(tmp_path):
     assert 0.15 < frac < 0.35
     # standardized features
     assert abs(float(np.concatenate([x_tr, x_te]).mean())) < 0.2
+
+
+# --- round 4 (cont.): fashion_mnist idx, cinic10 folder, landmarks, uci ------
+
+
+def _write_idx(path, arr, gz=True):
+    import gzip
+    import struct
+
+    arr = np.asarray(arr, np.uint8)
+    header = struct.pack(">I", 0x0800 | arr.ndim) + struct.pack(
+        ">" + "I" * arr.ndim, *arr.shape
+    )
+    data = header + arr.tobytes()
+    if gz:
+        with gzip.open(str(path) + ".gz", "wb") as f:
+            f.write(data)
+    else:
+        with open(path, "wb") as f:
+            f.write(data)
+
+
+def test_fashion_mnist_idx_ubyte(tmp_path):
+    from fedml_tpu.data.sources import load_image_dataset
+
+    rng = np.random.default_rng(3)
+    d = tmp_path / "fashion_mnist"
+    d.mkdir()
+    _write_idx(d / "train-images-idx3-ubyte", rng.integers(0, 256, (12, 28, 28)))
+    _write_idx(d / "train-labels-idx1-ubyte", rng.integers(0, 10, 12))
+    # mixed compression: gz train, raw test both parse
+    _write_idx(d / "t10k-images-idx3-ubyte", rng.integers(0, 256, (4, 28, 28)), gz=False)
+    _write_idx(d / "t10k-labels-idx1-ubyte", rng.integers(0, 10, 4), gz=False)
+    x_tr, y_tr, x_te, y_te, classes = load_image_dataset("fashion_mnist", str(tmp_path))
+    assert x_tr.shape == (12, 28, 28, 1) and x_te.shape == (4, 28, 28, 1)
+    assert classes == 10 and 0.0 <= x_tr.min() and x_tr.max() <= 1.0
+    assert y_tr.dtype == np.int64
+
+
+def test_idx_rejects_non_ubyte_magic(tmp_path):
+    import struct
+
+    from fedml_tpu.data.sources import _read_idx
+
+    p = tmp_path / "bad-idx"
+    with open(p, "wb") as f:  # 0x0D = float element type
+        f.write(struct.pack(">I", 0x0D02) + struct.pack(">II", 1, 1) + b"\x00" * 8)
+    with pytest.raises(ValueError, match="not an idx-ubyte"):
+        _read_idx(str(p))
+
+
+def _write_png_tree(root, split, per_class, size=(32, 32)):
+    from PIL import Image
+
+    rng = np.random.default_rng(hash(split) % 1000)
+    for cname, n in per_class.items():
+        d = root / split / cname
+        d.mkdir(parents=True)
+        for i in range(n):
+            arr = rng.integers(0, 256, size + (3,)).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"img{i}.png")
+
+
+def test_cinic10_image_folder(tmp_path):
+    from PIL import Image
+
+    from fedml_tpu.data.sources import load_image_dataset
+
+    root = tmp_path / "cinic10"
+    _write_png_tree(root, "train", {"airplane": 3, "cat": 3})
+    _write_png_tree(root, "test", {"airplane": 1, "cat": 1})
+    # a stray odd-sized file must be resized, not crash the stack
+    Image.fromarray(np.zeros((30, 30, 3), np.uint8)).save(root / "train" / "cat" / "odd.png")
+    x_tr, y_tr, x_te, y_te, classes = load_image_dataset("cinic10", str(tmp_path))
+    assert x_tr.shape == (7, 32, 32, 3) and x_te.shape == (2, 32, 32, 3)
+    # class ids follow sorted dir names: airplane=0, cat=1
+    assert classes == 2 and set(y_tr.tolist()) == {0, 1}
+
+
+def test_image_folder_cap_logged(tmp_path, monkeypatch, caplog):
+    from fedml_tpu.data.sources import load_image_dataset
+
+    root = tmp_path / "cinic10"
+    _write_png_tree(root, "train", {"a": 4, "b": 1})
+    _write_png_tree(root, "test", {"a": 1, "b": 1})
+    monkeypatch.setenv("FEDML_MAX_IMAGES_PER_CLASS", "2")
+    with caplog.at_level("WARNING"):
+        x_tr, *_ = load_image_dataset("cinic10", str(tmp_path))
+    assert len(x_tr) == 3  # 2 capped + 1
+    assert any("capped" in r.message for r in caplog.records)
+
+
+def _write_landmarks(tmp_path, n_users=3, per_user=4, classes=5):
+    import csv as _csv
+
+    from PIL import Image
+
+    root = tmp_path / "landmarks"
+    (root / "data_user_dict").mkdir(parents=True)
+    (root / "images").mkdir()
+    rng = np.random.default_rng(11)
+    rows = []
+    for u in range(n_users):
+        for i in range(per_user):
+            img_id = f"u{u}_{i}"
+            rows.append({"user_id": f"user{u}", "image_id": img_id,
+                         "class": int(rng.integers(0, classes))})
+            arr = rng.integers(0, 256, (64, 64, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(root / "images" / f"{img_id}.jpg")
+    for split, sel in (("train", rows[: n_users * per_user - 2]), ("test", rows[-2:])):
+        with open(root / "data_user_dict" / f"gld23k_user_dict_{split}.csv", "w", newline="") as f:
+            w = _csv.DictWriter(f, fieldnames=["user_id", "image_id", "class"])
+            w.writeheader()
+            w.writerows(sel)
+    return root
+
+
+def test_landmarks_user_csv_native_partition(tmp_path):
+    import fedml_tpu as fedml
+    from fedml_tpu.arguments import default_config
+
+    _write_landmarks(tmp_path)
+    assert detect_format_files("landmarks", str(tmp_path)) == "landmarks"
+    args = default_config(
+        "simulation", dataset="landmarks", client_num_in_total=3,
+        data_cache_dir=str(tmp_path),
+    )
+    dataset, out_dim = fedml.data.load(args)
+    (n_tr, n_te, _tr_g, _te_g, num_dict, tr_local, _te_local, cn) = dataset
+    assert len(tr_local) == 3 and n_tr == 10  # 12 images - 2 held out as test
+    assert tr_local[0].x.shape[1:] == (64, 64, 3)
+    assert out_dim == cn <= 5
+
+
+def test_landmarks_missing_jpg_skipped(tmp_path, caplog):
+    import os
+
+    from fedml_tpu.data.formats import load_landmarks_csv
+
+    root = _write_landmarks(tmp_path)
+    os.remove(root / "images" / "u0_0.jpg")
+    with caplog.at_level("WARNING"):
+        train, _test, _classes = load_landmarks_csv(str(root))
+    assert sum(len(y) for _x, y in train.values()) == 9
+    assert any("no jpg" in r.message for r in caplog.records)
+
+
+def test_uci_susy_csv(tmp_path):
+    from fedml_tpu.data.sources import load_tabular_dataset
+
+    d = tmp_path / "uci"
+    d.mkdir()
+    rng = np.random.default_rng(5)
+    with open(d / "SUSY.csv", "w") as f:
+        for i in range(30):
+            feats = ",".join(f"{v:.6f}" for v in rng.normal(0, 1, 18))
+            f.write(f"{float(i % 2):.18e},{feats}\n")
+    x_tr, y_tr, x_te, y_te, classes = load_tabular_dataset("uci", str(tmp_path))
+    assert classes == 2 and x_tr.shape[1] == 18
+    assert set(np.unique(np.concatenate([y_tr, y_te]))) == {0, 1}
+    assert abs(float(np.concatenate([x_tr, x_te]).mean())) < 0.2  # standardized
+
+
+def test_uci_room_occupancy_txt(tmp_path):
+    from fedml_tpu.data.sources import load_uci_csv
+
+    d = tmp_path / "uci"
+    d.mkdir()
+    with open(d / "datatraining.txt", "w") as f:
+        f.write('"date","Temperature","Humidity","Light","CO2","HumidityRatio","Occupancy"\n')
+        for i in range(20):
+            f.write(f'"{i}","2015-02-04 17:5{i % 10}:00",23.{i},27.2,426,721.25,0.004,{i % 2}\n')
+    x_tr, y_tr, x_te, y_te, classes = load_uci_csv(str(d / "datatraining.txt"), "room_occupancy")
+    assert classes == 2 and x_tr.shape[1] == 5  # Temperature..HumidityRatio
+    assert set(np.unique(np.concatenate([y_tr, y_te]))) == {0, 1}
+
+
+def test_image_folder_train_only_holdout_is_shuffled(tmp_path):
+    """A train-only drop's holdout must span classes (the array is
+    class-ordered; a prefix slice would make train/test class-disjoint)."""
+    from fedml_tpu.data.sources import load_image_dataset
+
+    root = tmp_path / "cinic10"
+    _write_png_tree(root, "train", {"a": 10, "b": 10})
+    x_tr, y_tr, x_te, y_te, classes = load_image_dataset("cinic10", str(tmp_path))
+    assert len(x_te) == 2 and len(x_tr) == 18
+    # both classes still trainable
+    assert set(y_tr.tolist()) == {0, 1}
+
+
+def test_image_folder_empty_tree_falls_back_to_surrogate(tmp_path, caplog):
+    from fedml_tpu.data.sources import load_image_dataset
+
+    (tmp_path / "cinic10" / "train" / "cat").mkdir(parents=True)  # dirs, no files
+    with caplog.at_level("WARNING"):
+        x_tr, _y, _xt, _yt, classes = load_image_dataset("cinic10", str(tmp_path))
+    assert classes == 10 and len(x_tr) > 0  # surrogate shape, not a crash
+    assert any("falling back to surrogate" in r.message for r in caplog.records)
+
+
+def test_uci_unparseable_csv_falls_back_to_surrogate(tmp_path, caplog):
+    from fedml_tpu.data.sources import load_tabular_dataset
+
+    d = tmp_path / "uci"
+    d.mkdir()
+    (d / "SUSY.csv").write_text("utterly,not\nnumeric,rows\n")
+    with caplog.at_level("WARNING"):
+        x_tr, *_rest, classes = load_tabular_dataset("uci", str(tmp_path))
+    assert classes == 2 and len(x_tr) > 0
+    assert any("falling back" in r.message or "surrogate" in r.message
+               for r in caplog.records)
+
+
+def test_landmarks_per_user_cap_logged(tmp_path, monkeypatch, caplog):
+    from fedml_tpu.data.formats import load_landmarks_csv
+
+    root = _write_landmarks(tmp_path, n_users=2, per_user=5)
+    monkeypatch.setenv("FEDML_MAX_IMAGES_PER_USER", "3")
+    with caplog.at_level("WARNING"):
+        train, _test, _classes = load_landmarks_csv(str(root))
+    assert all(len(y) <= 3 for _x, y in train.values())
+    assert any("capped" in r.message for r in caplog.records)
