@@ -75,7 +75,7 @@ def load(args: Any) -> FedDataset:
         return (len(train_g), len(test_g), train_g, test_g, train_num_dict, train_local, test_local, class_num)
 
     from .downloads import maybe_download
-    from .formats import detect_format_files, load_native_format
+    from .formats import FedDataConfigError, detect_format_files, load_native_format
 
     fmt = detect_format_files(dataset, cache)
     if not fmt and maybe_download(dataset, cache, bool(getattr(args, "allow_download", False))):
@@ -86,10 +86,21 @@ def load(args: Any) -> FedDataset:
     if fmt:
         # real reference-format files present (LEAF json / TFF h5): use them
         # with the file's own client partition
-        fed = load_native_format(
-            dataset, cache, client_num,
-            partition_method=getattr(args, "fednlp_partition_method", None),
-        )
+        try:
+            fed = load_native_format(
+                dataset, cache, client_num,
+                partition_method=getattr(args, "fednlp_partition_method", None),
+            )
+        except FedDataConfigError:
+            raise  # the files are fine; the CONFIG is wrong — tell the user
+        except (OSError, ValueError, KeyError) as e:
+            # detection is a cheap existence probe; a truncated/corrupt drop
+            # (e.g. the mapping csv extracted but images/ interrupted) must
+            # degrade to the surrogate loudly, never crash the training run
+            log.warning("dataset %s: native-format files detected but "
+                        "unparseable (%r) — falling back to surrogate", dataset, e)
+            fmt = None
+    if fmt:
         args.output_dim = fed[-1]
         # real files may carry a smaller feature space than the dataset's
         # canonical preset (e.g. a truncated word_count sidecar); record the

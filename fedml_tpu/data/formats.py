@@ -32,6 +32,12 @@ log = logging.getLogger(__name__)
 
 ClientData = Dict[str, Tuple[np.ndarray, np.ndarray]]
 
+
+class FedDataConfigError(ValueError):
+    """The FILES are fine but the user's config cannot be satisfied (e.g.
+    more clients requested than the file has users) — must surface to the
+    user, never be mistaken for a corrupt drop and silently surrogated."""
+
 # --- LEAF json ---------------------------------------------------------------
 
 
@@ -227,7 +233,7 @@ def clients_to_fed_dataset(
     uids = list(train.keys())
     n = client_num or len(uids)
     if n > len(uids):
-        raise ValueError(
+        raise FedDataConfigError(
             f"client_num_in_total={n} exceeds the file's {len(uids)} users; "
             f"every client needs at least one user's data"
         )
@@ -284,6 +290,7 @@ def detect_format_files(dataset: str, cache: str) -> Optional[str]:
                    and os.path.isdir(os.path.join(d, "images")))
             for name in ("landmarks", "gld23k")
         },
+        "reddit": lambda: bool(_reddit_txt_files(d, "train")),
     }
     fn = checks.get(dataset)
     try:
@@ -313,6 +320,8 @@ def load_native_format(dataset: str, cache: str, client_num: Optional[int] = Non
         train, test, classes = load_fednlp_text_clf(d, dataset, partition_method=partition_method)
     elif dataset in ("landmarks", "gld23k"):
         train, test, classes = load_landmarks_csv(d)
+    elif dataset == "reddit":
+        train, test, classes = load_reddit_text_dir(d)
     else:
         raise ValueError(f"no native-format loader for {dataset!r}")
     log.info("dataset %s: loaded NATIVE format files from %s (%d clients)", dataset, d, len(train))
@@ -599,3 +608,110 @@ def load_landmarks_csv(
     if not train:
         raise FileNotFoundError(f"{data_dir}: mapping csv present but no images resolved")
     return train, test, max(n_train_classes, n_test_classes)
+
+
+# --- reddit: per-user text files -> blocked LM examples -----------------------
+
+REDDIT_SEQ_LEN = 64
+
+
+def _reddit_txt_files(data_dir: str, split: str) -> List[str]:
+    """One ``.txt`` file per user (the reference enumerates a directory of
+    user files and bumps user_id per non-empty file —
+    ``data/reddit/nlp.py:53-71``). Accept ``{d}/{split}/*.txt`` or, for
+    train, a flat ``{d}/*.txt`` drop."""
+    import glob as _glob
+
+    for d in ([os.path.join(data_dir, split)] + ([data_dir] if split == "train" else [])):
+        files = sorted(_glob.glob(os.path.join(d, "*.txt")))
+        if files:
+            return files
+    return []
+
+
+def load_reddit_text_dir(
+    data_dir: str, seq_len: int = REDDIT_SEQ_LEN, vocab_size: Optional[int] = None,
+    max_users: Optional[int] = None, bpe_sample_bytes: int = 1 << 19,
+) -> Tuple[ClientData, ClientData, int]:
+    """Reddit LM corpus from a directory of per-user text files, blocked into
+    fixed-length next-token examples with a per-user federation — the
+    reference's exact structure (``data/reddit/nlp.py:53-71``: tokenize each
+    user file, truncate in blocks, client_mapping per user). Difference,
+    recorded here: the reference tokenizes with a PRETRAINED Albert subword
+    vocab fetched from the hub; zero egress makes that impossible, so a
+    byte-level BPE is trained ON the corpus itself (train/llm/tokenizer.py)
+    — deterministic, self-contained, same id-space contract (class_num =
+    vocab size). Users with fewer than seq_len+1 tokens yield no blocks,
+    exactly like the reference's ``len(tokenized_text) - block_size + 1``
+    guard."""
+    from ..train.llm.tokenizer import train_bpe
+
+    if vocab_size is None:
+        vocab_size = int(os.environ.get("FEDML_REDDIT_VOCAB", 2048))
+    if max_users is None:
+        max_users = int(os.environ.get("FEDML_REDDIT_MAX_USERS", 1000))
+
+    train_files = _reddit_txt_files(data_dir, "train")
+    if not train_files:
+        raise FileNotFoundError(f"{data_dir}: no per-user .txt files")
+    test_files = _reddit_txt_files(data_dir, "test")
+    if len(train_files) > max_users:
+        log.warning("reddit: capped at %d of %d user files — raise "
+                    "FEDML_REDDIT_MAX_USERS to parse more", max_users, len(train_files))
+        train_files = train_files[:max_users]
+    test_files = test_files[:max_users]
+
+    def read_texts(files: List[str]) -> Dict[str, str]:
+        out = {}
+        for path in files:
+            with open(path, encoding="utf-8", errors="ignore") as f:
+                text = f.read().strip()
+            if text:
+                out[os.path.splitext(os.path.basename(path))[0]] = text
+        return out
+
+    train_texts = read_texts(train_files)
+    test_texts = read_texts(test_files)
+    if not train_texts:
+        raise ValueError(f"{data_dir}: user files are all empty")
+
+    # BPE training cost is linear in sample size x vocab; a bounded sample
+    # keeps huge corpora loadable (the tokenizer only needs representative
+    # frequencies, not every byte)
+    sample, budget = [], bpe_sample_bytes
+    for text in train_texts.values():
+        sample.append(text[: max(0, budget)])
+        budget -= len(text)
+        if budget <= 0:
+            break
+    tok = train_bpe(sample, vocab_size=vocab_size)
+    vocab = len(tok.vocab) + len(tok.special_tokens)
+
+    def blocked(texts: Dict[str, str]) -> ClientData:
+        out: ClientData = {}
+        for uid, text in texts.items():
+            ids = tok.encode(text)
+            n_blocks = (len(ids) - 1) // seq_len
+            if n_blocks <= 0:
+                continue
+            arr = np.asarray(ids[: n_blocks * seq_len + 1], np.int64)
+            x = np.stack([arr[i * seq_len:(i + 1) * seq_len] for i in range(n_blocks)])
+            y = np.stack([arr[i * seq_len + 1:(i + 1) * seq_len + 1] for i in range(n_blocks)])
+            out[uid] = (x, y)
+        return out
+
+    train = blocked(train_texts)
+    test = blocked(test_texts)
+    if not train:
+        raise ValueError(f"{data_dir}: no user has >= {seq_len + 1} tokens")
+    if not test:
+        # no test/ drop: hold out each user's last block (their newest text,
+        # mirroring a temporal split)
+        test = {}
+        for uid, (x, y) in list(train.items()):
+            if len(x) > 1:
+                test[uid] = (x[-1:], y[-1:])
+                train[uid] = (x[:-1], y[:-1])
+    log.info("dataset reddit: %d users, %d train blocks, vocab %d (corpus-trained BPE)",
+             len(train), sum(len(x) for x, _ in train.values()), vocab)
+    return train, test, vocab

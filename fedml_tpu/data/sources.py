@@ -175,12 +175,18 @@ def load_idx_ubyte(idx_dir: str):
 
 # --- class-per-directory image folders (cinic10 / imagenet layout) -----------
 
-def max_images_per_class(default: int = 1000) -> int:
-    """In-memory cap per (split, class): full CINIC-10 is 270k images and the
-    reference streams it through a lazy torchvision ImageFolder; our
-    ArrayDataset holds arrays, so unbounded parsing would eat the host.
-    Raise via FEDML_MAX_IMAGES_PER_CLASS when the RAM exists."""
-    return int(os.environ.get("FEDML_MAX_IMAGES_PER_CLASS", default))
+def max_images_per_class(n_classes: int = 1, default: int = 1000,
+                         total_default: int = 50_000) -> int:
+    """In-memory cap per (split, class): the reference streams these trees
+    through a lazy torchvision ImageFolder; our ArrayDataset holds arrays,
+    so unbounded parsing would eat the host. Two knobs, the tighter wins:
+    FEDML_MAX_IMAGES_PER_CLASS (default 1000 — sized for CINIC's 10
+    classes) and FEDML_MAX_IMAGES_TOTAL per split (default 50k — a
+    1000-class imagenet drop would otherwise admit 1M images at the
+    per-class cap alone and OOM the host)."""
+    per_class = int(os.environ.get("FEDML_MAX_IMAGES_PER_CLASS", default))
+    total = int(os.environ.get("FEDML_MAX_IMAGES_TOTAL", total_default))
+    return max(1, min(per_class, total // max(1, n_classes)))
 
 
 def _image_folder_root(name: str, cache_dir: str) -> Optional[str]:
@@ -206,14 +212,30 @@ def load_image_folder(root: str, size: Tuple[int, int], test_split: str = "test"
     already 32x32; a stray odd-sized file must not break the batch shape)."""
     from PIL import Image
 
+    # class ids come from the TRAIN split's sorted dirs and are REUSED for
+    # test: re-deriving them per split silently misaligns every label when a
+    # partial drop is missing (or grew) a class dir in one split
+    train_dir = os.path.join(root, "train")
+    class_names = sorted(
+        c for c in os.listdir(train_dir) if os.path.isdir(os.path.join(train_dir, c))
+    )
+    class_ids = {c: i for i, c in enumerate(class_names)}
+
     def read_split(split: str):
         split_dir = os.path.join(root, split)
-        classes = sorted(
-            c for c in os.listdir(split_dir) if os.path.isdir(os.path.join(split_dir, c))
+        present = [c for c in class_names if os.path.isdir(os.path.join(split_dir, c))]
+        if not present:
+            raise FileNotFoundError(f"{split_dir}: none of the train classes present")
+        extra = sorted(
+            set(c for c in os.listdir(split_dir) if os.path.isdir(os.path.join(split_dir, c)))
+            - set(class_names)
         )
-        cap = max_images_per_class()
+        if extra:
+            log.warning("image folder %s/%s: ignoring %d class dirs absent from "
+                        "train (%s...)", root, split, len(extra), extra[0])
+        cap = max_images_per_class(n_classes=len(class_names))
         xs, ys, truncated = [], [], 0
-        for ci, cname in enumerate(classes):
+        for cname in present:
             cdir = os.path.join(split_dir, cname)
             files = sorted(f for f in os.listdir(cdir)
                            if f.lower().endswith((".png", ".jpg", ".jpeg")))
@@ -225,11 +247,12 @@ def load_image_folder(root: str, size: Tuple[int, int], test_split: str = "test"
                 if img.size != size:
                     img = img.resize(size)
                 xs.append(np.asarray(img, np.uint8))
-                ys.append(ci)
+                ys.append(class_ids[cname])
         if truncated:
             log.warning(
                 "image folder %s/%s: capped at %d images/class (%d skipped) — "
-                "raise FEDML_MAX_IMAGES_PER_CLASS to parse more", root, split, cap, truncated,
+                "raise FEDML_MAX_IMAGES_PER_CLASS / FEDML_MAX_IMAGES_TOTAL "
+                "to parse more", root, split, cap, truncated,
             )
         if not xs:
             # a partially-extracted drop can leave class dirs with no images;
@@ -238,7 +261,7 @@ def load_image_folder(root: str, size: Tuple[int, int], test_split: str = "test"
             # load_image_dataset — both see it as "split absent"
             raise FileNotFoundError(f"{split_dir}: no image files in any class dir")
         x = np.stack(xs).astype(np.float32) / 255.0
-        return x, np.asarray(ys, np.int64), len(classes)
+        return x, np.asarray(ys, np.int64), len(class_names)
 
     x_tr, y_tr, n_classes = read_split("train")
     try:

@@ -62,6 +62,7 @@ _INPUT_SPECS = {
     "shakespeare": ((1, 80), jnp.int32),
     "fed_shakespeare": ((1, 80), jnp.int32),
     "stackoverflow_nwp": ((1, 20), jnp.int32),
+    "reddit": ((1, 64), jnp.int32),  # formats.REDDIT_SEQ_LEN blocks
     "stackoverflow_lr": ((1, 10000), jnp.float32),
     # FedNLP text classification (BASELINE config 3)
     "20news": ((1, 128), jnp.int32),
@@ -113,7 +114,10 @@ def create(args: Any, output_dim: Optional[int] = None, seed: Optional[int] = No
             d_ff=int(getattr(args, "text_d_ff", 1024)),
         )
     elif model_name in ("rnn", "rnn_fedavg"):
-        module = RNNOriginalFedAvg()
+        # vocab follows the LOADED data (output_dim = vocab for LM datasets):
+        # the default 90 is shakespeare's char vocab, and a larger corpus
+        # vocab (e.g. reddit's trained BPE) would gather out of the embedding
+        module = RNNOriginalFedAvg(vocab_size=num_classes)
     elif model_name in ("rnn_stackoverflow", "rnn_nwp"):
         module = RNNStackOverflow()
     elif model_name in ("resnet56", "resnet"):

@@ -626,3 +626,107 @@ def test_landmarks_per_user_cap_logged(tmp_path, monkeypatch, caplog):
         train, _test, _classes = load_landmarks_csv(str(root))
     assert all(len(y) <= 3 for _x, y in train.values())
     assert any("capped" in r.message for r in caplog.records)
+
+
+def _write_reddit(tmp_path, n_users=3, sentences=40):
+    root = tmp_path / "reddit"
+    (root / "train").mkdir(parents=True)
+    rng = np.random.default_rng(17)
+    words = ["the", "cat", "sat", "on", "a", "mat", "dogs", "run", "fast", "today"]
+    for u in range(n_users):
+        text = " ".join(words[rng.integers(0, len(words))] for _ in range(sentences * 8))
+        (root / "train" / f"user{u}.txt").write_text(text)
+    return root
+
+
+def test_reddit_text_dir_blocks_and_federation(tmp_path):
+    from fedml_tpu.data.formats import load_reddit_text_dir
+
+    root = _write_reddit(tmp_path)
+    train, test, vocab = load_reddit_text_dir(str(root), seq_len=16, vocab_size=300)
+    assert len(train) == 3  # one client per user file
+    for x, y in train.values():
+        assert x.shape[1] == 16 and y.shape == x.shape
+        # next-token contract: y is x shifted by one within each block
+        np.testing.assert_array_equal(x[0, 1:], y[0, :-1])
+    assert vocab >= 259  # 256 byte symbols + specials
+    # held-out split exists even without a test/ dir
+    assert test and all(len(x) >= 1 for x, _ in test.values())
+
+
+def test_reddit_end_to_end_training(tmp_path):
+    import fedml_tpu as fedml
+    from fedml_tpu.arguments import default_config
+
+    _write_reddit(tmp_path)
+    assert detect_format_files("reddit", str(tmp_path)) == "reddit"
+    args = default_config(
+        "simulation", dataset="reddit", model="rnn", client_num_in_total=3,
+        client_num_per_round=3, comm_round=2, epochs=1,
+        data_cache_dir=str(tmp_path),
+    )
+    out = fedml.run_simulation(args=args)
+    assert out["test_total"] > 0
+    # a vocab/model mismatch (embedding narrower than the trained BPE's id
+    # space) surfaces as NaN loss — finite-and-plausible is the contract
+    assert np.isfinite(out["test_loss"]) and out["test_loss"] < 10.0
+
+
+def test_image_folder_test_split_labels_follow_train_classes(tmp_path):
+    """A test split missing one class dir must NOT re-number the survivors
+    (label ids belong to the train split's sorted class list)."""
+    from fedml_tpu.data.sources import load_image_dataset
+
+    root = tmp_path / "cinic10"
+    _write_png_tree(root, "train", {"airplane": 2, "bird": 2, "cat": 2})
+    _write_png_tree(root, "test", {"bird": 2, "cat": 2})  # airplane missing
+    _x_tr, y_tr, _x_te, y_te, classes = load_image_dataset("cinic10", str(tmp_path))
+    assert classes == 3
+    assert set(y_tr.tolist()) == {0, 1, 2}
+    assert set(y_te.tolist()) == {1, 2}  # bird, cat keep their TRAIN ids
+
+
+def test_image_folder_total_budget_scales_with_class_count(tmp_path, monkeypatch):
+    from fedml_tpu.data.sources import load_image_dataset
+
+    root = tmp_path / "cinic10"
+    _write_png_tree(root, "train", {f"c{i}": 4 for i in range(5)})
+    _write_png_tree(root, "test", {f"c{i}": 1 for i in range(5)})
+    monkeypatch.setenv("FEDML_MAX_IMAGES_TOTAL", "10")  # 10 // 5 classes = 2 each
+    x_tr, *_ = load_image_dataset("cinic10", str(tmp_path))
+    assert len(x_tr) == 10
+
+
+def test_corrupt_native_drop_falls_back_to_surrogate(tmp_path, caplog):
+    """Detection passed (csv + images/ exist) but the drop is unusable
+    (images dir empty): load must surrogate loudly, not crash."""
+    import fedml_tpu as fedml
+    from fedml_tpu.arguments import default_config
+
+    root = _write_landmarks(tmp_path)
+    for f in (root / "images").iterdir():
+        f.unlink()  # interrupted images.zip extraction
+    args = default_config(
+        "simulation", dataset="landmarks", client_num_in_total=3,
+        data_cache_dir=str(tmp_path),
+    )
+    with caplog.at_level("WARNING"):
+        dataset, out_dim = fedml.data.load(args)
+    assert dataset[0] > 0  # surrogate data loaded
+    assert any("falling back to surrogate" in r.message for r in caplog.records)
+
+
+def test_config_error_not_masked_by_surrogate_fallback(tmp_path):
+    """More clients than the file has users is a USER error — it must raise,
+    not silently train on the surrogate."""
+    import fedml_tpu as fedml
+    from fedml_tpu.arguments import default_config
+    from fedml_tpu.data.formats import FedDataConfigError
+
+    _write_landmarks(tmp_path, n_users=3)
+    args = default_config(
+        "simulation", dataset="landmarks", client_num_in_total=50,
+        data_cache_dir=str(tmp_path),
+    )
+    with pytest.raises(FedDataConfigError, match="exceeds the file's"):
+        fedml.data.load(args)
