@@ -183,6 +183,13 @@ _DATASET_CLASSES = {
     "mnist": 10, "fashion_mnist": 10, "femnist": 62, "cifar10": 10, "cinic10": 10,
     "cifar100": 100, "fed_cifar100": 100, "shakespeare": 90, "fed_shakespeare": 90,
     "stackoverflow_nwp": 10004,
+    # LM datasets: output_dim = vocab (model_hub sizes the RNN embedding
+    # from it — a fallback of 10 would emit an Embed(10) checkpoint that
+    # gathers out of range on real ids). 10000 matches the surrogate spec;
+    # the true corpus-trained vocab is recorded by data.load at train time.
+    "reddit": 10000,
+    "imagenet": 1000, "gld23k": 203, "landmarks": 203,
+    "lending_club": 2, "uci": 2,
 }
 
 

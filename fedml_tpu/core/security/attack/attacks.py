@@ -8,6 +8,7 @@ InvertGradient, RevealLabels) live in ``gradient_inversion.py``.
 
 from __future__ import annotations
 
+import os
 from typing import Any, List, Optional, Tuple
 
 import jax
@@ -129,6 +130,22 @@ class EdgeCaseBackdoorAttack:
         self.sample_pct = float(getattr(config, "backdoor_sample_percentage", 0.1))
         self.target_class = int(getattr(config, "target_class", 0))
         self.backdoor_dataset = backdoor_dataset or getattr(config, "backdoor_dataset", None)
+        if self.backdoor_dataset is None:
+            # the reference's southwest pickle dropped into the data cache is
+            # the real edge-case pool (edge_case_examples/data_loader.py:493);
+            # only consumed when the file actually exists — otherwise the
+            # tail-relabel fallback below keeps its semantics
+            cache = str(getattr(config, "data_cache_dir", "") or "")
+            pkl = os.path.join(cache, "edge_case_examples", "southwest_cifar10",
+                               "southwest_images_new_train.pkl")
+            if cache and os.path.exists(pkl):
+                from ....data.sources import load_edge_case_examples
+
+                pool = load_edge_case_examples(
+                    target_class=self.target_class, cache_dir=cache, n=0,
+                )
+                if len(pool[0]):  # unreadable pickle -> empty surrogate (n=0)
+                    self.backdoor_dataset = pool
         self._rng = np.random.RandomState(int(getattr(config, "random_seed", 0)) + 307)
 
     def poison_data(self, dataset):

@@ -685,7 +685,7 @@ def load_reddit_text_dir(
         if budget <= 0:
             break
     tok = train_bpe(sample, vocab_size=vocab_size)
-    vocab = len(tok.vocab) + len(tok.special_tokens)
+    vocab = tok.vocab_size
 
     def blocked(texts: Dict[str, str]) -> ClientData:
         out: ClientData = {}
@@ -695,8 +695,8 @@ def load_reddit_text_dir(
             if n_blocks <= 0:
                 continue
             arr = np.asarray(ids[: n_blocks * seq_len + 1], np.int64)
-            x = np.stack([arr[i * seq_len:(i + 1) * seq_len] for i in range(n_blocks)])
-            y = np.stack([arr[i * seq_len + 1:(i + 1) * seq_len + 1] for i in range(n_blocks)])
+            x = arr[: n_blocks * seq_len].reshape(n_blocks, seq_len)
+            y = arr[1: n_blocks * seq_len + 1].reshape(n_blocks, seq_len)
             out[uid] = (x, y)
         return out
 

@@ -184,8 +184,14 @@ def max_images_per_class(n_classes: int = 1, default: int = 1000,
     classes) and FEDML_MAX_IMAGES_TOTAL per split (default 50k — a
     1000-class imagenet drop would otherwise admit 1M images at the
     per-class cap alone and OOM the host)."""
-    per_class = int(os.environ.get("FEDML_MAX_IMAGES_PER_CLASS", default))
-    total = int(os.environ.get("FEDML_MAX_IMAGES_TOTAL", total_default))
+    per_class_env = os.environ.get("FEDML_MAX_IMAGES_PER_CLASS")
+    total_env = os.environ.get("FEDML_MAX_IMAGES_TOTAL")
+    per_class = int(per_class_env) if per_class_env else default
+    if per_class_env and not total_env:
+        # an EXPLICIT per-class override is the user sizing for their RAM;
+        # the total default must not silently tighten it back down
+        return max(1, per_class)
+    total = int(total_env) if total_env else total_default
     return max(1, min(per_class, total // max(1, n_classes)))
 
 
@@ -486,6 +492,86 @@ def load_stackoverflow_lr(cache_dir: str, seed: int = 0, n_train: int = 8000, n_
     return x_tr, y_tr, x_te, y_te, n_tags
 
 
+def _read_space_dat(path: str, sep: Optional[str] = None) -> np.ndarray:
+    """One NUS-WIDE .dat table -> float matrix; columns containing ANY NaN
+    (trailing separators, ragged empty fields) are dropped — pandas
+    ``df.dropna(axis=1)`` semantics, which the reference relies on. A kept
+    column is therefore guaranteed NaN-free: a scattered-NaN column must
+    not survive into standardize() where it would turn the whole feature
+    NaN silently."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            parts = line.split(sep) if sep else line.split()
+            rows.append([float(p) if p.strip() else np.nan for p in parts] if sep
+                        else [float(p) for p in parts])
+    arr = np.asarray(rows, np.float32)
+    if arr.ndim == 2:
+        keep = ~np.any(np.isnan(arr), axis=0)
+        arr = arr[:, keep]
+    return arr
+
+
+def load_nus_wide_files(data_dir: str, n_parties: int = 2, dtype: str = "Train",
+                        top_k: int = 2, max_rows: int = 20_000):
+    """NUS-WIDE from the reference's own on-disk trio
+    (``data/NUS_WIDE/nus_wide_dataset.py:23-71``):
+    ``Groundtruth/TrainTestLabels/Labels_<label>_<dtype>.txt`` (one 0/1 per
+    line), ``Low_Level_Features/<dtype>_Normalized_*.dat`` (space-separated
+    image features, 634 columns across files), and
+    ``NUS_WID_Tags/<dtype>_Tags1k.dat`` (tab-separated 1k tag indicators).
+    Selected labels = the reference's top-k-by-positive-count rule
+    (``get_top_k_labels``); rows with exactly one selected label kept; y = 1
+    for the first label, 0 otherwise (reference uses -1 for neg; our VFL
+    consumers expect {0,1}). Party 0 = image features, party 1 = tags;
+    n_parties > 2 splits the tag columns. Columns standardized like the
+    reference's StandardScaler."""
+    import glob as _glob
+
+    label_files = sorted(_glob.glob(os.path.join(
+        data_dir, "Groundtruth", "TrainTestLabels", f"Labels_*_{dtype}.txt")))
+    if not label_files:
+        raise FileNotFoundError(f"{data_dir}: no TrainTestLabels for {dtype}")
+    counts = {}
+    columns = {}
+    for path in label_files:
+        label = os.path.basename(path)[len("Labels_"):-(len(dtype) + 5)]
+        col = np.loadtxt(path, dtype=np.int64)[:max_rows]
+        columns[label] = col
+        counts[label] = int(col.sum())
+    selected = [lbl for lbl, _ in sorted(counts.items(), key=lambda kv: -kv[1])[:top_k]]
+    lab = np.stack([columns[lbl] for lbl in selected], axis=1)
+    mask = lab.sum(axis=1) == 1 if len(selected) > 1 else np.ones(len(lab), bool)
+
+    feat_files = sorted(_glob.glob(os.path.join(
+        data_dir, "Low_Level_Features", f"{dtype}_Normalized_*.dat")))
+    if not feat_files:
+        raise FileNotFoundError(f"{data_dir}: no {dtype}_Normalized_*.dat features")
+    xa = np.concatenate([_read_space_dat(p)[:max_rows] for p in feat_files], axis=1)
+    tags_path = os.path.join(data_dir, "NUS_WID_Tags", f"{dtype}_Tags1k.dat")
+    xb = _read_space_dat(tags_path, sep="\t")[:max_rows]
+
+    n = min(len(xa), len(xb), len(lab))
+    xa, xb, lab, mask = xa[:n], xb[:n], lab[:n], mask[:n]
+    xa, xb, lab = xa[mask], xb[mask], lab[mask]
+    y = (lab[:, 0] == 1).astype(np.int64)
+
+    def standardize(m):
+        std = m.std(axis=0)
+        std[std == 0] = 1.0
+        return ((m - m.mean(axis=0)) / std).astype(np.float32)
+
+    xa, xb = standardize(xa), standardize(xb)
+    if n_parties <= 2:
+        xs = [xa, xb][:max(1, n_parties)]
+    else:
+        xs = [xa] + [np.ascontiguousarray(part)
+                     for part in np.array_split(xb, n_parties - 1, axis=1)]
+    log.info("dataset nus_wide: parsed NATIVE files from %s (%d rows, labels %s)",
+             data_dir, len(y), selected)
+    return xs, y
+
+
 def load_nus_wide_vertical(cache_dir: str, n_parties: int = 2, seed: int = 0, n: int = 4000):
     """NUS-WIDE style vertical-FL source (reference: data/NUS_WIDE/
     nus_wide_dataset.py feeds classical_vertical_fl): the SAME samples'
@@ -498,6 +584,13 @@ def load_nus_wide_vertical(cache_dir: str, n_parties: int = 2, seed: int = 0, n:
         with np.load(path) as z:
             xs = [z[f"x{i}"].astype(np.float32) for i in range(n_parties)]
             return xs, z["y"].astype(np.int64)
+    native = os.path.join(cache_dir or "", "nus_wide")
+    if cache_dir and os.path.isdir(os.path.join(native, "Groundtruth")):
+        try:
+            return load_nus_wide_files(native, n_parties)
+        except (OSError, ValueError) as e:
+            log.warning("nus_wide: native files unreadable (%r) — falling back "
+                        "to surrogate", e)
     log.warning("dataset nus_wide: no local file — synthetic vertical surrogate")
     rng = np.random.default_rng(seed)
     latent = rng.normal(0, 1, (n, 16)).astype(np.float32)
@@ -509,11 +602,38 @@ def load_nus_wide_vertical(cache_dir: str, n_parties: int = 2, seed: int = 0, n:
     return xs, y
 
 
-def load_edge_case_examples(seed: int = 0, n: int = 256, shape=(28, 28, 1), target_class: int = 0):
+def load_edge_case_examples(seed: int = 0, n: int = 256, shape=(28, 28, 1),
+                            target_class: int = 0, cache_dir: str = ""):
     """Edge-case backdoor pool (reference: data/edge_case_examples/ — rare
     tail samples relabeled to the attacker's target, Wang et al. 2020).
-    Surrogate: high-contrast corner-patch patterns far from the benign
-    manifold, all labeled ``target_class``."""
+
+    Native: the reference's southwest-airplane pickle
+    (``edge_case_examples/data_loader.py:493-505``:
+    ``southwest_cifar10/southwest_images_new_train.pkl``, a [N,32,32,3]
+    uint8 array, every sample labeled to the attacker's target — the
+    reference hardcodes truck=9; here ``target_class``), read through the
+    restricted unpickler so a hostile 'dataset' file cannot execute.
+    Fallback surrogate: high-contrast corner-patch patterns far from the
+    benign manifold, all labeled ``target_class``."""
+    pkl = os.path.join(cache_dir or "", "edge_case_examples",
+                       "southwest_cifar10", "southwest_images_new_train.pkl")
+    if cache_dir and os.path.exists(pkl):
+        import pickle
+
+        from ..core.distributed.communication.grpc.ref_wire import unpickle_ref_tree
+
+        try:
+            with open(pkl, "rb") as f:
+                arr = np.asarray(unpickle_ref_tree(f.read(), encoding="bytes"))
+            x = arr.astype(np.float32) / 255.0
+            if n and len(x) > n:
+                x = x[np.random.default_rng(seed).choice(len(x), n, replace=False)]
+            log.info("edge_case_examples: loaded NATIVE southwest pool from %s "
+                     "(%d samples)", pkl, len(x))
+            return x, np.full(len(x), target_class, np.int64)
+        except (OSError, ValueError, KeyError, pickle.UnpicklingError) as e:
+            log.warning("edge_case_examples: %s unreadable (%r) — using "
+                        "surrogate", pkl, e)
     rng = np.random.default_rng(seed)
     x = rng.normal(0, 0.1, (n,) + tuple(shape)).astype(np.float32)
     x[:, : shape[0] // 4, : shape[1] // 4, ...] = 3.0  # trigger patch

@@ -730,3 +730,94 @@ def test_config_error_not_masked_by_surrogate_fallback(tmp_path):
     )
     with pytest.raises(FedDataConfigError, match="exceeds the file's"):
         fedml.data.load(args)
+
+
+def _write_nus_wide(tmp_path, n=40):
+    root = tmp_path / "nus_wide"
+    (root / "Groundtruth" / "TrainTestLabels").mkdir(parents=True)
+    (root / "Low_Level_Features").mkdir()
+    (root / "NUS_WID_Tags").mkdir()
+    rng = np.random.default_rng(23)
+    # three labels; 'animal' and 'person' are the top-2 by positives
+    labels = {"animal": rng.random(n) < 0.5, "person": rng.random(n) < 0.4,
+              "rare": rng.random(n) < 0.05}
+    for name, col in labels.items():
+        np.savetxt(root / "Groundtruth" / "TrainTestLabels" / f"Labels_{name}_Train.txt",
+                   col.astype(int), fmt="%d")
+    # two feature files whose columns concatenate to 7; trailing space makes
+    # a NaN column the parser must drop
+    for fname, d in (("Train_Normalized_CH.dat", 4), ("Train_Normalized_EDH.dat", 3)):
+        with open(root / "Low_Level_Features" / fname, "w") as f:
+            for i in range(n):
+                f.write(" ".join(f"{v:.4f}" for v in rng.normal(0, 1, d)) + " \n")
+    with open(root / "NUS_WID_Tags" / "Train_Tags1k.dat", "w") as f:
+        for i in range(n):
+            f.write("\t".join(str(int(v)) for v in (rng.random(10) < 0.2)) + "\t\n")
+    return root
+
+
+def test_nus_wide_native_files_two_party(tmp_path):
+    from fedml_tpu.data.sources import load_nus_wide_files, load_nus_wide_vertical
+
+    root = _write_nus_wide(tmp_path)
+    xs, y = load_nus_wide_files(str(root), n_parties=2)
+    assert len(xs) == 2
+    assert xs[0].shape[1] == 7 and xs[1].shape[1] == 10  # NaN cols dropped
+    assert len(xs[0]) == len(xs[1]) == len(y) and len(y) > 0
+    assert set(np.unique(y)).issubset({0, 1})
+    # the cache-dir dispatcher finds the same files
+    xs2, y2 = load_nus_wide_vertical(str(tmp_path), n_parties=2)
+    np.testing.assert_array_equal(y, y2)
+
+
+def test_nus_wide_three_party_splits_tags(tmp_path):
+    from fedml_tpu.data.sources import load_nus_wide_files
+
+    root = _write_nus_wide(tmp_path)
+    xs, y = load_nus_wide_files(str(root), n_parties=3)
+    assert len(xs) == 3
+    assert xs[1].shape[1] + xs[2].shape[1] == 10  # tag columns split
+
+
+def test_edge_case_southwest_pickle_native(tmp_path):
+    import pickle
+
+    from fedml_tpu.data.sources import load_edge_case_examples
+
+    d = tmp_path / "edge_case_examples" / "southwest_cifar10"
+    d.mkdir(parents=True)
+    rng = np.random.default_rng(31)
+    arr = rng.integers(0, 256, (20, 32, 32, 3)).astype(np.uint8)
+    (d / "southwest_images_new_train.pkl").write_bytes(pickle.dumps(arr))
+    x, y = load_edge_case_examples(n=8, target_class=9, cache_dir=str(tmp_path))
+    assert x.shape == (8, 32, 32, 3) and x.max() <= 1.0
+    assert (y == 9).all()
+    # a hostile pickle is refused -> surrogate, not code execution
+    import os as _os
+    (d / "southwest_images_new_train.pkl").write_bytes(pickle.dumps(_os.system))
+    x2, y2 = load_edge_case_examples(n=8, shape=(32, 32, 3), target_class=9,
+                                     cache_dir=str(tmp_path))
+    assert x2.shape[0] == 8 and (y2 == 9).all()
+
+
+def test_edge_case_attack_picks_up_native_pool(tmp_path):
+    """EdgeCaseBackdoorAttack consumes the dropped southwest pickle from the
+    data cache without explicit config wiring."""
+    import pickle
+    import types
+
+    from fedml_tpu.core.security.attack.attacks import EdgeCaseBackdoorAttack
+
+    d = tmp_path / "edge_case_examples" / "southwest_cifar10"
+    d.mkdir(parents=True)
+    rng = np.random.default_rng(41)
+    pool = rng.integers(0, 256, (16, 32, 32, 3)).astype(np.uint8)
+    (d / "southwest_images_new_train.pkl").write_bytes(pickle.dumps(pool))
+    cfg = types.SimpleNamespace(target_class=7, data_cache_dir=str(tmp_path),
+                                backdoor_sample_percentage=0.25, random_seed=0)
+    atk = EdgeCaseBackdoorAttack(cfg)
+    assert atk.backdoor_dataset is not None and len(atk.backdoor_dataset[0]) == 16
+    x = rng.normal(0, 1, (40, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 10, 40)
+    px, py = atk.poison_data((x, y))
+    assert (py == 7).sum() >= 10  # poisoned slots relabeled to the target
