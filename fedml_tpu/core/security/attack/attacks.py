@@ -136,11 +136,9 @@ class EdgeCaseBackdoorAttack:
             # only consumed when the file actually exists — otherwise the
             # tail-relabel fallback below keeps its semantics
             cache = str(getattr(config, "data_cache_dir", "") or "")
-            pkl = os.path.join(cache, "edge_case_examples", "southwest_cifar10",
-                               "southwest_images_new_train.pkl")
-            if cache and os.path.exists(pkl):
-                from ....data.sources import load_edge_case_examples
+            from ....data.sources import edge_case_pickle_path, load_edge_case_examples
 
+            if cache and os.path.exists(edge_case_pickle_path(cache)):
                 pool = load_edge_case_examples(
                     target_class=self.target_class, cache_dir=cache, n=0,
                 )
@@ -152,8 +150,20 @@ class EdgeCaseBackdoorAttack:
         x, y = dataset
         x, y = np.asarray(x), np.asarray(y).copy()
         n_poison = max(1, int(len(y) * self.sample_pct))
-        if self.backdoor_dataset is not None:
-            bx, _ = self.backdoor_dataset
+        pool = self.backdoor_dataset
+        if pool is not None and np.asarray(pool[0]).shape[1:] != x.shape[1:]:
+            # an auto-discovered pool (e.g. the 32x32x3 southwest pickle in a
+            # shared cache) may not match this run's dataset — tail-relabel
+            # rather than crash on the reshape
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "edge-case pool shape %s does not match local data %s; "
+                "falling back to tail-relabel poisoning",
+                np.asarray(pool[0]).shape[1:], x.shape[1:])
+            pool = None
+        if pool is not None:
+            bx, _ = pool
             bx = np.asarray(bx)
             pick = self._rng.randint(0, len(bx), n_poison)
             slots = self._rng.choice(len(y), n_poison, replace=False)

@@ -179,11 +179,14 @@ def max_images_per_class(n_classes: int = 1, default: int = 1000,
                          total_default: int = 50_000) -> int:
     """In-memory cap per (split, class): the reference streams these trees
     through a lazy torchvision ImageFolder; our ArrayDataset holds arrays,
-    so unbounded parsing would eat the host. Two knobs, the tighter wins:
+    so unbounded parsing would eat the host. Knobs:
     FEDML_MAX_IMAGES_PER_CLASS (default 1000 — sized for CINIC's 10
     classes) and FEDML_MAX_IMAGES_TOTAL per split (default 50k — a
     1000-class imagenet drop would otherwise admit 1M images at the
-    per-class cap alone and OOM the host)."""
+    per-class cap alone and OOM the host). Defaults combine tighter-wins;
+    an EXPLICIT per-class setting is taken as the user sizing for their
+    RAM and BYPASSES the default total cap (set both knobs to combine
+    explicit values)."""
     per_class_env = os.environ.get("FEDML_MAX_IMAGES_PER_CLASS")
     total_env = os.environ.get("FEDML_MAX_IMAGES_TOTAL")
     per_class = int(per_class_env) if per_class_env else default
@@ -492,16 +495,21 @@ def load_stackoverflow_lr(cache_dir: str, seed: int = 0, n_train: int = 8000, n_
     return x_tr, y_tr, x_te, y_te, n_tags
 
 
-def _read_space_dat(path: str, sep: Optional[str] = None) -> np.ndarray:
+def _read_space_dat(path: str, sep: Optional[str] = None,
+                    max_rows: Optional[int] = None) -> np.ndarray:
     """One NUS-WIDE .dat table -> float matrix; columns containing ANY NaN
     (trailing separators, ragged empty fields) are dropped — pandas
     ``df.dropna(axis=1)`` semantics, which the reference relies on. A kept
     column is therefore guaranteed NaN-free: a scattered-NaN column must
     not survive into standardize() where it would turn the whole feature
-    NaN silently."""
+    NaN silently. ``max_rows`` stops the (pure-Python) parse early — the
+    real Tags1k.dat is ~161k rows x 1000 fields and float()ing the unused
+    tail would dominate load time."""
     rows = []
     with open(path) as f:
-        for line in f:
+        for i, line in enumerate(f):
+            if max_rows is not None and i >= max_rows:
+                break
             parts = line.split(sep) if sep else line.split()
             rows.append([float(p) if p.strip() else np.nan for p in parts] if sep
                         else [float(p) for p in parts])
@@ -536,7 +544,7 @@ def load_nus_wide_files(data_dir: str, n_parties: int = 2, dtype: str = "Train",
     columns = {}
     for path in label_files:
         label = os.path.basename(path)[len("Labels_"):-(len(dtype) + 5)]
-        col = np.loadtxt(path, dtype=np.int64)[:max_rows]
+        col = np.loadtxt(path, dtype=np.int64, max_rows=max_rows)
         columns[label] = col
         counts[label] = int(col.sum())
     selected = [lbl for lbl, _ in sorted(counts.items(), key=lambda kv: -kv[1])[:top_k]]
@@ -547,9 +555,9 @@ def load_nus_wide_files(data_dir: str, n_parties: int = 2, dtype: str = "Train",
         data_dir, "Low_Level_Features", f"{dtype}_Normalized_*.dat")))
     if not feat_files:
         raise FileNotFoundError(f"{data_dir}: no {dtype}_Normalized_*.dat features")
-    xa = np.concatenate([_read_space_dat(p)[:max_rows] for p in feat_files], axis=1)
+    xa = np.concatenate([_read_space_dat(p, max_rows=max_rows) for p in feat_files], axis=1)
     tags_path = os.path.join(data_dir, "NUS_WID_Tags", f"{dtype}_Tags1k.dat")
-    xb = _read_space_dat(tags_path, sep="\t")[:max_rows]
+    xb = _read_space_dat(tags_path, sep="\t", max_rows=max_rows)
 
     n = min(len(xa), len(xb), len(lab))
     xa, xb, lab, mask = xa[:n], xb[:n], lab[:n], mask[:n]
@@ -602,6 +610,13 @@ def load_nus_wide_vertical(cache_dir: str, n_parties: int = 2, seed: int = 0, n:
     return xs, y
 
 
+def edge_case_pickle_path(cache_dir: str) -> str:
+    """Canonical location of the reference's southwest edge-case pool inside
+    the data cache — ONE definition, shared with the attack's pre-check."""
+    return os.path.join(cache_dir or "", "edge_case_examples",
+                        "southwest_cifar10", "southwest_images_new_train.pkl")
+
+
 def load_edge_case_examples(seed: int = 0, n: int = 256, shape=(28, 28, 1),
                             target_class: int = 0, cache_dir: str = ""):
     """Edge-case backdoor pool (reference: data/edge_case_examples/ — rare
@@ -615,8 +630,7 @@ def load_edge_case_examples(seed: int = 0, n: int = 256, shape=(28, 28, 1),
     restricted unpickler so a hostile 'dataset' file cannot execute.
     Fallback surrogate: high-contrast corner-patch patterns far from the
     benign manifold, all labeled ``target_class``."""
-    pkl = os.path.join(cache_dir or "", "edge_case_examples",
-                       "southwest_cifar10", "southwest_images_new_train.pkl")
+    pkl = edge_case_pickle_path(cache_dir)
     if cache_dir and os.path.exists(pkl):
         import pickle
 

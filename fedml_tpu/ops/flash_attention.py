@@ -59,6 +59,21 @@ def _compiler_params(dimension_semantics):
 NEG_INF = -1e30
 
 
+def _dot_nt(a, b):
+    """[m, k] x [n, k] -> [m, n] f32: contract the trailing dims WITHOUT
+    casting the operands up — bf16 inputs ride the MXU at full bf16 rate
+    with f32 accumulation (preferred_element_type); an up-front
+    .astype(f32) would force the ~4x-slower f32 matmul path."""
+    return jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _dot_nn(a, b):
+    """[m, k] x [k, n] -> [m, n] f32 accumulate (see _dot_nt)."""
+    return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
 def _causal_num_k(qi, num_k: int, block_q: int, block_k: int):
     """Number of k-blocks with any unmasked entry for q-block ``qi`` (shared
     by the forward and dQ kernels so their visit sets cannot diverge)."""
@@ -70,7 +85,7 @@ def _causal_num_k(qi, num_k: int, block_q: int, block_k: int):
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int, block_k: int,
                 causal: bool, scale: float):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+    q = q_ref[0]  # [block_q, D], input dtype — matmuls accumulate in f32
     T = k_ref.shape[1]
     D = q.shape[-1]
 
@@ -85,9 +100,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int, block_k: i
 
     def body(start, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k_blk.T  # [block_q, block_k] on the MXU
+        k_blk = k_ref[0, pl.ds(start * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(start * block_k, block_k), :]
+        # scale AFTER the matmul (in f32): pre-scaling bf16 q would round
+        s = _dot_nt(q, k_blk) * scale  # [block_q, block_k] on the MXU
         col = start * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         if causal:
             s = jnp.where(col <= row, s, NEG_INF)
@@ -97,7 +113,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int, block_k: i
         if causal:
             p = jnp.where(col <= row, p, 0.0)
         l_new = l * corr + p.sum(axis=-1, keepdims=True)
-        acc_new = acc * corr + p @ v_blk
+        # p back to the input dtype for the AV matmul (f32 accumulate) —
+        # the canonical flash mixed-precision recipe
+        acc_new = acc * corr + _dot_nn(p.astype(v_blk.dtype), v_blk)
         return m_new, l_new, acc_new
 
     num_k = T // block_k
@@ -157,8 +175,8 @@ def _fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int, Hq: int, Hkv
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                    block_q: int, block_k: int, causal: bool, scale: float):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)          # [block_q, D]
-    do = do_ref[0].astype(jnp.float32)        # [block_q, D]
+    q = q_ref[0]                              # [block_q, D], input dtype
+    do = do_ref[0]                            # [block_q, D], input dtype
     lse = lse_ref[0]                          # [block_q, 1]
     delta = delta_ref[0]                      # [block_q, 1] = rowsum(dO * O)
     T = k_ref.shape[1]
@@ -166,16 +184,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
     def body(start, dq):
-        k_blk = k_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
-        s = (q @ k_blk.T) * scale
+        k_blk = k_ref[0, pl.ds(start * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(start * block_k, block_k), :]
+        s = _dot_nt(q, k_blk) * scale          # f32 accumulate, bf16 MXU rate
         col = start * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         p = jnp.exp(s - lse)
         if causal:
             p = jnp.where(col <= row, p, 0.0)
-        dp = do @ v_blk.T                      # [block_q, block_k]
+        dp = _dot_nt(do, v_blk)                # [block_q, block_k] f32
         ds = p * (dp - delta)
-        return dq + (ds @ k_blk) * scale
+        return dq + _dot_nn(ds.astype(k_blk.dtype), k_blk) * scale
 
     num_k = T // block_k
     num_k_eff = _causal_num_k(qi, num_k, block_q, block_k) if causal else num_k
@@ -195,8 +213,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     revisited consecutively and accumulate across the group in f32."""
     ki = pl.program_id(1)
     g = pl.program_id(2)
-    k = k_ref[0].astype(jnp.float32)          # [block_k, D]
-    v = v_ref[0].astype(jnp.float32)          # [block_k, D]
+    k = k_ref[0]                              # [block_k, D], input dtype
+    v = v_ref[0]                              # [block_k, D], input dtype
     T = q_ref.shape[1]
 
     col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -206,19 +224,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(start, carry):
         dk, dv = carry
-        q_blk = q_ref[0, pl.ds(start * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(start * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[0, pl.ds(start * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(start * block_q, block_q), :]
         lse_blk = lse_ref[0, pl.ds(start * block_q, block_q), :]      # [block_q, 1]
         delta_blk = delta_ref[0, pl.ds(start * block_q, block_q), :]  # [block_q, 1]
-        s = (q_blk @ k.T) * scale              # [block_q, block_k]
+        s = _dot_nt(q_blk, k) * scale          # [block_q, block_k] f32
         row = start * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         p = jnp.exp(s - lse_blk)
         if causal:
             p = jnp.where(col <= row, p, 0.0)
-        dv_new = dv + p.T @ do_blk
-        dp = do_blk @ v.T
+        dv_new = dv + _dot_nn(p.T.astype(do_blk.dtype), do_blk)
+        dp = _dot_nt(do_blk, v)
         ds = p * (dp - delta_blk)
-        dk_new = dk + (ds.T @ q_blk) * scale
+        dk_new = dk + _dot_nn(ds.T.astype(q_blk.dtype), q_blk) * scale
         return dk_new, dv_new
 
     D = k.shape[-1]

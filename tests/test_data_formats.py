@@ -821,3 +821,28 @@ def test_edge_case_attack_picks_up_native_pool(tmp_path):
     y = rng.integers(0, 10, 40)
     px, py = atk.poison_data((x, y))
     assert (py == 7).sum() >= 10  # poisoned slots relabeled to the target
+
+
+def test_edge_case_attack_pool_shape_mismatch_falls_back(tmp_path, caplog):
+    """A 32x32x3 southwest pool in a shared cache must not crash an MNIST
+    attack run — tail-relabel fallback with a warning."""
+    import pickle
+    import types
+
+    from fedml_tpu.core.security.attack.attacks import EdgeCaseBackdoorAttack
+
+    d = tmp_path / "edge_case_examples" / "southwest_cifar10"
+    d.mkdir(parents=True)
+    rng = np.random.default_rng(43)
+    (d / "southwest_images_new_train.pkl").write_bytes(
+        pickle.dumps(rng.integers(0, 256, (8, 32, 32, 3)).astype(np.uint8)))
+    cfg = types.SimpleNamespace(target_class=5, data_cache_dir=str(tmp_path),
+                                backdoor_sample_percentage=0.25, random_seed=0)
+    atk = EdgeCaseBackdoorAttack(cfg)
+    x = rng.normal(0, 1, (40, 28, 28, 1)).astype(np.float32)  # MNIST shape
+    y = rng.integers(0, 10, 40)
+    with caplog.at_level("WARNING"):
+        px, py = atk.poison_data((x, y))
+    assert (py == 5).sum() >= 10
+    np.testing.assert_array_equal(px, x)  # tail-relabel: features untouched
+    assert any("does not match" in r.message for r in caplog.records)
