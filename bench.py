@@ -476,6 +476,10 @@ def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: i
             "endpoint_requests": len(results),
             "endpoint_model": "tiny" if tiny else "llama-268M flagship proxy (bf16)",
             "endpoint_batching": "dynamic (per-replica micro-batch, window 10ms, max 4)",
+            # int8 weight-only mode (serving/quant.py) is opt-in; the label
+            # keeps a quantized measurement from ever reading as fp
+            "endpoint_weight_quant": (
+                "int8" if os.environ.get("FEDML_BENCH_INT8") == "1" else "none"),
         }
     finally:
         if rs is not None:

@@ -50,6 +50,11 @@ class TransformerConfig:
     moe_ep_axis: Any = None      # mesh axis name for expert parallelism
     moe_local_experts: Any = None  # shard_map pp path: experts per ep rank
     decode: bool = False         # KV-cache autoregressive decode mode (serving)
+    # int8 = weight-only quantized dense kernels (serving/quant.py transform
+    # produces the kernel_q/kernel_scale layout). Decode is HBM-bandwidth
+    # bound, so halving weight bytes is a direct tokens/sec lever; activations
+    # and KV cache stay in ``dtype``.
+    weight_quant: str = "none"   # none | int8
 
     @property
     def head_dim(self) -> int:
@@ -119,8 +124,20 @@ class LoRALinear(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         in_dim = x.shape[-1]
-        kernel = self.param("kernel", nn.initializers.lecun_normal(), (in_dim, self.features))
-        y = x @ kernel.astype(x.dtype)
+        if self.cfg.weight_quant == "int8":
+            # weight-only int8 (symmetric, per-output-channel): HBM reads are
+            # int8, the convert fuses into the matmul operand load, compute
+            # stays in x.dtype with the f32-scale applied after
+            kq = self.param("kernel_q", nn.initializers.zeros,
+                            (in_dim, self.features), jnp.int8)
+            kscale = self.param("kernel_scale", nn.initializers.ones,
+                                (self.features,))
+            # multiply by the f32 scale (promotes), cast the RESULT back —
+            # rounding the scale itself to bf16 would double dequant error
+            y = ((x @ kq.astype(x.dtype)) * kscale).astype(x.dtype)
+        else:
+            kernel = self.param("kernel", nn.initializers.lecun_normal(), (in_dim, self.features))
+            y = x @ kernel.astype(x.dtype)
         r = self.cfg.lora_rank
         if r > 0 and _lora_target(self.name, self.cfg):
             a = self.param("lora_a", nn.initializers.normal(0.02), (in_dim, r))

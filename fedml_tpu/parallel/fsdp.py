@@ -24,9 +24,14 @@ from ..utils.pytree import PyTree
 # "layer_0/attn/q_proj/kernel".
 DEFAULT_RULES: Sequence[Tuple[str, P]] = (
     (r"embed/embedding$", P("tp", "fsdp")),
-    (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel$", P("fsdp", "tp")),
-    (r"(o_proj|down_proj)/kernel$", P("tp", "fsdp")),
-    (r"lm_head/kernel$", P("fsdp", "tp")),
+    # kernel_q mirrors kernel (int8 weight-only serving, serving/quant.py);
+    # its per-output-channel scale follows the kernel's OUTPUT axis sharding
+    (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel(_q)?$", P("fsdp", "tp")),
+    (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel_scale$", P("tp")),
+    (r"(o_proj|down_proj)/kernel(_q)?$", P("tp", "fsdp")),
+    (r"(o_proj|down_proj)/kernel_scale$", P("fsdp")),
+    (r"lm_head/kernel(_q)?$", P("fsdp", "tp")),
+    (r"lm_head/kernel_scale$", P("tp")),
     (r"lora_a$", P("fsdp", None)),
     (r"lora_b$", P(None, "tp")),
     # MoE expert weights [E, D, F] / [E, F, D]: experts over 'ep', the

@@ -75,6 +75,16 @@ def llm_bench_predictor():
     params = TransformerLM(cfg).init(
         jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
     )["params"]
+    if os.environ.get("FEDML_BENCH_INT8") == "1":
+        # weight-only int8 serving (quant.py): halves decode HBM traffic;
+        # the emitted JSON carries the mode so the number is never read as
+        # an fp measurement
+        import dataclasses
+
+        from .quant import quantize_params_int8
+
+        cfg = dataclasses.replace(cfg, weight_quant="int8")
+        params = quantize_params_int8(params)
     predictor = LLMPredictor(params, cfg, tok,
                              default_max_new_tokens=16 if tiny else 64)
     predictor.warmup()

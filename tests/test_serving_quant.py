@@ -1,0 +1,104 @@
+"""Weight-only int8 serving quantization (serving/quant.py).
+
+The decode path re-reads every dense kernel per generated token; int8
+weights halve that HBM traffic. These tests pin the layout transform, the
+numerics (per-channel symmetric), and the end-to-end decode path under
+``weight_quant="int8"``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.models.transformer import TransformerConfig, TransformerLM
+from fedml_tpu.serving.quant import dequantize_params_int8, quantize_params_int8
+
+
+def _small_cfg(**kw):
+    return TransformerConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=128, max_seq_len=32, dtype=jnp.float32, remat=False, **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def fp_model():
+    cfg = _small_cfg()
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+def test_quantize_layout_and_roundtrip(fp_model):
+    _cfg, _model, params = fp_model
+    q = quantize_params_int8(params)
+    leaves = jax.tree.leaves_with_path(q)
+    kq = [v for p, v in leaves if "kernel_q" in jax.tree_util.keystr(p)]
+    assert kq and all(v.dtype == jnp.int8 for v in kq)
+    assert not any("'kernel'" in jax.tree_util.keystr(p) for p, _ in leaves)
+    # non-kernel leaves (embed, norms) untouched
+    emb_q = q["embed"]["embedding"]
+    np.testing.assert_array_equal(np.asarray(emb_q), np.asarray(params["embed"]["embedding"]))
+    # per-channel symmetric round-trip error is bounded by scale/2 per entry
+    deq = dequantize_params_int8(q)
+    for path, orig in jax.tree.leaves_with_path(params):
+        key = jax.tree_util.keystr(path)
+        if "kernel" in key and getattr(orig, "ndim", 0) == 2:
+            rebuilt = deq
+            for part in [p.key for p in path]:
+                rebuilt = rebuilt[part]
+            absmax = np.abs(np.asarray(orig)).max(axis=0)
+            tol = (absmax / 127.0) * 0.51 + 1e-8
+            assert (np.abs(np.asarray(rebuilt) - np.asarray(orig)) <= tol[None, :]).all()
+
+
+def test_int8_logits_close_to_fp(fp_model):
+    cfg, model, params = fp_model
+    qcfg = dataclasses.replace(cfg, weight_quant="int8")
+    qparams = quantize_params_int8(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size)
+    fp = model.apply({"params": params}, tokens)
+    q = TransformerLM(qcfg).apply({"params": qparams}, tokens)
+    assert fp.shape == q.shape
+    # per-channel int8 keeps logits tightly aligned: top-1 agreement high
+    agree = float((fp.argmax(-1) == q.argmax(-1)).mean())
+    assert agree > 0.9, agree
+    rel = float(jnp.linalg.norm(fp - q) / jnp.linalg.norm(fp))
+    assert rel < 0.1, rel
+
+
+def test_int8_decode_end_to_end(fp_model):
+    from fedml_tpu.train.llm.generation import generate
+
+    cfg, _model, params = fp_model
+    qcfg = dataclasses.replace(cfg, weight_quant="int8")
+    qparams = quantize_params_int8(params)
+    prompt = jnp.asarray([[3, 5, 7, 11]], jnp.int32)
+    out = generate(qparams, qcfg, prompt, max_new_tokens=8, temperature=0.0)
+    toks = np.asarray(out)
+    assert toks.shape == (1, 8)  # generate returns the NEW tokens
+    assert (toks >= 0).all() and (toks < cfg.vocab_size).all()
+    # NOTE: no fp-vs-int8 sequence match here — on a random-init model the
+    # near-uniform logits make greedy decoding diverge permanently after one
+    # argmax flip; single-step top-1 agreement (the meaningful quality
+    # metric) is pinned in test_int8_logits_close_to_fp. Decode must at
+    # least be deterministic:
+    out2 = generate(qparams, qcfg, prompt, max_new_tokens=8, temperature=0.0)
+    np.testing.assert_array_equal(toks, np.asarray(out2))
+
+
+def test_bench_predictor_int8_mode(monkeypatch):
+    monkeypatch.setenv("FEDML_BENCH_TINY", "1")
+    monkeypatch.setenv("FEDML_BENCH_INT8", "1")
+    monkeypatch.setenv("FEDML_REPLICA_PLATFORM", "cpu")
+    from fedml_tpu.serving.bench_predictors import llm_bench_predictor
+
+    predictor = llm_bench_predictor()
+    out = predictor.predict({"prompt": "federated", "max_new_tokens": 4})
+    assert isinstance(out.get("text"), str)
+    assert predictor._cfg.weight_quant == "int8"
