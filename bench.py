@@ -555,7 +555,42 @@ def _bench_resnet_tpu(reps: int = 10, bs: int = 128):
     peak = _chip_peak_tflops(dev, dtype_bits=16) * 1e12  # bf16: default TPU matmul precision
     mfu = (analytic_step_flops / dt_step) / peak
     _check_mfu("resnet56", mfu)
-    return {"steps_per_sec": 1.0 / dt_step, "mfu": mfu, "bs": bs}
+
+    # North-star metric (BASELINE.md acceptance): FedAvg ROUNDS/HR, measured
+    # as a real sp-simulator-shaped round on-chip — N clients train from the
+    # same global params on DISTINCT batches (serial, like simulation/sp),
+    # then a jitted weighted average. Completion forced by fetching a scalar
+    # of the aggregated tree (same honesty contract as the step chains).
+    _p("resnet bench: timing a FedAvg round (4 clients x 10 local steps)")
+    n_clients, local_steps = 4, 10
+    cxs = [[jnp.asarray(rng.normal(size=(bs, 32, 32, 3)).astype(np.float32))
+            for _ in range(local_steps)] for _ in range(n_clients)]
+    cys = [[jnp.asarray(rng.integers(0, 10, bs).astype(np.int32))
+            for _ in range(local_steps)] for _ in range(n_clients)]
+
+    @jax.jit
+    def fedavg(trees):
+        return jax.tree.map(lambda *ls: sum(ls) / len(ls), *trees)
+
+    # warm the aggregation compile OUT of the timed round (the train step is
+    # already warm from the steps/sec phase — same function, same shapes)
+    float(jax.tree.leaves(fedavg([params] * n_clients))[0].reshape(-1)[0])
+    t0 = time.perf_counter()
+    locals_ = []
+    for c in range(n_clients):
+        p, o = params, opt_state
+        for s in range(local_steps):
+            p, o, loss = step(p, o, cxs[c][s], cys[c][s])
+        locals_.append(p)
+    agg = fedavg(locals_)
+    float(jax.tree.leaves(agg)[0].reshape(-1)[0])  # force the whole round
+    round_sec = time.perf_counter() - t0
+    return {
+        "steps_per_sec": 1.0 / dt_step, "mfu": mfu, "bs": bs,
+        "fedavg_round_sec": round_sec,
+        "fedavg_rounds_per_hr": 3600.0 / round_sec,
+        "fedavg_clients": n_clients, "fedavg_local_steps": local_steps,
+    }
 
 
 def _bench_resnet_torch_cpu(bs: int = 32, budget_s: float = 60.0) -> float | None:
@@ -967,6 +1002,13 @@ def main() -> None:
     if resnet is not None:
         out["resnet56_steps_per_sec"] = round(resnet["steps_per_sec"], 2)
         out["resnet56_mfu"] = round(resnet["mfu"], 4)
+        if "fedavg_rounds_per_hr" in resnet:
+            # the north-star vocabulary (BASELINE.md acceptance): FedAvg
+            # rounds/hr on the ResNet-56/CIFAR client workload
+            out["fedavg_rounds_per_hr"] = round(resnet["fedavg_rounds_per_hr"], 1)
+            out["fedavg_round_shape"] = (
+                f"{resnet['fedavg_clients']} clients x "
+                f"{resnet['fedavg_local_steps']} steps x bs{resnet['bs']}")
         if cpu_resnet:
             out["resnet56_vs_torch_cpu"] = round(
                 resnet["steps_per_sec"] * resnet["bs"] / cpu_resnet, 2)

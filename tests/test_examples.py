@@ -36,6 +36,9 @@ def test_found_all_platform_examples():
         "cross_device/main.py",
         "launch/hello_job/job.yaml",
         "workflow/train_deploy_infer/main.py",
+        "security/attack_defense/main.py",
+        "privacy/dp_fedavg/main.py",
+        "interop/run_mixed_demo.py",
     ]
     missing = [p for p in expected if not os.path.exists(os.path.join(EXAMPLES, p))]
     assert not missing, missing
@@ -134,3 +137,19 @@ def test_native_edge_federation_example_runs():
     assert r.returncode == 0, r.stdout + r.stderr
     assert "native edge federation example done" in r.stdout
     assert "rc=[0, 0]" in r.stdout
+
+
+@pytest.mark.slow
+def test_security_example_runs():
+    s = os.path.join(EXAMPLES, "security", "attack_defense", "main.py")
+    r = _run(s, timeout=900)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "defense margin" in r.stdout
+
+
+@pytest.mark.slow
+def test_privacy_example_runs():
+    s = os.path.join(EXAMPLES, "privacy", "dp_fedavg", "main.py")
+    r = _run(s, timeout=900)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "privacy cost" in r.stdout
