@@ -31,4 +31,4 @@ if __name__ == "__main__":
     clear = run(False)
     print(f"with cDP (eps=10, gaussian): test_acc = {private:.3f}")
     print(f"without DP                 : test_acc = {clear:.3f}")
-    print(f"privacy cost               : -{clear - private:.3f}")
+    print(f"privacy cost               : {private - clear:+.3f}")

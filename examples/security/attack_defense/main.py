@@ -20,7 +20,6 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 import fedml_tpu as fedml  # noqa: E402
-from fedml_tpu.arguments import load_arguments  # noqa: E402
 
 
 def run(enable_defense: bool) -> float:
@@ -36,4 +35,4 @@ if __name__ == "__main__":
     undefended = run(False)
     print(f"multi-Krum defended : test_acc = {defended:.3f}")
     print(f"undefended          : test_acc = {undefended:.3f}")
-    print(f"defense margin      : +{defended - undefended:.3f}")
+    print(f"defense margin      : {defended - undefended:+.3f}")

@@ -89,12 +89,149 @@ def _ring_block(q, k, v, axis_name: str):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B, Tl, H, D]
 
 
-def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp"):
+# --- zigzag layout (balanced causal ring) ------------------------------------
+#
+# The contiguous layout above is exact but imbalanced under causality: device
+# i's queries need i+1 of the n KV blocks, so device 0 idles while device n-1
+# works every rotation — and every step computes a FULL [Tl, Tl] logits tile,
+# mostly masked (~50% of all computed pairs are wasted). The zigzag layout
+# (Brandon et al. "Striped Attention" lineage; the zigzag variant used by
+# ring-flash implementations) reshards the sequence so device i owns chunk i
+# AND chunk 2n-1-i of 2n half-blocks: every device then needs exactly 2n+1
+# chunk-pairs (uniform), and per rotation only 3 of 4 quarter-tiles can ever
+# be unmasked (front-queries x back-KV is ALWAYS masked and is statically
+# skipped) — 25% fewer FLOPs than the contiguous ring and no stragglers.
+
+
+def _zigzag_split(x, axis_name: str, n: int):
+    """Contiguous shard [B, Tl, ...] -> (front, back) halves in zigzag
+    ownership: device d ends up holding global chunks d and 2n-1-d. Two
+    ppermutes (one per local half) — each is a bijection, verified by
+    construction: dest(c) = c for c < n else 2n-1-c over even/odd chunk ids
+    hits every device exactly once."""
+    idx = jax.lax.axis_index(axis_name)
+    C = x.shape[1] // 2
+    h0, h1 = x[:, :C], x[:, C:]  # global chunk ids 2*idx, 2*idx+1
+
+    def dest(c: int) -> int:
+        return c if c < n else 2 * n - 1 - c
+
+    r0 = jax.lax.ppermute(h0, axis_name, [(s, dest(2 * s)) for s in range(n)])
+    r1 = jax.lax.ppermute(h1, axis_name, [(s, dest(2 * s + 1)) for s in range(n)])
+    # device d received its even chunk via r0 and odd via r1; the FRONT
+    # chunk (id=d) is the even one iff d is even
+    even = (idx % 2) == 0
+    front = jnp.where(even, r0, r1)
+    back = jnp.where(even, r1, r0)
+    return front, back
+
+
+def _zigzag_merge(front, back, axis_name: str, n: int):
+    """Inverse of _zigzag_split: route chunks d / 2n-1-d back to their
+    contiguous owners and concatenate into [B, Tl, ...]."""
+    idx = jax.lax.axis_index(axis_name)
+    even = (idx % 2) == 0
+    # the EVEN-id chunk this device holds is front (id=d) iff d even,
+    # else back (id=2n-1-d, even when d is odd)
+    send_even = jnp.where(even, front, back)
+    send_odd = jnp.where(even, back, front)
+
+    def even_id(d: int) -> int:
+        return d if d % 2 == 0 else 2 * n - 1 - d
+
+    def odd_id(d: int) -> int:
+        return d if d % 2 == 1 else 2 * n - 1 - d
+
+    r0 = jax.lax.ppermute(send_even, axis_name,
+                          [(d, even_id(d) // 2) for d in range(n)])
+    r1 = jax.lax.ppermute(send_odd, axis_name,
+                          [(d, odd_id(d) // 2) for d in range(n)])
+    return jnp.concatenate([r0, r1], axis=1)
+
+
+def _ring_block_zigzag(q, k, v, axis_name: str):
+    """Balanced causal ring attention body. q/k/v: [B, Tl, H, D] contiguous;
+    resharded to zigzag internally, result resharded back — callers see the
+    same contract as _ring_block."""
+    idx = jax.lax.axis_index(axis_name)
+    n = jax.lax.axis_size(axis_name)
+    scale = q.shape[-1] ** -0.5
+    B, Tl, H, D = q.shape
+    qf, qb = _zigzag_split(q, axis_name, n)
+    kf, kb = _zigzag_split(k, axis_name, n)
+    vf, vb = _zigzag_split(v, axis_name, n)
+    C = Tl // 2
+
+    pvary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
+    zero_m = jnp.full((B, H, C), NEG_INF, jnp.float32)
+    zero_l = jnp.zeros((B, H, C), jnp.float32)
+    zero_a = jnp.zeros((B, H, C, D), jnp.float32)
+    intra = jnp.arange(C)[:, None] >= jnp.arange(C)[None, :]  # [C, C]
+
+    def dot_qk(qc, kc):
+        # bf16 operands, f32 accumulation (same recipe as ops/flash_attention)
+        return jnp.einsum("bqhd,bkhd->bhqk", qc, kc,
+                          preferred_element_type=jnp.float32) * scale
+
+    def online(m, l, acc, logits, allow, v_cur):
+        logits = jnp.where(allow, logits, NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[..., None]) * allow.astype(jnp.float32)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    def body(step, carry):
+        mf, lf, af, mb, lb, ab, kf_c, vf_c, kb_c, vb_c = carry
+        j = (idx - step) % n  # device whose zigzag chunks we currently hold
+        # front queries (chunk idx) x front KV (chunk j):
+        #   j < idx full, j == idx causal, j > idx masked
+        allow_ff = jnp.broadcast_to(
+            jnp.where(j == idx, intra, j < idx)[None, None], (B, H, C, C))
+        mf, lf, af = online(mf, lf, af, dot_qk(qf, kf_c), allow_ff, vf_c)
+        # back queries (chunk 2n-1-idx) x front KV (chunk j <= n-1): always
+        # fully visible
+        allow_all = jnp.broadcast_to(jnp.ones((), bool), (B, H, C, C))
+        mb, lb, ab = online(mb, lb, ab, dot_qk(qb, kf_c), allow_all, vf_c)
+        # back queries x back KV (chunk 2n-1-j): j > idx full, == causal
+        allow_bb = jnp.broadcast_to(
+            jnp.where(j == idx, intra, j > idx)[None, None], (B, H, C, C))
+        mb, lb, ab = online(mb, lb, ab, dot_qk(qb, kb_c), allow_bb, vb_c)
+        # (front queries x back KV is ALWAYS masked: chunk id 2n-1-j >= n >
+        # idx — statically skipped, the zigzag saving)
+        perm = [(d, (d + 1) % n) for d in range(n)]
+        rot = lambda t: jax.lax.ppermute(t, axis_name, perm)
+        return mf, lf, af, mb, lb, ab, rot(kf_c), rot(vf_c), rot(kb_c), rot(vb_c)
+
+    carry = (pvary(zero_m), pvary(zero_l), pvary(zero_a),
+             pvary(zero_m), pvary(zero_l), pvary(zero_a), kf, vf, kb, vb)
+    mf, lf, af, mb, lb, ab, _, _, _, _ = jax.lax.fori_loop(0, n, body, carry)
+    out_f = af / jnp.maximum(lf, 1e-20)[..., None]
+    out_b = ab / jnp.maximum(lb, 1e-20)[..., None]
+    to_btHD = lambda o: jnp.transpose(o, (0, 2, 1, 3)).astype(q.dtype)
+    return _zigzag_merge(to_btHD(out_f), to_btHD(out_b), axis_name, n)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                   layout: str = "zigzag"):
     """Shard the sequence axis over `axis_name` and run blockwise ring
-    attention. q/k/v: [B, T, H, D] (global view)."""
+    attention. q/k/v: [B, T, H, D] (global view). ``layout="zigzag"``
+    (default) balances causal work across the ring and skips the
+    always-masked quarter-tiles; ``"contiguous"`` is the classic Liu et al.
+    formulation (kept for comparison and for odd local block lengths)."""
+    if layout not in ("zigzag", "contiguous"):
+        raise ValueError(f"unknown ring layout {layout!r}")
+    n = mesh.shape[axis_name]
+    Tl = q.shape[1] // n
+    if layout == "zigzag" and Tl % 2:
+        layout = "contiguous"  # zigzag needs an even local block
+    body = _ring_block_zigzag if layout == "zigzag" else _ring_block
     spec = P(None, axis_name, None, None)
     return shard_map(
-        functools.partial(_ring_block, axis_name=axis_name),
+        functools.partial(body, axis_name=axis_name),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
