@@ -48,6 +48,29 @@ def get_active_mesh() -> Optional[Mesh]:
     return _ACTIVE_MESH
 
 
+def _dot_qk(qc, kc, scale: float):
+    """[B, Tq, H, D] x [B, Tk, H, D] -> [B, H, Tq, Tk] f32: operands stay in
+    their input dtype (bf16 rides the MXU at full rate), accumulation and
+    the post-matmul scale are f32 — same recipe as ops/flash_attention."""
+    return jnp.einsum("bqhd,bkhd->bhqk", qc, kc,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def _online_update(m, l, acc, logits, allow, v_cur):
+    """ONE copy of the numerically delicate online-softmax step, shared by
+    both ring bodies (max/correction/accumulate; masked entries contribute
+    exactly zero)."""
+    logits = jnp.where(allow, logits, NEG_INF)
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(logits - m_new[..., None]) * allow.astype(jnp.float32)
+    l_new = l * corr + p.sum(axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p.astype(v_cur.dtype), v_cur,
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
 def _ring_block(q, k, v, axis_name: str):
     """Per-device ring attention body. q/k/v: [B, T_local, H, D]."""
     idx = jax.lax.axis_index(axis_name)
@@ -55,7 +78,6 @@ def _ring_block(q, k, v, axis_name: str):
     scale = q.shape[-1] ** -0.5
     B, Tl, H, D = q.shape
 
-    q32 = q.astype(jnp.float32) * scale
     # initial accumulators must be marked device-varying for the scan carry
     pvary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
     m = pvary(jnp.full((B, H, Tl), NEG_INF, jnp.float32))
@@ -67,22 +89,16 @@ def _ring_block(q, k, v, axis_name: str):
     def body(step, carry):
         m, l, acc, k_cur, v_cur = carry
         j = (idx - step) % n  # block index currently held
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k_cur.astype(jnp.float32))
         # mask: j < idx -> full block; j == idx -> causal; j > idx -> none
         intra = row_ids[:, None] >= row_ids[None, :]  # [Tl, Tl]
         allow2d = jnp.where(j == idx, intra, j < idx)  # scalar conds broadcast
-        allow = jnp.broadcast_to(allow2d[None, None], logits.shape)
-        logits = jnp.where(allow, logits, NEG_INF)
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(logits - m_new[..., None]) * allow.astype(jnp.float32)
-        l_new = l * corr + p.sum(axis=-1)
-        acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32))
+        allow = jnp.broadcast_to(allow2d[None, None], (B, H, Tl, Tl))
+        m, l, acc = _online_update(m, l, acc, _dot_qk(q, k_cur, scale), allow, v_cur)
         # rotate kv to the next device
         perm = [(d, (d + 1) % n) for d in range(n)]
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-        return m_new, l_new, acc_new, k_next, v_next
+        return m, l, acc, k_next, v_next
 
     m, l, acc, _, _ = jax.lax.fori_loop(0, n, body, (m, l, acc, k, v))
     out = acc / jnp.maximum(l, 1e-20)[..., None]
@@ -168,22 +184,6 @@ def _ring_block_zigzag(q, k, v, axis_name: str):
     zero_a = jnp.zeros((B, H, C, D), jnp.float32)
     intra = jnp.arange(C)[:, None] >= jnp.arange(C)[None, :]  # [C, C]
 
-    def dot_qk(qc, kc):
-        # bf16 operands, f32 accumulation (same recipe as ops/flash_attention)
-        return jnp.einsum("bqhd,bkhd->bhqk", qc, kc,
-                          preferred_element_type=jnp.float32) * scale
-
-    def online(m, l, acc, logits, allow, v_cur):
-        logits = jnp.where(allow, logits, NEG_INF)
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(logits - m_new[..., None]) * allow.astype(jnp.float32)
-        l_new = l * corr + p.sum(axis=-1)
-        acc_new = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p.astype(v_cur.dtype), v_cur,
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
-
     def body(step, carry):
         mf, lf, af, mb, lb, ab, kf_c, vf_c, kb_c, vb_c = carry
         j = (idx - step) % n  # device whose zigzag chunks we currently hold
@@ -191,15 +191,15 @@ def _ring_block_zigzag(q, k, v, axis_name: str):
         #   j < idx full, j == idx causal, j > idx masked
         allow_ff = jnp.broadcast_to(
             jnp.where(j == idx, intra, j < idx)[None, None], (B, H, C, C))
-        mf, lf, af = online(mf, lf, af, dot_qk(qf, kf_c), allow_ff, vf_c)
+        mf, lf, af = _online_update(mf, lf, af, _dot_qk(qf, kf_c, scale), allow_ff, vf_c)
         # back queries (chunk 2n-1-idx) x front KV (chunk j <= n-1): always
         # fully visible
         allow_all = jnp.broadcast_to(jnp.ones((), bool), (B, H, C, C))
-        mb, lb, ab = online(mb, lb, ab, dot_qk(qb, kf_c), allow_all, vf_c)
+        mb, lb, ab = _online_update(mb, lb, ab, _dot_qk(qb, kf_c, scale), allow_all, vf_c)
         # back queries x back KV (chunk 2n-1-j): j > idx full, == causal
         allow_bb = jnp.broadcast_to(
             jnp.where(j == idx, intra, j > idx)[None, None], (B, H, C, C))
-        mb, lb, ab = online(mb, lb, ab, dot_qk(qb, kb_c), allow_bb, vb_c)
+        mb, lb, ab = _online_update(mb, lb, ab, _dot_qk(qb, kb_c, scale), allow_bb, vb_c)
         # (front queries x back KV is ALWAYS masked: chunk id 2n-1-j >= n >
         # idx — statically skipped, the zigzag saving)
         perm = [(d, (d + 1) % n) for d in range(n)]

@@ -39,6 +39,7 @@ def test_found_all_platform_examples():
         "security/attack_defense/main.py",
         "privacy/dp_fedavg/main.py",
         "interop/run_mixed_demo.py",
+        "flow/main.py",
     ]
     missing = [p for p in expected if not os.path.exists(os.path.join(EXAMPLES, p))]
     assert not missing, missing
@@ -153,3 +154,11 @@ def test_privacy_example_runs():
     r = _run(s, timeout=900)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "privacy cost" in r.stdout
+
+
+@pytest.mark.slow
+def test_flow_example_runs():
+    s = os.path.join(EXAMPLES, "flow", "main.py")
+    r = _run(s, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "flow example done: 3 rounds" in r.stdout
