@@ -929,11 +929,75 @@ def _spawn_stage(name: str, budget_s: int, argv: list[str] | None = None) -> tup
     return parsed, None
 
 
+_BENCH_LOCK_PATH = "/tmp/fedml_bench.lock"
+_BENCH_PID_PATH = "/tmp/fedml_bench.pid"
+
+
+def _acquire_bench_lock(watcher: bool, preempt_wait_s: float = 120.0):
+    """ONE bench owns the chip at a time. The opportunistic watcher
+    (tools/bench_watch.sh, FEDML_BENCH_WATCHER=1) yields: if another bench
+    holds the lock it returns None and the caller emits a structured skip.
+    A DRIVER run preempts: it SIGTERMs the holder (whose _handle_term kills
+    the in-flight stage group and exits, releasing the flock with it) and
+    waits for the lock — without this, the driver's end-of-round capture
+    can land mid-watcher-bench and the two runs OOM each other on one chip.
+    Returns the open locked file (held for the process lifetime)."""
+    import fcntl
+
+    f = open(_BENCH_LOCK_PATH, "a+")
+    locked = True
+    try:
+        fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except (BlockingIOError, OSError):
+        locked = False
+        if watcher:
+            f.close()
+            return None
+        try:
+            with open(_BENCH_PID_PATH) as pf:
+                holder = int(pf.read().strip())
+            print(f"warning: preempting bench pid {holder} (driver run takes "
+                  "the chip)", file=sys.stderr)
+            os.kill(holder, 15)  # SIGTERM -> holder reaps its stage and exits
+        except (OSError, ValueError):
+            pass
+        deadline = time.monotonic() + preempt_wait_s
+        while time.monotonic() < deadline:
+            try:
+                fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                locked = True
+                break
+            except (BlockingIOError, OSError):
+                time.sleep(1.0)
+        else:
+            # holder would not die; proceed anyway rather than skip the
+            # driver's only capture of the round (worst case matches the
+            # old behavior)
+            print("warning: bench lock still held after preempt wait; "
+                  "proceeding unlocked", file=sys.stderr)
+    if locked:
+        # the pidfile names the LOCK HOLDER only: writing it on the
+        # proceed-unlocked path would point later preemptors at a process
+        # that never held the lock (and leave the real holder running)
+        with open(_BENCH_PID_PATH, "w") as pf:
+            pf.write(str(os.getpid()))
+    return f
+
+
 def main() -> None:
     import signal
 
     signal.signal(signal.SIGTERM, _handle_term)
     signal.signal(signal.SIGINT, _handle_term)
+    watcher = os.environ.get("FEDML_BENCH_WATCHER") == "1"
+    lock = _acquire_bench_lock(watcher)
+    if watcher and lock is None:
+        print(json.dumps({
+            "skipped": "bench_lock_held",
+            "detail": "another bench run owns the chip; the watcher yields",
+            "last_measured": _last_measured(),
+        }))
+        sys.exit(1)
     try:
         _probe_backend()
     except BenchProbeTimeout as e:

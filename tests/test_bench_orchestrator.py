@@ -129,6 +129,9 @@ def _canned_stages(monkeypatch, tmp_path, results):
     results, artifacts under tmp_path."""
     monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: None)
     monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    # the real lock is process-lifetime; a second main() in the same pytest
+    # process would read its own pid from the pidfile and preempt ITSELF
+    monkeypatch.setattr(bench, "_acquire_bench_lock", lambda *a, **k: object())
 
     def fake_spawn(name, budget_s, argv=None):
         return results.get(name, (None, f"{name}: canned failure"))
@@ -225,6 +228,7 @@ def test_main_promotes_xla_stage_when_pallas_stage_dies(monkeypatch, tmp_path, c
 
 def test_main_probe_timeout_prints_structured_skip(monkeypatch, tmp_path, capsys, _restore_signals):
     monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "_acquire_bench_lock", lambda *a, **k: object())
 
     def raise_timeout(*a, **k):
         raise bench.BenchProbeTimeout("tunnel stalled")
@@ -235,3 +239,98 @@ def test_main_probe_timeout_prints_structured_skip(monkeypatch, tmp_path, capsys
     assert exc.value.code == 1
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["skipped"] == "tunnel_stalled"
+
+
+# --- bench lock: one bench owns the chip; driver preempts, watcher yields ----
+
+
+def _hold_bench_lock(tmp_lock, tmp_pid):
+    """Spawn a subprocess that flocks the bench lock, writes its pid, and
+    exits cleanly on SIGTERM (the real orchestrator's behavior via
+    _handle_term). Returns the Popen after the lock is confirmed held."""
+    import subprocess
+    import textwrap
+
+    script = textwrap.dedent(f"""
+        import fcntl, os, signal, sys, time
+        f = open({str(tmp_lock)!r}, "a+")
+        fcntl.flock(f, fcntl.LOCK_EX)
+        open({str(tmp_pid)!r}, "w").write(str(os.getpid()))
+        signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
+        print("held", flush=True)
+        time.sleep(120)
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.PIPE, text=True)
+    assert proc.stdout.readline().strip() == "held"
+    return proc
+
+
+def test_bench_lock_watcher_yields(tmp_path, monkeypatch):
+    lock, pid = tmp_path / "b.lock", tmp_path / "b.pid"
+    monkeypatch.setattr(bench, "_BENCH_LOCK_PATH", str(lock))
+    monkeypatch.setattr(bench, "_BENCH_PID_PATH", str(pid))
+    holder = _hold_bench_lock(lock, pid)
+    try:
+        assert bench._acquire_bench_lock(watcher=True) is None
+        assert holder.poll() is None  # the watcher never killed anyone
+    finally:
+        holder.kill()
+        holder.wait()
+
+
+def test_bench_lock_driver_preempts(tmp_path, monkeypatch):
+    lock, pid = tmp_path / "b.lock", tmp_path / "b.pid"
+    monkeypatch.setattr(bench, "_BENCH_LOCK_PATH", str(lock))
+    monkeypatch.setattr(bench, "_BENCH_PID_PATH", str(pid))
+    holder = _hold_bench_lock(lock, pid)
+    try:
+        f = bench._acquire_bench_lock(watcher=False, preempt_wait_s=20.0)
+        assert f is not None
+        assert holder.wait(timeout=5) == 0  # SIGTERMed holder exited cleanly
+        assert int(pid.read_text()) == os.getpid()  # we own it now
+        f.close()
+    finally:
+        if holder.poll() is None:
+            holder.kill()
+            holder.wait()
+
+
+def test_bench_lock_free_path(tmp_path, monkeypatch):
+    lock, pid = tmp_path / "b.lock", tmp_path / "b.pid"
+    monkeypatch.setattr(bench, "_BENCH_LOCK_PATH", str(lock))
+    monkeypatch.setattr(bench, "_BENCH_PID_PATH", str(pid))
+    f = bench._acquire_bench_lock(watcher=True)
+    assert f is not None and int(pid.read_text()) == os.getpid()
+    f.close()
+
+
+def test_bench_lock_unlocked_fallback_leaves_pidfile_alone(tmp_path, monkeypatch):
+    """A holder that ignores SIGTERM forces the driver's proceed-unlocked
+    fallback — the pidfile must keep naming the REAL lock holder, or a later
+    preemptor SIGTERMs the wrong process while the holder keeps the chip."""
+    import subprocess
+    import textwrap
+
+    lock, pid = tmp_path / "b.lock", tmp_path / "b.pid"
+    monkeypatch.setattr(bench, "_BENCH_LOCK_PATH", str(lock))
+    monkeypatch.setattr(bench, "_BENCH_PID_PATH", str(pid))
+    script = textwrap.dedent(f"""
+        import fcntl, os, signal, sys, time
+        f = open({str(lock)!r}, "a+")
+        fcntl.flock(f, fcntl.LOCK_EX)
+        open({str(pid)!r}, "w").write(str(os.getpid()))
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)  # stuck holder
+        print("held", flush=True)
+        time.sleep(120)
+    """)
+    holder = subprocess.Popen([sys.executable, "-c", script],
+                              stdout=subprocess.PIPE, text=True)
+    assert holder.stdout.readline().strip() == "held"
+    try:
+        f = bench._acquire_bench_lock(watcher=False, preempt_wait_s=3.0)
+        assert f is not None  # proceed-unlocked fallback
+        assert int(pid.read_text()) == holder.pid  # NOT overwritten with ours
+    finally:
+        holder.kill()
+        holder.wait()
