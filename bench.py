@@ -338,16 +338,27 @@ def _bench_llm_torch_cpu(shape, budget_s: float = 150.0) -> float | None:
         return None
 
 
-def _bench_llm_decode_tpu(reps: int = 4):
+def _bench_llm_decode_tpu(reps: int = 4, weight_quant: str = "none"):
     """Autoregressive decode throughput (serving path): tokens/sec of the
     KV-cache scan on the same llama model the train bench builds. Each rep
-    uses a distinct prompt so the platform cannot dedupe executions."""
+    uses a distinct prompt so the platform cannot dedupe executions.
+    ``weight_quant="int8"`` measures the weight-only quantized path
+    (serving/quant.py) — decode is HBM-bandwidth bound, so this is the
+    direct measurement of the halved weight traffic."""
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
 
     from fedml_tpu.train.llm.generation import generate
 
     _, cfg, params = _build_llm("pallas", remat=False)
+    if weight_quant == "int8":
+        from fedml_tpu.serving.quant import quantize_params_int8
+
+        _p("decode bench: quantizing weights to int8")
+        cfg = dataclasses.replace(cfg, weight_quant="int8")
+        params = quantize_params_int8(params)
     bs, P, new = 4, 64, 128
     rng = np.random.default_rng(1)
     prompts = [
@@ -360,7 +371,8 @@ def _bench_llm_decode_tpu(reps: int = 4):
     outs = [generate(params, cfg, p, new) for p in prompts[1:]]
     jax.block_until_ready(outs)
     dt = time.perf_counter() - t0
-    return {"decode_tokens_per_sec": bs * new * reps / dt, "bs": bs, "new": new}
+    return {"decode_tokens_per_sec": bs * new * reps / dt, "bs": bs, "new": new,
+            "weight_quant": weight_quant}
 
 
 def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: int = 3):
@@ -814,6 +826,8 @@ def _run_stage(name: str) -> None:
             out["remat"] = True
     elif name == "decode":
         out = _retry_transient(_bench_llm_decode_tpu)
+    elif name == "decode_int8":
+        out = _retry_transient(_bench_llm_decode_tpu, weight_quant="int8")
     elif name == "resnet":
         out = _retry_transient(_bench_resnet_tpu)
     elif name == "cpu_llm":
@@ -833,6 +847,11 @@ _STAGES: list[tuple[str, int]] = [
     ("llm_pallas", 1500),
     ("llm_xla", 1200),
     ("decode", 900),
+    # int8 weight-only decode: the measured side of the serving/quant.py
+    # story. Full decode budget — each stage is a FRESH subprocess, so this
+    # pays the same cold model-init/compile as the fp stage plus the
+    # host-side quantize walk (nothing is "reused" across stages by design)
+    ("decode_int8", 900),
     ("resnet", 900),
     ("cpu_llm", 400),
     ("cpu_resnet", 200),
@@ -1078,6 +1097,13 @@ def main() -> None:
                 resnet["steps_per_sec"] * resnet["bs"] / cpu_resnet, 2)
     if decode is not None:
         out["decode_tokens_per_sec"] = round(decode["decode_tokens_per_sec"], 1)
+    decode_int8 = stage_out.get("decode_int8")
+    if decode_int8 is not None:
+        out["decode_tokens_per_sec_int8"] = round(
+            decode_int8["decode_tokens_per_sec"], 1)
+        if decode is not None and decode["decode_tokens_per_sec"] > 0:
+            out["int8_decode_speedup"] = round(
+                decode_int8["decode_tokens_per_sec"] / decode["decode_tokens_per_sec"], 2)
     out.update({k: (round(v, 1) if isinstance(v, float) else v)
                 for k, v in serving.items()})
 

@@ -156,6 +156,8 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
                      "shape": _LLM_OK[0]["shape"], "device": "TPU v5 lite",
                      "step_flops": 1e12}, None),
         "decode": ({"decode_tokens_per_sec": 900.0, "bs": 4, "new": 128}, None),
+        "decode_int8": ({"decode_tokens_per_sec": 1500.0, "bs": 4, "new": 128,
+                         "weight_quant": "int8"}, None),
         "resnet": ({"steps_per_sec": 20.0, "mfu": 0.2, "bs": 128}, None),
         "cpu_llm": ({"cpu_llm_tokens_per_sec": 100.0}, None),
         "cpu_resnet": ({"cpu_resnet_images_per_sec": 80.0}, None),
@@ -334,3 +336,21 @@ def test_bench_lock_unlocked_fallback_leaves_pidfile_alone(tmp_path, monkeypatch
     finally:
         holder.kill()
         holder.wait()
+
+
+def test_main_int8_decode_comparison_surfaces(monkeypatch, tmp_path, capsys, _restore_signals):
+    _canned_stages(monkeypatch, tmp_path, {
+        "llm_pallas": _LLM_OK,
+        "cpu_llm": ({"cpu_llm_tokens_per_sec": 100.0}, None),
+        "decode": ({"decode_tokens_per_sec": 800.0, "bs": 4, "new": 128,
+                    "weight_quant": "none"}, None),
+        "decode_int8": ({"decode_tokens_per_sec": 1400.0, "bs": 4, "new": 128,
+                         "weight_quant": "int8"}, None),
+    })
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["decode_tokens_per_sec"] == 800.0
+    assert out["decode_tokens_per_sec_int8"] == 1400.0
+    assert out["int8_decode_speedup"] == 1.75
