@@ -345,8 +345,6 @@ def _bench_llm_decode_tpu(reps: int = 4, weight_quant: str = "none"):
     ``weight_quant="int8"`` measures the weight-only quantized path
     (serving/quant.py) — decode is HBM-bandwidth bound, so this is the
     direct measurement of the halved weight traffic."""
-    import dataclasses
-
     import jax
     import jax.numpy as jnp
 
@@ -354,11 +352,10 @@ def _bench_llm_decode_tpu(reps: int = 4, weight_quant: str = "none"):
 
     _, cfg, params = _build_llm("pallas", remat=False)
     if weight_quant == "int8":
-        from fedml_tpu.serving.quant import quantize_params_int8
+        from fedml_tpu.serving.quant import quantize_model_int8
 
         _p("decode bench: quantizing weights to int8")
-        cfg = dataclasses.replace(cfg, weight_quant="int8")
-        params = quantize_params_int8(params)
+        cfg, params = quantize_model_int8(cfg, params)
     bs, P, new = 4, 64, 128
     rng = np.random.default_rng(1)
     prompts = [

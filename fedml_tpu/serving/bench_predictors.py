@@ -79,12 +79,9 @@ def llm_bench_predictor():
         # weight-only int8 serving (quant.py): halves decode HBM traffic;
         # the emitted JSON carries the mode so the number is never read as
         # an fp measurement
-        import dataclasses
+        from .quant import quantize_model_int8
 
-        from .quant import quantize_params_int8
-
-        cfg = dataclasses.replace(cfg, weight_quant="int8")
-        params = quantize_params_int8(params)
+        cfg, params = quantize_model_int8(cfg, params)
     predictor = LLMPredictor(params, cfg, tok,
                              default_max_new_tokens=16 if tiny else 64)
     predictor.warmup()

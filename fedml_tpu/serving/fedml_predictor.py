@@ -85,15 +85,25 @@ class LLMPredictor(FedMLPredictor):
         self._ready = True  # flips False->True around warmup() when used
 
     @classmethod
-    def from_checkpoint(cls, path: str, **kw) -> "LLMPredictor":
+    def from_checkpoint(cls, path: str, quantize: str = "none", **kw) -> "LLMPredictor":
+        """``quantize="int8"`` serves the checkpoint with weight-only int8
+        kernels (serving/quant.py): halved decode HBM traffic, activations
+        and KV cache unchanged."""
         import json
         import os
 
         from ..train.llm.checkpoint_import import config_from_hf, import_hf_checkpoint
         from ..train.llm.data import load_or_train_tokenizer
 
+        if quantize not in ("none", "int8"):
+            # validate BEFORE the (potentially multi-GB) checkpoint import
+            raise ValueError(f"unknown quantize mode {quantize!r}")
         cfg = config_from_hf(path)
         params = import_hf_checkpoint(path, cfg)
+        if quantize == "int8":
+            from .quant import quantize_model_int8
+
+            cfg, params = quantize_model_int8(cfg, params)
         tok = load_or_train_tokenizer(None, os.path.join(path, "tokenizer.json"))
         if "eos_id" not in kw:
             # config.json's eos_token_id is authoritative (token STRINGS

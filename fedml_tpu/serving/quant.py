@@ -23,6 +23,16 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def quantize_model_int8(cfg, params: Dict[str, Any]):
+    """The ONE way to enable int8 serving: returns (cfg', params') with
+    ``weight_quant="int8"`` set and the kernels rewritten — keeping the
+    config flag and the param layout in lockstep (a cfg/params mismatch
+    gathers zeros or crashes at apply time)."""
+    import dataclasses
+
+    return dataclasses.replace(cfg, weight_quant="int8"), quantize_params_int8(params)
+
+
 def quantize_params_int8(params: Dict[str, Any]) -> Dict[str, Any]:
     """Float checkpoint -> int8 weight-only layout (pure, jit-free).
 

@@ -102,3 +102,37 @@ def test_bench_predictor_int8_mode(monkeypatch):
     out = predictor.predict({"prompt": "federated", "max_new_tokens": 4})
     assert isinstance(out.get("text"), str)
     assert predictor._cfg.weight_quant == "int8"
+
+
+@pytest.mark.slow
+def test_from_checkpoint_int8_serves(tmp_path):
+    """The user-facing serving entry (LLMPredictor.from_checkpoint) exposes
+    the int8 mode end-to-end: HF llama checkpoint -> quantized predictor ->
+    text out."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from fedml_tpu.serving.fedml_predictor import LLMPredictor
+    from fedml_tpu.train.llm.tokenizer import train_bpe
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=300, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=64,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    ckpt = str(tmp_path / "tiny_llama")
+    transformers.LlamaForCausalLM(hf_cfg).eval().save_pretrained(
+        ckpt, safe_serialization=True)
+    tok = train_bpe(["serving quantization test corpus " * 8] * 4, vocab_size=280)
+    tok.save(f"{ckpt}/tokenizer.json")
+
+    predictor = LLMPredictor.from_checkpoint(ckpt, quantize="int8",
+                                             default_max_new_tokens=4)
+    assert predictor._cfg.weight_quant == "int8"
+    out = predictor.predict({"prompt": "quantized", "max_new_tokens": 4})
+    assert isinstance(out.get("text"), str)
+
+    with pytest.raises(ValueError, match="unknown quantize mode"):
+        LLMPredictor.from_checkpoint(ckpt, quantize="fp4")
