@@ -12,8 +12,8 @@ ARCHITECTURE (round 4, VERDICT r3 item 1): every stage runs in its OWN
 subprocess that prints one JSON line —
     python bench.py --stage llm_pallas     (headline, runs FIRST)
     python bench.py --stage llm_xla
-    python bench.py --stage decode
-    python bench.py --stage resnet
+    python bench.py --stage decode / decode_int8   (fp vs weight-only int8)
+    python bench.py --stage resnet         (+ measured FedAvg rounds/hr)
     python bench.py --stage cpu_llm / cpu_resnet   (host-only baselines)
     python bench.py --stage serving        (runs LAST)
 so chip HBM is truly released between stages (the process exits) and one

@@ -25,6 +25,12 @@ SLEEP_DOWN=${SLEEP_DOWN:-120}     # tunnel down: re-probe every 2 min (short
                                   # up-windows are the norm; 10 min missed them)
 SLEEP_UP=${SLEEP_UP:-3600}        # after a good measurement: hourly is plenty
 SMOKE_STAMP=/tmp/fedml_smoke_passed
+# the stamp is valid only for the kernel code it smoked: a changed
+# flash_attention.py must be re-smoked on the next window
+KERNEL_HASH=$(sha256sum "$REPO/fedml_tpu/ops/flash_attention.py" | cut -d' ' -f1)
+if [ -f "$SMOKE_STAMP" ] && [ "$(cat "$SMOKE_STAMP" 2>/dev/null)" != "$KERNEL_HASH" ]; then
+  rm -f "$SMOKE_STAMP"
+fi
 
 log() { echo "[$(date -u +%FT%TZ)] $*"; }
 
@@ -60,7 +66,7 @@ while true; do
         cp /tmp/smoke_tpu.log "$REPO/docs/tpu_smoke_flash.log" 2>/dev/null || true
         git add docs/tpu_smoke_flash.log 2>/dev/null && \
           git commit -q -m "Record pallas flash-attention TPU smoke (fwd+bwd parity on real Mosaic)" -- docs/tpu_smoke_flash.log 2>/dev/null || true
-        touch "$SMOKE_STAMP"
+        echo "$KERNEL_HASH" > "$SMOKE_STAMP"
       else
         log "smoke FAILED/timeout: $(tail -3 /tmp/smoke_tpu.log | tr '\n' ' ')"
         # don't stamp: retry next window — but continue to the bench anyway
