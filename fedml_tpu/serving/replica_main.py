@@ -34,18 +34,11 @@ def main(argv=None) -> None:
 
     if os.environ.get("FEDML_COMPILE_CACHE_DIR"):
         # the serving bench's replicas pay the costliest cold compiles of a
-        # tunnel window; config.update (this jax build ignores the standard
-        # env var) lets a second window hit the persistent cache. Best
-        # effort — serving works identically uncached.
-        try:
-            import jax
+        # tunnel window; the shared persistent cache (ONE definition in
+        # utils/compile_cache.py) lets a second window hit it
+        from ..utils.compile_cache import enable_compile_cache
 
-            jax.config.update("jax_compilation_cache_dir",
-                              os.environ["FEDML_COMPILE_CACHE_DIR"])
-            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        except Exception:  # noqa: BLE001 - cache is an optimization only
-            pass
+        enable_compile_cache()
 
     factory = resolve_factory(args.predictor)
     predictor = factory(args.model_path) if args.model_path else factory()

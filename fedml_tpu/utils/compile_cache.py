@@ -1,0 +1,33 @@
+"""ONE definition of the persistent-compile-cache enable sequence.
+
+Short tunnel windows make cold XLA compiles the main risk to finishing a
+measurement; the persistent cache lets a second window reuse executables.
+``config.update`` (not the env var: this jax build ignores
+JAX_COMPILATION_CACHE_DIR — tests/conftest.py learned the same lesson).
+Callers: bench.py stage subprocesses and serving/replica_main.py replicas —
+both resolve the SAME directory through here, so the cache is never split.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+DEFAULT_CACHE_DIR = "/tmp/jax_bench_cache"
+ENV_VAR = "FEDML_COMPILE_CACHE_DIR"
+
+
+def cache_dir() -> str:
+    return os.environ.get(ENV_VAR) or DEFAULT_CACHE_DIR
+
+
+def enable_compile_cache() -> None:
+    """Best effort — everything works identically (just colder) uncached."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir())
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # noqa: BLE001 - cache is an optimization only
+        print(f"warning: compile cache unavailable ({e!r})", file=sys.stderr)

@@ -354,3 +354,43 @@ def test_main_int8_decode_comparison_surfaces(monkeypatch, tmp_path, capsys, _re
     assert out["decode_tokens_per_sec"] == 800.0
     assert out["decode_tokens_per_sec_int8"] == 1400.0
     assert out["int8_decode_speedup"] == 1.75
+
+
+def test_main_midrun_stall_aborts_remaining_stages(monkeypatch, tmp_path, capsys, _restore_signals):
+    """A stage timeout + dead re-probe must skip the remaining stages with a
+    structured record instead of burning every budget against a stalled
+    tunnel (and the already-measured stages still ship)."""
+    calls = []
+
+    def fake_spawn(name, budget_s, argv=None):
+        calls.append(name)
+        if name == "llm_pallas":
+            return _LLM_OK
+        if name == "cpu_llm":
+            return ({"cpu_llm_tokens_per_sec": 100.0}, None)
+        if name == "cpu_resnet":
+            return ({"cpu_resnet_images_per_sec": 80.0}, None)
+        return (None, f"{name}: timeout after {budget_s}s (last stderr: x)")
+
+    probes = {"n": 0}
+
+    def probe(timeout_s=180):
+        probes["n"] += 1
+        if probes["n"] > 1:  # first probe (startup) fine; re-probe dead
+            raise bench.BenchProbeTimeout("stalled mid-run")
+
+    monkeypatch.setattr(bench, "_probe_backend", probe)
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "_acquire_bench_lock", lambda *a, **k: object())
+    monkeypatch.setattr(bench, "_spawn_stage", fake_spawn)
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0  # headline measured before the stall
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 50000.0
+    # chip stages after the stall point were skipped without spawning; the
+    # torch-CPU baselines never touch the tunnel and still measured
+    assert calls == ["llm_pallas", "llm_xla", "cpu_llm", "cpu_resnet"]
+    assert out["vs_baseline"] == 500.0
+    assert any("skipped (tunnel stalled mid-run)" in f for f in out["stages_failed"])
+    assert not any(f.startswith("cpu_") for f in out["stages_failed"])
