@@ -268,3 +268,33 @@ class TestFSDPTrainStep:
         for a, b in zip(jax.tree.leaves(base_before), jax.tree.leaves(base_after)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         assert any(float(jnp.abs(l).sum()) > 0 for l in jax.tree.leaves(adapters_after))
+
+
+class TestZigzagEdgeCases:
+    def _qkv(self, T):
+        # same construction as TestAttentionImpls._qkv, smaller defaults
+        return TestAttentionImpls._qkv(self, T=T, B=1, H=2, D=8, seed=4)
+
+    @pytest.mark.parametrize("n,T", [(1, 8), (2, 16), (8, 32)])
+    def test_zigzag_exact_across_ring_widths(self, n, T):
+        """n=1 (degenerate single-device ring: back chunk fully attends the
+        front), n=2, and the full 8-wide virtual mesh all stay exact."""
+        from fedml_tpu.parallel.mesh import create_mesh
+        from fedml_tpu.parallel.ring_attention import ring_attention
+
+        q, k, v = self._qkv(T=T)
+        mesh = create_mesh((n,), ("sp",))
+        ref = xla_attention(q, k, v, causal=True)
+        out = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, mesh, layout="zigzag"))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, err_msg=f"n={n}")
+
+    def test_unknown_layout_raises(self):
+        from fedml_tpu.parallel.mesh import create_mesh
+        from fedml_tpu.parallel.ring_attention import ring_attention
+
+        q, k, v = self._qkv(T=16)
+        mesh = create_mesh((2,), ("sp",))
+        with pytest.raises(ValueError, match="unknown ring layout"):
+            ring_attention(q, k, v, mesh, layout="zigzig")
