@@ -732,6 +732,76 @@ def _write_measured_artifact(out: dict, stamp: str) -> str:
     return path
 
 
+# --- banked CPU baselines (VERDICT r4 weak #1) -------------------------------
+# The torch-CPU comparison denominators need no chip, so they are measured
+# tunnel-down and committed to git as BENCH_CPU_BASELINES.json. A live window
+# then spends every second on chip stages and reuses the banked numbers.
+
+def _cpu_baseline_path() -> str:
+    # derived from _REPO at call time so the test seam (monkeypatched _REPO)
+    # redirects it along with the measured artifacts
+    return os.path.join(_REPO, "BENCH_CPU_BASELINES.json")
+
+
+def _cpu_stage_env() -> dict:
+    """Env for CPU-only stage subprocesses: drop the axon pool var (this
+    image's sitecustomize force-selects the remote TPU backend, and with a
+    stalled tunnel even jax import-time work hangs) and pin jax to cpu.
+    The torch stages don't import jax, but the guard costs nothing."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _load_cpu_baselines() -> dict | None:
+    try:
+        with open(_cpu_baseline_path()) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+_CPU_BASELINE_STAGES = (("cpu_llm", "cpu_llm_tokens_per_sec", 400),
+                        ("cpu_resnet", "cpu_resnet_images_per_sec", 200))
+
+
+def _ensure_cpu_baselines(force: bool = False) -> dict | None:
+    """Return the banked CPU baselines, measuring + writing whatever is
+    missing first (all of it under ``force``). Runs entirely on the host —
+    safe tunnel-down. A partial bank (one stage failed last time) is
+    COMPLETED here, not returned as-is — otherwise one bad banking run
+    would permanently null the missing denominator."""
+    banked = (_load_cpu_baselines() or {}) if not force else {}
+    missing = [(name, budget) for name, key, budget in _CPU_BASELINE_STAGES
+               if banked.get(key) is None]
+    if not missing:
+        return banked
+    out: dict = {k: v for k, v in banked.items()
+                 if k not in ("measured_at_utc", "git_head")}
+    for name, budget in missing:
+        result, err = _spawn_stage(name, budget, env=_cpu_stage_env())
+        if err is not None:
+            print(f"warning: {err}", file=sys.stderr)
+        else:
+            out.update(result)
+    if not any(out.get(key) is not None for _, key, _ in _CPU_BASELINE_STAGES):
+        return None
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    try:
+        head = subprocess.run(
+            ["git", "-C", _REPO, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except Exception:
+        head = None
+    artifact = dict(out, measured_at_utc=stamp, git_head=head)
+    with open(_cpu_baseline_path(), "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"banked CPU baselines -> {_cpu_baseline_path()}", file=sys.stderr)
+    return artifact
+
+
 # --- stage runners (each runs in its own subprocess) -------------------------
 
 def _round_floats(d: dict, nd: int = 4) -> dict:
@@ -785,9 +855,13 @@ def _run_stage(name: str) -> None:
         # a Mosaic-rejected kernel (ADVICE r3: the lane-1 block layout has
         # never met the real compiler) falls back to einsum attention —
         # a measured einsum headline beats a dead stage, and the JSON's
-        # attention_impl field keeps the substitution visible
+        # attention_impl field keeps the substitution visible.
+        # FEDML_BENCH_FAST=1 (the --short-window path): fewer reps and no
+        # bs=2x probe, sized to land a headline inside a ~3-minute window.
+        fast = os.environ.get("FEDML_BENCH_FAST") == "1"
+        reps = 4 if fast else 10
         try:
-            out = _retry_transient(_bench_llm_tpu, remat=False)
+            out = _retry_transient(_bench_llm_tpu, reps=reps, remat=False)
             out["remat"] = False
         except BenchIntegrityError:
             raise
@@ -795,7 +869,7 @@ def _run_stage(name: str) -> None:
             print(f"warning: no-remat LLM bench failed ({e!r}); retrying with remat",
                   file=sys.stderr)
             try:
-                out = _bench_llm_tpu(remat=True)
+                out = _bench_llm_tpu(reps=reps, remat=True)
                 out["remat"] = True
             except BenchIntegrityError:
                 raise
@@ -803,14 +877,16 @@ def _run_stage(name: str) -> None:
                 print(f"warning: pallas LLM bench failed under remat too ({e2!r}); "
                       "falling back to xla attention for the headline",
                       file=sys.stderr)
-                out = _retry_transient(_bench_llm_tpu, attention_impl="xla", remat=True)
+                out = _retry_transient(_bench_llm_tpu, reps=reps,
+                                       attention_impl="xla", remat=True)
                 out["remat"] = True
         # larger batches usually raise MFU (bigger matmuls per dispatch);
         # tunnel windows are rare, so try bs=2x in the SAME window and ship
         # whichever measured faster — both results stay in the output. Only
         # probe while well inside the stage budget (1500s): overrunning it
         # would killpg the stage and discard the SUCCESSFUL 1x headline
-        if (out["attention_impl"] == "pallas"
+        if (not fast
+                and out["attention_impl"] == "pallas"
                 and out["shape"]["bs"] == _LLM_SHAPE["bs"]
                 and time.monotonic() - _STAGE_T0 < 600.0):
             try:
@@ -911,12 +987,14 @@ def _handle_term(signum, frame):  # noqa: ARG001
     sys.exit(128 + signum)
 
 
-def _spawn_stage(name: str, budget_s: int, argv: list[str] | None = None) -> tuple[dict | None, str | None]:
+def _spawn_stage(name: str, budget_s: int, argv: list[str] | None = None,
+                 env: dict | None = None) -> tuple[dict | None, str | None]:
     """Run one stage subprocess; returns (parsed_json, None) or
     (None, "stage: failure summary"). Output goes through temp files, not
     PIPE, so a timeout kill still leaves the partial stderr readable for
     the failure record. ``argv`` overrides the stage command (test seam for
-    the kill-the-whole-tree contract)."""
+    the kill-the-whole-tree contract); ``env`` overrides the child env
+    (CPU-only stages must never touch the tunnel)."""
     global _CURRENT_STAGE_PROC
     import tempfile
 
@@ -925,7 +1003,7 @@ def _spawn_stage(name: str, budget_s: int, argv: list[str] | None = None) -> tup
          tempfile.TemporaryFile(mode="w+") as f_err:
         proc = subprocess.Popen(
             argv or [sys.executable, os.path.abspath(__file__), "--stage", name],
-            stdout=f_out, stderr=f_err, text=True, cwd=_REPO,
+            stdout=f_out, stderr=f_err, text=True, cwd=_REPO, env=env,
             start_new_session=True,  # one killpg reaps replica grandchildren
         )
         _CURRENT_STAGE_PROC = proc
@@ -967,8 +1045,25 @@ def _spawn_stage(name: str, budget_s: int, argv: list[str] | None = None) -> tup
     return parsed, None
 
 
-_BENCH_LOCK_PATH = "/tmp/fedml_bench.lock"
-_BENCH_PID_PATH = "/tmp/fedml_bench.pid"
+# Lock/pidfile live in a 0700 dir under the repo, not world-writable /tmp:
+# a squatted /tmp pidfile (or a symlinked lock path — open(..., "a+") follows
+# symlinks) could point the preempt path at an unrelated same-user process
+# (ADVICE r4). tools/bench_watch.sh flocks the same path.
+_BENCH_RUNTIME_DIR = os.path.join(_REPO, ".bench_runtime")
+_BENCH_LOCK_PATH = os.path.join(_BENCH_RUNTIME_DIR, "bench.lock")
+_BENCH_PID_PATH = os.path.join(_BENCH_RUNTIME_DIR, "bench.pid")
+
+
+def _pid_is_bench(pid: int) -> bool:
+    """True iff ``pid``'s cmdline references this bench script — the preempt
+    SIGTERM must never land on a process that merely inherited a stale or
+    squatted pidfile (ADVICE r4)."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmdline = f.read().replace(b"\0", b" ").decode(errors="replace")
+    except OSError:
+        return False
+    return "bench.py" in cmdline
 
 
 def _acquire_bench_lock(watcher: bool, preempt_wait_s: float = 120.0):
@@ -982,6 +1077,7 @@ def _acquire_bench_lock(watcher: bool, preempt_wait_s: float = 120.0):
     Returns the open locked file (held for the process lifetime)."""
     import fcntl
 
+    os.makedirs(_BENCH_RUNTIME_DIR, mode=0o700, exist_ok=True)
     f = open(_BENCH_LOCK_PATH, "a+")
     locked = True
     try:
@@ -994,9 +1090,13 @@ def _acquire_bench_lock(watcher: bool, preempt_wait_s: float = 120.0):
         try:
             with open(_BENCH_PID_PATH) as pf:
                 holder = int(pf.read().strip())
-            print(f"warning: preempting bench pid {holder} (driver run takes "
-                  "the chip)", file=sys.stderr)
-            os.kill(holder, 15)  # SIGTERM -> holder reaps its stage and exits
+            if _pid_is_bench(holder):
+                print(f"warning: preempting bench pid {holder} (driver run "
+                      "takes the chip)", file=sys.stderr)
+                os.kill(holder, 15)  # SIGTERM -> holder reaps its stage, exits
+            else:
+                print(f"warning: pidfile names pid {holder} but its cmdline "
+                      "is not a bench.py run; not killing it", file=sys.stderr)
         except (OSError, ValueError):
             pass
         deadline = time.monotonic() + preempt_wait_s
@@ -1010,9 +1110,17 @@ def _acquire_bench_lock(watcher: bool, preempt_wait_s: float = 120.0):
         else:
             # holder would not die; proceed anyway rather than skip the
             # driver's only capture of the round (worst case matches the
-            # old behavior)
+            # old behavior). The pidfile is left ALONE: it still accurately
+            # names the live flock holder (tombstoning it would strand every
+            # later driver with nobody to preempt while the healthy holder
+            # keeps the chip), and the _pid_is_bench cmdline guard already
+            # covers the pid-recycled/squatted case ADVICE r4 raised. The
+            # unlocked state is flagged for the emitted JSON so a double-run
+            # window is visible in artifacts.
             print("warning: bench lock still held after preempt wait; "
                   "proceeding unlocked", file=sys.stderr)
+            global _PROCEEDED_UNLOCKED
+            _PROCEEDED_UNLOCKED = True
     if locked:
         # the pidfile names the LOCK HOLDER only: writing it on the
         # proceed-unlocked path would point later preemptors at a process
@@ -1020,6 +1128,9 @@ def _acquire_bench_lock(watcher: bool, preempt_wait_s: float = 120.0):
         with open(_BENCH_PID_PATH, "w") as pf:
             pf.write(str(os.getpid()))
     return f
+
+
+_PROCEEDED_UNLOCKED = False
 
 
 def main() -> None:
@@ -1042,10 +1153,15 @@ def main() -> None:
         # Structured skip record (VERDICT r2 weak #7): the driver/judge can
         # mechanically tell "tunnel down, code fine" from "bench crashed",
         # and the last committed measurement rides along for reference.
+        # The CPU comparison denominators need no chip — measure and bank
+        # them NOW (VERDICT r4 weak #1: the old path discarded them) so a
+        # short future window spends every second on chip stages.
+        cpu_banked = _ensure_cpu_baselines()
         print(json.dumps({
             "skipped": "tunnel_stalled",
             "probe_timeout_s": 180,
             "detail": str(e),
+            "cpu_baselines": cpu_banked,
             "last_measured": _last_measured(),
         }))
         sys.exit(1)
@@ -1054,7 +1170,24 @@ def main() -> None:
     stage_out: dict[str, dict] = {}
     failed: list[str] = []
     merged: dict = {"stages_failed": failed}
+    if _PROCEEDED_UNLOCKED:
+        merged["bench_lock"] = "proceeded_unlocked"
     remaining = list(_STAGES)
+    banked = _load_cpu_baselines()
+    if banked is not None:
+        # chip windows are scarce: reuse the committed host-side denominators
+        # instead of burning window time re-measuring them. Only a stage
+        # whose banked value actually EXISTS is skipped — a partial banking
+        # (one cpu stage failed) must not permanently suppress the other
+        skip = []
+        for stage, key, _budget in _CPU_BASELINE_STAGES:
+            if banked.get(key) is not None:
+                skip.append(stage)
+                stage_out[stage] = {
+                    key: banked[key],
+                    "source": f"banked {banked.get('measured_at_utc')}"}
+        remaining = [(n, b) for n, b in remaining if n not in skip]
+        banked_stages = skip
     while remaining:
         stage_name, budget = remaining.pop(0)
         result, err = _spawn_stage(stage_name, budget)
@@ -1106,6 +1239,16 @@ def main() -> None:
     cpu_resnet = (stage_out.get("cpu_resnet") or {}).get("cpu_resnet_images_per_sec")
 
     out: dict = {"metric": "llm_train_tokens_per_sec", "stages_failed": failed}
+    if _PROCEEDED_UNLOCKED:
+        # a double-run window existed (lock holder would not die); make it
+        # visible in the artifact rather than only in stderr (ADVICE r4)
+        out["bench_lock"] = "proceeded_unlocked"
+    if banked is not None and banked_stages:
+        # provenance names exactly the stages whose denominators were reused
+        # — a partial bank live-measures the rest, and claiming "banked" for
+        # a just-measured value would misattribute it
+        out["cpu_baseline_source"] = (
+            f"banked {banked.get('measured_at_utc')} ({', '.join(banked_stages)})")
     if llm is not None:
         out.update({
             "value": round(llm["tokens_per_sec"], 1),
@@ -1158,11 +1301,74 @@ def main() -> None:
     sys.exit(0 if llm is not None else 1)
 
 
+def main_short(budget_s: int = 240) -> None:
+    """Short-window bench (VERDICT r4 weak #2): probe -> ONE fast pallas
+    headline stage -> artifact, sized to survive a ~3-minute tunnel window
+    with the persistent compile cache warm. vs_baseline comes from the
+    banked CPU denominators (BENCH_CPU_BASELINES.json), never re-measured
+    here. rc 0 iff a headline number landed."""
+    import signal
+
+    signal.signal(signal.SIGTERM, _handle_term)
+    signal.signal(signal.SIGINT, _handle_term)
+    watcher = os.environ.get("FEDML_BENCH_WATCHER") == "1"
+    lock = _acquire_bench_lock(watcher)
+    if watcher and lock is None:
+        print(json.dumps({"skipped": "bench_lock_held",
+                          "last_measured": _last_measured()}))
+        sys.exit(1)
+    try:
+        _probe_backend(timeout_s=60)
+    except BenchProbeTimeout as e:
+        print(json.dumps({"skipped": "tunnel_stalled", "short_window": True,
+                          "detail": str(e)}))
+        sys.exit(1)
+
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    env = dict(os.environ, FEDML_BENCH_FAST="1")
+    result, err = _spawn_stage("llm_pallas", budget_s, env=env)
+    if err is not None:
+        print(json.dumps({"skipped": "short_window_stage_failed", "detail": err,
+                          "last_measured": _last_measured()}))
+        sys.exit(1)
+    banked = _load_cpu_baselines() or {}
+    cpu_llm = banked.get("cpu_llm_tokens_per_sec")
+    out = {
+        "metric": "llm_train_tokens_per_sec",
+        "value": round(result["tokens_per_sec"], 1),
+        "unit": f"tokens/s (llama-{result['n_params'] / 1e6:.0f}M full train step, "
+                f"bf16, seq{result['shape']['seq']} bs{result['shape']['bs']}, "
+                f"1x {result['device']})",
+        "vs_baseline": round(result["tokens_per_sec"] / cpu_llm, 2) if cpu_llm else None,
+        "mfu": round(result["mfu"], 4),
+        "attention_impl": result["attention_impl"],
+        "remat": result["remat"],
+        "short_window": True,
+    }
+    if banked:
+        out["cpu_baseline_source"] = f"banked {banked.get('measured_at_utc')}"
+    if _PROCEEDED_UNLOCKED:
+        out["bench_lock"] = "proceeded_unlocked"
+    _write_measured_artifact(dict(out, _stages={"_llm_pallas": result}), stamp)
+    print(json.dumps(out))
+    sys.exit(0)
+
+
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--stage", help="run one measurement stage and print its JSON")
+    parser.add_argument("--short-window", action="store_true",
+                        help="probe + one fast pallas headline stage, ~3-min budget")
+    parser.add_argument("--cpu-baselines", action="store_true",
+                        help="(re)measure and bank the torch-CPU denominators; no chip needed")
     ns = parser.parse_args()
     if ns.stage:
         _run_stage(ns.stage)
+    elif ns.cpu_baselines:
+        banked = _ensure_cpu_baselines(force=True)
+        print(json.dumps(banked or {"error": "cpu baseline stages failed"}))
+        sys.exit(0 if banked else 1)
+    elif ns.short_window:
+        main_short()
     else:
         main()

@@ -130,6 +130,10 @@ class EdgeCaseBackdoorAttack:
         self.sample_pct = float(getattr(config, "backdoor_sample_percentage", 0.1))
         self.target_class = int(getattr(config, "target_class", 0))
         self.backdoor_dataset = backdoor_dataset or getattr(config, "backdoor_dataset", None)
+        # an explicitly supplied pool is user config: a shape mismatch there
+        # must raise, not silently degrade (ADVICE r4); only auto-discovered
+        # cache pools get the tail-relabel fallback
+        self._pool_explicit = self.backdoor_dataset is not None
         if self.backdoor_dataset is None:
             # the reference's southwest pickle dropped into the data cache is
             # the real edge-case pool (edge_case_examples/data_loader.py:493);
@@ -152,14 +156,19 @@ class EdgeCaseBackdoorAttack:
         n_poison = max(1, int(len(y) * self.sample_pct))
         pool = self.backdoor_dataset
         if pool is not None and np.asarray(pool[0]).shape[1:] != x.shape[1:]:
+            if self._pool_explicit:
+                raise ValueError(
+                    f"backdoor_dataset shape {np.asarray(pool[0]).shape[1:]} "
+                    f"does not match local data {x.shape[1:]} — an explicitly "
+                    "configured pool must match the training data")
             # an auto-discovered pool (e.g. the 32x32x3 southwest pickle in a
             # shared cache) may not match this run's dataset — tail-relabel
             # rather than crash on the reshape
             import logging
 
             logging.getLogger(__name__).warning(
-                "edge-case pool shape %s does not match local data %s; "
-                "falling back to tail-relabel poisoning",
+                "auto-discovered edge-case pool shape %s does not match local "
+                "data %s; falling back to tail-relabel poisoning",
                 np.asarray(pool[0]).shape[1:], x.shape[1:])
             pool = None
         if pool is not None:

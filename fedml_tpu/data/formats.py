@@ -712,6 +712,17 @@ def load_reddit_text_dir(
             if len(x) > 1:
                 test[uid] = (x[-1:], y[-1:])
                 train[uid] = (x[:-1], y[:-1])
+        if not test:
+            # every user has exactly one block: an empty test split would
+            # crash downstream on an empty concatenate and get misreported
+            # as "unparseable" (ADVICE r4) — share the first user's single
+            # block as eval data instead of dropping the corpus
+            uid = next(iter(train))
+            x, y = train[uid]
+            test[uid] = (x[-1:], y[-1:])
+            log.warning(
+                "dataset reddit: corpus too small for a held-out split "
+                "(every user has one block); reusing %s's block for eval", uid)
     log.info("dataset reddit: %d users, %d train blocks, vocab %d (corpus-trained BPE)",
              len(train), sum(len(x) for x, _ in train.values()), vocab)
     return train, test, vocab

@@ -39,11 +39,18 @@ def quantize_params_int8(params: Dict[str, Any]) -> Dict[str, Any]:
     Walks the pytree; any mapping holding a 2D ``kernel`` (every dense in
     TransformerLM, lm_head included) is rewritten. Everything else —
     embeddings (gather-bound, cheap per token), norms, biases, LoRA
-    adapters — passes through unchanged.
+    adapters — passes through unchanged. Matches any Mapping (flax
+    FrozenDict included — ADVICE r4: a FrozenDict tree used to pass
+    through untouched while the cfg still flipped to int8) and refuses to
+    return a tree in which nothing was quantized.
     """
+    from collections.abc import Mapping
+
+    n_rewritten = 0
 
     def convert(node):
-        if isinstance(node, dict):
+        nonlocal n_rewritten
+        if isinstance(node, Mapping):
             out = {}
             for key, value in node.items():
                 if key == "kernel" and getattr(value, "ndim", 0) == 2:
@@ -53,20 +60,30 @@ def quantize_params_int8(params: Dict[str, Any]) -> Dict[str, Any]:
                     q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
                     out["kernel_q"] = jnp.asarray(q)
                     out["kernel_scale"] = jnp.asarray(scale)
+                    n_rewritten += 1
                 else:
                     out[key] = convert(value)
             return out
         return node
 
-    return convert(dict(params))
+    out = convert(dict(params))
+    if n_rewritten == 0:
+        raise ValueError(
+            "quantize_params_int8: no 2D 'kernel' leaf found — an unquantized "
+            "tree next to weight_quant='int8' would fail (or gather garbage) "
+            "at apply time"
+        )
+    return out
 
 
 def dequantize_params_int8(qparams: Dict[str, Any]) -> Dict[str, Any]:
     """Inverse layout transform (for tests and checkpoint interop): rebuilds
     float kernels from kernel_q * kernel_scale."""
 
+    from collections.abc import Mapping
+
     def convert(node):
-        if isinstance(node, dict):
+        if isinstance(node, Mapping):
             if "kernel_q" in node:
                 out = {k: convert(v) for k, v in node.items()
                        if k not in ("kernel_q", "kernel_scale")}

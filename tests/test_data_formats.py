@@ -654,6 +654,24 @@ def test_reddit_text_dir_blocks_and_federation(tmp_path):
     assert test and all(len(x) >= 1 for x, _ in test.values())
 
 
+def test_reddit_single_block_corpus_still_yields_test_split(tmp_path, caplog):
+    """Every user having exactly one block used to leave test empty, which
+    crashed downstream on an empty concatenate and was misreported as
+    'unparseable' (ADVICE r4) — the parser now shares a block for eval."""
+    from fedml_tpu.data.formats import load_reddit_text_dir
+
+    root = tmp_path / "reddit"
+    (root / "train").mkdir(parents=True)
+    # ~20 words/user: at seq_len=16 that is exactly one block each
+    for u in range(2):
+        (root / "train" / f"user{u}.txt").write_text("the cat sat on a mat " * 4)
+    with caplog.at_level("WARNING"):
+        train, test, _vocab = load_reddit_text_dir(str(root), seq_len=16, vocab_size=300)
+    assert all(len(x) == 1 for x, _ in train.values())
+    assert test and all(len(x) >= 1 for x, _ in test.values())
+    assert any("corpus too small" in r.message for r in caplog.records)
+
+
 def test_reddit_end_to_end_training(tmp_path):
     import fedml_tpu as fedml
     from fedml_tpu.arguments import default_config

@@ -171,6 +171,23 @@ def test_edge_case_backdoor_poisons_percentage():
     assert int((y == 5).sum()) == 0 and float(x.max()) == 0.0
 
 
+def test_edge_case_backdoor_explicit_pool_shape_mismatch_raises():
+    """An explicitly configured backdoor_dataset whose shape mismatches the
+    local data is user error and must surface, not silently degrade to
+    tail-relabel (ADVICE r4 — the fallback is for auto-discovered pools)."""
+    import pytest
+
+    x = np.zeros((20, 4), np.float32)
+    y = np.ones((20,), np.int64)
+    bad_pool = np.full((5, 7), 9.0, np.float32)  # wrong feature shape
+    atk = EdgeCaseBackdoorAttack(
+        _cfg(backdoor_sample_percentage=0.2, target_class=5),
+        backdoor_dataset=(bad_pool, None),
+    )
+    with pytest.raises(ValueError, match="does not match local data"):
+        atk.poison_data((x, y))
+
+
 def test_facade_registries_cover_new_types():
     for attack in ["backdoor", "edge_case_backdoor", "revealing_labels"]:
         a = FedMLAttacker.get_instance()

@@ -57,6 +57,21 @@ def test_quantize_layout_and_roundtrip(fp_model):
             assert (np.abs(np.asarray(rebuilt) - np.asarray(orig)) <= tol[None, :]).all()
 
 
+def test_quantize_handles_frozendict_and_refuses_kernel_free_tree(fp_model):
+    """A flax FrozenDict tree used to pass through UNQUANTIZED while the cfg
+    still flipped to int8 (ADVICE r4) — Mapping-based matching quantizes it,
+    and a tree with no 2D kernel at all is rejected outright."""
+    import flax.core
+
+    _cfg, _model, params = fp_model
+    q = quantize_params_int8(flax.core.freeze(params))
+    kq = [v for p, v in jax.tree.leaves_with_path(q)
+          if "kernel_q" in jax.tree_util.keystr(p)]
+    assert kq and all(v.dtype == jnp.int8 for v in kq)
+    with pytest.raises(ValueError, match="no 2D 'kernel' leaf"):
+        quantize_params_int8({"embed": {"embedding": jnp.zeros((4, 4, 1))}})
+
+
 def test_int8_logits_close_to_fp(fp_model):
     cfg, model, params = fp_model
     qcfg = dataclasses.replace(cfg, weight_quant="int8")
