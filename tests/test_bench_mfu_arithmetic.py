@@ -1,0 +1,148 @@
+"""Pin the bench's tokens/sec -> MFU arithmetic without a chip.
+
+VERDICT r4 next #9: the first measured TPU number must be unimpeachable, so
+the exact pipeline the bench publishes (`_analytic_llm_step_flops` and
+`_mfu_from_rate` — used verbatim by `_bench_llm_tpu`) is re-derived here
+from raw MAC counts of every matmul in the flagship architecture, checked
+against the real model's parameter tree, and cross-checked against XLA's
+own compiled cost analysis. The formula is shared by both attention impls
+(pallas flash and xla einsum) by design: wasted [T,T] mask FLOPs are not
+useful model FLOPs, so both impls are scored against the same numerator.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bench
+
+
+def _hand_param_count(d, L, d_ff, vocab, n_heads, n_kv_heads):
+    """Parameter count of TransformerLM from the architecture, written
+    independently of the model code: embed + per-layer (q,k,v,o + SwiGLU
+    gate/up/down + 2 RMSNorm scales) + final norm + untied lm_head."""
+    d_head = d // n_heads
+    per_layer = (
+        d * d                      # q
+        + d * (n_kv_heads * d_head)  # k
+        + d * (n_kv_heads * d_head)  # v
+        + d * d                    # o
+        + 3 * d * d_ff             # SwiGLU gate, up, down
+        + 2 * d                    # attn_norm + mlp_norm scales
+    )
+    return vocab * d + L * per_layer + d + d * vocab
+
+
+def _hand_step_flops(shape, n_params):
+    """Train-step FLOPs re-derived from raw MACs, structured differently
+    from the bench's formula: matmul params each contribute 1 MAC per token
+    forward (2 FLOPs), backward costs 2x forward; attention scores counted
+    per (query, key<=query) pair."""
+    d, L, seq, bs = shape["d_model"], shape["n_layers"], shape["seq"], shape["bs"]
+    n_matmul = n_params - shape["vocab"] * d  # embed table is a gather
+    flops_fwd_dense = 2.0 * n_matmul * bs * seq
+    # QK^T + AV: causal keeps seq*(seq+1)/2 ~ seq^2/2 pairs, d MACs each, x2
+    # matmuls, 2 FLOPs per MAC, per layer per sequence
+    flops_fwd_attn = (seq * seq / 2.0) * d * 2 * 2.0 * L * bs
+    return 3.0 * (flops_fwd_dense + flops_fwd_attn)  # fwd + 2x bwd
+
+
+def test_hand_param_count_matches_real_model_exactly():
+    """The closed-form count equals the real flax tree, leaf for leaf —
+    validating the method before it is applied to the flagship dims."""
+    from fedml_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=96, max_seq_len=32, dtype=jnp.float32, remat=False,
+    )
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    real = sum(x.size for x in jax.tree.leaves(params))
+    assert real == _hand_param_count(64, 2, 96, 128, 4, 4)
+
+
+def test_flagship_flops_formula_matches_independent_derivation():
+    """bench._analytic_llm_step_flops == the raw-MAC re-derivation at the
+    flagship geometry, exactly (same math, independently written)."""
+    s = dict(bench._LLM_SHAPE)
+    n_params = _hand_param_count(
+        s["d_model"], s["n_layers"], s["d_ff"], s["vocab"], s["n_heads"], s["n_heads"])
+    # sanity: this IS the ~268M proxy the docs claim
+    assert 0.26e9 < n_params < 0.28e9
+    got = bench._analytic_llm_step_flops(s, n_params)
+    want = _hand_step_flops(s, n_params)
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+    # order of magnitude pin: ~13.4 TFLOPs per step at bs=8 seq=1024
+    assert 1e13 < got < 2e13
+
+
+def test_mfu_roundtrip_from_published_fields():
+    """Any published artifact can be audited: mfu must equal
+    (step_flops / tokens_per_step) * tokens_per_sec / peak. Uses the v5e
+    peak the bench uses for bf16."""
+    s = dict(bench._LLM_SHAPE)
+    n_params = _hand_param_count(
+        s["d_model"], s["n_layers"], s["d_ff"], s["vocab"], s["n_heads"], s["n_heads"])
+    step_flops = bench._analytic_llm_step_flops(s, n_params)
+    tokens_per_step = s["bs"] * s["seq"]
+    peak = 197.0e12  # v5e bf16 (bench._PEAK_BF16_TFLOPS["v5e"])
+    # pick the throughput that would mean exactly 0.35 MFU and check the
+    # pipeline reports exactly 0.35 back
+    tok_s = 0.35 * peak * tokens_per_step / step_flops
+    assert bench._mfu_from_rate(tok_s, step_flops, tokens_per_step, peak) == pytest.approx(0.35)
+    # and the dt-based route _bench_llm_tpu takes is algebraically the same
+    dt = tokens_per_step / tok_s
+    assert (step_flops / dt) / peak == pytest.approx(0.35)
+
+
+def test_formula_within_band_of_xla_cost_analysis():
+    """The same 0.3-3.0x agreement gate the bench applies on-chip, run here
+    against XLA's CPU cost analysis of the real jitted train step on a tiny
+    geometry — catches an order-of-magnitude formula error without TPU."""
+    import optax
+
+    from fedml_tpu.models.transformer import TransformerConfig, TransformerLM
+    from fedml_tpu.parallel.fsdp import causal_lm_loss
+
+    shape = dict(d_model=64, n_layers=2, n_heads=4, d_ff=96, vocab=128,
+                 seq=64, bs=2)
+    cfg = TransformerConfig(
+        vocab_size=shape["vocab"], d_model=shape["d_model"],
+        n_layers=shape["n_layers"], n_heads=shape["n_heads"],
+        n_kv_heads=shape["n_heads"], d_ff=shape["d_ff"],
+        max_seq_len=shape["seq"], dtype=jnp.float32, remat=False,
+        attention_impl="xla",
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: causal_lm_loss(model.apply({"params": p}, tokens), tokens)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    tokens = jnp.zeros((shape["bs"], shape["seq"]), jnp.int32)
+    compiled = step.lower(params, opt_state, tokens).compile()
+    xla_flops = bench._cost_analysis_flops(compiled)
+    if xla_flops is None:
+        pytest.skip("cost_analysis reports no flops on this backend")
+    analytic = bench._analytic_llm_step_flops(shape, n_params)
+    assert 0.3 <= xla_flops / analytic <= 3.0, (xla_flops, analytic)
+
+
+def test_mfu_guard_rejects_impossible_rates():
+    with pytest.raises(bench.BenchIntegrityError):
+        bench._check_mfu("llm", 1.2)
+    with pytest.raises(bench.BenchIntegrityError):
+        bench._check_mfu("llm", -0.1)
+    bench._check_mfu("llm", 0.4)  # plausible: no raise
