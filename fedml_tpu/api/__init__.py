@@ -29,9 +29,11 @@ def _launch_manager(num_edges: int = 1):
         import os
 
         from ..computing.scheduler.agents import FedMLClientRunner
+        from ..computing.scheduler.cluster import detect_local_capacity
 
         i = len(manager.edges)
         manager.edges[i] = FedMLClientRunner(i, base_dir=os.path.join(manager.base_dir, f"edge_{i}"))
+        manager.cluster.refresh(detect_local_capacity(i))
     return manager
 
 
@@ -54,6 +56,35 @@ def launch_job(
 def job_stop(run_id: str) -> None:
     for edge in _launch_manager().edges.values():
         edge.callback_stop_train(run_id)
+
+
+# --- cluster capacity (reference api/__init__.py:142-178 cluster_* verbs) ---
+# The reference's verbs act on its cloud inventory; these act on the LOCAL
+# capacity journal the launch matcher consumes (scheduler/cluster.py). The
+# marketplace lifecycle verbs (start/stop/autostop) have no local meaning
+# and remain a documented scope cut (README).
+
+def cluster_register(edge_id: int, slots: int, cores: Optional[int] = None,
+                     memory_mb: int = 0, accelerator_kind: str = "") -> None:
+    """Declare an agent's capacity to the launch matcher (the reference
+    agent auto-reports this on check-in; a local/test topology sets it
+    explicitly)."""
+    from ..computing.scheduler.cluster import EdgeCapacity
+
+    _launch_manager().cluster.register(EdgeCapacity(
+        edge_id=edge_id, cores=cores if cores is not None else (os.cpu_count() or 1),
+        memory_mb=memory_mb, slots_total=slots, slots_available=slots,
+        accelerator_kind=accelerator_kind))
+
+
+def cluster_list() -> Dict[int, Any]:
+    """Registered agents and their capacity (reference cluster_list)."""
+    return _launch_manager().cluster.capacities()
+
+
+def cluster_status() -> Dict[str, int]:
+    """Aggregate slot availability (reference cluster_status)."""
+    return _launch_manager().cluster.status()
 
 
 # --- build (reference api/__init__.py fedml_build / train build) -----------

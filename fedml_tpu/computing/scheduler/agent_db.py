@@ -49,6 +49,15 @@ CREATE TABLE IF NOT EXISTS meta (
     key TEXT PRIMARY KEY,
     value TEXT NOT NULL
 );
+CREATE TABLE IF NOT EXISTS capacity (
+    edge_id INTEGER PRIMARY KEY,
+    cores INTEGER NOT NULL,
+    memory_mb INTEGER NOT NULL,
+    accelerator_kind TEXT NOT NULL DEFAULT '',
+    slots_total INTEGER NOT NULL,
+    slots_available INTEGER NOT NULL,
+    updated_at REAL
+);
 """
 
 
@@ -144,6 +153,88 @@ class AgentDatabase:
             )
             self._conn.commit()
             return int(self._conn.execute("SELECT count FROM restarts WHERE key=?", (key,)).fetchone()[0])
+
+    # --- cluster capacity (scheduler_core/scheduler_matcher.py parity) ----
+    def register_capacity(self, edge_id: int, cores: int, memory_mb: int,
+                          slots_total: int, slots_available: Optional[int] = None,
+                          accelerator_kind: str = "") -> None:
+        """An agent declares (or refreshes) its resources; the launch
+        matcher reads these rows. slots_available defaults to slots_total
+        on first registration and is preserved on refresh."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO capacity (edge_id, cores, memory_mb, accelerator_kind,"
+                " slots_total, slots_available, updated_at) VALUES (?,?,?,?,?,?,?)"
+                " ON CONFLICT(edge_id) DO UPDATE SET cores=excluded.cores,"
+                " memory_mb=excluded.memory_mb, accelerator_kind=excluded.accelerator_kind,"
+                " slots_total=excluded.slots_total,"
+                " slots_available=COALESCE(?, capacity.slots_available),"
+                " updated_at=excluded.updated_at",
+                (edge_id, cores, memory_mb, accelerator_kind, slots_total,
+                 slots_available if slots_available is not None else slots_total,
+                 time.time(), slots_available),
+            )
+            self._conn.commit()
+
+    def list_capacity(self) -> Dict[int, Dict[str, Any]]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT edge_id, cores, memory_mb, accelerator_kind,"
+                " slots_total, slots_available, updated_at FROM capacity"
+            ).fetchall()
+        return {
+            r[0]: dict(edge_id=r[0], cores=r[1], memory_mb=r[2],
+                       accelerator_kind=r[3], slots_total=r[4],
+                       slots_available=r[5], updated_at=r[6])
+            for r in rows
+        }
+
+    def register_capacity_if_absent(self, edge_id: int, cores: int, memory_mb: int,
+                                    slots_total: int, slots_available: int,
+                                    accelerator_kind: str = "") -> None:
+        """Insert a capacity row only when none exists — the startup
+        auto-inventory's write mode (an explicit registration always wins)."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO capacity (edge_id, cores, memory_mb,"
+                " accelerator_kind, slots_total, slots_available, updated_at)"
+                " VALUES (?,?,?,?,?,?,?)",
+                (edge_id, cores, memory_mb, accelerator_kind, slots_total,
+                 slots_available, time.time()),
+            )
+            self._conn.commit()
+
+    def debit_slots(self, assignment: Dict[int, int]) -> bool:
+        """Conditionally debit every edge's slots in ONE transaction.
+        Returns False (and changes nothing) if ANY edge no longer has the
+        assigned count available — the caller's match raced another
+        launcher on the shared journal."""
+        if not assignment:
+            return True
+        with self._lock:
+            try:
+                for eid, n in assignment.items():
+                    cur = self._conn.execute(
+                        "UPDATE capacity SET slots_available=slots_available-?,"
+                        " updated_at=? WHERE edge_id=? AND slots_available>=?",
+                        (n, time.time(), eid, n),
+                    )
+                    if cur.rowcount != 1:
+                        self._conn.rollback()
+                        return False
+                self._conn.commit()
+                return True
+            except Exception:
+                self._conn.rollback()
+                raise
+
+    def set_slots_available(self, edge_id: int, slots_available: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE capacity SET slots_available=?, updated_at=? WHERE edge_id=?",
+                (slots_available, time.time(), edge_id),
+            )
+            self._conn.commit()
 
     # --- meta ------------------------------------------------------------
     def set_meta(self, key: str, value: str) -> None:

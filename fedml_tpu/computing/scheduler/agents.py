@@ -95,6 +95,17 @@ class FedMLClientRunner:
         env.update({k: str(v) for k, v in (request.get("env") or {}).items()})
         env["FEDML_RUN_ID"] = run_id
         env["FEDML_EDGE_ID"] = str(self.edge_id)
+        sched = request.get("scheduler_info")
+        if sched:
+            # capacity-matched jobs learn topology + their own slot count
+            # (reference: scheduler_matcher.generate_match_info_for_scheduler
+            # shipped to each edge in the start-run payload); a multi-host
+            # runner feeds these into its mesh/process-group setup
+            env["FEDML_MASTER_ADDR"] = str(sched.get("master_node_addr", "localhost"))
+            env["FEDML_MASTER_PORT"] = str(sched.get("master_node_port", 29500))
+            env["FEDML_NUM_NODES"] = str(sched.get("num_nodes", 1))
+            env["FEDML_MATCHED_SLOTS"] = str(
+                (sched.get("matched_slots") or {}).get(str(self.edge_id), 0))
         # jobs must be able to `import fedml_tpu` wherever the agent unpacks
         # them (the reference gets this from the pip-installed fedml package)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))

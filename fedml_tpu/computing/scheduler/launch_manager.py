@@ -12,10 +12,13 @@ from __future__ import annotations
 import logging
 import os
 import tempfile
+import threading
+import time
 import uuid
 from typing import Dict, List, Optional
 
 from .agents import FedMLClientRunner, FedMLServerRunner, RunStatus
+from .cluster import ClusterRegistry, detect_local_capacity, match_and_assign
 from .job_config import FedMLJobConfig
 from .package import build_job_package
 
@@ -36,24 +39,36 @@ class FedMLLaunchManager:
         self.edges = {i: FedMLClientRunner(i, base_dir=os.path.join(self.base_dir, f"edge_{i}"))
                       for i in range(num_edges)}
         self.master = FedMLServerRunner(self.edges)
+        # each local edge announces its host inventory into the shared
+        # journal — first-contact only: an explicit cluster_register (or a
+        # previous session's row, which tracks in-flight slots) always wins
+        self.cluster = ClusterRegistry(os.path.join(self.base_dir, "cluster.db"))
+        for eid in self.edges:
+            self.cluster.announce(detect_local_capacity(eid))
 
-    def match_resources(self, config: FedMLJobConfig) -> List[int]:
-        """Capability filter (all local edges satisfy zero-GPU asks; a TPU
-        ask maps to edges whose env exposes an accelerator)."""
+    def match_resources(self, config: FedMLJobConfig) -> tuple[List[int], Dict[int, int]]:
+        """Returns (edge_ids, {edge_id: assigned_slots}).
+
+        A zero-slot ask runs on every local edge (the reference's CPU
+        jobs bypass GPU matching the same way); a positive ask is matched
+        over REGISTERED capacity with the reference's spread algorithm
+        (cluster.match_and_assign) — ClusterMatchError states ask vs
+        availability when the cluster can't satisfy it."""
         if config.minimum_num_gpus <= 0:
-            return sorted(self.edges)
-        try:
-            import jax
-
-            has_accel = any(d.platform != "cpu" for d in jax.devices())
-        except Exception:
-            has_accel = False
-        return sorted(self.edges) if has_accel else []
+            return sorted(self.edges), {}
+        # restrict to edges THIS manager runs: the shared journal may hold
+        # rows for edge ids with no local runner (stale topology, or a
+        # cluster_register for a remote agent) and dispatching to them
+        # would strand the run in a dead thread
+        assignment = match_and_assign(
+            config.minimum_num_gpus, self.cluster.capacities(),
+            edge_ids=sorted(self.edges))
+        return sorted(assignment), assignment
 
     def launch_job(self, job_yaml_path: str, timeout_s: float = 600.0) -> Dict[int, RunStatus]:
         config = FedMLJobConfig(job_yaml_path)
         config.validate()
-        edge_ids = self.match_resources(config)
+        edge_ids, assignment = self.match_resources(config)
         if not edge_ids:
             raise RuntimeError("no edge satisfies the job's resource requirements")
         run_id = uuid.uuid4().hex[:8]
@@ -62,18 +77,63 @@ class FedMLLaunchManager:
             os.path.join(self.base_dir, "packages", f"{config.job_name}-{run_id}.zip"),
             meta={"job_name": config.job_name, "project": config.project_name},
         )
-        log.info("launching job %s run=%s on edges %s", config.job_name, run_id, edge_ids)
-        # run history lives in master.statuses (api.run_list/run_status)
-        return self.master.dispatch(
-            {
-                "run_id": run_id,
-                "package_path": pkg,
-                "job_cmd": config.job,
-                "bootstrap_cmd": config.bootstrap,
-            },
-            edge_ids=edge_ids,
-            timeout_s=timeout_s,
-        )
+        log.info("launching job %s run=%s on edges %s (slots %s)",
+                 config.job_name, run_id, edge_ids, assignment or "n/a")
+        request = {
+            "run_id": run_id,
+            "package_path": pkg,
+            "job_cmd": config.job,
+            "bootstrap_cmd": config.bootstrap,
+        }
+        if assignment:
+            # scheduler_matcher.generate_match_info_for_scheduler parity:
+            # every edge learns the topology + its own slot count
+            request["scheduler_info"] = {
+                "master_node_addr": "localhost",
+                "master_node_port": 29500,
+                "num_nodes": len(edge_ids),
+                "matched_slots": {str(e): n for e, n in assignment.items()},
+            }
+        self.cluster.acquire(assignment)
+        statuses = None
+        try:
+            # run history lives in master.statuses (api.run_list/run_status)
+            statuses = self.master.dispatch(request, edge_ids=edge_ids, timeout_s=timeout_s)
+            return statuses
+        finally:
+            from .agents import TERMINAL
+
+            if statuses is None:
+                # dispatch itself blew up: nothing is running, credit it all
+                self.cluster.release(assignment)
+            else:
+                # credit only edges whose run actually ENDED — a RUNNING
+                # placeholder (dispatch timeout) still occupies its slots,
+                # and releasing them would double-book a busy chip. The
+                # stragglers' RunStatus objects mutate in place when their
+                # _wait threads finish, so a reaper polls them to terminal
+                # and credits the slots then.
+                done = {e: n for e, n in assignment.items()
+                        if getattr(statuses.get(e), "status", None) in TERMINAL}
+                self.cluster.release(done)
+                pending = {e: n for e, n in assignment.items() if e not in done}
+                if pending:
+                    threading.Thread(
+                        target=self._release_when_terminal,
+                        args=(statuses, pending), daemon=True).start()
+
+    def _release_when_terminal(self, statuses: Dict[int, RunStatus],
+                               pending: Dict[int, int], poll_s: float = 2.0) -> None:
+        from .agents import TERMINAL
+
+        pending = dict(pending)
+        while pending:
+            done = [e for e in pending
+                    if getattr(statuses.get(e), "status", None) in TERMINAL]
+            if done:
+                self.cluster.release({e: pending.pop(e) for e in done})
+            if pending:
+                time.sleep(poll_s)
 
 
 def launch_job_over_mqtt(
