@@ -90,6 +90,7 @@ def load(args: Any) -> FedDataset:
             fed = load_native_format(
                 dataset, cache, client_num,
                 partition_method=getattr(args, "fednlp_partition_method", None),
+                partition_alpha=alpha, seed=seed,
             )
         except FedDataConfigError:
             raise  # the files are fine; the CONFIG is wrong — tell the user

@@ -291,6 +291,12 @@ def detect_format_files(dataset: str, cache: str) -> Optional[str]:
             for name in ("landmarks", "gld23k")
         },
         "reddit": lambda: bool(_reddit_txt_files(d, "train")),
+        # SBD benchmark drop (fedcv image_segmentation example layout)
+        "pascal_voc": lambda: (
+            os.path.exists(os.path.join(d, "dataset", "train.txt"))
+            and os.path.isdir(os.path.join(d, "dataset", "img"))
+            and os.path.isdir(os.path.join(d, "dataset", "cls"))
+        ),
     }
     fn = checks.get(dataset)
     try:
@@ -300,8 +306,13 @@ def detect_format_files(dataset: str, cache: str) -> Optional[str]:
 
 
 def load_native_format(dataset: str, cache: str, client_num: Optional[int] = None,
-                       partition_method: Optional[str] = None):
-    """Load `dataset` from its reference-format files under ``{cache}/{dataset}``."""
+                       partition_method: Optional[str] = None,
+                       partition_alpha: Optional[float] = None, seed: int = 0):
+    """Load `dataset` from its reference-format files under ``{cache}/{dataset}``.
+
+    ``partition_alpha``/``seed`` reach the loaders that partition at parse
+    time (pascal_voc has no natural users); loaders with a file-native
+    client split ignore them."""
     d = os.path.join(cache, dataset)
     if dataset in ("femnist", "mnist"):
         shape = (28, 28, 1) if dataset == "femnist" else None
@@ -322,6 +333,13 @@ def load_native_format(dataset: str, cache: str, client_num: Optional[int] = Non
         train, test, classes = load_landmarks_csv(d)
     elif dataset == "reddit":
         train, test, classes = load_reddit_text_dir(d)
+    elif dataset == "pascal_voc":
+        # partitioned at parse time (no natural users in an SBD drop):
+        # one "user" per dirichlet shard sized to the requested client count
+        train, test, classes = load_pascal_voc_dir(
+            d, n_clients=client_num,
+            alpha=partition_alpha if partition_alpha is not None else 0.5,
+            seed=seed)
     else:
         raise ValueError(f"no native-format loader for {dataset!r}")
     log.info("dataset %s: loaded NATIVE format files from %s (%d clients)", dataset, d, len(train))
@@ -726,3 +744,106 @@ def load_reddit_text_dir(
     log.info("dataset reddit: %d users, %d train blocks, vocab %d (corpus-trained BPE)",
              len(train), sum(len(x) for x, _ in train.values()), vocab)
     return train, test, vocab
+
+
+# --- Pascal-VOC-augmented segmentation (FedSeg family) -----------------------
+
+PASCAL_VOC_CLASSES = 21  # background + 20 object categories (SBD benchmark)
+
+
+def load_pascal_voc_dir(root: str, n_clients: Optional[int] = None,
+                        image_hw: int = 64, alpha: float = 0.5,
+                        seed: int = 0) -> Tuple[ClientData, ClientData, int]:
+    """Pascal-VOC-augmented (SBD benchmark) layout, as the reference's
+    fedseg example consumes it (``examples/federate/prebuilt_jobs/fedcv/
+    image_segmentation/data/pascal_voc_augmented/dataset.py:33-106``):
+
+        {root}/dataset/img/<id>.jpg      RGB images
+        {root}/dataset/cls/<id>.mat      scipy .mat, GTcls struct with
+                                         .Segmentation (HxW class mask) and
+                                         .CategoriesPresent
+        {root}/dataset/train.txt         one image id per line
+        {root}/dataset/val.txt           eval split (optional)
+
+    Images are resized bilinearly (masks NEAREST — interpolating class ids
+    would invent phantom classes on boundaries) to ``image_hw`` so batches
+    are static-shaped for XLA. The federated split mirrors the reference's
+    data_loader.py partition_data: Dirichlet(alpha) over each image's FIRST
+    present category. Without a val.txt, every client shares a small
+    held-out tail of train as eval data.
+    """
+    import scipy.io as sio
+    from PIL import Image
+
+    from ..core.data.noniid_partition import (
+        non_iid_partition_with_dirichlet_distribution,
+    )
+
+    base = os.path.join(root, "dataset")
+
+    def read_ids(name: str) -> List[str]:
+        p = os.path.join(base, f"{name}.txt")
+        if not os.path.exists(p):
+            return []
+        with open(p) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+
+    def load_split(ids: List[str]) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        xs, ys, first_cat = [], [], []
+        for iid in ids:
+            img_p = os.path.join(base, "img", f"{iid}.jpg")
+            mat_p = os.path.join(base, "cls", f"{iid}.mat")
+            img = Image.open(img_p).convert("RGB").resize(
+                (image_hw, image_hw), Image.BILINEAR)
+            mat = sio.loadmat(mat_p, mat_dtype=True, squeeze_me=True,
+                              struct_as_record=False)
+            gtcls = mat["GTcls"]
+            mask_full = np.asarray(gtcls.Segmentation, np.uint8)
+            mask = np.asarray(Image.fromarray(mask_full).resize(
+                (image_hw, image_hw), Image.NEAREST))
+            xs.append(np.asarray(img, np.float32) / 255.0)
+            ys.append(mask.astype(np.int32))
+            # partition label from the mat's own CategoriesPresent (the
+            # reference's targets, dataset.py:88-102) — NOT the downsampled
+            # mask, where a small object can vanish under NEAREST and
+            # mislabel the image as background
+            cats = np.atleast_1d(np.asarray(
+                getattr(gtcls, "CategoriesPresent", []), np.int64)).ravel()
+            if not len(cats):
+                full = np.unique(mask_full)
+                cats = full[full > 0]
+            first_cat.append(int(cats[0]) if len(cats) else 0)
+        return (np.stack(xs), np.stack(ys), np.asarray(first_cat, np.int64))
+
+    train_ids = read_ids("train")
+    if not train_ids:
+        raise ValueError(f"{base}: train.txt is missing or empty")
+    x_tr, y_tr, cats_tr = load_split(train_ids)
+    val_ids = read_ids("val")
+    if val_ids:
+        x_te, y_te, _ = load_split(val_ids)
+    else:
+        # hold out a tail of train for eval (shared across clients)
+        n_te = max(1, len(x_tr) // 10)
+        x_te, y_te = x_tr[-n_te:], y_tr[-n_te:]
+        x_tr, y_tr, cats_tr = x_tr[:-n_te], y_tr[:-n_te], cats_tr[:-n_te]
+
+    n = min(n_clients or 4, len(x_tr))
+    net_map = non_iid_partition_with_dirichlet_distribution(
+        cats_tr, n, PASCAL_VOC_CLASSES, alpha, seed)
+    train: ClientData = {}
+    test: ClientData = {}
+    for cid, idx in net_map.items():
+        idx = np.asarray(idx, np.int64)
+        train[f"client_{cid:03d}"] = (x_tr[idx], y_tr[idx])
+        # val is PARTITIONED round-robin, not duplicated: handing every
+        # client the full val set would replicate it client_num times in
+        # memory and inflate the global test count by the same factor
+        te_idx = np.arange(cid, len(x_te), n)
+        if not len(te_idx):
+            te_idx = np.asarray([cid % len(x_te)])
+        test[f"client_{cid:03d}"] = (x_te[te_idx], y_te[te_idx])
+    log.info("dataset pascal_voc: %d train / %d eval images -> %d clients "
+             "(dirichlet alpha=%.2f over first-category)",
+             len(x_tr), len(x_te), len(train), alpha)
+    return train, test, PASCAL_VOC_CLASSES

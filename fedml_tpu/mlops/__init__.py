@@ -59,6 +59,7 @@ class MLOpsRuntime:
         self.uplink = None  # MQTT telemetry plane (backend.py), opt-in
         self.api_url: Optional[str] = None  # REST log collector, opt-in
         self.profiler = MLOpsProfilerEvent(self)
+        self._sys_perf = None  # continuous SysPerfSampler (log_sys_perf)
 
     def init(self, args: Any) -> None:
         self.enabled = bool(getattr(args, "using_mlops", False)) or bool(getattr(args, "enable_tracking", False))
@@ -88,6 +89,18 @@ class MLOpsRuntime:
                 logging.getLogger(__name__).warning(
                     "mlops MQTT uplink unavailable; continuing without it", exc_info=True
                 )
+        if self.enabled and bool(getattr(args, "enable_sys_perf", True)):
+            # tracked runs get the continuous device-perf series alongside
+            # training for free (reference: mlops.init starts the reporter
+            # processes the same way); opt out with enable_sys_perf: false
+            log_sys_perf(args)
+
+    def shutdown(self) -> None:
+        """Stop background reporters (sampler thread; the uplink publishes
+        synchronously and needs no teardown). Called by FedMLRunner.run's
+        finally (the run owns the sampler's lifetime); safe to call
+        repeatedly."""
+        stop_sys_perf()
 
     def append_record(self, rec: Dict[str, Any]) -> None:
         self.records.append(rec)
@@ -187,30 +200,34 @@ class profile_span:
 
 
 def log_sys_perf(args: Any = None) -> None:
-    """System perf sampling (reference: mlops_device_perfs.py). Samples
-    psutil counters once per call; TPU utilization comes from jax device
-    memory stats when exposed."""
-    try:
-        import psutil
+    """START continuous system-perf reporting (reference semantics:
+    ``mlops.log_sys_perf`` spawns the background device-perf reporter,
+    ``mlops_device_perfs.py:44-80`` — it is not a one-shot). A
+    ``SysPerfSampler`` thread records cpu/mem/net + jax device
+    ``memory_stats()`` every ``args.sys_perf_interval_s`` (default 10s)
+    into the run's ``events.jsonl`` and the uplink, after one immediate
+    sample so short runs still get a data point. Idempotent; stop with
+    :func:`stop_sys_perf` (``MLOpsRuntime.shutdown`` calls it too)."""
+    rt = MLOpsRuntime.get_instance()
+    if getattr(rt, "_sys_perf", None) is not None:
+        return
+    from .runtime_log import SysPerfSampler
 
-        rec = {
-            "type": "sys_perf",
-            "cpu_pct": psutil.cpu_percent(interval=None),
-            "mem_pct": psutil.virtual_memory().percent,
-            "t": time.time(),
-        }
-    except Exception:  # pragma: no cover
-        rec = {"type": "sys_perf", "t": time.time()}
-    try:
-        import jax
+    interval = float(getattr(args, "sys_perf_interval_s", 10.0) or 10.0)
+    sampler = SysPerfSampler(rt.append_record, interval_s=interval)
+    sampler.sample_once()
+    sampler.start()
+    rt._sys_perf = sampler
 
-        d = jax.devices()[0]
-        stats = getattr(d, "memory_stats", lambda: None)()
-        if stats:
-            rec["device_bytes_in_use"] = stats.get("bytes_in_use")
-    except Exception:  # pragma: no cover
-        pass
-    MLOpsRuntime.get_instance().append_record(rec)
+
+def stop_sys_perf() -> None:
+    """Stop the continuous reporter (reference:
+    ``stop_device_realtime_stats``)."""
+    rt = MLOpsRuntime.get_instance()
+    sampler = getattr(rt, "_sys_perf", None)
+    if sampler is not None:
+        sampler.stop()
+        rt._sys_perf = None
 
 
 def log_metric(metrics: Dict[str, Any], step: Optional[int] = None, commit: bool = True) -> None:

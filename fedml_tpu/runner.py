@@ -121,4 +121,13 @@ class FedMLRunner:
         return ServerEdge(args, device, dataset, model, server_aggregator)
 
     def run(self):
-        return self.runner.run()
+        try:
+            return self.runner.run()
+        finally:
+            # the run's background reporters (continuous sys-perf sampler)
+            # must die WITH the run — a long-lived process (notebook, sweep
+            # driver) would otherwise keep appending post-run samples to the
+            # finished run's event log forever
+            from .mlops import MLOpsRuntime
+
+            MLOpsRuntime.get_instance().shutdown()

@@ -864,3 +864,80 @@ def test_edge_case_attack_pool_shape_mismatch_falls_back(tmp_path, caplog):
     assert (py == 5).sum() >= 10
     np.testing.assert_array_equal(px, x)  # tail-relabel: features untouched
     assert any("does not match" in r.message for r in caplog.records)
+
+
+# --- pascal_voc_augmented segmentation (FedSeg) ----------------------------
+
+
+def _write_pascal_voc(tmp_path, n_train=6, n_val=2, hw=40):
+    """SBD benchmark drop in the reference fedcv example's layout:
+    dataset/{img/*.jpg, cls/*.mat (GTcls struct), train.txt, val.txt}."""
+    import scipy.io as sio
+    from PIL import Image
+
+    base = tmp_path / "pascal_voc" / "dataset"
+    (base / "img").mkdir(parents=True)
+    (base / "cls").mkdir()
+    rng = np.random.default_rng(3)
+    ids = []
+    for i in range(n_train + n_val):
+        iid = f"2008_{i:06d}"
+        ids.append(iid)
+        arr = rng.integers(0, 255, (hw, hw, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(base / "img" / f"{iid}.jpg")
+        mask = np.zeros((hw, hw), np.uint8)
+        cat = (i % 2) + 1  # categories 1 (airplane) and 2 (bicycle)
+        mask[5:20, 5:20] = cat
+        sio.savemat(base / "cls" / f"{iid}.mat",
+                    {"GTcls": {"Segmentation": mask,
+                               "CategoriesPresent": np.array([cat])}})
+    (base / "train.txt").write_text("\n".join(ids[:n_train]) + "\n")
+    (base / "val.txt").write_text("\n".join(ids[n_train:]) + "\n")
+    return tmp_path
+
+
+def test_pascal_voc_parser_shapes_and_partition(tmp_path):
+    from fedml_tpu.data.formats import load_pascal_voc_dir
+
+    _write_pascal_voc(tmp_path)
+    assert detect_format_files("pascal_voc", str(tmp_path)) == "pascal_voc"
+    train, test, classes = load_pascal_voc_dir(
+        str(tmp_path / "pascal_voc"), n_clients=2)
+    assert classes == 21
+    assert len(train) == 2
+    total = 0
+    for x, y in train.values():
+        assert x.shape[1:] == (64, 64, 3) and x.dtype == np.float32
+        assert 0.0 <= float(x.min()) and float(x.max()) <= 1.0
+        assert y.shape[1:] == (64, 64) and y.dtype == np.int32
+        # NEAREST mask resize invents no phantom classes
+        assert set(np.unique(y)) <= {0, 1, 2}
+        total += len(x)
+    assert total == 6  # every train image assigned exactly once
+    # val is PARTITIONED across clients (not duplicated into each)
+    assert sum(len(x) for x, _ in test.values()) == 2
+    assert all(len(x) >= 1 for x, _ in test.values())
+
+
+def test_pascal_voc_fedseg_end_to_end(tmp_path):
+    """The fedseg sp simulator consumes the real SBD drop (VERDICT r4 next
+    #5): real files -> native parser -> unet -> one FedSeg round."""
+    import fedml_tpu as fedml
+    from fedml_tpu.arguments import default_config
+    from fedml_tpu.simulation.simulator import SimulatorSingleProcess
+
+    _write_pascal_voc(tmp_path)
+    args = fedml.init(default_config(
+        "simulation", dataset="pascal_voc", model="unet",
+        federated_optimizer="FedSeg", client_num_in_total=2,
+        client_num_per_round=2, comm_round=1, epochs=1, batch_size=4,
+        data_cache_dir=str(tmp_path), random_seed=0,
+    ))
+    device = fedml.device.get_device(args)
+    dataset, output_dim = fedml.data.load(args)
+    assert output_dim == 21  # real files, not the 3-class surrogate
+    assert tuple(args.input_shape) == (1, 64, 64, 3)
+    model = fedml.model.create(args, output_dim)
+    sim = SimulatorSingleProcess(args, device, dataset, model)
+    metrics = sim.run()
+    assert "mIoU" in metrics and np.isfinite(metrics["test_loss"])
