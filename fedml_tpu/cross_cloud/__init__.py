@@ -17,21 +17,90 @@ broadcast). ``tests/test_llm.py`` shows the wiring.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, Optional
 
 from ..cross_silo.fedml_client import FedMLCrossSiloClient
 from ..cross_silo.fedml_server import FedMLCrossSiloServer
+from .wan_transfer import ResumableTransfer, TransferIntegrityError
+
+log = logging.getLogger(__name__)
+
+# args keys a region block may override — the cross-region knobs (where the
+# broker/store for THIS party lives, how its WAN transfers are chunked);
+# anything else in a region block is rejected loudly rather than silently
+# ignored
+_REGION_KEYS = {
+    "backend", "broker_host", "broker_port", "grpc_ipconfig_path",
+    "s3_bucket", "object_store_dir", "wan_chunk_mb", "wan_max_retries",
+}
+
+
+def apply_region_config(args: Any) -> Any:
+    """Per-region comm config (what makes cross_cloud more than an alias).
+
+    A Cheetah deployment spans regions whose parties reach DIFFERENT broker
+    endpoints / object stores: ``args.regions = {name: {broker_host: ...,
+    s3_bucket: ...}}`` declares them, ``args.region`` names the one this
+    party runs in, and the selected block's keys are copied onto args
+    before the comm manager reads them. No-op when the config declares no
+    regions (single-region behaves exactly like cross-silo)."""
+    regions: Optional[Dict[str, Dict[str, Any]]] = getattr(args, "regions", None)
+    if not regions:
+        return args
+    name = getattr(args, "region", None)
+    if name is None or name not in regions:
+        raise ValueError(
+            f"args.region={name!r} does not name a configured region "
+            f"(have: {sorted(regions)})")
+    block = regions[name] or {}
+    unknown = set(block) - _REGION_KEYS
+    if unknown:
+        raise ValueError(
+            f"region {name!r} config has unknown keys {sorted(unknown)} "
+            f"(allowed: {sorted(_REGION_KEYS)})")
+    for k, v in block.items():
+        setattr(args, k, v)
+    log.info("cross_cloud: applied region %r comm config (%s)",
+             name, ", ".join(sorted(block)))
+    return args
 
 
 class FedMLCrossCloudClient(FedMLCrossSiloClient):
-    """Reference: cross_cloud/fedml_client.py:5 (same manager stack)."""
+    """Reference: cross_cloud/fedml_client.py:5 (same manager stack), plus
+    the per-region comm overrides applied before the stack comes up."""
+
+    def __init__(self, args: Any, *a: Any, **kw: Any):
+        super().__init__(apply_region_config(args), *a, **kw)
 
 
 class FedMLCrossCloudServer(FedMLCrossSiloServer):
-    """Reference: cross_cloud/fedml_server.py:5 (same manager stack)."""
+    """Reference: cross_cloud/fedml_server.py:5 (same manager stack), plus
+    the per-region comm overrides applied before the stack comes up."""
+
+    def __init__(self, args: Any, *a: Any, **kw: Any):
+        super().__init__(apply_region_config(args), *a, **kw)
+
+
+def wan_transfer_for(args: Any) -> ResumableTransfer:
+    """The region-configured resumable transfer plane: chunk size / retry
+    budget from the region block, store from the region's bucket/dir."""
+    from ..core.distributed.communication.mqtt_s3.object_store import (
+        create_object_store,
+    )
+
+    return ResumableTransfer(
+        create_object_store(args),
+        chunk_bytes=int(float(getattr(args, "wan_chunk_mb", 4)) * 1024 * 1024),
+        max_retries=int(getattr(args, "wan_max_retries", 3)),
+    )
 
 
 Client = FedMLCrossCloudClient
 Server = FedMLCrossCloudServer
 
-__all__ = ["Client", "Server", "FedMLCrossCloudClient", "FedMLCrossCloudServer"]
+__all__ = [
+    "Client", "Server", "FedMLCrossCloudClient", "FedMLCrossCloudServer",
+    "ResumableTransfer", "TransferIntegrityError", "apply_region_config",
+    "wan_transfer_for",
+]

@@ -828,7 +828,14 @@ def load_pascal_voc_dir(root: str, n_clients: Optional[int] = None,
         x_te, y_te = x_tr[-n_te:], y_tr[-n_te:]
         x_tr, y_tr, cats_tr = x_tr[:-n_te], y_tr[:-n_te], cats_tr[:-n_te]
 
-    n = min(n_clients or 4, len(x_tr))
+    n = n_clients or 4
+    if n > len(x_tr):
+        # surfaced here (not after a wasted full parse + partition): the
+        # dirichlet split needs >=1 image per client, and downstream
+        # clients_to_fed_dataset enforces the same bound anyway
+        raise FedDataConfigError(
+            f"client_num_in_total={n} exceeds the drop's {len(x_tr)} train "
+            "images; every client needs at least one image")
     net_map = non_iid_partition_with_dirichlet_distribution(
         cats_tr, n, PASCAL_VOC_CLASSES, alpha, seed)
     train: ClientData = {}

@@ -159,6 +159,10 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
         "decode_int8": ({"decode_tokens_per_sec": 1500.0, "bs": 4, "new": 128,
                          "weight_quant": "int8"}, None),
         "resnet": ({"steps_per_sec": 20.0, "mfu": 0.2, "bs": 128}, None),
+        "memplan": ({"plan_bytes_per_device": 7_500_000_000,
+                     "device_bytes_limit": 16 * 2**30,
+                     "device_bytes_in_use": 0, "device_kind": "TPU v5 lite",
+                     "memory_plan_validated": True}, None),
         "cpu_llm": ({"cpu_llm_tokens_per_sec": 100.0}, None),
         "cpu_resnet": ({"cpu_resnet_images_per_sec": 80.0}, None),
         "serving": ({"endpoint_decode_tokens_per_sec": 700.0,
@@ -247,6 +251,25 @@ def test_main_probe_timeout_prints_structured_skip(monkeypatch, tmp_path, capsys
     assert out["skipped"] == "tunnel_stalled"
     # the CPU denominators rode along in the skip record
     assert out["cpu_baselines"]["cpu_llm_tokens_per_sec"] == 100.0
+
+
+def test_main_merges_memplan_validation(monkeypatch, tmp_path, capsys, _restore_signals):
+    """VERDICT r4 next #6: the real-HBM 7B plan validation lands in the
+    one-line JSON and the measured artifact."""
+    _canned_stages(monkeypatch, tmp_path, {
+        "llm_pallas": _LLM_OK,
+        "memplan": ({"plan_bytes_per_device": 7_500_000_000,
+                     "device_bytes_limit": 16 * 2**30,
+                     "device_bytes_in_use": 0, "device_kind": "TPU v5 lite",
+                     "memory_plan_validated": True}, None),
+    })
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["memory_plan_validated"] is True
+    assert out["device_bytes_limit"] == 16 * 2**30
+    assert out["memplan_bytes_per_device"] == 7_500_000_000
 
 
 def test_main_reuses_banked_cpu_baselines(monkeypatch, tmp_path, capsys, _restore_signals):
@@ -510,7 +533,7 @@ def test_main_midrun_stall_aborts_remaining_stages(monkeypatch, tmp_path, capsys
     tunnel (and the already-measured stages still ship)."""
     calls = []
 
-    def fake_spawn(name, budget_s, argv=None):
+    def fake_spawn(name, budget_s, argv=None, env=None):
         calls.append(name)
         if name == "llm_pallas":
             return _LLM_OK

@@ -1,0 +1,230 @@
+"""Cross-cloud (Cheetah) distinguishing capabilities (VERDICT r4 next #7):
+per-region comm config + resumable chunked WAN transfer — behavior the
+cross-silo path deliberately does not have."""
+
+from __future__ import annotations
+
+import json
+import types
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.distributed.communication.mqtt_s3.object_store import (
+    LocalObjectStore,
+)
+from fedml_tpu.cross_cloud import apply_region_config, wan_transfer_for
+from fedml_tpu.cross_cloud.wan_transfer import (
+    ResumableTransfer,
+    TransferIntegrityError,
+)
+
+
+# --- per-region comm config -------------------------------------------------
+
+def _args(**kw):
+    return types.SimpleNamespace(**kw)
+
+
+def test_region_config_overrides_comm_args():
+    args = _args(
+        backend="GRPC", region="eu-west",
+        regions={
+            "us-east": {"backend": "MQTT_S3", "broker_host": "us.broker"},
+            "eu-west": {"backend": "MQTT_S3", "broker_host": "eu.broker",
+                        "broker_port": 1884, "wan_chunk_mb": 8},
+        },
+    )
+    apply_region_config(args)
+    assert args.backend == "MQTT_S3"
+    assert args.broker_host == "eu.broker" and args.broker_port == 1884
+    assert args.wan_chunk_mb == 8
+
+
+def test_region_config_rejects_unknown_region_and_keys():
+    with pytest.raises(ValueError, match="does not name a configured region"):
+        apply_region_config(_args(region="mars", regions={"eu": {}}))
+    with pytest.raises(ValueError, match="unknown keys"):
+        apply_region_config(_args(
+            region="eu", regions={"eu": {"brokre_host": "typo"}}))
+
+
+def test_region_config_noop_without_regions():
+    args = _args(backend="GRPC")
+    apply_region_config(args)
+    assert args.backend == "GRPC"  # single-region == cross-silo behavior
+
+
+# --- resumable chunked transfer ---------------------------------------------
+
+class FlakyStore:
+    """Wraps a real store; fails the first ``fail_first`` write_blob calls
+    (a WAN blip) and counts every write so tests can prove resume skipped
+    already-shipped chunks."""
+
+    def __init__(self, inner, fail_first=0):
+        self.inner = inner
+        self.fail_first = fail_first
+        self.writes = 0
+        self.write_log = []
+
+    def write_blob(self, key, blob, ext=".bin"):
+        self.writes += 1
+        if self.writes <= self.fail_first:
+            raise ConnectionError("wan blip")
+        self.write_log.append(key)
+        return self.inner.write_blob(key, blob, ext)
+
+    def read_blob(self, url):
+        return self.inner.read_blob(url)
+
+
+def _big_file(tmp_path, n_bytes=300_000, seed=0):
+    rng = np.random.default_rng(seed)
+    p = tmp_path / "ckpt.bin"
+    p.write_bytes(rng.integers(0, 256, n_bytes, dtype=np.uint8).tobytes())
+    return str(p)
+
+
+def test_chunked_roundtrip(tmp_path):
+    store = LocalObjectStore(str(tmp_path / "store"))
+    xfer = ResumableTransfer(store, state_dir=str(tmp_path / "state"),
+                             chunk_bytes=64 * 1024)
+    src = _big_file(tmp_path)
+    url = xfer.upload(src, "run1/ckpt")
+    manifest = json.loads(store.read_blob(url).decode())
+    assert manifest["n_chunks"] == 5  # 300000 / 65536 -> 5 chunks
+    dst = str(tmp_path / "out" / "ckpt.bin")
+    xfer.download(url, dst)
+    assert open(dst, "rb").read() == open(src, "rb").read()
+
+
+def test_transient_failures_ride_retry(tmp_path):
+    store = FlakyStore(LocalObjectStore(str(tmp_path / "store")), fail_first=2)
+    xfer = ResumableTransfer(store, state_dir=str(tmp_path / "state"),
+                             chunk_bytes=64 * 1024, max_retries=3,
+                             backoff_s=0.01)
+    url = xfer.upload(_big_file(tmp_path), "run1/ckpt")
+    dst = str(tmp_path / "out.bin")
+    xfer.download(url, dst)  # roundtrip still intact
+
+
+def test_resume_skips_shipped_chunks(tmp_path):
+    """A mid-transfer failure (retries exhausted) leaves a journal; the
+    re-invoked upload ships ONLY the remaining chunks."""
+    inner = LocalObjectStore(str(tmp_path / "store"))
+    src = _big_file(tmp_path)  # 5 chunks at 64KB
+
+    # first attempt: chunks 0-1 succeed, then the link dies hard
+    class DieAfter(FlakyStore):
+        def write_blob(self, key, blob, ext=".bin"):
+            if len(self.write_log) >= 2:
+                raise ConnectionError("link down")
+            return super().write_blob(key, blob, ext)
+
+    dying = DieAfter(inner)
+    xfer = ResumableTransfer(dying, state_dir=str(tmp_path / "state"),
+                             chunk_bytes=64 * 1024, max_retries=1,
+                             backoff_s=0.01)
+    with pytest.raises(ConnectionError):
+        xfer.upload(src, "run1/ckpt")
+    assert len(dying.write_log) == 2  # chunks 0 and 1 shipped
+
+    # second attempt on a healthy link: resumes at chunk 2
+    healthy = FlakyStore(inner)
+    xfer2 = ResumableTransfer(healthy, state_dir=str(tmp_path / "state"),
+                              chunk_bytes=64 * 1024)
+    url = xfer2.upload(src, "run1/ckpt")
+    # 3 remaining chunks + 1 manifest = 4 writes; chunks 0-1 NOT re-sent
+    assert healthy.writes == 4
+    assert not any(".part00000" in k or ".part00001" in k
+                   for k in healthy.write_log)
+    dst = str(tmp_path / "out.bin")
+    xfer2.download(url, dst)
+    assert open(dst, "rb").read() == open(src, "rb").read()
+
+
+def test_resume_reverifies_chunks_against_current_store(tmp_path):
+    """A journal that outlives the store contents (pruned tempdir, or a
+    region switch pointing at a different store) must NOT produce a
+    manifest of dead urls: unreadable journal chunks are re-shipped."""
+    import shutil
+
+    inner = LocalObjectStore(str(tmp_path / "store"))
+    src = _big_file(tmp_path)
+
+    class DieAfter(FlakyStore):
+        def write_blob(self, key, blob, ext=".bin"):
+            if len(self.write_log) >= 2:
+                raise ConnectionError("link down")
+            return super().write_blob(key, blob, ext)
+
+    xfer = ResumableTransfer(DieAfter(inner), state_dir=str(tmp_path / "state"),
+                             chunk_bytes=64 * 1024, max_retries=0, backoff_s=0.01)
+    with pytest.raises(ConnectionError):
+        xfer.upload(src, "run1/ckpt")
+
+    shutil.rmtree(str(tmp_path / "store"))  # store pruned; journal survives
+    healthy = FlakyStore(LocalObjectStore(str(tmp_path / "store")))
+    xfer2 = ResumableTransfer(healthy, state_dir=str(tmp_path / "state"),
+                              chunk_bytes=64 * 1024)
+    url = xfer2.upload(src, "run1/ckpt")
+    assert healthy.writes == 6  # ALL 5 chunks re-shipped + manifest
+    dst = str(tmp_path / "out.bin")
+    xfer2.download(url, dst)  # and every manifest url is readable
+    assert open(dst, "rb").read() == open(src, "rb").read()
+
+
+def test_changed_file_invalidates_journal(tmp_path):
+    """Resume state is keyed to the file's sha: editing the file between
+    attempts restarts the transfer instead of stitching mismatched chunks."""
+    inner = LocalObjectStore(str(tmp_path / "store"))
+    src = _big_file(tmp_path)
+
+    class DieAfter(FlakyStore):
+        def write_blob(self, key, blob, ext=".bin"):
+            if len(self.write_log) >= 2:
+                raise ConnectionError("link down")
+            return super().write_blob(key, blob, ext)
+
+    xfer = ResumableTransfer(DieAfter(inner), state_dir=str(tmp_path / "state"),
+                             chunk_bytes=64 * 1024, max_retries=0, backoff_s=0.01)
+    with pytest.raises(ConnectionError):
+        xfer.upload(src, "run1/ckpt")
+
+    _big_file(tmp_path, seed=7)  # same path, new contents
+    healthy = FlakyStore(inner)
+    xfer2 = ResumableTransfer(healthy, state_dir=str(tmp_path / "state"),
+                              chunk_bytes=64 * 1024)
+    url = xfer2.upload(src, "run1/ckpt")
+    assert healthy.writes == 6  # ALL 5 chunks re-shipped + manifest
+    dst = str(tmp_path / "out.bin")
+    xfer2.download(url, dst)
+    assert open(dst, "rb").read() == open(src, "rb").read()
+
+
+def test_corrupted_chunk_detected_on_download(tmp_path):
+    store = LocalObjectStore(str(tmp_path / "store"))
+    xfer = ResumableTransfer(store, state_dir=str(tmp_path / "state"),
+                             chunk_bytes=64 * 1024)
+    url = xfer.upload(_big_file(tmp_path), "run1/ckpt")
+    manifest = json.loads(store.read_blob(url).decode())
+    chunk_path = store.local_path(manifest["chunks"][2]["url"])
+    with open(chunk_path, "r+b") as f:
+        f.seek(10)
+        f.write(b"\x00\x01\x02corrupt")
+    with pytest.raises(TransferIntegrityError, match="chunk 2"):
+        xfer.download(url, str(tmp_path / "out.bin"))
+
+
+def test_wan_transfer_for_reads_region_knobs(tmp_path):
+    args = _args(
+        region="eu", object_store_dir=str(tmp_path / "store"),
+        regions={"eu": {"wan_chunk_mb": 16, "wan_max_retries": 7,
+                        "object_store_dir": str(tmp_path / "eu_store")}},
+    )
+    apply_region_config(args)
+    xfer = wan_transfer_for(args)
+    assert xfer.chunk_bytes == 16 * 1024 * 1024
+    assert xfer.max_retries == 7
+    assert xfer.store.root == str(tmp_path / "eu_store")

@@ -27,6 +27,8 @@ class _Args:
     using_mlops = True
     mlops_backend_mqtt = True
     log_file_dir = None
+    enable_sys_perf = False  # no background sampler thread leaking records
+    # into these collector-count assertions
 
 
 def _wait(cond, timeout=10.0):
