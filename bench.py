@@ -103,6 +103,14 @@ def _cost_analysis_flops(lowered_compiled) -> float | None:
         return None
 
 
+def _flash_effective_stats_mode(seq: int) -> str:
+    """Kernel-truth stats mode for the bench geometry (imported lazily —
+    the orchestrator process never imports jax/fedml_tpu)."""
+    from fedml_tpu.ops.flash_attention import effective_stats_mode
+
+    return effective_stats_mode(seq)
+
+
 def _timed_chain(step_once, reps_small: int = 2, reps_large: int = 12) -> float:
     """Marginal per-step seconds of a dependent chain.
 
@@ -271,6 +279,12 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
         "tokens_per_sec": tokens_per_sec,
         "mfu": mfu,
         "attention_impl": attention_impl,
+        # which lse/delta lane layout the pallas kernels ran ("narrow" =
+        # (block_q,1), "wide" = 128-lane broadcast) — from the kernel's own
+        # shape-gated decision, not the env var, so the artifact can't claim
+        # a layout the effective block size couldn't host
+        "flash_stats_mode": (_flash_effective_stats_mode(seq)
+                             if attention_impl == "pallas" else None),
         "step_flops": analytic_step_flops,
         "n_params": n_params,
         "device": getattr(dev, "device_kind", str(dev)),
@@ -1161,6 +1175,43 @@ def _pid_is_bench(pid: int) -> bool:
     return "bench.py" in cmdline
 
 
+def _kernel_hash() -> str | None:
+    import hashlib
+
+    path = os.path.join(_REPO, "fedml_tpu", "ops", "flash_attention.py")
+    try:
+        with open(path, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def _flash_mode_env() -> dict | None:
+    """Honor the smoke's verdict on the flash-kernel stats layout
+    (tools/tpu_smoke_flash.py writes '.bench_runtime/flash_stats_mode' as
+    '<mode> <kernel sha256>'): 'wide' means real Mosaic rejected the
+    default (block_q, 1) layout but accepted the 128-lane-broadcast one, so
+    chip stages must run wide or the headline silently degrades to the
+    xla-einsum fallback. A verdict rendered on DIFFERENT kernel code (hash
+    mismatch) is ignored — it says nothing about the current kernels."""
+    try:
+        with open(os.path.join(_BENCH_RUNTIME_DIR, "flash_stats_mode")) as f:
+            parts = f.read().strip().split()
+    except OSError:
+        return None
+    mode = parts[0] if parts else ""
+    verdict_hash = parts[1] if len(parts) > 1 else None
+    if mode != "wide":
+        return None
+    if verdict_hash is not None and verdict_hash != _kernel_hash():
+        print("warning: flash_stats_mode verdict is for a different kernel "
+              "hash; ignoring it", file=sys.stderr)
+        return None
+    env = dict(os.environ)
+    env["FEDML_FLASH_WIDE_STATS"] = "1"
+    return env
+
+
 def _acquire_bench_lock(watcher: bool, preempt_wait_s: float = 120.0):
     """ONE bench owns the chip at a time. The opportunistic watcher
     (tools/bench_watch.sh, FEDML_BENCH_WATCHER=1) yields: if another bench
@@ -1283,13 +1334,14 @@ def main() -> None:
                     "source": f"banked {banked.get('measured_at_utc')}"}
         remaining = [(n, b) for n, b in remaining if n not in skip]
         banked_stages = skip
+    flash_env = _flash_mode_env()
     while remaining:
         stage_name, budget = remaining.pop(0)
-        env = None
+        env = dict(flash_env) if flash_env is not None else None
         if stage_name == "memplan":
             # the stage's plan math runs on a virtual 8-device CPU mesh
             # alongside the real chip (metadata only, nothing executes there)
-            env = dict(os.environ)
+            env = env or dict(os.environ)
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                                 " --xla_force_host_platform_device_count=8").strip()
         result, err = _spawn_stage(stage_name, budget, env=env)
@@ -1436,7 +1488,8 @@ def main_short(budget_s: int = 240) -> None:
         sys.exit(1)
 
     stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
-    env = dict(os.environ, FEDML_BENCH_FAST="1")
+    env = _flash_mode_env() or dict(os.environ)
+    env["FEDML_BENCH_FAST"] = "1"
     result, err = _spawn_stage("llm_pallas", budget_s, env=env)
     if err is not None:
         print(json.dumps({"skipped": "short_window_stage_failed", "detail": err,

@@ -253,6 +253,25 @@ def test_main_probe_timeout_prints_structured_skip(monkeypatch, tmp_path, capsys
     assert out["cpu_baselines"]["cpu_llm_tokens_per_sec"] == 100.0
 
 
+def test_flash_mode_env_honors_smoke_verdict(monkeypatch, tmp_path):
+    """The smoke's wide-layout verdict (.bench_runtime/flash_stats_mode)
+    must reach chip-stage subprocess envs, or the headline silently runs
+    the rejected layout and degrades to xla einsum."""
+    monkeypatch.setattr(bench, "_BENCH_RUNTIME_DIR", str(tmp_path))
+    assert bench._flash_mode_env() is None  # no verdict yet
+    (tmp_path / "flash_stats_mode").write_text("narrow")
+    assert bench._flash_mode_env() is None  # narrow = default, no override
+    (tmp_path / "flash_stats_mode").write_text("wide")
+    env = bench._flash_mode_env()
+    assert env is not None and env["FEDML_FLASH_WIDE_STATS"] == "1"
+    # a verdict carrying the CURRENT kernel hash is honored...
+    (tmp_path / "flash_stats_mode").write_text(f"wide {bench._kernel_hash()}")
+    assert bench._flash_mode_env() is not None
+    # ...but one rendered on different kernel code is ignored
+    (tmp_path / "flash_stats_mode").write_text("wide " + "0" * 64)
+    assert bench._flash_mode_env() is None
+
+
 def test_main_merges_memplan_validation(monkeypatch, tmp_path, capsys, _restore_signals):
     """VERDICT r4 next #6: the real-HBM 7B plan validation lands in the
     one-line JSON and the measured artifact."""

@@ -107,6 +107,49 @@ class TestAttentionImpls:
         for name, a, b in zip("dq dk dv".split(), got, want):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, err_msg=name)
 
+    def test_flash_wide_stats_mode_matches_xla(self, monkeypatch):
+        """FEDML_FLASH_WIDE_STATS=1: lse/delta broadcast over 128 lanes (the
+        official jax kernel's layout; the Mosaic-acceptance hedge for the
+        default (block_q, 1) layout) — fwd + all three grads must match the
+        einsum path exactly like narrow mode does."""
+        from fedml_tpu.ops.flash_attention import flash_attention
+
+        monkeypatch.setenv("FEDML_FLASH_WIDE_STATS", "1")
+        B, T, Hq, Hkv, D = 1, 256, 4, 2, 16
+        ks = jax.random.split(jax.random.PRNGKey(11), 3)
+        q = jax.random.normal(ks[0], (B, T, Hq, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, T, Hkv, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, T, Hkv, D), jnp.float32)
+        g = jax.random.normal(jax.random.PRNGKey(12), (B, T, Hq, D), jnp.float32)
+        from fedml_tpu.models.transformer import repeat_kv
+
+        kr, vr = repeat_kv(k, v, Hq)
+        ref = xla_attention(q, kr, vr, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+        def f_flash(q, k, v):
+            return (flash_attention(q, k, v, causal=True,
+                                    block_q=128, block_k=128) * g).sum()
+
+        def f_xla(q, k, v):
+            kr, vr = repeat_kv(k, v, Hq)
+            return (xla_attention(q, kr, vr, causal=True) * g).sum()
+
+        got = jax.grad(f_flash, (0, 1, 2))(q, k, v)
+        want = jax.grad(f_xla, (0, 1, 2))(q, k, v)
+        for name, a, b in zip("dq dk dv".split(), got, want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, err_msg=name)
+        # small-block shapes can't host 128 lanes: silently narrow, still correct
+        out_small = flash_attention(q[:, :32], k[:, :32], v[:, :32],
+                                    causal=True, block_q=16, block_k=16)
+        kr_s, vr_s = repeat_kv(k[:, :32], v[:, :32], Hq)
+        np.testing.assert_allclose(
+            np.asarray(out_small),
+            np.asarray(xla_attention(q[:, :32], kr_s, vr_s, causal=True)),
+            atol=2e-5)
+
     def test_flash_grads_match_xla(self):
         # the Pallas backward kernels (dq + dkv) against einsum autodiff,
         # causal and dense, with uneven q/k block sizes to exercise the

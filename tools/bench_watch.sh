@@ -21,7 +21,7 @@ mkdir -p -m 700 "$REPO/.bench_runtime"
 LOCK="$REPO/.bench_runtime/bench.lock"
 
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-90}
-SMOKE_TIMEOUT=${SMOKE_TIMEOUT:-900}
+SMOKE_TIMEOUT=${SMOKE_TIMEOUT:-1200}  # may run BOTH stats layouts (narrow+wide)
 # must exceed the sum of bench.py's per-stage budgets (_STAGES: 8100s with
 # memplan; banked CPU baselines usually shave 600s) plus the probe, or the
 # outer timeout kills a run whose stages are all within their own contracts
