@@ -1,0 +1,47 @@
+"""Cluster capacity demo: 3 agents, 2 with a slot each -> a 2-slot job
+lands on exactly those two; a 4-slot ask fails with a clear error.
+
+Reference parity: api cluster_* verbs + scheduler_core/scheduler_matcher
+(docstrings in fedml_tpu/computing/scheduler/cluster.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), *[".."] * 3))
+
+from fedml_tpu import api
+from fedml_tpu.computing.scheduler.cluster import ClusterMatchError
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    api._launch_manager(num_edges=3)  # 3 local agents
+    api.cluster_register(edge_id=0, slots=1, accelerator_kind="tpu-v5e")
+    api.cluster_register(edge_id=2, slots=1, accelerator_kind="tpu-v5e")
+    print("cluster:", api.cluster_status())
+
+    statuses = api.launch_job(os.path.join(HERE, "job.yaml"), num_edges=3)
+    for eid, st in sorted(statuses.items()):
+        print(f"edge {eid}: {st.status}")
+        print("  ", open(st.log_path).read().strip())
+    assert sorted(statuses) == [0, 2], "job must land on the 2 agents with capacity"
+
+    over_ask = os.path.join(HERE, "job.yaml")
+    import yaml
+
+    doc = yaml.safe_load(open(over_ask))
+    doc["computing"]["minimum_num_gpus"] = 4
+    big = os.path.join(HERE, "_over_ask.yaml")
+    with open(big, "w") as f:
+        yaml.safe_dump(doc, f)
+    try:
+        api.launch_job(big)
+        raise SystemExit("over-ask unexpectedly matched")
+    except ClusterMatchError as e:
+        print("over-ask correctly refused:", e)
+    finally:
+        os.remove(big)
+
+
+if __name__ == "__main__":
+    main()

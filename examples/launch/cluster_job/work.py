@@ -1,0 +1,8 @@
+"""The dispatched job: capacity-matched edges learn their topology from the
+scheduler env (reference: generate_match_info_for_scheduler payload)."""
+import os
+
+print("edge", os.environ.get("FEDML_EDGE_ID"),
+      "slots", os.environ.get("FEDML_MATCHED_SLOTS"),
+      "of", os.environ.get("FEDML_NUM_NODES"), "nodes",
+      "master", os.environ.get("FEDML_MASTER_ADDR"))

@@ -33,7 +33,7 @@ def _launch_manager(num_edges: int = 1):
 
         i = len(manager.edges)
         manager.edges[i] = FedMLClientRunner(i, base_dir=os.path.join(manager.base_dir, f"edge_{i}"))
-        manager.cluster.refresh(detect_local_capacity(i))
+        manager.cluster.announce(detect_local_capacity(i))
     return manager
 
 

@@ -147,6 +147,18 @@ def test_launch_matches_slots_and_passes_scheduler_info(tmp_path):
     assert caps[0].slots_available == 1 and caps[2].slots_available == 1
 
 
+def test_api_grow_path_announces_capacity(tmp_path, monkeypatch):
+    """api._launch_manager's on-demand pool growth must announce each new
+    edge's inventory (the renamed announce() — a drive of
+    examples/launch/cluster_job caught the stale refresh() call here)."""
+    from fedml_tpu import api
+
+    mgr = FedMLLaunchManager(num_edges=1, base_dir=str(tmp_path / "agent"))
+    monkeypatch.setattr(FedMLLaunchManager, "_instance", mgr)
+    api._launch_manager(num_edges=3)
+    assert set(mgr.cluster.capacities()) == {0, 1, 2}
+
+
 def test_launch_over_ask_raises_before_dispatch(tmp_path):
     mgr = FedMLLaunchManager(num_edges=3, base_dir=str(tmp_path / "agent"))
     mgr.cluster.register(EdgeCapacity(edge_id=0, cores=4, memory_mb=1024,
