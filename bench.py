@@ -80,6 +80,15 @@ _PEAK_BF16_TFLOPS = {
 # flagship single-chip proxy geometry, shared by train/decode/serving stages
 _LLM_SHAPE = dict(d_model=1024, n_layers=16, n_heads=16, d_ff=2752,
                   vocab=32000, seq=1024, bs=8)
+# FEDML_BENCH_TINY=1: CI/dry-run geometry — exercises the REAL stage
+# subprocess path (spawn, probe, fallback ladder, artifact write) in
+# seconds on CPU; never a publishable number (the device field says cpu)
+_TINY_LLM_SHAPE = dict(d_model=128, n_layers=2, n_heads=4, d_ff=256,
+                       vocab=512, seq=128, bs=2)
+
+
+def _llm_shape() -> dict:
+    return _TINY_LLM_SHAPE if os.environ.get("FEDML_BENCH_TINY") == "1" else _LLM_SHAPE
 
 
 def _chip_peak_tflops(device, dtype_bits: int) -> float:
@@ -195,7 +204,7 @@ def _build_llm(attention_impl: str, remat: bool):
 
     from fedml_tpu.models.transformer import TransformerConfig, TransformerLM
 
-    s = _LLM_SHAPE
+    s = _llm_shape()
     cfg = TransformerConfig(
         vocab_size=s["vocab"], d_model=s["d_model"], n_layers=s["n_layers"],
         n_heads=s["n_heads"], n_kv_heads=s["n_heads"], d_ff=s["d_ff"],
@@ -217,7 +226,7 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
 
     _p(f"llm bench: building model (attention={attention_impl} remat={remat})")
     model, cfg, params = _build_llm(attention_impl, remat)
-    s = _LLM_SHAPE
+    s = _llm_shape()
     vocab, seq = s["vocab"], s["seq"]
     bs = int(bs or s["bs"])
     n_params = sum(x.size for x in jax.tree.leaves(params))
@@ -822,7 +831,13 @@ def _write_measured_artifact(out: dict, stamp: str) -> str:
     """Persist the measurement-so-far as BENCH_MEASURED_<utc>.json with
     provenance (timestamp + git HEAD). Called after EVERY successful stage
     (same stamp → same file, progressively refined), so perf evidence
-    survives a later stage's death (VERDICT r3 weak #1/#2)."""
+    survives a later stage's death (VERDICT r3 weak #1/#2).
+
+    TINY dry-runs never persist: a CPU artifact with a numeric value would
+    satisfy the watcher's measured-headline gate (disabling the real
+    short-window path) and could be committed as if it were chip evidence."""
+    if os.environ.get("FEDML_BENCH_TINY") == "1":
+        return ""
     try:
         head = subprocess.run(
             ["git", "-C", _REPO, "rev-parse", "--short", "HEAD"],
@@ -992,11 +1007,11 @@ def _run_stage(name: str) -> None:
         # would killpg the stage and discard the SUCCESSFUL 1x headline
         if (not fast
                 and out["attention_impl"] == "pallas"
-                and out["shape"]["bs"] == _LLM_SHAPE["bs"]
+                and out["shape"]["bs"] == _llm_shape()["bs"]
                 and time.monotonic() - _STAGE_T0 < 600.0):
             try:
                 out2 = _bench_llm_tpu(reps=6, remat=out["remat"],
-                                      bs=2 * _LLM_SHAPE["bs"])
+                                      bs=2 * _llm_shape()["bs"])
                 out2["remat"] = out["remat"]
                 out["bs2x_tokens_per_sec"] = round(out2["tokens_per_sec"], 1)
                 out["bs2x_mfu"] = round(out2["mfu"], 4)
@@ -1495,8 +1510,11 @@ def main_short(budget_s: int = 240) -> None:
         print(json.dumps({"skipped": "short_window_stage_failed", "detail": err,
                           "last_measured": _last_measured()}))
         sys.exit(1)
+    tiny = os.environ.get("FEDML_BENCH_TINY") == "1"
     banked = _load_cpu_baselines() or {}
-    cpu_llm = banked.get("cpu_llm_tokens_per_sec")
+    # the banked denominator is FLAGSHIP-geometry torch-CPU: a tiny dry-run
+    # ratio against it would be meaningless
+    cpu_llm = None if tiny else banked.get("cpu_llm_tokens_per_sec")
     out = {
         "metric": "llm_train_tokens_per_sec",
         "value": round(result["tokens_per_sec"], 1),
@@ -1509,7 +1527,9 @@ def main_short(budget_s: int = 240) -> None:
         "remat": result["remat"],
         "short_window": True,
     }
-    if banked:
+    if tiny:
+        out["tiny_dryrun"] = True
+    if banked and cpu_llm is not None:
         out["cpu_baseline_source"] = f"banked {banked.get('measured_at_utc')}"
     if _PROCEEDED_UNLOCKED:
         out["bench_lock"] = "proceeded_unlocked"

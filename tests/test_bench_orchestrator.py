@@ -374,6 +374,28 @@ def test_main_short_window_lands_headline(monkeypatch, tmp_path, capsys, _restor
     assert len(arts) == 1
 
 
+def test_tiny_dryrun_writes_no_artifact_and_no_ratio(monkeypatch, tmp_path, capsys, _restore_signals):
+    """FEDML_BENCH_TINY=1 exercises the real short-window path end-to-end
+    on CPU, but must never persist a measured artifact (a CPU 'value' would
+    satisfy the watcher's headline gate and could be committed as chip
+    evidence) nor compare tiny throughput against the flagship denominator."""
+    (tmp_path / "BENCH_CPU_BASELINES.json").write_text(json.dumps({
+        "cpu_llm_tokens_per_sec": 100.0, "measured_at_utc": "20260731T000000Z"}))
+    monkeypatch.setenv("FEDML_BENCH_TINY", "1")
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "_acquire_bench_lock", lambda *a, **k: object())
+    monkeypatch.setattr(bench, "_spawn_stage",
+                        lambda *a, **k: _LLM_OK)
+    with pytest.raises(SystemExit) as exc:
+        bench.main_short()
+    assert exc.value.code == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["tiny_dryrun"] is True
+    assert out["vs_baseline"] is None
+    assert not glob.glob(str(tmp_path / "BENCH_MEASURED_*.json"))
+
+
 def test_main_short_window_stage_failure_is_structured(monkeypatch, tmp_path, capsys, _restore_signals):
     def fake_spawn(name, budget_s, argv=None, env=None):
         return None, "llm_pallas: timeout after 240s (last stderr: compiling)"
