@@ -79,8 +79,13 @@ def _stats_lanes(block_k: int) -> int:
 def effective_stats_mode(seq_len: int, block_q: int = 128, block_k: int = 128) -> str:
     """The stats layout flash_attention WILL actually use for these shapes —
     the bench records this (not the raw env var) so artifacts can't claim
-    'wide' for a call whose effective block_k can't host 128 lanes."""
-    return "wide" if _stats_lanes(min(block_k, seq_len)) == 128 else "narrow"
+    'wide' for a call whose effective block_k can't host 128 lanes (such a
+    call takes the einsum fallback when wide mode is forced — see
+    flash_attention)."""
+    bk = min(block_k, seq_len)
+    if os.environ.get(_WIDE_STATS_ENV) == "1":
+        return "wide" if bk % 128 == 0 else "xla-fallback"
+    return "narrow"
 
 
 def _stats_to_cols(stat, block_k: int):
@@ -408,7 +413,13 @@ def flash_attention(
     if Hq % Hkv:
         raise ValueError(f"q heads {Hq} not a multiple of kv heads {Hkv}")
     bq, bk = min(block_q, T), min(block_k, T)
-    if not _HAS_PALLAS or T % bq or T % bk:
+    # wide-stats mode set = the smoke found Mosaic REJECTS the narrow
+    # (block_q, 1) layout on this chip; a shape too small to host 128 lanes
+    # must then take the einsum path, not silently attempt the rejected
+    # narrow layout and crash at compile time (e.g. short prefills)
+    wide_requested = os.environ.get(_WIDE_STATS_ENV) == "1"
+    if (not _HAS_PALLAS or T % bq or T % bk
+            or (wide_requested and bk % 128 != 0)):
         from ..models.transformer import repeat_kv, xla_attention
 
         k, v = repeat_kv(k, v, Hq)

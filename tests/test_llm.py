@@ -141,7 +141,9 @@ class TestAttentionImpls:
         for name, a, b in zip("dq dk dv".split(), got, want):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-5, err_msg=name)
-        # small-block shapes can't host 128 lanes: silently narrow, still correct
+        # small-block shapes can't host 128 lanes: under a wide verdict
+        # (narrow is Mosaic-rejected) they take the einsum fallback — never
+        # the rejected narrow layout — and stay numerically correct
         out_small = flash_attention(q[:, :32], k[:, :32], v[:, :32],
                                     causal=True, block_q=16, block_k=16)
         kr_s, vr_s = repeat_kv(k[:, :32], v[:, :32], Hq)
