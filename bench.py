@@ -893,18 +893,27 @@ def _ensure_cpu_baselines(force: bool = False) -> dict | None:
     COMPLETED here, not returned as-is — otherwise one bad banking run
     would permanently null the missing denominator."""
     banked = (_load_cpu_baselines() or {}) if not force else {}
-    missing = [(name, budget) for name, key, budget in _CPU_BASELINE_STAGES
+    missing = [(name, key, budget) for name, key, budget in _CPU_BASELINE_STAGES
                if banked.get(key) is None]
     if not missing:
         return banked
+    stamp_now = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
     out: dict = {k: v for k, v in banked.items()
                  if k not in ("measured_at_utc", "git_head")}
-    for name, budget in missing:
+    # preserved values keep their ORIGINAL stamp (per-key provenance): a
+    # completion run must not re-claim an old measurement as its own
+    for name, key, _budget in _CPU_BASELINE_STAGES:
+        if banked.get(key) is not None:
+            out.setdefault(f"{key}_measured_at", banked.get(
+                f"{key}_measured_at", banked.get("measured_at_utc")))
+    for name, key, budget in missing:
         result, err = _spawn_stage(name, budget, env=_cpu_stage_env())
         if err is not None:
             print(f"warning: {err}", file=sys.stderr)
         else:
             out.update(result)
+            if result.get(key) is not None:
+                out[f"{key}_measured_at"] = stamp_now
     if not any(out.get(key) is not None for _, key, _ in _CPU_BASELINE_STAGES):
         return None
     stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
@@ -1334,7 +1343,11 @@ def main() -> None:
     if _PROCEEDED_UNLOCKED:
         merged["bench_lock"] = "proceeded_unlocked"
     remaining = list(_STAGES)
-    banked = _load_cpu_baselines()
+    tiny = os.environ.get("FEDML_BENCH_TINY") == "1"
+    # tiny dry-runs never touch the flagship CPU denominators: the ratio of
+    # tiny-geometry throughput over a flagship baseline is meaningless
+    # (main_short applies the same guard)
+    banked = None if tiny else _load_cpu_baselines()
     if banked is not None:
         # chip windows are scarce: reuse the committed host-side denominators
         # instead of burning window time re-measuring them. Only a stage
@@ -1344,9 +1357,12 @@ def main() -> None:
         for stage, key, _budget in _CPU_BASELINE_STAGES:
             if banked.get(key) is not None:
                 skip.append(stage)
+                # per-key stamp when present (a completed partial bank
+                # carries one per value); file-level stamp otherwise
                 stage_out[stage] = {
                     key: banked[key],
-                    "source": f"banked {banked.get('measured_at_utc')}"}
+                    "source": ("banked " + str(banked.get(
+                        f"{key}_measured_at", banked.get("measured_at_utc"))))}
         remaining = [(n, b) for n, b in remaining if n not in skip]
         banked_stages = skip
     flash_env = _flash_mode_env()
@@ -1408,6 +1424,12 @@ def main() -> None:
     cpu_resnet = (stage_out.get("cpu_resnet") or {}).get("cpu_resnet_images_per_sec")
 
     out: dict = {"metric": "llm_train_tokens_per_sec", "stages_failed": failed}
+    if tiny:
+        # cpu stages still run at FLAGSHIP geometry in a tiny ladder, so
+        # every tiny/flagship ratio below must be suppressed, not just the
+        # artifact write
+        out["tiny_dryrun"] = True
+        cpu_llm = cpu_resnet = None
     if _PROCEEDED_UNLOCKED:
         # a double-run window existed (lock holder would not die); make it
         # visible in the artifact rather than only in stderr (ADVICE r4)

@@ -159,8 +159,12 @@ class AgentDatabase:
                           slots_total: int, slots_available: Optional[int] = None,
                           accelerator_kind: str = "") -> None:
         """An agent declares (or refreshes) its resources; the launch
-        matcher reads these rows. slots_available defaults to slots_total
-        on first registration and is preserved on refresh."""
+        matcher reads these rows. slots_available (default slots_total)
+        applies only to a FIRST registration; a re-registration preserves
+        in-flight debits — new available = new_total - (old_total -
+        old_available), floored at 0 — so an agent check-in mid-run cannot
+        restore slots a running job still occupies (the over-commit the
+        atomic debit machinery exists to prevent)."""
         with self._lock:
             self._conn.execute(
                 "INSERT INTO capacity (edge_id, cores, memory_mb, accelerator_kind,"
@@ -168,11 +172,12 @@ class AgentDatabase:
                 " ON CONFLICT(edge_id) DO UPDATE SET cores=excluded.cores,"
                 " memory_mb=excluded.memory_mb, accelerator_kind=excluded.accelerator_kind,"
                 " slots_total=excluded.slots_total,"
-                " slots_available=COALESCE(?, capacity.slots_available),"
+                " slots_available=MAX(0, excluded.slots_total -"
+                "   (capacity.slots_total - capacity.slots_available)),"
                 " updated_at=excluded.updated_at",
                 (edge_id, cores, memory_mb, accelerator_kind, slots_total,
                  slots_available if slots_available is not None else slots_total,
-                 time.time(), slots_available),
+                 time.time()),
             )
             self._conn.commit()
 
@@ -224,6 +229,28 @@ class AgentDatabase:
                         return False
                 self._conn.commit()
                 return True
+            except Exception:
+                self._conn.rollback()
+                raise
+
+    def credit_slots(self, assignment: Dict[int, int]) -> None:
+        """Atomically credit slots back (terminal run status), clamped at
+        each edge's total. A read-modify-write here would lose credits when
+        a finally-release and a reaper thread (or a second launcher on the
+        shared journal) race — the debit side is atomic for the same
+        reason."""
+        if not assignment:
+            return
+        with self._lock:
+            try:
+                for eid, n in assignment.items():
+                    self._conn.execute(
+                        "UPDATE capacity SET"
+                        " slots_available=MIN(slots_total, slots_available+?),"
+                        " updated_at=? WHERE edge_id=?",
+                        (n, time.time(), eid),
+                    )
+                self._conn.commit()
             except Exception:
                 self._conn.rollback()
                 raise

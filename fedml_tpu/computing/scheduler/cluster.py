@@ -85,7 +85,11 @@ def match_and_assign(request_slots: int,
     share first (request // n_edges, clamped per edge), remainder greedily
     in edge order. Raises ClusterMatchError when the ask exceeds the total.
     """
-    pool = {eid: capacities[eid] for eid in (edge_ids or sorted(capacities))
+    # `is not None`, not truthiness: an explicitly EMPTY edge list (a
+    # manager running zero local edges) must match nothing — falling back
+    # to every journal row would dispatch onto phantom edges
+    pool = {eid: capacities[eid]
+            for eid in (edge_ids if edge_ids is not None else sorted(capacities))
             if eid in capacities}
     if request_slots <= 0:
         return {}
@@ -163,12 +167,11 @@ class ClusterRegistry:
                 f"(assignment {assignment}); re-run to re-match")
 
     def release(self, assignment: Dict[int, int]) -> None:
-        """Credit slots back (terminal run status)."""
+        """Credit slots back (terminal run status) — atomic, clamped at
+        each edge's total (see AgentDatabase.credit_slots)."""
         caps = self.capacities()
-        for eid, n in assignment.items():
-            if eid in caps:
-                self._db.set_slots_available(
-                    eid, min(caps[eid].slots_total, caps[eid].slots_available + n))
+        self._db.credit_slots({eid: n for eid, n in assignment.items()
+                               if eid in caps})
 
     def status(self) -> Dict[str, int]:
         caps = self.capacities()

@@ -66,6 +66,14 @@ class LocalObjectStore:
         the scheme)."""
         return url[len("file://") :] if url.startswith("file://") else url
 
+    def stat_blob(self, url: str) -> Optional[int]:
+        """Byte length of a stored blob, or None if absent — a cheap
+        existence probe (no content transfer) for resumable WAN uploads."""
+        try:
+            return os.path.getsize(self.local_path(url))
+        except OSError:
+            return None
+
     def delete(self, url: str) -> None:
         path = self.local_path(url)
         if os.path.exists(path):
@@ -108,6 +116,15 @@ class S3ObjectStore:  # pragma: no cover - requires boto3 + credentials
         os.makedirs(os.path.dirname(os.path.abspath(dst_path)), exist_ok=True)
         self.s3.download_file(bucket, key, dst_path)
         return dst_path
+
+    def stat_blob(self, url: str) -> Optional[int]:
+        """HEAD the object: content length without transferring it."""
+        _, _, rest = url.partition("s3://")
+        bucket, _, key = rest.partition("/")
+        try:
+            return int(self.s3.head_object(Bucket=bucket, Key=key)["ContentLength"])
+        except Exception:
+            return None
 
 
 def create_object_store(args: Any):

@@ -111,20 +111,30 @@ class ResumableTransfer:
         flat = key.replace("/", "__")
         journal = self._load_journal(key, file_sha)
         done: Dict[str, Any] = journal["chunks"]
-        # resume only chunks STILL readable in the CURRENT store with a
-        # matching sha: the journal may outlive the store contents (pruned
-        # tempdir) or describe a different region's store (the operator
-        # re-ran under another region config) — blindly trusting it would
-        # produce a "successful" manifest pointing at dead/foreign urls
+        # resume only chunks STILL present in the CURRENT store: the journal
+        # may outlive the store contents (pruned tempdir) or describe a
+        # different region's store (the operator re-ran under another region
+        # config) — blindly trusting it would produce a "successful"
+        # manifest pointing at dead/foreign urls. The probe is a cheap
+        # length stat (S3 HEAD / local getsize) when the store offers one:
+        # re-READING every shipped chunk would re-transfer nearly the whole
+        # payload over the WAN resume exists to save; chunk objects are
+        # write-once (uuid-suffixed keys) and the download verifies every
+        # sha end-to-end anyway. FEDML_WAN_PARANOID=1 forces full re-hash.
+        paranoid = os.environ.get("FEDML_WAN_PARANOID") == "1"
+        stat = getattr(self.store, "stat_blob", None)
         for idx in list(done):
             rec = done[idx]
             try:
-                blob = self.store.read_blob(rec["url"])
-                ok = hashlib.sha256(blob).hexdigest() == rec["sha"]
+                if stat is not None and not paranoid:
+                    ok = stat(rec["url"]) == rec["len"]
+                else:
+                    blob = self.store.read_blob(rec["url"])
+                    ok = hashlib.sha256(blob).hexdigest() == rec["sha"]
             except Exception:  # noqa: BLE001 - unreadable == not shipped
                 ok = False
             if not ok:
-                log.warning("resume: journal chunk %s of %s is not readable "
+                log.warning("resume: journal chunk %s of %s is not present "
                             "in this store; re-shipping it", idx, key)
                 del done[idx]
 

@@ -50,6 +50,13 @@ def test_no_registered_agents_is_its_own_error():
         match_and_assign(1, {})
 
 
+def test_explicit_empty_edge_list_matches_nothing():
+    """edge_ids=[] (a manager with zero local runners) must NOT fall back
+    to every journal row — phantom-edge dispatch (code-review r5)."""
+    with pytest.raises(ClusterMatchError, match="no agents have registered"):
+        match_and_assign(1, _caps(4, 4), edge_ids=[])
+
+
 def test_equal_spread_then_greedy_remainder():
     # 8 slots over (4, 4, 4): equal share 2 each, remainder 2 greedily in
     # edge order -> first edge tops up to 4 (reference lines 101-117)
@@ -85,6 +92,41 @@ def test_registry_persists_and_tracks_slots(tmp_path):
     assert reg2.capacities()[0].slots_available == 4
     assert reg2.status() == {"agents": 1, "slots_total": 4, "slots_available": 4}
     reg2.close()
+
+
+def test_reregistration_preserves_inflight_debits(tmp_path):
+    """An agent check-in (re-register) mid-run must not restore slots a
+    running job still occupies (code-review r5): new available =
+    new_total - in_flight, floored at 0."""
+    reg = ClusterRegistry(str(tmp_path / "cluster.db"))
+    cap = EdgeCapacity(edge_id=0, cores=4, memory_mb=1024,
+                       slots_total=2, slots_available=2)
+    reg.register(cap)
+    reg.acquire({0: 2})  # both slots busy
+    reg.register(cap)  # check-in refresh with the same declared capacity
+    assert reg.capacities()[0].slots_available == 0  # debits preserved
+    # growing the declared total grants only the NEW headroom
+    reg.register(EdgeCapacity(edge_id=0, cores=4, memory_mb=1024,
+                              slots_total=3, slots_available=3))
+    assert reg.capacities()[0].slots_available == 1
+    # shrinking below in-flight floors at 0 (never negative)
+    reg.register(EdgeCapacity(edge_id=0, cores=4, memory_mb=1024,
+                              slots_total=1, slots_available=1))
+    assert reg.capacities()[0].slots_available == 0
+    reg.close()
+
+
+def test_release_is_clamped_and_idempotent_at_total(tmp_path):
+    """Double releases (finally + reaper racing) must not overshoot the
+    total; the credit is one atomic clamped SQL update."""
+    reg = ClusterRegistry(str(tmp_path / "cluster.db"))
+    reg.register(EdgeCapacity(edge_id=0, cores=4, memory_mb=1024,
+                              slots_total=2, slots_available=2))
+    reg.acquire({0: 1})
+    reg.release({0: 1})
+    reg.release({0: 1})  # late duplicate credit
+    assert reg.capacities()[0].slots_available == 2  # clamped at total
+    reg.close()
 
 
 def test_acquire_detects_concurrent_claim(tmp_path):

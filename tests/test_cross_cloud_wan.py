@@ -76,7 +76,11 @@ class FlakyStore:
         return self.inner.write_blob(key, blob, ext)
 
     def read_blob(self, url):
+        self.reads = getattr(self, "reads", 0) + 1
         return self.inner.read_blob(url)
+
+    def stat_blob(self, url):
+        return self.inner.stat_blob(url)
 
 
 def _big_file(tmp_path, n_bytes=300_000, seed=0):
@@ -139,6 +143,9 @@ def test_resume_skips_shipped_chunks(tmp_path):
     assert healthy.writes == 4
     assert not any(".part00000" in k or ".part00001" in k
                    for k in healthy.write_log)
+    # resume verification used the cheap length stat, not content re-reads
+    # (re-downloading shipped chunks would defeat resumable WAN transfer)
+    assert getattr(healthy, "reads", 0) == 0
     dst = str(tmp_path / "out.bin")
     xfer2.download(url, dst)
     assert open(dst, "rb").read() == open(src, "rb").read()
