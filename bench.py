@@ -827,6 +827,25 @@ def _last_measured() -> dict | None:
         return None
 
 
+_GIT_HEAD_CACHE: list = []
+
+
+def _git_head() -> str | None:
+    """Short HEAD for artifact provenance, resolved once per process — the
+    code that produced a run's numbers is the checkout at start, even if a
+    commit lands mid-run."""
+    if not _GIT_HEAD_CACHE:
+        try:
+            head = subprocess.run(
+                ["git", "-C", _REPO, "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip() or None
+        except Exception:
+            head = None
+        _GIT_HEAD_CACHE.append(head)
+    return _GIT_HEAD_CACHE[0]
+
+
 def _write_measured_artifact(out: dict, stamp: str) -> str:
     """Persist the measurement-so-far as BENCH_MEASURED_<utc>.json with
     provenance (timestamp + git HEAD). Called after EVERY successful stage
@@ -838,14 +857,7 @@ def _write_measured_artifact(out: dict, stamp: str) -> str:
     short-window path) and could be committed as if it were chip evidence."""
     if os.environ.get("FEDML_BENCH_TINY") == "1":
         return ""
-    try:
-        head = subprocess.run(
-            ["git", "-C", _REPO, "rev-parse", "--short", "HEAD"],
-            capture_output=True, text=True, timeout=10,
-        ).stdout.strip() or None
-    except Exception:
-        head = None
-    artifact = dict(out, measured_at_utc=stamp, git_head=head)
+    artifact = dict(out, measured_at_utc=stamp, git_head=_git_head())
     path = os.path.join(_REPO, f"BENCH_MEASURED_{stamp}.json")
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)
@@ -902,7 +914,7 @@ def _ensure_cpu_baselines(force: bool = False) -> dict | None:
                  if k not in ("measured_at_utc", "git_head")}
     # preserved values keep their ORIGINAL stamp (per-key provenance): a
     # completion run must not re-claim an old measurement as its own
-    for name, key, _budget in _CPU_BASELINE_STAGES:
+    for _name, key, _budget in _CPU_BASELINE_STAGES:
         if banked.get(key) is not None:
             out.setdefault(f"{key}_measured_at", banked.get(
                 f"{key}_measured_at", banked.get("measured_at_utc")))
@@ -916,15 +928,7 @@ def _ensure_cpu_baselines(force: bool = False) -> dict | None:
                 out[f"{key}_measured_at"] = stamp_now
     if not any(out.get(key) is not None for _, key, _ in _CPU_BASELINE_STAGES):
         return None
-    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
-    try:
-        head = subprocess.run(
-            ["git", "-C", _REPO, "rev-parse", "--short", "HEAD"],
-            capture_output=True, text=True, timeout=10,
-        ).stdout.strip() or None
-    except Exception:
-        head = None
-    artifact = dict(out, measured_at_utc=stamp, git_head=head)
+    artifact = dict(out, measured_at_utc=stamp_now, git_head=_git_head())
     with open(_cpu_baseline_path(), "w") as f:
         json.dump(artifact, f, indent=1)
     print(f"banked CPU baselines -> {_cpu_baseline_path()}", file=sys.stderr)

@@ -25,15 +25,7 @@ def _launch_manager(num_edges: int = 1):
 
     manager = FedMLLaunchManager.get_instance()
     while len(manager.edges) < num_edges:
-        # grow the local pool on demand
-        import os
-
-        from ..computing.scheduler.agents import FedMLClientRunner
-        from ..computing.scheduler.cluster import detect_local_capacity
-
-        i = len(manager.edges)
-        manager.edges[i] = FedMLClientRunner(i, base_dir=os.path.join(manager.base_dir, f"edge_{i}"))
-        manager.cluster.announce(detect_local_capacity(i))
+        manager.add_edge()  # grow the local pool on demand
     return manager
 
 

@@ -46,6 +46,15 @@ class FedMLLaunchManager:
         for eid in self.edges:
             self.cluster.announce(detect_local_capacity(eid))
 
+    def add_edge(self) -> int:
+        """Grow the local pool by one runner (api._launch_manager's
+        on-demand growth) — construction + capacity announce in one place."""
+        eid = len(self.edges)
+        self.edges[eid] = FedMLClientRunner(
+            eid, base_dir=os.path.join(self.base_dir, f"edge_{eid}"))
+        self.cluster.announce(detect_local_capacity(eid))
+        return eid
+
     def match_resources(self, config: FedMLJobConfig) -> tuple[List[int], Dict[int, int]]:
         """Returns (edge_ids, {edge_id: assigned_slots}).
 

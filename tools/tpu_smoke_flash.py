@@ -100,7 +100,11 @@ def _run_child(env: dict) -> int:
         except OSError:
             pass
 
-    prev = signal.signal(signal.SIGTERM, lambda *a: (_kill_group(), sys.exit(143)))
+    def _on_term(*_a):
+        _kill_group()
+        sys.exit(143)
+
+    prev = signal.signal(signal.SIGTERM, _on_term)
     try:
         return proc.wait(timeout=CHILD_TIMEOUT_S)
     except subprocess.TimeoutExpired:
