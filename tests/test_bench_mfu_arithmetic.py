@@ -140,6 +140,32 @@ def test_formula_within_band_of_xla_cost_analysis():
     assert 0.3 <= xla_flops / analytic <= 3.0, (xla_flops, analytic)
 
 
+def test_resnet_flops_within_band_of_xla_cost_analysis():
+    """The secondary (ResNet-56) MFU numerator gets the same independent
+    pin as the headline: bench's analytic conv/fc count vs XLA's own cost
+    analysis of the real jitted forward, inside the bench's 0.3-3.0 gate."""
+    from fedml_tpu.models.resnet import ResNetCifar
+
+    model = ResNetCifar(depth=56, num_classes=10)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))["params"]
+    bs = 2
+
+    @jax.jit
+    def fwd(p, x):
+        return model.apply({"params": p}, x)
+
+    x = jnp.zeros((bs, 32, 32, 3))
+    compiled = fwd.lower(params, x).compile()
+    xla_flops = bench._cost_analysis_flops(compiled)
+    if xla_flops is None:
+        pytest.skip("cost_analysis reports no flops on this backend")
+    analytic = bench._resnet56_fwd_flops_per_image() * bs
+    assert 0.3 <= xla_flops / analytic <= 3.0, (xla_flops, analytic)
+    # literature pin: ResNet-56/CIFAR fwd is ~0.126 GMACs/image; the bench
+    # counts FLOPs (2*MACs), so ~0.25e9
+    assert 2.0e8 < bench._resnet56_fwd_flops_per_image() < 3.0e8
+
+
 def test_mfu_guard_rejects_impossible_rates():
     with pytest.raises(bench.BenchIntegrityError):
         bench._check_mfu("llm", 1.2)
