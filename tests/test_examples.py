@@ -92,6 +92,21 @@ def test_launch_example_runs():
 
 
 @pytest.mark.slow
+def test_cluster_job_example_runs():
+    """Capacity-matched launch demo: 2-slot job lands on the 2 registered
+    agents; over-ask refused with a clear error."""
+    s = os.path.join(EXAMPLES, "launch", "cluster_job", "main.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, s], cwd=os.path.dirname(s), env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "over-ask correctly refused" in r.stdout
+
+
+@pytest.mark.slow
 def test_llm_finetune_example_runs():
     s = os.path.join(EXAMPLES, "train", "llm_finetune", "main.py")
     r = _run(s, "--cf", "fedml_config.yaml", timeout=900)
