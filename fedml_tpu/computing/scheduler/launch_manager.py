@@ -165,8 +165,23 @@ def launch_job_over_mqtt(
         monitor = JobMonitor(agents)
         monitor.start()
         server = MqttServerAgent(list(range(num_edges)), args)
+        slots = config.minimum_num_gpus
+        if slots > 0:
+            # capacity-matched launch: agents check in with their inventory
+            # (announce), the master matches the ask over it before dispatch
+            for a in agents:
+                a.announce()
+            # the FULL cohort must check in before matching: over a real
+            # broker a dispatch racing in-flight announcements would see
+            # partial capacity and refuse a satisfiable ask
+            if not server.wait_for_agents(num_edges, timeout_s=30.0):
+                raise RuntimeError(
+                    f"only {len(server.capacity)}/{num_edges} agents "
+                    f"announced capacity within 30s; cannot match a "
+                    f"{slots}-slot job")
         run_id = server.dispatch_workspace(
-            config.workspace, config.job, bootstrap_cmd=config.bootstrap
+            config.workspace, config.job, bootstrap_cmd=config.bootstrap,
+            request_slots=slots,
         )
         raw = server.wait_for_run(run_id, timeout_s=timeout_s)
         return {

@@ -29,13 +29,14 @@ import tempfile
 import threading
 import time
 import uuid
-from dataclasses import asdict
+from dataclasses import asdict, fields
 from typing import Any, Callable, Dict, List, Optional
 
 from ...core.distributed.communication.mqtt_s3.mqtt_transport import create_mqtt_transport
 from ...core.distributed.communication.mqtt_s3.object_store import LocalObjectStore
 from .agent_db import AgentDatabase
 from .agents import TERMINAL, FedMLClientRunner, RunStatus
+from .cluster import EdgeCapacity, detect_local_capacity, match_and_assign
 from .package import build_job_package
 
 log = logging.getLogger(__name__)
@@ -61,6 +62,7 @@ class MqttClientAgent:
         store: Optional[LocalObjectStore] = None,
     ):
         self.edge_id = int(edge_id)
+        self._args = args
         self.transport = create_mqtt_transport(args, client_id=f"edge_agent_{edge_id}")
         self.store = store or LocalObjectStore()
         self.base_dir = base_dir or os.path.join(tempfile.gettempdir(), f"fedml_tpu_mqtt_edge_{edge_id}")
@@ -83,13 +85,25 @@ class MqttClientAgent:
         log.info("edge agent %d online (v%s)", self.edge_id, self.version)
 
     def announce(self) -> None:
-        """Publish agent liveness (daemon startup / post-OTA re-exec)."""
+        """Publish agent liveness + capacity (daemon startup / post-OTA
+        re-exec). Capacity rides the check-in the way the reference slave
+        reports gpu info (``slave/client_runner.py`` check-in payload →
+        ``scheduler_matcher`` inventory): host inventory by default,
+        ``args.agent_slots``/``args.agent_accelerator_kind`` declare
+        accelerator slots explicitly (local hosts detect zero)."""
+        cap = detect_local_capacity(self.edge_id)
+        slots = getattr(self._args, "agent_slots", None)
+        if slots is not None:
+            cap.slots_total = cap.slots_available = int(slots)
+            cap.accelerator_kind = str(
+                getattr(self._args, "agent_accelerator_kind", "") or cap.accelerator_kind)
         self.transport.publish(
             TOPIC_STATUS.format(edge_id=self.edge_id),
             json.dumps({
                 "type": "agent_online", "edge_id": self.edge_id,
                 "version": self.version, "pid": os.getpid(),
                 "recovered_runs": list(self.runner.recovered_runs),
+                "capacity": asdict(cap),
             }).encode(),
         )
 
@@ -169,6 +183,11 @@ class MqttServerAgent:
         self.statuses: Dict[str, Dict[int, Dict[str, Any]]] = {}
         self.ota_acks: List[Dict[str, Any]] = []
         self.agent_events: List[Dict[str, Any]] = []  # agent_online announcements
+        # master-side inventory, fed by agent check-ins (the reference
+        # master's active_edge_info_dict — scheduler_matcher.py consumes it)
+        self.capacity: Dict[int, EdgeCapacity] = {}
+        self.run_edges: Dict[str, List[int]] = {}       # matched targets per run
+        self.run_assignment: Dict[str, Dict[int, int]] = {}  # slots to credit back
         self._cv = threading.Condition()
         for eid in self.edge_ids:
             self.transport.subscribe(TOPIC_STATUS.format(edge_id=eid), self._on_status)
@@ -180,9 +199,41 @@ class MqttServerAgent:
                 self.ota_acks.append(doc)
             elif doc.get("type") == "agent_online":
                 self.agent_events.append(doc)
+                cap = doc.get("capacity")
+                if cap:
+                    known = {f.name for f in fields(EdgeCapacity)}
+                    eid = int(doc["edge_id"])
+                    new = EdgeCapacity(**{k: v for k, v in cap.items() if k in known})
+                    # a mid-run re-announce (agent daemon OTA re-exec while
+                    # its job keeps running) must not discard in-flight
+                    # debits — same invariant ClusterRegistry enforces on
+                    # the journal plane
+                    outstanding = sum(a.get(eid, 0)
+                                      for a in self.run_assignment.values())
+                    new.slots_available = max(0, new.slots_total - outstanding)
+                    self.capacity[eid] = new
             else:
-                self.statuses.setdefault(str(doc["run_id"]), {})[int(doc["edge_id"])] = doc
+                eid = int(doc["edge_id"])
+                self.statuses.setdefault(str(doc["run_id"]), {})[eid] = doc
+                if doc.get("status") in TERMINAL:
+                    # event-driven credit: a straggler finishing AFTER a
+                    # wait_for_run timeout still returns its slots (pop-
+                    # guarded, so a concurrent wait_for_run can't double-credit)
+                    self._credit_locked(str(doc["run_id"]), {eid})
             self._cv.notify_all()
+
+    def wait_for_agents(self, n: int, timeout_s: float = 30.0) -> bool:
+        """Block until ``n`` distinct edges have checked in with capacity —
+        a capacity-matched dispatch over a REAL broker must not race the
+        agents' announcements."""
+        deadline = time.time() + timeout_s
+        with self._cv:
+            while len(self.capacity) < n:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(timeout=min(remaining, 1.0))
+            return True
 
     # --- dispatch --------------------------------------------------------
     def dispatch_workspace(
@@ -194,20 +245,50 @@ class MqttServerAgent:
         env: Optional[Dict[str, str]] = None,
         edge_ids: Optional[List[int]] = None,
         run_id: Optional[str] = None,
+        request_slots: int = 0,
     ) -> str:
+        """``request_slots > 0`` turns the fan-out into a CAPACITY-MATCHED
+        dispatch (reference master: scheduler_matcher over the check-in
+        inventory): the ask is spread over agents that announced slots, only
+        matched agents receive the job (with scheduler topology env), slots
+        are debited until the run ends, and an over-ask raises
+        ClusterMatchError before anything ships."""
         run_id = run_id or uuid.uuid4().hex[:8]
-        pkg_local = os.path.join(tempfile.gettempdir(), f"fedml_pkg_{run_id}.zip")
-        build_job_package(workspace, pkg_local, meta={"run_id": run_id})
-        url = self.store.write_file(f"job_package_{run_id}", pkg_local)
-        request = {
+        targets = list(edge_ids if edge_ids is not None else self.edge_ids)
+        request: Dict[str, Any] = {
             "run_id": run_id,
-            "package_url": url,
             "job_cmd": job_cmd,
             "bootstrap_cmd": bootstrap_cmd,
             "env": env or {},
         }
-        for eid in edge_ids if edge_ids is not None else self.edge_ids:
-            self.transport.publish(TOPIC_START.format(edge_id=eid), json.dumps(request).encode())
+        # package FIRST: a build/upload failure must surface before any
+        # slot is debited (a leaked debit would shrink the cluster forever)
+        pkg_local = os.path.join(tempfile.gettempdir(), f"fedml_pkg_{run_id}.zip")
+        build_job_package(workspace, pkg_local, meta={"run_id": run_id})
+        request["package_url"] = self.store.write_file(f"job_package_{run_id}", pkg_local)
+        if request_slots > 0:
+            with self._cv:
+                assignment = match_and_assign(
+                    request_slots, self.capacity, edge_ids=targets)
+                for eid, n in assignment.items():
+                    self.capacity[eid].slots_available -= n
+                self.run_assignment[run_id] = assignment
+            targets = sorted(assignment)
+            request["scheduler_info"] = {
+                "master_node_addr": "localhost",
+                "master_node_port": 29500,
+                "num_nodes": len(targets),
+                "matched_slots": {str(e): n for e, n in assignment.items()},
+            }
+        self.run_edges[run_id] = targets
+        try:
+            for eid in targets:
+                self.transport.publish(TOPIC_START.format(edge_id=eid), json.dumps(request).encode())
+        except Exception:
+            # nothing (or only part) shipped: credit every debit back
+            with self._cv:
+                self._credit_locked(run_id, set(targets))
+            raise
         return run_id
 
     def stop_run(self, run_id: str, edge_ids: Optional[List[int]] = None) -> None:
@@ -229,7 +310,12 @@ class MqttServerAgent:
     def wait_for_run(
         self, run_id: str, *, edge_ids: Optional[List[int]] = None, timeout_s: float = 600.0
     ) -> Dict[int, Dict[str, Any]]:
-        """Block until every dispatched edge reports a terminal status."""
+        """Block until every dispatched edge reports a terminal status.
+        Defaults to the run's MATCHED targets (a capacity-matched dispatch
+        lands on a subset); terminal edges get their debited slots credited
+        back, a TIMEOUT edge stays debited (its job still runs)."""
+        if edge_ids is None:
+            edge_ids = self.run_edges.get(run_id)
         targets = set(edge_ids if edge_ids is not None else self.edge_ids)
         deadline = time.time() + timeout_s
         with self._cv:
@@ -237,11 +323,24 @@ class MqttServerAgent:
                 got = self.statuses.get(run_id, {})
                 done = {e for e, d in got.items() if d.get("status") in TERMINAL}
                 if targets <= done:
+                    self._credit_locked(run_id, done)
                     return {e: got[e] for e in targets}
                 remaining = deadline - time.time()
                 if remaining <= 0:
+                    self._credit_locked(run_id, done)
                     return {e: got.get(e, {"status": "TIMEOUT", "edge_id": e}) for e in targets}
                 self._cv.wait(timeout=min(remaining, 1.0))
+
+    def _credit_locked(self, run_id: str, terminal_edges) -> None:
+        """Credit debited slots for edges whose run ENDED (cv held)."""
+        assignment = self.run_assignment.get(run_id)
+        if not assignment:
+            return
+        for eid in list(assignment):
+            if eid in terminal_edges and eid in self.capacity:
+                cap = self.capacity[eid]
+                cap.slots_available = min(cap.slots_total,
+                                          cap.slots_available + assignment.pop(eid))
 
     def stop(self) -> None:
         self.transport.disconnect()

@@ -199,3 +199,128 @@ def test_job_monitor_elastic_restart(tmp_path):
         monitor.stop()
         server.stop()
         agent.stop()
+
+
+# --- capacity-matched dispatch over MQTT (reference scheduler_matcher) ------
+
+
+def test_capacity_matched_dispatch_over_mqtt(tmp_path):
+    """Agents announce capacity on check-in (reference slave gpu-info
+    payload); a slot-asking dispatch lands ONLY on agents with slots, ships
+    the scheduler topology env, debits slots for the run's duration, and
+    credits them back on terminal status."""
+    import types
+
+    from fedml_tpu.computing.scheduler.cluster import ClusterMatchError
+
+    ws = _workspace(
+        tmp_path,
+        """
+        import os
+        print("SLOTS", os.environ.get("FEDML_MATCHED_SLOTS"),
+              "NODES", os.environ.get("FEDML_NUM_NODES"))
+        """,
+    )
+    store = LocalObjectStore(str(tmp_path / "store"))
+    mk = lambda e, slots: MqttClientAgent(
+        e, types.SimpleNamespace(agent_slots=slots,
+                                 agent_accelerator_kind="tpu-v5e"),
+        base_dir=str(tmp_path / f"edge{e}"), store=store)
+    agents = [mk(0, 1), mk(1, 0), mk(2, 1)]
+    server = MqttServerAgent([0, 1, 2], store=store)
+    try:
+        for a in agents:
+            a.announce()
+        assert server.wait_for_agents(3, timeout_s=10)
+        assert server.capacity[0].slots_available == 1
+        assert server.capacity[1].slots_available == 0
+        assert server.capacity[2].accelerator_kind == "tpu-v5e"
+
+        run_id = server.dispatch_workspace(ws, "python main.py", request_slots=2)
+        # matched agents only; slots debited while the run is in flight
+        assert sorted(server.run_edges[run_id]) == [0, 2]
+        assert server.capacity[0].slots_available == 0
+        statuses = server.wait_for_run(run_id, timeout_s=60)
+        assert set(statuses) == {0, 2}  # agent 1 got no work
+        assert {d["status"] for d in statuses.values()} == {"FINISHED"}
+        for e, d in statuses.items():
+            assert "SLOTS 1 NODES 2" in open(d["log_path"]).read()
+        # terminal statuses credited the slots back
+        assert server.capacity[0].slots_available == 1
+        assert server.capacity[2].slots_available == 1
+
+        with pytest.raises(ClusterMatchError, match="requests 4 slot"):
+            server.dispatch_workspace(ws, "python main.py", request_slots=4)
+    finally:
+        server.stop()
+        for a in agents:
+            a.stop()
+
+
+def test_launch_job_over_mqtt_with_slots(tmp_path):
+    """fedml launch --backend mqtt honors computing.minimum_num_gpus: the
+    whole path (announce -> match -> dispatch -> env -> statuses) through
+    the public entry."""
+    import textwrap as tw
+    import types
+
+    from fedml_tpu.computing.scheduler.launch_manager import launch_job_over_mqtt
+
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    (ws / "main.py").write_text("import os\nprint('S', os.environ.get('FEDML_MATCHED_SLOTS'))\n")
+    job_yaml = tmp_path / "job.yaml"
+    job_yaml.write_text(tw.dedent("""
+        job_name: slots_mqtt
+        workspace: ws
+        job: python main.py
+        computing:
+          minimum_num_gpus: 2
+    """))
+    statuses = launch_job_over_mqtt(
+        str(job_yaml), num_edges=2, timeout_s=120,
+        args=types.SimpleNamespace(agent_slots=1),
+    )
+    assert set(statuses) == {0, 1}
+    assert all(st.status == "FINISHED" for st in statuses.values())
+
+
+def test_straggler_credit_and_reannounce_preserve_debits(tmp_path):
+    """(a) An edge reporting terminal AFTER wait_for_run timed out still
+    credits its slots (event-driven, not poll-driven); (b) a mid-run
+    re-announce (agent daemon OTA re-exec) must not discard in-flight
+    debits."""
+    import types
+
+    ws = _workspace(tmp_path, "import time; time.sleep(3)\n")
+    store = LocalObjectStore(str(tmp_path / "store"))
+    agent = MqttClientAgent(
+        0, types.SimpleNamespace(agent_slots=1),
+        base_dir=str(tmp_path / "edge0"), store=store)
+    server = MqttServerAgent([0], store=store)
+    try:
+        agent.announce()
+        assert server.wait_for_agents(1, timeout_s=10)
+        run_id = server.dispatch_workspace(ws, "python main.py", request_slots=1)
+        assert server.capacity[0].slots_available == 0  # debited
+
+        # (b) the agent re-announces while its job is still running: the
+        # master keeps the outstanding debit instead of resetting to full
+        agent.announce()
+        assert server.capacity[0].slots_available == 0
+
+        # (a) wait_for_run gives up before the job ends: the slot stays
+        # debited at the timeout...
+        out = server.wait_for_run(run_id, timeout_s=0.3)
+        assert out[0]["status"] in ("RUNNING", "TIMEOUT")  # not terminal yet
+        assert server.capacity[0].slots_available == 0
+        # ...and the straggler's eventual FINISHED status credits it back
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if server.capacity[0].slots_available == 1:
+                break
+            time.sleep(0.2)
+        assert server.capacity[0].slots_available == 1
+    finally:
+        server.stop()
+        agent.stop()
