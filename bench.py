@@ -470,7 +470,12 @@ def _bench_llm_decode_tpu(reps: int = 4, weight_quant: str = "none"):
 
         _p("decode bench: quantizing weights to int8")
         cfg, params = quantize_model_int8(cfg, params)
-    bs, P, new = 4, 64, 128
+    # prompt/new derived from the model's seq budget so the tiny dry-run
+    # geometry (max_seq_len 128) fits: flagship stays 64 + 128
+    s = _llm_shape()
+    bs = 4
+    P = min(64, s["seq"] // 2)
+    new = min(128, s["seq"] - P)
     rng = np.random.default_rng(1)
     prompts = [
         jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, P)).astype(np.int32))
