@@ -39,6 +39,50 @@ def fedml_launch(yaml_file: str, edges: int, timeout: float, backend: str) -> No
         click.echo(f"edge {edge_id}: {getattr(st, 'status', st)}")
 
 
+# --- cluster (reference cli/modules/cluster.py — local inventory verbs) -----
+
+@cli.group("cluster", help="Capacity registry the launch matcher consumes")
+def fedml_cluster() -> None:
+    """Local capacity verbs; the cloud lifecycle verbs (start/stop/
+    autostop) are a documented scope cut (README)."""
+
+
+@fedml_cluster.command("register", help="Declare an agent's slot capacity")
+@click.argument("edge_id", type=int)
+@click.argument("slots", type=int)
+@click.option("--cores", default=None, type=int)
+@click.option("--memory-mb", default=0, type=int)
+@click.option("--kind", default="", help="accelerator kind, e.g. tpu-v5e")
+def cluster_register_cmd(edge_id: int, slots: int, cores: int, memory_mb: int, kind: str) -> None:
+    api.cluster_register(edge_id, slots, cores=cores, memory_mb=memory_mb,
+                         accelerator_kind=kind)
+    click.echo(json.dumps(api.cluster_status()))
+
+
+@fedml_cluster.command("list", help="Registered agents and their capacity")
+def cluster_list_cmd() -> None:
+    for eid, row in sorted(api.cluster_list().items()):
+        click.echo(
+            f"edge {eid}: {row.slots_available}/{row.slots_total} slots"
+            f"{' ' + row.accelerator_kind if row.accelerator_kind else ''}"
+            f" ({row.cores} cores, {row.memory_mb} MB)")
+
+
+@fedml_cluster.command("status", help="Aggregate slot availability")
+def cluster_status_cmd() -> None:
+    click.echo(json.dumps(api.cluster_status()))
+
+
+def _cluster_cloud_stub() -> None:
+    raise click.ClickException(
+        "this deployment is offline-first: marketplace cluster lifecycle "
+        "verbs need the MLOps cloud. Local capacity verbs: register/list/status.")
+
+
+for _verb in ("start", "stop", "autostop"):
+    fedml_cluster.command(_verb, help="(cloud) marketplace lifecycle")(_cluster_cloud_stub)
+
+
 # --- run (reference cli/modules/run.py) -------------------------------------
 
 @cli.command("run", help="Run a training config in this process")
@@ -186,11 +230,6 @@ def fedml_login(api_key: str) -> None:
 
 @cli.command("logout", help="(cloud) unbind this device")
 def fedml_logout() -> None:
-    raise click.ClickException(_OFFLINE_MSG)
-
-
-@cli.command("cluster", help="(cloud) manage GPU/TPU clusters")
-def fedml_cluster() -> None:
     raise click.ClickException(_OFFLINE_MSG)
 
 

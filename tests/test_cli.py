@@ -87,10 +87,29 @@ validation_args:
 
 
 def test_offline_verbs_fail_clearly(runner):
-    for verb in ("login", "logout", "cluster", "storage"):
+    for verb in ("login", "logout", "storage"):
         out = runner.invoke(cli, [verb])
         assert out.exit_code != 0
         assert "offline" in out.output
+    # cluster's cloud LIFECYCLE verbs are the offline stubs now — the local
+    # capacity verbs under the same group are real (below)
+    for verb in ("start", "stop", "autostop"):
+        out = runner.invoke(cli, ["cluster", verb])
+        assert out.exit_code != 0 and "offline" in out.output
+
+
+def test_cluster_capacity_verbs(runner, tmp_path, monkeypatch):
+    """register -> list -> status through the CLI (component #29 surface)."""
+    from fedml_tpu.computing.scheduler.launch_manager import FedMLLaunchManager
+
+    mgr = FedMLLaunchManager(num_edges=1, base_dir=str(tmp_path / "agent"))
+    monkeypatch.setattr(FedMLLaunchManager, "_instance", mgr)
+    out = runner.invoke(cli, ["cluster", "register", "0", "2", "--kind", "tpu-v5e"])
+    assert out.exit_code == 0, out.output
+    out = runner.invoke(cli, ["cluster", "list"])
+    assert "edge 0: 2/2 slots tpu-v5e" in out.output
+    out = runner.invoke(cli, ["cluster", "status"])
+    assert json.loads(out.output.splitlines()[-1])["slots_total"] == 2
 
 
 def test_api_collect_env_and_diagnose():
