@@ -37,11 +37,24 @@ def launch_job(
 
     backend="local": in-process edge runners. backend="MQTT": persistent
     agents speaking the reference's flserver_agent/... topics over the
-    broker, package shipped through the object store."""
+    broker, package shipped through the object store. Capacity declared
+    via cluster_register reaches BOTH planes: the MQTT agents announce the
+    journal's slots on check-in, so a slot-asking job.yaml matches against
+    the same inventory either way."""
     if backend.upper() == "MQTT":
+        import types
+
         from ..computing.scheduler.launch_manager import launch_job_over_mqtt
 
-        return launch_job_over_mqtt(yaml_file, num_edges=num_edges, timeout_s=timeout_s)
+        caps = _launch_manager(num_edges).cluster.capacities()
+        args = None
+        if any(c.slots_total for c in caps.values()):
+            args = types.SimpleNamespace(
+                agent_slots={e: c.slots_available for e, c in caps.items()},
+                agent_accelerator_kind={e: c.accelerator_kind for e, c in caps.items()},
+            )
+        return launch_job_over_mqtt(yaml_file, num_edges=num_edges,
+                                    timeout_s=timeout_s, args=args)
     return _launch_manager(num_edges).launch_job(yaml_file, timeout_s=timeout_s)
 
 

@@ -93,10 +93,14 @@ class MqttClientAgent:
         accelerator slots explicitly (local hosts detect zero)."""
         cap = detect_local_capacity(self.edge_id)
         slots = getattr(self._args, "agent_slots", None)
+        if isinstance(slots, dict):  # per-edge declarations (journal bridge)
+            slots = slots.get(self.edge_id)
         if slots is not None:
             cap.slots_total = cap.slots_available = int(slots)
-            cap.accelerator_kind = str(
-                getattr(self._args, "agent_accelerator_kind", "") or cap.accelerator_kind)
+            kind = getattr(self._args, "agent_accelerator_kind", "")
+            if isinstance(kind, dict):
+                kind = kind.get(self.edge_id, "")
+            cap.accelerator_kind = str(kind or cap.accelerator_kind)
         self.transport.publish(
             TOPIC_STATUS.format(edge_id=self.edge_id),
             json.dumps({
@@ -187,7 +191,10 @@ class MqttServerAgent:
         # master's active_edge_info_dict — scheduler_matcher.py consumes it)
         self.capacity: Dict[int, EdgeCapacity] = {}
         self.run_edges: Dict[str, List[int]] = {}       # matched targets per run
-        self.run_assignment: Dict[str, Dict[int, int]] = {}  # slots to credit back
+        # the ORIGINAL match per run (immutable record) + a per-(run, edge)
+        # debit flag: terminal credits, an elastic-restart RUNNING re-debits
+        self.run_assignment: Dict[str, Dict[int, int]] = {}
+        self._debited: Dict[tuple, bool] = {}
         self._cv = threading.Condition()
         for eid in self.edge_ids:
             self.transport.subscribe(TOPIC_STATUS.format(edge_id=eid), self._on_status)
@@ -214,12 +221,24 @@ class MqttServerAgent:
                     self.capacity[eid] = new
             else:
                 eid = int(doc["edge_id"])
-                self.statuses.setdefault(str(doc["run_id"]), {})[eid] = doc
+                run = str(doc["run_id"])
+                self.statuses.setdefault(run, {})[eid] = doc
                 if doc.get("status") in TERMINAL:
                     # event-driven credit: a straggler finishing AFTER a
-                    # wait_for_run timeout still returns its slots (pop-
-                    # guarded, so a concurrent wait_for_run can't double-credit)
-                    self._credit_locked(str(doc["run_id"]), {eid})
+                    # wait_for_run timeout still returns its slots (the
+                    # debit flag makes credits idempotent)
+                    self._credit_locked(run, {eid})
+                else:
+                    # a RUNNING status on a slot whose debit was already
+                    # credited = the JobMonitor elastically RESTARTED a
+                    # FAILED run — the slot is occupied again and must be
+                    # re-debited or a new dispatch double-books the edge
+                    n = self.run_assignment.get(run, {}).get(eid, 0)
+                    if n and not self._debited.get((run, eid), False):
+                        cap = self.capacity.get(eid)
+                        if cap is not None:
+                            cap.slots_available = max(0, cap.slots_available - n)
+                        self._debited[(run, eid)] = True
             self._cv.notify_all()
 
     def wait_for_agents(self, n: int, timeout_s: float = 30.0) -> bool:
@@ -272,6 +291,7 @@ class MqttServerAgent:
                     request_slots, self.capacity, edge_ids=targets)
                 for eid, n in assignment.items():
                     self.capacity[eid].slots_available -= n
+                    self._debited[(run_id, eid)] = True
                 self.run_assignment[run_id] = assignment
             targets = sorted(assignment)
             request["scheduler_info"] = {
@@ -281,13 +301,24 @@ class MqttServerAgent:
                 "matched_slots": {str(e): n for e, n in assignment.items()},
             }
         self.run_edges[run_id] = targets
+        shipped: set = set()
         try:
             for eid in targets:
                 self.transport.publish(TOPIC_START.format(edge_id=eid), json.dumps(request).encode())
+                shipped.add(eid)
         except Exception:
-            # nothing (or only part) shipped: credit every debit back
+            # SHIPPED edges are executing the job: best-effort stop them
+            # (their KILLED statuses credit the slots) and credit back only
+            # the UNSHIPPED debits — crediting a running edge would let the
+            # next dispatch double-book it
+            if shipped:
+                try:
+                    self.stop_run(run_id, edge_ids=sorted(shipped))
+                except Exception:  # noqa: BLE001 - broker already failing
+                    log.warning("could not stop partially-dispatched run %s "
+                                "on edges %s", run_id, sorted(shipped))
             with self._cv:
-                self._credit_locked(run_id, set(targets))
+                self._credit_locked(run_id, set(targets) - shipped)
             raise
         return run_id
 
@@ -332,15 +363,18 @@ class MqttServerAgent:
                 self._cv.wait(timeout=min(remaining, 1.0))
 
     def _credit_locked(self, run_id: str, terminal_edges) -> None:
-        """Credit debited slots for edges whose run ENDED (cv held)."""
+        """Credit debited slots for edges whose run ENDED (cv held). The
+        per-(run, edge) flag makes this idempotent AND reversible: an
+        elastic restart re-debits via _on_status's RUNNING branch."""
         assignment = self.run_assignment.get(run_id)
         if not assignment:
             return
-        for eid in list(assignment):
-            if eid in terminal_edges and eid in self.capacity:
+        for eid, n in assignment.items():
+            if (eid in terminal_edges and eid in self.capacity
+                    and self._debited.get((run_id, eid), False)):
                 cap = self.capacity[eid]
-                cap.slots_available = min(cap.slots_total,
-                                          cap.slots_available + assignment.pop(eid))
+                cap.slots_available = min(cap.slots_total, cap.slots_available + n)
+                self._debited[(run_id, eid)] = False
 
     def stop(self) -> None:
         self.transport.disconnect()

@@ -324,3 +324,63 @@ def test_straggler_credit_and_reannounce_preserve_debits(tmp_path):
     finally:
         server.stop()
         agent.stop()
+
+
+def test_elastic_restart_redebits_credited_slot():
+    """FAILED credits the slot, but the JobMonitor's elastic restart makes
+    the edge report RUNNING again for the SAME run — the master must
+    re-debit or a new dispatch double-books the edge; the final terminal
+    credits exactly once."""
+    from fedml_tpu.computing.scheduler.cluster import EdgeCapacity
+
+    server = MqttServerAgent([0])
+    try:
+        server.capacity[0] = EdgeCapacity(
+            edge_id=0, cores=4, memory_mb=0, slots_total=1, slots_available=0)
+        server.run_assignment["r1"] = {0: 1}
+        server._debited[("r1", 0)] = True
+
+        def st(status):
+            server._on_status("", json.dumps(
+                {"run_id": "r1", "edge_id": 0, "status": status}).encode())
+
+        st("FAILED")
+        assert server.capacity[0].slots_available == 1  # credited
+        st("RUNNING")  # elastic restart of the same run
+        assert server.capacity[0].slots_available == 0  # re-debited
+        st("FINISHED")
+        assert server.capacity[0].slots_available == 1  # credited once
+        st("FINISHED")  # duplicate terminal: idempotent
+        assert server.capacity[0].slots_available == 1
+    finally:
+        server.stop()
+
+
+def test_cluster_register_reaches_mqtt_launch(tmp_path, monkeypatch):
+    """The CLI/api journal registration feeds the MQTT plane too: agents
+    announce the registered slots on check-in, so `launch --backend mqtt`
+    matches a slot ask without any python-API-only knob."""
+    import textwrap as tw
+
+    from fedml_tpu import api
+    from fedml_tpu.computing.scheduler.launch_manager import FedMLLaunchManager
+
+    mgr = FedMLLaunchManager(num_edges=2, base_dir=str(tmp_path / "agent"))
+    monkeypatch.setattr(FedMLLaunchManager, "_instance", mgr)
+    api.cluster_register(0, slots=1, accelerator_kind="tpu-v5e")
+    api.cluster_register(1, slots=1, accelerator_kind="tpu-v5e")
+
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    (ws / "main.py").write_text("import os\nprint('S', os.environ.get('FEDML_MATCHED_SLOTS'))\n")
+    job_yaml = tmp_path / "job.yaml"
+    job_yaml.write_text(tw.dedent("""
+        job_name: bridge
+        workspace: ws
+        job: python main.py
+        computing:
+          minimum_num_gpus: 2
+    """))
+    statuses = api.launch_job(str(job_yaml), num_edges=2, backend="mqtt", timeout_s=120)
+    assert set(statuses) == {0, 1}
+    assert all(st.status == "FINISHED" for st in statuses.values())
