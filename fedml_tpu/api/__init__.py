@@ -42,19 +42,34 @@ def launch_job(
     journal's slots on check-in, so a slot-asking job.yaml matches against
     the same inventory either way."""
     if backend.upper() == "MQTT":
+        import logging
         import types
 
-        from ..computing.scheduler.launch_manager import launch_job_over_mqtt
+        from ..computing.scheduler.launch_manager import (
+            FedMLLaunchManager,
+            launch_job_over_mqtt,
+        )
 
-        caps = _launch_manager(num_edges).cluster.capacities()
+        # read-only journal view: no pool growth (the MQTT path runs its
+        # own agents; growing the local runner pool here would both waste
+        # runners and write zero-slot announce rows into the journal)
+        registry = FedMLLaunchManager.get_instance().cluster
+        caps = registry.capacities()
         args = None
         if any(c.slots_total for c in caps.values()):
+            dropped = sorted(e for e in caps if e >= num_edges and caps[e].slots_total)
+            if dropped:
+                logging.getLogger(__name__).warning(
+                    "cluster capacity registered for edge ids %s is outside "
+                    "this launch's %d MQTT agents and will not be announced",
+                    dropped, num_edges)
             args = types.SimpleNamespace(
                 agent_slots={e: c.slots_available for e, c in caps.items()},
                 agent_accelerator_kind={e: c.accelerator_kind for e, c in caps.items()},
             )
         return launch_job_over_mqtt(yaml_file, num_edges=num_edges,
-                                    timeout_s=timeout_s, args=args)
+                                    timeout_s=timeout_s, args=args,
+                                    registry=registry)
     return _launch_manager(num_edges).launch_job(yaml_file, timeout_s=timeout_s)
 
 

@@ -146,12 +146,18 @@ class FedMLLaunchManager:
 
 
 def launch_job_over_mqtt(
-    job_yaml_path: str, *, num_edges: int = 1, timeout_s: float = 600.0, args=None
+    job_yaml_path: str, *, num_edges: int = 1, timeout_s: float = 600.0,
+    args=None, registry: Optional[ClusterRegistry] = None,
 ) -> Dict[int, "RunStatus"]:
     """Launch a job.yaml through persistent MQTT agents (reference topics +
     object-store package plane) and block for terminal statuses. The agents
     and a JobMonitor live for the call; in a deployment they run as daemons
-    (``fedml-tpu launch --backend mqtt`` / devops manifests)."""
+    (``fedml-tpu launch --backend mqtt`` / devops manifests).
+
+    ``registry``: the shared capacity journal — a matched run's slots are
+    debited there for its duration so a CONCURRENT local-backend launch
+    cannot double-book the same physical accelerators (api.launch_job
+    passes it; the journal is the one inventory both planes share)."""
     from .job_config import FedMLJobConfig
     from .mqtt_agents import JobMonitor, MqttClientAgent, MqttServerAgent
 
@@ -160,6 +166,7 @@ def launch_job_over_mqtt(
     agents: list = []
     monitor = None
     server = None
+    journal_debit: Dict[int, int] = {}
     try:
         agents = [MqttClientAgent(eid, args) for eid in range(num_edges)]
         monitor = JobMonitor(agents)
@@ -183,6 +190,17 @@ def launch_job_over_mqtt(
             config.workspace, config.job, bootstrap_cmd=config.bootstrap,
             request_slots=slots,
         )
+        if registry is not None and slots > 0:
+            # mirror the master's in-memory debit into the shared journal
+            # for the run's duration (best-effort: journal rows may not
+            # cover every matched edge)
+            matched = server.run_assignment.get(run_id, {})
+            journal_debit = {e: n for e, n in matched.items()
+                            if e in registry.capacities()}
+            try:
+                registry.acquire(journal_debit)
+            except Exception:
+                journal_debit = {}  # raced a local launch; skip the mirror
         raw = server.wait_for_run(run_id, timeout_s=timeout_s)
         return {
             eid: RunStatus(
@@ -196,6 +214,10 @@ def launch_job_over_mqtt(
             for eid, doc in raw.items()
         }
     finally:
+        if registry is not None and journal_debit:
+            # the blocking call owns the run end to end (agents are torn
+            # down below), so the journal mirror ends with it
+            registry.release(journal_debit)
         if monitor is not None:
             monitor.stop()
         if server is not None:

@@ -192,9 +192,13 @@ class MqttServerAgent:
         self.capacity: Dict[int, EdgeCapacity] = {}
         self.run_edges: Dict[str, List[int]] = {}       # matched targets per run
         # the ORIGINAL match per run (immutable record) + a per-(run, edge)
-        # debit flag: terminal credits, an elastic-restart RUNNING re-debits
+        # debit flag: terminal credits, an elastic-restart RUNNING re-debits.
+        # Bounded: a daemonized master serves unbounded runs, so bookkeeping
+        # for runs beyond the newest _RUN_RETENTION is evicted (statuses
+        # kept — they predate this and callers read them after wait)
         self.run_assignment: Dict[str, Dict[int, int]] = {}
         self._debited: Dict[tuple, bool] = {}
+        self._RUN_RETENTION = 256
         self._cv = threading.Condition()
         for eid in self.edge_ids:
             self.transport.subscribe(TOPIC_STATUS.format(edge_id=eid), self._on_status)
@@ -293,6 +297,21 @@ class MqttServerAgent:
                     self.capacity[eid].slots_available -= n
                     self._debited[(run_id, eid)] = True
                 self.run_assignment[run_id] = assignment
+                # evict the OLDEST fully-credited runs past the retention
+                # cap (a run with a live debit is never evicted — that
+                # would leak the slot)
+                while len(self.run_assignment) > self._RUN_RETENTION:
+                    for old in list(self.run_assignment):
+                        if old == run_id:
+                            continue
+                        if not any(self._debited.get((old, e), False)
+                                   for e in self.run_assignment[old]):
+                            for e in self.run_assignment.pop(old):
+                                self._debited.pop((old, e), None)
+                            self.run_edges.pop(old, None)
+                            break
+                    else:
+                        break  # every older run still holds a debit
             targets = sorted(assignment)
             request["scheduler_info"] = {
                 "master_node_addr": "localhost",

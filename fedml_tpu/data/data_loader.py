@@ -103,6 +103,10 @@ def load(args: Any) -> FedDataset:
             fmt = None
     if fmt:
         args.output_dim = fed[-1]
+        if dataset == "cityscapes":
+            # trainId masks carry 255 for void classes; the fedseg loss
+            # masks that label (reference CE ignore_index=255)
+            args.seg_ignore_label = 255
         # real files may carry a smaller feature space than the dataset's
         # canonical preset (e.g. a truncated word_count sidecar); record the
         # ACTUAL shape so model_hub builds a matching input layer

@@ -297,6 +297,11 @@ def detect_format_files(dataset: str, cache: str) -> Optional[str]:
             and os.path.isdir(os.path.join(d, "dataset", "img"))
             and os.path.isdir(os.path.join(d, "dataset", "cls"))
         ),
+        "cityscapes": lambda: (
+            os.path.isdir(os.path.join(d, "leftImg8bit", "train"))
+            and (os.path.isdir(os.path.join(d, "gtFine"))
+                 or os.path.isdir(os.path.join(d, "gtCoarse")))
+        ),
     }
     fn = checks.get(dataset)
     try:
@@ -340,6 +345,10 @@ def load_native_format(dataset: str, cache: str, client_num: Optional[int] = Non
             d, n_clients=client_num,
             alpha=partition_alpha if partition_alpha is not None else 0.5,
             seed=seed)
+    elif dataset == "cityscapes":
+        gt = "gtFine" if os.path.isdir(os.path.join(d, "gtFine")) else "gtCoarse"
+        train, test, classes = load_cityscapes_dir(d, n_clients=client_num,
+                                                   annotation_type=gt)
     else:
         raise ValueError(f"no native-format loader for {dataset!r}")
     log.info("dataset %s: loaded NATIVE format files from %s (%d clients)", dataset, d, len(train))
@@ -854,3 +863,97 @@ def load_pascal_voc_dir(root: str, n_clients: Optional[int] = None,
              "(dirichlet alpha=%.2f over first-category)",
              len(x_tr), len(x_te), len(train), alpha)
     return train, test, PASCAL_VOC_CLASSES
+
+
+# --- Cityscapes segmentation (FedSeg family) ---------------------------------
+
+CITYSCAPES_CLASSES = 19  # trainId classes; everything else -> 255 (ignored)
+
+# labelId -> trainId (reference fedcv cityscapes/dataset.py id_to_train_id;
+# 255 = void/ignore, masked out of the loss and the confusion matrix)
+_CITYSCAPES_ID_TO_TRAIN = {
+    7: 0, 8: 1, 11: 2, 12: 3, 13: 4, 17: 5, 19: 6, 20: 7, 21: 8, 22: 9,
+    23: 10, 24: 11, 25: 12, 26: 13, 27: 14, 28: 15, 31: 16, 32: 17, 33: 18,
+}
+
+
+def load_cityscapes_dir(root: str, n_clients: Optional[int] = None,
+                        image_hw: int = 64,
+                        annotation_type: str = "gtFine",
+                        ) -> Tuple[ClientData, ClientData, int]:
+    """Cityscapes layout as the reference's fedcv example consumes it
+    (``examples/federate/prebuilt_jobs/fedcv/image_segmentation/data/
+    cityscapes/dataset.py:24-60``):
+
+        {root}/leftImg8bit/{split}/{city}/<id>_leftImg8bit.png
+        {root}/{gtFine|gtCoarse}/{split}/{city}/<id>_{type}_labelIds.png
+
+    labelIds are mapped to the 19 trainId classes (everything else -> 255,
+    the void label the loss must ignore — ``seg_ignore_label``). The
+    federation is per-CITY: cities are the natural clients of a cityscapes
+    deployment (one municipality's cameras per silo), giving a real non-IID
+    split where the reference synthesizes one with Dirichlet. ``n_clients``
+    regrouping happens downstream (clients_to_fed_dataset round-robins
+    cities). val/ becomes the shared eval pool, partitioned round-robin.
+    """
+    from PIL import Image
+
+    lut = np.full(256, 255, np.uint8)
+    for label_id, train_id in _CITYSCAPES_ID_TO_TRAIN.items():
+        lut[label_id] = train_id
+
+    def load_split(split: str) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+        img_root = os.path.join(root, "leftImg8bit", split)
+        mask_root = os.path.join(root, annotation_type, split)
+        if not os.path.isdir(img_root):
+            return {}
+        out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        for city in sorted(os.listdir(img_root)):
+            city_dir = os.path.join(img_root, city)
+            if not os.path.isdir(city_dir):
+                continue
+            xs, ys = [], []
+            for fname in sorted(os.listdir(city_dir)):
+                if not fname.endswith("_leftImg8bit.png"):
+                    continue
+                stem = fname[: -len("_leftImg8bit.png")]
+                mask_p = os.path.join(
+                    mask_root, city, f"{stem}_{annotation_type}_labelIds.png")
+                if not os.path.exists(mask_p):
+                    continue
+                img = Image.open(os.path.join(city_dir, fname)).convert("RGB")
+                img = img.resize((image_hw, image_hw), Image.BILINEAR)
+                mask = np.asarray(Image.open(mask_p).resize(
+                    (image_hw, image_hw), Image.NEAREST))
+                xs.append(np.asarray(img, np.float32) / 255.0)
+                ys.append(lut[mask].astype(np.int32))
+            if xs:
+                out[city] = (np.stack(xs), np.stack(ys))
+        return out
+
+    train = load_split("train")
+    if not train:
+        raise ValueError(
+            f"{root}: no leftImg8bit/train/<city>/*_leftImg8bit.png with "
+            f"matching {annotation_type} labelIds masks")
+    val = load_split("val")
+    if val:
+        # shared eval pool split round-robin across the train cities
+        vx = np.concatenate([x for x, _ in val.values()])
+        vy = np.concatenate([y for _, y in val.values()])
+        cities = list(train)
+        test = {c: (vx[i::len(cities)], vy[i::len(cities)])
+                for i, c in enumerate(cities) if len(vx[i::len(cities)])}
+    else:
+        test = {}
+        for city, (x, y) in list(train.items()):
+            if len(x) > 1:
+                test[city] = (x[-1:], y[-1:])
+                train[city] = (x[:-1], y[:-1])
+        if not test:
+            city = next(iter(train))
+            x, y = train[city]
+            test[city] = (x[-1:], y[-1:])
+    log.info("dataset cityscapes: %d cities (natural clients), %d train images",
+             len(train), sum(len(x) for x, _ in train.values()))
+    return train, test, CITYSCAPES_CLASSES

@@ -121,6 +121,18 @@ class FedSegAPI:
         batch = int(getattr(args, "batch_size", 8))
 
         num_classes = self.num_classes
+        # void/ignore label (reference SegmentationLosses ignore_index=255
+        # — cityscapes trainId maps unlabeled classes to 255): masked out of
+        # the CE, the class weights, and (via out-of-range one_hot rows)
+        # already absent from the confusion matrix. -1 disables.
+        ignore = int(getattr(args, "seg_ignore_label", -1))
+
+        def _masked(y):
+            """(y_safe for indexing, f32 validity mask)."""
+            if ignore < 0:
+                return y, None
+            valid = (y != ignore)
+            return jnp.where(valid, y, 0), valid.astype(jnp.float32)
 
         def local_train(params, xs, ys):
             opt_state = tx.init(params)
@@ -130,8 +142,12 @@ class FedSegAPI:
             xb = xs[: nb * b].reshape(nb, b, *xs.shape[1:])
             yb = ys[: nb * b].reshape(nb, b, *ys.shape[1:])
             # inverse-frequency class weights (reference SegmentationLosses
-            # weighted-CE mode): the background-heavy prior otherwise wins
-            counts = jnp.bincount(ys.reshape(-1), length=num_classes).astype(jnp.float32)
+            # weighted-CE mode): the background-heavy prior otherwise wins.
+            # Ignored pixels are routed to an overflow bin and dropped.
+            flat = ys.reshape(-1)
+            if ignore >= 0:
+                flat = jnp.where(flat == ignore, num_classes, flat)
+            counts = jnp.bincount(flat, length=num_classes + 1)[:num_classes].astype(jnp.float32)
             cw = counts.sum() / (num_classes * jnp.maximum(counts, 1.0))
 
             def step(carry, b):
@@ -140,8 +156,11 @@ class FedSegAPI:
 
                 def loss_fn(p):
                     logits = model.apply({"params": p}, x)
-                    ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
-                    return (ce * cw[y]).mean()
+                    y_safe, valid = _masked(y)
+                    ce = optax.softmax_cross_entropy_with_integer_labels(logits, y_safe)
+                    w = cw[y_safe] if valid is None else cw[y_safe] * valid
+                    denom = ce.size if valid is None else jnp.maximum(valid.sum(), 1.0)
+                    return (ce * w).sum() / denom
 
                 loss, grads = jax.value_and_grad(loss_fn)(params)
                 updates, opt_state = tx.update(grads, opt_state, params)
@@ -157,7 +176,14 @@ class FedSegAPI:
 
         def evaluate(params, xs, ys):
             logits = model.apply({"params": params}, xs)
-            loss = optax.softmax_cross_entropy_with_integer_labels(logits, ys).mean()
+            y_safe, valid = _masked(ys)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y_safe)
+            if valid is None:
+                loss = ce.mean()
+            else:
+                loss = (ce * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+            # cm: ignored gt pixels one_hot to all-zero rows -> contribute
+            # nothing (ys passed RAW, not y_safe, exactly for that)
             return _confusion_matrix(jnp.argmax(logits, -1), ys, num_classes), loss
 
         self._evaluate = jax.jit(evaluate)

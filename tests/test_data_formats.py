@@ -941,3 +941,70 @@ def test_pascal_voc_fedseg_end_to_end(tmp_path):
     sim = SimulatorSingleProcess(args, device, dataset, model)
     metrics = sim.run()
     assert "mIoU" in metrics and np.isfinite(metrics["test_loss"])
+
+
+# --- cityscapes segmentation (FedSeg) ---------------------------------------
+
+
+def _write_cityscapes(tmp_path, cities=("aachen", "bochum"), per_city=3, hw=40):
+    """Cityscapes drop in the reference fedcv example's layout:
+    leftImg8bit/{split}/{city}/<id>_leftImg8bit.png +
+    gtFine/{split}/{city}/<id>_gtFine_labelIds.png."""
+    from PIL import Image
+
+    root = tmp_path / "cityscapes"
+    rng = np.random.default_rng(5)
+    for split, n in (("train", per_city), ("val", 1)):
+        for city in cities:
+            (root / "leftImg8bit" / split / city).mkdir(parents=True, exist_ok=True)
+            (root / "gtFine" / split / city).mkdir(parents=True, exist_ok=True)
+            for i in range(n):
+                stem = f"{city}_{i:06d}_000019"
+                arr = rng.integers(0, 255, (hw, hw, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(
+                    root / "leftImg8bit" / split / city / f"{stem}_leftImg8bit.png")
+                mask = np.zeros((hw, hw), np.uint8)  # labelId 0 -> void (255)
+                mask[4:20, 4:20] = 7   # road -> trainId 0
+                mask[22:36, 22:36] = 26  # car -> trainId 13
+                Image.fromarray(mask).save(
+                    root / "gtFine" / split / city / f"{stem}_gtFine_labelIds.png")
+    return root
+
+
+def test_cityscapes_parser_city_clients_and_trainid_mapping(tmp_path):
+    from fedml_tpu.data.formats import load_cityscapes_dir
+
+    _write_cityscapes(tmp_path)
+    assert detect_format_files("cityscapes", str(tmp_path)) == "cityscapes"
+    train, test, classes = load_cityscapes_dir(str(tmp_path / "cityscapes"))
+    assert classes == 19
+    assert set(train) == {"aachen", "bochum"}  # cities ARE the clients
+    for x, y in train.values():
+        assert x.shape == (3, 64, 64, 3) and x.dtype == np.float32
+        # labelIds mapped to trainIds; unlabeled -> 255 (void)
+        assert set(np.unique(y)) <= {0, 13, 255}
+    # val images split round-robin across the city clients
+    assert sum(len(x) for x, _ in test.values()) == 2
+
+
+def test_cityscapes_fedseg_end_to_end_with_void_masking(tmp_path):
+    """Real files -> 19-class unet -> one FedSeg round with the void label
+    masked out of the loss (finite loss despite 255s in every mask)."""
+    import fedml_tpu as fedml
+    from fedml_tpu.arguments import default_config
+    from fedml_tpu.simulation.simulator import SimulatorSingleProcess
+
+    _write_cityscapes(tmp_path)
+    args = fedml.init(default_config(
+        "simulation", dataset="cityscapes", model="unet",
+        federated_optimizer="FedSeg", client_num_in_total=2,
+        client_num_per_round=2, comm_round=1, epochs=1, batch_size=3,
+        data_cache_dir=str(tmp_path), random_seed=0,
+    ))
+    device = fedml.device.get_device(args)
+    dataset, output_dim = fedml.data.load(args)
+    assert output_dim == 19 and args.seg_ignore_label == 255
+    model = fedml.model.create(args, output_dim)
+    sim = SimulatorSingleProcess(args, device, dataset, model)
+    metrics = sim.run()
+    assert "mIoU" in metrics and np.isfinite(metrics["test_loss"])

@@ -384,3 +384,8 @@ def test_cluster_register_reaches_mqtt_launch(tmp_path, monkeypatch):
     statuses = api.launch_job(str(job_yaml), num_edges=2, backend="mqtt", timeout_s=120)
     assert set(statuses) == {0, 1}
     assert all(st.status == "FINISHED" for st in statuses.values())
+    # the journal mirror was released at run end: both planes see the
+    # slots free again (a concurrent local launch during the run would
+    # have seen them DEBITED — the cross-plane double-book guard)
+    caps = mgr.cluster.capacities()
+    assert caps[0].slots_available == 1 and caps[1].slots_available == 1
