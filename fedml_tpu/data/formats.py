@@ -302,6 +302,11 @@ def detect_format_files(dataset: str, cache: str) -> Optional[str]:
             and (os.path.isdir(os.path.join(d, "gtFine"))
                  or os.path.isdir(os.path.join(d, "gtCoarse")))
         ),
+        "coco_seg": lambda: any(
+            os.path.exists(os.path.join(d, y, "annotations", f"instances_train{y}.json"))
+            and os.path.isdir(os.path.join(d, y, f"train{y}"))
+            for y in ("2017", "2014")
+        ),
     }
     fn = checks.get(dataset)
     try:
@@ -349,6 +354,11 @@ def load_native_format(dataset: str, cache: str, client_num: Optional[int] = Non
         gt = "gtFine" if os.path.isdir(os.path.join(d, "gtFine")) else "gtCoarse"
         train, test, classes = load_cityscapes_dir(d, n_clients=client_num,
                                                    annotation_type=gt)
+    elif dataset == "coco_seg":
+        train, test, classes = load_coco_seg_dir(
+            d, n_clients=client_num,
+            alpha=partition_alpha if partition_alpha is not None else 0.5,
+            seed=seed)
     else:
         raise ValueError(f"no native-format loader for {dataset!r}")
     log.info("dataset %s: loaded NATIVE format files from %s (%d clients)", dataset, d, len(train))
@@ -784,10 +794,6 @@ def load_pascal_voc_dir(root: str, n_clients: Optional[int] = None,
     import scipy.io as sio
     from PIL import Image
 
-    from ..core.data.noniid_partition import (
-        non_iid_partition_with_dirichlet_distribution,
-    )
-
     base = os.path.join(root, "dataset")
 
     def read_ids(name: str) -> List[str]:
@@ -829,14 +835,33 @@ def load_pascal_voc_dir(root: str, n_clients: Optional[int] = None,
         raise ValueError(f"{base}: train.txt is missing or empty")
     x_tr, y_tr, cats_tr = load_split(train_ids)
     val_ids = read_ids("val")
+    x_te = y_te = None
     if val_ids:
         x_te, y_te, _ = load_split(val_ids)
-    else:
-        # hold out a tail of train for eval (shared across clients)
+    train, test = _dirichlet_seg_federation(
+        x_tr, y_tr, cats_tr, x_te, y_te, n_clients,
+        PASCAL_VOC_CLASSES, alpha, seed, "pascal_voc")
+    return train, test, PASCAL_VOC_CLASSES
+
+
+def _dirichlet_seg_federation(x_tr, y_tr, cats_tr, x_te, y_te,
+                              n_clients: Optional[int], classes: int,
+                              alpha: float, seed: int, dataset: str):
+    """Shared federation tail for seg drops with no natural users
+    (pascal_voc, coco_seg): optional tail holdout when no val split exists,
+    Dirichlet(alpha) over first-present category, and a val split
+    PARTITIONED round-robin — handing every client the full val set would
+    replicate it client_num times in memory and inflate the global test
+    count by the same factor."""
+    from ..core.data.noniid_partition import (
+        non_iid_partition_with_dirichlet_distribution,
+    )
+
+    if x_te is None:
+        # hold out a tail of train for eval
         n_te = max(1, len(x_tr) // 10)
         x_te, y_te = x_tr[-n_te:], y_tr[-n_te:]
         x_tr, y_tr, cats_tr = x_tr[:-n_te], y_tr[:-n_te], cats_tr[:-n_te]
-
     n = n_clients or 4
     if n > len(x_tr):
         # surfaced here (not after a wasted full parse + partition): the
@@ -846,23 +871,20 @@ def load_pascal_voc_dir(root: str, n_clients: Optional[int] = None,
             f"client_num_in_total={n} exceeds the drop's {len(x_tr)} train "
             "images; every client needs at least one image")
     net_map = non_iid_partition_with_dirichlet_distribution(
-        cats_tr, n, PASCAL_VOC_CLASSES, alpha, seed)
+        cats_tr, n, classes, alpha, seed)
     train: ClientData = {}
     test: ClientData = {}
     for cid, idx in net_map.items():
         idx = np.asarray(idx, np.int64)
         train[f"client_{cid:03d}"] = (x_tr[idx], y_tr[idx])
-        # val is PARTITIONED round-robin, not duplicated: handing every
-        # client the full val set would replicate it client_num times in
-        # memory and inflate the global test count by the same factor
         te_idx = np.arange(cid, len(x_te), n)
         if not len(te_idx):
             te_idx = np.asarray([cid % len(x_te)])
         test[f"client_{cid:03d}"] = (x_te[te_idx], y_te[te_idx])
-    log.info("dataset pascal_voc: %d train / %d eval images -> %d clients "
+    log.info("dataset %s: %d train / %d eval images -> %d clients "
              "(dirichlet alpha=%.2f over first-category)",
-             len(x_tr), len(x_te), len(train), alpha)
-    return train, test, PASCAL_VOC_CLASSES
+             dataset, len(x_tr), len(x_te), len(train), alpha)
+    return train, test
 
 
 # --- Cityscapes segmentation (FedSeg family) ---------------------------------
@@ -957,3 +979,134 @@ def load_cityscapes_dir(root: str, n_clients: Optional[int] = None,
     log.info("dataset cityscapes: %d cities (natural clients), %d train images",
              len(train), sum(len(x) for x, _ in train.values()))
     return train, test, CITYSCAPES_CLASSES
+
+
+# --- COCO segmentation (FedSeg family) ---------------------------------------
+
+# the reference's 20 VOC-style category names selected from COCO
+# (fedcv coco/segmentation/dataset.py:58-80); class index = position + 1,
+# background = 0. (The reference indexes classes AT position — making
+# "airplane" collide with background; that is an evident off-by-one in its
+# mask builder, not a semantic to reproduce.)
+COCO_SEG_CATEGORIES = [
+    "airplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "dining table", "dog", "horse", "motorcycle", "person",
+    "potted plant", "sheep", "sofa", "tv", "train",
+]
+# official COCO names that differ from the VOC-style list: a real
+# instances json says "couch", never "sofa" (the reference's getCatIds
+# silently drops the class for the same reason — not a semantic to keep)
+_COCO_NAME_ALIASES = {"couch": "sofa"}
+COCO_SEG_CLASSES = len(COCO_SEG_CATEGORIES) + 1  # + background
+
+
+def load_coco_seg_dir(root: str, n_clients: Optional[int] = None,
+                      image_hw: int = 64, year: Optional[str] = None,
+                      alpha: float = 0.5, seed: int = 0,
+                      min_mask_pixels: int = 1000,
+                      ) -> Tuple[ClientData, ClientData, int]:
+    """COCO-instances layout as the reference's fedcv example consumes it
+    (``fedcv/image_segmentation/data/coco/coco_base.py:38-62`` paths,
+    ``segmentation/dataset.py:96-165`` mask building):
+
+        {root}/{year}/annotations/instances_{split}{year}.json
+        {root}/{year}/{split}{year}/*.jpg
+
+    Masks are rasterized NATIVELY from the polygon annotations (PIL
+    ImageDraw — no pycocotools dependency): first annotation wins where
+    regions overlap (the reference's ``mask == 0`` guard), crowd/RLE
+    annotations are skipped (logged; pycocotools-only format). Images are
+    kept when their native-resolution mask covers > ``min_mask_pixels``
+    (the reference's qualification rule), then resized (mask NEAREST).
+    Partition: Dirichlet(alpha) over each image's first present category,
+    like pascal_voc (COCO has no natural users)."""
+    import json as _json
+
+    from PIL import Image, ImageDraw
+
+    if year is None:
+        # same predicate as detection: the year must actually hold the
+        # instances json + image dir (a stray empty 2017/ next to a valid
+        # 2014 drop must not win)
+        year = next((y for y in ("2017", "2014")
+                     if os.path.exists(os.path.join(
+                         root, y, "annotations", f"instances_train{y}.json"))
+                     and os.path.isdir(os.path.join(root, y, f"train{y}"))),
+                    "2017")
+    base = os.path.join(root, year)
+
+    def load_split(split: str):
+        inst = os.path.join(base, "annotations", f"instances_{split}{year}.json")
+        img_dir = os.path.join(base, f"{split}{year}")
+        if not os.path.exists(inst):
+            return None
+        with open(inst) as f:
+            doc = _json.load(f)
+        name_to_class = {}
+        for cat in doc.get("categories", []):
+            name = _COCO_NAME_ALIASES.get(cat["name"], cat["name"])
+            if name in COCO_SEG_CATEGORIES:
+                name_to_class[cat["id"]] = COCO_SEG_CATEGORIES.index(name) + 1
+        anns_by_img: Dict[int, list] = {}
+        n_crowd = 0
+        for ann in doc.get("annotations", []):
+            if ann.get("category_id") not in name_to_class:
+                continue
+            if ann.get("iscrowd"):
+                n_crowd += 1
+                continue
+            anns_by_img.setdefault(int(ann["image_id"]), []).append(ann)
+        if n_crowd:
+            log.info("dataset coco_seg %s: skipped %d crowd (RLE) annotations",
+                     split, n_crowd)
+        xs, ys, first_cat = [], [], []
+        for meta in doc.get("images", []):
+            anns = anns_by_img.get(int(meta["id"]))
+            if not anns:
+                continue
+            h, w = int(meta["height"]), int(meta["width"])
+            mask = np.zeros((h, w), np.uint8)
+            for ann in anns:
+                c = name_to_class[ann["category_id"]]
+                layer = Image.new("L", (w, h), 0)
+                drawer = ImageDraw.Draw(layer)
+                segs = ann.get("segmentation") or []
+                if not isinstance(segs, list):
+                    continue  # RLE dict without iscrowd: not representable
+                for poly in segs:
+                    if len(poly) >= 6:
+                        drawer.polygon(list(map(float, poly)), fill=1)
+                m = np.asarray(layer, np.uint8)
+                mask = np.where((mask == 0) & (m > 0), np.uint8(c), mask)
+            if int((mask > 0).sum()) <= min_mask_pixels:
+                continue  # reference __preprocess qualification
+            img_p = os.path.join(img_dir, meta["file_name"])
+            if not os.path.exists(img_p):
+                continue
+            img = Image.open(img_p).convert("RGB").resize(
+                (image_hw, image_hw), Image.BILINEAR)
+            mask_small = np.asarray(Image.fromarray(mask).resize(
+                (image_hw, image_hw), Image.NEAREST))
+            xs.append(np.asarray(img, np.float32) / 255.0)
+            ys.append(mask_small.astype(np.int32))
+            cats = np.unique(mask)
+            cats = cats[cats > 0]
+            first_cat.append(int(cats[0]) if len(cats) else 0)
+        if not xs:
+            return None
+        return np.stack(xs), np.stack(ys), np.asarray(first_cat, np.int64)
+
+    loaded = load_split("train")
+    if loaded is None:
+        raise ValueError(
+            f"{base}: no qualifying train images (need instances_train{year}"
+            f".json + train{year}/ jpgs with > {min_mask_pixels} mask pixels)")
+    x_tr, y_tr, cats_tr = loaded
+    val = load_split("val")
+    x_te = y_te = None
+    if val is not None:
+        x_te, y_te, _ = val
+    train, test = _dirichlet_seg_federation(
+        x_tr, y_tr, cats_tr, x_te, y_te, n_clients,
+        COCO_SEG_CLASSES, alpha, seed, "coco_seg")
+    return train, test, COCO_SEG_CLASSES
