@@ -1,10 +1,12 @@
 """`fedml_tpu` CLI.
 
 Reference: ``python/fedml/cli/cli.py:11-77`` — a click group whose
-subcommands call only the api layer. Cloud-bound subcommands (login, cluster
-marketplace, storage) exist with an explicit offline message instead of a
-broken half-implementation: this environment has zero egress, and the local
-scheduler covers the launch/run/build/logs paths end-to-end.
+subcommands call only the api layer. Cloud-bound subcommands (login,
+storage, the cluster marketplace LIFECYCLE verbs) exist with an explicit
+offline message instead of a broken half-implementation: this environment
+has zero egress. The local scheduler covers launch/run/build/logs
+end-to-end, and ``cluster register/list/status`` act on the real local
+capacity inventory the launch matcher consumes.
 
 Invoke as ``python -m fedml_tpu.cli <cmd>`` (or the console script when the
 package is installed).
