@@ -832,14 +832,16 @@ def _last_measured() -> dict | None:
         return None
 
 
-_GIT_HEAD_CACHE: list = []
+_GIT_HEAD_CACHE: dict = {}
 
 
 def _git_head() -> str | None:
-    """Short HEAD for artifact provenance, resolved once per process — the
-    code that produced a run's numbers is the checkout at start, even if a
-    commit lands mid-run."""
-    if not _GIT_HEAD_CACHE:
+    """Short HEAD for artifact provenance, resolved once per repo per
+    process — the code that produced a run's numbers is the checkout at
+    start, even if a commit lands mid-run. Keyed by _REPO (the test seam
+    monkeypatches it); a transient git failure is NOT cached, so a later
+    write in the same run can still recover provenance."""
+    if _REPO not in _GIT_HEAD_CACHE:
         try:
             head = subprocess.run(
                 ["git", "-C", _REPO, "rev-parse", "--short", "HEAD"],
@@ -847,8 +849,10 @@ def _git_head() -> str | None:
             ).stdout.strip() or None
         except Exception:
             head = None
-        _GIT_HEAD_CACHE.append(head)
-    return _GIT_HEAD_CACHE[0]
+        if head is None:
+            return None
+        _GIT_HEAD_CACHE[_REPO] = head
+    return _GIT_HEAD_CACHE[_REPO]
 
 
 def _write_measured_artifact(out: dict, stamp: str) -> str:

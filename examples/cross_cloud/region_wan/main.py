@@ -15,9 +15,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), *[".."] * 3))
 
 import numpy as np
 
-from fedml_tpu.core.distributed.communication.mqtt_s3.object_store import (
-    LocalObjectStore,
-)
 from fedml_tpu.cross_cloud import apply_region_config, wan_transfer_for
 
 HERE = os.path.dirname(os.path.abspath(__file__))
@@ -72,7 +69,8 @@ def main():
     try:
         xfer.upload(ckpt, "round7/adapters")
     except ConnectionError:
-        print(f"link dropped after {xfer.store.writes} uploads (journal keeps the progress)")
+        shipped = xfer.store.writes - 1  # the last attempt raised, not shipped
+        print(f"link dropped after {shipped} uploads (journal keeps the progress)")
 
     # retry on a healthy link: resumes, doesn't restart
     xfer.store = FlakyLink(healthy_store, fail_after=10**9)

@@ -85,16 +85,23 @@ def job_stop(run_id: str) -> None:
 # and remain a documented scope cut (README).
 
 def cluster_register(edge_id: int, slots: int, cores: Optional[int] = None,
-                     memory_mb: int = 0, accelerator_kind: str = "") -> None:
+                     memory_mb: int = 0, accelerator_kind: str = "",
+                     reset: bool = False) -> None:
     """Declare an agent's capacity to the launch matcher (the reference
     agent auto-reports this on check-in; a local/test topology sets it
-    explicitly)."""
+    explicitly). Re-registration preserves in-flight debits; ``reset=True``
+    forces availability back to ``slots`` — the operator's escape hatch
+    when a held debit outlived its job (e.g. an MQTT launch that timed out
+    and tore down before the job's terminal status could be observed)."""
     from ..computing.scheduler.cluster import EdgeCapacity
 
-    _launch_manager().cluster.register(EdgeCapacity(
+    cluster = _launch_manager().cluster
+    cluster.register(EdgeCapacity(
         edge_id=edge_id, cores=cores if cores is not None else (os.cpu_count() or 1),
         memory_mb=memory_mb, slots_total=slots, slots_available=slots,
         accelerator_kind=accelerator_kind))
+    if reset:
+        cluster._db.set_slots_available(edge_id, slots)
 
 
 def cluster_list() -> Dict[int, Any]:

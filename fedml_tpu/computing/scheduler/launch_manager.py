@@ -202,6 +202,23 @@ def launch_job_over_mqtt(
             except Exception:
                 journal_debit = {}  # raced a local launch; skip the mirror
         raw = server.wait_for_run(run_id, timeout_s=timeout_s)
+        if registry is not None and journal_debit:
+            from .agents import TERMINAL
+
+            # a TIMEOUT edge's job is still physically running (runner jobs
+            # are durable; agent teardown below only drops the transport) —
+            # releasing its journal slots would let a concurrent local
+            # launch double-book the accelerator. They stay held.
+            kept = {e: n for e, n in journal_debit.items()
+                    if raw.get(e, {}).get("status") not in TERMINAL}
+            for e in kept:
+                journal_debit.pop(e)
+            if kept:
+                log.warning(
+                    "mqtt launch hit its wait timeout with jobs still "
+                    "running on edges %s; their journal slots remain held — "
+                    "api.cluster_register(..., reset=True) reclaims them "
+                    "once the jobs actually end", sorted(kept))
         return {
             eid: RunStatus(
                 run_id=str(doc.get("run_id", run_id)),

@@ -218,9 +218,12 @@ class MqttServerAgent:
                     # a mid-run re-announce (agent daemon OTA re-exec while
                     # its job keeps running) must not discard in-flight
                     # debits — same invariant ClusterRegistry enforces on
-                    # the journal plane
-                    outstanding = sum(a.get(eid, 0)
-                                      for a in self.run_assignment.values())
+                    # the journal plane. Only LIVE debits count: retained
+                    # records of completed runs must not strand capacity
+                    outstanding = sum(
+                        n for run, a in self.run_assignment.items()
+                        for e, n in a.items()
+                        if e == eid and self._debited.get((run, e), False))
                     new.slots_available = max(0, new.slots_total - outstanding)
                     self.capacity[eid] = new
             else:
@@ -297,21 +300,6 @@ class MqttServerAgent:
                     self.capacity[eid].slots_available -= n
                     self._debited[(run_id, eid)] = True
                 self.run_assignment[run_id] = assignment
-                # evict the OLDEST fully-credited runs past the retention
-                # cap (a run with a live debit is never evicted — that
-                # would leak the slot)
-                while len(self.run_assignment) > self._RUN_RETENTION:
-                    for old in list(self.run_assignment):
-                        if old == run_id:
-                            continue
-                        if not any(self._debited.get((old, e), False)
-                                   for e in self.run_assignment[old]):
-                            for e in self.run_assignment.pop(old):
-                                self._debited.pop((old, e), None)
-                            self.run_edges.pop(old, None)
-                            break
-                    else:
-                        break  # every older run still holds a debit
             targets = sorted(assignment)
             request["scheduler_info"] = {
                 "master_node_addr": "localhost",
@@ -320,6 +308,21 @@ class MqttServerAgent:
                 "matched_slots": {str(e): n for e, n in assignment.items()},
             }
         self.run_edges[run_id] = targets
+        # evict the OLDEST retained runs past the cap — run_edges is the
+        # superset (every dispatch adds one, slot ask or not); a run with a
+        # live debit is never evicted (that would leak the slot)
+        while len(self.run_edges) > self._RUN_RETENTION:
+            for old in list(self.run_edges):
+                if old == run_id:
+                    continue
+                if not any(self._debited.get((old, e), False)
+                           for e in self.run_assignment.get(old, {})):
+                    for e in self.run_assignment.pop(old, {}):
+                        self._debited.pop((old, e), None)
+                    self.run_edges.pop(old, None)
+                    break
+            else:
+                break  # every older run still holds a debit
         shipped: set = set()
         try:
             for eid in targets:

@@ -356,6 +356,35 @@ def test_elastic_restart_redebits_credited_slot():
         server.stop()
 
 
+def test_reannounce_after_completed_runs_does_not_strand_capacity():
+    """Retained bookkeeping of COMPLETED runs must not count as outstanding
+    when an agent re-announces — only LIVE debits reduce the refreshed
+    availability (code-review r5: an idle edge was stranded at 0 slots)."""
+    from fedml_tpu.computing.scheduler.cluster import EdgeCapacity
+
+    server = MqttServerAgent([0])
+    try:
+        server.capacity[0] = EdgeCapacity(
+            edge_id=0, cores=4, memory_mb=0, slots_total=1, slots_available=1)
+        # a matched run that already completed (record retained, debit off)
+        server.run_assignment["done1"] = {0: 1}
+        server._debited[("done1", 0)] = False
+        server._on_status("", json.dumps({
+            "type": "agent_online", "edge_id": 0, "version": "1", "pid": 1,
+            "capacity": {"edge_id": 0, "cores": 4, "memory_mb": 0,
+                         "slots_total": 1, "slots_available": 1}}).encode())
+        assert server.capacity[0].slots_available == 1  # not stranded
+        # but a LIVE debit still holds through the re-announce
+        server._debited[("done1", 0)] = True
+        server._on_status("", json.dumps({
+            "type": "agent_online", "edge_id": 0, "version": "1", "pid": 1,
+            "capacity": {"edge_id": 0, "cores": 4, "memory_mb": 0,
+                         "slots_total": 1, "slots_available": 1}}).encode())
+        assert server.capacity[0].slots_available == 0
+    finally:
+        server.stop()
+
+
 def test_cluster_register_reaches_mqtt_launch(tmp_path, monkeypatch):
     """The CLI/api journal registration feeds the MQTT plane too: agents
     announce the registered slots on check-in, so `launch --backend mqtt`
