@@ -481,13 +481,37 @@ def _bench_llm_decode_tpu(reps: int = 4, weight_quant: str = "none"):
         jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, P)).astype(np.int32))
         for _ in range(reps + 1)
     ]
-    # warmup compiles prefill + the shared decode scan
-    jax.block_until_ready(generate(params, cfg, prompts[0], new))
+    # warmup compiles prefill + the shared decode scan; the trailing scalar
+    # fetch forces the warmup to actually complete (see the module header:
+    # axon's block_until_ready returns before remote execution)
+    int(np.asarray(generate(params, cfg, prompts[0], new)[-1, -1]))
     t0 = time.perf_counter()
     outs = [generate(params, cfg, p, new) for p in prompts[1:]]
-    jax.block_until_ready(outs)
+    # completion is forced the same way the train stages do it — a 4-byte
+    # fetch that depends on every full output. block_until_ready alone
+    # measured DISPATCH on this backend (the r5 full ladder printed a
+    # physically impossible 370k tok/s before this fetch existed). ONE
+    # combined fetch, not one per rep: sequential per-rep fetches would pay
+    # reps tunnel round-trips inside the timed window and deflate the rate.
+    int(np.asarray(sum(o[-1, -1] for o in outs)))
     dt = time.perf_counter() - t0
-    return {"decode_tokens_per_sec": bs * new * reps / dt, "bs": bs, "new": new,
+    rate = bs * new * reps / dt
+    # integrity guard, mirroring the train stages' MFU<1 refusal: decode is
+    # weight-traffic bound — every decode step must stream the full param
+    # set from HBM, so steps/s * param_bytes cannot exceed HBM bandwidth.
+    # Allow 3x the v5e ~819 GB/s spec for headroom/other chips; beyond that
+    # the number is a measurement artifact, not a throughput.
+    param_bytes = sum(
+        x.nbytes for x in jax.tree_util.tree_leaves(params) if hasattr(x, "nbytes")
+    )
+    implied_bw = (rate / bs) * param_bytes
+    if implied_bw > 3 * 819e9:
+        raise BenchIntegrityError(
+            f"decode rate {rate:.0f} tok/s implies {implied_bw / 1e12:.1f} TB/s "
+            f"of weight traffic (params {param_bytes / 1e9:.2f} GB) — "
+            "physically impossible; the timing did not capture execution"
+        )
+    return {"decode_tokens_per_sec": rate, "bs": bs, "new": new,
             "weight_quant": weight_quant}
 
 
@@ -966,6 +990,24 @@ def _retry_transient(fn, *args, **kw):
     except Exception as e:
         print(f"warning: {getattr(fn, '__name__', fn)} failed ({e!r}); "
               "retrying same config once", file=sys.stderr)
+        # RESOURCE_EXHAUSTED right at a stage's FIRST allocation is the
+        # predecessor stage's HBM not yet reaped by the remote allocator
+        # (r5 full ladder: llm_xla died at PRNGKey seconds after llm_pallas
+        # exited, then its immediate retry died identically). Give the
+        # remote side time to free before the one retry — but sleep OUTSIDE
+        # this except block: the live traceback pins the failed attempt's
+        # own device buffers, and those must be released BEFORE the wait or
+        # an own-allocation OOM gets no reap time at all. The sleep also
+        # fires for deterministic own-allocation OOMs, where it wastes
+        # 45s + one doomed retry before the caller's fallback — accepted:
+        # the cases aren't mechanically distinguishable here, the cost is
+        # bounded, and the only downstream timing gate it can push past
+        # (the bs=2x probe's 600s cutoff) guards a strictly additive probe.
+        oom = "RESOURCE_EXHAUSTED" in repr(e) or "ResourceExhausted" in repr(e)
+    if oom:
+        print("note: resource-exhausted; sleeping 45s for the device "
+              "allocator to reap freed buffers", file=sys.stderr)
+        time.sleep(45)
     return fn(*args, **kw)
 
 
@@ -1048,18 +1090,27 @@ def _run_stage(name: str) -> None:
                 print(f"note: bs=2x probe failed ({e3!r}); keeping bs=1x headline",
                       file=sys.stderr)
     elif name == "llm_xla":
-        try:
-            out = _retry_transient(_bench_llm_tpu, reps=6, attention_impl="xla", remat=False)
-            out["remat"] = False
-        except BenchIntegrityError:
-            raise
-        except Exception as e:  # noqa: BLE001 - the einsum path keeps [T,T]
-            # score tensors for the backward, so no-remat can OOM where the
-            # flash run fit
-            print(f"warning: xla-attention bench failed ({e!r}); retrying with remat",
-                  file=sys.stderr)
-            out = _bench_llm_tpu(reps=6, attention_impl="xla", remat=True)
-            out["remat"] = True
+        # remat is the PRIMARY config here: the einsum path materializes
+        # [T,T] score tensors fwd AND saved-for-bwd (~256MB/layer at the
+        # headline geometry), which deterministically OOMed a 16GB v5e at
+        # warmup (measured 2026-08-01) — and the failed attempt's buffers
+        # then starved every later attempt in the same process, including
+        # the remat fallback that fits. The flash/pallas headline runs the
+        # same geometry WITHOUT remat; that asymmetry is part of the result
+        # (recorded via the remat field) — flash attention's whole point is
+        # not materializing scores.
+        out = _retry_transient(_bench_llm_tpu, reps=6, attention_impl="xla",
+                               remat=True)
+        out["remat"] = True
+        # record the measured OOM fact only for the geometry AND device it
+        # was actually observed at — a tiny dry-run, a future flagship-shape
+        # change, or a bigger-HBM chip must not emit an artifact asserting a
+        # measurement this run never made
+        if (out.get("shape", {}).get("bs") == _LLM_SHAPE["bs"]
+                and out.get("shape", {}).get("seq") == _LLM_SHAPE["seq"]
+                and "v5 lite" in str(out.get("device", ""))):
+            out["no_remat_oom"] = ("einsum attention at bs8/seq1024 OOMs "
+                                   "16GB v5e without remat (measured 2026-08-01)")
     elif name == "decode":
         out = _retry_transient(_bench_llm_decode_tpu)
     elif name == "decode_int8":
