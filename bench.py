@@ -496,14 +496,21 @@ def _bench_llm_decode_tpu(reps: int = 4, weight_quant: str = "none"):
     int(np.asarray(sum(o[-1, -1] for o in outs)))
     dt = time.perf_counter() - t0
     rate = bs * new * reps / dt
-    # integrity guard, mirroring the train stages' MFU<1 refusal: decode is
-    # weight-traffic bound — every decode step must stream the full param
-    # set from HBM, so steps/s * param_bytes cannot exceed HBM bandwidth.
-    # Allow 3x the v5e ~819 GB/s spec for headroom/other chips; beyond that
-    # the number is a measurement artifact, not a throughput.
     param_bytes = sum(
         x.nbytes for x in jax.tree_util.tree_leaves(params) if hasattr(x, "nbytes")
     )
+    _check_decode_bandwidth(rate, bs, param_bytes)
+    return {"decode_tokens_per_sec": rate, "bs": bs, "new": new,
+            "weight_quant": weight_quant}
+
+
+def _check_decode_bandwidth(rate: float, bs: int, param_bytes: int) -> None:
+    """Integrity guard, mirroring the train stages' MFU<1 refusal: decode is
+    weight-traffic bound — every decode step must stream the full param set
+    from HBM, so steps/s * param_bytes cannot exceed HBM bandwidth. Allow 3x
+    the v5e ~819 GB/s spec for headroom/other chips; beyond that the number
+    is a measurement artifact (the r5 ladder published 370k tok/s when the
+    timing captured only dispatch), not a throughput."""
     implied_bw = (rate / bs) * param_bytes
     if implied_bw > 3 * 819e9:
         raise BenchIntegrityError(
@@ -511,8 +518,6 @@ def _bench_llm_decode_tpu(reps: int = 4, weight_quant: str = "none"):
             f"of weight traffic (params {param_bytes / 1e9:.2f} GB) — "
             "physically impossible; the timing did not capture execution"
         )
-    return {"decode_tokens_per_sec": rate, "bs": bs, "new": new,
-            "weight_quant": weight_quant}
 
 
 def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: int = 3):

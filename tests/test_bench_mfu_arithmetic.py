@@ -172,3 +172,44 @@ def test_mfu_guard_rejects_impossible_rates():
     with pytest.raises(bench.BenchIntegrityError):
         bench._check_mfu("llm", -0.1)
     bench._check_mfu("llm", 0.4)  # plausible: no raise
+
+
+def test_decode_bandwidth_guard_rejects_dispatch_artifacts():
+    """The r5 full ladder published 370k decode tok/s when block_until_ready
+    captured only dispatch (this backend completes remotely). The guard must
+    reject that measured artifact and accept the honest re-measurement."""
+    params_bytes_268m_bf16 = 267_944_960 * 2
+    # the actual bogus number from BENCH_MEASURED_20260801T083607Z (pre-fix)
+    with pytest.raises(bench.BenchIntegrityError):
+        bench._check_decode_bandwidth(369_724.7, bs=4, param_bytes=params_bytes_268m_bf16)
+    # the honest post-fix measurements pass
+    bench._check_decode_bandwidth(798.3, bs=4, param_bytes=params_bytes_268m_bf16)
+    bench._check_decode_bandwidth(883.3, bs=4, param_bytes=params_bytes_268m_bf16 // 2)
+
+
+def test_no_remat_oom_stamp_gated_on_flagship_geometry_and_device(monkeypatch):
+    """A tiny dry-run or a bigger-HBM chip must not emit an artifact
+    asserting the 16GB-v5e OOM this run never measured (r5 review)."""
+    calls = {}
+
+    def fake_bench(reps, attention_impl, remat):
+        return dict(calls["out"])
+
+    monkeypatch.setattr(bench, "_bench_llm_tpu", fake_bench)
+    printed = []
+    monkeypatch.setattr(
+        "builtins.print", lambda *a, **k: printed.append(a[0] if a else ""))
+
+    def run(shape, device):
+        calls["out"] = {"tokens_per_sec": 1.0, "mfu": 0.1, "shape": shape,
+                        "device": device, "attention_impl": "xla"}
+        printed.clear()
+        bench._run_stage("llm_xla")
+        import json as _json
+        return _json.loads(printed[-1])
+
+    flagship = {"bs": 8, "seq": 1024}
+    tiny = {"bs": 2, "seq": 128}
+    assert "no_remat_oom" in run(flagship, "TPU v5 lite")
+    assert "no_remat_oom" not in run(tiny, "cpu")
+    assert "no_remat_oom" not in run(flagship, "TPU v4")
