@@ -69,6 +69,34 @@ NEG_INF = -1e30
 # verdict via this env var (ADVICE r3: narrow has never met real Mosaic).
 _WIDE_STATS_ENV = "FEDML_FLASH_WIDE_STATS"
 
+# Block-size overrides (FEDML_FLASH_BLOCK_Q / FEDML_FLASH_BLOCK_K): the
+# bench's attention microbench sweeps configs on the live chip and records
+# the fastest to .bench_runtime/flash_blocks; the headline stage exports
+# these vars so the next window's train step runs the tuned kernel. Callers
+# passing explicit block sizes are never overridden. Invalid values (not a
+# positive multiple of the Mosaic tile granularity: 8 sublanes for block_q,
+# 128 lanes for block_k) are ignored with a warning rather than crashing a
+# training run over a bad env var.
+_BLOCK_Q_ENV = "FEDML_FLASH_BLOCK_Q"
+_BLOCK_K_ENV = "FEDML_FLASH_BLOCK_K"
+
+
+def _env_block(name: str, default: int, multiple: int) -> int:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        val = -1
+    if val <= 0 or val % multiple:
+        import warnings
+
+        warnings.warn(f"{name}={raw!r} is not a positive multiple of "
+                      f"{multiple}; using default {default}")
+        return default
+    return val
+
 
 def _stats_lanes(block_k: int) -> int:
     if os.environ.get(_WIDE_STATS_ENV) == "1" and block_k % 128 == 0:
@@ -76,12 +104,29 @@ def _stats_lanes(block_k: int) -> int:
     return 1
 
 
-def effective_stats_mode(seq_len: int, block_q: int = 128, block_k: int = 128) -> str:
+def effective_blocks(seq_len: int, block_q: int | None = None,
+                     block_k: int | None = None) -> str:
+    """The '<bq>x<bk>' config flash_attention WILL actually run for this
+    sequence length — env-resolved defaults AND the min(block, T) clamp
+    applied, so artifact provenance records kernel truth, not the raw env
+    (a tiny-geometry run under a flagship '512 512' verdict executes
+    128x128, and must say so)."""
+    if block_q is None:
+        block_q = _env_block(_BLOCK_Q_ENV, 128, 8)
+    if block_k is None:
+        block_k = _env_block(_BLOCK_K_ENV, 128, 128)
+    return f"{min(block_q, seq_len)}x{min(block_k, seq_len)}"
+
+
+def effective_stats_mode(seq_len: int, block_k: int | None = None) -> str:
     """The stats layout flash_attention WILL actually use for these shapes —
     the bench records this (not the raw env var) so artifacts can't claim
     'wide' for a call whose effective block_k can't host 128 lanes (such a
     call takes the einsum fallback when wide mode is forced — see
-    flash_attention)."""
+    flash_attention). Only block_k matters: the stats lane count is a
+    function of the k-block width alone."""
+    if block_k is None:
+        block_k = _env_block(_BLOCK_K_ENV, 128, 128)
     bk = min(block_k, seq_len)
     if os.environ.get(_WIDE_STATS_ENV) == "1":
         return "wide" if bk % 128 == 0 else "xla-fallback"
@@ -401,13 +446,18 @@ def flash_attention(
     v: jnp.ndarray,
     *,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
 ) -> jnp.ndarray:
     """[B, T, Hq, D], [B, T, Hkv, D] x2 -> [B, T, Hq, D]. GQA-native: Hkv may
     divide Hq; K/V are consumed at their own head count (no repeat). Falls
     back to the einsum path when pallas is unavailable or shapes don't tile
-    (T % block != 0)."""
+    (T % block != 0). Block sizes default to 128/128, overridable via
+    FEDML_FLASH_BLOCK_Q/K (see _BLOCK_Q_ENV above) when not passed."""
+    if block_q is None:
+        block_q = _env_block(_BLOCK_Q_ENV, 128, 8)
+    if block_k is None:
+        block_k = _env_block(_BLOCK_K_ENV, 128, 128)
     B, T, Hq, D = q.shape
     Hkv = k.shape[2]
     if Hq % Hkv:

@@ -159,6 +159,13 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
         "decode_int8": ({"decode_tokens_per_sec": 1500.0, "bs": 4, "new": 128,
                          "weight_quant": "int8"}, None),
         "resnet": ({"steps_per_sec": 20.0, "mfu": 0.2, "bs": 128}, None),
+        "attn_micro": ({"fwd_bwd_ms": {"flash_128x128": 9.0,
+                                       "flash_256x256": 7.5,
+                                       "xla_einsum": 8.0},
+                        "best_flash": "flash_256x256",
+                        "best_vs_128x128": 1.2,
+                        "best_vs_einsum": 1.067,
+                        "recorded": "256x256"}, None),
         "memplan": ({"plan_bytes_per_device": 7_500_000_000,
                      "device_bytes_limit": 16 * 2**30,
                      "device_bytes_in_use": 0, "device_kind": "TPU v5 lite",
@@ -183,6 +190,8 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
     assert out["vs_baseline"] == 500.0  # 50000 / 100
     assert out["resnet56_vs_torch_cpu"] == 32.0  # 20*128 / 80
     assert out["endpoint_replicas"] == 2
+    assert out["attn_best_flash"] == "flash_256x256"
+    assert out["attn_best_vs_einsum"] == 1.067
     assert out["stages_failed"] == []
     # incremental artifacts landed (one per stage + final, same stamp file)
     arts = glob.glob(str(tmp_path / "BENCH_MEASURED_*.json"))
@@ -606,3 +615,24 @@ def test_main_midrun_stall_aborts_remaining_stages(monkeypatch, tmp_path, capsys
     assert out["vs_baseline"] == 500.0
     assert any("skipped (tunnel stalled mid-run)" in f for f in out["stages_failed"])
     assert not any(f.startswith("cpu_") for f in out["stages_failed"])
+
+
+def test_flash_blocks_env_honors_hash_scoped_verdict(monkeypatch, tmp_path):
+    """The attn_micro sweep's recorded block config steers later stages only
+    when it was rendered on the CURRENT kernel code (hash match)."""
+    monkeypatch.setattr(bench, "_BENCH_RUNTIME_DIR", str(tmp_path))
+    monkeypatch.setattr(bench, "_kernel_hash", lambda: "abc123")
+    # no verdict file: env passes through untouched
+    assert bench._flash_blocks_env(None) is None
+    base = {"X": "1"}
+    assert bench._flash_blocks_env(base) is base
+    # matching hash: block vars exported
+    (tmp_path / "flash_blocks").write_text("256 512 abc123")
+    env = bench._flash_blocks_env({"X": "1"})
+    assert env["FEDML_FLASH_BLOCK_Q"] == "256"
+    assert env["FEDML_FLASH_BLOCK_K"] == "512"
+    assert env["X"] == "1"
+    # stale hash: ignored
+    (tmp_path / "flash_blocks").write_text("256 512 othersha")
+    out = bench._flash_blocks_env({"X": "1"})
+    assert "FEDML_FLASH_BLOCK_Q" not in out

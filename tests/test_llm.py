@@ -152,6 +152,34 @@ class TestAttentionImpls:
             np.asarray(xla_attention(q[:, :32], kr_s, vr_s, causal=True)),
             atol=2e-5)
 
+    def test_flash_block_env_override_matches_xla(self, monkeypatch):
+        """FEDML_FLASH_BLOCK_Q/K (the attn_micro sweep's tuned-config
+        channel) resolve the default block sizes; the kernel must stay
+        numerically exact at a non-default config, and an invalid value
+        must fall back to the 128 default instead of crashing."""
+        from fedml_tpu.ops import flash_attention as fa
+
+        monkeypatch.setenv("FEDML_FLASH_BLOCK_Q", "64")
+        monkeypatch.setenv("FEDML_FLASH_BLOCK_K", "256")
+        B, T, Hq, Hkv, D = 1, 256, 4, 2, 16
+        ks = jax.random.split(jax.random.PRNGKey(21), 3)
+        q = jax.random.normal(ks[0], (B, T, Hq, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, T, Hkv, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, T, Hkv, D), jnp.float32)
+        from fedml_tpu.models.transformer import repeat_kv
+
+        kr, vr = repeat_kv(k, v, Hq)
+        ref = xla_attention(q, kr, vr, causal=True)
+        out = fa.flash_attention(q, k, v, causal=True)  # env-resolved blocks
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+        # invalid: not a multiple of the lane granularity -> default, warn
+        monkeypatch.setenv("FEDML_FLASH_BLOCK_K", "100")
+        with pytest.warns(UserWarning, match="FEDML_FLASH_BLOCK_K"):
+            assert fa._env_block(fa._BLOCK_K_ENV, 128, 128) == 128
+        # explicit caller args always win over env
+        out2 = fa.flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(ref), atol=2e-5)
+
     def test_flash_grads_match_xla(self):
         # the Pallas backward kernels (dq + dkv) against einsum autodiff,
         # causal and dense, with uneven q/k block sizes to exercise the
