@@ -1257,6 +1257,20 @@ def _run_stage(name: str) -> None:
         out = _retry_transient(_bench_resnet_tpu)
     elif name == "attn_micro":
         out = _retry_transient(_bench_attn_micro)
+    elif name == "llm_pallas_tuned":
+        # re-run the pallas headline under the block config attn_micro just
+        # recorded (the orchestrator exports FEDML_FLASH_BLOCK_Q/K into this
+        # stage's env from the verdict file) — without this, a tuned config
+        # only pays off in the NEXT window. Skips itself when there is no
+        # non-default verdict to apply.
+        bq = os.environ.get("FEDML_FLASH_BLOCK_Q")
+        bk = os.environ.get("FEDML_FLASH_BLOCK_K")
+        if not bq or not bk or (bq, bk) == ("128", "128"):
+            out = {"skipped": "no non-default flash_blocks verdict"}
+        else:
+            out = _retry_transient(_bench_llm_tpu, reps=10,
+                                   attention_impl="pallas", remat=False)
+            out["remat"] = False
     elif name == "memplan":
         out = _bench_memplan()
     elif name == "cpu_llm":
@@ -1283,9 +1297,12 @@ _STAGES: list[tuple[str, int]] = [
     # (_enable_compile_cache) can serve; budget for fully cold
     ("decode_int8", 900),
     ("resnet", 900),
-    # attention-kernel block sweep: feeds the NEXT window's headline via
-    # .bench_runtime/flash_blocks (6 small compiles + marginal timings)
+    # attention-kernel block sweep: records the fastest config to
+    # .bench_runtime/flash_blocks (6 small compiles + marginal timings) ...
     ("attn_micro", 600),
+    # ... and the tuned headline re-run applies it IN THIS WINDOW (skips
+    # itself when the verdict is absent or the 128x128 default)
+    ("llm_pallas_tuned", 900),
     # real-HBM validation of the 7B plan: metadata math + one stats read
     ("memplan", 300),
     ("cpu_llm", 400),
@@ -1594,6 +1611,25 @@ def main() -> None:
         stage_name, budget = remaining.pop(0)
         env = dict(flash_env) if flash_env is not None else None
         env = _flash_blocks_env(env)
+        if stage_name == "llm_pallas_tuned":
+            # spawn only when the re-run would measure something NEW: a
+            # pallas no-remat flagship headline exists AND the current
+            # verdict resolves to a block config the headline did not
+            # already run (in steady state llm_pallas itself runs under the
+            # persisted verdict, making this stage redundant)
+            head = stage_out.get("llm_pallas") or {}
+            verdict = (env or {}).get("FEDML_FLASH_BLOCK_Q"), (env or {}).get(
+                "FEDML_FLASH_BLOCK_K")
+            verdict_blocks = (f"{verdict[0]}x{verdict[1]}"
+                              if all(verdict) else None)
+            if (head.get("attention_impl") != "pallas" or head.get("remat")
+                    or head.get("shape", {}).get("bs") != _llm_shape()["bs"]
+                    or verdict_blocks is None
+                    or verdict_blocks == head.get("flash_blocks")):
+                stage_out[stage_name] = {
+                    "skipped": "headline already ran this config (or is not "
+                               "a no-remat pallas flagship run)"}
+                continue
         if stage_name == "memplan":
             # the stage's plan math runs on a virtual 8-device CPU mesh
             # alongside the real chip (metadata only, nothing executes there)
@@ -1642,6 +1678,23 @@ def main() -> None:
         print("warning: llm_pallas stage produced nothing; promoting llm_xla "
               "measurement to the headline", file=sys.stderr)
         llm = llm_xla
+    tuned = stage_out.get("llm_pallas_tuned")
+    if (tuned is not None and tuned.get("tokens_per_sec") is not None
+            and llm is not None and llm.get("attention_impl") == "pallas"
+            # config parity: a tuned run may only claim a blocks-delta over
+            # a headline with the same remat mode, batch size, and a
+            # DIFFERENT block config — anything else attributes a remat/bs
+            # effect to tuning
+            and tuned.get("remat") == llm.get("remat")
+            and tuned.get("shape", {}).get("bs") == llm.get("shape", {}).get("bs")
+            and tuned.get("flash_blocks") != llm.get("flash_blocks")
+            and tuned["tokens_per_sec"] > llm["tokens_per_sec"]):
+        # the block-tuned re-run beat the default-config headline: promote
+        # it, keeping the default run's numbers as provenance
+        tuned = dict(tuned)
+        tuned["default_blocks_tokens_per_sec"] = round(llm["tokens_per_sec"], 1)
+        tuned["default_blocks_mfu"] = round(llm["mfu"], 4)
+        llm = tuned
     decode = stage_out.get("decode")
     resnet = stage_out.get("resnet")
     serving = stage_out.get("serving") or {"endpoint_decode_tokens_per_sec": None}
@@ -1675,6 +1728,11 @@ def main() -> None:
             "attention_impl": llm["attention_impl"],
             "remat": llm["remat"],
         })
+        if llm.get("flash_blocks"):
+            out["flash_blocks"] = llm["flash_blocks"]
+        if llm.get("default_blocks_tokens_per_sec") is not None:
+            out["default_blocks_tokens_per_sec"] = llm["default_blocks_tokens_per_sec"]
+            out["default_blocks_mfu"] = llm["default_blocks_mfu"]
     else:
         out.update({"value": None, "unit": "tokens/s", "vs_baseline": None, "mfu": None})
     if llm_xla is not None:

@@ -145,6 +145,7 @@ _LLM_OK = ({
     "shape": {"d_model": 1024, "n_layers": 16, "n_heads": 16, "d_ff": 2752,
               "vocab": 32000, "seq": 1024, "bs": 8},
     "remat": False,
+    "flash_blocks": "128x128",
 }, None)
 
 
@@ -166,6 +167,7 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
                         "best_vs_128x128": 1.2,
                         "best_vs_einsum": 1.067,
                         "recorded": "256x256"}, None),
+        "llm_pallas_tuned": ({"skipped": "no non-default flash_blocks verdict"}, None),
         "memplan": ({"plan_bytes_per_device": 7_500_000_000,
                      "device_bytes_limit": 16 * 2**30,
                      "device_bytes_in_use": 0, "device_kind": "TPU v5 lite",
@@ -636,3 +638,70 @@ def test_flash_blocks_env_honors_hash_scoped_verdict(monkeypatch, tmp_path):
     (tmp_path / "flash_blocks").write_text("256 512 othersha")
     out = bench._flash_blocks_env({"X": "1"})
     assert "FEDML_FLASH_BLOCK_Q" not in out
+
+
+def test_tuned_headline_promotion(monkeypatch, tmp_path, capsys, _restore_signals):
+    """A block-tuned pallas re-run that beats the default-config headline is
+    promoted (default numbers kept as provenance); a skipped tuned stage
+    changes nothing."""
+    tuned = dict(_LLM_OK[0], tokens_per_sec=56000.0, mfu=0.46,
+                 flash_blocks="256x512")
+    _canned_stages(monkeypatch, tmp_path, {
+        "llm_pallas": _LLM_OK,
+        "llm_pallas_tuned": (tuned, None),
+        "cpu_llm": ({"cpu_llm_tokens_per_sec": 100.0}, None),
+    })
+    # an attn_micro verdict exists and differs from the headline's 128x128
+    monkeypatch.setattr(bench, "_flash_blocks_env", lambda env: dict(
+        env or {}, FEDML_FLASH_BLOCK_Q="256", FEDML_FLASH_BLOCK_K="512"))
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 56000.0
+    assert out["mfu"] == 0.46
+    assert out["default_blocks_tokens_per_sec"] == 50000.0
+    assert out["default_blocks_mfu"] == 0.41
+
+
+def test_tuned_stage_skip_keeps_default_headline(monkeypatch, tmp_path, capsys, _restore_signals):
+    _canned_stages(monkeypatch, tmp_path, {
+        "llm_pallas": _LLM_OK,
+        "llm_pallas_tuned": ({"skipped": "no non-default flash_blocks verdict"}, None),
+        "cpu_llm": ({"cpu_llm_tokens_per_sec": 100.0}, None),
+    })
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 50000.0
+    assert "default_blocks_tokens_per_sec" not in out
+
+
+def test_tuned_stage_not_spawned_when_headline_ran_same_config(monkeypatch, tmp_path, capsys, _restore_signals):
+    """Steady state: llm_pallas itself already ran under the persisted
+    verdict — the tuned re-run must be skipped at the orchestrator level
+    (no 900s spawn) and no tuning delta may be claimed."""
+    spawned = []
+    results = {
+        "llm_pallas": ({**_LLM_OK[0], "flash_blocks": "256x512"}, None),
+        "cpu_llm": ({"cpu_llm_tokens_per_sec": 100.0}, None),
+    }
+    _canned_stages(monkeypatch, tmp_path, results)
+
+    orig = bench._spawn_stage
+
+    def spy(name, budget_s, argv=None, env=None):
+        spawned.append(name)
+        return orig(name, budget_s, argv=argv, env=env)
+
+    monkeypatch.setattr(bench, "_spawn_stage", spy)
+    monkeypatch.setattr(bench, "_flash_blocks_env", lambda env: dict(
+        env or {}, FEDML_FLASH_BLOCK_Q="256", FEDML_FLASH_BLOCK_K="512"))
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0
+    assert "llm_pallas_tuned" not in spawned
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 50000.0
+    assert "default_blocks_tokens_per_sec" not in out

@@ -22,10 +22,11 @@ LOCK="$REPO/.bench_runtime/bench.lock"
 
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-90}
 SMOKE_TIMEOUT=${SMOKE_TIMEOUT:-1200}  # may run BOTH stats layouts (narrow+wide)
-# must exceed the sum of bench.py's per-stage budgets (_STAGES: 8100s with
-# memplan; banked CPU baselines usually shave 600s) plus the probe, or the
-# outer timeout kills a run whose stages are all within their own contracts
-BENCH_TIMEOUT=${BENCH_TIMEOUT:-9000}
+# must exceed the sum of bench.py's per-stage budgets (_STAGES: 9600s with
+# attn_micro + the tuned re-run; banked CPU baselines usually shave 600s)
+# plus the 180s probe, or the outer timeout kills a run whose stages are
+# all within their own contracts
+BENCH_TIMEOUT=${BENCH_TIMEOUT:-10500}
 SLEEP_DOWN=${SLEEP_DOWN:-120}     # tunnel down: re-probe every 2 min (short
                                   # up-windows are the norm; 10 min missed them)
 SLEEP_UP=${SLEEP_UP:-3600}        # after a good measurement: hourly is plenty
