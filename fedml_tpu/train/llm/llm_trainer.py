@@ -10,6 +10,7 @@ rank-sized (the PEFT analogue).
 
 from __future__ import annotations
 
+import functools
 import logging
 import time
 from typing import Any, Dict, Iterator, Optional, Tuple
@@ -175,7 +176,10 @@ class LLMTrainer:
         )
         opt_state = tx.init(p3)
 
-        @jax.jit
+        # donate params + opt state like the fsdp path (make_fsdp_train_step
+        # donate=True): the train loop overwrites both with the outputs, and
+        # without donation XLA double-buffers the full fp32 state
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step(params3, opt_state, tokens, mask):
             # mask is accepted for step-signature parity; the pipelined loss
             # packs full microbatches so no padding mask is needed
