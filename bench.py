@@ -509,31 +509,46 @@ def _bench_llm_decode_tpu(reps: int = 4, weight_quant: str = "none"):
     P = min(64, s["seq"] // 2)
     new = min(128, s["seq"] - P)
     rng = np.random.default_rng(1)
-    prompts = [
-        jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, P)).astype(np.int32))
-        for _ in range(reps + 1)
-    ]
-    # warmup compiles prefill + the shared decode scan; the trailing scalar
-    # fetch forces the warmup to actually complete (see the module header:
-    # axon's block_until_ready returns before remote execution)
-    int(np.asarray(generate(params, cfg, prompts[0], new)[-1, -1]))
-    t0 = time.perf_counter()
-    outs = [generate(params, cfg, p, new) for p in prompts[1:]]
-    # completion is forced the same way the train stages do it — a 4-byte
-    # fetch that depends on every full output. block_until_ready alone
-    # measured DISPATCH on this backend (the r5 full ladder printed a
-    # physically impossible 370k tok/s before this fetch existed). ONE
-    # combined fetch, not one per rep: sequential per-rep fetches would pay
-    # reps tunnel round-trips inside the timed window and deflate the rate.
-    int(np.asarray(sum(o[-1, -1] for o in outs)))
-    dt = time.perf_counter() - t0
-    rate = bs * new * reps / dt
     param_bytes = sum(
         x.nbytes for x in jax.tree_util.tree_leaves(params) if hasattr(x, "nbytes")
     )
-    _check_decode_bandwidth(rate, bs, param_bytes)
-    return {"decode_tokens_per_sec": rate, "bs": bs, "new": new,
-            "weight_quant": weight_quant}
+
+    def measure(n_new: int, n_reps: int) -> float:
+        prompts = [
+            jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, P)).astype(np.int32))
+            for _ in range(n_reps + 1)
+        ]
+        # warmup compiles prefill + the decode scan for this length bucket;
+        # the trailing scalar fetch forces it to actually complete (module
+        # header: axon's block_until_ready returns before remote execution)
+        int(np.asarray(generate(params, cfg, prompts[0], n_new)[-1, -1]))
+        t0 = time.perf_counter()
+        outs = [generate(params, cfg, p, n_new) for p in prompts[1:]]
+        # completion forced the same way the train stages do it — a 4-byte
+        # fetch that depends on every full output. block_until_ready alone
+        # measured DISPATCH on this backend (the r5 full ladder printed a
+        # physically impossible 370k tok/s before this fetch existed). ONE
+        # combined fetch, not one per rep: sequential per-rep fetches would
+        # pay n_reps tunnel round-trips inside the window and deflate the rate.
+        int(np.asarray(sum(o[-1, -1] for o in outs)))
+        dt = time.perf_counter() - t0
+        rate = bs * n_new * n_reps / dt
+        _check_decode_bandwidth(rate, bs, param_bytes)
+        return rate
+
+    out = {"decode_tokens_per_sec": measure(new, reps), "bs": bs, "new": new,
+           "weight_quant": weight_quant}
+    # long decode: at new=128 the rate is partly fixed-cost bound (prefill +
+    # tunnel round trip), which masks int8's halved weight traffic (measured
+    # r5: 1.11x). A longer scan amortizes those costs so the quantized
+    # comparison reflects the bandwidth story. Costs one extra scan-bucket
+    # compile; skipped at tiny geometry where no longer bucket exists.
+    new_long = min(512, cfg.max_seq_len - P)
+    if new_long > new:
+        _p(f"decode bench: long decode (new={new_long})")
+        out["new_long"] = new_long
+        out["decode_tokens_per_sec_long"] = measure(new_long, max(2, reps // 2))
+    return out
 
 
 _FLASH_SWEEP = [(128, 128), (128, 256), (256, 256), (128, 512), (256, 512),
@@ -1757,6 +1772,10 @@ def main() -> None:
                 resnet["steps_per_sec"] * resnet["bs"] / cpu_resnet, 2)
     if decode is not None:
         out["decode_tokens_per_sec"] = round(decode["decode_tokens_per_sec"], 1)
+        if decode.get("decode_tokens_per_sec_long") is not None:
+            out["decode_tokens_per_sec_long"] = round(
+                decode["decode_tokens_per_sec_long"], 1)
+            out["decode_new_long"] = decode["new_long"]
     decode_int8 = stage_out.get("decode_int8")
     if decode_int8 is not None:
         out["decode_tokens_per_sec_int8"] = round(
@@ -1764,6 +1783,17 @@ def main() -> None:
         if decode is not None and decode["decode_tokens_per_sec"] > 0:
             out["int8_decode_speedup"] = round(
                 decode_int8["decode_tokens_per_sec"] / decode["decode_tokens_per_sec"], 2)
+        if decode_int8.get("decode_tokens_per_sec_long") is not None:
+            # the measured int8 long rate publishes unconditionally, like
+            # its short counterpart; only the RATIO needs the fp denominator
+            out["decode_tokens_per_sec_int8_long"] = round(
+                decode_int8["decode_tokens_per_sec_long"], 1)
+            if decode is not None and decode.get("decode_tokens_per_sec_long"):
+                # the bandwidth-story comparison: long decode amortizes the
+                # fixed per-call costs that mask int8 at new=128
+                out["int8_decode_speedup_long"] = round(
+                    decode_int8["decode_tokens_per_sec_long"]
+                    / decode["decode_tokens_per_sec_long"], 2)
     out.update({k: (round(v, 1) if isinstance(v, float) else v)
                 for k, v in serving.items()})
     memplan = stage_out.get("memplan")

@@ -705,3 +705,26 @@ def test_tuned_stage_not_spawned_when_headline_ran_same_config(monkeypatch, tmp_
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["value"] == 50000.0
     assert "default_blocks_tokens_per_sec" not in out
+
+
+def test_long_decode_speedup_merge(monkeypatch, tmp_path, capsys, _restore_signals):
+    """int8_decode_speedup_long is published only when BOTH stages measured
+    the long bucket; the short-bucket ratio stays independent."""
+    _canned_stages(monkeypatch, tmp_path, {
+        "llm_pallas": _LLM_OK,
+        "decode": ({"decode_tokens_per_sec": 800.0, "bs": 4, "new": 128,
+                    "new_long": 512, "decode_tokens_per_sec_long": 1500.0,
+                    "weight_quant": "none"}, None),
+        "decode_int8": ({"decode_tokens_per_sec": 900.0, "bs": 4, "new": 128,
+                         "new_long": 512, "decode_tokens_per_sec_long": 2400.0,
+                         "weight_quant": "int8"}, None),
+        "cpu_llm": ({"cpu_llm_tokens_per_sec": 100.0}, None),
+    })
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["int8_decode_speedup"] == 1.12
+    assert out["decode_tokens_per_sec_long"] == 1500.0
+    assert out["decode_new_long"] == 512
+    assert out["int8_decode_speedup_long"] == 1.6
