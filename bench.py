@@ -1044,17 +1044,44 @@ def _probe_backend(timeout_s: int = 180) -> None:
 
 
 def _last_measured() -> dict | None:
-    """Newest committed BENCH_MEASURED_*.json artifact, or None. These are
-    written after every successful stage (see main) precisely so a tunnel
-    stall mid-run still leaves an auditable, timestamped number in git."""
+    """The most INFORMATIVE committed BENCH_MEASURED_*.json artifact, or
+    None. These are written after every successful stage (see main)
+    precisely so a tunnel stall mid-run still leaves an auditable,
+    timestamped number in git. 'Most informative' = newest among the
+    artifacts with the most stage records: a later headline-only artifact
+    (an interrupted ladder's first increment) must not shadow an earlier
+    full-ladder record in a skip report; the full artifact list rides
+    along so nothing is hidden."""
     paths = sorted(glob.glob(os.path.join(_REPO, "BENCH_MEASURED_*.json")))
     if not paths:
         return None
-    try:
-        with open(paths[-1]) as f:
-            return json.load(f)
-    except Exception:
+    docs = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except Exception:
+            continue
+        if isinstance(doc, dict):
+            docs.append((p, doc))
+    if not docs:
         return None
+
+    def n_stages(doc: dict) -> int:
+        # stage records are the '_'-prefixed keys, both inside a final
+        # artifact's _stages dict and at an incremental artifact's top
+        # level; bookkeeping keys (stages_failed, aborted, ...) must not
+        # inflate the count
+        stages = doc.get("_stages")
+        pool = stages if isinstance(stages, dict) and stages else doc
+        return sum(1 for k in pool
+                   if str(k).startswith("_") and k != "_stages"
+                   and isinstance(pool[k], dict))
+
+    best = max(docs, key=lambda pd: (n_stages(pd[1]),
+                                     pd[1].get("measured_at_utc") or ""))[1]
+    best = dict(best, all_artifacts=[os.path.basename(p) for p in paths])
+    return best
 
 
 _GIT_HEAD_CACHE: dict = {}
