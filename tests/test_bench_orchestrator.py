@@ -728,3 +728,27 @@ def test_long_decode_speedup_merge(monkeypatch, tmp_path, capsys, _restore_signa
     assert out["decode_tokens_per_sec_long"] == 1500.0
     assert out["decode_new_long"] == 512
     assert out["int8_decode_speedup_long"] == 1.6
+
+
+def test_last_measured_prefers_most_informative_artifact(monkeypatch, tmp_path):
+    """A newer headline-only increment (interrupted ladder) must not shadow
+    an older full-ladder record; bookkeeping keys don't inflate the count;
+    non-dict artifact files are skipped, and every filename is listed."""
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    full = {"measured_at_utc": "20260801T080000Z",
+            "_stages": {"_llm_pallas": {"mfu": 0.3}, "_resnet": {"mfu": 0.1},
+                        "stages_failed": [], "aborted": False}}
+    headline_only = {"measured_at_utc": "20260801T090000Z",
+                     "_llm_pallas": {"mfu": 0.31}}
+    (tmp_path / "BENCH_MEASURED_20260801T080000Z.json").write_text(json.dumps(full))
+    (tmp_path / "BENCH_MEASURED_20260801T090000Z.json").write_text(json.dumps(headline_only))
+    (tmp_path / "BENCH_MEASURED_20260801T100000Z.json").write_text("[1, 2]")
+    got = bench._last_measured()
+    assert got["measured_at_utc"] == "20260801T080000Z"
+    assert len(got["all_artifacts"]) == 3
+    # equal stage counts: the newer wins
+    richer_newer = {"measured_at_utc": "20260801T110000Z",
+                    "_stages": {"_llm_pallas": {}, "_resnet": {}}}
+    (tmp_path / "BENCH_MEASURED_20260801T110000Z.json").write_text(
+        json.dumps(richer_newer))
+    assert bench._last_measured()["measured_at_utc"] == "20260801T110000Z"
