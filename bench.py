@@ -642,12 +642,28 @@ def _bench_attn_micro(reps: int = 6):
         return dt
 
     results: dict[str, float] = {}
+    rejected: dict[str, str] = {}
     for bq, bk in _FLASH_SWEEP:
         if T % bq or T % bk:
             continue
         _p(f"attn micro: flash {bq}x{bk}")
-        dt = time_impl(lambda q, k, v, bq=bq, bk=bk: flash_attention(
-            q, k, v, causal=True, block_q=bq, block_k=bk))
+        try:
+            # through _retry_transient so one tunnel flake (or an OOM whose
+            # buffers need reap time) gets the same same-config retry every
+            # other measurement enjoys — only a REPEATED failure rejects
+            dt = _retry_transient(
+                time_impl, lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_k=bk))
+        except BenchIntegrityError:
+            raise
+        except Exception as e:  # noqa: BLE001 - a Mosaic rejection (or
+            # persistent OOM) of ONE block config must not void the sweep:
+            # only the smoked 128x128 default has proven acceptance, every
+            # other config meets the real compiler for the first time here
+            print(f"warning: flash {bq}x{bk} failed twice ({e!r}); "
+                  "continuing sweep", file=sys.stderr)
+            rejected[f"flash_{bq}x{bk}"] = repr(e)[:200]
+            continue
         results[f"flash_{bq}x{bk}"] = round(dt * 1e3, 3)
     _p("attn micro: xla einsum")
 
@@ -659,15 +675,23 @@ def _bench_attn_micro(reps: int = 6):
     results["xla_einsum"] = round(dt * 1e3, 3)
 
     flash = {cfg: t for cfg, t in results.items() if cfg.startswith("flash_")}
-    best = min(flash, key=flash.get)
     out = {
         "shape": {"bs": B, "seq": T, "heads": H, "d_head": Dh},
         "fwd_bwd_ms": results,
+    }
+    if rejected:
+        out["rejected_configs"] = rejected
+    if not flash:
+        # every flash config failed: the einsum time is still a measurement
+        # and the rejections are the finding — no verdict to record
+        return out
+    best = min(flash, key=flash.get)
+    out.update({
         "best_flash": best,
         "best_vs_128x128": round(flash.get("flash_128x128", 0.0)
                                  / flash[best], 3) if flash.get("flash_128x128") else None,
         "best_vs_einsum": round(results["xla_einsum"] / flash[best], 3),
-    }
+    })
     # a CPU interpret-mode sweep says nothing about Mosaic scheduling and
     # must not steer the chip headline
     if jax.devices()[0].platform == "tpu":
@@ -1893,8 +1917,11 @@ def main() -> None:
     attn = stage_out.get("attn_micro")
     if attn is not None:
         out["attn_fwd_bwd_ms"] = attn["fwd_bwd_ms"]
-        out["attn_best_flash"] = attn["best_flash"]
-        out["attn_best_vs_einsum"] = attn["best_vs_einsum"]
+        if attn.get("rejected_configs"):
+            out["attn_rejected_configs"] = attn["rejected_configs"]
+        if attn.get("best_flash") is not None:
+            out["attn_best_flash"] = attn["best_flash"]
+            out["attn_best_vs_einsum"] = attn["best_vs_einsum"]
 
     if stage_out:
         _write_measured_artifact(dict(out, _stages=merged), stamp)

@@ -752,3 +752,23 @@ def test_last_measured_prefers_most_informative_artifact(monkeypatch, tmp_path):
     (tmp_path / "BENCH_MEASURED_20260801T110000Z.json").write_text(
         json.dumps(richer_newer))
     assert bench._last_measured()["measured_at_utc"] == "20260801T110000Z"
+
+
+def test_attn_micro_rejection_merge(monkeypatch, tmp_path, capsys, _restore_signals):
+    """A sweep where every flash config was rejected merges its rejections
+    and einsum time without best_flash keys; a partial sweep merges both."""
+    _canned_stages(monkeypatch, tmp_path, {
+        "llm_pallas": _LLM_OK,
+        "attn_micro": ({"fwd_bwd_ms": {"xla_einsum": 8.0},
+                        "rejected_configs": {"flash_128x128": "Mosaic: no"}},
+                       None),
+        "cpu_llm": ({"cpu_llm_tokens_per_sec": 100.0}, None),
+    })
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["attn_rejected_configs"] == {"flash_128x128": "Mosaic: no"}
+    assert "attn_best_flash" not in out
+    assert "attn_best_vs_einsum" not in out
+    assert out["attn_fwd_bwd_ms"] == {"xla_einsum": 8.0}
