@@ -213,3 +213,20 @@ def test_no_remat_oom_stamp_gated_on_flagship_geometry_and_device(monkeypatch):
     assert "no_remat_oom" in run(flagship, "TPU v5 lite")
     assert "no_remat_oom" not in run(tiny, "cpu")
     assert "no_remat_oom" not in run(flagship, "TPU v4")
+
+
+@pytest.mark.slow
+def test_decode_long_bucket_measures_at_reduced_width(monkeypatch):
+    """The long-decode bucket (new=512) only runs at flagship geometry on
+    chip — CI pins its code path at a CPU-feasible width: same seq budget
+    (so the bucket exists), narrow layers. Both buckets must publish and
+    pass the bandwidth guard."""
+    monkeypatch.setitem(bench._LLM_SHAPE, "d_model", 128)
+    monkeypatch.setitem(bench._LLM_SHAPE, "n_layers", 2)
+    monkeypatch.setitem(bench._LLM_SHAPE, "n_heads", 4)
+    monkeypatch.setitem(bench._LLM_SHAPE, "d_ff", 256)
+    monkeypatch.setitem(bench._LLM_SHAPE, "vocab", 512)
+    out = bench._bench_llm_decode_tpu(reps=2)
+    assert out["new"] == 128 and out["new_long"] == 512
+    assert out["decode_tokens_per_sec"] > 0
+    assert out["decode_tokens_per_sec_long"] > 0
