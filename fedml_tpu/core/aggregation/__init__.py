@@ -6,6 +6,12 @@ from .agg_operator import (
     scaffold_aggregate,
     uniform_average,
 )
+from .bucketed import (
+    DEFAULT_BUCKET_SIZE,
+    BucketedAggregator,
+    bucketed_weighted_average,
+    get_engine,
+)
 from .server_optimizer import FedOptServer, create_server_optimizer
 
 __all__ = [
@@ -15,6 +21,10 @@ __all__ = [
     "scaffold_aggregate",
     "async_fedavg",
     "uniform_average",
+    "BucketedAggregator",
+    "bucketed_weighted_average",
+    "get_engine",
+    "DEFAULT_BUCKET_SIZE",
     "FedOptServer",
     "create_server_optimizer",
 ]

@@ -37,9 +37,7 @@ from ...utils.pytree import (
     PyTree,
     tree_add,
     tree_scale,
-    tree_stack,
     tree_sub,
-    stacked_weighted_average,
     weighted_average,
 )
 
@@ -82,8 +80,8 @@ def fednova_aggregate(
     p = jnp.asarray([n / n_total for n, _ in grad_list], dtype=jnp.float32)
     a = jnp.asarray([float(payload[0]) for _, payload in grad_list], dtype=jnp.float32)
     tau_eff = jnp.sum(p * a)
-    stacked_d = tree_stack([payload[1] for _, payload in grad_list])
-    avg_d = stacked_weighted_average(stacked_d, p)
+    # bucketed engine normalizes weights; sample counts already carry p_k
+    avg_d = weighted_average([(float(n), payload[1]) for n, payload in grad_list])
     return jax.tree.map(lambda w, d: w - tau_eff * d, w_global, avg_d)
 
 

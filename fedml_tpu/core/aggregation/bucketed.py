@@ -1,0 +1,216 @@
+"""Bucketed, donation-aware aggregation engine.
+
+``utils/pytree.weighted_average`` had two perf cliffs: cohorts <= 64 built a
+full ``[K, ...]`` stacked copy of the model in HBM (``tree_stack``) and
+retraced the contraction for every distinct cohort size, while cohorts > 64
+fell back to a Python fold issuing O(K x num_leaves) tiny dispatches. This
+engine consumes clients in fixed-size buckets through ONE jitted accumulator
+step with ``donate_argnums`` on the running f32 accumulator:
+
+- HBM high-water is O(bucket x model), not O(K x model);
+- kernel count is O(K / bucket), not O(K x leaves);
+- the compile cache is keyed on ``(bucket_size, treedef, shapes, dtypes)`` —
+  the accumulator signature does not mention the cohort size, so one compile
+  is reused across every round and every cohort size. Ragged tails are padded
+  to the bucket shape by repeating the last client tree with weight 0.0, so
+  K=57 and K=64 share the same executable.
+
+Object leaves (e.g. homomorphic ciphertexts, ``core/fhe/rlwe.py``) define
+their own ``+``/``*`` algebra and cannot ride XLA; they keep the host fold.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+DEFAULT_BUCKET_SIZE = 16
+
+
+def _is_object_leaf(leaf: Any) -> bool:
+    return not isinstance(leaf, (np.ndarray, jnp.ndarray, np.generic, float, int))
+
+
+def _object_fold(trees: Sequence[PyTree], weights: np.ndarray) -> PyTree:
+    """Fold with the leaves' own +/* — they define the algebra (FHE path)."""
+    acc = jax.tree.map(lambda x: x * float(weights[0]), trees[0])
+    for w, t in zip(weights[1:], trees[1:]):
+        acc = jax.tree.map(lambda a, x, w=w: a + x * float(w), acc, t)
+    return acc
+
+
+class BucketedAggregator:
+    """Streaming weighted average over client pytrees in fixed-size buckets.
+
+    The public entry points are :meth:`aggregate` (list of ``(weight, tree)``
+    pairs, weights normalized — drop-in for ``weighted_average``) and
+    :meth:`aggregate_stacked` (leaves already carry a leading client axis —
+    drop-in for ``stacked_weighted_average``). The bench drives the raw
+    bucket step via :meth:`accumulate_bucket` / :meth:`finalize`.
+
+    ``accum_traces`` / ``stacked_traces`` count jit *traces* (they only
+    advance when XLA actually recompiles) — the compile-count regression
+    test pins them.
+    """
+
+    def __init__(self, bucket_size: int = DEFAULT_BUCKET_SIZE):
+        if bucket_size < 1:
+            raise ValueError(f"bucket_size must be >= 1, got {bucket_size}")
+        self.bucket_size = int(bucket_size)
+        self.accum_traces = 0
+        self.stacked_traces = 0
+        # first bucket has no accumulator yet: a separate executable avoids a
+        # zeros-alloc + add per aggregate; the steady-state step donates acc
+        self._accum_first = jax.jit(self._accum_first_impl)
+        self._accum = jax.jit(self._accum_impl, donate_argnums=(0,))
+        self._scan_reduce = jax.jit(self._scan_reduce_impl)
+        self._finalize_cache: Dict[Any, Any] = {}
+
+    # --- jitted bodies ----------------------------------------------------
+    def _bucket_sum(self, chunk: Tuple[PyTree, ...], weights: jax.Array) -> PyTree:
+        # the stack happens INSIDE the jit: it fuses with the contraction
+        # into one executable, so a bucket costs one dispatch, not one per
+        # leaf — and the [b, ...] stacked copy never persists in HBM
+        def leaf_sum(*xs):
+            stacked = jnp.stack([x.astype(jnp.float32) for x in xs])
+            return jnp.tensordot(weights, stacked, axes=((0,), (0,)))
+
+        return jax.tree.map(leaf_sum, *chunk)
+
+    def _accum_first_impl(self, chunk, weights):
+        self.accum_traces += 1  # trace-time only: counts compiles, not calls
+        return self._bucket_sum(chunk, weights)
+
+    def _accum_impl(self, acc, chunk, weights):
+        self.accum_traces += 1
+        return jax.tree.map(jnp.add, acc, self._bucket_sum(chunk, weights))
+
+    def _scan_reduce_impl(self, stacked, weights):
+        # already-stacked [nb*b, ...] leaves: scan over buckets so the f32
+        # temporaries stay O(bucket x model); compiles once per distinct
+        # bucket COUNT (K=57 and K=64 both pad to nb=4 -> same executable)
+        self.stacked_traces += 1
+        b = self.bucket_size
+        resh = jax.tree.map(lambda x: x.reshape((-1, b) + x.shape[1:]), stacked)
+        wr = weights.astype(jnp.float32).reshape((-1, b))
+
+        def body(acc, xs):
+            wb, chunk = xs
+            contrib = jax.tree.map(
+                lambda x: jnp.tensordot(wb, x.astype(jnp.float32), axes=((0,), (0,))), chunk
+            )
+            return jax.tree.map(jnp.add, acc, contrib), None
+
+        init = jax.tree.map(lambda x: jnp.zeros(x.shape[2:], jnp.float32), resh)
+        acc, _ = jax.lax.scan(body, init, (wr, resh))
+        return jax.tree.map(lambda a, x: a.astype(x.dtype), acc, stacked)
+
+    def _finalize_fn(self, template: PyTree):
+        """Jitted f32-acc -> original-dtype cast, cached per (treedef, dtypes)."""
+        leaves, treedef = jax.tree.flatten(template)
+        dtypes = tuple(jnp.asarray(l).dtype if not hasattr(l, "dtype") else l.dtype for l in leaves)
+        key = (treedef, dtypes)
+        fn = self._finalize_cache.get(key)
+        if fn is None:
+            if all(d == jnp.float32 for d in dtypes):
+                fn = lambda acc: acc  # noqa: E731 — identity, no dispatch
+            else:
+                fn = jax.jit(
+                    lambda acc: jax.tree.unflatten(
+                        treedef, [l.astype(d) for l, d in zip(jax.tree.leaves(acc), dtypes)]
+                    )
+                )
+            self._finalize_cache[key] = fn
+        return fn
+
+    # --- raw step API (bench + power users) -------------------------------
+    def accumulate_bucket(self, acc, chunk: Sequence[PyTree], weights) -> PyTree:
+        """One bucket step: ``acc + sum_i weights[i] * chunk[i]`` in f32.
+
+        ``chunk`` must hold exactly ``bucket_size`` trees (pad ragged tails
+        with weight 0.0). ``acc`` of None starts a fresh accumulator; a
+        non-None ``acc`` is DONATED — the caller must not reuse it.
+        """
+        chunk = tuple(chunk)
+        if len(chunk) != self.bucket_size:
+            raise ValueError(f"chunk has {len(chunk)} trees, bucket_size is {self.bucket_size}")
+        weights = jnp.asarray(weights, dtype=jnp.float32)
+        if acc is None:
+            return self._accum_first(chunk, weights)
+        return self._accum(acc, chunk, weights)
+
+    def finalize(self, acc: PyTree, template: PyTree) -> PyTree:
+        """Cast the f32 accumulator back to ``template``'s leaf dtypes."""
+        return self._finalize_fn(template)(acc)
+
+    # --- public entry points ----------------------------------------------
+    def aggregate(self, pairs: Sequence[Tuple[float, PyTree]]) -> PyTree:
+        """Weighted average of ``(weight, tree)`` pairs; weights normalized."""
+        if not pairs:
+            raise ValueError("aggregate() needs at least one (weight, tree) pair")
+        weights = np.asarray([float(w) for w, _ in pairs], dtype=np.float32)
+        weights = weights / weights.sum()
+        trees = [t for _, t in pairs]
+        if any(_is_object_leaf(l) for l in jax.tree.leaves(trees[0])):
+            return _object_fold(trees, weights)
+        b = self.bucket_size
+        acc = None
+        for start in range(0, len(trees), b):
+            chunk = trees[start : start + b]
+            w = weights[start : start + b]
+            if len(chunk) < b:  # ragged tail: zero-weight pad to bucket shape
+                pad = b - len(chunk)
+                chunk = list(chunk) + [chunk[-1]] * pad
+                w = np.concatenate([w, np.zeros((pad,), np.float32)])
+            acc = self.accumulate_bucket(acc, chunk, w)
+        return self.finalize(acc, trees[0])
+
+    def aggregate_stacked(self, stacked: PyTree, weights) -> PyTree:
+        """``sum_k weights[k] * leaf[k]`` on leaves with a leading client
+        axis (weights NOT normalized here — drop-in for
+        ``stacked_weighted_average``)."""
+        leaves = jax.tree.leaves(stacked)
+        if not leaves:
+            return stacked
+        k = leaves[0].shape[0]
+        b = self.bucket_size
+        pad = (-k) % b
+        w = jnp.asarray(weights, dtype=jnp.float32)
+        if pad:
+            # O(leaves) concats once per round, outside jit: buys a jit
+            # signature that only sees the padded (bucket-multiple) K
+            w = jnp.concatenate([w, jnp.zeros((pad,), jnp.float32)])
+            stacked = jax.tree.map(
+                lambda x: jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)]), stacked
+            )
+        return self._scan_reduce(stacked, w)
+
+
+# --- engine registry --------------------------------------------------------
+_ENGINES: Dict[int, BucketedAggregator] = {}
+_ENGINES_LOCK = threading.Lock()
+
+
+def get_engine(bucket_size: int | None = None) -> BucketedAggregator:
+    """Process-wide engine per bucket size (the jit caches live on it).
+
+    Default bucket size is 16, overridable via ``FEDML_AGG_BUCKET``.
+    """
+    if bucket_size is None:
+        bucket_size = int(os.environ.get("FEDML_AGG_BUCKET", DEFAULT_BUCKET_SIZE))
+    with _ENGINES_LOCK:
+        eng = _ENGINES.get(bucket_size)
+        if eng is None:
+            eng = _ENGINES[bucket_size] = BucketedAggregator(bucket_size)
+        return eng
+
+
+def bucketed_weighted_average(pairs: Sequence[Tuple[float, PyTree]], bucket_size: int | None = None) -> PyTree:
+    return get_engine(bucket_size).aggregate(pairs)

@@ -12,12 +12,29 @@ import logging
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+import jax.numpy as jnp
 import numpy as np
+from jax import tree as jax_tree
 
 from ... import mlops
 from ...core.alg_frame.context import Context
+from ...utils.pytree import tree_from_numpy
 
 log = logging.getLogger(__name__)
+
+
+def _float_array_leaves_only(tree) -> bool:
+    """True iff every leaf is a float array — the only payloads safe to
+    eagerly upload. Integer leaves (MPC masks need exact int64 beyond jnp's
+    canonicalization) and object leaves (FHE ciphertexts) stay host-side."""
+    leaves = jax_tree.leaves(tree)
+    if not leaves:
+        return False
+    for l in leaves:
+        dt = getattr(l, "dtype", None)
+        if dt is None or not jnp.issubdtype(dt, jnp.floating):
+            return False
+    return True
 
 
 class FedMLAggregator:
@@ -57,6 +74,11 @@ class FedMLAggregator:
 
     def add_local_trained_result(self, index: int, model_params, sample_num) -> None:
         log.info("add_model. index = %d", index)
+        if _float_array_leaves_only(model_params):
+            # upload at the comm boundary with ONE flat-vector transfer per
+            # dtype group (not one per leaf), so the bucketed aggregator
+            # consumes device-resident trees instead of re-uploading per leaf
+            model_params = tree_from_numpy(model_params)
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = sample_num
         self.flag_client_model_uploaded_dict[index] = True

@@ -110,12 +110,22 @@ def effective_blocks(seq_len: int, block_q: int | None = None,
     sequence length — env-resolved defaults AND the min(block, T) clamp
     applied, so artifact provenance records kernel truth, not the raw env
     (a tiny-geometry run under a flagship '512 512' verdict executes
-    128x128, and must say so)."""
+    128x128, and must say so). Returns "xla-fallback" whenever the call
+    would actually take the einsum path — no pallas, clamped blocks that
+    don't tile seq_len, or wide-stats forced onto a block_k that can't host
+    128 lanes — mirroring the exact condition in flash_attention (an
+    artifact must not claim a kernel config for a dispatch that never ran
+    the kernel)."""
     if block_q is None:
         block_q = _env_block(_BLOCK_Q_ENV, 128, 8)
     if block_k is None:
         block_k = _env_block(_BLOCK_K_ENV, 128, 128)
-    return f"{min(block_q, seq_len)}x{min(block_k, seq_len)}"
+    bq, bk = min(block_q, seq_len), min(block_k, seq_len)
+    wide_requested = os.environ.get(_WIDE_STATS_ENV) == "1"
+    if (not _HAS_PALLAS or seq_len % bq or seq_len % bk
+            or (wide_requested and bk % 128 != 0)):
+        return "xla-fallback"
+    return f"{bq}x{bk}"
 
 
 def effective_stats_mode(seq_len: int, block_k: int | None = None) -> str:

@@ -181,7 +181,9 @@ class FedAvgAPI:
             alpha = float(getattr(self.args, "feddyn_alpha", 0.01))
             avg_w = uniform_average([w for _, w in lst])
             m = int(self.args.client_num_in_total)
-            delta = uniform_average([tree_sub(w, w_global) for _, w in lst])
+            # uniform mean of (w_i - g) == mean(w_i) - g: reuse avg_w instead
+            # of a second K-tree aggregation pass
+            delta = tree_sub(avg_w, w_global)
             frac = len(lst) / float(m)
             self._feddyn_h = jax.tree.map(lambda h, d: h - alpha * frac * d, self._feddyn_h, delta)
             new_w = jax.tree.map(lambda w, h: w - h / alpha, avg_w, self._feddyn_h)

@@ -23,7 +23,7 @@ import numpy as np
 from ...core.alg_frame.context import Context
 from ...ml.aggregator import create_server_aggregator
 from ...ml.trainer.local_sgd import epoch_index_array, make_local_train_fn
-from ...utils.pytree import stacked_weighted_average
+from ...core.aggregation.bucketed import get_engine
 
 log = logging.getLogger(__name__)
 
@@ -115,7 +115,10 @@ class VmapFedAvgAPI:
             if lst is not None:
                 w_global = self.aggregator.aggregate(lst)
             else:
-                w_global = stacked_weighted_average(stacked, jnp.asarray(weights))
+                # bucketed scan over the client axis: f32 temporaries stay
+                # O(bucket x model) and the compile is shared across cohort
+                # sizes that pad to the same bucket count
+                w_global = get_engine().aggregate_stacked(stacked, jnp.asarray(weights))
             w_global = self.aggregator.on_after_aggregation(w_global)
             self.aggregator.set_model_params(w_global)
             freq = int(getattr(self.args, "frequency_of_the_test", 5))

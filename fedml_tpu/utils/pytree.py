@@ -74,26 +74,16 @@ def stacked_weighted_average(stacked: PyTree, weights: jax.Array) -> PyTree:
 def weighted_average(pairs: Sequence[Tuple[float, PyTree]]) -> PyTree:
     """Weighted average of ``(weight, tree)`` pairs; weights normalized.
 
-    For small cohorts we stack (one fused kernel); for large cohorts we fold
-    to avoid materializing K copies of the model in HBM.
+    Delegates to the bucketed, donation-aware engine
+    (``core/aggregation/bucketed.py``): fixed-size buckets through one jitted
+    accumulator step, so HBM high-water is O(bucket x model) and the compile
+    is shared across all cohort sizes. Object leaves (FHE ciphertexts) keep
+    their host fold inside the engine. Lazy import: core.aggregation imports
+    this module at import time.
     """
-    weights = np.asarray([float(w) for w, _ in pairs], dtype=np.float32)
-    weights = weights / weights.sum()
-    trees = [t for _, t in pairs]
-    if any(not isinstance(l, (np.ndarray, jnp.ndarray, np.generic, float, int))
-           for l in jax.tree.leaves(trees[0])):
-        # object leaves (e.g. homomorphic ciphertexts, core/fhe/rlwe.py):
-        # fold with the leaves' own +/* — they define the algebra
-        acc = jax.tree.map(lambda x: x * float(weights[0]), trees[0])
-        for w, t in zip(weights[1:], trees[1:]):
-            acc = jax.tree.map(lambda a, x, w=w: a + x * float(w), acc, t)
-        return acc
-    if len(trees) <= 64:
-        return stacked_weighted_average(tree_stack(trees), jnp.asarray(weights))
-    acc = tree_scale(trees[0], weights[0])
-    for w, t in zip(weights[1:], trees[1:]):
-        acc = tree_add(acc, tree_scale(t, w))
-    return acc
+    from ..core.aggregation.bucketed import bucketed_weighted_average
+
+    return bucketed_weighted_average(pairs)
 
 
 def tree_flatten_to_vector(a: PyTree, dtype=jnp.float32) -> Tuple[jax.Array, Any]:
@@ -131,11 +121,80 @@ def tree_unflatten_from_vector(flat: jax.Array, spec) -> PyTree:
 
 def tree_to_numpy(a: PyTree) -> PyTree:
     """Materialize device arrays on host (the comm-boundary hand-off,
-    reference analogue: ``jax.device_get`` at ml_engine_adapter.py:223)."""
-    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), a)
+    reference analogue: ``jax.device_get`` at ml_engine_adapter.py:223).
+
+    Device leaves are grouped by dtype, raveled into ONE flat vector per
+    group on-device, and fetched with a single transfer — O(dtypes) PCIe
+    round-trips per model instead of O(leaves). Host-resident and object
+    leaves pass through untouched (no spurious device round-trip). The
+    returned leaves are views into the per-group host buffer.
+    """
+    leaves, treedef = jax.tree.flatten(a)
+    out: list = [None] * len(leaves)
+    groups: dict = {}
+    for i, l in enumerate(leaves):
+        if isinstance(l, jnp.ndarray) and not isinstance(l, np.ndarray):
+            groups.setdefault(l.dtype, []).append(i)
+        elif isinstance(l, (np.ndarray, np.generic, float, int, bool)):
+            out[i] = np.asarray(l)
+        else:  # object leaf (e.g. FHE ciphertext): already host-side
+            out[i] = l
+    for idxs in groups.values():
+        ls = [leaves[i] for i in idxs]
+        flat = jnp.concatenate([jnp.ravel(x) for x in ls]) if len(ls) > 1 else jnp.ravel(ls[0])
+        host = np.asarray(jax.device_get(flat))
+        off = 0
+        for i, x in zip(idxs, ls):
+            out[i] = host[off : off + x.size].reshape(x.shape)
+            off += x.size
+    return jax.tree.unflatten(treedef, out)
+
+
+# jitted flat-vector -> leaves splitter, cached per (dtype, shapes): the whole
+# split is one executable, so the upload costs one transfer + one dispatch
+_SPLIT_CACHE: dict = {}
+
+
+def _split_fn(dtype, shapes: Tuple[Tuple[int, ...], ...]):
+    key = (dtype, shapes)
+    fn = _SPLIT_CACHE.get(key)
+    if fn is None:
+
+        def split(flat):
+            parts, off = [], 0
+            for shp in shapes:
+                size = int(np.prod(shp)) if shp else 1
+                parts.append(flat[off : off + size].reshape(shp))
+                off += size
+            return tuple(parts)
+
+        fn = _SPLIT_CACHE[key] = jax.jit(split)
+    return fn
 
 
 def tree_from_numpy(a: PyTree, device=None) -> PyTree:
-    if device is None:
-        return jax.tree.map(jnp.asarray, a)
-    return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), device), a)
+    """Upload a host pytree to device — one flat-vector transfer per dtype
+    group instead of one per leaf, then a single jitted split/reshape.
+    Leaves already on device, and object leaves, pass through."""
+    leaves, treedef = jax.tree.flatten(a)
+    out: list = [None] * len(leaves)
+    groups: dict = {}
+    for i, l in enumerate(leaves):
+        if isinstance(l, jnp.ndarray) and not isinstance(l, np.ndarray):
+            out[i] = l if device is None else jax.device_put(l, device)
+        elif isinstance(l, (np.ndarray, np.generic, float, int, bool)):
+            arr = np.asarray(l)
+            groups.setdefault(arr.dtype, []).append((i, arr))
+        else:  # object leaf: no device representation
+            out[i] = l
+    for items in groups.values():
+        arrs = [arr for _, arr in items]
+        flat_host = np.concatenate([np.ravel(x) for x in arrs]) if len(arrs) > 1 else np.ravel(arrs[0])
+        flat = jnp.asarray(flat_host)  # ONE transfer (+ x64 canonicalization)
+        if device is not None:
+            flat = jax.device_put(flat, device)
+        shapes = tuple(x.shape for x in arrs)
+        parts = _split_fn(flat.dtype, shapes)(flat)
+        for (i, _), p in zip(items, parts):
+            out[i] = p
+    return jax.tree.unflatten(treedef, out)

@@ -178,6 +178,17 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
                      "endpoint_replicas": 2, "endpoint_requests": 12,
                      "endpoint_model": "llama-268M flagship proxy (bf16)",
                      "endpoint_batching": "dynamic"}, None),
+        "agg": ({"agg_clients_per_sec": {"resnet56": {"8": 120.0, "64": 240.0},
+                                         "llm268m": {"8": 3.0}},
+                 "agg_hbm_gbps": {"resnet56": {"8": 1.5, "64": 2.8},
+                                  "llm268m": {"8": 40.0}},
+                 "agg_bucket_size": 16,
+                 "agg_cohorts": [8, 64, 257, 512],
+                 "agg_pytrees": {"resnet56": {"n_params": 861620,
+                                              "client_dtype": "float32",
+                                              "geometry": "flagship"}},
+                 "agg_accum_traces": 4,
+                 "device": "TPU v5 lite"}, None),
     })
     with pytest.raises(SystemExit) as exc:
         bench.main()
@@ -194,6 +205,10 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
     assert out["endpoint_replicas"] == 2
     assert out["attn_best_flash"] == "flash_256x256"
     assert out["attn_best_vs_einsum"] == 1.067
+    assert out["agg_clients_per_sec"]["resnet56"]["64"] == 240.0
+    assert out["agg_hbm_gbps"]["llm268m"]["8"] == 40.0
+    assert out["agg_bucket_size"] == 16
+    assert out["agg_accum_traces"] == 4
     assert out["stages_failed"] == []
     # incremental artifacts landed (one per stage + final, same stamp file)
     arts = glob.glob(str(tmp_path / "BENCH_MEASURED_*.json"))

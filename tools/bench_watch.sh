@@ -22,11 +22,11 @@ LOCK="$REPO/.bench_runtime/bench.lock"
 
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-90}
 SMOKE_TIMEOUT=${SMOKE_TIMEOUT:-1200}  # may run BOTH stats layouts (narrow+wide)
-# must exceed the sum of bench.py's per-stage budgets (_STAGES: 9600s with
-# attn_micro + the tuned re-run; banked CPU baselines usually shave 600s)
-# plus the 180s probe, or the outer timeout kills a run whose stages are
-# all within their own contracts
-BENCH_TIMEOUT=${BENCH_TIMEOUT:-10500}
+# must exceed the sum of bench.py's per-stage budgets (_STAGES: 10380s with
+# attn_micro, the tuned re-run and the agg microbench; banked CPU baselines
+# usually shave 600s) plus the 180s probe, or the outer timeout kills a run
+# whose stages are all within their own contracts
+BENCH_TIMEOUT=${BENCH_TIMEOUT:-11100}
 SLEEP_DOWN=${SLEEP_DOWN:-120}     # tunnel down: re-probe every 2 min (short
                                   # up-windows are the norm; 10 min missed them)
 SLEEP_UP=${SLEEP_UP:-3600}        # after a good measurement: hourly is plenty
@@ -55,10 +55,32 @@ commit_artifacts() {
       log "no new artifact to commit"
     elif git commit -q -m "Record measured bench artifact from live chip" -- "${paths[@]}" 2>/tmp/bench_watch_commit.err; then
       log "artifact committed: $(git rev-parse --short HEAD)"
+      surface_agg_rates
     else
       log "COMMIT FAILED: $(tail -c 400 /tmp/bench_watch_commit.err)"
     fi
   fi
+}
+
+surface_agg_rates() {
+  # one-line view of the aggregation-engine measurement in the newest
+  # artifact, so the watcher log answers "how fast is agg on chip" without
+  # opening BENCH_MEASURED_*.json
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1) || return 0
+  [ -n "$newest" ] || return 0
+  local rates
+  rates=$(python3 - "$newest" <<'PYEOF' 2>/dev/null
+import json, sys
+doc = json.load(open(sys.argv[1]))
+agg = doc.get("agg_clients_per_sec") or {}
+if agg:
+    parts = [f"{label} {{{', '.join(f'K={k}: {v}/s' for k, v in r.items())}}}"
+             for label, r in agg.items()]
+    print(f"agg_clients_per_sec (bucket={doc.get('agg_bucket_size')}): " + "; ".join(parts))
+PYEOF
+) || return 0
+  [ -n "$rates" ] && log "$rates"
 }
 
 have_measured_headline() {
