@@ -769,6 +769,7 @@ def _bench_agg(reps_cap: int = 16):
     import jax
     import jax.numpy as jnp
 
+    from fedml_tpu.core import telemetry as tel
     from fedml_tpu.core.aggregation.bucketed import BucketedAggregator
 
     dev = jax.devices()[0]
@@ -823,10 +824,13 @@ def _bench_agg(reps_cap: int = 16):
             "geometry": geometry,
         }
 
-        def fresh_weights(n_real: int) -> jax.Array:
+        def fresh_weights(n_real: int) -> np.ndarray:
             w = np.abs(rng.standard_normal(bucket)).astype(np.float32) + 0.1
             w[n_real:] = 0.0  # zero-weight padding of the ragged tail
-            return jnp.asarray(w)
+            # host weights: the ENGINE does the upload at its comm boundary
+            # (booked as comm.host_to_device_bytes — visible in --trace runs),
+            # exactly what production rounds pay per bucket
+            return w
 
         def one_rep(k: int):
             acc = None
@@ -865,6 +869,15 @@ def _bench_agg(reps_cap: int = 16):
         hbm_gbps[label] = per_cohort_bw
         del clients
 
+    # per-span roll-up of the engine's own instrumentation (agg.bucket /
+    # agg.finalize counts + totals) — rides the artifact so bench_watch.sh
+    # can surface where the aggregation wall time went without a trace file
+    agg_span_summary = {
+        k: {"count": v["count"], "total_ms": round(v["total_ms"], 1),
+            "max_ms": round(v["max_ms"], 2)}
+        for k, v in tel.snapshot()["span_stats"].items()
+        if k.startswith("agg.")
+    }
     return {
         "agg_clients_per_sec": clients_per_sec,
         "agg_hbm_gbps": hbm_gbps,
@@ -875,6 +888,7 @@ def _bench_agg(reps_cap: int = 16):
         # ALL cohort sizes — the in-artifact proof of the single-compile
         # contract the tier-1 regression test pins
         "agg_accum_traces": eng.accum_traces,
+        "agg_span_summary": agg_span_summary,
         "device": getattr(dev, "device_kind", str(dev)),
     }
 
@@ -1434,15 +1448,42 @@ def _enable_compile_cache() -> None:
     enable_compile_cache()
 
 
-def _run_stage(name: str) -> None:
+def _run_stage(name: str, trace=None) -> None:
     """Entry point for `python bench.py --stage NAME`: run ONE measurement in
     this process and print exactly one JSON line. The process exits afterward,
     releasing every device buffer it held — the orchestrator's isolation
-    guarantee."""
+    guarantee.
+
+    ``trace`` (the --trace flag) wraps the stage in a ``bench.<name>``
+    telemetry span and writes the Chrome-trace/Perfetto JSON to that path on
+    the way out (open in ui.perfetto.dev). The overhead guard runs first:
+    ``span()`` on a disabled registry must stay under 1µs/call — the measured
+    number ships in the JSON (tier-1 pins the same bound) and a breach warns
+    on stderr."""
     if name not in ("cpu_llm", "cpu_resnet"):
         # torch-only baseline stages stay jax-free (their budgets are tight
         # and they never compile jax code)
         _enable_compile_cache()
+    if trace is None:
+        out = _stage_result(name)
+    else:
+        from fedml_tpu.core import telemetry as tel  # stdlib-only import
+
+        overhead_ns = tel.disabled_span_overhead_ns()
+        if overhead_ns >= 1000.0:
+            print(f"warning: disabled-path span costs {overhead_ns:.0f}ns/call "
+                  "(budget < 1000ns)", file=sys.stderr)
+        tel.set_enabled(True)
+        tel.reset()
+        with tel.span(f"bench.{name}"):
+            out = _stage_result(name)
+        out["trace_file"] = tel.export_chrome_trace(trace)
+        out["telemetry_disabled_span_ns"] = round(overhead_ns, 1)
+    print(json.dumps(_round_floats(out)))
+
+
+def _stage_result(name: str) -> dict:
+    """Dispatch ONE stage measurement and return its result dict."""
     _STAGE_T0 = time.monotonic()
     if name == "llm_pallas":
         # headline: Pallas flash attention, NO remat — with the [T,T]-free
@@ -1557,7 +1598,7 @@ def _run_stage(name: str) -> None:
         out = _bench_llm_serving()
     else:
         raise SystemExit(f"unknown stage {name!r}")
-    print(json.dumps(_round_floats(out)))
+    return out
 
 
 # (stage, per-stage wall budget seconds). Headline FIRST; serving LAST so its
@@ -2088,6 +2129,8 @@ def main() -> None:
         out["agg_hbm_gbps"] = agg["agg_hbm_gbps"]
         out["agg_bucket_size"] = agg["agg_bucket_size"]
         out["agg_accum_traces"] = agg["agg_accum_traces"]
+        if agg.get("agg_span_summary"):
+            out["agg_span_summary"] = agg["agg_span_summary"]
 
     attn = stage_out.get("attn_micro")
     if attn is not None:
@@ -2168,13 +2211,18 @@ def main_short(budget_s: int = 240) -> None:
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--stage", help="run one measurement stage and print its JSON")
+    parser.add_argument("--trace", metavar="OUT.json",
+                        help="with --stage: wrap the stage in a telemetry span and "
+                             "write a Chrome-trace/Perfetto JSON of it to this path")
     parser.add_argument("--short-window", action="store_true",
                         help="probe + one fast pallas headline stage, ~3-min budget")
     parser.add_argument("--cpu-baselines", action="store_true",
                         help="(re)measure and bank the torch-CPU denominators; no chip needed")
     ns = parser.parse_args()
+    if ns.trace and not ns.stage:
+        parser.error("--trace requires --stage")
     if ns.stage:
-        _run_stage(ns.stage)
+        _run_stage(ns.stage, trace=ns.trace)
     elif ns.cpu_baselines:
         banked = _ensure_cpu_baselines(force=True)
         print(json.dumps(banked or {"error": "cpu baseline stages failed"}))

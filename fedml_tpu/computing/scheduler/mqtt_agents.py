@@ -252,10 +252,10 @@ class MqttServerAgent:
         """Block until ``n`` distinct edges have checked in with capacity —
         a capacity-matched dispatch over a REAL broker must not race the
         agents' announcements."""
-        deadline = time.time() + timeout_s
+        deadline = time.time() + timeout_s  # wall-clock ok: wait deadline
         with self._cv:
             while len(self.capacity) < n:
-                remaining = deadline - time.time()
+                remaining = deadline - time.time()  # wall-clock ok: wait deadline
                 if remaining <= 0:
                     return False
                 self._cv.wait(timeout=min(remaining, 1.0))
@@ -370,7 +370,7 @@ class MqttServerAgent:
         if edge_ids is None:
             edge_ids = self.run_edges.get(run_id)
         targets = set(edge_ids if edge_ids is not None else self.edge_ids)
-        deadline = time.time() + timeout_s
+        deadline = time.time() + timeout_s  # wall-clock ok: wait deadline
         with self._cv:
             while True:
                 got = self.statuses.get(run_id, {})
@@ -378,7 +378,7 @@ class MqttServerAgent:
                 if targets <= done:
                     self._credit_locked(run_id, done)
                     return {e: got[e] for e in targets}
-                remaining = deadline - time.time()
+                remaining = deadline - time.time()  # wall-clock ok: wait deadline
                 if remaining <= 0:
                     self._credit_locked(run_id, done)
                     return {e: got.get(e, {"status": "TIMEOUT", "edge_id": e}) for e in targets}

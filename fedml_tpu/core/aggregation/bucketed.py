@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry as tel
+
 PyTree = Any
 
 DEFAULT_BUCKET_SIZE = 16
@@ -67,10 +69,12 @@ class BucketedAggregator:
         self.accum_traces = 0
         self.stacked_traces = 0
         # first bucket has no accumulator yet: a separate executable avoids a
-        # zeros-alloc + add per aggregate; the steady-state step donates acc
-        self._accum_first = jax.jit(self._accum_first_impl)
-        self._accum = jax.jit(self._accum_impl, donate_argnums=(0,))
-        self._scan_reduce = jax.jit(self._scan_reduce_impl)
+        # zeros-alloc + add per aggregate; the steady-state step donates acc.
+        # track_compiles mirrors accum_traces/stacked_traces into the
+        # process-wide telemetry counters (jax.compiles.agg_accum / agg_stacked)
+        self._accum_first = jax.jit(tel.track_compiles(self._accum_first_impl, name="agg_accum"))
+        self._accum = jax.jit(tel.track_compiles(self._accum_impl, name="agg_accum"), donate_argnums=(0,))
+        self._scan_reduce = jax.jit(tel.track_compiles(self._scan_reduce_impl, name="agg_stacked"))
         self._finalize_cache: Dict[Any, Any] = {}
 
     # --- jitted bodies ----------------------------------------------------
@@ -141,14 +145,20 @@ class BucketedAggregator:
         chunk = tuple(chunk)
         if len(chunk) != self.bucket_size:
             raise ValueError(f"chunk has {len(chunk)} trees, bucket_size is {self.bucket_size}")
-        weights = jnp.asarray(weights, dtype=jnp.float32)
-        if acc is None:
-            return self._accum_first(chunk, weights)
-        return self._accum(acc, chunk, weights)
+        if not isinstance(weights, jnp.ndarray):
+            weights = jnp.asarray(weights, dtype=jnp.float32)
+            tel.record_transfer("host_to_device", weights.nbytes)
+        else:
+            weights = weights.astype(jnp.float32)
+        with tel.span("agg.bucket", bucket_size=self.bucket_size, first=acc is None):
+            if acc is None:
+                return self._accum_first(chunk, weights)
+            return self._accum(acc, chunk, weights)
 
     def finalize(self, acc: PyTree, template: PyTree) -> PyTree:
         """Cast the f32 accumulator back to ``template``'s leaf dtypes."""
-        return self._finalize_fn(template)(acc)
+        with tel.span("agg.finalize"):
+            return self._finalize_fn(template)(acc)
 
     # --- public entry points ----------------------------------------------
     def aggregate(self, pairs: Sequence[Tuple[float, PyTree]]) -> PyTree:
@@ -161,16 +171,18 @@ class BucketedAggregator:
         if any(_is_object_leaf(l) for l in jax.tree.leaves(trees[0])):
             return _object_fold(trees, weights)
         b = self.bucket_size
-        acc = None
-        for start in range(0, len(trees), b):
-            chunk = trees[start : start + b]
-            w = weights[start : start + b]
-            if len(chunk) < b:  # ragged tail: zero-weight pad to bucket shape
-                pad = b - len(chunk)
-                chunk = list(chunk) + [chunk[-1]] * pad
-                w = np.concatenate([w, np.zeros((pad,), np.float32)])
-            acc = self.accumulate_bucket(acc, chunk, w)
-        return self.finalize(acc, trees[0])
+        with tel.span("agg.aggregate", k=len(trees), bucket_size=b):
+            acc = None
+            for start in range(0, len(trees), b):
+                chunk = trees[start : start + b]
+                w = weights[start : start + b]
+                if len(chunk) < b:  # ragged tail: zero-weight pad to bucket shape
+                    pad = b - len(chunk)
+                    with tel.span("agg.pad_tail", pad=pad, real=len(chunk)):
+                        chunk = list(chunk) + [chunk[-1]] * pad
+                        w = np.concatenate([w, np.zeros((pad,), np.float32)])
+                acc = self.accumulate_bucket(acc, chunk, w)
+            return self.finalize(acc, trees[0])
 
     def aggregate_stacked(self, stacked: PyTree, weights) -> PyTree:
         """``sum_k weights[k] * leaf[k]`` on leaves with a leading client

@@ -141,7 +141,7 @@ class GRPCCommManager(BaseCommunicationManager):
         else:
             data = message_to_bytes(msg)
         receiver = msg.get_receiver_id()
-        deadline = time.time() + 120.0
+        deadline = time.time() + 120.0  # wall-clock ok: retry deadline
         delay = 0.2
         while True:
             try:
@@ -149,7 +149,7 @@ class GRPCCommManager(BaseCommunicationManager):
                 return
             except grpc.RpcError as e:  # pragma: no cover - timing dependent
                 code = e.code() if hasattr(e, "code") else None
-                if code != grpc.StatusCode.UNAVAILABLE or time.time() > deadline:
+                if code != grpc.StatusCode.UNAVAILABLE or time.time() > deadline:  # wall-clock ok: retry deadline
                     raise
                 time.sleep(delay)
                 delay = min(delay * 2, 5.0)

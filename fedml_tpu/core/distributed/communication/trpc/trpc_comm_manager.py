@@ -215,7 +215,7 @@ class TRPCCommManager(BaseCommunicationManager):
                     pass
             self._out_locks.setdefault(receiver, threading.Lock())
         addr = (self.ip_table.get(receiver, "127.0.0.1"), self.base_port + receiver)
-        deadline = time.time() + 120.0
+        deadline = time.time() + 120.0  # wall-clock ok: retry deadline
         delay = 0.1
         while True:
             try:
@@ -229,7 +229,7 @@ class TRPCCommManager(BaseCommunicationManager):
                         self._out_socks[receiver] = sock
                     return self._out_socks[receiver]
             except OSError:
-                if time.time() > deadline:
+                if time.time() > deadline:  # wall-clock ok: retry deadline
                     raise
                 time.sleep(delay)
                 delay = min(delay * 2, 2.0)

@@ -1,0 +1,59 @@
+"""Unified telemetry: spans, counters, histograms, Perfetto export.
+
+See docs/observability.md for the span taxonomy and naming conventions.
+Typical use::
+
+    from fedml_tpu.core import telemetry as tel
+
+    with tel.span("fedavg.round", round=3):
+        ...
+    tel.counter("comm.host_to_device_bytes").add(nbytes)
+    tel.histogram("server.aggregate_seconds").observe(dt)
+    tel.export_chrome_trace("/tmp/round.json")   # open in ui.perfetto.dev
+"""
+
+from .core import (
+    Counter,
+    Histogram,
+    Telemetry,
+    counter,
+    disabled_span_overhead_ns,
+    export_chrome_trace,
+    get_telemetry,
+    histogram,
+    reset,
+    set_enabled,
+    snapshot,
+    span,
+    summary,
+    timed,
+)
+from .jax_hooks import (
+    D2H_BYTES,
+    H2D_BYTES,
+    compile_count,
+    record_transfer,
+    track_compiles,
+)
+
+__all__ = [
+    "Telemetry",
+    "Counter",
+    "Histogram",
+    "get_telemetry",
+    "span",
+    "timed",
+    "counter",
+    "histogram",
+    "snapshot",
+    "summary",
+    "export_chrome_trace",
+    "set_enabled",
+    "reset",
+    "disabled_span_overhead_ns",
+    "track_compiles",
+    "compile_count",
+    "record_transfer",
+    "H2D_BYTES",
+    "D2H_BYTES",
+]

@@ -12,6 +12,7 @@ import logging
 from typing import Any, Dict, Optional
 
 from ... import mlops
+from ...core import telemetry as tel
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ...parallel.multihost import broadcast_model_params, broadcast_round_metadata, process_count
@@ -113,10 +114,11 @@ class ClientMasterManager(FedMLCommManager):
 
     def send_model_to_server(self, receive_id: int, weights, local_sample_num) -> None:
         mlops.event("comm_c2s", event_started=True, event_value=str(self.args.round_idx))
-        message = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.client_real_id, receive_id)
-        message.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
-        message.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, int(local_sample_num))
-        self.send_message(message)
+        with tel.span("client.upload", round=int(self.args.round_idx)):
+            message = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.client_real_id, receive_id)
+            message.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
+            message.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, int(local_sample_num))
+            self.send_message(message)
 
     def __train(self) -> None:
         log.info("====== training on round %d ======", self.args.round_idx)
@@ -135,6 +137,7 @@ class ClientMasterManager(FedMLCommManager):
             )
             broadcast_model_params(self.trainer_dist_adapter.get_model_params(), is_source=True)
         mlops.event("train", event_started=True, event_value=str(self.args.round_idx))
-        weights, local_sample_num = self.trainer_dist_adapter.train(self.args.round_idx)
+        with tel.span("client.train", round=int(self.args.round_idx)):
+            weights, local_sample_num = self.trainer_dist_adapter.train(self.args.round_idx)
         mlops.event("train", event_started=False, event_value=str(self.args.round_idx))
         self.send_model_to_server(0, weights, local_sample_num)

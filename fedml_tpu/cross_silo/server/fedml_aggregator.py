@@ -17,6 +17,7 @@ import numpy as np
 from jax import tree as jax_tree
 
 from ... import mlops
+from ...core import telemetry as tel
 from ...core.alg_frame.context import Context
 from ...utils.pytree import tree_from_numpy
 
@@ -91,17 +92,23 @@ class FedMLAggregator:
         return False
 
     def aggregate(self):
-        start = time.time()
-        Context().add("client_indexes_of_round", sorted(self.model_dict))
-        model_list = [(self.sample_num_dict[i], self.model_dict[i]) for i in sorted(self.model_dict)]
-        model_list = self.aggregator.on_before_aggregation(model_list)
-        Context().add(Context.KEY_CLIENT_MODEL_LIST, model_list)
-        averaged = self.aggregator.aggregate(model_list)
-        averaged = self.aggregator.on_after_aggregation(averaged)
-        self.set_global_model_params(averaged)
-        self.aggregator.assess_contribution()
-        self.model_dict.clear()
-        log.info("aggregate time cost: %.3fs", time.time() - start)
+        # perf_counter, not the wall clock: NTP steps / slew must not corrupt
+        # the duration series the autoscaling + PiPar-style phase analysis
+        # read (tools/check_timing.py enforces repo-wide)
+        start = time.perf_counter()
+        with tel.span("server.aggregate", k=len(self.model_dict)):
+            Context().add("client_indexes_of_round", sorted(self.model_dict))
+            model_list = [(self.sample_num_dict[i], self.model_dict[i]) for i in sorted(self.model_dict)]
+            model_list = self.aggregator.on_before_aggregation(model_list)
+            Context().add(Context.KEY_CLIENT_MODEL_LIST, model_list)
+            averaged = self.aggregator.aggregate(model_list)
+            averaged = self.aggregator.on_after_aggregation(averaged)
+            self.set_global_model_params(averaged)
+            self.aggregator.assess_contribution()
+            self.model_dict.clear()
+        dt = time.perf_counter() - start
+        tel.histogram("server.aggregate_seconds").observe(dt)
+        log.info("aggregate time cost: %.3fs", dt)
         return averaged
 
     def data_silo_selection(self, round_idx: int, client_num_in_total: int, client_num_per_round: int) -> List[int]:

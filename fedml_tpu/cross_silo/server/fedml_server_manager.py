@@ -11,6 +11,7 @@ import logging
 from typing import Any, Dict, Optional
 
 from ... import mlops
+from ...core import telemetry as tel
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ..message_define import MyMessage
@@ -81,17 +82,21 @@ class FedMLServerManager(FedMLCommManager):
         sender_id = msg_params.get_sender_id()
         model_params = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         local_sample_number = msg_params.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
-        self.aggregator.add_local_trained_result(sender_id - 1, model_params, local_sample_number)
+        with tel.span("server.receive_model", round=int(self.args.round_idx), sender=int(sender_id)):
+            self.aggregator.add_local_trained_result(sender_id - 1, model_params, local_sample_number)
         if not self.aggregator.check_whether_all_receive():
             return
         mlops.event("server.wait", event_started=False, event_value=str(self.args.round_idx))
         mlops.event("server.agg_and_eval", event_started=True, event_value=str(self.args.round_idx))
+        # FedMLAggregator.aggregate opens the server.aggregate span itself
         global_model_params = self.aggregator.aggregate()
-        metrics = self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+        with tel.span("server.eval", round=int(self.args.round_idx)):
+            metrics = self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
         if metrics is not None:
             self.final_metrics = metrics
         mlops.event("server.agg_and_eval", event_started=False, event_value=str(self.args.round_idx))
         mlops.log_round_info(self.round_num, self.args.round_idx)
+        mlops.log_telemetry_summary(self.args.round_idx)
 
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:
@@ -107,8 +112,11 @@ class FedMLServerManager(FedMLCommManager):
             int(getattr(self.args, "client_num_in_total", self.size - 1)),
             len(self.client_id_list_in_this_round),
         )
-        for idx, receiver_id in enumerate(self.client_id_list_in_this_round):
-            self.send_message_sync_model_to_client(receiver_id, global_model_params, self.data_silo_index_list[idx])
+        with tel.span(
+            "server.broadcast", round=int(self.args.round_idx), receivers=len(self.client_id_list_in_this_round)
+        ):
+            for idx, receiver_id in enumerate(self.client_id_list_in_this_round):
+                self.send_message_sync_model_to_client(receiver_id, global_model_params, self.data_silo_index_list[idx])
         mlops.event("server.wait", event_started=True, event_value=str(self.args.round_idx))
 
     # --- senders ----------------------------------------------------------

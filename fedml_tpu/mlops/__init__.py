@@ -30,14 +30,18 @@ class MLOpsProfilerEvent:
         self._open: Dict[str, float] = {}
 
     def log_event_started(self, event_name: str, event_value: Optional[str] = None) -> None:
-        self._open[event_name] = time.time()
-        self._runtime.append_record({"type": "event_started", "name": event_name, "value": event_value, "t": self._open[event_name]})
+        # records carry a wall timestamp, but the duration is computed on the
+        # monotonic timeline so clock steps can't produce negative spans
+        self._open[event_name] = time.perf_counter()
+        self._runtime.append_record(
+            {"type": "event_started", "name": event_name, "value": event_value, "t": time.time()}  # wall-clock ok
+        )
 
     def log_event_ended(self, event_name: str, event_value: Optional[str] = None) -> None:
         t0 = self._open.pop(event_name, None)
-        t1 = time.time()
+        dur = (time.perf_counter() - t0) if t0 is not None else None
         self._runtime.append_record(
-            {"type": "event_ended", "name": event_name, "value": event_value, "t": t1, "duration": (t1 - t0) if t0 else None}
+            {"type": "event_ended", "name": event_name, "value": event_value, "t": time.time(), "duration": dur}  # wall-clock ok
         )
 
 
@@ -137,6 +141,30 @@ def event(event_name: str, event_started: bool = True, event_value: Optional[str
 def log_round_info(total_rounds: int, round_index: int) -> None:
     """Reference: mlops.log_round_info at core/mlops/__init__.py:1001."""
     log({"round_index": round_index, "total_rounds": total_rounds}, step=round_index)
+
+
+def log_telemetry_summary(round_idx: Optional[int] = None) -> None:
+    """Publish the telemetry roll-up (span stats, comm byte counters,
+    histograms — ``core/telemetry``) as a metric record. Routed through
+    ``append_record``, it reaches the run's events.jsonl and, when an uplink
+    is attached, ``MLOpsUplink.publish`` — deployments get per-round phase
+    timings with no new infra. Aggregates are cumulative since process start
+    (diff consecutive rounds for per-round deltas)."""
+    from ..core.telemetry import get_telemetry
+
+    t = get_telemetry()
+    if not t.enabled:
+        return
+    rec: Dict[str, Any] = {
+        "type": "metric",
+        "name": "telemetry_round_summary",
+        "t": time.time(),  # wall-clock ok: record timestamp, not a duration
+        "summary": t.summary(),
+    }
+    if round_idx is not None:
+        rec["round"] = int(round_idx)
+        rec["step"] = int(round_idx)
+    MLOpsRuntime.get_instance().append_record(rec)
 
 
 def log_training_status(status: str, run_id: Optional[str] = None) -> None:

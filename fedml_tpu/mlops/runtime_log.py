@@ -31,22 +31,42 @@ class MLOpsRuntimeLog:
     """Attach a per-run FileHandler to the root logger."""
 
     _handlers = {}
+    _lock = threading.Lock()
 
     @classmethod
     def init(cls, run_dir: str, run_id: str, rank: int = 0) -> str:
         os.makedirs(run_dir, exist_ok=True)
         path = log_file_path(run_id, rank, run_dir)
         key = (run_id, rank)
-        if key not in cls._handlers:
-            h = logging.FileHandler(path)
-            h.setFormatter(logging.Formatter("[FedML-TPU] %(asctime)s %(levelname)s %(name)s: %(message)s"))
-            logging.getLogger().addHandler(h)
-            cls._handlers[key] = h
+        # the lock closes the check-then-add race: two threads hitting init
+        # during a detach/re-init cycle must not each attach a FileHandler
+        # (duplicate handlers double every line in the shipped log)
+        with cls._lock:
+            if key not in cls._handlers:
+                root = logging.getLogger()
+                # a handler for this path may survive from a crashed detach
+                # (e.g. close() raised); adopt it instead of stacking another
+                existing = next(
+                    (
+                        h
+                        for h in root.handlers
+                        if isinstance(h, logging.FileHandler) and getattr(h, "baseFilename", None) == os.path.abspath(path)
+                    ),
+                    None,
+                )
+                if existing is None:
+                    existing = logging.FileHandler(path)
+                    existing.setFormatter(
+                        logging.Formatter("[FedML-TPU] %(asctime)s %(levelname)s %(name)s: %(message)s")
+                    )
+                    root.addHandler(existing)
+                cls._handlers[key] = existing
         return path
 
     @classmethod
     def detach(cls, run_id: str, rank: int = 0) -> None:
-        h = cls._handlers.pop((run_id, rank), None)
+        with cls._lock:
+            h = cls._handlers.pop((run_id, rank), None)
         if h is not None:
             logging.getLogger().removeHandler(h)
             h.close()
@@ -132,6 +152,11 @@ class MLOpsRuntimeLogDaemon:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        # final drain from the CALLER's thread: lines written after the loop's
+        # last poll (or when the daemon never started / the join timed out)
+        # must still reach the sink. poll_once is offset-based, so this is a
+        # no-op when the loop's own final drain already shipped everything.
+        self.poll_once(final=True)
 
 
 class SysPerfSampler:
@@ -145,7 +170,7 @@ class SysPerfSampler:
         self._thread: Optional[threading.Thread] = None
 
     def sample_once(self) -> dict:
-        rec = {"type": "sys_perf", "t": time.time()}
+        rec = {"type": "sys_perf", "t": time.time()}  # wall-clock ok: record timestamp
         try:
             import psutil
 

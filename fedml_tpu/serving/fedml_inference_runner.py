@@ -87,9 +87,9 @@ class _MicroBatcher:
                 self._drain_on_stop()
                 return
             batch = [first]
-            deadline = time.time() + self.window_s
+            deadline = time.time() + self.window_s  # wall-clock ok: window deadline
             while len(batch) < self.max_batch:
-                remaining = deadline - time.time()
+                remaining = deadline - time.time()  # wall-clock ok: window deadline
                 if remaining <= 0:
                     break
                 try:

@@ -27,6 +27,8 @@ import uuid
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
+from ..core import telemetry as tel
+
 log = logging.getLogger(__name__)
 
 
@@ -63,8 +65,8 @@ class SubprocessReplica:
         self.consecutive_failures = 0
 
     def _await_port(self, timeout_s: float) -> int:
-        deadline = time.time() + timeout_s
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
             if os.path.exists(self._port_file):
                 try:
                     return int(open(self._port_file).read())
@@ -189,7 +191,9 @@ class GatewayStats:
     latency_ewma_s: float = 0.0
 
     def qps(self) -> float:
-        dt = time.time() - self.window_start
+        # window_start is on the perf_counter timeline: wall-clock steps
+        # (NTP) must not spike the QPS the autoscaler acts on
+        dt = time.perf_counter() - self.window_start
         return self.window_requests / dt if dt > 0 else 0.0
 
 
@@ -199,13 +203,13 @@ class InferenceGateway:
 
     def __init__(self, replica_set: ReplicaSet):
         self.replica_set = replica_set
-        self.stats = GatewayStats(window_start=time.time())
+        self.stats = GatewayStats(window_start=time.perf_counter())
         self._rr = 0
         self._lock = threading.Lock()
 
     def reset_window(self) -> None:
         with self._lock:
-            self.stats.window_start = time.time()
+            self.stats.window_start = time.perf_counter()
             self.stats.window_requests = 0
 
     def predict(self, payload: Dict[str, Any], *, timeout_s: float = 30.0, retries: int = 3) -> Dict[str, Any]:
@@ -221,14 +225,17 @@ class InferenceGateway:
             with self._lock:
                 r = healthy[self._rr % len(healthy)]
                 self._rr += 1
-            t0 = time.perf_counter()
             try:
-                req = urllib.request.Request(
-                    r.url + "/predict", data=data, headers={"Content-Type": "application/json"}
-                )
-                with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-                    out = json.loads(resp.read())
-                dt = time.perf_counter() - t0
+                # tel.timed: the EWMA consumes the duration, and the span
+                # lands per-request latency in traces when telemetry is on
+                with tel.timed("serving.predict", replica=r.id) as sp:
+                    req = urllib.request.Request(
+                        r.url + "/predict", data=data, headers={"Content-Type": "application/json"}
+                    )
+                    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                        out = json.loads(resp.read())
+                dt = sp.duration_s
+                tel.histogram("serving.request_seconds").observe(dt)
                 with self._lock:
                     r.consecutive_failures = 0
                     s = self.stats
@@ -238,6 +245,7 @@ class InferenceGateway:
                 return out
             except (urllib.error.URLError, OSError, ConnectionError) as e:
                 last_err = e
+                tel.counter("serving.request_errors").add(1)
                 with self._lock:
                     r.consecutive_failures += 1
                     self.stats.errors += 1
@@ -278,7 +286,9 @@ class AutoScaler:
         return max(self.min_replicas, min(self.max_replicas, want))
 
     def tick(self, now: Optional[float] = None) -> int:
-        now = now if now is not None else time.time()
+        # cooldown arithmetic on the monotonic timeline (an explicit `now`
+        # must share the perf_counter basis)
+        now = now if now is not None else time.perf_counter()
         rs = self.gateway.replica_set
         want = self.desired_replicas()
         have = rs.desired

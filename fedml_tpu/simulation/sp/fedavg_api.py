@@ -24,6 +24,8 @@ from ...constants import (
     FEDML_FEDERATED_OPTIMIZER_MIME,
     FEDML_FEDERATED_OPTIMIZER_SCAFFOLD,
 )
+from ... import mlops
+from ...core import telemetry as tel
 from ...core.aggregation.agg_operator import fednova_aggregate, scaffold_aggregate, uniform_average
 from ...core.aggregation.server_optimizer import FedOptServer
 from ...core.alg_frame.context import Context
@@ -109,41 +111,47 @@ class FedAvgAPI:
         comm_round = int(getattr(self.args, "comm_round", 10))
         for round_idx in range(comm_round):
             log.info("================ Communication round : %d", round_idx)
-            client_indexes = self._client_sampling(
-                round_idx, int(self.args.client_num_in_total), int(self.args.client_num_per_round)
-            )
-            Context().add("client_indexes_of_round", client_indexes)
-            w_locals: List[Tuple[float, Any]] = []
-            for idx, client in enumerate(self.client_list):
-                client_idx = client_indexes[idx]
-                client.update_local_dataset(
-                    client_idx,
-                    self.train_data_local_dict[client_idx],
-                    self.test_data_local_dict[client_idx],
-                    self.train_data_local_num_dict[client_idx],
-                )
-                if self.fed_opt == FEDML_FEDERATED_OPTIMIZER_SCAFFOLD:
-                    self.model_trainer.set_control_variate(self._scaffold_c)
-                elif self.fed_opt == FEDML_FEDERATED_OPTIMIZER_MIME:
-                    self.model_trainer.set_server_momentum(self._mime_s)
-                w = client.train(w_global)
-                payload = getattr(self.model_trainer, "round_payload", None)
-                if self.fed_opt in (
-                    FEDML_FEDERATED_OPTIMIZER_FEDNOVA,
-                    FEDML_FEDERATED_OPTIMIZER_SCAFFOLD,
-                    FEDML_FEDERATED_OPTIMIZER_MIME,
-                ) and payload is not None:
-                    w_locals.append((client.get_sample_number(), payload))
-                else:
-                    w_locals.append((client.get_sample_number(), w))
-            w_global = self._server_update(w_global, w_locals)
-            self.model_trainer.set_model_params(w_global)
-            self.aggregator.set_model_params(w_global)
+            with tel.span("fedavg.round", round=round_idx, optimizer=self.fed_opt):
+                with tel.span("fedavg.sample", round=round_idx):
+                    client_indexes = self._client_sampling(
+                        round_idx, int(self.args.client_num_in_total), int(self.args.client_num_per_round)
+                    )
+                Context().add("client_indexes_of_round", client_indexes)
+                w_locals: List[Tuple[float, Any]] = []
+                for idx, client in enumerate(self.client_list):
+                    client_idx = client_indexes[idx]
+                    client.update_local_dataset(
+                        client_idx,
+                        self.train_data_local_dict[client_idx],
+                        self.test_data_local_dict[client_idx],
+                        self.train_data_local_num_dict[client_idx],
+                    )
+                    if self.fed_opt == FEDML_FEDERATED_OPTIMIZER_SCAFFOLD:
+                        self.model_trainer.set_control_variate(self._scaffold_c)
+                    elif self.fed_opt == FEDML_FEDERATED_OPTIMIZER_MIME:
+                        self.model_trainer.set_server_momentum(self._mime_s)
+                    with tel.span("fedavg.client_train", round=round_idx, client=int(client_idx)):
+                        w = client.train(w_global)
+                    payload = getattr(self.model_trainer, "round_payload", None)
+                    if self.fed_opt in (
+                        FEDML_FEDERATED_OPTIMIZER_FEDNOVA,
+                        FEDML_FEDERATED_OPTIMIZER_SCAFFOLD,
+                        FEDML_FEDERATED_OPTIMIZER_MIME,
+                    ) and payload is not None:
+                        w_locals.append((client.get_sample_number(), payload))
+                    else:
+                        w_locals.append((client.get_sample_number(), w))
+                with tel.span("fedavg.aggregate", round=round_idx, k=len(w_locals)):
+                    w_global = self._server_update(w_global, w_locals)
+                self.model_trainer.set_model_params(w_global)
+                self.aggregator.set_model_params(w_global)
 
-            freq = int(getattr(self.args, "frequency_of_the_test", 5))
-            if round_idx == comm_round - 1 or (freq > 0 and round_idx % freq == 0):
-                metrics = self._test_global(round_idx)
-                self.metrics_history.append(metrics)
+                freq = int(getattr(self.args, "frequency_of_the_test", 5))
+                if round_idx == comm_round - 1 or (freq > 0 and round_idx % freq == 0):
+                    with tel.span("fedavg.eval", round=round_idx):
+                        metrics = self._test_global(round_idx)
+                    self.metrics_history.append(metrics)
+            mlops.log_telemetry_summary(round_idx)
         return self.metrics_history[-1] if self.metrics_history else {}
 
     # ------------------------------------------------------------------

@@ -16,11 +16,11 @@ multi-worker deployment would experience).
 from __future__ import annotations
 
 import logging
-import time
 from typing import Any, Dict, List, Tuple
 
 import jax
 
+from ...core import telemetry as tel
 from ...core.alg_frame.context import Context
 from ...core.schedule.runtime_estimate import t_sample_fit
 from ...core.schedule.seq_train_scheduler import SeqTrainScheduler
@@ -106,10 +106,12 @@ class FedAvgSeqAPI(FedAvgAPI):
                         self.test_data_local_dict[cid],
                         self.train_data_local_num_dict[cid],
                     )
-                    t0 = time.perf_counter()
-                    w_local = client.train(w_global)
-                    jax.block_until_ready(w_local)
-                    dt = time.perf_counter() - t0
+                    # tel.timed: always measures (the scheduler consumes dt),
+                    # records the span only when telemetry is enabled
+                    with tel.timed("fedavg.client_train", round=r, client=int(cid), lane=w) as sp:
+                        w_local = client.train(w_global)
+                        jax.block_until_ready(w_local)
+                    dt = sp.duration_s
                     lane_times[w] += dt
                     if r > 0:
                         # round 0 wall times include one-off jit compiles,

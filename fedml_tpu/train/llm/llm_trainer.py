@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import functools
 import logging
-import time
 from typing import Any, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -21,6 +20,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ...core import telemetry as tel
 from ...models.lora import lora_mask
 from ...models.transformer import TransformerConfig, TransformerLM
 from ...parallel.fsdp import make_fsdp_train_step, param_shardings
@@ -227,25 +227,30 @@ class LLMTrainer:
                 batches = synthetic_token_batches(
                     self.cfg.vocab_size, self.model_args.seq_len, global_batch, exp.max_steps, exp.seed
                 )
-        losses, t0, tokens_seen = [], time.perf_counter(), 0
+        losses, tokens_seen = [], 0
         step = 0
-        for step, (toks, mask) in enumerate(batches):
-            self.params, self.opt_state, loss = self._step_fn(
-                self.params, self.opt_state, jnp.asarray(toks), jnp.asarray(mask)
-            )
-            losses.append(loss)
-            tokens_seen += toks.size
-            if exp.save_steps and (step + 1) % exp.save_steps == 0:
-                self.save(step + 1)
-            if step + 1 >= exp.max_steps:
-                break
-        jax.block_until_ready(self.params)
-        dt = time.perf_counter() - t0
+        # tel.timed: tokens/sec consumes the window duration; the span itself
+        # shows the whole local-training window in round traces
+        with tel.timed("llm.train", max_steps=exp.max_steps) as sp:
+            for step, (toks, mask) in enumerate(batches):
+                self.params, self.opt_state, loss = self._step_fn(
+                    self.params, self.opt_state, jnp.asarray(toks), jnp.asarray(mask)
+                )
+                losses.append(loss)
+                tokens_seen += toks.size
+                if exp.save_steps and (step + 1) % exp.save_steps == 0:
+                    self.save(step + 1)
+                if step + 1 >= exp.max_steps:
+                    break
+            jax.block_until_ready(self.params)
+        dt = sp.duration_s
         final_loss = float(jax.device_get(losses[-1])) if losses else float("nan")
+        tokens_per_sec = tokens_seen / dt if dt > 0 else 0.0
+        tel.histogram("llm.tokens_per_sec").observe(tokens_per_sec)
         metrics = {
             "final_loss": final_loss,
             "steps": step + 1,
-            "tokens_per_sec": tokens_seen / dt if dt > 0 else 0.0,
+            "tokens_per_sec": tokens_per_sec,
         }
         log.info("LLM train done: %s", metrics)
         self.save(step + 1)

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.telemetry import record_transfer
+
 PyTree = Any
 
 
@@ -143,6 +145,7 @@ def tree_to_numpy(a: PyTree) -> PyTree:
         ls = [leaves[i] for i in idxs]
         flat = jnp.concatenate([jnp.ravel(x) for x in ls]) if len(ls) > 1 else jnp.ravel(ls[0])
         host = np.asarray(jax.device_get(flat))
+        record_transfer("device_to_host", host.nbytes)
         off = 0
         for i, x in zip(idxs, ls):
             out[i] = host[off : off + x.size].reshape(x.shape)
@@ -191,6 +194,7 @@ def tree_from_numpy(a: PyTree, device=None) -> PyTree:
         arrs = [arr for _, arr in items]
         flat_host = np.concatenate([np.ravel(x) for x in arrs]) if len(arrs) > 1 else np.ravel(arrs[0])
         flat = jnp.asarray(flat_host)  # ONE transfer (+ x64 canonicalization)
+        record_transfer("host_to_device", flat_host.nbytes)
         if device is not None:
             flat = jax.device_put(flat, device)
         shapes = tuple(x.shape for x in arrs)

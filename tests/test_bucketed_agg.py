@@ -216,12 +216,15 @@ class TestFlashFallbackMarker:
 
 @pytest.mark.slow
 def test_bench_agg_stage_emits_valid_json(tmp_path):
-    """`bench.py --stage agg` prints exactly one JSON line with per-cohort
-    clients/sec for both pytrees (tiny CPU geometry)."""
+    """`bench.py --stage agg --trace OUT.json` prints exactly one JSON line
+    with per-cohort clients/sec for both pytrees (tiny CPU geometry) AND
+    writes a Chrome-trace with per-bucket agg spans + comm byte counters."""
+    trace_path = tmp_path / "agg_trace.json"
     env = dict(os.environ, JAX_PLATFORMS="cpu", FEDML_BENCH_TINY="1")
     env.pop("PALLAS_AXON_POOL_IPS", None)
     proc = subprocess.run(
-        [sys.executable, os.path.join(_REPO, "bench.py"), "--stage", "agg"],
+        [sys.executable, os.path.join(_REPO, "bench.py"), "--stage", "agg",
+         "--trace", str(trace_path)],
         env=env, capture_output=True, text=True, timeout=900,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
@@ -237,3 +240,18 @@ def test_bench_agg_stage_emits_valid_json(tmp_path):
     # one compile pair PER PYTREE for the whole cohort sweep (2 pytrees x
     # first-bucket + steady-state): the engine's single-compile claim
     assert out["agg_accum_traces"] == 4
+    # the artifact roll-up of the engine's own spans rides the stage JSON
+    assert out["agg_span_summary"]["agg.bucket"]["count"] > 0
+
+    # --trace acceptance: the stage's Perfetto trace holds the per-bucket
+    # engine spans and the comm-boundary byte counters (the per-bucket host
+    # weight upload), wrapped in the stage span, under the overhead budget
+    assert out["trace_file"] == str(trace_path)
+    assert out["telemetry_disabled_span_ns"] < 1000.0
+    doc = json.loads(trace_path.read_text())
+    events = doc["traceEvents"]
+    span_names = {e["name"] for e in events if e["ph"] == "X"}
+    assert {"bench.agg", "agg.bucket", "agg.finalize"} <= span_names
+    counter_names = {e["name"] for e in events if e["ph"] == "C"}
+    assert "comm.host_to_device_bytes" in counter_names
+    assert "jax.compiles.agg_accum" in counter_names

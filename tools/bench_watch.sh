@@ -56,6 +56,7 @@ commit_artifacts() {
     elif git commit -q -m "Record measured bench artifact from live chip" -- "${paths[@]}" 2>/tmp/bench_watch_commit.err; then
       log "artifact committed: $(git rev-parse --short HEAD)"
       surface_agg_rates
+      surface_span_summary
     else
       log "COMMIT FAILED: $(tail -c 400 /tmp/bench_watch_commit.err)"
     fi
@@ -81,6 +82,27 @@ if agg:
 PYEOF
 ) || return 0
   [ -n "$rates" ] && log "$rates"
+}
+
+surface_span_summary() {
+  # one-line roll-up of the telemetry span stats riding the newest artifact
+  # (agg_span_summary: count/total_ms/max_ms per agg.* span), so the watcher
+  # log answers "where did the aggregation wall time go" per round
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1) || return 0
+  [ -n "$newest" ] || return 0
+  local spans
+  spans=$(python3 - "$newest" <<'PYEOF' 2>/dev/null
+import json, sys
+doc = json.load(open(sys.argv[1]))
+stats = doc.get("agg_span_summary") or {}
+if stats:
+    parts = [f"{name} x{st['count']} {st['total_ms']:.0f}ms (max {st['max_ms']:.1f}ms)"
+             for name, st in sorted(stats.items())]
+    print("agg spans: " + "; ".join(parts))
+PYEOF
+) || return 0
+  [ -n "$spans" ] && log "$spans"
 }
 
 have_measured_headline() {

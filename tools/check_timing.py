@@ -1,0 +1,59 @@
+#!/usr/bin/env python3
+"""Timing-idiom lint: no new ``time.time()`` duration measurements.
+
+``time.time()`` follows the wall clock — NTP steps and slew corrupt any
+duration computed from it (a negative "aggregate time" poisons runtime fits
+and autoscaling). Durations belong to the telemetry layer
+(``fedml_tpu/core/telemetry``: span/timed/histogram, perf_counter-based).
+
+The rule enforced over every ``fedml_tpu/**/*.py`` file: a line containing
+``time.time()`` must carry a ``# wall-clock ok: <reason>`` marker on the same
+line. The marker is the allowlist — legitimate uses are *timestamps* (record
+fields, DB rows) and *wall deadlines* (timeouts coordinated with other
+processes), and the reason says which. Anything unmarked fails tier-1
+(tests/test_telemetry.py invokes ``main()``).
+
+Exit status: 0 clean, 1 with violations listed on stdout.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+MARKER = "wall-clock ok"
+PATTERN = "time.time()"  # substring: also catches `_time.time()` aliases
+
+
+def find_violations(root: str) -> list:
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if PATTERN in line and MARKER not in line:
+                        violations.append((path, lineno, line.strip()))
+    return violations
+
+
+def main(argv: list = ()) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = argv[0] if argv else os.path.join(repo, "fedml_tpu")
+    violations = find_violations(root)
+    for path, lineno, line in violations:
+        print(f"{os.path.relpath(path, repo)}:{lineno}: unmarked time.time(): {line}")
+    if violations:
+        print(
+            f"\n{len(violations)} unmarked time.time() call(s). Durations must use "
+            "fedml_tpu.core.telemetry (span/timed/histogram, perf_counter-based); "
+            f"genuine timestamps/deadlines need a '# {MARKER}: <reason>' marker."
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
