@@ -1477,7 +1477,9 @@ def _run_stage(name: str, trace=None) -> None:
         tel.reset()
         with tel.span(f"bench.{name}"):
             out = _stage_result(name)
-        out["trace_file"] = tel.export_chrome_trace(trace)
+        # merge: multi-stage runs pointing --trace at ONE path accumulate
+        # events instead of each stage clobbering the previous stage's spans
+        out["trace_file"] = tel.export_chrome_trace(trace, merge=True)
         out["telemetry_disabled_span_ns"] = round(overhead_ns, 1)
     print(json.dumps(_round_floats(out)))
 
@@ -2213,7 +2215,9 @@ if __name__ == "__main__":
     parser.add_argument("--stage", help="run one measurement stage and print its JSON")
     parser.add_argument("--trace", metavar="OUT.json",
                         help="with --stage: wrap the stage in a telemetry span and "
-                             "write a Chrome-trace/Perfetto JSON of it to this path")
+                             "write a Chrome-trace/Perfetto JSON of it to this path; "
+                             "an existing trace file is merged into, so multi-stage "
+                             "runs sharing one path keep every stage's spans")
     parser.add_argument("--short-window", action="store_true",
                         help="probe + one fast pallas headline stage, ~3-min budget")
     parser.add_argument("--cpu-baselines", action="store_true",

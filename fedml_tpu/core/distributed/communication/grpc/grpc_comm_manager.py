@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 import grpc
 
 from .....constants import GRPC_BASE_PORT
+from .....core.telemetry import trace_context
 from ..base_com_manager import BaseCommunicationManager, Observer
 from ..codec import message_from_bytes, message_to_bytes
 from ..message import Message
@@ -134,6 +135,7 @@ class GRPCCommManager(BaseCommunicationManager):
         retry until the receiver's server socket exists)."""
         import time
 
+        trace_context.inject(msg)
         if self.wire == "fedml":
             from . import ref_wire
 
@@ -171,8 +173,9 @@ class GRPCCommManager(BaseCommunicationManager):
                 continue
             if item is _STOP:
                 break
-            for obs in list(self._observers):
-                obs.receive_message(item.get_type(), item)
+            with trace_context.activated(trace_context.extract(item)):
+                for obs in list(self._observers):
+                    obs.receive_message(item.get_type(), item)
 
     def stop_receive_message(self) -> None:
         self._running = False

@@ -14,6 +14,7 @@ import queue
 import threading
 from typing import List, Optional
 
+from .....core.telemetry import trace_context
 from ..base_com_manager import BaseCommunicationManager, Observer
 from ..message import Message
 from .broker import InMemoryBroker
@@ -33,6 +34,7 @@ class InMemoryCommManager(BaseCommunicationManager):
         self._running = False
 
     def send_message(self, msg: Message) -> None:
+        trace_context.inject(msg)
         receiver = msg.get_receiver_id()
         log.debug("inmemory send %s", msg)
         self.broker.publish(receiver, msg)
@@ -54,8 +56,11 @@ class InMemoryCommManager(BaseCommunicationManager):
                 continue
             if item is _STOP:
                 break
-            for obs in list(self._observers):
-                obs.receive_message(item.get_type(), item)
+            # activated(None) on a context-free message deliberately clears
+            # any stale context from the previous dispatch (old-sender compat)
+            with trace_context.activated(trace_context.extract(item)):
+                for obs in list(self._observers):
+                    obs.receive_message(item.get_type(), item)
 
     def stop_receive_message(self) -> None:
         self._running = False

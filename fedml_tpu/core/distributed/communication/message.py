@@ -11,6 +11,8 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Optional
 
+from ...telemetry.trace_context import RESERVED_TELEMETRY_KEY
+
 
 class Message:
     MSG_ARG_KEY_OPERATION = "operation"
@@ -19,6 +21,10 @@ class Message:
     MSG_ARG_KEY_RECEIVER = "receiver"
     MSG_ARG_KEY_MODEL_PARAMS = "model_params"
     MSG_ARG_KEY_MODEL_PARAMS_URL = "model_params_url"
+    # Reserved header: trace context + client telemetry delta ride here.
+    # The literal lives in core/telemetry/trace_context.py ONLY
+    # (tools/check_telemetry.py enforces it) so payload keys cannot collide.
+    MSG_ARG_KEY_TELEMETRY = RESERVED_TELEMETRY_KEY
     MSG_OPERATION_SEND = "send"
 
     def __init__(self, msg_type: Any = 0, sender_id: int = 0, receiver_id: int = 0):

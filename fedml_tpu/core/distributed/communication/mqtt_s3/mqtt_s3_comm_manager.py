@@ -16,6 +16,7 @@ import queue
 import threading
 from typing import List, Optional
 
+from .....core.telemetry import trace_context
 from ..base_com_manager import BaseCommunicationManager, Observer
 from ..message import Message
 from .mqtt_transport import create_mqtt_transport
@@ -110,6 +111,7 @@ class MqttS3MultiClientsCommManager(BaseCommunicationManager):
 
     # --- send ------------------------------------------------------------
     def send_message(self, msg: Message) -> None:
+        trace_context.inject(msg)
         receiver = msg.get_receiver_id()
         params = msg.get_params().get(Message.MSG_ARG_KEY_MODEL_PARAMS)
         topic = (
@@ -156,8 +158,9 @@ class MqttS3MultiClientsCommManager(BaseCommunicationManager):
                 continue
             if item is _STOP:
                 break
-            for obs in list(self._observers):
-                obs.receive_message(item.get_type(), item)
+            with trace_context.activated(trace_context.extract(item)):
+                for obs in list(self._observers):
+                    obs.receive_message(item.get_type(), item)
 
     def stop_receive_message(self) -> None:
         self._running = False

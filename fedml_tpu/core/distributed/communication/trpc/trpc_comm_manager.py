@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from .....constants import TRPC_BASE_PORT
+from .....core.telemetry import trace_context
 from ..base_com_manager import BaseCommunicationManager, Observer
 from ..grpc.grpc_comm_manager import read_ip_config
 from ..message import Message
@@ -247,6 +248,7 @@ class TRPCCommManager(BaseCommunicationManager):
         """A dead cached socket (peer restarted — elastic jobs do) is dropped
         and the send retried on a fresh connection; a mid-frame failure always
         abandons the socket, so the peer never sees a misaligned stream."""
+        trace_context.inject(msg)
         receiver = msg.get_receiver_id()
         header, tensors = encode_frame(msg)
         for attempt in range(2):
@@ -279,8 +281,9 @@ class TRPCCommManager(BaseCommunicationManager):
                 continue
             if item is _STOP:
                 break
-            for obs in list(self._observers):
-                obs.receive_message(item.get_type(), item)
+            with trace_context.activated(trace_context.extract(item)):
+                for obs in list(self._observers):
+                    obs.receive_message(item.get_type(), item)
 
     def stop_receive_message(self) -> None:
         self._running = False

@@ -28,6 +28,7 @@ from .core import (
     summary,
     timed,
 )
+from .fleet import FleetTelemetry
 from .jax_hooks import (
     D2H_BYTES,
     H2D_BYTES,
@@ -35,11 +36,22 @@ from .jax_hooks import (
     record_transfer,
     track_compiles,
 )
+from .trace_context import (
+    RESERVED_TELEMETRY_KEY,
+    TraceContext,
+    activated,
+    current,
+    extract,
+    inject,
+    new_trace_id,
+    set_current,
+)
 
 __all__ = [
     "Telemetry",
     "Counter",
     "Histogram",
+    "FleetTelemetry",
     "get_telemetry",
     "span",
     "timed",
@@ -56,4 +68,12 @@ __all__ = [
     "record_transfer",
     "H2D_BYTES",
     "D2H_BYTES",
+    "TraceContext",
+    "RESERVED_TELEMETRY_KEY",
+    "new_trace_id",
+    "current",
+    "set_current",
+    "activated",
+    "inject",
+    "extract",
 ]

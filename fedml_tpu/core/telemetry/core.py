@@ -26,11 +26,12 @@ exposes ``duration_s`` even when recording is disabled.
 
 from __future__ import annotations
 
+import bisect
 import json
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 __all__ = [
     "Telemetry",
@@ -54,6 +55,11 @@ _ENV_DISABLE = "FEDML_TELEMETRY"  # set to "0" to disable the default registry
 MAX_SPAN_RECORDS = 200_000
 MAX_COUNTER_EVENTS = 10_000
 
+# Installed by trace_context on import (avoids a circular import; that module
+# imports this one). When set, enabled-path span records carry the active
+# distributed trace context. The disabled path never touches it.
+_trace_ctx_getter: Optional[Callable[[], Any]] = None
+
 
 class _NullSpan:
     """Shared no-op handle for the disabled path — enter/exit do nothing."""
@@ -70,6 +76,11 @@ class _NullSpan:
 
 
 _NULL_SPAN = _NullSpan()
+
+
+def _json_safe(v: Any) -> Any:
+    """Span attrs are arbitrary; the wire is JSON. Pass scalars, repr the rest."""
+    return v if isinstance(v, (str, int, float, bool)) or v is None else repr(v)
 
 
 class _Span:
@@ -140,12 +151,16 @@ class Counter:
                     t.dropped += 1
 
 
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
 class Histogram:
-    """Streaming aggregate of observed values (count/sum/min/max/last)."""
+    """Streaming aggregate of observed values (count/sum/min/max/last) plus
+    fixed-boundary bucket counts (Prometheus-style; seconds-scaled defaults)."""
 
-    __slots__ = ("name", "count", "total", "min", "max", "last", "_t")
+    __slots__ = ("name", "count", "total", "min", "max", "last", "_t", "buckets", "bucket_counts")
 
-    def __init__(self, name: str, t: "Telemetry"):
+    def __init__(self, name: str, t: "Telemetry", buckets=DEFAULT_BUCKETS):
         self.name = name
         self.count = 0
         self.total = 0.0
@@ -153,6 +168,9 @@ class Histogram:
         self.max: Optional[float] = None
         self.last: Optional[float] = None
         self._t = t
+        self.buckets = tuple(buckets)
+        # per-bucket (non-cumulative) counts; index len(buckets) is +Inf
+        self.bucket_counts = [0] * (len(self.buckets) + 1)
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -164,6 +182,18 @@ class Histogram:
                 self.min = v
             if self.max is None or v > self.max:
                 self.max = v
+            # Prometheus semantics: bucket le=B counts observations <= B
+            self.bucket_counts[bisect.bisect_left(self.buckets, v)] += 1
+
+    def cumulative_buckets(self) -> List[tuple]:
+        """[(le, cumulative_count), ..., (inf, count)] — Prometheus shape."""
+        out: List[tuple] = []
+        running = 0
+        for le, n in zip(self.buckets, self.bucket_counts):
+            running += n
+            out.append((le, running))
+        out.append((float("inf"), running + self.bucket_counts[-1]))
+        return out
 
     def as_dict(self) -> Dict[str, Any]:
         mean = self.total / self.count if self.count else None
@@ -261,6 +291,15 @@ class Telemetry:
             rec["attrs"] = sp.attrs
         if errored:
             rec["error"] = True
+        getter = _trace_ctx_getter
+        if getter is not None:
+            ctx = getter()
+            if ctx is not None:
+                rec["trace_id"] = ctx.trace_id
+                if ctx.parent_span_id is not None:
+                    rec["trace_parent"] = ctx.parent_span_id
+                if ctx.round_idx is not None:
+                    rec["trace_round"] = ctx.round_idx
         with self._lock:
             self._thread_names.setdefault(tid, threading.current_thread().name)
             st = self._span_stats.get(sp.name)
@@ -276,6 +315,43 @@ class Telemetry:
                 self.dropped += 1
 
     # --- export -----------------------------------------------------------
+    def epoch_unix_ns(self) -> int:
+        """Wall-clock estimate of this registry's epoch (the perf-counter
+        origin all span timestamps are relative to). Lets a fleet exporter
+        align lanes from registries with different epochs."""
+        return time.time_ns() - (time.perf_counter_ns() - self._epoch_ns)
+
+    def delta_snapshot(self, cursor: int = 0, tid: Optional[int] = None) -> Dict[str, Any]:
+        """Compact, JSON-safe snapshot of activity since ``cursor`` (a span
+        ``seq``); ship it over the wire each round and advance the cursor to
+        the returned ``"cursor"``. ``tid`` filters spans to one thread so an
+        in-process simulation ships only its own lane."""
+        with self._lock:
+            spans = [
+                r for r in self._spans
+                if r["seq"] > cursor and (tid is None or r["tid"] == tid)
+            ]
+            spans.sort(key=lambda r: r["seq"])
+            out_spans = []
+            for r in spans:
+                rec = dict(r)
+                if "attrs" in rec:
+                    rec["attrs"] = {k: _json_safe(v) for k, v in rec["attrs"].items()}
+                out_spans.append(rec)
+            return {
+                "cursor": self._seq,
+                "epoch_unix_ns": self.epoch_unix_ns(),
+                "spans": out_spans,
+                "counters": {k: c.value for k, c in self._counters.items()},
+                "histograms": {k: h.as_dict() for k, h in self._histograms.items()},
+                "span_stats": {
+                    k: {"count": int(v[0]), "total_ms": v[1] / 1e6, "max_ms": v[2] / 1e6}
+                    for k, v in self._span_stats.items()
+                },
+                "thread_names": {str(k): v for k, v in self._thread_names.items()},
+                "dropped": self.dropped,
+            }
+
     def snapshot(self) -> Dict[str, Any]:
         """Plain-dict view for programmatic assertion. Spans are in START
         order (``seq`` is assigned at entry), with parentage + depth."""
@@ -303,10 +379,23 @@ class Telemetry:
             "dropped": snap["dropped"],
         }
 
-    def export_chrome_trace(self, path: str) -> str:
+    def export_chrome_trace(self, path: str, merge: bool = False) -> str:
         """Write Chrome-trace/Perfetto JSON (object form with ``traceEvents``;
         "X" complete events for spans, "C" series for counters, "M" metadata
-        rows naming process and threads). Returns ``path``."""
+        rows naming process and threads). Returns ``path``.
+
+        ``merge=True`` prepends the ``traceEvents`` already in ``path`` (if it
+        holds valid trace JSON) so repeated exports — e.g. multi-stage bench
+        runs — accumulate instead of overwrite. A corrupt existing file is
+        overwritten."""
+        prior_events: List[Dict[str, Any]] = []
+        if merge and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    prior = json.load(f)
+                prior_events = list(prior.get("traceEvents", [])) if isinstance(prior, dict) else []
+            except (OSError, ValueError):
+                prior_events = []
         pid = os.getpid()
         with self._lock:
             spans = sorted(self._spans, key=lambda r: r["seq"])
@@ -333,6 +422,9 @@ class Telemetry:
             args["seq"] = r["seq"]
             if r.get("error"):
                 args["error"] = True
+            for k in ("trace_id", "trace_parent", "trace_round"):
+                if k in r:
+                    args[k] = r[k]
             ev["args"] = args
             events.append(ev)
         for name, series in counter_series.items():
@@ -347,7 +439,7 @@ class Telemetry:
                         "args": {"value": value},
                     }
                 )
-        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        doc = {"traceEvents": prior_events + events, "displayTimeUnit": "ms"}
         with open(path, "w") as f:
             json.dump(doc, f)
         return path
@@ -389,8 +481,8 @@ def summary() -> Dict[str, Any]:
     return _DEFAULT.summary()
 
 
-def export_chrome_trace(path: str) -> str:
-    return _DEFAULT.export_chrome_trace(path)
+def export_chrome_trace(path: str, merge: bool = False) -> str:
+    return _DEFAULT.export_chrome_trace(path, merge=merge)
 
 
 def set_enabled(on: bool) -> None:

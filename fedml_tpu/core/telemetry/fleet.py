@@ -1,0 +1,159 @@
+"""Fleet telemetry: merge per-client delta snapshots into one cohort view.
+
+Clients attach ``Telemetry.delta_snapshot()`` output to their model-upload
+message (under the reserved header's ``"delta"`` field); the server merges
+them here keyed by client rank. ``export_fleet_trace`` then writes a single
+Perfetto JSON where the server is one process lane (pid 0) and every client
+rank is its own pid lane — straggler bubbles and comm gaps line up visually.
+
+Cross-host clock alignment: each delta carries ``epoch_unix_ns`` (wall-clock
+estimate of that registry's perf-counter epoch). Client span timestamps are
+shifted by ``client_epoch - server_epoch`` so lanes share the server's
+timebase; NTP-level skew (~ms) is visible but the round structure survives.
+
+In a single-process simulation all parties share ONE registry (same epoch, so
+the shift degenerates to ~0) and client deltas are thread-filtered; the
+server lane excludes any thread a client has claimed, so each span appears in
+exactly one lane.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from .core import Telemetry, get_telemetry
+
+MAX_FLEET_SPANS_PER_CLIENT = 50_000
+
+
+class FleetTelemetry:
+    """Server-side accumulator of client telemetry deltas, keyed by rank."""
+
+    def __init__(self, max_spans_per_client: int = MAX_FLEET_SPANS_PER_CLIENT):
+        self.max_spans_per_client = int(max_spans_per_client)
+        self._clients: Dict[int, Dict[str, Any]] = {}
+        self.merges = 0
+        self.rejected = 0
+
+    def merge_client_delta(self, rank: int, delta: Any) -> bool:
+        """Fold one client delta in; returns False (and counts it) on junk.
+        Defensive by design — a misbehaving client must not crash the server's
+        receive loop."""
+        if not isinstance(delta, dict):
+            self.rejected += 1
+            return False
+        try:
+            rank = int(rank)
+        except (TypeError, ValueError):
+            self.rejected += 1
+            return False
+        ent = self._clients.setdefault(
+            rank, {"spans": [], "counters": {}, "histograms": {}, "span_stats": {},
+                   "thread_names": {}, "epoch_unix_ns": None, "dropped": 0,
+                   "client_dropped": 0}
+        )
+        spans = delta.get("spans")
+        if isinstance(spans, list):
+            for r in spans:
+                if not (isinstance(r, dict) and "name" in r and "t0_ns" in r and "dur_ns" in r):
+                    continue
+                if len(ent["spans"]) >= self.max_spans_per_client:
+                    ent["dropped"] += 1
+                    continue
+                ent["spans"].append(r)
+        # cumulative aggregates: latest delta wins
+        for key in ("counters", "histograms", "span_stats"):
+            val = delta.get(key)
+            if isinstance(val, dict):
+                ent[key] = val
+        names = delta.get("thread_names")
+        if isinstance(names, dict):
+            ent["thread_names"].update({str(k): str(v) for k, v in names.items()})
+        if isinstance(delta.get("epoch_unix_ns"), (int, float)):
+            ent["epoch_unix_ns"] = int(delta["epoch_unix_ns"])
+        if isinstance(delta.get("dropped"), int):
+            # client-side Telemetry.dropped is cumulative: latest wins
+            ent["client_dropped"] = delta["dropped"]
+        self.merges += 1
+        return True
+
+    @property
+    def ranks(self) -> List[int]:
+        return sorted(self._clients)
+
+    def summary(self) -> Dict[str, Any]:
+        """Per-rank roll-up, small enough for the mlops uplink every round."""
+        per_client = {}
+        for rank, ent in self._clients.items():
+            per_client[str(rank)] = {
+                "span_stats": ent["span_stats"],
+                "counters": ent["counters"],
+                "histograms": ent["histograms"],
+                "spans_merged": len(ent["spans"]),
+                "dropped": ent["dropped"] + ent["client_dropped"],
+            }
+        return {"clients": per_client, "merges": self.merges, "rejected": self.rejected}
+
+    # --- export ----------------------------------------------------------
+    def export_fleet_trace(self, path: str, server: Optional[Telemetry] = None) -> str:
+        """One Perfetto JSON: server lane (pid 0) + one pid lane per client."""
+        server = server or get_telemetry()
+        server_epoch = server.epoch_unix_ns()
+        snap = server.snapshot()
+
+        # Threads shipped by any client belong to that client's lane, not the
+        # server's (single-process sim: one shared registry).
+        client_tids = set()
+        for ent in self._clients.values():
+            for r in ent["spans"]:
+                client_tids.add(r.get("tid"))
+
+        events: List[Dict[str, Any]] = [
+            {"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+             "args": {"name": "server"}},
+        ]
+        for r in snap["spans"]:
+            if r["tid"] in client_tids:
+                continue
+            events.append(_span_event(r, pid=0, shift_ns=0))
+        for rank in self.ranks:
+            ent = self._clients[rank]
+            pid = int(rank)
+            events.append({"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                           "args": {"name": f"client-{rank}"}})
+            for tid_s, tname in ent["thread_names"].items():
+                try:
+                    tid = int(tid_s)
+                except ValueError:
+                    continue
+                events.append({"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                               "args": {"name": tname}})
+            shift_ns = 0
+            if ent["epoch_unix_ns"] is not None:
+                shift_ns = ent["epoch_unix_ns"] - server_epoch
+            for r in ent["spans"]:
+                events.append(_span_event(r, pid=pid, shift_ns=shift_ns))
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+
+def _span_event(r: Dict[str, Any], pid: int, shift_ns: int) -> Dict[str, Any]:
+    args = dict(r.get("attrs") or {})
+    args["seq"] = r.get("seq")
+    for k in ("trace_id", "trace_parent", "trace_round"):
+        if k in r:
+            args[k] = r[k]
+    if r.get("error"):
+        args["error"] = True
+    return {
+        "ph": "X",
+        "name": r["name"],
+        "ts": (r["t0_ns"] + shift_ns) / 1e3,
+        "dur": r["dur_ns"] / 1e3,
+        "pid": pid,
+        "tid": r.get("tid", 0),
+        "args": args,
+    }

@@ -1,0 +1,156 @@
+"""Prometheus text-exposition encoder for the telemetry registry.
+
+Dependency-free (no ``prometheus_client``): renders the 0.0.4 text format
+from a ``Telemetry`` registry so the serving layer can expose ``GET /metrics``
+from either the FastAPI app or the stdlib HTTP runner.
+
+Mapping:
+
+- ``counter("a.b")``            → ``fedml_a_b_total`` (TYPE counter)
+- ``counter("jax.compiles.f")`` → ``fedml_jax_compiles_total{fn="f"}`` — the
+  per-function compile counters collapse into one labeled family
+- ``histogram("x_seconds")``    → ``fedml_x_seconds_bucket{le=...}`` cumulative
+  buckets + ``_sum`` + ``_count`` (TYPE histogram)
+- span stats                    → ``fedml_span_seconds_total{span=...}`` and
+  ``fedml_span_count_total{span=...}``
+- caller gauges                 → TYPE gauge (replica state, readiness, ...)
+
+QPS is not exported directly — scrape ``fedml_serving_request_seconds_count``
+and let PromQL ``rate()`` do it, as is idiomatic.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from .core import Telemetry, get_telemetry
+from .jax_hooks import COMPILE_COUNTER_PREFIX
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+NAMESPACE = "fedml"
+
+# (name, labels, value) triple; labels may be None
+Gauge = Tuple[str, Optional[Dict[str, str]], float]
+
+
+def escape_label_value(v: str) -> str:
+    """Label values escape backslash, double-quote, and newline (spec order:
+    backslash first so later escapes are not double-escaped)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def escape_help(s: str) -> str:
+    return str(s).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Metric names are ``[a-zA-Z_:][a-zA-Z0-9_:]*``; everything else → ``_``."""
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isascii() and (ch.isalpha() or ch == "_" or ch == ":" or (ch.isdigit() and i > 0)):
+            out.append(ch)
+        else:
+            out.append("_")
+    return "".join(out) or "_"
+
+
+def format_value(v: float) -> str:
+    if isinstance(v, float):
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        if math.isnan(v):
+            return "NaN"
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+    return str(v)
+
+
+def _labels_str(labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{sanitize_metric_name(k)}="{escape_label_value(v)}"' for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+def _fam(name: str, suffix: str = "") -> str:
+    return sanitize_metric_name(f"{NAMESPACE}_{name}{suffix}")
+
+
+def render(telemetry: Optional[Telemetry] = None,
+           gauges: Optional[Iterable[Gauge]] = None) -> str:
+    """Render the registry (and optional caller-supplied gauges) as
+    Prometheus 0.0.4 text. Always ends with a trailing newline."""
+    t = telemetry or get_telemetry()
+    snap = t.summary()
+    lines: List[str] = []
+
+    # --- counters --------------------------------------------------------
+    compiles: Dict[str, int] = {}
+    plain: Dict[str, int] = {}
+    for name, value in sorted(snap["counters"].items()):
+        if name.startswith(COMPILE_COUNTER_PREFIX):
+            compiles[name[len(COMPILE_COUNTER_PREFIX):]] = value
+        else:
+            plain[name] = value
+    if compiles:
+        fam = _fam("jax_compiles", "_total")
+        lines.append(f"# HELP {fam} jit trace count per tracked function")
+        lines.append(f"# TYPE {fam} counter")
+        for fn, value in sorted(compiles.items()):
+            lines.append(f'{fam}{{fn="{escape_label_value(fn)}"}} {format_value(value)}')
+    for name, value in plain.items():
+        fam = _fam(name, "_total")
+        lines.append(f"# HELP {fam} telemetry counter {escape_help(name)}")
+        lines.append(f"# TYPE {fam} counter")
+        lines.append(f"{fam} {format_value(value)}")
+
+    # --- histograms ------------------------------------------------------
+    for name in sorted(snap["histograms"]):
+        h = t.histogram(name)
+        fam = _fam(name)
+        lines.append(f"# HELP {fam} telemetry histogram {escape_help(name)}")
+        lines.append(f"# TYPE {fam} histogram")
+        for le, cum in h.cumulative_buckets():
+            lines.append(f'{fam}_bucket{{le="{format_value(float(le))}"}} {format_value(cum)}')
+        lines.append(f"{fam}_sum {format_value(h.total)}")
+        lines.append(f"{fam}_count {format_value(h.count)}")
+
+    # --- span stats ------------------------------------------------------
+    stats = snap["span_stats"]
+    if stats:
+        sec_fam = _fam("span_seconds", "_total")
+        cnt_fam = _fam("span_count", "_total")
+        lines.append(f"# HELP {sec_fam} cumulative seconds spent inside each span")
+        lines.append(f"# TYPE {sec_fam} counter")
+        for span_name in sorted(stats):
+            lines.append(
+                f'{sec_fam}{{span="{escape_label_value(span_name)}"}} '
+                f'{format_value(stats[span_name]["total_ms"] / 1e3)}'
+            )
+        lines.append(f"# HELP {cnt_fam} completed span count")
+        lines.append(f"# TYPE {cnt_fam} counter")
+        for span_name in sorted(stats):
+            lines.append(
+                f'{cnt_fam}{{span="{escape_label_value(span_name)}"}} '
+                f'{format_value(stats[span_name]["count"])}'
+            )
+
+    drop_fam = _fam("telemetry_dropped", "_total")
+    lines.append(f"# HELP {drop_fam} telemetry records dropped by caps")
+    lines.append(f"# TYPE {drop_fam} counter")
+    lines.append(f"{drop_fam} {format_value(snap['dropped'])}")
+
+    # --- caller gauges ---------------------------------------------------
+    if gauges:
+        seen_fams = set()
+        for name, labels, value in gauges:
+            fam = _fam(name)
+            if fam not in seen_fams:
+                seen_fams.add(fam)
+                lines.append(f"# HELP {fam} gauge {escape_help(name)}")
+                lines.append(f"# TYPE {fam} gauge")
+            lines.append(f"{fam}{_labels_str(labels)} {format_value(float(value))}")
+
+    return "\n".join(lines) + "\n"

@@ -9,10 +9,12 @@ via ClientTrainer.is_main_process).
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Any, Dict, Optional
 
 from ... import mlops
 from ...core import telemetry as tel
+from ...core.telemetry import trace_context
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ...parallel.multihost import broadcast_model_params, broadcast_round_metadata, process_count
@@ -31,6 +33,8 @@ class ClientMasterManager(FedMLCommManager):
         self.client_real_id = rank
         self.has_sent_online_msg = False
         self.is_inited = False
+        # telemetry shipping: spans after this seq go out with the next upload
+        self._tel_cursor = 0
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(MyMessage.MSG_TYPE_CONNECTION_IS_READY, self.handle_message_connection_ready)
@@ -118,7 +122,26 @@ class ClientMasterManager(FedMLCommManager):
             message = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.client_real_id, receive_id)
             message.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
             message.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, int(local_sample_num))
+            self._attach_telemetry_delta(message)
             self.send_message(message)
+
+    def _attach_telemetry_delta(self, message: Message) -> None:
+        """Ship spans/counters accumulated since the last upload under the
+        reserved header; the server folds them into its fleet view. The
+        thread filter matters in single-process simulation, where all parties
+        share one registry — ship only this client's own lane."""
+        t = tel.get_telemetry()
+        if not t.enabled:
+            return
+        # INMEMORY: all parties share one registry, filter to our thread.
+        # Real multi-process backends own their registry — ship every thread.
+        tid = threading.get_ident() if self.backend == "INMEMORY" else None
+        delta = t.delta_snapshot(self._tel_cursor, tid=tid)
+        self._tel_cursor = delta.pop("cursor")
+        delta["rank"] = int(self.client_real_id)
+        message.add_params(
+            Message.MSG_ARG_KEY_TELEMETRY, {trace_context.DELTA_FIELD: delta}
+        )
 
     def __train(self) -> None:
         log.info("====== training on round %d ======", self.args.round_idx)

@@ -19,6 +19,7 @@ from jax import tree as jax_tree
 from ... import mlops
 from ...core import telemetry as tel
 from ...core.alg_frame.context import Context
+from ...core.telemetry.fleet import FleetTelemetry
 from ...utils.pytree import tree_from_numpy
 
 log = logging.getLogger(__name__)
@@ -65,6 +66,8 @@ class FedMLAggregator:
         self.model_dict: Dict[int, Any] = {}
         self.sample_num_dict: Dict[int, float] = {}
         self.flag_client_model_uploaded_dict = {i: False for i in range(client_num)}
+        # fleet view: per-rank telemetry deltas shipped on model upload
+        self.fleet = FleetTelemetry()
         Context().add(Context.KEY_TEST_DATA, test_global)
 
     def get_global_model_params(self):
@@ -110,6 +113,18 @@ class FedMLAggregator:
         tel.histogram("server.aggregate_seconds").observe(dt)
         log.info("aggregate time cost: %.3fs", dt)
         return averaged
+
+    # --- fleet telemetry --------------------------------------------------
+    def merge_client_telemetry(self, rank: int, delta: Any) -> bool:
+        """Fold one client's shipped telemetry delta into the fleet view."""
+        return self.fleet.merge_client_delta(rank, delta)
+
+    def fleet_summary(self) -> Dict[str, Any]:
+        return self.fleet.summary()
+
+    def export_fleet_trace(self, path: str) -> str:
+        """One Perfetto JSON: server lane + one lane per client rank."""
+        return self.fleet.export_fleet_trace(path, server=tel.get_telemetry())
 
     def data_silo_selection(self, round_idx: int, client_num_in_total: int, client_num_per_round: int) -> List[int]:
         """reference fedml_aggregator.py data_silo_selection — sample which

@@ -12,6 +12,7 @@ from typing import Any, Dict, Optional
 
 from ... import mlops
 from ...core import telemetry as tel
+from ...core.telemetry import trace_context
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ..message_define import MyMessage
@@ -30,13 +31,46 @@ class FedMLServerManager(FedMLCommManager):
         self.data_silo_index_list = None
         self.is_initialized = False
         self.final_metrics: Optional[Dict[str, float]] = None
+        # distributed tracing: one trace id per run; each round is a
+        # server.round span whose seq is the parent of everything the round's
+        # broadcasts reach (clients restore it from the message header)
+        self.trace_id = trace_context.new_trace_id()
+        self._round_span = None
+        self._round_span_idx: Optional[int] = None
 
     def run(self) -> None:
         mlops.log_aggregation_status("INITIALIZING", str(getattr(self.args, "run_id", "0")))
         super().run()
 
+    # --- round trace lifecycle --------------------------------------------
+    # All handlers run on the one receive-loop thread, so the round span can
+    # stay open across handler invocations: entered when the round's configs
+    # go out, exited when the next round begins (or at finish).
+    def _begin_round_trace(self) -> None:
+        self._end_round_trace()
+        sp = tel.get_telemetry().span("server.round", round=int(self.args.round_idx))
+        sp.__enter__()
+        self._round_span = sp
+        self._round_span_idx = int(self.args.round_idx)
+        trace_context.set_current(
+            trace_context.TraceContext(self.trace_id, getattr(sp, "seq", None), int(self.args.round_idx))
+        )
+
+    def _end_round_trace(self) -> None:
+        if self._round_span is None:
+            return
+        # the round span is the trace root: record it parentless, not
+        # pointing at its own seq
+        trace_context.set_current(
+            trace_context.TraceContext(self.trace_id, None, self._round_span_idx)
+        )
+        self._round_span.__exit__(None, None, None)
+        self._round_span = None
+        trace_context.set_current(None)
+
     # --- round bootstrap --------------------------------------------------
     def send_init_msg(self) -> None:
+        self._begin_round_trace()
         global_model_params = self.aggregator.get_global_model_params()
         for idx, client_id in enumerate(self.client_id_list_in_this_round):
             self.send_message_init_config(
@@ -82,6 +116,12 @@ class FedMLServerManager(FedMLCommManager):
         sender_id = msg_params.get_sender_id()
         model_params = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         local_sample_number = msg_params.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        header = trace_context.telemetry_header(msg_params)
+        # the aggregator interface is duck-typed (fa/cross_silo.py adapts an
+        # FA aggregator into it) — fleet telemetry is optional on it
+        merge = getattr(self.aggregator, "merge_client_telemetry", None)
+        if merge is not None and header is not None and trace_context.DELTA_FIELD in header:
+            merge(sender_id, header[trace_context.DELTA_FIELD])
         with tel.span("server.receive_model", round=int(self.args.round_idx), sender=int(sender_id)):
             self.aggregator.add_local_trained_result(sender_id - 1, model_params, local_sample_number)
         if not self.aggregator.check_whether_all_receive():
@@ -97,11 +137,16 @@ class FedMLServerManager(FedMLCommManager):
         mlops.event("server.agg_and_eval", event_started=False, event_value=str(self.args.round_idx))
         mlops.log_round_info(self.round_num, self.args.round_idx)
         mlops.log_telemetry_summary(self.args.round_idx)
+        fleet = getattr(self.aggregator, "fleet", None)
+        if fleet is not None and fleet.merges:
+            mlops.log_fleet_summary(self.args.round_idx, self.aggregator.fleet_summary())
 
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:
             mlops.log_aggregation_status("FINISHED", str(getattr(self.args, "run_id", "0")))
             self.send_finish_to_all()
+            self._end_round_trace()
+            self._export_fleet_trace_if_configured()
             self.finish()
             return
         self.client_id_list_in_this_round = self.aggregator.client_selection(
@@ -112,12 +157,27 @@ class FedMLServerManager(FedMLCommManager):
             int(getattr(self.args, "client_num_in_total", self.size - 1)),
             len(self.client_id_list_in_this_round),
         )
+        self._begin_round_trace()
         with tel.span(
             "server.broadcast", round=int(self.args.round_idx), receivers=len(self.client_id_list_in_this_round)
         ):
             for idx, receiver_id in enumerate(self.client_id_list_in_this_round):
                 self.send_message_sync_model_to_client(receiver_id, global_model_params, self.data_silo_index_list[idx])
         mlops.event("server.wait", event_started=True, event_value=str(self.args.round_idx))
+
+    def _export_fleet_trace_if_configured(self) -> None:
+        """Write the fleet Perfetto JSON when ``args.fleet_trace`` names a
+        path (and any client telemetry actually arrived)."""
+        path = getattr(self.args, "fleet_trace", None)
+        fleet = getattr(self.aggregator, "fleet", None)
+        if not path or fleet is None or not fleet.merges:
+            return
+        try:
+            out = self.aggregator.export_fleet_trace(str(path))
+            log.info("fleet trace written to %s (open in ui.perfetto.dev)", out)
+            mlops.log_artifact(out, artifact_name="fleet_trace.json", artifact_type="trace")
+        except Exception:  # noqa: BLE001 - observability must not fail the run
+            log.exception("fleet trace export failed")
 
     # --- senders ----------------------------------------------------------
     def send_message_init_config(self, receive_id: int, global_model_params, datasilo_index) -> None:

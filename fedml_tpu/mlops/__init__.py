@@ -167,6 +167,23 @@ def log_telemetry_summary(round_idx: Optional[int] = None) -> None:
     MLOpsRuntime.get_instance().append_record(rec)
 
 
+def log_fleet_summary(round_idx: Optional[int], fleet_summary: Dict[str, Any]) -> None:
+    """Publish the server's merged per-client telemetry view (``FleetTelemetry
+    .summary()``) through the same uplink path as ``log_telemetry_summary`` —
+    one record per round with every client's span stats and counters keyed by
+    rank, so a dashboard can chart stragglers without scraping N processes."""
+    rec: Dict[str, Any] = {
+        "type": "metric",
+        "name": "fleet_round_summary",
+        "t": time.time(),  # wall-clock ok: record timestamp, not a duration
+        "fleet": fleet_summary,
+    }
+    if round_idx is not None:
+        rec["round"] = int(round_idx)
+        rec["step"] = int(round_idx)
+    MLOpsRuntime.get_instance().append_record(rec)
+
+
 def log_training_status(status: str, run_id: Optional[str] = None) -> None:
     MLOpsRuntime.get_instance().append_record({"type": "status", "role": "client", "status": status, "run_id": run_id})
 

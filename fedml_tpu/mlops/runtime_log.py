@@ -137,13 +137,23 @@ class MLOpsRuntimeLogDaemon:
         return len(lines)
 
     def _loop(self) -> None:
-        while not self._stop.is_set():
+        # bind the event: if start() replaces self._stop for a restart, an
+        # orphaned old loop must keep honoring ITS stop flag, not the new one
+        stop = self._stop
+        while not stop.is_set():
             self.poll_once()
-            self._stop.wait(self.interval_s)
+            stop.wait(self.interval_s)
         self.poll_once(final=True)  # final drain ships an unterminated tail too
 
     def start(self) -> None:
         if self._thread is None:
+            # restart-after-stop: a FRESH event, not .clear() — the stop flag
+            # is still set from stop(), and a new loop reading it would exit
+            # after one final drain, silently dropping every later line. A
+            # fresh object also leaves any orphaned old thread (join timeout)
+            # with its own set flag so it still winds down.
+            if self._stop.is_set():
+                self._stop = threading.Event()
             self._thread = threading.Thread(target=self._loop, daemon=True, name="mlops-log-daemon")
             self._thread.start()
 

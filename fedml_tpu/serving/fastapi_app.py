@@ -30,6 +30,15 @@ def build_fastapi_app(predictor) -> "FastAPI":
             return {"status": "Success"}
         return Response(status_code=status.HTTP_202_ACCEPTED)
 
+    @api.get("/metrics")
+    async def metrics():
+        from ..core.telemetry import prom
+
+        body = prom.render(
+            gauges=[("predictor_ready", None, 1.0 if predictor.ready() else 0.0)]
+        )
+        return Response(content=body, media_type=prom.CONTENT_TYPE)
+
     return api
 
 

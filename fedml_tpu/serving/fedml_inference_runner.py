@@ -19,6 +19,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
+from ..core.telemetry import prom
 from .fedml_predictor import FedMLPredictor
 
 log = logging.getLogger(__name__)
@@ -165,6 +166,18 @@ class FedMLInferenceRunner:
                         self._send_json({"status": "Success"})
                     else:
                         self._send_json({"status": "Initializing"}, code=202)
+                elif self.path == "/metrics":
+                    gauges = [("predictor_ready", None, 1.0 if predictor.ready() else 0.0)]
+                    if batcher is not None:
+                        sizes = list(batcher.batch_sizes)
+                        if sizes:
+                            gauges.append(("serving_last_batch_size", None, float(sizes[-1])))
+                    body = prom.render(gauges=gauges).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", prom.CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._send_json({"error": "not found"}, code=404)
 

@@ -174,6 +174,21 @@ class ReplicaSet:
         with self._lock:
             return [r for r in self.replicas if r.alive()]
 
+    def prom_gauges(self, probe_ready: bool = True) -> List[tuple]:
+        """Replica-state gauges for ``core.telemetry.prom.render`` —
+        ``fedml_serving_replicas{state=desired|healthy|ready}``. The ready
+        probe is an HTTP round-trip per replica; scrape handlers that cannot
+        afford it pass ``probe_ready=False``."""
+        healthy = self.healthy()
+        gauges = [
+            ("serving_replicas", {"state": "desired"}, float(self.desired)),
+            ("serving_replicas", {"state": "healthy"}, float(len(healthy))),
+        ]
+        if probe_ready:
+            ready = [r for r in healthy if r.ready(timeout_s=1.0)]
+            gauges.append(("serving_replicas", {"state": "ready"}, float(len(ready))))
+        return gauges
+
     def shutdown(self) -> None:
         with self._lock:
             self.desired = 0

@@ -85,6 +85,43 @@ def test_log_daemon_thread_lifecycle(tmp_path):
     assert "line1\n" in flat and "line2\n" in flat
 
 
+def test_log_daemon_restart_after_stop(tmp_path):
+    """A late start() after stop() must re-create the flush loop. The old bug:
+    the stop Event stayed set, so the restarted thread exited after one drain
+    and every later line was silently dropped."""
+    p = tmp_path / "x.log"
+    p.write_text("line1\n")
+    shipped = []
+    d = MLOpsRuntimeLogDaemon(str(p), "r", 0, sink=lambda *a: shipped.append(a[2]), interval_s=0.05)
+    d.start()
+    d.stop()
+    assert ["line1\n"] in shipped
+    d.start()  # the late restart
+    time.sleep(0.2)
+    assert d._thread is not None and d._thread.is_alive(), "restarted loop died"
+    with open(p, "a") as f:
+        f.write("line2\n")
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if any("line2\n" in chunk for chunk in shipped):
+            break
+        time.sleep(0.05)
+    # shipped PERIODICALLY by the restarted loop — stop() is deliberately not
+    # called before the assertion (its caller-side drain would mask the bug)
+    assert any("line2\n" in chunk for chunk in shipped), shipped
+    d.stop()
+
+
+def test_log_fleet_summary_record(tmp_path):
+    rt = _fresh_runtime(tmp_path)
+    summary = {"clients": {"1": {"spans_merged": 4}}, "merges": 2, "rejected": 0}
+    mlops.log_fleet_summary(3, summary)
+    recs = [r for r in rt.records if r.get("name") == "fleet_round_summary"]
+    assert len(recs) == 1
+    assert recs[0]["fleet"] == summary
+    assert recs[0]["round"] == 3
+
+
 def test_sys_perf_sampler():
     recs = []
     s = SysPerfSampler(recs.append, interval_s=0.05)

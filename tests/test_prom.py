@@ -1,0 +1,231 @@
+"""Prometheus text-encoder tests: escaping, bucket cumulativity, and a
+golden parse-back of the full exposition — plus the live ``GET /metrics``
+endpoint on the stdlib inference runner."""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from fedml_tpu.core.telemetry import Telemetry
+from fedml_tpu.core.telemetry import prom
+
+# One Prometheus 0.0.4 sample line: name, optional {labels}, value.
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?P<labels>\{[^{}]*\})?'
+    r' (?P<value>-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|\+Inf|-Inf|NaN)$'
+)
+_LABEL_RE = re.compile(r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<val>(?:[^"\\]|\\.)*)"')
+
+
+def _parse(text):
+    """Parse exposition text into (samples, families-with-help-type)."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    samples = []
+    helped, typed = set(), set()
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            typed.add(line.split()[2])
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        labels = dict(
+            (lm.group("key"), lm.group("val"))
+            for lm in _LABEL_RE.finditer(m.group("labels") or "")
+        )
+        samples.append((m.group("name"), labels, m.group("value")))
+    return samples, helped, typed
+
+
+class TestEscaping:
+    def test_label_value_escapes_backslash_first(self):
+        # a backslash followed by a quote: if quote were escaped first, the
+        # added backslash would be doubled by the later backslash pass
+        assert prom.escape_label_value('a\\"b') == 'a\\\\\\"b'
+        assert prom.escape_label_value("line1\nline2") == "line1\\nline2"
+        assert prom.escape_label_value("plain") == "plain"
+
+    def test_escaped_label_round_trips_through_parser(self):
+        nasty = 'back\\slash "quoted"\nnewline'
+        text = prom.render(telemetry=Telemetry(enabled=True),
+                           gauges=[("g", {"l": nasty}, 1.0)])
+        samples, _, _ = _parse(text)
+        (name, labels, value) = [s for s in samples if s[0] == "fedml_g"][0]
+        # unescape per spec and recover the original
+        unescaped = labels["l"].replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+        assert unescaped == nasty
+
+    def test_metric_name_sanitized(self):
+        assert prom.sanitize_metric_name("comm.h2d-bytes") == "comm_h2d_bytes"
+        assert prom.sanitize_metric_name("0abc") == "_abc"
+
+    def test_format_value_specials(self):
+        assert prom.format_value(float("inf")) == "+Inf"
+        assert prom.format_value(float("-inf")) == "-Inf"
+        assert prom.format_value(float("nan")) == "NaN"
+        assert prom.format_value(3.0) == "3"
+        assert prom.format_value(0.25) == "0.25"
+
+
+class TestHistogramBuckets:
+    def test_cumulativity_and_inf(self):
+        t = Telemetry(enabled=True)
+        h = t.histogram("req_seconds")
+        values = [0.0005, 0.003, 0.003, 0.07, 0.9, 42.0]  # last is > top bound
+        for v in values:
+            h.observe(v)
+        text = prom.render(telemetry=t)
+        samples, _, _ = _parse(text)
+        buckets = [(labels["le"], float(val)) for name, labels, val in samples
+                   if name == "fedml_req_seconds_bucket"]
+        # cumulative: non-decreasing in bound order, +Inf last and == count
+        assert buckets[-1][0] == "+Inf"
+        counts = [c for _, c in buckets]
+        assert counts == sorted(counts)
+        assert buckets[-1][1] == len(values)
+        # each finite bucket equals the manual <= count
+        for le_s, cum in buckets[:-1]:
+            le = float(le_s)
+            assert cum == sum(1 for v in values if v <= le), (le, cum)
+        count = [float(v) for n, _, v in samples if n == "fedml_req_seconds_count"][0]
+        total = [float(v) for n, _, v in samples if n == "fedml_req_seconds_sum"][0]
+        assert count == len(values)
+        assert total == pytest.approx(sum(values))
+
+    def test_boundary_value_lands_in_le_bucket(self):
+        # Prometheus semantics: le is inclusive — an observation exactly on a
+        # bound counts in that bound's bucket
+        t = Telemetry(enabled=True)
+        h = t.histogram("x")
+        h.observe(0.005)
+        cum = dict(h.cumulative_buckets())
+        assert cum[0.005] == 1
+        assert cum[0.001] == 0
+
+
+class TestGoldenParseBack:
+    def _populated(self):
+        t = Telemetry(enabled=True)
+        with t.span("server.round", round=0):
+            with t.span("server.aggregate"):
+                pass
+        t.counter("comm.host_to_device_bytes").add(4096)
+        t.counter("jax.compiles.agg_accum").add(3)
+        t.counter("jax.compiles.train_step").add(1)
+        t.histogram("serving.request_seconds").observe(0.02)
+        return t
+
+    def test_every_line_parses_and_families_are_declared(self):
+        text = prom.render(telemetry=self._populated(),
+                           gauges=[("serving_replicas", {"state": "ready"}, 2),
+                                   ("serving_replicas", {"state": "desired"}, 3),
+                                   ("predictor_ready", None, 1)])
+        samples, helped, typed = _parse(text)
+        names = {s[0] for s in samples}
+        expected = {
+            "fedml_jax_compiles_total",
+            "fedml_comm_host_to_device_bytes_total",
+            "fedml_serving_request_seconds_bucket",
+            "fedml_serving_request_seconds_sum",
+            "fedml_serving_request_seconds_count",
+            "fedml_span_seconds_total",
+            "fedml_span_count_total",
+            "fedml_telemetry_dropped_total",
+            "fedml_serving_replicas",
+            "fedml_predictor_ready",
+        }
+        assert expected <= names, expected - names
+        # every family has HELP + TYPE (histogram samples share one family)
+        for n in names:
+            fam = re.sub(r"_(bucket|sum|count)$", "", n) if "request_seconds" in n else n
+            assert fam in helped and fam in typed, fam
+
+    def test_compile_counters_collapse_to_one_labeled_family(self):
+        text = prom.render(telemetry=self._populated())
+        samples, _, _ = _parse(text)
+        fns = {labels["fn"]: float(v) for name, labels, v in samples
+               if name == "fedml_jax_compiles_total"}
+        assert fns == {"agg_accum": 3.0, "train_step": 1.0}
+
+    def test_span_stats_exported_as_counters(self):
+        text = prom.render(telemetry=self._populated())
+        samples, _, _ = _parse(text)
+        span_counts = {labels["span"]: float(v) for name, labels, v in samples
+                       if name == "fedml_span_count_total"}
+        assert span_counts == {"server.round": 1.0, "server.aggregate": 1.0}
+        secs = {labels["span"]: float(v) for name, labels, v in samples
+                if name == "fedml_span_seconds_total"}
+        assert all(v >= 0 for v in secs.values())
+
+    def test_help_and_type_precede_samples(self):
+        text = prom.render(telemetry=self._populated())
+        seen_sample_of = set()
+        for line in text.splitlines():
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                fam = line.split()[2]
+                assert fam not in seen_sample_of, f"{fam} declared after its samples"
+            else:
+                m = _SAMPLE_RE.match(line)
+                fam = re.sub(r"_(bucket|sum|count)$", "", m.group("name"))
+                seen_sample_of.add(m.group("name"))
+                seen_sample_of.add(fam)
+
+
+class _TinyPredictor:
+    """Duck-typed predictor: predict + ready, no jax, no abc ceremony."""
+
+    def predict(self, request):
+        return {"echo": request}
+
+    def ready(self):
+        return True
+
+
+class TestMetricsEndpoint:
+    def test_stdlib_runner_serves_metrics(self):
+        from fedml_tpu.serving.fedml_inference_runner import FedMLInferenceRunner
+
+        runner = FedMLInferenceRunner(_TinyPredictor(), port=0)
+        port = runner.start()
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"] == prom.CONTENT_TYPE
+                body = resp.read().decode("utf-8")
+            samples, helped, typed = _parse(body)  # the whole body must parse
+            ready = [v for n, _, v in samples if n == "fedml_predictor_ready"]
+            assert ready == ["1"]
+            assert "fedml_predictor_ready" in typed
+            # /predict still works next to /metrics
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict",
+                data=json.dumps({"inputs": [1]}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert json.load(resp) == {"echo": {"inputs": [1]}}
+        finally:
+            runner.stop()
+
+    def test_replica_set_gauges_render(self):
+        from fedml_tpu.serving.replica_controller import ReplicaSet
+
+        import threading
+
+        rs = ReplicaSet.__new__(ReplicaSet)  # state-only: no processes spawned
+        rs._lock = threading.Lock()
+        rs.desired = 3
+        rs.replicas = []
+        gauges = rs.prom_gauges(probe_ready=False)
+        by_state = {g[1]["state"]: g[2] for g in gauges}
+        assert by_state["desired"] == 3.0
+        assert by_state["healthy"] == 0.0
+        text = prom.render(telemetry=Telemetry(enabled=True), gauges=gauges)
+        samples, _, _ = _parse(text)
+        states = {labels["state"] for n, labels, _ in samples if n == "fedml_serving_replicas"}
+        assert "desired" in states and "healthy" in states

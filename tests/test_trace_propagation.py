@@ -1,0 +1,310 @@
+"""Distributed-tracing tests: trace-context wire format, backend
+propagation, and the end-to-end fleet trace.
+
+The acceptance path (ISSUE 3): a single-process cross-silo simulation over
+the inmemory backend with 3 clients produces ONE ``export_fleet_trace()``
+Perfetto JSON containing the server lane plus one lane per client, with
+client ``train`` spans sharing the server round's ``trace_id``.
+"""
+
+import json
+import queue
+import threading
+import time
+
+import pytest
+
+from fedml_tpu.core import telemetry as tel
+from fedml_tpu.core.telemetry import trace_context as tc
+from fedml_tpu.core.telemetry.fleet import FleetTelemetry
+from fedml_tpu.core.distributed.communication.message import Message
+
+
+class TestTraceparentFormat:
+    def test_round_trip(self):
+        ctx = tc.TraceContext(tc.new_trace_id(), parent_span_id=71, round_idx=4)
+        assert tc.TraceContext.from_traceparent(ctx.to_traceparent()) == ctx
+
+    def test_no_parent_and_no_round(self):
+        ctx = tc.TraceContext(tc.new_trace_id())
+        tp = ctx.to_traceparent()
+        assert "-0000000000000000-" in tp and tp.endswith("--1")
+        back = tc.TraceContext.from_traceparent(tp)
+        assert back.parent_span_id is None and back.round_idx is None
+        assert back == ctx
+
+    @pytest.mark.parametrize("bad", [
+        None,
+        42,
+        "",
+        "00",
+        "00-short-0000000000000001-0",
+        "99-" + "a" * 32 + "-" + "0" * 16 + "-0",       # unknown version
+        "00-" + "g" * 32 + "-" + "0" * 16 + "-0",       # non-hex trace id
+        "00-" + "a" * 32 + "-xyz-0",                     # bad parent
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-notanint",
+    ])
+    def test_malformed_returns_none(self, bad):
+        assert tc.TraceContext.from_traceparent(bad) is None
+
+    def test_new_trace_id_shape(self):
+        tid = tc.new_trace_id()
+        assert len(tid) == 32
+        int(tid, 16)  # hex
+        assert tc.new_trace_id() != tid
+
+
+class TestInjectExtract:
+    def test_inject_noop_without_context(self):
+        msg = Message(1, 1, 0)
+        tc.set_current(None)
+        tc.inject(msg)
+        assert msg.get(Message.MSG_ARG_KEY_TELEMETRY) is None
+
+    def test_inject_extract_round_trip(self):
+        ctx = tc.TraceContext(tc.new_trace_id(), 9, 1)
+        msg = Message(1, 1, 0)
+        with tc.activated(ctx):
+            tc.inject(msg)
+        assert tc.extract(msg) == ctx
+
+    def test_inject_preserves_existing_delta(self):
+        msg = Message(1, 1, 0)
+        msg.add_params(Message.MSG_ARG_KEY_TELEMETRY, {tc.DELTA_FIELD: {"rank": 1}})
+        with tc.activated(tc.TraceContext(tc.new_trace_id(), 1, 0)):
+            tc.inject(msg)
+        header = msg.get(Message.MSG_ARG_KEY_TELEMETRY)
+        assert header[tc.DELTA_FIELD] == {"rank": 1}
+        assert tc.TRACEPARENT_FIELD in header
+
+    def test_header_survives_to_json(self):
+        """The reserved header is control-plane: it must ride every wire
+        format, i.e. survive Message.to_json() (which strips the payload)."""
+        msg = Message(1, 1, 0)
+        with tc.activated(tc.TraceContext(tc.new_trace_id(), 2, 0)):
+            tc.inject(msg)
+        wire = json.loads(msg.to_json())
+        assert tc.TRACEPARENT_FIELD in wire[Message.MSG_ARG_KEY_TELEMETRY]
+
+    def test_extract_absent_header_is_none(self):
+        assert tc.extract(Message(1, 1, 0)) is None
+
+    def test_extract_malformed_bumps_counter(self):
+        before = tel.get_telemetry().counter(tc.MALFORMED_COUNTER).value
+        msg = Message(1, 1, 0)
+        msg.add_params(Message.MSG_ARG_KEY_TELEMETRY, {tc.TRACEPARENT_FIELD: "not-a-traceparent"})
+        assert tc.extract(msg) is None
+        assert tel.get_telemetry().counter(tc.MALFORMED_COUNTER).value == before + 1
+
+    def test_activated_restores_previous(self):
+        outer = tc.TraceContext(tc.new_trace_id(), 1, 0)
+        inner = tc.TraceContext(tc.new_trace_id(), 2, 1)
+        with tc.activated(outer):
+            with tc.activated(inner):
+                assert tc.current() == inner
+            assert tc.current() == outer
+            with tc.activated(None):  # old-sender message clears the context
+                assert tc.current() is None
+            assert tc.current() == outer
+        assert tc.current() is None
+
+
+class _RecordingObserver:
+    """Observer that records the trace context active at dispatch time."""
+
+    def __init__(self):
+        self.seen = queue.Queue()
+
+    def receive_message(self, msg_type, msg):
+        self.seen.put((msg_type, tc.current()))
+
+
+class TestInMemoryBackendPropagation:
+    def _mgr(self, run_id):
+        from fedml_tpu.core.distributed.communication.inmemory.broker import InMemoryBroker
+        from fedml_tpu.core.distributed.communication.inmemory.inmemory_comm_manager import (
+            InMemoryCommManager,
+        )
+
+        InMemoryBroker.reset()
+        return InMemoryCommManager(run_id, rank=0, size=2)
+
+    def test_receive_loop_restores_and_clears_context(self):
+        mgr = self._mgr("tp_prop")
+        obs = _RecordingObserver()
+        mgr.add_observer(obs)
+        loop = threading.Thread(target=mgr.handle_receive_message, daemon=True)
+        loop.start()
+        try:
+            ctx = tc.TraceContext(tc.new_trace_id(), 5, 2)
+            with_header = Message("with", 1, 0)
+            with tc.activated(ctx):
+                mgr.send_message(with_header)  # rank 0 -> itself, via broker
+            malformed = Message("malformed", 1, 0)
+            malformed.add_params(Message.MSG_ARG_KEY_TELEMETRY, {tc.TRACEPARENT_FIELD: "junk"})
+            mgr.broker.publish(0, malformed)
+            absent = Message("absent", 1, 0)  # old sender: no header at all
+            mgr.broker.publish(0, absent)
+
+            got = [obs.seen.get(timeout=10) for _ in range(3)]
+            assert got[0] == ("with", ctx)
+            assert got[1] == ("malformed", None)  # tolerated, not raised
+            assert got[2] == ("absent", None)     # and no stale inheritance
+        finally:
+            mgr.stop_receive_message()
+            loop.join(timeout=10)
+        assert tc.current() is None
+
+
+class TestDeltaSnapshot:
+    def test_cursor_and_thread_filter(self):
+        t = tel.Telemetry(enabled=True)
+        with t.span("a"):
+            pass
+        d1 = t.delta_snapshot(0)
+        assert [r["name"] for r in d1["spans"]] == ["a"]
+        with t.span("b"):
+            pass
+        d2 = t.delta_snapshot(d1["cursor"])
+        assert [r["name"] for r in d2["spans"]] == ["b"]
+        # a span recorded from another thread is filtered out by tid
+        worker = threading.Thread(target=lambda: t.span("other").__enter__().__exit__(None, None, None))
+        worker.start()
+        worker.join()
+        d3 = t.delta_snapshot(d2["cursor"], tid=threading.get_ident())
+        assert [r["name"] for r in d3["spans"]] == []
+
+    def test_json_safe_attrs(self):
+        t = tel.Telemetry(enabled=True)
+        with t.span("a", obj=object(), n=3):
+            pass
+        d = t.delta_snapshot(0)
+        json.dumps(d)  # must be wire-able
+        assert d["spans"][0]["attrs"]["n"] == 3
+
+    def test_fleet_merge_rejects_junk(self):
+        f = FleetTelemetry()
+        assert not f.merge_client_delta(1, "not a dict")
+        assert not f.merge_client_delta("rank?", {})
+        assert f.rejected == 2
+        assert f.merge_client_delta(1, {"spans": [{"bogus": True}], "counters": {"c": 1}})
+        assert f.summary()["clients"]["1"]["spans_merged"] == 0
+        assert f.summary()["clients"]["1"]["counters"] == {"c": 1}
+
+
+class TestFleetTraceEndToEnd:
+    def test_three_client_round_produces_fleet_trace(self, tmp_path):
+        """ISSUE 3 acceptance: 3-client inmemory cross-silo run -> one fleet
+        Perfetto JSON (server lane + 3 client lanes), client train spans
+        sharing the server round's trace_id and nesting under round spans."""
+        import fedml_tpu as fedml
+        from fedml_tpu.arguments import default_config
+        from fedml_tpu.core.distributed.communication.inmemory.broker import InMemoryBroker
+
+        fleet_path = tmp_path / "fleet.json"
+        n_clients, rounds = 3, 2
+
+        def make_args(rank, role):
+            over = dict(
+                run_id="test_fleet_trace", rank=rank, role=role, backend="INMEMORY",
+                scenario="horizontal", client_num_in_total=n_clients,
+                client_num_per_round=n_clients, comm_round=rounds, epochs=1,
+                batch_size=16, frequency_of_the_test=1, dataset="synthetic",
+                model="lr", random_seed=0,
+            )
+            if role == "server":
+                over["fleet_trace"] = str(fleet_path)
+            return default_config("cross_silo", **over)
+
+        def run_party(args, results, key):
+            args = fedml.init(args)
+            device = fedml.device.get_device(args)
+            dataset, output_dim = fedml.data.load(args)
+            model = fedml.model.create(args, output_dim)
+            results[key] = fedml.FedMLRunner(args, device, dataset, model).run()
+
+        t = tel.get_telemetry()
+        was_enabled = t.enabled
+        t.set_enabled(True)
+        t.reset()
+        try:
+            InMemoryBroker.reset()
+            results = {}
+            threads = [threading.Thread(
+                target=run_party, args=(make_args(0, "server"), results, "server"), daemon=True)]
+            for rank in range(1, n_clients + 1):
+                threads.append(threading.Thread(
+                    target=run_party, args=(make_args(rank, "client"), results, f"c{rank}"), daemon=True))
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=600)
+                assert not th.is_alive(), "fleet-trace cluster deadlocked"
+            assert results["server"] is not None
+
+            snap = t.snapshot()
+            rounds_spans = [r for r in snap["spans"] if r["name"] == "server.round"]
+            train_spans = [r for r in snap["spans"] if r["name"] == "client.train"]
+            assert len(rounds_spans) == rounds
+            assert len(train_spans) == rounds * n_clients
+            trace_ids = {r.get("trace_id") for r in rounds_spans}
+            assert len(trace_ids) == 1 and None not in trace_ids, rounds_spans
+            round_seqs = {r["seq"] for r in rounds_spans}
+            for r in train_spans:
+                # client spans carry the server's trace_id ...
+                assert r.get("trace_id") == next(iter(trace_ids)), r
+                # ... and nest under a server.round span
+                assert r.get("trace_parent") in round_seqs, (r, round_seqs)
+
+            # one Perfetto JSON: server lane + one pid lane per client
+            assert fleet_path.exists(), "export_fleet_trace did not run"
+            doc = json.loads(fleet_path.read_text())
+            lanes = {e["args"]["name"]: e["pid"] for e in doc["traceEvents"]
+                     if e["ph"] == "M" and e["name"] == "process_name"}
+            assert "server" in lanes
+            for rank in range(1, n_clients + 1):
+                assert f"client-{rank}" in lanes, lanes
+            assert len({lanes[k] for k in lanes}) == n_clients + 1  # distinct pids
+            # the client lanes actually contain the train spans
+            by_pid = {}
+            for e in doc["traceEvents"]:
+                if e["ph"] == "X":
+                    by_pid.setdefault(e["pid"], []).append(e["name"])
+            for rank in range(1, n_clients + 1):
+                assert "client.train" in by_pid.get(lanes[f"client-{rank}"], []), by_pid
+            assert "server.round" in by_pid.get(lanes["server"], [])
+            # spans appear in exactly one lane (thread-partitioned registry)
+            assert "client.train" not in by_pid.get(lanes["server"], [])
+        finally:
+            t.reset()
+            t.set_enabled(was_enabled)
+            tc.set_current(None)
+
+
+class TestTelemetryLint:
+    def test_reserved_key_containment_and_timing(self, capsys):
+        """tools/check_telemetry.py: the reserved header literal appears only
+        in trace_context.py, and no unmarked time.time() regressions."""
+        import importlib.util
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "check_telemetry", os.path.join(repo, "tools", "check_telemetry.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rc = mod.main()
+        assert rc == 0, capsys.readouterr().out
+
+    def test_lint_catches_raw_literal(self, tmp_path):
+        import importlib.util
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "check_telemetry", os.path.join(repo, "tools", "check_telemetry.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        bad = tmp_path / "offender.py"
+        bad.write_text('KEY = "' + "__" + "telemetry" + '__"\n')
+        assert mod.find_reserved_key_violations(str(tmp_path)) != []

@@ -57,6 +57,7 @@ commit_artifacts() {
       log "artifact committed: $(git rev-parse --short HEAD)"
       surface_agg_rates
       surface_span_summary
+      surface_trace_files
     else
       log "COMMIT FAILED: $(tail -c 400 /tmp/bench_watch_commit.err)"
     fi
@@ -103,6 +104,34 @@ if stats:
 PYEOF
 ) || return 0
   [ -n "$spans" ] && log "$spans"
+}
+
+surface_trace_files() {
+  # surface where the trace artifacts landed (per-stage --trace Perfetto
+  # file and the cross-silo fleet trace, if either stage produced one), so
+  # the operator can pull them into ui.perfetto.dev without digging through
+  # the artifact JSON
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1) || return 0
+  [ -n "$newest" ] || return 0
+  local traces
+  traces=$(python3 - "$newest" <<'PYEOF' 2>/dev/null
+import json, sys
+doc = json.load(open(sys.argv[1]))
+found = []
+def walk(d):
+    if isinstance(d, dict):
+        for k, v in d.items():
+            if k in ("trace_file", "fleet_trace_file") and isinstance(v, str):
+                found.append(f"{k}={v}")
+            else:
+                walk(v)
+walk(doc)
+if found:
+    print("trace files (open in ui.perfetto.dev): " + "; ".join(sorted(set(found))))
+PYEOF
+) || return 0
+  [ -n "$traces" ] && log "$traces"
 }
 
 have_measured_headline() {

@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Telemetry-hygiene lint (tier-1 enforced; tests/test_telemetry.py runs it).
+
+Two rules over ``fedml_tpu/**/*.py``:
+
+1. **Reserved-header containment.** The comm layer reserves one ``Message``
+   parameter key for the trace-context + delta-snapshot header. The string
+   literal must appear ONLY in ``core/telemetry/trace_context.py`` (its
+   canonical home); everywhere else must reference
+   ``trace_context.RESERVED_TELEMETRY_KEY`` / ``Message.MSG_ARG_KEY_TELEMETRY``.
+   A payload constructed from the raw literal would silently collide with the
+   header and be clobbered by ``inject()`` on send.
+
+2. **Timing-idiom regressions.** Re-runs ``check_timing.find_violations`` so
+   one tool invocation covers both lints (new ad-hoc ``time.time()`` calls
+   still need their ``# wall-clock ok:`` marker).
+
+Exit status: 0 clean, 1 with violations listed on stdout.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import check_timing  # noqa: E402
+
+# The reserved key, spelled fragment-wise so THIS file does not trip its own
+# lint when scanned.
+RESERVED = "__" + "telemetry" + "__"
+# The one module allowed to spell the literal (relative to the scan root).
+ALLOWED_FILES = (os.path.join("core", "telemetry", "trace_context.py"),)
+
+
+def find_reserved_key_violations(root: str) -> list:
+    violations = []
+    needles = ('"' + RESERVED + '"', "'" + RESERVED + "'")
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            if rel in ALLOWED_FILES:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if any(n in line for n in needles):
+                        violations.append((path, lineno, line.strip()))
+    return violations
+
+
+def main(argv: list = ()) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = argv[0] if argv else os.path.join(repo, "fedml_tpu")
+    rc = 0
+
+    reserved = find_reserved_key_violations(root)
+    for path, lineno, line in reserved:
+        print(f"{os.path.relpath(path, repo)}:{lineno}: raw reserved telemetry key: {line}")
+    if reserved:
+        print(
+            f"\n{len(reserved)} raw use(s) of the reserved telemetry header key. "
+            "Use Message.MSG_ARG_KEY_TELEMETRY (or trace_context."
+            "RESERVED_TELEMETRY_KEY) — payload keys must never collide with it."
+        )
+        rc = 1
+
+    timing = check_timing.find_violations(root)
+    for path, lineno, line in timing:
+        print(f"{os.path.relpath(path, repo)}:{lineno}: unmarked time.time(): {line}")
+    if timing:
+        print(
+            f"\n{len(timing)} unmarked time.time() call(s) — see tools/check_timing.py."
+        )
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
