@@ -1469,10 +1469,20 @@ def _run_stage(name: str, trace=None) -> None:
     else:
         from fedml_tpu.core import telemetry as tel  # stdlib-only import
 
+        from fedml_tpu.core.telemetry import flight_recorder
+
         overhead_ns = tel.disabled_span_overhead_ns()
         if overhead_ns >= 1000.0:
             print(f"warning: disabled-path span costs {overhead_ns:.0f}ns/call "
                   "(budget < 1000ns)", file=sys.stderr)
+        # same contract for the flight recorder: an enabled record() stays
+        # under 2µs/call, and with no active recorder the module helpers are
+        # a None-check (tier-1 pins both bounds)
+        recorder_ns = flight_recorder.enabled_event_overhead_ns()
+        if recorder_ns >= 2000.0:
+            print(f"warning: enabled recorder event costs {recorder_ns:.0f}ns/call "
+                  "(budget < 2000ns)", file=sys.stderr)
+        recorder_noop_ns = flight_recorder.noop_event_overhead_ns()
         tel.set_enabled(True)
         tel.reset()
         with tel.span(f"bench.{name}"):
@@ -1481,6 +1491,13 @@ def _run_stage(name: str, trace=None) -> None:
         # events instead of each stage clobbering the previous stage's spans
         out["trace_file"] = tel.export_chrome_trace(trace, merge=True)
         out["telemetry_disabled_span_ns"] = round(overhead_ns, 1)
+        out["telemetry_recorder_event_ns"] = round(recorder_ns, 1)
+        out["telemetry_recorder_noop_ns"] = round(recorder_noop_ns, 1)
+        rec = flight_recorder.active()
+        if rec is not None and rec.last_dump_path:
+            # a stage that crash-dumped mid-measurement surfaces the path in
+            # its JSON (bench_watch forwards it into the artifact log)
+            out["crash_dump"] = rec.last_dump_path
     print(json.dumps(_round_floats(out)))
 
 

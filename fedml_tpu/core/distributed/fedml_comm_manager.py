@@ -21,6 +21,7 @@ from ...constants import (
     COMM_BACKEND_MQTT_WEB3,
     COMM_BACKEND_TRPC,
 )
+from ..telemetry import flight_recorder
 from .communication.base_com_manager import BaseCommunicationManager, Observer
 from .communication.message import Message
 
@@ -70,6 +71,9 @@ class FedMLCommManager(Observer):
         return self.rank
 
     def receive_message(self, msg_type, msg_params: Message) -> None:
+        # every backend dispatches through here, so the flight recorder's
+        # comm breadcrumbs cover GRPC/TRPC/MQTT/INMEMORY alike
+        flight_recorder.record_comm("recv", msg_params)
         handler = self.message_handler_dict.get(msg_type)
         if handler is None:
             raise KeyError(
@@ -79,6 +83,7 @@ class FedMLCommManager(Observer):
         handler(msg_params)
 
     def send_message(self, message: Message) -> None:
+        flight_recorder.record_comm("send", message)
         self.com_manager.send_message(message)
 
     def register_message_receive_handler(self, msg_type, handler_callback_func: Callable[[Message], None]) -> None:

@@ -28,7 +28,10 @@ from .core import (
     summary,
     timed,
 )
+from .flight_recorder import FlightRecorder
 from .fleet import FleetTelemetry
+from .health import ClientHealth, HealthReport, HealthTracker
+from .statusz import StatuszServer
 from .jax_hooks import (
     D2H_BYTES,
     H2D_BYTES,
@@ -52,6 +55,11 @@ __all__ = [
     "Counter",
     "Histogram",
     "FleetTelemetry",
+    "FlightRecorder",
+    "ClientHealth",
+    "HealthReport",
+    "HealthTracker",
+    "StatuszServer",
     "get_telemetry",
     "span",
     "timed",

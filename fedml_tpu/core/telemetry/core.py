@@ -60,6 +60,13 @@ MAX_COUNTER_EVENTS = 10_000
 # distributed trace context. The disabled path never touches it.
 _trace_ctx_getter: Optional[Callable[[], Any]] = None
 
+# Installed by flight_recorder.install() (same circularity dodge). Signature:
+# hook(opened: bool, span: _Span, exc_type) — called on the enabled span path
+# only, outside the timed region (before the t0 read / after the t1 read), so
+# the recorder never inflates measured durations. The disabled path and the
+# no-hook path stay untouched.
+_span_event_hook: Optional[Callable[[bool, Any, Any], None]] = None
+
 
 class _NullSpan:
     """Shared no-op handle for the disabled path — enter/exit do nothing."""
@@ -113,6 +120,9 @@ class _Span:
             t._seq += 1
             self.seq = t._seq
         stack.append(self)
+        hook = _span_event_hook
+        if hook is not None and t._enabled:
+            hook(True, self, None)
         self.t0_ns = time.perf_counter_ns()  # last: exclude bookkeeping
         return self
 
@@ -125,6 +135,9 @@ class _Span:
             stack.pop()
         if self._record and t._enabled:
             t._record_span(self, exc_type is not None)
+        hook = _span_event_hook
+        if hook is not None and t._enabled:
+            hook(False, self, exc_type)
         return False
 
 
@@ -149,6 +162,7 @@ class Counter:
                     self.events.append((time.perf_counter_ns(), self.value))
                 else:
                     t.dropped += 1
+                    t.dropped_events += 1
 
 
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
@@ -222,7 +236,11 @@ class Telemetry:
         self._histograms: Dict[str, Histogram] = {}
         self._thread_names: Dict[int, str] = {}
         self.max_span_records = int(max_span_records)
+        # `dropped` is the historical total; the per-kind splits feed the
+        # labeled fedml_telemetry_dropped_total{kind=...} Prometheus family
         self.dropped = 0
+        self.dropped_spans = 0
+        self.dropped_events = 0
 
     # --- state ------------------------------------------------------------
     @property
@@ -242,6 +260,8 @@ class Telemetry:
             self._histograms.clear()
             self._thread_names.clear()
             self.dropped = 0
+            self.dropped_spans = 0
+            self.dropped_events = 0
             self._epoch_ns = time.perf_counter_ns()
 
     def _stack(self) -> List[_Span]:
@@ -313,6 +333,17 @@ class Telemetry:
                 self._spans.append(rec)
             else:
                 self.dropped += 1
+                self.dropped_spans += 1
+
+    def dropped_kinds(self) -> Dict[str, int]:
+        """Per-kind drop counts for the labeled Prometheus export. The
+        recorder ring's own count is appended by the caller (prom.render)
+        because the flight recorder lives above this registry."""
+        with self._lock:
+            return {
+                "span_records": self.dropped_spans,
+                "counter_events": self.dropped_events,
+            }
 
     # --- export -----------------------------------------------------------
     def epoch_unix_ns(self) -> int:

@@ -20,11 +20,18 @@ exactly one lane.
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional
+import logging
+from typing import Any, Dict, List, Optional, Set
 
 from .core import Telemetry, get_telemetry
+from .health import HealthTracker
+
+log = logging.getLogger(__name__)
 
 MAX_FLEET_SPANS_PER_CLIENT = 50_000
+
+# the client span whose duration is the health model's round-time signal
+TRAIN_SPAN_NAME = "client.train"
 
 
 class FleetTelemetry:
@@ -35,6 +42,15 @@ class FleetTelemetry:
         self._clients: Dict[int, Dict[str, Any]] = {}
         self.merges = 0
         self.rejected = 0
+        # a delta from a rank outside the expected cohort (late upload after
+        # a reshuffle) is logged + skipped, never raised mid-aggregation
+        self.stale = 0
+        self.expected_ranks: Optional[Set[int]] = None
+        self.health = HealthTracker()
+
+    def set_expected_ranks(self, ranks) -> None:
+        """Declare this round's cohort; ``None`` accepts any rank."""
+        self.expected_ranks = None if ranks is None else {int(r) for r in ranks}
 
     def merge_client_delta(self, rank: int, delta: Any) -> bool:
         """Fold one client delta in; returns False (and counts it) on junk.
@@ -48,6 +64,14 @@ class FleetTelemetry:
         except (TypeError, ValueError):
             self.rejected += 1
             return False
+        if self.expected_ranks is not None and rank not in self.expected_ranks:
+            self.stale += 1
+            self.health.heartbeat(rank)  # it is alive, just late/stale
+            log.warning(
+                "fleet: skipping delta from unexpected rank %d (cohort %s); "
+                "late upload after reshuffle?", rank, sorted(self.expected_ranks),
+            )
+            return False
         ent = self._clients.setdefault(
             rank, {"spans": [], "counters": {}, "histograms": {}, "span_stats": {},
                    "thread_names": {}, "epoch_unix_ns": None, "dropped": 0,
@@ -58,6 +82,7 @@ class FleetTelemetry:
             for r in spans:
                 if not (isinstance(r, dict) and "name" in r and "t0_ns" in r and "dur_ns" in r):
                     continue
+                self._observe_health(rank, r)
                 if len(ent["spans"]) >= self.max_spans_per_client:
                     ent["dropped"] += 1
                     continue
@@ -76,7 +101,25 @@ class FleetTelemetry:
             # client-side Telemetry.dropped is cumulative: latest wins
             ent["client_dropped"] = delta["dropped"]
         self.merges += 1
+        self.health.heartbeat(rank)
         return True
+
+    def _observe_health(self, rank: int, span_rec: Dict[str, Any]) -> None:
+        """Feed the health model from the merged span stream: each completed
+        ``client.train`` span is one round-time observation (or a failure,
+        when the span unwound on an exception)."""
+        if span_rec.get("name") != TRAIN_SPAN_NAME:
+            return
+        try:
+            if span_rec.get("error"):
+                self.health.observe_failure(rank)
+                return
+            dur_s = float(span_rec["dur_ns"]) / 1e9
+            attrs = span_rec.get("attrs") or {}
+            round_idx = attrs.get("round") if isinstance(attrs, dict) else None
+            self.health.observe_round(rank, dur_s, round_idx)
+        except (TypeError, ValueError, KeyError):
+            pass  # malformed span record: fleet merge already tolerates it
 
     @property
     def ranks(self) -> List[int]:
@@ -93,7 +136,8 @@ class FleetTelemetry:
                 "spans_merged": len(ent["spans"]),
                 "dropped": ent["dropped"] + ent["client_dropped"],
             }
-        return {"clients": per_client, "merges": self.merges, "rejected": self.rejected}
+        return {"clients": per_client, "merges": self.merges,
+                "rejected": self.rejected, "stale": self.stale}
 
     # --- export ----------------------------------------------------------
     def export_fleet_trace(self, path: str, server: Optional[Telemetry] = None) -> str:

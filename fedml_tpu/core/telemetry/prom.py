@@ -137,10 +137,24 @@ def render(telemetry: Optional[Telemetry] = None,
                 f'{format_value(stats[span_name]["count"])}'
             )
 
+    # --- bounded-buffer drops, labeled by which buffer overflowed ---------
+    # Silent truncation is an observability bug; each cap gets its own
+    # sample: span records, counter events, and the flight-recorder ring.
+    drop_kinds = dict(t.dropped_kinds())
+    try:
+        from . import flight_recorder
+        rec = flight_recorder.active()
+        drop_kinds["recorder_ring"] = rec.dropped if rec is not None else 0
+    except Exception:  # noqa: BLE001 - metrics must render without the recorder
+        drop_kinds["recorder_ring"] = 0
     drop_fam = _fam("telemetry_dropped", "_total")
-    lines.append(f"# HELP {drop_fam} telemetry records dropped by caps")
+    lines.append(f"# HELP {drop_fam} telemetry records dropped by caps, by buffer kind")
     lines.append(f"# TYPE {drop_fam} counter")
-    lines.append(f"{drop_fam} {format_value(snap['dropped'])}")
+    for kind in sorted(drop_kinds):
+        lines.append(
+            f'{drop_fam}{{kind="{escape_label_value(kind)}"}} '
+            f"{format_value(drop_kinds[kind])}"
+        )
 
     # --- caller gauges ---------------------------------------------------
     if gauges:

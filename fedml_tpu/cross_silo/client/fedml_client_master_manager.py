@@ -10,11 +10,12 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Dict, Optional
 
 from ... import mlops
 from ...core import telemetry as tel
-from ...core.telemetry import trace_context
+from ...core.telemetry import flight_recorder, trace_context
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ...parallel.multihost import broadcast_model_params, broadcast_round_metadata, process_count
@@ -35,6 +36,12 @@ class ClientMasterManager(FedMLCommManager):
         self.is_inited = False
         # telemetry shipping: spans after this seq go out with the next upload
         self._tel_cursor = 0
+
+    def run(self) -> None:
+        # an exception anywhere in the client's receive loop (trainer bug,
+        # protocol violation) writes one crash dump before propagating
+        with flight_recorder.installed(role="cross_silo_client"):
+            super().run()
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(MyMessage.MSG_TYPE_CONNECTION_IS_READY, self.handle_message_connection_ready)
@@ -160,7 +167,20 @@ class ClientMasterManager(FedMLCommManager):
             )
             broadcast_model_params(self.trainer_dist_adapter.get_model_params(), is_source=True)
         mlops.event("train", event_started=True, event_value=str(self.args.round_idx))
+        # chaos knobs (tests + controlled fault drills): an artificial delay
+        # inflates this client's measured train time so the server's
+        # straggler detector fires; a scheduled raise exercises the flight
+        # recorder's crash-dump path inside a live round span.
+        chaos_delay = float(getattr(self.args, "chaos_train_delay_s", 0) or 0)
+        chaos_raise_at = getattr(self.args, "chaos_raise_at_round", None)
         with tel.span("client.train", round=int(self.args.round_idx)):
+            if chaos_delay > 0:
+                time.sleep(chaos_delay)
+            if chaos_raise_at is not None and int(chaos_raise_at) == int(self.args.round_idx):
+                raise RuntimeError(
+                    f"chaos: injected failure at round {self.args.round_idx} "
+                    f"on rank {self.client_real_id}"
+                )
             weights, local_sample_num = self.trainer_dist_adapter.train(self.args.round_idx)
         mlops.event("train", event_started=False, event_value=str(self.args.round_idx))
         self.send_model_to_server(0, weights, local_sample_num)

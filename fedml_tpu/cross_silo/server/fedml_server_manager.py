@@ -10,9 +10,11 @@ from __future__ import annotations
 import logging
 from typing import Any, Dict, Optional
 
+import os
+
 from ... import mlops
 from ...core import telemetry as tel
-from ...core.telemetry import trace_context
+from ...core.telemetry import flight_recorder, statusz, trace_context
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ..message_define import MyMessage
@@ -37,10 +39,61 @@ class FedMLServerManager(FedMLCommManager):
         self.trace_id = trace_context.new_trace_id()
         self._round_span = None
         self._round_span_idx: Optional[int] = None
+        self._statusz_server: Optional[statusz.StatuszServer] = None
 
     def run(self) -> None:
         mlops.log_aggregation_status("INITIALIZING", str(getattr(self.args, "run_id", "0")))
-        super().run()
+        # the whole receive loop runs under the flight recorder: an exception
+        # in any handler produces one crash dump with the open round span
+        with flight_recorder.installed(role="cross_silo_server"):
+            self._start_statusz_if_configured()
+            try:
+                super().run()
+            finally:
+                self._stop_statusz()
+
+    # --- statusz ----------------------------------------------------------
+    def _start_statusz_if_configured(self) -> None:
+        """Serve `/statusz` + `/metrics` when ``args.statusz_port`` is set
+        (port 0 = ephemeral; the bound port is written to
+        ``args.statusz_port_file`` if given, so tests/operators can find it)."""
+        port = getattr(self.args, "statusz_port", None)
+        if port is None:
+            return
+        fleet = getattr(self.aggregator, "fleet", None)
+        statusz.register_section("round", self._statusz_round_section)
+        if fleet is not None:
+            statusz.register_section("health", fleet.health.statusz)
+        self._statusz_server = statusz.StatuszServer(
+            port=int(port),
+            service="cross_silo_server",
+            gauges_fn=(fleet.health.prom_gauges if fleet is not None else None),
+        )
+        bound = self._statusz_server.start()
+        log.info("statusz serving on http://127.0.0.1:%d/statusz", bound)
+        port_file = getattr(self.args, "statusz_port_file", None)
+        if port_file:
+            tmp = str(port_file) + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(bound))
+            os.replace(tmp, str(port_file))
+
+    def _stop_statusz(self) -> None:
+        if self._statusz_server is None:
+            return
+        statusz.unregister_section("round")
+        statusz.unregister_section("health")
+        self._statusz_server.stop()
+        self._statusz_server = None
+
+    def _statusz_round_section(self) -> dict:
+        return {
+            "round_idx": int(self.args.round_idx),
+            "round_num": self.round_num,
+            "initialized": self.is_initialized,
+            "clients_online": len(self.client_online_status),
+            "cohort": list(self.client_id_list_in_this_round or []),
+        }
 
     # --- round trace lifecycle --------------------------------------------
     # All handlers run on the one receive-loop thread, so the round span can
@@ -99,6 +152,14 @@ class FedMLServerManager(FedMLCommManager):
             int(getattr(self.args, "client_num_in_total", self.size - 1)),
             len(self.client_id_list_in_this_round),
         )
+        self._declare_cohort()
+
+    def _declare_cohort(self) -> None:
+        """Tell fleet telemetry which ranks this round's cohort contains, so
+        a late delta from a reshuffled-out rank is skipped, not raised on."""
+        fleet = getattr(self.aggregator, "fleet", None)
+        if fleet is not None:
+            fleet.set_expected_ranks(self.client_id_list_in_this_round)
 
     def handle_message_client_status_update(self, msg_params: Message) -> None:
         status = msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
@@ -140,6 +201,13 @@ class FedMLServerManager(FedMLCommManager):
         fleet = getattr(self.aggregator, "fleet", None)
         if fleet is not None and fleet.merges:
             mlops.log_fleet_summary(self.args.round_idx, self.aggregator.fleet_summary())
+            # close the health round: MAD straggler test over this round's
+            # client.train durations, shipped through the uplink like the
+            # fleet summary (and readable live on /statusz + /metrics)
+            report = fleet.health.end_round(self.args.round_idx)
+            mlops.log_health_report(self.args.round_idx, report)
+            if report.stragglers:
+                log.warning("round %d stragglers: %s", self.args.round_idx, report.stragglers)
 
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:
@@ -157,6 +225,7 @@ class FedMLServerManager(FedMLCommManager):
             int(getattr(self.args, "client_num_in_total", self.size - 1)),
             len(self.client_id_list_in_this_round),
         )
+        self._declare_cohort()
         self._begin_round_trace()
         with tel.span(
             "server.broadcast", round=int(self.args.round_idx), receivers=len(self.client_id_list_in_this_round)

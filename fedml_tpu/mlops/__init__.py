@@ -184,6 +184,23 @@ def log_fleet_summary(round_idx: Optional[int], fleet_summary: Dict[str, Any]) -
     MLOpsRuntime.get_instance().append_record(rec)
 
 
+def log_health_report(round_idx: Optional[int], report: Dict[str, Any]) -> None:
+    """Publish the cohort :class:`HealthReport` (``core/telemetry/health``)
+    through the uplink: per-rank scores, EWMA round times, failure counts,
+    and the round's straggler verdicts — one record per round, so operator
+    tooling can alarm on a degrading silo without scraping `/statusz`."""
+    rec: Dict[str, Any] = {
+        "type": "metric",
+        "name": "health_round_summary",
+        "t": time.time(),  # wall-clock ok: record timestamp, not a duration
+        "health": dict(report),
+    }
+    if round_idx is not None:
+        rec["round"] = int(round_idx)
+        rec["step"] = int(round_idx)
+    MLOpsRuntime.get_instance().append_record(rec)
+
+
 def log_training_status(status: str, run_id: Optional[str] = None) -> None:
     MLOpsRuntime.get_instance().append_record({"type": "status", "role": "client", "status": status, "run_id": run_id})
 

@@ -39,6 +39,14 @@ def build_fastapi_app(predictor) -> "FastAPI":
         )
         return Response(content=body, media_type=prom.CONTENT_TYPE)
 
+    @api.get("/statusz")
+    async def statusz_page():
+        from ..core.telemetry import statusz
+
+        return statusz.render(service="inference_runner", extra={
+            "predictor_ready": bool(predictor.ready()),
+        })
+
     return api
 
 

@@ -19,7 +19,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
-from ..core.telemetry import prom
+from ..core.telemetry import prom, statusz
 from .fedml_predictor import FedMLPredictor
 
 log = logging.getLogger(__name__)
@@ -178,6 +178,16 @@ class FedMLInferenceRunner:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path == "/statusz":
+                    doc = statusz.render(service="inference_runner", extra={
+                        "predictor_ready": bool(predictor.ready()),
+                        "batching": None if batcher is None else {
+                            "max_batch": batcher.max_batch,
+                            "window_s": batcher.window_s,
+                            "recent_batch_sizes": list(batcher.batch_sizes)[-16:],
+                        },
+                    })
+                    self._send_json(doc)
                 else:
                     self._send_json({"error": "not found"}, code=404)
 

@@ -189,7 +189,39 @@ class ReplicaSet:
             gauges.append(("serving_replicas", {"state": "ready"}, float(len(ready))))
         return gauges
 
+    def statusz_section(self, probe_ready: bool = False) -> Dict[str, Any]:
+        """Per-replica states for `/statusz` (the ready probe is an HTTP
+        round-trip per replica — off by default for the same reason as
+        ``prom_gauges``)."""
+        with self._lock:
+            replicas = list(self.replicas)
+            desired = self.desired
+        out = []
+        for r in replicas:
+            ent: Dict[str, Any] = {
+                "id": r.id,
+                "url": r.url,
+                "alive": r.alive(),
+                "consecutive_failures": r.consecutive_failures,
+            }
+            if probe_ready:
+                ent["ready"] = r.ready(timeout_s=1.0)
+            out.append(ent)
+        return {"desired": desired, "replicas": out}
+
+    def register_statusz(self) -> None:
+        """Expose this replica set as the `/statusz` ``replicas`` section."""
+        from ..core.telemetry import statusz
+
+        statusz.register_section("replicas", self.statusz_section)
+        self._statusz_registered = True
+
     def shutdown(self) -> None:
+        if getattr(self, "_statusz_registered", False):
+            from ..core.telemetry import statusz
+
+            statusz.unregister_section("replicas")
+            self._statusz_registered = False
         with self._lock:
             self.desired = 0
             for r in self.replicas:

@@ -32,6 +32,12 @@ def main(argv=None) -> None:
     p.add_argument("--model-path", default=None)
     args = p.parse_args(argv)
 
+    # install BEFORE the predictor build: model-load crashes are exactly the
+    # ones a restarting controller loses the traceback for
+    from ..core.telemetry import flight_recorder
+
+    flight_recorder.install(role="serving_replica")
+
     if os.environ.get("FEDML_COMPILE_CACHE_DIR"):
         # the serving bench's replicas pay the costliest cold compiles of a
         # tunnel window; the shared persistent cache (ONE definition in

@@ -59,7 +59,12 @@ class SimulatorSingleProcess:
         self.fl_trainer = API(args, device, dataset, model, client_trainer, server_aggregator)
 
     def run(self):
-        return self.fl_trainer.train()
+        from ..core.telemetry import flight_recorder
+
+        # a crash mid-simulation leaves a dump with the open round span and
+        # the last-N events instead of just a traceback
+        with flight_recorder.installed(role="sp_simulator"):
+            return self.fl_trainer.train()
 
 
 class SimulatorVmap:

@@ -1,0 +1,76 @@
+"""Driver for tests/test_flight_recorder.py::TestCrashEndToEnd — NOT a test.
+
+Runs a 3-client cross-silo cluster in THIS process where one client has
+``chaos_raise_at_round=0`` injected, waits for that client to die (its
+``flight_recorder.installed()`` wrapper writes the crash dump), then hard-kills
+the process with ``os._exit``. The surviving parties deadlock waiting on the
+dead client by design — exiting through normal interpreter teardown while
+their daemon threads sit inside native code aborts the process, which is
+exactly the noise a real crashed training job produces and exactly why the
+parent test drives this file as a subprocess and asserts only on the dump
+left behind.
+
+Env: FEDML_FR_DIR must point at the dump directory. Exit 0 once the injected
+exception fired, 3 on timeout.
+"""
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import fedml_tpu as fedml  # noqa: E402
+from fedml_tpu.arguments import default_config  # noqa: E402
+from fedml_tpu.core import telemetry as tel  # noqa: E402
+from fedml_tpu.core.distributed.communication.inmemory.broker import InMemoryBroker  # noqa: E402
+
+N_CLIENTS = 3
+BAD_RANK = 2
+
+
+def make_args(rank, role):
+    over = dict(
+        run_id="test_fr_crash", rank=rank, role=role, backend="INMEMORY",
+        scenario="horizontal", client_num_in_total=N_CLIENTS,
+        client_num_per_round=N_CLIENTS, comm_round=2, epochs=1,
+        batch_size=16, frequency_of_the_test=1, dataset="synthetic",
+        model="lr", random_seed=0,
+    )
+    if role == "client" and rank == BAD_RANK:
+        over["chaos_raise_at_round"] = 0
+    return default_config("cross_silo", **over)
+
+
+def main() -> int:
+    tel.get_telemetry().set_enabled(True)
+    InMemoryBroker.reset()
+    died = threading.Event()
+
+    def run_party(args, key):
+        try:
+            args = fedml.init(args)
+            device = fedml.device.get_device(args)
+            dataset, output_dim = fedml.data.load(args)
+            model = fedml.model.create(args, output_dim)
+            fedml.FedMLRunner(args, device, dataset, model).run()
+        except Exception:  # noqa: BLE001 - the dump already happened downstream
+            if key == f"c{BAD_RANK}":
+                died.set()
+
+    threads = [threading.Thread(
+        target=run_party, args=(make_args(0, "server"), "server"), daemon=True)]
+    for rank in range(1, N_CLIENTS + 1):
+        threads.append(threading.Thread(
+            target=run_party, args=(make_args(rank, "client"), f"c{rank}"),
+            daemon=True))
+    for th in threads:
+        th.start()
+    ok = died.wait(timeout=240)
+    return 0 if ok else 3
+
+
+if __name__ == "__main__":
+    # _exit: skip interpreter teardown — the deadlocked daemon threads are
+    # the point of this scenario, not something to unwind politely
+    os._exit(main())

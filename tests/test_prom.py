@@ -162,6 +162,35 @@ class TestGoldenParseBack:
                 if name == "fedml_span_seconds_total"}
         assert all(v >= 0 for v in secs.values())
 
+    def test_dropped_total_labeled_by_buffer_kind(self):
+        """ISSUE 4 satellite: each bounded buffer gets its own labeled sample
+        under the one fedml_telemetry_dropped_total family."""
+        from fedml_tpu.core.telemetry import flight_recorder as fr
+
+        t = Telemetry(enabled=True)
+        t.dropped_spans = 7
+        t.dropped_events = 2
+        rec = fr.FlightRecorder(capacity=1, enabled=True)
+        for i in range(4):
+            rec.record(fr.EVENT_MARK, f"e{i}")  # 3 overwrites
+        while fr.active() is not None:
+            fr.uninstall()
+        try:
+            fr.install(role="prom_test", recorder=rec)
+            text = prom.render(telemetry=t)
+        finally:
+            fr.uninstall()
+        samples, _, _ = _parse(text)
+        kinds = {labels["kind"]: float(v) for name, labels, v in samples
+                 if name == "fedml_telemetry_dropped_total"}
+        assert kinds == {"span_records": 7.0, "counter_events": 2.0,
+                         "recorder_ring": 3.0}
+        # without an active recorder the ring sample renders as 0, not vanishes
+        samples2, _, _ = _parse(prom.render(telemetry=t))
+        kinds2 = {labels["kind"]: float(v) for name, labels, v in samples2
+                  if name == "fedml_telemetry_dropped_total"}
+        assert kinds2["recorder_ring"] == 0.0
+
     def test_help_and_type_precede_samples(self):
         text = prom.render(telemetry=self._populated())
         seen_sample_of = set()

@@ -58,6 +58,7 @@ commit_artifacts() {
       surface_agg_rates
       surface_span_summary
       surface_trace_files
+      surface_crash_dumps
     else
       log "COMMIT FAILED: $(tail -c 400 /tmp/bench_watch_commit.err)"
     fi
@@ -134,6 +135,40 @@ PYEOF
   [ -n "$traces" ] && log "$traces"
 }
 
+surface_crash_dumps() {
+  # surface flight-recorder crash dumps: any "crash_dump" key riding the
+  # newest artifact JSON plus fresh files in the recorder's dump dir, so a
+  # stage that died mid-measurement points straight at its forensic record
+  # (render with: python tools/fr_dump.py PATH)
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1)
+  local dumps
+  dumps=$(python3 - "${newest:-}" <<'PYEOF' 2>/dev/null
+import glob, json, os, sys, time
+found = []
+if len(sys.argv) > 1 and sys.argv[1] and os.path.exists(sys.argv[1]):
+    doc = json.load(open(sys.argv[1]))
+    def walk(d):
+        if isinstance(d, dict):
+            for k, v in d.items():
+                if k == "crash_dump" and isinstance(v, str):
+                    found.append(v)
+                else:
+                    walk(v)
+    walk(doc)
+dump_dir = os.environ.get("FEDML_FR_DIR") or os.path.expanduser("~/.fedml_tpu/crash")
+cutoff = time.time() - 24 * 3600
+for p in glob.glob(os.path.join(dump_dir, "fr_*.jsonl")):
+    if os.path.getmtime(p) >= cutoff:
+        found.append(p)
+if found:
+    print("crash dumps (render: python tools/fr_dump.py PATH): "
+          + "; ".join(sorted(set(found))))
+PYEOF
+) || return 0
+  [ -n "$dumps" ] && log "$dumps"
+}
+
 have_measured_headline() {
   # true iff some measured artifact carries a NUMERIC headline value — the
   # full ladder writes incremental artifacts even when the headline stage
@@ -195,6 +230,9 @@ while true; do
         log "another bench owns the chip (designed yield, rc=$rc)"
       else
         log "bench incomplete (rc=$rc): $(tail -c 400 /tmp/bench_watch_last.err)"
+        # a dying stage may have left a crash dump even when no artifact
+        # landed — surface it now rather than only on successful commits
+        surface_crash_dumps
       fi
       # stage isolation means partial artifacts may still exist — bank them
       commit_artifacts

@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Telemetry-hygiene lint (tier-1 enforced; tests/test_telemetry.py runs it).
 
-Two rules over ``fedml_tpu/**/*.py``:
+Four rules over ``fedml_tpu/**/*.py``:
 
 1. **Reserved-header containment.** The comm layer reserves one ``Message``
    parameter key for the trace-context + delta-snapshot header. The string
@@ -14,6 +14,18 @@ Two rules over ``fedml_tpu/**/*.py``:
 2. **Timing-idiom regressions.** Re-runs ``check_timing.find_violations`` so
    one tool invocation covers both lints (new ad-hoc ``time.time()`` calls
    still need their ``# wall-clock ok:`` marker).
+
+3. **Recorder event-kind containment.** The flight recorder's event-kind
+   literals ("span_open" etc.) belong ONLY to
+   ``core/telemetry/flight_recorder.py``; ad-hoc producers spelling them
+   elsewhere would invent look-alike events ``tools/fr_dump.py`` cannot
+   interpret. Everything else records via ``flight_recorder.record_event``
+   with the EVENT_* constants (or ``mark``/``record_comm``).
+
+4. **Excepthook containment.** ``sys.excepthook`` / ``threading.excepthook``
+   may be touched ONLY by ``core/telemetry/flight_recorder.py`` — a second
+   installer would silently drop crash dumps (or the other hook), depending
+   on import order.
 
 Exit status: 0 clean, 1 with violations listed on stdout.
 """
@@ -32,23 +44,51 @@ RESERVED = "__" + "telemetry" + "__"
 # The one module allowed to spell the literal (relative to the scan root).
 ALLOWED_FILES = (os.path.join("core", "telemetry", "trace_context.py"),)
 
+# The one module allowed to spell recorder event kinds or touch excepthooks.
+FLIGHT_RECORDER = os.path.join("core", "telemetry", "flight_recorder.py")
+# Distinctive kind literals only — generic words ("exception", "mark") would
+# false-positive across the tree.
+RECORDER_KINDS = ("span_open", "span_close", "comm_send", "comm_recv")
+EXCEPTHOOK_NEEDLES = ("sys.excepthook", "threading.excepthook")
 
-def find_reserved_key_violations(root: str) -> list:
+
+def _scan(root: str, match, allowed: tuple) -> list:
+    """Generic line scan: ``match(line) -> bool`` over .py files outside
+    ``allowed`` (paths relative to the scan root)."""
     violations = []
-    needles = ('"' + RESERVED + '"', "'" + RESERVED + "'")
     for dirpath, _dirnames, filenames in os.walk(root):
         for fname in sorted(filenames):
             if not fname.endswith(".py"):
                 continue
             path = os.path.join(dirpath, fname)
             rel = os.path.relpath(path, root)
-            if rel in ALLOWED_FILES:
+            if rel in allowed:
                 continue
             with open(path, encoding="utf-8") as f:
                 for lineno, line in enumerate(f, 1):
-                    if any(n in line for n in needles):
+                    if match(line):
                         violations.append((path, lineno, line.strip()))
     return violations
+
+
+def find_reserved_key_violations(root: str) -> list:
+    needles = ('"' + RESERVED + '"', "'" + RESERVED + "'")
+    return _scan(root, lambda line: any(n in line for n in needles), ALLOWED_FILES)
+
+
+def find_recorder_kind_violations(root: str) -> list:
+    """Quoted recorder event-kind literals outside flight_recorder.py."""
+    needles = tuple('"' + k + '"' for k in RECORDER_KINDS) + tuple(
+        "'" + k + "'" for k in RECORDER_KINDS
+    )
+    return _scan(root, lambda line: any(n in line for n in needles),
+                 (FLIGHT_RECORDER,))
+
+
+def find_excepthook_violations(root: str) -> list:
+    """sys/threading excepthook references outside flight_recorder.py."""
+    return _scan(root, lambda line: any(n in line for n in EXCEPTHOOK_NEEDLES),
+                 (FLIGHT_RECORDER,))
 
 
 def main(argv: list = ()) -> int:
@@ -73,6 +113,28 @@ def main(argv: list = ()) -> int:
     if timing:
         print(
             f"\n{len(timing)} unmarked time.time() call(s) — see tools/check_timing.py."
+        )
+        rc = 1
+
+    kinds = find_recorder_kind_violations(root)
+    for path, lineno, line in kinds:
+        print(f"{os.path.relpath(path, repo)}:{lineno}: raw recorder event kind: {line}")
+    if kinds:
+        print(
+            f"\n{len(kinds)} raw recorder event-kind literal(s). Use the "
+            "flight_recorder.EVENT_* constants via record_event/mark/"
+            "record_comm — ad-hoc kinds are invisible to tools/fr_dump.py."
+        )
+        rc = 1
+
+    hooks = find_excepthook_violations(root)
+    for path, lineno, line in hooks:
+        print(f"{os.path.relpath(path, repo)}:{lineno}: excepthook outside flight_recorder: {line}")
+    if hooks:
+        print(
+            f"\n{len(hooks)} excepthook reference(s) outside "
+            "core/telemetry/flight_recorder.py. Crash handling has ONE owner: "
+            "use flight_recorder.install()/installed() instead."
         )
         rc = 1
     return rc

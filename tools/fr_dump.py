@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""Pretty-print a flight-recorder crash dump (JSONL written by
+fedml_tpu.core.telemetry.flight_recorder).
+
+Usage:
+    python tools/fr_dump.py PATH [PATH ...]
+    python tools/fr_dump.py --latest [DIR]     # newest dump in DIR
+                                               # (default: ~/.fedml_tpu/crash)
+    python tools/fr_dump.py --json PATH        # parsed dump as one JSON doc
+
+Renders the meta header, the triggering exception, the failing span stack
+(open spans + the error-unwind trail), the counter snapshot, the trace
+context, and the event ring as a timeline (relative seconds, kind, name,
+fields). Exits non-zero on a missing/unparseable dump so scripts can gate
+on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+DEFAULT_DUMP_DIR = os.path.join("~", ".fedml_tpu", "crash")
+
+
+def parse_dump(path: str) -> Dict[str, Any]:
+    """Parse a dump file into {meta, exception, span_stack, counters,
+    histograms, trace, env, events}. Raises ValueError on malformed input."""
+    doc: Dict[str, Any] = {"events": []}
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{lineno}: not JSON: {e}") from e
+            kind = rec.get("type")
+            if kind == "event":
+                doc["events"].append(rec)
+            elif kind is not None:
+                doc[kind] = rec
+            else:
+                raise ValueError(f"{path}:{lineno}: record without a type")
+    if "meta" not in doc:
+        raise ValueError(f"{path}: no meta record — not a flight-recorder dump")
+    return doc
+
+
+def find_latest(dump_dir: str) -> Optional[str]:
+    paths = glob.glob(os.path.join(os.path.expanduser(dump_dir), "fr_*.jsonl"))
+    return max(paths, key=os.path.getmtime) if paths else None
+
+
+def _fmt_fields(fields: Optional[Dict[str, Any]]) -> str:
+    if not fields:
+        return ""
+    return " " + " ".join(f"{k}={v}" for k, v in fields.items())
+
+
+def render(doc: Dict[str, Any], out=sys.stdout) -> None:
+    meta = doc["meta"]
+    w = out.write
+    w("=== flight recorder dump ===\n")
+    w(f"reason:   {meta.get('reason')}\n")
+    w(f"role:     {meta.get('role')}   pid: {meta.get('pid')}   "
+      f"schema: v{meta.get('schema')}\n")
+    w(f"events:   {meta.get('events')}/{meta.get('capacity')} "
+      f"(dropped {meta.get('dropped')})\n")
+
+    exc = doc.get("exception")
+    if exc:
+        w(f"\n--- exception: {exc.get('class')}: {exc.get('message')}\n")
+        for chunk in exc.get("traceback", []):
+            w("    " + chunk.replace("\n", "\n    ").rstrip() + "\n")
+
+    trace = doc.get("trace", {}).get("context")
+    if trace:
+        w(f"\n--- trace: id={trace.get('trace_id')} round={trace.get('round')}\n")
+
+    spans = doc.get("span_stack", {}).get("spans", [])
+    if spans:
+        w("\n--- failing span stack (outermost first):\n")
+        for depth, sp in enumerate(spans):
+            state = "open" if sp.get("open") else "unwound"
+            w(f"  {'  ' * depth}{sp.get('name')} [{state}]"
+              f"{_fmt_fields(sp.get('attrs'))}\n")
+
+    counters = doc.get("counters", {}).get("counters", {})
+    if counters:
+        w("\n--- counters:\n")
+        for name in sorted(counters):
+            w(f"  {name} = {counters[name]}\n")
+
+    events = doc.get("events", [])
+    if events:
+        w(f"\n--- last {len(events)} events (oldest first):\n")
+        t0 = events[0].get("t_ns", 0)
+        for ev in events:
+            rel_s = (ev.get("t_ns", 0) - t0) / 1e9
+            w(f"  +{rel_s:9.4f}s  {ev.get('kind'):<10} {ev.get('name')}"
+              f"{_fmt_fields(ev.get('fields'))}\n")
+    w("\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="*", help="dump files to render")
+    p.add_argument("--latest", nargs="?", const=DEFAULT_DUMP_DIR, default=None,
+                   metavar="DIR", help="render the newest dump in DIR")
+    p.add_argument("--json", action="store_true",
+                   help="emit the parsed dump as one JSON document")
+    args = p.parse_args(argv)
+
+    paths = list(args.paths)
+    if args.latest is not None:
+        latest = find_latest(args.latest)
+        if latest is None:
+            print(f"no dumps in {args.latest}", file=sys.stderr)
+            return 1
+        paths.append(latest)
+    if not paths:
+        p.print_usage(sys.stderr)
+        return 2
+
+    rc = 0
+    for path in paths:
+        try:
+            doc = parse_dump(path)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        if args.json:
+            json.dump(doc, sys.stdout, indent=2)
+            sys.stdout.write("\n")
+        else:
+            print(f"# {path}")
+            render(doc)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
