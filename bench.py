@@ -878,6 +878,7 @@ def _bench_agg(reps_cap: int = 16):
         for k, v in tel.snapshot()["span_stats"].items()
         if k.startswith("agg.")
     }
+    ckpt_enqueue_ms, resume_verified = _bench_round_checkpoint()
     return {
         "agg_clients_per_sec": clients_per_sec,
         "agg_hbm_gbps": hbm_gbps,
@@ -889,8 +890,68 @@ def _bench_agg(reps_cap: int = 16):
         # contract the tier-1 regression test pins
         "agg_accum_traces": eng.accum_traces,
         "agg_span_summary": agg_span_summary,
+        "ckpt_enqueue_ms": ckpt_enqueue_ms,
+        "resume_verified": resume_verified,
         "device": getattr(dev, "device_kind", str(dev)),
     }
+
+
+def _bench_round_checkpoint(rounds: int = 4):
+    """Durable-round-state cost rider on the agg stage: the server enqueues
+    an async checkpoint at every round boundary (core/resilience), so the
+    enqueue must be effectively free next to aggregation itself. Times
+    ``RoundStateStore.save_round(wait=False)`` on the ResNet-56 pytree and
+    guards the best enqueue under 5 ms — past that the "async" save is
+    blocking the round loop and resilience is no longer a rider. Then proves
+    the whole durability story end to end: wait for the writer, resume from
+    the watermark, and require the restored tree bit-identical
+    (``resume_verified`` in the artifact; tools/bench_watch.sh surfaces it)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.core.resilience import RoundStateStore
+    from fedml_tpu.models.resnet import ResNetCifar
+
+    model = ResNetCifar(depth=56, num_classes=10)
+    params = jax.device_get(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))["params"]
+    )
+    tmp = tempfile.mkdtemp(prefix="bench_round_ckpt_")
+    try:
+        store = RoundStateStore(tmp)
+        enqueue_ms = []
+        for r in range(rounds):
+            t0 = time.perf_counter()
+            store.save_round(r, {"model": params}, cohort=[1, 2, 3], wait=False)
+            enqueue_ms.append((time.perf_counter() - t0) * 1e3)
+            # drain between reps (untimed): back-to-back enqueues would hit
+            # the one-in-flight drop path and time nothing
+            store.wait()
+        best_ms = min(enqueue_ms)
+        if best_ms >= 5.0:
+            raise BenchIntegrityError(
+                f"round-state enqueue {best_ms:.2f} ms >= 5 ms — the async "
+                "checkpoint is blocking the round loop; refusing to publish"
+            )
+        store.close()
+        reopened = RoundStateStore(tmp)
+        template = jax.tree.map(np.zeros_like, params)
+        rs = reopened.resume(template={"model": template})
+        ok = rs is not None and rs.round_idx == rounds - 1 and all(
+            np.array_equal(a, b) for a, b in zip(
+                jax.tree.leaves(params), jax.tree.leaves(rs.state["model"]))
+        )
+        reopened.close()
+        if not ok:
+            raise BenchIntegrityError(
+                "round-state resume is not bit-identical to the saved tree"
+            )
+        return round(best_ms, 3), True
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: int = 3):
@@ -2150,6 +2211,11 @@ def main() -> None:
         out["agg_accum_traces"] = agg["agg_accum_traces"]
         if agg.get("agg_span_summary"):
             out["agg_span_summary"] = agg["agg_span_summary"]
+        # resilience rider: async round-checkpoint enqueue cost (<5ms guard
+        # inside the stage) + proof that watermark resume is bit-identical
+        if agg.get("ckpt_enqueue_ms") is not None:
+            out["ckpt_enqueue_ms"] = agg["ckpt_enqueue_ms"]
+            out["resume_verified"] = agg["resume_verified"]
 
     attn = stage_out.get("attn_micro")
     if attn is not None:

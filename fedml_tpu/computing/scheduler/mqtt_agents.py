@@ -442,7 +442,7 @@ class JobMonitor:
                 rc = proc.poll()
                 if rc is not None and st.status == "RUNNING":
                     # give the runner's own waiter a beat to report first
-                    time.sleep(0.2)
+                    time.sleep(0.2)  # sleep ok: grace period for the runner's own waiter, not a retry
                     if agent.runner.runs[run_id].status == "RUNNING":
                         st.returncode = rc
                         st.status = "FINISHED" if rc == 0 else "FAILED"

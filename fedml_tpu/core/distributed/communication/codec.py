@@ -26,11 +26,31 @@ def message_to_bytes(msg: Message) -> bytes:
 
 
 def message_from_bytes(data: bytes) -> Message:
+    """Decode one frame. A truncated or corrupt frame raises ``ValueError``
+    (retryable by core.resilience.retry) rather than a confusing
+    struct/json/KeyError deep in a backend's receive loop."""
+    if len(data) < 4:
+        raise ValueError(
+            f"truncated frame: {len(data)} bytes, need >= 4 for the header length"
+        )
     (hlen,) = struct.unpack(">I", data[:4])
-    header = json.loads(data[4 : 4 + hlen].decode())
+    if 4 + hlen > len(data):
+        raise ValueError(
+            f"truncated frame: header claims {hlen} bytes but only "
+            f"{len(data) - 4} follow the length prefix"
+        )
+    try:
+        header = json.loads(data[4 : 4 + hlen].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+        raise ValueError(f"corrupt frame header: {exc}") from exc
+    if not isinstance(header, dict):
+        raise ValueError(f"corrupt frame header: expected JSON object, got {type(header).__name__}")
     msg = Message()
     msg.init_from_json_object(header)
     payload = data[4 + hlen :]
     if payload:
-        msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, deserialize_pytree(payload))
+        try:
+            msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, deserialize_pytree(payload))
+        except Exception as exc:  # noqa: BLE001 - npz corruption surfaces many types
+            raise ValueError(f"corrupt frame payload: {exc}") from exc
     return msg

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 import grpc
 
 from .....constants import GRPC_BASE_PORT
+from .....core.resilience.retry import RetryPolicy, retry_call
 from .....core.telemetry import trace_context
 from ..base_com_manager import BaseCommunicationManager, Observer
 from ..codec import message_from_bytes, message_to_bytes
@@ -129,12 +130,15 @@ class GRPCCommManager(BaseCommunicationManager):
             method = f"/{SERVICE}/{METHOD}"
         return ch.unary_unary(method, request_serializer=None, response_deserializer=None)
 
-    def send_message(self, msg: Message) -> None:
-        """Send with UNAVAILABLE retry: peers may come up in any order (the
-        MQTT broker absorbs this for MQTT_S3; point-to-point gRPC must
-        retry until the receiver's server socket exists)."""
-        import time
+    # peers come up in any order (the MQTT broker absorbs this for MQTT_S3;
+    # point-to-point gRPC must retry until the receiver's socket exists), so
+    # this policy is generous: many attempts under a 120s elapsed budget
+    _SEND_RETRY = RetryPolicy(
+        max_attempts=1000, base_delay_s=0.2, max_delay_s=5.0, budget_s=120.0
+    )
 
+    def send_message(self, msg: Message) -> None:
+        """Send with UNAVAILABLE retry via core.resilience.retry."""
         trace_context.inject(msg)
         if self.wire == "fedml":
             from . import ref_wire
@@ -143,18 +147,16 @@ class GRPCCommManager(BaseCommunicationManager):
         else:
             data = message_to_bytes(msg)
         receiver = msg.get_receiver_id()
-        deadline = time.time() + 120.0  # wall-clock ok: retry deadline
-        delay = 0.2
-        while True:
-            try:
-                self._stub(receiver)(data, timeout=600)
-                return
-            except grpc.RpcError as e:  # pragma: no cover - timing dependent
-                code = e.code() if hasattr(e, "code") else None
-                if code != grpc.StatusCode.UNAVAILABLE or time.time() > deadline:  # wall-clock ok: retry deadline
-                    raise
-                time.sleep(delay)
-                delay = min(delay * 2, 5.0)
+
+        def _unavailable(exc: BaseException) -> bool:  # pragma: no cover - timing dependent
+            return isinstance(exc, grpc.RpcError) and getattr(exc, "code", lambda: None)() == grpc.StatusCode.UNAVAILABLE
+
+        retry_call(
+            lambda: self._stub(receiver)(data, timeout=600),
+            policy=self._SEND_RETRY,
+            label="grpc",
+            is_retryable=_unavailable,
+        )
 
     # --- loop ------------------------------------------------------------
     def add_observer(self, observer: Observer) -> None:

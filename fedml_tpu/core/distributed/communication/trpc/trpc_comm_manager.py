@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from .....constants import TRPC_BASE_PORT
+from .....core.resilience.retry import RetryPolicy, retry_call
 from .....core.telemetry import trace_context
 from ..base_com_manager import BaseCommunicationManager, Observer
 from ..grpc.grpc_comm_manager import read_ip_config
@@ -129,6 +130,12 @@ def recv_frame(sock: socket.socket) -> Message:
 # --- comm manager ------------------------------------------------------------
 
 class TRPCCommManager(BaseCommunicationManager):
+    # generous connect policy: peers come up in any order, so many attempts
+    # under an elapsed budget (mirrors the gRPC backend's UNAVAILABLE retry)
+    _CONNECT_RETRY = RetryPolicy(
+        max_attempts=1000, base_delay_s=0.1, max_delay_s=2.0, budget_s=120.0
+    )
+
     def __init__(
         self,
         ip_config_path: Optional[str] = None,
@@ -195,8 +202,6 @@ class TRPCCommManager(BaseCommunicationManager):
         backend's UNAVAILABLE retry). The lock is created under _connect_lock
         BEFORE the socket is published so concurrent first senders never see
         a socket without its lock."""
-        import time
-
         import select
 
         with self._connect_lock:
@@ -216,24 +221,24 @@ class TRPCCommManager(BaseCommunicationManager):
                     pass
             self._out_locks.setdefault(receiver, threading.Lock())
         addr = (self.ip_table.get(receiver, "127.0.0.1"), self.base_port + receiver)
-        deadline = time.time() + 120.0  # wall-clock ok: retry deadline
-        delay = 0.1
-        while True:
-            try:
-                sock = socket.create_connection(addr, timeout=10)
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                sock.settimeout(None)
-                with self._connect_lock:
-                    if receiver in self._out_socks:  # lost a connect race
-                        sock.close()
-                    else:
-                        self._out_socks[receiver] = sock
-                    return self._out_socks[receiver]
-            except OSError:
-                if time.time() > deadline:  # wall-clock ok: retry deadline
-                    raise
-                time.sleep(delay)
-                delay = min(delay * 2, 2.0)
+
+        def _dial() -> socket.socket:
+            sock = socket.create_connection(addr, timeout=10)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(None)
+            with self._connect_lock:
+                if receiver in self._out_socks:  # lost a connect race
+                    sock.close()
+                else:
+                    self._out_socks[receiver] = sock
+                return self._out_socks[receiver]
+
+        return retry_call(
+            _dial,
+            policy=self._CONNECT_RETRY,
+            label="trpc",
+            is_retryable=lambda e: isinstance(e, OSError),
+        )
 
     def _drop(self, receiver: int, sock: socket.socket) -> None:
         with self._connect_lock:

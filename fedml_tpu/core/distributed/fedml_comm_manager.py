@@ -49,6 +49,11 @@ class FedMLCommManager(Observer):
         self.comm = comm
         self.com_manager: Optional[BaseCommunicationManager] = None
         self.message_handler_dict: Dict[Any, Callable[[Message], None]] = {}
+        # send-path retry policy; None (retries disabled) keeps send_message
+        # a plain two-call path with zero added cost
+        from ..resilience.retry import RetryPolicy
+
+        self._retry_policy = RetryPolicy.from_args(args)
         self._init_manager()
 
     def register_comm_manager(self, comm_manager: BaseCommunicationManager) -> None:
@@ -84,7 +89,16 @@ class FedMLCommManager(Observer):
 
     def send_message(self, message: Message) -> None:
         flight_recorder.record_comm("send", message)
-        self.com_manager.send_message(message)
+        if self._retry_policy is None:
+            self.com_manager.send_message(message)
+            return
+        from ..resilience.retry import retry_call
+
+        retry_call(
+            lambda: self.com_manager.send_message(message),
+            policy=self._retry_policy,
+            label=self.backend.lower(),
+        )
 
     def register_message_receive_handler(self, msg_type, handler_callback_func: Callable[[Message], None]) -> None:
         self.message_handler_dict[msg_type] = handler_callback_func

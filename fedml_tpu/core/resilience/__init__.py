@@ -1,0 +1,87 @@
+"""Resilience subsystem: durable round state, quorum aggregation, retrying comms.
+
+PR 4 gave the stack *detection* (flight recorder, per-client health, chaos
+injection); this package is the *recovery* half (Holmes, arxiv 2312.03549:
+heterogeneous failure-prone clusters are the norm, not the exception):
+
+- :mod:`round_state` — atomic, async round-boundary checkpoints with a
+  completion watermark, plus crash-resume for the sp simulator and the
+  cross-silo server;
+- :mod:`quorum` — deadline-based partial aggregation so one dead client
+  cannot hang a synchronous round forever, with straggler-aware cohort
+  over-provisioning;
+- :mod:`retry` — the one retry/backoff policy every comm backend shares
+  (exponential + jitter, budget-capped, flight-recorder-booked,
+  ``fedml_comm_retry_total{backend=...}`` counters).
+
+`/statusz` renders a ``resilience`` block from :func:`statusz_snapshot`
+(see ``core/telemetry/statusz.py``), fed by the module-level registry the
+three submodules update as they act.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict
+
+from .quorum import QuorumPolicy, RoundQuorum, overprovisioned_cohort_size
+from .retry import RetryPolicy, retry_call, transient_error
+from .round_state import RoundState, RoundStateStore
+
+__all__ = [
+    "QuorumPolicy",
+    "RoundQuorum",
+    "RetryPolicy",
+    "RoundState",
+    "RoundStateStore",
+    "retry_call",
+    "transient_error",
+    "overprovisioned_cohort_size",
+    "note",
+    "statusz_snapshot",
+]
+
+# Process-wide "most recent resilience facts" for the /statusz page. Written
+# by round_state/quorum/retry as they act; read by statusz.render(). A status
+# page wants "what happened last", not a full event log — the flight recorder
+# owns the log.
+_lock = threading.Lock()
+_state: Dict[str, Any] = {}
+
+
+def note(**facts: Any) -> None:
+    """Record status facts (e.g. ``note(last_checkpoint_round=7)``)."""
+    with _lock:
+        _state.update(facts)
+
+
+def statusz_snapshot() -> Dict[str, Any]:
+    """The ``resilience`` block for `/statusz`: last checkpointed round,
+    quorum stats, and the retry counters from the telemetry registry."""
+    from ..telemetry import core as tel_core
+
+    with _lock:
+        doc: Dict[str, Any] = dict(_state)
+    t = tel_core.get_telemetry()
+    retries = {
+        name[len(retry_counter_prefix()):]: c.value
+        for name, c in t._counters.items()
+        if name.startswith(retry_counter_prefix())
+    }
+    if retries:
+        doc["comm_retries"] = retries
+    for key, counter_name in (
+        ("quorum_partial_total", "quorum.partial"),
+        ("quorum_late_discarded_total", "quorum.late_discarded"),
+        ("checkpoint_dropped_total", "checkpoint.dropped"),
+    ):
+        c = t._counters.get(counter_name)
+        if c is not None:
+            doc[key] = c.value
+    return doc
+
+
+def retry_counter_prefix() -> str:
+    from .retry import RETRY_COUNTER_PREFIX
+
+    return RETRY_COUNTER_PREFIX

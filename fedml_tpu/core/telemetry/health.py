@@ -266,6 +266,44 @@ class HealthTracker:
             get_telemetry().counter("straggler").add(len(flagged))
         return report
 
+    # --- persistence (core.resilience round-state snapshots) ----------------
+    def export_state(self) -> Dict[str, Any]:
+        """JSON-safe per-rank state for a round-state snapshot, so a resumed
+        server keeps its EWMA baselines (the adaptive quorum deadline derives
+        from them) instead of relearning the cohort from scratch. Monotonic
+        ``last_seen`` timestamps are deliberately not exported — they are
+        meaningless across a process restart."""
+        with self._lock:
+            return {
+                str(r): {
+                    "ewma_s": c.ewma_s,
+                    "last_s": c.last_s,
+                    "rounds": c.rounds,
+                    "consecutive_failures": c.consecutive_failures,
+                    "total_failures": c.total_failures,
+                    "straggler_rounds": c.straggler_rounds,
+                    "flagged": c.flagged,
+                }
+                for r, c in sorted(self._clients.items())
+            }
+
+    def restore_state(self, state: Optional[Dict[str, Any]]) -> None:
+        if not state:
+            return
+        with self._lock:
+            for rank_str, d in state.items():
+                try:
+                    c = self._client(int(rank_str))
+                except (TypeError, ValueError):
+                    continue
+                c.ewma_s = d.get("ewma_s")
+                c.last_s = d.get("last_s")
+                c.rounds = int(d.get("rounds", 0))
+                c.consecutive_failures = int(d.get("consecutive_failures", 0))
+                c.total_failures = int(d.get("total_failures", 0))
+                c.straggler_rounds = int(d.get("straggler_rounds", 0))
+                c.flagged = bool(d.get("flagged", False))
+
     # --- read side (statusz / metrics / uplink) ----------------------------
     def report(self) -> Optional[HealthReport]:
         """The most recent :meth:`end_round` report (None before round 0)."""

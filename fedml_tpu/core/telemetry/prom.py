@@ -9,6 +9,8 @@ Mapping:
 - ``counter("a.b")``            → ``fedml_a_b_total`` (TYPE counter)
 - ``counter("jax.compiles.f")`` → ``fedml_jax_compiles_total{fn="f"}`` — the
   per-function compile counters collapse into one labeled family
+- ``counter("comm.retry.grpc")`` → ``fedml_comm_retry_total{backend="grpc"}``
+  — the resilience retry counters collapse the same way
 - ``histogram("x_seconds")``    → ``fedml_x_seconds_bucket{le=...}`` cumulative
   buckets + ``_sum`` + ``_count`` (TYPE histogram)
 - span stats                    → ``fedml_span_seconds_total{span=...}`` and
@@ -87,11 +89,16 @@ def render(telemetry: Optional[Telemetry] = None,
     lines: List[str] = []
 
     # --- counters --------------------------------------------------------
+    from ..resilience.retry import RETRY_COUNTER_PREFIX
+
     compiles: Dict[str, int] = {}
+    retries: Dict[str, int] = {}
     plain: Dict[str, int] = {}
     for name, value in sorted(snap["counters"].items()):
         if name.startswith(COMPILE_COUNTER_PREFIX):
             compiles[name[len(COMPILE_COUNTER_PREFIX):]] = value
+        elif name.startswith(RETRY_COUNTER_PREFIX):
+            retries[name[len(RETRY_COUNTER_PREFIX):]] = value
         else:
             plain[name] = value
     if compiles:
@@ -100,6 +107,12 @@ def render(telemetry: Optional[Telemetry] = None,
         lines.append(f"# TYPE {fam} counter")
         for fn, value in sorted(compiles.items()):
             lines.append(f'{fam}{{fn="{escape_label_value(fn)}"}} {format_value(value)}')
+    if retries:
+        fam = _fam("comm_retry", "_total")
+        lines.append(f"# HELP {fam} comm send retries per backend")
+        lines.append(f"# TYPE {fam} counter")
+        for backend, value in sorted(retries.items()):
+            lines.append(f'{fam}{{backend="{escape_label_value(backend)}"}} {format_value(value)}')
     for name, value in plain.items():
         fam = _fam(name, "_total")
         lines.append(f"# HELP {fam} telemetry counter {escape_help(name)}")

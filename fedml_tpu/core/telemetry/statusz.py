@@ -80,6 +80,17 @@ def render(service: Optional[str] = None,
         "flight_recorder": fr,
         "sections": {},
     }
+    # the resilience section (last checkpointed round, quorum stats, retry
+    # counters) is always registered: any process that checkpointed, retried,
+    # or aggregated partially shows it without per-process wiring
+    try:
+        from ..resilience import statusz_snapshot
+
+        res = statusz_snapshot()
+        if res:
+            doc["sections"]["resilience"] = res
+    except Exception as e:  # noqa: BLE001 - status page must not throw
+        doc["sections"]["resilience"] = {"error": repr(e)}
     with _sections_lock:
         providers = dict(_sections)
     for name, provider in sorted(providers.items()):
@@ -132,11 +143,13 @@ class StatuszServer:
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  service: Optional[str] = None,
-                 gauges_fn: Optional[Callable[[], List[tuple]]] = None):
+                 gauges_fn: Optional[Callable[[], List[tuple]]] = None,
+                 port_file: Optional[str] = None):
         self._host = host
         self._want_port = int(port)
         self.service = service
         self._gauges_fn = gauges_fn
+        self._port_file = port_file
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
@@ -150,6 +163,11 @@ class StatuszServer:
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="statusz", daemon=True)
         self._thread.start()
+        if self._port_file:
+            tmp = self._port_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(self.port))
+            os.replace(tmp, self._port_file)  # atomic: probes never see a torn port
         return self.port
 
     def stop(self) -> None:
@@ -160,3 +178,10 @@ class StatuszServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        # a clean shutdown removes the discovery breadcrumb so probes never
+        # dial a port that has been reused by another process
+        if self._port_file:
+            try:
+                os.remove(self._port_file)
+            except OSError:
+                pass

@@ -26,7 +26,6 @@ import json
 import logging
 import os
 import tempfile
-import time
 from typing import Any, Dict, Optional
 
 log = logging.getLogger(__name__)
@@ -86,17 +85,20 @@ class ResumableTransfer:
 
     # --- retry -----------------------------------------------------------
     def _with_retry(self, what: str, fn, *args):
-        delay = self.backoff_s
-        for attempt in range(self.max_retries + 1):
-            try:
-                return fn(*args)
-            except Exception as e:  # noqa: BLE001 - WAN faults are opaque
-                if attempt == self.max_retries:
-                    raise
-                log.warning("%s failed (%r); retry %d/%d in %.1fs",
-                            what, e, attempt + 1, self.max_retries, delay)
-                time.sleep(delay)
-                delay *= 2
+        from ..core.resilience.retry import RetryPolicy, retry_call
+
+        policy = RetryPolicy(
+            max_attempts=self.max_retries + 1,
+            base_delay_s=self.backoff_s,
+            max_delay_s=max(self.backoff_s * 16, self.backoff_s),
+            budget_s=None,  # chunk count bounds the transfer, not wall time
+        )
+        return retry_call(
+            lambda: fn(*args),
+            policy=policy,
+            label="wan",
+            is_retryable=lambda e: True,  # WAN faults are opaque
+        )
 
     # --- upload ----------------------------------------------------------
     def upload(self, src_path: str, key: str) -> str:

@@ -76,7 +76,9 @@ class ClientMasterManager(FedMLCommManager):
         self.client_index = int(data_silo_index)
         self.trainer_dist_adapter.update_dataset(int(data_silo_index))
         self.trainer_dist_adapter.update_model(global_model_params)
-        self.args.round_idx = 0
+        # a resumed server's first round is not 0 — adopt its round index so
+        # local-training seeds replay exactly (crash-resume bit-identity)
+        self.args.round_idx = int(msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX) or 0)
         self.__train()
 
     def handle_message_receive_model_from_server(self, msg_params: Message) -> None:
@@ -85,7 +87,14 @@ class ClientMasterManager(FedMLCommManager):
         self.client_index = int(client_index)
         self.trainer_dist_adapter.update_dataset(int(client_index))
         self.trainer_dist_adapter.update_model(model_params)
-        if self.args.round_idx + 1 < self.num_rounds:
+        ridx = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        if ridx is not None:
+            # our server stamps every sync with its round index; adopt it —
+            # with subset cohorts (over-provisioning) or a resumed server the
+            # local +1 counter would drift from the true round
+            self.args.round_idx = int(ridx)
+            self.__train()
+        elif self.args.round_idx + 1 < self.num_rounds:
             self.args.round_idx += 1
             self.__train()
         else:
@@ -129,6 +138,8 @@ class ClientMasterManager(FedMLCommManager):
             message = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.client_real_id, receive_id)
             message.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
             message.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, int(local_sample_num))
+            # round tag: the server's quorum discards deltas from past rounds
+            message.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, int(self.args.round_idx))
             self._attach_telemetry_delta(message)
             self.send_message(message)
 
@@ -175,7 +186,7 @@ class ClientMasterManager(FedMLCommManager):
         chaos_raise_at = getattr(self.args, "chaos_raise_at_round", None)
         with tel.span("client.train", round=int(self.args.round_idx)):
             if chaos_delay > 0:
-                time.sleep(chaos_delay)
+                time.sleep(chaos_delay)  # sleep ok: chaos injection delay, not a retry loop
             if chaos_raise_at is not None and int(chaos_raise_at) == int(self.args.round_idx):
                 raise RuntimeError(
                     f"chaos: injected failure at round {self.args.round_idx} "

@@ -87,6 +87,13 @@ class FedMLAggregator:
         self.sample_num_dict[index] = sample_num
         self.flag_client_model_uploaded_dict[index] = True
 
+    def reset_round_flags(self) -> None:
+        """Clear upload flags after a quorum-driven (partial or keep-first-k)
+        round completion — ``check_whether_all_receive`` only clears them
+        when every flag is set, which a partial round never reaches."""
+        for i in list(self.flag_client_model_uploaded_dict):
+            self.flag_client_model_uploaded_dict[i] = False
+
     def check_whether_all_receive(self) -> bool:
         if all(self.flag_client_model_uploaded_dict.get(i, False) for i in range(self.client_num)):
             for i in range(self.client_num):

@@ -8,12 +8,14 @@ aggregate -> sync (steps 3-8 of SURVEY §3.2).
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, Optional
-
-import os
+import threading
+from typing import Any, Dict, List, Optional
 
 from ... import mlops
 from ...core import telemetry as tel
+from ...core.resilience import QuorumPolicy, RoundQuorum, RoundStateStore, note, overprovisioned_cohort_size
+from ...core.resilience import quorum as quorum_mod
+from ...core.resilience.round_state import restore_numpy_rng
 from ...core.telemetry import flight_recorder, statusz, trace_context
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
@@ -40,6 +42,39 @@ class FedMLServerManager(FedMLCommManager):
         self._round_span = None
         self._round_span_idx: Optional[int] = None
         self._statusz_server: Optional[statusz.StatuszServer] = None
+        # --- resilience: quorum rounds + durable round state ---------------
+        self._quorum_policy = QuorumPolicy.from_args(args)
+        self._round_quorum: Optional[RoundQuorum] = None
+        self._keep_k = int(getattr(args, "client_num_per_round", self.size - 1))
+        # deltas arrive on the receive loop while the deadline timer fires on
+        # its own thread — every round-advancing decision holds this lock
+        self._round_lock = threading.RLock()
+        self._deadline_timer: Optional[threading.Timer] = None
+        self._round_store: Optional[RoundStateStore] = None
+        rdir = getattr(args, "resilience_dir", None)
+        if rdir:
+            self._round_store = RoundStateStore(str(rdir))
+            if getattr(args, "resume", False):
+                self._try_resume()
+
+    def _try_resume(self) -> None:
+        """Restart from the last complete round: restore the global model,
+        the cohort health baselines, the numpy RNG, and set ``round_idx`` to
+        the first round that never finished."""
+        rs = self._round_store.resume(
+            template={"model": self.aggregator.get_global_model_params()}
+        )
+        if rs is None:
+            return
+        self.aggregator.set_global_model_params(rs.state["model"])
+        self.args.round_idx = rs.round_idx + 1
+        restore_numpy_rng(rs.meta.get("numpy_rng"))
+        fleet = getattr(self.aggregator, "fleet", None)
+        if fleet is not None:
+            fleet.health.restore_state(rs.meta.get("health"))
+        mlops.log_resilience_event("resume", round_idx=rs.round_idx)
+        log.info("server resumed: round %d complete, restarting at round %d",
+                 rs.round_idx, self.args.round_idx)
 
     def run(self) -> None:
         mlops.log_aggregation_status("INITIALIZING", str(getattr(self.args, "run_id", "0")))
@@ -64,19 +99,15 @@ class FedMLServerManager(FedMLCommManager):
         statusz.register_section("round", self._statusz_round_section)
         if fleet is not None:
             statusz.register_section("health", fleet.health.statusz)
+        port_file = getattr(self.args, "statusz_port_file", None)
         self._statusz_server = statusz.StatuszServer(
             port=int(port),
             service="cross_silo_server",
             gauges_fn=(fleet.health.prom_gauges if fleet is not None else None),
+            port_file=str(port_file) if port_file else None,
         )
         bound = self._statusz_server.start()
         log.info("statusz serving on http://127.0.0.1:%d/statusz", bound)
-        port_file = getattr(self.args, "statusz_port_file", None)
-        if port_file:
-            tmp = str(port_file) + ".tmp"
-            with open(tmp, "w") as f:
-                f.write(str(bound))
-            os.replace(tmp, str(port_file))
 
     def _stop_statusz(self) -> None:
         if self._statusz_server is None:
@@ -87,13 +118,20 @@ class FedMLServerManager(FedMLCommManager):
         self._statusz_server = None
 
     def _statusz_round_section(self) -> dict:
-        return {
+        doc = {
             "round_idx": int(self.args.round_idx),
             "round_num": self.round_num,
             "initialized": self.is_initialized,
             "clients_online": len(self.client_online_status),
             "cohort": list(self.client_id_list_in_this_round or []),
         }
+        # no _round_lock here: the receive loop holds it across aggregation,
+        # and a status page that blocks on a live round is useless mid-round.
+        # RoundQuorum.statusz() is internally locked, so a bare read is safe.
+        q = self._round_quorum
+        if q is not None:
+            doc["quorum"] = q.statusz()
+        return doc
 
     # --- round trace lifecycle --------------------------------------------
     # All handlers run on the one receive-loop thread, so the round span can
@@ -129,6 +167,7 @@ class FedMLServerManager(FedMLCommManager):
             self.send_message_init_config(
                 client_id, global_model_params, self.data_silo_index_list[idx]
             )
+        self._begin_quorum_round()
         mlops.event("server.wait", event_started=True, event_value=str(self.args.round_idx))
 
     def register_message_receive_handlers(self) -> None:
@@ -138,21 +177,99 @@ class FedMLServerManager(FedMLCommManager):
             MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.handle_message_receive_model_from_client
         )
 
-    # --- handlers ---------------------------------------------------------
-    def handle_message_connection_ready(self, msg_params: Message) -> None:
-        if self.is_initialized:
-            return
+    # --- cohort selection -------------------------------------------------
+    def _select_cohort(self) -> None:
+        """Pick this round's cohort + data silos. With over-provisioning on
+        and stragglers flagged last round, samples ``ceil(k·(1+f))`` clients;
+        the quorum keeps the first k deltas."""
+        k = int(getattr(self.args, "client_num_per_round", self.size - 1))
+        n_sample = k
+        if self._quorum_policy.overprovision_frac > 0:
+            fleet = getattr(self.aggregator, "fleet", None)
+            report = fleet.health.report() if fleet is not None else None
+            stragglers = bool(report and report.stragglers)
+            n_sample = overprovisioned_cohort_size(
+                k, self._quorum_policy.overprovision_frac, stragglers, self.size - 1
+            )
+            if n_sample > k:
+                log.info("round %d: over-provisioning cohort %d -> %d (stragglers flagged)",
+                         self.args.round_idx, k, n_sample)
+                note(overprovisioned={"round": int(self.args.round_idx), "k": k, "sampled": n_sample})
         self.client_id_list_in_this_round = self.aggregator.client_selection(
-            self.args.round_idx,
-            list(range(1, self.size)),
-            int(getattr(self.args, "client_num_per_round", self.size - 1)),
+            self.args.round_idx, list(range(1, self.size)), n_sample
         )
         self.data_silo_index_list = self.aggregator.data_silo_selection(
             self.args.round_idx,
             int(getattr(self.args, "client_num_in_total", self.size - 1)),
             len(self.client_id_list_in_this_round),
         )
+        self._keep_k = min(k, len(self.client_id_list_in_this_round))
         self._declare_cohort()
+
+    # --- quorum round lifecycle -------------------------------------------
+    def _begin_quorum_round(self) -> None:
+        if not self._quorum_policy.enabled:
+            return
+        with self._round_lock:
+            self._cancel_deadline_timer()
+            self._round_quorum = RoundQuorum(
+                int(self.args.round_idx),
+                self.client_id_list_in_this_round,
+                self._keep_k,
+                self._quorum_policy,
+            )
+            note(last_quorum=self._round_quorum.statusz())
+            self._arm_deadline_timer()
+
+    def _arm_deadline_timer(self) -> None:
+        fleet = getattr(self.aggregator, "fleet", None)
+        health = fleet.health if fleet is not None else None
+        deadline_s = self._quorum_policy.deadline_for_round(health)
+        if deadline_s is None:
+            return
+        t = threading.Timer(deadline_s, self._on_round_deadline, args=(int(self.args.round_idx),))
+        t.daemon = True
+        t.start()
+        self._deadline_timer = t
+
+    def _cancel_deadline_timer(self) -> None:
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+            self._deadline_timer = None
+
+    def _on_round_deadline(self, round_idx: int) -> None:
+        """Timer thread: the round's deadline fired. Aggregate partially if
+        the quorum is there; otherwise extend by one more deadline period."""
+        with self._round_lock:
+            q = self._round_quorum
+            if q is None or q.round_idx != round_idx or int(self.args.round_idx) != round_idx:
+                return  # round already advanced
+            if not q.deadline_quorum_met():
+                log.warning(
+                    "round %d deadline: quorum not met (%d/%d arrived, need %d) — extending",
+                    round_idx, len(q.arrived()), q.keep_k,
+                    self._quorum_policy.min_quorum(q.keep_k),
+                )
+                self._arm_deadline_timer()
+                return
+            missing = q.close_partial()
+            fleet = getattr(self.aggregator, "fleet", None)
+            if fleet is not None:
+                for r in missing:
+                    fleet.health.observe_failure(r)
+            note(last_quorum=q.statusz())
+            mlops.log_resilience_event(
+                "quorum_partial", round_idx=round_idx, missing=missing, arrived=q.arrived()
+            )
+            log.warning("round %d: partial aggregation with %s (missing %s)",
+                        round_idx, q.arrived(), missing)
+            self._complete_round()
+
+    # --- handlers ---------------------------------------------------------
+    def handle_message_connection_ready(self, msg_params: Message) -> None:
+        if self.is_initialized:
+            return
+        self._select_cohort()
 
     def _declare_cohort(self) -> None:
         """Tell fleet telemetry which ranks this round's cohort contains, so
@@ -171,44 +288,87 @@ class FedMLServerManager(FedMLCommManager):
         if all_online and not self.is_initialized:
             mlops.log_aggregation_status("RUNNING", str(getattr(self.args, "run_id", "0")))
             self.is_initialized = True
+            if int(self.args.round_idx) >= self.round_num:
+                # resumed from a store whose last complete round was the final
+                # one: nothing left to train, release the fleet immediately
+                log.info("resume found all %d rounds complete; finishing", self.round_num)
+                mlops.log_aggregation_status("FINISHED", str(getattr(self.args, "run_id", "0")))
+                self.send_finish_to_all()
+                self.finish()
+                return
             self.send_init_msg()
 
     def handle_message_receive_model_from_client(self, msg_params: Message) -> None:
         sender_id = msg_params.get_sender_id()
         model_params = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         local_sample_number = msg_params.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        delta_round = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
         header = trace_context.telemetry_header(msg_params)
         # the aggregator interface is duck-typed (fa/cross_silo.py adapts an
         # FA aggregator into it) — fleet telemetry is optional on it
         merge = getattr(self.aggregator, "merge_client_telemetry", None)
         if merge is not None and header is not None and trace_context.DELTA_FIELD in header:
             merge(sender_id, header[trace_context.DELTA_FIELD])
-        with tel.span("server.receive_model", round=int(self.args.round_idx), sender=int(sender_id)):
-            self.aggregator.add_local_trained_result(sender_id - 1, model_params, local_sample_number)
-        if not self.aggregator.check_whether_all_receive():
-            return
-        mlops.event("server.wait", event_started=False, event_value=str(self.args.round_idx))
-        mlops.event("server.agg_and_eval", event_started=True, event_value=str(self.args.round_idx))
+        with self._round_lock:
+            q = self._round_quorum
+            if q is not None:
+                verdict = q.on_delta(sender_id, None if delta_round is None else int(delta_round))
+                if verdict != quorum_mod.ACCEPT:
+                    # late/surplus/duplicate: the delta is discarded but the
+                    # rank is alive — keep its silence clock fresh
+                    fleet = getattr(self.aggregator, "fleet", None)
+                    if fleet is not None:
+                        fleet.health.heartbeat(sender_id)
+                    note(last_quorum=q.statusz())
+                    return
+            with tel.span("server.receive_model", round=int(self.args.round_idx), sender=int(sender_id)):
+                self.aggregator.add_local_trained_result(sender_id - 1, model_params, local_sample_number)
+            if q is not None:
+                note(last_quorum=q.statusz())
+                if not q.complete():
+                    return
+            elif not self.aggregator.check_whether_all_receive():
+                return
+            self._complete_round()
+
+    def _complete_round(self) -> None:
+        """Aggregate (all arrivals, or the quorum's partial set), evaluate,
+        persist the round state, and advance — or finish the run. Caller
+        holds ``_round_lock`` (receive loop or deadline timer)."""
+        self._cancel_deadline_timer()
+        round_idx = int(self.args.round_idx)
+        mlops.event("server.wait", event_started=False, event_value=str(round_idx))
+        mlops.event("server.agg_and_eval", event_started=True, event_value=str(round_idx))
         # FedMLAggregator.aggregate opens the server.aggregate span itself
         global_model_params = self.aggregator.aggregate()
-        with tel.span("server.eval", round=int(self.args.round_idx)):
-            metrics = self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+        if self._round_quorum is not None:
+            # partial rounds leave upload flags set for arrived ranks;
+            # check_whether_all_receive never ran, so clear them here
+            reset = getattr(self.aggregator, "reset_round_flags", None)
+            if reset is not None:
+                reset()
+            self._round_quorum = None
+        with tel.span("server.eval", round=round_idx):
+            metrics = self.aggregator.test_on_server_for_all_clients(round_idx)
         if metrics is not None:
             self.final_metrics = metrics
-        mlops.event("server.agg_and_eval", event_started=False, event_value=str(self.args.round_idx))
-        mlops.log_round_info(self.round_num, self.args.round_idx)
-        mlops.log_telemetry_summary(self.args.round_idx)
+        mlops.event("server.agg_and_eval", event_started=False, event_value=str(round_idx))
+        mlops.log_round_info(self.round_num, round_idx)
+        mlops.log_telemetry_summary(round_idx)
         fleet = getattr(self.aggregator, "fleet", None)
         if fleet is not None and fleet.merges:
-            mlops.log_fleet_summary(self.args.round_idx, self.aggregator.fleet_summary())
+            mlops.log_fleet_summary(round_idx, self.aggregator.fleet_summary())
             # close the health round: MAD straggler test over this round's
             # client.train durations, shipped through the uplink like the
             # fleet summary (and readable live on /statusz + /metrics)
-            report = fleet.health.end_round(self.args.round_idx)
-            mlops.log_health_report(self.args.round_idx, report)
+            report = fleet.health.end_round(round_idx)
+            mlops.log_health_report(round_idx, report)
             if report.stragglers:
-                log.warning("round %d stragglers: %s", self.args.round_idx, report.stragglers)
+                log.warning("round %d stragglers: %s", round_idx, report.stragglers)
 
+        self._save_round_state(
+            round_idx, global_model_params, final=(round_idx + 1 >= self.round_num)
+        )
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:
             mlops.log_aggregation_status("FINISHED", str(getattr(self.args, "run_id", "0")))
@@ -217,22 +377,45 @@ class FedMLServerManager(FedMLCommManager):
             self._export_fleet_trace_if_configured()
             self.finish()
             return
-        self.client_id_list_in_this_round = self.aggregator.client_selection(
-            self.args.round_idx, list(range(1, self.size)), int(getattr(self.args, "client_num_per_round", self.size - 1))
-        )
-        self.data_silo_index_list = self.aggregator.data_silo_selection(
-            self.args.round_idx,
-            int(getattr(self.args, "client_num_in_total", self.size - 1)),
-            len(self.client_id_list_in_this_round),
-        )
-        self._declare_cohort()
+        self._select_cohort()
         self._begin_round_trace()
         with tel.span(
             "server.broadcast", round=int(self.args.round_idx), receivers=len(self.client_id_list_in_this_round)
         ):
             for idx, receiver_id in enumerate(self.client_id_list_in_this_round):
                 self.send_message_sync_model_to_client(receiver_id, global_model_params, self.data_silo_index_list[idx])
+        self._begin_quorum_round()
         mlops.event("server.wait", event_started=True, event_value=str(self.args.round_idx))
+
+    def _save_round_state(self, round_idx: int, global_model_params, *, final: bool = False) -> None:
+        """Durable round boundary: async checkpoint enqueue + chaos kill hook
+        (``args.chaos_kill_after_round``: SIGKILL self right after the
+        enqueue, so the kill-and-resume e2e exercises the watermark). The
+        final round drains the writer and saves synchronously — the finished
+        model must be durable, never best-effort."""
+        if self._round_store is None:
+            return
+        kill_after = getattr(self.args, "chaos_kill_after_round", None)
+        kill_now = kill_after is not None and int(round_idx) == int(kill_after)
+        if final or kill_now:
+            # drain before the final (sync) save so it cannot be dropped; the
+            # chaos kill also drains first so earlier rounds are committed and
+            # the drill models "watermark at round k-1, round k's save torn"
+            self._round_store.wait()
+        fleet = getattr(self.aggregator, "fleet", None)
+        self._round_store.save_round(
+            int(round_idx),
+            {"model": global_model_params},
+            cohort=[int(c) for c in (self.client_id_list_in_this_round or [])],
+            health=(fleet.health.export_state() if fleet is not None else None),
+            wait=final,
+        )
+        if kill_now:
+            import os
+            import signal
+
+            log.warning("chaos: SIGKILL self after round %d checkpoint enqueue", round_idx)
+            os.kill(os.getpid(), signal.SIGKILL)
 
     def _export_fleet_trace_if_configured(self) -> None:
         """Write the fleet Perfetto JSON when ``args.fleet_trace`` names a
@@ -253,6 +436,8 @@ class FedMLServerManager(FedMLCommManager):
         message = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.get_sender_id(), receive_id)
         message.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model_params)
         message.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(datasilo_index))
+        # a resumed server's first round is not round 0 — clients adopt this
+        message.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, int(self.args.round_idx))
         self.send_message(message)
 
     def send_message_sync_model_to_client(self, receive_id: int, global_model_params, client_index) -> None:

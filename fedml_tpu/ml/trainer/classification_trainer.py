@@ -23,6 +23,17 @@ from .local_sgd import epoch_index_array, make_eval_fn, make_local_train_fn
 log = logging.getLogger(__name__)
 
 
+def round_seed(args: Any, client_id: int, fallback_round: int) -> int:
+    """Deterministic per-(client, round) local-training seed. Prefers
+    ``args.round_idx`` — the cross-silo trainer sets it per round, so a
+    crash-resumed run replays the exact seed of the round it recomputes —
+    falling back to the trainer's internal round counter in the sp
+    simulator (which persists that counter via round-state meta)."""
+    r = getattr(args, "round_idx", None)
+    rnd = int(r) if r is not None else int(fallback_round)
+    return int(getattr(args, "random_seed", 0)) * 100003 + int(client_id) * 131 + rnd
+
+
 class ClassificationTrainer(ClientTrainer):
     def __init__(self, model: FedModel, args: Any):
         super().__init__(model, args)
@@ -42,7 +53,7 @@ class ClassificationTrainer(ClientTrainer):
         args = args or self.args
         batch_size = int(getattr(args, "batch_size", 32))
         epochs = int(getattr(args, "epochs", 1))
-        seed = int(getattr(args, "random_seed", 0)) * 100003 + self.id * 131 + self._round
+        seed = round_seed(args, self.id, self._round)
         idx, mask = epoch_index_array(len(train_data), batch_size, epochs, seed)
         x_all = jnp.asarray(train_data.x)
         y_all = jnp.asarray(train_data.y)

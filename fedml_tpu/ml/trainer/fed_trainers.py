@@ -22,7 +22,7 @@ import numpy as np
 
 from ...models.model_hub import FedModel
 from ...utils.pytree import PyTree, tree_scale, tree_sub, tree_zeros_like, tree_add
-from .classification_trainer import ClassificationTrainer
+from .classification_trainer import ClassificationTrainer, round_seed
 from .local_sgd import epoch_index_array, make_local_train_fn, make_loss_fn
 
 log = logging.getLogger(__name__)
@@ -102,7 +102,7 @@ class ScaffoldTrainer(ClassificationTrainer):
         args = args or self.args
         batch_size = int(getattr(args, "batch_size", 32))
         epochs = int(getattr(args, "epochs", 1))
-        seed = int(getattr(args, "random_seed", 0)) * 100003 + self.id * 131 + self._round
+        seed = round_seed(args, self.id, self._round)
         w_global = self.get_model_params()
         idx, mask = epoch_index_array(len(train_data), batch_size, epochs, seed)
         result = self._local_train(
@@ -162,7 +162,7 @@ class FedDynTrainer(ClassificationTrainer):
         args = args or self.args
         batch_size = int(getattr(args, "batch_size", 32))
         epochs = int(getattr(args, "epochs", 1))
-        seed = int(getattr(args, "random_seed", 0)) * 100003 + self.id * 131 + self._round
+        seed = round_seed(args, self.id, self._round)
         w_global = self.get_model_params()
         idx, mask = epoch_index_array(len(train_data), batch_size, epochs, seed)
         result = self._local_train(
@@ -208,7 +208,7 @@ class MimeTrainer(ClassificationTrainer):
         args = args or self.args
         batch_size = int(getattr(args, "batch_size", 32))
         epochs = int(getattr(args, "epochs", 1))
-        seed = int(getattr(args, "random_seed", 0)) * 100003 + self.id * 131 + self._round
+        seed = round_seed(args, self.id, self._round)
         w_global = self.get_model_params()
         x = jnp.asarray(train_data.x)
         y = jnp.asarray(train_data.y)

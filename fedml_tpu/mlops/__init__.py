@@ -201,6 +201,24 @@ def log_health_report(round_idx: Optional[int], report: Dict[str, Any]) -> None:
     MLOpsRuntime.get_instance().append_record(rec)
 
 
+def log_resilience_event(event: str, round_idx: Optional[int] = None, **fields: Any) -> None:
+    """Publish one resilience lifecycle event (``resume``, ``quorum_partial``,
+    ``checkpoint_dropped``) through the uplink so operator tooling sees
+    recoveries and partial rounds without scraping `/statusz`."""
+    rec: Dict[str, Any] = {
+        "type": "metric",
+        "name": "resilience_event",
+        "t": time.time(),  # wall-clock ok: record timestamp, not a duration
+        "event": str(event),
+    }
+    if round_idx is not None:
+        rec["round"] = int(round_idx)
+        rec["step"] = int(round_idx)
+    if fields:
+        rec["fields"] = dict(fields)
+    MLOpsRuntime.get_instance().append_record(rec)
+
+
 def log_training_status(status: str, run_id: Optional[str] = None) -> None:
     MLOpsRuntime.get_instance().append_record({"type": "status", "role": "client", "status": status, "run_id": run_id})
 

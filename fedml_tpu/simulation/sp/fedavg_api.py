@@ -78,6 +78,15 @@ class FedAvgAPI:
         self._mime_s = tree_zeros_like(self.model_trainer.get_model_params())
         self.metrics_history: List[Dict[str, float]] = []
 
+        # durable round state (core.resilience): every round boundary is
+        # checkpointed async; --resume restarts from the last complete round
+        self._round_store = None
+        rdir = getattr(args, "resilience_dir", None)
+        if rdir:
+            from ...core.resilience import RoundStateStore
+
+            self._round_store = RoundStateStore(str(rdir))
+
     def _setup_clients(self, train_data_local_num_dict, train_data_local_dict, test_data_local_dict) -> None:
         """One Client object per sampled slot, reused across rounds
         (reference fedavg_api.py:76-97: client objects are per-slot, local
@@ -105,11 +114,82 @@ class FedAvgAPI:
         log.info("client_indexes = %s", client_indexes)
         return list(client_indexes)
 
+    # --- durable round state ------------------------------------------
+    def _round_state_dict(self, w_global) -> Dict[str, Any]:
+        """The named pytrees a round boundary must persist: the global model
+        plus whichever server-side algorithm state this optimizer carries."""
+        st: Dict[str, Any] = {"model": w_global}
+        if self.fed_opt == FEDML_FEDERATED_OPTIMIZER_SCAFFOLD:
+            st["scaffold_c"] = self._scaffold_c
+        elif self.fed_opt == FEDML_FEDERATED_OPTIMIZER_FEDDYN:
+            st["feddyn_h"] = self._feddyn_h
+        elif self.fed_opt == FEDML_FEDERATED_OPTIMIZER_MIME:
+            st["mime_s"] = self._mime_s
+        if self._fedopt_server is not None:
+            st["fedopt"] = self._fedopt_server.state
+        return st
+
+    def _try_resume(self, w_global) -> Tuple[Any, int]:
+        """Restore (w_global, start_round) from the round store when
+        ``args.resume`` is set; (w_global, 0) otherwise."""
+        if self._round_store is None or not getattr(self.args, "resume", False):
+            return w_global, 0
+        from ...core.resilience.round_state import restore_numpy_rng
+
+        rs = self._round_store.resume(template=self._round_state_dict(w_global))
+        if rs is None:
+            return w_global, 0
+        st = rs.state
+        w_global = st["model"]
+        if "scaffold_c" in st:
+            self._scaffold_c = st["scaffold_c"]
+        if "feddyn_h" in st:
+            self._feddyn_h = st["feddyn_h"]
+        if "mime_s" in st:
+            self._mime_s = st["mime_s"]
+        if self._fedopt_server is not None and "fedopt" in st:
+            self._fedopt_server.state = st["fedopt"]
+        restore_numpy_rng(rs.meta.get("numpy_rng"))
+        tr = rs.meta.get("trainer_round")
+        if tr is not None and hasattr(self.model_trainer, "_round"):
+            self.model_trainer._round = int(tr)
+        self.model_trainer.set_model_params(w_global)
+        self.aggregator.set_model_params(w_global)
+        mlops.log_resilience_event("resume", round_idx=rs.round_idx)
+        return w_global, rs.round_idx + 1
+
+    def _save_round_state(self, round_idx: int, w_global, cohort: List[int], *, final: bool = False) -> None:
+        if self._round_store is None:
+            return
+        kill_after = getattr(self.args, "chaos_kill_after_round", None)
+        kill_now = kill_after is not None and int(round_idx) == int(kill_after)
+        if final or kill_now:
+            # the run's last round must be durable, never best-effort: drain
+            # any in-flight async save so this one cannot be dropped, then
+            # save synchronously. The chaos kill also drains first: real
+            # rounds take long enough that earlier finalizes always land, so
+            # the drill models "watermark at round k-1, round k's save torn".
+            self._round_store.wait()
+        self._round_store.save_round(
+            int(round_idx),
+            self._round_state_dict(w_global),
+            cohort=[int(c) for c in cohort],
+            extra_meta={"trainer_round": getattr(self.model_trainer, "_round", None)},
+            wait=final,
+        )
+        if kill_now:
+            import os
+            import signal
+
+            log.warning("chaos: SIGKILL self after round %d checkpoint enqueue", round_idx)
+            os.kill(os.getpid(), signal.SIGKILL)
+
     # ------------------------------------------------------------------
     def train(self) -> Dict[str, float]:
         w_global = self.model_trainer.get_model_params()
         comm_round = int(getattr(self.args, "comm_round", 10))
-        for round_idx in range(comm_round):
+        w_global, start_round = self._try_resume(w_global)
+        for round_idx in range(start_round, comm_round):
             log.info("================ Communication round : %d", round_idx)
             with tel.span("fedavg.round", round=round_idx, optimizer=self.fed_opt):
                 with tel.span("fedavg.sample", round=round_idx):
@@ -145,6 +225,9 @@ class FedAvgAPI:
                     w_global = self._server_update(w_global, w_locals)
                 self.model_trainer.set_model_params(w_global)
                 self.aggregator.set_model_params(w_global)
+                self._save_round_state(
+                    round_idx, w_global, client_indexes, final=(round_idx == comm_round - 1)
+                )
 
                 freq = int(getattr(self.args, "frequency_of_the_test", 5))
                 if round_idx == comm_round - 1 or (freq > 0 and round_idx % freq == 0):
@@ -152,6 +235,8 @@ class FedAvgAPI:
                         metrics = self._test_global(round_idx)
                     self.metrics_history.append(metrics)
             mlops.log_telemetry_summary(round_idx)
+        if self._round_store is not None:
+            self._round_store.wait()
         return self.metrics_history[-1] if self.metrics_history else {}
 
     # ------------------------------------------------------------------

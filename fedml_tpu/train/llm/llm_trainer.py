@@ -239,7 +239,10 @@ class LLMTrainer:
                 losses.append(loss)
                 tokens_seen += toks.size
                 if exp.save_steps and (step + 1) % exp.save_steps == 0:
-                    self.save(step + 1)
+                    # async enqueue: the orbax writer runs behind the next
+                    # train steps; the watermark commits on completion, so a
+                    # crash mid-write resumes from the previous complete step
+                    self.save(step + 1, wait=False)
                 if step + 1 >= exp.max_steps:
                     break
             jax.block_until_ready(self.params)
@@ -254,6 +257,9 @@ class LLMTrainer:
         }
         log.info("LLM train done: %s", metrics)
         self.save(step + 1)
+        # drain any async mid-training save still in flight before returning:
+        # callers treat a returned train() as fully durable
+        self.ckpt.wait_until_finished()
         return metrics
 
     def text_batches(self, global_batch: int, steps: Optional[int] = None, *, seed: Optional[int] = None):
@@ -280,10 +286,10 @@ class LLMTrainer:
         return ds.batches(global_batch, steps, seed=self.exp_args.seed if seed is None else seed)
 
     # --- checkpointing ----------------------------------------------------
-    def save(self, step: int) -> None:
+    def save(self, step: int, *, wait: bool = True) -> None:
         # checkpoints always use the named layout so they are loadable
         # regardless of the parallel mode that produced them
-        self.ckpt.save(step, jax.device_get(self.named_params()))
+        self.ckpt.save(step, jax.device_get(self.named_params()), wait=wait)
 
     def restore(self, step: Optional[int] = None) -> bool:
         if self.params is None:

@@ -4,18 +4,40 @@ The reference has NO training checkpointing in the FL core (SURVEY §5:
 "make round-level checkpointing (orbax) first-class — it's cheap and
 missing"); the LLM path inherits HF Trainer checkpoints. Here both paths
 share one orbax-backed store: save(step, pytree[, extra]) / restore(step).
+
+Async saves (``wait=False``) go through a completion *watermark*: a single
+background waiter thread runs the whole orbax save (even its "blocking
+phase" stays off the hot path), then commits ``<dir>/.watermark`` atomically. ``latest_complete_step()`` reads the
+watermark, so a resume after SIGKILL never trusts a step whose finalization
+was still in flight. At most one async save is in flight at a time — a
+``wait=False`` save arriving while the previous one is still finalizing is
+*dropped* (bumping ``fedml_checkpoint_dropped_total``) rather than queued,
+so checkpointing can never back up behind slow storage. Save latency lands
+in the ``fedml_checkpoint_save_seconds`` histogram either way.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import threading
+import time
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
+from ..core import telemetry as tel
+
 log = logging.getLogger(__name__)
+
+WATERMARK_FILE = ".watermark"
+
+# metric names (rendered as fedml_checkpoint_save_seconds /
+# fedml_checkpoint_dropped_total on /metrics)
+SAVE_SECONDS_HISTOGRAM = "checkpoint_save_seconds"
+DROPPED_COUNTER = "checkpoint.dropped"
 
 
 class CheckpointManager:
@@ -29,18 +51,92 @@ class CheckpointManager:
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
         )
+        self._async_lock = threading.Lock()
+        self._async_thread: Optional[threading.Thread] = None
 
-    def save(self, step: int, pytree: Any, *, extra: Optional[Dict[str, Any]] = None, wait: bool = True) -> None:
+    # --- watermark (the async-save commit point) --------------------------
+    def _watermark_path(self) -> str:
+        return os.path.join(self.directory, WATERMARK_FILE)
+
+    def _commit_watermark(self, step: int) -> None:
+        """Atomically record ``step`` as fully finalized. Monotonic: a late
+        waiter for an old step never regresses the mark."""
+        path = self._watermark_path()
+        current = self.latest_complete_step()
+        if current is not None and current >= step:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"step": int(step)}, f)
+        os.replace(tmp, path)
+
+    def latest_complete_step(self) -> Optional[int]:
+        """The newest step whose save fully finalized (watermark-committed).
+        Falls back to orbax's ``latest_step()`` for stores written before the
+        watermark existed (sync saves committed by orbax's own rename)."""
+        try:
+            with open(self._watermark_path()) as f:
+                return int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return self._mgr.latest_step()
+
+    # --- save/restore -----------------------------------------------------
+    def save(self, step: int, pytree: Any, *, extra: Optional[Dict[str, Any]] = None, wait: bool = True) -> bool:
+        """Persist ``pytree`` as ``step``. ``wait=True`` blocks until the
+        step is finalized and watermarked. ``wait=False`` hands the WHOLE
+        orbax save to a background waiter thread and returns immediately —
+        even orbax's "blocking phase" (directory + per-leaf metadata setup,
+        tens of ms for wide trees) stays off the hot path, so the enqueue is
+        payload construction + one thread spawn (<5 ms; bench.py guards it).
+        The caller must not mutate leaves in place after an async enqueue
+        (round loops produce fresh trees each round, so this holds by
+        construction). Returns False iff the save was dropped because a
+        previous async save is still finalizing."""
         payload = {"state": pytree}
         if extra:
             payload["extra"] = extra
-        self._mgr.save(step, args=self._ocp.args.StandardSave(payload))
-        if wait:
-            self._mgr.wait_until_finished()
-        log.info("checkpoint step %d saved to %s", step, self.directory)
+        with self._async_lock:
+            if self._async_thread is not None and self._async_thread.is_alive():
+                if not wait:
+                    tel.counter(DROPPED_COUNTER).add(1)
+                    log.warning("checkpoint step %d dropped: previous async save still in flight", step)
+                    return False
+                self._async_thread.join()
+            t0 = time.perf_counter()
+            if wait:
+                self._mgr.save(step, args=self._ocp.args.StandardSave(payload))
+                self._mgr.wait_until_finished()
+                self._commit_watermark(step)
+                tel.histogram(SAVE_SECONDS_HISTOGRAM).observe(time.perf_counter() - t0)
+                log.info("checkpoint step %d saved to %s", step, self.directory)
+                return True
+
+            def _save_and_finalize() -> None:
+                try:
+                    self._mgr.save(step, args=self._ocp.args.StandardSave(payload))
+                    self._mgr.wait_until_finished()
+                    self._commit_watermark(step)
+                    tel.histogram(SAVE_SECONDS_HISTOGRAM).observe(time.perf_counter() - t0)
+                    log.info("checkpoint step %d finalized (async) in %s", step, self.directory)
+                except Exception:  # noqa: BLE001 - a torn save stays below the watermark
+                    log.exception("async checkpoint step %d failed to finalize", step)
+
+            self._async_thread = threading.Thread(
+                target=_save_and_finalize, name=f"ckpt-finalize-{step}", daemon=True
+            )
+            self._async_thread.start()
+            return True
+
+    def wait_until_finished(self) -> None:
+        """Block until any in-flight async save is finalized + watermarked."""
+        with self._async_lock:
+            th = self._async_thread
+        if th is not None and th.is_alive():
+            th.join()
+        self._mgr.wait_until_finished()
 
     def restore(self, step: Optional[int] = None, template: Any = None):
-        step = step if step is not None else self.latest_step()
+        step = step if step is not None else self.latest_complete_step()
         if step is None:
             return None
         if template is not None:
@@ -48,11 +144,23 @@ class CheckpointManager:
                 step, args=self._ocp.args.StandardRestore({"state": template})
             )
         else:
-            payload = self._mgr.restore(step)
+            # explicit StandardRestore: a bare restore() only works when this
+            # manager instance also did the save (handler registered); a fresh
+            # process restoring the checkpoint gets raw numpy leaves this way
+            payload = self._mgr.restore(step, args=self._ocp.args.StandardRestore())
         return payload["state"]
+
+    def restore_extra(self, step: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """The ``extra`` dict saved alongside ``step`` (None if absent)."""
+        step = step if step is not None else self.latest_complete_step()
+        if step is None:
+            return None
+        payload = self._mgr.restore(step, args=self._ocp.args.StandardRestore())
+        return payload.get("extra")
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
     def close(self) -> None:
+        self.wait_until_finished()
         self._mgr.close()

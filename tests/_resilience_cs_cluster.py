@@ -1,0 +1,80 @@
+"""Driver for tests/test_resilience.py cross-silo kill-resume e2e — NOT a test.
+
+Runs a 3-client cross-silo INMEMORY cluster (server + clients as threads in
+THIS process) with a durable round store on the server. Modes (argv[1], with
+argv[2] = the resilience directory):
+
+- ``baseline``: run all rounds uninterrupted, exit 0;
+- ``crash``: ``chaos_kill_after_round=1`` on the server — it SIGKILLs the
+  whole process right after round 1's async checkpoint enqueue (the clients
+  die with it, exactly like a machine loss);
+- ``resume``: restart the full cluster with ``resume=True`` on the server;
+  it restores the last watermarked round, stamps its round index on the
+  init/sync messages, and the fresh clients replay the remaining rounds
+  with the exact per-round seeds.
+
+The parent test compares the two stores' final round state bit-for-bit.
+"""
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import fedml_tpu as fedml  # noqa: E402
+from fedml_tpu.arguments import default_config  # noqa: E402
+from fedml_tpu.core.distributed.communication.inmemory.broker import InMemoryBroker  # noqa: E402
+
+N_CLIENTS = 3
+ROUNDS = 4
+KILL_AFTER_ROUND = 1
+
+
+def make_args(mode, rank, role, rdir):
+    over = dict(
+        run_id=f"test_res_cs_{mode}", rank=rank, role=role, backend="INMEMORY",
+        scenario="horizontal", client_num_in_total=N_CLIENTS,
+        client_num_per_round=N_CLIENTS, comm_round=ROUNDS, epochs=1,
+        batch_size=16, frequency_of_the_test=ROUNDS + 1, dataset="synthetic",
+        model="lr", random_seed=0,
+    )
+    if role == "server":
+        over["resilience_dir"] = rdir
+        if mode == "crash":
+            over["chaos_kill_after_round"] = KILL_AFTER_ROUND
+        elif mode == "resume":
+            over["resume"] = True
+    return default_config("cross_silo", **over)
+
+
+def main() -> int:
+    mode, rdir = sys.argv[1], sys.argv[2]
+    InMemoryBroker.reset()
+    results = {}
+
+    def run_party(args, key):
+        args = fedml.init(args)
+        device = fedml.device.get_device(args)
+        dataset, output_dim = fedml.data.load(args)
+        model = fedml.model.create(args, output_dim)
+        results[key] = fedml.FedMLRunner(args, device, dataset, model).run()
+
+    threads = [threading.Thread(
+        target=run_party, args=(make_args(mode, 0, "server", rdir), "server"),
+        daemon=True)]
+    for rank in range(1, N_CLIENTS + 1):
+        threads.append(threading.Thread(
+            target=run_party, args=(make_args(mode, rank, "client", rdir), f"c{rank}"),
+            daemon=True))
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=240)
+        if th.is_alive():
+            return 4  # deadlock (crash mode never reaches here: SIGKILL)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

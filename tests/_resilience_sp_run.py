@@ -1,0 +1,51 @@
+"""Driver for tests/test_resilience.py sp kill-resume e2e — NOT a test.
+
+Runs the sp FedAvg simulator with a durable round store. Modes (argv[1],
+with argv[2] = the resilience directory):
+
+- ``baseline``: run all rounds uninterrupted;
+- ``crash``: same run with ``chaos_kill_after_round=1`` — the simulator
+  SIGKILLs its own process right after round 1's async checkpoint enqueue
+  (the parent sees returncode -9 / 137);
+- ``resume``: restart with ``resume=True``; the simulator restores the last
+  watermarked round and recomputes the rest.
+
+The parent test compares the two stores' final round state bit-for-bit.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import fedml_tpu as fedml  # noqa: E402
+from fedml_tpu.arguments import default_config  # noqa: E402
+
+ROUNDS = 4
+KILL_AFTER_ROUND = 1
+
+
+def main() -> int:
+    mode, rdir = sys.argv[1], sys.argv[2]
+    over = dict(
+        run_id=f"test_res_sp_{mode}", backend="sp", model="lr",
+        dataset="synthetic", random_seed=0, comm_round=ROUNDS,
+        client_num_in_total=4, client_num_per_round=2, epochs=1,
+        batch_size=16, frequency_of_the_test=ROUNDS + 1,  # eval only at the end
+        resilience_dir=rdir,
+    )
+    if mode == "crash":
+        over["chaos_kill_after_round"] = KILL_AFTER_ROUND
+    elif mode == "resume":
+        over["resume"] = True
+    args = default_config("simulation", **over)
+    args = fedml.init(args)
+    device = fedml.device.get_device(args)
+    dataset, output_dim = fedml.data.load(args)
+    model = fedml.model.create(args, output_dim)
+    metrics = fedml.FedMLRunner(args, device, dataset, model).run()
+    return 0 if metrics is not None else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

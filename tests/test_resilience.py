@@ -1,0 +1,646 @@
+"""Resilience subsystem tests: durable round state + crash-resume, quorum
+rounds, retrying comms, codec hardening, and the idiom lint.
+
+The e2e layer drives real SIGKILLs through subprocess drivers
+(`_resilience_sp_run.py`, `_resilience_cs_cluster.py`): a run killed right
+after an async checkpoint enqueue must restart with ``resume=True`` and
+produce a final model **bit-identical** to an uninterrupted baseline — in
+both the sp simulator and the cross-silo INMEMORY cluster. The dead-client
+drill runs in-process (threads, like test_health) and proves one dead
+client cannot hang a quorum-armed server.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu.core import telemetry as tel
+from fedml_tpu.core.resilience import (
+    QuorumPolicy,
+    RetryPolicy,
+    RoundQuorum,
+    RoundStateStore,
+    retry_call,
+    statusz_snapshot,
+)
+from fedml_tpu.core.resilience import quorum as quorum_mod
+from fedml_tpu.core.resilience.retry import RETRY_COUNTER_PREFIX, transient_error
+from fedml_tpu.core.resilience.round_state import capture_numpy_rng, restore_numpy_rng
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- retry -------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_delay_bounds_exponential_with_full_jitter(self):
+        p = RetryPolicy(base_delay_s=0.2, max_delay_s=5.0, multiplier=2.0, jitter=0.5)
+        assert p.delay_bounds(1) == (0.1, 0.2)
+        assert p.delay_bounds(2) == (0.2, 0.4)
+        lo, hi = p.delay_bounds(10)
+        assert hi == 5.0 and lo == 2.5  # capped at max_delay_s
+
+    def test_from_args_disabled_returns_none(self):
+        class A:
+            comm_retry_max_attempts = 1
+
+        assert RetryPolicy.from_args(A()) is None
+        A.comm_retry_max_attempts = 0
+        assert RetryPolicy.from_args(A()) is None
+
+    def test_from_args_enabled(self):
+        class A:
+            comm_retry_max_attempts = 4
+            comm_retry_base_delay_s = 0.01
+            comm_retry_max_delay_s = 0.1
+            comm_retry_budget_s = 9.0
+
+        p = RetryPolicy.from_args(A())
+        assert p.max_attempts == 4 and p.base_delay_s == 0.01 and p.budget_s == 9.0
+
+
+class TestRetryCall:
+    def _deterministic(self):
+        sleeps = []
+        clock = {"t": 0.0}
+
+        def sleep(s):
+            sleeps.append(s)
+            clock["t"] += s
+
+        return sleeps, (lambda: clock["t"]), sleep
+
+    def test_succeeds_after_transient_failures_and_counts(self):
+        was = tel.get_telemetry().enabled
+        tel.get_telemetry().set_enabled(True)
+        tel.get_telemetry().reset()
+        try:
+            sleeps, clock, sleep = self._deterministic()
+            calls = {"n": 0}
+
+            def fn():
+                calls["n"] += 1
+                if calls["n"] < 3:
+                    raise ConnectionError("transient")
+                return "ok"
+
+            p = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=1.0, jitter=0.5)
+            import random
+            out = retry_call(fn, policy=p, label="testbk", sleep=sleep,
+                             clock=clock, rng=random.Random(0))
+            assert out == "ok" and calls["n"] == 3
+            # two retries, each sleep inside its attempt's jitter bounds
+            assert len(sleeps) == 2
+            for attempt, s in enumerate(sleeps, 1):
+                lo, hi = p.delay_bounds(attempt)
+                assert lo <= s <= hi
+            counters = tel.snapshot()["counters"]
+            assert counters[RETRY_COUNTER_PREFIX + "testbk"] == 2
+        finally:
+            tel.get_telemetry().reset()
+            tel.get_telemetry().set_enabled(was)
+
+    def test_attempt_cap_reraises_last_error(self):
+        sleeps, clock, sleep = self._deterministic()
+        p = RetryPolicy(max_attempts=3, base_delay_s=0.01)
+
+        def fn():
+            raise TimeoutError("always")
+
+        with pytest.raises(TimeoutError):
+            retry_call(fn, policy=p, sleep=sleep, clock=clock)
+        assert len(sleeps) == 2  # attempts 1,2 slept; attempt 3 raised
+
+    def test_budget_wins_over_attempts(self):
+        """A policy with a huge attempt cap still gives up once the next
+        sleep would blow the elapsed budget."""
+        sleeps, clock, sleep = self._deterministic()
+        p = RetryPolicy(max_attempts=10_000, base_delay_s=1.0, max_delay_s=1.0,
+                        jitter=0.0, budget_s=3.5)
+
+        def fn():
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            retry_call(fn, policy=p, sleep=sleep, clock=clock)
+        # 1s sleeps: after 3 the next would exceed 3.5s elapsed budget
+        assert len(sleeps) == 3
+
+    def test_non_retryable_raises_immediately(self):
+        sleeps, clock, sleep = self._deterministic()
+        p = RetryPolicy(max_attempts=5)
+
+        def fn():
+            raise KeyError("programming error")
+
+        with pytest.raises(KeyError):
+            retry_call(fn, policy=p, sleep=sleep, clock=clock)
+        assert sleeps == []
+
+    def test_transient_error_classification(self):
+        assert transient_error(ConnectionResetError())
+        assert transient_error(TimeoutError())
+        assert transient_error(ValueError("truncated frame"))
+        assert not transient_error(KeyError("k"))
+
+    def test_default_config_arms_retry_and_opt_out_disables_it(self):
+        """Cross-silo defaults ship with retry armed; comm_retry_max_attempts=1
+        resolves the policy to None — the send path is then one direct call,
+        no wrapper frame."""
+        from fedml_tpu.arguments import default_config
+
+        args = default_config("cross_silo", rank=0, role="server")
+        policy = RetryPolicy.from_args(args)
+        assert policy is not None and policy.max_attempts >= 2
+        args.comm_retry_max_attempts = 1
+        assert RetryPolicy.from_args(args) is None
+
+
+# --- quorum ------------------------------------------------------------------
+
+
+class TestQuorumPolicy:
+    def test_disabled_by_default(self):
+        class A:
+            pass
+
+        p = QuorumPolicy.from_args(A())
+        assert not p.enabled and p.deadline_for_round() is None
+
+    def test_enabled_by_any_knob(self):
+        assert QuorumPolicy(deadline_s=5.0).enabled
+        assert QuorumPolicy(quorum_frac=0.5).enabled
+        assert QuorumPolicy(adaptive=True).enabled
+        assert QuorumPolicy(overprovision_frac=0.5).enabled
+
+    def test_min_quorum_ceil(self):
+        p = QuorumPolicy(quorum_frac=0.5)
+        assert p.min_quorum(3) == 2
+        assert p.min_quorum(4) == 2
+        assert QuorumPolicy(quorum_frac=1.0).min_quorum(3) == 3
+        assert QuorumPolicy(quorum_frac=0.0).min_quorum(3) == 1  # floor of 1
+
+    def test_adaptive_deadline_tracks_slowest_ewma(self):
+        class C:
+            def __init__(self, e):
+                self.ewma_s = e
+
+        class H:
+            _clients = {1: C(0.5), 2: C(2.0), 3: C(None)}
+
+        p = QuorumPolicy(adaptive=True, adaptive_mult=3.0, min_deadline_s=1.0)
+        assert p.deadline_for_round(H()) == pytest.approx(6.0)
+        # static deadline caps the adaptive one
+        p2 = QuorumPolicy(deadline_s=4.0, adaptive=True, adaptive_mult=3.0)
+        assert p2.deadline_for_round(H()) == pytest.approx(4.0)
+        # no observations yet: fall back to static
+        class Empty:
+            _clients = {}
+
+        assert p2.deadline_for_round(Empty()) == pytest.approx(4.0)
+
+    def test_overprovisioned_cohort_size(self):
+        assert quorum_mod.overprovisioned_cohort_size(2, 0.5, True, 4) == 3
+        assert quorum_mod.overprovisioned_cohort_size(2, 0.5, False, 4) == 2
+        # capped at the connected population
+        assert quorum_mod.overprovisioned_cohort_size(3, 1.0, True, 4) == 4
+        assert quorum_mod.overprovisioned_cohort_size(3, 0.0, True, 9) == 3
+
+
+class TestRoundQuorum:
+    def _counters(self):
+        return tel.snapshot()["counters"]
+
+    def test_accept_then_complete(self):
+        q = RoundQuorum(0, [1, 2, 3], 3, QuorumPolicy(deadline_s=60))
+        assert q.on_delta(1, 0) == quorum_mod.ACCEPT
+        assert not q.complete()
+        assert q.on_delta(1, 0) == quorum_mod.DUPLICATE
+        assert q.on_delta(2, 0) == quorum_mod.ACCEPT
+        assert q.on_delta(3, 0) == quorum_mod.ACCEPT
+        assert q.complete() and q.missing() == []
+
+    def test_late_delta_discarded_and_counted(self):
+        was = tel.get_telemetry().enabled
+        tel.get_telemetry().set_enabled(True)
+        tel.get_telemetry().reset()
+        try:
+            q = RoundQuorum(5, [1, 2], 2, QuorumPolicy(deadline_s=60))
+            assert q.on_delta(1, 4) == quorum_mod.LATE  # tagged a past round
+            assert q.arrived() == []
+            assert self._counters()[quorum_mod.LATE_COUNTER] == 1
+        finally:
+            tel.get_telemetry().reset()
+            tel.get_telemetry().set_enabled(was)
+
+    def test_surplus_beyond_keep_k_discarded(self):
+        was = tel.get_telemetry().enabled
+        tel.get_telemetry().set_enabled(True)
+        tel.get_telemetry().reset()
+        try:
+            # over-provisioned round: 3 sampled, keep first 2
+            q = RoundQuorum(0, [1, 2, 3], 2, QuorumPolicy(overprovision_frac=0.5))
+            assert q.on_delta(1, 0) == quorum_mod.ACCEPT
+            assert q.on_delta(3, 0) == quorum_mod.ACCEPT
+            assert q.complete()
+            assert q.on_delta(2, 0) == quorum_mod.SURPLUS
+            assert q.arrived() == [1, 3]
+            assert self._counters()[quorum_mod.SURPLUS_COUNTER] == 1
+        finally:
+            tel.get_telemetry().reset()
+            tel.get_telemetry().set_enabled(was)
+
+    def test_deadline_quorum_and_partial_close(self):
+        was = tel.get_telemetry().enabled
+        tel.get_telemetry().set_enabled(True)
+        tel.get_telemetry().reset()
+        try:
+            q = RoundQuorum(0, [1, 2, 3], 3, QuorumPolicy(deadline_s=1, quorum_frac=0.5))
+            assert not q.deadline_quorum_met()  # 0 of min 2
+            q.on_delta(1, 0)
+            assert not q.deadline_quorum_met()  # 1 of min 2 -> extend
+            q.on_delta(2, 0)
+            assert q.deadline_quorum_met()
+            missing = q.close_partial()
+            assert missing == [3]
+            assert self._counters()[quorum_mod.PARTIAL_COUNTER] == 1
+            # closed: a straggler's delta is surplus now
+            assert q.on_delta(3, 0) == quorum_mod.SURPLUS
+        finally:
+            tel.get_telemetry().reset()
+            tel.get_telemetry().set_enabled(was)
+
+    def test_prom_renders_quorum_and_retry_families(self):
+        from fedml_tpu.core.telemetry import prom
+
+        was = tel.get_telemetry().enabled
+        tel.get_telemetry().set_enabled(True)
+        tel.get_telemetry().reset()
+        try:
+            tel.counter(quorum_mod.PARTIAL_COUNTER).add(2)
+            tel.counter(RETRY_COUNTER_PREFIX + "grpc").add(3)
+            text = prom.render(telemetry=tel.get_telemetry())
+            assert "fedml_quorum_partial_total 2" in text
+            assert 'fedml_comm_retry_total{backend="grpc"} 3' in text
+        finally:
+            tel.get_telemetry().reset()
+            tel.get_telemetry().set_enabled(was)
+
+
+# --- durable round state -----------------------------------------------------
+
+
+class TestRoundStateStore:
+    def test_save_resume_roundtrip_with_template(self, tmp_path):
+        store = RoundStateStore(str(tmp_path / "rs"))
+        state = {"model": {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                           "b": np.ones(3, dtype=np.float32)}}
+        np.random.seed(123)
+        np.random.random(7)  # advance the stream so the capture is non-trivial
+        store.save_round(0, state, cohort=[1, 3], wait=True)
+        before = np.random.random(4)
+
+        np.random.seed(999)  # clobber
+        store2 = RoundStateStore(str(tmp_path / "rs"))
+        rs = store2.resume(template={"model": {"w": np.zeros((2, 3), np.float32),
+                                               "b": np.zeros(3, np.float32)}})
+        assert rs is not None and rs.round_idx == 0
+        np.testing.assert_array_equal(rs.state["model"]["w"], state["model"]["w"])
+        assert rs.cohort == [1, 3]
+        restore_numpy_rng(rs.meta.get("numpy_rng"))
+        np.testing.assert_array_equal(np.random.random(4), before)
+        store.close()
+        store2.close()
+
+    def test_resume_empty_store_returns_none(self, tmp_path):
+        store = RoundStateStore(str(tmp_path / "empty"))
+        assert store.resume() is None
+        assert store.latest_complete_round() is None
+        store.close()
+
+    def test_watermark_ignores_torn_round(self, tmp_path):
+        """A meta sidecar without a finalized checkpoint (the SIGKILL-mid-
+        write shape) must not advance the resume point."""
+        store = RoundStateStore(str(tmp_path / "rs"))
+        store.save_round(0, {"model": {"w": np.zeros(2, np.float32)}}, wait=True)
+        # simulate the torn round-1 save: meta landed, orbax never finalized
+        (tmp_path / "rs" / "meta-1.json").write_text(json.dumps({"round_idx": 1}))
+        assert store.latest_complete_round() == 0
+        rs = store.resume()
+        assert rs.round_idx == 0
+        store.close()
+
+    def test_async_save_commits_watermark_and_second_is_dropped(self, tmp_path, monkeypatch):
+        from fedml_tpu.utils import checkpoint as ckpt_mod
+
+        was = tel.get_telemetry().enabled
+        tel.get_telemetry().set_enabled(True)
+        tel.get_telemetry().reset()
+        try:
+            store = RoundStateStore(str(tmp_path / "rs"))
+            # slow the orbax save down so the second enqueue reliably arrives
+            # while the first is still finalizing
+            orig_save = store.ckpt._mgr.save
+
+            def slow_save(step, **kw):
+                time.sleep(0.4)  # sleep ok: test fixture slowing a save, not a retry
+                return orig_save(step, **kw)
+
+            monkeypatch.setattr(store.ckpt._mgr, "save", slow_save)
+            st = {"model": {"w": np.ones(4, np.float32)}}
+            assert store.save_round(0, st, wait=False) is True
+            assert store.save_round(1, st, wait=False) is False  # dropped
+            store.wait()
+            assert store.latest_complete_round() == 0  # dropped round never committed
+            counters = tel.snapshot()["counters"]
+            assert counters[ckpt_mod.DROPPED_COUNTER] == 1
+            hist = tel.snapshot()["histograms"][ckpt_mod.SAVE_SECONDS_HISTOGRAM]
+            assert hist["count"] >= 1
+            store.close()
+        finally:
+            tel.get_telemetry().reset()
+            tel.get_telemetry().set_enabled(was)
+
+    def test_async_enqueue_is_fast(self, tmp_path):
+        """The round loop pays only payload construction + thread spawn
+        (bench.py guards <5ms on the ResNet tree; here a loose 50ms bound
+        on a tiny tree catches the orbax blocking phase leaking back onto
+        the caller thread)."""
+        store = RoundStateStore(str(tmp_path / "rs"))
+        st = {"model": {"w": np.ones((64, 64), np.float32)}}
+        store.save_round(0, st, wait=True)  # warm orbax
+        t0 = time.perf_counter()
+        store.save_round(1, st, wait=False)
+        dt = time.perf_counter() - t0
+        store.wait()
+        assert dt < 0.05, f"async enqueue took {dt * 1e3:.1f}ms"
+        store.close()
+
+    def test_statusz_snapshot_carries_resilience_facts(self, tmp_path):
+        store = RoundStateStore(str(tmp_path / "rs"))
+        store.save_round(3, {"model": {"w": np.zeros(2, np.float32)}}, wait=True)
+        snap = statusz_snapshot()
+        assert snap["last_checkpoint_enqueued_round"] == 3
+        doc = __import__("fedml_tpu.core.telemetry.statusz", fromlist=["render"]).render()
+        assert doc["sections"]["resilience"]["last_checkpoint_enqueued_round"] == 3
+        store.close()
+
+    def test_rng_capture_restore_is_exact(self):
+        np.random.seed(7)
+        np.random.random(11)
+        st = capture_numpy_rng()
+        a = np.random.random(5)
+        restore_numpy_rng(st)
+        np.testing.assert_array_equal(np.random.random(5), a)
+
+
+class TestStatuszPortFile:
+    def test_port_file_written_and_removed_on_stop(self, tmp_path):
+        from fedml_tpu.core.telemetry.statusz import StatuszServer
+
+        pf = tmp_path / "statusz.port"
+        srv = StatuszServer(port=0, service="t", port_file=str(pf))
+        port = srv.start()
+        assert int(pf.read_text()) == port
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/statusz", timeout=5) as r:
+            assert json.loads(r.read())["service"] == "t"
+        srv.stop()
+        assert not pf.exists()  # clean shutdown removes the breadcrumb
+
+
+# --- codec hardening ---------------------------------------------------------
+
+
+class TestCodecHardening:
+    def _frame(self):
+        from fedml_tpu.core.distributed.communication.codec import message_to_bytes
+        from fedml_tpu.core.distributed.communication.message import Message
+
+        msg = Message(3, 1, 0)
+        msg.add_params("num_samples", 42)
+        return message_to_bytes(msg)
+
+    def test_truncated_frame_raises_value_error(self):
+        from fedml_tpu.core.distributed.communication.codec import message_from_bytes
+
+        data = self._frame()
+        for cut in (0, 2, len(data) // 2, len(data) - 1):
+            with pytest.raises(ValueError):
+                message_from_bytes(data[:cut])
+
+    def test_corrupt_header_raises_value_error(self):
+        from fedml_tpu.core.distributed.communication.codec import message_from_bytes
+
+        data = bytearray(self._frame())
+        data[4] ^= 0xFF  # flip a byte inside the JSON header
+        with pytest.raises(ValueError):
+            message_from_bytes(bytes(data))
+
+    def test_corruption_is_retryable(self):
+        """The codec's ValueError is classified transient, so a retrying
+        receive loop re-requests the frame instead of dying."""
+        from fedml_tpu.core.distributed.communication.codec import message_from_bytes
+
+        try:
+            message_from_bytes(b"\x00\x00")
+        except ValueError as e:
+            assert transient_error(e)
+        else:
+            pytest.fail("truncated frame did not raise")
+
+
+# --- the idiom lint ----------------------------------------------------------
+
+
+class TestResilienceLint:
+    def _load_tool(self):
+        spec = importlib.util.spec_from_file_location(
+            "check_resilience", os.path.join(_REPO, "tools", "check_resilience.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_tree_is_clean(self):
+        assert self._load_tool().main() == 0
+
+    def test_catches_bare_sleep_loop(self, tmp_path):
+        bad = tmp_path / "fedml_bad.py"
+        bad.write_text("import time\nfor i in range(3):\n    time.sleep(1)\n")
+        mod = self._load_tool()
+        assert mod.main([str(tmp_path)]) == 1
+
+    def test_catches_direct_orbax_use(self, tmp_path):
+        bad = tmp_path / "fedml_bad.py"
+        bad.write_text("import orbax.checkpoint as ocp\nmgr = ocp.CheckpointManager('/tmp/x')\n")
+        mod = self._load_tool()
+        assert mod.main([str(tmp_path)]) == 1
+
+    def test_marker_allows_sleep(self, tmp_path):
+        ok = tmp_path / "fedml_ok.py"
+        ok.write_text("import time\ntime.sleep(1)  # sleep ok: test pacing\n")
+        mod = self._load_tool()
+        assert mod.main([str(tmp_path)]) == 0
+
+
+# --- e2e: dead client + quorum (in-process cluster) --------------------------
+
+
+class TestDeadClientQuorum:
+    def test_one_dead_client_cannot_hang_the_round(self, tmp_path, monkeypatch):
+        """3 clients, one raises inside round 0 (chaos) and never uploads.
+        With a deadline + quorum_frac the server aggregates partially within
+        the deadline, marks the dead rank failed, and finishes the run —
+        the reference's all-receive gate would hang forever."""
+        import fedml_tpu as fedml
+        from fedml_tpu import mlops
+        from fedml_tpu.arguments import default_config
+        from fedml_tpu.core.distributed.communication.inmemory.broker import InMemoryBroker
+
+        monkeypatch.setenv("FEDML_FR_DIR", str(tmp_path / "crash"))
+        n_clients, dead_rank, rounds = 3, 2, 2
+        partial_events = []
+
+        real_event = mlops.log_resilience_event
+
+        def capture_event(event, round_idx=None, **fields):
+            if event == "quorum_partial":
+                partial_events.append((round_idx, dict(fields)))
+            return real_event(event, round_idx=round_idx, **fields)
+
+        monkeypatch.setattr(mlops, "log_resilience_event", capture_event)
+
+        def make_args(rank, role):
+            over = dict(
+                run_id="test_quorum_dead", rank=rank, role=role, backend="INMEMORY",
+                scenario="horizontal", client_num_in_total=n_clients,
+                client_num_per_round=n_clients, comm_round=rounds, epochs=1,
+                batch_size=16, frequency_of_the_test=rounds + 1, dataset="synthetic",
+                model="lr", random_seed=0,
+            )
+            if role == "server":
+                over["round_deadline_s"] = 3.0
+                over["quorum_frac"] = 0.5
+            if role == "client" and rank == dead_rank:
+                over["chaos_raise_at_round"] = 0
+            return default_config("cross_silo", **over)
+
+        def run_party(args, results, key):
+            try:
+                args = fedml.init(args)
+                device = fedml.device.get_device(args)
+                dataset, output_dim = fedml.data.load(args)
+                model = fedml.model.create(args, output_dim)
+                results[key] = fedml.FedMLRunner(args, device, dataset, model).run()
+            except RuntimeError:
+                results[key] = "died"  # the chaos client's injected raise
+
+        t = tel.get_telemetry()
+        was = t.enabled
+        t.set_enabled(True)
+        t.reset()
+        try:
+            InMemoryBroker.reset()
+            results = {}
+            threads = [threading.Thread(
+                target=run_party, args=(make_args(0, "server"), results, "server"),
+                daemon=True)]
+            for rank in range(1, n_clients + 1):
+                threads.append(threading.Thread(
+                    target=run_party, args=(make_args(rank, "client"), results, f"c{rank}"),
+                    daemon=True))
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=240)
+                assert not th.is_alive(), "dead client hung the quorum-armed cluster"
+
+            assert results["server"] is not None
+            assert results[f"c{dead_rank}"] == "died"
+            # every round closed partially, always missing exactly the dead rank
+            assert len(partial_events) == rounds
+            for _ridx, fields in partial_events:
+                assert fields["missing"] == [dead_rank]
+                assert sorted(fields["arrived"]) == [1, 3]
+            counters = tel.snapshot()["counters"]
+            assert counters[quorum_mod.PARTIAL_COUNTER] == rounds
+        finally:
+            t.reset()
+            t.set_enabled(was)
+
+
+# --- e2e: SIGKILL + resume, bit-identical (subprocess drivers) ---------------
+
+
+def _run_driver(driver, mode, rdir, expect_kill=False):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tests", driver), mode, str(rdir)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=_REPO,
+    )
+    if expect_kill:
+        assert proc.returncode in (-9, 137), (
+            f"{driver} {mode}: expected SIGKILL, got rc={proc.returncode}\n"
+            f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-2000:]}")
+    else:
+        assert proc.returncode == 0, (
+            f"{driver} {mode}: rc={proc.returncode}\n"
+            f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-2000:]}")
+    return proc
+
+
+def _final_round_state(rdir):
+    store = RoundStateStore(str(rdir))
+    rs = store.resume()
+    store.close()
+    assert rs is not None, f"no complete round in {rdir}"
+    return rs
+
+
+def _assert_bit_identical(rs_a, rs_b):
+    assert rs_a.round_idx == rs_b.round_idx
+    la, lb = jax.tree.leaves(rs_a.state), jax.tree.leaves(rs_b.state)
+    assert len(la) == len(lb)
+    for a, b in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestKillResumeSp:
+    def test_sigkill_then_resume_is_bit_identical(self, tmp_path):
+        """sp simulator: kill the process right after round 1's async
+        checkpoint enqueue, restart with --resume, and require the final
+        round state bit-identical to an uninterrupted baseline."""
+        base_dir, crash_dir = tmp_path / "baseline", tmp_path / "crash"
+        _run_driver("_resilience_sp_run.py", "baseline", base_dir)
+        _run_driver("_resilience_sp_run.py", "crash", crash_dir, expect_kill=True)
+        # the kill happened mid/just-after-enqueue: the store must hold a
+        # complete round strictly before the end of the run
+        partial = _final_round_state(crash_dir)
+        assert partial.round_idx < 3
+        _run_driver("_resilience_sp_run.py", "resume", crash_dir)
+        _assert_bit_identical(_final_round_state(base_dir), _final_round_state(crash_dir))
+
+
+class TestKillResumeCrossSilo:
+    def test_sigkill_then_resume_is_bit_identical(self, tmp_path):
+        """Cross-silo INMEMORY 3-client cluster: the server SIGKILLs the
+        whole process after round 1's enqueue (clients die with it);
+        restarting the full cluster with --resume must converge to the
+        baseline's final global model bit-for-bit."""
+        base_dir, crash_dir = tmp_path / "baseline", tmp_path / "crash"
+        _run_driver("_resilience_cs_cluster.py", "baseline", base_dir)
+        _run_driver("_resilience_cs_cluster.py", "crash", crash_dir, expect_kill=True)
+        partial = _final_round_state(crash_dir)
+        assert partial.round_idx < 3
+        _run_driver("_resilience_cs_cluster.py", "resume", crash_dir)
+        _assert_bit_identical(_final_round_state(base_dir), _final_round_state(crash_dir))

@@ -56,6 +56,7 @@ commit_artifacts() {
     elif git commit -q -m "Record measured bench artifact from live chip" -- "${paths[@]}" 2>/tmp/bench_watch_commit.err; then
       log "artifact committed: $(git rev-parse --short HEAD)"
       surface_agg_rates
+      surface_resilience
       surface_span_summary
       surface_trace_files
       surface_crash_dumps
@@ -84,6 +85,26 @@ if agg:
 PYEOF
 ) || return 0
   [ -n "$rates" ] && log "$rates"
+}
+
+surface_resilience() {
+  # one-line view of the resilience rider on the agg stage: async round-
+  # checkpoint enqueue cost and whether watermark resume round-tripped
+  # bit-identically (resume_verified), so the watcher log answers "is
+  # crash-resume still free and correct" per artifact
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1) || return 0
+  [ -n "$newest" ] || return 0
+  local res
+  res=$(python3 - "$newest" <<'PYEOF' 2>/dev/null
+import json, sys
+doc = json.load(open(sys.argv[1]))
+if "resume_verified" in doc:
+    print(f"resilience: ckpt_enqueue {doc.get('ckpt_enqueue_ms')}ms, "
+          f"resume_verified={doc['resume_verified']}")
+PYEOF
+) || return 0
+  [ -n "$res" ] && log "$res"
 }
 
 surface_span_summary() {

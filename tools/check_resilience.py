@@ -1,0 +1,81 @@
+#!/usr/bin/env python3
+"""Resilience-idiom lint: no ad-hoc retry loops, no bypassing the watermark.
+
+Two rules enforced over every ``fedml_tpu/**/*.py`` file:
+
+1. **No bare sleep loops.** A line containing ``time.sleep(`` outside
+   ``core/resilience/retry.py`` must carry a ``# sleep ok: <reason>`` marker
+   on the same line. Hand-rolled ``for attempt in range(n): ... sleep(...)``
+   loops are how unbounded, untelemetered retries creep back in — transient
+   failures belong to :mod:`fedml_tpu.core.resilience.retry` (jittered,
+   budget-capped, flight-recorder-booked). The marker is the allowlist for
+   sleeps that are *not* retries: chaos injection, polling an external
+   process, rate pacing — the reason says which.
+
+2. **Checkpoint writes go through the watermark.** Orbax checkpointers
+   (``ocp.CheckpointManager`` / ``orbax.checkpoint``) may only be touched by
+   ``fedml_tpu/utils/checkpoint.py``. Everything else uses
+   :class:`fedml_tpu.utils.checkpoint.CheckpointManager`, whose async save +
+   watermark commit is what makes crash-resume pick a *complete* step; a
+   direct orbax save would reintroduce torn checkpoints.
+
+Anything unmarked fails tier-1 (tests/test_resilience.py invokes ``main()``).
+Exit status: 0 clean, 1 with violations listed on stdout.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+SLEEP_MARKER = "sleep ok"
+SLEEP_PATTERN = "time.sleep("
+SLEEP_EXEMPT = os.path.join("core", "resilience", "retry.py")
+
+ORBAX_PATTERNS = ("ocp.CheckpointManager", "orbax.checkpoint")
+ORBAX_HOME = os.path.join("utils", "checkpoint.py")
+
+
+def find_violations(root: str) -> list:
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if (
+                        SLEEP_PATTERN in line
+                        and SLEEP_MARKER not in line
+                        and not rel.endswith(SLEEP_EXEMPT)
+                    ):
+                        violations.append((path, lineno, "unmarked time.sleep()", line.strip()))
+                    if (
+                        any(p in line for p in ORBAX_PATTERNS)
+                        and not rel.endswith(ORBAX_HOME)
+                    ):
+                        violations.append((path, lineno, "orbax outside utils/checkpoint.py", line.strip()))
+    return violations
+
+
+def main(argv: list = ()) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = argv[0] if argv else os.path.join(repo, "fedml_tpu")
+    violations = find_violations(root)
+    for path, lineno, kind, line in violations:
+        print(f"{os.path.relpath(path, repo)}:{lineno}: {kind}: {line}")
+    if violations:
+        print(
+            f"\n{len(violations)} resilience violation(s). Retries belong to "
+            "fedml_tpu.core.resilience.retry (jittered, budget-capped); checkpoint "
+            "writes go through fedml_tpu.utils.checkpoint (watermark commit); "
+            f"legitimate non-retry sleeps need a '# {SLEEP_MARKER}: <reason>' marker."
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
