@@ -125,16 +125,27 @@ class LoRALinear(nn.Module):
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         in_dim = x.shape[-1]
         if self.cfg.weight_quant == "int8":
-            # weight-only int8 (symmetric, per-output-channel): HBM reads are
-            # int8, the convert fuses into the matmul operand load, compute
-            # stays in x.dtype with the f32-scale applied after
+            # weight-only int8 (symmetric, per-output-channel): the int8
+            # operand feeds lax.dot_general DIRECTLY (mixed bf16 x s8 dot) so
+            # HBM reads stay int8 and the widening happens inside the matmul
+            # pipeline. The old `x @ kq.astype(x.dtype)` emitted an explicit
+            # convert HLO, and inside the decode scan XLA materialized a full
+            # bf16 copy of EVERY kernel per generated token — int8 decode
+            # measured ~375x SLOWER than bf16 (BENCH_r05) instead of ~2x
+            # faster. tests/test_serving_quant.py pins both numerics and the
+            # no-per-step-retrace compile count.
             kq = self.param("kernel_q", nn.initializers.zeros,
                             (in_dim, self.features), jnp.int8)
             kscale = self.param("kernel_scale", nn.initializers.ones,
                                 (self.features,))
-            # multiply by the f32 scale (promotes), cast the RESULT back —
+            y = jax.lax.dot_general(
+                x, kq,
+                (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            # apply the f32 scale while still in f32, cast the RESULT back —
             # rounding the scale itself to bf16 would double dequant error
-            y = ((x @ kq.astype(x.dtype)) * kscale).astype(x.dtype)
+            y = (y * kscale).astype(x.dtype)
         else:
             kernel = self.param("kernel", nn.initializers.lecun_normal(), (in_dim, self.features))
             y = x @ kernel.astype(x.dtype)
@@ -182,7 +193,8 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, positions: jnp.ndarray,
-                 attn_start: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 attn_start: Optional[jnp.ndarray] = None,
+                 cache_idx: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.cfg
         B, T, _ = x.shape
         hd = cfg.head_dim
@@ -192,7 +204,7 @@ class Attention(nn.Module):
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
         if cfg.decode:
-            return self._decode_attention(q, k, v, B, T, attn_start)
+            return self._decode_attention(q, k, v, B, T, attn_start, cache_idx)
         impl = cfg.attention_impl
         if impl == "auto":
             # pallas only where it runs compiled: interpret-mode flash on CPU
@@ -217,7 +229,8 @@ class Attention(nn.Module):
         return LoRALinear(cfg.d_model, cfg, name="o_proj")(out)
 
     def _decode_attention(self, q, k, v, B: int, T: int,
-                          attn_start: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                          attn_start: Optional[jnp.ndarray] = None,
+                          cache_idx: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         """KV-cache attention for autoregressive decode (flax 'cache'
         collection). Supports prefill (T = prompt length) and single-token
         steps (T = 1): new k/v are written at the running cache index and
@@ -226,13 +239,36 @@ class Attention(nn.Module):
 
         ``attn_start`` [B] (optional): first VALID cache slot per row —
         batched serving LEFT-pads shorter prompts so all rows share the
-        write index, and each row masks out its pad prefix."""
+        write index, and each row masks out its pad prefix.
+
+        ``cache_idx`` [B] (optional, T must be 1): PER-ROW write index —
+        the continuous-batching slot engine's mode. Rows at different
+        sequence lengths share ONE decode executable: row b's k/v land at
+        ``cache_idx[b]`` via scatter and its query attends to positions
+        ``<= cache_idx[b]``. The shared scalar index is ignored (each slot
+        tracks its own length host-side); stale garbage beyond a row's
+        index is invisible by the same argument as ``_rewind_cache``, and
+        a freed slot's leftovers are fully overwritten when the slot is
+        re-admitted (serving/continuous_batching.py writes the whole row)."""
         cfg = self.cfg
         hd = cfg.head_dim
         S = cfg.max_seq_len
         ck = self.variable("cache", "k", jnp.zeros, (B, S, cfg.n_kv_heads, hd), q.dtype)
         cv = self.variable("cache", "v", jnp.zeros, (B, S, cfg.n_kv_heads, hd), q.dtype)
         cidx = self.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
+        if cache_idx is not None:
+            if T != 1:
+                raise ValueError(f"cache_idx decode requires T=1 steps, got T={T}")
+            rows = jnp.arange(B)
+            if self.is_mutable_collection("cache"):
+                ck.value = ck.value.at[rows, cache_idx].set(k[:, 0].astype(ck.value.dtype))
+                cv.value = cv.value.at[rows, cache_idx].set(v[:, 0].astype(cv.value.dtype))
+            k_all, v_all = repeat_kv(ck.value, cv.value, cfg.n_heads)
+            # [B, 1, 1, S]: row b sees exactly its own written prefix
+            valid = (jnp.arange(S)[None, :] <= cache_idx[:, None])[:, None, None]
+            out = xla_attention(q, k_all, v_all, mask=valid)
+            out = out.reshape(B, T, cfg.n_heads * hd)
+            return LoRALinear(cfg.d_model, cfg, name="o_proj")(out)
         idx = cidx.value
         if self.is_mutable_collection("cache"):
             ck.value = jax.lax.dynamic_update_slice(ck.value, k.astype(ck.value.dtype), (0, idx, 0, 0))
@@ -268,9 +304,10 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, positions: jnp.ndarray,
-                 attn_start: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 attn_start: Optional[jnp.ndarray] = None,
+                 cache_idx: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.cfg
-        x = x + Attention(cfg, name="attn")(RMSNorm(name="attn_norm")(x), positions, attn_start)
+        x = x + Attention(cfg, name="attn")(RMSNorm(name="attn_norm")(x), positions, attn_start, cache_idx)
         h = RMSNorm(name="mlp_norm")(x)
         if cfg.moe_experts > 0:
             from .moe import MoEConfig, MoEMLP
@@ -299,7 +336,8 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens: jnp.ndarray, train: bool = False,
                  positions: Optional[jnp.ndarray] = None,
-                 attn_start: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 attn_start: Optional[jnp.ndarray] = None,
+                 cache_idx: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model, name="embed")(tokens).astype(cfg.dtype)
         if positions is None:
@@ -315,7 +353,7 @@ class TransformerLM(nn.Module):
                 policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
             block = nn.remat(Block, static_argnums=(), policy=policy)
         for i in range(cfg.n_layers):
-            x = block(cfg, name=f"layer_{i}")(x, positions, attn_start)
+            x = block(cfg, name=f"layer_{i}")(x, positions, attn_start, cache_idx)
         x = RMSNorm(name="final_norm")(x)
         # tied-untied head: separate projection (llama style)
         logits = LoRALinear(cfg.vocab_size, cfg, name="lm_head")(x)

@@ -10,13 +10,13 @@ a round-robin gateway; scale_to() adds/removes replicas live.
 
 from __future__ import annotations
 
+import http.client
 import itertools
 import json
 import logging
 import os
 import threading
 import time
-import urllib.request
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -62,13 +62,59 @@ class ModelDB:
         return self.cards.get(f"{name}:{version}")
 
 
+class _ReplicaClient:
+    """Keep-alive HTTP client for one replica: a pool of reusable
+    ``http.client`` connections plus the in-flight count the router reads.
+    The old gateway opened a fresh ``urllib`` connection per request — a
+    full TCP handshake on every predict, and at continuous-batching
+    concurrency (hundreds of parked streams) ephemeral-port churn."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self.in_flight = 0  # mutated under the owning Endpoint's lock
+        self._pool: List[http.client.HTTPConnection] = []
+        self._lock = threading.Lock()
+
+    def request(self, path: str, payload: Dict[str, Any], timeout_s: float) -> Dict[str, Any]:
+        with self._lock:
+            conn = self._pool.pop() if self._pool else None
+        if conn is None:
+            conn = http.client.HTTPConnection(self.host, self.port, timeout=timeout_s)
+        elif conn.sock is not None:
+            conn.sock.settimeout(timeout_s)  # pooled conns: per-call timeout
+        try:
+            conn.request("POST", path, json.dumps(payload).encode(),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"replica {self.host}:{self.port} returned {resp.status}: {data[:200]!r}")
+        except Exception:
+            # a half-read or errored connection must never go back in the
+            # pool: the next borrower would read this request's leftovers
+            conn.close()
+            raise
+        with self._lock:
+            self._pool.append(conn)
+        return json.loads(data)
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._pool:
+                c.close()
+            self._pool.clear()
+
+
 class Endpoint:
-    """N replicas + round-robin gateway."""
+    """N replicas + least-in-flight keep-alive gateway."""
 
     def __init__(self, name: str, predictor_factory: Callable[[], FedMLPredictor], num_replicas: int = 1):
         self.name = name
         self.predictor_factory = predictor_factory
         self.replicas: List[FedMLInferenceRunner] = []
+        self._clients: List[_ReplicaClient] = []
         self._rr = itertools.count()
         self._lock = threading.Lock()
         self.scale_to(num_replicas)
@@ -79,9 +125,12 @@ class Endpoint:
                 runner = FedMLInferenceRunner(self.predictor_factory(), port=0)
                 runner.start()
                 self.replicas.append(runner)
+                self._clients.append(_ReplicaClient(runner.host, runner.port))
                 log.info("endpoint %s: replica up on port %d", self.name, runner.port)
             while len(self.replicas) > n:
                 runner = self.replicas.pop()
+                client = self._clients.pop()
+                client.close()
                 runner.stop()
                 log.info("endpoint %s: replica down", self.name)
 
@@ -92,19 +141,31 @@ class Endpoint:
     def ready(self) -> bool:
         return all(r.client_predictor.ready() for r in self.replicas)
 
+    def in_flight(self) -> List[int]:
+        """Per-replica outstanding request counts (observability/tests)."""
+        with self._lock:
+            return [c.in_flight for c in self._clients]
+
     def predict(self, payload: Dict[str, Any], timeout_s: float = 30.0) -> Dict[str, Any]:
-        """Gateway: forward to the next replica over real HTTP (reference
-        device_model_inference.py forwards to the container)."""
+        """Gateway: forward to the LEAST-IN-FLIGHT replica over a keep-alive
+        connection (reference device_model_inference.py forwards to the
+        container, blindly round-robin). Least-in-flight matters once
+        replicas run continuous batching: a round-robin gateway keeps
+        feeding a replica whose slots are saturated while another sits
+        idle — queue depth, not arrival order, is the real load signal.
+        Ties rotate round-robin so idle replicas still share warm-up."""
         with self._lock:
             if not self.replicas:
                 raise RuntimeError(f"endpoint {self.name} has no replicas")
-            idx = next(self._rr) % len(self.replicas)
-            url = self.urls[idx]
-        req = urllib.request.Request(
-            url + "/predict", data=json.dumps(payload).encode(), headers={"Content-Type": "application/json"}
-        )
-        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-            return json.loads(resp.read())
+            low = min(c.in_flight for c in self._clients)
+            candidates = [c for c in self._clients if c.in_flight == low]
+            client = candidates[next(self._rr) % len(candidates)]
+            client.in_flight += 1
+        try:
+            return client.request("/predict", payload, timeout_s)
+        finally:
+            with self._lock:
+                client.in_flight -= 1
 
     def shutdown(self) -> None:
         self.scale_to(0)

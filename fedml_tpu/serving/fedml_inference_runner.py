@@ -140,13 +140,19 @@ class FedMLInferenceRunner:
         if batch_window_ms is None:
             batch_window_ms = float(os.environ.get("FEDML_SERVE_BATCH_WINDOW_MS", "10"))
         self.batcher: Optional[_MicroBatcher] = None
-        if max_batch > 1 and hasattr(client_predictor, "predict_many"):
+        # continuous-batching predictors do their own cross-request
+        # interleaving (serving/continuous_batching.py) — wrapping them in
+        # the window micro-batcher would re-introduce the request-boundary
+        # barrier the engine exists to remove
+        self.engine = getattr(client_predictor, "engine", None)
+        if self.engine is None and max_batch > 1 and hasattr(client_predictor, "predict_many"):
             self.batcher = _MicroBatcher(client_predictor, max_batch, batch_window_ms / 1000.0)
 
     # -- stdlib path -------------------------------------------------------
     def _make_handler(self):
         predictor = self.client_predictor
         batcher = self.batcher
+        engine = self.engine
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # route to logging, not stderr
@@ -172,6 +178,17 @@ class FedMLInferenceRunner:
                         sizes = list(batcher.batch_sizes)
                         if sizes:
                             gauges.append(("serving_last_batch_size", None, float(sizes[-1])))
+                    if engine is not None:
+                        # autoscaler/load-test signals: slot occupancy +
+                        # queue depth (TTFT/TPOT ride along automatically
+                        # as serving_cb_* histograms in the registry)
+                        st = engine.stats()
+                        gauges += [
+                            ("serving_cb_slots_total", None, float(st["slots_total"])),
+                            ("serving_cb_slots_active", None, float(st["slots_active"])),
+                            ("serving_cb_slot_occupancy", None, float(st["slot_occupancy"])),
+                            ("serving_cb_queue_depth", None, float(st["queue_depth"])),
+                        ]
                     body = prom.render(gauges=gauges).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", prom.CONTENT_TYPE)
@@ -186,6 +203,7 @@ class FedMLInferenceRunner:
                             "window_s": batcher.window_s,
                             "recent_batch_sizes": list(batcher.batch_sizes)[-16:],
                         },
+                        "continuous_batching": None if engine is None else engine.stats(),
                     })
                     self._send_json(doc)
                 else:
@@ -218,7 +236,15 @@ class FedMLInferenceRunner:
 
     def start(self) -> int:
         """Non-blocking start; returns the bound port (0 picks a free one)."""
-        self._server = ThreadingHTTPServer((self.host, self.port), self._make_handler())
+
+        class _Server(ThreadingHTTPServer):
+            # socketserver's default listen backlog is 5: a 1k-stream load
+            # burst overflows the accept queue and clients see connection
+            # resets before the first byte is served
+            request_queue_size = 1024
+            daemon_threads = True
+
+        self._server = _Server((self.host, self.port), self._make_handler())
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._thread.start()
@@ -229,6 +255,8 @@ class FedMLInferenceRunner:
             # end the batcher thread: it holds the predictor (and its model
             # params) and would otherwise outlive this runner forever
             self.batcher.shutdown()
+        if self.engine is not None:
+            self.engine.shutdown()
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()

@@ -72,7 +72,12 @@ class LLMPredictor(FedMLPredictor):
     pass (params, cfg, tokenizer) directly."""
 
     def __init__(self, params, cfg, tokenizer, default_max_new_tokens: int = 64,
-                 eos_id: "int | tuple | None" = None):
+                 eos_id: "int | tuple | None" = None,
+                 continuous: Optional[bool] = None,
+                 num_slots: Optional[int] = None,
+                 decode_chunk: Optional[int] = None):
+        import os
+
         self._params = params
         self._cfg = cfg
         self._tok = tokenizer
@@ -83,6 +88,23 @@ class LLMPredictor(FedMLPredictor):
             tokenizer, "special_tokens", {}
         ).get("</s>")
         self._ready = True  # flips False->True around warmup() when used
+        # continuous batching (serving/continuous_batching.py): requests
+        # stream through a slotted decode engine instead of the window
+        # micro-batcher. Explicit arg wins; env seam lets subprocess
+        # replicas opt in without code changes.
+        if continuous is None:
+            continuous = os.environ.get("FEDML_SERVE_CONTINUOUS", "0") not in ("0", "", "false")
+        self.engine = None
+        if continuous:
+            from .continuous_batching import ContinuousBatchingEngine
+
+            self.engine = ContinuousBatchingEngine(
+                params, cfg,
+                num_slots=int(num_slots if num_slots is not None
+                              else os.environ.get("FEDML_SERVE_SLOTS", "8")),
+                chunk=int(decode_chunk if decode_chunk is not None
+                          else os.environ.get("FEDML_SERVE_CHUNK", "8")),
+            )
 
     @classmethod
     def from_checkpoint(cls, path: str, quantize: str = "none", **kw) -> "LLMPredictor":
@@ -134,6 +156,19 @@ class LLMPredictor(FedMLPredictor):
 
         from ..train.llm.generation import generate_text
 
+        if self.engine is not None:
+            # continuous mode: this thread just parks on its future; the
+            # engine's worker interleaves every in-flight request through
+            # one always-running decode step (ThreadingHTTPServer gives a
+            # thread per connection, so concurrency comes for free)
+            toks = self.engine.generate(
+                self._tok.encode(str(request["prompt"])),
+                int(request.get("max_new_tokens", self._max_new)),
+                temperature=float(request.get("temperature", 0.0)),
+                seed=int(request.get("seed", 0)),
+                eos_id=self._eos_id,
+            )
+            return {"text": self._tok.decode([int(t) for t in toks])}
         text = generate_text(
             self._params,
             self._cfg,

@@ -259,6 +259,25 @@ class InferenceGateway:
             self.stats.window_start = time.perf_counter()
             self.stats.window_requests = 0
 
+    def signals(self) -> Dict[str, float]:
+        """The gateway's load signals — ONE source read by both the
+        Prometheus scrape (``prom_gauges``) and the autoscaler policy, so
+        what the operator graphs is exactly what the scaler acts on."""
+        with self._lock:
+            return {
+                "qps": self.stats.qps(),
+                "latency_ewma_s": self.stats.latency_ewma_s,
+                "errors": float(self.stats.errors),
+            }
+
+    def prom_gauges(self) -> List[tuple]:
+        sig = self.signals()
+        return [
+            ("serving_gateway_qps", None, sig["qps"]),
+            ("serving_gateway_latency_ewma_seconds", None, sig["latency_ewma_s"]),
+            ("serving_gateway_errors", None, sig["errors"]),
+        ]
+
     def predict(self, payload: Dict[str, Any], *, timeout_s: float = 30.0, retries: int = 3) -> Dict[str, Any]:
         data = json.dumps(payload).encode()
         last_err: Optional[Exception] = None
@@ -305,7 +324,13 @@ class AutoScaler:
     """QPS/latency -> replica count policy (reference
     device_replica_controller autoscale surface).
 
-    desired = ceil(observed_qps / target_qps_per_replica), clamped to
+    Policy inputs are the gateway's exported Prometheus signals
+    (``InferenceGateway.signals``: the same values scraped as
+    ``fedml_serving_gateway_qps`` / ``_latency_ewma_seconds``):
+    desired = ceil(observed_qps / target_qps_per_replica), and when the
+    latency EWMA breaches ``max_latency_s`` under load the scaler adds a
+    replica even if QPS alone looks satisfied (queueing shows up in
+    latency before it shows up in completed-request QPS). Clamped to
     [min_replicas, max_replicas]; scale-down only after `cooldown_s` of
     sustained low load, scale-up immediate."""
 
@@ -314,12 +339,14 @@ class AutoScaler:
         gateway: InferenceGateway,
         *,
         target_qps_per_replica: float = 50.0,
+        max_latency_s: Optional[float] = None,
         min_replicas: int = 1,
         max_replicas: int = 8,
         cooldown_s: float = 30.0,
     ):
         self.gateway = gateway
         self.target = float(target_qps_per_replica)
+        self.max_latency_s = None if max_latency_s is None else float(max_latency_s)
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
         self.cooldown_s = float(cooldown_s)
@@ -328,8 +355,15 @@ class AutoScaler:
         self._thread: Optional[threading.Thread] = None
 
     def desired_replicas(self) -> int:
-        qps = self.gateway.stats.qps()
+        sig = self.gateway.signals()
+        qps = sig["qps"]
         want = max(1, math.ceil(qps / self.target)) if qps > 0 else self.min_replicas
+        if (
+            self.max_latency_s is not None
+            and qps > 0
+            and sig["latency_ewma_s"] > self.max_latency_s
+        ):
+            want = max(want, self.gateway.replica_set.desired + 1)
         return max(self.min_replicas, min(self.max_replicas, want))
 
     def tick(self, now: Optional[float] = None) -> int:

@@ -25,6 +25,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ...core.telemetry import track_compiles
 from ...models.transformer import TransformerConfig, TransformerLM
 
 
@@ -101,7 +102,9 @@ def _prefill_fn(cfg: TransformerConfig, B: int, P_bucket: int):
             first = logits[jnp.arange(B), true_len - 1]
             return _rewind_cache(state["cache"], true_len), first
 
-        return jax.jit(run)
+        # compile observability: counter("jax.compiles.prefill") advances per
+        # TRACE, not per call — the serving compile-count guards read it
+        return jax.jit(track_compiles(run, name="prefill"))
 
     return _lru_get(("prefill", cfg, B, P_bucket), build)
 
@@ -140,7 +143,11 @@ def _decode_fn(cfg: TransformerConfig, B: int, max_new: int, sampled: bool,
             )
             return jnp.concatenate([toks.swapaxes(0, 1), last[:, None]], axis=1)
 
-        return jax.jit(run)
+        # "jax.compiles.decode_scan" is the int8 regression guard's witness:
+        # a per-call (or per-token) retrace of the scan shows up here (the
+        # r05 int8 collapse's suspected mechanism), and bench.py --stage
+        # decode_int8 refuses to publish when the count exceeds the key count
+        return jax.jit(track_compiles(run, name="decode_scan"))
 
     return _lru_get(("decode", cfg, B, max_new, sampled, eos_ids), build)
 
@@ -210,7 +217,7 @@ def _prefill_batch_fn(cfg: TransformerConfig, B: int, P_bucket: int):
             )
             return state["cache"], logits[:, -1]
 
-        return jax.jit(run)
+        return jax.jit(track_compiles(run, name="prefill_batch"))
 
     return _lru_get(("prefill_b", cfg, B, P_bucket), build)
 
@@ -253,7 +260,7 @@ def _decode_batch_fn(cfg: TransformerConfig, B: int, max_new: int, sampled: bool
             )
             return jnp.concatenate([toks.swapaxes(0, 1), last[:, None]], axis=1)
 
-        return jax.jit(run)
+        return jax.jit(track_compiles(run, name="decode_scan_batch"))
 
     return _lru_get(("decode_b", cfg, B, max_new, sampled, eos_ids), build)
 

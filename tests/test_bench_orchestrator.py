@@ -178,6 +178,15 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
                      "endpoint_replicas": 2, "endpoint_requests": 12,
                      "endpoint_model": "llama-268M flagship proxy (bf16)",
                      "endpoint_batching": "dynamic"}, None),
+        "serving_load": ({"serving_load_streams": 1024,
+                          "serving_load_tokens_per_sec": 300.0,
+                          "serving_load_ttft_p50_s": 0.8,
+                          "serving_load_ttft_p99_s": 2.5,
+                          "serving_load_tpot_p50_s": 0.004,
+                          "serving_load_tpot_p99_s": 0.02,
+                          "serving_load_slots": 64,
+                          "serving_load_slot_occupancy_peak": 1.0,
+                          "serving_load_slot_occupancy_mean": 0.9}, None),
         "agg": ({"agg_clients_per_sec": {"resnet56": {"8": 120.0, "64": 240.0},
                                          "llm268m": {"8": 3.0}},
                  "agg_hbm_gbps": {"resnet56": {"8": 1.5, "64": 2.8},
@@ -787,3 +796,108 @@ def test_attn_micro_rejection_merge(monkeypatch, tmp_path, capsys, _restore_sign
     assert "attn_best_flash" not in out
     assert "attn_best_vs_einsum" not in out
     assert out["attn_fwd_bwd_ms"] == {"xla_einsum": 8.0}
+
+
+def test_llm_xla_oom_respawns_once_at_half_bs(monkeypatch, tmp_path, capsys,
+                                              _restore_signals):
+    """ISSUE 6 satellite (r05 stages_failed): an llm_xla RESOURCE_EXHAUSTED
+    death triggers exactly one respawn in a FRESH subprocess at half batch
+    (FEDML_LLM_XLA_BS in the child env), and the shrunken geometry is
+    surfaced in the merged JSON rather than silently passing as the
+    headline shape."""
+    xla_envs = []
+
+    def fake_spawn(name, budget_s, argv=None, env=None):
+        if name == "llm_xla":
+            xla_envs.append(env)
+            if len(xla_envs) == 1:
+                return None, "llm_xla: rc=1 RESOURCE_EXHAUSTED: out of memory"
+            return ({"tokens_per_sec": 15000.0, "mfu": 0.12, "remat": True,
+                     "attention_impl": "xla", "n_params": 268000000,
+                     "shape": dict(_LLM_OK[0]["shape"], bs=4),
+                     "device": "TPU v5 lite", "step_flops": 1e12,
+                     "degraded_bs": 4}, None)
+        return {"llm_pallas": _LLM_OK}.get(name, (None, f"{name}: canned failure"))
+
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "_acquire_bench_lock", lambda *a, **k: object())
+    monkeypatch.setattr(bench, "_spawn_stage", fake_spawn)
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0
+    assert len(xla_envs) == 2  # one OOM, ONE respawn — not a retry loop
+    half = str(max(1, bench._llm_shape()["bs"] // 2))
+    assert xla_envs[1] is not None
+    assert xla_envs[1]["FEDML_LLM_XLA_BS"] == half
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["tokens_per_sec_xla_attention"] == 15000.0
+    assert out["llm_xla_degraded_bs"] == 4
+    # the recovered stage is a success: no llm_xla entry in stages_failed
+    assert not any("llm_xla" in f for f in out.get("stages_failed", []))
+
+
+def test_llm_xla_non_oom_failure_does_not_respawn(monkeypatch, tmp_path,
+                                                  capsys, _restore_signals):
+    calls = []
+
+    def fake_spawn(name, budget_s, argv=None, env=None):
+        if name == "llm_xla":
+            calls.append(env)
+            return None, "llm_xla: rc=1 RuntimeError: tunnel hiccup"
+        return {"llm_pallas": _LLM_OK}.get(name, (None, f"{name}: canned failure"))
+
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "_acquire_bench_lock", lambda *a, **k: object())
+    monkeypatch.setattr(bench, "_spawn_stage", fake_spawn)
+    with pytest.raises(SystemExit):
+        bench.main()
+    assert len(calls) == 1  # the half-bs respawn is OOM-specific
+    capsys.readouterr()
+
+
+def test_main_merges_serving_load_and_vs_decode(monkeypatch, tmp_path, capsys,
+                                                _restore_signals):
+    """The serving_load stage's keys (tokens/s, TTFT/TPOT tails, slot
+    occupancy) merge into the one-line JSON, and serving_load_vs_decode =
+    raw decode rate / endpoint rate (ISSUE 6 acceptance: within 10x)."""
+    _canned_stages(monkeypatch, tmp_path, {
+        "llm_pallas": _LLM_OK,
+        "decode": ({"decode_tokens_per_sec": 900.0, "bs": 4, "new": 128}, None),
+        "serving_load": ({"serving_load_streams": 1024,
+                          "serving_load_tokens_per_sec": 300.0,
+                          "serving_load_tokens": 32768,
+                          "serving_load_wall_s": 109.2,
+                          "serving_load_ttft_p50_s": 0.8,
+                          "serving_load_ttft_p99_s": 2.5,
+                          "serving_load_tpot_p50_s": 0.004,
+                          "serving_load_tpot_p99_s": 0.02,
+                          "serving_load_slots": 64,
+                          "serving_load_chunk": 16,
+                          "serving_load_slot_occupancy_peak": 1.0,
+                          "serving_load_slot_occupancy_mean": 0.9,
+                          "serving_load_queue_depth_peak": 960,
+                          "serving_load_model": "llama-268M flagship proxy (bf16)",
+                          "serving_load_engine": "continuous"}, None),
+    })
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["serving_load_tokens_per_sec"] == 300.0
+    assert out["serving_load_ttft_p99_s"] == 2.5
+    assert out["serving_load_slot_occupancy_peak"] == 1.0
+    assert out["serving_load_vs_decode"] == 3.0  # 900 / 300, within the 10x gate
+
+
+def test_memplan_device_kind_hbm_fallback_table():
+    """Satellite: when the runtime exposes no memory_stats bytes_limit, the
+    per-device-kind datasheet table supplies the HBM ceiling (v5e = 16 GiB
+    per device) so memory_plan_validated is a real verdict, not null."""
+    assert bench._device_hbm_fallback("TPU v5 lite") == 16 * 2**30
+    assert bench._device_hbm_fallback("TPU v5p") == 95 * 2**30
+    assert bench._device_hbm_fallback("TPU v4") == 32 * 2**30
+    assert bench._device_hbm_fallback("TPU v6e") == 32 * 2**30
+    assert bench._device_hbm_fallback("TPU v3") == 16 * 2**30
+    assert bench._device_hbm_fallback("some-future-chip") is None

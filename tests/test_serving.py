@@ -242,3 +242,99 @@ def test_flagship_predictor_geometry_matches_headline_model():
 
     tiny = bench_predictor_config(tiny=True, flagship=False, tok_vocab=512)
     assert tiny.d_model == 64 and tiny.n_layers == 2  # CPU harness stays tiny
+
+
+def test_endpoint_least_in_flight_routing():
+    """The gateway routes to the replica with the fewest outstanding
+    requests (queue depth, not arrival order, is the load signal once
+    replicas run continuous batching); ties rotate round-robin."""
+    ep = Endpoint("lif", EchoPredictor, num_replicas=2)
+    try:
+        assert ep.in_flight() == [0, 0]
+        # pin replica 0 as "busy": every request must land on replica 1
+        ep._clients[0].in_flight = 5
+        busy_free_who = {ep.predict({"inputs": [i]})["who"] for i in range(4)}
+        assert len(busy_free_who) == 1
+        ep._clients[0].in_flight = 0
+        # balanced again: ties rotate, both replicas serve
+        whos = {ep.predict({"inputs": [i]})["who"] for i in range(4)}
+        assert len(whos) == 2
+        assert ep.in_flight() == [0, 0]  # decrements survived every path
+    finally:
+        ep.shutdown()
+
+
+def test_endpoint_keepalive_reuses_connections():
+    """Repeated predicts ride pooled keep-alive connections instead of a
+    TCP handshake per request (the pool holds at most one conn here since
+    requests are sequential)."""
+    ep = Endpoint("ka", EchoPredictor, num_replicas=1)
+    try:
+        for i in range(3):
+            assert ep.predict({"inputs": [i]})["echo"] == [i]
+        [client] = ep._clients
+        assert len(client._pool) == 1
+        conn = client._pool[0]
+        assert ep.predict({"inputs": [9]})["echo"] == [9]
+        assert client._pool[0] is conn  # same socket came back
+    finally:
+        ep.shutdown()
+
+
+def test_autoscaler_latency_policy_reads_gateway_signals():
+    """AutoScaler consumes InferenceGateway.signals() — the same values the
+    Prometheus scrape exports — and a latency-EWMA breach under load adds a
+    replica even when QPS alone looks satisfied."""
+    from fedml_tpu.serving.replica_controller import AutoScaler, InferenceGateway
+
+    class _RS:
+        desired = 2
+
+    class _GW:
+        replica_set = _RS()
+
+        def __init__(self, qps, lat):
+            self._sig = {"qps": qps, "latency_ewma_s": lat, "errors": 0.0}
+
+        def signals(self):
+            return self._sig
+
+    # qps says 1 replica; the latency breach bumps to desired+1 = 3
+    sc = AutoScaler(_GW(10.0, 0.5), target_qps_per_replica=10.0,
+                    max_latency_s=0.2, min_replicas=1, max_replicas=8)
+    assert sc.desired_replicas() == 3
+    # same load, healthy latency: qps policy alone
+    sc2 = AutoScaler(_GW(10.0, 0.05), target_qps_per_replica=10.0,
+                     max_latency_s=0.2, min_replicas=1, max_replicas=8)
+    assert sc2.desired_replicas() == 1
+    # no latency policy configured: breach is ignored
+    sc3 = AutoScaler(_GW(10.0, 0.5), target_qps_per_replica=10.0,
+                     min_replicas=1, max_replicas=8)
+    assert sc3.desired_replicas() == 1
+    # idle latency spike must NOT scale (qps == 0 gate)
+    sc4 = AutoScaler(_GW(0.0, 9.9), target_qps_per_replica=10.0,
+                     max_latency_s=0.2, min_replicas=1, max_replicas=8)
+    assert sc4.desired_replicas() == 1
+
+    # the scrape and the policy read ONE source: gauge names + values
+    class _EmptyRS:
+        desired = 0
+
+        def healthy(self):
+            return []
+
+    gw = InferenceGateway.__new__(InferenceGateway)
+    gw.replica_set = _EmptyRS()
+    import threading as _threading
+    import time as _time
+
+    from fedml_tpu.serving.replica_controller import GatewayStats
+
+    gw.stats = GatewayStats(window_start=_time.perf_counter())
+    gw._rr = 0
+    gw._lock = _threading.Lock()
+    names = {g[0] for g in gw.prom_gauges()}
+    assert names == {"serving_gateway_qps",
+                     "serving_gateway_latency_ewma_seconds",
+                     "serving_gateway_errors"}
+    assert set(gw.signals()) == {"qps", "latency_ewma_s", "errors"}

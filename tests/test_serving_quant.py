@@ -37,7 +37,7 @@ def fp_model():
 def test_quantize_layout_and_roundtrip(fp_model):
     _cfg, _model, params = fp_model
     q = quantize_params_int8(params)
-    leaves = jax.tree.leaves_with_path(q)
+    leaves = jax.tree_util.tree_leaves_with_path(q)
     kq = [v for p, v in leaves if "kernel_q" in jax.tree_util.keystr(p)]
     assert kq and all(v.dtype == jnp.int8 for v in kq)
     assert not any("'kernel'" in jax.tree_util.keystr(p) for p, _ in leaves)
@@ -46,7 +46,7 @@ def test_quantize_layout_and_roundtrip(fp_model):
     np.testing.assert_array_equal(np.asarray(emb_q), np.asarray(params["embed"]["embedding"]))
     # per-channel symmetric round-trip error is bounded by scale/2 per entry
     deq = dequantize_params_int8(q)
-    for path, orig in jax.tree.leaves_with_path(params):
+    for path, orig in jax.tree_util.tree_leaves_with_path(params):
         key = jax.tree_util.keystr(path)
         if "kernel" in key and getattr(orig, "ndim", 0) == 2:
             rebuilt = deq
@@ -65,7 +65,7 @@ def test_quantize_handles_frozendict_and_refuses_kernel_free_tree(fp_model):
 
     _cfg, _model, params = fp_model
     q = quantize_params_int8(flax.core.freeze(params))
-    kq = [v for p, v in jax.tree.leaves_with_path(q)
+    kq = [v for p, v in jax.tree_util.tree_leaves_with_path(q)
           if "kernel_q" in jax.tree_util.keystr(p)]
     assert kq and all(v.dtype == jnp.int8 for v in kq)
     with pytest.raises(ValueError, match="no 2D 'kernel' leaf"):
@@ -151,3 +151,66 @@ def test_from_checkpoint_int8_serves(tmp_path):
 
     with pytest.raises(ValueError, match="unknown quantize mode"):
         LLMPredictor.from_checkpoint(ckpt, quantize="fp4")
+
+
+def test_int8_decode_logits_close_to_fp(fp_model):
+    """The DECODE path's int8 numerics (distinct from the forward-pass test
+    above: decode runs the cache_idx/KV-cache kernels the serving engine
+    uses): stepped int8 logits track stepped fp logits closely enough that
+    top-1 agreement stays high at every position."""
+    from fedml_tpu.train.llm.generation import decode_model
+
+    cfg, _model, params = fp_model
+    qcfg = dataclasses.replace(cfg, weight_quant="int8")
+    qparams = quantize_params_int8(params)
+    toks = jax.random.randint(jax.random.PRNGKey(2), (2, 10), 0, cfg.vocab_size)
+
+    def stepped_logits(model, p):
+        positions = jnp.broadcast_to(jnp.arange(4), (2, 4))
+        logits, state = model.apply(
+            {"params": p}, toks[:, :4], positions=positions, mutable=["cache"])
+        outs = [logits]
+        cache = state["cache"]
+        for t in range(4, 10):
+            pos = jnp.full((2, 1), t, jnp.int32)
+            step, state = model.apply(
+                {"params": p, "cache": cache}, toks[:, t:t + 1],
+                positions=pos, mutable=["cache"])
+            cache = state["cache"]
+            outs.append(step)
+        return jnp.concatenate(outs, axis=1)  # [2, 10, V]
+
+    fp = stepped_logits(decode_model(cfg), params)
+    q = stepped_logits(decode_model(qcfg), qparams)
+    agree = float((fp.argmax(-1) == q.argmax(-1)).mean())
+    assert agree > 0.9, agree
+    rel = float(jnp.linalg.norm(fp - q) / jnp.linalg.norm(fp))
+    assert rel < 0.1, rel
+
+
+def test_int8_generate_no_retrace(fp_model):
+    """The r05 regression class bench.py now guards with compile counters:
+    int8 decode retracing per call (or per step) is what turned 370k tok/s
+    into 985. After one warm call, repeated int8 generate calls — including
+    different runtime temperatures — must add ZERO compiles of the decode
+    scan or prefill."""
+    from fedml_tpu.core import telemetry as tel
+    from fedml_tpu.train.llm.generation import generate
+
+    cfg, _model, params = fp_model
+    qcfg = dataclasses.replace(cfg, weight_quant="int8")
+    qparams = quantize_params_int8(params)
+    prompt = jnp.asarray([[3, 5, 7, 11]], jnp.int32)
+    generate(qparams, qcfg, prompt, max_new_tokens=8)  # warm
+    d0 = tel.compile_count("decode_scan")
+    p0 = tel.compile_count("prefill")
+    for temp in (0.0, 0.0, 0.7):
+        generate(qparams, qcfg, prompt, max_new_tokens=8, temperature=temp)
+    # temperature>0 selects the SAMPLED decode executable (a static branch,
+    # one extra legitimate compile the first time it is ever used); the
+    # greedy repeats must be exactly zero new compiles
+    assert tel.compile_count("prefill") == p0
+    assert tel.compile_count("decode_scan") <= d0 + 1
+    d1 = tel.compile_count("decode_scan")
+    generate(qparams, qcfg, prompt, max_new_tokens=8, temperature=0.9)
+    assert tel.compile_count("decode_scan") == d1  # sampled path now warm too

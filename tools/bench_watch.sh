@@ -57,6 +57,7 @@ commit_artifacts() {
       log "artifact committed: $(git rev-parse --short HEAD)"
       surface_agg_rates
       surface_resilience
+      surface_serving
       surface_span_summary
       surface_trace_files
       surface_crash_dumps
@@ -105,6 +106,41 @@ if "resume_verified" in doc:
 PYEOF
 ) || return 0
   [ -n "$res" ] && log "$res"
+}
+
+surface_serving() {
+  # one-line view of the serving-perf keys in the newest artifact: the int8
+  # decode speedup (the r05 regression this round fixed), the continuous-
+  # batching load test's tokens/s + TTFT/TPOT tails, and slot occupancy —
+  # so the watcher log answers "is the endpoint keeping the chip busy"
+  # without opening BENCH_MEASURED_*.json
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1) || return 0
+  [ -n "$newest" ] || return 0
+  local serving
+  serving=$(python3 - "$newest" <<'PYEOF' 2>/dev/null
+import json, sys
+doc = json.load(open(sys.argv[1]))
+parts = []
+if doc.get("int8_decode_speedup") is not None:
+    parts.append(f"int8_decode_speedup {doc['int8_decode_speedup']}x")
+if doc.get("serving_load_tokens_per_sec") is not None:
+    parts.append(
+        f"serving_load {doc['serving_load_tokens_per_sec']} tok/s "
+        f"@{doc.get('serving_load_streams')} streams "
+        f"(ttft p50/p99 {doc.get('serving_load_ttft_p50_s')}/"
+        f"{doc.get('serving_load_ttft_p99_s')}s, "
+        f"tpot p50/p99 {doc.get('serving_load_tpot_p50_s')}/"
+        f"{doc.get('serving_load_tpot_p99_s')}s, "
+        f"occupancy peak {doc.get('serving_load_slot_occupancy_peak')} "
+        f"mean {doc.get('serving_load_slot_occupancy_mean')})")
+if doc.get("serving_load_vs_decode") is not None:
+    parts.append(f"vs raw decode {doc['serving_load_vs_decode']}x slower")
+if parts:
+    print("serving: " + "; ".join(parts))
+PYEOF
+) || return 0
+  [ -n "$serving" ] && log "$serving"
 }
 
 surface_span_summary() {
