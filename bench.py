@@ -232,14 +232,15 @@ def _build_llm(attention_impl: str, remat: bool):
 
 
 def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool = False,
-                   bs: int | None = None):
+                   bs: int | None = None, fsdp_shard: bool = False):
     import jax
     import jax.numpy as jnp
     import optax
 
     from fedml_tpu.parallel.fsdp import causal_lm_loss
 
-    _p(f"llm bench: building model (attention={attention_impl} remat={remat})")
+    _p(f"llm bench: building model (attention={attention_impl} remat={remat}"
+       f"{' fsdp_shard' if fsdp_shard else ''})")
     model, cfg, params = _build_llm(attention_impl, remat)
     s = _llm_shape()
     vocab, seq = s["vocab"], s["seq"]
@@ -247,19 +248,46 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
     n_params = sum(x.size for x in jax.tree.leaves(params))
     _p(f"llm bench: {n_params/1e6:.0f}M params initialized")
     tx = optax.adamw(1e-4)
-    opt_state = tx.init(params)
 
-    # donate params + opt state: the real training loop's aliasing. Without
-    # donation XLA double-buffers ~3.2GB of fp32 params + adam moments
-    # (in + out live simultaneously), which is exactly the headroom the
-    # bs=2x no-remat probe needs on a 16GB chip.
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p: causal_lm_loss(model.apply({"params": p}, tokens), tokens)
-        )(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+    if fsdp_shard:
+        # OOM-recovery step 1 (orchestrator respawn, r7): ZeRO-3 the train
+        # state over every local device via the GSPMD fsdp rules — the
+        # measured geometry is unchanged, only the layout. Mask is all-ones
+        # so the masked-mean loss equals the unmasked mean.
+        from jax.sharding import Mesh
+
+        from fedml_tpu.parallel.fsdp import make_fsdp_train_step
+
+        n_dev = jax.device_count()
+        mesh = Mesh(np.asarray(jax.devices()).reshape(n_dev), ("fsdp",))
+        compile_step, init_fn = make_fsdp_train_step(
+            lambda p, toks: model.apply({"params": p}, toks), tx, mesh,
+            batch_axes=("fsdp",) if bs % n_dev == 0 else ())
+        params, opt_state = init_fn(params)
+        _mask = jnp.ones((bs, seq), jnp.float32)
+        _fsdp_step = compile_step(params, opt_state)
+
+        def step(params, opt_state, tokens):
+            return _fsdp_step(params, opt_state, tokens, _mask)
+
+        def _lower(p, o, t):
+            return _fsdp_step.lower(p, o, t, _mask)
+    else:
+        opt_state = tx.init(params)
+
+        # donate params + opt state: the real training loop's aliasing.
+        # Without donation XLA double-buffers ~3.2GB of fp32 params + adam
+        # moments (in + out live simultaneously), which is exactly the
+        # headroom the bs=2x no-remat probe needs on a 16GB chip.
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(
+                lambda p: causal_lm_loss(model.apply({"params": p}, tokens), tokens)
+            )(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        _lower = step.lower
 
     def fresh_state():
         # donation consumes the buffers passed in, so every chain starts
@@ -276,7 +304,7 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
     batches = [jnp.asarray(rng.integers(0, vocab, (bs, seq)).astype(np.int32)) for _ in range(reps + 6)]
     _p(f"llm bench: {len(batches)} batches of ({bs},{seq}) on device; compiling step")
 
-    compiled = step.lower(params, opt_state, batches[0]).compile()
+    compiled = _lower(params, opt_state, batches[0]).compile()
     xla_flops = _cost_analysis_flops(compiled)
     _p("llm bench: compile done; warmup step")
     float(step(*fresh_state(), batches[reps + 5])[2])  # warmup (excluded)
@@ -308,7 +336,10 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
         )
 
     dev = jax.devices()[0]
-    peak = _chip_peak_tflops(dev, dtype_bits=16) * 1e12
+    # a GSPMD-sharded step spreads the same FLOPs over every device, so the
+    # MFU denominator is the MESH peak, not one chip's
+    mesh_devices = jax.device_count() if fsdp_shard else 1
+    peak = _chip_peak_tflops(dev, dtype_bits=16) * 1e12 * mesh_devices
     tokens_per_sec = tokens_per_step / dt_step
     mfu = _mfu_from_rate(tokens_per_sec, analytic_step_flops, tokens_per_step, peak)
     _check_mfu("llm", mfu)
@@ -316,6 +347,8 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
         "tokens_per_sec": tokens_per_sec,
         "mfu": mfu,
         "attention_impl": attention_impl,
+        "server_sharded": bool(fsdp_shard),
+        "mesh_devices": mesh_devices,
         # which lse/delta lane layout the pallas kernels ran ("narrow" =
         # (block_q,1), "wide" = 128-lane broadcast) — from the kernel's own
         # shape-gated decision, not the env var, so the artifact can't claim
@@ -1015,6 +1048,235 @@ def _bench_round_checkpoint(rounds: int = 4):
         return round(best_ms, 3), True
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_agg_sharded(rounds: int = 4):
+    """Mesh-parallel server round (core/aggregation/sharded.py) vs the
+    single-device engine on the SAME cohort: per-device HBM high-water for
+    accumulator + params + optimizer state, round throughput, and the
+    ingestion-overlap efficiency of the double-buffered per-shard stream.
+
+    Honesty contract: both engines consume identical (weight, tree) pairs
+    with identical per-round weights, and end-of-run parity of the global
+    params is an INTEGRITY GUARD (BenchIntegrityError), not a footnote. The
+    headline HBM ratio is the analytic layout model — accumulator + params
+    + optimizer state + one in-flight bucket + the finalized view, the
+    terms the engine actually holds across a round — because CPU devices
+    expose no memory_stats; where the platform reports peak_bytes_in_use
+    the measured per-device peaks ride along, and hbm_source names which
+    basis backed the ratio. Zero recompiles across rounds is enforced via
+    the engine's trace-time counters, and the overlap measurement forces
+    the serial reference by BLOCKING each bucket's per-shard transfer
+    before its accumulation dispatches — the exact latency the
+    double-buffered loop hides."""
+    import types
+
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.core import telemetry as tel
+    from fedml_tpu.core.aggregation.bucketed import BucketedAggregator
+    from fedml_tpu.core.aggregation.server_optimizer import FedOptServer
+    from fedml_tpu.core.aggregation.sharded import (
+        ShardedBucketedAggregator,
+        ShardedFedOptServer,
+    )
+    from fedml_tpu.core.distributed import mesh as dmesh
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    spec = os.environ.get(dmesh.SERVER_MESH_ENV) or "auto"
+    dmesh.configure_server_mesh(spec=spec)
+    mesh = dmesh.server_mesh()
+    if mesh is None:
+        # single-device host: the orchestrator respawns this stage once on
+        # the virtual 8-CPU mesh (layout/overlap/parity are platform-
+        # independent); this record is what triggers that respawn
+        return {"skipped": f"single-device {dev.platform} host — no server mesh",
+                "device": getattr(dev, "device_kind", str(dev))}
+
+    bucket = int(os.environ.get("FEDML_AGG_BUCKET", "8"))
+    k = 3 * bucket + 1  # ragged tail exercises the zero-weight pad path
+
+    from fedml_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    s = _llm_shape() if on_tpu else _TINY_LLM_SHAPE
+    geometry = "flagship" if s is _LLM_SHAPE else "tiny"
+    cfg = TransformerConfig(
+        vocab_size=s["vocab"], d_model=s["d_model"], n_layers=s["n_layers"],
+        n_heads=s["n_heads"], n_kv_heads=s["n_heads"], d_ff=s["d_ff"],
+        max_seq_len=s["seq"], remat=False, lora_rank=0, attention_impl="xla")
+    client_dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    # one dtype end to end (bf16 on TPU — the flagship broadcast dtype; f32
+    # on CPU so the parity guard can pin a tight tolerance)
+    params = jax.tree.map(lambda x: x.astype(client_dtype), params)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    param_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
+    _p(f"agg_sharded bench: {n_params / 1e6:.1f}M params ({geometry}), "
+       f"{k} clients, bucket {bucket}")
+
+    # one bucket of DISTINCT client trees (deterministic per-client
+    # perturbation; setup cost, untimed) — larger cohorts cycle it with
+    # fresh weights, the engine's production buffer pressure
+    clients = tuple(
+        jax.jit(lambda t, i=i: jax.tree.map(
+            lambda x: (x.astype(jnp.float32) + (i + 1) * 1e-4).astype(client_dtype), t))(params)
+        for i in range(bucket)
+    )
+    client_bytes = sum(x.nbytes for x in jax.tree.leaves(clients[0]))
+    rng = np.random.default_rng(11)
+    round_w = [np.abs(rng.standard_normal(k)).astype(np.float32) + 0.1
+               for _ in range(rounds)]
+
+    def pairs_for(r, pool):
+        return [(float(round_w[r][i]), pool[i % bucket]) for i in range(k)]
+
+    args_ns = types.SimpleNamespace(server_optimizer="adam", server_lr=0.05)
+
+    # --- unsharded reference: whole accumulator + FedOpt state on device 0
+    _p("agg_sharded bench: unsharded reference rounds")
+    eng_u = BucketedAggregator(bucket)
+    srv_u = FedOptServer(args_ns, params)
+    g_u = params
+    g_u = srv_u.apply(g_u, eng_u.aggregate(pairs_for(0, clients)))  # warmup round
+    jax.block_until_ready(g_u)
+    t0 = time.perf_counter()
+    for r in range(1, rounds):
+        g_u = srv_u.apply(g_u, eng_u.aggregate(pairs_for(r, clients)))
+    jax.block_until_ready(g_u)
+    unshard_rate = k * (rounds - 1) / (time.perf_counter() - t0)
+    opt_bytes = sum(int(l.nbytes) for l in jax.tree.leaves(srv_u.state)
+                    if hasattr(l, "nbytes"))
+    # what the unsharded round actually holds on ONE device: f32 accumulator
+    # + global params + finalized average + optimizer state + one bucket
+    unsharded_peak = (4 * n_params + 2 * param_bytes + opt_bytes
+                      + bucket * client_bytes)
+
+    # --- sharded engine: same pairs, same weights, fused round step
+    _p(f"agg_sharded bench: sharded rounds over "
+       f"{int(np.prod(list(mesh.shape.values())))} devices")
+    eng_s = ShardedBucketedAggregator(bucket, mesh)
+    srv_s = ShardedFedOptServer(args_ns, params, eng_s)
+    layout = eng_s.layout_for(params)
+    g_s = eng_s.aggregate_round(pairs_for(0, clients), srv_s)  # warmup round
+    jax.block_until_ready(g_s)
+    warm_traces = eng_s.sharded_traces
+    t0 = time.perf_counter()
+    for r in range(1, rounds):
+        g_s = eng_s.aggregate_round(pairs_for(r, clients), srv_s)
+    jax.block_until_ready(g_s)
+    shard_rate = k * (rounds - 1) / (time.perf_counter() - t0)
+    if eng_s.sharded_traces != warm_traces or srv_s.round_traces != 1:
+        raise BenchIntegrityError(
+            f"sharded round step recompiled across rounds (accum traces "
+            f"{warm_traces} -> {eng_s.sharded_traces}, round traces "
+            f"{srv_s.round_traces}); refusing to publish")
+
+    # parity: the final global params after IDENTICAL rounds must agree (the
+    # flat-group contraction reorders the reduction, nothing else)
+    host_u = jax.tree.map(np.asarray, g_u)
+    host_s = srv_s.materialize_broadcast()
+    max_rel = 0.0
+    for a, b in zip(jax.tree.leaves(host_u), jax.tree.leaves(host_s)):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        # per-leaf max-abs error normalized by the LEAF scale: an
+        # elementwise-relative metric divides by near-zero entries (adam
+        # keeps many) and reports noise as divergence
+        rel = float(np.max(np.abs(a - b))) / (float(np.max(np.abs(a))) + 1e-12)
+        max_rel = max(max_rel, rel)
+    tol = 5e-2 if client_dtype == jnp.bfloat16 else 1e-3
+    if max_rel > tol:
+        raise BenchIntegrityError(
+            f"sharded-vs-unsharded parity failed: max rel err {max_rel:.3e} "
+            f"> {tol:g}; refusing to publish")
+
+    # per-device high-water, analytic: the booked accumulator + fedopt
+    # params/opt-state shards + one in-flight bucket + the finalized view
+    booked = dmesh.shard_bytes_by_device()
+    sharded_per_dev = (max(booked.values())
+                       + bucket * layout.shard_bytes(np.dtype(client_dtype))
+                       + layout.shard_bytes())
+    ratio = sharded_per_dev / unsharded_peak
+    if ratio > 0.60:
+        raise BenchIntegrityError(
+            f"sharded per-device peak {sharded_per_dev / 1e6:.1f}MB is "
+            f"{ratio:.0%} of the unsharded single-device peak "
+            f"{unsharded_peak / 1e6:.1f}MB (> 60% acceptance bound); "
+            "refusing to publish")
+    measured = {}
+    for d in jax.local_devices():
+        try:
+            ms = d.memory_stats()
+        except Exception:  # noqa: BLE001 - CPU/tunnel devices expose none
+            ms = None
+        if ms and ms.get("peak_bytes_in_use"):
+            measured[str(d)] = int(ms["peak_bytes_in_use"])
+    hbm_source = "analytic+memory_stats" if measured else "analytic"
+
+    # --- ingestion-overlap efficiency: host deltas exercise the per-shard
+    # device_put stream; serial reference BLOCKS each transfer before its
+    # accumulation dispatches, overlapped is the engine's own loop
+    _p("agg_sharded bench: ingestion-overlap measurement")
+    host_clients = [jax.tree.map(np.asarray, c) for c in clients]
+    host_pairs = pairs_for(0, host_clients)
+    jax.block_until_ready(eng_s.aggregate(host_pairs))  # warm finalize path
+    t0 = time.perf_counter()
+    jax.block_until_ready(eng_s.aggregate(host_pairs))
+    dt_overlap = time.perf_counter() - t0
+    buckets = []
+    for start in range(0, k, bucket):
+        chunk = host_pairs[start:start + bucket]
+        trees = [t for _, t in chunk]
+        w = np.asarray([wgt for wgt, _ in chunk], np.float32)
+        if len(trees) < bucket:
+            pad = bucket - len(trees)
+            trees = trees + [trees[-1]] * pad
+            w = np.concatenate([w, np.zeros((pad,), np.float32)])
+        buckets.append((trees, w))
+    t0 = time.perf_counter()
+    acc = None
+    for bk in buckets:
+        cur = eng_s._ingest_bucket(bk, layout)
+        jax.block_until_ready(cur[0])  # serialize: transfer lands first
+        acc = eng_s._saccum_first(*cur) if acc is None else eng_s._saccum(acc, *cur)
+        jax.block_until_ready(acc)
+    jax.block_until_ready(eng_s._finalize_sharded_fn(layout)(acc))
+    dt_serial = time.perf_counter() - t0
+    overlap_eff = dt_serial / dt_overlap
+
+    span_summary = {
+        name: {"count": v["count"], "total_ms": round(v["total_ms"], 1),
+               "max_ms": round(v["max_ms"], 2)}
+        for name, v in tel.snapshot()["span_stats"].items()
+        if name.startswith("agg.")
+    }
+    return {
+        "agg_sharded_mesh": dmesh.mesh_topology(mesh),
+        "agg_sharded_bucket_size": bucket,
+        "agg_sharded_cohort": k,
+        "agg_sharded_rounds": rounds,
+        "agg_sharded_clients_per_sec": round(shard_rate, 1),
+        "agg_unsharded_clients_per_sec": round(unshard_rate, 1),
+        "agg_sharded_per_device_bytes": int(sharded_per_dev),
+        "agg_unsharded_peak_bytes": int(unsharded_peak),
+        "agg_sharded_hbm_ratio": round(ratio, 4),
+        "hbm_source": hbm_source,
+        "per_device_peak_measured": measured or None,
+        "agg_sharded_overlap_efficiency": round(overlap_eff, 3),
+        "agg_sharded_traces": eng_s.sharded_traces,
+        "agg_round_traces": srv_s.round_traces,
+        "agg_sharded_parity_max_rel_err": float(f"{max_rel:.3e}"),
+        "agg_sharded_pytree": {
+            "n_params": int(n_params),
+            "client_dtype": str(np.dtype(client_dtype)),
+            "geometry": geometry,
+        },
+        "agg_sharded_span_summary": span_summary,
+        "device": getattr(dev, "device_kind", str(dev)),
+    }
 
 
 def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: int = 3):
@@ -1839,6 +2101,19 @@ def _stage_result(name: str) -> dict:
         # recovery MUST be a fresh subprocess at smaller batch
         xla_bs = os.environ.get("FEDML_LLM_XLA_BS")
         xla_kw = {"bs": int(xla_bs)} if xla_bs else {}
+        if os.environ.get("FEDML_LLM_XLA_SHARDED") == "1":
+            # orchestrator OOM-respawn step 1: shard params/grads/opt state
+            # over every local device BEFORE any geometry degradation. On a
+            # single-device host sharding cannot change the memory picture;
+            # fail fast with a marker the orchestrator can distinguish from
+            # a second OOM so it moves straight to the half-batch respawn.
+            import jax
+
+            if jax.device_count() < 2:
+                raise RuntimeError(
+                    "SHARDED_UNAVAILABLE: 1 device — the fsdp-sharded train "
+                    "state needs a multi-device mesh")
+            xla_kw["fsdp_shard"] = True
         out = _retry_transient(_bench_llm_tpu, reps=6, attention_impl="xla",
                                remat=True, **xla_kw)
         out["remat"] = True
@@ -1863,6 +2138,8 @@ def _stage_result(name: str) -> dict:
         out = _retry_transient(_bench_attn_micro)
     elif name == "agg":
         out = _retry_transient(_bench_agg)
+    elif name == "agg_sharded":
+        out = _retry_transient(_bench_agg_sharded)
     elif name == "llm_pallas_tuned":
         # re-run the pallas headline under the block config attn_micro just
         # recorded (the orchestrator exports FEDML_FLASH_BLOCK_Q/K into this
@@ -1909,6 +2186,11 @@ _STAGES: list[tuple[str, int]] = [
     # cohort sizes on the ResNet-56 and LLM pytrees (single-compile proof
     # rides along via agg_accum_traces)
     ("agg", 600),
+    # mesh-parallel server round vs the single-device engine on the same
+    # cohort: per-device HBM ratio (<=60% integrity guard), parity, and
+    # ingestion-overlap efficiency; single-chip windows respawn it on the
+    # virtual 8-CPU mesh (orchestrator, below)
+    ("agg_sharded", 600),
     # attention-kernel block sweep: records the fastest config to
     # .bench_runtime/flash_blocks (6 small compiles + marginal timings) ...
     ("attn_micro", 600),
@@ -2248,29 +2530,74 @@ def main() -> None:
                     "skipped": "headline already ran this config (or is not "
                                "a no-remat pallas flagship run)"}
                 continue
-        if stage_name == "memplan":
-            # the stage's plan math runs on a virtual 8-device CPU mesh
-            # alongside the real chip (metadata only, nothing executes there)
+        if stage_name in ("memplan", "agg_sharded"):
+            # memplan's plan math — and agg_sharded's server mesh — run on a
+            # virtual 8-device CPU mesh alongside the real chip (for memplan
+            # it is metadata only; agg_sharded actually computes there when
+            # the default platform is multi-device CPU)
             env = env or dict(os.environ)
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                                 " --xla_force_host_platform_device_count=8").strip()
         result, err = _spawn_stage(stage_name, budget, env=env)
+        if (err is None and stage_name == "agg_sharded"
+                and isinstance(result, dict)
+                and "single-device" in str(result.get("skipped", ""))):
+            # single-chip accelerator window: the sharded engine cannot lay
+            # out over one device, but the layout/overlap/parity measurement
+            # is platform-independent — respawn once on the virtual 8-CPU
+            # mesh; the artifact's device field keeps the substitution
+            # visible (throughput there is a CPU number, never compared
+            # against chip stages)
+            retry_env = dict(env)
+            retry_env["JAX_PLATFORMS"] = "cpu"
+            retry_env.pop("PALLAS_AXON_POOL_IPS", None)
+            print("note: agg_sharded found a single-device chip; respawning "
+                  "on the virtual 8-CPU mesh", file=sys.stderr)
+            result2, err2 = _spawn_stage(stage_name, budget, env=retry_env)
+            if err2 is None:
+                result = dict(result2)
+                result["agg_sharded_platform"] = "cpu_virtual_8dev"
+            else:
+                print(f"warning: {err2}", file=sys.stderr)
         if (err is not None and stage_name == "llm_xla"
                 and ("RESOURCE_EXHAUSTED" in err or "ResourceExhausted" in err)):
-            # r5: llm_xla died RESOURCE_EXHAUSTED even with remat on — the
-            # chip can't fit the einsum path at the headline batch, and the
-            # dead attempt's buffers starve every in-process retry, so the
-            # recovery must be a FRESH subprocess at half batch. One respawn
-            # only; the shrunken geometry ships honestly in the artifact
-            # via degraded_bs (and the shape guard on no_remat_oom keeps
-            # the full-geometry OOM note from being asserted by this run).
-            small = max(1, int(_llm_shape()["bs"]) // 2)
-            retry_env = dict(env if env is not None else os.environ)
-            retry_env["FEDML_LLM_XLA_BS"] = str(small)
+            # r5 -> r7: llm_xla died RESOURCE_EXHAUSTED even with remat on —
+            # the chip can't fit the einsum path at the headline batch, and
+            # the dead attempt's buffers starve every in-process retry, so
+            # every recovery is a FRESH subprocess. Recovery 1 (r7): shard
+            # the train state over every local device (ZeRO-3 layout — the
+            # measured geometry is unchanged, so it gets first claim on the
+            # respawn). Recovery 2 (the r5 path, now the fallback): half
+            # batch — the shrunken geometry ships honestly via degraded_bs
+            # (and the shape guard on no_remat_oom keeps the full-geometry
+            # OOM note from being asserted by a degraded run).
             print(f"warning: {err}", file=sys.stderr)
-            print(f"note: llm_xla OOMed at headline bs; respawning once at "
-                  f"bs={small}", file=sys.stderr)
+            retry_env = dict(env if env is not None else os.environ)
+            retry_env["FEDML_LLM_XLA_SHARDED"] = "1"
+            print("note: llm_xla OOMed at headline bs; respawning once with "
+                  "the fsdp-sharded train state", file=sys.stderr)
             result, err = _spawn_stage(stage_name, budget, env=retry_env)
+            sharded_ran = not (err is not None and "SHARDED_UNAVAILABLE" in err)
+            if err is None:
+                result = dict(result)
+                result["sharded_attempted"] = True
+            elif ("RESOURCE_EXHAUSTED" in err or "ResourceExhausted" in err
+                    or not sharded_ran):
+                small = max(1, int(_llm_shape()["bs"]) // 2)
+                retry_env2 = dict(env if env is not None else os.environ)
+                if sharded_ran:
+                    # sharding ran but the chip still OOMed: keep it for the
+                    # half-batch attempt (strictly more headroom)
+                    retry_env2["FEDML_LLM_XLA_SHARDED"] = "1"
+                retry_env2["FEDML_LLM_XLA_BS"] = str(small)
+                print(f"warning: {err}", file=sys.stderr)
+                print(f"note: sharded respawn did not recover; respawning "
+                      f"once at bs={small}", file=sys.stderr)
+                result, err = _spawn_stage(stage_name, budget, env=retry_env2)
+                if err is None:
+                    result = dict(result)
+                    result["sharded_attempted"] = (True if sharded_ran
+                                                   else "unavailable")
         if err is not None:
             print(f"warning: {err}", file=sys.stderr)
             failed.append(err)
@@ -2380,6 +2707,13 @@ def main() -> None:
             # the OOM-respawn path shrank the geometry — a reader comparing
             # xla vs pallas tokens/s must see the batch mismatch up front
             out["llm_xla_degraded_bs"] = llm_xla["degraded_bs"]
+        if llm_xla.get("sharded_attempted") is not None:
+            # the r7 recovery ladder ran: True = the fsdp-sharded respawn
+            # executed (and produced this measurement unless degraded_bs is
+            # also set); "unavailable" = single device, sharding impossible
+            out["llm_xla_sharded_attempted"] = llm_xla["sharded_attempted"]
+        if llm_xla.get("server_sharded"):
+            out["llm_xla_mesh_devices"] = llm_xla.get("mesh_devices")
     if resnet is not None:
         out["resnet56_steps_per_sec"] = round(resnet["steps_per_sec"], 2)
         out["resnet56_mfu"] = round(resnet["mfu"], 4)
@@ -2462,6 +2796,22 @@ def main() -> None:
         if agg.get("ckpt_enqueue_ms") is not None:
             out["ckpt_enqueue_ms"] = agg["ckpt_enqueue_ms"]
             out["resume_verified"] = agg["resume_verified"]
+
+    agg_sharded = stage_out.get("agg_sharded")
+    if agg_sharded is not None and "skipped" not in agg_sharded:
+        # mesh-parallel server round headline trio (tools/bench_watch.sh
+        # surfaces these): per-device HBM ratio vs the unsharded engine on
+        # the same cohort (<=60% integrity-guarded in-stage), throughput,
+        # and how much of the per-shard transfer hid under compute
+        out["agg_sharded_hbm_ratio"] = agg_sharded["agg_sharded_hbm_ratio"]
+        out["agg_sharded_clients_per_sec"] = agg_sharded["agg_sharded_clients_per_sec"]
+        out["agg_sharded_overlap_efficiency"] = agg_sharded[
+            "agg_sharded_overlap_efficiency"]
+        out["agg_sharded_traces"] = agg_sharded["agg_sharded_traces"]
+        if agg_sharded.get("agg_sharded_platform"):
+            out["agg_sharded_platform"] = agg_sharded["agg_sharded_platform"]
+    elif agg_sharded is not None:
+        out["agg_sharded_skipped"] = agg_sharded["skipped"]
 
     attn = stage_out.get("attn_micro")
     if attn is not None:

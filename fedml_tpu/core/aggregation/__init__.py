@@ -11,8 +11,9 @@ from .bucketed import (
     BucketedAggregator,
     bucketed_weighted_average,
     get_engine,
+    reset_engines,
 )
-from .server_optimizer import FedOptServer, create_server_optimizer
+from .server_optimizer import FedOptServer, create_fedopt_server, create_server_optimizer
 
 __all__ = [
     "FedMLAggOperator",
@@ -24,7 +25,9 @@ __all__ = [
     "BucketedAggregator",
     "bucketed_weighted_average",
     "get_engine",
+    "reset_engines",
     "DEFAULT_BUCKET_SIZE",
     "FedOptServer",
+    "create_fedopt_server",
     "create_server_optimizer",
 ]

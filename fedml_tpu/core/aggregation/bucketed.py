@@ -206,22 +206,69 @@ class BucketedAggregator:
 
 
 # --- engine registry --------------------------------------------------------
-_ENGINES: Dict[int, BucketedAggregator] = {}
+# Keyed on the FULL engine config — (bucket_size, server-mesh spec) — not just
+# the bucket size: a mesh configured (or torn down) after an engine was handed
+# out must yield a DIFFERENT engine, or stale jit caches keep the old layout.
+# Per-template dtype-group state (finalize/unflatten caches) lives on the
+# engine itself keyed by (treedef, shapes, dtypes), so template drift is
+# handled there; config drift is handled here. Bounded LRU: an engine pins
+# its jit caches forever, so unbounded growth is a leak.
+from collections import OrderedDict
+
+_ENGINES: "OrderedDict[Tuple[int, Any], BucketedAggregator]" = OrderedDict()
 _ENGINES_LOCK = threading.Lock()
+_MAX_ENGINES = 8
+
+
+def _engine_key(bucket_size: int) -> Tuple[int, Any]:
+    from ..distributed import mesh as dmesh
+
+    return (int(bucket_size), dmesh.configured_spec())
 
 
 def get_engine(bucket_size: int | None = None) -> BucketedAggregator:
-    """Process-wide engine per bucket size (the jit caches live on it).
+    """Process-wide engine per (bucket size, server-mesh spec).
 
-    Default bucket size is 16, overridable via ``FEDML_AGG_BUCKET``.
+    Default bucket size is 16, overridable via ``FEDML_AGG_BUCKET``. When a
+    server mesh is configured (``args.server_mesh`` via
+    ``distributed.mesh.configure_server_mesh`` or ``FEDML_SERVER_MESH``) AND
+    it resolves to >1 device, the engine is the mesh-sharded
+    ``ShardedBucketedAggregator``; otherwise — including a configured spec on
+    a 1-device host — the single-device engine, so the sp CPU tier-1 path is
+    untouched by mesh config.
     """
     if bucket_size is None:
         bucket_size = int(os.environ.get("FEDML_AGG_BUCKET", DEFAULT_BUCKET_SIZE))
+    key = _engine_key(bucket_size)
     with _ENGINES_LOCK:
-        eng = _ENGINES.get(bucket_size)
-        if eng is None:
-            eng = _ENGINES[bucket_size] = BucketedAggregator(bucket_size)
+        eng = _ENGINES.get(key)
+        if eng is not None:
+            _ENGINES.move_to_end(key)
+            return eng
+    # build outside the lock: sharded construction touches jax.devices()
+    mesh = None
+    if key[1] is not None:
+        from ..distributed import mesh as dmesh
+
+        mesh = dmesh.server_mesh(key[1])
+    if mesh is not None:
+        from .sharded import ShardedBucketedAggregator
+
+        eng = ShardedBucketedAggregator(bucket_size, mesh)
+    else:
+        eng = BucketedAggregator(bucket_size)
+    with _ENGINES_LOCK:
+        eng = _ENGINES.setdefault(key, eng)  # lost race: keep the winner
+        _ENGINES.move_to_end(key)
+        while len(_ENGINES) > _MAX_ENGINES:
+            _ENGINES.popitem(last=False)
         return eng
+
+
+def reset_engines() -> None:
+    """Test hook: drop every cached engine (and its jit/layout caches)."""
+    with _ENGINES_LOCK:
+        _ENGINES.clear()
 
 
 def bucketed_weighted_average(pairs: Sequence[Tuple[float, PyTree]], bucket_size: int | None = None) -> PyTree:

@@ -56,3 +56,26 @@ class FedOptServer:
     def apply(self, w_global: PyTree, w_avg: PyTree) -> PyTree:
         new_params, self.state = self._step(w_global, w_avg, self.state)
         return new_params
+
+
+def create_fedopt_server(args: Any, params_template: PyTree):
+    """FedOpt server state holder, sharded over the server mesh when one is
+    configured (``args.server_mesh`` / ``FEDML_SERVER_MESH`` resolving to >1
+    device): params + optimizer state then live as sharded flat group
+    vectors and the round step runs fused on the mesh
+    (``core/aggregation/sharded.py``). Single-device hosts — the sp CPU
+    tier-1 path — get the plain :class:`FedOptServer`, byte-identical to
+    before."""
+    from ..distributed import mesh as dmesh
+    from .bucketed import get_engine
+
+    dmesh.configure_server_mesh(args)
+    if dmesh.server_mesh() is not None:
+        engine = get_engine()
+        # get_engine returns the sharded engine iff the mesh resolved; the
+        # isinstance guard covers a config race between the two calls
+        from .sharded import ShardedBucketedAggregator, ShardedFedOptServer
+
+        if isinstance(engine, ShardedBucketedAggregator):
+            return ShardedFedOptServer(args, params_template, engine)
+    return FedOptServer(args, params_template)

@@ -255,6 +255,19 @@ class FlightRecorder:
             except Exception:  # noqa: BLE001
                 pass
             lines.append({"type": "trace", "context": ctx})
+            # mesh topology (axis names/sizes, device kinds) of every mesh
+            # the process registered — an OOM dump without the sharding
+            # layout is undebuggable on multi-chip
+            try:
+                from ..distributed import mesh as _dmesh
+
+                topos = _dmesh.current_topologies()
+            except Exception:  # noqa: BLE001 - diagnostics must not throw
+                topos = {}
+            if topos:
+                lines.append({"type": "mesh", "meshes": topos,
+                              "configured_spec": _dmesh.configured_spec(),
+                              "shard_bytes_by_device": _dmesh.shard_bytes_by_device()})
             lines.append({"type": "env", "env": redact_env()})
             for t_ns, kind, name, fields, tid in evs:
                 rec = {"type": "event", "t_ns": t_ns, "kind": kind, "name": name,

@@ -170,6 +170,17 @@ def render(telemetry: Optional[Telemetry] = None,
         )
 
     # --- caller gauges ---------------------------------------------------
+    # sharding gauges (fedml_server_shard_bytes{device=}, per-device HBM
+    # high-water) ride along whenever a server mesh has been registered, so
+    # every /metrics surface shows them without per-process wiring
+    try:
+        from ..distributed import mesh as _dmesh
+
+        mesh_gauges = _dmesh.prom_gauges()
+    except Exception:  # noqa: BLE001 - metrics must render without the mesh
+        mesh_gauges = []
+    if mesh_gauges:
+        gauges = list(gauges) + mesh_gauges if gauges else mesh_gauges
     if gauges:
         seen_fams = set()
         for name, labels, value in gauges:

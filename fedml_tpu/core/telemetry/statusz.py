@@ -91,6 +91,16 @@ def render(service: Optional[str] = None,
             doc["sections"]["resilience"] = res
     except Exception as e:  # noqa: BLE001 - status page must not throw
         doc["sections"]["resilience"] = {"error": repr(e)}
+    # the sharding section (server-mesh topology, per-device shard bytes) is
+    # likewise always-on: any process that built a server mesh shows it
+    try:
+        from ..distributed import mesh as _dmesh
+
+        shard = _dmesh.statusz_snapshot()
+        if shard:
+            doc["sections"]["sharding"] = shard
+    except Exception as e:  # noqa: BLE001 - status page must not throw
+        doc["sections"]["sharding"] = {"error": repr(e)}
     with _sections_lock:
         providers = dict(_sections)
     for name, provider in sorted(providers.items()):

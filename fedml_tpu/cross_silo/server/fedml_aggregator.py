@@ -68,7 +68,43 @@ class FedMLAggregator:
         self.flag_client_model_uploaded_dict = {i: False for i in range(client_num)}
         # fleet view: per-rank telemetry deltas shipped on model upload
         self.fleet = FleetTelemetry()
+        # server mesh (args.server_mesh / FEDML_SERVER_MESH): when it resolves
+        # to >1 device the engine is mesh-sharded and client deltas stream in
+        # per-shard at ARRIVAL time (see add_local_trained_result)
+        self._sharded_engine = None
+        from ...core.distributed import mesh as dmesh
+
+        dmesh.configure_server_mesh(args)
+        if dmesh.server_mesh() is not None:
+            from ...core.aggregation.bucketed import get_engine
+            from ...core.aggregation.sharded import ShardedBucketedAggregator
+
+            eng = get_engine()
+            if isinstance(eng, ShardedBucketedAggregator):
+                self._sharded_engine = eng
         Context().add(Context.KEY_TEST_DATA, test_global)
+
+    def _sharded_ingest_engine(self):
+        """The sharded engine iff eager per-shard ingestion is safe: the agg
+        rule is a plain sample-weighted average and no attack/defense/DP hook
+        wants to inspect raw client trees before aggregation."""
+        if self._sharded_engine is None:
+            return None
+        from ...core.aggregation.agg_operator import SAMPLE_WEIGHTED
+        from ...core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+        from ...core.security.fedml_attacker import FedMLAttacker
+        from ...core.security.fedml_defender import FedMLDefender
+
+        fed_opt = getattr(self.args, "federated_optimizer", "FedAvg")
+        if fed_opt not in SAMPLE_WEIGHTED:
+            return None
+        if getattr(self.args, "contribution_alg", None):
+            return None  # Shapley/LOO valuation reads raw client trees
+        if (FedMLAttacker.get_instance().is_model_attack()
+                or FedMLDefender.get_instance().is_defense_enabled()
+                or FedMLDifferentialPrivacy.get_instance().is_dp_enabled()):
+            return None
+        return self._sharded_engine
 
     def get_global_model_params(self):
         return self.aggregator.get_model_params()
@@ -79,10 +115,21 @@ class FedMLAggregator:
     def add_local_trained_result(self, index: int, model_params, sample_num) -> None:
         log.info("add_model. index = %d", index)
         if _float_array_leaves_only(model_params):
-            # upload at the comm boundary with ONE flat-vector transfer per
-            # dtype group (not one per leaf), so the bucketed aggregator
-            # consumes device-resident trees instead of re-uploading per leaf
-            model_params = tree_from_numpy(model_params)
+            engine = self._sharded_ingest_engine()
+            if engine is not None:
+                # per-shard ingestion stream: the flat dtype-group vectors are
+                # sliced host-side and device_put against the mesh sharding —
+                # an async dispatch, so the PCIe transfer of THIS delta
+                # overlaps whatever the mesh is doing (and round aggregation
+                # later consumes already-resident shards)
+                with tel.span("server.ingest_sharded", index=index):
+                    model_params = engine.ingest(model_params)
+            else:
+                # upload at the comm boundary with ONE flat-vector transfer
+                # per dtype group (not one per leaf), so the bucketed
+                # aggregator consumes device-resident trees instead of
+                # re-uploading per leaf
+                model_params = tree_from_numpy(model_params)
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = sample_num
         self.flag_client_model_uploaded_dict[index] = True

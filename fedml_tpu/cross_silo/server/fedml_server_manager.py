@@ -78,6 +78,21 @@ class FedMLServerManager(FedMLCommManager):
 
     def run(self) -> None:
         mlops.log_aggregation_status("INITIALIZING", str(getattr(self.args, "run_id", "0")))
+        # resolve the server mesh up front (args.server_mesh / env): the
+        # aggregator/engine pick it up via the configured spec, the topology
+        # lands in /statusz + crash dumps, and a spec that cannot resolve
+        # (1 device) logs its fallback HERE instead of mid-round
+        from ...core.distributed import mesh as dmesh
+
+        spec = dmesh.configure_server_mesh(self.args)
+        if spec or dmesh.configured_spec():
+            mesh = dmesh.server_mesh()
+            if mesh is not None:
+                log.info("server mesh: %s", dmesh.mesh_topology(mesh))
+            else:
+                log.info("server mesh spec %r resolved to a single device; "
+                         "keeping the unsharded aggregation path",
+                         dmesh.configured_spec())
         # the whole receive loop runs under the flight recorder: an exception
         # in any handler produces one crash dump with the open round span
         with flight_recorder.installed(role="cross_silo_server"):

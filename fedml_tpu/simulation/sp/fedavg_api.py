@@ -69,10 +69,16 @@ class FedAvgAPI:
         self.client_list: List[Client] = []
         self._setup_clients(train_data_local_num_dict, train_data_local_dict, test_data_local_dict)
 
-        # server-side algorithm state
+        # server-side algorithm state. create_fedopt_server returns the
+        # mesh-sharded holder when args.server_mesh/FEDML_SERVER_MESH
+        # resolves to >1 device (params + optimizer state live sharded and
+        # the step runs fused on the mesh); on one device it is the plain
+        # FedOptServer — identical to before.
         self._fedopt_server: Optional[FedOptServer] = None
         if self.fed_opt == FEDML_FEDERATED_OPTIMIZER_FEDOPT:
-            self._fedopt_server = FedOptServer(args, self.model_trainer.get_model_params())
+            from ...core.aggregation.server_optimizer import create_fedopt_server
+
+            self._fedopt_server = create_fedopt_server(args, self.model_trainer.get_model_params())
         self._scaffold_c = tree_zeros_like(self.model_trainer.get_model_params())
         self._feddyn_h = tree_zeros_like(self.model_trainer.get_model_params())
         self._mime_s = tree_zeros_like(self.model_trainer.get_model_params())

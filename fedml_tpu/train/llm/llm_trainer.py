@@ -94,6 +94,18 @@ class LLMTrainer:
         axes, names = exp_args.mesh_shape()
         self.mesh = create_mesh(axes, names, devices)
         log.info("LLM mesh: %s", dict(zip(names, axes)))
+        # register the topology (crash dumps / statusz); an explicit
+        # exp_args.server_mesh (or "auto" = the training mesh's device set)
+        # turns on the sharded SERVER path so federated adapter deltas
+        # aggregate sharded over the same chips instead of on one
+        from ...core.distributed import mesh as dmesh
+
+        dmesh.note_mesh("llm_trainer", self.mesh)
+        server_spec = getattr(exp_args, "server_mesh", None)
+        if server_spec:
+            if str(server_spec) == "auto" and self.mesh.devices.size > 1:
+                server_spec = f"fsdp:{int(self.mesh.devices.size)}"
+            dmesh.configure_server_mesh(spec=str(server_spec))
 
         schedule = optax.warmup_cosine_decay_schedule(
             0.0, exp_args.learning_rate, exp_args.warmup_steps, max(exp_args.max_steps, exp_args.warmup_steps + 1)

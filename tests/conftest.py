@@ -63,6 +63,13 @@ def _reset_singletons():
     FedMLDifferentialPrivacy._instance = None
     FedMLFHE._instance = None
     Context._instance = None
+    # server-mesh config + engine registry are process-wide too: a test that
+    # configures a mesh must not leak sharded engines into the next test
+    from fedml_tpu.core.aggregation.bucketed import reset_engines
+    from fedml_tpu.core.distributed.mesh import reset_mesh_state
+
+    reset_engines()
+    reset_mesh_state()
 
 
 def spawn_to_logs(cmds, tmp_path, env=None, timeout=600, names=None):

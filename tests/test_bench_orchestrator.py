@@ -198,6 +198,12 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
                                               "geometry": "flagship"}},
                  "agg_accum_traces": 4,
                  "device": "TPU v5 lite"}, None),
+        "agg_sharded": ({"agg_sharded_hbm_ratio": 0.125,
+                         "agg_sharded_clients_per_sec": 12.0,
+                         "agg_sharded_overlap_efficiency": 1.4,
+                         "agg_sharded_traces": 2,
+                         "agg_round_traces": 1,
+                         "device": "TPU v5 lite"}, None),
     })
     with pytest.raises(SystemExit) as exc:
         bench.main()
@@ -218,6 +224,10 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
     assert out["agg_hbm_gbps"]["llm268m"]["8"] == 40.0
     assert out["agg_bucket_size"] == 16
     assert out["agg_accum_traces"] == 4
+    assert out["agg_sharded_hbm_ratio"] == 0.125
+    assert out["agg_sharded_clients_per_sec"] == 12.0
+    assert out["agg_sharded_overlap_efficiency"] == 1.4
+    assert out["agg_sharded_traces"] == 2
     assert out["stages_failed"] == []
     # incremental artifacts landed (one per stage + final, same stamp file)
     arts = glob.glob(str(tmp_path / "BENCH_MEASURED_*.json"))
@@ -798,19 +808,61 @@ def test_attn_micro_rejection_merge(monkeypatch, tmp_path, capsys, _restore_sign
     assert out["attn_fwd_bwd_ms"] == {"xla_einsum": 8.0}
 
 
-def test_llm_xla_oom_respawns_once_at_half_bs(monkeypatch, tmp_path, capsys,
+def _patch_orchestrator(monkeypatch, tmp_path, fake_spawn):
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "_acquire_bench_lock", lambda *a, **k: object())
+    monkeypatch.setattr(bench, "_spawn_stage", fake_spawn)
+
+
+def test_llm_xla_oom_sharded_respawn_recovers(monkeypatch, tmp_path, capsys,
                                               _restore_signals):
-    """ISSUE 6 satellite (r05 stages_failed): an llm_xla RESOURCE_EXHAUSTED
-    death triggers exactly one respawn in a FRESH subprocess at half batch
-    (FEDML_LLM_XLA_BS in the child env), and the shrunken geometry is
-    surfaced in the merged JSON rather than silently passing as the
-    headline shape."""
+    """ISSUE 7: the llm_xla OOM ladder tries the fsdp-sharded train state
+    FIRST (FEDML_LLM_XLA_SHARDED=1 in a fresh subprocess, full geometry);
+    when that fits, there is no half-batch respawn and the headline
+    geometry ships undegraded with sharded_attempted=True."""
     xla_envs = []
 
     def fake_spawn(name, budget_s, argv=None, env=None):
         if name == "llm_xla":
             xla_envs.append(env)
             if len(xla_envs) == 1:
+                return None, "llm_xla: rc=1 RESOURCE_EXHAUSTED: out of memory"
+            return ({"tokens_per_sec": 22000.0, "mfu": 0.18, "remat": True,
+                     "attention_impl": "xla", "n_params": 268000000,
+                     "shape": _LLM_OK[0]["shape"],
+                     "device": "TPU v5 lite", "step_flops": 1e12,
+                     "server_sharded": True, "mesh_devices": 8}, None)
+        return {"llm_pallas": _LLM_OK}.get(name, (None, f"{name}: canned failure"))
+
+    _patch_orchestrator(monkeypatch, tmp_path, fake_spawn)
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0
+    assert len(xla_envs) == 2  # one OOM, ONE sharded respawn — it fit
+    assert xla_envs[1] is not None
+    assert xla_envs[1]["FEDML_LLM_XLA_SHARDED"] == "1"
+    assert "FEDML_LLM_XLA_BS" not in xla_envs[1]  # geometry NOT degraded
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["tokens_per_sec_xla_attention"] == 22000.0
+    assert out["llm_xla_sharded_attempted"] is True
+    assert out["llm_xla_mesh_devices"] == 8
+    assert "llm_xla_degraded_bs" not in out
+    assert not any("llm_xla" in f for f in out.get("stages_failed", []))
+
+
+def test_llm_xla_oom_half_bs_is_the_fallback_after_sharded(
+        monkeypatch, tmp_path, capsys, _restore_signals):
+    """When the sharded respawn ALSO OOMs, the r5 half-batch respawn runs
+    as the fallback (keeping the sharded state for its extra headroom),
+    and the shrunken geometry is surfaced via degraded_bs rather than
+    silently passing as the headline shape."""
+    xla_envs = []
+
+    def fake_spawn(name, budget_s, argv=None, env=None):
+        if name == "llm_xla":
+            xla_envs.append(env)
+            if len(xla_envs) <= 2:
                 return None, "llm_xla: rc=1 RESOURCE_EXHAUSTED: out of memory"
             return ({"tokens_per_sec": 15000.0, "mfu": 0.12, "remat": True,
                      "attention_impl": "xla", "n_params": 268000000,
@@ -819,22 +871,94 @@ def test_llm_xla_oom_respawns_once_at_half_bs(monkeypatch, tmp_path, capsys,
                      "degraded_bs": 4}, None)
         return {"llm_pallas": _LLM_OK}.get(name, (None, f"{name}: canned failure"))
 
-    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: None)
-    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
-    monkeypatch.setattr(bench, "_acquire_bench_lock", lambda *a, **k: object())
-    monkeypatch.setattr(bench, "_spawn_stage", fake_spawn)
+    _patch_orchestrator(monkeypatch, tmp_path, fake_spawn)
     with pytest.raises(SystemExit) as exc:
         bench.main()
     assert exc.value.code == 0
-    assert len(xla_envs) == 2  # one OOM, ONE respawn — not a retry loop
+    assert len(xla_envs) == 3  # OOM -> sharded OOM -> half-bs, no loop
     half = str(max(1, bench._llm_shape()["bs"] // 2))
-    assert xla_envs[1] is not None
-    assert xla_envs[1]["FEDML_LLM_XLA_BS"] == half
+    assert xla_envs[1]["FEDML_LLM_XLA_SHARDED"] == "1"
+    assert "FEDML_LLM_XLA_BS" not in xla_envs[1]
+    assert xla_envs[2]["FEDML_LLM_XLA_BS"] == half
+    assert xla_envs[2]["FEDML_LLM_XLA_SHARDED"] == "1"  # kept: more headroom
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["tokens_per_sec_xla_attention"] == 15000.0
     assert out["llm_xla_degraded_bs"] == 4
+    assert out["llm_xla_sharded_attempted"] is True
     # the recovered stage is a success: no llm_xla entry in stages_failed
     assert not any("llm_xla" in f for f in out.get("stages_failed", []))
+
+
+def test_llm_xla_oom_single_device_skips_sharding_honestly(
+        monkeypatch, tmp_path, capsys, _restore_signals):
+    """On a single-device host the sharded respawn reports
+    SHARDED_UNAVAILABLE without measuring; the half-bs fallback then runs
+    WITHOUT the sharded env and the artifact records
+    sharded_attempted="unavailable" — a degraded single-chip number must
+    never claim a sharded attempt backed it."""
+    xla_envs = []
+
+    def fake_spawn(name, budget_s, argv=None, env=None):
+        if name == "llm_xla":
+            xla_envs.append(env)
+            if len(xla_envs) == 1:
+                return None, "llm_xla: rc=1 RESOURCE_EXHAUSTED: out of memory"
+            if len(xla_envs) == 2:
+                return None, ("llm_xla: rc=1 SHARDED_UNAVAILABLE: 1 device — "
+                              "the fsdp-sharded train state needs a "
+                              "multi-device mesh")
+            return ({"tokens_per_sec": 15000.0, "mfu": 0.12, "remat": True,
+                     "attention_impl": "xla", "n_params": 268000000,
+                     "shape": dict(_LLM_OK[0]["shape"], bs=4),
+                     "device": "TPU v5 lite", "step_flops": 1e12,
+                     "degraded_bs": 4}, None)
+        return {"llm_pallas": _LLM_OK}.get(name, (None, f"{name}: canned failure"))
+
+    _patch_orchestrator(monkeypatch, tmp_path, fake_spawn)
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0
+    assert len(xla_envs) == 3
+    assert "FEDML_LLM_XLA_SHARDED" not in xla_envs[2]  # sharding can't run
+    assert xla_envs[2]["FEDML_LLM_XLA_BS"] == str(
+        max(1, bench._llm_shape()["bs"] // 2))
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["llm_xla_sharded_attempted"] == "unavailable"
+    assert out["llm_xla_degraded_bs"] == 4
+
+
+def test_agg_sharded_single_device_respawns_on_virtual_cpu_mesh(
+        monkeypatch, tmp_path, capsys, _restore_signals):
+    """A single-chip window cannot lay the sharded engine out; the
+    orchestrator respawns the stage once on the virtual 8-CPU mesh and
+    labels the substitution (agg_sharded_platform) so its throughput is
+    never read as a chip number."""
+    agg_envs = []
+
+    def fake_spawn(name, budget_s, argv=None, env=None):
+        if name == "agg_sharded":
+            agg_envs.append(env)
+            if len(agg_envs) == 1:
+                return {"skipped": "single-device tpu host — no server mesh",
+                        "device": "TPU v5 lite"}, None
+            return ({"agg_sharded_hbm_ratio": 0.125,
+                     "agg_sharded_clients_per_sec": 5.0,
+                     "agg_sharded_overlap_efficiency": 0.9,
+                     "agg_sharded_traces": 2, "agg_round_traces": 1,
+                     "device": "cpu"}, None)
+        return {"llm_pallas": _LLM_OK}.get(name, (None, f"{name}: canned failure"))
+
+    _patch_orchestrator(monkeypatch, tmp_path, fake_spawn)
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0
+    assert len(agg_envs) == 2
+    assert agg_envs[1]["JAX_PLATFORMS"] == "cpu"
+    assert "xla_force_host_platform_device_count=8" in agg_envs[1]["XLA_FLAGS"]
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["agg_sharded_hbm_ratio"] == 0.125
+    assert out["agg_sharded_platform"] == "cpu_virtual_8dev"
+    assert "agg_sharded_skipped" not in out
 
 
 def test_llm_xla_non_oom_failure_does_not_respawn(monkeypatch, tmp_path,

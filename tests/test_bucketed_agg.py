@@ -88,6 +88,28 @@ class TestNumericalEquivalence:
             ref = _reference_avg(pairs)
             np.testing.assert_allclose(np.asarray(out["w"]), ref["w"], rtol=2e-5)
 
+    def test_aggregate_stacked_nonuniform_weights_matches_reference(self):
+        """Strongly skewed weights (4 orders of magnitude apart, plus an
+        exact zero) through the stacked tensordot path vs the f64 ground
+        truth — the contraction must not lose the small contributors."""
+        eng = BucketedAggregator(bucket_size=8)
+        rng = np.random.default_rng(13)
+        k = 19  # ragged tail: two full buckets + 3
+        trees = [_client_tree(rng) for _ in range(k)]
+        w = np.asarray([10.0 ** (i % 5 - 2) for i in range(k)], np.float64)
+        w[4] = 0.0  # a zero-weight client must contribute exactly nothing
+        wn = (w / w.sum()).astype(np.float32)
+        stacked = tree_stack(trees)
+        out = eng.aggregate_stacked(stacked, jnp.asarray(wn))
+        ref = _reference_avg(list(zip(w, trees)))
+        for name in ref:
+            np.testing.assert_allclose(
+                np.asarray(out[name]), ref[name], rtol=5e-5, atol=1e-6)
+        # the zeroed client really is absent: perturbing it changes nothing
+        trees[4] = jax.tree.map(lambda x: x + 100.0, trees[4])
+        out2 = eng.aggregate_stacked(tree_stack(trees), jnp.asarray(wn))
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(out2["w"]))
+
     def test_object_leaf_fold_uses_leaf_algebra(self):
         class Cipher:
             """FHE-ciphertext stand-in: only + and scalar * are defined."""
@@ -105,6 +127,39 @@ class TestNumericalEquivalence:
         out = weighted_average(pairs)
         assert isinstance(out["c"], Cipher)
         np.testing.assert_allclose(out["c"].v, 0.25 * 2.0 + 0.75 * 6.0)
+
+    def test_object_leaf_mixture_folds_both_kinds(self):
+        """A tree MIXING object leaves with array leaves (the FHE-partial
+        case: some layers encrypted, some plain) must fold the objects via
+        their algebra and the arrays numerically, in one pass."""
+        class Cipher:
+            def __init__(self, v):
+                self.v = v
+
+            def __add__(self, other):
+                return Cipher(self.v + other.v)
+
+            def __mul__(self, s):
+                return Cipher(self.v * s)
+
+        rng = np.random.default_rng(21)
+        pairs = [
+            (float(i + 1), {
+                "enc": Cipher(float(i) * 2.0),
+                "plain": jnp.asarray(rng.normal(size=(3,)).astype(np.float32)),
+            })
+            for i in range(5)
+        ]
+        out = weighted_average(pairs)
+        ws = np.asarray([w for w, _ in pairs], np.float64)
+        ws = ws / ws.sum()
+        assert isinstance(out["enc"], Cipher)
+        np.testing.assert_allclose(
+            out["enc"].v, sum(w * float(i) * 2.0 for i, w in enumerate(ws)),
+            rtol=1e-6)
+        ref = sum(w * np.asarray(t["plain"], np.float64)
+                  for w, (_, t) in zip(ws, pairs))
+        np.testing.assert_allclose(np.asarray(out["plain"]), ref, rtol=2e-5)
 
 
 class TestCompileReuse:

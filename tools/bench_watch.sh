@@ -22,11 +22,11 @@ LOCK="$REPO/.bench_runtime/bench.lock"
 
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-90}
 SMOKE_TIMEOUT=${SMOKE_TIMEOUT:-1200}  # may run BOTH stats layouts (narrow+wide)
-# must exceed the sum of bench.py's per-stage budgets (_STAGES: 10380s with
-# attn_micro, the tuned re-run and the agg microbench; banked CPU baselines
-# usually shave 600s) plus the 180s probe, or the outer timeout kills a run
-# whose stages are all within their own contracts
-BENCH_TIMEOUT=${BENCH_TIMEOUT:-11100}
+# must exceed the sum of bench.py's per-stage budgets (_STAGES: 12180s with
+# attn_micro, the tuned re-run and the agg + agg_sharded microbenches; banked
+# CPU baselines usually shave 600s) plus the 180s probe, or the outer timeout
+# kills a run whose stages are all within their own contracts
+BENCH_TIMEOUT=${BENCH_TIMEOUT:-12900}
 SLEEP_DOWN=${SLEEP_DOWN:-120}     # tunnel down: re-probe every 2 min (short
                                   # up-windows are the norm; 10 min missed them)
 SLEEP_UP=${SLEEP_UP:-3600}        # after a good measurement: hourly is plenty
@@ -56,6 +56,7 @@ commit_artifacts() {
     elif git commit -q -m "Record measured bench artifact from live chip" -- "${paths[@]}" 2>/tmp/bench_watch_commit.err; then
       log "artifact committed: $(git rev-parse --short HEAD)"
       surface_agg_rates
+      surface_agg_sharded
       surface_resilience
       surface_serving
       surface_span_summary
@@ -86,6 +87,32 @@ if agg:
 PYEOF
 ) || return 0
   [ -n "$rates" ] && log "$rates"
+}
+
+surface_agg_sharded() {
+  # one-line view of the mesh-parallel server round in the newest artifact:
+  # per-device HBM ratio vs the unsharded engine (<=0.60 guarded in-stage),
+  # throughput, ingestion-overlap efficiency, and the zero-recompile trace
+  # count — so the watcher log answers "did sharding actually shrink the
+  # server's per-chip footprint" without opening BENCH_MEASURED_*.json
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1) || return 0
+  [ -n "$newest" ] || return 0
+  local sharded
+  sharded=$(python3 - "$newest" <<'PYEOF' 2>/dev/null
+import json, sys
+doc = json.load(open(sys.argv[1]))
+if doc.get("agg_sharded_hbm_ratio") is not None:
+    extra = f" [{doc['agg_sharded_platform']}]" if doc.get("agg_sharded_platform") else ""
+    print(f"agg_sharded: hbm_ratio {doc['agg_sharded_hbm_ratio']}, "
+          f"{doc.get('agg_sharded_clients_per_sec')} clients/s, "
+          f"overlap_eff {doc.get('agg_sharded_overlap_efficiency')}, "
+          f"traces {doc.get('agg_sharded_traces')}{extra}")
+elif doc.get("agg_sharded_skipped"):
+    print(f"agg_sharded: skipped ({doc['agg_sharded_skipped']})")
+PYEOF
+) || return 0
+  [ -n "$sharded" ] && log "$sharded"
 }
 
 surface_resilience() {

@@ -83,6 +83,19 @@ def render(doc: Dict[str, Any], out=sys.stdout) -> None:
     if trace:
         w(f"\n--- trace: id={trace.get('trace_id')} round={trace.get('round')}\n")
 
+    mesh = doc.get("mesh")
+    if mesh:
+        w(f"\n--- mesh topology (spec: {mesh.get('configured_spec')}):\n")
+        for name, topo in sorted(mesh.get("meshes", {}).items()):
+            axes = "x".join(
+                f"{a}:{s}" for a, s in zip(topo.get("axis_names", []),
+                                           topo.get("axis_sizes", [])))
+            w(f"  {name}: [{axes}] {topo.get('n_devices')}x"
+              f"{','.join(topo.get('device_kinds', []))}\n")
+        shard = mesh.get("shard_bytes_by_device", {})
+        if shard:
+            w(f"  shard bytes/device: {min(shard.values())}..{max(shard.values())}\n")
+
     spans = doc.get("span_stack", {}).get("spans", [])
     if spans:
         w("\n--- failing span stack (outermost first):\n")
