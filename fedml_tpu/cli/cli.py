@@ -189,7 +189,7 @@ def model_deploy_cmd(name: str, predictor_spec: str, model_path: str, replicas: 
             return
         click.echo("serving; Ctrl-C to undeploy")
         while True:  # pragma: no cover - interactive serve loop
-            _time.sleep(1)  # sleep ok: interactive serve idle loop, not a retry
+            _time.sleep(1)  # fedlint: disable=bare-sleep interactive serve idle loop, not a retry
     except KeyboardInterrupt:  # pragma: no cover
         pass
     finally:

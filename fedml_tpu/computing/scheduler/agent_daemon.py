@@ -58,7 +58,7 @@ def main(argv=None) -> None:
             os.execv(sys.executable, [sys.executable, "-m",
                                       "fedml_tpu.computing.scheduler.agent_daemon",
                                       *(argv if argv is not None else sys.argv[1:])])
-        time.sleep(0.2)  # sleep ok: daemon supervision poll cadence, not a retry
+        time.sleep(0.2)  # fedlint: disable=bare-sleep daemon supervision poll cadence, not a retry
 
 
 if __name__ == "__main__":

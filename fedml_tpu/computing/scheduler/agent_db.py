@@ -101,7 +101,7 @@ class AgentDatabase:
                 " returncode=excluded.returncode, log_path=excluded.log_path,"
                 " detail=excluded.detail, updated_at=excluded.updated_at",
                 (d["run_id"], d["edge_id"], d["status"], d["returncode"],
-                 d["log_path"], d["detail"], time.time()),  # wall-clock ok: db timestamp
+                 d["log_path"], d["detail"], time.time()),  # fedlint: disable=wall-clock db timestamp
             )
             self._conn.commit()
 
@@ -177,7 +177,7 @@ class AgentDatabase:
                 " updated_at=excluded.updated_at",
                 (edge_id, cores, memory_mb, accelerator_kind, slots_total,
                  slots_available if slots_available is not None else slots_total,
-                 time.time()),  # wall-clock ok: db timestamp
+                 time.time()),  # fedlint: disable=wall-clock db timestamp
             )
             self._conn.commit()
 
@@ -205,7 +205,7 @@ class AgentDatabase:
                 " accelerator_kind, slots_total, slots_available, updated_at)"
                 " VALUES (?,?,?,?,?,?,?)",
                 (edge_id, cores, memory_mb, accelerator_kind, slots_total,
-                 slots_available, time.time()),  # wall-clock ok: db timestamp
+                 slots_available, time.time()),  # fedlint: disable=wall-clock db timestamp
             )
             self._conn.commit()
 
@@ -222,7 +222,7 @@ class AgentDatabase:
                     cur = self._conn.execute(
                         "UPDATE capacity SET slots_available=slots_available-?,"
                         " updated_at=? WHERE edge_id=? AND slots_available>=?",
-                        (n, time.time(), eid, n),  # wall-clock ok: db timestamp
+                        (n, time.time(), eid, n),  # fedlint: disable=wall-clock db timestamp
                     )
                     if cur.rowcount != 1:
                         self._conn.rollback()
@@ -248,7 +248,7 @@ class AgentDatabase:
                         "UPDATE capacity SET"
                         " slots_available=MIN(slots_total, slots_available+?),"
                         " updated_at=? WHERE edge_id=?",
-                        (n, time.time(), eid),  # wall-clock ok: db timestamp
+                        (n, time.time(), eid),  # fedlint: disable=wall-clock db timestamp
                     )
                 self._conn.commit()
             except Exception:
@@ -259,7 +259,7 @@ class AgentDatabase:
         with self._lock:
             self._conn.execute(
                 "UPDATE capacity SET slots_available=?, updated_at=? WHERE edge_id=?",
-                (slots_available, time.time(), edge_id),  # wall-clock ok: db timestamp
+                (slots_available, time.time(), edge_id),  # fedlint: disable=wall-clock db timestamp
             )
             self._conn.commit()
 

@@ -186,9 +186,9 @@ class FedMLServerRunner:
             )
             t.start()
             threads.append(t)
-        deadline = time.time() + timeout_s  # wall-clock ok: join deadline
+        deadline = time.time() + timeout_s  # fedlint: disable=wall-clock join deadline
         for t in threads:
-            t.join(timeout=max(0.0, deadline - time.time()))  # wall-clock ok: join deadline
+            t.join(timeout=max(0.0, deadline - time.time()))  # fedlint: disable=wall-clock join deadline
         # edges still working at the deadline get a RUNNING placeholder so the
         # returned dict always has one entry per dispatched edge (setdefault:
         # a worker thread finishing concurrently must win over the placeholder)

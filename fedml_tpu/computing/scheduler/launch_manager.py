@@ -142,7 +142,7 @@ class FedMLLaunchManager:
             if done:
                 self.cluster.release({e: pending.pop(e) for e in done})
             if pending:
-                time.sleep(poll_s)  # sleep ok: job-status poll pacing, not a retry
+                time.sleep(poll_s)  # fedlint: disable=bare-sleep job-status poll pacing, not a retry
 
 
 def launch_job_over_mqtt(

@@ -170,7 +170,7 @@ class ShardedBucketedAggregator(BucketedAggregator):
         for name, g in layout.groups.items():
             vec = np.zeros((g.padded,), g.dtype)  # zero pad -> pads never pollute acc
             for i, off, size in zip(g.leaf_idx, g.offsets, g.sizes):
-                vec[off:off + size] = np.ravel(np.asarray(leaves[i]))
+                vec[off:off + size] = np.ravel(np.asarray(leaves[i]))  # fedlint: disable=host-sync host-slicing ingest IS the host path: one copy per delta leaf, feeding per-shard device_put
             out[name] = vec
         return out
 
@@ -286,7 +286,7 @@ class ShardedBucketedAggregator(BucketedAggregator):
         leaf. The full model assembles on the host, never on a chip."""
         leaves: List[Any] = [None] * len(layout.shapes)
         for name, g in layout.groups.items():
-            host = np.asarray(groups[name])
+            host = np.asarray(groups[name])  # fedlint: disable=host-sync THE sanctioned broadcast gather: once per dtype group, byte-booked below
             tel.record_transfer("device_to_host", host.nbytes)
             for i, off, size in zip(g.leaf_idx, g.offsets, g.sizes):
                 leaves[i] = host[off:off + size].reshape(layout.shapes[i])

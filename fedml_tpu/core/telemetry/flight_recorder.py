@@ -219,7 +219,7 @@ class FlightRecorder:
                 "type": "meta",
                 "schema": DUMP_SCHEMA_VERSION,
                 "reason": reason,
-                "time_unix": time.time(),  # wall-clock ok: record timestamp, not a duration
+                "time_unix": time.time(),  # fedlint: disable=wall-clock record timestamp, not a duration
                 "pid": os.getpid(),
                 "role": self.role,
                 "python": sys.version.split()[0],

@@ -72,7 +72,7 @@ def render(service: Optional[str] = None,
         "service": service,
         "pid": os.getpid(),
         "uptime_s": round(time.monotonic() - _SERVICE_START_MONO, 3),
-        "time_unix": time.time(),  # wall-clock ok: page timestamp, not a duration
+        "time_unix": time.time(),  # fedlint: disable=wall-clock page timestamp, not a duration
         "telemetry": {
             "enabled": tel.enabled,
             "dropped": dict(tel.dropped_kinds()),

@@ -153,10 +153,10 @@ class ServerEdgeWAN:
         final = None
         for round_idx in range(rounds):
             self._publish_round(round_idx, MSG_INIT if round_idx == 0 else MSG_SYNC)
-            deadline = time.time() + timeout_s  # wall-clock ok: wait deadline
+            deadline = time.time() + timeout_s  # fedlint: disable=wall-clock wait deadline
             with self._cv:
                 while len(self._uploads.get(round_idx, {})) < len(self.edge_ids):
-                    remaining = deadline - time.time()  # wall-clock ok: wait deadline
+                    remaining = deadline - time.time()  # fedlint: disable=wall-clock wait deadline
                     if remaining <= 0:
                         raise TimeoutError(
                             f"round {round_idx}: only {len(self._uploads.get(round_idx, {}))}"

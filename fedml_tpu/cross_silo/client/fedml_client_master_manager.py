@@ -186,7 +186,7 @@ class ClientMasterManager(FedMLCommManager):
         chaos_raise_at = getattr(self.args, "chaos_raise_at_round", None)
         with tel.span("client.train", round=int(self.args.round_idx)):
             if chaos_delay > 0:
-                time.sleep(chaos_delay)  # sleep ok: chaos injection delay, not a retry loop
+                time.sleep(chaos_delay)  # fedlint: disable=bare-sleep chaos injection delay, not a retry loop
             if chaos_raise_at is not None and int(chaos_raise_at) == int(self.args.round_idx):
                 raise RuntimeError(
                     f"chaos: injected failure at round {self.args.round_idx} "

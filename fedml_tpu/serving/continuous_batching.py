@@ -421,7 +421,7 @@ class ContinuousBatchingEngine:
                         jax.random.PRNGKey(item.seed),
                         jnp.float32(item.temperature),
                     )
-                    tok0 = int(np.asarray(tok0))  # forces admit completion
+                    tok0 = int(np.asarray(tok0))  # fedlint: disable=host-sync forces admit completion: one sync per admission, not per decode step
             except Exception as e:  # noqa: BLE001 - a bad prompt (or a
                 # prefill compile failure) fails ITS caller, not the pool;
                 # the popped item would otherwise hang its future forever
@@ -434,7 +434,7 @@ class ContinuousBatchingEngine:
             self._tok[free] = tok0
             self._lengths[free] = P
             self._temps[free] = item.temperature
-            self._keys[free] = np.asarray(key2, np.uint32)
+            self._keys[free] = np.asarray(key2, np.uint32)  # fedlint: disable=host-sync PRNG row refresh once per admission; key already host-resident post-admit
             ttft = now - item.t_submit
             active.pending.handle.ttft_s = ttft
             self._recent_ttft.append(ttft)

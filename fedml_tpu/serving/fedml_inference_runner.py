@@ -88,9 +88,9 @@ class _MicroBatcher:
                 self._drain_on_stop()
                 return
             batch = [first]
-            deadline = time.time() + self.window_s  # wall-clock ok: window deadline
+            deadline = time.time() + self.window_s  # fedlint: disable=wall-clock window deadline
             while len(batch) < self.max_batch:
-                remaining = deadline - time.time()  # wall-clock ok: window deadline
+                remaining = deadline - time.time()  # fedlint: disable=wall-clock window deadline
                 if remaining <= 0:
                     break
                 try:

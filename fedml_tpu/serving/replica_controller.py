@@ -74,7 +74,7 @@ class SubprocessReplica:
                     pass
             if self.proc.poll() is not None:
                 raise RuntimeError(f"replica {self.id} died during startup (rc={self.proc.returncode})")
-            time.sleep(0.05)  # sleep ok: subprocess startup poll, not a retry
+            time.sleep(0.05)  # fedlint: disable=bare-sleep subprocess startup poll, not a retry
         self.proc.kill()
         raise TimeoutError(f"replica {self.id} did not report a port within {timeout_s}s")
 

@@ -1,0 +1,659 @@
+"""fedlint: the unified static-analysis framework (ISSUE 8).
+
+Three layers under test:
+
+* **engine** — suppression pragmas (line / file / reason-mandatory),
+  fingerprint stability under line drift, baseline matching + staleness,
+  syntax-error reporting, the rule registry;
+* **rules** — every rule family gets a true-positive fixture, a clean
+  fixture, and a suppressed fixture (acceptance criterion for the four
+  JAX-aware rules: retrace-risk, host-sync, donation-misuse,
+  lock-discipline);
+* **gates** — the repo itself is clean (`python -m tools.fedlint` exits 0
+  with zero unsuppressed findings), the five check_*.py shims keep their
+  historical tuple/exit-code contracts, and no legacy `# sleep ok` /
+  `# wall-clock ok` markers remain in the package (they were migrated to
+  the unified pragma syntax; the rules still *honor* them only for the
+  shims' synthetic-tree contracts).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import unittest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.fedlint import api, baseline as baseline_mod, cli  # noqa: E402
+from tools.fedlint.core import Finding, run as engine_run  # noqa: E402
+from tools.fedlint.registry import all_rules, get_rules  # noqa: E402
+
+
+def _scan(tmp_path, files, rule_ids, options=None, baseline_entries=()):
+    """Write ``files`` (relpath -> source) under ``tmp_path`` and run
+    ``rule_ids`` over the tree. Options default to empty (NOT repo config)
+    so fixtures control e.g. hot-modules explicitly."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    rules = get_rules(rule_ids, options=options or {})
+    return engine_run(str(tmp_path), ["."], rules,
+                      baseline_entries=baseline_entries)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestEngine(unittest.TestCase):
+    """Suppressions, fingerprints, baseline, registry."""
+
+    def test_line_pragma_suppresses_with_reason(self):
+        import pathlib
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            res = _scan(pathlib.Path(d), {
+                "m.py": "import time\n"
+                        "t = time.time()  # fedlint: disable=wall-clock epoch timestamp for a record field\n",
+            }, ["wall-clock"])
+            self.assertEqual([f.rule for f in res.findings], [])
+            self.assertEqual(len(res.suppressed), 1)
+            self.assertEqual(res.suppressed[0].rule, "wall-clock")
+
+    def test_reasonless_pragma_is_itself_a_finding(self):
+        import pathlib
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            res = _scan(pathlib.Path(d), {
+                "m.py": "import time\n"
+                        "t = time.time()  # fedlint: disable=wall-clock\n",
+            }, ["wall-clock"])
+            # the wall-clock finding is suppressed, but the mute button
+            # itself is reported: suppressions are reviewed artifacts
+            self.assertEqual([f.rule for f in res.findings],
+                             ["bare-suppression"])
+            self.assertEqual(res.exit_code(), 1)
+
+    def test_file_pragma_and_multi_rule_pragma(self):
+        import pathlib
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            res = _scan(pathlib.Path(d), {
+                "m.py": "# fedlint: disable-file=wall-clock fixture module, timestamps throughout\n"
+                        "import time\n"
+                        "a = time.time()\n"
+                        "time.sleep(1)  # fedlint: disable=bare-sleep,wall-clock chaos pacing fixture\n",
+            }, ["wall-clock", "bare-sleep"])
+            self.assertEqual(res.findings, [])
+            self.assertEqual(len(res.suppressed), 2)
+
+    def test_pragma_inside_docstring_does_not_count(self):
+        import pathlib
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            res = _scan(pathlib.Path(d), {
+                "m.py": '"""Docs show the syntax: # fedlint: disable=wall-clock"""\n'
+                        "import time\n"
+                        "t = time.time()\n",
+            }, ["wall-clock"])
+            # neither a bare-suppression finding (it is not a comment) nor
+            # a suppression of the real finding below it
+            self.assertEqual([f.rule for f in res.findings], ["wall-clock"])
+
+    def test_fingerprint_survives_line_drift(self):
+        a = Finding(rule="r", severity="error", path="/x/m.py",
+                    relpath="m.py", line=10, col=0, message="m",
+                    line_text="  t = time.time()\n")
+        b = Finding(rule="r", severity="error", path="/x/m.py",
+                    relpath="m.py", line=99, col=4, message="m",
+                    line_text="t = time.time()")
+        self.assertEqual(a.fingerprint, b.fingerprint)
+
+    def test_baseline_matches_and_reports_stale(self):
+        import pathlib
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            probe = _scan(pathlib.Path(d), {
+                "m.py": "import time\nt = time.time()\n",
+            }, ["wall-clock"])
+            f = probe.findings[0]
+            entries = [
+                {"rule": f.rule, "path": f.relpath,
+                 "fingerprint": f.fingerprint, "reason": "grandfathered"},
+                {"rule": "wall-clock", "path": "gone.py",
+                 "fingerprint": "0" * 16, "reason": "fixed since"},
+            ]
+            res = _scan(pathlib.Path(d), {}, ["wall-clock"],
+                        baseline_entries=entries)
+            self.assertEqual(res.findings, [])
+            self.assertEqual(len(res.baselined), 1)
+            self.assertEqual(len(res.stale_baseline), 1)
+            self.assertEqual(res.stale_baseline[0]["path"], "gone.py")
+            self.assertEqual(res.exit_code(), 0)
+
+    def test_baseline_entries_require_reasons(self):
+        import tempfile
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump({"version": 1, "entries": [
+                {"rule": "wall-clock", "path": "m.py",
+                 "fingerprint": "a" * 16}]}, f)
+            path = f.name
+        try:
+            with self.assertRaises(baseline_mod.BaselineError):
+                baseline_mod.load(path)
+        finally:
+            os.unlink(path)
+
+    def test_syntax_error_is_reported_not_fatal(self):
+        import pathlib
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            res = _scan(pathlib.Path(d), {
+                "bad.py": "def broken(:\n",
+                "ok.py": "import time\nt = time.time()\n",
+            }, ["wall-clock"])
+            rules = sorted(f.rule for f in res.findings)
+            self.assertEqual(rules, ["syntax-error", "wall-clock"])
+
+    def test_registry_has_all_families_and_rejects_unknown(self):
+        ids = {r.id for r in all_rules()}
+        self.assertTrue({
+            "wall-clock", "reserved-key", "recorder-kind", "excepthook",
+            "bare-sleep", "orbax", "hot-span", "sharding-containment",
+            "device-get", "retrace-risk", "host-sync", "donation-misuse",
+            "lock-discipline"} <= ids)
+        with self.assertRaises(KeyError):
+            get_rules(["no-such-rule"])
+
+
+class _RuleCase(unittest.TestCase):
+    """Helper: run one rule family over fixtures in a temp tree."""
+
+    rule_ids: tuple = ()
+    options: dict = {}
+
+    def check(self, files, **kw):
+        import pathlib
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            return _scan(pathlib.Path(d), files, list(self.rule_ids),
+                         options=dict(self.options), **kw)
+
+    def assert_fires(self, files, rule=None, count=None):
+        res = self.check(files)
+        rules = [f.rule for f in res.findings]
+        self.assertTrue(rules, f"expected findings, got none")
+        if rule:
+            self.assertIn(rule, rules)
+        if count is not None:
+            self.assertEqual(len(rules), count, rules)
+        return res
+
+    def assert_clean(self, files):
+        res = self.check(files)
+        self.assertEqual(
+            [f.render() for f in res.findings], [],
+            "expected a clean run")
+        return res
+
+    def assert_suppressed(self, files):
+        res = self.check(files)
+        self.assertEqual([f.render() for f in res.findings], [])
+        self.assertTrue(res.suppressed, "expected a suppressed finding")
+        return res
+
+
+class TestPortedRules(_RuleCase):
+    """The five check_*.py walkers as rules: one bad/good pair each."""
+
+    rule_ids = ("wall-clock", "reserved-key", "recorder-kind", "excepthook",
+                "bare-sleep", "orbax")
+
+    def test_wall_clock(self):
+        self.assert_fires({"m.py": "import time\nt = time.time()\n"},
+                          rule="wall-clock")
+        self.assert_clean({"m.py": "import time\nt = time.perf_counter()\n"})
+        # legacy marker still honored (shim contract)
+        self.assert_clean(
+            {"m.py": "import time\nt = time.time()  # wall-clock ok: epoch\n"})
+
+    def test_reserved_key_containment(self):
+        needle = "__" + "telemetry" + "__"
+        bad = f"KEY = '{needle}'\n"
+        self.assert_fires({"pkg/comm.py": bad}, rule="reserved-key")
+        # the one home for the literal
+        self.assert_clean({"core/telemetry/trace_context.py": bad})
+
+    def test_recorder_kind_containment(self):
+        self.assert_fires({"pkg/worker.py": "k = 'span_open'\n"},
+                          rule="recorder-kind")
+        self.assert_clean(
+            {"core/telemetry/flight_recorder.py": "k = 'span_open'\n"})
+
+    def test_excepthook_containment(self):
+        self.assert_fires(
+            {"pkg/boot.py": "import sys\nsys.excepthook = print\n"},
+            rule="excepthook")
+        self.assert_clean(
+            {"core/telemetry/flight_recorder.py":
+             "import sys\nsys.excepthook = print\n"})
+
+    def test_bare_sleep_and_retry_home(self):
+        self.assert_fires({"pkg/poll.py": "import time\ntime.sleep(1)\n"},
+                          rule="bare-sleep")
+        self.assert_clean(
+            {"core/resilience/retry.py": "import time\ntime.sleep(1)\n"})
+        self.assert_suppressed(
+            {"pkg/poll.py": "import time\n"
+             "time.sleep(1)  # fedlint: disable=bare-sleep chaos pacing\n"})
+
+    def test_orbax_containment(self):
+        self.assert_fires(
+            {"pkg/saver.py": "import orbax.checkpoint as ocp\n"},
+            rule="orbax")
+        self.assert_clean(
+            {"utils/checkpoint.py": "import orbax.checkpoint as ocp\n"})
+
+
+class TestRetraceRisk(_RuleCase):
+    rule_ids = ("retrace-risk",)
+
+    def test_traced_branch_in_jit_wrapped_fn(self):
+        res = self.assert_fires({"m.py": (
+            "import jax\n"
+            "def decode(x):\n"
+            "    if x > 0:\n"
+            "        return x\n"
+            "    return -x\n"
+            "decode_j = jax.jit(decode)\n"
+        )}, rule="retrace-risk", count=1)
+        self.assertIn("branches on traced parameter `x`",
+                      res.findings[0].message)
+
+    def test_args_namespace_capture_through_wrapper(self):
+        # the repo idiom: jax.jit(tel.track_compiles(run, name=...)) — the
+        # wrapped def is the first positional arg of the inner call
+        self.assert_fires({"m.py": (
+            "import jax\n"
+            "def run(x):\n"
+            "    return x * args.scale\n"
+            "run_j = jax.jit(tel.track_compiles(run, name='run'))\n"
+        )}, rule="retrace-risk", count=1)
+
+    def test_closure_dict_lookup_and_fstring(self):
+        res = self.assert_fires({"m.py": (
+            "import jax\n"
+            "cfg = {}\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    y = x * cfg['lr']\n"
+            "    name = f'step {x}'\n"
+            "    return y\n"
+        )}, rule="retrace-risk", count=2)
+        msgs = " | ".join(f.message for f in res.findings)
+        self.assertIn("closure dict lookup", msgs)
+        self.assertIn("f-string formats traced value", msgs)
+
+    def test_static_argnums_exempts_the_site(self):
+        self.assert_clean({"m.py": (
+            "import jax\n"
+            "def decode(x, mode):\n"
+            "    if mode:\n"
+            "        return x\n"
+            "    return -x\n"
+            "decode_j = jax.jit(decode, static_argnums=(1,))\n"
+        )})
+
+    def test_static_shape_checks_and_is_none_are_fine(self):
+        self.assert_clean({"m.py": (
+            "import jax\n"
+            "@jax.jit\n"
+            "def step(x, mask):\n"
+            "    if mask is None:\n"
+            "        return x\n"
+            "    if x.ndim == 2 and len(ALL) > 0:\n"
+            "        return x + 1\n"
+            "    return x\n"
+            "ALL = []\n"
+        )})
+
+    def test_suppressed_with_reason(self):
+        self.assert_suppressed({"m.py": (
+            "import jax\n"
+            "def decode(x):\n"
+            "    if x > 0:  # fedlint: disable=retrace-risk shape-gated upstream, both traces wanted\n"
+            "        return x\n"
+            "    return -x\n"
+            "decode_j = jax.jit(decode)\n"
+        )})
+
+
+class TestHostSync(_RuleCase):
+    rule_ids = ("host-sync",)
+    options = {"hot-modules": ["hot.py"]}
+
+    def test_item_in_loop_fires(self):
+        res = self.assert_fires({"hot.py": (
+            "def drain(toks):\n"
+            "    out = []\n"
+            "    for t in toks:\n"
+            "        out.append(t.item())\n"
+            "    return out\n"
+        )}, rule="host-sync", count=1)
+        self.assertIn(".item() inside a hot loop", res.findings[0].message)
+
+    def test_all_sync_shapes_fire(self):
+        self.assert_fires({"hot.py": (
+            "import numpy as np\n"
+            "import jax.numpy as jnp\n"
+            "def loop(xs):\n"
+            "    while xs:\n"
+            "        a = np.asarray(xs[0])\n"
+            "        xs[0].block_until_ready()\n"
+            "        b = float(jnp.sum(a))\n"
+            "        c = device_get(a)\n"
+        )}, rule="host-sync", count=4)
+
+    def test_only_hot_modules_and_only_loops(self):
+        # same sync, cold module: silent
+        self.assert_clean({"cold.py": (
+            "def drain(toks):\n"
+            "    for t in toks:\n"
+            "        t.item()\n"
+        )})
+        # hot module, no loop: silent
+        self.assert_clean({"hot.py": "def one(t):\n    return t.item()\n"})
+        # nested def inside the loop is the jitted payload — its body is
+        # not a per-iteration host sync
+        self.assert_clean({"hot.py": (
+            "def build(xs):\n"
+            "    for x in xs:\n"
+            "        def inner(t):\n"
+            "            return t.item()\n"
+        )})
+
+    def test_suppressed_with_reason(self):
+        self.assert_suppressed({"hot.py": (
+            "def drain(toks):\n"
+            "    for t in toks:\n"
+            "        t.item()  # fedlint: disable=host-sync once-per-chunk EOS check is the design\n"
+        )})
+
+
+class TestDonationMisuse(_RuleCase):
+    rule_ids = ("donation-misuse",)
+
+    def test_read_after_donation_fires(self):
+        res = self.assert_fires({"m.py": (
+            "import jax\n"
+            "def _step(s, g):\n"
+            "    return s\n"
+            "step = jax.jit(_step, donate_argnums=(0,))\n"
+            "def round_(state, grads):\n"
+            "    out = step(state, grads)\n"
+            "    return state\n"
+        )}, rule="donation-misuse", count=1)
+        self.assertIn("read after being donated", res.findings[0].message)
+
+    def test_rebind_at_call_is_the_safe_shape(self):
+        self.assert_clean({"m.py": (
+            "import jax\n"
+            "def _step(s, g):\n"
+            "    return s\n"
+            "step = jax.jit(_step, donate_argnums=(0,))\n"
+            "def round_(state, grads):\n"
+            "    state = step(state, grads)\n"
+            "    return state\n"
+        )})
+
+    def test_rebind_before_read_is_safe(self):
+        self.assert_clean({"m.py": (
+            "import jax\n"
+            "def _step(s, g):\n"
+            "    return s\n"
+            "step = jax.jit(_step, donate_argnums=(0,))\n"
+            "def round_(state, grads):\n"
+            "    out = step(state, grads)\n"
+            "    state = out\n"
+            "    return state\n"
+        )})
+
+    def test_donate_argnames_and_method_donor(self):
+        self.assert_fires({"m.py": (
+            "import jax\n"
+            "def _agg(acc, delta):\n"
+            "    return acc\n"
+            "class Engine:\n"
+            "    def __init__(self):\n"
+            "        self._agg = jax.jit(_agg, donate_argnums=(0,))\n"
+            "    def push(self, acc, delta):\n"
+            "        out = self._agg(acc, delta)\n"
+            "        return acc.shape\n"
+        )}, rule="donation-misuse", count=1)
+
+    def test_suppressed_with_reason(self):
+        self.assert_suppressed({"m.py": (
+            "import jax\n"
+            "def _step(s, g):\n"
+            "    return s\n"
+            "step = jax.jit(_step, donate_argnums=(0,))\n"
+            "def round_(state, grads):\n"
+            "    out = step(state, grads)\n"
+            "    return state  # fedlint: disable=donation-misuse error path only logs the pytree structure\n"
+        )})
+
+
+class TestLockDiscipline(_RuleCase):
+    rule_ids = ("lock-discipline",)
+
+    _BAD = (
+        "import threading\n"
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._queue = []\n"
+        "        threading.Thread(target=self._loop, daemon=True).start()\n"
+        "    def push(self, item):\n"
+        "        with self._lock:\n"
+        "            self._queue.append(item)\n"
+        "    def _loop(self):\n"
+        "        while True:\n"
+        "            self._queue.pop()\n"
+    )
+
+    def test_unlocked_write_on_thread_path_fires(self):
+        res = self.assert_fires({"m.py": self._BAD},
+                                rule="lock-discipline", count=1)
+        self.assertIn("Worker._loop()", res.findings[0].message)
+        self.assertIn("self._lock", res.findings[0].message)
+
+    def test_locked_write_is_clean(self):
+        good = self._BAD.replace(
+            "        while True:\n            self._queue.pop()\n",
+            "        while True:\n"
+            "            with self._lock:\n"
+            "                self._queue.pop()\n")
+        self.assert_clean({"m.py": good})
+
+    def test_condition_aliases_its_lock(self):
+        # holding the Condition built on self._lock IS holding self._lock
+        self.assert_clean({"m.py": (
+            "import threading\n"
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._work = threading.Condition(self._lock)\n"
+            "        self._queue = []\n"
+            "        threading.Thread(target=self._loop).start()\n"
+            "    def push(self, item):\n"
+            "        with self._lock:\n"
+            "            self._queue.append(item)\n"
+            "    def _loop(self):\n"
+            "        with self._work:\n"
+            "            self._queue.pop()\n"
+        )})
+
+    def test_handler_callback_is_an_entry_point(self):
+        self.assert_fires({"m.py": (
+            "import threading\n"
+            "class Manager:\n"
+            "    def __init__(self, com):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._rounds = {}\n"
+            "        com.register_message_receive_handler(1, self._on_msg)\n"
+            "    def record(self, r):\n"
+            "        with self._lock:\n"
+            "            self._rounds[r] = 1\n"
+            "    def _on_msg(self, msg):\n"
+            "        self._rounds[msg.round] = 2\n"
+        )}, rule="lock-discipline", count=1)
+
+    def test_suppressed_with_reason(self):
+        sup = self._BAD.replace(
+            "            self._queue.pop()\n",
+            "            self._queue.pop()  # fedlint: disable=lock-discipline drained only after join(), thread-confined by then\n")
+        self.assert_suppressed({"m.py": sup})
+
+
+class TestShimParity(unittest.TestCase):
+    """The five tools/check_*.py shims keep their historical contracts.
+    (Deeper behavioral coverage lives with each subsystem's own tests —
+    test_telemetry, test_resilience, test_sharded_agg,
+    test_continuous_batching — which all still load the shims.)"""
+
+    def test_check_timing_tuple_shape_and_exit_codes(self):
+        import tempfile
+        mod = _load_tool("check_timing")
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "m.py"), "w") as f:
+                f.write("import time\nt = time.time()\n"
+                        "ok = time.time()  # wall-clock ok: legacy marker\n")
+            v = mod.find_violations(d)
+            self.assertEqual(len(v), 1)
+            path, lineno, line = v[0]
+            self.assertEqual(lineno, 2)
+            self.assertIn("time.time()", line)
+            self.assertEqual(mod.main([d]), 1)
+        with tempfile.TemporaryDirectory() as d:
+            self.assertEqual(mod.main([d]), 0)
+
+    def test_check_resilience_kinds(self):
+        import tempfile
+        mod = _load_tool("check_resilience")
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "m.py"), "w") as f:
+                f.write("import time\nimport orbax.checkpoint\n"
+                        "time.sleep(2)\n")
+            kinds = {kind for _p, _l, kind, _t in mod.find_violations(d)}
+            self.assertEqual(
+                kinds,
+                {"unmarked time.sleep()", "orbax outside utils/checkpoint.py"})
+            self.assertEqual(mod.main([d]), 1)
+
+    def test_check_telemetry_functions(self):
+        import tempfile
+        mod = _load_tool("check_telemetry")
+        needle = "__" + "telemetry" + "__"
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "m.py"), "w") as f:
+                f.write(f"K = '{needle}'\nE = 'span_open'\n"
+                        "import sys\nsys.excepthook = print\n")
+            self.assertEqual(len(mod.find_reserved_key_violations(d)), 1)
+            self.assertEqual(len(mod.find_recorder_kind_violations(d)), 1)
+            self.assertEqual(len(mod.find_excepthook_violations(d)), 1)
+            self.assertEqual(mod.main([d]), 1)
+
+    def test_check_serving_and_sharding_run_clean_on_repo(self):
+        serving = _load_tool("check_serving")
+        self.assertEqual(
+            serving.main([os.path.join(_REPO, "fedml_tpu", "serving")]), 0)
+        sharding = _load_tool("check_sharding")
+        self.assertEqual(
+            sharding.main([os.path.join(_REPO, "fedml_tpu")]), 0)
+
+    def test_check_sharding_detects_stray_mesh(self):
+        import tempfile
+        mod = _load_tool("check_sharding")
+        with tempfile.TemporaryDirectory() as d:
+            os.makedirs(os.path.join(d, "core"))
+            with open(os.path.join(d, "core", "stray.py"), "w") as f:
+                f.write("from jax.sharding import NamedSharding\n")
+            msgs = [m for _p, _l, m in mod.find_violations(d)]
+            self.assertTrue(
+                any("outside the mesh/sharded modules" in m for m in msgs),
+                msgs)
+
+
+class TestRepoGates(unittest.TestCase):
+    """CI gates: the tree itself is lint-clean and marker-migrated."""
+
+    def test_repo_has_zero_unsuppressed_findings(self):
+        result = api.run_repo()
+        rendered = "\n".join(f.render() for f in result.findings)
+        self.assertEqual(
+            result.findings, [],
+            "fedlint found unsuppressed findings — fix them or suppress "
+            "with `# fedlint: disable=<rule> <reason>`:\n" + rendered)
+        self.assertEqual(
+            result.stale_baseline, [],
+            "stale baseline entries — the finding is fixed; shrink "
+            "tools/fedlint/baseline.json")
+        self.assertGreater(result.files_scanned, 200)
+
+    def test_cli_clean_run_and_json_shape(self):
+        self.assertEqual(cli.main([]), 0)
+        self.assertEqual(cli.main(["--list-rules"]), 0)
+        import contextlib
+        import io
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli.main(["--format", "json"])
+        self.assertEqual(rc, 0)
+        doc = json.loads(buf.getvalue())
+        self.assertEqual(doc["counts"]["findings"], 0)
+        self.assertGreater(doc["counts"]["suppressed"], 0)
+
+    def test_cli_unknown_rule_is_usage_error(self):
+        self.assertEqual(cli.main(["--rules", "no-such-rule"]), 2)
+
+    def test_legacy_markers_are_fully_migrated(self):
+        """`# sleep ok` / `# wall-clock ok` only survive in the fedlint
+        rule/shim sources that keep the shims' historical contracts."""
+        offenders = []
+        roots = [os.path.join(_REPO, "fedml_tpu"),
+                 os.path.join(_REPO, "bench.py")]
+        for top in roots:
+            files = ([top] if os.path.isfile(top) else
+                     [os.path.join(dp, fn)
+                      for dp, _dn, fns in os.walk(top)
+                      for fn in fns if fn.endswith(".py")])
+            for path in files:
+                with open(path, encoding="utf-8") as f:
+                    for i, line in enumerate(f, 1):
+                        if "# sleep ok" in line or "# wall-clock ok" in line:
+                            offenders.append(f"{path}:{i}")
+        self.assertEqual(
+            offenders, [],
+            "legacy lint markers remain — migrate to "
+            "`# fedlint: disable=<rule> <reason>`")
+
+    def test_every_suppression_in_tree_carries_a_reason(self):
+        # bare-suppression is an error-severity rule, so this is implied by
+        # the zero-findings gate; assert it directly for a sharp message
+        result = api.run_repo()
+        bare = [f.render() for f in result.findings
+                if f.rule == "bare-suppression"]
+        self.assertEqual(bare, [])
+
+
+if __name__ == "__main__":
+    unittest.main()

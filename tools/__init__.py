@@ -1,0 +1,2 @@
+# Namespace package marker so `python -m tools.fedlint` works from the repo
+# root. Operational scripts in this directory stay plain scripts.

@@ -40,6 +40,22 @@ fi
 
 log() { echo "[$(date -u +%FT%TZ)] $*"; }
 
+surface_fedlint() {
+  # one-line static-analysis health check (docs/static_analysis.md): runs the
+  # unified linter once at watcher startup so a window that begins with
+  # unsuppressed findings (retrace risk, host syncs in hot loops, donation
+  # misuse, lock discipline) is called out in the log before any chip time is
+  # spent measuring code the lint already flags. Pure CPU/AST — no chip, no
+  # lock needed.
+  local summary
+  summary=$(timeout 120 python -m tools.fedlint 2>/dev/null | tail -1) || true
+  if [ -n "$summary" ]; then
+    log "$summary"
+  else
+    log "fedlint: could not run (python -m tools.fedlint failed)"
+  fi
+}
+
 commit_artifacts() {
   # commit ONLY the artifact paths so a concurrent interactive commit's
   # staged files are never swept into this commit. Pathspecs are collected
@@ -260,6 +276,8 @@ have_measured_headline() {
   # before a headline ever landed
   grep -l '"value": [0-9]' BENCH_MEASURED_*.json >/dev/null 2>&1
 }
+
+surface_fedlint
 
 while true; do
   # tpu_probe.py EXECUTES a jitted op (shared with bench.py's _probe_backend

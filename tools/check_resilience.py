@@ -1,26 +1,17 @@
 #!/usr/bin/env python3
-"""Resilience-idiom lint: no ad-hoc retry loops, no bypassing the watermark.
+"""Resilience-idiom lint — thin shim over ``tools.fedlint`` (rules:
+bare-sleep, orbax).
 
-Two rules enforced over every ``fedml_tpu/**/*.py`` file:
+The walker that lived here (PR 5) is now
+``tools/fedlint/rules/resilience.py``; this shim preserves the historical
+contract — ``find_violations(root)`` tuples, stdout format, exit codes —
+for tier-1 callers (tests/test_resilience.py). New callers use
+``python -m tools.fedlint``.
 
-1. **No bare sleep loops.** A line containing ``time.sleep(`` outside
-   ``core/resilience/retry.py`` must carry a ``# sleep ok: <reason>`` marker
-   on the same line. Hand-rolled ``for attempt in range(n): ... sleep(...)``
-   loops are how unbounded, untelemetered retries creep back in — transient
-   failures belong to :mod:`fedml_tpu.core.resilience.retry` (jittered,
-   budget-capped, flight-recorder-booked). The marker is the allowlist for
-   sleeps that are *not* retries: chaos injection, polling an external
-   process, rate pacing — the reason says which.
-
-2. **Checkpoint writes go through the watermark.** Orbax checkpointers
-   (``ocp.CheckpointManager`` / ``orbax.checkpoint``) may only be touched by
-   ``fedml_tpu/utils/checkpoint.py``. Everything else uses
-   :class:`fedml_tpu.utils.checkpoint.CheckpointManager`, whose async save +
-   watermark commit is what makes crash-resume pick a *complete* step; a
-   direct orbax save would reintroduce torn checkpoints.
-
-Anything unmarked fails tier-1 (tests/test_resilience.py invokes ``main()``).
-Exit status: 0 clean, 1 with violations listed on stdout.
+Rules: ``time.sleep()`` outside ``core/resilience/retry.py`` needs a
+``# fedlint: disable=bare-sleep <reason>`` suppression (legacy
+``# sleep ok:`` still honored); orbax checkpointers are touched only by
+``fedml_tpu/utils/checkpoint.py`` (watermark commit).
 """
 
 from __future__ import annotations
@@ -28,50 +19,39 @@ from __future__ import annotations
 import os
 import sys
 
-SLEEP_MARKER = "sleep ok"
-SLEEP_PATTERN = "time.sleep("
-SLEEP_EXEMPT = os.path.join("core", "resilience", "retry.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-ORBAX_PATTERNS = ("ocp.CheckpointManager", "orbax.checkpoint")
-ORBAX_HOME = os.path.join("utils", "checkpoint.py")
+from tools.fedlint import api  # noqa: E402
+
+SLEEP_MARKER = "sleep ok"
+
+_KINDS = {
+    "bare-sleep": "unmarked time.sleep()",
+    "orbax": "orbax outside utils/checkpoint.py",
+}
 
 
 def find_violations(root: str) -> list:
-    violations = []
-    for dirpath, _dirnames, filenames in os.walk(root):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, root)
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    if (
-                        SLEEP_PATTERN in line
-                        and SLEEP_MARKER not in line
-                        and not rel.endswith(SLEEP_EXEMPT)
-                    ):
-                        violations.append((path, lineno, "unmarked time.sleep()", line.strip()))
-                    if (
-                        any(p in line for p in ORBAX_PATTERNS)
-                        and not rel.endswith(ORBAX_HOME)
-                    ):
-                        violations.append((path, lineno, "orbax outside utils/checkpoint.py", line.strip()))
-    return violations
+    """Legacy shape: (path, lineno, kind, stripped source line)."""
+    result = api.run_rules(root, ["bare-sleep", "orbax"])
+    return [(f.path, f.line, _KINDS[f.rule], f.line_text.strip())
+            for f in result.findings if f.rule in _KINDS]
 
 
 def main(argv: list = ()) -> int:
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    root = argv[0] if argv else os.path.join(repo, "fedml_tpu")
+    root = argv[0] if argv else os.path.join(_REPO, "fedml_tpu")
     violations = find_violations(root)
     for path, lineno, kind, line in violations:
-        print(f"{os.path.relpath(path, repo)}:{lineno}: {kind}: {line}")
+        print(f"{os.path.relpath(path, _REPO)}:{lineno}: {kind}: {line}")
     if violations:
         print(
             f"\n{len(violations)} resilience violation(s). Retries belong to "
             "fedml_tpu.core.resilience.retry (jittered, budget-capped); checkpoint "
             "writes go through fedml_tpu.utils.checkpoint (watermark commit); "
-            f"legitimate non-retry sleeps need a '# {SLEEP_MARKER}: <reason>' marker."
+            "legitimate non-retry sleeps need a "
+            "'# fedlint: disable=bare-sleep <reason>' suppression."
         )
         return 1
     return 0

@@ -1,99 +1,42 @@
 #!/usr/bin/env python3
-"""Serving-hygiene lint (tier-1 enforced; tests/test_continuous_batching.py
-runs it).
+"""Serving-hygiene lint — thin shim over ``tools.fedlint`` (rules:
+hot-span, wall-clock).
 
-Two rules over ``fedml_tpu/serving/**/*.py``:
-
-1. **Hot loops carry telemetry spans.** The serving hot paths — the
-   continuous-batching engine's admit/step loop and the gateway's forward
-   path — must time themselves through ``tel.timed(``/``tel.span(`` (which
-   are perf_counter-based): an uninstrumented hot loop is how the r05
-   endpoint collapse (14.5 tok/s against a 370k tok/s chip) stayed
-   invisible until a full bench window. The registry below names the
-   functions that MUST contain a span call; deleting the instrumentation
-   without updating the registry fails tier-1.
-
-2. **No wall-clock durations.** Latency math in serving must ride
-   ``time.perf_counter()``; ad-hoc ``time.time()`` needs the repo-wide
-   ``# wall-clock ok:`` marker (re-runs ``check_timing.find_violations``
-   scoped to serving/, so one tool covers both lints for this subtree).
-
-Exit status: 0 clean, 1 with violations listed on stdout.
+The AST walker that lived here (PR 6) is now
+``tools/fedlint/rules/serving.py``; this shim preserves the historical
+contract — ``find_unspanned_hot_loops(root)`` tuples, stdout format, exit
+codes — for tier-1 callers (tests/test_continuous_batching.py). The hot-
+loop registry itself now lives in the rule module (HOT_LOOPS). New callers
+use ``python -m tools.fedlint``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-import check_timing  # noqa: E402
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# (relative path under the scan root, qualified function name) -> every
-# listed function must contain a tel.timed(/tel.span( call somewhere in its
-# body. "Class.method" pins one method; a bare name matches module level.
-HOT_LOOPS: tuple[tuple[str, str], ...] = (
-    ("continuous_batching.py", "ContinuousBatchingEngine._admit_all"),
-    ("continuous_batching.py", "ContinuousBatchingEngine._step_chunk"),
-    ("replica_controller.py", "InferenceGateway.predict"),
-)
-
-_SPAN_ATTRS = ("timed", "span")
-
-
-def _calls_span(node: ast.AST) -> bool:
-    """True if any call inside ``node`` is tel.timed(...) / tel.span(...)
-    (any receiver named like the telemetry module counts — serving imports
-    it as ``tel``)."""
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
-            if sub.func.attr in _SPAN_ATTRS:
-                return True
-    return False
+from tools.fedlint import api  # noqa: E402
+from tools.fedlint.rules.serving import HOT_LOOPS  # noqa: E402,F401 (re-export)
 
 
 def find_unspanned_hot_loops(root: str) -> list:
-    """HOT_LOOPS entries whose function exists but contains no span call
-    (a registry entry whose file/function is GONE is also a violation —
-    silently skipping it would let a rename drop the guard)."""
-    violations = []
-    for rel, fn_name in HOT_LOOPS:
-        path = os.path.join(root, rel)
-        if not os.path.exists(path):
-            violations.append((path, 0, f"registry names missing file {rel}"))
-            continue
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read(), filename=path)
-        cls_name, _, meth = fn_name.rpartition(".")
-        if cls_name:
-            scopes = [n for n in ast.walk(tree)
-                      if isinstance(n, ast.ClassDef) and n.name == cls_name]
-        else:
-            scopes = [tree]
-        found = False
-        for scope in scopes:
-            for node in scope.body if cls_name else ast.walk(scope):
-                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node.name == meth:
-                    found = True
-                    if not _calls_span(node):
-                        violations.append(
-                            (path, node.lineno,
-                             f"hot loop {fn_name}() has no tel.timed()/tel.span()"))
-        if not found:
-            violations.append(
-                (path, 0, f"registry names missing function {fn_name}()"))
-    return violations
+    """Legacy shape: (path, lineno, message)."""
+    result = api.run_rules(root, ["hot-span"])
+    return [(f.path, f.line, f.message)
+            for f in result.findings if f.rule == "hot-span"]
 
 
 def main(argv: list = ()) -> int:
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    root = argv[0] if argv else os.path.join(repo, "fedml_tpu", "serving")
+    root = argv[0] if argv else os.path.join(_REPO, "fedml_tpu", "serving")
     rc = 0
 
     unspanned = find_unspanned_hot_loops(root)
     for path, lineno, msg in unspanned:
-        print(f"{os.path.relpath(path, repo)}:{lineno}: {msg}")
+        print(f"{os.path.relpath(path, _REPO)}:{lineno}: {msg}")
     if unspanned:
         print(
             f"\n{len(unspanned)} uninstrumented serving hot loop(s). Wrap the "
@@ -102,9 +45,11 @@ def main(argv: list = ()) -> int:
         )
         rc = 1
 
-    timing = check_timing.find_violations(root)
+    result = api.run_rules(root, ["wall-clock"])
+    timing = [(f.path, f.line, f.line_text.strip())
+              for f in result.findings if f.rule == "wall-clock"]
     for path, lineno, line in timing:
-        print(f"{os.path.relpath(path, repo)}:{lineno}: unmarked time.time(): {line}")
+        print(f"{os.path.relpath(path, _REPO)}:{lineno}: unmarked time.time(): {line}")
     if timing:
         print(
             f"\n{len(timing)} unmarked time.time() call(s) in serving — "

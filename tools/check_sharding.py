@@ -1,144 +1,57 @@
 #!/usr/bin/env python3
-"""Sharding-hygiene lint (tier-1 enforced; tests/test_sharded_agg.py runs it).
+"""Sharding-hygiene lint — thin shim over ``tools.fedlint`` (rules:
+sharding-containment, device-get).
 
-Two rules over the SERVER scope (``fedml_tpu/core``, ``fedml_tpu/cross_silo``,
-``fedml_tpu/simulation``):
-
-1. **Mesh plumbing stays contained.** ``jax.sharding`` (Mesh / NamedSharding /
-   PartitionSpec) may be imported or referenced only by
-   ``core/distributed/mesh.py`` and ``core/aggregation/sharded.py``.
-   Everything else in the server scope goes through those two modules' APIs —
-   scattered NamedSharding construction is how layout drift (one module
-   sharding dim 0, another replicating the same leaf) stops being reviewable.
-   The TRAINER scope (``fedml_tpu/parallel``, ``fedml_tpu/train``,
-   ``fedml_tpu/serving``) carries its own GSPMD plumbing and is deliberately
-   out of scope.
-
-2. **No device_get in the sharding modules.** ``jax.device_get`` is banned in
-   the two modules rule 1 privileges: the only full-model gather is the host
-   broadcast materialization (``ShardedBucketedAggregator.host_tree``), which
-   rides ``np.asarray`` per dtype group and books its bytes via
-   ``record_transfer``. A ``device_get`` of sharded params inside the round
-   step would replicate the model host-side with zero byte accounting —
-   exactly the materialization the sharded server exists to avoid.
-
-Exit status: 0 clean, 1 with violations listed on stdout.
+The AST walker that lived here (PR 7) is now
+``tools/fedlint/rules/sharding.py``; this shim preserves the historical
+contract — ``find_violations(root)`` tuples, stdout format, exit codes —
+for tier-1 callers (tests/test_sharded_agg.py). The server-scope dirs and
+the privileged-file allowlist live in the rule module. New callers use
+``python -m tools.fedlint``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-# directories under the scan root that form the server scope
-SERVER_SCOPE: tuple[str, ...] = ("core", "cross_silo", "simulation")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# the only files (relative to the scan root) allowed to touch jax.sharding
-ALLOWED_SHARDING_FILES: frozenset = frozenset({
-    os.path.join("core", "distributed", "mesh.py"),
-    os.path.join("core", "aggregation", "sharded.py"),
-    # the device-collective SIMULATOR shards stacked clients over its own
-    # "agg" mesh — that mesh is the simulation's subject (the Parrot-NCCL
-    # topology under test), not server-layout plumbing, so it keeps its
-    # jax.sharding access; the device_get ban applies to it all the same
-    os.path.join("simulation", "collective", "collective_sim.py"),
-})
+from tools.fedlint import api  # noqa: E402
+from tools.fedlint.rules.sharding import (  # noqa: E402,F401 (re-export)
+    ALLOWED_SHARDING_FILES,
+    SERVER_SCOPE,
+)
 
-
-def _is_jax_sharding_attr(node: ast.AST) -> bool:
-    """True for a ``jax.sharding`` attribute chain (``jax.sharding.Mesh``)."""
-    return (isinstance(node, ast.Attribute) and node.attr == "sharding"
-            and isinstance(node.value, ast.Name) and node.value.id == "jax")
-
-
-def _sharding_refs(tree: ast.AST) -> list:
-    """(lineno, description) of every jax.sharding import or reference."""
-    refs = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                if alias.name == "jax.sharding" or alias.name.startswith("jax.sharding."):
-                    refs.append((node.lineno, f"import {alias.name}"))
-        elif isinstance(node, ast.ImportFrom):
-            mod = node.module or ""
-            if mod == "jax.sharding" or mod.startswith("jax.sharding."):
-                names = ", ".join(a.name for a in node.names)
-                refs.append((node.lineno, f"from {mod} import {names}"))
-        elif _is_jax_sharding_attr(node):
-            refs.append((node.lineno, "jax.sharding attribute access"))
-    return refs
-
-
-def _device_get_refs(tree: ast.AST) -> list:
-    """(lineno, description) of every jax.device_get reference (attribute or
-    ``from jax import device_get``) — conservative: ANY ``.device_get`` attr
-    counts, an alias cannot launder the gather."""
-    refs = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Attribute) and node.attr == "device_get":
-            refs.append((node.lineno, "device_get attribute access"))
-        elif isinstance(node, ast.ImportFrom):
-            if (node.module or "") == "jax":
-                for alias in node.names:
-                    if alias.name == "device_get":
-                        refs.append((node.lineno, "from jax import device_get"))
-    return refs
-
-
-def _iter_scope_files(root: str):
-    for scope in SERVER_SCOPE:
-        top = os.path.join(root, scope)
-        for dirpath, _dirnames, filenames in os.walk(top):
-            for fn in sorted(filenames):
-                if fn.endswith(".py"):
-                    yield os.path.join(dirpath, fn)
+_RULES = ("sharding-containment", "device-get")
 
 
 def find_violations(root: str) -> list:
-    """(path, lineno, message) for every rule break under ``root`` (the
-    ``fedml_tpu`` package dir). Missing privileged files are violations too:
-    a rename must move the allowlist, not silently drop the guard."""
-    violations = []
-    for rel in sorted(ALLOWED_SHARDING_FILES):
-        if not os.path.exists(os.path.join(root, rel)):
-            violations.append((os.path.join(root, rel), 0,
-                               f"allowlist names missing file {rel}"))
-    for path in _iter_scope_files(root):
-        rel = os.path.relpath(path, root)
-        with open(path, encoding="utf-8") as f:
-            try:
-                tree = ast.parse(f.read(), filename=path)
-            except SyntaxError as e:
-                violations.append((path, e.lineno or 0, f"unparseable: {e.msg}"))
-                continue
-        if rel not in ALLOWED_SHARDING_FILES:
-            for lineno, desc in _sharding_refs(tree):
-                violations.append(
-                    (path, lineno,
-                     f"{desc} outside the mesh/sharded modules — go through "
-                     "core.distributed.mesh / core.aggregation.sharded"))
-        else:
-            for lineno, desc in _device_get_refs(tree):
-                violations.append(
-                    (path, lineno,
-                     f"{desc} in a sharding module — the host gather is "
-                     "host_tree()'s np.asarray per dtype group (byte-booked "
-                     "via record_transfer), never device_get"))
-    return violations
+    """Legacy shape: (path, lineno, message) — includes syntax errors in
+    scope and missing allowlisted files, as the original walker did."""
+    result = api.run_rules(root, list(_RULES))
+    out = []
+    for f in result.findings:
+        if f.rule in _RULES:
+            out.append((f.path, f.line, f.message))
+        elif f.rule == "syntax-error":
+            out.append((f.path, f.line, f.message))
+    return out
 
 
 def main(argv: list = ()) -> int:
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    root = argv[0] if argv else os.path.join(repo, "fedml_tpu")
+    root = argv[0] if argv else os.path.join(_REPO, "fedml_tpu")
     violations = find_violations(root)
     for path, lineno, msg in violations:
-        print(f"{os.path.relpath(path, repo)}:{lineno}: {msg}")
+        print(f"{os.path.relpath(path, _REPO)}:{lineno}: {msg}")
     if violations:
         print(
             f"\n{len(violations)} sharding-hygiene violation(s). Mesh and "
             "NamedSharding plumbing lives in core/distributed/mesh.py and "
-            "core/aggregation/sharded.py only; see tools/check_sharding.py."
+            "core/aggregation/sharded.py only; see tools/fedlint/rules/"
+            "sharding.py."
         )
         return 1
     return 0

@@ -1,33 +1,12 @@
 #!/usr/bin/env python3
-"""Telemetry-hygiene lint (tier-1 enforced; tests/test_telemetry.py runs it).
+"""Telemetry-hygiene lint — thin shim over ``tools.fedlint`` (rules:
+reserved-key, wall-clock, recorder-kind, excepthook).
 
-Four rules over ``fedml_tpu/**/*.py``:
-
-1. **Reserved-header containment.** The comm layer reserves one ``Message``
-   parameter key for the trace-context + delta-snapshot header. The string
-   literal must appear ONLY in ``core/telemetry/trace_context.py`` (its
-   canonical home); everywhere else must reference
-   ``trace_context.RESERVED_TELEMETRY_KEY`` / ``Message.MSG_ARG_KEY_TELEMETRY``.
-   A payload constructed from the raw literal would silently collide with the
-   header and be clobbered by ``inject()`` on send.
-
-2. **Timing-idiom regressions.** Re-runs ``check_timing.find_violations`` so
-   one tool invocation covers both lints (new ad-hoc ``time.time()`` calls
-   still need their ``# wall-clock ok:`` marker).
-
-3. **Recorder event-kind containment.** The flight recorder's event-kind
-   literals ("span_open" etc.) belong ONLY to
-   ``core/telemetry/flight_recorder.py``; ad-hoc producers spelling them
-   elsewhere would invent look-alike events ``tools/fr_dump.py`` cannot
-   interpret. Everything else records via ``flight_recorder.record_event``
-   with the EVENT_* constants (or ``mark``/``record_comm``).
-
-4. **Excepthook containment.** ``sys.excepthook`` / ``threading.excepthook``
-   may be touched ONLY by ``core/telemetry/flight_recorder.py`` — a second
-   installer would silently drop crash dumps (or the other hook), depending
-   on import order.
-
-Exit status: 0 clean, 1 with violations listed on stdout.
+The four line-scan walkers that lived here (PRs 3–4) are now
+``tools/fedlint/rules/telemetry.py`` (AST-based); this shim preserves the
+historical contract — per-rule ``find_*_violations(root)`` tuples, stdout
+format, exit codes — for tier-1 callers (tests/test_trace_propagation.py,
+tests/test_flight_recorder.py). New callers use ``python -m tools.fedlint``.
 """
 
 from __future__ import annotations
@@ -35,70 +14,38 @@ from __future__ import annotations
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-import check_timing  # noqa: E402
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# The reserved key, spelled fragment-wise so THIS file does not trip its own
-# lint when scanned.
-RESERVED = "__" + "telemetry" + "__"
-# The one module allowed to spell the literal (relative to the scan root).
-ALLOWED_FILES = (os.path.join("core", "telemetry", "trace_context.py"),)
-
-# The one module allowed to spell recorder event kinds or touch excepthooks.
-FLIGHT_RECORDER = os.path.join("core", "telemetry", "flight_recorder.py")
-# Distinctive kind literals only — generic words ("exception", "mark") would
-# false-positive across the tree.
-RECORDER_KINDS = ("span_open", "span_close", "comm_send", "comm_recv")
-EXCEPTHOOK_NEEDLES = ("sys.excepthook", "threading.excepthook")
+from tools.fedlint import api  # noqa: E402
 
 
-def _scan(root: str, match, allowed: tuple) -> list:
-    """Generic line scan: ``match(line) -> bool`` over .py files outside
-    ``allowed`` (paths relative to the scan root)."""
-    violations = []
-    for dirpath, _dirnames, filenames in os.walk(root):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, root)
-            if rel in allowed:
-                continue
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    if match(line):
-                        violations.append((path, lineno, line.strip()))
-    return violations
+def _tuples(root: str, rule: str) -> list:
+    result = api.run_rules(root, [rule])
+    return [(f.path, f.line, f.line_text.strip())
+            for f in result.findings if f.rule == rule]
 
 
 def find_reserved_key_violations(root: str) -> list:
-    needles = ('"' + RESERVED + '"', "'" + RESERVED + "'")
-    return _scan(root, lambda line: any(n in line for n in needles), ALLOWED_FILES)
+    return _tuples(root, "reserved-key")
 
 
 def find_recorder_kind_violations(root: str) -> list:
-    """Quoted recorder event-kind literals outside flight_recorder.py."""
-    needles = tuple('"' + k + '"' for k in RECORDER_KINDS) + tuple(
-        "'" + k + "'" for k in RECORDER_KINDS
-    )
-    return _scan(root, lambda line: any(n in line for n in needles),
-                 (FLIGHT_RECORDER,))
+    return _tuples(root, "recorder-kind")
 
 
 def find_excepthook_violations(root: str) -> list:
-    """sys/threading excepthook references outside flight_recorder.py."""
-    return _scan(root, lambda line: any(n in line for n in EXCEPTHOOK_NEEDLES),
-                 (FLIGHT_RECORDER,))
+    return _tuples(root, "excepthook")
 
 
 def main(argv: list = ()) -> int:
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    root = argv[0] if argv else os.path.join(repo, "fedml_tpu")
+    root = argv[0] if argv else os.path.join(_REPO, "fedml_tpu")
     rc = 0
 
     reserved = find_reserved_key_violations(root)
     for path, lineno, line in reserved:
-        print(f"{os.path.relpath(path, repo)}:{lineno}: raw reserved telemetry key: {line}")
+        print(f"{os.path.relpath(path, _REPO)}:{lineno}: raw reserved telemetry key: {line}")
     if reserved:
         print(
             f"\n{len(reserved)} raw use(s) of the reserved telemetry header key. "
@@ -107,9 +54,9 @@ def main(argv: list = ()) -> int:
         )
         rc = 1
 
-    timing = check_timing.find_violations(root)
+    timing = _tuples(root, "wall-clock")
     for path, lineno, line in timing:
-        print(f"{os.path.relpath(path, repo)}:{lineno}: unmarked time.time(): {line}")
+        print(f"{os.path.relpath(path, _REPO)}:{lineno}: unmarked time.time(): {line}")
     if timing:
         print(
             f"\n{len(timing)} unmarked time.time() call(s) — see tools/check_timing.py."
@@ -118,7 +65,7 @@ def main(argv: list = ()) -> int:
 
     kinds = find_recorder_kind_violations(root)
     for path, lineno, line in kinds:
-        print(f"{os.path.relpath(path, repo)}:{lineno}: raw recorder event kind: {line}")
+        print(f"{os.path.relpath(path, _REPO)}:{lineno}: raw recorder event kind: {line}")
     if kinds:
         print(
             f"\n{len(kinds)} raw recorder event-kind literal(s). Use the "
@@ -129,7 +76,7 @@ def main(argv: list = ()) -> int:
 
     hooks = find_excepthook_violations(root)
     for path, lineno, line in hooks:
-        print(f"{os.path.relpath(path, repo)}:{lineno}: excepthook outside flight_recorder: {line}")
+        print(f"{os.path.relpath(path, _REPO)}:{lineno}: excepthook outside flight_recorder: {line}")
     if hooks:
         print(
             f"\n{len(hooks)} excepthook reference(s) outside "

@@ -1,19 +1,17 @@
 #!/usr/bin/env python3
-"""Timing-idiom lint: no new ``time.time()`` duration measurements.
+"""Timing-idiom lint — thin shim over ``tools.fedlint`` (rule: wall-clock).
 
-``time.time()`` follows the wall clock — NTP steps and slew corrupt any
-duration computed from it (a negative "aggregate time" poisons runtime fits
-and autoscaling). Durations belong to the telemetry layer
-(``fedml_tpu/core/telemetry``: span/timed/histogram, perf_counter-based).
+The walker that lived here (PR 2) is now ``tools/fedlint/rules/timing.py``;
+this shim preserves the historical contract — ``find_violations(root)``
+tuples, stdout format, exit codes (0 clean / 1 violations) — for
+tier-1 callers (tests/test_telemetry.py) and the sibling shims that
+re-run it. New callers should use ``python -m tools.fedlint`` directly.
 
-The rule enforced over every ``fedml_tpu/**/*.py`` file: a line containing
-``time.time()`` must carry a ``# wall-clock ok: <reason>`` marker on the same
-line. The marker is the allowlist — legitimate uses are *timestamps* (record
-fields, DB rows) and *wall deadlines* (timeouts coordinated with other
-processes), and the reason says which. Anything unmarked fails tier-1
-(tests/test_telemetry.py invokes ``main()``).
-
-Exit status: 0 clean, 1 with violations listed on stdout.
+Rule: ``time.time()`` durations are forbidden — NTP steps/slew corrupt
+them; use ``fedml_tpu.core.telemetry`` (perf_counter-based). Genuine
+timestamps/deadlines are suppressed with the unified pragma
+``# fedlint: disable=wall-clock <reason>`` (the legacy
+``# wall-clock ok: <reason>`` marker is still honored).
 """
 
 from __future__ import annotations
@@ -21,35 +19,33 @@ from __future__ import annotations
 import os
 import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.fedlint import api  # noqa: E402
+
 MARKER = "wall-clock ok"
-PATTERN = "time.time()"  # substring: also catches `_time.time()` aliases
 
 
 def find_violations(root: str) -> list:
-    violations = []
-    for dirpath, _dirnames, filenames in os.walk(root):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    if PATTERN in line and MARKER not in line:
-                        violations.append((path, lineno, line.strip()))
-    return violations
+    """Legacy shape: (path, lineno, stripped source line)."""
+    result = api.run_rules(root, ["wall-clock"])
+    return [(f.path, f.line, f.line_text.strip())
+            for f in result.findings if f.rule == "wall-clock"]
 
 
 def main(argv: list = ()) -> int:
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    root = argv[0] if argv else os.path.join(repo, "fedml_tpu")
+    root = argv[0] if argv else os.path.join(_REPO, "fedml_tpu")
     violations = find_violations(root)
     for path, lineno, line in violations:
-        print(f"{os.path.relpath(path, repo)}:{lineno}: unmarked time.time(): {line}")
+        print(f"{os.path.relpath(path, _REPO)}:{lineno}: unmarked time.time(): {line}")
     if violations:
         print(
             f"\n{len(violations)} unmarked time.time() call(s). Durations must use "
             "fedml_tpu.core.telemetry (span/timed/histogram, perf_counter-based); "
-            f"genuine timestamps/deadlines need a '# {MARKER}: <reason>' marker."
+            "genuine timestamps/deadlines need a "
+            "'# fedlint: disable=wall-clock <reason>' suppression."
         )
         return 1
     return 0

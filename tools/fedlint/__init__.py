@@ -1,0 +1,24 @@
+"""fedlint — the repo's unified JAX-aware static-analysis framework.
+
+One shared AST walk, many rules. PRs 2–7 each grew a bespoke line-scanning
+lint (``tools/check_*.py``); fedlint replaces the five walkers with a single
+engine (``core.py``), a ``Rule`` plugin API (``rules/``), one suppression
+syntax (``# fedlint: disable=RULE[,RULE] <reason>``), a checked-in baseline
+for grandfathered findings, and config in ``pyproject.toml [tool.fedlint]``.
+
+Entry points:
+
+* ``python -m tools.fedlint`` (CLI, text/JSON output, used by CI and
+  ``tools/bench_watch.sh``),
+* ``fedlint`` console script (``pyproject.toml [project.scripts]``),
+* :func:`tools.fedlint.api.run_rules` (programmatic — the legacy
+  ``tools/check_*.py`` shims ride it to preserve their exit-code contracts).
+
+See ``docs/static_analysis.md`` for the rule catalogue and the
+suppression/baseline workflow.
+"""
+
+from .core import Finding, Rule, RunResult, run  # noqa: F401
+from .registry import all_rules, get_rules  # noqa: F401
+
+__all__ = ["Finding", "Rule", "RunResult", "run", "all_rules", "get_rules"]

@@ -1,0 +1,42 @@
+"""Programmatic entry points.
+
+Two callers: the CLI (:mod:`tools.fedlint.cli`) and the legacy
+``tools/check_*.py`` shims, which run a subset of rules over an arbitrary
+root (their historical CLI contract lets tests point them at synthetic
+trees) and adapt the findings to their historical tuple shapes.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import baseline as baseline_mod
+from .config import load_config
+from .core import RunResult, run
+from .registry import all_rules, get_rules
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_rules(root: str, rule_ids, paths=None, exclude=(),
+              options: dict = None) -> RunResult:
+    """Run ``rule_ids`` over ``root`` (whole tree when ``paths`` is None).
+    No baseline — shims and tests see raw (pragma-filtered) findings."""
+    rules = get_rules(rule_ids, options=options or load_config(repo_root()))
+    return run(root, paths or ["."], rules, exclude=exclude)
+
+
+def run_repo(root: str = None, rule_ids=None, use_baseline: bool = True) -> RunResult:
+    """The full configured run: config paths/excludes, every rule (minus
+    config-disabled), baseline applied. This is what CI and the CLI use."""
+    root = root or repo_root()
+    cfg = load_config(root)
+    rules = (get_rules(rule_ids, options=cfg) if rule_ids
+             else [r for r in all_rules(cfg) if r.id not in set(cfg.get("disable") or ())])
+    entries = []
+    if use_baseline:
+        entries = baseline_mod.load(os.path.join(root, cfg["baseline"]))
+    return run(root, cfg["paths"], rules, exclude=cfg["exclude"],
+               baseline_entries=entries)

@@ -1,0 +1,64 @@
+"""Baseline file: grandfathered findings, each with a mandatory reason.
+
+The baseline exists so a new rule can land tree-wide without a flag-day —
+pre-existing findings get parked here (reviewed, reasoned) and burned down
+over time. Two invariants, both enforced at load/write time:
+
+* every entry carries a non-empty ``reason`` (ISSUE 8: "no entry may land
+  in the baseline file without a reason string");
+* stale entries (matching no current finding) are surfaced by the CLI so
+  the file only ever shrinks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+class BaselineError(ValueError):
+    pass
+
+
+def load(path: str) -> list:
+    """Entries from ``path``; [] when the file does not exist."""
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data.get("entries", [])
+    for e in entries:
+        for field in ("rule", "path", "fingerprint"):
+            if not e.get(field):
+                raise BaselineError(
+                    f"baseline entry missing '{field}': {e!r}")
+        if not str(e.get("reason", "")).strip():
+            raise BaselineError(
+                f"baseline entry for {e['path']} [{e['rule']}] has no "
+                "reason — every grandfathered finding must say why it is "
+                "parked, not fixed")
+    return entries
+
+
+def write(path: str, findings, reason: str) -> int:
+    """Write ``findings`` as the new baseline, all under one ``reason``."""
+    if not reason or not reason.strip():
+        raise BaselineError("--write-baseline requires --reason <text>")
+    entries = [
+        {
+            "rule": f.rule,
+            "path": f.relpath,
+            "fingerprint": f.fingerprint,
+            "line": f.line,
+            "message": f.message,
+            "reason": reason.strip(),
+        }
+        for f in findings
+    ]
+    payload = {"version": 1, "entries": entries}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return len(entries)

@@ -1,0 +1,131 @@
+"""fedlint CLI.
+
+    python -m tools.fedlint                  # full configured run, text output
+    python -m tools.fedlint --format json    # machine output (CI, bench_watch)
+    python -m tools.fedlint --rules host-sync,retrace-risk fedml_tpu/serving
+    python -m tools.fedlint --list-rules
+    python -m tools.fedlint --write-baseline --reason "pre-ISSUE-9 burn-down"
+
+Exit codes: 0 clean (no unsuppressed error-severity findings), 1 findings,
+2 usage/config/baseline error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import api, baseline as baseline_mod
+from .config import load_config
+from .registry import all_rules, get_rules
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="fedlint",
+        description="Unified JAX-aware static analysis for the fedml_tpu tree.")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to scan (default: [tool.fedlint] paths)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: autodetected from this file)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default: all minus "
+                        "config-disabled)")
+    p.add_argument("--disable", default=None,
+                   help="comma-separated rule ids to skip for this run")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline file (show grandfathered findings)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="park all current unsuppressed findings in the "
+                        "baseline file (requires --reason)")
+    p.add_argument("--reason", default=None,
+                   help="reason string recorded on baseline entries")
+    p.add_argument("--statistics", action="store_true",
+                   help="append per-rule counts to text output")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    root = os.path.abspath(args.root) if args.root else api.repo_root()
+    cfg = load_config(root)
+
+    if args.list_rules:
+        for rule in all_rules(cfg):
+            print(f"{rule.id:24s} [{rule.severity}] {rule.description}")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+    disabled = set(cfg.get("disable") or ())
+    if args.disable:
+        disabled |= {r.strip() for r in args.disable.split(",") if r.strip()}
+
+    try:
+        rules = (get_rules(rule_ids, options=cfg) if rule_ids
+                 else [r for r in all_rules(cfg) if r.id not in disabled])
+    except KeyError as e:
+        print(f"fedlint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    baseline_path = os.path.join(root, cfg["baseline"])
+    entries = []
+    if not args.no_baseline and not args.write_baseline:
+        try:
+            entries = baseline_mod.load(baseline_path)
+        except (baseline_mod.BaselineError, ValueError) as e:
+            print(f"fedlint: bad baseline {baseline_path}: {e}", file=sys.stderr)
+            return 2
+
+    from .core import run as engine_run
+    result = engine_run(root, args.paths or cfg["paths"], rules,
+                        exclude=cfg["exclude"], baseline_entries=entries)
+
+    if args.write_baseline:
+        try:
+            n = baseline_mod.write(baseline_path, result.findings, args.reason or "")
+        except baseline_mod.BaselineError as e:
+            print(f"fedlint: {e}", file=sys.stderr)
+            return 2
+        print(f"fedlint: wrote {n} baseline entr{'y' if n == 1 else 'ies'} "
+              f"to {os.path.relpath(baseline_path, root)}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(result.to_json(), indent=2, sort_keys=True))
+        return result.exit_code()
+
+    for f in result.findings:
+        print(f.render())
+        if f.line_text.strip():
+            print(f"    {f.line_text.strip()}")
+    if result.stale_baseline:
+        for e in result.stale_baseline:
+            print(f"stale baseline entry: {e['path']} [{e['rule']}] — fixed? "
+                  "remove it from the baseline")
+    if args.statistics or result.findings:
+        by_rule: dict = {}
+        for f in result.findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        stats = ", ".join(f"{k}={v}" for k, v in sorted(by_rule.items())) or "none"
+        print(
+            f"\nfedlint: {len(result.findings)} finding(s) "
+            f"[{stats}] · {len(result.suppressed)} suppressed · "
+            f"{len(result.baselined)} baselined · "
+            f"{result.files_scanned} files")
+    elif not result.findings:
+        print(
+            f"fedlint: clean — {result.files_scanned} files, "
+            f"{len(result.suppressed)} suppressed, "
+            f"{len(result.baselined)} baselined")
+    return result.exit_code()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

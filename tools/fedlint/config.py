@@ -1,0 +1,129 @@
+"""``[tool.fedlint]`` config loading.
+
+Python 3.10 has no ``tomllib`` and the repo pins zero new dependencies, so
+this is a deliberately minimal TOML subset reader: table headers, string /
+bool / int scalars, and (possibly multi-line) arrays of strings. That covers
+the whole ``[tool.fedlint]`` block; anything fancier belongs in code, not
+config. When running on 3.11+ the real ``tomllib`` is used instead.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+DEFAULTS = {
+    # scan scope: the package, the bench driver, and the tooling itself.
+    # tests/ are deliberately excluded — lint fixtures must be able to spell
+    # violations (ISSUE 8).
+    "paths": ["fedml_tpu", "bench.py", "tools"],
+    "exclude": ["tests", "__pycache__", "native", "examples", "devops",
+                "fixtures"],
+    "baseline": "tools/fedlint/baseline.json",
+    # modules whose loops are latency-critical: one host sync per iteration
+    # multiplies into a bench collapse (r05: 985 tok/s int8 decode)
+    "hot-modules": [
+        "fedml_tpu/serving/continuous_batching.py",
+        "fedml_tpu/serving/replica_controller.py",
+        "fedml_tpu/serving/endpoint.py",
+        "fedml_tpu/core/aggregation/bucketed.py",
+        "fedml_tpu/core/aggregation/sharded.py",
+        "fedml_tpu/train/llm/llm_trainer.py",
+        "fedml_tpu/parallel/fsdp.py",
+    ],
+    # method names that run on listener/worker threads even though no
+    # Thread(target=...) names them directly (comm handler callbacks)
+    "thread-entry-methods": ["handle_receive_message"],
+    "disable": [],
+}
+
+_SECTION_RE = re.compile(r"^\s*\[(?P<name>[^\]]+)\]\s*$")
+_KEY_RE = re.compile(r"^\s*(?P<key>[A-Za-z0-9_\-\.\"']+)\s*=\s*(?P<val>.*)$")
+
+
+def _parse_scalar(text: str):
+    text = text.strip()
+    if text.startswith(("'", '"')):
+        return text[1:-1] if len(text) >= 2 else ""
+    if text in ("true", "false"):
+        return text == "true"
+    try:
+        return int(text)
+    except ValueError:
+        return text
+
+
+def _strip_comment(line: str) -> str:
+    # good enough for this block: '#' never appears inside our strings
+    out, in_str, quote = [], False, ""
+    for ch in line:
+        if in_str:
+            out.append(ch)
+            if ch == quote:
+                in_str = False
+        elif ch in ("'", '"'):
+            in_str, quote = True, ch
+            out.append(ch)
+        elif ch == "#":
+            break
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _parse_toml_subset(text: str) -> dict:
+    data: dict = {}
+    section: dict = data
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        line = _strip_comment(lines[i]).rstrip()
+        i += 1
+        if not line.strip():
+            continue
+        m = _SECTION_RE.match(line)
+        if m:
+            section = data
+            for part in m.group("name").split("."):
+                section = section.setdefault(part.strip(), {})
+            continue
+        m = _KEY_RE.match(line)
+        if not m:
+            continue
+        key = m.group("key").strip().strip("\"'")
+        val = m.group("val").strip()
+        if val.startswith("["):
+            buf = val
+            while "]" not in buf and i < len(lines):
+                buf += " " + _strip_comment(lines[i]).strip()
+                i += 1
+            inner = buf[buf.index("[") + 1: buf.rindex("]")]
+            items = [s for s in re.split(r"\s*,\s*", inner.strip()) if s]
+            section[key] = [_parse_scalar(s) for s in items]
+        else:
+            section[key] = _parse_scalar(val)
+    return data
+
+
+def load_config(root: str) -> dict:
+    """DEFAULTS overlaid with ``pyproject.toml [tool.fedlint]`` (if any)."""
+    cfg = {k: (list(v) if isinstance(v, list) else v)
+           for k, v in DEFAULTS.items()}
+    pyproject = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(pyproject):
+        return cfg
+    with open(pyproject, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        import tomllib  # Python 3.11+
+        data = tomllib.loads(text)
+    except ModuleNotFoundError:
+        data = _parse_toml_subset(text)
+    block = data.get("tool", {}).get("fedlint", {})
+    for key, val in block.items():
+        if isinstance(val, dict):
+            cfg.setdefault(key, {})
+            cfg[key] = {**cfg.get(key, {}), **val}
+        else:
+            cfg[key] = val
+    return cfg

@@ -1,0 +1,449 @@
+"""fedlint engine: one shared AST walk, a Rule plugin API, unified
+suppressions, and a reviewed baseline.
+
+Design (ISSUE 8):
+
+* **One walk per file.** The engine parses each file once, builds a
+  parent-link map and qualified-name/scope helpers (:class:`FileContext`),
+  and dispatches every node to the rules subscribed to its type
+  (``Rule.node_types``). Rules that need whole-module dataflow (donation
+  tracking, lock protection maps) implement ``check_file`` instead and get
+  the same parsed context. Tree-level rules (registries that must notice a
+  *missing* file) implement ``finalize``.
+
+* **Findings** carry rule id, severity, span (line/col), the offending
+  source line, and a stable fingerprint (rule + relpath + normalized line
+  text) so the baseline survives unrelated line drift.
+
+* **Suppression** is ONE syntax everywhere::
+
+      x = risky()  # fedlint: disable=rule-id[,rule-id] <reason>
+      # fedlint: disable-file=rule-id[,rule-id] <reason>
+
+  The pragma must sit on the reported line (file-level pragmas anywhere in
+  the file). A pragma without a reason is itself reported
+  (``bare-suppression``) — suppressions are reviewed artifacts, not mute
+  buttons. Legacy markers (``# wall-clock ok:``, ``# sleep ok:``) are still
+  honored by the two rules that introduced them so the ``check_*.py`` shims
+  keep their historical contracts; new code uses the unified syntax.
+
+* **Baseline**: a checked-in JSON file of grandfathered findings, every
+  entry carrying a mandatory reason. Matching findings are reported as
+  "baselined", not failures; stale entries (matching nothing) are reported
+  so the baseline only ever shrinks.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+SEVERITIES = ("error", "warn")
+
+# rule ids are kebab-case tokens; "all" is reserved for blanket pragmas
+_PRAGMA_RE = re.compile(
+    r"#\s*fedlint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\-]+)\s*(.*)$"
+)
+
+#: rule id used for suppression pragmas that carry no reason
+BARE_SUPPRESSION = "bare-suppression"
+#: rule id used for files the engine cannot parse
+SYNTAX_ERROR = "syntax-error"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str          # absolute
+    relpath: str       # relative to the run root, '/'-separated
+    line: int
+    col: int
+    message: str
+    line_text: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        basis = self.line_text.strip() or self.message
+        h = hashlib.sha1(
+            f"{self.rule}|{self.relpath}|{basis}".encode("utf-8", "replace")
+        )
+        return h.hexdigest()[:16]
+
+    def render(self) -> str:
+        loc = f"{self.relpath}:{self.line}"
+        if self.col:
+            loc += f":{self.col}"
+        return f"{loc}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.relpath,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "line_text": self.line_text.strip(),
+            "fingerprint": self.fingerprint,
+        }
+
+
+class _Suppressions:
+    """Per-file pragma table, parsed from real COMMENT tokens (so pragma
+    examples inside docstrings never count)."""
+
+    def __init__(self):
+        self.by_line: dict = {}      # lineno -> set of rule ids (or {"all"})
+        self.file_wide: set = set()
+        self.bare_lines: list = []   # linenos of reason-less pragmas
+
+    @classmethod
+    def scan(cls, source: str) -> "_Suppressions":
+        sup = cls()
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            comments = [(t.start[0], t.string) for t in tokens
+                        if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            comments = [(i, line) for i, line in
+                        enumerate(source.splitlines(), 1) if "#" in line]
+        for lineno, text in comments:
+            m = _PRAGMA_RE.search(text)
+            if not m:
+                continue
+            kind, rules_s, reason = m.groups()
+            rules = {r.strip() for r in rules_s.split(",") if r.strip()}
+            if not reason.strip():
+                sup.bare_lines.append(lineno)
+            if kind == "disable-file":
+                sup.file_wide |= rules
+            else:
+                sup.by_line.setdefault(lineno, set()).update(rules)
+        return sup
+
+    def matches(self, rule: str, line: int) -> bool:
+        if rule in self.file_wide or "all" in self.file_wide:
+            return True
+        at = self.by_line.get(line, ())
+        return rule in at or "all" in at
+
+
+class FileContext:
+    """Everything a rule may ask about one parsed file."""
+
+    def __init__(self, root: str, path: str, source: str, tree: ast.AST):
+        self.root = root
+        self.path = path
+        self.relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.parents: dict = {}
+        self.suppressions = _Suppressions.scan(source)
+        self._qualname_cache: dict = {}
+
+    # --- source access ---------------------------------------------------
+    def raw_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    # --- structure helpers ------------------------------------------------
+    def parent(self, node: ast.AST):
+        return self.parents.get(node)
+
+    def ancestors(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def enclosing_class(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+        return None
+
+    def in_loop(self, node: ast.AST) -> bool:
+        """True when ``node`` sits inside a for/while loop without crossing a
+        function boundary (a nested def resets hotness)."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+                cur = self.parents.get(cur)
+                continue
+            cur = self.parents.get(cur)
+        return False
+
+    def in_loop_strict(self, node: ast.AST) -> bool:
+        """Like :meth:`in_loop` but a function boundary stops the search —
+        code inside a nested helper def is that helper's business."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return False
+            cur = self.parents.get(cur)
+        return False
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted scope name: ``Class.method.<locals>.inner`` style without
+        the ``<locals>`` noise — ``Class.method.inner``."""
+        if node in self._qualname_cache:
+            return self._qualname_cache[node]
+        parts = []
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            parts.append(node.name)
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                parts.append(anc.name)
+        qn = ".".join(reversed(parts))
+        self._qualname_cache[node] = qn
+        return qn
+
+
+class Rule:
+    """Plugin base. Subclasses set ``id``/``severity``/``description`` and
+    implement one (or more) of:
+
+    * ``node_types`` + ``check_node(node, ctx)`` — per-node subscription on
+      the shared walk;
+    * ``check_file(ctx)`` — whole-module analyses (run after the walk, so
+      ``ctx.parents`` is complete);
+    * ``finalize(run)`` — tree-level checks after every file (missing-file
+      registries).
+
+    All three yield/return iterables of :class:`Finding`; use
+    :meth:`make` to build them consistently.
+    """
+
+    id: str = ""
+    severity: str = "error"
+    description: str = ""
+    node_types: tuple = ()
+
+    def configure(self, options: dict) -> None:
+        """Hook for [tool.fedlint] per-rule options; default ignores them."""
+
+    def applies_to(self, relpath: str) -> bool:
+        return True
+
+    def check_node(self, node: ast.AST, ctx: FileContext):
+        return ()
+
+    def check_file(self, ctx: FileContext):
+        return ()
+
+    def finalize(self, run: "RunContext"):
+        return ()
+
+    def make(self, ctx: FileContext, node, message: str) -> Finding:
+        if isinstance(node, int):
+            line, col = node, 0
+        else:
+            line = getattr(node, "lineno", 0)
+            col = getattr(node, "col_offset", 0)
+        return Finding(
+            rule=self.id, severity=self.severity, path=ctx.path,
+            relpath=ctx.relpath, line=line, col=col, message=message,
+            line_text=ctx.raw_line(line),
+        )
+
+
+@dataclass
+class RunContext:
+    root: str
+    files: list = field(default_factory=list)       # FileContext, parse OK
+    failed: list = field(default_factory=list)      # (path, SyntaxError)
+
+    def relpaths(self) -> set:
+        return {ctx.relpath for ctx in self.files}
+
+
+@dataclass
+class RunResult:
+    findings: list = field(default_factory=list)     # live, unsuppressed
+    suppressed: list = field(default_factory=list)   # (finding, "pragma")
+    baselined: list = field(default_factory=list)
+    stale_baseline: list = field(default_factory=list)  # baseline entries matching nothing
+    files_scanned: int = 0
+
+    @property
+    def errors(self):
+        return [f for f in self.findings if f.severity == "error"]
+
+    def exit_code(self) -> int:
+        return 1 if self.errors else 0
+
+    def to_json(self) -> dict:
+        return {
+            "files_scanned": self.files_scanned,
+            "counts": {
+                "findings": len(self.findings),
+                "errors": len(self.errors),
+                "suppressed": len(self.suppressed),
+                "baselined": len(self.baselined),
+                "stale_baseline": len(self.stale_baseline),
+            },
+            "findings": [f.to_json() for f in self.findings],
+            "suppressed": [f.to_json() for f in self.suppressed],
+            "baselined": [f.to_json() for f in self.baselined],
+            "stale_baseline": list(self.stale_baseline),
+        }
+
+
+# --- file discovery ---------------------------------------------------------
+
+def iter_py_files(root: str, paths, exclude):
+    """Yield absolute paths of .py files under ``paths`` (files or dirs,
+    relative to ``root``), pruning any directory whose name or root-relative
+    path is in ``exclude``."""
+    exclude = set(exclude or ())
+    seen = set()
+    for p in paths:
+        top = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(top):
+            if top not in seen:
+                seen.add(top)
+                yield top
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            rel_dir = os.path.relpath(dirpath, root).replace(os.sep, "/")
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in exclude
+                and f"{rel_dir}/{d}".lstrip("./") not in exclude
+                and not d.startswith(".")
+            )
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                if path not in seen:
+                    seen.add(path)
+                    yield path
+
+
+# --- the shared walk --------------------------------------------------------
+
+def _walk_and_dispatch(ctx: FileContext, dispatch: dict, sink: list):
+    """Single DFS: record parent links and hand each node to the rules
+    subscribed to its type."""
+    stack = [ctx.tree]
+    while stack:
+        node = stack.pop()
+        for rule in dispatch.get(type(node), ()):
+            sink.extend(
+                (rule, f) for f in (rule.check_node(node, ctx) or ())
+            )
+        children = list(ast.iter_child_nodes(node))
+        for child in children:
+            ctx.parents[child] = node
+        stack.extend(reversed(children))
+
+
+def run(root: str, paths, rules, exclude=(), baseline_entries=()) -> RunResult:
+    """Run ``rules`` over every .py under ``paths``; returns a
+    :class:`RunResult` with pragma-suppression and baseline applied.
+
+    ``baseline_entries`` is an iterable of dicts with ``rule``, ``path``,
+    ``fingerprint`` (see :mod:`tools.fedlint.baseline`).
+    """
+    root = os.path.abspath(root)
+    runctx = RunContext(root=root)
+    result = RunResult()
+
+    dispatch: dict = {}
+    for rule in rules:
+        for nt in rule.node_types:
+            dispatch.setdefault(nt, []).append(rule)
+
+    raw: list = []  # (rule_obj_or_None, Finding)
+
+    for path in iter_py_files(root, paths, exclude):
+        result.files_scanned += 1
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            relpath = os.path.relpath(path, root).replace(os.sep, "/")
+            result.findings.append(Finding(
+                rule=SYNTAX_ERROR, severity="error", path=path,
+                relpath=relpath, line=e.lineno or 0, col=e.offset or 0,
+                message=f"unparseable: {e.msg}"))
+            runctx.failed.append((path, e))
+            continue
+        ctx = FileContext(root, path, source, tree)
+        runctx.files.append(ctx)
+
+        active = [r for r in rules if r.applies_to(ctx.relpath)]
+        for rule in active:
+            begin = getattr(rule, "begin_file", None)
+            if begin is not None:
+                begin(ctx)
+        file_dispatch = {
+            nt: [r for r in rs if r in active] for nt, rs in dispatch.items()
+        }
+        _walk_and_dispatch(ctx, file_dispatch, raw)
+        for rule in active:
+            raw.extend((rule, f) for f in (rule.check_file(ctx) or ()))
+
+        for lineno in ctx.suppressions.bare_lines:
+            raw.append((None, Finding(
+                rule=BARE_SUPPRESSION, severity="error", path=path,
+                relpath=ctx.relpath, line=lineno, col=0,
+                message="suppression pragma without a reason — write "
+                        "`# fedlint: disable=<rule> <why it is safe>`",
+                line_text=ctx.raw_line(lineno))))
+
+    for rule in rules:
+        for f in rule.finalize(runctx) or ():
+            raw.append((rule, f))
+
+    # --- suppression + baseline filters ---
+    by_ctx = {ctx.path: ctx for ctx in runctx.files}
+    baseline_keys = {}
+    for e in baseline_entries or ():
+        baseline_keys.setdefault(
+            (e.get("rule"), e.get("path"), e.get("fingerprint")), []).append(e)
+    matched_baseline = set()
+
+    for rule, finding in raw:
+        ctx = by_ctx.get(finding.path)
+        if (ctx is not None
+                and finding.rule != BARE_SUPPRESSION
+                and ctx.suppressions.matches(finding.rule, finding.line)):
+            result.suppressed.append(finding)
+            continue
+        key = (finding.rule, finding.relpath, finding.fingerprint)
+        if key in baseline_keys:
+            matched_baseline.add(key)
+            result.baselined.append(finding)
+            continue
+        result.findings.append(finding)
+
+    for key, entries in baseline_keys.items():
+        if key not in matched_baseline:
+            result.stale_baseline.extend(entries)
+
+    result.findings.sort(key=lambda f: (f.relpath, f.line, f.rule))
+    return result

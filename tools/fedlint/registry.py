@@ -1,0 +1,48 @@
+"""Rule registry: the one place that knows every rule."""
+
+from __future__ import annotations
+
+from .rules.donation import DonationMisuseRule
+from .rules.host_sync import HostSyncRule
+from .rules.locking import LockDisciplineRule
+from .rules.resilience import BareSleepRule, OrbaxContainmentRule
+from .rules.retrace import RetraceRiskRule
+from .rules.serving import HotSpanRule
+from .rules.sharding import DeviceGetRule, ShardingContainmentRule
+from .rules.telemetry import ExcepthookRule, RecorderKindRule, ReservedKeyRule
+from .rules.timing import WallClockRule
+
+_RULE_CLASSES = (
+    # ported from the five check_*.py walkers (PRs 2–7)
+    WallClockRule,
+    ReservedKeyRule,
+    RecorderKindRule,
+    ExcepthookRule,
+    BareSleepRule,
+    OrbaxContainmentRule,
+    HotSpanRule,
+    ShardingContainmentRule,
+    DeviceGetRule,
+    # the JAX-aware rules none of the ad-hoc walkers could express (ISSUE 8)
+    RetraceRiskRule,
+    HostSyncRule,
+    DonationMisuseRule,
+    LockDisciplineRule,
+)
+
+
+def all_rules(options: dict = None) -> list:
+    rules = [cls() for cls in _RULE_CLASSES]
+    if options:
+        for rule in rules:
+            rule.configure(options)
+    return rules
+
+
+def get_rules(ids, options: dict = None) -> list:
+    wanted = set(ids)
+    rules = [r for r in all_rules(options) if r.id in wanted]
+    missing = wanted - {r.id for r in rules}
+    if missing:
+        raise KeyError(f"unknown fedlint rule id(s): {sorted(missing)}")
+    return rules

@@ -1,0 +1,2 @@
+"""fedlint rule modules. Each module defines one rule family; the registry
+(:mod:`tools.fedlint.registry`) instantiates them all."""

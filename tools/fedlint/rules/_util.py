@@ -1,0 +1,79 @@
+"""Shared helpers for rule implementations."""
+
+from __future__ import annotations
+
+import ast
+
+
+def dotted(node: ast.AST) -> str:
+    """Render a Name/Attribute chain as ``a.b.c`` ('' when not a chain)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def pkg_rel(relpath: str, package: str = "fedml_tpu") -> str:
+    """Path relative to the package dir, whether the scan root is the repo
+    (``fedml_tpu/core/x.py`` -> ``core/x.py``) or the package itself
+    (legacy shims pass the package dir — already ``core/x.py``)."""
+    prefix = package + "/"
+    if relpath.startswith(prefix):
+        return relpath[len(prefix):]
+    return relpath
+
+
+def matches_file(relpath: str, target: str) -> bool:
+    """True when ``relpath`` names ``target`` (exact or trailing-path match,
+    so rules work from both repo-rooted and package-rooted scans)."""
+    return relpath == target or relpath.endswith("/" + target)
+
+
+def is_jit_callable(node: ast.AST) -> bool:
+    """True for ``jit`` / ``jax.jit`` / ``pjit`` / ``jax.pjit`` references."""
+    if isinstance(node, ast.Name):
+        return node.id in ("jit", "pjit")
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return node.attr in ("jit", "pjit") and node.value.id == "jax"
+    return False
+
+
+def param_names(fn: ast.AST) -> set:
+    a = fn.args
+    names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def const_int_tuple(node: ast.AST):
+    """Parse ``0`` / ``(0, 2)`` / ``[0]`` of int constants, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant) and isinstance(elt.value, int)):
+                return None
+            vals.append(elt.value)
+        return tuple(vals)
+    return None
+
+
+def const_str_tuple(node: ast.AST):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant) and isinstance(elt.value, str)):
+                return None
+            vals.append(elt.value)
+        return tuple(vals)
+    return None

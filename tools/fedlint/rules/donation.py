@@ -1,0 +1,190 @@
+"""``donation-misuse``: a donated buffer read after the donating call.
+
+``donate_argnums`` hands the argument's device buffer to XLA for reuse —
+after the call the Python reference points at invalidated memory, and JAX
+raises (or silently copies, depending on backend) on the next read. The
+aggregation engine and the fused server step lean hard on donation
+(PR 1/PR 7); this rule keeps the discipline honest:
+
+* it collects every donating callable in the module — ``name = jax.jit(fn,
+  donate_argnums=...)``, ``self._step = jax.jit(..., donate_argnums=...)``
+  and ``@partial(jax.jit, donate_argnums=...)`` decorations (plus
+  ``donate_argnames`` resolved against the wrapped def when visible);
+* at each call site, a plain-name argument in a donated position whose
+  name is read again later in the same function — with no rebinding in
+  between — is a finding. The canonical safe shape ``state = step(state)``
+  rebinds at the call statement itself and is never flagged.
+
+Known-safe re-reads (e.g. an error path that only logs shapes) get
+``# fedlint: disable=donation-misuse <why the buffer is not dereferenced>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+from ._util import const_int_tuple, const_str_tuple, dotted, is_jit_callable, param_names
+
+
+def _donation_keywords(call: ast.Call):
+    """(argnums tuple or None, argnames tuple or None) from a jit call."""
+    nums = names = None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums = const_int_tuple(kw.value)
+        elif kw.arg == "donate_argnames":
+            names = const_str_tuple(kw.value)
+    return nums, names
+
+
+def _jit_call_with_donation(call: ast.Call):
+    """For ``jax.jit(fn?, donate_...)`` or ``partial(jax.jit, donate_...)``
+    return (wrapped_name_or_None, argnums, argnames); else None."""
+    if is_jit_callable(call.func):
+        nums, names = _donation_keywords(call)
+        if nums is None and names is None:
+            return None
+        wrapped = None
+        if call.args and isinstance(call.args[0], ast.Name):
+            wrapped = call.args[0].id
+        return wrapped, nums, names
+    func = call.func
+    is_partial = (isinstance(func, ast.Name) and func.id == "partial") or (
+        isinstance(func, ast.Attribute) and func.attr == "partial")
+    if is_partial and call.args and is_jit_callable(call.args[0]):
+        nums, names = _donation_keywords(call)
+        if nums is None and names is None:
+            return None
+        return None, nums, names
+    return None
+
+
+def _names_to_nums(names, fn_def):
+    if not names or fn_def is None:
+        return ()
+    order = [p.arg for p in fn_def.args.posonlyargs + fn_def.args.args]
+    return tuple(order.index(n) for n in names if n in order)
+
+
+class DonationMisuseRule(Rule):
+    id = "donation-misuse"
+    severity = "error"
+    description = "variable read again after being donated to a jitted call"
+
+    def check_file(self, ctx):
+        defs_by_name: dict = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, node)
+
+        donors: dict = {}  # dotted callee name -> tuple of donated positions
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                parsed = _jit_call_with_donation(node.value)
+                if parsed:
+                    wrapped, nums, names = parsed
+                    positions = tuple(nums or ()) + _names_to_nums(
+                        names, defs_by_name.get(wrapped))
+                    if positions:
+                        for tgt in node.targets:
+                            key = dotted(tgt)
+                            if key:
+                                donors[key] = positions
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        parsed = _jit_call_with_donation(dec)
+                        if parsed:
+                            _w, nums, names = parsed
+                            positions = tuple(nums or ()) + _names_to_nums(
+                                names, node)
+                            if positions:
+                                donors[node.name] = positions
+        if not donors:
+            return
+
+        for scope in self._scopes(ctx.tree):
+            yield from self._check_scope(scope, donors, ctx)
+
+    def _scopes(self, tree):
+        yield tree
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    def _check_scope(self, scope, donors, ctx):
+        # own nodes only: stop at nested function boundaries
+        own: list = []
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            own.append(node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+        calls = [n for n in own if isinstance(n, ast.Call)
+                 and dotted(n.func) in donors]
+        if not calls:
+            return
+        names_in_scope = [n for n in own if isinstance(n, ast.Name)]
+        for call in calls:
+            positions = donors[dotted(call.func)]
+            call_end = getattr(call, "end_lineno", call.lineno)
+            stmt = self._statement_of(call, ctx, scope)
+            stmt_binds = self._bound_names(stmt)
+            for pos in positions:
+                if pos >= len(call.args):
+                    continue
+                arg = call.args[pos]
+                if not isinstance(arg, ast.Name):
+                    continue
+                if arg.id in stmt_binds:
+                    continue  # state = step(state): rebinding at the call
+                later_reads = sorted(
+                    (n for n in names_in_scope
+                     if n.id == arg.id and isinstance(n.ctx, ast.Load)
+                     and n.lineno > call_end),
+                    key=lambda n: (n.lineno, n.col_offset))
+                rebinds = sorted(
+                    n.lineno for n in names_in_scope
+                    if n.id == arg.id and isinstance(n.ctx, ast.Store)
+                    and n.lineno > call_end)
+                for read in later_reads:
+                    if any(rl <= read.lineno for rl in rebinds):
+                        break  # rebound before (or on the line of) this read
+                    yield self.make(
+                        ctx, read,
+                        f"`{arg.id}` read after being donated (position "
+                        f"{pos}) to `{dotted(call.func)}` at line "
+                        f"{call.lineno} — the buffer is invalidated by "
+                        "donate_argnums; use the call's return value or "
+                        "drop the donation")
+                    break
+
+    def _statement_of(self, node, ctx, scope):
+        cur = node
+        while cur is not None and cur is not scope:
+            parent = ctx.parent(cur)
+            if isinstance(cur, ast.stmt):
+                return cur
+            cur = parent
+        return node
+
+    def _bound_names(self, stmt) -> set:
+        bound = set()
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        bound.add(n.id)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            for n in ast.walk(stmt.target):
+                if isinstance(n, ast.Name):
+                    bound.add(n.id)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            for n in ast.walk(stmt.target):
+                if isinstance(n, ast.Name):
+                    bound.add(n.id)
+        return bound

@@ -1,0 +1,82 @@
+"""``host-sync``: device→host round-trips inside hot loops.
+
+A single ``.item()`` / ``np.asarray`` / ``device_get`` per loop iteration
+serializes the device stream against the host — at decode cadence that is
+the difference between 370k tok/s and 985 tok/s (bench r05). This rule
+generalizes check_sharding's device_get ban to every *registered hot
+module* (``[tool.fedlint] hot-modules`` in pyproject.toml): inside any
+``for``/``while`` loop body (not crossing into nested defs — those are
+usually the jitted payload), it flags
+
+* ``.item()`` and ``.block_until_ready()`` calls,
+* ``np.asarray(...)`` / ``jax.device_get(...)``,
+* ``float()/int()/bool()`` applied to an expression that touches
+  ``jnp.``/``jax.`` (host scalarization of a device value).
+
+Legitimate per-loop syncs exist (an EOS check between chunks, a final
+drain) — suppress with ``# fedlint: disable=host-sync <why once-per-chunk
+is the design>``; the pragma is the reviewable record that the sync is a
+decision, not an accident.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+from ._util import matches_file
+
+
+class HostSyncRule(Rule):
+    id = "host-sync"
+    severity = "error"
+    description = "device→host sync inside a hot-module loop"
+    node_types = (ast.Call,)
+
+    def __init__(self):
+        self.hot_modules: tuple = ()
+
+    def configure(self, options):
+        mods = options.get("hot-modules")
+        if mods:
+            self.hot_modules = tuple(mods)
+
+    def applies_to(self, relpath):
+        return any(matches_file(relpath, m) or relpath == m
+                   for m in self.hot_modules)
+
+    def check_node(self, node, ctx):
+        if not ctx.in_loop_strict(node):
+            return
+        func = node.func
+        msg = None
+        if isinstance(func, ast.Attribute):
+            if func.attr == "item" and not node.args:
+                msg = ".item() inside a hot loop — one device→host sync per iteration"
+            elif func.attr == "block_until_ready":
+                msg = (".block_until_ready() inside a hot loop — serializes "
+                       "the device stream every iteration")
+            elif (func.attr == "asarray" and isinstance(func.value, ast.Name)
+                  and func.value.id in ("np", "numpy", "onp")):
+                msg = ("np.asarray() inside a hot loop — materializes the "
+                       "array host-side every iteration")
+            elif func.attr == "device_get":
+                msg = ("device_get inside a hot loop — host gather per "
+                       "iteration with zero byte accounting")
+        elif isinstance(func, ast.Name):
+            if func.id == "device_get":
+                msg = ("device_get inside a hot loop — host gather per "
+                       "iteration with zero byte accounting")
+            elif func.id in ("float", "int", "bool") and len(node.args) == 1:
+                touches_device = any(
+                    isinstance(n, ast.Name) and n.id in ("jnp", "jax")
+                    for n in ast.walk(node.args[0]))
+                if touches_device:
+                    msg = (f"{func.id}() scalarizes a device value inside a "
+                           "hot loop — one blocking transfer per iteration")
+        if msg:
+            yield self.make(
+                ctx, node,
+                msg + "; hoist it out of the loop, batch it per chunk, or "
+                "record the design decision with "
+                "`# fedlint: disable=host-sync <reason>`")

@@ -1,0 +1,295 @@
+"""``lock-discipline``: shared mutable state written off-thread without the
+lock that guards it everywhere else.
+
+The codebase is full of worker threads — comm handler callbacks, the
+continuous-batching worker, the async checkpoint waiter, statusz/metrics
+servers — and every one of them shares state with the main thread. The
+convention is consistent: state mutated from a worker is guarded by a
+``threading.Lock``/``RLock``/``Condition`` held in a ``with`` block. This
+rule mechanizes the convention:
+
+* **protected map** — for each class, every ``self.<attr>`` written (or
+  mutated via ``.append/.pop/...``) inside ``with self.<lock>:`` anywhere
+  in the class is recorded as guarded by that lock. Module-level globals
+  written under a module-level lock are tracked the same way.
+* **entry points** — methods handed to ``threading.Thread(target=...)``,
+  callbacks registered via ``register_message_receive_handler``, and the
+  method names in ``[tool.fedlint] thread-entry-methods`` (default:
+  ``handle_receive_message``) run off-thread.
+* a write to a *protected* attribute from an *entry point* that is not
+  itself under a ``with`` on one of that attribute's locks is a finding.
+
+Benign unlocked writes (thread-confined state, pre-start initialization)
+get ``# fedlint: disable=lock-discipline <why no lock is needed>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+from ._util import dotted
+
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore")
+_MUTATORS = ("append", "extend", "add", "insert", "remove", "discard", "pop",
+             "popleft", "appendleft", "clear", "update", "setdefault")
+
+
+def _is_lock_ctor(call: ast.AST) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _LOCK_FACTORIES:
+        return isinstance(f.value, ast.Name) and f.value.id == "threading"
+    if isinstance(f, ast.Name) and f.id in _LOCK_FACTORIES:
+        return True
+    return False
+
+
+def _self_attr(node: ast.AST):
+    """'attr' for a ``self.attr`` chain head, else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _written_self_attrs(node: ast.AST):
+    """(attr, anchor_node) pairs for self-state mutations inside ``node``."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Assign):
+            for tgt in sub.targets:
+                attr = _self_attr(tgt)
+                if attr:
+                    yield attr, sub
+                # self.x[k] = v
+                if isinstance(tgt, ast.Subscript):
+                    attr = _self_attr(tgt.value)
+                    if attr:
+                        yield attr, sub
+        elif isinstance(sub, ast.AugAssign):
+            attr = _self_attr(sub.target)
+            if attr:
+                yield attr, sub
+            if isinstance(sub.target, ast.Subscript):
+                attr = _self_attr(sub.target.value)
+                if attr:
+                    yield attr, sub
+        elif isinstance(sub, ast.Call):
+            f = sub.func
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                attr = _self_attr(f.value)
+                if attr:
+                    yield attr, sub
+
+
+def _written_globals(fn: ast.AST):
+    declared = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Global):
+            declared.update(sub.names)
+    if not declared:
+        return
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Assign):
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Name) and tgt.id in declared:
+                    yield tgt.id, sub
+        elif isinstance(sub, ast.AugAssign):
+            if isinstance(sub.target, ast.Name) and sub.target.id in declared:
+                yield sub.target.id, sub
+
+
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    severity = "error"
+    description = ("shared state written from a thread-worker entry point "
+                   "without the lock guarding it elsewhere")
+
+    def __init__(self):
+        self.entry_methods: tuple = ("handle_receive_message",)
+
+    def configure(self, options):
+        methods = options.get("thread-entry-methods")
+        if methods:
+            self.entry_methods = tuple(methods)
+
+    # ------------------------------------------------------------------
+    def check_file(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(node, ctx)
+        yield from self._check_module_level(ctx)
+
+    # ------------------------------------------------------------------
+    def _check_class(self, cls, ctx):
+        lock_attrs = set()
+        aliases = {}  # Condition attr -> the Lock it wraps
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr:
+                        lock_attrs.add(attr)
+                        # self._work = threading.Condition(self._lock):
+                        # holding the condition IS holding the lock
+                        call = node.value
+                        if call.args:
+                            inner = _self_attr(call.args[0])
+                            if inner:
+                                aliases[attr] = inner
+        if not lock_attrs:
+            return
+
+        def canon(attr):
+            seen = set()
+            while attr in aliases and attr not in seen:
+                seen.add(attr)
+                attr = aliases[attr]
+            return attr
+
+        # attr -> set of lock attrs seen guarding it anywhere in the class
+        protected: dict = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            held = {
+                canon(_self_attr(item.context_expr))
+                for item in node.items
+                if _self_attr(item.context_expr) in lock_attrs
+            } - {None}
+            if not held:
+                continue
+            for attr, _anchor in _written_self_attrs(node):
+                protected.setdefault(attr, set()).update(held)
+        if not protected:
+            return
+
+        entries = self._entry_methods(cls)
+        for meth in entries:
+            for attr, anchor in _written_self_attrs(meth):
+                locks = protected.get(attr)
+                if not locks:
+                    continue
+                if self._held_at(anchor, locks, meth, ctx, canon):
+                    continue
+                lock_names = " / ".join(f"self.{l}" for l in sorted(locks))
+                yield self.make(
+                    ctx, anchor,
+                    f"`self.{attr}` written on thread-entry path "
+                    f"{cls.name}.{meth.name}() without holding "
+                    f"{lock_names} — the lock that guards it everywhere "
+                    "else; wrap the write in `with ...:` or record why the "
+                    "state is thread-confined")
+
+    def _entry_methods(self, cls):
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        entries = {}
+        for name in self.entry_methods:
+            if name in methods:
+                entries[name] = methods[name]
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_thread = ((isinstance(f, ast.Name) and f.id == "Thread")
+                         or (isinstance(f, ast.Attribute) and f.attr == "Thread"))
+            if is_thread:
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        attr = _self_attr(kw.value)
+                        if attr and attr in methods:
+                            entries[attr] = methods[attr]
+            is_register = (isinstance(f, ast.Attribute)
+                           and f.attr == "register_message_receive_handler")
+            if is_register:
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    attr = _self_attr(arg)
+                    if attr and attr in methods:
+                        entries[attr] = methods[attr]
+        return list(entries.values())
+
+    def _held_at(self, node, locks, boundary, ctx, canon) -> bool:
+        cur = ctx.parent(node)
+        while cur is not None and cur is not boundary:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                held = {canon(_self_attr(i.context_expr))
+                        for i in cur.items if _self_attr(i.context_expr)}
+                if held & locks:
+                    return True
+            cur = ctx.parent(cur)
+        return False
+
+    # ------------------------------------------------------------------
+    def _check_module_level(self, ctx):
+        lock_names = set()
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        lock_names.add(tgt.id)
+        if not lock_names:
+            return
+
+        protected: dict = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            held = set()
+            for item in node.items:
+                if (isinstance(item.context_expr, ast.Name)
+                        and item.context_expr.id in lock_names):
+                    held.add(item.context_expr.id)
+            if not held:
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            protected.setdefault(tgt.id, set()).update(held)
+                elif isinstance(sub, ast.AugAssign):
+                    if isinstance(sub.target, ast.Name):
+                        protected.setdefault(sub.target.id, set()).update(held)
+        if not protected:
+            return
+
+        module_defs = {n.name: n for n in ctx.tree.body
+                       if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        targets = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_thread = ((isinstance(f, ast.Name) and f.id == "Thread")
+                         or (isinstance(f, ast.Attribute) and f.attr == "Thread"))
+            if not is_thread:
+                continue
+            for kw in node.keywords:
+                if (kw.arg == "target" and isinstance(kw.value, ast.Name)
+                        and kw.value.id in module_defs):
+                    targets.add(kw.value.id)
+        for name in sorted(targets):
+            fn = module_defs[name]
+            for gname, anchor in _written_globals(fn):
+                locks = protected.get(gname)
+                if not locks:
+                    continue
+                if self._global_held_at(anchor, locks, fn, ctx):
+                    continue
+                yield self.make(
+                    ctx, anchor,
+                    f"global `{gname}` written in thread target `{name}()` "
+                    f"without holding {'/'.join(sorted(locks))} — the lock "
+                    "that guards it elsewhere in this module")
+
+    def _global_held_at(self, node, locks, boundary, ctx) -> bool:
+        cur = ctx.parent(node)
+        while cur is not None and cur is not boundary:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                for item in cur.items:
+                    if (isinstance(item.context_expr, ast.Name)
+                            and item.context_expr.id in locks):
+                        return True
+            cur = ctx.parent(cur)
+        return False

@@ -1,0 +1,87 @@
+"""Resilience-idiom rules (ported from tools/check_resilience.py, PR 5):
+
+* ``bare-sleep`` — ``time.sleep()`` outside ``core/resilience/retry.py``
+  needs a reason. Hand-rolled ``for attempt in range(n): ... sleep(...)``
+  loops are how unbounded, untelemetered retries creep back in — transient
+  failures belong to ``fedml_tpu.core.resilience.retry`` (jittered,
+  budget-capped, flight-recorder-booked). Legitimate non-retry sleeps
+  (chaos injection, polling an external process, rate pacing) get
+  ``# fedlint: disable=bare-sleep <which one>``.
+* ``orbax`` — orbax checkpointers may be touched only by
+  ``fedml_tpu/utils/checkpoint.py``: its async save + watermark commit is
+  what makes crash-resume pick a *complete* step; a direct orbax save would
+  reintroduce torn checkpoints.
+
+The legacy ``# sleep ok: <reason>`` marker is still honored so the
+``tools/check_resilience.py`` shim keeps its historical contract.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+from ._util import matches_file
+
+LEGACY_MARKER = "sleep ok"
+RETRY_HOME = "core/resilience/retry.py"
+CHECKPOINT_HOME = "utils/checkpoint.py"
+
+
+class BareSleepRule(Rule):
+    id = "bare-sleep"
+    severity = "error"
+    description = ("time.sleep() outside the retry module without a reason "
+                   "— retries belong to core.resilience.retry")
+    node_types = (ast.Call,)
+
+    def applies_to(self, relpath):
+        return not matches_file(relpath, RETRY_HOME)
+
+    def check_node(self, node, ctx):
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "sleep"
+                and isinstance(func.value, ast.Name)
+                and "time" in func.value.id):
+            return
+        if LEGACY_MARKER in ctx.raw_line(node.lineno):
+            return
+        yield self.make(
+            ctx, node,
+            "unmarked time.sleep(): retries belong to "
+            "fedml_tpu.core.resilience.retry (jittered, budget-capped); "
+            "legitimate non-retry sleeps need "
+            "`# fedlint: disable=bare-sleep <reason>`",
+        )
+
+
+class OrbaxContainmentRule(Rule):
+    id = "orbax"
+    severity = "error"
+    description = ("direct orbax use outside utils/checkpoint.py bypasses "
+                   "the watermark commit")
+    node_types = (ast.Import, ast.ImportFrom, ast.Attribute)
+
+    def applies_to(self, relpath):
+        return not matches_file(relpath, CHECKPOINT_HOME)
+
+    def check_node(self, node, ctx):
+        hit = False
+        if isinstance(node, ast.Import):
+            hit = any(a.name == "orbax" or a.name.startswith("orbax.")
+                      for a in node.names)
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            hit = mod == "orbax" or mod.startswith("orbax.")
+        elif isinstance(node, ast.Attribute):
+            hit = (node.attr == "CheckpointManager"
+                   and isinstance(node.value, ast.Name)
+                   and node.value.id == "ocp")
+        if hit:
+            yield self.make(
+                ctx, node,
+                "orbax outside utils/checkpoint.py: checkpoint writes go "
+                "through fedml_tpu.utils.checkpoint.CheckpointManager "
+                "(async save + watermark commit) — a direct orbax save "
+                "reintroduces torn checkpoints",
+            )

@@ -1,0 +1,171 @@
+"""``retrace-risk``: host values captured inside jit traces.
+
+The r05 int8-decode collapse (985 tok/s against a 370k tok/s chip) was a
+per-step retrace: a Python value baked into a jitted closure changed every
+step, so XLA recompiled every step. This rule flags the capture patterns
+that cause exactly that, inside any function that is jit-compiled —
+``@jit`` / ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated, or wrapped
+via ``name = jax.jit(fn, ...)`` in the same module:
+
+* ``args.<x>`` reads where ``args`` is a free variable — the argparse
+  namespace is a mutable grab-bag; every distinct value is a new trace.
+  Pass the value as an argument (or hash it into static_argnums).
+* closure dict lookups ``cfg["key"]`` on a free lowercase name — same
+  failure mode with one more level of indirection. (ALL_CAPS module
+  constants are deliberate static baking and are skipped.)
+* f-strings formatting a traced parameter — host-side string formatting
+  forces concretization at trace time.
+* ``if``/``while`` branching on a bare traced parameter — Python control
+  flow runs at trace time; use ``lax.cond``/``jnp.where``. (``is None``
+  checks, ``.shape``/``.ndim``/``.dtype`` accesses and ``len()`` are
+  static under jit and are skipped.)
+
+A jit site that declares ``static_argnums``/``static_argnames`` has
+thought about the static/traced split and is exempted wholesale — the
+point is to catch the *unconsidered* captures.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+from ._util import is_jit_callable, param_names
+
+_STATIC_KEYWORDS = ("static_argnums", "static_argnames")
+_STATIC_ATTRS = ("shape", "ndim", "dtype", "size", "sharding")
+
+
+def _jit_site(call: ast.Call):
+    """(is_jit, has_static) for a Call node."""
+    if is_jit_callable(call.func):
+        has_static = any(k.arg in _STATIC_KEYWORDS for k in call.keywords)
+        return True, has_static
+    # partial(jax.jit, ...) / functools.partial(jit, ...)
+    func = call.func
+    is_partial = (isinstance(func, ast.Name) and func.id == "partial") or (
+        isinstance(func, ast.Attribute) and func.attr == "partial")
+    if is_partial and call.args and is_jit_callable(call.args[0]):
+        has_static = any(k.arg in _STATIC_KEYWORDS for k in call.keywords)
+        return True, has_static
+    return False, False
+
+
+class RetraceRiskRule(Rule):
+    id = "retrace-risk"
+    severity = "error"
+    description = ("host value captured inside a jit trace — every new "
+                   "value recompiles")
+
+    def check_file(self, ctx):
+        jitted: list = []  # (FunctionDef, has_static)
+        defs_by_name: dict = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, node)
+                for dec in node.decorator_list:
+                    if is_jit_callable(dec):
+                        jitted.append((node, False))
+                    elif isinstance(dec, ast.Call):
+                        is_jit, has_static = _jit_site(dec)
+                        if is_jit:
+                            jitted.append((node, has_static))
+            elif isinstance(node, ast.Call):
+                is_jit, has_static = _jit_site(node)
+                if is_jit and node.args:
+                    wrapped = node.args[0]
+                    # peel instrumentation wrappers taking the fn as first
+                    # positional arg: jax.jit(tel.track_compiles(run, ...))
+                    while isinstance(wrapped, ast.Call) and wrapped.args:
+                        wrapped = wrapped.args[0]
+                    if (isinstance(wrapped, ast.Name)
+                            and wrapped.id in defs_by_name):
+                        jitted.append((defs_by_name[wrapped.id], has_static))
+        seen = set()
+        for fn, has_static in jitted:
+            if id(fn) in seen or has_static:
+                continue
+            seen.add(id(fn))
+            yield from self._check_jitted(fn, ctx)
+
+    def _check_jitted(self, fn, ctx):
+        params = param_names(fn)
+        local_stores = {
+            n.id for n in ast.walk(fn)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+        }
+        bound = params | local_stores
+        for node in ast.walk(fn):
+            if node is fn:
+                continue
+            if isinstance(node, ast.Attribute):
+                base = node.value
+                if (isinstance(base, ast.Name) and base.id == "args"
+                        and "args" not in bound
+                        and isinstance(node.ctx, ast.Load)):
+                    yield self.make(
+                        ctx, node,
+                        f"`args.{node.attr}` captured from the enclosing "
+                        f"scope inside jitted `{fn.name}` — each new value "
+                        "retraces; pass it as a traced argument or bind it "
+                        "before the jit boundary")
+            elif isinstance(node, ast.Subscript):
+                base = node.value
+                key = node.slice
+                if (isinstance(base, ast.Name) and base.id not in bound
+                        and not base.id.isupper()
+                        and isinstance(node.ctx, ast.Load)
+                        and isinstance(key, ast.Constant)
+                        and isinstance(key.value, str)):
+                    yield self.make(
+                        ctx, node,
+                        f"closure dict lookup `{base.id}[{key.value!r}]` "
+                        f"inside jitted `{fn.name}` — the value is baked at "
+                        "trace time and a changed entry retraces silently")
+            elif isinstance(node, ast.JoinedStr):
+                for fv in node.values:
+                    if not isinstance(fv, ast.FormattedValue):
+                        continue
+                    names = [n.id for n in ast.walk(fv.value)
+                             if isinstance(n, ast.Name) and n.id in params]
+                    if names:
+                        yield self.make(
+                            ctx, node,
+                            f"f-string formats traced value(s) "
+                            f"{sorted(set(names))} inside jitted "
+                            f"`{fn.name}` — host formatting concretizes at "
+                            "trace time")
+                        break
+            elif isinstance(node, (ast.If, ast.While)):
+                yield from self._check_branch(node, fn, params, ctx)
+
+    def _check_branch(self, node, fn, params, ctx):
+        test = node.test
+        if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return
+        for sub in ast.walk(test):
+            if not (isinstance(sub, ast.Name) and sub.id in params
+                    and isinstance(sub.ctx, ast.Load)):
+                continue
+            parent = ctx.parent(sub)
+            # x.shape / x.ndim / len(x) / isinstance(x, T) are static
+            if isinstance(parent, ast.Attribute) and parent.attr in _STATIC_ATTRS:
+                continue
+            if isinstance(parent, ast.Attribute):
+                continue  # any attribute read — give methods the benefit
+            if isinstance(parent, ast.Call):
+                fname = parent.func.id if isinstance(parent.func, ast.Name) else ""
+                if fname in ("len", "isinstance", "getattr", "hasattr"):
+                    continue
+            if isinstance(parent, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops):
+                continue
+            kind = "while" if isinstance(node, ast.While) else "if"
+            yield self.make(
+                ctx, node,
+                f"`{kind}` branches on traced parameter `{sub.id}` inside "
+                f"jitted `{fn.name}` — Python control flow runs at trace "
+                "time (ConcretizationTypeError or silent retrace); use "
+                "lax.cond/lax.select/jnp.where")
+            return

@@ -1,0 +1,98 @@
+"""``hot-span``: serving hot loops keep their telemetry spans (ported from
+tools/check_serving.py, PR 6).
+
+The serving hot paths — the continuous-batching engine's admit/step loop
+and the gateway's forward path — must time themselves through
+``tel.timed(``/``tel.span(`` (perf_counter-based): an uninstrumented hot
+loop is how the r05 endpoint collapse (14.5 tok/s against a 370k tok/s
+chip) stayed invisible until a full bench window. The registry below names
+the functions that MUST contain a span call; deleting the instrumentation
+— or renaming a registered function/file without updating the registry —
+is a finding (silently skipping a stale entry would let a rename drop the
+guard).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ..core import Finding, Rule
+from ._util import matches_file
+
+#: (serving-relative file, qualified function) -> must contain tel.timed/span
+HOT_LOOPS: tuple = (
+    ("continuous_batching.py", "ContinuousBatchingEngine._admit_all"),
+    ("continuous_batching.py", "ContinuousBatchingEngine._step_chunk"),
+    ("replica_controller.py", "InferenceGateway.predict"),
+)
+
+_SPAN_ATTRS = ("timed", "span")
+_SERVING_DIR = "fedml_tpu/serving"
+
+
+def _calls_span(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+            if sub.func.attr in _SPAN_ATTRS:
+                return True
+    return False
+
+
+class HotSpanRule(Rule):
+    id = "hot-span"
+    severity = "error"
+    description = ("registered serving hot loop lost its tel.timed()/"
+                   "tel.span() instrumentation (or the registry went stale)")
+
+    def finalize(self, run):
+        # serving root: repo layout when present, else the scan root itself
+        # (the legacy shim points straight at a serving-shaped directory)
+        repo_serving = os.path.join(run.root, *_SERVING_DIR.split("/"))
+        in_repo_layout = os.path.isdir(repo_serving)
+        by_entry_file: dict = {}
+        for ctx in run.files:
+            for rel, _fn in HOT_LOOPS:
+                target = f"{_SERVING_DIR}/{rel}" if in_repo_layout else rel
+                if matches_file(ctx.relpath, target):
+                    by_entry_file[rel] = ctx
+        findings = []
+        for rel, fn_name in HOT_LOOPS:
+            ctx = by_entry_file.get(rel)
+            if ctx is None:
+                missing = (os.path.join(repo_serving, rel) if in_repo_layout
+                           else os.path.join(run.root, rel))
+                findings.append(Finding(
+                    rule=self.id, severity=self.severity, path=missing,
+                    relpath=os.path.relpath(missing, run.root).replace(os.sep, "/"),
+                    line=0, col=0,
+                    message=f"registry names missing file {rel}"))
+                continue
+            findings.extend(self._check_fn(ctx, rel, fn_name))
+        return findings
+
+    def _check_fn(self, ctx, rel, fn_name):
+        cls_name, _, meth = fn_name.rpartition(".")
+        if cls_name:
+            scopes = [n for n in ast.walk(ctx.tree)
+                      if isinstance(n, ast.ClassDef) and n.name == cls_name]
+        else:
+            scopes = [ctx.tree]
+        found = False
+        for scope in scopes:
+            nodes = scope.body if cls_name else ast.walk(scope)
+            for node in nodes:
+                if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and node.name == meth):
+                    found = True
+                    if not _calls_span(node):
+                        yield self.make(
+                            ctx, node,
+                            f"hot loop {fn_name}() has no tel.timed()/"
+                            "tel.span() — wrap the device-touching section "
+                            "in tel.timed('serving....') so TTFT/TPOT "
+                            "regressions show up in /metrics, not in bench "
+                            "windows")
+        if not found:
+            yield self.make(
+                ctx, 0, f"registry names missing function {fn_name}()")

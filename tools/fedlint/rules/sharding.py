@@ -1,0 +1,122 @@
+"""Sharding-hygiene rules (ported from tools/check_sharding.py, PR 7).
+
+Over the SERVER scope (``fedml_tpu/core``, ``fedml_tpu/cross_silo``,
+``fedml_tpu/simulation``):
+
+* ``sharding-containment`` — ``jax.sharding`` (Mesh / NamedSharding /
+  PartitionSpec) may be referenced only by ``core/distributed/mesh.py``,
+  ``core/aggregation/sharded.py`` and the device-collective simulator.
+  Scattered NamedSharding construction is how layout drift (one module
+  sharding dim 0, another replicating the same leaf) stops being
+  reviewable. The TRAINER scope (``parallel/``, ``train/``, ``serving/``)
+  carries its own GSPMD plumbing and is deliberately out of scope.
+* ``device-get`` — ``jax.device_get`` is banned in the privileged sharding
+  modules: the only full-model gather is the host broadcast
+  materialization (``host_tree``), which rides ``np.asarray`` per dtype
+  group and books its bytes via ``record_transfer``. A ``device_get`` of
+  sharded params would replicate the model host-side with zero byte
+  accounting.
+
+A privileged file that disappears is a finding too: a rename must move the
+allowlist, not silently drop the guard.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ..core import Finding, Rule
+from ._util import pkg_rel
+
+SERVER_SCOPE = ("core", "cross_silo", "simulation")
+
+ALLOWED_SHARDING_FILES = (
+    "core/distributed/mesh.py",
+    "core/aggregation/sharded.py",
+    # the device-collective SIMULATOR shards stacked clients over its own
+    # "agg" mesh — that mesh is the simulation's subject, not server-layout
+    # plumbing; the device_get ban applies to it all the same
+    "simulation/collective/collective_sim.py",
+)
+
+
+def _in_server_scope(relpath: str) -> bool:
+    rel = pkg_rel(relpath)
+    return rel.split("/", 1)[0] in SERVER_SCOPE
+
+
+def _is_allowed(relpath: str) -> bool:
+    return pkg_rel(relpath) in ALLOWED_SHARDING_FILES
+
+
+class ShardingContainmentRule(Rule):
+    id = "sharding-containment"
+    severity = "error"
+    description = ("jax.sharding reference outside the mesh/sharded modules")
+    node_types = (ast.Import, ast.ImportFrom, ast.Attribute)
+
+    def applies_to(self, relpath):
+        return _in_server_scope(relpath) and not _is_allowed(relpath)
+
+    def check_node(self, node, ctx):
+        desc = None
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax.sharding" or alias.name.startswith("jax.sharding."):
+                    desc = f"import {alias.name}"
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax.sharding" or mod.startswith("jax.sharding."):
+                names = ", ".join(a.name for a in node.names)
+                desc = f"from {mod} import {names}"
+        elif isinstance(node, ast.Attribute):
+            if (node.attr == "sharding" and isinstance(node.value, ast.Name)
+                    and node.value.id == "jax"):
+                desc = "jax.sharding attribute access"
+        if desc:
+            yield self.make(
+                ctx, node,
+                f"{desc} outside the mesh/sharded modules — go through "
+                "core.distributed.mesh / core.aggregation.sharded")
+
+    def finalize(self, run):
+        """A privileged file that vanished is a violation (rename must move
+        the allowlist). Only meaningful when the scan covers a package-shaped
+        tree — require the scope dirs' parent to exist."""
+        pkg_root = os.path.join(run.root, "fedml_tpu")
+        base = pkg_root if os.path.isdir(pkg_root) else run.root
+        if not any(os.path.isdir(os.path.join(base, s)) for s in SERVER_SCOPE):
+            return
+        for rel in ALLOWED_SHARDING_FILES:
+            path = os.path.join(base, *rel.split("/"))
+            if not os.path.exists(path):
+                yield Finding(
+                    rule=self.id, severity=self.severity, path=path,
+                    relpath=os.path.relpath(path, run.root).replace(os.sep, "/"),
+                    line=0, col=0,
+                    message=f"allowlist names missing file {rel}")
+
+
+class DeviceGetRule(Rule):
+    id = "device-get"
+    severity = "error"
+    description = "jax.device_get inside a privileged sharding module"
+    node_types = (ast.Attribute, ast.ImportFrom)
+
+    def applies_to(self, relpath):
+        return _is_allowed(relpath)
+
+    def check_node(self, node, ctx):
+        desc = None
+        if isinstance(node, ast.Attribute) and node.attr == "device_get":
+            desc = "device_get attribute access"
+        elif isinstance(node, ast.ImportFrom) and (node.module or "") == "jax":
+            if any(a.name == "device_get" for a in node.names):
+                desc = "from jax import device_get"
+        if desc:
+            yield self.make(
+                ctx, node,
+                f"{desc} in a sharding module — the host gather is "
+                "host_tree()'s np.asarray per dtype group (byte-booked via "
+                "record_transfer), never device_get")

@@ -1,0 +1,98 @@
+"""Telemetry-hygiene rules (ported from tools/check_telemetry.py, PRs 3–4):
+
+* ``reserved-key`` — the reserved ``Message`` header literal belongs ONLY to
+  ``core/telemetry/trace_context.py``; everywhere else must reference
+  ``trace_context.RESERVED_TELEMETRY_KEY`` / ``Message.MSG_ARG_KEY_TELEMETRY``
+  or a payload key will silently collide and be clobbered by ``inject()``.
+* ``recorder-kind`` — flight-recorder event-kind literals belong ONLY to
+  ``core/telemetry/flight_recorder.py``; ad-hoc producers spelling them
+  elsewhere invent look-alike events ``tools/fr_dump.py`` cannot interpret.
+* ``excepthook`` — ``sys.excepthook`` / ``threading.excepthook`` may be
+  touched ONLY by the flight recorder; a second installer silently drops
+  crash dumps depending on import order.
+
+Ported line-substring scans became AST checks (string constants, attribute
+accesses, imports) so docstrings that merely *mention* the needles no longer
+have to dance around them.
+"""
+
+from __future__ import annotations
+
+import ast
+
+# fedlint: disable-file=recorder-kind this module IS the rule's needle table
+
+from ..core import Rule
+from ._util import matches_file
+
+# fragment-wise so this module never matches its own rule
+RESERVED_KEY = "__" + "telemetry" + "__"
+TRACE_CONTEXT = "core/telemetry/trace_context.py"
+FLIGHT_RECORDER = "core/telemetry/flight_recorder.py"
+RECORDER_KINDS = frozenset({"span_open", "span_close", "comm_send", "comm_recv"})
+
+
+class ReservedKeyRule(Rule):
+    id = "reserved-key"
+    severity = "error"
+    description = ("raw reserved telemetry header literal outside "
+                   "trace_context.py")
+    node_types = (ast.Constant,)
+
+    def applies_to(self, relpath):
+        return not matches_file(relpath, TRACE_CONTEXT)
+
+    def check_node(self, node, ctx):
+        if isinstance(node.value, str) and node.value == RESERVED_KEY:
+            yield self.make(
+                ctx, node,
+                "raw reserved telemetry key: use Message.MSG_ARG_KEY_TELEMETRY "
+                "(or trace_context.RESERVED_TELEMETRY_KEY) — payload keys "
+                "must never collide with the header",
+            )
+
+
+class RecorderKindRule(Rule):
+    id = "recorder-kind"
+    severity = "error"
+    description = ("flight-recorder event-kind literal outside "
+                   "flight_recorder.py")
+    node_types = (ast.Constant,)
+
+    def applies_to(self, relpath):
+        return not matches_file(relpath, FLIGHT_RECORDER)
+
+    def check_node(self, node, ctx):
+        if isinstance(node.value, str) and node.value in RECORDER_KINDS:
+            yield self.make(
+                ctx, node,
+                f"raw recorder event kind {node.value!r}: use the "
+                "flight_recorder.EVENT_* constants via record_event/mark/"
+                "record_comm — ad-hoc kinds are invisible to tools/fr_dump.py",
+            )
+
+
+class ExcepthookRule(Rule):
+    id = "excepthook"
+    severity = "error"
+    description = "sys/threading excepthook touched outside flight_recorder.py"
+    node_types = (ast.Attribute, ast.ImportFrom)
+
+    def applies_to(self, relpath):
+        return not matches_file(relpath, FLIGHT_RECORDER)
+
+    def check_node(self, node, ctx):
+        hit = False
+        if isinstance(node, ast.Attribute):
+            hit = (node.attr == "excepthook"
+                   and isinstance(node.value, ast.Name)
+                   and node.value.id in ("sys", "threading"))
+        elif isinstance(node, ast.ImportFrom):
+            hit = ((node.module or "") in ("sys", "threading")
+                   and any(a.name == "excepthook" for a in node.names))
+        if hit:
+            yield self.make(
+                ctx, node,
+                "excepthook outside flight_recorder: crash handling has ONE "
+                "owner — use flight_recorder.install()/installed() instead",
+            )

@@ -1,0 +1,48 @@
+"""``wall-clock``: no ``time.time()`` durations (ported from
+tools/check_timing.py, PR 2).
+
+``time.time()`` follows the wall clock — NTP steps and slew corrupt any
+duration computed from it (a negative "aggregate time" poisons runtime fits
+and autoscaling). Durations belong to ``fedml_tpu.core.telemetry``
+(span/timed/histogram, perf_counter-based). Legitimate uses are
+*timestamps* (record fields, DB rows) and *wall deadlines* (timeouts
+coordinated with other processes) — suppress with
+``# fedlint: disable=wall-clock <which one and why>``.
+
+The legacy ``# wall-clock ok: <reason>`` marker is still honored so the
+``tools/check_timing.py`` shim keeps its historical contract.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+
+LEGACY_MARKER = "wall-clock ok"
+
+
+class WallClockRule(Rule):
+    id = "wall-clock"
+    severity = "error"
+    description = ("time.time() used for durations — use telemetry "
+                   "span/timed (perf_counter); mark genuine timestamps/"
+                   "deadlines with a suppression")
+    node_types = (ast.Call,)
+
+    def check_node(self, node, ctx):
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "time"):
+            return
+        base = func.value
+        if not (isinstance(base, ast.Name) and "time" in base.id):
+            return
+        if LEGACY_MARKER in ctx.raw_line(node.lineno):
+            return
+        yield self.make(
+            ctx, node,
+            "unmarked time.time(): durations must use "
+            "fedml_tpu.core.telemetry (span/timed/histogram, "
+            "perf_counter-based); genuine timestamps/deadlines need "
+            "`# fedlint: disable=wall-clock <reason>`",
+        )
