@@ -1279,6 +1279,187 @@ def _bench_agg_sharded(rounds: int = 4):
     }
 
 
+def _bench_async_rounds(publishes: int = 8, reps: int = 3):
+    """Asynchronous buffered federation (ISSUE 9): rounds/hr INDEPENDENT of
+    cohort size. The event-driven simulator
+    (simulation/vmapped/async_driver.py) runs 1k/10k/100k clients with
+    heterogeneous delays against a fresh AsyncAggBuffer; a "round" is a
+    publish (every publish_k merges), so the server-side work per round is
+    O(publish_k) no matter how many clients are in flight. rounds/hr divides
+    publishes by the SERVER seconds (submit folds + publishes, perf_counter
+    around exactly those calls) — delta generation is simulated client
+    compute, massively parallel in a real fleet and overlapped with server
+    work in the PiPar sense, so it does not belong in the denominator.
+
+    Integrity guards (BenchIntegrityError, refusing to publish):
+    - parity: staleness exponent 0 + publish_k == cohort == bucket must
+      reproduce the synchronous engine.aggregate BIT-EXACTLY (same pairs,
+      same order); the multi-bucket streaming path must agree at 1e-6.
+    - flatness: min-of-reps rounds/hr at the largest cohort must be within
+      FEDML_ASYNC_FLATNESS_TOL (default 1.1x) of the smallest cohort.
+    - zero retraces: the engine's accumulate trace counters must not move
+      after warmup (one steady-state fold program across ALL cohorts)."""
+    import jax
+
+    from fedml_tpu.core.aggregation.async_buffer import AsyncAggBuffer, StalenessPolicy
+    from fedml_tpu.core.aggregation.bucketed import BucketedAggregator
+    from fedml_tpu.simulation.vmapped.async_driver import (
+        AsyncEventSim,
+        DelayModel,
+        make_synthetic_delta_fn,
+    )
+
+    dev = jax.devices()[0]
+    tiny = os.environ.get("FEDML_BENCH_TINY") == "1"
+    cohorts = (100, 400, 1000) if tiny else (1000, 10000, 100000)
+    bucket = 16
+    publish_k = 2 * bucket  # > bucket: exercises the streaming fold path
+    eng = BucketedAggregator(bucket)  # fresh engine: clean trace counters
+
+    # model proxy: a ~100k-param MLP-shaped pytree — the fold cost scales
+    # with bytes, the FLATNESS claim is about the cohort axis
+    key = np.random.default_rng(5)
+    template = {
+        "dense1": {"kernel": np.asarray(key.standard_normal((128, 256)), np.float32),
+                   "bias": np.zeros((256,), np.float32)},
+        "dense2": {"kernel": np.asarray(key.standard_normal((256, 256)), np.float32),
+                   "bias": np.zeros((256,), np.float32)},
+        "head": {"kernel": np.asarray(key.standard_normal((256, 64)), np.float32),
+                 "bias": np.zeros((64,), np.float32)},
+    }
+    template = jax.device_put(template)
+    n_params = sum(x.size for x in jax.tree.leaves(template))
+    gen = make_synthetic_delta_fn(seed=11)
+
+    # --- parity guards (the acceptance anchor) -----------------------------
+    def _unstack(stacked, n):
+        return [jax.tree.map(lambda l, _k=k: l[_k], stacked) for k in range(n)]
+
+    ids = np.arange(bucket, dtype=np.int32)
+    trees = _unstack(gen(template, ids, 0), bucket)
+    weights = (np.arange(bucket) + 1.0).astype(np.float64)
+    buf = AsyncAggBuffer(publish_k=bucket, policy=StalenessPolicy(exponent=0.0),
+                         engine=eng)
+    for k in range(bucket):
+        buf.submit(k, trees[k], float(weights[k]), 0)
+    got = buf.publish()
+    want = eng.aggregate([(float(weights[k]), trees[k]) for k in range(bucket)])
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            raise BenchIntegrityError(
+                "async parity failed: exponent 0 + publish_k == cohort must "
+                "be BIT-EXACT with synchronous engine.aggregate; refusing to "
+                "publish")
+    k3 = 3 * bucket
+    trees3 = _unstack(gen(template, np.arange(k3, dtype=np.int32), 1), k3)
+    w3 = (np.arange(k3) + 1.0).astype(np.float64)
+    buf3 = AsyncAggBuffer(publish_k=k3, policy=StalenessPolicy(exponent=0.0),
+                          engine=eng)
+    for k in range(k3):
+        buf3.submit(k, trees3[k], float(w3[k]), 0)
+    got3 = buf3.publish()
+    want3 = eng.aggregate([(float(w3[k]), trees3[k]) for k in range(k3)])
+    # leaf-scale-normalized error (the agg_sharded metric): elementwise
+    # relative error divides by near-cancelling entries and reports float
+    # noise as divergence
+    mb_err = 0.0
+    for a, b in zip(jax.tree.leaves(got3), jax.tree.leaves(want3)):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        mb_err = max(mb_err, float(np.max(np.abs(a - b)))
+                     / (float(np.max(np.abs(a))) + 1e-12))
+    if mb_err > 1e-6:
+        raise BenchIntegrityError(
+            f"async multi-bucket parity failed: streaming scale-after-fold "
+            f"drifted {mb_err:.3e} (> 1e-6 of leaf scale) from the "
+            "synchronous path; refusing to publish")
+
+    # --- cohort sweep ------------------------------------------------------
+    def one_run(n_clients: int, seed: int):
+        sim = AsyncEventSim(
+            AsyncAggBuffer(publish_k=publish_k, engine=eng),
+            gen, n_clients, initial_model=template,
+            delay=DelayModel(n_clients, mean_delay=1.0, heterogeneity=0.5,
+                             seed=seed),
+            gen_batch=512)
+        return sim.run(publishes)
+
+    _p(f"async bench: warmup ({n_params / 1e3:.0f}k params, "
+       f"publish_k={publish_k})")
+    one_run(cohorts[0], seed=99)  # compiles fold + scale + finalize chain
+    traces_before = int(eng.accum_traces)
+
+    rounds_per_hr: dict = {}
+    staleness_p50: dict = {}
+    staleness_p99: dict = {}
+    high_water: dict = {}
+    rejected: dict = {}
+    merge_us: dict = {}
+    for n in cohorts:
+        _p(f"async bench: cohort {n} x {reps} reps")
+        best: dict | None = None
+        for r in range(reps):
+            stats = one_run(n, seed=1000 + r)
+            if best is None or stats["server_seconds"] < best["server_seconds"]:
+                best = stats
+        rounds_per_hr[str(n)] = round(best["publishes"] / best["server_seconds"] * 3600.0, 1)
+        staleness_p50[str(n)] = best["staleness_p50"]
+        staleness_p99[str(n)] = best["staleness_p99"]
+        high_water[str(n)] = best["buffer_high_water"]
+        rejected[str(n)] = best["stale_rejected"]
+        merge_us[str(n)] = round(best["server_seconds"] / max(best["merges"], 1) * 1e6, 1)
+
+    if eng.accum_traces != traces_before:
+        raise BenchIntegrityError(
+            f"async fold retraced during the timed sweep ({traces_before} -> "
+            f"{eng.accum_traces}); refusing to publish")
+
+    # flatness: the claim itself. rounds/hr at the largest cohort within
+    # tol x of the smallest (min-of-reps absorbs scheduler noise)
+    tol = float(os.environ.get("FEDML_ASYNC_FLATNESS_TOL", "1.1"))
+    small, large = rounds_per_hr[str(cohorts[0])], rounds_per_hr[str(cohorts[-1])]
+    flatness = small / large if large else float("inf")
+    if flatness > tol:
+        raise BenchIntegrityError(
+            f"async rounds/hr NOT cohort-independent: {cohorts[0]} clients -> "
+            f"{small}/hr vs {cohorts[-1]} clients -> {large}/hr "
+            f"({flatness:.2f}x > {tol}x); refusing to publish")
+
+    # hierarchy rider: same workload through an 8-edge tree (fan-in per node
+    # stays O(children); root version is the global round)
+    _p("async bench: hierarchy rider (8 edges)")
+    from fedml_tpu.core.distributed.hierarchy import HierarchyTree
+
+    tree = HierarchyTree.build(8, publish_k=8, engine=eng, initial_model=template)
+    hsim = AsyncEventSim(tree, gen, cohorts[0], initial_model=template,
+                         delay=DelayModel(cohorts[0], seed=7), gen_batch=512)
+    hstats = hsim.run(max(2, publishes // 2))
+
+    return {
+        "async_rounds_per_hr": rounds_per_hr,
+        "async_flatness_ratio": round(flatness, 4),
+        "async_staleness_p50": staleness_p50,
+        "async_staleness_p99": staleness_p99,
+        "async_buffer_high_water": high_water,
+        "async_stale_rejected": rejected,
+        "async_server_merge_us": merge_us,
+        "async_publish_k": publish_k,
+        "async_publishes_per_cohort": publishes,
+        "async_cohorts": list(cohorts),
+        "async_parity_bit_exact": True,
+        "async_parity_multibucket_rel_err": float(f"{mb_err:.3e}"),
+        "async_accum_traces": eng.accum_traces,
+        "async_pytree_params": int(n_params),
+        "async_hierarchy": {
+            "edges": 8,
+            "root_publishes": hstats["publishes"],
+            "merges": hstats["merges"],
+            "staleness_p99": hstats["staleness_p99"],
+            "buffer_high_water": hstats["buffer_high_water"],
+        },
+        "device": getattr(dev, "device_kind", str(dev)),
+    }
+
+
 def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: int = 3):
     """Endpoint-level decode throughput (BASELINE config 5): tokens/s
     measured THROUGH the gateway with subprocess replicas — the real
@@ -2140,6 +2321,8 @@ def _stage_result(name: str) -> dict:
         out = _retry_transient(_bench_agg)
     elif name == "agg_sharded":
         out = _retry_transient(_bench_agg_sharded)
+    elif name == "async_rounds":
+        out = _retry_transient(_bench_async_rounds)
     elif name == "llm_pallas_tuned":
         # re-run the pallas headline under the block config attn_micro just
         # recorded (the orchestrator exports FEDML_FLASH_BLOCK_Q/K into this
@@ -2191,6 +2374,9 @@ _STAGES: list[tuple[str, int]] = [
     # ingestion-overlap efficiency; single-chip windows respawn it on the
     # virtual 8-CPU mesh (orchestrator, below)
     ("agg_sharded", 600),
+    # async buffered federation: rounds/hr at 1k/10k/100k simulated clients
+    # (flatness + bit-exact sync parity + zero-retrace integrity guards)
+    ("async_rounds", 600),
     # attention-kernel block sweep: records the fastest config to
     # .bench_runtime/flash_blocks (6 small compiles + marginal timings) ...
     ("attn_micro", 600),
@@ -2812,6 +2998,21 @@ def main() -> None:
             out["agg_sharded_platform"] = agg_sharded["agg_sharded_platform"]
     elif agg_sharded is not None:
         out["agg_sharded_skipped"] = agg_sharded["skipped"]
+
+    async_rounds = stage_out.get("async_rounds")
+    if async_rounds is not None and "skipped" not in async_rounds:
+        # buffered-async headline (tools/bench_watch.sh surfaces these):
+        # rounds/hr per cohort with the 1.1x flatness guard + both parity
+        # guards asserted in-stage
+        for key in ("async_rounds_per_hr", "async_flatness_ratio",
+                    "async_staleness_p50", "async_staleness_p99",
+                    "async_buffer_high_water", "async_publish_k",
+                    "async_parity_bit_exact", "async_parity_multibucket_rel_err",
+                    "async_server_merge_us", "async_hierarchy"):
+            if async_rounds.get(key) is not None:
+                out[key] = async_rounds[key]
+    elif async_rounds is not None:
+        out["async_rounds_skipped"] = async_rounds["skipped"]
 
     attn = stage_out.get("attn_micro")
     if attn is not None:

@@ -6,6 +6,7 @@ from .agg_operator import (
     scaffold_aggregate,
     uniform_average,
 )
+from .async_buffer import AsyncAggBuffer, StalenessPolicy, buffer_from_args
 from .bucketed import (
     DEFAULT_BUCKET_SIZE,
     BucketedAggregator,
@@ -22,6 +23,9 @@ __all__ = [
     "scaffold_aggregate",
     "async_fedavg",
     "uniform_average",
+    "AsyncAggBuffer",
+    "StalenessPolicy",
+    "buffer_from_args",
     "BucketedAggregator",
     "bucketed_weighted_average",
     "get_engine",

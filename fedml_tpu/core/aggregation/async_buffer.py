@@ -1,0 +1,453 @@
+"""Asynchronous buffered aggregation (FedBuff-style) over the bucketed engine.
+
+PR 5's quorum rounds are still fundamentally synchronous: one deadline, one
+aggregate, one broadcast — so server round throughput degrades linearly with
+cohort size. This module removes the barrier. Client models are accepted at
+ANY time and folded into the PR-1 streaming bucketed accumulator the moment a
+full bucket of arrivals exists; a new global model is published every
+``publish_k`` buffered merges instead of per-cohort deadline. Clients pull the
+latest model right after each upload and immediately start the next local
+round, so server communication overlaps client compute (PiPar, arxiv
+2302.12803) and rounds/hr depends on ``publish_k`` — not on the cohort size.
+
+Staleness policy (Xie et al., "Asynchronous Federated Optimization"; the same
+polynomial family the sp FedAsync simulator uses): an arrival trained on model
+version ``v`` when the server is at version ``V`` has staleness ``V - v`` and
+its aggregation weight is scaled by ``(1 + staleness) ** -exponent``. Arrivals
+beyond ``max_staleness`` are refused (``stale_rejected`` verdict — the
+admission half of the policy, which repurposes PR 5's quorum/health EWMA
+machinery: a rank the health tracker currently flags as a straggler gets a
+configurable staleness grace, because its lateness is already priced into the
+adaptive deadline EWMAs).
+
+Normalization contract: publishes divide the streamed raw-weight accumulator
+by the streamed weight sum. When every buffered arrival is still pending at
+publish (``publish_k`` <= one bucket — the synchronous degenerate
+configuration), the publish routes through ``engine.aggregate`` itself, so
+``staleness exponent 0 + publish_k == cohort`` reproduces the synchronous
+FedAvg result BIT-EXACTLY (bench.py --stage async_rounds pins this). Beyond
+one bucket the normalization order differs from the synchronous path by one
+float rounding per element (scale-after-fold vs fold-of-scaled), guarded at
+rtol 1e-6 in the bench.
+
+Crash safety: :meth:`export_pytree_state` / :meth:`export_meta` snapshot the
+f32 accumulator, the un-folded pending trees and the staleness clock
+(version + per-rank last-trained versions) so ``core/resilience`` round-state
+checkpoints can persist a HALF-FULL buffer; :meth:`restore` rebuilds it and
+subsequent merges are bit-identical to an uninterrupted run
+(tests/_async_buffer_run.py proves it under SIGKILL).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .. import telemetry as tel
+from ..resilience import quorum as quorum_mod
+from .bucketed import BucketedAggregator, get_engine
+
+PyTree = Any
+
+MERGE_COUNTER = "async.merges"        # rendered fedml_async_merges_total
+PUBLISH_COUNTER = "async.publishes"   # rendered fedml_async_publishes_total
+STALENESS_HISTOGRAM = "async.staleness"
+
+DEFAULT_PUBLISH_K = 8
+DEFAULT_STALENESS_EXPONENT = 0.5
+DEFAULT_MAX_STALENESS = 10
+DEFAULT_STRAGGLER_GRACE = 1.5
+
+
+class StalenessPolicy:
+    """Polynomial staleness decay + admission cut.
+
+    ``weight(s) = (1 + s) ** -exponent`` (exponent 0 == unit weight, the
+    synchronous parity configuration). ``admit`` refuses arrivals staler than
+    ``max_staleness``; when a health tracker is wired in and currently flags
+    the rank as a straggler, the cut stretches by ``straggler_grace`` — the
+    EWMA machinery already knows that rank is slow, so its lateness is
+    expected rather than suspicious.
+    """
+
+    def __init__(self, exponent: float = DEFAULT_STALENESS_EXPONENT,
+                 max_staleness: int = DEFAULT_MAX_STALENESS,
+                 straggler_grace: float = DEFAULT_STRAGGLER_GRACE,
+                 health: Any = None):
+        if exponent < 0:
+            raise ValueError(f"staleness exponent must be >= 0, got {exponent}")
+        self.exponent = float(exponent)
+        self.max_staleness = int(max_staleness)
+        self.straggler_grace = float(straggler_grace)
+        self.health = health  # HealthTracker or None
+
+    @classmethod
+    def from_args(cls, args: Any, health: Any = None) -> "StalenessPolicy":
+        return cls(
+            exponent=float(getattr(args, "async_staleness_exponent",
+                                   DEFAULT_STALENESS_EXPONENT)),
+            max_staleness=int(getattr(args, "async_max_staleness",
+                                      DEFAULT_MAX_STALENESS)),
+            straggler_grace=float(getattr(args, "async_straggler_grace",
+                                          DEFAULT_STRAGGLER_GRACE)),
+            health=health,
+        )
+
+    def weight(self, staleness: int) -> float:
+        if staleness <= 0 or self.exponent == 0.0:
+            return 1.0
+        return float((1.0 + staleness) ** -self.exponent)
+
+    def _rank_flagged(self, rank: Optional[int]) -> bool:
+        if rank is None or self.health is None:
+            return False
+        try:
+            c = self.health._clients.get(int(rank))
+        except Exception:  # noqa: BLE001 - duck-typed health object
+            return False
+        return bool(c is not None and c.flagged)
+
+    def admission_cut(self, rank: Optional[int] = None) -> int:
+        cut = self.max_staleness
+        if self._rank_flagged(rank):
+            cut = int(math.ceil(cut * self.straggler_grace))
+        return cut
+
+    def admit(self, staleness: int, rank: Optional[int] = None) -> bool:
+        return int(staleness) <= self.admission_cut(rank)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "exponent": self.exponent,
+            "max_staleness": self.max_staleness,
+            "straggler_grace": self.straggler_grace,
+            "health_wired": self.health is not None,
+        }
+
+
+class AsyncAggBuffer:
+    """Staleness-weighted streaming merge buffer with publish-every-K.
+
+    Thread-safe: :meth:`submit` runs on the server receive loop while
+    `/statusz`, `/metrics` and checkpoint snapshots read concurrently.
+
+    Folding discipline: arrivals append to ``_pending``; the moment a full
+    engine bucket of them exists, the bucket folds into the donated f32
+    accumulator and the trees are dropped — buffer HBM high-water is
+    O(bucket x model) regardless of ``publish_k`` or cohort size. The
+    mesh-sharded engine keeps pending arrivals as per-shard
+    ``ShardedDelta`` handles instead (its ``ingest`` is already the
+    overlapped per-shard upload stream) and folds them at publish.
+    """
+
+    def __init__(self, publish_k: int = DEFAULT_PUBLISH_K,
+                 policy: Optional[StalenessPolicy] = None,
+                 engine: Optional[BucketedAggregator] = None,
+                 initial_version: int = 0):
+        if publish_k < 1:
+            raise ValueError(f"publish_k must be >= 1, got {publish_k}")
+        self.publish_k = int(publish_k)
+        self.policy = policy or StalenessPolicy()
+        self.engine = engine or get_engine()
+        self._lock = threading.Lock()
+        self._pending: List[Tuple[float, PyTree]] = []
+        self._pending_meta: List[Dict[str, Any]] = []  # rank/staleness per pending
+        self._acc: Optional[PyTree] = None
+        self._weight_sum = 0.0
+        self._template: Optional[PyTree] = None
+        self._merges_since_publish = 0
+        self.version = int(initial_version)
+        self.merges_total = 0
+        self.publishes_total = 0
+        self.stale_accepted_total = 0
+        self.stale_rejected_total = 0
+        self.depth_high_water = 0
+        # what the last publish folded: the hierarchy forwards a publish
+        # upward as ONE (weight, model) submission, weighted by the window
+        self.last_publish_weight = 0.0
+        self.last_publish_merges = 0
+        # staleness clock: rank -> model version of that rank's last merge
+        self._client_versions: Dict[int, int] = {}
+        self._staleness_sum = 0
+
+    # --- submit (receive-loop thread) --------------------------------------
+    def submit(self, rank: int, model_params: PyTree, sample_num: float,
+               client_version: Optional[int]) -> str:
+        """Fold one arrival. Returns a quorum-vocabulary verdict:
+        ``accept`` (fresh), ``stale_accepted`` (admitted with decayed
+        weight), or ``stale_rejected`` (beyond the admission cut — the
+        arrival is discarded, never folded)."""
+        staleness = 0 if client_version is None else max(0, self.version - int(client_version))
+        if not self.policy.admit(staleness, rank):
+            with self._lock:
+                self.stale_rejected_total += 1
+            tel.get_telemetry().counter(quorum_mod.STALE_REJECTED_COUNTER).add(1)
+            return quorum_mod.STALE_REJECTED
+        weight = float(sample_num) * self.policy.weight(staleness)
+        with tel.span("async.merge", rank=int(rank), staleness=int(staleness)):
+            with self._lock:
+                self._merge_locked(rank, model_params, weight, staleness)
+                if staleness > 0:
+                    self.stale_accepted_total += 1
+        tel.get_telemetry().counter(MERGE_COUNTER).add(1)
+        tel.histogram(STALENESS_HISTOGRAM).observe(float(staleness))
+        if staleness > 0:
+            tel.get_telemetry().counter(quorum_mod.STALE_ACCEPTED_COUNTER).add(1)
+            return quorum_mod.STALE_ACCEPTED
+        return quorum_mod.ACCEPT
+
+    def _merge_locked(self, rank: int, tree: PyTree, weight: float,
+                      staleness: int) -> None:
+        if self._template is None:
+            self._template = tree
+        if not self._streaming():
+            # mesh-sharded engine: start the per-shard upload NOW — ingest's
+            # device_put returns before the transfer lands, so the copy
+            # overlaps whatever the mesh is computing and publish folds the
+            # already-resident handles without re-uploading
+            tree = self.engine.ingest(tree, self._template)
+        self._pending.append((weight, tree))
+        self._pending_meta.append({"rank": int(rank), "staleness": int(staleness)})
+        self.merges_total += 1
+        self._merges_since_publish += 1
+        self._staleness_sum += int(staleness)
+        self._client_versions[int(rank)] = self.version
+        self.depth_high_water = max(self.depth_high_water, self._merges_since_publish)
+        self._fold_full_buckets_locked()
+
+    def _streaming(self) -> bool:
+        # the sharded engine's pending handles are ShardedDelta group dicts;
+        # its aggregate() owns the double-buffered fold, so pending is kept
+        from .sharded import ShardedBucketedAggregator
+
+        return not isinstance(self.engine, ShardedBucketedAggregator)
+
+    def _fold_full_buckets_locked(self) -> None:
+        if not self._streaming():
+            return
+        b = self.engine.bucket_size
+        if self.publish_k <= b:
+            # the whole publish window fits one bucket: keep arrivals pending
+            # so publish can take the engine's normalize-first path — this is
+            # what makes publish_k == cohort BIT-EXACT with synchronous FedAvg
+            return
+        while len(self._pending) >= b:
+            chunk = [t for _, t in self._pending[:b]]
+            w = np.asarray([w for w, _ in self._pending[:b]], dtype=np.float32)  # fedlint: disable=host-sync python-float weights per folded bucket, no device readback
+            self._acc = self.engine.accumulate_bucket(self._acc, chunk, w)
+            self._weight_sum += float(w.sum())
+            del self._pending[:b]
+            del self._pending_meta[:b]
+
+    # --- publish -----------------------------------------------------------
+    def ready(self) -> bool:
+        with self._lock:
+            return self._merges_since_publish >= self.publish_k
+
+    def publish(self) -> Optional[PyTree]:
+        """Fold the ragged pending tail, normalize, advance the model
+        version, and return the new global model (None when nothing was
+        merged since the last publish)."""
+        with tel.span("async.publish", version=self.version):
+            with self._lock:
+                return self._publish_locked()
+
+    def _publish_locked(self) -> Optional[PyTree]:
+        if self._merges_since_publish == 0:
+            return None
+        if self._acc is None and self._pending:
+            # nothing folded eagerly yet (buffer fit one bucket): route
+            # through the engine's own normalized aggregate — BIT-IDENTICAL
+            # to the synchronous path, which is the parity guard's anchor
+            self.last_publish_weight = float(sum(w for w, _ in self._pending))
+            out = self.engine.aggregate(list(self._pending))
+        else:
+            if self._pending:
+                b = self.engine.bucket_size
+                chunk = [t for _, t in self._pending]
+                w = np.asarray([w for w, _ in self._pending], dtype=np.float32)
+                pad = b - len(chunk)
+                if pad > 0:
+                    chunk = chunk + [chunk[-1]] * pad
+                    w = np.concatenate([w, np.zeros((pad,), np.float32)])
+                self._acc = self.engine.accumulate_bucket(self._acc, chunk, w)
+                self._weight_sum += float(w.sum())
+            self.last_publish_weight = float(self._weight_sum)
+            scaled = self._scale_fn()(self._acc, np.float32(1.0 / self._weight_sum))
+            out = self.engine.finalize(scaled, self._template)
+        self.last_publish_merges = self._merges_since_publish
+        self._acc = None
+        self._weight_sum = 0.0
+        self._pending = []
+        self._pending_meta = []
+        self._merges_since_publish = 0
+        self._staleness_sum = 0
+        self.version += 1
+        self.publishes_total += 1
+        tel.get_telemetry().counter(PUBLISH_COUNTER).add(1)
+        return out
+
+    def _scale_fn(self):
+        return _scale_fn()
+
+    # --- introspection -----------------------------------------------------
+    def depth(self) -> int:
+        with self._lock:
+            return self._merges_since_publish
+
+    def statusz(self) -> Dict[str, Any]:
+        with self._lock:
+            n = self._merges_since_publish
+            return {
+                "version": self.version,
+                "publish_k": self.publish_k,
+                "depth": n,
+                "depth_high_water": self.depth_high_water,
+                "pending_unfolded": len(self._pending),
+                "merges_total": self.merges_total,
+                "publishes_total": self.publishes_total,
+                "stale_accepted_total": self.stale_accepted_total,
+                "stale_rejected_total": self.stale_rejected_total,
+                "mean_staleness": (self._staleness_sum / n) if n else 0.0,
+                "policy": self.policy.as_dict(),
+                "client_versions": dict(self._client_versions),
+            }
+
+    def prom_gauges(self) -> List[tuple]:
+        """``(name, labels, value)`` triples for ``prom.render(gauges=...)``."""
+        with self._lock:
+            return [
+                ("async_buffer_depth", {}, float(self._merges_since_publish)),
+                ("async_buffer_high_water", {}, float(self.depth_high_water)),
+                ("async_model_version", {}, float(self.version)),
+            ]
+
+    # --- persistence (core.resilience round-state snapshots) ---------------
+    def export_pytree_state(self) -> Dict[str, Any]:
+        """The array half of a buffer snapshot — shaped for orbax. ``acc`` is
+        the f32 accumulator ([] when empty so the treedef stays static-ish),
+        ``pending`` the un-folded arrival trees in submit order."""
+        with self._lock:
+            state: Dict[str, Any] = {}
+            if self._acc is not None:
+                # HOST COPY, not a reference: the next bucket fold DONATES the
+                # live accumulator, which would free these buffers out from
+                # under an in-flight async orbax save. device_get alone is NOT
+                # a copy on CPU (it returns a numpy view of the device buffer,
+                # which the donating fold then overwrites in place), so force
+                # an owned ndarray per leaf.
+                state["acc"] = jax.tree.map(
+                    lambda x: np.array(x, copy=True), jax.device_get(self._acc))
+            if self._pending:
+                state["pending"] = [self._host_pending(t) for _, t in self._pending]
+            return state
+
+    def _host_pending(self, t: PyTree) -> PyTree:
+        """Checkpointable form of one pending arrival (sharded handles
+        materialize back to a host tree; plain trees pass through)."""
+        from .sharded import ShardedDelta
+
+        if isinstance(t, ShardedDelta):
+            return self.engine.host_tree(t.groups, self.engine.layout_for(self._template))
+        return t
+
+    def export_meta(self) -> Dict[str, Any]:
+        """The JSON half: staleness clock + scalars. ``weight_sum`` is a
+        python float (f64) — JSON round-trips it exactly, which the
+        bit-identical resume contract needs."""
+        with self._lock:
+            return {
+                "version": self.version,
+                "publish_k": self.publish_k,
+                "weight_sum": float(self._weight_sum),
+                "merges_since_publish": self._merges_since_publish,
+                "merges_total": self.merges_total,
+                "publishes_total": self.publishes_total,
+                "stale_accepted_total": self.stale_accepted_total,
+                "stale_rejected_total": self.stale_rejected_total,
+                "staleness_sum": self._staleness_sum,
+                "depth_high_water": self.depth_high_water,
+                "has_acc": self._acc is not None,
+                "pending_weights": [float(w) for w, _ in self._pending],
+                "pending_meta": [dict(m) for m in self._pending_meta],
+                "client_versions": {str(r): int(v) for r, v in self._client_versions.items()},
+            }
+
+    def state_template(self, model_template: PyTree, meta: Dict[str, Any]) -> Dict[str, Any]:
+        """Build the orbax restore template matching a snapshot's meta (the
+        pending count is dynamic, so the caller must read the meta sidecar
+        before asking orbax to restore)."""
+        tmpl: Dict[str, Any] = {}
+        if meta.get("has_acc"):
+            tmpl["acc"] = jax.tree.map(
+                lambda x: np.zeros(np.shape(x), np.float32) if hasattr(x, "shape") else np.float32(0),
+                model_template)
+        n_pending = len(meta.get("pending_weights") or [])
+        if n_pending:
+            tmpl["pending"] = [model_template for _ in range(n_pending)]
+        return tmpl
+
+    def restore(self, state: Dict[str, Any], meta: Dict[str, Any],
+                template: Optional[PyTree] = None) -> None:
+        """Rebuild the buffer from a snapshot. Restores the accumulator, the
+        pending trees WITH their original weights, and the staleness clock —
+        merges after this are bit-identical to an uninterrupted run."""
+        with self._lock:
+            self.version = int(meta.get("version", 0))
+            self._weight_sum = float(meta.get("weight_sum", 0.0))
+            self._merges_since_publish = int(meta.get("merges_since_publish", 0))
+            self.merges_total = int(meta.get("merges_total", 0))
+            self.publishes_total = int(meta.get("publishes_total", 0))
+            self.stale_accepted_total = int(meta.get("stale_accepted_total", 0))
+            self.stale_rejected_total = int(meta.get("stale_rejected_total", 0))
+            self._staleness_sum = int(meta.get("staleness_sum", 0))
+            self.depth_high_water = int(meta.get("depth_high_water", 0))
+            self._client_versions = {
+                int(r): int(v) for r, v in (meta.get("client_versions") or {}).items()}
+            self._acc = state.get("acc") if meta.get("has_acc") else None
+            weights = [float(w) for w in (meta.get("pending_weights") or [])]
+            trees = list(state.get("pending") or [])
+            if len(weights) != len(trees):
+                raise ValueError(
+                    f"buffer snapshot torn: {len(weights)} pending weights vs "
+                    f"{len(trees)} pending trees")
+            self._pending = list(zip(weights, trees))
+            self._pending_meta = [dict(m) for m in (meta.get("pending_meta") or
+                                                    [{} for _ in trees])]
+            if template is not None:
+                self._template = template
+            elif trees:
+                self._template = trees[0]
+
+
+_SCALE_FN = None
+
+
+def _scale_fn():
+    # one executable shared by every publish of EVERY buffer (hierarchy
+    # tiers, bench reps): module-level so jit's (treedef, shape) cache is
+    # process-wide, and the scalar rides as a traced argument so a new 1/S
+    # never retraces
+    global _SCALE_FN
+    if _SCALE_FN is None:
+        _SCALE_FN = jax.jit(
+            tel.track_compiles(
+                lambda acc, s: jax.tree.map(lambda x: x * s, acc),
+                name="async_scale"))
+    return _SCALE_FN
+
+
+def buffer_from_args(args: Any, health: Any = None,
+                     engine: Optional[BucketedAggregator] = None) -> AsyncAggBuffer:
+    """The cross-silo server's construction path: publish_k from
+    ``args.async_publish_k``, staleness policy from the ``async_*`` knobs,
+    health wired so straggler grace rides the EWMA machinery."""
+    return AsyncAggBuffer(
+        publish_k=int(getattr(args, "async_publish_k", DEFAULT_PUBLISH_K)),
+        policy=StalenessPolicy.from_args(args, health=health),
+        engine=engine,
+    )

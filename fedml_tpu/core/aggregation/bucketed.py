@@ -153,6 +153,14 @@ class BucketedAggregator:
         with tel.span("agg.bucket", bucket_size=self.bucket_size, first=acc is None):
             if acc is None:
                 return self._accum_first(chunk, weights)
+            if any(isinstance(l, np.ndarray) for l in jax.tree.leaves(acc)):
+                # a donated buffer must be jax-OWNED: CPU device_put aliases
+                # numpy memory zero-copy, so donating a host array (e.g. an
+                # accumulator restored from a checkpoint snapshot) lets XLA
+                # write the step's output straight into the caller's numpy
+                # buffer — silent host-state corruption. Copy once here.
+                acc = jax.tree.map(
+                    lambda l: jnp.array(l) if isinstance(l, np.ndarray) else l, acc)
             return self._accum(acc, chunk, weights)
 
     def finalize(self, acc: PyTree, template: PyTree) -> PyTree:

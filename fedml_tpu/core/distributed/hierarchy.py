@@ -1,0 +1,229 @@
+"""Hierarchical asynchronous aggregation: edge → regional → root.
+
+A single async buffer removes the round barrier but still funnels every
+client upload through one server. This module composes buffers into a tree:
+clients submit to **edge** nodes, each edge folds its own publish window and
+forwards the published model UP as one ``(window weight, model)`` submission
+to its **regional** parent, regionals forward to the **root**, and a root
+publish bumps the fleet-wide model version which propagates DOWN to every
+tier. Per-node fan-in stays O(children) no matter how many clients the fleet
+has — the hierarchical half of the rounds/hr-independent-of-cohort claim.
+
+Every tier runs the SAME :class:`~fedml_tpu.core.aggregation.async_buffer.
+AsyncAggBuffer` the cross-silo server manager runs in async mode (the
+cross-silo deployment form of a tier is a server manager whose "clients" are
+the child tier's servers; this in-process tree is the simulation/bench form
+and the semantics reference).
+
+Observability flows up with the models: client fleet-telemetry deltas merge
+into the edge's :class:`FleetTelemetry` AND forward to every ancestor, so
+`/statusz` on the root sees the whole fleet while a regional sees only its
+subtree. Publishes forward under the tree's trace context (one trace id per
+root model version), so a fleet trace shows the edge→regional→root cascade
+as one span tree.
+
+Staleness clock: client versions are ROOT model versions (the only version
+clients ever see). After every root publish the tree syncs each node's
+buffer version to the root version, so an edge judges staleness against the
+newest global model even though its own buffer publishes more often.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import telemetry as tel
+from ..aggregation.async_buffer import AsyncAggBuffer, StalenessPolicy
+from ..telemetry import FleetTelemetry, TraceContext, new_trace_id
+
+PyTree = Any
+
+TIER_EDGE = "edge"
+TIER_REGIONAL = "regional"
+TIER_ROOT = "root"
+
+FORWARD_COUNTER = "hierarchy.forwards"  # fedml_hierarchy_forwards_total
+
+
+class HierarchyNode:
+    """One aggregation tier node: an async buffer + a fleet-telemetry view
+    of its subtree. Thread-safe through the buffer's own lock plus a node
+    lock around the fleet merge / forward bookkeeping."""
+
+    def __init__(self, name: str, tier: str, buffer: AsyncAggBuffer,
+                 parent: Optional["HierarchyNode"] = None):
+        self.name = str(name)
+        self.tier = str(tier)
+        self.buffer = buffer
+        self.parent = parent
+        self.children: List["HierarchyNode"] = []
+        self.fleet = FleetTelemetry()
+        self.forwards = 0
+        self._lock = threading.Lock()
+        # child submissions need a stable integer rank for the buffer's
+        # staleness clock; allocated on first forward from each child
+        self._child_ranks: Dict[str, int] = {}
+        self._on_publish = None  # root-only: set by HierarchyTree
+        if parent is not None:
+            parent.children.append(self)
+
+    # --- upward flow -------------------------------------------------------
+    def submit(self, rank: int, model_params: PyTree, sample_num: float,
+               client_version: Optional[int],
+               telemetry_delta: Optional[dict] = None) -> str:
+        """One client (or child-tier) arrival. Merges telemetry up the whole
+        ancestor chain, folds the model into this node's buffer, and cascades
+        a publish upward when the window fills."""
+        if telemetry_delta is not None:
+            node: Optional[HierarchyNode] = self
+            while node is not None:
+                with node._lock:
+                    node.fleet.merge_client_delta(rank, telemetry_delta)
+                node = node.parent
+        verdict = self.buffer.submit(rank, model_params, sample_num, client_version)
+        self._maybe_publish()
+        return verdict
+
+    def _maybe_publish(self) -> None:
+        if not self.buffer.ready():
+            return
+        with tel.span("hierarchy.publish", node=self.name, tier=self.tier,
+                      version=self.buffer.version):
+            model = self.buffer.publish()
+        if model is None:
+            return
+        if self.parent is not None:
+            with self._lock:
+                self.forwards += 1
+            tel.get_telemetry().counter(FORWARD_COUNTER).add(1)
+            self.parent._submit_from_child(self, self.buffer.last_publish_weight, model)
+        elif self._on_publish is not None:
+            self._on_publish(model)
+
+    def _submit_from_child(self, child: "HierarchyNode", weight: float,
+                           model: PyTree) -> None:
+        with self._lock:
+            rank = self._child_ranks.setdefault(child.name, len(self._child_ranks))
+        # a child's publish is already the freshest model its subtree has:
+        # forward at the child's current (synced) version so the staleness
+        # decay never double-penalizes the extra tier hop
+        self.buffer.submit(rank, model, weight, client_version=self.buffer.version)
+        self._maybe_publish()
+
+    # --- introspection -----------------------------------------------------
+    def statusz(self) -> Dict[str, Any]:
+        with self._lock:
+            doc = {
+                "tier": self.tier,
+                "parent": self.parent.name if self.parent else None,
+                "children": [c.name for c in self.children],
+                "forwards": self.forwards,
+                "fleet_merges": self.fleet.merges,
+            }
+        doc["buffer"] = self.buffer.statusz()
+        return doc
+
+    def prom_gauges(self) -> List[tuple]:
+        labels = {"node": self.name, "tier": self.tier}
+        out = [(name, {**lbl, **labels}, v) for name, lbl, v in self.buffer.prom_gauges()]
+        with self._lock:
+            out.append(("hierarchy_forwards", labels, float(self.forwards)))
+        return out
+
+
+class HierarchyTree:
+    """The whole edge→regional→root assembly plus the downward version sync.
+
+    ``submit`` routes a client to its edge by ``rank % n_edges`` (the bench
+    overrides routing by calling ``edge.submit`` directly). ``latest_model``
+    / ``version`` are what clients pull — the root's most recent publish.
+    """
+
+    def __init__(self, root: HierarchyNode, regionals: Sequence[HierarchyNode],
+                 edges: Sequence[HierarchyNode], initial_model: Optional[PyTree] = None):
+        self.root = root
+        self.regionals = list(regionals)
+        self.edges = list(edges)
+        self._lock = threading.Lock()
+        self._model = initial_model
+        self._trace = TraceContext(new_trace_id(), round_idx=root.buffer.version)
+        root._on_publish = self._on_root_publish
+
+    @classmethod
+    def build(cls, n_edges: int, regional_fanout: int = 4,
+              publish_k: int = 8, root_publish_k: Optional[int] = None,
+              policy: Optional[StalenessPolicy] = None,
+              engine=None, initial_model: Optional[PyTree] = None) -> "HierarchyTree":
+        """Assemble a tree with ``n_edges`` edges grouped ``regional_fanout``
+        per regional. Tiers share one engine (one jit cache — the trees all
+        have the same treedef) but each node owns its buffer."""
+        if n_edges < 1:
+            raise ValueError(f"n_edges must be >= 1, got {n_edges}")
+        mk = lambda k: AsyncAggBuffer(  # noqa: E731
+            publish_k=k, policy=policy or StalenessPolicy(), engine=engine)
+        n_regionals = max(1, -(-n_edges // int(regional_fanout)))
+        root = HierarchyNode("root", TIER_ROOT, mk(root_publish_k or max(1, n_regionals)))
+        # a regional publishes once every child-publish-cycle: its window is
+        # capped by how many edges it ACTUALLY parents (round-robin split), or
+        # a sparse tier (e.g. 1 edge under a fanout-4 regional) stalls forever
+        n_children = [n_edges // n_regionals + (1 if r < n_edges % n_regionals else 0)
+                      for r in range(n_regionals)]
+        regionals = [HierarchyNode(f"regional-{i}", TIER_REGIONAL,
+                                   mk(max(1, min(publish_k, regional_fanout, n_children[i]))),
+                                   parent=root)
+                     for i in range(n_regionals)]
+        edges = [HierarchyNode(f"edge-{i}", TIER_EDGE, mk(publish_k),
+                               parent=regionals[i % n_regionals])
+                 for i in range(int(n_edges))]
+        return cls(root, regionals, edges, initial_model=initial_model)
+
+    # --- client-facing -----------------------------------------------------
+    def submit(self, rank: int, model_params: PyTree, sample_num: float,
+               client_version: Optional[int] = None,
+               telemetry_delta: Optional[dict] = None) -> str:
+        edge = self.edges[int(rank) % len(self.edges)]
+        with tel.activated(self._trace):
+            return edge.submit(rank, model_params, sample_num, client_version,
+                               telemetry_delta=telemetry_delta)
+
+    def latest_model(self) -> Optional[PyTree]:
+        with self._lock:
+            return self._model
+
+    @property
+    def version(self) -> int:
+        return self.root.buffer.version
+
+    # --- downward flow -----------------------------------------------------
+    def _on_root_publish(self, model: PyTree) -> None:
+        version = self.root.buffer.version
+        with self._lock:
+            self._model = model
+            # new trace per global model generation: the next cascade of
+            # edge/regional publishes groups under the new round index
+            self._trace = TraceContext(new_trace_id(), round_idx=version)
+        with tel.span("hierarchy.version_sync", version=version):
+            for node in self.regionals + self.edges:
+                # sync the staleness clocks: every tier now judges arrivals
+                # against the newest GLOBAL model version
+                with node.buffer._lock:  # fedlint: disable=lock-discipline version stamp only, never folds under a foreign lock
+                    node.buffer.version = version
+
+    # --- introspection -----------------------------------------------------
+    def nodes(self) -> List[HierarchyNode]:
+        return [self.root] + self.regionals + self.edges
+
+    def statusz(self) -> Dict[str, Any]:
+        return {
+            "version": self.version,
+            "n_edges": len(self.edges),
+            "n_regionals": len(self.regionals),
+            "nodes": {n.name: n.statusz() for n in self.nodes()},
+        }
+
+    def prom_gauges(self) -> List[tuple]:
+        out: List[tuple] = []
+        for n in self.nodes():
+            out.extend(n.prom_gauges())
+        return out

@@ -73,6 +73,8 @@ def statusz_snapshot() -> Dict[str, Any]:
     for key, counter_name in (
         ("quorum_partial_total", "quorum.partial"),
         ("quorum_late_discarded_total", "quorum.late_discarded"),
+        ("quorum_stale_accepted_total", "quorum.stale_accepted"),
+        ("quorum_stale_rejected_total", "quorum.stale_rejected"),
         ("checkpoint_dropped_total", "checkpoint.dropped"),
     ):
         c = t._counters.get(counter_name)

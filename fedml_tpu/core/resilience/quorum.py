@@ -39,11 +39,19 @@ log = logging.getLogger(__name__)
 PARTIAL_COUNTER = "quorum.partial"
 LATE_COUNTER = "quorum.late_discarded"
 SURPLUS_COUNTER = "quorum.surplus"
+STALE_ACCEPTED_COUNTER = "quorum.stale_accepted"
+STALE_REJECTED_COUNTER = "quorum.stale_rejected"
 
 ACCEPT = "accept"
 LATE = "late"
 SURPLUS = "surplus"
 DUPLICATE = "duplicate"
+# async-mode verdicts (core/aggregation/async_buffer.py): an arrival trained
+# on an older model version is either admitted with a decayed weight or
+# refused past the admission cut — LATE/SURPLUS never fire in async mode
+# because there is no round barrier to be late for.
+STALE_ACCEPTED = "stale_accepted"
+STALE_REJECTED = "stale_rejected"
 
 
 def overprovisioned_cohort_size(k: int, frac: float, stragglers_flagged: bool,

@@ -36,6 +36,14 @@ class ClientMasterManager(FedMLCommManager):
         self.is_inited = False
         # telemetry shipping: spans after this seq go out with the next upload
         self._tel_cursor = 0
+        # the published-model version this client last trained on; echoed on
+        # upload so an async server can weight the delta by staleness
+        self._model_version: Optional[int] = None
+        # opt-in uplink compression (args.comm_compressor: eftopk/topk/qsgd/
+        # quantize) at the flat-vector boundary; eftopk keeps its residual here
+        from ...utils.compression import make_comm_compressor
+
+        self._comm_compressor = make_comm_compressor(args)
 
     def run(self) -> None:
         # an exception anywhere in the client's receive loop (trainer bug,
@@ -79,6 +87,7 @@ class ClientMasterManager(FedMLCommManager):
         # a resumed server's first round is not 0 — adopt its round index so
         # local-training seeds replay exactly (crash-resume bit-identity)
         self.args.round_idx = int(msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX) or 0)
+        self._adopt_model_version(msg_params)
         self.__train()
 
     def handle_message_receive_model_from_server(self, msg_params: Message) -> None:
@@ -87,6 +96,7 @@ class ClientMasterManager(FedMLCommManager):
         self.client_index = int(client_index)
         self.trainer_dist_adapter.update_dataset(int(client_index))
         self.trainer_dist_adapter.update_model(model_params)
+        self._adopt_model_version(msg_params)
         ridx = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
         if ridx is not None:
             # our server stamps every sync with its round index; adopt it —
@@ -124,6 +134,11 @@ class ClientMasterManager(FedMLCommManager):
         mlops.log_training_status("FINISHED", str(getattr(self.args, "run_id", "0")))
         self.finish()
 
+    def _adopt_model_version(self, msg_params: Message) -> None:
+        v = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_VERSION)
+        if v is not None:
+            self._model_version = int(v)
+
     def send_client_status(self, receive_id: int, status: str) -> None:
         import platform
 
@@ -135,11 +150,17 @@ class ClientMasterManager(FedMLCommManager):
     def send_model_to_server(self, receive_id: int, weights, local_sample_num) -> None:
         mlops.event("comm_c2s", event_started=True, event_value=str(self.args.round_idx))
         with tel.span("client.upload", round=int(self.args.round_idx)):
+            if self._comm_compressor is not None:
+                with tel.span("client.compress", kind=self._comm_compressor.kind):
+                    weights = self._comm_compressor.compress_tree(weights)
             message = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.client_real_id, receive_id)
             message.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
             message.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, int(local_sample_num))
             # round tag: the server's quorum discards deltas from past rounds
             message.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, int(self.args.round_idx))
+            if self._model_version is not None:
+                # staleness tag: which published model this delta trained on
+                message.add_params(MyMessage.MSG_ARG_KEY_MODEL_VERSION, int(self._model_version))
             self._attach_telemetry_delta(message)
             self.send_message(message)
 

@@ -31,6 +31,10 @@ class MyMessage:
     MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
     MSG_ARG_KEY_LOCAL_TRAINING_DATA_SIZE = "local_sample_num"
     MSG_ARG_KEY_ROUND_IDX = "round_idx"
+    # async (non-barrier) rounds: the server stamps every model sync with the
+    # published model version; clients echo the version they trained on so
+    # the async buffer's staleness policy can weight/admit the delta
+    MSG_ARG_KEY_MODEL_VERSION = "model_version"
 
     # statuses
     MSG_CLIENT_STATUS_OFFLINE = "OFFLINE"

@@ -82,6 +82,15 @@ class FedMLAggregator:
             eng = get_engine()
             if isinstance(eng, ShardedBucketedAggregator):
                 self._sharded_engine = eng
+        # async (non-barrier) rounds: deltas fold into this buffer at arrival
+        # instead of parking in model_dict until a round completes
+        self.async_buffer = None
+        if getattr(args, "async_rounds", False):
+            from ...core.aggregation.async_buffer import buffer_from_args
+            from ...core.aggregation.bucketed import get_engine
+
+            self.async_buffer = buffer_from_args(
+                args, health=self.fleet.health, engine=get_engine())
         Context().add(Context.KEY_TEST_DATA, test_global)
 
     def _sharded_ingest_engine(self):
@@ -133,6 +142,30 @@ class FedMLAggregator:
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = sample_num
         self.flag_client_model_uploaded_dict[index] = True
+
+    # --- async (non-barrier) rounds ---------------------------------------
+    def submit_async_result(self, index: int, model_params, sample_num,
+                            client_version: Optional[int]) -> str:
+        """Fold one arrival straight into the async buffer (no round barrier).
+        Returns the staleness verdict. The buffer itself handles sharded
+        ingestion; float trees take the same one-transfer-per-dtype-group
+        upload as the synchronous path."""
+        if _float_array_leaves_only(model_params) and self._sharded_engine is None:
+            model_params = tree_from_numpy(model_params)
+        return self.async_buffer.submit(
+            int(index), model_params, float(sample_num), client_version)
+
+    def publish_async(self):
+        """Publish a new global model from the buffered merges (None when
+        nothing merged since the last publish) and install it as the global
+        params. The async path is plain staleness-scaled sample-weighted
+        averaging — the on_before/on_after aggregation hooks (attack, defense,
+        DP, contribution) need the full round's raw client trees and do not
+        run here."""
+        published = self.async_buffer.publish()
+        if published is not None:
+            self.set_global_model_params(published)
+        return published
 
     def reset_round_flags(self) -> None:
         """Clear upload flags after a quorum-driven (partial or keep-first-k)

@@ -42,6 +42,14 @@ class FedMLServerManager(FedMLCommManager):
         self._round_span = None
         self._round_span_idx: Optional[int] = None
         self._statusz_server: Optional[statusz.StatuszServer] = None
+        # --- async (non-barrier) rounds ------------------------------------
+        # round_idx counts PUBLISHES in async mode: every upload gets an
+        # immediate model reply, a new global model publishes every
+        # args.async_publish_k buffered merges, and the run finishes after
+        # comm_round publishes — no per-cohort barrier anywhere
+        self._async_mode = bool(getattr(args, "async_rounds", False))
+        self._silo_of: Dict[int, int] = {}
+        self._ckpt_step = 0
         # --- resilience: quorum rounds + durable round state ---------------
         self._quorum_policy = QuorumPolicy.from_args(args)
         self._round_quorum: Optional[RoundQuorum] = None
@@ -54,20 +62,42 @@ class FedMLServerManager(FedMLCommManager):
         rdir = getattr(args, "resilience_dir", None)
         if rdir:
             self._round_store = RoundStateStore(str(rdir))
+            latest = self._round_store.latest_complete_round()
+            self._ckpt_step = 0 if latest is None else int(latest) + 1
             if getattr(args, "resume", False):
                 self._try_resume()
 
     def _try_resume(self) -> None:
         """Restart from the last complete round: restore the global model,
         the cohort health baselines, the numpy RNG, and set ``round_idx`` to
-        the first round that never finished."""
-        rs = self._round_store.resume(
-            template={"model": self.aggregator.get_global_model_params()}
-        )
+        the first round that never finished. In async mode the checkpoint
+        additionally carries the buffer (accumulator + un-folded pending
+        deltas + staleness clock), so a SIGKILL mid-window resumes with the
+        partial buffer intact and subsequent merges are bit-identical."""
+        model_template = self.aggregator.get_global_model_params()
+        template = {"model": model_template}
+        buf = getattr(self.aggregator, "async_buffer", None)
+        buf_meta = None
+        if self._async_mode and buf is not None:
+            # the pending-delta count varies per snapshot: read the meta
+            # sidecar FIRST so orbax gets a structure-matching template
+            step = self._round_store.latest_complete_round()
+            meta = self._round_store.read_meta(step) if step is not None else None
+            buf_meta = (meta or {}).get("async_buffer")
+            if buf_meta:
+                btmpl = buf.state_template(model_template, buf_meta)
+                if btmpl:
+                    template["async_buffer"] = btmpl
+        rs = self._round_store.resume(template=template)
         if rs is None:
             return
         self.aggregator.set_global_model_params(rs.state["model"])
-        self.args.round_idx = rs.round_idx + 1
+        if self._async_mode and buf is not None and buf_meta:
+            buf.restore(rs.state.get("async_buffer", {}), buf_meta,
+                        template=rs.state["model"])
+            self.args.round_idx = buf.version
+        else:
+            self.args.round_idx = rs.round_idx + 1
         restore_numpy_rng(rs.meta.get("numpy_rng"))
         fleet = getattr(self.aggregator, "fleet", None)
         if fleet is not None:
@@ -111,14 +141,24 @@ class FedMLServerManager(FedMLCommManager):
         if port is None:
             return
         fleet = getattr(self.aggregator, "fleet", None)
+        buf = getattr(self.aggregator, "async_buffer", None)
         statusz.register_section("round", self._statusz_round_section)
         if fleet is not None:
             statusz.register_section("health", fleet.health.statusz)
+        if buf is not None:
+            statusz.register_section("async", buf.statusz)
+
+        def gauges():
+            out = list(fleet.health.prom_gauges()) if fleet is not None else []
+            if buf is not None:
+                out.extend(buf.prom_gauges())
+            return out
+
         port_file = getattr(self.args, "statusz_port_file", None)
         self._statusz_server = statusz.StatuszServer(
             port=int(port),
             service="cross_silo_server",
-            gauges_fn=(fleet.health.prom_gauges if fleet is not None else None),
+            gauges_fn=gauges if (fleet is not None or buf is not None) else None,
             port_file=str(port_file) if port_file else None,
         )
         bound = self._statusz_server.start()
@@ -129,6 +169,7 @@ class FedMLServerManager(FedMLCommManager):
             return
         statusz.unregister_section("round")
         statusz.unregister_section("health")
+        statusz.unregister_section("async")
         self._statusz_server.stop()
         self._statusz_server = None
 
@@ -219,10 +260,16 @@ class FedMLServerManager(FedMLCommManager):
             len(self.client_id_list_in_this_round),
         )
         self._keep_k = min(k, len(self.client_id_list_in_this_round))
+        # async replies go to one sender at a time, long after the cohort
+        # list was built — remember each client's silo assignment
+        self._silo_of = {int(cid): int(self.data_silo_index_list[i])
+                         for i, cid in enumerate(self.client_id_list_in_this_round)}
         self._declare_cohort()
 
     # --- quorum round lifecycle -------------------------------------------
     def _begin_quorum_round(self) -> None:
+        if self._async_mode:
+            return  # no barrier, no deadline: staleness policy governs instead
         if not self._quorum_policy.enabled:
             return
         with self._round_lock:
@@ -317,6 +364,11 @@ class FedMLServerManager(FedMLCommManager):
         sender_id = msg_params.get_sender_id()
         model_params = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         local_sample_number = msg_params.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        from ...utils.compression import decompress_comm_payload, is_comm_payload
+
+        if is_comm_payload(model_params):
+            with tel.span("server.decompress", sender=int(sender_id)):
+                model_params = decompress_comm_payload(model_params)
         delta_round = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
         header = trace_context.telemetry_header(msg_params)
         # the aggregator interface is duck-typed (fa/cross_silo.py adapts an
@@ -324,6 +376,9 @@ class FedMLServerManager(FedMLCommManager):
         merge = getattr(self.aggregator, "merge_client_telemetry", None)
         if merge is not None and header is not None and trace_context.DELTA_FIELD in header:
             merge(sender_id, header[trace_context.DELTA_FIELD])
+        if self._async_mode:
+            self._handle_async_upload(sender_id, model_params, local_sample_number, msg_params)
+            return
         with self._round_lock:
             q = self._round_quorum
             if q is not None:
@@ -345,6 +400,75 @@ class FedMLServerManager(FedMLCommManager):
             elif not self.aggregator.check_whether_all_receive():
                 return
             self._complete_round()
+
+    # --- async (non-barrier) flow ------------------------------------------
+    def _handle_async_upload(self, sender_id: int, model_params,
+                             local_sample_number, msg_params: Message) -> None:
+        """One async arrival: fold it immediately, publish if the window
+        filled, and reply to THIS sender with the newest global model so it
+        starts its next local round while other clients are still training —
+        the PiPar overlap that makes rounds/hr independent of cohort size."""
+        client_version = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_VERSION)
+        buf = self.aggregator.async_buffer
+        with self._round_lock:
+            with tel.span("server.async_receive", sender=int(sender_id),
+                          version=buf.version):
+                verdict = self.aggregator.submit_async_result(
+                    sender_id - 1, model_params, local_sample_number,
+                    None if client_version is None else int(client_version))
+            fleet = getattr(self.aggregator, "fleet", None)
+            if fleet is not None:
+                fleet.health.heartbeat(sender_id)
+            if verdict == quorum_mod.STALE_REJECTED:
+                mlops.log_resilience_event(
+                    "stale_rejected", round_idx=buf.version, rank=int(sender_id))
+            note(last_async=buf.statusz())
+            ckpt_every = int(getattr(self.args, "async_checkpoint_every_merges", 0) or 0)
+            if buf.ready():
+                self._complete_async_publish()
+                if self.args.round_idx >= self.round_num:
+                    return  # finished: S2C_FINISH already sent to everyone
+            elif ckpt_every and buf.merges_total % ckpt_every == 0:
+                # mid-window durability: snapshot the half-full buffer so a
+                # SIGKILL here resumes with the partial merges intact
+                self._save_round_state(int(self.args.round_idx),
+                                       self.aggregator.get_global_model_params())
+            self.send_message_sync_model_to_client(
+                sender_id, self.aggregator.get_global_model_params(),
+                self._silo_of.get(int(sender_id), sender_id - 1))
+
+    def _complete_async_publish(self) -> None:
+        """Publish one async model generation: install it, evaluate on the
+        test cadence, checkpoint, and finish the run after ``comm_round``
+        publishes. Caller holds ``_round_lock``."""
+        global_model_params = self.aggregator.publish_async()
+        if global_model_params is None:
+            return
+        buf = self.aggregator.async_buffer
+        round_idx = buf.version - 1  # the generation just published
+        self.args.round_idx = buf.version
+        mlops.event("server.agg_and_eval", event_started=True, event_value=str(round_idx))
+        with tel.span("server.eval", round=round_idx):
+            metrics = self.aggregator.test_on_server_for_all_clients(round_idx)
+        if metrics is not None:
+            self.final_metrics = metrics
+        mlops.event("server.agg_and_eval", event_started=False, event_value=str(round_idx))
+        mlops.log_round_info(self.round_num, round_idx)
+        mlops.log_telemetry_summary(round_idx)
+        fleet = getattr(self.aggregator, "fleet", None)
+        if fleet is not None and fleet.merges:
+            report = fleet.health.end_round(round_idx)
+            mlops.log_health_report(round_idx, report)
+        final = buf.version >= self.round_num
+        self._save_round_state(round_idx, global_model_params, final=final)
+        if final:
+            mlops.log_aggregation_status("FINISHED", str(getattr(self.args, "run_id", "0")))
+            self.send_finish_to_all()
+            self._end_round_trace()
+            self._export_fleet_trace_if_configured()
+            self.finish()
+            return
+        self._begin_round_trace()
 
     def _complete_round(self) -> None:
         """Aggregate (all arrivals, or the quorum's partial set), evaluate,
@@ -412,20 +536,45 @@ class FedMLServerManager(FedMLCommManager):
             return
         kill_after = getattr(self.args, "chaos_kill_after_round", None)
         kill_now = kill_after is not None and int(round_idx) == int(kill_after)
-        if final or kill_now:
+        # async drill (``args.chaos_kill_after_merges``): SIGKILL right after
+        # the Nth merge's snapshot COMMITS — the machine dies with a durable
+        # mid-window checkpoint, so resume must rebuild a NON-EMPTY buffer
+        # (vs chaos_kill_after_round, which models the torn-save shape)
+        kill_merges = getattr(self.args, "chaos_kill_after_merges", None)
+        kill_committed = False
+        if self._async_mode and kill_merges is not None:
+            kill_committed = int(self.aggregator.async_buffer.merges_total) == int(kill_merges)
+        if final or kill_now or kill_committed:
             # drain before the final (sync) save so it cannot be dropped; the
             # chaos kill also drains first so earlier rounds are committed and
             # the drill models "watermark at round k-1, round k's save torn"
             self._round_store.wait()
         fleet = getattr(self.aggregator, "fleet", None)
+        state = {"model": global_model_params}
+        extra_meta = None
+        step = int(round_idx)
+        if self._async_mode:
+            # async saves happen mid-window too (same FL round, newer buffer
+            # contents), so the checkpoint step is a monotone save counter and
+            # the FL round travels in the meta; the buffer snapshot carries
+            # the partial accumulator + pending deltas + staleness clock
+            buf = self.aggregator.async_buffer
+            bstate = buf.export_pytree_state()
+            if bstate:
+                state["async_buffer"] = bstate
+            extra_meta = {"async_buffer": buf.export_meta(),
+                          "fl_round_idx": int(round_idx)}
+            step = self._ckpt_step
+            self._ckpt_step += 1
         self._round_store.save_round(
-            int(round_idx),
-            {"model": global_model_params},
+            step,
+            state,
             cohort=[int(c) for c in (self.client_id_list_in_this_round or [])],
             health=(fleet.health.export_state() if fleet is not None else None),
-            wait=final,
+            extra_meta=extra_meta,
+            wait=final or kill_committed,
         )
-        if kill_now:
+        if kill_now or kill_committed:
             import os
             import signal
 
@@ -447,12 +596,20 @@ class FedMLServerManager(FedMLCommManager):
             log.exception("fleet trace export failed")
 
     # --- senders ----------------------------------------------------------
+    def _model_version(self) -> int:
+        """The published-model version stamped on every model sync: the async
+        buffer's version in async mode, the round index otherwise (one
+        publish per round makes them the same thing synchronously)."""
+        buf = getattr(self.aggregator, "async_buffer", None)
+        return int(buf.version) if buf is not None else int(self.args.round_idx)
+
     def send_message_init_config(self, receive_id: int, global_model_params, datasilo_index) -> None:
         message = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.get_sender_id(), receive_id)
         message.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model_params)
         message.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(datasilo_index))
         # a resumed server's first round is not round 0 — clients adopt this
         message.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, int(self.args.round_idx))
+        message.add_params(MyMessage.MSG_ARG_KEY_MODEL_VERSION, self._model_version())
         self.send_message(message)
 
     def send_message_sync_model_to_client(self, receive_id: int, global_model_params, client_index) -> None:
@@ -460,6 +617,7 @@ class FedMLServerManager(FedMLCommManager):
         message.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model_params)
         message.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(client_index))
         message.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.args.round_idx)
+        message.add_params(MyMessage.MSG_ARG_KEY_MODEL_VERSION, self._model_version())
         self.send_message(message)
 
     def send_finish_to_all(self) -> None:

@@ -69,9 +69,16 @@ class SimulatorSingleProcess:
 
 class SimulatorVmap:
     def __init__(self, args: Any, device: Any, dataset, model, client_trainer=None, server_aggregator=None):
-        from .vmapped.vmap_fedavg import VmapFedAvgAPI
+        if getattr(args, "async_rounds", False):
+            # non-barrier variant: event-driven async federation, publishes
+            # every args.async_publish_k merges (comm_round counts publishes)
+            from .vmapped.async_driver import VmapAsyncFedAvgAPI
 
-        self.fl_trainer = VmapFedAvgAPI(args, device, dataset, model)
+            self.fl_trainer = VmapAsyncFedAvgAPI(args, device, dataset, model)
+        else:
+            from .vmapped.vmap_fedavg import VmapFedAvgAPI
+
+            self.fl_trainer = VmapFedAvgAPI(args, device, dataset, model)
 
     def run(self):
         return self.fl_trainer.train()

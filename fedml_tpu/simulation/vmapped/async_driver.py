@@ -1,0 +1,385 @@
+"""Event-driven asynchronous FL simulation with vmapped delta generation.
+
+``vmap_fedavg.py`` proves the synchronous claim (a whole cohort's local
+training as ONE XLA program); this module proves the asynchronous one:
+**rounds/hr independent of cohort size**. A discrete-event loop advances a
+virtual clock over per-client completion events (heterogeneous delays — slow
+clients exist, that is the point of staleness policy), folds each arrival into
+an :class:`~fedml_tpu.core.aggregation.async_buffer.AsyncAggBuffer` (or a
+:class:`~fedml_tpu.core.distributed.hierarchy.HierarchyTree`), and lets the
+buffer publish every ``publish_k`` merges. The server-side cost per publish is
+O(publish_k) regardless of how many clients are in flight — which is what
+``bench.py --stage async_rounds`` measures at 1k/10k/100k simulated clients.
+
+Delta generation is LAZY and BATCHED: a dispatch records only
+``(client, model_version)``; when the event loop first needs a delta it
+vmap-generates deltas for up to ``gen_batch`` pending dispatches that share
+that model version in one device dispatch (the model is identical inside a
+version group, so the client dimension batches exactly like the synchronous
+simulator). Memory therefore stays O(gen_batch x model + versions_in_flight
+x model), not O(cohort x model) — 100k clients in flight hold 100k scalar
+event records, not 100k model copies.
+
+Event ordering is EXACT (arrivals process strictly in virtual-time order, one
+submit at a time, staleness judged against the live version) — batching only
+reorders *generation*, which is order-independent: a delta is a pure function
+of (model version, client id), never of the clock.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...core.aggregation.async_buffer import AsyncAggBuffer, buffer_from_args
+from ...core.aggregation.bucketed import get_engine
+from ...core.distributed.hierarchy import HierarchyTree
+from .vmap_fedavg import VmapFedAvgAPI
+
+log = logging.getLogger(__name__)
+
+PyTree = Any
+
+# train_batch(model, client_ids[int32 array], version) -> stacked delta pytree
+# (leading axis == len(client_ids)); pure in (version, client id)
+TrainBatchFn = Callable[[PyTree, np.ndarray, int], PyTree]
+
+DEFAULT_GEN_BATCH = 1024
+
+
+class DelayModel:
+    """Per-client heterogeneous completion delays.
+
+    Client ``c`` owns a base latency drawn ONCE from a lognormal centred on
+    ``mean_delay`` with spread ``heterogeneity`` (a persistent slow-device
+    population — the straggler tail that makes staleness policy matter), and
+    each dispatch multiplies it by ``min_frac + Exp(1)`` (per-round jitter;
+    the floor keeps delays strictly positive so event times stay ordered).
+    Fully deterministic under ``seed``.
+    """
+
+    def __init__(self, n_clients: int, mean_delay: float = 1.0,
+                 heterogeneity: float = 0.5, min_frac: float = 0.1,
+                 seed: int = 0):
+        if n_clients < 1:
+            raise ValueError(f"n_clients must be >= 1, got {n_clients}")
+        base_rng = np.random.default_rng(int(seed))
+        self.base = np.asarray(
+            float(mean_delay) * np.exp(base_rng.normal(0.0, float(heterogeneity), int(n_clients))),
+            np.float64)
+        self.min_frac = float(min_frac)
+        self._rng = np.random.default_rng(int(seed) + 1)
+
+    @classmethod
+    def from_args(cls, args: Any, n_clients: int) -> "DelayModel":
+        return cls(
+            n_clients,
+            mean_delay=float(getattr(args, "async_mean_delay", 1.0)),
+            heterogeneity=float(getattr(args, "async_delay_heterogeneity", 0.5)),
+            seed=int(getattr(args, "random_seed", 0)),
+        )
+
+    def draw(self, client_ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(client_ids, np.int64)
+        return self.base[ids] * (self.min_frac + self._rng.exponential(1.0, size=ids.shape))
+
+
+class AsyncEventSim:
+    """The discrete-event async federation loop over a buffer or hierarchy.
+
+    ``sink`` is either an :class:`AsyncAggBuffer` (the sim drives its
+    ``ready``/``publish`` cycle) or a :class:`HierarchyTree` (publishes
+    cascade inside ``submit``; the sim watches the root version). Each
+    arrival's submit + publish work is timed with ``perf_counter`` into
+    ``server_seconds`` — the denominator of the bench's rounds/hr, which
+    deliberately EXCLUDES delta generation (that is simulated client compute,
+    massively parallel in a real fleet and overlapped with server work in the
+    PiPar sense).
+    """
+
+    def __init__(self, sink: Any, train_batch: TrainBatchFn, n_clients: int,
+                 initial_model: PyTree, weights: Optional[np.ndarray] = None,
+                 in_flight: Optional[int] = None,
+                 delay: Optional[DelayModel] = None,
+                 gen_batch: int = DEFAULT_GEN_BATCH,
+                 on_publish: Optional[Callable[[int, PyTree], None]] = None):
+        self.sink = sink
+        self.train_batch = train_batch
+        self.n_clients = int(n_clients)
+        self.weights = (np.ones(self.n_clients, np.float64) if weights is None
+                        else np.asarray(weights, np.float64))
+        self.in_flight = min(int(in_flight or n_clients), self.n_clients)
+        self.delay = delay or DelayModel(self.n_clients)
+        self.gen_batch = max(1, int(gen_batch))
+        self.on_publish = on_publish
+        self._is_tree = isinstance(sink, HierarchyTree)
+        self._last_seen_version = int(sink.version)
+        # virtual state
+        self._events: List[Tuple[float, int, int, int]] = []  # (t, seq, client, version)
+        self._seq = 0
+        self._models: Dict[int, PyTree] = {int(self._version()): initial_model}
+        # ungenerated dispatches, grouped by the model version they train on
+        self._pending_by_version: Dict[int, List[Tuple[float, int, int]]] = {}
+        self._deltas: Dict[int, PyTree] = {}
+        # stats
+        self.merges = 0
+        self.publishes = 0
+        self.rejected = 0
+        self.staleness_samples: List[int] = []
+        self.virtual_time = 0.0
+        self.server_seconds = 0.0
+        self.gen_dispatches = 0  # device dispatches spent generating deltas
+
+    # --- sink facade -------------------------------------------------------
+    def _version(self) -> int:
+        return int(self.sink.version)
+
+    def _submit(self, client: int, tree: PyTree, weight: float, version: int) -> str:
+        return self.sink.submit(int(client), tree, float(weight), int(version))
+
+    def _try_publish(self) -> Optional[Tuple[int, PyTree]]:
+        """(new_version, model) when a global publish happened, else None."""
+        if self._is_tree:
+            # edge/regional publishes cascaded inside submit; a root publish
+            # shows up as a version bump + a fresh latest_model
+            v = self._version()
+            if v == self._last_seen_version:
+                return None
+            self._last_seen_version = v
+            model = self.sink.latest_model()
+            return (v, model) if model is not None else None
+        if not self.sink.ready():
+            return None
+        model = self.sink.publish()
+        if model is None:
+            return None
+        self._last_seen_version = self._version()
+        return (self._last_seen_version, model)
+
+    # --- dispatch / generation ---------------------------------------------
+    def _dispatch(self, clients, now) -> None:
+        version = self._version()
+        cs = np.asarray(clients, np.int64)
+        ts = np.asarray(now, np.float64)
+        delays = self.delay.draw(cs)
+        group = self._pending_by_version.setdefault(version, [])
+        for c, t0, d in zip(cs, ts, delays):
+            seq = self._seq
+            self._seq += 1
+            t = float(t0 + d)
+            heapq.heappush(self._events, (t, seq, int(c), version))
+            group.append((t, seq, int(c)))
+
+    def _ensure_delta(self, seq: int, version: int) -> None:
+        if seq in self._deltas:
+            return
+        pending = self._pending_by_version.get(version) or []
+        # the event being processed is the earliest arrival overall, hence the
+        # earliest of its version group — generating the group front-to-back
+        # by arrival time means later flushes never regenerate
+        pending.sort()
+        take, rest = pending[: self.gen_batch], pending[self.gen_batch:]
+        self._pending_by_version[version] = rest
+        ids = np.asarray([c for _, _, c in take], np.int32)
+        stacked = self.train_batch(self._models[version], ids, version)
+        self.gen_dispatches += 1
+        for k, (_, s, _) in enumerate(take):
+            self._deltas[s] = jax.tree.map(lambda leaf, _k=k: leaf[_k], stacked)
+        if not rest:
+            self._pending_by_version.pop(version, None)
+            self._prune_models()
+
+    def _prune_models(self) -> None:
+        """Drop model versions no ungenerated dispatch references (generated
+        deltas never need the model again; the current version always stays)."""
+        current = self._version()
+        for v in [v for v in self._models
+                  if v != current and v not in self._pending_by_version]:
+            del self._models[v]
+
+    def _install_model(self, version: int, model: PyTree) -> None:
+        self._models[version] = model
+        self._prune_models()
+        if self.on_publish is not None:
+            self.on_publish(version, model)
+
+    # --- driver ------------------------------------------------------------
+    def run(self, publish_target: int, max_events: Optional[int] = None) -> Dict[str, Any]:
+        """Advance virtual time until ``publish_target`` global publishes
+        (``max_events`` caps the loop when a hostile staleness config rejects
+        everything). Returns :meth:`stats`."""
+        self._dispatch(np.arange(self.in_flight, dtype=np.int64),
+                       np.zeros(self.in_flight))
+        if max_events is None:
+            max_events = publish_target * max(self._publish_k(), 1) * 50
+        processed = 0
+        while self._events and self.publishes < publish_target and processed < max_events:
+            t, seq, client, version = heapq.heappop(self._events)
+            self.virtual_time = t
+            self._ensure_delta(seq, version)
+            delta = self._deltas.pop(seq)
+            staleness = max(0, self._version() - version)
+            t0 = time.perf_counter()
+            verdict = self._submit(client, delta, self.weights[client], version)
+            published = self._try_publish()
+            self.server_seconds += time.perf_counter() - t0
+            processed += 1
+            if verdict == "stale_rejected":
+                self.rejected += 1
+            else:
+                self.merges += 1
+                self.staleness_samples.append(staleness)
+            if published is not None:
+                self.publishes += 1
+                self._install_model(*published)
+            # the client pulls the freshest model with its upload ack and
+            # immediately starts the next local round (PiPar overlap)
+            self._dispatch([client], [t])
+        return self.stats()
+
+    def _publish_k(self) -> int:
+        if self._is_tree:
+            return int(self.sink.edges[0].buffer.publish_k)
+        return int(self.sink.publish_k)
+
+    # --- stats -------------------------------------------------------------
+    def _high_water(self) -> int:
+        if self._is_tree:
+            return max(n.buffer.depth_high_water for n in self.sink.nodes())
+        return int(self.sink.depth_high_water)
+
+    def stats(self) -> Dict[str, Any]:
+        s = np.asarray(self.staleness_samples or [0], np.float64)
+        return {
+            "n_clients": self.n_clients,
+            "in_flight": self.in_flight,
+            "merges": self.merges,
+            "publishes": self.publishes,
+            "stale_rejected": self.rejected,
+            "virtual_time": float(self.virtual_time),
+            "server_seconds": float(self.server_seconds),
+            "gen_dispatches": int(self.gen_dispatches),
+            "staleness_mean": float(s.mean()),
+            "staleness_p50": float(np.percentile(s, 50)),
+            "staleness_p99": float(np.percentile(s, 99)),
+            "buffer_high_water": self._high_water(),
+        }
+
+
+def make_synthetic_delta_fn(seed: int = 0, step_scale: float = 0.01) -> TrainBatchFn:
+    """A cheap, deterministic stand-in for local training (bench substrate):
+    client ``c``'s delta on model version ``v`` is ``model + step_scale *
+    N(0,1)`` keyed by ``fold_in(fold_in(seed, c), v)`` — pure in (c, v) like
+    real local SGD under the simulator's seeding discipline, and vmapped so a
+    whole generation batch is one device dispatch."""
+    base_key = jax.random.PRNGKey(int(seed))
+
+    def _one(model: PyTree, key: jax.Array) -> PyTree:
+        leaves, treedef = jax.tree.flatten(model)
+        keys = list(jax.random.split(key, len(leaves)))
+        noise = [jax.random.normal(k, np.shape(l), l.dtype) for k, l in zip(keys, leaves)]
+        return jax.tree.unflatten(
+            treedef, [l + np.float32(step_scale) * n for l, n in zip(leaves, noise)])
+
+    _vmapped = jax.jit(jax.vmap(_one, in_axes=(None, 0)))
+    _keys = jax.jit(jax.vmap(
+        lambda c, v: jax.random.fold_in(jax.random.fold_in(base_key, c), v),
+        in_axes=(0, None)))
+
+    def batch(model: PyTree, client_ids: np.ndarray, version: int) -> PyTree:
+        return _vmapped(model, _keys(np.asarray(client_ids, np.int32), int(version)))
+
+    return batch
+
+
+def simulate_async_rounds(n_clients: int, publish_k: int, template: PyTree,
+                          publishes: int, *, hierarchy_edges: int = 0,
+                          gen_batch: int = DEFAULT_GEN_BATCH,
+                          buffer: Optional[AsyncAggBuffer] = None,
+                          seed: int = 0, mean_delay: float = 1.0,
+                          heterogeneity: float = 0.5) -> Dict[str, Any]:
+    """One synthetic async federation run (the bench's workhorse): ``n_clients``
+    simulated clients with heterogeneous delays drive a fresh buffer (or an
+    edge→regional→root tree when ``hierarchy_edges > 0``) until ``publishes``
+    global model versions exist. Returns the sim stats."""
+    if hierarchy_edges > 0:
+        sink: Any = HierarchyTree.build(
+            hierarchy_edges, publish_k=publish_k, engine=get_engine(),
+            initial_model=template)
+    elif buffer is not None:
+        sink = buffer
+    else:
+        sink = AsyncAggBuffer(publish_k=publish_k, engine=get_engine())
+    sim = AsyncEventSim(
+        sink, make_synthetic_delta_fn(seed=seed), n_clients,
+        initial_model=template,
+        delay=DelayModel(n_clients, mean_delay=mean_delay,
+                         heterogeneity=heterogeneity, seed=seed),
+        gen_batch=gen_batch)
+    return sim.run(publishes)
+
+
+class VmapAsyncFedAvgAPI(VmapFedAvgAPI):
+    """Asynchronous counterpart of :class:`VmapFedAvgAPI`: same vmapped
+    local-training program, but the round barrier is replaced by the event
+    loop + async buffer. ``client_num_per_round`` clients stay in flight;
+    ``comm_round`` counts PUBLISHES (model versions), matching the cross-silo
+    server's async semantics. Evaluation runs on publish at the usual
+    ``frequency_of_the_test`` cadence."""
+
+    def train(self) -> Dict[str, float]:
+        args = self.args
+        n_total = int(args.client_num_in_total)
+        in_flight = min(int(args.client_num_per_round), n_total)
+        publish_target = int(getattr(args, "comm_round", 10))
+        w_global = self.model.params
+        buffer = buffer_from_args(args, engine=get_engine())
+        base_key = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+
+        def train_batch(model: PyTree, client_ids: np.ndarray, version: int) -> PyTree:
+            ids = [int(c) for c in client_ids]
+            x, y, idx, mask = self._stack_clients(ids)
+            rngs = jax.vmap(
+                lambda c, v: jax.random.fold_in(jax.random.fold_in(base_key, c), v),
+                in_axes=(0, None))(np.asarray(ids, np.int32), int(version))
+            return self._vmapped_train(model, x, y, idx, mask, rngs, None).params
+
+        weights = np.asarray(
+            [float(self.train_data_local_num_dict[i]) for i in range(n_total)],
+            np.float64)
+        freq = int(getattr(args, "frequency_of_the_test", 5))
+
+        def on_publish(version: int, model: PyTree) -> None:
+            round_idx = version - 1
+            self.aggregator.set_model_params(model)
+            if round_idx == publish_target - 1 or (freq > 0 and round_idx % freq == 0):
+                metrics = self.aggregator.test(self.test_global, self.device, args)
+                metrics["round"] = round_idx
+                metrics["staleness_mean"] = float(
+                    np.mean(sim.staleness_samples or [0]))
+                log.info("vmap async sim publish %d: %s", version,
+                         {k: round(float(v), 4) for k, v in metrics.items()})
+                self.metrics_history.append(metrics)
+
+        sim = AsyncEventSim(
+            buffer, train_batch, n_total, initial_model=w_global,
+            weights=weights, in_flight=in_flight,
+            delay=DelayModel.from_args(args, n_total),
+            gen_batch=int(getattr(args, "async_gen_batch", DEFAULT_GEN_BATCH)),
+            on_publish=on_publish)
+        stats = sim.run(publish_target)
+        log.info("vmap async sim done: %s", stats)
+        w_final = self._models_latest(sim, w_global)
+        self.model = self.model.clone_with(w_final)
+        self.aggregator.set_model_params(w_final)
+        return self.metrics_history[-1] if self.metrics_history else {}
+
+    @staticmethod
+    def _models_latest(sim: AsyncEventSim, fallback: PyTree) -> PyTree:
+        v = sim._version()
+        return sim._models.get(v, fallback)

@@ -205,3 +205,101 @@ compressors = {
     "quantize": QuantizationCompressor,
     "qsgd": QSGDCompressor,
 }
+
+
+# ---------------------------------------------------------------------------
+# Comm-boundary wiring (opt-in via args.comm_compressor)
+# ---------------------------------------------------------------------------
+# The client→server uplink is the hot path once rounds stop barriering: every
+# client uploads every local round instead of once per global round. These
+# helpers apply the registry's kernels at the flat-vector comm boundary
+# (utils/pytree.tree_flatten_to_vector): the whole model compresses as ONE
+# f32 vector, not per-leaf, so top-k ranks magnitudes globally and the wire
+# payload is two small host arrays instead of a full tree.
+
+COMM_PAYLOAD_KEY = "__comm_compressed__"
+
+_SPARSE_KINDS = ("topk", "eftopk")
+_DENSE_KINDS = ("quantize", "qsgd")
+
+
+class CommCompressor:
+    """Stateful client-side compressor for model uploads.
+
+    ``eftopk`` keeps the error-feedback residual across uploads (one residual
+    per client process — exactly the reference semantics, just in flat space).
+    Decompression is stateless; the server uses :func:`decompress_comm_payload`.
+    """
+
+    def __init__(self, kind: str, ratio: float = 0.05,
+                 quantize_level: int = 8, seed: int = 0):
+        if kind not in _SPARSE_KINDS + _DENSE_KINDS:
+            raise ValueError(
+                f"unknown comm compressor {kind!r}; pick one of "
+                f"{sorted(_SPARSE_KINDS + _DENSE_KINDS)} (or unset args.comm_compressor)")
+        self.kind = kind
+        self.ratio = float(ratio)
+        self.quantize_level = int(quantize_level)
+        self._residual: Optional[jax.Array] = None
+        self._key = jax.random.PRNGKey(int(seed))
+
+    def compress_tree(self, tree: PyTree) -> Dict[str, Any]:
+        """Tree -> wire payload dict (host numpy leaves + the flat spec)."""
+        from .pytree import tree_flatten_to_vector
+
+        flat, spec = tree_flatten_to_vector(tree, jnp.float32)
+        size = int(flat.size)
+        payload: Dict[str, Any] = {COMM_PAYLOAD_KEY: True, "kind": self.kind,
+                                   "spec": spec, "size": size}
+        if self.kind in _SPARSE_KINDS:
+            k = max(1, min(size, int(np.ceil(size * self.ratio))))
+            if self.kind == "eftopk":
+                if self._residual is None or self._residual.size != size:
+                    self._residual = jnp.zeros((size,), flat.dtype)
+                (values, indexes), self._residual = ef_topk_step((self._residual, flat), k)
+            else:
+                values, indexes = topk_compress(flat, k)
+            payload["values"] = np.asarray(values)
+            payload["indexes"] = np.asarray(indexes)
+        else:
+            s = 2 ** self.quantize_level - 1
+            if self.kind == "qsgd":
+                self._key, sub = jax.random.split(self._key)
+                dense = qsgd_quantize(sub, flat, s, True)
+            else:
+                dense = naive_quantize(flat, s, True)
+            payload["dense"] = np.asarray(dense)
+        return payload
+
+    def reset(self) -> None:
+        self._residual = None
+
+
+def is_comm_payload(obj: Any) -> bool:
+    return isinstance(obj, dict) and bool(obj.get(COMM_PAYLOAD_KEY))
+
+
+def decompress_comm_payload(payload: Dict[str, Any]) -> PyTree:
+    """Wire payload -> tree (stateless; server side)."""
+    from .pytree import tree_unflatten_from_vector
+
+    size = int(payload["size"])
+    if payload["kind"] in _SPARSE_KINDS:
+        flat = topk_decompress(jnp.asarray(payload["values"]),
+                               jnp.asarray(payload["indexes"]), size)
+    else:
+        flat = jnp.asarray(payload["dense"])
+    return tree_unflatten_from_vector(flat, payload["spec"])
+
+
+def make_comm_compressor(args: Any) -> Optional[CommCompressor]:
+    """Build the upload compressor from args (None when not configured)."""
+    kind = getattr(args, "comm_compressor", None)
+    if not kind or str(kind).lower() in ("no", "none"):
+        return None
+    return CommCompressor(
+        str(kind).lower(),
+        ratio=float(getattr(args, "comm_compressor_ratio", 0.05)),
+        quantize_level=int(getattr(args, "comm_compressor_level", 8)),
+        seed=int(getattr(args, "comm_compressor_seed", 0)),
+    )

@@ -1,0 +1,89 @@
+"""Driver for tests/test_async_buffer.py async kill-resume e2e — NOT a test.
+
+Runs a single-client cross-silo INMEMORY cluster in ASYNC mode (no round
+barrier: the server folds every upload into the AsyncAggBuffer and publishes
+every ``async_publish_k`` merges). One client makes the arrival order total,
+so the whole run is deterministic and a resumed run can be compared
+bit-for-bit against an uninterrupted baseline. Modes (argv[1], with
+argv[2] = the resilience directory):
+
+- ``baseline``: run all publishes uninterrupted, exit 0;
+- ``crash``: ``chaos_kill_after_merges=3`` on the server — with
+  ``publish_k=2`` the third merge is the FIRST merge of window v1, so the
+  mid-window checkpoint (``async_checkpoint_every_merges=1``) snapshots a
+  buffer holding one un-folded pending delta; the chaos knob waits for that
+  snapshot to COMMIT and then SIGKILLs the whole process;
+- ``resume``: restart the cluster with ``resume=True``; the server rebuilds
+  the half-full buffer (accumulator + pending deltas + staleness clock) from
+  the snapshot and subsequent merges must be bit-identical to the baseline.
+
+The parent test additionally reads the crash store's newest meta sidecar and
+asserts the resumed-from buffer snapshot was NON-empty.
+"""
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import fedml_tpu as fedml  # noqa: E402
+from fedml_tpu.arguments import default_config  # noqa: E402
+from fedml_tpu.core.distributed.communication.inmemory.broker import InMemoryBroker  # noqa: E402
+
+N_CLIENTS = 1
+PUBLISHES = 3          # comm_round counts publishes in async mode
+PUBLISH_K = 2
+KILL_AFTER_MERGES = 3  # first merge of window v1: buffer holds 1 pending delta
+
+
+def make_args(mode, rank, role, rdir):
+    over = dict(
+        run_id=f"test_async_buf_{mode}", rank=rank, role=role, backend="INMEMORY",
+        scenario="horizontal", client_num_in_total=N_CLIENTS,
+        client_num_per_round=N_CLIENTS, comm_round=PUBLISHES, epochs=1,
+        batch_size=16, frequency_of_the_test=PUBLISHES + 1, dataset="synthetic",
+        model="lr", random_seed=0,
+        async_rounds=True, async_publish_k=PUBLISH_K,
+        async_staleness_exponent=0.5, async_max_staleness=10,
+    )
+    if role == "server":
+        over["resilience_dir"] = rdir
+        over["async_checkpoint_every_merges"] = 1
+        if mode == "crash":
+            over["chaos_kill_after_merges"] = KILL_AFTER_MERGES
+        elif mode == "resume":
+            over["resume"] = True
+    return default_config("cross_silo", **over)
+
+
+def main() -> int:
+    mode, rdir = sys.argv[1], sys.argv[2]
+    InMemoryBroker.reset()
+    results = {}
+
+    def run_party(args, key):
+        args = fedml.init(args)
+        device = fedml.device.get_device(args)
+        dataset, output_dim = fedml.data.load(args)
+        model = fedml.model.create(args, output_dim)
+        results[key] = fedml.FedMLRunner(args, device, dataset, model).run()
+
+    threads = [threading.Thread(
+        target=run_party, args=(make_args(mode, 0, "server", rdir), "server"),
+        daemon=True)]
+    for rank in range(1, N_CLIENTS + 1):
+        threads.append(threading.Thread(
+            target=run_party, args=(make_args(mode, rank, "client", rdir), f"c{rank}"),
+            daemon=True))
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=240)
+        if th.is_alive():
+            return 4  # deadlock (crash mode never reaches here: SIGKILL)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,650 @@
+"""Async buffered federation tests: staleness policy, the streaming buffer
+(including the publish_k == cohort bit-exact parity anchor), buffer snapshot/
+restore, hierarchical edge→regional→root cascades, the event-driven async
+simulator's determinism, quorum deadline re-arm + MAD==0 fallback interacting
+with staleness verdicts, and the e2e layer:
+
+- a 3-client INMEMORY async cluster where one client is frozen two model
+  versions behind (its uploads must flow through ``stale_accepted`` and then
+  ``stale_rejected`` without hanging the run);
+- a real SIGKILL through ``tests/_async_buffer_run.py``: the server dies
+  right after a MID-WINDOW buffer snapshot commits, and the resumed run's
+  subsequent merges must be bit-identical to an uninterrupted baseline.
+"""
+
+import threading
+import types
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu.core import telemetry as tel
+from fedml_tpu.core.aggregation.async_buffer import (
+    MERGE_COUNTER,
+    PUBLISH_COUNTER,
+    STALENESS_HISTOGRAM,
+    AsyncAggBuffer,
+    StalenessPolicy,
+    buffer_from_args,
+)
+from fedml_tpu.core.aggregation.bucketed import BucketedAggregator
+from fedml_tpu.core.distributed.hierarchy import HierarchyTree
+from fedml_tpu.core.resilience import QuorumPolicy, RoundQuorum, RoundStateStore
+from fedml_tpu.core.resilience import quorum as quorum_mod
+from fedml_tpu.core.telemetry.health import HealthTracker
+
+from tests.test_resilience import _assert_bit_identical, _final_round_state, _run_driver
+
+
+def _tree(seed, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": (scale * rng.normal(size=(4, 3))).astype(np.float32),
+        "b": (scale * rng.normal(size=(3,))).astype(np.float32),
+    }
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class _FakeClient:
+    def __init__(self, flagged):
+        self.flagged = flagged
+
+
+class _FakeHealth:
+    def __init__(self, flagged_ranks):
+        self._clients = {r: _FakeClient(True) for r in flagged_ranks}
+
+
+# --- staleness policy --------------------------------------------------------
+
+
+class TestStalenessPolicy:
+    def test_weight_polynomial_decay(self):
+        p = StalenessPolicy(exponent=0.5)
+        assert p.weight(0) == 1.0
+        assert p.weight(1) == pytest.approx(2 ** -0.5)
+        assert p.weight(3) == pytest.approx(4 ** -0.5)
+        assert p.weight(1) > p.weight(2) > p.weight(5)
+
+    def test_exponent_zero_is_unit_weight(self):
+        p = StalenessPolicy(exponent=0.0)
+        assert p.weight(7) == 1.0  # the synchronous parity configuration
+
+    def test_negative_exponent_rejected(self):
+        with pytest.raises(ValueError):
+            StalenessPolicy(exponent=-0.1)
+
+    def test_admission_cut_and_straggler_grace(self):
+        p = StalenessPolicy(max_staleness=10, straggler_grace=1.5,
+                            health=_FakeHealth({7}))
+        assert p.admission_cut(8) == 10      # unflagged rank: plain cut
+        assert p.admission_cut(7) == 15      # flagged: ceil(10 * 1.5)
+        assert p.admit(12, rank=7)
+        assert not p.admit(12, rank=8)
+        assert not p.admit(16, rank=7)       # grace is a stretch, not a bypass
+        # no health wired: the cut never stretches
+        assert StalenessPolicy(max_staleness=10).admission_cut(7) == 10
+
+    def test_from_args_reads_async_knobs(self):
+        args = types.SimpleNamespace(async_staleness_exponent=0.3,
+                                     async_max_staleness=7,
+                                     async_straggler_grace=2.0)
+        p = StalenessPolicy.from_args(args, health=_FakeHealth(set()))
+        assert p.exponent == 0.3 and p.max_staleness == 7
+        assert p.straggler_grace == 2.0 and p.health is not None
+
+
+# --- the buffer --------------------------------------------------------------
+
+
+class TestAsyncAggBuffer:
+    def test_publish_k_equals_cohort_is_bit_exact_with_engine_aggregate(self):
+        """The parity anchor: staleness exponent 0 + publish_k == cohort must
+        reproduce the engine's synchronous normalize-first FedAvg result
+        BIT-EXACTLY (the bench's refuse-to-publish guard pins the same)."""
+        engine = BucketedAggregator(bucket_size=16)
+        pairs = [(float(i + 1), _tree(i)) for i in range(5)]
+        buf = AsyncAggBuffer(publish_k=5, policy=StalenessPolicy(exponent=0.0),
+                             engine=engine)
+        for i, (w, t) in enumerate(pairs):
+            assert buf.submit(i, t, w, client_version=0) == quorum_mod.ACCEPT
+        out = buf.publish()
+        ref = BucketedAggregator(bucket_size=16).aggregate(
+            [(float(i + 1), _tree(i)) for i in range(5)])
+        _leaves_equal(out, ref)
+
+    def test_multibucket_streaming_tracks_aggregate(self):
+        """publish_k > bucket_size takes the eager-fold path; the published
+        model differs from normalize-first only by one rounding per element
+        (scale-after-fold vs fold-of-scaled)."""
+        engine = BucketedAggregator(bucket_size=4)
+        pairs = [(float(i % 3 + 1), _tree(100 + i)) for i in range(12)]
+        buf = AsyncAggBuffer(publish_k=12, policy=StalenessPolicy(exponent=0.0),
+                             engine=engine)
+        for i, (w, t) in enumerate(pairs):
+            buf.submit(i, t, w, client_version=0)
+        # the eager folds kept HBM bounded: pending never held a full window
+        assert buf.statusz()["pending_unfolded"] < 12
+        out = buf.publish()
+        ref = BucketedAggregator(bucket_size=4).aggregate(
+            [(float(i % 3 + 1), _tree(100 + i)) for i in range(12)])
+        for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(ref)):
+            a, b = np.asarray(a), np.asarray(b)
+            err = float(np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-12))
+            assert err <= 1e-6
+
+    def test_stale_rejected_is_never_folded(self):
+        was = tel.get_telemetry().enabled
+        tel.get_telemetry().set_enabled(True)
+        tel.get_telemetry().reset()
+        try:
+            buf = AsyncAggBuffer(publish_k=4,
+                                 policy=StalenessPolicy(max_staleness=1))
+            buf.version = 3
+            v = buf.submit(0, _tree(0), 1.0, client_version=0)  # staleness 3
+            assert v == quorum_mod.STALE_REJECTED
+            assert buf.merges_total == 0 and buf.depth() == 0
+            assert buf.stale_rejected_total == 1
+            assert buf.publish() is None  # nothing folded, nothing to publish
+            assert buf.version == 3
+            counters = tel.snapshot()["counters"]
+            assert counters[quorum_mod.STALE_REJECTED_COUNTER] == 1
+            assert MERGE_COUNTER not in counters
+        finally:
+            tel.get_telemetry().reset()
+            tel.get_telemetry().set_enabled(was)
+
+    def test_stale_accepted_applies_decayed_weight(self):
+        buf = AsyncAggBuffer(publish_k=2,
+                             policy=StalenessPolicy(exponent=1.0, max_staleness=10))
+        buf.version = 1
+        a, b = _tree(1), _tree(2)
+        assert buf.submit(0, a, 2.0, client_version=1) == quorum_mod.ACCEPT
+        # staleness 1 with exponent 1: weight 4.0 * (1+1)^-1 == 2.0
+        assert buf.submit(1, b, 4.0, client_version=0) == quorum_mod.STALE_ACCEPTED
+        assert buf.stale_accepted_total == 1
+        out = buf.publish()
+        expect = jax.tree.map(lambda x, y: (2.0 * x + 2.0 * y) / 4.0, a, b)
+        for x, y in zip(jax.tree.leaves(out), jax.tree.leaves(expect)):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-6)
+
+    def test_publish_advances_version_and_resets_window(self):
+        was = tel.get_telemetry().enabled
+        tel.get_telemetry().set_enabled(True)
+        tel.get_telemetry().reset()
+        try:
+            buf = AsyncAggBuffer(publish_k=3, policy=StalenessPolicy(exponent=0.0))
+            for i in range(3):
+                buf.submit(i, _tree(i), float(i + 1), client_version=0)
+                assert buf.ready() == (i == 2)
+            assert buf.publish() is not None
+            assert buf.version == 1 and buf.publishes_total == 1
+            assert not buf.ready() and buf.depth() == 0
+            assert buf.last_publish_merges == 3
+            assert buf.last_publish_weight == pytest.approx(6.0)
+            snap = tel.snapshot()
+            assert snap["counters"][MERGE_COUNTER] == 3
+            assert snap["counters"][PUBLISH_COUNTER] == 1
+            assert snap["histograms"][STALENESS_HISTOGRAM]["count"] == 3
+        finally:
+            tel.get_telemetry().reset()
+            tel.get_telemetry().set_enabled(was)
+
+    def test_staleness_clock_tracks_client_versions(self):
+        buf = AsyncAggBuffer(publish_k=2, policy=StalenessPolicy(exponent=0.0))
+        buf.submit(4, _tree(0), 1.0, client_version=0)
+        buf.submit(9, _tree(1), 1.0, client_version=0)
+        assert buf.statusz()["client_versions"] == {4: 0, 9: 0}
+        buf.publish()
+        buf.submit(4, _tree(2), 1.0, client_version=1)
+        assert buf.statusz()["client_versions"][4] == 1
+
+    def test_invalid_publish_k_rejected(self):
+        with pytest.raises(ValueError):
+            AsyncAggBuffer(publish_k=0)
+
+    def test_buffer_from_args(self):
+        args = types.SimpleNamespace(async_publish_k=4,
+                                     async_staleness_exponent=0.25,
+                                     async_max_staleness=6,
+                                     async_straggler_grace=3.0)
+        buf = buffer_from_args(args, health=_FakeHealth(set()))
+        assert buf.publish_k == 4
+        assert buf.policy.exponent == 0.25 and buf.policy.max_staleness == 6
+        assert buf.policy.health is not None
+
+    def test_prom_gauges_shape(self):
+        buf = AsyncAggBuffer(publish_k=4)
+        buf.submit(0, _tree(0), 1.0, client_version=0)
+        gauges = dict((name, v) for name, _labels, v in buf.prom_gauges())
+        assert gauges["async_buffer_depth"] == 1.0
+        assert gauges["async_model_version"] == 0.0
+
+
+# --- snapshot / restore ------------------------------------------------------
+
+
+class TestBufferSnapshotRestore:
+    def _fill(self, buf, n, offset=0):
+        for i in range(n):
+            buf.submit(i, _tree(50 + offset + i), float(i + 1), client_version=0)
+
+    def test_mid_window_snapshot_restore_then_merges_are_bit_identical(self):
+        """Snapshot a half-full buffer holding BOTH a folded accumulator and
+        un-folded pending deltas; a restored buffer fed the same remaining
+        arrivals must publish the bit-identical model."""
+        a = AsyncAggBuffer(publish_k=6, policy=StalenessPolicy(exponent=0.0),
+                           engine=BucketedAggregator(bucket_size=4))
+        self._fill(a, 5)  # one bucket folded into _acc, 1 arrival pending
+        meta = a.export_meta()
+        state = a.export_pytree_state()
+        assert meta["has_acc"] and len(meta["pending_weights"]) == 1
+        assert meta["merges_since_publish"] == 5
+
+        b = AsyncAggBuffer(publish_k=6, policy=StalenessPolicy(exponent=0.0),
+                           engine=BucketedAggregator(bucket_size=4))
+        b.restore(state, meta, template=_tree(0))
+        assert b.depth() == 5 and b.merges_total == a.merges_total
+
+        final = _tree(99)
+        a.submit(5, final, 6.0, client_version=0)
+        b.submit(5, final, 6.0, client_version=0)
+        assert a.ready() and b.ready()
+        _leaves_equal(a.publish(), b.publish())
+        assert a.version == b.version == 1
+
+    def test_pending_only_snapshot_keeps_parity_path(self):
+        """publish_k <= bucket keeps everything pending (the bit-exact parity
+        path); the snapshot must round-trip the un-folded trees + weights."""
+        a = AsyncAggBuffer(publish_k=3, policy=StalenessPolicy(exponent=0.0))
+        self._fill(a, 2, offset=20)
+        meta, state = a.export_meta(), a.export_pytree_state()
+        assert not meta["has_acc"] and len(state["pending"]) == 2
+
+        b = AsyncAggBuffer(publish_k=3, policy=StalenessPolicy(exponent=0.0))
+        b.restore(state, meta, template=_tree(0))
+        last = _tree(77)
+        a.submit(2, last, 3.0, client_version=0)
+        b.submit(2, last, 3.0, client_version=0)
+        _leaves_equal(a.publish(), b.publish())
+
+    def test_restore_rebuilds_staleness_clock_and_counters(self):
+        a = AsyncAggBuffer(publish_k=2, policy=StalenessPolicy(max_staleness=1))
+        a.version = 2
+        a.submit(3, _tree(1), 1.0, client_version=1)   # stale_accepted
+        a.submit(8, _tree(2), 1.0, client_version=0)   # stale_rejected
+        meta, state = a.export_meta(), a.export_pytree_state()
+        b = AsyncAggBuffer(publish_k=2, policy=StalenessPolicy(max_staleness=1))
+        b.restore(state, meta, template=_tree(0))
+        assert b.version == 2
+        assert b.stale_accepted_total == 1 and b.stale_rejected_total == 1
+        assert b.statusz()["client_versions"] == {3: 2}
+
+    def test_torn_snapshot_refuses_to_restore(self):
+        a = AsyncAggBuffer(publish_k=4)
+        self._fill(a, 2)
+        meta, state = a.export_meta(), a.export_pytree_state()
+        state["pending"] = state["pending"][:1]  # one tree lost in the tear
+        b = AsyncAggBuffer(publish_k=4)
+        with pytest.raises(ValueError, match="torn"):
+            b.restore(state, meta, template=_tree(0))
+
+    def test_state_template_matches_snapshot_structure(self):
+        a = AsyncAggBuffer(publish_k=6, engine=BucketedAggregator(bucket_size=4))
+        self._fill(a, 5)
+        meta = a.export_meta()
+        tmpl = a.state_template(_tree(0), meta)
+        assert "acc" in tmpl and len(tmpl["pending"]) == 1
+        assert tmpl["acc"]["w"].dtype == np.float32
+        # empty buffer: nothing to template
+        assert AsyncAggBuffer(publish_k=2).state_template(
+            _tree(0), AsyncAggBuffer(publish_k=2).export_meta()) == {}
+
+
+# --- hierarchy ---------------------------------------------------------------
+
+
+class TestHierarchy:
+    def test_edge_regional_root_cascade_and_version_sync(self):
+        m = [_tree(200 + i) for i in range(4)]
+        tree = HierarchyTree.build(
+            n_edges=2, regional_fanout=2, publish_k=2,
+            policy=StalenessPolicy(exponent=0.0),
+            engine=BucketedAggregator(bucket_size=16), initial_model=_tree(0))
+        assert len(tree.regionals) == 1
+        # ranks route rank % n_edges: 0,2 -> edge-0; 1,3 -> edge-1
+        for rank in range(4):
+            tree.submit(rank, m[rank], 1.0, client_version=0)
+        assert tree.version == 1
+        # unit weights + exponent 0: the root publish is the plain mean
+        expect = jax.tree.map(lambda *xs: np.mean(np.stack(xs), axis=0).astype(np.float32), *m)
+        for a, b in zip(jax.tree.leaves(tree.latest_model()), jax.tree.leaves(expect)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+        # downward sync: every tier now judges staleness against version 1
+        for node in tree.nodes():
+            assert node.buffer.version == 1
+        assert all(e.forwards == 1 for e in tree.edges)
+        doc = tree.statusz()
+        assert doc["version"] == 1 and set(doc["nodes"]) == {
+            "root", "regional-0", "edge-0", "edge-1"}
+
+    def test_edge_window_weight_forwards_upward(self):
+        """An edge publish forwards as ONE submission weighted by the window's
+        streamed weight, so unbalanced edges keep sample weighting."""
+        tree = HierarchyTree.build(
+            n_edges=2, regional_fanout=2, publish_k=2,
+            policy=StalenessPolicy(exponent=0.0),
+            engine=BucketedAggregator(bucket_size=16))
+        tree.submit(0, _tree(1), 3.0, client_version=0)
+        tree.submit(2, _tree(2), 1.0, client_version=0)  # edge-0 publishes
+        assert tree.edges[0].buffer.last_publish_weight == pytest.approx(4.0)
+        # the regional's single pending entry carries weight 4.0
+        assert tree.regionals[0].buffer.export_meta()["pending_weights"] == [4.0]
+
+    def test_single_edge_degenerate_tree(self):
+        tree = HierarchyTree.build(n_edges=1, publish_k=2,
+                                   policy=StalenessPolicy(exponent=0.0),
+                                   engine=BucketedAggregator(bucket_size=16))
+        tree.submit(0, _tree(3), 1.0, client_version=0)
+        tree.submit(1, _tree(4), 1.0, client_version=0)
+        assert tree.version == 1 and tree.latest_model() is not None
+
+    def test_build_rejects_zero_edges(self):
+        with pytest.raises(ValueError):
+            HierarchyTree.build(n_edges=0)
+
+
+# --- event-driven async simulation ------------------------------------------
+
+
+class TestAsyncSim:
+    def _run(self, seed=0, n_clients=32, publish_k=8, publishes=3):
+        from fedml_tpu.simulation.vmapped.async_driver import (
+            AsyncEventSim,
+            DelayModel,
+            make_synthetic_delta_fn,
+        )
+
+        models = []
+        sim = AsyncEventSim(
+            AsyncAggBuffer(publish_k=publish_k,
+                           policy=StalenessPolicy(exponent=0.5),
+                           engine=BucketedAggregator(bucket_size=16)),
+            make_synthetic_delta_fn(seed=seed), n_clients,
+            initial_model=_tree(7),
+            delay=DelayModel(n_clients, mean_delay=1.0, heterogeneity=0.5, seed=seed),
+            gen_batch=16,
+            on_publish=lambda v, m: models.append((v, jax.device_get(m))))
+        stats = sim.run(publishes)
+        return stats, models
+
+    def test_same_seed_is_bit_deterministic(self):
+        s1, m1 = self._run(seed=3)
+        s2, m2 = self._run(seed=3)
+        assert s1["publishes"] == s2["publishes"] == 3
+        assert s1["merges"] == s2["merges"]
+        assert s1["virtual_time"] == s2["virtual_time"]
+        assert s1["staleness_mean"] == s2["staleness_mean"]
+        assert [v for v, _ in m1] == [v for v, _ in m2]
+        for (_, a), (_, b) in zip(m1, m2):
+            _leaves_equal(a, b)
+
+    def test_stats_shape_and_pipar_overlap(self):
+        stats, models = self._run(seed=1)
+        assert stats["merges"] >= 3 * 8
+        assert stats["buffer_high_water"] >= 1
+        assert stats["server_seconds"] >= 0.0
+        assert len(models) == 3
+
+    def test_hierarchy_sink_publishes(self):
+        from fedml_tpu.simulation.vmapped.async_driver import simulate_async_rounds
+
+        stats = simulate_async_rounds(
+            n_clients=24, publish_k=4, template=_tree(5), publishes=2,
+            hierarchy_edges=2, gen_batch=16, seed=2)
+        assert stats["publishes"] == 2
+
+    def test_hostile_staleness_config_terminates(self):
+        """max_staleness=0 on a deep in-flight pool rejects almost everything;
+        the event cap must end the run instead of spinning forever."""
+        from fedml_tpu.simulation.vmapped.async_driver import (
+            AsyncEventSim,
+            DelayModel,
+            make_synthetic_delta_fn,
+        )
+
+        sim = AsyncEventSim(
+            AsyncAggBuffer(publish_k=4, policy=StalenessPolicy(max_staleness=0)),
+            make_synthetic_delta_fn(seed=0), 16, initial_model=_tree(1),
+            delay=DelayModel(16, seed=0), gen_batch=8)
+        stats = sim.run(publish_target=100, max_events=300)
+        assert stats["publishes"] < 100  # capped, not hung
+
+
+# --- quorum deadline re-arm + MAD==0 fallback x staleness --------------------
+
+
+class TestQuorumDeadlineRearm:
+    def _manager(self, policy, quorum):
+        """A bare server manager carrying only what _on_round_deadline touches
+        (the full manager drags in comm backends)."""
+        from fedml_tpu.cross_silo.server.fedml_server_manager import FedMLServerManager
+
+        mgr = object.__new__(FedMLServerManager)
+        mgr.args = types.SimpleNamespace(round_idx=0)
+        mgr._round_lock = threading.RLock()
+        mgr._quorum_policy = policy
+        mgr._round_quorum = quorum
+        mgr._deadline_timer = None
+        mgr.aggregator = types.SimpleNamespace()  # no fleet -> health None
+        completed = []
+        mgr._complete_round = lambda: completed.append(True)
+        return mgr, completed
+
+    def test_deadline_without_quorum_rearms_instead_of_closing(self):
+        policy = QuorumPolicy(deadline_s=60.0, quorum_frac=0.5)
+        q = RoundQuorum(0, [1, 2, 3], 3, policy)
+        mgr, completed = self._manager(policy, q)
+        q.on_delta(1, 0)  # 1 of min 2: not enough to close
+        try:
+            mgr._on_round_deadline(0)
+            assert completed == []
+            assert mgr._deadline_timer is not None  # re-armed, round still open
+            assert not q.statusz()["closed"]
+
+            # the second delta lands during the extension; the next deadline
+            # fire closes partially and completes the round
+            q.on_delta(2, 0)
+            mgr._on_round_deadline(0)
+            assert completed == [True]
+            assert q.statusz()["closed"]
+            assert q.missing() == [3]
+        finally:
+            mgr._cancel_deadline_timer()
+
+    def test_stale_round_deadline_is_ignored(self):
+        policy = QuorumPolicy(deadline_s=60.0, quorum_frac=0.5)
+        q = RoundQuorum(1, [1, 2], 2, policy)
+        mgr, completed = self._manager(policy, q)
+        mgr.args.round_idx = 1
+        mgr._on_round_deadline(0)  # a timer from the previous round fires late
+        assert completed == [] and mgr._deadline_timer is None
+
+    def test_mad_zero_fallback_flags_only_absolute_stragglers(self):
+        """Identical durations make MAD 0 (z undefined); the fallback is the
+        absolute min_gap_s floor alone — ties are never flagged, a genuine
+        outlier still is."""
+        h = HealthTracker(mad_z_threshold=3.5, min_gap_s=5.0)
+        for r in (1, 2, 3):
+            h.observe_round(r, 1.0)
+        report = h.end_round(0)
+        assert report["cohort"]["mad_s"] == 0.0 and report.stragglers == []
+
+        for r, d in ((1, 1.0), (2, 1.0), (3, 7.0)):
+            h.observe_round(r, d)
+        report = h.end_round(1)
+        assert report["cohort"]["mad_s"] == 0.0
+        assert report.stragglers == [3]
+        assert h._clients[3].last_z is None  # z undefined under MAD==0
+
+    def test_mad_zero_flagged_straggler_gets_staleness_grace(self):
+        """The interaction the async server relies on: a rank the MAD==0
+        fallback flagged is exactly the rank whose admission cut stretches —
+        its stale delta is admitted (decayed) where a healthy rank's is
+        refused."""
+        h = HealthTracker(mad_z_threshold=3.5, min_gap_s=5.0)
+        for r, d in ((1, 1.0), (2, 1.0), (3, 7.0)):
+            h.observe_round(r, d)
+        h.end_round(0)
+        buf = AsyncAggBuffer(
+            publish_k=8,
+            policy=StalenessPolicy(exponent=0.5, max_staleness=2,
+                                   straggler_grace=2.0, health=h))
+        buf.version = 4
+        stale_v = 1  # staleness 3: beyond the plain cut, inside the graced one
+        assert buf.submit(3, _tree(1), 1.0, stale_v) == quorum_mod.STALE_ACCEPTED
+        assert buf.submit(1, _tree(2), 1.0, stale_v) == quorum_mod.STALE_REJECTED
+        # adaptive deadlines draw from the same EWMAs the grace keys off
+        policy = QuorumPolicy(adaptive=True, adaptive_mult=2.0, min_deadline_s=1.0)
+        assert policy.deadline_for_round(h) == pytest.approx(2.0 * 7.0)
+
+
+# --- e2e: 3-client async cluster with one frozen-stale client ----------------
+
+
+class TestAsyncStaleClientE2E:
+    def test_frozen_client_flows_through_stale_verdicts_without_hanging(
+            self, tmp_path, monkeypatch):
+        """3 clients in async mode, publish_k=2, max_staleness=1. Client 2's
+        model-version adoption is frozen at 0, so as the server publishes
+        v1, v2, ... its uploads become 1 then 2 versions stale: first
+        ``stale_accepted`` (decayed weight), then ``stale_rejected`` — and a
+        permanently-rejected client must not hang the run (every upload still
+        gets a model reply). The other two clients carry a chaos train delay
+        so the frozen client demonstrably drives windows alone."""
+        import fedml_tpu as fedml
+        from fedml_tpu import mlops
+        from fedml_tpu.arguments import default_config
+        from fedml_tpu.core.distributed.communication.inmemory.broker import InMemoryBroker
+        from fedml_tpu.cross_silo.client import fedml_client_master_manager as cmm
+
+        monkeypatch.setenv("FEDML_FR_DIR", str(tmp_path / "crash"))
+        n_clients, frozen_rank, publishes = 3, 2, 3
+        rejected_events = []
+
+        real_event = mlops.log_resilience_event
+
+        def capture_event(event, round_idx=None, **fields):
+            if event == "stale_rejected":
+                rejected_events.append((round_idx, dict(fields)))
+            return real_event(event, round_idx=round_idx, **fields)
+
+        monkeypatch.setattr(mlops, "log_resilience_event", capture_event)
+
+        real_adopt = cmm.ClientMasterManager._adopt_model_version
+
+        def frozen_adopt(self, msg_params):
+            if int(self.client_real_id) == frozen_rank:
+                self._model_version = 0  # never learns about newer publishes
+                return
+            real_adopt(self, msg_params)
+
+        monkeypatch.setattr(cmm.ClientMasterManager, "_adopt_model_version",
+                            frozen_adopt)
+
+        def make_args(rank, role):
+            over = dict(
+                run_id="test_async_stale", rank=rank, role=role,
+                backend="INMEMORY", scenario="horizontal",
+                client_num_in_total=n_clients, client_num_per_round=n_clients,
+                comm_round=publishes, epochs=1, batch_size=16,
+                frequency_of_the_test=publishes + 1, dataset="synthetic",
+                model="lr", random_seed=0,
+                async_rounds=True, async_publish_k=2,
+                async_staleness_exponent=0.5, async_max_staleness=1,
+                async_straggler_grace=1.0,
+            )
+            if role == "client" and rank != frozen_rank:
+                over["chaos_train_delay_s"] = 0.25
+            return default_config("cross_silo", **over)
+
+        def run_party(args, results, key):
+            args = fedml.init(args)
+            device = fedml.device.get_device(args)
+            dataset, output_dim = fedml.data.load(args)
+            model = fedml.model.create(args, output_dim)
+            results[key] = fedml.FedMLRunner(args, device, dataset, model).run()
+
+        t = tel.get_telemetry()
+        was = t.enabled
+        t.set_enabled(True)
+        t.reset()
+        try:
+            InMemoryBroker.reset()
+            results = {}
+            threads = [threading.Thread(
+                target=run_party, args=(make_args(0, "server"), results, "server"),
+                daemon=True)]
+            for rank in range(1, n_clients + 1):
+                threads.append(threading.Thread(
+                    target=run_party, args=(make_args(rank, "client"), results, f"c{rank}"),
+                    daemon=True))
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=240)
+                assert not th.is_alive(), "stale client hung the async cluster"
+
+            counters = tel.snapshot()["counters"]
+            # the frozen client passed through BOTH halves of the policy
+            assert counters.get(quorum_mod.STALE_ACCEPTED_COUNTER, 0) >= 1
+            assert counters.get(quorum_mod.STALE_REJECTED_COUNTER, 0) >= 1
+            assert rejected_events, "no stale_rejected resilience event logged"
+            # the frozen rank MUST be among the rejected (other clients may
+            # legitimately go stale too while windows advance around them)
+            frozen_rejects = [ridx for ridx, f in rejected_events
+                              if f["rank"] == frozen_rank]
+            assert frozen_rejects, rejected_events
+            # its rejections began once it fell 2 versions behind
+            assert min(frozen_rejects) >= 2
+        finally:
+            t.reset()
+            t.set_enabled(was)
+
+
+# --- e2e: SIGKILL mid-window + resume, bit-identical -------------------------
+
+
+class TestKillResumeAsyncBuffer:
+    def test_sigkill_after_midwindow_snapshot_resumes_bit_identical(self, tmp_path):
+        """The server SIGKILLs itself right after a MID-WINDOW buffer
+        snapshot commits (``chaos_kill_after_merges``): the newest checkpoint
+        holds a non-empty async buffer (one un-folded pending delta plus the
+        staleness clock). Restarting with ``resume=True`` must rebuild the
+        buffer and finish with a final round state bit-identical to an
+        uninterrupted baseline — the subsequent merges replayed exactly."""
+        base_dir, crash_dir = tmp_path / "baseline", tmp_path / "crash"
+        _run_driver("_async_buffer_run.py", "baseline", base_dir)
+        _run_driver("_async_buffer_run.py", "crash", crash_dir, expect_kill=True)
+
+        # the resumed-from snapshot carries a NON-empty buffer
+        store = RoundStateStore(str(crash_dir))
+        step = store.latest_complete_round()
+        assert step is not None
+        buf_meta = store.read_meta(step)["async_buffer"]
+        store.close()
+        assert buf_meta["merges_since_publish"] == 1
+        assert len(buf_meta["pending_weights"]) == 1
+        assert buf_meta["version"] == 1  # killed inside window v1
+
+        _run_driver("_async_buffer_run.py", "resume", crash_dir)
+        _assert_bit_identical(_final_round_state(base_dir),
+                              _final_round_state(crash_dir))

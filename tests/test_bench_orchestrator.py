@@ -204,6 +204,13 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
                          "agg_sharded_traces": 2,
                          "agg_round_traces": 1,
                          "device": "TPU v5 lite"}, None),
+        "async_rounds": ({"async_rounds_per_hr": {"1000": 350000.0,
+                                                  "10000": 340000.0,
+                                                  "100000": 330000.0},
+                          "async_flatness_ratio": 1.06,
+                          "async_publish_k": 32,
+                          "async_parity_bit_exact": True,
+                          "device": "TPU v5 lite"}, None),
     })
     with pytest.raises(SystemExit) as exc:
         bench.main()
@@ -228,6 +235,9 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
     assert out["agg_sharded_clients_per_sec"] == 12.0
     assert out["agg_sharded_overlap_efficiency"] == 1.4
     assert out["agg_sharded_traces"] == 2
+    assert out["async_rounds_per_hr"]["100000"] == 330000.0
+    assert out["async_flatness_ratio"] == 1.06
+    assert out["async_parity_bit_exact"] is True
     assert out["stages_failed"] == []
     # incremental artifacts landed (one per stage + final, same stamp file)
     arts = glob.glob(str(tmp_path / "BENCH_MEASURED_*.json"))

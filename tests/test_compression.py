@@ -1,15 +1,23 @@
 """Compression kernel tests: round trips, error feedback accumulation,
-QSGD unbiasedness."""
+QSGD unbiasedness, and the comm-boundary wiring (``args.comm_compressor``)
+the async uplink hot path uses."""
+
+import types
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fedml_tpu.utils.compression import (
+    CommCompressor,
     EFTopKCompressor,
     QSGDCompressor,
     TopKCompressor,
     compressors,
+    decompress_comm_payload,
+    is_comm_payload,
+    make_comm_compressor,
     naive_quantize,
     qsgd_quantize,
     topk_compress,
@@ -89,3 +97,94 @@ def test_tree_compress_roundtrip():
 
 def test_registry():
     assert set(compressors) == {"no", "topk", "eftopk", "quantize", "qsgd"}
+
+
+# --- comm boundary (client upload <-> server receive) ------------------------
+
+
+def _model_tree(seed=11):
+    rng = np.random.default_rng(seed)
+    return {
+        "dense": {"w": rng.normal(size=(6, 4)).astype(np.float32),
+                  "b": rng.normal(size=(4,)).astype(np.float32)},
+        "out": rng.normal(size=(4, 2)).astype(np.float32),
+    }
+
+
+def _leaves_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_comm_eftopk_full_ratio_roundtrip_is_exact():
+    """ratio=1.0 keeps every coordinate and the residual stays zero, so the
+    uplink is bit-exact — the configuration the cross-silo parity e2e pins."""
+    tree = _model_tree()
+    c = CommCompressor("eftopk", ratio=1.0)
+    payload = c.compress_tree(tree)
+    assert is_comm_payload(payload) and payload["kind"] == "eftopk"
+    _leaves_equal(decompress_comm_payload(payload), tree)
+    # a second upload stays exact too (residual must remain zero)
+    _leaves_equal(decompress_comm_payload(c.compress_tree(tree)), tree)
+
+
+def test_comm_topk_sparsifies_and_kept_entries_match():
+    tree = _model_tree()
+    size = sum(int(np.size(x)) for x in jax.tree.leaves(tree))
+    c = CommCompressor("topk", ratio=0.25)
+    payload = c.compress_tree(tree)
+    assert len(payload["values"]) == int(np.ceil(size * 0.25))
+    back = decompress_comm_payload(payload)
+    for got, orig in zip(jax.tree.leaves(back), jax.tree.leaves(tree)):
+        got, orig = np.asarray(got), np.asarray(orig)
+        assert got.shape == orig.shape
+        mask = got != 0
+        np.testing.assert_allclose(got[mask], orig[mask], rtol=1e-6)
+
+
+def test_comm_eftopk_residual_recovers_dropped_mass():
+    """The residual is per-client state: coordinates dropped on upload N come
+    back on upload N+1 once their accumulated error dominates."""
+    tree = {"w": np.array([1.0, 0.9, 0.0, 0.0], np.float32)}
+    c = CommCompressor("eftopk", ratio=0.25)  # k=1
+    first = c.compress_tree(tree)
+    assert np.asarray(first["indexes"]).tolist() == [0]
+    second = c.compress_tree(tree)  # residual 0.9 + fresh 0.9 beats fresh 1.0
+    assert np.asarray(second["indexes"]).tolist() == [1]
+    assert float(np.asarray(second["values"])[0]) == pytest.approx(1.8)
+
+
+@pytest.mark.parametrize("kind", ["quantize", "qsgd"])
+def test_comm_dense_kinds_bounded_error(kind):
+    tree = _model_tree()
+    c = CommCompressor(kind, quantize_level=8, seed=0)
+    payload = c.compress_tree(tree)
+    assert "dense" in payload and "values" not in payload
+    back = decompress_comm_payload(payload)
+    for got, orig in zip(jax.tree.leaves(back), jax.tree.leaves(tree)):
+        got, orig = np.asarray(got), np.asarray(orig)
+        assert got.shape == orig.shape and got.dtype == np.float32
+        # 8-bit quantization of a ~N(0,1) tree: loose sanity bound
+        assert float(np.abs(got - orig).max()) < 0.5
+
+
+def test_comm_unknown_kind_raises():
+    with pytest.raises(ValueError, match="unknown comm compressor"):
+        CommCompressor("gzip")
+
+
+def test_make_comm_compressor_from_args():
+    assert make_comm_compressor(types.SimpleNamespace()) is None
+    assert make_comm_compressor(types.SimpleNamespace(comm_compressor="no")) is None
+    assert make_comm_compressor(types.SimpleNamespace(comm_compressor="none")) is None
+    c = make_comm_compressor(types.SimpleNamespace(
+        comm_compressor="EFTopK", comm_compressor_ratio=0.1,
+        comm_compressor_level=6, comm_compressor_seed=3))
+    assert c is not None and c.kind == "eftopk"
+    assert c.ratio == 0.1 and c.quantize_level == 6
+
+
+def test_is_comm_payload_rejects_plain_trees():
+    assert not is_comm_payload(_model_tree())
+    assert not is_comm_payload({"kind": "topk"})
+    assert not is_comm_payload(None)

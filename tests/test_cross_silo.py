@@ -16,7 +16,7 @@ from fedml_tpu.arguments import default_config
 from fedml_tpu.core.distributed.communication.inmemory.broker import InMemoryBroker
 
 
-def _make_args(run_id, rank, role, n_clients=2, rounds=2, scenario="horizontal", backend="INMEMORY"):
+def _make_args(run_id, rank, role, n_clients=2, rounds=2, scenario="horizontal", backend="INMEMORY", **extra):
     return default_config(
         "cross_silo",
         run_id=run_id,
@@ -33,6 +33,7 @@ def _make_args(run_id, rank, role, n_clients=2, rounds=2, scenario="horizontal",
         dataset="synthetic",
         model="lr",
         random_seed=0,
+        **extra,
     )
 
 
@@ -45,7 +46,7 @@ def _run_party(args, results, key):
     results[key] = runner.run()
 
 
-def _run_cluster(run_id, scenario, backend, n_clients=2, rounds=2):
+def _run_cluster(run_id, scenario, backend, n_clients=2, rounds=2, **extra):
     """Server + N clients as threads over any backend; returns server metrics."""
     if backend == "INMEMORY":
         InMemoryBroker.reset()
@@ -57,7 +58,7 @@ def _run_cluster(run_id, scenario, backend, n_clients=2, rounds=2):
     threads = [
         threading.Thread(
             target=_run_party,
-            args=(_make_args(run_id, 0, "server", n_clients, rounds, scenario, backend), results, "server"),
+            args=(_make_args(run_id, 0, "server", n_clients, rounds, scenario, backend, **extra), results, "server"),
             daemon=True,
         )
     ]
@@ -65,7 +66,7 @@ def _run_cluster(run_id, scenario, backend, n_clients=2, rounds=2):
         threads.append(
             threading.Thread(
                 target=_run_party,
-                args=(_make_args(run_id, rank, "client", n_clients, rounds, scenario, backend), results, f"client{rank}"),
+                args=(_make_args(run_id, rank, "client", n_clients, rounds, scenario, backend, **extra), results, f"client{rank}"),
                 daemon=True,
             )
         )
@@ -84,6 +85,28 @@ def _run_cluster(run_id, scenario, backend, n_clients=2, rounds=2):
 @pytest.mark.parametrize("scenario", ["horizontal", "hierarchical"])
 def test_cross_silo_round_trip(scenario):
     _run_cluster(f"test_cs_{scenario}", scenario, "INMEMORY")
+
+
+def test_comm_compressor_full_ratio_uplink_parity():
+    """``args.comm_compressor`` wires utils/compression.py into the C2S
+    boundary. At eftopk ratio=1.0 the uplink round-trips bit-exactly, so the
+    compressed run's final metrics must EQUAL the uncompressed run's — the
+    parity guard for the comm wiring itself."""
+    plain = _run_cluster("test_cs_comp_off", "horizontal", "INMEMORY")
+    exact = _run_cluster(
+        "test_cs_comp_on", "horizontal", "INMEMORY",
+        comm_compressor="eftopk", comm_compressor_ratio=1.0)
+    assert plain["test_loss"] == exact["test_loss"], (plain, exact)
+    assert plain["test_acc"] == exact["test_acc"]
+
+
+def test_comm_compressor_lossy_uplink_still_converges():
+    """A genuinely sparsifying uplink (topk ratio 0.25) must still complete
+    the run with finite metrics — the server transparently decompresses."""
+    m = _run_cluster(
+        "test_cs_comp_lossy", "horizontal", "INMEMORY",
+        comm_compressor="topk", comm_compressor_ratio=0.25)
+    assert np.isfinite(m["test_loss"])
 
 
 def test_message_codec_roundtrip():

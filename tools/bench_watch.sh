@@ -73,6 +73,7 @@ commit_artifacts() {
       log "artifact committed: $(git rev-parse --short HEAD)"
       surface_agg_rates
       surface_agg_sharded
+      surface_async_rounds
       surface_resilience
       surface_serving
       surface_span_summary
@@ -129,6 +130,35 @@ elif doc.get("agg_sharded_skipped"):
 PYEOF
 ) || return 0
   [ -n "$sharded" ] && log "$sharded"
+}
+
+surface_async_rounds() {
+  # one-line view of the async buffered-federation stage: rounds/hr per
+  # cohort size (the flatness claim), staleness p50/p99 and the buffer's
+  # high-water depth — so the watcher log answers "is round throughput
+  # still cohort-independent" without opening BENCH_MEASURED_*.json
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1) || return 0
+  [ -n "$newest" ] || return 0
+  local asy
+  asy=$(python3 - "$newest" <<'PYEOF' 2>/dev/null
+import json, sys
+doc = json.load(open(sys.argv[1]))
+rph = doc.get("async_rounds_per_hr") or {}
+if rph:
+    rates = ", ".join(f"{k}: {v}/hr" for k, v in rph.items())
+    p50 = doc.get("async_staleness_p50") or {}
+    p99 = doc.get("async_staleness_p99") or {}
+    hw = doc.get("async_buffer_high_water") or {}
+    big = max(rph, key=lambda k: int(k))
+    print(f"async_rounds (publish_k={doc.get('async_publish_k')}): {{{rates}}}, "
+          f"flatness {doc.get('async_flatness_ratio')}, "
+          f"staleness p50/p99@{big} {p50.get(big)}/{p99.get(big)}, "
+          f"high_water {hw.get(big)}, "
+          f"parity_bit_exact={doc.get('async_parity_bit_exact')}")
+PYEOF
+) || return 0
+  [ -n "$asy" ] && log "$asy"
 }
 
 surface_resilience() {
