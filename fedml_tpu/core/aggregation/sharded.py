@@ -334,7 +334,7 @@ class ShardedBucketedAggregator(BucketedAggregator):
             acc = None
             for i in range(len(buckets)):
                 cur = pending
-                pending = (self._ingest_bucket(buckets[i + 1], layout)
+                pending = (self._ingest_bucket(buckets[i + 1], layout)  # fedlint: disable=interproc-host-sync double-buffered ingest: the host-side staging copy's device_put deliberately overlaps bucket i's accumulation
                            if i + 1 < len(buckets) else None)
                 with tel.span("agg.bucket_sharded", bucket_size=b, first=acc is None):
                     if acc is None:

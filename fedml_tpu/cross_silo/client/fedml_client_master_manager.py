@@ -54,7 +54,7 @@ class ClientMasterManager(FedMLCommManager):
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(MyMessage.MSG_TYPE_CONNECTION_IS_READY, self.handle_message_connection_ready)
         self.register_message_receive_handler(
-            MyMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.handle_message_check_status
+            MyMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.handle_message_check_status  # fedlint: disable=protocol-contract reference-server interop: FedML's server probes client status; ours infers it from CONNECTION_IS_READY, but clients must keep answering the probe
         )
         self.register_message_receive_handler(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init)
         self.register_message_receive_handler(
@@ -144,7 +144,7 @@ class ClientMasterManager(FedMLCommManager):
 
         message = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.client_real_id, receive_id)
         message.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, status)
-        message.add_params(MyMessage.MSG_ARG_KEY_CLIENT_OS, platform.system())
+        message.add_params(MyMessage.MSG_ARG_KEY_CLIENT_OS, platform.system())  # fedlint: disable=protocol-contract telemetry-only payload: the reference MLOps backend reads the OS tag server-side; no in-tree receiver wants it
         self.send_message(message)
 
     def send_model_to_server(self, receive_id: int, weights, local_sample_num) -> None:

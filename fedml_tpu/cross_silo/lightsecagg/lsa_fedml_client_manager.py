@@ -94,7 +94,10 @@ class LightSecAggClientManager(FedMLCommManager):
         client_index = msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
         self.trainer_dist_adapter.update_dataset(int(client_index))
         self.trainer_dist_adapter.update_model(model_params)
-        self.args.round_idx += 1
+        # the server stamps every sync with its round index; adopt it so a
+        # resumed server can't drift from the local +1 counter
+        ridx = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        self.args.round_idx = int(ridx) if ridx is not None else self.args.round_idx + 1
         self._run_round()
 
     def handle_message_encoded_mask(self, msg_params: Message) -> None:

@@ -43,6 +43,9 @@ class LightSecAggServerManager(FedMLCommManager):
 
     # --- handlers ---------------------------------------------------------
     def handle_message_client_status(self, msg_params: Message) -> None:
+        status = msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
+        if status is not None and status != MyMessage.MSG_CLIENT_STATUS_ONLINE:
+            return  # only ONLINE counts toward the init gate
         sender = msg_params.get_sender_id()
         self.client_online_status[sender] = True
         if len(self.client_online_status) == self.size - 1 and not self.is_initialized:

@@ -30,10 +30,6 @@ class MyMessage:
     MSG_TYPE_C2S_SEND_MASK_TO_SERVER = 7
     MSG_TYPE_C2S_CLIENT_STATUS = 8
 
-    MSG_ARG_KEY_TYPE = "msg_type"
-    MSG_ARG_KEY_SENDER = "sender"
-    MSG_ARG_KEY_RECEIVER = "receiver"
-
     MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
     MSG_ARG_KEY_MODEL_PARAMS = "model_params"
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"

@@ -20,16 +20,13 @@ class MyMessage:
     MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = 3
     MSG_TYPE_C2S_CLIENT_STATUS = 5
 
-    # arg keys
-    MSG_ARG_KEY_TYPE = "msg_type"
-    MSG_ARG_KEY_SENDER = "sender"
-    MSG_ARG_KEY_RECEIVER = "receiver"
+    # arg keys (routing lives in Message's own envelope fields; the old
+    # TYPE/SENDER/RECEIVER duplicates were dead vocabulary and are gone)
     MSG_ARG_KEY_MODEL_PARAMS = "model_params"
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
     MSG_ARG_KEY_CLIENT_STATUS = "client_status"
     MSG_ARG_KEY_CLIENT_OS = "client_os"
     MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
-    MSG_ARG_KEY_LOCAL_TRAINING_DATA_SIZE = "local_sample_num"
     MSG_ARG_KEY_ROUND_IDX = "round_idx"
     # async (non-barrier) rounds: the server stamps every model sync with the
     # published model version; clients echo the version they trained on so

@@ -80,7 +80,10 @@ class SecAggClientManager(FedMLCommManager):
     def handle_message_receive_model_from_server(self, msg_params: Message) -> None:
         self.trainer_dist_adapter.update_dataset(int(msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)))
         self.trainer_dist_adapter.update_model(msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
-        self.args.round_idx += 1
+        # the server stamps every sync with its round index; adopt it so a
+        # resumed server can't drift from the local +1 counter
+        ridx = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        self.args.round_idx = int(ridx) if ridx is not None else self.args.round_idx + 1
         self._run_round()
 
     def handle_message_key_directory(self, msg_params: Message) -> None:

@@ -38,6 +38,9 @@ class SecAggServerManager(FedMLCommManager):
 
     # --- handlers ---------------------------------------------------------
     def handle_message_client_status(self, msg_params: Message) -> None:
+        status = msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
+        if status is not None and status != MyMessage.MSG_CLIENT_STATUS_ONLINE:
+            return  # only ONLINE counts toward the init gate
         self.client_online_status[msg_params.get_sender_id()] = True
         if len(self.client_online_status) == self.size - 1 and not self.is_initialized:
             self.is_initialized = True

@@ -33,10 +33,6 @@ class MyMessage:
     MSG_TYPE_C2S_REVEAL = 8
     MSG_TYPE_C2S_CLIENT_STATUS = 11
 
-    MSG_ARG_KEY_TYPE = "msg_type"
-    MSG_ARG_KEY_SENDER = "sender"
-    MSG_ARG_KEY_RECEIVER = "receiver"
-
     MSG_ARG_KEY_MODEL_PARAMS = "model_params"
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
     MSG_ARG_KEY_NUM_SAMPLES = "num_samples"

@@ -254,7 +254,7 @@ class LLMTrainer:
                     # async enqueue: the orbax writer runs behind the next
                     # train steps; the watermark commits on completion, so a
                     # crash mid-write resumes from the previous complete step
-                    self.save(step + 1, wait=False)
+                    self.save(step + 1, wait=False)  # fedlint: disable=interproc-host-sync amortized: fires every save_steps, and the device_get feeds the async orbax writer that runs behind the next train steps
                 if step + 1 >= exp.max_steps:
                     break
             jax.block_until_ready(self.params)

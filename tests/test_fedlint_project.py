@@ -1,0 +1,737 @@
+"""fedlint v2: whole-program analysis over the cached project graph
+(ISSUE 10).
+
+Layers under test:
+
+* **project graph** — module naming, import edges, reverse closure,
+  cross-module constant/symbol resolution;
+* **incremental cache** — warm-run parity (identical findings, zero files
+  re-parsed), import-reverse-closure invalidation, unparseable files never
+  poisoning the cache, warm runs beating cold by the contract factor;
+* **whole-program rules** — protocol-contract, lock-graph (including the
+  PR-5 statusz lock-order shape), interproc donation (the PR-9
+  device_get-view-then-donate shape across functions and files),
+  interproc host-sync, and metric-registry: each with bad / good /
+  suppressed fixtures;
+* **SARIF** — ``--sarif`` output validates against the 2.1.0 structural
+  checks, suppressed findings carry ``suppressions[]``;
+* **--changed** — git-diff scoping reports only the changed files'
+  import-reverse-closure.
+"""
+
+import json
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import unittest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.fedlint import api, cli, sarif  # noqa: E402
+from tools.fedlint.project import (  # noqa: E402
+    ProjectGraph, changed_files, collect_summary, module_name, run_project,
+)
+from tools.fedlint.core import FileContext  # noqa: E402
+from tools.fedlint.registry import get_rules  # noqa: E402
+
+
+def _write(tmp, files):
+    for rel, src in files.items():
+        p = pathlib.Path(tmp) / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+
+
+def _pscan(tmp, files, rule_ids, options=None, cache=None, changed=None):
+    _write(tmp, files)
+    rules = get_rules(rule_ids, options=options or {})
+    return run_project(str(tmp), ["."], rules, cache_path=cache,
+                       changed_scope=changed)
+
+
+def _graph(tmp, files):
+    _write(tmp, files)
+    summaries = {}
+    for rel in files:
+        path = os.path.join(tmp, rel)
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        import ast
+        ctx = FileContext(str(tmp), path, src, ast.parse(src))
+        summaries[rel] = collect_summary(ctx)
+    return ProjectGraph(str(tmp), summaries)
+
+
+class TestProjectGraph(unittest.TestCase):
+
+    def test_module_names(self):
+        self.assertEqual(module_name("a/b/c.py"), "a.b.c")
+        self.assertEqual(module_name("a/b/__init__.py"), "a.b")
+        self.assertEqual(module_name("top.py"), "top")
+
+    def test_import_edges_and_reverse_closure(self):
+        with tempfile.TemporaryDirectory() as d:
+            g = _graph(d, {
+                "pkg/__init__.py": "",
+                "pkg/base.py": "X = 1\n",
+                "pkg/mid.py": "from pkg.base import X\n",
+                "pkg/top.py": "from pkg import mid\n",
+                "lone.py": "Y = 2\n",
+            })
+            self.assertIn("pkg/base.py", g.imports.get("pkg/mid.py", set()))
+            closure = g.reverse_closure({"pkg/base.py"})
+            self.assertEqual(
+                closure,
+                {"pkg/base.py", "pkg/mid.py", "pkg/top.py"})
+            self.assertEqual(g.reverse_closure({"lone.py"}), {"lone.py"})
+
+    def test_cross_module_constant_resolution(self):
+        with tempfile.TemporaryDirectory() as d:
+            g = _graph(d, {
+                "defs.py": "PREFIX = 'jax.compiles.'\n"
+                           "class C:\n    NAME = 'quorum.partial'\n",
+                "user.py": "from defs import C, PREFIX\nimport defs\n",
+            })
+            self.assertEqual(g.constant("user.py", "PREFIX"), "jax.compiles.")
+            self.assertEqual(g.constant("user.py", "C.NAME"), "quorum.partial")
+            self.assertEqual(g.constant("user.py", "defs.PREFIX"),
+                             "jax.compiles.")
+            self.assertIsNone(g.constant("user.py", "defs.MISSING"))
+
+
+_PROTO_DEFS = """\
+class MyMessage:
+    MSG_TYPE_S2C_INIT_CONFIG = 1
+    MSG_TYPE_C2S_UPLOAD = 2
+    MSG_TYPE_S2C_ORPHAN = 3
+    MSG_TYPE_CONNECTION_IS_READY = 0
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_DEAD = "dead"
+    MSG_ARG_KEY_MODEL_VERSION = "model_version"
+"""
+
+_PROTO_CLIENT = """\
+from proto_defs import MyMessage
+
+class Client:
+    def register(self):
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.handle_init)
+
+    def handle_init(self, msg_params):
+        self.version = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_VERSION)
+        return msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+
+    def upload(self):
+        msg = Message(MyMessage.MSG_TYPE_C2S_UPLOAD, 1, 0)
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, {})
+        self.send_message(msg)
+"""
+
+_PROTO_SERVER = """\
+from proto_defs import MyMessage
+
+class Server:
+    def register(self):
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_UPLOAD, self.handle_upload)
+
+    def handle_upload(self, msg_params):
+        return msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+
+    def broadcast(self):
+        msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, 0, 1)
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, {})
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_VERSION, 7)
+        self.send_message(msg)
+"""
+
+
+class TestProtocolContract(unittest.TestCase):
+
+    def test_clean_protocol_has_no_findings(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "proto_defs.py": _PROTO_DEFS.replace(
+                    "    MSG_TYPE_S2C_ORPHAN = 3\n", "").replace(
+                    '    MSG_ARG_KEY_DEAD = "dead"\n', ""),
+                "client.py": _PROTO_CLIENT,
+                "server.py": _PROTO_SERVER,
+            }, ["protocol-contract"])
+            self.assertEqual([f.render() for f in res.findings], [])
+
+    def test_drift_is_reported_per_site(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "proto_defs.py": _PROTO_DEFS,
+                "client.py": _PROTO_CLIENT,
+                # server never registers the upload handler and sends
+                # init without the version stamp
+                "server.py": _PROTO_SERVER.replace(
+                    "    def register(self):\n"
+                    "        self.register_message_receive_handler(\n"
+                    "            MyMessage.MSG_TYPE_C2S_UPLOAD, "
+                    "self.handle_upload)\n", "").replace(
+                    "        msg.add_params("
+                    "MyMessage.MSG_ARG_KEY_MODEL_VERSION, 7)\n", ""),
+            }, ["protocol-contract"])
+            msgs = "\n".join(f.message for f in res.findings)
+            self.assertIn("MSG_TYPE_C2S_UPLOAD is sent here but no file "
+                          "registers", msgs)
+            self.assertIn("MSG_TYPE_S2C_ORPHAN is defined but never", msgs)
+            self.assertIn("MSG_ARG_KEY_DEAD is defined but never", msgs)
+            self.assertIn("does not stamp MSG_ARG_KEY_MODEL_VERSION", msgs)
+            # the exempt synthesized type is never reported
+            self.assertNotIn("CONNECTION_IS_READY", msgs)
+            # sent-no-handler anchors at the send site in client.py
+            send = [f for f in res.findings
+                    if "MSG_TYPE_C2S_UPLOAD" in f.message]
+            self.assertEqual(send[0].relpath, "client.py")
+
+    def test_suppression_with_reason_is_honored(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "proto_defs.py": _PROTO_DEFS.replace(
+                    "    MSG_TYPE_S2C_ORPHAN = 3\n",
+                    "    MSG_TYPE_S2C_ORPHAN = 3  "
+                    "# fedlint: disable=protocol-contract reserved for the "
+                    "reference server's probe\n").replace(
+                    '    MSG_ARG_KEY_DEAD = "dead"\n',
+                    '    MSG_ARG_KEY_DEAD = "dead"  '
+                    "# fedlint: disable=protocol-contract telemetry-only "
+                    "payload read off-tree\n"),
+                "client.py": _PROTO_CLIENT,
+                "server.py": _PROTO_SERVER,
+            }, ["protocol-contract"])
+            self.assertEqual([f.render() for f in res.findings], [])
+            self.assertEqual(len(res.suppressed), 2)
+
+
+# The PR-5 statusz shape: render() invokes registered section callbacks
+# while still holding the registry lock; a manager calls render() under its
+# round lock, and a registered section takes the round lock. Cycle:
+# _round_lock -> _sections_lock -> _round_lock, spanning three files.
+_LG_STATUSZ_BAD = """\
+import threading
+
+_sections = {}
+_sections_lock = threading.Lock()
+
+def register_section(name, provider):
+    with _sections_lock:
+        _sections[name] = provider
+
+def render():
+    out = {}
+    with _sections_lock:
+        for name, provider in _sections.items():
+            out[name] = provider()
+    return out
+"""
+
+_LG_STATUSZ_GOOD = """\
+import threading
+
+_sections = {}
+_sections_lock = threading.Lock()
+
+def register_section(name, provider):
+    with _sections_lock:
+        _sections[name] = provider
+
+def render():
+    with _sections_lock:
+        providers = dict(_sections)
+    out = {}
+    for name, provider in providers.items():
+        out[name] = provider()
+    return out
+"""
+
+_LG_MANAGER = """\
+import threading
+import statusz
+
+class Manager:
+    def __init__(self):
+        self._round_lock = threading.Lock()
+        statusz.register_section("round", self.section)
+
+    def section(self):
+        with self._round_lock:
+            return {"round": 1}
+
+    def dump(self):
+        with self._round_lock:
+            return statusz.render()
+"""
+
+
+class TestLockGraph(unittest.TestCase):
+
+    def test_pr5_statusz_cycle_is_detected(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "statusz.py": _LG_STATUSZ_BAD,
+                "manager.py": _LG_MANAGER,
+            }, ["lock-graph"])
+            self.assertEqual(len(res.findings), 1, [f.render() for f in res.findings])
+            self.assertIn("cycle", res.findings[0].message)
+            self.assertIn("_round_lock", res.findings[0].message)
+            self.assertIn("_sections_lock", res.findings[0].message)
+
+    def test_fixed_render_shape_is_clean(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "statusz.py": _LG_STATUSZ_GOOD,
+                "manager.py": _LG_MANAGER,
+            }, ["lock-graph"])
+            self.assertEqual([f.render() for f in res.findings], [])
+
+    def test_direct_two_file_ab_ba_cycle(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "a.py": "import threading\nimport b\n"
+                        "class A:\n"
+                        "    def __init__(self):\n"
+                        "        self._la = threading.Lock()\n"
+                        "    def fwd(self, other):\n"
+                        "        with self._la:\n"
+                        "            b.helper(other)\n",
+                "b.py": "import threading\n"
+                        "class B:\n"
+                        "    def __init__(self):\n"
+                        "        self._lb = threading.Lock()\n"
+                        "    def back(self, a_obj):\n"
+                        "        with self._lb:\n"
+                        "            a_obj.grab()\n"
+                        "def helper(b_obj):\n"
+                        "    b_obj.take()\n"
+                        "class B2:\n"
+                        "    def __init__(self):\n"
+                        "        self._lb = threading.Lock()\n",
+            }, ["lock-graph"])
+            # one-hop propagation: fwd holds A._la and calls b.helper; this
+            # fixture only orders A->B, no cycle yet
+            self.assertEqual([f.render() for f in res.findings], [])
+
+    def test_suppressed_cycle(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "statusz.py": _LG_STATUSZ_BAD,
+                "manager.py": _LG_MANAGER.replace(
+                    "            return statusz.render()",
+                    "            return statusz.render()  "
+                    "# fedlint: disable=lock-graph single-threaded test "
+                    "harness, registry is frozen before threads start"),
+            }, ["lock-graph"])
+            # the finding anchors at the first witness edge; accept either
+            # zero findings (suppressed) or assert the suppression landed
+            total = len(res.findings) + len(res.suppressed)
+            self.assertEqual(total, 1)
+
+
+# The PR-9 shape: snapshot() returns a device_get view of a param that
+# fold() later donates; reading the view after the fold is a use of freed
+# memory. Two functions, and in the cross-file variant two files.
+_IP_SNAPSHOT = """\
+import jax
+
+def snapshot(params):
+    return jax.device_get(params)
+"""
+
+_IP_FOLD = """\
+import jax
+
+def _fold_impl(params, delta):
+    return params
+
+fold = jax.jit(_fold_impl, donate_argnums=(0,))
+"""
+
+_IP_DRIVER_BAD = """\
+from snap import snapshot
+from foldmod import fold
+
+def round_step(state, delta):
+    view = snapshot(state)
+    state = fold(state, delta)
+    return view["w"], state
+"""
+
+_IP_DRIVER_GOOD = """\
+from snap import snapshot
+from foldmod import fold
+
+def round_step(state, delta):
+    view = snapshot(state)
+    report = view["w"]
+    state = fold(state, delta)
+    return report, state
+"""
+
+
+class TestInterprocDonation(unittest.TestCase):
+
+    def test_pr9_view_then_donate_across_files(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "snap.py": _IP_SNAPSHOT,
+                "foldmod.py": _IP_FOLD,
+                "driver.py": _IP_DRIVER_BAD,
+            }, ["interproc-donation"])
+            self.assertEqual(len(res.findings), 1,
+                             [f.render() for f in res.findings])
+            f = res.findings[0]
+            self.assertEqual(f.relpath, "driver.py")
+            self.assertIn("view", f.message)
+
+    def test_read_before_donate_is_clean(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "snap.py": _IP_SNAPSHOT,
+                "foldmod.py": _IP_FOLD,
+                "driver.py": _IP_DRIVER_GOOD,
+            }, ["interproc-donation"])
+            self.assertEqual([f.render() for f in res.findings], [])
+
+    def test_direct_read_after_donation(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "foldmod.py": _IP_FOLD,
+                "driver.py": "from foldmod import fold\n"
+                             "def step(state, delta):\n"
+                             "    new = fold(state, delta)\n"
+                             "    stale = state\n"
+                             "    return new, stale\n",
+            }, ["interproc-donation"])
+            self.assertEqual(len(res.findings), 1,
+                             [f.render() for f in res.findings])
+
+    def test_suppressed_donation_read(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "snap.py": _IP_SNAPSHOT,
+                "foldmod.py": _IP_FOLD,
+                "driver.py": _IP_DRIVER_BAD.replace(
+                    'return view["w"], state',
+                    'return view["w"], state  '
+                    "# fedlint: disable=interproc-donation host copy "
+                    "materialized before the fold in this backend"),
+            }, ["interproc-donation"])
+            self.assertEqual([f.render() for f in res.findings], [])
+            self.assertEqual(len(res.suppressed), 1)
+
+
+class TestInterprocHostSync(unittest.TestCase):
+
+    _HELPER = ("import numpy as np\n"
+               "def to_host(x):\n"
+               "    return np.asarray(x)\n")
+
+    def test_hot_loop_calling_syncing_helper(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "helpers.py": self._HELPER,
+                "engine.py": "from helpers import to_host\n"
+                             "def run(xs):\n"
+                             "    out = []\n"
+                             "    for x in xs:\n"
+                             "        out.append(to_host(x))\n"
+                             "    return out\n",
+            }, ["interproc-host-sync"],
+                options={"hot-modules": ["engine.py"]})
+            self.assertEqual(len(res.findings), 1,
+                             [f.render() for f in res.findings])
+            self.assertIn("to_host", res.findings[0].message)
+
+    def test_cold_module_is_not_flagged(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "helpers.py": self._HELPER,
+                "engine.py": "from helpers import to_host\n"
+                             "def run(xs):\n"
+                             "    return [to_host(x) for x in xs]\n",
+            }, ["interproc-host-sync"],
+                options={"hot-modules": ["other.py"]})
+            self.assertEqual([f.render() for f in res.findings], [])
+
+
+class TestMetricRegistryRule(unittest.TestCase):
+
+    _OPTS = {"metric-doc": "docs/obs.md", "metric-tests-dir": "checks",
+             "metric-doc-ignore": []}
+
+    def test_drift_in_both_directions(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "emit.py": "COUNTER = 'quorum.partial'\n"
+                           "def go(tel):\n"
+                           "    tel.counter(COUNTER).add(1)\n"
+                           "    tel.histogram('agg_seconds').observe(1.0)\n",
+                "docs/obs.md": "only `fedml_ghost_total` is written up\n",
+                "checks/test_x.py": "EXPECT = 'fedml_agg_seconds'\n",
+            }, ["metric-registry"], options=self._OPTS)
+            msgs = "\n".join(f.message for f in res.findings)
+            self.assertIn("`fedml_quorum_partial_total` is emitted here but "
+                          "not documented", msgs)
+            self.assertIn("`fedml_quorum_partial_total` is emitted here but "
+                          "asserted by no test", msgs)
+            self.assertIn("`fedml_agg_seconds` is emitted here but not "
+                          "documented", msgs)
+            self.assertIn("documented metric `fedml_ghost_total` is emitted "
+                          "nowhere", msgs)
+            # the doc-drift finding anchors in the doc file itself
+            ghost = [f for f in res.findings if "ghost" in f.message]
+            self.assertEqual(ghost[0].relpath, "docs/obs.md")
+
+    def test_documented_and_tested_is_clean(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "emit.py": "def go(tel):\n"
+                           "    tel.counter('quorum.partial').add(1)\n",
+                "docs/obs.md": "| `fedml_quorum_partial_total` | partials |\n",
+                "checks/test_x.py":
+                    "EXPECT = 'fedml_quorum_partial_total'\n",
+            }, ["metric-registry"], options=self._OPTS)
+            self.assertEqual([f.render() for f in res.findings], [])
+
+
+class TestIncrementalCache(unittest.TestCase):
+
+    _TREE = {
+        "pkg/__init__.py": "",
+        "pkg/base.py": "import time\nT = time.time()\n",
+        "pkg/mid.py": "from pkg.base import T\n",
+        "lone.py": "import time\nU = time.time()\n",
+    }
+
+    def test_warm_run_is_pure_cache_and_identical(self):
+        with tempfile.TemporaryDirectory() as d:
+            cache = os.path.join(d, ".cache.json")
+            cold = _pscan(d, self._TREE, ["wall-clock"], cache=cache)
+            self.assertEqual(len(cold.analyzed), 4)
+            warm = _pscan(d, {}, ["wall-clock"], cache=cache)
+            self.assertEqual(warm.analyzed, [])
+            self.assertEqual(warm.cache_hits, 4)
+            self.assertEqual(
+                [f.render() for f in warm.findings],
+                [f.render() for f in cold.findings])
+            self.assertEqual(len(warm.findings), 2)
+
+    def test_one_file_edit_reanalyzes_only_reverse_closure(self):
+        with tempfile.TemporaryDirectory() as d:
+            cache = os.path.join(d, ".cache.json")
+            _pscan(d, self._TREE, ["wall-clock"], cache=cache)
+            res = _pscan(d, {
+                "pkg/base.py": "import time\nT = time.time()\nX = 1\n",
+            }, ["wall-clock"], cache=cache)
+            self.assertEqual(sorted(res.analyzed),
+                             ["pkg/base.py", "pkg/mid.py"])
+            self.assertEqual(res.cache_hits, 2)  # __init__ and lone.py
+
+    def test_engine_change_invalidates_cache(self):
+        with tempfile.TemporaryDirectory() as d:
+            cache = os.path.join(d, ".cache.json")
+            _pscan(d, self._TREE, ["wall-clock"], cache=cache)
+            res = _pscan(d, {}, ["wall-clock", "bare-sleep"], cache=cache)
+            self.assertEqual(len(res.analyzed), 4)
+
+    def test_corrupt_cache_is_rebuilt_not_fatal(self):
+        with tempfile.TemporaryDirectory() as d:
+            cache = os.path.join(d, ".cache.json")
+            _pscan(d, self._TREE, ["wall-clock"], cache=cache)
+            with open(cache, "w") as f:
+                f.write("{not json")
+            res = _pscan(d, {}, ["wall-clock"], cache=cache)
+            self.assertEqual(len(res.analyzed), 4)
+            self.assertEqual(len(res.findings), 2)
+
+    def test_syntax_error_never_poisons_the_cache(self):
+        with tempfile.TemporaryDirectory() as d:
+            cache = os.path.join(d, ".cache.json")
+            tree = dict(self._TREE)
+            tree["broken.py"] = "def oops(:\n"
+            first = _pscan(d, tree, ["wall-clock"], cache=cache)
+            self.assertIn("syntax-error", {f.rule for f in first.findings})
+            # warm run: everything else cached, the broken file re-analyzed
+            # and re-reported every single run
+            again = _pscan(d, {}, ["wall-clock"], cache=cache)
+            self.assertEqual(again.analyzed, ["broken.py"])
+            self.assertIn("syntax-error", {f.rule for f in again.findings})
+            with open(cache, encoding="utf-8") as f:
+                self.assertNotIn("broken.py", json.load(f)["files"])
+            # once fixed it joins the cache like any other file
+            fixed = _pscan(d, {"broken.py": "def oops():\n    return 1\n"},
+                           ["wall-clock"], cache=cache)
+            self.assertEqual(fixed.analyzed, ["broken.py"])
+            healed = _pscan(d, {}, ["wall-clock"], cache=cache)
+            self.assertEqual(healed.analyzed, [])
+
+
+class TestWarmSpeedAndRepoGates(unittest.TestCase):
+
+    def test_warm_cache_is_5x_faster_on_the_repo(self):
+        """ISSUE 10 acceptance: warm runs must be >=5x faster than cold.
+        Measured over the real tree with a throwaway cache path."""
+        with tempfile.TemporaryDirectory() as d:
+            cache = os.path.join(d, "cache.json")
+            t0 = time.perf_counter()
+            cold = api.run_repo(use_baseline=False, use_cache=True)
+            # run_repo uses the repo cache path; re-run against a fresh
+            # private cache for a true cold/warm pair
+            from tools.fedlint.config import load_config
+            from tools.fedlint.registry import all_rules
+            cfg = load_config(_REPO)
+            rules = [r for r in all_rules(cfg)
+                     if r.id not in set(cfg.get("disable") or ())]
+            t0 = time.perf_counter()
+            cold = run_project(_REPO, cfg["paths"], rules,
+                               exclude=cfg["exclude"], cache_path=cache)
+            cold_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            warm = run_project(_REPO, cfg["paths"], rules,
+                               exclude=cfg["exclude"], cache_path=cache)
+            warm_s = time.perf_counter() - t0
+            self.assertEqual(warm.analyzed, [])
+            self.assertEqual(warm.cache_hits, cold.files_scanned)
+            self.assertEqual(
+                [f.render() for f in warm.findings],
+                [f.render() for f in cold.findings])
+            self.assertGreaterEqual(
+                cold_s / max(warm_s, 1e-9), 5.0,
+                f"warm {warm_s:.3f}s vs cold {cold_s:.3f}s")
+
+    def test_repo_is_clean_under_project_engine(self):
+        res = api.run_repo(use_cache=False)
+        self.assertEqual(
+            [f.render() for f in res.findings], [],
+            "unsuppressed findings under the whole-program rules")
+
+
+class TestSarifOutput(unittest.TestCase):
+
+    def test_repo_sarif_validates(self):
+        res = api.run_repo(use_cache=False)
+        from tools.fedlint.config import load_config
+        from tools.fedlint.registry import all_rules
+        rules = all_rules(load_config(_REPO))
+        doc = sarif.to_sarif(res, rules)
+        self.assertEqual(sarif.validate(doc), [])
+        self.assertEqual(doc["version"], "2.1.0")
+        run0 = doc["runs"][0]
+        self.assertEqual(run0["tool"]["driver"]["name"], "fedlint")
+        # suppressed findings ride along flagged as suppressed
+        supp = [r for r in run0["results"] if r.get("suppressions")]
+        self.assertGreater(len(supp), 0)
+        for r in supp:
+            self.assertTrue(r["suppressions"][0]["kind"])
+
+    def test_fixture_findings_round_trip(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "m.py": "import time\nt = time.time()\n",
+            }, ["wall-clock"])
+            rules = get_rules(["wall-clock"], options={})
+            doc = sarif.to_sarif(res, rules)
+            self.assertEqual(sarif.validate(doc), [])
+            results = doc["runs"][0]["results"]
+            self.assertEqual(len(results), 1)
+            loc = results[0]["locations"][0]["physicalLocation"]
+            self.assertEqual(loc["artifactLocation"]["uri"], "m.py")
+            self.assertEqual(loc["region"]["startLine"], 2)
+            self.assertIn("fedlint/v1", results[0]["partialFingerprints"])
+
+    def test_cli_sarif_flag_writes_file(self):
+        with tempfile.TemporaryDirectory() as d:
+            out = os.path.join(d, "out.sarif")
+            rc = cli.main(["--sarif", out, "--no-cache"])
+            self.assertEqual(rc, 0)
+            with open(out, encoding="utf-8") as f:
+                doc = json.load(f)
+            self.assertEqual(sarif.validate(doc), [])
+
+
+class TestChangedScope(unittest.TestCase):
+
+    def _git(self, d, *args):
+        subprocess.run(["git", "-C", d, *args], check=True,
+                       capture_output=True,
+                       env={**os.environ,
+                            "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t"})
+
+    def test_changed_files_and_scoped_report(self):
+        if shutil.which("git") is None:
+            self.skipTest("git unavailable")
+        with tempfile.TemporaryDirectory() as d:
+            _write(d, {
+                "pkg/__init__.py": "",
+                "pkg/base.py": "import time\nT = time.time()\n",
+                "pkg/mid.py": "from pkg.base import T\n",
+                "lone.py": "import time\nU = time.time()\n",
+            })
+            self._git(d, "init", "-q")
+            self._git(d, "add", "-A")
+            self._git(d, "commit", "-qm", "seed")
+            self.assertEqual(changed_files(d), set())
+            with open(os.path.join(d, "pkg", "base.py"), "a") as f:
+                f.write("X = 1\n")
+            self.assertEqual(changed_files(d), {"pkg/base.py"})
+
+            rules = get_rules(["wall-clock"], options={})
+            scope = changed_files(d)
+            g = run_project(d, ["."], rules).graph
+            closure = g.reverse_closure(scope)
+            self.assertEqual(closure, {"pkg/base.py", "pkg/mid.py"})
+            res = run_project(d, ["."], rules, changed_scope=closure)
+            # lone.py's wall-clock finding is out of scope; base.py's is in
+            self.assertEqual({f.relpath for f in res.findings},
+                             {"pkg/base.py"})
+
+    def test_untracked_files_are_in_scope(self):
+        if shutil.which("git") is None:
+            self.skipTest("git unavailable")
+        with tempfile.TemporaryDirectory() as d:
+            _write(d, {"a.py": "A = 1\n"})
+            self._git(d, "init", "-q")
+            self._git(d, "add", "-A")
+            self._git(d, "commit", "-qm", "seed")
+            _write(d, {"fresh.py": "import time\nT = time.time()\n"})
+            self.assertEqual(changed_files(d), {"fresh.py"})
+
+
+class TestShimProjectMode(unittest.TestCase):
+    """api.run_rules now routes through the project engine; the shims'
+    contracts (tuple shapes, exit codes, no cache side effects) must hold."""
+
+    def test_run_rules_writes_no_cache_file(self):
+        with tempfile.TemporaryDirectory() as d:
+            _write(d, {"m.py": "import time\nt = time.time()\n"})
+            res = api.run_rules(d, ["wall-clock"])
+            self.assertEqual(len(res.findings), 1)
+            leftovers = [fn for fn in os.listdir(d) if fn != "m.py"]
+            self.assertEqual(leftovers, [])
+
+    def test_project_rules_run_via_run_rules(self):
+        with tempfile.TemporaryDirectory() as d:
+            _write(d, {
+                "proto_defs.py": _PROTO_DEFS,
+                "client.py": _PROTO_CLIENT,
+                "server.py": _PROTO_SERVER,
+            })
+            res = api.run_rules(d, ["protocol-contract"])
+            self.assertTrue(
+                any("MSG_TYPE_S2C_ORPHAN" in f.message for f in res.findings))
+
+
+if __name__ == "__main__":
+    unittest.main()

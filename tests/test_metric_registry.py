@@ -1,0 +1,124 @@
+"""The exported-metric registry: every ``fedml_*`` series the tree emits,
+by literal canonical name.
+
+This file is one leg of the ``metric-registry`` fedlint rule's contract
+(docs/static_analysis.md): a series is healthy only if it is emitted,
+documented in docs/observability.md, AND asserted by at least one test.
+Renaming a metric without touching this registry (and the doc) fails both
+the rule and these tests — which is the point: dashboards and alerts key
+on these exact strings.
+"""
+
+import os
+import re
+
+from fedml_tpu.core.telemetry import Telemetry
+from fedml_tpu.core.telemetry import prom
+
+# name -> Prometheus kind. Histograms are listed by base name (they render
+# _bucket/_sum/_count); counters end in _total by construction.
+EXPORTED = {
+    # comm / resilience
+    "fedml_comm_retry_total": "counter",
+    "fedml_jax_compiles_total": "counter",
+    "fedml_quorum_partial_total": "counter",
+    "fedml_quorum_late_discarded_total": "counter",
+    "fedml_quorum_surplus_total": "counter",
+    "fedml_quorum_stale_accepted_total": "counter",
+    "fedml_quorum_stale_rejected_total": "counter",
+    "fedml_checkpoint_save_seconds": "histogram",
+    "fedml_checkpoint_dropped_total": "counter",
+    "fedml_client_health": "gauge",
+    "fedml_client_straggler": "gauge",
+    "fedml_straggler_total": "counter",
+    # async / hierarchy aggregation
+    "fedml_async_merges_total": "counter",
+    "fedml_async_publishes_total": "counter",
+    "fedml_async_staleness": "histogram",
+    "fedml_async_buffer_depth": "gauge",
+    "fedml_async_buffer_high_water": "gauge",
+    "fedml_async_model_version": "gauge",
+    "fedml_hierarchy_forwards": "gauge",
+    "fedml_hierarchy_forwards_total": "counter",
+    # server / mesh
+    "fedml_server_aggregate_seconds": "histogram",
+    "fedml_server_shard_bytes": "gauge",
+    "fedml_device_hbm_peak_bytes": "gauge",
+    # training
+    "fedml_llm_tokens_per_sec": "histogram",
+    # serving
+    "fedml_predictor_ready": "gauge",
+    "fedml_serving_replicas": "gauge",
+    "fedml_serving_request_seconds": "histogram",
+    "fedml_serving_request_errors_total": "counter",
+    "fedml_serving_cb_requests_total": "counter",
+    "fedml_serving_cb_admissions_total": "counter",
+    "fedml_serving_cb_tokens_generated_total": "counter",
+    "fedml_serving_cb_ttft_seconds": "histogram",
+    "fedml_serving_cb_tpot_seconds": "histogram",
+    "fedml_serving_gateway_qps": "gauge",
+    "fedml_serving_gateway_latency_ewma_seconds": "gauge",
+    "fedml_serving_gateway_errors": "gauge",
+    # telemetry internals
+    "fedml_span_seconds_total": "counter",
+    "fedml_span_count_total": "counter",
+    "fedml_telemetry_dropped_total": "counter",
+    "fedml_telemetry_trace_ctx_malformed_total": "counter",
+}
+
+_DOC = os.path.join(os.path.dirname(__file__), "..", "docs", "observability.md")
+
+
+def test_names_are_canonical():
+    for name, kind in EXPORTED.items():
+        assert re.fullmatch(r"fedml_[a-z0-9_]+", name), name
+        if kind == "counter":
+            assert name.endswith("_total"), f"counter {name} must end _total"
+        else:
+            assert not name.endswith("_total"), name
+
+
+def test_registry_matches_observability_doc():
+    with open(_DOC, encoding="utf-8") as f:
+        doc = f.read()
+    missing = [n for n in EXPORTED if n not in doc]
+    assert not missing, f"undocumented exported metrics: {missing}"
+
+
+def test_prom_render_produces_registry_names():
+    """Dotted telemetry names render to the registry's canonical prom
+    families — the exact transform the whole registry relies on."""
+    t = Telemetry(enabled=True)
+    t.counter("quorum.partial").add(1)
+    t.counter("serving.cb.requests").add(2)
+    t.histogram("serving.cb.ttft_seconds").observe(0.01)
+    t.histogram("llm.tokens_per_sec").observe(1234.0)
+    text = prom.render(t, gauges=[("hierarchy_forwards", {"node": "leaf-0"}, 3.0)])
+    assert "fedml_quorum_partial_total 1" in text
+    assert "fedml_serving_cb_requests_total 2" in text
+    assert "fedml_serving_cb_ttft_seconds_bucket" in text
+    assert "fedml_serving_cb_ttft_seconds_count 1" in text
+    assert "fedml_llm_tokens_per_sec_sum" in text
+    assert 'fedml_hierarchy_forwards{node="leaf-0"} 3' in text
+
+
+def test_registry_covers_live_exposition():
+    """Every family a real render emits is registered (no unregistered
+    series can sneak into /metrics via this path)."""
+    t = Telemetry(enabled=True)
+    t.counter("quorum.surplus").add(1)
+    t.counter("checkpoint.dropped").add(1)
+    t.histogram("server.aggregate_seconds").observe(0.2)
+    text = prom.render(t)
+    fams = set()
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        fam = line.split("{")[0].split(" ")[0]
+        for suffix in ("_bucket", "_sum", "_count"):
+            if fam.endswith(suffix) and fam[: -len(suffix)] in EXPORTED:
+                fam = fam[: -len(suffix)]
+        fams.add(fam)
+    unregistered = {f for f in fams if f not in EXPORTED
+                    and not f.startswith("fedml_span_")}
+    assert not unregistered, f"unregistered families in exposition: {unregistered}"

@@ -281,6 +281,112 @@ class TestFleetTraceEndToEnd:
             tc.set_current(None)
 
 
+class TestCompressedUplinkTracePropagation:
+    """ISSUE 10 satellite: the uplink compressor rewrites the model payload
+    in place; the trace header must ride the SAME message untouched, and the
+    server's handling spans must still land inside the round's trace."""
+
+    def test_traceparent_survives_compressed_payload(self):
+        import numpy as np
+
+        from fedml_tpu.utils.compression import (
+            decompress_comm_payload,
+            is_comm_payload,
+            make_comm_compressor,
+        )
+
+        class _Args:
+            comm_compressor = "eftopk"
+            comm_compressor_ratio = 0.5
+
+        comp = make_comm_compressor(_Args())
+        tree = {"w": np.arange(8.0, dtype=np.float32)}
+        ctx = tc.TraceContext(tc.new_trace_id(), parent_span_id=3, round_idx=1)
+        msg = Message("c2s", 1, 0)
+        with tc.activated(ctx):
+            msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, comp.compress_tree(tree))
+            tc.inject(msg)
+        # compressed payload present AND the header intact on the same message
+        assert is_comm_payload(msg.get(Message.MSG_ARG_KEY_MODEL_PARAMS))
+        assert tc.extract(msg) == ctx
+        # the control-plane header rides the payload-stripping wire format too
+        wire = json.loads(msg.to_json())
+        assert tc.TRACEPARENT_FIELD in wire[Message.MSG_ARG_KEY_TELEMETRY]
+        # and the payload still decompresses after the trip
+        out = decompress_comm_payload(msg.get(Message.MSG_ARG_KEY_MODEL_PARAMS))
+        assert out["w"].shape == (8,)
+
+    def test_compressed_cluster_spans_nest_under_round(self):
+        """2-client inmemory cross-silo run with eftopk uplink compression:
+        client.compress fires per upload, server.decompress per receipt, and
+        every one of them carries the round's trace — compression must not
+        sever the trace chain."""
+        import fedml_tpu as fedml
+        from fedml_tpu.arguments import default_config
+        from fedml_tpu.core.distributed.communication.inmemory.broker import InMemoryBroker
+
+        n_clients, rounds = 2, 2
+
+        def make_args(rank, role):
+            return default_config(
+                "cross_silo", run_id="test_compress_trace", rank=rank, role=role,
+                backend="INMEMORY", scenario="horizontal",
+                client_num_in_total=n_clients, client_num_per_round=n_clients,
+                comm_round=rounds, epochs=1, batch_size=16,
+                frequency_of_the_test=1, dataset="synthetic", model="lr",
+                random_seed=0, comm_compressor="eftopk",
+                comm_compressor_ratio=0.5,
+            )
+
+        def run_party(args, results, key):
+            args = fedml.init(args)
+            device = fedml.device.get_device(args)
+            dataset, output_dim = fedml.data.load(args)
+            model = fedml.model.create(args, output_dim)
+            results[key] = fedml.FedMLRunner(args, device, dataset, model).run()
+
+        t = tel.get_telemetry()
+        was_enabled = t.enabled
+        t.set_enabled(True)
+        t.reset()
+        try:
+            InMemoryBroker.reset()
+            results = {}
+            threads = [threading.Thread(
+                target=run_party, args=(make_args(0, "server"), results, "server"), daemon=True)]
+            for rank in range(1, n_clients + 1):
+                threads.append(threading.Thread(
+                    target=run_party, args=(make_args(rank, "client"), results, f"c{rank}"), daemon=True))
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=600)
+                assert not th.is_alive(), "compressed-uplink cluster deadlocked"
+            assert results["server"] is not None
+
+            snap = t.snapshot()
+            round_spans = [r for r in snap["spans"] if r["name"] == "server.round"]
+            compress = [r for r in snap["spans"] if r["name"] == "client.compress"]
+            decompress = [r for r in snap["spans"] if r["name"] == "server.decompress"]
+            assert len(round_spans) == rounds
+            assert len(compress) == rounds * n_clients
+            assert len(decompress) == rounds * n_clients
+            for r in compress:
+                assert r["attrs"]["kind"] == "eftopk", r
+            trace_ids = {r.get("trace_id") for r in round_spans}
+            assert len(trace_ids) == 1 and None not in trace_ids, round_spans
+            round_seqs = {r["seq"] for r in round_spans}
+            for r in compress + decompress:
+                # the compressed hop keeps the round's trace_id ...
+                assert r.get("trace_id") == next(iter(trace_ids)), r
+                # ... and still nests under a server.round span
+                assert r.get("trace_parent") in round_seqs, (r, round_seqs)
+        finally:
+            t.reset()
+            t.set_enabled(was_enabled)
+            tc.set_current(None)
+
+
 class TestTelemetryLint:
     def test_reserved_key_containment_and_timing(self, capsys):
         """tools/check_telemetry.py: the reserved header literal appears only

@@ -12,7 +12,8 @@ import os
 
 from . import baseline as baseline_mod
 from .config import load_config
-from .core import RunResult, run
+from .core import RunResult
+from .project import run_project
 from .registry import all_rules, get_rules
 
 
@@ -23,14 +24,18 @@ def repo_root() -> str:
 def run_rules(root: str, rule_ids, paths=None, exclude=(),
               options: dict = None) -> RunResult:
     """Run ``rule_ids`` over ``root`` (whole tree when ``paths`` is None).
-    No baseline — shims and tests see raw (pragma-filtered) findings."""
+    No baseline, no cache — shims and tests see raw (pragma-filtered)
+    findings computed fresh every call."""
     rules = get_rules(rule_ids, options=options or load_config(repo_root()))
-    return run(root, paths or ["."], rules, exclude=exclude)
+    return run_project(root, paths or ["."], rules, exclude=exclude,
+                       cache_path=None)
 
 
-def run_repo(root: str = None, rule_ids=None, use_baseline: bool = True) -> RunResult:
+def run_repo(root: str = None, rule_ids=None, use_baseline: bool = True,
+             use_cache: bool = True, changed_scope=None) -> RunResult:
     """The full configured run: config paths/excludes, every rule (minus
-    config-disabled), baseline applied. This is what CI and the CLI use."""
+    config-disabled), baseline applied, incremental cache warm. This is what
+    CI, bench_watch, and the CLI use."""
     root = root or repo_root()
     cfg = load_config(root)
     rules = (get_rules(rule_ids, options=cfg) if rule_ids
@@ -38,5 +43,7 @@ def run_repo(root: str = None, rule_ids=None, use_baseline: bool = True) -> RunR
     entries = []
     if use_baseline:
         entries = baseline_mod.load(os.path.join(root, cfg["baseline"]))
-    return run(root, cfg["paths"], rules, exclude=cfg["exclude"],
-               baseline_entries=entries)
+    cache_path = os.path.join(root, cfg["cache"]) if use_cache else None
+    return run_project(root, cfg["paths"], rules, exclude=cfg["exclude"],
+                       baseline_entries=entries, cache_path=cache_path,
+                       changed_scope=changed_scope)

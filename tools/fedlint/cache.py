@@ -1,0 +1,55 @@
+"""Incremental-analysis cache for the project engine.
+
+One JSON document per repo: ``{"sig": <engine signature>, "files":
+{relpath: entry}}``. The signature hashes the rule set + summary format
+version, so adding/removing a rule or changing the cache layout cold-starts
+the whole cache instead of mixing incompatible entries.
+
+Writes are atomic (temp file + ``os.replace``) so a crashed run never
+leaves a truncated cache behind; a corrupt or unreadable cache is treated
+as empty, never as an error — the cache is a pure accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+DEFAULT_CACHE_NAME = ".fedlint_cache.json"
+
+
+def load(path: str, sig: str) -> dict:
+    """Cached ``{relpath: entry}`` map, or ``{}`` when the cache is absent,
+    unreadable, corrupt, or was written by a different engine signature."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(doc, dict) or doc.get("sig") != sig:
+        return {}
+    files = doc.get("files")
+    return files if isinstance(files, dict) else {}
+
+
+def save(path: str, sig: str, files: dict) -> None:
+    """Atomically persist the cache; failures are swallowed (a missing
+    cache only costs the next run a cold start)."""
+    doc = {"sig": sig, "files": files}
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd, tmp = tempfile.mkstemp(
+            prefix=".fedlint_cache.", suffix=".tmp", dir=directory)
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(doc, f, separators=(",", ":"))
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except OSError:
+        pass

@@ -5,6 +5,8 @@
     python -m tools.fedlint --rules host-sync,retrace-risk fedml_tpu/serving
     python -m tools.fedlint --list-rules
     python -m tools.fedlint --write-baseline --reason "pre-ISSUE-9 burn-down"
+    python -m tools.fedlint --sarif out.sarif   # SARIF 2.1.0 for code scanning
+    python -m tools.fedlint --changed           # git-diff scope + import closure
 
 Exit codes: 0 clean (no unsuppressed error-severity findings), 1 findings,
 2 usage/config/baseline error.
@@ -47,6 +49,14 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="reason string recorded on baseline entries")
     p.add_argument("--statistics", action="store_true",
                    help="append per-rule counts to text output")
+    p.add_argument("--sarif", metavar="PATH", default=None,
+                   help="also write findings as SARIF 2.1.0 to PATH "
+                        "('-' for stdout)")
+    p.add_argument("--changed", action="store_true",
+                   help="report only findings in files changed per git "
+                        "(plus their import-reverse-closure)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="ignore and do not write the incremental cache")
     return p
 
 
@@ -83,9 +93,27 @@ def main(argv=None) -> int:
             print(f"fedlint: bad baseline {baseline_path}: {e}", file=sys.stderr)
             return 2
 
-    from .core import run as engine_run
-    result = engine_run(root, args.paths or cfg["paths"], rules,
-                        exclude=cfg["exclude"], baseline_entries=entries)
+    changed_scope = None
+    if args.changed:
+        from .project import changed_files
+        changed_scope = changed_files(root)
+        if not changed_scope:
+            print("fedlint: clean — no changed .py files")
+            return 0
+
+    from .project import run_project
+    cache_path = None if args.no_cache else os.path.join(root, cfg["cache"])
+    result = run_project(root, args.paths or cfg["paths"], rules,
+                         exclude=cfg["exclude"], baseline_entries=entries,
+                         cache_path=cache_path, changed_scope=changed_scope)
+
+    if args.sarif:
+        from . import sarif as sarif_mod
+        if args.sarif == "-":
+            print(json.dumps(sarif_mod.to_sarif(result, rules), indent=2,
+                             sort_keys=True))
+        else:
+            sarif_mod.write(args.sarif, result, rules)
 
     if args.write_baseline:
         try:
@@ -109,6 +137,9 @@ def main(argv=None) -> int:
         for e in result.stale_baseline:
             print(f"stale baseline entry: {e['path']} [{e['rule']}] — fixed? "
                   "remove it from the baseline")
+    cache_note = (f"cache {result.cache_hit_rate:.0%} "
+                  f"({result.files_analyzed} analyzed) · "
+                  f"{result.wall_time_s:.2f}s")
     if args.statistics or result.findings:
         by_rule: dict = {}
         for f in result.findings:
@@ -118,12 +149,12 @@ def main(argv=None) -> int:
             f"\nfedlint: {len(result.findings)} finding(s) "
             f"[{stats}] · {len(result.suppressed)} suppressed · "
             f"{len(result.baselined)} baselined · "
-            f"{result.files_scanned} files")
+            f"{result.files_scanned} files · {cache_note}")
     elif not result.findings:
         print(
             f"fedlint: clean — {result.files_scanned} files, "
             f"{len(result.suppressed)} suppressed, "
-            f"{len(result.baselined)} baselined")
+            f"{len(result.baselined)} baselined, {cache_note}")
     return result.exit_code()
 
 

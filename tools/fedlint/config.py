@@ -35,6 +35,14 @@ DEFAULTS = {
     # Thread(target=...) names them directly (comm handler callbacks)
     "thread-entry-methods": ["handle_receive_message"],
     "disable": [],
+    # project-graph incremental cache (ISSUE 10); repo-root-relative
+    "cache": ".fedlint_cache.json",
+    # metric-registry rule: where fedml_* series must be documented/tested
+    "metric-doc": "docs/observability.md",
+    "metric-tests-dir": "tests",
+    # fnmatch patterns exempt from the doc/test contract: "fedml_tpu" is the
+    # package name, not a metric, and matches the fedml_* token regex
+    "metric-doc-ignore": ["fedml_tpu*"],
 }
 
 _SECTION_RE = re.compile(r"^\s*\[(?P<name>[^\]]+)\]\s*$")

@@ -134,6 +134,23 @@ class _Suppressions:
         at = self.by_line.get(line, ())
         return rule in at or "all" in at
 
+    # --- cache serialization (tools.fedlint.project) ---------------------
+    def to_json(self) -> dict:
+        return {
+            "by_line": {str(k): sorted(v) for k, v in self.by_line.items()},
+            "file_wide": sorted(self.file_wide),
+            "bare_lines": list(self.bare_lines),
+        }
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "_Suppressions":
+        sup = cls()
+        sup.by_line = {int(k): set(v)
+                       for k, v in (doc.get("by_line") or {}).items()}
+        sup.file_wide = set(doc.get("file_wide") or ())
+        sup.bare_lines = list(doc.get("bare_lines") or ())
+        return sup
+
 
 class FileContext:
     """Everything a rule may ask about one parsed file."""
@@ -268,6 +285,42 @@ class Rule:
         )
 
 
+class ProjectRule(Rule):
+    """Whole-program rule: per-file fact collection + a finalize pass over
+    the :class:`tools.fedlint.project.ProjectGraph`.
+
+    The split is what makes these rules cacheable: ``collect(ctx)`` runs
+    only on files that changed (returning a JSON-serializable fact dict
+    that is stored in the incremental cache), while
+    ``finalize_project(graph, facts)`` runs every time over the union of
+    fresh and cached facts. Facts must therefore carry everything a
+    finding needs — line numbers and line text included — because at
+    finalize time there is no live :class:`FileContext` for cache-hit
+    files.
+    """
+
+    #: marks the rule for the project engine's collect/finalize protocol
+    project = True
+
+    def collect(self, ctx: FileContext):
+        """Per-file facts (JSON-safe dict) or None when the file holds
+        nothing of interest. Runs only on changed files."""
+        return None
+
+    def finalize_project(self, graph, facts: dict):
+        """Cross-file findings from ``facts`` (relpath -> collect() result,
+        interest-bearing files only) and the project ``graph``."""
+        return ()
+
+    def fact_finding(self, root: str, relpath: str, line: int, message: str,
+                     line_text: str = "", severity: str = None) -> Finding:
+        """Build a Finding without a live FileContext (cache-hit files)."""
+        return Finding(
+            rule=self.id, severity=severity or self.severity,
+            path=os.path.join(root, *relpath.split("/")), relpath=relpath,
+            line=line, col=0, message=message, line_text=line_text)
+
+
 @dataclass
 class RunContext:
     root: str
@@ -285,6 +338,20 @@ class RunResult:
     baselined: list = field(default_factory=list)
     stale_baseline: list = field(default_factory=list)  # baseline entries matching nothing
     files_scanned: int = 0
+    # project-engine extras (tools.fedlint.project): which files were
+    # actually parsed this run vs served from the incremental cache
+    analyzed: list = field(default_factory=list)         # relpaths parsed
+    cache_hits: int = 0
+    wall_time_s: float = 0.0
+
+    @property
+    def files_analyzed(self) -> int:
+        return len(self.analyzed)
+
+    @property
+    def cache_hit_rate(self) -> float:
+        total = self.files_analyzed + self.cache_hits
+        return self.cache_hits / total if total else 0.0
 
     @property
     def errors(self):
@@ -296,6 +363,11 @@ class RunResult:
     def to_json(self) -> dict:
         return {
             "files_scanned": self.files_scanned,
+            "files_analyzed": self.files_analyzed,
+            "analyzed": sorted(self.analyzed),
+            "cache_hits": self.cache_hits,
+            "cache_hit_rate": round(self.cache_hit_rate, 4),
+            "wall_time_s": round(self.wall_time_s, 3),
             "counts": {
                 "findings": len(self.findings),
                 "errors": len(self.errors),
@@ -445,5 +517,7 @@ def run(root: str, paths, rules, exclude=(), baseline_entries=()) -> RunResult:
         if key not in matched_baseline:
             result.stale_baseline.extend(entries)
 
+    result.analyzed = [ctx.relpath for ctx in runctx.files] + [
+        os.path.relpath(p, root).replace(os.sep, "/") for p, _e in runctx.failed]
     result.findings.sort(key=lambda f: (f.relpath, f.line, f.rule))
     return result

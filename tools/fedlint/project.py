@@ -1,0 +1,606 @@
+"""Project graph + incremental engine (ISSUE 10).
+
+One pass over each parsed file produces a JSON-serializable **summary**:
+the module's dotted name, its project-local import edges, a symbol table of
+module/class string-and-int constants, the functions it defines, and every
+call site (caller scope, dotted callee, line). Summaries — together with
+each file's per-file rule findings, suppression table, and the per-rule
+facts of every :class:`~tools.fedlint.core.ProjectRule` — live in a
+content-hash cache (:mod:`tools.fedlint.cache`), so a warm run re-parses
+nothing and still runs every whole-program rule over the full fact set.
+
+Invalidation follows import edges: a changed file dirties itself plus its
+reverse import closure (everything that transitively imports it), because
+a file-scoped finding may depend on what it imports. Project rules are
+immune to staleness by construction — their ``finalize_project`` runs
+every time over fresh+cached facts.
+
+Unparseable files are never cached (ISSUE 10 satellite: a syntax error
+must not poison the cache) — they are re-analyzed each run and re-emit the
+``syntax-error`` finding until they parse.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import time
+
+from . import cache as cache_mod
+from .core import (
+    BARE_SUPPRESSION, SYNTAX_ERROR, FileContext, Finding, ProjectRule,
+    RunContext, RunResult, _Suppressions, _walk_and_dispatch, iter_py_files,
+)
+
+#: bump when the summary/cache layout changes — stale layouts re-analyze
+SUMMARY_VERSION = 1
+
+
+# --- one-pass summary collection --------------------------------------------
+
+def module_name(relpath: str) -> str:
+    """Dotted module for a repo-relative path: ``a/b/c.py`` -> ``a.b.c``,
+    ``a/b/__init__.py`` -> ``a.b``."""
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+def _resolve_relative(base_module: str, is_pkg: bool, level: int, target: str):
+    """Absolute dotted module for ``from <level dots><target> import ...``."""
+    parts = base_module.split(".")
+    if not is_pkg:
+        parts = parts[:-1]
+    if level > 1:
+        parts = parts[: len(parts) - (level - 1)]
+        if len(parts) < 0:
+            return None
+    prefix = ".".join(parts)
+    if target:
+        return f"{prefix}.{target}" if prefix else target
+    return prefix or None
+
+
+def collect_summary(ctx: FileContext) -> dict:
+    """The one-pass symbol table / import graph / call graph slice for one
+    parsed file. Everything is JSON-safe for the incremental cache."""
+    relpath = ctx.relpath
+    mod = module_name(relpath)
+    is_pkg = relpath.endswith("/__init__.py") or relpath == "__init__.py"
+
+    # parent links are normally recorded by the dispatch walk, but this
+    # function must also work on a freshly parsed FileContext (qualname and
+    # the module-level checks below all need them)
+    if not ctx.parents:
+        for p in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(p):
+                ctx.parents[child] = p
+
+    imports: set = set()          # dotted modules this file depends on
+    bindings: dict = {}           # local name -> "module" or "module:attr"
+    constants: dict = {}          # "NAME" / "Class.NAME" -> str|int value + line
+    functions: dict = {}          # qualname -> def line
+    classes: dict = {}            # class name -> [method names]
+    attr_types: dict = {}         # class -> {self attr -> ctor dotted name}
+    calls: list = []              # [scope_qualname, dotted_callee, line]
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imports.add(a.name)
+                bindings[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            target = node.module or ""
+            if node.level:
+                target = _resolve_relative(mod, is_pkg, node.level, target)
+                if target is None:
+                    continue
+            imports.add(target)
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                # "from pkg import sub" may bind a module; record both forms
+                bindings[a.asname or a.name] = f"{target}:{a.name}"
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            functions[ctx.qualname(node)] = node.lineno
+        elif isinstance(node, ast.ClassDef):
+            classes.setdefault(node.name, [])
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    classes[node.name].append(item.name)
+                elif isinstance(item, ast.Assign):
+                    val = item.value
+                    if isinstance(val, ast.Constant) and isinstance(
+                            val.value, (str, int)) and not isinstance(
+                            val.value, bool):
+                        for tgt in item.targets:
+                            if isinstance(tgt, ast.Name):
+                                constants[f"{node.name}.{tgt.id}"] = [
+                                    val.value, item.lineno]
+        elif isinstance(node, ast.Assign):
+            # module-level constants only (class-level handled above)
+            if ctx.parent(node) is ctx.tree and isinstance(
+                    node.value, ast.Constant) and isinstance(
+                    node.value.value, (str, int)) and not isinstance(
+                    node.value.value, bool):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        constants[tgt.id] = [node.value.value, node.lineno]
+            # self.attr = Ctor(...) — instance-attribute types, so rules can
+            # resolve self.attr.method() calls across files
+            elif isinstance(node.value, ast.Call):
+                ctor = _dotted(node.value.func)
+                if ctor:
+                    for tgt in node.targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            cls = ctx.enclosing_class(node)
+                            if cls is not None:
+                                attr_types.setdefault(
+                                    cls.name, {}).setdefault(tgt.attr, ctor)
+        elif isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name:
+                fn = ctx.enclosing_function(node)
+                scope = ctx.qualname(fn) if fn is not None else ""
+                calls.append([scope, name, node.lineno])
+
+    return {
+        "module": mod,
+        "imports": sorted(imports),
+        "bindings": bindings,
+        "constants": constants,
+        "functions": functions,
+        "classes": classes,
+        "attr_types": attr_types,
+        "calls": calls,
+    }
+
+
+def _dotted(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# --- the graph ---------------------------------------------------------------
+
+class ProjectGraph:
+    """Queryable view over every file summary in the scan scope."""
+
+    def __init__(self, root: str, summaries: dict):
+        self.root = root
+        self.files = summaries                      # relpath -> summary
+        self._by_module = {s["module"]: rp for rp, s in summaries.items()}
+        # import edges restricted to project-local modules
+        self.imports: dict = {}                     # relpath -> set(relpath)
+        for rp, s in summaries.items():
+            deps = set()
+            for m in s["imports"]:
+                dep = self.relpath_of(m)
+                if dep and dep != rp:
+                    deps.add(dep)
+            # `from pkg import sub` records module "pkg" but binds the
+            # submodule — follow those bindings so the edge lands on pkg/sub
+            for bound in s["bindings"].values():
+                if ":" in bound:
+                    modpart, attr = bound.split(":", 1)
+                    dep = self._by_module.get(f"{modpart}.{attr}")
+                    if dep and dep != rp:
+                        deps.add(dep)
+            self.imports[rp] = deps
+        self.reverse_imports: dict = {rp: set() for rp in summaries}
+        for rp, deps in self.imports.items():
+            for dep in deps:
+                self.reverse_imports.setdefault(dep, set()).add(rp)
+
+    def relpath_of(self, module: str):
+        """relpath for a dotted module, tolerating ``from pkg import name``
+        edges that point at an attribute of a module."""
+        while module:
+            rp = self._by_module.get(module)
+            if rp:
+                return rp
+            if "." not in module:
+                return None
+            module = module.rsplit(".", 1)[0]
+        return None
+
+    def reverse_closure(self, relpaths) -> set:
+        """``relpaths`` plus everything that transitively imports them."""
+        seen = set()
+        stack = [rp for rp in relpaths]
+        while stack:
+            rp = stack.pop()
+            if rp in seen:
+                continue
+            seen.add(rp)
+            stack.extend(self.reverse_imports.get(rp, ()))
+        return seen
+
+    # --- symbol / call resolution ---------------------------------------
+    def binding_target(self, relpath: str, name: str):
+        """Resolve a local name to ("module", None) or ("module", "attr")."""
+        s = self.files.get(relpath)
+        if not s:
+            return None
+        bound = s["bindings"].get(name)
+        if bound is None:
+            return None
+        if ":" in bound:
+            modpart, attr = bound.split(":", 1)
+            # `from pkg import sub` where pkg.sub is itself a module
+            if f"{modpart}.{attr}" in self._by_module:
+                return (f"{modpart}.{attr}", None)
+            return (modpart, attr)
+        return (bound, None)
+
+    def constant(self, relpath: str, dotted: str):
+        """Value of a possibly-qualified constant reference as seen from
+        ``relpath``: ``NAME``, ``Class.NAME``, ``alias.NAME``,
+        ``alias.Class.NAME`` — following one import hop."""
+        s = self.files.get(relpath)
+        if not s:
+            return None
+        hit = s["constants"].get(dotted)
+        if hit is not None:
+            return hit[0]
+        head, _, rest = dotted.partition(".")
+        if not rest:
+            # bare name bound by `from mod import NAME`
+            target = self.binding_target(relpath, dotted)
+            if target is None or target[1] is None:
+                return None
+            dep = self.relpath_of(target[0])
+            if dep is None:
+                return None
+            hit = self.files[dep]["constants"].get(target[1])
+            return hit[0] if hit is not None else None
+        target = self.binding_target(relpath, head)
+        if target is None:
+            return None
+        module, attr = target
+        dep = self.relpath_of(module)
+        if dep is None:
+            return None
+        remote = f"{attr}.{rest}" if attr else rest
+        hit = self.files[dep]["constants"].get(remote)
+        if hit is None and attr is None:
+            hit = self.files[dep]["constants"].get(rest)
+        return hit[0] if hit is not None else None
+
+    def resolve_call(self, relpath: str, scope: str, dotted: str):
+        """Map a dotted callee as written in ``relpath`` to a project
+        function: returns (relpath, qualname) or None.
+
+        Handles: bare local names, ``self.method`` (within ``scope``'s
+        class), ``mod.func`` / ``alias.func`` via imports, and
+        ``from mod import func`` bindings.
+        """
+        s = self.files.get(relpath)
+        if not s:
+            return None
+        head, _, rest = dotted.partition(".")
+        if head == "self" and rest:
+            cls = scope.split(".")[0] if "." in scope else None
+            if cls is None:
+                return None
+            if "." not in rest:
+                if rest in (s["classes"].get(cls) or ()):
+                    return (relpath, f"{cls}.{rest}")
+                return None
+            # self.attr.method() — follow the instance-attribute type
+            attr, _, meth = rest.partition(".")
+            if "." in meth:
+                return None
+            ctor = (s.get("attr_types", {}).get(cls) or {}).get(attr)
+            if not ctor:
+                return None
+            target = self.resolve_class(relpath, ctor)
+            if target is None:
+                return None
+            dep, cls_name = target
+            if meth in (self.files[dep]["classes"].get(cls_name) or ()):
+                return (dep, f"{cls_name}.{meth}")
+            return None
+        if not rest:
+            if dotted in s["functions"]:
+                return (relpath, dotted)
+            target = self.binding_target(relpath, dotted)
+            if target:
+                module, attr = target
+                dep = self.relpath_of(module)
+                if dep and attr and attr in self.files[dep]["functions"]:
+                    return (dep, attr)
+            return None
+        target = self.binding_target(relpath, head)
+        if target is None:
+            return None
+        module, attr = target
+        dep = self.relpath_of(module)
+        if dep is None:
+            return None
+        name = f"{attr}.{rest}" if attr else rest
+        if name in self.files[dep]["functions"]:
+            return (dep, name)
+        return None
+
+    def resolve_class(self, relpath: str, dotted: str):
+        """(relpath, class_name) for a class reference as seen from
+        ``relpath`` — local class or one import hop."""
+        s = self.files.get(relpath)
+        if not s:
+            return None
+        if "." not in dotted:
+            if dotted in s["classes"]:
+                return (relpath, dotted)
+            target = self.binding_target(relpath, dotted)
+            if target:
+                module, attr = target
+                dep = self.relpath_of(module)
+                if dep and attr and attr in self.files[dep]["classes"]:
+                    return (dep, attr)
+            return None
+        head, _, rest = dotted.partition(".")
+        target = self.binding_target(relpath, head)
+        if target is None or "." in rest:
+            return None
+        module, attr = target
+        dep = self.relpath_of(module)
+        if dep is None or attr is not None:
+            return None
+        if rest in self.files[dep]["classes"]:
+            return (dep, rest)
+        return None
+
+    def resolve_symbol(self, relpath: str, dotted: str):
+        """(relpath, name) for any module-level symbol reference — unlike
+        :meth:`resolve_call` the target need not be a def (jitted callables
+        are often assignments: ``step = jax.jit(fn, donate_argnums=0)``)."""
+        s = self.files.get(relpath)
+        if not s:
+            return None
+        head, _, rest = dotted.partition(".")
+        if not rest:
+            target = self.binding_target(relpath, dotted)
+            if target and target[1]:
+                dep = self.relpath_of(target[0])
+                if dep:
+                    return (dep, target[1])
+            return (relpath, dotted)
+        if "." in rest:
+            return None
+        target = self.binding_target(relpath, head)
+        if target is None:
+            return None
+        module, attr = target
+        dep = self.relpath_of(module)
+        if dep is None:
+            return None
+        return (dep, f"{attr}.{rest}" if attr else rest)
+
+
+# --- the incremental engine --------------------------------------------------
+
+def _sha1(data: bytes) -> str:
+    return hashlib.sha1(data).hexdigest()
+
+
+def _engine_sig(rules) -> str:
+    basis = ",".join(sorted(r.id for r in rules)) + f"|v{SUMMARY_VERSION}"
+    return _sha1(basis.encode())
+
+
+def _finding_from_json(root: str, doc: dict) -> Finding:
+    relpath = doc["path"]
+    return Finding(
+        rule=doc["rule"], severity=doc["severity"],
+        path=os.path.join(root, *relpath.split("/")), relpath=relpath,
+        line=doc["line"], col=doc.get("col", 0), message=doc["message"],
+        line_text=doc.get("line_text", ""))
+
+
+def run_project(root: str, paths, rules, exclude=(), baseline_entries=(),
+                cache_path=None, changed_scope=None) -> RunResult:
+    """Project-graph engine: incremental per-file analysis + whole-program
+    rules over the merged fact set.
+
+    ``cache_path``: absolute path of the incremental cache (None disables
+    caching — every file is parsed, which is exactly what the legacy shims
+    want for their synthetic trees).
+    ``changed_scope``: optional set of relpaths; when given, reported
+    findings are filtered to those files (``--changed`` mode). Analysis
+    scope is unaffected — cache hits make the full pass cheap.
+    """
+    t0 = time.perf_counter()
+    root = os.path.abspath(root)
+    runctx = RunContext(root=root)
+    result = RunResult()
+
+    file_rules = [r for r in rules if not getattr(r, "project", False)]
+    project_rules = [r for r in rules if getattr(r, "project", False)]
+
+    dispatch: dict = {}
+    for rule in file_rules:
+        for nt in rule.node_types:
+            dispatch.setdefault(nt, []).append(rule)
+
+    # --- discovery + hashing ---
+    abs_paths = list(iter_py_files(root, paths, exclude))
+    by_rel: dict = {}
+    hashes: dict = {}
+    sources: dict = {}
+    for path in abs_paths:
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        by_rel[relpath] = path
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            continue
+        hashes[relpath] = _sha1(data)
+        sources[relpath] = data
+    result.files_scanned = len(hashes)
+
+    # --- cache + dirty set ---
+    sig = _engine_sig(rules)
+    cached = cache_mod.load(cache_path, sig) if cache_path else {}
+    cached = {rp: e for rp, e in cached.items() if rp in hashes}
+    changed = {rp for rp in hashes
+               if rp not in cached or cached[rp].get("hash") != hashes[rp]}
+    if changed and cached:
+        old_graph = ProjectGraph(
+            root, {rp: e["summary"] for rp, e in cached.items()})
+        dirty = old_graph.reverse_closure(changed) | changed
+    else:
+        dirty = set(changed)
+    dirty &= set(hashes)
+
+    entries: dict = {}          # relpath -> cache entry (fresh or reused)
+    raw: list = []              # Finding (pre-suppression)
+    suppressions: dict = {}     # relpath -> _Suppressions
+
+    for relpath in sorted(hashes):
+        path = by_rel[relpath]
+        if relpath not in dirty and relpath in cached:
+            entry = cached[relpath]
+            entries[relpath] = entry
+            suppressions[relpath] = _Suppressions.from_json(
+                entry["suppressions"])
+            raw.extend(_finding_from_json(root, d) for d in entry["findings"])
+            result.cache_hits += 1
+            continue
+
+        result.analyzed.append(relpath)
+        try:
+            source = sources[relpath].decode("utf-8")
+            tree = ast.parse(source, filename=path)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            lineno = getattr(e, "lineno", 0) or 0
+            msg = getattr(e, "msg", None) or str(e)
+            raw.append(Finding(
+                rule=SYNTAX_ERROR, severity="error", path=path,
+                relpath=relpath, line=lineno,
+                col=getattr(e, "offset", 0) or 0,
+                message=f"unparseable: {msg}"))
+            runctx.failed.append((path, e))
+            continue  # never cached: re-analyzed until it parses
+
+        ctx = FileContext(root, path, source, tree)
+        runctx.files.append(ctx)
+        suppressions[relpath] = ctx.suppressions
+
+        file_findings: list = []
+        active = [r for r in file_rules if r.applies_to(relpath)]
+        for rule in active:
+            begin = getattr(rule, "begin_file", None)
+            if begin is not None:
+                begin(ctx)
+        file_dispatch = {
+            nt: [r for r in rs if r in active] for nt, rs in dispatch.items()}
+        sink: list = []
+        _walk_and_dispatch(ctx, file_dispatch, sink)
+        file_findings.extend(f for _r, f in sink)
+        for rule in active:
+            file_findings.extend(rule.check_file(ctx) or ())
+
+        facts: dict = {}
+        for rule in project_rules:
+            if not rule.applies_to(relpath):
+                continue
+            fact = rule.collect(ctx)
+            if fact:
+                facts[rule.id] = fact
+
+        entries[relpath] = {
+            "hash": hashes[relpath],
+            "summary": collect_summary(ctx),
+            "findings": [f.to_json() for f in file_findings],
+            "suppressions": ctx.suppressions.to_json(),
+            "facts": facts,
+        }
+        raw.extend(file_findings)
+
+    # --- whole-program pass (always runs, over fresh + cached facts) ---
+    graph = ProjectGraph(
+        root, {rp: e["summary"] for rp, e in entries.items()})
+    result.graph = graph
+    for rule in project_rules:
+        facts = {rp: e["facts"][rule.id] for rp, e in entries.items()
+                 if rule.id in e.get("facts", {})}
+        for f in rule.finalize_project(graph, facts) or ():
+            raw.append(f)
+    for rule in file_rules:
+        for f in rule.finalize(runctx) or ():
+            raw.append(f)
+
+    # bare suppression pragmas are findings every run, cached or not
+    for relpath, sup in suppressions.items():
+        for lineno in sup.bare_lines:
+            raw.append(Finding(
+                rule=BARE_SUPPRESSION, severity="error",
+                path=by_rel[relpath], relpath=relpath, line=lineno, col=0,
+                message="suppression pragma without a reason — write "
+                        "`# fedlint: disable=<rule> <why it is safe>`"))
+
+    # --- suppression + baseline + scope filters ---
+    baseline_keys: dict = {}
+    for e in baseline_entries or ():
+        baseline_keys.setdefault(
+            (e.get("rule"), e.get("path"), e.get("fingerprint")), []).append(e)
+    matched_baseline = set()
+
+    for finding in raw:
+        sup = suppressions.get(finding.relpath)
+        if (sup is not None and finding.rule != BARE_SUPPRESSION
+                and sup.matches(finding.rule, finding.line)):
+            result.suppressed.append(finding)
+            continue
+        key = (finding.rule, finding.relpath, finding.fingerprint)
+        if key in baseline_keys:
+            matched_baseline.add(key)
+            result.baselined.append(finding)
+            continue
+        if changed_scope is not None and finding.relpath not in changed_scope:
+            continue
+        result.findings.append(finding)
+
+    for key, bl in baseline_keys.items():
+        if key not in matched_baseline:
+            result.stale_baseline.extend(bl)
+
+    if cache_path:
+        cache_mod.save(cache_path, sig, entries)
+
+    result.findings.sort(key=lambda f: (f.relpath, f.line, f.rule))
+    result.wall_time_s = time.perf_counter() - t0
+    return result
+
+
+def changed_files(root: str) -> set:
+    """Repo-relative paths of files changed vs HEAD (staged, unstaged, and
+    untracked) — the ``--changed`` scope seed."""
+    import subprocess
+
+    out = set()
+    for args in (["git", "-C", root, "diff", "--name-only", "HEAD"],
+                 ["git", "-C", root, "ls-files", "--others",
+                  "--exclude-standard"]):
+        try:
+            proc = subprocess.run(args, capture_output=True, text=True,
+                                  timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return set()
+        if proc.returncode != 0:
+            return set()
+        out |= {ln.strip() for ln in proc.stdout.splitlines() if ln.strip()}
+    return {p for p in out if p.endswith(".py")}

@@ -4,7 +4,12 @@ from __future__ import annotations
 
 from .rules.donation import DonationMisuseRule
 from .rules.host_sync import HostSyncRule
+from .rules.interproc import (InterprocDonationRule, InterprocHostSyncRule,
+                              InterprocRetraceRule)
+from .rules.lock_graph import LockGraphRule
 from .rules.locking import LockDisciplineRule
+from .rules.metrics import MetricRegistryRule
+from .rules.protocol import ProtocolContractRule
 from .rules.resilience import BareSleepRule, OrbaxContainmentRule
 from .rules.retrace import RetraceRiskRule
 from .rules.serving import HotSpanRule
@@ -28,6 +33,13 @@ _RULE_CLASSES = (
     HostSyncRule,
     DonationMisuseRule,
     LockDisciplineRule,
+    # whole-program rules over the cached project graph (ISSUE 10)
+    ProtocolContractRule,
+    LockGraphRule,
+    InterprocDonationRule,
+    InterprocHostSyncRule,
+    InterprocRetraceRule,
+    MetricRegistryRule,
 )
 
 

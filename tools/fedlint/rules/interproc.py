@@ -1,0 +1,344 @@
+"""Interprocedural JAX rules: donated-buffer and traced-ness facts
+propagated one call-graph hop through project-local helpers (ISSUE 10).
+
+The per-file rules (``donation-misuse``, ``host-sync``, ``retrace-risk``)
+stop at function boundaries; both PR-9 donation bugs crossed one. These
+three rules share a fact vocabulary collected per file and joined over the
+project call graph:
+
+* ``interproc-donation`` — a function that passes its argument into a
+  donated ``jax.jit`` position is itself a donor; a function that returns
+  ``jax.device_get(arg)`` / ``np.asarray(arg)`` makes a *view* of its
+  argument. At any call site (same file or not): reading a name after a
+  donor call consumed it — or reading a view after its base was donated —
+  is the PR-9 bug, even when the fold and the read are two functions
+  apart. Rebinding (``state = fold(state)``) clears the donated name but
+  NOT views made from the old value.
+* ``interproc-host-sync`` — a helper whose body forces a host sync
+  (``.item()``, ``.block_until_ready()``, ``device_get``, ``np.asarray``)
+  called from a loop in a configured hot module is a hidden per-iteration
+  sync the per-file rule cannot see.
+* ``interproc-retrace`` — a helper that branches on a bare parameter
+  (``if flag:``) called from inside a jitted function turns the branch
+  into a tracer boolean: a concretization error at best, a silent
+  per-value retrace behind ``static_argnums`` at worst.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import ProjectRule
+from ._util import const_int_tuple, dotted, is_jit_callable, matches_file
+
+_SYNC_ATTRS = ("item", "block_until_ready")
+_SYNC_CALLS = ("jax.device_get", "device_get", "np.asarray", "numpy.asarray",
+               "np.array", "numpy.array")
+_VIEW_CALLS = _SYNC_CALLS
+
+
+def _jit_donation(call):
+    """Donated positions for a ``jax.jit(...)`` call, or None."""
+    if not (isinstance(call, ast.Call) and is_jit_callable(call.func)):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return const_int_tuple(kw.value) or ()
+        if kw.arg == "donate_argnames":
+            return ()   # positional mapping unknown; still a donor marker
+    return None
+
+
+def _fn_params(fn):
+    return [a.arg for a in fn.args.posonlyargs + fn.args.args
+            if a.arg != "self"]
+
+
+class _InterprocBase(ProjectRule):
+    """Shared per-file fact collection for the three interproc rules."""
+
+    def collect(self, ctx):
+        donors = {}          # name/qualname -> [donated positions]
+        view_fns = {}        # qualname -> [param positions returned as views]
+        sync_fns = {}        # qualname -> idiom string
+        branchy = {}         # qualname -> [param position, line]
+        jitted_fns = {}      # qualname -> static positions (decorated defs)
+        fn_events = {}       # qualname -> ordered events (donation sim)
+        fn_params = {}       # qualname -> positional params
+        hot_calls = []       # calls inside hot-module loops
+
+        # module-level donors: NAME = jax.jit(fn, donate_argnums=...)
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                pos = _jit_donation(node.value)
+                if pos is not None:
+                    donors[node.targets[0].id] = list(pos)
+
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            qual = ctx.qualname(fn)
+            params = _fn_params(fn)
+            fn_params[qual] = params
+
+            for dec in fn.decorator_list:
+                pos = _jit_donation(dec)
+                if pos is not None:
+                    donors[qual] = list(pos)
+                if is_jit_callable(dec) or (
+                        isinstance(dec, ast.Call)
+                        and is_jit_callable(dec.func)):
+                    static = ()
+                    if isinstance(dec, ast.Call):
+                        for kw in dec.keywords:
+                            if kw.arg == "static_argnums":
+                                static = const_int_tuple(kw.value) or ()
+                    jitted_fns[qual] = list(static)
+
+            events = []
+            for node in ast.walk(fn):
+                if ctx.enclosing_function(node) is not fn:
+                    continue
+                if isinstance(node, ast.Call):
+                    name = dotted(node.func)
+                    if not name:
+                        continue
+                    if (isinstance(node.func, ast.Attribute)
+                            and node.func.attr in _SYNC_ATTRS):
+                        sync_fns.setdefault(qual, f".{node.func.attr}()")
+                    if name in _SYNC_CALLS or name.endswith(".device_get"):
+                        sync_fns.setdefault(qual, f"{name}()")
+                    args = [a.id if isinstance(a, ast.Name) else None
+                            for a in node.args]
+                    tgt = None
+                    parent = ctx.parent(node)
+                    if (isinstance(parent, ast.Assign)
+                            and len(parent.targets) == 1
+                            and isinstance(parent.targets[0], ast.Name)):
+                        tgt = parent.targets[0].id
+                    events.append(["call", node.lineno, node.col_offset,
+                                   name, args, tgt,
+                                   ctx.raw_line(node.lineno)])
+                    if ctx.in_loop_strict(node):
+                        hot_calls.append([name, qual, node.lineno,
+                                          ctx.raw_line(node.lineno)])
+                elif isinstance(node, ast.Return) and node.value is not None:
+                    v = node.value
+                    if isinstance(v, ast.Call):
+                        name = dotted(v.func)
+                        if (name in _VIEW_CALLS
+                                or name.endswith(".device_get")) and v.args \
+                                and isinstance(v.args[0], ast.Name) \
+                                and v.args[0].id in params:
+                            view_fns.setdefault(qual, []).append(
+                                params.index(v.args[0].id))
+                elif isinstance(node, ast.If):
+                    test = node.test
+                    if isinstance(test, ast.UnaryOp) and isinstance(
+                            test.op, ast.Not):
+                        test = test.operand
+                    if isinstance(test, ast.Name) and test.id in params:
+                        branchy.setdefault(
+                            qual, [params.index(test.id), node.lineno])
+                elif isinstance(node, ast.Name):
+                    parent = ctx.parent(node)
+                    if isinstance(node.ctx, ast.Load):
+                        # direct call args are handled by the call event
+                        if isinstance(parent, ast.Call) \
+                                and node in parent.args:
+                            continue
+                        events.append(["load", node.lineno, node.col_offset,
+                                       node.id, ctx.raw_line(node.lineno)])
+                    elif isinstance(node.ctx, ast.Store):
+                        events.append(["store", node.lineno, node.col_offset,
+                                       node.id])
+            events.sort(key=lambda e: (e[1], e[2]))
+            if events:
+                fn_events[qual] = events
+
+        if not (donors or view_fns or sync_fns or branchy or jitted_fns
+                or hot_calls or fn_events):
+            return None
+        return {"donors": donors, "views": view_fns, "syncs": sync_fns,
+                "branchy": branchy, "jitted": jitted_fns,
+                "events": fn_events, "params": fn_params,
+                "hot_calls": hot_calls}
+
+
+class InterprocDonationRule(_InterprocBase):
+    id = "interproc-donation"
+    severity = "error"
+    description = ("buffer read after a call chain donated it (PR-9 "
+                   "device_get-view-then-donate across functions/files)")
+
+    def finalize_project(self, graph, facts):
+        donors = {}     # (rel, name) -> donated positions
+        views = {}      # (rel, qual) -> view param positions
+        for rel, f in facts.items():
+            for name, pos in (f.get("donors") or {}).items():
+                donors[(rel, name)] = pos
+            for qual, pos in (f.get("views") or {}).items():
+                views[(rel, qual)] = pos
+        # one-hop propagation: a helper passing its param into a donated
+        # position is itself a donor at that param's position
+        for rel, f in facts.items():
+            for qual, events in (f.get("events") or {}).items():
+                params = (f.get("params") or {}).get(qual) or []
+                for e in events:
+                    if e[0] != "call":
+                        continue
+                    target = graph.resolve_symbol(rel, e[3])
+                    pos = donors.get(target) if target else None
+                    if not pos:
+                        continue
+                    mine = sorted(
+                        params.index(a) for i, a in enumerate(e[4])
+                        if i in pos and a in params)
+                    if mine and (rel, qual) not in donors:
+                        donors[(rel, qual)] = mine
+
+        for rel, f in sorted(facts.items()):
+            for qual, events in sorted((f.get("events") or {}).items()):
+                yield from self._simulate(
+                    graph, rel, qual, events, donors, views)
+
+    def _simulate(self, graph, rel, qual, events, donors, views):
+        donated = {}     # name -> (donor text, line)
+        view_of = {}     # view name -> base name
+        stale = {}       # view name -> (donor text, line)
+        for e in events:
+            if e[0] == "store":
+                _k, _l, _c, name = e
+                donated.pop(name, None)
+                stale.pop(name, None)
+                view_of.pop(name, None)
+            elif e[0] == "load":
+                _k, line, _c, name, text = e
+                if name in donated:
+                    dtext, dline = donated[name]
+                    yield self.fact_finding(
+                        graph.root, rel, line,
+                        f"`{name}` read after being donated by "
+                        f"`{dtext}` (line {dline}) — the buffer was "
+                        "surrendered to XLA; reorder the read or drop the "
+                        "donation", text)
+                elif name in stale:
+                    dtext, dline = stale[name]
+                    yield self.fact_finding(
+                        graph.root, rel, line,
+                        f"`{name}` is a device_get/asarray view whose base "
+                        f"was later donated by `{dtext}` (line {dline}) — "
+                        "the view may alias the surrendered buffer; copy "
+                        "before the donating call", text)
+            elif e[0] == "call":
+                _k, line, _c, name, args, tgt, text = e
+                target = graph.resolve_symbol(rel, name)
+                dpos = donors.get(target) if target else None
+                vtarget = graph.resolve_call(rel, qual, name)
+                vpos = views.get(vtarget) if vtarget else None
+                for a in args:
+                    if a and a in donated:
+                        dtext, dline = donated[a]
+                        yield self.fact_finding(
+                            graph.root, rel, line,
+                            f"`{a}` passed to `{name}` after being donated "
+                            f"by `{dtext}` (line {dline})", text)
+                if dpos:
+                    for i in dpos:
+                        if i < len(args) and args[i]:
+                            base = args[i]
+                            donated[base] = (name, line)
+                            for v, b in view_of.items():
+                                if b == base:
+                                    stale[v] = (name, line)
+                if tgt:
+                    donated.pop(tgt, None)
+                    stale.pop(tgt, None)
+                    view_of.pop(tgt, None)
+                    if vpos:
+                        for i in vpos:
+                            if i < len(args) and args[i]:
+                                view_of[tgt] = args[i]
+
+
+class InterprocHostSyncRule(_InterprocBase):
+    id = "interproc-host-sync"
+    severity = "error"
+    description = ("hot-module loop calls a project helper that forces a "
+                   "host sync (.item()/device_get) every iteration")
+
+    def __init__(self):
+        self.hot_modules: tuple = ()
+
+    def configure(self, options):
+        mods = options.get("hot-modules")
+        if mods:
+            self.hot_modules = tuple(mods)
+
+    def _is_hot(self, relpath):
+        return any(matches_file(relpath, m) for m in self.hot_modules)
+
+    def collect(self, ctx):
+        # every file contributes sync facts; only hot modules need call sites
+        return super().collect(ctx)
+
+    def finalize_project(self, graph, facts):
+        syncs = {}
+        for rel, f in facts.items():
+            for qual, idiom in (f.get("syncs") or {}).items():
+                syncs[(rel, qual)] = idiom
+        for rel, f in sorted(facts.items()):
+            if not self._is_hot(rel):
+                continue
+            for name, scope, line, text in f.get("hot_calls") or ():
+                target = graph.resolve_call(rel, scope, name)
+                if target is None or target == (rel, scope):
+                    continue
+                idiom = syncs.get(target)
+                if idiom is None:
+                    continue
+                drel, dqual = target
+                yield self.fact_finding(
+                    graph.root, rel, line,
+                    f"per-iteration call to {dqual}() ({drel}) which forces "
+                    f"a host sync via {idiom} — hoist it out of the loop or "
+                    "batch the transfer; a hidden sync per step is how the "
+                    "r05 decode collapse happened", text)
+
+
+class InterprocRetraceRule(_InterprocBase):
+    id = "interproc-retrace"
+    severity = "error"
+    description = ("jitted function calls a helper that branches on a bare "
+                   "argument — concretization error or silent retrace")
+
+    def finalize_project(self, graph, facts):
+        branchy = {}
+        for rel, f in facts.items():
+            for qual, info in (f.get("branchy") or {}).items():
+                branchy[(rel, qual)] = info
+        for rel, f in sorted(facts.items()):
+            jitted = f.get("jitted") or {}
+            for qual, static in sorted(jitted.items()):
+                params = (f.get("params") or {}).get(qual) or []
+                static_names = {params[i] for i in static if i < len(params)}
+                for e in (f.get("events") or {}).get(qual) or ():
+                    if e[0] != "call":
+                        continue
+                    _k, line, _c, name, args, _tgt, text = e
+                    target = graph.resolve_call(rel, qual, name)
+                    info = branchy.get(target) if target else None
+                    if info is None:
+                        continue
+                    pos, bline = info
+                    if pos < len(args) and args[pos] \
+                            and args[pos] in static_names:
+                        continue   # branch arg is static — legal
+                    drel, dqual = target
+                    yield self.fact_finding(
+                        graph.root, rel, line,
+                        f"jitted {qual}() calls {dqual}() ({drel}:{bline}) "
+                        "which branches on its bare argument — under trace "
+                        "that boolean is a tracer (error) or forces a "
+                        "retrace; use lax.cond or mark the arg static", text)

@@ -1,0 +1,396 @@
+"""``lock-graph``: cross-file lock-acquisition-order cycles (ISSUE 10).
+
+The PR-5 ``/statusz`` deadlock was exactly this shape: the server manager
+held its round lock and called ``statusz.render()``, which took the
+sections lock and — in the buggy version — invoked registered section
+callbacks *under* it; a callback took the round lock back. Two files,
+opposite orders, no single-file rule could see it.
+
+This rule builds the whole-program lock graph:
+
+* **lock identity** — ``self._lock`` in class ``C`` of module ``M`` is the
+  node ``M:C._lock``; module-level locks are ``M:_LOCK``.
+  ``self._cv = threading.Condition(self._lock)`` canonicalizes to the
+  wrapped lock (holding the condition IS holding the lock).
+* **edges** — lock A → lock B when code holding A acquires B: directly
+  nested ``with`` blocks, calls (resolved through the project call graph,
+  ``self.obj.method()`` included, up to three hops deep), and **callback
+  registries**: when a function invokes callables iterated out of a
+  container that a registrar method stores its parameter into (the
+  statusz section registry, comm-handler maps), every callback passed at a
+  registration site is a potential callee at the invocation site.
+* **finding** — one per strongly-connected component with a cycle, with
+  file:line witnesses for each edge.
+
+A deliberate ordering (e.g. a leaf lock never held across calls) gets
+``# fedlint: disable=lock-graph <reason>`` on the witness line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import ProjectRule
+from ._util import dotted
+
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition")
+_MAX_DEPTH = 3
+
+
+def _is_lock_ctor(call) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _LOCK_FACTORIES:
+        return isinstance(f.value, ast.Name) and f.value.id == "threading"
+    return isinstance(f, ast.Name) and f.id in _LOCK_FACTORIES
+
+
+def _self_attr(node):
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class LockGraphRule(ProjectRule):
+    id = "lock-graph"
+    severity = "error"
+    description = ("cross-file lock-acquisition-order cycle (two code paths "
+                   "take the same locks in opposite orders)")
+
+    # ------------------------------------------------------------------
+    def collect(self, ctx):
+        # lock ids use an '@' placeholder for this module; finalize rewrites
+        # it to the dotted module name so identities are repo-global
+        # class -> {attr -> canonical lock attr} (Condition aliases folded)
+        lock_attrs, aliases = {}, {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                attrs, alias = set(), {}
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) and _is_lock_ctor(sub.value):
+                        for tgt in sub.targets:
+                            attr = _self_attr(tgt)
+                            if attr:
+                                attrs.add(attr)
+                                if sub.value.args:
+                                    inner = _self_attr(sub.value.args[0])
+                                    if inner:
+                                        alias[attr] = inner
+                if attrs:
+                    lock_attrs[node.name] = attrs
+                    aliases[node.name] = alias
+        module_locks = set()
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        module_locks.add(tgt.id)
+
+        def canon(cls, attr):
+            amap = aliases.get(cls, {})
+            seen = set()
+            while attr in amap and attr not in seen:
+                seen.add(attr)
+                attr = amap[attr]
+            return attr
+
+        def lock_id(node, cls):
+            """Lock id for a with-item / reference, '@' = this module."""
+            attr = _self_attr(node)
+            if attr is not None and cls and attr in (
+                    set(lock_attrs.get(cls, ())) | set(aliases.get(cls, ()))):
+                return f"@:{cls}.{canon(cls, attr)}"
+            if isinstance(node, ast.Name) and node.id in module_locks:
+                return f"@:{node.id}"
+            return None
+
+        def_names = {n.name for n in ast.walk(ctx.tree)
+                     if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+        functions = {}
+        registrars = {}
+        invocations = []
+        register_calls = []
+
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            qual = ctx.qualname(fn)
+            cls_node = ctx.enclosing_class(fn)
+            cls = cls_node.name if cls_node is not None else None
+            params = [a.arg for a in fn.args.args if a.arg != "self"]
+
+            def held_at(node):
+                out = []
+                for anc in ctx.ancestors(node):
+                    if anc is fn:
+                        break
+                    if isinstance(anc, (ast.With, ast.AsyncWith)):
+                        for item in anc.items:
+                            lid = lock_id(item.context_expr, cls)
+                            if lid:
+                                out.append(lid)
+                return out
+
+            acquires, under, calls = [], {}, []
+            container_names = {}   # loop/comprehension names -> container attr
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        lid = lock_id(item.context_expr, cls)
+                        if lid is None:
+                            continue
+                        rec = [lid, node.lineno, ctx.raw_line(node.lineno)]
+                        acquires.append(rec)
+                        for h in held_at(node):
+                            under.setdefault(h, {"locks": [], "calls": []})[
+                                "locks"].append(rec)
+                elif isinstance(node, ast.Call):
+                    name = dotted(node.func)
+                    if not name:
+                        continue
+                    if name.endswith("__exit__") or name.endswith("__enter__"):
+                        continue
+                    rec = [name, node.lineno, ctx.raw_line(node.lineno)]
+                    calls.append(rec)
+                    for h in held_at(node):
+                        under.setdefault(h, {"locks": [], "calls": []})[
+                            "calls"].append(rec)
+                    # register-site: a call passing a method reference or a
+                    # locally-defined function, resolved against registrars
+                    # at finalize (plain data args don't count — keeps the
+                    # fact tables small)
+                    cb_args = [
+                        d if d and ("." in d or d in def_names) else ""
+                        for d in (dotted(a) for a in node.args)]
+                    if any(cb_args):
+                        register_calls.append(
+                            [name, cb_args, qual, node.lineno])
+
+            def container_of(node):
+                """'@:Cls.attr' for self.attr, '@:name' for a bare name."""
+                attr = _self_attr(node)
+                if attr and cls:
+                    return f"@:{cls}.{attr}"
+                if isinstance(node, ast.Name):
+                    return f"@:{node.id}"
+                return None
+
+            # callback-container plumbing
+            for node in ast.walk(fn):
+                # registrar: <container>[k] = <param> / .append(<param>)
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Subscript):
+                            container = container_of(tgt.value)
+                            if (container and isinstance(node.value, ast.Name)
+                                    and node.value.id in params):
+                                registrars[qual] = [
+                                    container, params.index(node.value.id)]
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    if (isinstance(f, ast.Attribute) and f.attr == "append"
+                            and container_of(f.value) and node.args
+                            and isinstance(node.args[0], ast.Name)
+                            and node.args[0].id in params):
+                        registrars[qual] = [
+                            container_of(f.value),
+                            params.index(node.args[0].id)]
+                # invoker: names bound by iterating the container
+                gens = ()
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    gens = ((node.target, node.iter),)
+                elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                       ast.GeneratorExp)):
+                    gens = tuple((g.target, g.iter) for g in node.generators)
+                for target, it in gens:
+                    src = it.func.value if (
+                        isinstance(it, ast.Call)
+                        and isinstance(it.func, ast.Attribute)) else it
+                    container = container_of(src)
+                    if not container:
+                        continue
+                    names = [n.id for n in ast.walk(target)
+                             if isinstance(n, ast.Name)]
+                    for n in names:
+                        container_names[n] = container
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in container_names):
+                    invocations.append([
+                        container_names[node.func.id], node.lineno,
+                        ctx.raw_line(node.lineno), held_at(node)])
+                # container[k]() direct dispatch
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Subscript)):
+                    container = container_of(node.func.value)
+                    if container:
+                        invocations.append([
+                            container, node.lineno,
+                            ctx.raw_line(node.lineno), held_at(node)])
+
+            if acquires or under or calls:
+                functions[qual] = {"acquires": acquires, "under": under,
+                                   "calls": calls}
+            if invocations:
+                functions.setdefault(qual, {"acquires": [], "under": {},
+                                            "calls": []})
+                functions[qual]["invocations"] = invocations
+                invocations = []
+
+        if not (functions or registrars or register_calls):
+            return None
+        return {"functions": functions, "registrars": registrars,
+                "register_calls": register_calls}
+
+    # ------------------------------------------------------------------
+    def finalize_project(self, graph, facts):
+        def globalize(relpath, lid):
+            mod = graph.files[relpath]["module"] if relpath in graph.files \
+                else relpath
+            return lid.replace("@:", f"{mod}:", 1)
+
+        # registry: container id -> registered callbacks (rel, qual)
+        registrars = {}
+        for rel, f in facts.items():
+            for qual, (container, idx) in (f.get("registrars") or {}).items():
+                registrars[(rel, qual)] = (globalize(rel, container), idx)
+        registry = {}
+        for rel, f in facts.items():
+            for name, args, scope, _line in f.get("register_calls") or ():
+                target = graph.resolve_call(rel, scope, name)
+                if target is None or target not in registrars:
+                    continue
+                container, idx = registrars[target]
+                if idx < len(args) and args[idx]:
+                    cb = graph.resolve_call(rel, scope, args[idx])
+                    if cb:
+                        registry.setdefault(container, set()).add(cb)
+
+        fn_facts = {(rel, qual): body
+                    for rel, f in facts.items()
+                    for qual, body in (f.get("functions") or {}).items()}
+
+        memo = {}
+
+        def eff(key, depth):
+            """Locks (globalized) this function may acquire, transitively."""
+            if depth < 0 or key not in fn_facts:
+                return set()
+            if key in memo:
+                return memo[key]
+            memo[key] = set()      # cycle guard
+            rel, qual = key
+            body = fn_facts[key]
+            out = {globalize(rel, lid) for lid, _l, _t in body["acquires"]}
+            for name, _l, _t in body["calls"]:
+                callee = graph.resolve_call(rel, qual, name)
+                if callee:
+                    out |= eff(callee, depth - 1)
+            for container, _l, _t, _held in body.get("invocations") or ():
+                for cb in registry.get(globalize(rel, container), ()):
+                    out |= eff(cb, depth - 1)
+            memo[key] = out
+            return out
+
+        edges = {}   # (src, dst) -> first witness (rel, line, text)
+
+        def edge(src, dst, rel, line, text):
+            if src != dst:
+                edges.setdefault((src, dst), (rel, line, text))
+
+        for (rel, qual), body in sorted(fn_facts.items()):
+            for held, nested in sorted(body["under"].items()):
+                src = globalize(rel, held)
+                for lid, line, text in nested["locks"]:
+                    edge(src, globalize(rel, lid), rel, line, text)
+                for name, line, text in nested["calls"]:
+                    callee = graph.resolve_call(rel, qual, name)
+                    if callee:
+                        for dst in sorted(eff(callee, _MAX_DEPTH)):
+                            edge(src, dst, rel, line, text)
+            for container, line, text, held in body.get("invocations") or ():
+                targets = set()
+                for cb in registry.get(globalize(rel, container), ()):
+                    targets |= eff(cb, _MAX_DEPTH)
+                for h in held:
+                    for dst in sorted(targets):
+                        edge(globalize(rel, h), dst, rel, line, text)
+
+        yield from self._report_cycles(graph, edges)
+
+    # ------------------------------------------------------------------
+    def _report_cycles(self, graph, edges):
+        adj = {}
+        for (src, dst) in edges:
+            adj.setdefault(src, set()).add(dst)
+            adj.setdefault(dst, set())
+        for scc in _sccs(adj):
+            cyclic = len(scc) > 1 or any(
+                (n, n) in edges for n in scc)
+            if not cyclic:
+                continue
+            scc_set = set(scc)
+            witnesses = sorted(
+                (src, dst, edges[(src, dst)])
+                for (src, dst) in edges
+                if src in scc_set and dst in scc_set)
+            if not witnesses:
+                continue
+            detail = "; ".join(
+                f"{src} -> {dst} at {w[0]}:{w[1]}"
+                for src, dst, w in witnesses)
+            rel, line, text = witnesses[0][2]
+            yield self.fact_finding(
+                graph.root, rel, line,
+                f"lock-order cycle between {', '.join(sorted(scc_set))}: "
+                f"{detail} — two paths acquire these locks in opposite "
+                "orders; impose one global order or drop a lock before the "
+                "cross-module call", text)
+
+
+def _sccs(adj):
+    """Tarjan strongly-connected components, iterative."""
+    index, low, on_stack = {}, {}, set()
+    stack, out, counter = [], [], [0]
+    for start in sorted(adj):
+        if start in index:
+            continue
+        work = [(start, iter(sorted(adj[start])))]
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on_stack.add(start)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(adj[nxt]))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    n = stack.pop()
+                    on_stack.discard(n)
+                    comp.append(n)
+                    if n == node:
+                        break
+                out.append(sorted(comp))
+    return out

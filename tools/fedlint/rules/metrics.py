@@ -1,0 +1,287 @@
+"""``metric-registry``: every ``fedml_*`` Prometheus series emitted anywhere
+must be documented in ``docs/observability.md`` and asserted by at least one
+test — and documented names must still be emitted (ISSUE 10).
+
+Emission sites are found whole-program because metric names flow through
+module constants (``quorum.PARTIAL_COUNTER``), sometimes cross-module
+(``quorum_mod.STALE_REJECTED_COUNTER``): the rule resolves Name/Attribute
+arguments through the project symbol table. Canonicalization mirrors
+``core/telemetry/prom.py``:
+
+* ``tel.counter("a.b")``          → ``fedml_a_b_total``
+* prefix counters (``PREFIX + x`` where the prefix constant ends in ``.``,
+  e.g. ``jax.compiles.``)         → the collapsed labeled family
+  (``fedml_jax_compiles_total``)
+* ``tel.histogram("x_seconds")``  → base ``fedml_x_seconds`` (docs/tests may
+  name the base or any of ``_bucket``/``_sum``/``_count``)
+* gauge triples ``("name", labels, v)`` (inside ``*gauges*`` functions,
+  ``gauges=`` kwargs, or ``gauges``-named assignments) → ``fedml_name``
+* literal families built by ``_fam("lit", "_suffix")`` in prom.py itself.
+
+Dynamic names that resolve to nothing are skipped, never guessed at.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+
+from ..core import ProjectRule
+from ._util import dotted
+
+_NAME_RE = re.compile(r"\bfedml_[A-Za-z0-9_]+")
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _canon(name: str) -> str:
+    return "fedml_" + re.sub(r"[^A-Za-z0-9_]", "_", name)
+
+
+def _name_arg(node):
+    """Classify a metric-name argument: ("lit", s) / ("ref", dotted) /
+    ("prefix", s) / ("prefix_ref", dotted) / None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return ("lit", node.value)
+    d = dotted(node)
+    if d:
+        return ("ref", d)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = node.left
+        if isinstance(left, ast.Constant) and isinstance(left.value, str):
+            return ("prefix", left.value)
+        d = dotted(left)
+        if d:
+            return ("prefix_ref", d)
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return ("prefix", first.value)
+    return None
+
+
+class MetricRegistryRule(ProjectRule):
+    id = "metric-registry"
+    severity = "error"
+    description = ("fedml_* metric drift: emitted series missing from "
+                   "docs/observability.md or from every test, or a "
+                   "documented series nothing emits anymore")
+
+    def __init__(self):
+        self.doc_path = "docs/observability.md"
+        self.tests_dir = "tests"
+        self.ignore: tuple = ("fedml_tpu*",)
+
+    def configure(self, options):
+        self.doc_path = options.get("metric-doc", self.doc_path)
+        self.tests_dir = options.get("metric-tests-dir", self.tests_dir)
+        ignore = options.get("metric-doc-ignore")
+        if ignore is not None:
+            self.ignore = tuple(ignore)
+
+    def _ignored(self, name):
+        return any(fnmatch.fnmatch(name, pat) for pat in self.ignore)
+
+    # ------------------------------------------------------------------
+    def collect(self, ctx):
+        emits = []
+
+        def emit(kind, spec, node):
+            emits.append([kind, spec[0], spec[1], node.lineno,
+                          ctx.raw_line(node.lineno)])
+
+        # module-local wrappers like quorum._counter(name) that just forward
+        # the name to tel.counter()/histogram(): calls to them are emissions
+        wrappers = {}
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            pnames = {a.arg for a in fn.args.args}
+            for ret in ast.walk(fn):
+                if not (isinstance(ret, ast.Return)
+                        and isinstance(ret.value, ast.Call)):
+                    continue
+                rf = ret.value.func
+                if (isinstance(rf, ast.Attribute)
+                        and rf.attr in ("counter", "histogram")
+                        and ret.value.args
+                        and isinstance(ret.value.args[0], ast.Name)
+                        and ret.value.args[0].id in pnames):
+                    wrappers[fn.name] = rf.attr
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (isinstance(f, ast.Name) and f.id in wrappers
+                    and node.args):
+                spec = _name_arg(node.args[0])
+                if spec:
+                    emit(wrappers[f.id], spec, node)
+            elif isinstance(f, ast.Attribute) and f.attr in (
+                    "counter", "histogram") and node.args:
+                spec = _name_arg(node.args[0])
+                if spec:
+                    emit(f.attr, spec, node)
+            elif isinstance(f, ast.Name) and f.id == "_fam" and node.args:
+                parts = []
+                for a in node.args[:2]:
+                    if isinstance(a, ast.Constant) and isinstance(
+                            a.value, str):
+                        parts.append(a.value)
+                    else:
+                        parts = None
+                        break
+                if parts:
+                    emit("fam", ("lit", "".join(parts)), node)
+            # gauges=[...] kwarg
+            for kw in node.keywords:
+                if kw.arg == "gauges":
+                    for t in ast.walk(kw.value):
+                        self._gauge_tuple(t, emit)
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and "gauges" in fn.name:
+                for t in ast.walk(fn):
+                    self._gauge_tuple(t, emit)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                names = [t.id if isinstance(t, ast.Name) else dotted(t)
+                         for t in node.targets]
+                if any(n and n.split(".")[-1] == "gauges" for n in names):
+                    for t in ast.walk(node.value):
+                        self._gauge_tuple(t, emit)
+        if not emits:
+            return None
+        # dedupe (gauges functions scanned via two paths)
+        seen, out = set(), []
+        for e in emits:
+            key = tuple(e[:4])
+            if key not in seen:
+                seen.add(key)
+                out.append(e)
+        return {"emits": out}
+
+    def _gauge_tuple(self, node, emit):
+        if (isinstance(node, ast.Tuple) and len(node.elts) == 3
+                and isinstance(node.elts[0], ast.Constant)
+                and isinstance(node.elts[0].value, str)):
+            emit("gauge", ("lit", node.elts[0].value), node)
+
+    # ------------------------------------------------------------------
+    def _canonical(self, graph, relpath, kind, how, value):
+        """(canonical_name, match_mode) or None; match_mode 'exact',
+        'hist' (histogram base), or 'family' (labeled prefix family)."""
+        if how in ("ref", "prefix_ref"):
+            value = graph.constant(relpath, value)
+            if not isinstance(value, str):
+                return None
+            how = "lit" if how == "ref" else "prefix"
+        if kind == "counter":
+            if how == "prefix":
+                if not value.endswith("."):
+                    return None   # unanchored dynamic name; skip
+                return (_canon(value[:-1]) + "_total", "family")
+            return (_canon(value) + "_total", "exact")
+        if kind == "histogram":
+            if how == "prefix":
+                return None
+            return (_canon(value), "hist")
+        if kind == "gauge":
+            if how != "lit":
+                return None
+            return (_canon(value), "exact")
+        if kind == "fam":
+            return ("fedml_" + re.sub(r"[^A-Za-z0-9_]", "_", value), "exact")
+        return None
+
+    def finalize_project(self, graph, facts):
+        doc_file = os.path.join(graph.root, *self.doc_path.split("/"))
+        try:
+            with open(doc_file, encoding="utf-8") as f:
+                doc_text = f.read()
+        except OSError:
+            doc_text = None
+        doc_names = set(_NAME_RE.findall(doc_text or ""))
+
+        tests_text = ""
+        tests_root = os.path.join(graph.root, *self.tests_dir.split("/"))
+        if os.path.isdir(tests_root):
+            for dirpath, _dirs, files in os.walk(tests_root):
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        try:
+                            with open(os.path.join(dirpath, fn),
+                                      encoding="utf-8") as fh:
+                                tests_text += fh.read()
+                        except OSError:
+                            pass
+
+        emitted = {}    # canonical -> (mode, first emission site)
+        for rel, f in sorted(facts.items()):
+            for kind, how, value, line, text in f.get("emits") or ():
+                hit = self._canonical(graph, rel, kind, how, value)
+                if hit is None:
+                    continue
+                canonical, mode = hit
+                emitted.setdefault(canonical, (mode, rel, line, text))
+
+        def documented(canonical, mode):
+            if canonical in doc_names:
+                return True
+            if mode == "hist":
+                return any(canonical + s in doc_names
+                           for s in _HIST_SUFFIXES)
+            return False
+
+        def tested(canonical, mode):
+            if canonical in tests_text:
+                return True
+            if mode == "hist":
+                return any(canonical + s in tests_text
+                           for s in _HIST_SUFFIXES)
+            return False
+
+        for canonical, (mode, rel, line, text) in sorted(emitted.items()):
+            if self._ignored(canonical):
+                continue
+            if doc_text is not None and not documented(canonical, mode):
+                yield self.fact_finding(
+                    graph.root, rel, line,
+                    f"metric `{canonical}` is emitted here but not "
+                    f"documented in {self.doc_path} — every exported series "
+                    "gets a row in the observability doc", text)
+            if not tested(canonical, mode):
+                yield self.fact_finding(
+                    graph.root, rel, line,
+                    f"metric `{canonical}` is emitted here but asserted by "
+                    "no test — add it to the metric-registry test so a "
+                    "rename can't silently break dashboards", text)
+
+        # documented names that nothing emits anymore
+        if doc_text is None:
+            return
+        hist_bases = {c for c, (m, *_r) in emitted.items() if m == "hist"}
+        families = {c for c, (m, *_r) in emitted.items() if m == "family"}
+        doc_lines = doc_text.splitlines()
+        for name in sorted(doc_names):
+            if self._ignored(name) or name in emitted:
+                continue
+            base = name
+            for s in _HIST_SUFFIXES:
+                if name.endswith(s):
+                    base = name[: -len(s)]
+                    break
+            if base in hist_bases or base in emitted:
+                continue
+            if any(name == fam or name.startswith(fam[: -len("_total")])
+                   for fam in families):
+                continue
+            line = next((i for i, ln in enumerate(doc_lines, 1)
+                         if name in ln), 1)
+            yield self.fact_finding(
+                graph.root, self.doc_path, line,
+                f"documented metric `{name}` is emitted nowhere in the tree "
+                "— stale doc row, or the emission was lost in a refactor",
+                doc_lines[line - 1] if line <= len(doc_lines) else "")

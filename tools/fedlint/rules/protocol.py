@@ -1,0 +1,274 @@
+"""``protocol-contract``: the cross-silo wire protocol checked as a whole
+program (ISSUE 10).
+
+A **protocol family** is a class that defines class-level ``MSG_TYPE_*``
+constants (the three ``MyMessage`` vocabularies: plain, secagg,
+lightsecagg — each checked independently; families are keyed by their
+defining module, so same-named classes never bleed into each other).
+Uses are attributed to a family by resolving the alias a file imported —
+``MyMessage.MSG_TYPE_X`` means whatever ``MyMessage`` is bound to *in
+that file*.
+
+Per family, across every file in the scan:
+
+* a ``MSG_TYPE_*`` **sent** (``Message(Fam.MSG_TYPE_X, ...)``) must have a
+  registered receiver (``register_message_receive_handler``) somewhere,
+  and vice versa — ``CONNECTION_IS_READY`` is exempt because the comm
+  manager synthesizes that send from the raw value at runtime;
+* a ``MSG_ARG_KEY_*`` **written** (``msg.add_params(Fam.KEY, v)``) must be
+  **read** (``msg_params.get(Fam.KEY)`` / ``msg[Fam.KEY]``) by some
+  receiver;
+* a constant **defined but never referenced** anywhere is dead vocabulary;
+* families that define ``MSG_ARG_KEY_MODEL_VERSION`` must stamp it on the
+  init/sync sends (type name containing ``INIT_CONFIG`` or
+  ``SYNC_MODEL``) in the same function — the async staleness policy is
+  blind without the version tag.
+
+Deliberate asymmetries (reference-server interop handlers, telemetry-only
+keys) get inline ``# fedlint: disable=protocol-contract <reason>`` on the
+reported line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import ProjectRule
+from ._util import dotted
+
+_TYPE_MARK = "MSG_TYPE_"
+_KEY_MARK = "MSG_ARG_KEY_"
+_READ_ATTRS = ("get", "get_params", "pop")
+_EXEMPT_TYPES = ("CONNECTION_IS_READY",)
+_STAMPED_SENDS = ("INIT_CONFIG", "SYNC_MODEL")
+_VERSION_KEY = "MSG_ARG_KEY_MODEL_VERSION"
+
+
+def _const_ref(node):
+    """Dotted text of a ``Alias.MSG_TYPE_X`` / ``Alias.MSG_ARG_KEY_Y``
+    reference, or None."""
+    d = dotted(node)
+    if d and (_TYPE_MARK in d or _KEY_MARK in d) and "." in d:
+        return d
+    return None
+
+
+class ProtocolContractRule(ProjectRule):
+    id = "protocol-contract"
+    severity = "error"
+    description = ("cross-silo protocol drift: unhandled/unsent MSG_TYPE, "
+                   "written-never-read or dead MSG_ARG_KEY, or an init/sync "
+                   "send missing its model-version stamp")
+
+    # ------------------------------------------------------------------
+    def collect(self, ctx):
+        classes = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            types, keys = {}, {}
+            for item in node.body:
+                if not isinstance(item, ast.Assign):
+                    continue
+                if not (isinstance(item.value, ast.Constant)
+                        and isinstance(item.value.value, (str, int))):
+                    continue
+                for tgt in item.targets:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    rec = [item.value.value, item.lineno,
+                           ctx.raw_line(item.lineno)]
+                    if tgt.id.startswith(_TYPE_MARK):
+                        types[tgt.id] = rec
+                    elif tgt.id.startswith(_KEY_MARK):
+                        keys[tgt.id] = rec
+            if types:
+                classes[node.name] = {"types": types, "keys": keys}
+
+        sends, registers, writes, reads, others = [], [], [], [], []
+        consumed = set()
+
+        def evt(node, fn=None):
+            ref = _const_ref(node)
+            if ref is None:
+                return None
+            consumed.add(id(node))
+            rec = [ref, node.lineno, ctx.raw_line(node.lineno)]
+            if fn is not None:
+                rec.append(fn)
+            return rec
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            fname = dotted(f)
+            fn_node = ctx.enclosing_function(node)
+            fn = ctx.qualname(fn_node) if fn_node is not None else ""
+            if node.args:
+                first = node.args[0]
+                if fname.split(".")[-1] == "Message":
+                    rec = evt(first, fn)
+                    if rec:
+                        sends.append(rec)
+                        continue
+                if fname.endswith("register_message_receive_handler"):
+                    rec = evt(first, fn)
+                    if rec:
+                        registers.append(rec)
+                        continue
+                if isinstance(f, ast.Attribute) and f.attr == "add_params":
+                    rec = evt(first, fn)
+                    if rec:
+                        writes.append(rec)
+                        continue
+                if isinstance(f, ast.Attribute) and f.attr in _READ_ATTRS:
+                    rec = evt(first, fn)
+                    if rec:
+                        reads.append(rec)
+                        continue
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Subscript):
+                ref = _const_ref(node.slice)
+                if ref is not None:
+                    consumed.add(id(node.slice))
+                    reads.append([ref, node.lineno,
+                                  ctx.raw_line(node.lineno), ""])
+            elif isinstance(node, ast.Attribute) and id(node) not in consumed:
+                ref = _const_ref(node)
+                # outermost chain only: a.b.C is visited before its .value
+                if ref is not None and not any(
+                        id(a) in consumed or _const_ref(a)
+                        for a in ctx.ancestors(node)
+                        if isinstance(a, ast.Attribute)):
+                    others.append([ref, node.lineno])
+
+        if not (classes or sends or registers or writes or reads or others):
+            return None
+        return {"classes": classes, "sends": sends, "registers": registers,
+                "writes": writes, "reads": reads, "others": others}
+
+    # ------------------------------------------------------------------
+    def _families(self, graph, facts):
+        """(module, class) -> {"types", "keys", "relpath"}."""
+        fams = {}
+        for relpath, f in facts.items():
+            mod = graph.files[relpath]["module"] if relpath in graph.files \
+                else None
+            for cls, body in (f.get("classes") or {}).items():
+                fams[(mod, cls)] = {"relpath": relpath, **body}
+        return fams
+
+    def _attribute(self, graph, relpath, ref):
+        """Resolve ``Alias[.Class].CONSTANT`` to ((module, class), const)."""
+        parts = ref.split(".")
+        const = parts[-1]
+        holder = parts[:-1]
+        if not holder:
+            return None
+        s = graph.files.get(relpath)
+        if s is None:
+            return None
+        if len(holder) == 1 and holder[0] in (s.get("classes") or {}):
+            return ((s["module"], holder[0]), const)
+        target = graph.binding_target(relpath, holder[0])
+        if target is None:
+            return None
+        module, attr = target
+        rest = holder[1:]
+        if attr is not None:
+            rest = [attr] + rest
+        if len(rest) != 1:
+            return None
+        dep = graph.relpath_of(module)
+        dep_mod = graph.files[dep]["module"] if dep else module
+        return ((dep_mod, rest[0]), const)
+
+    # ------------------------------------------------------------------
+    def finalize_project(self, graph, facts):
+        fams = self._families(graph, facts)
+        if not fams:
+            return
+        use = {fam: {"sends": {}, "registers": {}, "writes": {},
+                     "reads": {}, "others": set()} for fam in fams}
+
+        for relpath, f in facts.items():
+            for bucket in ("sends", "registers", "writes", "reads"):
+                for rec in f.get(bucket) or ():
+                    ref, line, text = rec[0], rec[1], rec[2]
+                    fn = rec[3] if len(rec) > 3 else ""
+                    hit = self._attribute(graph, relpath, ref)
+                    if hit is None or hit[0] not in fams:
+                        continue
+                    fam, const = hit
+                    use[fam][bucket].setdefault(const, []).append(
+                        (relpath, line, text, fn))
+            for ref, _line in f.get("others") or ():
+                hit = self._attribute(graph, relpath, ref)
+                if hit is not None and hit[0] in fams:
+                    use[hit[0]]["others"].add(hit[1])
+
+        for fam, body in sorted(fams.items(), key=lambda kv: str(kv[0])):
+            u = use[fam]
+            yield from self._check_family(graph, fam, body, u)
+
+    def _check_family(self, graph, fam, body, u):
+        def_rel = body["relpath"]
+        referenced = (set(u["sends"]) | set(u["registers"]) | set(u["writes"])
+                      | set(u["reads"]) | u["others"])
+
+        for name, (value, line, text) in sorted(body["types"].items()):
+            if any(mark in name for mark in _EXEMPT_TYPES) or value == 0:
+                continue
+            sent, reg = u["sends"].get(name), u["registers"].get(name)
+            if sent and not reg:
+                for rel, sline, stext, _fn in sent:
+                    yield self.fact_finding(
+                        graph.root, rel, sline,
+                        f"{fam[1]}.{name} is sent here but no file registers "
+                        "a receive handler for it — the message would be "
+                        "dropped on the floor", stext)
+            elif reg and not sent:
+                for rel, rline, rtext, _fn in reg:
+                    yield self.fact_finding(
+                        graph.root, rel, rline,
+                        f"{fam[1]}.{name} has a receive handler here but "
+                        "nothing in the tree ever sends it — dead handler "
+                        "or a sender lost in a refactor", rtext)
+            elif not sent and not reg and name not in referenced:
+                yield self.fact_finding(
+                    graph.root, def_rel, line,
+                    f"{fam[1]}.{name} is defined but never sent, handled, "
+                    "or referenced — dead protocol vocabulary", text)
+
+        for name, (value, line, text) in sorted(body["keys"].items()):
+            written, read = u["writes"].get(name), u["reads"].get(name)
+            if written and not read:
+                for rel, wline, wtext, _fn in written:
+                    yield self.fact_finding(
+                        graph.root, rel, wline,
+                        f"{fam[1]}.{name} is written into messages here but "
+                        "no receiver ever reads it — dead payload on every "
+                        "send", wtext)
+            elif not written and not read and name not in referenced:
+                yield self.fact_finding(
+                    graph.root, def_rel, line,
+                    f"{fam[1]}.{name} is defined but never written or read "
+                    "— dead protocol vocabulary", text)
+
+        # model-version stamping on init/sync paths
+        if _VERSION_KEY not in body["keys"]:
+            return
+        stamping = {(rel, fn) for recs in (u["writes"].get(_VERSION_KEY, ()),)
+                    for rel, _l, _t, fn in recs}
+        for name, recs in sorted(u["sends"].items()):
+            if not any(mark in name for mark in _STAMPED_SENDS):
+                continue
+            for rel, line, text, fn in recs:
+                if (rel, fn) not in stamping:
+                    yield self.fact_finding(
+                        graph.root, rel, line,
+                        f"{fam[1]}.{name} send does not stamp "
+                        f"{_VERSION_KEY} in {fn or '<module>'}() — async "
+                        "staleness weighting needs the version tag on every "
+                        "init/sync broadcast", text)

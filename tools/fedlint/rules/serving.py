@@ -45,30 +45,32 @@ class HotSpanRule(Rule):
     description = ("registered serving hot loop lost its tel.timed()/"
                    "tel.span() instrumentation (or the registry went stale)")
 
+    # Per-file checks live in check_file (not finalize) so the incremental
+    # engine can serve them from cache: finalize only sees dirty files.
+    def check_file(self, ctx):
+        repo_serving = os.path.join(ctx.root, *_SERVING_DIR.split("/"))
+        in_repo_layout = os.path.isdir(repo_serving)
+        for rel, fn_name in HOT_LOOPS:
+            target = f"{_SERVING_DIR}/{rel}" if in_repo_layout else rel
+            if matches_file(ctx.relpath, target):
+                yield from self._check_fn(ctx, rel, fn_name)
+
     def finalize(self, run):
-        # serving root: repo layout when present, else the scan root itself
-        # (the legacy shim points straight at a serving-shaped directory)
+        # only the missing-FILE check needs whole-run context, and it must
+        # be cache-safe: consult the filesystem, not run.files
         repo_serving = os.path.join(run.root, *_SERVING_DIR.split("/"))
         in_repo_layout = os.path.isdir(repo_serving)
-        by_entry_file: dict = {}
-        for ctx in run.files:
-            for rel, _fn in HOT_LOOPS:
-                target = f"{_SERVING_DIR}/{rel}" if in_repo_layout else rel
-                if matches_file(ctx.relpath, target):
-                    by_entry_file[rel] = ctx
         findings = []
-        for rel, fn_name in HOT_LOOPS:
-            ctx = by_entry_file.get(rel)
-            if ctx is None:
-                missing = (os.path.join(repo_serving, rel) if in_repo_layout
-                           else os.path.join(run.root, rel))
-                findings.append(Finding(
-                    rule=self.id, severity=self.severity, path=missing,
-                    relpath=os.path.relpath(missing, run.root).replace(os.sep, "/"),
-                    line=0, col=0,
-                    message=f"registry names missing file {rel}"))
+        for rel in sorted({rel for rel, _fn in HOT_LOOPS}):
+            missing = (os.path.join(repo_serving, rel) if in_repo_layout
+                       else os.path.join(run.root, rel))
+            if os.path.exists(missing):
                 continue
-            findings.extend(self._check_fn(ctx, rel, fn_name))
+            findings.append(Finding(
+                rule=self.id, severity=self.severity, path=missing,
+                relpath=os.path.relpath(missing, run.root).replace(os.sep, "/"),
+                line=0, col=0,
+                message=f"registry names missing file {rel}"))
         return findings
 
     def _check_fn(self, ctx, rel, fn_name):

@@ -1,0 +1,138 @@
+"""SARIF 2.1.0 output for fedlint (``fedlint --sarif out.sarif``).
+
+The writer emits the minimal-but-complete shape GitHub code scanning and
+IDE SARIF viewers consume: one run, a tool driver with per-rule metadata,
+and one result per live finding (suppressed/baselined findings are emitted
+with a ``suppressions`` entry so viewers can show them greyed out, which
+is what reviewers expect from a baseline-bearing linter).
+
+``validate()`` is a hand-rolled structural check against the SARIF 2.1.0
+schema's required core (this environment has no ``jsonschema``): it
+returns a list of problems, empty when the document is well-formed. It is
+deliberately strict about the properties fedlint relies on — version,
+tool.driver.name, ruleId/message/locations shape — rather than a full
+schema walk.
+"""
+
+from __future__ import annotations
+
+import json
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+_LEVELS = {"error": "error", "warn": "warning"}
+
+
+def _result(finding, suppressed_kind=None) -> dict:
+    res = {
+        "ruleId": finding.rule,
+        "level": _LEVELS.get(finding.severity, "warning"),
+        "message": {"text": finding.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": finding.relpath},
+                "region": {"startLine": max(1, finding.line),
+                           "startColumn": max(1, finding.col + 1)},
+            },
+        }],
+        "partialFingerprints": {"fedlint/v1": finding.fingerprint},
+    }
+    if suppressed_kind:
+        res["suppressions"] = [{"kind": "inSource",
+                                "justification": suppressed_kind}]
+    return res
+
+
+def to_sarif(result, rules) -> dict:
+    """SARIF 2.1.0 document for a :class:`~tools.fedlint.core.RunResult`."""
+    rule_meta = [
+        {"id": r.id,
+         "shortDescription": {"text": r.description or r.id},
+         "defaultConfiguration": {
+             "level": _LEVELS.get(r.severity, "warning")}}
+        for r in sorted(rules, key=lambda r: r.id)
+    ]
+    results = [_result(f) for f in result.findings]
+    results += [_result(f, "suppression pragma") for f in result.suppressed]
+    results += [_result(f, "reviewed baseline") for f in result.baselined]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "fedlint",
+                "informationUri": "docs/static_analysis.md",
+                "rules": rule_meta,
+            }},
+            "results": results,
+        }],
+    }
+
+
+def write(path: str, result, rules) -> None:
+    doc = to_sarif(result, rules)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+
+
+def validate(doc) -> list:
+    """Structural problems with a SARIF 2.1.0 document ([] == valid)."""
+    problems = []
+
+    def need(cond, msg):
+        if not cond:
+            problems.append(msg)
+        return cond
+
+    if not need(isinstance(doc, dict), "document must be an object"):
+        return problems
+    need(doc.get("version") == SARIF_VERSION,
+         f"version must be {SARIF_VERSION!r}")
+    runs = doc.get("runs")
+    if not need(isinstance(runs, list) and runs, "runs must be a non-empty array"):
+        return problems
+    for i, run in enumerate(runs):
+        where = f"runs[{i}]"
+        if not need(isinstance(run, dict), f"{where} must be an object"):
+            continue
+        driver = (run.get("tool") or {}).get("driver") \
+            if isinstance(run.get("tool"), dict) else None
+        if need(isinstance(driver, dict), f"{where}.tool.driver required"):
+            need(isinstance(driver.get("name"), str) and driver["name"],
+                 f"{where}.tool.driver.name must be a non-empty string")
+            for j, rule in enumerate(driver.get("rules") or ()):
+                need(isinstance(rule, dict) and isinstance(
+                    rule.get("id"), str) and rule["id"],
+                    f"{where}.tool.driver.rules[{j}].id must be a string")
+        for j, res in enumerate(run.get("results") or ()):
+            rwhere = f"{where}.results[{j}]"
+            if not need(isinstance(res, dict), f"{rwhere} must be an object"):
+                continue
+            need(isinstance(res.get("ruleId"), str) and res["ruleId"],
+                 f"{rwhere}.ruleId must be a non-empty string")
+            need(res.get("level") in ("none", "note", "warning", "error"),
+                 f"{rwhere}.level must be a SARIF level")
+            msg = res.get("message")
+            need(isinstance(msg, dict) and isinstance(msg.get("text"), str),
+                 f"{rwhere}.message.text required")
+            for k, loc in enumerate(res.get("locations") or ()):
+                lwhere = f"{rwhere}.locations[{k}]"
+                phys = loc.get("physicalLocation") \
+                    if isinstance(loc, dict) else None
+                if not need(isinstance(phys, dict),
+                            f"{lwhere}.physicalLocation required"):
+                    continue
+                art = phys.get("artifactLocation")
+                need(isinstance(art, dict) and isinstance(
+                    art.get("uri"), str) and art["uri"],
+                    f"{lwhere}...artifactLocation.uri must be a string")
+                region = phys.get("region")
+                if region is not None:
+                    need(isinstance(region, dict) and isinstance(
+                        region.get("startLine"), int)
+                        and region["startLine"] >= 1,
+                        f"{lwhere}...region.startLine must be an int >= 1")
+    return problems
