@@ -1460,6 +1460,188 @@ def _bench_async_rounds(publishes: int = 8, reps: int = 3):
     }
 
 
+def _bench_placement_search(probe_publishes: int = 4, reps: int = 2):
+    """Auto-placement search (ISSUE 11): cost-model-seeded, measurement-
+    refined search (core/engine/placement_search.py) vs the hand-picked
+    defaults, on TWO workloads sharing one BucketedAggregator:
+
+    - async_fedbuff: search (publish_k x staleness exponent) with short
+      AsyncEventSim probes; headline rounds/hr. The hand-picked default is
+      the async_rounds stage's own config (publish_k=32, exponent=0.5).
+    - sync_agg: search the execution strategy (per-client sequential
+      dispatch vs one megabatch fold); headline clients/sec. The
+      hand-picked default is the sp front's in_process_sequential.
+
+    The winning PlacementPlan per workload is written as a committed JSON
+    artifact (PLACEMENT_PLAN_<workload>.json — bench_watch commits it next
+    to BENCH_MEASURED_*) so `args.placement=/path/to/plan.json` replays the
+    searched config without re-probing.
+
+    Integrity guards (BenchIntegrityError, refusing to publish):
+    - the searched winner must beat its baseline on >= 1 workload headline;
+    - zero retraces: a warmup search compiles every program any probed
+      candidate needs; the timed search must not move the engine's
+      accumulate trace counters (the searched config is a re-wiring of the
+      SAME compiled folds, not a new program)."""
+    import jax
+
+    from fedml_tpu.core.aggregation.async_buffer import AsyncAggBuffer, StalenessPolicy
+    from fedml_tpu.core.aggregation.bucketed import BucketedAggregator
+    from fedml_tpu.core.engine import (
+        STRATEGY_IN_PROCESS,
+        STRATEGY_VMAPPED,
+        PlacementCandidate,
+        PlacementSearch,
+        WorkloadProfile,
+        enumerate_candidates,
+    )
+    from fedml_tpu.simulation.vmapped.async_driver import (
+        AsyncEventSim,
+        DelayModel,
+        make_synthetic_delta_fn,
+    )
+
+    dev = jax.devices()[0]
+    tiny = os.environ.get("FEDML_BENCH_TINY") == "1"
+    bucket = 16
+    eng = BucketedAggregator(bucket)
+    n_clients = 200 if tiny else 2000
+
+    # same ~100k-param MLP-shaped pytree as the async_rounds stage — the
+    # search compares PLACEMENTS of one workload, so the model is fixed
+    key = np.random.default_rng(5)
+    template = {
+        "dense1": {"kernel": np.asarray(key.standard_normal((128, 256)), np.float32),
+                   "bias": np.zeros((256,), np.float32)},
+        "dense2": {"kernel": np.asarray(key.standard_normal((256, 256)), np.float32),
+                   "bias": np.zeros((256,), np.float32)},
+        "head": {"kernel": np.asarray(key.standard_normal((256, 64)), np.float32),
+                 "bias": np.zeros((64,), np.float32)},
+    }
+    template = jax.device_put(template)
+    model_bytes = int(sum(np.asarray(l).nbytes for l in jax.tree.leaves(template)))
+    gen = make_synthetic_delta_fn(seed=11)
+
+    # --- workload A: async FedBuff, headline rounds/hr ---------------------
+    async_prof = WorkloadProfile(
+        name="async_fedbuff", cohort_size=n_clients, model_bytes=model_bytes,
+        is_async=True, headline="rounds_per_hr")
+    # hand-picked default: exactly what _bench_async_rounds runs today
+    async_default = PlacementCandidate(
+        strategy=STRATEGY_VMAPPED, publish_k=32, staleness_exponent=0.5)
+
+    def probe_async(cand):
+        best = None
+        for r in range(reps):
+            sim = AsyncEventSim(
+                AsyncAggBuffer(
+                    publish_k=int(cand.publish_k or 32),
+                    policy=StalenessPolicy(
+                        exponent=float(cand.staleness_exponent or 0.0)),
+                    engine=eng),
+                gen, n_clients, initial_model=template,
+                delay=DelayModel(n_clients, mean_delay=1.0, heterogeneity=0.5,
+                                 seed=1000 + r),
+                gen_batch=256)
+            stats = sim.run(probe_publishes)
+            if best is None or stats["server_seconds"] < best:
+                best = stats["server_seconds"]
+        return probe_publishes / best * 3600.0
+
+    async_cands = enumerate_candidates(
+        async_prof, max_devices=1, publish_ks=(8, 16, 32, 64),
+        staleness_exponents=(0.0, 0.5))
+
+    # --- workload B: sync cohort aggregation, headline clients/sec ---------
+    sync_prof = WorkloadProfile(
+        name="sync_agg", cohort_size=2 * bucket, model_bytes=model_bytes,
+        is_async=False, headline="clients_per_sec")
+    # hand-picked default: the sp front's per-client sequential dispatch
+    sync_default = PlacementCandidate(strategy=STRATEGY_IN_PROCESS)
+    ids = np.arange(2 * bucket, dtype=np.int32)
+    stacked = gen(template, ids, 0)
+    cohort = [(float(k + 1),
+               jax.tree.map(lambda l, _k=k: l[_k], stacked))
+              for k in range(2 * bucket)]
+
+    def probe_sync(cand):
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            if cand.strategy == STRATEGY_IN_PROCESS:
+                for w, tree in cohort:   # one dispatch per client
+                    eng.aggregate([(w, tree)])
+            else:
+                eng.aggregate(cohort)    # one megabatch fold per bucket
+            dt = time.perf_counter() - t0
+            if best is None or dt < best:
+                best = dt
+        return len(cohort) / best
+
+    sync_cands = enumerate_candidates(sync_prof, max_devices=1)
+
+    def run_search():
+        plans = {}
+        plans["async_fedbuff"] = PlacementSearch(
+            async_prof, probe_async, candidates=async_cands, probe_top_n=3,
+            baseline=async_default).search()
+        plans["sync_agg"] = PlacementSearch(
+            sync_prof, probe_sync, candidates=sync_cands, probe_top_n=2,
+            baseline=sync_default).search()
+        return plans
+
+    _p(f"placement bench: warmup search ({len(async_cands)} async + "
+       f"{len(sync_cands)} sync candidates, {n_clients} clients)")
+    run_search()  # compiles every fold program any probed candidate touches
+    traces_before = int(eng.accum_traces)
+
+    _p("placement bench: timed search")
+    plans = run_search()
+
+    if eng.accum_traces != traces_before:
+        raise BenchIntegrityError(
+            f"placement probes retraced during the timed search "
+            f"({traces_before} -> {eng.accum_traces}); the searched config "
+            "must re-wire the SAME compiled folds; refusing to publish")
+
+    plan_docs: dict = {}
+    speedups: dict = {}
+    plan_files: list = []
+    for workload, ranked in plans.items():
+        win = ranked[0]
+        fname = f"PLACEMENT_PLAN_{workload}.json"
+        with open(fname, "w", encoding="utf-8") as f:
+            f.write(win.to_json() + "\n")
+        plan_files.append(fname)
+        cand = win.candidate
+        plan_docs[workload] = {
+            "fingerprint": cand.fingerprint(),
+            "strategy": cand.strategy,
+            "publish_k": cand.publish_k,
+            "staleness_exponent": cand.staleness_exponent,
+            "headline": win.headline_metric,
+            "measured": round(float(win.measured), 1),
+            "baseline": round(float(win.baseline_value), 1),
+        }
+        speedups[workload] = round(float(win.speedup), 2)
+
+    if max(speedups.values()) <= 1.0:
+        raise BenchIntegrityError(
+            f"placement search failed to beat the hand-picked default on any "
+            f"workload ({speedups}); refusing to publish")
+
+    return {
+        "placement_plan": plan_docs,
+        "placement_speedup": speedups,
+        "placement_plan_files": plan_files,
+        "placement_probe_publishes": probe_publishes,
+        "placement_candidates": {"async_fedbuff": len(async_cands),
+                                 "sync_agg": len(sync_cands)},
+        "placement_accum_traces": int(eng.accum_traces),
+        "device": getattr(dev, "device_kind", str(dev)),
+    }
+
+
 def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: int = 3):
     """Endpoint-level decode throughput (BASELINE config 5): tokens/s
     measured THROUGH the gateway with subprocess replicas — the real
@@ -2323,6 +2505,8 @@ def _stage_result(name: str) -> dict:
         out = _retry_transient(_bench_agg_sharded)
     elif name == "async_rounds":
         out = _retry_transient(_bench_async_rounds)
+    elif name == "placement_search":
+        out = _retry_transient(_bench_placement_search)
     elif name == "llm_pallas_tuned":
         # re-run the pallas headline under the block config attn_micro just
         # recorded (the orchestrator exports FEDML_FLASH_BLOCK_Q/K into this
@@ -2377,6 +2561,11 @@ _STAGES: list[tuple[str, int]] = [
     # async buffered federation: rounds/hr at 1k/10k/100k simulated clients
     # (flatness + bit-exact sync parity + zero-retrace integrity guards)
     ("async_rounds", 600),
+    # auto-placement search: cost-model-seeded probes over (strategy x
+    # publish_k x staleness exponent) on two workloads; default-vs-searched
+    # speedup + the winning PlacementPlan JSON artifact (zero-retrace +
+    # must-beat-baseline integrity guards)
+    ("placement_search", 600),
     # attention-kernel block sweep: records the fastest config to
     # .bench_runtime/flash_blocks (6 small compiles + marginal timings) ...
     ("attn_micro", 600),
@@ -3013,6 +3202,19 @@ def main() -> None:
                 out[key] = async_rounds[key]
     elif async_rounds is not None:
         out["async_rounds_skipped"] = async_rounds["skipped"]
+
+    placement = stage_out.get("placement_search")
+    if placement is not None and "skipped" not in placement:
+        # auto-placement headline (tools/bench_watch.sh surfaces these):
+        # searched-vs-default speedup per workload, plus the winning plan's
+        # fingerprint/knobs; the full PlacementPlan JSON is its own
+        # committed artifact (placement_plan_files)
+        for key in ("placement_plan", "placement_speedup",
+                    "placement_plan_files", "placement_candidates"):
+            if placement.get(key) is not None:
+                out[key] = placement[key]
+    elif placement is not None:
+        out["placement_search_skipped"] = placement["skipped"]
 
     attn = stage_out.get("attn_micro")
     if attn is not None:

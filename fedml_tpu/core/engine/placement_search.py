@@ -1,0 +1,376 @@
+"""Auto-placement search over the round engine.
+
+In the spirit of "Integrated Hardware Architecture and Device Placement
+Search" (PAPERS.md): instead of hand-picking the mesh shape, aggregation
+partitioning, client-execution strategy, and async publish knobs per
+workload, enumerate the space, seed it with an analytic cost model, probe
+the top candidates with SHORT measured rounds (reading the same
+MFU/HBM/clients-per-sec/rounds-per-hr telemetry bench already records),
+and emit a ranked :class:`PlacementPlan` JSON that the orchestrator and
+``bench.py`` apply via one flag (``args.placement=auto`` or
+``args.placement=/path/to/plan.json``).
+
+Search space axes
+-----------------
+- **mesh spec** — ``core/distributed/mesh.py`` vocabulary (``"agg:8"``,
+  ``""`` for single-device); infeasible specs (more devices than the
+  host exposes) are pruned before probing.
+- **partition** — how the aggregation state lies on the mesh, matching
+  ``core/aggregation/sharded.py``'s two shardings: ``"vec_dim0"`` (the
+  flattened f32 vector sharded on dim 0, ``PartitionSpec(axis)``) or
+  ``"replicated"`` (``PartitionSpec()``, i.e. the plain single-device
+  bucketed path).
+- **execution strategy** — round-engine strategy names
+  (``in_process_sequential`` | ``vmapped_megabatch`` | ``remote_comm``).
+- **async knobs** — ``publish_k`` and the staleness decay exponent of
+  the FedBuff buffer (sync workloads pin both to None).
+
+Probe protocol
+--------------
+The search never trusts the cost model for the final ranking: the model
+only ORDERS candidates so the expensive part — measured probe rounds —
+runs on the top-N. A probe callable receives a candidate and returns the
+measured headline metric (higher is better: rounds/hr, clients/sec, or
+``-hbm_high_water``). Each probe is spanned (``placement.probe``) and
+counted (``fedml_placement_probes_total``); the whole search books
+``fedml_placement_search_seconds``. Determinism: candidates carry a
+stable fingerprint, ties rank by fingerprint, and re-running the search
+with the same probe results reproduces the same order bit for bit.
+
+See docs/placement.md for the plan JSON schema.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import time
+from dataclasses import asdict, dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .. import telemetry as tel
+
+log = logging.getLogger(__name__)
+
+PLAN_VERSION = 1
+
+STRATEGY_IN_PROCESS = "in_process_sequential"
+STRATEGY_VMAPPED = "vmapped_megabatch"
+STRATEGY_REMOTE = "remote_comm"
+
+PARTITION_VEC = "vec_dim0"
+PARTITION_REPLICATED = "replicated"
+
+# per-client host dispatch overhead (seconds) by strategy — rough analytic
+# priors, only used to ORDER candidates before measurement refines them.
+_DISPATCH_OVERHEAD_S = {
+    STRATEGY_IN_PROCESS: 2e-3,   # one python/jit dispatch per client
+    STRATEGY_VMAPPED: 2e-5,      # amortized: one dispatch per cohort
+    STRATEGY_REMOTE: 5e-3,       # serialization + comm handler per client
+}
+_HOST_AGG_BYTES_PER_S = 4e9      # single-device fold throughput prior
+_PUBLISH_OVERHEAD_S = 1e-3       # buffer publish (finalize + install) prior
+
+
+@dataclass(frozen=True)
+class PlacementCandidate:
+    """One point in the placement space. ``None`` async knobs mean the
+    workload is synchronous."""
+
+    mesh_spec: str = ""                      # "" = single device
+    partition: str = PARTITION_REPLICATED    # vec_dim0 | replicated
+    strategy: str = STRATEGY_VMAPPED
+    publish_k: Optional[int] = None
+    staleness_exponent: Optional[float] = None
+
+    def fingerprint(self) -> str:
+        blob = json.dumps(asdict(self), sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+    def n_mesh_devices(self) -> int:
+        if not self.mesh_spec:
+            return 1
+        from ..distributed.mesh import parse_mesh_spec
+
+        n = 1
+        for _, size in parse_mesh_spec(self.mesh_spec):
+            n *= size
+        return n
+
+
+@dataclass
+class WorkloadProfile:
+    """What the cost model needs to know about a workload to rank
+    candidates: scale, model size, and which headline metric decides."""
+
+    name: str
+    cohort_size: int
+    model_bytes: int
+    is_async: bool = False
+    headline: str = "clients_per_sec"   # clients_per_sec | rounds_per_hr | neg_hbm_high_water
+    mean_client_delay_s: float = 1.0
+    hbm_budget_bytes: Optional[int] = None
+
+
+@dataclass
+class PlacementPlan:
+    """The searched answer for one workload: the winning candidate plus the
+    evidence (cost score, measured probe value, baseline) that picked it."""
+
+    workload: str
+    candidate: PlacementCandidate
+    cost_score: float
+    measured: Optional[float] = None
+    headline_metric: str = "clients_per_sec"
+    baseline_value: Optional[float] = None
+    plan_version: int = PLAN_VERSION
+
+    @property
+    def speedup(self) -> Optional[float]:
+        if self.measured is None or not self.baseline_value:
+            return None
+        return float(self.measured) / float(self.baseline_value)
+
+    def to_json(self) -> str:
+        doc = asdict(self)
+        doc["fingerprint"] = self.candidate.fingerprint()
+        doc["speedup"] = self.speedup
+        return json.dumps(doc, sort_keys=True, indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "PlacementPlan":
+        doc = json.loads(text)
+        cand = PlacementCandidate(**doc["candidate"])
+        want = doc.get("fingerprint")
+        if want is not None and want != cand.fingerprint():
+            raise ValueError(
+                f"placement plan fingerprint mismatch: doc says {want}, "
+                f"candidate hashes to {cand.fingerprint()} — plan edited by hand?"
+            )
+        return cls(
+            workload=doc["workload"],
+            candidate=cand,
+            cost_score=float(doc["cost_score"]),
+            measured=doc.get("measured"),
+            headline_metric=doc.get("headline_metric", "clients_per_sec"),
+            baseline_value=doc.get("baseline_value"),
+            plan_version=int(doc.get("plan_version", PLAN_VERSION)),
+        )
+
+    def apply_to_args(self, args: Any) -> Any:
+        """Write the placement onto an args namespace — the single boundary
+        the orchestrator/bench use. Idempotent: applying twice is a no-op."""
+        cand = self.candidate
+        args.server_mesh = cand.mesh_spec or ""
+        args.engine_strategy = cand.strategy
+        args.agg_partition = cand.partition
+        if cand.publish_k is not None:
+            args.async_publish_k = int(cand.publish_k)
+        if cand.staleness_exponent is not None:
+            args.async_staleness_exponent = float(cand.staleness_exponent)
+        # in simulation the execution strategy IS the backend choice — map it
+        # so `placement=auto` changes which simulator the runner dispatches to
+        if getattr(args, "training_type", None) == "simulation":
+            from ...constants import FEDML_SIMULATION_TYPE_SP, FEDML_SIMULATION_TYPE_VMAP
+
+            if cand.strategy == STRATEGY_VMAPPED:
+                args.backend = FEDML_SIMULATION_TYPE_VMAP
+            elif cand.strategy == STRATEGY_IN_PROCESS:
+                args.backend = FEDML_SIMULATION_TYPE_SP
+        args.placement_fingerprint = cand.fingerprint()
+        return args
+
+
+def available_device_count() -> int:
+    import jax
+
+    return jax.local_device_count()
+
+
+def enumerate_candidates(
+    profile: WorkloadProfile,
+    *,
+    mesh_specs: Optional[Sequence[str]] = None,
+    publish_ks: Sequence[int] = (8, 16, 32, 64),
+    staleness_exponents: Sequence[float] = (0.0, 0.5, 1.0),
+    max_devices: Optional[int] = None,
+) -> List[PlacementCandidate]:
+    """The full (pruned) candidate list for a workload, deterministic order.
+
+    Sync workloads vary (mesh × partition × strategy); async workloads add
+    (publish_k × staleness_exponent) on the megabatch strategy (the async
+    event loop generates deltas vmapped; sequential generation would bury
+    the signal in dispatch overhead).
+    """
+    n_dev = max_devices if max_devices is not None else available_device_count()
+    if mesh_specs is None:
+        mesh_specs = [""]
+        d = 2
+        while d <= n_dev:
+            mesh_specs = list(mesh_specs) + [f"agg:{d}"]
+            d *= 2
+    out: List[PlacementCandidate] = []
+    for mesh in mesh_specs:
+        for partition in (PARTITION_REPLICATED, PARTITION_VEC):
+            if partition == PARTITION_VEC and not mesh:
+                continue  # sharding needs a mesh
+            if partition == PARTITION_REPLICATED and mesh:
+                continue  # a mesh without sharding is pure overhead
+            if profile.is_async:
+                for pk in publish_ks:
+                    for exp in staleness_exponents:
+                        out.append(PlacementCandidate(
+                            mesh_spec=mesh, partition=partition,
+                            strategy=STRATEGY_VMAPPED,
+                            publish_k=int(pk), staleness_exponent=float(exp)))
+            else:
+                for strategy in (STRATEGY_IN_PROCESS, STRATEGY_VMAPPED):
+                    out.append(PlacementCandidate(
+                        mesh_spec=mesh, partition=partition, strategy=strategy))
+    # prune infeasible meshes (more devices than the host has)
+    out = [c for c in out if c.n_mesh_devices() <= n_dev]
+    return sorted(out, key=lambda c: c.fingerprint())
+
+
+def cost_model(profile: WorkloadProfile, cand: PlacementCandidate) -> float:
+    """Analytic prior for the headline metric (higher = better). Deliberately
+    crude — it exists to pick WHICH candidates get measured, not to decide.
+
+    Sync: clients/sec ≈ K / (K·dispatch + fold_bytes/(devices·throughput)).
+    Async: rounds/hr ≈ 3600 / (publish_k·merge_s + publish_s), discounted by
+    the staleness admission (a higher exponent keeps more weight mass but a
+    nonzero one costs a decay multiply per merge — the real effect is
+    measured, the prior just breaks ties toward cheaper merges).
+    HBM: the sharded fold divides the accumulator high-water by the device
+    count; infeasible (over budget) candidates return -inf.
+    """
+    devices = cand.n_mesh_devices()
+    shards = devices if cand.partition == PARTITION_VEC else 1
+    hbm_high_water = 2.0 * profile.model_bytes / shards  # acc + incoming bucket
+    if profile.hbm_budget_bytes is not None and hbm_high_water > profile.hbm_budget_bytes:
+        return float("-inf")
+    fold_s_per_client = profile.model_bytes / (_HOST_AGG_BYTES_PER_S * shards)
+    if profile.is_async:
+        merge_s = fold_s_per_client + _DISPATCH_OVERHEAD_S[STRATEGY_VMAPPED]
+        decay_tax = 1.0 + 0.02 * float(cand.staleness_exponent or 0.0)
+        publish_s = (cand.publish_k or 1) * merge_s * decay_tax + _PUBLISH_OVERHEAD_S
+        score = 3600.0 / publish_s
+    else:
+        k = max(1, profile.cohort_size)
+        round_s = k * (_DISPATCH_OVERHEAD_S[cand.strategy] + fold_s_per_client)
+        score = k / round_s
+    if profile.headline == "neg_hbm_high_water":
+        return -hbm_high_water
+    return score
+
+
+class PlacementSearch:
+    """Cost-model-seeded, measurement-refined search.
+
+    ``probe_fn(candidate) -> measured_headline`` runs a SHORT probe (a few
+    rounds / publishes) and returns the measured headline value (higher is
+    better). The search ranks all candidates by the analytic cost model,
+    probes the top ``probe_top_n``, and returns plans ranked by measurement
+    (un-probed candidates rank below all probed ones, by cost score).
+    """
+
+    def __init__(
+        self,
+        profile: WorkloadProfile,
+        probe_fn: Callable[[PlacementCandidate], float],
+        *,
+        candidates: Optional[Sequence[PlacementCandidate]] = None,
+        probe_top_n: int = 4,
+        baseline: Optional[PlacementCandidate] = None,
+    ):
+        self.profile = profile
+        self.probe_fn = probe_fn
+        self.candidates = list(candidates) if candidates is not None else enumerate_candidates(profile)
+        self.probe_top_n = int(probe_top_n)
+        self.baseline = baseline
+
+    def search(self) -> List[PlacementPlan]:
+        prof = self.profile
+        t0 = time.perf_counter()
+        with tel.span("placement.search", workload=prof.name,
+                      candidates=len(self.candidates)):
+            scored = sorted(
+                ((cost_model(prof, c), c) for c in self.candidates),
+                key=lambda sc: (-sc[0], sc[1].fingerprint()),
+            )
+            scored = [(s, c) for s, c in scored if s != float("-inf")]
+            baseline_value = None
+            if self.baseline is not None:
+                baseline_value = self._probe(self.baseline)
+            plans: List[PlacementPlan] = []
+            for score, cand in scored[: self.probe_top_n]:
+                plans.append(PlacementPlan(
+                    workload=prof.name, candidate=cand, cost_score=float(score),
+                    measured=self._probe(cand), headline_metric=prof.headline,
+                    baseline_value=baseline_value))
+            for score, cand in scored[self.probe_top_n:]:
+                plans.append(PlacementPlan(
+                    workload=prof.name, candidate=cand, cost_score=float(score),
+                    measured=None, headline_metric=prof.headline,
+                    baseline_value=baseline_value))
+        tel.histogram("placement.search_seconds").observe(time.perf_counter() - t0)
+        plans.sort(key=lambda p: (
+            p.measured is None,                                   # probed first
+            -(p.measured if p.measured is not None else p.cost_score),
+            p.candidate.fingerprint(),
+        ))
+        if plans:
+            log.info("placement search %s: winner %s (%s=%s, cost=%.3g)",
+                     prof.name, plans[0].candidate, prof.headline,
+                     plans[0].measured, plans[0].cost_score)
+        return plans
+
+    def _probe(self, cand: PlacementCandidate) -> float:
+        tel.counter("placement.probes").add(1)
+        with tel.span("placement.probe", workload=self.profile.name,
+                      fingerprint=cand.fingerprint()):
+            return float(self.probe_fn(cand))
+
+
+def resolve_placement(args: Any) -> Optional[PlacementPlan]:
+    """The one flag the orchestrator/bench use: ``args.placement`` is either
+    a path to a committed plan JSON (apply it) or ``"auto"`` (run a quick
+    cost-model-only search — no probes; callers wanting measured refinement
+    run :class:`PlacementSearch` with a real probe_fn, as
+    ``bench.py --stage placement_search`` does). Returns the applied plan,
+    or None when ``args.placement`` is unset."""
+    spec = getattr(args, "placement", None)
+    if not spec:
+        return None
+    if spec != "auto":
+        with open(spec, encoding="utf-8") as f:
+            plan = PlacementPlan.from_json(f.read())
+        plan.apply_to_args(args)
+        log.info("placement: applied plan %s from %s", plan.candidate, spec)
+        return plan
+    model_bytes = 0
+    template = getattr(args, "placement_model_template", None)
+    if template is not None:
+        import jax
+        import numpy as np
+
+        model_bytes = int(sum(np.asarray(l).nbytes for l in jax.tree.leaves(template)))
+    profile = WorkloadProfile(
+        name=str(getattr(args, "run_name", "auto")),
+        cohort_size=int(getattr(args, "client_num_per_round", 8) or 8),
+        model_bytes=model_bytes or 4 * 1024 * 1024,
+        is_async=bool(getattr(args, "async_rounds", False)),
+        headline="rounds_per_hr" if getattr(args, "async_rounds", False) else "clients_per_sec",
+    )
+    cands = enumerate_candidates(profile)
+    ranked = sorted(
+        ((cost_model(profile, c), c) for c in cands),
+        key=lambda sc: (-sc[0], sc[1].fingerprint()),
+    )
+    score, winner = ranked[0]
+    plan = PlacementPlan(workload=profile.name, candidate=winner,
+                         cost_score=float(score), headline_metric=profile.headline)
+    plan.apply_to_args(args)
+    log.info("placement=auto: cost model picked %s (score=%.3g)", winner, score)
+    return plan

@@ -1,0 +1,691 @@
+"""One round loop for every federation front.
+
+Before this module, sp (`simulation/sp/fedavg_api.py`), vmapped
+(`simulation/vmapped/vmap_fedavg.py` + `async_driver.py`) and cross-silo
+(`cross_silo/server` + `cross_silo/client`) each carried their own copy
+of the round-loop scaffolding: telemetry span taxonomy, cohort sampling
+with the reference's bit-exact seeding, flight-recorder install, chaos
+injection knobs, round-state checkpoint enqueue (with the SIGKILL
+drills), and eval cadence. Every capability (PRs 4, 5, 9) was threaded
+through three times.
+
+The engine factors the loop into two plug points plus shared services:
+
+=====================  =============================  =======================
+front                  client-execution strategy      aggregation sink
+=====================  =============================  =======================
+sp sequential          InProcessSequentialStrategy    AlgFrameSink
+sp hierarchical        GroupedSequentialStrategy      HookedAverageSink
+vmapped sync           VmappedMegabatchStrategy       StackedBucketedSink
+vmapped/silo async     (event-driven arrivals)        AsyncBufferSink /
+                                                      HierarchySink
+cross-silo sync        RemoteCommStrategy             AlgFrameSink (server)
+=====================  =============================  =======================
+
+Synchronous fronts run ``RoundEngine.run``; the async paths are
+event-driven (arrivals fold at once, no round barrier) so they consume
+the ``AsyncSink`` facade instead of the loop — the same submit /
+try_publish vocabulary whether the sink is a flat ``AsyncAggBuffer`` or
+a ``HierarchyTree``.
+
+Shared services (``sample_cohort``, ``eval_due``, ``RoundCheckpointer``,
+``run_local_round``, ``decompress_arrival``/``compress_upload``,
+``flight_recorded``) are the single home of behaviour that used to be
+copy-pasted per front. Bit-exactness matters: sampling reproduces
+``np.random.seed(round_idx)`` + ``choice`` from the reference
+fedavg_api.py:127, and the checkpointer reproduces the sp/server
+save-drain-kill semantics byte for byte so the SIGKILL-resume drills
+stay bit-identical.
+
+See docs/architecture.md ("The round engine") for the matrix above in
+prose and docs/placement.md for the search that runs on top.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import mlops
+from .. import telemetry as tel
+from ..telemetry import flight_recorder
+
+# NOTE: alg_frame (and everything heavier) is imported lazily at use sites.
+# Both cross-silo managers import this module at the top of threads that
+# race each other through the package graph; keeping core.engine a leaf at
+# import time means no thread ever holds this module's import lock while
+# waiting on another package's (cross-thread lock-order inversion → Python
+# breaks the deadlock by exposing partially initialised modules).
+
+log = logging.getLogger(__name__)
+
+PyTree = Any
+
+
+# ---------------------------------------------------------------------------
+# cohort sampling — the reference's exact seeding, in one place
+# ---------------------------------------------------------------------------
+
+def sample_cohort(round_idx: int, client_num_in_total: int, client_num_per_round: int) -> List[int]:
+    """Bit-exact mirror of reference ``_client_sampling`` (fedavg_api.py:127):
+    full cohort when the pool fits, else ``np.random.seed(round_idx)`` +
+    ``np.random.choice`` without replacement."""
+    if client_num_in_total == client_num_per_round:
+        client_indexes: Sequence[int] = [i for i in range(client_num_in_total)]
+    else:
+        num_clients = min(client_num_per_round, client_num_in_total)
+        np.random.seed(round_idx)
+        client_indexes = np.random.choice(range(client_num_in_total), num_clients, replace=False)
+    log.info("client_indexes = %s", client_indexes)
+    return list(client_indexes)
+
+
+def sample_silos(round_idx: int, client_num_in_total: int, client_num_per_round: int) -> List[int]:
+    """Silo-index variant (reference fedml_aggregator.data_silo_selection):
+    when every silo participates the ordered range is returned — note the
+    ``>=`` guard, unlike :func:`sample_cohort`'s ``==``."""
+    if client_num_per_round >= client_num_in_total:
+        return list(range(client_num_in_total))
+    np.random.seed(round_idx)
+    return list(np.random.choice(range(client_num_in_total), client_num_per_round, replace=False))
+
+
+def sample_from_pool(round_idx: int, client_id_list_in_total: Sequence[Any], client_num_per_round: int) -> List[Any]:
+    """Sample concrete client ids from an explicit pool (reference
+    fedml_aggregator.client_selection; ``>=`` guard like :func:`sample_silos`
+    so an over-provisioned round returns the whole pool)."""
+    if client_num_per_round >= len(client_id_list_in_total):
+        return list(client_id_list_in_total)
+    np.random.seed(round_idx)
+    return list(np.random.choice(client_id_list_in_total, client_num_per_round, replace=False))
+
+
+def eval_due(round_idx: int, comm_round: int, frequency_of_the_test: int) -> bool:
+    """The sp cadence: always on the final round, else every ``freq`` rounds."""
+    freq = int(frequency_of_the_test)
+    return round_idx == comm_round - 1 or (freq > 0 and round_idx % freq == 0)
+
+
+# ---------------------------------------------------------------------------
+# client-execution strategies
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RoundResult:
+    """What one round of client execution produced, in whichever of the two
+    shapes the fronts use: per-client ``(weight, tree)`` pairs, or a stacked
+    megabatch ``(stacked_trees, normalized_weights)``."""
+
+    pairs: Optional[List[Tuple[float, PyTree]]] = None
+    stacked: Optional[Tuple[PyTree, Any]] = None
+
+    @property
+    def k(self) -> int:
+        if self.pairs is not None:
+            return len(self.pairs)
+        if self.stacked is not None:
+            return len(self.stacked[1])
+        return 0
+
+
+class ClientExecutionStrategy(abc.ABC):
+    """How a cohort's local training happens for one round."""
+
+    name: str = "strategy"
+
+    @abc.abstractmethod
+    def run_round(self, round_idx: int, w_global: PyTree, cohort: Sequence[int]) -> RoundResult:
+        ...
+
+
+class InProcessSequentialStrategy(ClientExecutionStrategy):
+    """The sp front: one ``Client`` object per slot trained in-process, one
+    ``fedavg.client_train`` span per client, optimizer-specific control
+    state pushed into the trainer before each local run, structured round
+    payloads (FedNova/SCAFFOLD/MIME) preferred over raw weights."""
+
+    name = "in_process_sequential"
+
+    def __init__(self, api: Any):
+        self.api = api
+
+    def run_round(self, round_idx: int, w_global: PyTree, cohort: Sequence[int]) -> RoundResult:
+        from ...constants import (
+            FEDML_FEDERATED_OPTIMIZER_FEDNOVA,
+            FEDML_FEDERATED_OPTIMIZER_MIME,
+            FEDML_FEDERATED_OPTIMIZER_SCAFFOLD,
+        )
+
+        api = self.api
+        w_locals: List[Tuple[float, PyTree]] = []
+        for idx, client in enumerate(api.client_list):
+            client_idx = cohort[idx]
+            client.update_local_dataset(
+                client_idx,
+                api.train_data_local_dict[client_idx],
+                api.test_data_local_dict[client_idx],
+                api.train_data_local_num_dict[client_idx],
+            )
+            if api.fed_opt == FEDML_FEDERATED_OPTIMIZER_SCAFFOLD:
+                api.model_trainer.set_control_variate(api._scaffold_c)
+            elif api.fed_opt == FEDML_FEDERATED_OPTIMIZER_MIME:
+                api.model_trainer.set_server_momentum(api._mime_s)
+            with tel.span("fedavg.client_train", round=round_idx, client=int(client_idx)):
+                w = client.train(w_global)
+            payload = getattr(api.model_trainer, "round_payload", None)
+            if api.fed_opt in (
+                FEDML_FEDERATED_OPTIMIZER_FEDNOVA,
+                FEDML_FEDERATED_OPTIMIZER_SCAFFOLD,
+                FEDML_FEDERATED_OPTIMIZER_MIME,
+            ) and payload is not None:
+                w_locals.append((client.get_sample_number(), payload))
+            else:
+                w_locals.append((client.get_sample_number(), w))
+        return RoundResult(pairs=w_locals)
+
+
+class GroupedSequentialStrategy(ClientExecutionStrategy):
+    """Hierarchical FL: partition the sampled cohort by group, run an inner
+    FedAvg (``group_comm_round`` rounds) per group, return one tree per
+    group weighted by the group's sample count."""
+
+    name = "grouped_sequential"
+
+    def __init__(self, api: Any):
+        self.api = api
+
+    def run_round(self, round_idx: int, w_global: PyTree, cohort: Sequence[int]) -> RoundResult:
+        api = self.api
+        group_to_clients: Dict[int, List[int]] = {}
+        for ci in cohort:
+            group_to_clients.setdefault(int(api.group_indexes[ci]), []).append(int(ci))
+        log.info("client_indexes of each group = %s", group_to_clients)
+        pairs: List[Tuple[float, PyTree]] = []
+        for gidx in sorted(group_to_clients):
+            pairs.append(api._group_train(group_to_clients[gidx], w_global))
+        return RoundResult(pairs=pairs)
+
+
+class VmappedMegabatchStrategy(ClientExecutionStrategy):
+    """The vmapped front: stack the cohort's shards into one megabatch and
+    run every client in a single vmapped+jitted step on device; weights are
+    the normalized per-client sample counts."""
+
+    name = "vmapped_megabatch"
+
+    def __init__(self, api: Any):
+        self.api = api
+
+    def run_round(self, round_idx: int, w_global: PyTree, cohort: Sequence[int]) -> RoundResult:
+        import jax
+
+        api = self.api
+        x, y, idx, mask = api._stack_clients(list(cohort))
+        rngs = jax.random.split(jax.random.PRNGKey(round_idx), len(cohort))
+        result = api._vmapped_train(w_global, x, y, idx, mask, rngs, None)
+        # result.params leaves have a leading client axis -> fold in place
+        counts = np.asarray([api.train_data_local_num_dict[i] for i in cohort], dtype=np.float32)
+        weights = counts / counts.sum()
+        return RoundResult(stacked=(result.params, weights))
+
+
+class RemoteCommStrategy(ClientExecutionStrategy):
+    """Cross-silo: clients live behind a comm backend. The server half uses
+    :meth:`broadcast` inside a ``server.broadcast`` span to push the global
+    model; arrivals flow back through the comm manager's message handlers
+    (quorum, staleness verdicts), so a blocking ``run_round`` only exists
+    when a ``collect_fn`` is provided (in-process backends and tests)."""
+
+    name = "remote_comm"
+
+    def __init__(self, send_fn: Callable[..., None],
+                 collect_fn: Optional[Callable[[int], RoundResult]] = None):
+        self._send_fn = send_fn
+        self._collect_fn = collect_fn
+
+    def broadcast(self, round_idx: int, w_global: PyTree, receiver_ids: Sequence[Any],
+                  silo_indexes: Sequence[Any]) -> None:
+        with tel.span("server.broadcast", round=int(round_idx), receivers=len(receiver_ids)):
+            for idx, receiver_id in enumerate(receiver_ids):
+                self._send_fn(receiver_id, w_global, silo_indexes[idx])
+
+    def run_round(self, round_idx: int, w_global: PyTree, cohort: Sequence[int]) -> RoundResult:
+        if self._collect_fn is None:
+            raise RuntimeError(
+                "RemoteCommStrategy without collect_fn is broadcast-only: arrivals "
+                "fold through the comm manager's handlers, not a blocking round loop"
+            )
+        self.broadcast(round_idx, w_global, list(cohort), list(range(len(cohort))))
+        return self._collect_fn(round_idx)
+
+
+# ---------------------------------------------------------------------------
+# aggregation sinks (synchronous)
+# ---------------------------------------------------------------------------
+
+def middleware_wants_client_trees() -> bool:
+    """True when an attack/defense/DP middleware is active, i.e. the
+    per-client trees must be materialized host-side for the alg-frame hooks
+    instead of flowing through the fused stacked aggregation."""
+    from ..dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+    from ..security.fedml_attacker import FedMLAttacker
+    from ..security.fedml_defender import FedMLDefender
+
+    return (
+        FedMLAttacker.get_instance().is_model_attack()
+        or FedMLDefender.get_instance().is_defense_enabled()
+        or FedMLDifferentialPrivacy.get_instance().is_dp_enabled()
+    )
+
+
+class AggregationSink(abc.ABC):
+    """Where one round's client results fold into the next global model."""
+
+    name: str = "sink"
+
+    @abc.abstractmethod
+    def fold(self, round_idx: int, w_global: PyTree, result: RoundResult) -> PyTree:
+        ...
+
+
+class AlgFrameSink(AggregationSink):
+    """Delegate to a per-algorithm server rule (the sp ``_server_update``
+    and its turboaggregate/fedavg_seq overrides): FedNova/SCAFFOLD/MIME
+    structured payloads, FedDyn h-state, FedOpt server step, alg-frame
+    hooks — all behind one callable."""
+
+    name = "alg_frame"
+
+    def __init__(self, update_fn: Callable[[PyTree, List[Tuple[float, PyTree]]], PyTree]):
+        self._update_fn = update_fn
+
+    def fold(self, round_idx: int, w_global: PyTree, result: RoundResult) -> PyTree:
+        return self._update_fn(w_global, result.pairs or [])
+
+
+class HookedAverageSink(AggregationSink):
+    """Plain hooks + sample-weighted average (the hierarchical front's
+    group fold: no FedOpt step, no contribution assessment)."""
+
+    name = "hooked_average"
+
+    def __init__(self, aggregator: Any):
+        self._agg = aggregator
+
+    def fold(self, round_idx: int, w_global: PyTree, result: RoundResult) -> PyTree:
+        lst = self._agg.on_before_aggregation(result.pairs or [])
+        new_w = self._agg.aggregate(lst)
+        return self._agg.on_after_aggregation(new_w)
+
+
+class StackedBucketedSink(AggregationSink):
+    """The vmapped front's fold: the stacked megabatch goes straight into
+    the bucketed engine's fused ``aggregate_stacked`` unless a middleware
+    needs per-client trees, in which case they are unstacked host-side and
+    run through the hook pipeline."""
+
+    name = "stacked_bucketed"
+
+    def __init__(self, aggregator: Any):
+        self._agg = aggregator
+
+    def fold(self, round_idx: int, w_global: PyTree, result: RoundResult) -> PyTree:
+        import jax
+        import jax.numpy as jnp
+
+        from ..aggregation.bucketed import get_engine
+
+        stacked, weights = result.stacked
+        if self._agg.enable_hooks and middleware_wants_client_trees():
+            w_locals = [
+                (float(weights[k]), jax.tree.map(lambda leaf, _k=k: leaf[_k], stacked))
+                for k in range(len(weights))
+            ]
+            lst = self._agg.on_before_aggregation(w_locals)
+            new_w = self._agg.aggregate(lst)
+        else:
+            # bucketed scan over the client axis: f32 temporaries stay
+            # O(bucket x model) and the compile is shared across cohort
+            # sizes that pad to the same bucket count
+            new_w = get_engine().aggregate_stacked(stacked, jnp.asarray(weights))
+        return self._agg.on_after_aggregation(new_w)
+
+
+# ---------------------------------------------------------------------------
+# async sinks — one facade over AsyncAggBuffer and HierarchyTree
+# ---------------------------------------------------------------------------
+
+class AsyncSink(abc.ABC):
+    """Barrier-free fold-at-arrival endpoint: the async driver and the
+    cross-silo async path submit deltas and poll for publishes through this
+    facade regardless of the concrete sink's topology."""
+
+    name: str = "async_sink"
+    raw: Any = None
+
+    @abc.abstractmethod
+    def submit(self, rank: int, tree: PyTree, weight: float, client_version: int) -> str:
+        """Fold one arrival; returns the staleness verdict string."""
+
+    @abc.abstractmethod
+    def try_publish(self) -> Optional[Tuple[int, PyTree]]:
+        """``(new_version, model)`` if a publish happened, else None."""
+
+    @property
+    @abc.abstractmethod
+    def version(self) -> int:
+        ...
+
+    @property
+    @abc.abstractmethod
+    def publish_k(self) -> int:
+        ...
+
+    @property
+    @abc.abstractmethod
+    def high_water(self) -> int:
+        ...
+
+    def statusz(self) -> Dict[str, Any]:
+        return self.raw.statusz() if hasattr(self.raw, "statusz") else {}
+
+
+class AsyncBufferSink(AsyncSink):
+    """Flat FedBuff buffer: publish when ``publish_k`` merges accumulated."""
+
+    name = "async_buffer"
+
+    def __init__(self, buffer: Any):
+        self.raw = buffer
+
+    def submit(self, rank: int, tree: PyTree, weight: float, client_version: int) -> str:
+        return self.raw.submit(rank, tree, weight, client_version)
+
+    def try_publish(self) -> Optional[Tuple[int, PyTree]]:
+        if not self.raw.ready():
+            return None
+        model = self.raw.publish()
+        if model is None:
+            return None
+        return int(self.raw.version), model
+
+    @property
+    def version(self) -> int:
+        return int(self.raw.version)
+
+    @property
+    def publish_k(self) -> int:
+        return int(self.raw.publish_k)
+
+    @property
+    def high_water(self) -> int:
+        return int(self.raw.depth_high_water)
+
+
+class HierarchySink(AsyncSink):
+    """Edge→regional→root tree: edges publish upward on their own cadence,
+    so a root publish is detected by watching the root version move."""
+
+    name = "hierarchy"
+
+    def __init__(self, tree: Any):
+        self.raw = tree
+        self._last_seen_version = int(tree.version)
+
+    def submit(self, rank: int, tree: PyTree, weight: float, client_version: int) -> str:
+        return self.raw.submit(rank, tree, weight, client_version)
+
+    def try_publish(self) -> Optional[Tuple[int, PyTree]]:
+        v = int(self.raw.version)
+        if v == self._last_seen_version:
+            return None
+        self._last_seen_version = v
+        model = self.raw.latest_model()
+        if model is None:
+            return None
+        return v, model
+
+    @property
+    def version(self) -> int:
+        return int(self.raw.version)
+
+    @property
+    def publish_k(self) -> int:
+        return int(self.raw.edges[0].buffer.publish_k)
+
+    @property
+    def high_water(self) -> int:
+        return max(int(n.buffer.depth_high_water) for n in self.raw.nodes())
+
+
+def as_async_sink(sink: Any) -> AsyncSink:
+    """Wrap a raw ``AsyncAggBuffer`` / ``HierarchyTree`` (or pass an
+    :class:`AsyncSink` through untouched)."""
+    if isinstance(sink, AsyncSink):
+        return sink
+    from ..distributed.hierarchy import HierarchyTree
+
+    if isinstance(sink, HierarchyTree):
+        return HierarchySink(sink)
+    return AsyncBufferSink(sink)
+
+
+# ---------------------------------------------------------------------------
+# shared round services
+# ---------------------------------------------------------------------------
+
+def flight_recorded(role: str):
+    """The one place fronts install the flight recorder (crash forensics:
+    last-N spans + env snapshot dumped on unhandled errors)."""
+    return flight_recorder.installed(role=role)
+
+
+def run_local_round(train_fn: Callable[[], Any], args: Any, round_idx: int, *, rank: Any = None) -> Any:
+    """Client-side local-round scaffolding every front shares: the
+    ``client.train`` span plus the chaos knobs — ``chaos_train_delay_s``
+    (inflates measured train time for straggler drills) and
+    ``chaos_raise_at_round`` (scheduled failure exercising the crash path)."""
+    chaos_delay = float(getattr(args, "chaos_train_delay_s", 0) or 0)
+    chaos_raise_at = getattr(args, "chaos_raise_at_round", None)
+    with tel.span("client.train", round=int(round_idx)):
+        if chaos_delay > 0:
+            time.sleep(chaos_delay)  # fedlint: disable=bare-sleep chaos straggler injection, not a poll loop
+        if chaos_raise_at is not None and int(chaos_raise_at) == int(round_idx):
+            raise RuntimeError(f"chaos: injected failure at round {round_idx} on rank {rank}")
+        return train_fn()
+
+
+def decompress_arrival(model_params: Any, sender_id: Any) -> Any:
+    """Server-side arrival boundary: rehydrate a compressed uplink payload
+    (identity for plain trees) under the ``server.decompress`` span."""
+    from ...utils.compression import decompress_comm_payload, is_comm_payload
+
+    if not is_comm_payload(model_params):
+        return model_params
+    with tel.span("server.decompress", sender=int(sender_id)):
+        return decompress_comm_payload(model_params)
+
+
+def compress_upload(compressor: Any, weights: Any) -> Any:
+    """Client-side upload boundary: run the configured uplink compressor
+    (error feedback lives inside it) under the ``client.compress`` span."""
+    if compressor is None:
+        return weights
+    with tel.span("client.compress", kind=str(getattr(compressor, "kind", "?"))):
+        return compressor.compress_tree(weights)
+
+
+class RoundCheckpointer:
+    """The one implementation of round-boundary durability the sp front and
+    the cross-silo server used to carry separately.
+
+    Semantics preserved exactly (the SIGKILL-resume drills assert
+    bit-identical stores):
+
+    - final round (and chaos kills) drain in-flight async saves first, then
+      save with ``wait=True`` — the last round must be durable, never
+      best-effort; the chaos drill models "watermark at k-1, round k torn".
+    - sync mode steps the store by ``round_idx``; async mode keeps its own
+      monotone step counter (mid-window checkpoints outnumber rounds) and
+      persists the buffer's pytree state + meta sidecar next to the model.
+    - ``chaos_kill_after_round`` / ``chaos_kill_after_merges`` SIGKILL the
+      process right after the checkpoint enqueue.
+    """
+
+    def __init__(self, store: Any, args: Any, *, async_mode: bool = False):
+        self.store = store
+        self.args = args
+        self.async_mode = bool(async_mode)
+        latest = store.latest_complete_round()
+        self._ckpt_step = (int(latest) + 1) if latest is not None else 0
+
+    def wait(self) -> None:
+        self.store.wait()
+
+    def save(
+        self,
+        round_idx: int,
+        state: Dict[str, Any],
+        *,
+        cohort: Sequence[int] = (),
+        health: Any = None,
+        extra_meta: Optional[Dict[str, Any]] = None,
+        final: bool = False,
+        async_buffer: Any = None,
+    ) -> None:
+        kill_after = getattr(self.args, "chaos_kill_after_round", None)
+        kill_now = kill_after is not None and int(round_idx) == int(kill_after)
+        kill_after_merges = getattr(self.args, "chaos_kill_after_merges", None)
+        kill_committed = False
+
+        meta: Optional[Dict[str, Any]] = dict(extra_meta) if extra_meta is not None else None
+        step = int(round_idx)
+        if self.async_mode and async_buffer is not None:
+            # async saves happen mid-window too (same FL round, newer buffer
+            # contents), so the checkpoint step is a monotone save counter and
+            # the FL round travels in the meta; the buffer snapshot carries
+            # the partial accumulator + pending deltas + staleness clock
+            state = dict(state)
+            bstate = async_buffer.export_pytree_state()
+            if bstate:
+                state["async_buffer"] = bstate
+            meta = dict(meta or {})
+            meta["async_buffer"] = async_buffer.export_meta()
+            meta["fl_round_idx"] = int(round_idx)
+            step = self._ckpt_step
+            self._ckpt_step += 1
+            # async drill: SIGKILL right after the Nth merge's snapshot
+            # COMMITS — the machine dies with a durable mid-window checkpoint,
+            # so resume must rebuild a NON-EMPTY buffer (vs
+            # chaos_kill_after_round, which models the torn-save shape)
+            if kill_after_merges is not None and int(async_buffer.merges_total) == int(kill_after_merges):
+                kill_committed = True
+
+        if final or kill_now or kill_committed:
+            # the run's last round must be durable, never best-effort: drain
+            # any in-flight async save so this one cannot be dropped, then
+            # save synchronously. The chaos kill also drains first: real
+            # rounds take long enough that earlier finalizes always land, so
+            # the drill models "watermark at round k-1, round k's save torn".
+            self.store.wait()
+        self.store.save_round(
+            step,
+            state,
+            cohort=[int(c) for c in cohort],
+            health=health,
+            extra_meta=meta,
+            wait=final or kill_committed,
+        )
+        if kill_now or kill_committed:
+            import os
+            import signal
+
+            log.warning("chaos: SIGKILL self after round %d checkpoint enqueue", round_idx)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class RoundEngine:
+    """The synchronous round loop, once.
+
+    A front supplies a strategy + sink pair plus the handful of closures
+    that are genuinely front-specific (sampling bounds, model install,
+    eval, resume, checkpoint); the engine owns the loop structure: span
+    taxonomy (``<prefix>.round`` > ``.sample`` / ``.aggregate`` /
+    ``.eval``), the shared ``Context`` cohort publication, eval cadence,
+    per-round telemetry summary, and the ``fedml_engine_*`` series.
+    """
+
+    def __init__(
+        self,
+        args: Any,
+        strategy: ClientExecutionStrategy,
+        sink: AggregationSink,
+        *,
+        sample_fn: Callable[[int], List[int]],
+        install_fn: Callable[[PyTree], None],
+        eval_fn: Callable[[int], Optional[Dict[str, float]]],
+        resume_fn: Optional[Callable[[PyTree], Tuple[PyTree, int]]] = None,
+        checkpoint_fn: Optional[Callable[[int, PyTree, List[int], bool], None]] = None,
+        finalize_fn: Optional[Callable[[PyTree], None]] = None,
+        span_prefix: str = "fedavg",
+        round_span_attrs: Optional[Dict[str, Any]] = None,
+        metrics_history: Optional[List[Dict[str, float]]] = None,
+        log_summary: bool = True,
+    ):
+        self.args = args
+        self.strategy = strategy
+        self.sink = sink
+        self.sample_fn = sample_fn
+        self.install_fn = install_fn
+        self.eval_fn = eval_fn
+        self.resume_fn = resume_fn
+        self.checkpoint_fn = checkpoint_fn
+        self.finalize_fn = finalize_fn
+        self.span_prefix = span_prefix
+        self.round_span_attrs = dict(round_span_attrs or {})
+        self.metrics_history = metrics_history if metrics_history is not None else []
+        self.log_summary = bool(log_summary)
+
+    def run(self, w_global: PyTree) -> PyTree:
+        from ..alg_frame.context import Context
+
+        p = self.span_prefix
+        comm_round = int(getattr(self.args, "comm_round", 10))
+        start_round = 0
+        if self.resume_fn is not None:
+            w_global, start_round = self.resume_fn(w_global)
+        freq = int(getattr(self.args, "frequency_of_the_test", 5))
+        for round_idx in range(start_round, comm_round):
+            log.info("================ Communication round : %d", round_idx)
+            t0 = time.perf_counter()
+            with tel.span(f"{p}.round", round=round_idx, **self.round_span_attrs):
+                with tel.span(f"{p}.sample", round=round_idx):
+                    cohort = self.sample_fn(round_idx)
+                Context().add("client_indexes_of_round", cohort)
+                result = self.strategy.run_round(round_idx, w_global, cohort)
+                with tel.span(f"{p}.aggregate", round=round_idx, k=result.k):
+                    w_global = self.sink.fold(round_idx, w_global, result)
+                self.install_fn(w_global)
+                if self.checkpoint_fn is not None:
+                    self.checkpoint_fn(round_idx, w_global, cohort, round_idx == comm_round - 1)
+                if eval_due(round_idx, comm_round, freq):
+                    with tel.span(f"{p}.eval", round=round_idx):
+                        metrics = self.eval_fn(round_idx)
+                    if metrics is not None:
+                        self.metrics_history.append(metrics)
+            tel.counter("engine.rounds").add(1)
+            tel.histogram("engine.round_seconds").observe(time.perf_counter() - t0)
+            if self.log_summary:
+                mlops.log_telemetry_summary(round_idx)
+        if self.finalize_fn is not None:
+            self.finalize_fn(w_global)
+        return w_global

@@ -10,12 +10,12 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Any, Dict, Optional
 
 from ... import mlops
 from ...core import telemetry as tel
-from ...core.telemetry import flight_recorder, trace_context
+from ...core.engine import compress_upload, flight_recorded, run_local_round
+from ...core.telemetry import trace_context
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ...parallel.multihost import broadcast_model_params, broadcast_round_metadata, process_count
@@ -48,7 +48,7 @@ class ClientMasterManager(FedMLCommManager):
     def run(self) -> None:
         # an exception anywhere in the client's receive loop (trainer bug,
         # protocol violation) writes one crash dump before propagating
-        with flight_recorder.installed(role="cross_silo_client"):
+        with flight_recorded(role="cross_silo_client"):
             super().run()
 
     def register_message_receive_handlers(self) -> None:
@@ -150,9 +150,7 @@ class ClientMasterManager(FedMLCommManager):
     def send_model_to_server(self, receive_id: int, weights, local_sample_num) -> None:
         mlops.event("comm_c2s", event_started=True, event_value=str(self.args.round_idx))
         with tel.span("client.upload", round=int(self.args.round_idx)):
-            if self._comm_compressor is not None:
-                with tel.span("client.compress", kind=self._comm_compressor.kind):
-                    weights = self._comm_compressor.compress_tree(weights)
+            weights = compress_upload(self._comm_compressor, weights)
             message = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.client_real_id, receive_id)
             message.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
             message.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, int(local_sample_num))
@@ -199,20 +197,13 @@ class ClientMasterManager(FedMLCommManager):
             )
             broadcast_model_params(self.trainer_dist_adapter.get_model_params(), is_source=True)
         mlops.event("train", event_started=True, event_value=str(self.args.round_idx))
-        # chaos knobs (tests + controlled fault drills): an artificial delay
-        # inflates this client's measured train time so the server's
-        # straggler detector fires; a scheduled raise exercises the flight
-        # recorder's crash-dump path inside a live round span.
-        chaos_delay = float(getattr(self.args, "chaos_train_delay_s", 0) or 0)
-        chaos_raise_at = getattr(self.args, "chaos_raise_at_round", None)
-        with tel.span("client.train", round=int(self.args.round_idx)):
-            if chaos_delay > 0:
-                time.sleep(chaos_delay)  # fedlint: disable=bare-sleep chaos injection delay, not a retry loop
-            if chaos_raise_at is not None and int(chaos_raise_at) == int(self.args.round_idx):
-                raise RuntimeError(
-                    f"chaos: injected failure at round {self.args.round_idx} "
-                    f"on rank {self.client_real_id}"
-                )
-            weights, local_sample_num = self.trainer_dist_adapter.train(self.args.round_idx)
+        # the client.train span + chaos knobs (straggler delay, scheduled
+        # raise) live in the engine's shared local-round scaffolding
+        weights, local_sample_num = run_local_round(
+            lambda: self.trainer_dist_adapter.train(self.args.round_idx),
+            self.args,
+            int(self.args.round_idx),
+            rank=self.client_real_id,
+        )
         mlops.event("train", event_started=False, event_value=str(self.args.round_idx))
         self.send_model_to_server(0, weights, local_sample_num)

@@ -235,17 +235,14 @@ class FedMLAggregator:
 
 def select_data_silos(round_idx: int, client_num_in_total: int, client_num_per_round: int) -> List[int]:
     """Round-seeded silo sampling (reference fedml_aggregator.py
-    data_silo_selection; np.random.seed(round_idx) keeps runs reproducible
-    and bit-comparable with the reference's sampling discipline). Shared by
-    the FL aggregator, the FA adapters and the sp simulators."""
-    if client_num_per_round >= client_num_in_total:
-        return list(range(client_num_in_total))
-    np.random.seed(round_idx)
-    return list(np.random.choice(range(client_num_in_total), client_num_per_round, replace=False))
+    data_silo_selection). Shared by the FL aggregator, the FA adapters and
+    the sp simulators; the sampling discipline itself lives in the engine."""
+    from ...core.engine import sample_silos
+
+    return sample_silos(round_idx, client_num_in_total, client_num_per_round)
 
 
 def select_clients(round_idx: int, client_id_list_in_total: List[int], client_num_per_round: int) -> List[int]:
-    if client_num_per_round >= len(client_id_list_in_total):
-        return list(client_id_list_in_total)
-    np.random.seed(round_idx)
-    return list(np.random.choice(client_id_list_in_total, client_num_per_round, replace=False))
+    from ...core.engine import sample_from_pool
+
+    return sample_from_pool(round_idx, client_id_list_in_total, client_num_per_round)

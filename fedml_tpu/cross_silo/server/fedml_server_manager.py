@@ -13,10 +13,11 @@ from typing import Any, Dict, List, Optional
 
 from ... import mlops
 from ...core import telemetry as tel
+from ...core.engine import RemoteCommStrategy, RoundCheckpointer, decompress_arrival, flight_recorded
 from ...core.resilience import QuorumPolicy, RoundQuorum, RoundStateStore, note, overprovisioned_cohort_size
 from ...core.resilience import quorum as quorum_mod
 from ...core.resilience.round_state import restore_numpy_rng
-from ...core.telemetry import flight_recorder, statusz, trace_context
+from ...core.telemetry import statusz, trace_context
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ..message_define import MyMessage
@@ -49,7 +50,10 @@ class FedMLServerManager(FedMLCommManager):
         # comm_round publishes — no per-cohort barrier anywhere
         self._async_mode = bool(getattr(args, "async_rounds", False))
         self._silo_of: Dict[int, int] = {}
-        self._ckpt_step = 0
+        # broadcast half of the engine's remote-comm strategy: arrivals come
+        # back through the message handlers (quorum/staleness verdicts), so
+        # only the server.broadcast side runs here
+        self._strategy = RemoteCommStrategy(self.send_message_sync_model_to_client)
         # --- resilience: quorum rounds + durable round state ---------------
         self._quorum_policy = QuorumPolicy.from_args(args)
         self._round_quorum: Optional[RoundQuorum] = None
@@ -59,11 +63,13 @@ class FedMLServerManager(FedMLCommManager):
         self._round_lock = threading.RLock()
         self._deadline_timer: Optional[threading.Timer] = None
         self._round_store: Optional[RoundStateStore] = None
+        self._checkpointer: Optional[RoundCheckpointer] = None
         rdir = getattr(args, "resilience_dir", None)
         if rdir:
             self._round_store = RoundStateStore(str(rdir))
-            latest = self._round_store.latest_complete_round()
-            self._ckpt_step = 0 if latest is None else int(latest) + 1
+            self._checkpointer = RoundCheckpointer(
+                self._round_store, args, async_mode=self._async_mode
+            )
             if getattr(args, "resume", False):
                 self._try_resume()
 
@@ -125,7 +131,7 @@ class FedMLServerManager(FedMLCommManager):
                          dmesh.configured_spec())
         # the whole receive loop runs under the flight recorder: an exception
         # in any handler produces one crash dump with the open round span
-        with flight_recorder.installed(role="cross_silo_server"):
+        with flight_recorded(role="cross_silo_server"):
             self._start_statusz_if_configured()
             try:
                 super().run()
@@ -362,13 +368,10 @@ class FedMLServerManager(FedMLCommManager):
 
     def handle_message_receive_model_from_client(self, msg_params: Message) -> None:
         sender_id = msg_params.get_sender_id()
-        model_params = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        model_params = decompress_arrival(
+            msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS), sender_id
+        )
         local_sample_number = msg_params.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
-        from ...utils.compression import decompress_comm_payload, is_comm_payload
-
-        if is_comm_payload(model_params):
-            with tel.span("server.decompress", sender=int(sender_id)):
-                model_params = decompress_comm_payload(model_params)
         delta_round = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
         header = trace_context.telemetry_header(msg_params)
         # the aggregator interface is duck-typed (fa/cross_silo.py adapts an
@@ -518,68 +521,29 @@ class FedMLServerManager(FedMLCommManager):
             return
         self._select_cohort()
         self._begin_round_trace()
-        with tel.span(
-            "server.broadcast", round=int(self.args.round_idx), receivers=len(self.client_id_list_in_this_round)
-        ):
-            for idx, receiver_id in enumerate(self.client_id_list_in_this_round):
-                self.send_message_sync_model_to_client(receiver_id, global_model_params, self.data_silo_index_list[idx])
+        self._strategy.broadcast(
+            int(self.args.round_idx), global_model_params,
+            self.client_id_list_in_this_round, self.data_silo_index_list,
+        )
         self._begin_quorum_round()
         mlops.event("server.wait", event_started=True, event_value=str(self.args.round_idx))
 
     def _save_round_state(self, round_idx: int, global_model_params, *, final: bool = False) -> None:
-        """Durable round boundary: async checkpoint enqueue + chaos kill hook
-        (``args.chaos_kill_after_round``: SIGKILL self right after the
-        enqueue, so the kill-and-resume e2e exercises the watermark). The
-        final round drains the writer and saves synchronously — the finished
-        model must be durable, never best-effort."""
-        if self._round_store is None:
+        """Durable round boundary, owned by the engine's RoundCheckpointer:
+        async checkpoint enqueue, drain-then-sync-save on the final round,
+        mid-window async buffer snapshots, and both chaos SIGKILL drills
+        (``chaos_kill_after_round`` / ``chaos_kill_after_merges``)."""
+        if self._checkpointer is None:
             return
-        kill_after = getattr(self.args, "chaos_kill_after_round", None)
-        kill_now = kill_after is not None and int(round_idx) == int(kill_after)
-        # async drill (``args.chaos_kill_after_merges``): SIGKILL right after
-        # the Nth merge's snapshot COMMITS — the machine dies with a durable
-        # mid-window checkpoint, so resume must rebuild a NON-EMPTY buffer
-        # (vs chaos_kill_after_round, which models the torn-save shape)
-        kill_merges = getattr(self.args, "chaos_kill_after_merges", None)
-        kill_committed = False
-        if self._async_mode and kill_merges is not None:
-            kill_committed = int(self.aggregator.async_buffer.merges_total) == int(kill_merges)
-        if final or kill_now or kill_committed:
-            # drain before the final (sync) save so it cannot be dropped; the
-            # chaos kill also drains first so earlier rounds are committed and
-            # the drill models "watermark at round k-1, round k's save torn"
-            self._round_store.wait()
         fleet = getattr(self.aggregator, "fleet", None)
-        state = {"model": global_model_params}
-        extra_meta = None
-        step = int(round_idx)
-        if self._async_mode:
-            # async saves happen mid-window too (same FL round, newer buffer
-            # contents), so the checkpoint step is a monotone save counter and
-            # the FL round travels in the meta; the buffer snapshot carries
-            # the partial accumulator + pending deltas + staleness clock
-            buf = self.aggregator.async_buffer
-            bstate = buf.export_pytree_state()
-            if bstate:
-                state["async_buffer"] = bstate
-            extra_meta = {"async_buffer": buf.export_meta(),
-                          "fl_round_idx": int(round_idx)}
-            step = self._ckpt_step
-            self._ckpt_step += 1
-        self._round_store.save_round(
-            step,
-            state,
-            cohort=[int(c) for c in (self.client_id_list_in_this_round or [])],
+        self._checkpointer.save(
+            int(round_idx),
+            {"model": global_model_params},
+            cohort=self.client_id_list_in_this_round or [],
             health=(fleet.health.export_state() if fleet is not None else None),
-            extra_meta=extra_meta,
-            wait=final or kill_committed,
+            final=final,
+            async_buffer=(self.aggregator.async_buffer if self._async_mode else None),
         )
-        if kill_now or kill_committed:
-            import os
-            import signal
-
-            log.warning("chaos: SIGKILL self after round %d checkpoint enqueue", round_idx)
-            os.kill(os.getpid(), signal.SIGKILL)
 
     def _export_fleet_trace_if_configured(self) -> None:
         """Write the fleet Perfetto JSON when ``args.fleet_trace`` names a

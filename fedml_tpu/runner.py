@@ -35,6 +35,13 @@ class FedMLRunner:
         server_aggregator: Optional[Any] = None,
     ):
         self.args = args
+        if getattr(args, "placement", None):
+            # args.placement: a committed PlacementPlan JSON path, or "auto"
+            # for a cost-model pick — resolved BEFORE dispatch so the plan's
+            # mesh/strategy/async knobs shape which runner we build
+            from .core.engine import resolve_placement
+
+            resolve_placement(args)
         if args.training_type == FEDML_TRAINING_PLATFORM_SIMULATION:
             self.runner = self._init_simulation_runner(args, device, dataset, model, client_trainer, server_aggregator)
         elif args.training_type == FEDML_TRAINING_PLATFORM_CROSS_SILO:

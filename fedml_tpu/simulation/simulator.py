@@ -59,11 +59,11 @@ class SimulatorSingleProcess:
         self.fl_trainer = API(args, device, dataset, model, client_trainer, server_aggregator)
 
     def run(self):
-        from ..core.telemetry import flight_recorder
+        from ..core.engine import flight_recorded
 
         # a crash mid-simulation leaves a dump with the open round span and
         # the last-N events instead of just a traceback
-        with flight_recorder.installed(role="sp_simulator"):
+        with flight_recorded(role="sp_simulator"):
             return self.fl_trainer.train()
 
 

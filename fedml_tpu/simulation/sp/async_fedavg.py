@@ -14,6 +14,13 @@ arrival applies
 The client then re-dispatches with the fresh global model, keeping
 ``client_num_per_round`` clients in flight — mirroring the reference's
 always-busy MPI workers without processes.
+
+LEGACY — not ported to ``core.engine.round_engine``. See
+:data:`LEGACY_REASON`: per-arrival global mixing has no round boundary and
+no buffer, so neither the engine's synchronous loop nor its AsyncSink
+facade (submit/try_publish over a FedBuff buffer or hierarchy) describes
+it. The maintained async path is the buffered one
+(``backend='vmap_async'`` / ``args.async_rounds`` on cross-silo).
 """
 
 from __future__ import annotations
@@ -29,9 +36,21 @@ from .fedavg_api import FedAvgAPI
 
 log = logging.getLogger(__name__)
 
+# Why this front skips the unified round engine (ISSUE 11 satellite): FedAsync
+# mixes each arrival straight into w_global — there is no publish_k window, no
+# buffered fold, and no round barrier, so it matches neither RoundEngine.run
+# nor the AsyncSink submit/try_publish contract. Kept for algorithm parity
+# with the reference; new async work belongs on the FedBuff path.
+LEGACY_REASON = (
+    "FedAsync per-arrival global mixing predates the async buffer: no round "
+    "boundary, no publish window — the engine's strategies/sinks do not apply. "
+    "Use the buffered async path (vmap_async / async_rounds) for maintained work."
+)
+
 
 class AsyncFedAvgAPI(FedAvgAPI):
     _warned_agg_defense = False
+    _warned_legacy = False
 
     class _defender_disabled:
         """Cohort defenses (aggregation rules, paired before/after
@@ -66,6 +85,9 @@ class AsyncFedAvgAPI(FedAvgAPI):
         AsyncFedAvgAPI._warned_agg_defense = True
 
     def train(self) -> Dict[str, float]:
+        if not AsyncFedAvgAPI._warned_legacy:
+            log.warning("AsyncFedAvgAPI is a legacy front: %s", LEGACY_REASON)
+            AsyncFedAvgAPI._warned_legacy = True
         args = self.args
         w_global = self.model_trainer.get_model_params()
         n_total = int(args.client_num_in_total)

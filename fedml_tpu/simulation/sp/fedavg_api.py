@@ -25,10 +25,16 @@ from ...constants import (
     FEDML_FEDERATED_OPTIMIZER_SCAFFOLD,
 )
 from ... import mlops
-from ...core import telemetry as tel
 from ...core.aggregation.agg_operator import fednova_aggregate, scaffold_aggregate, uniform_average
 from ...core.aggregation.server_optimizer import FedOptServer
 from ...core.alg_frame.context import Context
+from ...core.engine import (
+    AlgFrameSink,
+    InProcessSequentialStrategy,
+    RoundCheckpointer,
+    RoundEngine,
+    sample_cohort,
+)
 from ...ml.aggregator import create_server_aggregator
 from ...ml.trainer.trainer_creator import create_model_trainer
 from ...utils.pytree import tree_sub, tree_zeros_like
@@ -87,11 +93,13 @@ class FedAvgAPI:
         # durable round state (core.resilience): every round boundary is
         # checkpointed async; --resume restarts from the last complete round
         self._round_store = None
+        self._checkpointer: Optional[RoundCheckpointer] = None
         rdir = getattr(args, "resilience_dir", None)
         if rdir:
             from ...core.resilience import RoundStateStore
 
             self._round_store = RoundStateStore(str(rdir))
+            self._checkpointer = RoundCheckpointer(self._round_store, args)
 
     def _setup_clients(self, train_data_local_num_dict, train_data_local_dict, test_data_local_dict) -> None:
         """One Client object per sampled slot, reused across rounds
@@ -110,15 +118,9 @@ class FedAvgAPI:
             self.client_list.append(c)
 
     def _client_sampling(self, round_idx: int, client_num_in_total: int, client_num_per_round: int) -> List[int]:
-        """Bit-exact mirror of reference _client_sampling (fedavg_api.py:127)."""
-        if client_num_in_total == client_num_per_round:
-            client_indexes = [i for i in range(client_num_in_total)]
-        else:
-            num_clients = min(client_num_per_round, client_num_in_total)
-            np.random.seed(round_idx)
-            client_indexes = np.random.choice(range(client_num_in_total), num_clients, replace=False)
-        log.info("client_indexes = %s", client_indexes)
-        return list(client_indexes)
+        """Bit-exact mirror of reference _client_sampling (fedavg_api.py:127),
+        now owned by the engine (core.engine.sample_cohort)."""
+        return sample_cohort(round_idx, client_num_in_total, client_num_per_round)
 
     # --- durable round state ------------------------------------------
     def _round_state_dict(self, w_global) -> Dict[str, Any]:
@@ -165,85 +167,44 @@ class FedAvgAPI:
         return w_global, rs.round_idx + 1
 
     def _save_round_state(self, round_idx: int, w_global, cohort: List[int], *, final: bool = False) -> None:
-        if self._round_store is None:
+        """Round-boundary durability, owned by the engine's RoundCheckpointer
+        (drain-then-sync-save on the final round, chaos SIGKILL drills)."""
+        if self._checkpointer is None:
             return
-        kill_after = getattr(self.args, "chaos_kill_after_round", None)
-        kill_now = kill_after is not None and int(round_idx) == int(kill_after)
-        if final or kill_now:
-            # the run's last round must be durable, never best-effort: drain
-            # any in-flight async save so this one cannot be dropped, then
-            # save synchronously. The chaos kill also drains first: real
-            # rounds take long enough that earlier finalizes always land, so
-            # the drill models "watermark at round k-1, round k's save torn".
-            self._round_store.wait()
-        self._round_store.save_round(
+        self._checkpointer.save(
             int(round_idx),
             self._round_state_dict(w_global),
-            cohort=[int(c) for c in cohort],
+            cohort=cohort,
             extra_meta={"trainer_round": getattr(self.model_trainer, "_round", None)},
-            wait=final,
+            final=final,
         )
-        if kill_now:
-            import os
-            import signal
-
-            log.warning("chaos: SIGKILL self after round %d checkpoint enqueue", round_idx)
-            os.kill(os.getpid(), signal.SIGKILL)
 
     # ------------------------------------------------------------------
     def train(self) -> Dict[str, float]:
-        w_global = self.model_trainer.get_model_params()
-        comm_round = int(getattr(self.args, "comm_round", 10))
-        w_global, start_round = self._try_resume(w_global)
-        for round_idx in range(start_round, comm_round):
-            log.info("================ Communication round : %d", round_idx)
-            with tel.span("fedavg.round", round=round_idx, optimizer=self.fed_opt):
-                with tel.span("fedavg.sample", round=round_idx):
-                    client_indexes = self._client_sampling(
-                        round_idx, int(self.args.client_num_in_total), int(self.args.client_num_per_round)
-                    )
-                Context().add("client_indexes_of_round", client_indexes)
-                w_locals: List[Tuple[float, Any]] = []
-                for idx, client in enumerate(self.client_list):
-                    client_idx = client_indexes[idx]
-                    client.update_local_dataset(
-                        client_idx,
-                        self.train_data_local_dict[client_idx],
-                        self.test_data_local_dict[client_idx],
-                        self.train_data_local_num_dict[client_idx],
-                    )
-                    if self.fed_opt == FEDML_FEDERATED_OPTIMIZER_SCAFFOLD:
-                        self.model_trainer.set_control_variate(self._scaffold_c)
-                    elif self.fed_opt == FEDML_FEDERATED_OPTIMIZER_MIME:
-                        self.model_trainer.set_server_momentum(self._mime_s)
-                    with tel.span("fedavg.client_train", round=round_idx, client=int(client_idx)):
-                        w = client.train(w_global)
-                    payload = getattr(self.model_trainer, "round_payload", None)
-                    if self.fed_opt in (
-                        FEDML_FEDERATED_OPTIMIZER_FEDNOVA,
-                        FEDML_FEDERATED_OPTIMIZER_SCAFFOLD,
-                        FEDML_FEDERATED_OPTIMIZER_MIME,
-                    ) and payload is not None:
-                        w_locals.append((client.get_sample_number(), payload))
-                    else:
-                        w_locals.append((client.get_sample_number(), w))
-                with tel.span("fedavg.aggregate", round=round_idx, k=len(w_locals)):
-                    w_global = self._server_update(w_global, w_locals)
-                self.model_trainer.set_model_params(w_global)
-                self.aggregator.set_model_params(w_global)
-                self._save_round_state(
-                    round_idx, w_global, client_indexes, final=(round_idx == comm_round - 1)
-                )
-
-                freq = int(getattr(self.args, "frequency_of_the_test", 5))
-                if round_idx == comm_round - 1 or (freq > 0 and round_idx % freq == 0):
-                    with tel.span("fedavg.eval", round=round_idx):
-                        metrics = self._test_global(round_idx)
-                    self.metrics_history.append(metrics)
-            mlops.log_telemetry_summary(round_idx)
-        if self._round_store is not None:
-            self._round_store.wait()
+        engine = RoundEngine(
+            self.args,
+            InProcessSequentialStrategy(self),
+            AlgFrameSink(self._server_update),
+            sample_fn=lambda r: self._client_sampling(
+                r, int(self.args.client_num_in_total), int(self.args.client_num_per_round)
+            ),
+            install_fn=self._install_global,
+            eval_fn=self._test_global,
+            resume_fn=self._try_resume,
+            checkpoint_fn=(self._save_round_state_cb if self._checkpointer is not None else None),
+            finalize_fn=(lambda w: self._round_store.wait()) if self._round_store is not None else None,
+            round_span_attrs={"optimizer": self.fed_opt},
+            metrics_history=self.metrics_history,
+        )
+        engine.run(self.model_trainer.get_model_params())
         return self.metrics_history[-1] if self.metrics_history else {}
+
+    def _install_global(self, w_global) -> None:
+        self.model_trainer.set_model_params(w_global)
+        self.aggregator.set_model_params(w_global)
+
+    def _save_round_state_cb(self, round_idx: int, w_global, cohort: List[int], final: bool) -> None:
+        self._save_round_state(round_idx, w_global, cohort, final=final)
 
     # ------------------------------------------------------------------
     def _server_update(self, w_global, w_locals):

@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
+from ...core.engine import GroupedSequentialStrategy, HookedAverageSink, RoundEngine
 from .fedavg_api import FedAvgAPI
 from .client import Client
 
@@ -48,16 +49,6 @@ class HierarchicalTrainer(FedAvgAPI):
                    train_data_local_num_dict[0], args, self.device, self.model_trainer)
         ]
 
-    def _sample_groups(self, round_idx: int) -> Dict[int, List[int]]:
-        sampled = self._client_sampling(
-            round_idx, int(self.args.client_num_in_total), int(self.args.client_num_per_round)
-        )
-        group_to_sampled: Dict[int, List[int]] = {}
-        for client_idx in sampled:
-            group_to_sampled.setdefault(int(self.group_indexes[client_idx]), []).append(client_idx)
-        log.info("client_indexes of each group = %s", group_to_sampled)
-        return group_to_sampled
-
     def _train_one_client(self, client_idx: int, w) -> Tuple[int, Any]:
         client = self.client_list[0]
         client.update_local_dataset(
@@ -81,20 +72,22 @@ class HierarchicalTrainer(FedAvgAPI):
         return n_group, w_group
 
     def train(self) -> Dict[str, float]:
-        w_global = self.model_trainer.get_model_params()
-        comm_round = int(getattr(self.args, "comm_round", 10))
-        for round_idx in range(comm_round):
-            log.info("================ Global Communication round : %d", round_idx)
-            group_to_sampled = self._sample_groups(round_idx)
-            w_groups = [
-                self._group_train(clients, w_global)
-                for _, clients in sorted(group_to_sampled.items())
-            ]
-            lst = self.aggregator.on_before_aggregation(w_groups)
-            w_global = self.aggregator.on_after_aggregation(self.aggregator.aggregate(lst))
-            self.model_trainer.set_model_params(w_global)
-            self.aggregator.set_model_params(w_global)
-            freq = int(getattr(self.args, "frequency_of_the_test", 5))
-            if round_idx == comm_round - 1 or (freq > 0 and round_idx % freq == 0):
-                self.metrics_history.append(self._test_global(round_idx))
+        """Engine run: grouped-sequential strategy (per-group inner FedAvg)
+        feeding the plain hooks+average sink — the two-level fold."""
+        engine = RoundEngine(
+            self.args,
+            GroupedSequentialStrategy(self),
+            HookedAverageSink(self.aggregator),
+            sample_fn=lambda r: self._client_sampling(
+                r, int(self.args.client_num_in_total), int(self.args.client_num_per_round)
+            ),
+            install_fn=self._install_global,
+            eval_fn=self._test_global,
+            resume_fn=self._try_resume,
+            checkpoint_fn=(self._save_round_state_cb if self._checkpointer is not None else None),
+            finalize_fn=(lambda w: self._round_store.wait()) if self._round_store is not None else None,
+            round_span_attrs={"optimizer": "HierarchicalFL"},
+            metrics_history=self.metrics_history,
+        )
+        engine.run(self.model_trainer.get_model_params())
         return self.metrics_history[-1] if self.metrics_history else {}

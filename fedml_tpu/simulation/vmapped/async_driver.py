@@ -39,6 +39,7 @@ import numpy as np
 from ...core.aggregation.async_buffer import AsyncAggBuffer, buffer_from_args
 from ...core.aggregation.bucketed import get_engine
 from ...core.distributed.hierarchy import HierarchyTree
+from ...core.engine.round_engine import AsyncSink, as_async_sink
 from .vmap_fedavg import VmapFedAvgAPI
 
 log = logging.getLogger(__name__)
@@ -92,9 +93,10 @@ class DelayModel:
 class AsyncEventSim:
     """The discrete-event async federation loop over a buffer or hierarchy.
 
-    ``sink`` is either an :class:`AsyncAggBuffer` (the sim drives its
-    ``ready``/``publish`` cycle) or a :class:`HierarchyTree` (publishes
-    cascade inside ``submit``; the sim watches the root version). Each
+    ``sink`` is an :class:`AsyncAggBuffer`, a :class:`HierarchyTree`, or any
+    :class:`~fedml_tpu.core.engine.round_engine.AsyncSink` — raw sinks are
+    wrapped via ``as_async_sink``, so the loop speaks one submit/try_publish
+    vocabulary regardless of sink topology. Each
     arrival's submit + publish work is timed with ``perf_counter`` into
     ``server_seconds`` — the denominator of the bench's rounds/hr, which
     deliberately EXCLUDES delta generation (that is simulated client compute,
@@ -108,7 +110,7 @@ class AsyncEventSim:
                  delay: Optional[DelayModel] = None,
                  gen_batch: int = DEFAULT_GEN_BATCH,
                  on_publish: Optional[Callable[[int, PyTree], None]] = None):
-        self.sink = sink
+        self.sink: AsyncSink = as_async_sink(sink)
         self.train_batch = train_batch
         self.n_clients = int(n_clients)
         self.weights = (np.ones(self.n_clients, np.float64) if weights is None
@@ -117,8 +119,6 @@ class AsyncEventSim:
         self.delay = delay or DelayModel(self.n_clients)
         self.gen_batch = max(1, int(gen_batch))
         self.on_publish = on_publish
-        self._is_tree = isinstance(sink, HierarchyTree)
-        self._last_seen_version = int(sink.version)
         # virtual state
         self._events: List[Tuple[float, int, int, int]] = []  # (t, seq, client, version)
         self._seq = 0
@@ -135,7 +135,7 @@ class AsyncEventSim:
         self.server_seconds = 0.0
         self.gen_dispatches = 0  # device dispatches spent generating deltas
 
-    # --- sink facade -------------------------------------------------------
+    # --- sink facade (engine AsyncSink) ------------------------------------
     def _version(self) -> int:
         return int(self.sink.version)
 
@@ -144,22 +144,7 @@ class AsyncEventSim:
 
     def _try_publish(self) -> Optional[Tuple[int, PyTree]]:
         """(new_version, model) when a global publish happened, else None."""
-        if self._is_tree:
-            # edge/regional publishes cascaded inside submit; a root publish
-            # shows up as a version bump + a fresh latest_model
-            v = self._version()
-            if v == self._last_seen_version:
-                return None
-            self._last_seen_version = v
-            model = self.sink.latest_model()
-            return (v, model) if model is not None else None
-        if not self.sink.ready():
-            return None
-        model = self.sink.publish()
-        if model is None:
-            return None
-        self._last_seen_version = self._version()
-        return (self._last_seen_version, model)
+        return self.sink.try_publish()
 
     # --- dispatch / generation ---------------------------------------------
     def _dispatch(self, clients, now) -> None:
@@ -243,15 +228,11 @@ class AsyncEventSim:
         return self.stats()
 
     def _publish_k(self) -> int:
-        if self._is_tree:
-            return int(self.sink.edges[0].buffer.publish_k)
         return int(self.sink.publish_k)
 
     # --- stats -------------------------------------------------------------
     def _high_water(self) -> int:
-        if self._is_tree:
-            return max(n.buffer.depth_high_water for n in self.sink.nodes())
-        return int(self.sink.depth_high_water)
+        return int(self.sink.high_water)
 
     def stats(self) -> Dict[str, Any]:
         s = np.asarray(self.staleness_samples or [0], np.float64)

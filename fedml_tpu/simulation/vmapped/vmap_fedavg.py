@@ -21,9 +21,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core.alg_frame.context import Context
+from ...core.engine import (
+    RoundEngine,
+    StackedBucketedSink,
+    VmappedMegabatchStrategy,
+    sample_cohort,
+)
 from ...ml.aggregator import create_server_aggregator
 from ...ml.trainer.local_sgd import epoch_index_array, make_local_train_fn
-from ...core.aggregation.bucketed import get_engine
 
 log = logging.getLogger(__name__)
 
@@ -86,61 +91,32 @@ class VmapFedAvgAPI:
         )
 
     def _client_sampling(self, round_idx: int, client_num_in_total: int, client_num_per_round: int) -> List[int]:
-        if client_num_in_total == client_num_per_round:
-            return list(range(client_num_in_total))
-        np.random.seed(round_idx)
-        return list(np.random.choice(range(client_num_in_total), client_num_per_round, replace=False))
+        return sample_cohort(round_idx, client_num_in_total, client_num_per_round)
 
     # --- driver -----------------------------------------------------------
     def train(self) -> Dict[str, float]:
-        w_global = self.model.params
-        comm_round = int(getattr(self.args, "comm_round", 10))
-        for round_idx in range(comm_round):
-            client_indexes = self._client_sampling(
-                round_idx, int(self.args.client_num_in_total), int(self.args.client_num_per_round)
-            )
-            Context().add("client_indexes_of_round", client_indexes)
-            x, y, idx, mask = self._stack_clients(client_indexes)
-            rngs = jax.random.split(jax.random.PRNGKey(round_idx), len(client_indexes))
-            result = self._vmapped_train(w_global, x, y, idx, mask, rngs, None)
-            # result.params leaves have a leading client axis -> aggregate in place
-            weights = np.asarray(
-                [self.train_data_local_num_dict[i] for i in client_indexes], dtype=np.float32
-            )
-            weights = weights / weights.sum()
-            stacked = result.params
-            lst = self.aggregator.on_before_aggregation(
-                [(float(weights[k]), jax.tree.map(lambda l: l[k], stacked)) for k in range(len(client_indexes))]
-            ) if self.aggregator.enable_hooks and _hooks_active() else None
-            if lst is not None:
-                w_global = self.aggregator.aggregate(lst)
-            else:
-                # bucketed scan over the client axis: f32 temporaries stay
-                # O(bucket x model) and the compile is shared across cohort
-                # sizes that pad to the same bucket count
-                w_global = get_engine().aggregate_stacked(stacked, jnp.asarray(weights))
-            w_global = self.aggregator.on_after_aggregation(w_global)
-            self.aggregator.set_model_params(w_global)
-            freq = int(getattr(self.args, "frequency_of_the_test", 5))
-            if round_idx == comm_round - 1 or (freq > 0 and round_idx % freq == 0):
-                metrics = self.aggregator.test(self.test_global, self.device, self.args)
-                metrics["round"] = round_idx
-                log.info("vmap sim round %d: %s", round_idx, {k: round(float(v), 4) for k, v in metrics.items()})
-                self.metrics_history.append(metrics)
+        """One engine run: the vmapped megabatch strategy feeds the stacked
+        bucketed sink (hook-aware unstack only when middleware needs the
+        per-client list — see core.engine.StackedBucketedSink)."""
+        engine = RoundEngine(
+            self.args,
+            VmappedMegabatchStrategy(self),
+            StackedBucketedSink(self.aggregator),
+            sample_fn=lambda r: self._client_sampling(
+                r, int(self.args.client_num_in_total), int(self.args.client_num_per_round)
+            ),
+            install_fn=self.aggregator.set_model_params,
+            eval_fn=self._test_global,
+            span_prefix="fedavg",
+            round_span_attrs={"optimizer": "FedAvg", "front": "vmapped"},
+            metrics_history=self.metrics_history,
+        )
+        w_global = engine.run(self.model.params)
         self.model = self.model.clone_with(w_global)
         return self.metrics_history[-1] if self.metrics_history else {}
 
-
-def _hooks_active() -> bool:
-    """Unstack into per-client trees only when middleware actually needs the
-    list (defense/attack/dp enabled) — otherwise aggregate the stacked pytree
-    directly (no K-way unstack on the hot path)."""
-    from ...core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
-    from ...core.security.fedml_attacker import FedMLAttacker
-    from ...core.security.fedml_defender import FedMLDefender
-
-    return (
-        FedMLAttacker.get_instance().is_model_attack()
-        or FedMLDefender.get_instance().is_defense_enabled()
-        or FedMLDifferentialPrivacy.get_instance().is_dp_enabled()
-    )
+    def _test_global(self, round_idx: int) -> Dict[str, float]:
+        metrics = self.aggregator.test(self.test_global, self.device, self.args)
+        metrics["round"] = round_idx
+        log.info("vmap sim round %d: %s", round_idx, {k: round(float(v), 4) for k, v in metrics.items()})
+        return metrics

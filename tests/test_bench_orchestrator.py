@@ -211,6 +211,15 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
                           "async_publish_k": 32,
                           "async_parity_bit_exact": True,
                           "device": "TPU v5 lite"}, None),
+        "placement_search": ({"placement_plan": {
+                                  "async_fedbuff": {"fingerprint": "abc123",
+                                                    "strategy": "vmapped_megabatch",
+                                                    "publish_k": 8}},
+                              "placement_speedup": {"async_fedbuff": 4.07,
+                                                    "sync_agg": 3.14},
+                              "placement_plan_files": [
+                                  "PLACEMENT_PLAN_async_fedbuff.json"],
+                              "device": "TPU v5 lite"}, None),
     })
     with pytest.raises(SystemExit) as exc:
         bench.main()
@@ -238,6 +247,8 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
     assert out["async_rounds_per_hr"]["100000"] == 330000.0
     assert out["async_flatness_ratio"] == 1.06
     assert out["async_parity_bit_exact"] is True
+    assert out["placement_speedup"]["async_fedbuff"] == 4.07
+    assert out["placement_plan"]["async_fedbuff"]["publish_k"] == 8
     assert out["stages_failed"] == []
     # incremental artifacts landed (one per stage + final, same stamp file)
     arts = glob.glob(str(tmp_path / "BENCH_MEASURED_*.json"))

@@ -40,6 +40,11 @@ EXPORTED = {
     "fedml_async_model_version": "gauge",
     "fedml_hierarchy_forwards": "gauge",
     "fedml_hierarchy_forwards_total": "counter",
+    # round engine / placement search
+    "fedml_engine_rounds_total": "counter",
+    "fedml_engine_round_seconds": "histogram",
+    "fedml_placement_probes_total": "counter",
+    "fedml_placement_search_seconds": "histogram",
     # server / mesh
     "fedml_server_aggregate_seconds": "histogram",
     "fedml_server_shard_bytes": "gauge",

@@ -1,0 +1,223 @@
+"""Placement search (core/engine/placement_search.py): candidate fingerprints,
+deterministic enumeration and ranking, the analytic cost model's ordering
+properties, plan JSON round-trip with fingerprint tamper detection,
+apply_to_args idempotence + backend mapping, probe accounting, and
+resolve_placement from both a committed plan file and `auto`."""
+
+import json
+import os
+
+import pytest
+
+from fedml_tpu.core import telemetry as tel
+from fedml_tpu.core.engine import (
+    PARTITION_REPLICATED,
+    PARTITION_VEC,
+    STRATEGY_IN_PROCESS,
+    STRATEGY_VMAPPED,
+    PlacementCandidate,
+    PlacementPlan,
+    PlacementSearch,
+    WorkloadProfile,
+    cost_model,
+    enumerate_candidates,
+    resolve_placement,
+)
+
+
+class _Args(dict):
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            raise AttributeError(k)
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+def _sync_profile(**over):
+    kw = dict(name="sync", cohort_size=16, model_bytes=4 << 20, is_async=False)
+    kw.update(over)
+    return WorkloadProfile(**kw)
+
+
+def _async_profile(**over):
+    kw = dict(name="async", cohort_size=16, model_bytes=4 << 20, is_async=True,
+              headline="rounds_per_hr")
+    kw.update(over)
+    return WorkloadProfile(**kw)
+
+
+class TestCandidate:
+    def test_fingerprint_is_stable_and_content_addressed(self):
+        a = PlacementCandidate(strategy=STRATEGY_VMAPPED)
+        b = PlacementCandidate(strategy=STRATEGY_VMAPPED)
+        c = PlacementCandidate(strategy=STRATEGY_IN_PROCESS)
+        assert a.fingerprint() == b.fingerprint()
+        assert a.fingerprint() != c.fingerprint()
+        assert len(a.fingerprint()) == 16
+
+    def test_mesh_device_count(self):
+        assert PlacementCandidate(mesh_spec="").n_mesh_devices() == 1
+        assert PlacementCandidate(mesh_spec="agg:4").n_mesh_devices() == 4
+
+
+class TestEnumeration:
+    def test_deterministic_and_pruned(self):
+        prof = _sync_profile()
+        a = enumerate_candidates(prof, max_devices=4)
+        b = enumerate_candidates(prof, max_devices=4)
+        assert a == b
+        # meshless candidates are replicated; meshed ones shard over dim0
+        for c in a:
+            if c.mesh_spec:
+                assert c.partition == PARTITION_VEC
+                assert c.n_mesh_devices() <= 4
+            else:
+                assert c.partition == PARTITION_REPLICATED
+        # sync space: both strategies present, no async knobs
+        assert {c.strategy for c in a} == {STRATEGY_IN_PROCESS, STRATEGY_VMAPPED}
+        assert all(c.publish_k is None for c in a)
+
+    def test_async_space_varies_publish_knobs_on_vmapped(self):
+        cands = enumerate_candidates(_async_profile(), max_devices=1,
+                                     publish_ks=(8, 32), staleness_exponents=(0.0, 1.0))
+        assert {c.strategy for c in cands} == {STRATEGY_VMAPPED}
+        assert {(c.publish_k, c.staleness_exponent) for c in cands} == {
+            (8, 0.0), (8, 1.0), (32, 0.0), (32, 1.0)}
+
+
+class TestCostModel:
+    def test_vmapped_beats_sequential_on_dispatch(self):
+        prof = _sync_profile()
+        seq = cost_model(prof, PlacementCandidate(strategy=STRATEGY_IN_PROCESS))
+        vm = cost_model(prof, PlacementCandidate(strategy=STRATEGY_VMAPPED))
+        assert vm > seq > 0
+
+    def test_hbm_budget_marks_infeasible(self):
+        prof = _sync_profile(hbm_budget_bytes=1 << 20)  # 1 MiB budget, 4 MiB model
+        assert cost_model(prof, PlacementCandidate()) == float("-inf")
+        # sharding 8-ways brings the high-water under budget
+        ok = cost_model(prof, PlacementCandidate(mesh_spec="agg:8", partition=PARTITION_VEC))
+        assert ok > 0
+
+    def test_async_prefers_larger_publish_window(self):
+        prof = _async_profile()
+        small = cost_model(prof, PlacementCandidate(publish_k=8, staleness_exponent=0.0))
+        large = cost_model(prof, PlacementCandidate(publish_k=64, staleness_exponent=0.0))
+        # rounds/hr headline: fewer, bigger publishes -> fewer publish overheads
+        # per merge, but more merges per publish -> lower publish rate
+        assert small > large
+
+
+class TestPlanJson:
+    def test_round_trip(self):
+        plan = PlacementPlan(
+            workload="w", candidate=PlacementCandidate(publish_k=16, staleness_exponent=0.5),
+            cost_score=1.25, measured=42.0, headline_metric="rounds_per_hr",
+            baseline_value=21.0)
+        back = PlacementPlan.from_json(plan.to_json())
+        assert back == plan
+        assert back.speedup == pytest.approx(2.0)
+        doc = json.loads(plan.to_json())
+        assert doc["fingerprint"] == plan.candidate.fingerprint()
+        assert doc["speedup"] == pytest.approx(2.0)
+
+    def test_hand_edited_plan_is_rejected(self):
+        plan = PlacementPlan(workload="w", candidate=PlacementCandidate(), cost_score=1.0)
+        doc = json.loads(plan.to_json())
+        doc["candidate"]["strategy"] = STRATEGY_IN_PROCESS  # fingerprint now stale
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            PlacementPlan.from_json(json.dumps(doc))
+
+    def test_apply_to_args_idempotent_and_maps_backend(self):
+        plan = PlacementPlan(
+            workload="w",
+            candidate=PlacementCandidate(mesh_spec="agg:2", partition=PARTITION_VEC,
+                                         strategy=STRATEGY_VMAPPED, publish_k=16,
+                                         staleness_exponent=0.5),
+            cost_score=1.0)
+        args = _Args(training_type="simulation", backend="sp")
+        plan.apply_to_args(args)
+        first = dict(args)
+        plan.apply_to_args(args)
+        assert dict(args) == first
+        assert args.backend == "vmap"
+        assert args.server_mesh == "agg:2"
+        assert args.agg_partition == PARTITION_VEC
+        assert args.async_publish_k == 16
+        assert args.async_staleness_exponent == 0.5
+        assert args.placement_fingerprint == plan.candidate.fingerprint()
+
+
+class TestSearch:
+    def test_ranking_is_deterministic_and_probed_first(self):
+        prof = _sync_profile()
+        cands = enumerate_candidates(prof, max_devices=2)
+        # stub probe: deterministic value keyed on the fingerprint so two
+        # searches agree; vmapped probes "measure" faster than sequential
+        probe = lambda c: 100.0 if c.strategy == STRATEGY_VMAPPED else 10.0
+
+        t = tel.get_telemetry()
+        was = t.enabled
+        t.reset()
+        t.set_enabled(True)
+        try:
+            plans_a = PlacementSearch(prof, probe, candidates=cands, probe_top_n=2).search()
+            snap = t.snapshot()
+        finally:
+            t.reset()
+            t.set_enabled(was)
+        plans_b = PlacementSearch(prof, probe, candidates=cands, probe_top_n=2).search()
+
+        assert [p.candidate for p in plans_a] == [p.candidate for p in plans_b]
+        assert len(plans_a) == len(cands)
+        measured = [p for p in plans_a if p.measured is not None]
+        unmeasured = [p for p in plans_a if p.measured is None]
+        assert len(measured) == 2
+        # every probed plan ranks above every un-probed one
+        assert plans_a[: len(measured)] == measured
+        assert plans_a[0].measured == max(p.measured for p in measured)
+        assert unmeasured  # the tail kept its cost-model order
+        assert snap["counters"]["placement.probes"] == 2
+        assert snap["histograms"]["placement.search_seconds"]["count"] == 1
+
+    def test_baseline_probe_feeds_speedup(self):
+        prof = _sync_profile()
+        base = PlacementCandidate(strategy=STRATEGY_IN_PROCESS)
+        probe = lambda c: 80.0 if c.strategy == STRATEGY_VMAPPED else 20.0
+        plans = PlacementSearch(
+            prof, probe, candidates=enumerate_candidates(prof, max_devices=1),
+            probe_top_n=2, baseline=base).search()
+        win = plans[0]
+        assert win.baseline_value == 20.0
+        assert win.speedup == pytest.approx(4.0)
+
+
+class TestResolvePlacement:
+    def test_unset_is_none(self):
+        assert resolve_placement(_Args()) is None
+
+    def test_from_committed_plan_file(self, tmp_path):
+        plan = PlacementPlan(
+            workload="w",
+            candidate=PlacementCandidate(strategy=STRATEGY_VMAPPED, publish_k=32),
+            cost_score=1.0)
+        p = tmp_path / "plan.json"
+        p.write_text(plan.to_json())
+        args = _Args(placement=str(p), training_type="simulation", backend="sp")
+        applied = resolve_placement(args)
+        assert applied == plan
+        assert args.backend == "vmap"
+        assert args.placement_fingerprint == plan.candidate.fingerprint()
+
+    def test_auto_picks_cost_model_winner(self):
+        args = _Args(placement="auto", training_type="simulation", backend="sp",
+                     client_num_per_round=8)
+        plan = resolve_placement(args)
+        assert plan is not None
+        assert args.placement_fingerprint == plan.candidate.fingerprint()
+        # the analytic prior always prefers the megabatch strategy on sync
+        assert plan.candidate.strategy == STRATEGY_VMAPPED
+        assert args.backend == "vmap"

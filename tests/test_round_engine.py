@@ -1,0 +1,364 @@
+"""The unified round engine (core/engine/round_engine.py): sampling parity
+with the reference discipline, eval cadence, the strategy/sink plug points,
+the AsyncSink facade over buffer and hierarchy, the shared client-side round
+scaffolding (chaos knobs, compression boundaries), the engine loop's span
+taxonomy + checkpoint final flag + fedml_engine_* series, and the guarantee
+that the sp/vmapped/hierarchical fronts actually route through the engine."""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core import telemetry as tel
+from fedml_tpu.core.engine import (
+    AlgFrameSink,
+    AsyncBufferSink,
+    AsyncSink,
+    HierarchySink,
+    HookedAverageSink,
+    RemoteCommStrategy,
+    RoundEngine,
+    RoundResult,
+    as_async_sink,
+    compress_upload,
+    decompress_arrival,
+    eval_due,
+    run_local_round,
+    sample_cohort,
+    sample_from_pool,
+    sample_silos,
+)
+
+
+class _Args(dict):
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            raise AttributeError(k)
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+@pytest.fixture
+def live_tel():
+    t = tel.get_telemetry()
+    was = t.enabled
+    t.reset()
+    t.set_enabled(True)
+    yield t
+    t.reset()
+    t.set_enabled(was)
+
+
+# --- sampling: the reference's exact seeding, in one place -------------------
+
+
+class TestSampling:
+    def test_cohort_matches_reference_seeding(self):
+        for r in (0, 1, 7):
+            np.random.seed(r)
+            expect = list(np.random.choice(range(20), 5, replace=False))
+            assert sample_cohort(r, 20, 5) == expect
+
+    def test_cohort_full_pool_only_on_exact_match(self):
+        # == guard: the sp front only short-circuits when the pool exactly
+        # fits; an over-asked cohort still goes through seeded choice
+        assert sample_cohort(3, 4, 4) == [0, 1, 2, 3]
+        assert len(sample_cohort(3, 4, 9)) == 4
+
+    def test_silos_ordered_range_when_everyone_participates(self):
+        # >= guard (reference data_silo_selection)
+        assert sample_silos(5, 3, 3) == [0, 1, 2]
+        assert sample_silos(5, 3, 8) == [0, 1, 2]
+        assert len(sample_silos(5, 10, 4)) == 4
+
+    def test_pool_sampling_returns_whole_pool_when_over_asked(self):
+        pool = [11, 22, 33]
+        assert sample_from_pool(2, pool, 5) == pool
+        picked = sample_from_pool(2, list(range(100, 120)), 6)
+        assert len(picked) == 6 and set(picked) <= set(range(100, 120))
+
+    def test_front_shims_delegate(self):
+        from fedml_tpu.cross_silo.server.fedml_aggregator import (
+            select_clients,
+            select_data_silos,
+        )
+        from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+        assert select_data_silos(4, 12, 5) == sample_silos(4, 12, 5)
+        assert select_clients(4, list(range(12)), 5) == sample_from_pool(4, list(range(12)), 5)
+        assert FedAvgAPI._client_sampling(None, 4, 12, 5) == sample_cohort(4, 12, 5)
+
+
+class TestEvalCadence:
+    def test_final_round_always_due(self):
+        assert eval_due(9, 10, 0)
+        assert eval_due(9, 10, 1000)
+
+    def test_frequency_divisor(self):
+        due = [r for r in range(10) if eval_due(r, 10, 3)]
+        assert due == [0, 3, 6, 9]
+
+    def test_zero_frequency_means_final_only(self):
+        assert [r for r in range(10) if eval_due(r, 10, 0)] == [9]
+
+
+# --- RoundResult / plug-point contracts --------------------------------------
+
+
+class TestRoundResult:
+    def test_k_counts_pairs_and_stacked(self):
+        assert RoundResult(pairs=[(1.0, {}), (2.0, {})]).k == 2
+        assert RoundResult(stacked=({}, np.ones(3))).k == 3
+        assert RoundResult().k == 0
+
+
+class TestRemoteCommStrategy:
+    def test_broadcast_sends_to_every_receiver_under_span(self, live_tel):
+        sent = []
+        strat = RemoteCommStrategy(lambda rid, w, silo: sent.append((rid, silo)))
+        strat.broadcast(2, {"w": 1}, [10, 11, 12], [0, 1, 2])
+        assert sent == [(10, 0), (11, 1), (12, 2)]
+        spans = [s["name"] for s in live_tel.snapshot()["spans"]]
+        assert spans == ["server.broadcast"]
+
+    def test_run_round_requires_collect_fn(self):
+        strat = RemoteCommStrategy(lambda *a: None)
+        with pytest.raises(RuntimeError, match="broadcast-only"):
+            strat.run_round(0, {}, [0])
+
+    def test_run_round_with_collect_fn(self):
+        sent = []
+        expect = RoundResult(pairs=[(1.0, {"w": 0})])
+        strat = RemoteCommStrategy(
+            lambda rid, w, silo: sent.append(rid),
+            collect_fn=lambda r: expect,
+        )
+        assert strat.run_round(0, {}, [5, 6]) is expect
+        assert sent == [5, 6]
+
+
+class TestSinks:
+    def test_alg_frame_sink_delegates(self):
+        calls = []
+
+        def update(w, pairs):
+            calls.append((w, pairs))
+            return {"w": 99}
+
+        out = AlgFrameSink(update).fold(0, {"w": 0}, RoundResult(pairs=[(2.0, {"w": 1})]))
+        assert out == {"w": 99}
+        assert calls == [({"w": 0}, [(2.0, {"w": 1})])]
+
+    def test_hooked_average_sink_runs_hook_pipeline_in_order(self):
+        order = []
+
+        class Agg:
+            def on_before_aggregation(self, lst):
+                order.append("before")
+                return lst
+
+            def aggregate(self, lst):
+                order.append("agg")
+                total = sum(n for n, _ in lst)
+                return {"w": sum(n * t["w"] for n, t in lst) / total}
+
+            def on_after_aggregation(self, w):
+                order.append("after")
+                return w
+
+        out = HookedAverageSink(Agg()).fold(
+            0, {"w": 0.0}, RoundResult(pairs=[(1.0, {"w": 2.0}), (3.0, {"w": 6.0})])
+        )
+        assert order == ["before", "agg", "after"]
+        assert out["w"] == pytest.approx(5.0)
+
+
+# --- the AsyncSink facade ----------------------------------------------------
+
+
+def _delta(v):
+    return {"w": np.full((2,), float(v), dtype=np.float32)}
+
+
+class TestAsyncSinkFacade:
+    def test_buffer_sink_publish_window(self):
+        from fedml_tpu.core.aggregation.async_buffer import AsyncAggBuffer
+
+        sink = as_async_sink(AsyncAggBuffer(publish_k=2))
+        assert isinstance(sink, AsyncBufferSink)
+        assert sink.publish_k == 2
+        sink.submit(0, _delta(1.0), 1.0, sink.version)
+        assert sink.try_publish() is None
+        sink.submit(1, _delta(3.0), 1.0, sink.version)
+        published = sink.try_publish()
+        assert published is not None
+        version, model = published
+        assert version == sink.version == 1
+        np.testing.assert_allclose(np.asarray(model["w"]), 2.0)
+        assert sink.high_water >= 1
+
+    def test_hierarchy_sink_version_watch(self):
+        from fedml_tpu.core.distributed.hierarchy import HierarchyTree
+
+        tree = HierarchyTree.build(n_edges=2, publish_k=1, root_publish_k=1)
+        sink = as_async_sink(tree)
+        assert isinstance(sink, HierarchySink)
+        assert sink.publish_k == 1
+        assert sink.try_publish() is None  # nothing moved yet
+        sink.submit(0, _delta(4.0), 1.0, sink.version)
+        published = sink.try_publish()
+        assert published is not None
+        version, model = published
+        assert version == int(tree.version)
+        assert sink.try_publish() is None  # same version -> no republish
+
+    def test_passthrough_for_existing_sink(self):
+        from fedml_tpu.core.aggregation.async_buffer import AsyncAggBuffer
+
+        sink = AsyncBufferSink(AsyncAggBuffer(publish_k=2))
+        assert as_async_sink(sink) is sink
+        assert isinstance(sink, AsyncSink)
+
+
+# --- shared client-side round scaffolding ------------------------------------
+
+
+class TestLocalRoundScaffolding:
+    def test_returns_train_result_under_span(self, live_tel):
+        out = run_local_round(lambda: ("w", 7), _Args(), 3, rank=1)
+        assert out == ("w", 7)
+        spans = live_tel.snapshot()["spans"]
+        assert [s["name"] for s in spans] == ["client.train"]
+        assert spans[0]["attrs"]["round"] == 3
+
+    def test_chaos_raise_at_round(self):
+        args = _Args(chaos_raise_at_round=2)
+        assert run_local_round(lambda: 1, args, 1, rank=0) == 1
+        with pytest.raises(RuntimeError, match="chaos: injected failure at round 2 on rank 0"):
+            run_local_round(lambda: 1, args, 2, rank=0)
+
+    def test_compression_boundaries_are_identity_when_unconfigured(self):
+        w = {"w": np.ones(3)}
+        assert compress_upload(None, w) is w
+        assert decompress_arrival(w, 0) is w
+
+
+# --- the engine loop ---------------------------------------------------------
+
+
+def _run_engine(args, live_tel, **overrides):
+    seen = {"install": [], "ckpt": [], "evals": []}
+
+    class Strat:
+        name = "stub"
+
+        def run_round(self, round_idx, w_global, cohort):
+            return RoundResult(pairs=[(1.0, {"w": w_global["w"] + 1.0})])
+
+    class Sink:
+        name = "stub"
+
+        def fold(self, round_idx, w_global, result):
+            return result.pairs[0][1]
+
+    kwargs = dict(
+        sample_fn=lambda r: [r, r + 1],
+        install_fn=lambda w: seen["install"].append(w["w"]),
+        eval_fn=lambda r: seen["evals"].append(r) or {"round": float(r)},
+        checkpoint_fn=lambda r, w, cohort, final: seen["ckpt"].append((r, final)),
+        log_summary=False,
+    )
+    kwargs.update(overrides)
+    engine = RoundEngine(args, Strat(), Sink(), **kwargs)
+    w = engine.run({"w": 0.0})
+    return engine, w, seen
+
+
+class TestRoundEngineLoop:
+    def test_loop_folds_installs_and_flags_final_checkpoint(self, live_tel):
+        args = _Args(comm_round=3, frequency_of_the_test=0)
+        engine, w, seen = _run_engine(args, live_tel)
+        assert w["w"] == 3.0
+        assert seen["install"] == [1.0, 2.0, 3.0]
+        assert seen["ckpt"] == [(0, False), (1, False), (2, True)]
+        # freq=0 -> eval only on the final round
+        assert seen["evals"] == [2]
+        assert engine.metrics_history == [{"round": 2.0}]
+
+    def test_span_taxonomy_and_engine_series(self, live_tel):
+        args = _Args(comm_round=2, frequency_of_the_test=1)
+        _run_engine(args, live_tel)
+        snap = live_tel.snapshot()
+        names = [s["name"] for s in snap["spans"]]
+        assert names == [
+            "fedavg.round", "fedavg.sample", "fedavg.aggregate", "fedavg.eval",
+            "fedavg.round", "fedavg.sample", "fedavg.aggregate", "fedavg.eval",
+        ]
+        by_name = {}
+        for s in snap["spans"]:
+            by_name.setdefault(s["name"], s)
+        for child in ("fedavg.sample", "fedavg.aggregate", "fedavg.eval"):
+            assert by_name[child]["parent_seq"] == by_name["fedavg.round"]["seq"]
+        assert snap["counters"]["engine.rounds"] == 2
+        assert snap["histograms"]["engine.round_seconds"]["count"] == 2
+
+    def test_span_prefix_and_attrs(self, live_tel):
+        args = _Args(comm_round=1, frequency_of_the_test=0)
+        _run_engine(args, live_tel, span_prefix="hier",
+                    round_span_attrs={"optimizer": "HierarchicalFL"})
+        spans = live_tel.snapshot()["spans"]
+        assert spans[0]["name"] == "hier.round"
+        assert spans[0]["attrs"]["optimizer"] == "HierarchicalFL"
+
+    def test_resume_skips_completed_rounds(self, live_tel):
+        args = _Args(comm_round=4, frequency_of_the_test=0)
+        _, w, seen = _run_engine(
+            args, live_tel, resume_fn=lambda w: ({"w": 10.0}, 2)
+        )
+        # rounds 2 and 3 only, starting from the restored model
+        assert w["w"] == 12.0
+        assert seen["ckpt"] == [(2, False), (3, True)]
+
+    def test_finalize_fn_runs_after_loop(self, live_tel):
+        done = []
+        args = _Args(comm_round=1, frequency_of_the_test=0)
+        _run_engine(args, live_tel, finalize_fn=lambda w: done.append(w["w"]))
+        assert done == [1.0]
+
+    def test_cohort_published_to_context(self, live_tel):
+        from fedml_tpu.core.alg_frame.context import Context
+
+        args = _Args(comm_round=1, frequency_of_the_test=0)
+        _run_engine(args, live_tel)
+        assert Context().get("client_indexes_of_round") == [0, 1]
+
+
+# --- the fronts actually ride the engine -------------------------------------
+
+
+class TestFrontsRouteThroughEngine:
+    def test_sp_and_vmapped_and_hierarchical_train_via_engine(self):
+        import inspect
+
+        from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+        from fedml_tpu.simulation.sp.hierarchical_fl import HierarchicalTrainer
+        from fedml_tpu.simulation.vmapped.vmap_fedavg import VmapFedAvgAPI
+
+        for front in (FedAvgAPI, HierarchicalTrainer, VmapFedAvgAPI):
+            src = inspect.getsource(front.train)
+            assert "RoundEngine" in src, front
+
+    def test_async_driver_rides_async_sink(self):
+        import inspect
+
+        from fedml_tpu.simulation.vmapped import async_driver
+
+        src = inspect.getsource(async_driver)
+        assert "as_async_sink" in src
+
+    def test_legacy_front_is_marked(self):
+        from fedml_tpu.simulation.sp.async_fedavg import LEGACY_REASON
+
+        assert "engine" in LEGACY_REASON or "publish window" in LEGACY_REASON

@@ -22,11 +22,12 @@ LOCK="$REPO/.bench_runtime/bench.lock"
 
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-90}
 SMOKE_TIMEOUT=${SMOKE_TIMEOUT:-1200}  # may run BOTH stats layouts (narrow+wide)
-# must exceed the sum of bench.py's per-stage budgets (_STAGES: 12180s with
-# attn_micro, the tuned re-run and the agg + agg_sharded microbenches; banked
-# CPU baselines usually shave 600s) plus the 180s probe, or the outer timeout
-# kills a run whose stages are all within their own contracts
-BENCH_TIMEOUT=${BENCH_TIMEOUT:-12900}
+# must exceed the sum of bench.py's per-stage budgets (_STAGES: 12780s with
+# attn_micro, the tuned re-run, the agg + agg_sharded microbenches and the
+# placement search; banked CPU baselines usually shave 600s) plus the 180s
+# probe, or the outer timeout kills a run whose stages are all within their
+# own contracts
+BENCH_TIMEOUT=${BENCH_TIMEOUT:-13500}
 SLEEP_DOWN=${SLEEP_DOWN:-120}     # tunnel down: re-probe every 2 min (short
                                   # up-windows are the norm; 10 min missed them)
 SLEEP_UP=${SLEEP_UP:-3600}        # after a good measurement: hourly is plenty
@@ -67,6 +68,9 @@ commit_artifacts() {
   # and measured-only cases must each build their own list
   local paths=()
   while IFS= read -r f; do paths+=("$f"); done < <(compgen -G "BENCH_MEASURED_*.json")
+  # winning placement plans (bench.py --stage placement_search) ride along:
+  # a committed plan is what `args.placement=PATH` replays without re-probing
+  while IFS= read -r f; do paths+=("$f"); done < <(compgen -G "PLACEMENT_PLAN_*.json")
   [ -f BENCH_CPU_BASELINES.json ] && paths+=(BENCH_CPU_BASELINES.json)
   if [ "${#paths[@]}" -gt 0 ]; then
     git add -- "${paths[@]}"
@@ -77,6 +81,7 @@ commit_artifacts() {
       surface_agg_rates
       surface_agg_sharded
       surface_async_rounds
+      surface_placement
       surface_resilience
       surface_serving
       surface_span_summary
@@ -162,6 +167,35 @@ if rph:
 PYEOF
 ) || return 0
   [ -n "$asy" ] && log "$asy"
+}
+
+surface_placement() {
+  # one-line view of the auto-placement search: searched-vs-default speedup
+  # per workload plus the winning candidate's knobs and fingerprint — so the
+  # watcher log answers "did the search beat the hand-picked config, and
+  # with what placement" without opening BENCH_MEASURED_*.json
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1) || return 0
+  [ -n "$newest" ] || return 0
+  local plc
+  plc=$(python3 - "$newest" <<'PYEOF' 2>/dev/null
+import json, sys
+doc = json.load(open(sys.argv[1]))
+speed = doc.get("placement_speedup") or {}
+plans = doc.get("placement_plan") or {}
+if speed:
+    parts = []
+    for w, s in sorted(speed.items()):
+        p = plans.get(w) or {}
+        knobs = p.get("strategy", "?")
+        if p.get("publish_k") is not None:
+            knobs += f" k={p['publish_k']}/exp={p['staleness_exponent']}"
+        parts.append(f"{w} {s}x ({knobs}, {p.get('fingerprint')})")
+    print("placement_search: " + "; ".join(parts)
+          + f"; plans: {', '.join(doc.get('placement_plan_files') or [])}")
+PYEOF
+) || return 0
+  [ -n "$plc" ] && log "$plc"
 }
 
 surface_resilience() {
