@@ -1460,6 +1460,218 @@ def _bench_async_rounds(publishes: int = 8, reps: int = 3):
     }
 
 
+def _bench_wan_profile():
+    """Per-link WAN observability (ISSUE 12): a heterogeneous-throttle
+    in-memory fleet must be MEASURABLE by the netlink estimators. One
+    server-side LinkProber probes N echo-loop clients through the real
+    InMemoryBroker with per-rank ``chaos_link_throttle`` profiles injected;
+    the probe traffic is real ``Message`` objects passing through the same
+    ``record_send``/``record_recv`` hooks as production comm, so the passive
+    accounting, the active RTT/bandwidth estimators, and the cost model all
+    run exactly the code the cross-silo managers run.
+
+    Integrity guards (BenchIntegrityError, refusing to publish):
+    - convergence: every throttled pair's bandwidth estimate must land
+      within FEDML_WAN_BW_TOL (default 20%) of its injected bytes/sec, with
+      >= 3 retained samples — an estimator that cannot recover a KNOWN
+      synthetic profile has no business steering deadlines;
+    - overhead: total ``link.probe`` span time must stay under
+      FEDML_WAN_OVERHEAD_TOL_PCT (default 1%) of the probing window wall
+      time — active probing is only admissible if it is ~free;
+    - liveness: >= 80% of sent probes must be answered (a timeout
+      misconfigured against the injected RTT would silently turn the bw
+      series into loss noise)."""
+    import queue
+    import threading
+
+    from fedml_tpu.core import telemetry as tel
+    from fedml_tpu.core.distributed.communication.inmemory.broker import InMemoryBroker
+    from fedml_tpu.core.distributed.communication.message import Message
+    from fedml_tpu.core.distributed.link_probe import LinkProber
+    from fedml_tpu.core.telemetry import netlink
+    from fedml_tpu.cross_silo.message_define import MyMessage
+
+    tiny = os.environ.get("FEDML_BENCH_TINY") == "1"
+    # injected per-rank WAN profile (bytes/sec). Payload sized so the
+    # transfer term dominates timer jitter (~ms) even on the fastest link.
+    if tiny:
+        profile = {1: 2 * (1 << 20), 2: 512 * 1024}
+        payload_bytes, interval_s, ticks = 65536, 0.2, 8
+    else:
+        profile = {1: 4 * (1 << 20), 2: 1 << 20, 3: 256 * 1024}
+        payload_bytes, interval_s, ticks = 131072, 0.25, 12
+    base_delay_s = 0.02  # propagation floor: the zero-payload probe's RTT/2
+    run_id = "bench_wan_profile"
+    backend = "INMEMORY"
+
+    InMemoryBroker.reset(run_id)
+    broker = InMemoryBroker.get(run_id)
+    for rank, bps in profile.items():
+        broker.set_throttle(rank, bps, base_delay_s)
+
+    netlink.reset()
+    registry = netlink.get_registry()
+    t = tel.get_telemetry()
+    tel_was_enabled = t.enabled
+    t.set_enabled(True)
+    t.reset()
+
+    stop_evt = threading.Event()
+
+    def _client_loop(rank: int) -> None:
+        # stateless probe echoer: exactly what fedml_client_master_manager
+        # does, minus the trainer
+        q = broker.queue_for(rank)
+        while not stop_evt.is_set():
+            try:
+                msg = q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            registry.record_recv(msg, backend=backend)
+            if msg.get_type() != MyMessage.MSG_TYPE_LINK_PROBE:
+                continue
+            echo = Message(MyMessage.MSG_TYPE_LINK_PROBE_ECHO, rank, 0)
+            for key in (MyMessage.MSG_ARG_KEY_PROBE_SEQ,
+                        MyMessage.MSG_ARG_KEY_PROBE_T_SEND_NS,
+                        MyMessage.MSG_ARG_KEY_PROBE_NBYTES,
+                        MyMessage.MSG_ARG_KEY_PROBE_PAD):
+                val = msg.get(key)
+                if val is not None:
+                    echo.add_params(key, val)
+            registry.record_send(echo, backend=backend)
+            broker.publish(0, echo)
+
+    def _send_probe(peer: int, seq: int, t_send_ns: int, nbytes: int) -> None:
+        m = Message(MyMessage.MSG_TYPE_LINK_PROBE, 0, peer)
+        m.add_params(MyMessage.MSG_ARG_KEY_PROBE_SEQ, seq)
+        m.add_params(MyMessage.MSG_ARG_KEY_PROBE_T_SEND_NS, t_send_ns)
+        m.add_params(MyMessage.MSG_ARG_KEY_PROBE_NBYTES, nbytes)
+        if nbytes > 0:
+            m.add_params(MyMessage.MSG_ARG_KEY_PROBE_PAD,
+                         np.zeros(int(nbytes), dtype=np.uint8))
+        registry.record_send(m, backend=backend)
+        broker.publish(peer, m)
+
+    prober = LinkProber(
+        local_rank=0, send_probe=_send_probe,
+        peers=lambda: list(profile), interval_s=interval_s,
+        payload_bytes=payload_bytes,
+        # timeout must clear the SLOWEST injected RTT: 2*(base + payload/bps)
+        timeout_intervals=(2.0 * (base_delay_s + payload_bytes / min(profile.values()))
+                          / interval_s) + 4.0,
+        registry=registry, backend=backend)
+
+    def _server_loop() -> None:
+        q = broker.queue_for(0)
+        while not stop_evt.is_set():
+            try:
+                msg = q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            registry.record_recv(msg, backend=backend)
+            if msg.get_type() == MyMessage.MSG_TYPE_LINK_PROBE_ECHO:
+                prober.observe_echo(
+                    msg.get_sender_id(),
+                    msg.get(MyMessage.MSG_ARG_KEY_PROBE_SEQ),
+                    msg.get(MyMessage.MSG_ARG_KEY_PROBE_T_SEND_NS))
+
+    threads = [threading.Thread(target=_server_loop, name="wan-server", daemon=True)]
+    threads += [threading.Thread(target=_client_loop, args=(r,),
+                                 name=f"wan-client-{r}", daemon=True)
+                for r in profile]
+    slowest_rtt = 2.0 * (base_delay_s + payload_bytes / min(profile.values()))
+    _p(f"wan_profile: {len(profile)} clients, payload {payload_bytes}B, "
+       f"{ticks} ticks @ {interval_s}s (slowest injected rtt {slowest_rtt:.2f}s)")
+
+    wall_t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    try:
+        # deterministic cadence (prober.tick, not the thread): exactly
+        # `ticks` probe pairs per peer, no partial-tail ambiguity
+        for _ in range(ticks):
+            prober.tick()
+            time.sleep(interval_s)  # fedlint: disable=bare-sleep probe cadence, not a retry
+        # drain: the slowest pair's last padded echo is still in flight
+        time.sleep(slowest_rtt + 0.5)  # fedlint: disable=bare-sleep waiting out the injected link delay, not a retry
+    finally:
+        wall_s = time.perf_counter() - wall_t0
+        stop_evt.set()
+        for th in threads:
+            th.join(timeout=2.0)
+        for rank in profile:
+            broker.clear_throttle(rank)
+        InMemoryBroker.reset(run_id)
+
+    # --- convergence guard -------------------------------------------------
+    tol = float(os.environ.get("FEDML_WAN_BW_TOL", "0.20"))
+    cost = registry.cost_model()
+    per_link: dict = {}
+    worst_err_pct = 0.0
+    for rank, injected in sorted(profile.items()):
+        stats = registry.pair((0, rank), create=False)
+        measured = None if stats is None else stats.bw.value
+        count = 0 if stats is None else stats.bw.count
+        if measured is None or count < 3:
+            raise BenchIntegrityError(
+                f"wan_profile: pair 0->{rank} has no converged bandwidth "
+                f"estimate ({count} retained samples) after {ticks} probe "
+                "ticks; refusing to publish")
+        err = abs(measured - injected) / injected
+        worst_err_pct = max(worst_err_pct, 100.0 * err)
+        if err > tol:
+            raise BenchIntegrityError(
+                f"wan_profile: pair 0->{rank} estimated "
+                f"{measured / 1e6:.3f} MB/s vs injected {injected / 1e6:.3f} "
+                f"MB/s ({100 * err:.1f}% > {100 * tol:.0f}%); refusing to publish")
+        pred = cost.predict_transfer_s(0, rank, 1 << 20)
+        per_link[str(rank)] = {
+            "injected_bytes_per_sec": injected,
+            "measured_bytes_per_sec": round(measured, 1),
+            "bw_error_pct": round(100.0 * err, 2),
+            "rtt_ms": (None if stats.rtt.value is None
+                       else round(stats.rtt.value * 1e3, 2)),
+            "loss_ratio": round(stats.loss_ratio(), 4),
+            "predicted_mib_s": (None if pred.seconds is None
+                                else round(pred.seconds, 4)),
+            "confidence": round(pred.confidence, 3),
+        }
+
+    # --- liveness guard ----------------------------------------------------
+    sent = sum(s.probes_sent for s in registry.pairs().values())
+    answered = sum(s.probes_answered for s in registry.pairs().values())
+    if sent == 0 or answered < 0.8 * sent:
+        raise BenchIntegrityError(
+            f"wan_profile: only {answered}/{sent} probes answered (< 80%) — "
+            "probe timeout is misconfigured against the injected RTT; "
+            "refusing to publish")
+
+    # --- overhead guard ----------------------------------------------------
+    probe_stats = t.snapshot()["span_stats"].get("link.probe") or {}
+    probe_ms = float(probe_stats.get("total_ms", 0.0))
+    overhead_pct = 100.0 * probe_ms / (wall_s * 1e3)
+    overhead_tol = float(os.environ.get("FEDML_WAN_OVERHEAD_TOL_PCT", "1.0"))
+    if overhead_pct >= overhead_tol:
+        raise BenchIntegrityError(
+            f"wan_profile: probing consumed {overhead_pct:.3f}% of the "
+            f"window wall time (>= {overhead_tol}%); active probing must be "
+            "~free; refusing to publish")
+
+    if not tel_was_enabled:
+        t.set_enabled(False)
+    netlink.reset()
+    return {
+        "wan_profile": per_link,
+        "link_bw_error_pct": round(worst_err_pct, 2),
+        "probe_overhead_pct": round(overhead_pct, 4),
+        "wan_probe_ticks": ticks,
+        "wan_probes_sent": sent,
+        "wan_probes_answered": answered,
+        "wan_probe_payload_bytes": payload_bytes,
+        "wan_window_s": round(wall_s, 2),
+    }
+
+
 def _bench_placement_search(probe_publishes: int = 4, reps: int = 2):
     """Auto-placement search (ISSUE 11): cost-model-seeded, measurement-
     refined search (core/engine/placement_search.py) vs the hand-picked
@@ -2505,6 +2717,8 @@ def _stage_result(name: str) -> dict:
         out = _retry_transient(_bench_agg_sharded)
     elif name == "async_rounds":
         out = _retry_transient(_bench_async_rounds)
+    elif name == "wan_profile":
+        out = _retry_transient(_bench_wan_profile)
     elif name == "placement_search":
         out = _retry_transient(_bench_placement_search)
     elif name == "llm_pallas_tuned":
@@ -2561,6 +2775,11 @@ _STAGES: list[tuple[str, int]] = [
     # async buffered federation: rounds/hr at 1k/10k/100k simulated clients
     # (flatness + bit-exact sync parity + zero-retrace integrity guards)
     ("async_rounds", 600),
+    # per-link WAN observability: heterogeneous chaos-throttle fleet, the
+    # netlink estimators must recover every injected bandwidth within 20%
+    # with probe overhead < 1% of the window (both integrity-guarded). The
+    # window itself is seconds; the budget covers interpreter start + retry
+    ("wan_profile", 240),
     # auto-placement search: cost-model-seeded probes over (strategy x
     # publish_k x staleness exponent) on two workloads; default-vs-searched
     # speedup + the winning PlacementPlan JSON artifact (zero-retrace +
@@ -3202,6 +3421,20 @@ def main() -> None:
                 out[key] = async_rounds[key]
     elif async_rounds is not None:
         out["async_rounds_skipped"] = async_rounds["skipped"]
+
+    wan = stage_out.get("wan_profile")
+    if wan is not None and "skipped" not in wan:
+        # per-link WAN headline (tools/bench_watch.sh surfaces these):
+        # worst estimator error vs the injected profile + probe overhead,
+        # both integrity-guarded in-stage; the per-pair table rides along
+        for key in ("wan_profile", "link_bw_error_pct", "probe_overhead_pct",
+                    "wan_probe_ticks", "wan_probes_sent",
+                    "wan_probes_answered", "wan_probe_payload_bytes",
+                    "wan_window_s"):
+            if wan.get(key) is not None:
+                out[key] = wan[key]
+    elif wan is not None:
+        out["wan_profile_skipped"] = wan["skipped"]
 
     placement = stage_out.get("placement_search")
     if placement is not None and "skipped" not in placement:

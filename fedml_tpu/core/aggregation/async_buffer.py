@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -84,6 +85,12 @@ class StalenessPolicy:
         self.max_staleness = int(max_staleness)
         self.straggler_grace = float(straggler_grace)
         self.health = health  # HealthTracker or None
+        # args.async_link_admission: rank -> predicted upload seconds (the
+        # netlink cost model) + a publish-interval estimate convert measured
+        # WAN transfer time into extra tolerated staleness versions
+        self._link_predict = None
+        self._publish_interval_fn = None
+        self.link_grace_cap = 0
 
     @classmethod
     def from_args(cls, args: Any, health: Any = None) -> "StalenessPolicy":
@@ -111,11 +118,37 @@ class StalenessPolicy:
             return False
         return bool(c is not None and c.flagged)
 
+    def set_link_predictor(self, link_predict: Any, publish_interval_fn: Any,
+                           grace_cap: Optional[int] = None) -> None:
+        """Wire the netlink cost model into admission (``args.async_link_admission``).
+
+        ``link_predict(rank)`` returns predicted upload seconds (None when the
+        estimate isn't confident); ``publish_interval_fn()`` the server's mean
+        seconds between publishes. Their ratio is how many model versions a
+        delta ages **in flight** — lateness the link explains, so the cut
+        stretches by that many versions (capped at ``grace_cap``, default
+        ``max_staleness``, so a wild estimate can at most double the cut)."""
+        self._link_predict = link_predict
+        self._publish_interval_fn = publish_interval_fn
+        self.link_grace_cap = int(self.max_staleness if grace_cap is None else grace_cap)
+
+    def _link_extra(self, rank: Optional[int]) -> int:
+        if rank is None or self._link_predict is None or self._publish_interval_fn is None:
+            return 0
+        try:
+            pred_s = self._link_predict(int(rank))
+            interval_s = self._publish_interval_fn()
+        except Exception:  # noqa: BLE001 - duck-typed predictor/interval
+            return 0
+        if not pred_s or not interval_s or interval_s <= 0:
+            return 0
+        return min(int(math.ceil(float(pred_s) / float(interval_s))), self.link_grace_cap)
+
     def admission_cut(self, rank: Optional[int] = None) -> int:
         cut = self.max_staleness
         if self._rank_flagged(rank):
             cut = int(math.ceil(cut * self.straggler_grace))
-        return cut
+        return cut + self._link_extra(rank)
 
     def admit(self, staleness: int, rank: Optional[int] = None) -> bool:
         return int(staleness) <= self.admission_cut(rank)
@@ -126,6 +159,7 @@ class StalenessPolicy:
             "max_staleness": self.max_staleness,
             "straggler_grace": self.straggler_grace,
             "health_wired": self.health is not None,
+            "link_wired": self._link_predict is not None,
         }
 
 
@@ -173,6 +207,10 @@ class AsyncAggBuffer:
         # staleness clock: rank -> model version of that rank's last merge
         self._client_versions: Dict[int, int] = {}
         self._staleness_sum = 0
+        # mean seconds between publishes — the link-admission policy's
+        # seconds->versions conversion rate (None until two publishes)
+        self.publish_interval_ewma_s: Optional[float] = None
+        self._last_publish_mono: Optional[float] = None
 
     # --- submit (receive-loop thread) --------------------------------------
     def submit(self, rank: int, model_params: PyTree, sample_num: float,
@@ -288,6 +326,12 @@ class AsyncAggBuffer:
         self._staleness_sum = 0
         self.version += 1
         self.publishes_total += 1
+        now = time.monotonic()
+        if self._last_publish_mono is not None:
+            dt = now - self._last_publish_mono
+            self.publish_interval_ewma_s = dt if self.publish_interval_ewma_s is None \
+                else 0.7 * self.publish_interval_ewma_s + 0.3 * dt
+        self._last_publish_mono = now
         tel.get_telemetry().counter(PUBLISH_COUNTER).add(1)
         return out
 
@@ -313,6 +357,7 @@ class AsyncAggBuffer:
                 "stale_accepted_total": self.stale_accepted_total,
                 "stale_rejected_total": self.stale_rejected_total,
                 "mean_staleness": (self._staleness_sum / n) if n else 0.0,
+                "publish_interval_ewma_s": self.publish_interval_ewma_s,
                 "policy": self.policy.as_dict(),
                 "client_versions": dict(self._client_versions),
             }

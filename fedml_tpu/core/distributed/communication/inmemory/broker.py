@@ -4,13 +4,21 @@ framework should put a real in-memory fake").
 
 Topics are rank ids; each rank gets a FIFO queue. Thread-safe; one broker
 per ``run_id`` so concurrent tests don't cross-talk.
+
+Fault injection: :meth:`set_throttle` (the ``chaos_link_throttle`` knob)
+models a degraded WAN link for one rank — every message to or from that
+rank is delivered after ``nbytes / bytes_per_sec (+ base delay)``. Delivery
+is delayed per message (a timer, not a serial pipe), which is what the
+netlink estimators' per-message latency samples assume; it is enough to
+make the throttled pair's bandwidth gauges and the health scorer react in
+the chaos e2e without modeling queueing.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 
 class InMemoryBroker:
@@ -20,6 +28,8 @@ class InMemoryBroker:
     def __init__(self) -> None:
         self._queues: Dict[int, "queue.Queue"] = {}
         self._qlock = threading.Lock()
+        # rank -> (bytes_per_sec, base_delay_s); applies to both directions
+        self._throttles: Dict[int, Tuple[float, float]] = {}
 
     @classmethod
     def get(cls, run_id: str) -> "InMemoryBroker":
@@ -42,5 +52,43 @@ class InMemoryBroker:
                 self._queues[rank] = queue.Queue()
             return self._queues[rank]
 
+    # --- chaos_link_throttle ---------------------------------------------
+    def set_throttle(self, rank: int, bytes_per_sec: float,
+                     base_delay_s: float = 0.0) -> None:
+        """Degrade ``rank``'s link: messages it sends or receives take
+        ``base_delay_s + nbytes / bytes_per_sec`` to deliver."""
+        with self._qlock:
+            self._throttles[int(rank)] = (float(bytes_per_sec), float(base_delay_s))
+
+    def clear_throttle(self, rank: int) -> None:
+        with self._qlock:
+            self._throttles.pop(int(rank), None)
+
+    def _chaos_delay_s(self, receiver_rank: int, item) -> float:
+        with self._qlock:
+            if not self._throttles:
+                return 0.0
+            throttles = dict(self._throttles)
+        ranks = {int(receiver_rank)}
+        try:
+            ranks.add(int(item.get_sender_id()))
+        except Exception:  # noqa: BLE001 - _STOP sentinel and duck-typed items
+            pass
+        hit = [throttles[r] for r in ranks if r in throttles]
+        if not hit:
+            return 0.0
+        from ....telemetry.netlink import payload_nbytes
+
+        nbytes = payload_nbytes(item)
+        # a message crossing two throttled endpoints pays the slower link
+        return max(base + (nbytes / bps if bps > 0 else 0.0)
+                   for bps, base in hit)
+
     def publish(self, rank: int, item) -> None:
-        self.queue_for(rank).put(item)
+        delay_s = self._chaos_delay_s(rank, item)
+        if delay_s <= 0.0:
+            self.queue_for(rank).put(item)
+            return
+        t = threading.Timer(delay_s, self.queue_for(rank).put, args=(item,))
+        t.daemon = True
+        t.start()

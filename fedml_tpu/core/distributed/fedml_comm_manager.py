@@ -21,7 +21,7 @@ from ...constants import (
     COMM_BACKEND_MQTT_WEB3,
     COMM_BACKEND_TRPC,
 )
-from ..telemetry import flight_recorder
+from ..telemetry import flight_recorder, netlink
 from .communication.base_com_manager import BaseCommunicationManager, Observer
 from .communication.message import Message
 
@@ -77,8 +77,10 @@ class FedMLCommManager(Observer):
 
     def receive_message(self, msg_type, msg_params: Message) -> None:
         # every backend dispatches through here, so the flight recorder's
-        # comm breadcrumbs cover GRPC/TRPC/MQTT/INMEMORY alike
+        # comm breadcrumbs and netlink's per-pair accounting cover
+        # GRPC/TRPC/MQTT/INMEMORY alike
         flight_recorder.record_comm("recv", msg_params)
+        netlink.record_recv(msg_params, backend=self.backend.lower())
         handler = self.message_handler_dict.get(msg_type)
         if handler is None:
             raise KeyError(
@@ -89,6 +91,9 @@ class FedMLCommManager(Observer):
 
     def send_message(self, message: Message) -> None:
         flight_recorder.record_comm("send", message)
+        # books the pair's outgoing bytes and stamps the send time into the
+        # reserved header (the receiver's latency sample)
+        netlink.record_send(message, backend=self.backend.lower())
         if self._retry_policy is None:
             self.com_manager.send_message(message)
             return
@@ -118,6 +123,16 @@ class FedMLCommManager(Observer):
             from .communication.inmemory.inmemory_comm_manager import InMemoryCommManager
 
             self.com_manager = InMemoryCommManager(str(getattr(self.args, "run_id", "0")), self.rank, self.size)
+            # chaos_link_throttle: degrade THIS party's link in the broker
+            # (fault injection for the netlink estimators / chaos e2e)
+            throttle = getattr(self.args, "chaos_link_throttle", None)
+            if throttle:
+                from .communication.inmemory.broker import InMemoryBroker
+
+                InMemoryBroker.get(str(getattr(self.args, "run_id", "0"))).set_throttle(
+                    self.rank, float(throttle),
+                    base_delay_s=float(getattr(self.args, "chaos_link_base_delay_s", 0.0) or 0.0),
+                )
         elif self.backend == COMM_BACKEND_TRPC:
             from ...constants import TRPC_BASE_PORT
             from .communication.trpc.trpc_comm_manager import TRPCCommManager

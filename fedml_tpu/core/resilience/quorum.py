@@ -75,6 +75,10 @@ class QuorumPolicy:
     adaptive_mult: float = 3.0               # deadline = mult * max healthy EWMA
     min_deadline_s: float = 1.0              # adaptive floor
     overprovision_frac: float = 0.0
+    # args.quorum_link_cost: stretch the adaptive deadline by each rank's
+    # measured upload time (core/telemetry/netlink.py cost model) so a slow
+    # WAN link widens the deadline instead of being misread as slow compute
+    use_link_cost: bool = False
 
     @property
     def enabled(self) -> bool:
@@ -91,22 +95,40 @@ class QuorumPolicy:
             adaptive_mult=float(getattr(args, "adaptive_deadline_mult", 3.0)),
             min_deadline_s=float(getattr(args, "adaptive_deadline_min_s", 1.0)),
             overprovision_frac=float(getattr(args, "overprovision_frac", 0.0)),
+            use_link_cost=bool(getattr(args, "quorum_link_cost", False)),
         )
 
     def min_quorum(self, keep_k: int) -> int:
         return max(1, int(math.ceil(float(self.quorum_frac) * int(keep_k))))
 
-    def deadline_for_round(self, health: Any = None) -> Optional[float]:
+    def deadline_for_round(self, health: Any = None,
+                           link_predict: Any = None) -> Optional[float]:
         """Seconds until this round's deadline (None = wait forever). The
         adaptive mode needs at least one EWMA observation; until then the
-        static deadline (or none) applies."""
+        static deadline (or none) applies.
+
+        ``link_predict`` (rank -> predicted upload seconds, or None where the
+        link cost model has no confident estimate) only applies with
+        ``use_link_cost``: each rank's EWMA is stretched by its measured
+        transfer time BEFORE the cohort max, so one rank behind a slow WAN
+        link widens the deadline by its own transfer cost, not everyone's."""
         if self.adaptive and health is not None:
             try:
-                ewmas = [c.ewma_s for c in health._clients.values() if c.ewma_s is not None]
+                ewmas = {r: c.ewma_s for r, c in health._clients.items()
+                         if c.ewma_s is not None}
             except Exception:  # noqa: BLE001 - duck-typed health object
-                ewmas = []
+                ewmas = {}
             if ewmas:
-                adaptive = max(self.min_deadline_s, self.adaptive_mult * max(ewmas))
+                per_rank = list(ewmas.values())
+                if self.use_link_cost and link_predict is not None:
+                    per_rank = []
+                    for rank, ewma in ewmas.items():
+                        try:
+                            extra = link_predict(rank)
+                        except Exception:  # noqa: BLE001 - duck-typed predictor
+                            extra = None
+                        per_rank.append(ewma + (float(extra) if extra else 0.0))
+                adaptive = max(self.min_deadline_s, self.adaptive_mult * max(per_rank))
                 return adaptive if self.deadline_s is None else min(adaptive, self.deadline_s)
         return self.deadline_s
 

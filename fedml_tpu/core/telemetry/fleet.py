@@ -100,6 +100,16 @@ class FleetTelemetry:
         if isinstance(delta.get("dropped"), int):
             # client-side Telemetry.dropped is cumulative: latest wins
             ent["client_dropped"] = delta["dropped"]
+        link = delta.get("link")
+        if isinstance(link, dict) and link:
+            # client-observed per-pair link estimates: fold into the server's
+            # netlink registry (it only adopts pairs it cannot measure itself)
+            try:
+                from . import netlink
+
+                netlink.get_registry().merge_remote(rank, link)
+            except Exception:  # noqa: BLE001 - observability must not crash the merge
+                log.debug("fleet: link snapshot from rank %d unusable", rank)
         self.merges += 1
         self.health.heartbeat(rank)
         return True
@@ -178,6 +188,14 @@ class FleetTelemetry:
                 shift_ns = ent["epoch_unix_ns"] - server_epoch
             for r in ent["spans"]:
                 events.append(_span_event(r, pid=pid, shift_ns=shift_ns))
+        # measured message flows: arrows from sender lane to receiver lane
+        # carrying bytes + the pair's live bandwidth/RTT estimates
+        try:
+            from . import netlink
+
+            events.extend(netlink.get_registry().flow_events(server_epoch))
+        except Exception:  # noqa: BLE001 - flow decoration must not fail the export
+            log.debug("fleet: link flow events skipped")
         doc = {"traceEvents": events, "displayTimeUnit": "ms"}
         with open(path, "w") as f:
             json.dump(doc, f)

@@ -431,9 +431,17 @@ def record_comm(direction: str, message: Any) -> None:
         return
     kind = EVENT_COMM_SEND if direction == "send" else EVENT_COMM_RECV
     try:
+        sender = message.get_sender_id()
+        receiver = message.get_receiver_id()
+        from . import netlink
+
         fields = {
-            "sender": message.get_sender_id(),
-            "receiver": message.get_receiver_id(),
+            "sender": sender,
+            "receiver": receiver,
+            # who was talking to whom, and how much: the peer is the far end
+            # of this event's direction, the bytes are the payload estimate
+            "peer": receiver if direction == "send" else sender,
+            "bytes": netlink.payload_nbytes(message),
         }
         name = str(message.get_type())
     except Exception:  # noqa: BLE001 - diagnostics must not throw

@@ -181,6 +181,16 @@ def render(telemetry: Optional[Telemetry] = None,
         mesh_gauges = []
     if mesh_gauges:
         gauges = list(gauges) + mesh_gauges if gauges else mesh_gauges
+    # per-pair link gauges (fedml_link_*{src,dst,backend}) likewise ride
+    # along on every /metrics surface once any message has moved
+    try:
+        from . import netlink as _netlink
+
+        link_gauges = _netlink.prom_gauges()
+    except Exception:  # noqa: BLE001 - metrics must render without netlink
+        link_gauges = []
+    if link_gauges:
+        gauges = list(gauges) + link_gauges if gauges else link_gauges
     if gauges:
         seen_fams = set()
         for name, labels, value in gauges:

@@ -101,6 +101,17 @@ def render(service: Optional[str] = None,
             doc["sections"]["sharding"] = shard
     except Exception as e:  # noqa: BLE001 - status page must not throw
         doc["sections"]["sharding"] = {"error": repr(e)}
+    # the links section (per-pair bandwidth/RTT estimates, bytes in/out,
+    # probe ages) is always-on: any process whose comm manager moved a
+    # message has pairs to show
+    try:
+        from . import netlink as _netlink
+
+        links = _netlink.statusz_snapshot()
+        if links:
+            doc["sections"]["links"] = links
+    except Exception as e:  # noqa: BLE001 - status page must not throw
+        doc["sections"]["links"] = {"error": repr(e)}
     with _sections_lock:
         providers = dict(_sections)
     for name, provider in sorted(providers.items()):

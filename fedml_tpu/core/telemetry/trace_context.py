@@ -37,6 +37,8 @@ RESERVED_TELEMETRY_KEY = "__telemetry__"
 # Sub-keys inside the reserved header dict.
 TRACEPARENT_FIELD = "tp"  # traceparent string (this module)
 DELTA_FIELD = "delta"     # client delta snapshot (fleet.py consumes)
+SENT_AT_FIELD = "ts"      # sender wall-clock ns at send (netlink.py stamps/reads)
+LINK_FIELD = "link"       # client link-pair snapshot inside the delta (netlink.py)
 
 _VERSION = "00"
 _NO_PARENT = "0" * 16

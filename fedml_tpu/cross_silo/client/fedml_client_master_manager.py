@@ -15,7 +15,7 @@ from typing import Any, Dict, Optional
 from ... import mlops
 from ...core import telemetry as tel
 from ...core.engine import compress_upload, flight_recorded, run_local_round
-from ...core.telemetry import trace_context
+from ...core.telemetry import netlink, trace_context
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ...parallel.multihost import broadcast_model_params, broadcast_round_metadata, process_count
@@ -61,6 +61,7 @@ class ClientMasterManager(FedMLCommManager):
             MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.handle_message_receive_model_from_server
         )
         self.register_message_receive_handler(MyMessage.MSG_TYPE_S2C_FINISH, self.handle_message_finish)
+        self.register_message_receive_handler(MyMessage.MSG_TYPE_LINK_PROBE, self.handle_message_link_probe)
 
     def handle_message_connection_ready(self, msg_params: Message) -> None:
         if not self.has_sent_online_msg:
@@ -134,6 +135,26 @@ class ClientMasterManager(FedMLCommManager):
         mlops.log_training_status("FINISHED", str(getattr(self.args, "run_id", "0")))
         self.finish()
 
+    def handle_message_link_probe(self, msg_params: Message) -> None:
+        """Echo a link probe: bounce the originator's opaque timestamp and an
+        equal-size pad straight back, so the server measures a symmetric
+        round trip on its own clock (core/distributed/link_probe.py)."""
+        import numpy as np
+
+        nbytes = int(msg_params.get(MyMessage.MSG_ARG_KEY_PROBE_NBYTES) or 0)
+        pad = msg_params.get(MyMessage.MSG_ARG_KEY_PROBE_PAD)
+        echo = Message(MyMessage.MSG_TYPE_LINK_PROBE_ECHO, self.client_real_id,
+                       msg_params.get_sender_id())
+        echo.add_params(MyMessage.MSG_ARG_KEY_PROBE_SEQ,
+                        int(msg_params.get(MyMessage.MSG_ARG_KEY_PROBE_SEQ)))
+        echo.add_params(MyMessage.MSG_ARG_KEY_PROBE_T_SEND_NS,
+                        int(msg_params.get(MyMessage.MSG_ARG_KEY_PROBE_T_SEND_NS)))
+        echo.add_params(MyMessage.MSG_ARG_KEY_PROBE_NBYTES, nbytes)
+        if nbytes > 0:
+            echo.add_params(MyMessage.MSG_ARG_KEY_PROBE_PAD,
+                            pad if pad is not None else np.zeros(nbytes, dtype=np.uint8))
+        self.send_message(echo)
+
     def _adopt_model_version(self, msg_params: Message) -> None:
         v = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_VERSION)
         if v is not None:
@@ -176,6 +197,12 @@ class ClientMasterManager(FedMLCommManager):
         delta = t.delta_snapshot(self._tel_cursor, tid=tid)
         self._tel_cursor = delta.pop("cursor")
         delta["rank"] = int(self.client_real_id)
+        # client-observed link estimates ride along; the server's fleet view
+        # merges them for pairs it cannot measure itself (client->client, or
+        # pairs whose only traffic is client-initiated)
+        link = netlink.get_registry().delta_snapshot()
+        if link:
+            delta[trace_context.LINK_FIELD] = link
         message.add_params(
             Message.MSG_ARG_KEY_TELEMETRY, {trace_context.DELTA_FIELD: delta}
         )

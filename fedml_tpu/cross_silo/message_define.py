@@ -20,6 +20,13 @@ class MyMessage:
     MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = 3
     MSG_TYPE_C2S_CLIENT_STATUS = 5
 
+    # link probing (core/distributed/link_probe.py drives, netlink records):
+    # the server sends PROBE with an opaque monotonic timestamp + optional
+    # pad; the client echoes both back so the originator measures RTT on its
+    # own clock and bandwidth from the padded round trip
+    MSG_TYPE_LINK_PROBE = 8
+    MSG_TYPE_LINK_PROBE_ECHO = 9
+
     # arg keys (routing lives in Message's own envelope fields; the old
     # TYPE/SENDER/RECEIVER duplicates were dead vocabulary and are gone)
     MSG_ARG_KEY_MODEL_PARAMS = "model_params"
@@ -32,6 +39,12 @@ class MyMessage:
     # published model version; clients echo the version they trained on so
     # the async buffer's staleness policy can weight/admit the delta
     MSG_ARG_KEY_MODEL_VERSION = "model_version"
+    # link probes: sequence number, originator send time (opaque to the
+    # peer — echoed verbatim), declared pad size, and the pad itself
+    MSG_ARG_KEY_PROBE_SEQ = "probe_seq"
+    MSG_ARG_KEY_PROBE_T_SEND_NS = "probe_t_send_ns"
+    MSG_ARG_KEY_PROBE_NBYTES = "probe_nbytes"
+    MSG_ARG_KEY_PROBE_PAD = "probe_pad"
 
     # statuses
     MSG_CLIENT_STATUS_OFFLINE = "OFFLINE"

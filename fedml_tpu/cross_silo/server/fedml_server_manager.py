@@ -17,7 +17,8 @@ from ...core.engine import RemoteCommStrategy, RoundCheckpointer, decompress_arr
 from ...core.resilience import QuorumPolicy, RoundQuorum, RoundStateStore, note, overprovisioned_cohort_size
 from ...core.resilience import quorum as quorum_mod
 from ...core.resilience.round_state import restore_numpy_rng
-from ...core.telemetry import statusz, trace_context
+from ...core.telemetry import netlink, statusz, trace_context
+from ...core.distributed import link_probe
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ..message_define import MyMessage
@@ -64,6 +65,25 @@ class FedMLServerManager(FedMLCommManager):
         self._deadline_timer: Optional[threading.Timer] = None
         self._round_store: Optional[RoundStateStore] = None
         self._checkpointer: Optional[RoundCheckpointer] = None
+        # --- link telemetry -------------------------------------------------
+        # active probing is opt-in (args.link_probe_interval_s > 0); passive
+        # per-pair accounting in FedMLCommManager is always on
+        self._link_prober: Optional[link_probe.LinkProber] = None
+        # WAN-aware health (args.link_wan_health): observe each client's
+        # round as broadcast->arrival on the server's monotonic clock, so a
+        # slow LINK flags in health like a slow trainer does
+        self._link_wan_health = bool(getattr(args, "link_wan_health", False))
+        self._bcast_sent_mono: Dict[int, float] = {}
+        self._last_bcast_nbytes = 0
+        if self._async_mode and bool(getattr(args, "async_link_admission", False)):
+            # flag-gated: the staleness admission cut stretches for ranks
+            # whose predicted upload time spans publish windows
+            buf = getattr(aggregator, "async_buffer", None)
+            if buf is not None:
+                buf.policy.set_link_predictor(
+                    netlink.make_upload_predictor(lambda _r: self._last_bcast_nbytes),
+                    lambda: buf.publish_interval_ewma_s,
+                )
         rdir = getattr(args, "resilience_dir", None)
         if rdir:
             self._round_store = RoundStateStore(str(rdir))
@@ -136,6 +156,7 @@ class FedMLServerManager(FedMLCommManager):
             try:
                 super().run()
             finally:
+                self._stop_link_prober()
                 self._stop_statusz()
 
     # --- statusz ----------------------------------------------------------
@@ -195,6 +216,53 @@ class FedMLServerManager(FedMLCommManager):
             doc["quorum"] = q.statusz()
         return doc
 
+    # --- link probing ------------------------------------------------------
+    def _start_link_prober(self) -> None:
+        """Start the active prober once the fleet is online (configured via
+        ``args.link_probe_interval_s``; default off). Probes every connected
+        client, not just the round's cohort — a link estimate is most useful
+        for the clients you are about to re-admit."""
+        cfg = link_probe.probe_config(self.args)
+        if cfg is None or self._link_prober is not None:
+            return
+        self._link_prober = link_probe.LinkProber(
+            local_rank=self.rank,
+            send_probe=self._send_link_probe,
+            peers=lambda: range(1, self.size),
+            registry=netlink.get_registry(),
+            backend=self.backend.lower(),
+            **cfg,
+        )
+        self._link_prober.start()
+        statusz.register_section("link_probe", self._link_prober.statusz)
+
+    def _stop_link_prober(self) -> None:
+        if self._link_prober is None:
+            return
+        statusz.unregister_section("link_probe")
+        self._link_prober.stop()
+        self._link_prober = None
+
+    def _send_link_probe(self, peer: int, seq: int, t_send_ns: int, nbytes: int) -> None:
+        import numpy as np
+
+        message = Message(MyMessage.MSG_TYPE_LINK_PROBE, self.get_sender_id(), peer)
+        message.add_params(MyMessage.MSG_ARG_KEY_PROBE_SEQ, int(seq))
+        message.add_params(MyMessage.MSG_ARG_KEY_PROBE_T_SEND_NS, int(t_send_ns))
+        message.add_params(MyMessage.MSG_ARG_KEY_PROBE_NBYTES, int(nbytes))
+        if nbytes > 0:
+            message.add_params(MyMessage.MSG_ARG_KEY_PROBE_PAD,
+                               np.zeros(int(nbytes), dtype=np.uint8))
+        self.send_message(message)
+
+    def handle_message_link_probe_echo(self, msg_params: Message) -> None:
+        if self._link_prober is not None:
+            self._link_prober.observe_echo(
+                msg_params.get_sender_id(),
+                msg_params.get(MyMessage.MSG_ARG_KEY_PROBE_SEQ),
+                msg_params.get(MyMessage.MSG_ARG_KEY_PROBE_T_SEND_NS),
+            )
+
     # --- round trace lifecycle --------------------------------------------
     # All handlers run on the one receive-loop thread, so the round span can
     # stay open across handler invocations: entered when the round's configs
@@ -237,6 +305,9 @@ class FedMLServerManager(FedMLCommManager):
         self.register_message_receive_handler(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.handle_message_client_status_update)
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.handle_message_receive_model_from_client
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_LINK_PROBE_ECHO, self.handle_message_link_probe_echo
         )
 
     # --- cohort selection -------------------------------------------------
@@ -292,7 +363,12 @@ class FedMLServerManager(FedMLCommManager):
     def _arm_deadline_timer(self) -> None:
         fleet = getattr(self.aggregator, "fleet", None)
         health = fleet.health if fleet is not None else None
-        deadline_s = self._quorum_policy.deadline_for_round(health)
+        link_predict = None
+        if self._quorum_policy.use_link_cost:
+            # stretch each rank's EWMA by its measured upload time; the last
+            # broadcast's size is the best estimate of the symmetric upload
+            link_predict = netlink.make_upload_predictor(lambda _r: self._last_bcast_nbytes)
+        deadline_s = self._quorum_policy.deadline_for_round(health, link_predict=link_predict)
         if deadline_s is None:
             return
         t = threading.Timer(deadline_s, self._on_round_deadline, args=(int(self.args.round_idx),))
@@ -356,6 +432,7 @@ class FedMLServerManager(FedMLCommManager):
         if all_online and not self.is_initialized:
             mlops.log_aggregation_status("RUNNING", str(getattr(self.args, "run_id", "0")))
             self.is_initialized = True
+            self._start_link_prober()
             if int(self.args.round_idx) >= self.round_num:
                 # resumed from a store whose last complete round was the final
                 # one: nothing left to train, release the fleet immediately
@@ -379,6 +456,19 @@ class FedMLServerManager(FedMLCommManager):
         merge = getattr(self.aggregator, "merge_client_telemetry", None)
         if merge is not None and header is not None and trace_context.DELTA_FIELD in header:
             merge(sender_id, header[trace_context.DELTA_FIELD])
+        if self._link_wan_health:
+            # WAN-aware round time: broadcast->arrival on this clock. Booked
+            # AFTER the delta merge so it supersedes the train-span
+            # observation for this round — a throttled link then flags in
+            # health exactly like a slow trainer would.
+            sent_mono = self._bcast_sent_mono.pop(int(sender_id), None)
+            fleet = getattr(self.aggregator, "fleet", None)
+            if sent_mono is not None and fleet is not None:
+                import time as _time
+
+                fleet.health.observe_round(
+                    int(sender_id), _time.monotonic() - sent_mono,
+                    None if delta_round is None else int(delta_round))
         if self._async_mode:
             self._handle_async_upload(sender_id, model_params, local_sample_number, msg_params)
             return
@@ -574,6 +664,7 @@ class FedMLServerManager(FedMLCommManager):
         # a resumed server's first round is not round 0 — clients adopt this
         message.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, int(self.args.round_idx))
         message.add_params(MyMessage.MSG_ARG_KEY_MODEL_VERSION, self._model_version())
+        self._note_model_broadcast(receive_id, message)
         self.send_message(message)
 
     def send_message_sync_model_to_client(self, receive_id: int, global_model_params, client_index) -> None:
@@ -582,7 +673,18 @@ class FedMLServerManager(FedMLCommManager):
         message.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(client_index))
         message.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.args.round_idx)
         message.add_params(MyMessage.MSG_ARG_KEY_MODEL_VERSION, self._model_version())
+        self._note_model_broadcast(receive_id, message)
         self.send_message(message)
+
+    def _note_model_broadcast(self, receive_id: int, message: Message) -> None:
+        """Remember the broadcast size (the link cost model's payload
+        estimate for the symmetric upload) and, under WAN-aware health, when
+        this rank's round started on the server clock."""
+        import time as _time
+
+        self._last_bcast_nbytes = netlink.payload_nbytes(message)
+        if self._link_wan_health:
+            self._bcast_sent_mono[int(receive_id)] = _time.monotonic()
 
     def send_finish_to_all(self) -> None:
         for client_id in range(1, self.size):

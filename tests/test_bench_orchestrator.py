@@ -220,6 +220,14 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
                               "placement_plan_files": [
                                   "PLACEMENT_PLAN_async_fedbuff.json"],
                               "device": "TPU v5 lite"}, None),
+        "wan_profile": ({"wan_profile": {
+                             "3": {"injected_bytes_per_sec": 262144,
+                                   "measured_bytes_per_sec": 263750.6,
+                                   "bw_error_pct": 0.61}},
+                         "link_bw_error_pct": 0.97,
+                         "probe_overhead_pct": 0.36,
+                         "wan_probes_sent": 72,
+                         "wan_probes_answered": 72}, None),
     })
     with pytest.raises(SystemExit) as exc:
         bench.main()
@@ -249,6 +257,8 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
     assert out["async_parity_bit_exact"] is True
     assert out["placement_speedup"]["async_fedbuff"] == 4.07
     assert out["placement_plan"]["async_fedbuff"]["publish_k"] == 8
+    assert out["link_bw_error_pct"] == 0.97
+    assert out["probe_overhead_pct"] == 0.36
     assert out["stages_failed"] == []
     # incremental artifacts landed (one per stage + final, same stamp file)
     arts = glob.glob(str(tmp_path / "BENCH_MEASURED_*.json"))

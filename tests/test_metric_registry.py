@@ -40,6 +40,16 @@ EXPORTED = {
     "fedml_async_model_version": "gauge",
     "fedml_hierarchy_forwards": "gauge",
     "fedml_hierarchy_forwards_total": "counter",
+    # per-link network telemetry (core/telemetry/netlink.py; all labeled
+    # {src, dst, backend})
+    "fedml_link_bandwidth_bytes_per_sec": "gauge",
+    "fedml_link_rtt_seconds": "gauge",
+    "fedml_link_loss_ratio": "gauge",
+    "fedml_link_last_probe_age_seconds": "gauge",
+    "fedml_link_bytes_sent": "gauge",
+    "fedml_link_bytes_received": "gauge",
+    "fedml_link_predicted_mib_seconds": "gauge",
+    "fedml_link_confidence": "gauge",
     # round engine / placement search
     "fedml_engine_rounds_total": "counter",
     "fedml_engine_round_seconds": "histogram",

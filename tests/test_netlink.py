@@ -1,0 +1,517 @@
+"""Per-link network observability tests (ISSUE 12): payload sizing, the
+MAD-gated RobustEwma (including the regime-shift escape), per-pair
+passive/probe accounting, the LinkCostModel and its staleness-aware
+confidence, fleet merge of client-observed estimates, Perfetto flow events,
+the LinkProber send/echo/expire cycle, the flag-gated consumers (quorum
+adaptive deadline + async staleness admission), export surfaces
+(`/metrics` + `/statusz` ride-alongs), and the chaos-throttle 3-client
+cross-silo end-to-end where the throttled rank's bandwidth gauge drops AND
+the PR-4 health scorer flags it as a straggler."""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core import telemetry as tel
+from fedml_tpu.core.aggregation.async_buffer import AsyncAggBuffer, StalenessPolicy
+from fedml_tpu.core.distributed.communication.message import Message
+from fedml_tpu.core.distributed.link_probe import LinkProber, probe_config
+from fedml_tpu.core.resilience.quorum import QuorumPolicy
+from fedml_tpu.core.telemetry import netlink, prom, statusz
+from fedml_tpu.core.telemetry.netlink import (
+    LinkCostModel,
+    NetLinkRegistry,
+    PairStats,
+    RobustEwma,
+    payload_nbytes,
+)
+from fedml_tpu.cross_silo.message_define import MyMessage
+
+
+@pytest.fixture
+def registry():
+    return NetLinkRegistry()
+
+
+def _msg(msg_type=2, sender=0, receiver=1, **params):
+    m = Message(msg_type, sender, receiver)
+    for k, v in params.items():
+        m.add_params(k, v)
+    return m
+
+
+class TestPayloadNbytes:
+    def test_arrays_strings_scalars(self):
+        m = _msg(model_params={"w": np.zeros((10, 10), np.float32)},
+                 name="abcd", round_idx=3, flag=True)
+        # 400 array bytes + 4 str + 8 scalar + 1 bool + envelope
+        # (type/sender/receiver scalars)
+        assert payload_nbytes(m) == 400 + 4 + 8 + 1 + 3 * 8
+
+    def test_nested_and_depth_capped(self):
+        deep = {"a": {"b": {"c": {"d": {"e": {"f": {"g": {"h": 1.0}}}}}}}}
+        m = _msg(payload=deep)
+        # the 8-levels-deep scalar is beyond the walk cap; the envelope
+        # scalars still count
+        assert payload_nbytes(m) == 3 * 8
+
+    def test_junk_object_returns_zero(self):
+        assert payload_nbytes(object()) == 0
+        assert payload_nbytes(None) == 0
+
+
+class TestRobustEwma:
+    def test_first_sample_sets_value_then_ewma(self):
+        e = RobustEwma(alpha=0.3)
+        assert e.update(2.0) and e.value == pytest.approx(2.0)
+        e.update(4.0)
+        assert e.value == pytest.approx(0.3 * 4.0 + 0.7 * 2.0)
+        assert e.count == 2 and e.rejected == 0
+
+    def test_mad_gate_rejects_outlier(self):
+        e = RobustEwma()
+        for x in (1.0, 1.1, 0.9, 1.05, 0.95, 1.0):
+            e.update(x)
+        before = e.value
+        assert e.update(100.0) is False
+        assert e.value == before and e.rejected == 1
+        # the outlier never entered the reference window either
+        assert 100.0 not in e.samples
+
+    def test_nonfinite_rejected(self):
+        e = RobustEwma()
+        assert e.update(float("nan")) is False
+        assert e.update(float("inf")) is False
+        assert e.value is None and e.rejected == 2
+
+    def test_regime_shift_flushes_window(self):
+        # a genuinely degraded link keeps producing "outliers": after
+        # REGIME_SHIFT_REJECTS consecutive rejections the stale window is
+        # flushed and the new level adopted — the gate must not lock out
+        # the truth forever
+        e = RobustEwma()
+        for x in (1.0, 1.1, 0.9, 1.05, 0.95, 1.0):
+            e.update(x)
+        for _ in range(netlink.REGIME_SHIFT_REJECTS - 1):
+            assert e.update(100.0) is False
+        assert e.update(100.0) is True
+        assert e.value == pytest.approx(100.0)
+        assert list(e.samples) == [100.0]
+
+    def test_restore_adopts_remote_summary(self):
+        e = RobustEwma()
+        e.restore({"value": 5.5, "samples": 7})
+        assert e.value == pytest.approx(5.5) and e.count == 7
+        e.restore("junk")  # tolerated, no change
+        assert e.value == pytest.approx(5.5)
+
+
+class TestPairStats:
+    def test_zero_payload_probe_sets_rtt_floor(self):
+        s = PairStats(0, 1)
+        s.on_probe(0.040, 0)
+        assert s.rtt.value == pytest.approx(0.040)
+        assert s.bw.value is None
+
+    def test_sized_probe_yields_bandwidth(self):
+        s = PairStats(0, 1)
+        s.on_probe(0.040, 0)                       # floor
+        s.on_probe(0.040 + 0.2, 65536)             # pad adds 0.1s each way
+        assert s.bw.value == pytest.approx(2 * 65536 / 0.2)
+
+    def test_passive_bw_needs_large_message(self):
+        s = PairStats(0, 1)
+        s.on_recv(100, "INMEMORY", 0.01)           # control-plane: no bw
+        assert s.bw.value is None and s.oneway.value == pytest.approx(0.01)
+        s.on_recv(1 << 20, "INMEMORY", 1.0)        # transfer-dominated
+        assert s.bw.value is not None
+        # the latency floor is the (already-updated) one-way EWMA
+        floor = 0.3 * 1.0 + 0.7 * 0.01
+        assert s.bw.value == pytest.approx((1 << 20) / (1.0 - floor), rel=0.01)
+
+    def test_loss_ewma(self):
+        s = PairStats(0, 1)
+        s.on_probe_sent()
+        s.on_probe_lost()
+        assert s.loss_ratio() == pytest.approx(1.0)
+        s.on_probe(0.01, 0)
+        assert 0.0 < s.loss_ratio() < 1.0
+        assert s.probes_sent == 1 and s.probes_lost == 1 and s.probes_answered == 1
+
+
+class TestRegistryPassive:
+    def test_send_stamps_header_and_books_bytes(self, registry):
+        m = _msg(model_params=np.zeros(1000, np.uint8))
+        registry.record_send(m, backend="INMEMORY")
+        from fedml_tpu.core.telemetry.trace_context import SENT_AT_FIELD
+        header = m.get(Message.MSG_ARG_KEY_TELEMETRY)
+        assert isinstance(header, dict)
+        assert isinstance(header.get(SENT_AT_FIELD), int)
+        s = registry.pair((0, 1), create=False)
+        assert s.bytes_sent >= 1000 and s.msgs_sent == 1
+        assert s.bytes_recvd == 0  # recv side books separately
+
+    def test_recv_books_latency_and_flow(self, registry):
+        m = _msg(model_params=np.zeros(20000, np.uint8))
+        registry.record_send(m, backend="INMEMORY")
+        registry.record_recv(m, backend="INMEMORY")
+        s = registry.pair((0, 1), create=False)
+        assert s.msgs_recvd == 1 and s.bytes_recvd >= 20000
+        assert s.oneway.value is not None
+        events = registry.flow_events(0)
+        assert len(events) == 2
+        send_ev, recv_ev = events
+        assert send_ev["ph"] == "s" and send_ev["pid"] == 0
+        assert recv_ev["ph"] == "f" and recv_ev["pid"] == 1
+        assert send_ev["args"]["bytes"] >= 20000
+        assert recv_ev["ts"] >= send_ev["ts"]
+
+    def test_self_messages_are_not_links(self, registry):
+        registry.record_send(_msg(sender=2, receiver=2))
+        registry.record_recv(_msg(sender=2, receiver=2))
+        assert registry.pairs() == {}
+
+
+class TestCostModel:
+    def test_unknown_pair(self, registry):
+        pred = LinkCostModel(registry).predict_transfer_s(0, 9, 1 << 20)
+        assert pred.seconds is None and pred.confidence == 0.0
+
+    def test_prediction_math_and_support(self, registry):
+        registry.observe_probe(0, 1, 0.040, 0)
+        for _ in range(4):
+            registry.observe_probe(0, 1, 0.240, 65536)
+        s = registry.pair((0, 1), create=False)
+        pred = LinkCostModel(registry).predict_transfer_s(0, 1, 1 << 20)
+        assert pred.seconds == pytest.approx(
+            s.rtt.value / 2.0 + (1 << 20) / s.bw.value)
+        # fresh pair: confidence == support == count/(count+3)
+        assert pred.confidence == pytest.approx(
+            s.bw.count / (s.bw.count + 3.0), rel=0.05)
+
+    def test_latency_only_is_low_confidence(self, registry):
+        registry.observe_probe(0, 1, 0.030, 0)
+        pred = LinkCostModel(registry).predict_transfer_s(0, 1, 100)
+        assert pred.seconds == pytest.approx(0.015)
+        assert pred.confidence <= 0.25
+
+    def test_upload_predictor_gates_on_confidence(self, registry, monkeypatch):
+        monkeypatch.setattr(netlink, "_registry", registry)
+        predict = netlink.make_upload_predictor(lambda _r: 1 << 20)
+        assert predict(1) is None           # unknown pair
+        registry.observe_probe(1, 0, 0.020, 0)
+        for _ in range(8):
+            registry.observe_probe(1, 0, 0.220, 65536)
+        got = predict(1)
+        assert got is not None and got > 0
+
+
+class TestMergeRemote:
+    def test_adopts_remote_only_where_local_is_empty(self, registry):
+        registry.observe_probe(0, 1, 0.010, 0)  # local rtt on 0->1
+        snap = {
+            "0->1": {"bw_bytes_per_s": {"value": 5e6, "samples": 4},
+                     "rtt_s": {"value": 9.0, "samples": 4}},
+            "1->0": {"bw_bytes_per_s": {"value": 2e6, "samples": 3}},
+        }
+        assert registry.merge_remote(1, snap) is True
+        s01 = registry.pair((0, 1), create=False)
+        assert s01.bw.value == pytest.approx(5e6)      # adopted: no local bw
+        assert s01.rtt.value == pytest.approx(0.010)   # kept: local wins
+        assert registry.pair((1, 0), create=False).bw.value == pytest.approx(2e6)
+        assert registry.statusz()["remote"]["1"] == snap
+
+    def test_junk_tolerated(self, registry):
+        assert registry.merge_remote(1, "nope") is False
+        assert registry.merge_remote("x", {}) is False
+        assert registry.merge_remote(1, {"bad-key": {"bw_bytes_per_s": {}},
+                                         "0->2": "junk"}) is True
+        assert registry.pairs() == {}
+
+
+class TestLinkProber:
+    def _prober(self, registry, sent, **kw):
+        kw.setdefault("interval_s", 0.05)
+        kw.setdefault("payload_bytes", 4096)
+        return LinkProber(
+            local_rank=0,
+            send_probe=lambda peer, seq, t_ns, nbytes: sent.append(
+                (peer, seq, t_ns, nbytes)),
+            peers=lambda: [1, 2], registry=registry, **kw)
+
+    def test_tick_sends_probe_pair_per_peer(self, registry):
+        sent = []
+        p = self._prober(registry, sent)
+        p.tick()
+        assert len(sent) == 4  # (floor, sized) x 2 peers
+        assert {s[3] for s in sent} == {0, 4096}
+        assert p.outstanding() == 4
+        assert registry.pair((0, 1), create=False).probes_sent == 2
+
+    def test_echo_updates_estimators_and_drops_unknown(self, registry):
+        sent = []
+        p = self._prober(registry, sent)
+        p.tick()
+        for peer, seq, t_ns, _ in sent:
+            p.observe_echo(peer, seq, t_ns)
+        assert p.echoes == 4 and p.outstanding() == 0
+        assert registry.pair((0, 1), create=False).rtt.value is not None
+        p.observe_echo(1, 99999, 0)    # unknown seq: dropped
+        p.observe_echo(1, "junk", 0)   # malformed: dropped
+        assert p.echoes == 4
+
+    def test_unanswered_probes_expire_as_losses(self, registry):
+        sent = []
+        p = self._prober(registry, sent, interval_s=0.01, timeout_intervals=1.0)
+        p.tick()
+        time.sleep(0.05)
+        p.tick()  # the expire pass runs at tick start
+        assert registry.pair((0, 1), create=False).probes_lost == 2
+        assert registry.pair((0, 1), create=False).loss_ratio() > 0.0
+
+    def test_probe_config_gating(self):
+        class A:
+            pass
+        assert probe_config(A()) is None
+        a = A()
+        a.link_probe_interval_s = 2.5
+        cfg = probe_config(a)
+        assert cfg["interval_s"] == 2.5 and cfg["payload_bytes"] == 65536
+
+    def test_rejects_nonpositive_interval(self, registry):
+        with pytest.raises(ValueError):
+            self._prober(registry, [], interval_s=0.0)
+
+
+class TestLinkConsumers:
+    def test_staleness_link_extra_stretches_cut(self):
+        pol = StalenessPolicy(max_staleness=10)
+        assert pol.admission_cut(rank=1) == 10
+        pol.set_link_predictor(lambda r: 2.5, lambda: 1.0)
+        assert pol._link_extra(1) == 3  # ceil(2.5 / 1.0)
+        assert pol.admission_cut(rank=1) == 13
+        assert pol.admit(12, rank=1) and not pol.admit(14, rank=1)
+        assert pol.as_dict()["link_wired"] is True
+
+    def test_staleness_link_extra_capped_and_defensive(self):
+        pol = StalenessPolicy(max_staleness=4)
+        pol.set_link_predictor(lambda r: 1e9, lambda: 0.1)  # wild estimate
+        assert pol._link_extra(1) == 4                      # capped at max
+        pol.set_link_predictor(lambda r: None, lambda: 1.0)
+        assert pol._link_extra(1) == 0                      # unconfident: no-op
+        pol.set_link_predictor(lambda r: 1.0, lambda: None)
+        assert pol._link_extra(1) == 0                      # no interval yet
+        pol.set_link_predictor(lambda r: 1 / 0, lambda: 1.0)
+        assert pol._link_extra(1) == 0                      # predictor raised
+
+    def test_quorum_link_cost_stretches_only_the_slow_rank(self):
+        class C:
+            def __init__(self, e):
+                self.ewma_s = e
+
+        class H:
+            _clients = {1: C(1.0), 2: C(1.0), 3: C(1.0)}
+
+        base = QuorumPolicy(adaptive=True, adaptive_mult=2.0, min_deadline_s=0.1)
+        assert base.deadline_for_round(H()) == pytest.approx(2.0)
+        linked = QuorumPolicy(adaptive=True, adaptive_mult=2.0,
+                              min_deadline_s=0.1, use_link_cost=True)
+        predict = {3: 4.0}.get
+        assert linked.deadline_for_round(H(), link_predict=predict) == \
+            pytest.approx(2.0 * (1.0 + 4.0))
+        # defensive: a raising predictor degrades to the plain EWMA deadline
+        def boom(rank):
+            raise RuntimeError("no estimate")
+        assert linked.deadline_for_round(H(), link_predict=boom) == \
+            pytest.approx(2.0)
+
+    def test_from_args_wires_flag(self):
+        class A:
+            quorum_link_cost = True
+        assert QuorumPolicy.from_args(A()).use_link_cost is True
+        assert QuorumPolicy.from_args(object()).use_link_cost is False
+
+    def test_publish_interval_ewma_tracks_publishes(self):
+        buf = AsyncAggBuffer(publish_k=1, policy=StalenessPolicy(exponent=0.0))
+        assert buf.publish_interval_ewma_s is None
+        t0 = {"w": np.ones((2,), np.float32)}
+        buf.submit(1, t0, 1.0, 0)
+        buf.publish()
+        assert buf.publish_interval_ewma_s is None  # first publish: no dt yet
+        buf.submit(2, t0, 1.0, 1)
+        buf.publish()
+        assert buf.publish_interval_ewma_s is not None
+        assert buf.publish_interval_ewma_s >= 0.0
+        assert "publish_interval_ewma_s" in buf.statusz()
+
+
+class TestExportSurfaces:
+    def test_prom_render_carries_link_gauges(self, monkeypatch):
+        r = NetLinkRegistry()
+        monkeypatch.setattr(netlink, "_registry", r)
+        r.observe_probe(0, 3, 0.020, 0)
+        for _ in range(3):
+            r.observe_probe(0, 3, 0.220, 65536)
+        text = prom.render(tel.Telemetry(enabled=True))
+        assert re.search(
+            r'fedml_link_bandwidth_bytes_per_sec\{[^}]*dst="3"[^}]*\} ', text)
+        assert re.search(r'fedml_link_rtt_seconds\{[^}]*dst="3"', text)
+        assert re.search(r'fedml_link_confidence\{[^}]*dst="3"', text)
+
+    def test_statusz_links_section_only_when_pairs_exist(self, monkeypatch):
+        r = NetLinkRegistry()
+        monkeypatch.setattr(netlink, "_registry", r)
+        assert "links" not in statusz.render()["sections"]
+        r.record_send(_msg(sender=0, receiver=1, x=1.0))
+        doc = statusz.render()
+        assert "0->1" in doc["sections"]["links"]["pairs"]
+        json.dumps(doc, default=repr)  # page must stay serializable
+
+
+class TestChaosLinkEndToEnd:
+    def test_throttled_client_visible_in_gauges_and_health(self, tmp_path,
+                                                           monkeypatch):
+        """ISSUE 12 acceptance: a 3-client in-memory run where one client's
+        link is chaos-throttled. The per-pair bandwidth gauge for the
+        throttled pair must be live on `/metrics` and far below the fast
+        pairs', the `links` statusz section must carry the pair, and — with
+        WAN-aware health on — the PR-4 health scorer must flag the throttled
+        rank as a straggler from its link alone (no train delay)."""
+        import fedml_tpu as fedml
+        from fedml_tpu import mlops
+        from fedml_tpu.arguments import default_config
+        from fedml_tpu.core.distributed.communication.inmemory.broker import InMemoryBroker
+
+        n_clients, slow_rank, rounds = 3, 3, 4
+        throttle_bps, base_delay_s = 131072.0, 0.5
+        probe_interval_s = 0.2
+        port_file = tmp_path / "statusz.port"
+        reports = []
+        ready = threading.Event()    # straggler flagged AND bw estimate live
+        release = threading.Event()  # main thread done probing HTTP
+
+        def capture_report(round_idx, report):
+            reports.append((round_idx, dict(report)))
+            pair = netlink.get_registry().pair((0, slow_rank), create=False)
+            # gate on an ANSWERED probe, not just passive bw: the first
+            # padded echo takes ~2s through the throttle, and the /statusz
+            # assertions below want active-probe rows
+            if (report.get("stragglers") == [slow_rank]
+                    and pair is not None and pair.bw.value is not None
+                    and pair.probes_answered > 0):
+                ready.set()
+                # hold the receive loop so /statusz + /metrics can be probed
+                # while the run is live
+                release.wait(timeout=120)
+
+        monkeypatch.setattr(mlops, "log_health_report", capture_report)
+
+        def make_args(rank, role):
+            over = dict(
+                run_id="test_chaos_link", rank=rank, role=role,
+                backend="INMEMORY", scenario="horizontal",
+                client_num_in_total=n_clients, client_num_per_round=n_clients,
+                comm_round=rounds, epochs=1, batch_size=16,
+                frequency_of_the_test=1, dataset="synthetic", model="lr",
+                random_seed=0,
+            )
+            if role == "server":
+                over["statusz_port"] = 0
+                over["statusz_port_file"] = str(port_file)
+                over["link_probe_interval_s"] = probe_interval_s
+                # padded RTT through the throttle is ~2s; the timeout must
+                # clear it or every sized probe counts as a loss
+                over["link_probe_timeout_intervals"] = 60
+                over["link_wan_health"] = True
+            if role == "client" and rank == slow_rank:
+                over["chaos_link_throttle"] = throttle_bps
+                over["chaos_link_base_delay_s"] = base_delay_s
+            return default_config("cross_silo", **over)
+
+        def run_party(args, results, key):
+            args = fedml.init(args)
+            device = fedml.device.get_device(args)
+            dataset, output_dim = fedml.data.load(args)
+            model = fedml.model.create(args, output_dim)
+            results[key] = fedml.FedMLRunner(args, device, dataset, model).run()
+
+        t = tel.get_telemetry()
+        was = t.enabled
+        t.set_enabled(True)
+        t.reset()
+        netlink.reset()
+        try:
+            InMemoryBroker.reset()
+            results = {}
+            threads = [threading.Thread(
+                target=run_party, args=(make_args(0, "server"), results, "server"),
+                daemon=True)]
+            for rank in range(1, n_clients + 1):
+                threads.append(threading.Thread(
+                    target=run_party,
+                    args=(make_args(rank, "client"), results, f"c{rank}"),
+                    daemon=True))
+            for th in threads:
+                th.start()
+            try:
+                assert ready.wait(timeout=300), \
+                    "no straggler report with a live 0->slow bandwidth estimate"
+                deadline = time.monotonic() + 60
+                while not port_file.exists() and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                port = int(port_file.read_text())
+
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/statusz", timeout=10) as resp:
+                    doc = json.loads(resp.read())
+                links = doc["sections"]["links"]["pairs"]
+                slow_pair = links[f"0->{slow_rank}"]
+                assert slow_pair["bw_bytes_per_s"]["value"] is not None
+                assert slow_pair["probes"]["answered"] > 0
+                assert doc["sections"]["link_probe"]["ticks"] > 0
+                health = doc["sections"]["health"]
+                assert health["clients"][str(slow_rank)]["straggler"] is True
+
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+                    metrics = resp.read().decode()
+                bw = {}
+                for mline in metrics.splitlines():
+                    m = re.match(
+                        r'fedml_link_bandwidth_bytes_per_sec\{([^}]*)\} (\S+)',
+                        mline)
+                    if not m:
+                        continue
+                    labels = dict(re.findall(r'(\w+)="([^"]*)"', m.group(1)))
+                    bw[(labels["src"], labels["dst"])] = float(m.group(2))
+                slow_bw = bw[("0", str(slow_rank))]
+                # the injected profile is ~128 KiB/s; the estimate must sit
+                # near it, far under any unthrottled pair's
+                assert slow_bw < 4 * throttle_bps
+                fast = [v for (s, d), v in bw.items()
+                        if s == "0" and d not in ("0", str(slow_rank))]
+                assert fast and all(v > 4 * slow_bw for v in fast), (slow_bw, bw)
+                assert f'fedml_client_straggler{{rank="{slow_rank}"}} 1' in metrics
+            finally:
+                release.set()
+
+            for th in threads:
+                th.join(timeout=300)
+                assert not th.is_alive(), "chaos-link cluster deadlocked"
+            assert results["server"] is not None
+            # a throttled LINK alone produced the flag; no fast rank was ever
+            # flagged
+            flagged_sets = [rep["stragglers"] for _, rep in reports]
+            assert [slow_rank] in flagged_sets
+            assert all(fs in ([], [slow_rank]) for fs in flagged_sets), flagged_sets
+        finally:
+            release.set()
+            t.reset()
+            t.set_enabled(was)
+            netlink.reset()
+            InMemoryBroker.reset()

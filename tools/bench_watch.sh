@@ -22,12 +22,12 @@ LOCK="$REPO/.bench_runtime/bench.lock"
 
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-90}
 SMOKE_TIMEOUT=${SMOKE_TIMEOUT:-1200}  # may run BOTH stats layouts (narrow+wide)
-# must exceed the sum of bench.py's per-stage budgets (_STAGES: 12780s with
-# attn_micro, the tuned re-run, the agg + agg_sharded microbenches and the
-# placement search; banked CPU baselines usually shave 600s) plus the 180s
-# probe, or the outer timeout kills a run whose stages are all within their
-# own contracts
-BENCH_TIMEOUT=${BENCH_TIMEOUT:-13500}
+# must exceed the sum of bench.py's per-stage budgets (_STAGES: 13620s with
+# attn_micro, the tuned re-run, the agg + agg_sharded microbenches, the
+# placement search and the wan_profile link-observability stage; banked CPU
+# baselines usually shave 600s) plus the 180s probe, or the outer timeout
+# kills a run whose stages are all within their own contracts
+BENCH_TIMEOUT=${BENCH_TIMEOUT:-14100}
 SLEEP_DOWN=${SLEEP_DOWN:-120}     # tunnel down: re-probe every 2 min (short
                                   # up-windows are the norm; 10 min missed them)
 SLEEP_UP=${SLEEP_UP:-3600}        # after a good measurement: hourly is plenty
@@ -81,6 +81,7 @@ commit_artifacts() {
       surface_agg_rates
       surface_agg_sharded
       surface_async_rounds
+      surface_wan_profile
       surface_placement
       surface_resilience
       surface_serving
@@ -167,6 +168,33 @@ if rph:
 PYEOF
 ) || return 0
   [ -n "$asy" ] && log "$asy"
+}
+
+surface_wan_profile() {
+  # one-line view of the per-link WAN observability stage: worst measured-
+  # vs-injected bandwidth error across the throttled fleet and the probe
+  # overhead share — so the watcher log answers "can the link estimators
+  # still recover a known WAN profile, and for free" without opening
+  # BENCH_MEASURED_*.json
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1) || return 0
+  [ -n "$newest" ] || return 0
+  local wan
+  wan=$(python3 - "$newest" <<'PYEOF' 2>/dev/null
+import json, sys
+doc = json.load(open(sys.argv[1]))
+links = doc.get("wan_profile") or {}
+if links:
+    pairs = ", ".join(
+        f"0->{r}: {v['measured_bytes_per_sec'] / 1e6:.2f}MB/s "
+        f"({v['bw_error_pct']}% err)" for r, v in sorted(links.items()))
+    print(f"wan_profile: {{{pairs}}}, "
+          f"link_bw_error_pct {doc.get('link_bw_error_pct')}, "
+          f"probe_overhead_pct {doc.get('probe_overhead_pct')}, "
+          f"answered {doc.get('wan_probes_answered')}/{doc.get('wan_probes_sent')}")
+PYEOF
+) || return 0
+  [ -n "$wan" ] && log "$wan"
 }
 
 surface_placement() {

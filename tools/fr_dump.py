@@ -63,6 +63,33 @@ def _fmt_fields(fields: Optional[Dict[str, Any]]) -> str:
     return " " + " ".join(f"{k}={v}" for k, v in fields.items())
 
 
+def _fmt_bytes(n: Any) -> str:
+    try:
+        n = int(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"
+
+
+def _fmt_comm(ev: Dict[str, Any]) -> str:
+    """comm_send/comm_recv events: direction arrow to the peer + payload size
+    (booked by the comm manager via the netlink payload estimator)."""
+    fields = dict(ev.get("fields") or {})
+    peer = fields.pop("peer", None)
+    nbytes = fields.pop("bytes", None)
+    arrow = "->" if ev.get("kind") == "comm_send" else "<-"  # fedlint: disable=recorder-kind stdlib-only dump reader: matches EVENT_COMM_SEND without importing fedml_tpu
+    parts = []
+    if peer is not None:
+        parts.append(f"{arrow} peer {peer}")
+    if nbytes is not None:
+        parts.append(f"[{_fmt_bytes(nbytes)}]")
+    return (" " + " ".join(parts) if parts else "") + _fmt_fields(fields)
+
+
 def render(doc: Dict[str, Any], out=sys.stdout) -> None:
     meta = doc["meta"]
     w = out.write
@@ -116,8 +143,11 @@ def render(doc: Dict[str, Any], out=sys.stdout) -> None:
         t0 = events[0].get("t_ns", 0)
         for ev in events:
             rel_s = (ev.get("t_ns", 0) - t0) / 1e9
-            w(f"  +{rel_s:9.4f}s  {ev.get('kind'):<10} {ev.get('name')}"
-              f"{_fmt_fields(ev.get('fields'))}\n")
+            if ev.get("kind") in ("comm_send", "comm_recv"):  # fedlint: disable=recorder-kind stdlib-only dump reader: matches EVENT_COMM_* without importing fedml_tpu
+                detail = _fmt_comm(ev)
+            else:
+                detail = _fmt_fields(ev.get("fields"))
+            w(f"  +{rel_s:9.4f}s  {ev.get('kind'):<10} {ev.get('name')}{detail}\n")
     w("\n")
 
 
