@@ -1672,6 +1672,132 @@ def _bench_wan_profile():
     }
 
 
+def _bench_slo_overhead():
+    """SLO evaluator overhead (ISSUE 14): the tsdb ingest hook rides EVERY
+    telemetry counter/histogram emission and the burn-rate evaluator ticks
+    every round — observability that slows the round loop it watches is a
+    bug. Drive a simulated round loop (real numpy work per round, the same
+    engine.rounds/engine.round_seconds emissions RoundEngine books, one
+    maybe_tick per round) through a real activated engine with a
+    deliberately-breaching canary SLO riding args.slo_spec, then bill the
+    evaluator's self-accounted time (tsdb ingest_ms + engine tick_ms)
+    against the loop's wall time.
+
+    Integrity guards (BenchIntegrityError, refusing to publish):
+    - overhead: ingest + tick must stay under FEDML_SLO_OVERHEAD_TOL_PCT
+      (default 1%) of the loop wall time;
+    - liveness: the canary alert must FIRE during the loop (an evaluator
+      that never evaluated has a meaningless overhead figure), ticks and
+      ingested samples must both be nonzero."""
+    import json as _json
+    import tempfile
+
+    import numpy as np
+
+    from fedml_tpu.core import telemetry as tel
+    from fedml_tpu.core.telemetry import slo
+
+    tiny = os.environ.get("FEDML_BENCH_TINY") == "1"
+    # per-round work must be ROUND-SHAPED (ms-scale): the guard is a ratio,
+    # and against a microsecond-scale loop even a free evaluator looks
+    # expensive — no real front books rounds faster than milliseconds
+    rounds = 240 if tiny else 600
+    work_elems = 384
+
+    # canary: engine.round_seconds "last" can never meet a 1e-9s target, so
+    # the alert must walk ok->pending->firing while the loop runs — proving
+    # the spec-file override path AND the evaluator end to end
+    spec_doc = {"slos": [{"name": "bench_slo_canary",
+                          "series": "engine.round_seconds",
+                          "signal": "last", "comparator": "<=",
+                          "target": 1e-9, "fast_window_s": 60,
+                          "slow_window_s": 60,
+                          "firing_for_ticks": 2, "clear_for_ticks": 2}]}
+    spec_file = tempfile.NamedTemporaryFile(
+        "w", suffix="_slo_spec.json", delete=False)
+    _json.dump(spec_doc, spec_file)
+    spec_file.close()
+
+    class _Args:
+        slo_spec = spec_file.name
+
+    t = tel.get_telemetry()
+    tel_was_enabled = t.enabled
+    t.set_enabled(True)
+    t.reset()
+    engine = slo.activate(_Args(), front="engine")
+    if engine is None:
+        return {"skipped": "slo_disabled"}
+    try:
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((work_elems, work_elems))
+        b = rng.standard_normal((work_elems, work_elems))
+        t0 = time.perf_counter()
+        done = 0
+        # at least `rounds` rounds AND >= 1.2s of wall: maybe_tick's 0.25s
+        # production spacing needs multiple intervals for the canary to walk
+        # ok -> pending -> firing (firing_for_ticks=2)
+        while done < rounds or time.perf_counter() - t0 < 1.2:
+            r0 = time.perf_counter()
+            a = a @ b / float(work_elems)          # the "round" itself
+            t.counter("engine.rounds").add(1)
+            t.histogram("engine.round_seconds").observe(
+                time.perf_counter() - r0)
+            engine.maybe_tick()   # production spacing (0.25s floor)
+            done += 1
+            if done >= rounds * 200:               # pathological-fast guard
+                break
+        wall_s = time.perf_counter() - t0
+        rounds = done
+        if not np.isfinite(a).all():               # keep the matmul live
+            raise BenchIntegrityError("slo_overhead: workload diverged")
+
+        st = engine.statusz()
+        store_st = engine.store.statusz()
+        ticks = int(st["tick_count"])
+        alerts_fired = int(st["alerts_fired"])
+        overhead_ms = float(st["tick_ms"]) + float(store_st["ingest_ms"])
+        overhead_pct = 100.0 * (overhead_ms / 1e3) / wall_s
+        canary = st["slos"].get("bench_slo_canary") or {}
+    finally:
+        slo.deactivate(engine)
+        if not tel_was_enabled:
+            t.set_enabled(False)
+        os.unlink(spec_file.name)
+
+    _p(f"slo_overhead: {rounds} rounds in {wall_s:.2f}s, {ticks} ticks, "
+       f"ingest+tick {overhead_ms:.2f}ms ({overhead_pct:.4f}% of wall), "
+       f"canary state {canary.get('state')}, alerts_fired {alerts_fired}")
+
+    if ticks == 0 or int(store_st["samples_total"]) == 0:
+        raise BenchIntegrityError(
+            f"slo_overhead: evaluator never ran (ticks {ticks}, samples "
+            f"{store_st['samples_total']}) — overhead figure is meaningless; "
+            "refusing to publish")
+    if alerts_fired < 1 or canary.get("state") != slo.STATE_FIRING:
+        raise BenchIntegrityError(
+            f"slo_overhead: canary SLO never fired (state "
+            f"{canary.get('state')!r}, alerts_fired {alerts_fired}) — the "
+            "evaluator is not evaluating; refusing to publish")
+    tol_pct = float(os.environ.get("FEDML_SLO_OVERHEAD_TOL_PCT", "1.0"))
+    if overhead_pct >= tol_pct:
+        raise BenchIntegrityError(
+            f"slo_overhead: evaluator consumed {overhead_pct:.4f}% of the "
+            f"round-loop wall time (>= {tol_pct}%); always-on observability "
+            "must be ~free; refusing to publish")
+
+    return {
+        "slo_overhead_pct": round(overhead_pct, 4),
+        "slo_ticks": ticks,
+        "slo_ingest_ms": round(float(store_st["ingest_ms"]), 3),
+        "slo_tick_ms": round(float(st["tick_ms"]), 3),
+        "slo_samples": int(store_st["samples_total"]),
+        "alerts_fired": alerts_fired,
+        "slo_rounds": rounds,
+        "slo_window_s": round(wall_s, 2),
+    }
+
+
 def _bench_placement_search(probe_publishes: int = 4, reps: int = 2):
     """Auto-placement search (ISSUE 11): cost-model-seeded, measurement-
     refined search (core/engine/placement_search.py) vs the hand-picked
@@ -2719,6 +2845,8 @@ def _stage_result(name: str) -> dict:
         out = _retry_transient(_bench_async_rounds)
     elif name == "wan_profile":
         out = _retry_transient(_bench_wan_profile)
+    elif name == "slo_overhead":
+        out = _bench_slo_overhead()
     elif name == "placement_search":
         out = _retry_transient(_bench_placement_search)
     elif name == "llm_pallas_tuned":
@@ -2780,6 +2908,11 @@ _STAGES: list[tuple[str, int]] = [
     # with probe overhead < 1% of the window (both integrity-guarded). The
     # window itself is seconds; the budget covers interpreter start + retry
     ("wan_profile", 240),
+    # SLO evaluator overhead: simulated round loop through a real activated
+    # engine + deliberately-breaching canary spec; tsdb ingest + burn-rate
+    # ticks must stay under 1% of loop wall (integrity-guarded). Pure
+    # CPU/numpy — seconds of work; the budget covers interpreter start
+    ("slo_overhead", 180),
     # auto-placement search: cost-model-seeded probes over (strategy x
     # publish_k x staleness exponent) on two workloads; default-vs-searched
     # speedup + the winning PlacementPlan JSON artifact (zero-retrace +
@@ -3435,6 +3568,19 @@ def main() -> None:
                 out[key] = wan[key]
     elif wan is not None:
         out["wan_profile_skipped"] = wan["skipped"]
+
+    slo_out = stage_out.get("slo_overhead")
+    if slo_out is not None and "skipped" not in slo_out:
+        # SLO evaluator headline (tools/bench_watch.sh surfaces these):
+        # evaluator cost share of the round loop + alerts fired during the
+        # measurement, both integrity-guarded in-stage
+        for key in ("slo_overhead_pct", "slo_ticks", "slo_ingest_ms",
+                    "slo_tick_ms", "slo_samples", "alerts_fired",
+                    "slo_rounds", "slo_window_s"):
+            if slo_out.get(key) is not None:
+                out[key] = slo_out[key]
+    elif slo_out is not None:
+        out["slo_overhead_skipped"] = slo_out["skipped"]
 
     placement = stage_out.get("placement_search")
     if placement is not None and "skipped" not in placement:

@@ -488,9 +488,14 @@ def flight_recorded(role: str):
 def run_local_round(train_fn: Callable[[], Any], args: Any, round_idx: int, *, rank: Any = None) -> Any:
     """Client-side local-round scaffolding every front shares: the
     ``client.train`` span plus the chaos knobs — ``chaos_train_delay_s``
-    (inflates measured train time for straggler drills) and
+    (inflates measured train time for straggler drills; scoped to rounds
+    below ``chaos_train_delay_rounds`` when that is set, so recovery drills
+    can watch an alert resolve) and
     ``chaos_raise_at_round`` (scheduled failure exercising the crash path)."""
     chaos_delay = float(getattr(args, "chaos_train_delay_s", 0) or 0)
+    chaos_delay_rounds = getattr(args, "chaos_train_delay_rounds", None)
+    if chaos_delay_rounds is not None and int(round_idx) >= int(chaos_delay_rounds):
+        chaos_delay = 0.0  # recovery drill: stop straggling so alerts can resolve
     chaos_raise_at = getattr(args, "chaos_raise_at_round", None)
     with tel.span("client.train", round=int(round_idx)):
         if chaos_delay > 0:
@@ -657,6 +662,7 @@ class RoundEngine:
 
     def run(self, w_global: PyTree) -> PyTree:
         from ..alg_frame.context import Context
+        from ..telemetry import slo
 
         p = self.span_prefix
         comm_round = int(getattr(self.args, "comm_round", 10))
@@ -664,28 +670,34 @@ class RoundEngine:
         if self.resume_fn is not None:
             w_global, start_round = self.resume_fn(w_global)
         freq = int(getattr(self.args, "frequency_of_the_test", 5))
-        for round_idx in range(start_round, comm_round):
-            log.info("================ Communication round : %d", round_idx)
-            t0 = time.perf_counter()
-            with tel.span(f"{p}.round", round=round_idx, **self.round_span_attrs):
-                with tel.span(f"{p}.sample", round=round_idx):
-                    cohort = self.sample_fn(round_idx)
-                Context().add("client_indexes_of_round", cohort)
-                result = self.strategy.run_round(round_idx, w_global, cohort)
-                with tel.span(f"{p}.aggregate", round=round_idx, k=result.k):
-                    w_global = self.sink.fold(round_idx, w_global, result)
-                self.install_fn(w_global)
-                if self.checkpoint_fn is not None:
-                    self.checkpoint_fn(round_idx, w_global, cohort, round_idx == comm_round - 1)
-                if eval_due(round_idx, comm_round, freq):
-                    with tel.span(f"{p}.eval", round=round_idx):
-                        metrics = self.eval_fn(round_idx)
-                    if metrics is not None:
-                        self.metrics_history.append(metrics)
-            tel.counter("engine.rounds").add(1)
-            tel.histogram("engine.round_seconds").observe(time.perf_counter() - t0)
-            if self.log_summary:
-                mlops.log_telemetry_summary(round_idx)
+        slo_engine = slo.activate(self.args, front="engine")
+        try:
+            for round_idx in range(start_round, comm_round):
+                log.info("================ Communication round : %d", round_idx)
+                t0 = time.perf_counter()
+                with tel.span(f"{p}.round", round=round_idx, **self.round_span_attrs):
+                    with tel.span(f"{p}.sample", round=round_idx):
+                        cohort = self.sample_fn(round_idx)
+                    Context().add("client_indexes_of_round", cohort)
+                    result = self.strategy.run_round(round_idx, w_global, cohort)
+                    with tel.span(f"{p}.aggregate", round=round_idx, k=result.k):
+                        w_global = self.sink.fold(round_idx, w_global, result)
+                    self.install_fn(w_global)
+                    if self.checkpoint_fn is not None:
+                        self.checkpoint_fn(round_idx, w_global, cohort, round_idx == comm_round - 1)
+                    if eval_due(round_idx, comm_round, freq):
+                        with tel.span(f"{p}.eval", round=round_idx):
+                            metrics = self.eval_fn(round_idx)
+                        if metrics is not None:
+                            self.metrics_history.append(metrics)
+                tel.counter("engine.rounds").add(1)
+                tel.histogram("engine.round_seconds").observe(time.perf_counter() - t0)
+                if slo_engine is not None:
+                    slo_engine.maybe_tick()
+                if self.log_summary:
+                    mlops.log_telemetry_summary(round_idx)
+        finally:
+            slo.deactivate(slo_engine)
         if self.finalize_fn is not None:
             self.finalize_fn(w_global)
         return w_global

@@ -31,7 +31,9 @@ from .core import (
 from .flight_recorder import FlightRecorder
 from .fleet import FleetTelemetry
 from .health import ClientHealth, HealthReport, HealthTracker
+from .slo import SLOEngine, SLOSpec
 from .statusz import StatuszServer
+from .tsdb import TimeSeriesStore
 from .jax_hooks import (
     D2H_BYTES,
     H2D_BYTES,
@@ -60,6 +62,9 @@ __all__ = [
     "HealthReport",
     "HealthTracker",
     "StatuszServer",
+    "TimeSeriesStore",
+    "SLOSpec",
+    "SLOEngine",
     "get_telemetry",
     "span",
     "timed",

@@ -67,6 +67,13 @@ _trace_ctx_getter: Optional[Callable[[], Any]] = None
 # no-hook path stay untouched.
 _span_event_hook: Optional[Callable[[bool, Any, Any], None]] = None
 
+# Installed by tsdb.install() (same circularity dodge). Signature:
+# hook(kind: str, name: str, value: float) — "counter" emissions carry the
+# cumulative value after the add, "observe" emissions the raw observation.
+# Called OUTSIDE the registry lock so the store's lock stays a leaf (no
+# telemetry->tsdb ordering edge); the no-hook path is a None-check.
+_metric_sample_hook: Optional[Callable[[str, str, float], None]] = None
+
 
 class _NullSpan:
     """Shared no-op handle for the disabled path — enter/exit do nothing."""
@@ -157,12 +164,16 @@ class Counter:
         t = self._t
         with t._lock:
             self.value += n
+            value_after = self.value
             if t._enabled:
                 if len(self.events) < MAX_COUNTER_EVENTS:
                     self.events.append((time.perf_counter_ns(), self.value))
                 else:
                     t.dropped += 1
                     t.dropped_events += 1
+        hook = _metric_sample_hook
+        if hook is not None:
+            hook("counter", self.name, value_after)
 
 
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
@@ -198,6 +209,9 @@ class Histogram:
                 self.max = v
             # Prometheus semantics: bucket le=B counts observations <= B
             self.bucket_counts[bisect.bisect_left(self.buckets, v)] += 1
+        hook = _metric_sample_hook
+        if hook is not None:
+            hook("observe", self.name, v)
 
     def cumulative_buckets(self) -> List[tuple]:
         """[(le, cumulative_count), ..., (inf, count)] — Prometheus shape."""

@@ -204,9 +204,12 @@ class FlightRecorder:
         return stack
 
     def dump(self, path: Optional[str] = None, reason: str = "explicit",
-             exc_info: Optional[tuple] = None) -> Optional[str]:
+             exc_info: Optional[tuple] = None,
+             alert: Optional[Dict[str, Any]] = None) -> Optional[str]:
         """Write one JSONL crash dump; returns the path (None on I/O failure).
-        Never raises — the recorder must not mask the original exception."""
+        Never raises — the recorder must not mask the original exception.
+        ``alert`` attaches the triggering SLO's metadata (slo.py one-shot
+        snapshots) so fr_dump can say *why* this dump exists."""
         try:
             if path is None:
                 os.makedirs(self.dump_dir, exist_ok=True)
@@ -235,6 +238,8 @@ class FlightRecorder:
                     "message": str(evalue),
                     "traceback": traceback.format_exception(etype, evalue, etb),
                 })
+            if alert:
+                lines.append(dict({"type": "alert"}, **alert))
             lines.append({"type": "span_stack", "spans": self.span_stack()})
             try:
                 snap = get_telemetry().summary()

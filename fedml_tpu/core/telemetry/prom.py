@@ -191,6 +191,16 @@ def render(telemetry: Optional[Telemetry] = None,
         link_gauges = []
     if link_gauges:
         gauges = list(gauges) + link_gauges if gauges else link_gauges
+    # SLO alert gauges (fedml_alert_active{slo=}, fedml_slo_burn_rate) ride
+    # along whenever an SLO engine is active in the process
+    try:
+        from . import slo as _slo
+
+        slo_gauges = _slo.prom_gauges()
+    except Exception:  # noqa: BLE001 - metrics must render without the slo engine
+        slo_gauges = []
+    if slo_gauges:
+        gauges = list(gauges) + slo_gauges if gauges else slo_gauges
     if gauges:
         seen_fams = set()
         for name, labels, value in gauges:

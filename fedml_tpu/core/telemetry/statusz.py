@@ -112,6 +112,17 @@ def render(service: Optional[str] = None,
             doc["sections"]["links"] = links
     except Exception as e:  # noqa: BLE001 - status page must not throw
         doc["sections"]["links"] = {"error": repr(e)}
+    # the alerts section (per-SLO state, burn rates, recent transitions,
+    # tsdb ingest stats) is always-on: any process with an active SLO
+    # engine shows its alerts without per-process wiring
+    try:
+        from . import slo as _slo
+
+        alerts = _slo.statusz_snapshot()
+        if alerts:
+            doc["sections"]["alerts"] = alerts
+    except Exception as e:  # noqa: BLE001 - status page must not throw
+        doc["sections"]["alerts"] = {"error": repr(e)}
     with _sections_lock:
         providers = dict(_sections)
     for name, provider in sorted(providers.items()):

@@ -17,7 +17,7 @@ from ...core.engine import RemoteCommStrategy, RoundCheckpointer, decompress_arr
 from ...core.resilience import QuorumPolicy, RoundQuorum, RoundStateStore, note, overprovisioned_cohort_size
 from ...core.resilience import quorum as quorum_mod
 from ...core.resilience.round_state import restore_numpy_rng
-from ...core.telemetry import netlink, statusz, trace_context
+from ...core.telemetry import netlink, slo, statusz, trace_context
 from ...core.distributed import link_probe
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
@@ -44,6 +44,7 @@ class FedMLServerManager(FedMLCommManager):
         self._round_span = None
         self._round_span_idx: Optional[int] = None
         self._statusz_server: Optional[statusz.StatuszServer] = None
+        self._slo: Optional[slo.SLOEngine] = None
         # --- async (non-barrier) rounds ------------------------------------
         # round_idx counts PUBLISHES in async mode: every upload gets an
         # immediate model reply, a new global model publishes every
@@ -152,12 +153,17 @@ class FedMLServerManager(FedMLCommManager):
         # the whole receive loop runs under the flight recorder: an exception
         # in any handler produces one crash dump with the open round span
         with flight_recorded(role="cross_silo_server"):
+            self._slo = slo.activate(self.args, front="cross_silo")
+            if self._slo is not None:
+                self._slo.store.add_collector(self._slo_health_collector)
             self._start_statusz_if_configured()
             try:
                 super().run()
             finally:
                 self._stop_link_prober()
                 self._stop_statusz()
+                slo.deactivate(self._slo)
+                self._slo = None
 
     # --- statusz ----------------------------------------------------------
     def _start_statusz_if_configured(self) -> None:
@@ -548,10 +554,14 @@ class FedMLServerManager(FedMLCommManager):
         mlops.event("server.agg_and_eval", event_started=False, event_value=str(round_idx))
         mlops.log_round_info(self.round_num, round_idx)
         mlops.log_telemetry_summary(round_idx)
+        tel.counter("engine.rounds").add(1)
         fleet = getattr(self.aggregator, "fleet", None)
         if fleet is not None and fleet.merges:
             report = fleet.health.end_round(round_idx)
+            self._slo_tick()
             mlops.log_health_report(round_idx, report)
+        else:
+            self._slo_tick()
         final = buf.version >= self.round_num
         self._save_round_state(round_idx, global_model_params, final=final)
         if final:
@@ -587,6 +597,7 @@ class FedMLServerManager(FedMLCommManager):
         mlops.event("server.agg_and_eval", event_started=False, event_value=str(round_idx))
         mlops.log_round_info(self.round_num, round_idx)
         mlops.log_telemetry_summary(round_idx)
+        tel.counter("engine.rounds").add(1)
         fleet = getattr(self.aggregator, "fleet", None)
         if fleet is not None and fleet.merges:
             mlops.log_fleet_summary(round_idx, self.aggregator.fleet_summary())
@@ -594,9 +605,15 @@ class FedMLServerManager(FedMLCommManager):
             # client.train durations, shipped through the uplink like the
             # fleet summary (and readable live on /statusz + /metrics)
             report = fleet.health.end_round(round_idx)
+            # evaluator tick AFTER end_round (fresh straggler ratio) and
+            # BEFORE the uplink, so anything observing log_health_report
+            # sees this round's alert state already applied
+            self._slo_tick()
             mlops.log_health_report(round_idx, report)
             if report.stragglers:
                 log.warning("round %d stragglers: %s", round_idx, report.stragglers)
+        else:
+            self._slo_tick()
 
         self._save_round_state(
             round_idx, global_model_params, final=(round_idx + 1 >= self.round_num)
@@ -617,6 +634,24 @@ class FedMLServerManager(FedMLCommManager):
         )
         self._begin_quorum_round()
         mlops.event("server.wait", event_started=True, event_value=str(self.args.round_idx))
+
+    def _slo_tick(self) -> None:
+        """Per-round SLO evaluator tick (no-op when SLOs are disabled)."""
+        if self._slo is not None:
+            self._slo.tick()
+
+    def _slo_health_collector(self, store) -> None:
+        """Feed the live straggler ratio (flagged / cohort size from the
+        fleet's most recent health report) into the tsdb each tick, so the
+        ``straggler_ratio`` SLO can watch it breach and recover."""
+        fleet = getattr(self.aggregator, "fleet", None)
+        report = fleet.health.report() if fleet is not None else None
+        if not report:
+            return
+        n = int((report.get("cohort") or {}).get("n") or 0)
+        if n > 0:
+            store.record_gauge("health.straggler_ratio",
+                               len(report.get("stragglers") or ()) / n)
 
     def _save_round_state(self, round_idx: int, global_model_params, *, final: bool = False) -> None:
         """Durable round boundary, owned by the engine's RoundCheckpointer:

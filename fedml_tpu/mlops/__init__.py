@@ -219,6 +219,31 @@ def log_resilience_event(event: str, round_idx: Optional[int] = None, **fields: 
     MLOpsRuntime.get_instance().append_record(rec)
 
 
+def log_alert(slo: str, transition: str, observed: Optional[float] = None,
+              target: Optional[float] = None, window_s: Optional[float] = None,
+              burn_rate: Optional[float] = None, **fields: Any) -> None:
+    """Publish one SLO alert transition (``pending->firing``,
+    ``firing->resolved``) through the uplink so the ops plane sees burn-rate
+    alerts without scraping `/statusz` (see core.telemetry.slo)."""
+    rec: Dict[str, Any] = {
+        "type": "alert",
+        "name": str(slo),
+        "t": time.time(),  # fedlint: disable=wall-clock record timestamp, not a duration
+        "transition": str(transition),
+    }
+    if observed is not None:
+        rec["observed"] = float(observed)
+    if target is not None:
+        rec["target"] = float(target)
+    if window_s is not None:
+        rec["window_s"] = float(window_s)
+    if burn_rate is not None:
+        rec["burn_rate"] = float(burn_rate)
+    if fields:
+        rec["fields"] = dict(fields)
+    MLOpsRuntime.get_instance().append_record(rec)
+
+
 def log_training_status(status: str, run_id: Optional[str] = None) -> None:
     MLOpsRuntime.get_instance().append_record({"type": "status", "role": "client", "status": status, "run_id": run_id})
 

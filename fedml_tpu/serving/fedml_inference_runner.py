@@ -19,7 +19,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
-from ..core.telemetry import prom, statusz
+from ..core.telemetry import prom, slo, statusz
 from .fedml_predictor import FedMLPredictor
 
 log = logging.getLogger(__name__)
@@ -133,6 +133,7 @@ class FedMLInferenceRunner:
         self.host = host
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._slo: Optional[slo.SLOEngine] = None
         # dynamic batching: explicit args win; env seam lets subprocess
         # replicas opt in (FEDML_SERVE_MAX_BATCH / FEDML_SERVE_BATCH_WINDOW_MS)
         if max_batch is None:
@@ -248,9 +249,14 @@ class FedMLInferenceRunner:
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._thread.start()
+        # serving SLO pack (TTFT/TPOT p99 ceilings, error rate) evaluated on
+        # a background ticker (FEDML_SLO_TICK_S) for the replica's lifetime
+        self._slo = slo.activate(None, front="serving")
         return self.port
 
     def stop(self) -> None:
+        slo.deactivate(getattr(self, "_slo", None))
+        self._slo = None
         if self.batcher is not None:
             # end the batcher thread: it holds the predictor (and its model
             # params) and would otherwise outlive this runner forever

@@ -70,6 +70,11 @@ def _reset_singletons():
 
     reset_engines()
     reset_mesh_state()
+    # SLO engine + tsdb hook are process-wide ride-alongs on /statusz and
+    # /metrics: a leaked engine would surface in unrelated tests' expositions
+    from fedml_tpu.core.telemetry import slo as _slo
+
+    _slo.reset()
 
 
 def spawn_to_logs(cmds, tmp_path, env=None, timeout=600, names=None):

@@ -228,6 +228,14 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
                          "probe_overhead_pct": 0.36,
                          "wan_probes_sent": 72,
                          "wan_probes_answered": 72}, None),
+        "slo_overhead": ({"slo_overhead_pct": 0.38,
+                          "slo_ticks": 6,
+                          "slo_ingest_ms": 1.2,
+                          "slo_tick_ms": 1.9,
+                          "slo_samples": 1200,
+                          "alerts_fired": 1,
+                          "slo_rounds": 600,
+                          "slo_window_s": 1.21}, None),
     })
     with pytest.raises(SystemExit) as exc:
         bench.main()
@@ -259,6 +267,8 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
     assert out["placement_plan"]["async_fedbuff"]["publish_k"] == 8
     assert out["link_bw_error_pct"] == 0.97
     assert out["probe_overhead_pct"] == 0.36
+    assert out["slo_overhead_pct"] == 0.38
+    assert out["alerts_fired"] == 1
     assert out["stages_failed"] == []
     # incremental artifacts landed (one per stage + final, same stamp file)
     arts = glob.glob(str(tmp_path / "BENCH_MEASURED_*.json"))

@@ -503,6 +503,42 @@ class TestMetricRegistryRule(unittest.TestCase):
             }, ["metric-registry"], options=self._OPTS)
             self.assertEqual([f.render() for f in res.findings], [])
 
+    def test_slo_series_nothing_feeds_is_flagged(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "slo.py": "PACK = [\n"
+                          "    dict(name='ghost_rate', series='engine.ghost',"
+                          " signal='rate', target=1.0),\n"
+                          "]\n",
+            }, ["metric-registry"], options=self._OPTS)
+            msgs = "\n".join(f.message for f in res.findings)
+            self.assertIn("SLO spec watches series `engine.ghost` but "
+                          "nothing in the tree feeds it", msgs)
+
+    def test_slo_series_fed_by_counter_gauge_or_prefix_is_clean(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "emit.py": "PREFIX = 'comm.retry.'\n"
+                           "def go(tel, store, label):\n"
+                           "    tel.counter('engine.rounds').add(1)\n"
+                           "    tel.counter(PREFIX + label).add(1)\n"
+                           "    store.record_gauge('health.ratio', 0.1)\n",
+                "slo.py": "PACK = [\n"
+                          "    dict(name='a', series='engine.rounds'),\n"
+                          "    dict(name='b', series='health.ratio'),\n"
+                          "    dict(name='c', series='comm.retry.*'),\n"
+                          "    dict(name='d', series='comm.retry.grpc'),\n"
+                          "]\n"
+                          "SPEC = SLOSpec(name='e', series='engine.rounds')\n",
+                # not a spec row: a series key without name= is just a dict
+                "other.py": "CFG = dict(series='not.a.spec')\n",
+                "docs/obs.md": "| `fedml_engine_rounds_total` | rounds |\n"
+                               "| `fedml_comm_retry_total` | retries |\n",
+                "checks/test_x.py": "E = ('fedml_engine_rounds_total', "
+                                    "'fedml_comm_retry_total')\n",
+            }, ["metric-registry"], options=self._OPTS)
+            self.assertEqual([f.render() for f in res.findings], [])
+
 
 class TestIncrementalCache(unittest.TestCase):
 

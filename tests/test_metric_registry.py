@@ -50,6 +50,13 @@ EXPORTED = {
     "fedml_link_bytes_received": "gauge",
     "fedml_link_predicted_mib_seconds": "gauge",
     "fedml_link_confidence": "gauge",
+    # SLO engine burn-rate alerts (core/telemetry/slo.py; gauges labeled
+    # {slo} — burn_rate adds {window="fast"|"slow"})
+    "fedml_alert_active": "gauge",
+    "fedml_alert_transitions_total": "counter",
+    "fedml_slo_burn_rate": "gauge",
+    "fedml_slo_observed": "gauge",
+    "fedml_slo_evaluations_total": "counter",
     # round engine / placement search
     "fedml_engine_rounds_total": "counter",
     "fedml_engine_round_seconds": "histogram",

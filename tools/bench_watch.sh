@@ -22,12 +22,13 @@ LOCK="$REPO/.bench_runtime/bench.lock"
 
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-90}
 SMOKE_TIMEOUT=${SMOKE_TIMEOUT:-1200}  # may run BOTH stats layouts (narrow+wide)
-# must exceed the sum of bench.py's per-stage budgets (_STAGES: 13620s with
+# must exceed the sum of bench.py's per-stage budgets (_STAGES: 13800s with
 # attn_micro, the tuned re-run, the agg + agg_sharded microbenches, the
-# placement search and the wan_profile link-observability stage; banked CPU
-# baselines usually shave 600s) plus the 180s probe, or the outer timeout
-# kills a run whose stages are all within their own contracts
-BENCH_TIMEOUT=${BENCH_TIMEOUT:-14100}
+# placement search, the wan_profile link-observability stage and the
+# slo_overhead evaluator guard; banked CPU baselines usually shave 600s)
+# plus the 180s probe, or the outer timeout kills a run whose stages are
+# all within their own contracts
+BENCH_TIMEOUT=${BENCH_TIMEOUT:-14400}
 SLEEP_DOWN=${SLEEP_DOWN:-120}     # tunnel down: re-probe every 2 min (short
                                   # up-windows are the norm; 10 min missed them)
 SLEEP_UP=${SLEEP_UP:-3600}        # after a good measurement: hourly is plenty
@@ -86,8 +87,10 @@ commit_artifacts() {
       surface_resilience
       surface_serving
       surface_span_summary
+      surface_alerts
       surface_trace_files
       surface_crash_dumps
+      surface_bench_regress
     else
       log "COMMIT FAILED: $(tail -c 400 /tmp/bench_watch_commit.err)"
     fi
@@ -300,6 +303,44 @@ if stats:
 PYEOF
 ) || return 0
   [ -n "$spans" ] && log "$spans"
+}
+
+surface_alerts() {
+  # one-line view of the SLO evaluator keys riding the newest artifact
+  # (alerts_fired + slo_overhead_pct from bench.py's slo_overhead rider), so
+  # the watcher log answers "did any burn-rate alert fire during the
+  # measurement, and what did evaluating cost" without opening the JSON
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1) || return 0
+  [ -n "$newest" ] || return 0
+  local alerts
+  alerts=$(python3 - "$newest" <<'PYEOF' 2>/dev/null
+import json, sys
+doc = json.load(open(sys.argv[1]))
+if doc.get("alerts_fired") is not None or doc.get("slo_overhead_pct") is not None:
+    print(f"slo: alerts_fired {doc.get('alerts_fired')}, "
+          f"overhead {doc.get('slo_overhead_pct')}% of stage wall "
+          f"(ticks {doc.get('slo_ticks')})")
+PYEOF
+) || return 0
+  [ -n "$alerts" ] && log "$alerts"
+}
+
+surface_bench_regress() {
+  # regression sentinel over the banked trajectory: compares each headline
+  # key's newest occurrence against its prior occurrence / r0 baseline and
+  # logs the verdict, so a decaying rounds/hr or a TTFT tail doubling is
+  # called out the moment the artifact that shows it is committed
+  local verdict rc
+  verdict=$(timeout 60 python tools/bench_regress.py 2>/dev/null)
+  rc=$?
+  if [ $rc -eq 1 ]; then
+    log "BENCH REGRESSION: $(echo "$verdict" | grep -E 'REGRESS|=>' | tr '\n' ' ')"
+  elif [ $rc -eq 0 ] && [ -n "$verdict" ]; then
+    log "bench_regress: $(echo "$verdict" | tail -1 | sed 's/^ *//')"
+  else
+    log "bench_regress: could not run (rc=$rc)"
+  fi
 }
 
 surface_trace_files() {

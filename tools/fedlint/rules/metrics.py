@@ -19,6 +19,15 @@ arguments through the project symbol table. Canonicalization mirrors
 * literal families built by ``_fam("lit", "_suffix")`` in prom.py itself.
 
 Dynamic names that resolve to nothing are skipped, never guessed at.
+
+ISSUE 14 extension — **SLO series resolution**: every tsdb series named in
+an SLO spec (the ``dict(name=..., series="...")`` pack rows and literal
+``SLOSpec(series=...)`` constructions) must resolve to a series something
+actually feeds: a ``tel.counter``/``tel.histogram`` emission (the tsdb hook
+mirrors every telemetry sample), a ``record_gauge``/``record_counter``/
+``record_observation`` call, or a prefix family (``comm.retry.`` + label)
+that a glob spec (``comm.retry.*``) covers. An SLO watching a series nothing
+emits would simply never fire — silent monitoring, worse than none.
 """
 
 from __future__ import annotations
@@ -86,6 +95,7 @@ class MetricRegistryRule(ProjectRule):
     # ------------------------------------------------------------------
     def collect(self, ctx):
         emits = []
+        slo_series = []
 
         def emit(kind, spec, node):
             emits.append([kind, spec[0], spec[1], node.lineno,
@@ -124,6 +134,30 @@ class MetricRegistryRule(ProjectRule):
                 spec = _name_arg(node.args[0])
                 if spec:
                     emit(f.attr, spec, node)
+            # direct tsdb feeds: store.record_gauge("lit", v) etc. register
+            # the series for SLO resolution (they bypass the telemetry hook)
+            elif isinstance(f, ast.Attribute) and f.attr in (
+                    "record_gauge", "record_counter",
+                    "record_observation") and node.args:
+                spec = _name_arg(node.args[0])
+                if spec:
+                    emit("tsdb", spec, node)
+            # SLO spec rows: dict(name=..., series="...") pack entries and
+            # literal SLOSpec(series=...) constructions both NAME a series
+            # that must resolve to something emitted
+            if ((isinstance(f, ast.Name) and f.id in ("dict", "SLOSpec"))
+                    or (isinstance(f, ast.Attribute)
+                        and f.attr == "SLOSpec")):
+                kws = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+                sv = kws.get("series")
+                # a bare dict() only counts as a spec row when it also
+                # carries name= — arbitrary dicts with a series key don't
+                is_spec = "name" in kws or not (
+                    isinstance(f, ast.Name) and f.id == "dict")
+                if (isinstance(sv, ast.Constant)
+                        and isinstance(sv.value, str) and is_spec):
+                    slo_series.append([sv.value, node.lineno,
+                                       ctx.raw_line(node.lineno)])
             elif isinstance(f, ast.Name) and f.id == "_fam" and node.args:
                 parts = []
                 for a in node.args[:2]:
@@ -152,7 +186,7 @@ class MetricRegistryRule(ProjectRule):
                 if any(n and n.split(".")[-1] == "gauges" for n in names):
                     for t in ast.walk(node.value):
                         self._gauge_tuple(t, emit)
-        if not emits:
+        if not emits and not slo_series:
             return None
         # dedupe (gauges functions scanned via two paths)
         seen, out = set(), []
@@ -161,7 +195,10 @@ class MetricRegistryRule(ProjectRule):
             if key not in seen:
                 seen.add(key)
                 out.append(e)
-        return {"emits": out}
+        facts = {"emits": out}
+        if slo_series:
+            facts["slo_series"] = slo_series
+        return facts
 
     def _gauge_tuple(self, node, emit):
         if (isinstance(node, ast.Tuple) and len(node.elts) == 3
@@ -258,6 +295,55 @@ class MetricRegistryRule(ProjectRule):
                     f"metric `{canonical}` is emitted here but asserted by "
                     "no test — add it to the metric-registry test so a "
                     "rename can't silently break dashboards", text)
+
+        # --- SLO series resolution (ISSUE 14) --------------------------
+        # every series an SLO spec watches must be fed by SOMETHING: a
+        # telemetry counter/histogram (the tsdb hook mirrors each sample),
+        # a record_* call, or a prefix family a glob spec covers
+        series_reg: set = set()
+        prefix_reg: set = set()
+        slo_refs = []
+        for rel, f in sorted(facts.items()):
+            for kind, how, value, _line, _text in f.get("emits") or ():
+                if kind not in ("counter", "histogram", "tsdb"):
+                    continue
+                if how in ("ref", "prefix_ref"):
+                    value = graph.constant(rel, value)
+                    if not isinstance(value, str):
+                        continue
+                    how = "lit" if how == "ref" else "prefix"
+                if how == "lit":
+                    series_reg.add(value)
+                elif how == "prefix" and value.endswith("."):
+                    prefix_reg.add(value)
+            for value, line, text in f.get("slo_series") or ():
+                slo_refs.append((rel, value, line, text))
+
+        def series_resolves(series):
+            if series in series_reg:
+                return True
+            if any(series.startswith(p) for p in prefix_reg):
+                return True
+            if any(ch in series for ch in "*?["):
+                if any(fnmatch.fnmatch(s, series) for s in series_reg):
+                    return True
+                lit = re.split(r"[*?\[]", series, 1)[0]
+                if lit and any(p.startswith(lit) or lit.startswith(p)
+                               for p in prefix_reg):
+                    return True
+            if series.startswith("fedml_"):
+                return any(series in (_canon(s), _canon(s) + "_total")
+                           for s in series_reg)
+            return False
+
+        for rel, series, line, text in slo_refs:
+            if not series_resolves(series):
+                yield self.fact_finding(
+                    graph.root, rel, line,
+                    f"SLO spec watches series `{series}` but nothing in the "
+                    "tree feeds it (no telemetry counter/histogram, no tsdb "
+                    "record_* call, no matching prefix family) — the "
+                    "burn-rate alert can never fire", text)
 
         # documented names that nothing emits anymore
         if doc_text is None:

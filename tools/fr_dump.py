@@ -8,11 +8,13 @@ Usage:
                                                # (default: ~/.fedml_tpu/crash)
     python tools/fr_dump.py --json PATH        # parsed dump as one JSON doc
 
-Renders the meta header, the triggering exception, the failing span stack
-(open spans + the error-unwind trail), the counter snapshot, the trace
-context, and the event ring as a timeline (relative seconds, kind, name,
-fields). Exits non-zero on a missing/unparseable dump so scripts can gate
-on it.
+Renders the meta header, the triggering exception, the SLO alert that
+auto-captured the dump (name, transition, observed vs target over the
+window, burn rate), the failing span stack (open spans + the error-unwind
+trail), the counter snapshot, the trace context, and the event ring as a
+timeline (relative seconds, kind, name, fields; ``slo_alert`` breadcrumbs
+are called out with their burn-rate math). Exits non-zero on a
+missing/unparseable dump so scripts can gate on it.
 """
 
 from __future__ import annotations
@@ -90,6 +92,35 @@ def _fmt_comm(ev: Dict[str, Any]) -> str:
     return (" " + " ".join(parts) if parts else "") + _fmt_fields(fields)
 
 
+def _fmt_window(window_s: Any) -> str:
+    try:
+        w = float(window_s)
+    except (TypeError, ValueError):
+        return str(window_s)
+    return f"{w / 60:g}m" if w >= 60 else f"{w:g}s"
+
+
+def _fmt_alert_mark(ev: Dict[str, Any]) -> str:
+    """slo_alert breadcrumbs: the burn-rate math inline, so the timeline
+    reads "which SLO moved, when, and by how much" without the alert record."""
+    fields = dict(ev.get("fields") or {})
+    slo = fields.pop("slo", "?")
+    transition = fields.pop("transition", "?")
+    observed = fields.pop("observed", None)
+    target = fields.pop("target", None)
+    burn = fields.pop("burn_rate", None)
+    window_s = fields.pop("window_s", None)
+    out = f" {slo}: {transition}"
+    if observed is not None:
+        out += f" (observed {observed} vs target {target}"
+        if window_s is not None:
+            out += f" over {_fmt_window(window_s)}"
+        if burn is not None:
+            out += f", burn {burn}x"
+        out += ")"
+    return out + _fmt_fields(fields)
+
+
 def render(doc: Dict[str, Any], out=sys.stdout) -> None:
     meta = doc["meta"]
     w = out.write
@@ -105,6 +136,14 @@ def render(doc: Dict[str, Any], out=sys.stdout) -> None:
         w(f"\n--- exception: {exc.get('class')}: {exc.get('message')}\n")
         for chunk in exc.get("traceback", []):
             w("    " + chunk.replace("\n", "\n    ").rstrip() + "\n")
+
+    alert = doc.get("alert")
+    if alert:
+        w(f"\n--- alert: {alert.get('slo')} ({alert.get('transition')})\n")
+        w(f"    series:   {alert.get('series')}  signal: {alert.get('signal')}\n")
+        w(f"    observed: {alert.get('observed')} {alert.get('comparator')} "
+          f"target {alert.get('target')} over {_fmt_window(alert.get('window_s'))}\n")
+        w(f"    burn rate: {alert.get('burn_rate')}x\n")
 
     trace = doc.get("trace", {}).get("context")
     if trace:
@@ -145,6 +184,8 @@ def render(doc: Dict[str, Any], out=sys.stdout) -> None:
             rel_s = (ev.get("t_ns", 0) - t0) / 1e9
             if ev.get("kind") in ("comm_send", "comm_recv"):  # fedlint: disable=recorder-kind stdlib-only dump reader: matches EVENT_COMM_* without importing fedml_tpu
                 detail = _fmt_comm(ev)
+            elif ev.get("kind") == "mark" and ev.get("name") == "slo_alert":  # fedlint: disable=recorder-kind stdlib-only dump reader: matches EVENT_MARK without importing fedml_tpu
+                detail = _fmt_alert_mark(ev)
             else:
                 detail = _fmt_fields(ev.get("fields"))
             w(f"  +{rel_s:9.4f}s  {ev.get('kind'):<10} {ev.get('name')}{detail}\n")
