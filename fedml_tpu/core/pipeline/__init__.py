@@ -1,0 +1,40 @@
+"""Micro-batched pipelined round execution (docs/pipeline.md).
+
+``executor`` is the generic staged pipeline (worker threads + bounded
+queues + overlap accounting), ``microbatch`` sizes chunks from the PR-12
+link-cost model, and ``strategy`` adapts both to the round engine as the
+``PipelinedExecution`` client strategy. The split-learning front
+(``fedml_tpu.split``) drives the same executor over a real comm boundary.
+
+Import from here, not from ``core.engine`` — the engine package stays an
+import-time leaf (see the lock-order note in ``engine/round_engine.py``)
+and this package pulls in aggregation + compression at use time.
+"""
+
+from .executor import (
+    PipelineError,
+    PipelineReport,
+    PipelinedExecutor,
+    StageSpec,
+    StageStats,
+)
+from .microbatch import MicroBatchPlan, even_micro_batches, plan_micro_batches
+from .strategy import (
+    PipelinedBufferSink,
+    PipelinedExecution,
+    build_pipelined_execution,
+)
+
+__all__ = [
+    "PipelineError",
+    "PipelineReport",
+    "PipelinedExecutor",
+    "StageSpec",
+    "StageStats",
+    "MicroBatchPlan",
+    "even_micro_batches",
+    "plan_micro_batches",
+    "PipelinedBufferSink",
+    "PipelinedExecution",
+    "build_pipelined_execution",
+]

@@ -112,6 +112,15 @@ _ENGINE_PACK: List[Dict[str, Any]] = [
          scale=3600.0, comparator=">=", target=1.0),
     dict(name="round_p99_seconds", series="engine.round_seconds",
          signal="quantile", q=0.99, comparator="<=", target=600.0),
+    # pipelined execution (core/pipeline): a healthy pipeline keeps some
+    # measured train/compress/uplink/fold overlap; a run that collapses to
+    # serial reports ~0 and burns the floor to infinity (no data = no
+    # opinion, so sequential runs never alert)
+    dict(name="pipeline_overlap_frac", series="pipeline.overlap_frac",
+         signal="avg", comparator=">=", target=0.05),
+    dict(name="pipeline_stage_stall_p99_seconds",
+         series="pipeline.stage_stall_seconds", signal="quantile", q=0.99,
+         comparator="<=", target=120.0),
 ]
 
 _CROSS_SILO_PACK: List[Dict[str, Any]] = _ENGINE_PACK + [

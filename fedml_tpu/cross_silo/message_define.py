@@ -27,6 +27,16 @@ class MyMessage:
     MSG_TYPE_LINK_PROBE = 8
     MSG_TYPE_LINK_PROBE_ECHO = 9
 
+    # split learning (fedml_tpu/split): the server owns the round — it opens
+    # it with a version-stamped INIT_CONFIG, the client streams forward
+    # activations as micro-batches (ACT), the server answers each with the
+    # activation gradient (GRAD), and the client closes its round with DONE
+    # after the local backward completes
+    MSG_TYPE_S2C_SPLIT_INIT_CONFIG = 10
+    MSG_TYPE_C2S_SPLIT_ACT = 11
+    MSG_TYPE_S2C_SPLIT_GRAD = 12
+    MSG_TYPE_C2S_SPLIT_DONE = 13
+
     # arg keys (routing lives in Message's own envelope fields; the old
     # TYPE/SENDER/RECEIVER duplicates were dead vocabulary and are gone)
     MSG_ARG_KEY_MODEL_PARAMS = "model_params"
@@ -45,6 +55,14 @@ class MyMessage:
     MSG_ARG_KEY_PROBE_T_SEND_NS = "probe_t_send_ns"
     MSG_ARG_KEY_PROBE_NBYTES = "probe_nbytes"
     MSG_ARG_KEY_PROBE_PAD = "probe_pad"
+    # split learning: activations / targets travel C2S per micro-batch, the
+    # activation gradient travels S2C; mb_idx keys reassembly (the broker's
+    # throttle timers may reorder deliveries) and mb_count closes the window
+    MSG_ARG_KEY_SPLIT_ACTS = "split_acts"
+    MSG_ARG_KEY_SPLIT_TARGETS = "split_targets"
+    MSG_ARG_KEY_SPLIT_GRADS = "split_grads"
+    MSG_ARG_KEY_SPLIT_MB_IDX = "split_mb_idx"
+    MSG_ARG_KEY_SPLIT_MB_COUNT = "split_mb_count"
 
     # statuses
     MSG_CLIENT_STATUS_OFFLINE = "OFFLINE"

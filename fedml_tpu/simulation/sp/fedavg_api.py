@@ -180,11 +180,28 @@ class FedAvgAPI:
         )
 
     # ------------------------------------------------------------------
+    def _build_execution(self):
+        """Strategy + sink for the engine. ``--client_execution pipelined``
+        swaps in the staged pipeline (core.pipeline): train/compress/fold
+        overlap across the cohort, fold-at-arrival when the optimizer's
+        semantics allow it (plain FedAvg, no middleware — bit-exact either
+        way; see docs/pipeline.md), else pairs mode behind the same
+        AlgFrameSink as the sequential path."""
+        mode = str(getattr(self.args, "client_execution", "sequential") or "sequential")
+        if mode == "pipelined":
+            # lazy: core.pipeline pulls aggregation+compression, and the
+            # engine package must stay an import-time leaf
+            from ...core.pipeline import build_pipelined_execution
+
+            return build_pipelined_execution(self)
+        return InProcessSequentialStrategy(self), AlgFrameSink(self._server_update)
+
     def train(self) -> Dict[str, float]:
+        strategy, sink = self._build_execution()
         engine = RoundEngine(
             self.args,
-            InProcessSequentialStrategy(self),
-            AlgFrameSink(self._server_update),
+            strategy,
+            sink,
             sample_fn=lambda r: self._client_sampling(
                 r, int(self.args.client_num_in_total), int(self.args.client_num_per_round)
             ),

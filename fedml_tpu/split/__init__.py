@@ -1,0 +1,38 @@
+"""Split learning over the existing comm boundary (docs/pipeline.md).
+
+``model`` holds the cut-at-layer math (shared verbatim by the wire run and
+its in-process parity reference); ``api`` holds the comm managers that
+stream activation micro-batches through ``core.pipeline``'s executor.
+"""
+
+from .api import SplitClientManager, SplitServerManager, run_split_rounds
+from .model import (
+    accumulate_trees,
+    client_backward,
+    client_forward,
+    cut_params,
+    fold_round,
+    full_loss,
+    init_params,
+    merge_params,
+    reference_round,
+    server_grads,
+    sgd_step,
+)
+
+__all__ = [
+    "SplitClientManager",
+    "SplitServerManager",
+    "run_split_rounds",
+    "accumulate_trees",
+    "client_backward",
+    "client_forward",
+    "cut_params",
+    "fold_round",
+    "full_loss",
+    "init_params",
+    "merge_params",
+    "reference_round",
+    "server_grads",
+    "sgd_step",
+]

@@ -1,0 +1,422 @@
+"""Split-learning managers: stream activations over the comm boundary.
+
+Wire protocol (docs/pipeline.md has the ladder diagram):
+
+1. server -> clients ``S2C_SPLIT_INIT_CONFIG`` — opens round *r*; carries
+   the current global client shard and stamps ``model_version`` (the
+   fedlint protocol-contract rule polices the stamp on INIT_CONFIG sends).
+2. client -> server ``C2S_SPLIT_ACT`` x m — one message per micro-batch:
+   activations + targets + ``(mb_idx, mb_count)``. The client's forward
+   and uplink run as pipeline stages (``core.pipeline.executor``), so
+   micro-batch *i+1* computes while *i* is on the wire; *m* comes from the
+   link-cost planner clamped to an even batch split.
+3. server -> client ``S2C_SPLIT_GRAD`` x m — the server computes its
+   backward **at arrival** (its stage of the pipeline) and returns
+   ``d loss / d acts`` keyed by ``mb_idx`` (the broker's throttle timers
+   may reorder deliveries; both sides reassemble by index, never order).
+4. client -> server ``C2S_SPLIT_DONE`` — after the recompute-vjp backward
+   and a local SGD step: updated client shard + sample count + round tag,
+   version-stamped. DONE feeds ``RoundQuorum``; the round closes on full
+   quorum or at the deadline with the partial cohort (the kill drill), and
+   the fold is ``split.model.fold_round`` — shared with the in-process
+   reference, so split == unsplit bit-exactly.
+
+Transport is whatever ``FedMLCommManager`` gives us: send-path retry
+(``fedml_comm_retry_total{backend=...}``), flight-recorder comm
+breadcrumbs, netlink per-pair accounting, and trace context riding every
+message all come from the base class, not from code here.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import telemetry as tel
+from ..core.distributed.communication.message import Message
+from ..core.distributed.fedml_comm_manager import FedMLCommManager
+from ..core.pipeline.executor import PipelinedExecutor, StageSpec
+from ..core.pipeline.microbatch import even_micro_batches, plan_micro_batches
+from ..core.resilience.quorum import ACCEPT, QuorumPolicy, RoundQuorum
+from ..core.telemetry import flight_recorder
+from ..cross_silo.message_define import MyMessage
+from . import model as split_model
+
+log = logging.getLogger(__name__)
+
+PyTree = Any
+_SERVER_RANK = 0
+
+
+class _FlakySender:
+    """Chaos shim: make the first ``fail_n`` raw sends raise ConnectionError
+    so the base manager's retry policy has something real to retry."""
+
+    def __init__(self, inner: Any, fail_n: int):
+        self._inner = inner
+        self._fail_n = int(fail_n)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+    def send_message(self, msg: Message) -> None:
+        if self._fail_n > 0:
+            self._fail_n -= 1
+            raise ConnectionError("chaos: injected transient send failure")
+        self._inner.send_message(msg)
+
+
+class SplitServerManager(FedMLCommManager):
+    """Owns the global shards, folds at round close, drives the round ladder."""
+
+    def __init__(self, args: Any, w_client: PyTree, w_server: PyTree, *,
+                 client_ranks: List[int], rounds: int, lr: float,
+                 sample_nums: Optional[Dict[int, float]] = None):
+        self.w_client = w_client
+        self.w_server = w_server
+        self.client_ranks = sorted(int(r) for r in client_ranks)
+        self.rounds = int(rounds)
+        self.lr = float(lr)
+        self.sample_nums = dict(sample_nums or {})
+        self.version = 0
+        self.round_idx = 0
+        self._policy = QuorumPolicy.from_args(args)
+        self._lock = threading.Lock()  # handlers vs the deadline timer
+        self._quorum: Optional[RoundQuorum] = None
+        self._deadline_timer: Optional[threading.Timer] = None
+        self._g_server: Dict[int, Dict[int, PyTree]] = {}
+        self._mb_counts: Dict[int, int] = {}
+        self._done: Dict[int, Tuple[float, PyTree]] = {}
+        self.rounds_closed: List[Dict[str, Any]] = []
+        self.finished = threading.Event()
+        super().__init__(args, rank=_SERVER_RANK, size=len(self.client_ranks) + 1)
+
+    # -- protocol ----------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_CONNECTION_IS_READY, self._on_ready)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SPLIT_ACT, self._on_act)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SPLIT_DONE, self._on_done)
+
+    def _on_ready(self, _msg: Message) -> None:
+        self._open_round()
+
+    def _open_round(self) -> None:
+        with self._lock:
+            r = self.round_idx
+            self._quorum = RoundQuorum(r, self.client_ranks,
+                                       len(self.client_ranks), self._policy)
+            self._g_server = {}
+            self._mb_counts = {}
+            self._done = {}
+            deadline = self._policy.deadline_for_round()
+            if deadline is not None:
+                self._deadline_timer = threading.Timer(deadline, self._on_deadline, args=(r,))
+                self._deadline_timer.daemon = True
+                self._deadline_timer.start()
+        flight_recorder.mark("split_round_open", round=r, version=self.version)
+        for rank in self.client_ranks:
+            self._send_init(rank, r)
+
+    def _send_init(self, receiver: int, round_idx: int) -> None:
+        msg = Message(MyMessage.MSG_TYPE_S2C_SPLIT_INIT_CONFIG, self.rank, receiver)
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self.w_client)
+        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, round_idx)
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_VERSION, self.version)
+        self.send_message(msg)
+
+    def _on_act(self, msg: Message) -> None:
+        rank = int(msg.get_sender_id())
+        r = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX))
+        if r != self.round_idx:
+            log.warning("split server: late ACT from rank %d (round %d != %d)",
+                        rank, r, self.round_idx)
+            return
+        mb_idx = int(msg.get(MyMessage.MSG_ARG_KEY_SPLIT_MB_IDX))
+        mb_count = int(msg.get(MyMessage.MSG_ARG_KEY_SPLIT_MB_COUNT))
+        acts = msg.get(MyMessage.MSG_ARG_KEY_SPLIT_ACTS)
+        targets = msg.get(MyMessage.MSG_ARG_KEY_SPLIT_TARGETS)
+        # fold-at-arrival: the server's backward is its pipeline stage — it
+        # runs the moment the micro-batch lands, overlapping the client's
+        # forward on the next micro-batch and the wire on both
+        with tel.span("split.server_grads", round=r, client=rank, mb=mb_idx):
+            loss, g_srv, g_acts = split_model.server_grads(
+                self.w_server, np.asarray(acts), np.asarray(targets))
+        with self._lock:
+            self._g_server.setdefault(rank, {})[mb_idx] = g_srv
+            self._mb_counts[rank] = mb_count
+        reply = Message(MyMessage.MSG_TYPE_S2C_SPLIT_GRAD, self.rank, rank)
+        reply.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, r)
+        reply.add_params(MyMessage.MSG_ARG_KEY_SPLIT_MB_IDX, mb_idx)
+        reply.add_params(MyMessage.MSG_ARG_KEY_SPLIT_GRADS, np.asarray(g_acts))
+        self.send_message(reply)
+        tel.histogram("split.mb_loss").observe(float(loss))
+
+    def _on_done(self, msg: Message) -> None:
+        rank = int(msg.get_sender_id())
+        r = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        verdict = self._quorum.on_delta(rank, None if r is None else int(r))
+        if verdict != ACCEPT:
+            log.warning("split server: DONE from rank %d -> %s", rank, verdict)
+            return
+        n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES))
+        shard = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        with self._lock:
+            self._done[rank] = (n, shard)
+        if self._quorum.complete():
+            self._close_round(partial=False)
+
+    def _on_deadline(self, round_idx: int) -> None:
+        with self._lock:
+            quorum = self._quorum
+            if quorum is None or quorum.round_idx != round_idx or self.finished.is_set():
+                return
+            if quorum.complete():
+                return
+        if quorum.deadline_quorum_met():
+            missing = quorum.close_partial()
+            log.warning("split server: round %d closed partial, missing %s",
+                        round_idx, missing)
+            tel.get_telemetry().counter("split.partial_rounds").add(1)
+            self._close_round(partial=True)
+        else:
+            # below min quorum: keep waiting another deadline window
+            with self._lock:
+                deadline = self._policy.deadline_for_round()
+                if deadline is not None:
+                    self._deadline_timer = threading.Timer(
+                        deadline, self._on_deadline, args=(round_idx,))
+                    self._deadline_timer.daemon = True
+                    self._deadline_timer.start()
+
+    def _close_round(self, *, partial: bool) -> None:
+        with self._lock:
+            if self._deadline_timer is not None:
+                self._deadline_timer.cancel()
+                self._deadline_timer = None
+            r = self.round_idx
+            arrived = sorted(self._done)  # ascending rank: fixed fold order
+            client_updates = [(self._done[k][0], self._done[k][1]) for k in arrived]
+            server_grad_means = []
+            for k in arrived:
+                mbs = self._g_server.get(k, {})
+                count = self._mb_counts.get(k, len(mbs))
+                grads = [mbs[i] for i in range(count) if i in mbs]
+                server_grad_means.append(
+                    (self._done[k][0], split_model.accumulate_trees(grads)))
+            with tel.span("split.fold", round=r, k=len(arrived), partial=partial):
+                self.w_client, self.w_server = split_model.fold_round(
+                    self.w_client, self.w_server, client_updates,
+                    server_grad_means, self.lr)
+            self.version += 1
+            self.round_idx += 1
+            done_all = self.round_idx >= self.rounds
+        self.rounds_closed.append(
+            {"round": r, "k": len(arrived), "partial": bool(partial),
+             "arrived": arrived})
+        tel.get_telemetry().counter("split.rounds").add(1)
+        flight_recorder.mark("split_round_close", round=r, k=len(arrived),
+                             partial=partial)
+        if done_all:
+            for rank in self.client_ranks:
+                fin = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, rank)
+                self.send_message(fin)
+            self.finished.set()
+            self.finish()
+        else:
+            self._open_round()
+
+
+class SplitClientManager(FedMLCommManager):
+    """Owns one party's data; runs forward/uplink as pipeline stages and the
+    recompute backward as GRADs land."""
+
+    def __init__(self, args: Any, rank: int, size: int,
+                 tokens: np.ndarray, targets: np.ndarray, *,
+                 target_micro_batches: Optional[int] = None):
+        self.tokens = np.asarray(tokens)
+        self.targets = np.asarray(targets)
+        self.target_micro_batches = target_micro_batches
+        self._grads: Dict[int, np.ndarray] = {}
+        self._grad_cv = threading.Condition()
+        self._round_round_idx: Optional[int] = None
+        self._worker: Optional[threading.Thread] = None
+        # chaos: die mid-stream at (round, mb) — the quorum drill's victim
+        self._kill_at = None
+        if getattr(args, "chaos_split_kill_rank", None) is not None \
+                and int(args.chaos_split_kill_rank) == int(rank):
+            self._kill_at = (int(getattr(args, "chaos_split_kill_round", 0)),
+                             int(getattr(args, "chaos_split_kill_mb", 1)))
+        self.killed = threading.Event()
+        # EWMA of per-micro-batch forward seconds feeds the planner
+        self._fwd_s_ewma: Optional[float] = None
+        super().__init__(args, rank=int(rank), size=int(size))
+        fail_n = int(getattr(args, "chaos_split_send_fail_n", 0) or 0)
+        fail_rank = getattr(args, "chaos_split_send_fail_rank", None)
+        if fail_n > 0 and (fail_rank is None or int(fail_rank) == int(rank)):
+            self.register_comm_manager(_FlakySender(self.com_manager, fail_n))
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SPLIT_INIT_CONFIG, self._on_init)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SPLIT_GRAD, self._on_grad)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_FINISH, self._on_finish)
+
+    def _on_init(self, msg: Message) -> None:
+        w_client = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        r = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX))
+        version = int(msg.get(MyMessage.MSG_ARG_KEY_MODEL_VERSION))
+        with self._grad_cv:
+            self._grads = {}
+            self._round_round_idx = r
+        # the local round runs off the receive loop so GRAD messages can
+        # keep landing while the forward stream is still in flight
+        self._worker = threading.Thread(
+            target=self._run_local_round, args=(r, version, w_client),
+            name=f"split-client-{self.rank}", daemon=True)
+        self._worker.start()
+
+    def _on_grad(self, msg: Message) -> None:
+        r = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX))
+        mb_idx = int(msg.get(MyMessage.MSG_ARG_KEY_SPLIT_MB_IDX))
+        grads = msg.get(MyMessage.MSG_ARG_KEY_SPLIT_GRADS)
+        with self._grad_cv:
+            if self._round_round_idx == r:
+                self._grads[mb_idx] = grads
+                self._grad_cv.notify_all()
+
+    def _on_finish(self, _msg: Message) -> None:
+        self.finish()
+
+    # -- the local round (worker thread) ------------------------------------
+    def _plan_m(self, w_client: PyTree) -> int:
+        batch = int(self.tokens.shape[0])
+        if self.target_micro_batches is not None:
+            return even_micro_batches(batch, int(self.target_micro_batches))
+        probe = split_model.client_forward(
+            w_client, np.asarray(self.tokens[:1]))
+        acts_nbytes = int(probe.nbytes) * batch
+        plan = plan_micro_batches(
+            max(1, acts_nbytes), self._fwd_s_ewma or 0.0,
+            src=self.rank, dst=_SERVER_RANK, default_chunks=4)
+        flight_recorder.record_event("pipeline", "split_microbatch_plan",
+                                     rank=self.rank, **plan.as_dict())
+        return even_micro_batches(batch, plan.n_micro_batches)
+
+    def _run_local_round(self, r: int, version: int, w_client: PyTree) -> None:
+        import time as _time
+
+        m = self._plan_m(w_client)
+        tok_mb = np.split(self.tokens, m)
+        tgt_mb = np.split(self.targets, m)
+
+        def forward_stage(i: int) -> Tuple[int, np.ndarray]:
+            if self._kill_at == (r, i):
+                self.killed.set()
+                flight_recorder.mark("split_client_killed", rank=self.rank,
+                                     round=r, mb=i)
+                raise RuntimeError("chaos: client shard killed mid-micro-batch")
+            t0 = _time.perf_counter()
+            acts = split_model.client_forward(w_client, np.asarray(tok_mb[i]))
+            acts = np.asarray(acts)
+            dt = _time.perf_counter() - t0
+            self._fwd_s_ewma = dt if self._fwd_s_ewma is None \
+                else 0.7 * self._fwd_s_ewma + 0.3 * dt
+            return i, acts
+
+        def uplink_stage(item: Tuple[int, np.ndarray]) -> int:
+            i, acts = item
+            msg = Message(MyMessage.MSG_TYPE_C2S_SPLIT_ACT, self.rank, _SERVER_RANK)
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, r)
+            msg.add_params(MyMessage.MSG_ARG_KEY_SPLIT_MB_IDX, i)
+            msg.add_params(MyMessage.MSG_ARG_KEY_SPLIT_MB_COUNT, m)
+            msg.add_params(MyMessage.MSG_ARG_KEY_SPLIT_ACTS, acts)
+            msg.add_params(MyMessage.MSG_ARG_KEY_SPLIT_TARGETS, np.asarray(tgt_mb[i]))
+            self.send_message(msg)
+            return i
+
+        executor = PipelinedExecutor(
+            [StageSpec("forward", forward_stage, maxsize=1),
+             StageSpec("uplink", uplink_stage, maxsize=2)],
+            name="split")
+        try:
+            executor.run(range(m))
+        except Exception:
+            if self.killed.is_set():
+                self.finish()  # the dead client leaves the broker for good
+                return
+            raise
+        # backward in fixed mb order, each starting as soon as its GRAD
+        # lands — the tail of the stream is still on the wire meanwhile
+        g_client_mbs: List[PyTree] = []
+        for i in range(m):
+            with self._grad_cv:
+                while i not in self._grads:
+                    self._grad_cv.wait(timeout=60.0)
+            with tel.span("split.client_backward", round=r, mb=i):
+                g_client_mbs.append(split_model.client_backward(
+                    w_client, np.asarray(tok_mb[i]), np.asarray(self._grads[i])))  # fedlint: disable=host-sync wire grads/token slices are already numpy; asarray is a no-copy view, not a device fetch
+        lr = float(getattr(self.args, "split_lr", 0.1))
+        new_shard = split_model.sgd_step(
+            w_client, split_model.accumulate_trees(g_client_mbs), lr)
+        done = Message(MyMessage.MSG_TYPE_C2S_SPLIT_DONE, self.rank, _SERVER_RANK)
+        done.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, r)
+        done.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(self.tokens.shape[0]))
+        done.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, new_shard)
+        done.add_params(MyMessage.MSG_ARG_KEY_MODEL_VERSION, version)
+        self.send_message(done)
+
+
+def run_split_rounds(
+    args: Any,
+    params: Dict[str, Any],
+    data_by_rank: Dict[int, Tuple[np.ndarray, np.ndarray]],
+    *,
+    cut: int,
+    rounds: int,
+    lr: float,
+    target_micro_batches: Optional[int] = None,
+    join_timeout_s: float = 120.0,
+) -> Tuple[PyTree, PyTree, SplitServerManager]:
+    """Drive a whole split-learning run over the in-memory broker.
+
+    ``data_by_rank`` maps client comm ranks (1-based) to ``(tokens,
+    targets)``. Returns the server's final shards plus the server manager
+    (its ``rounds_closed`` trajectory is what the tests assert on).
+    """
+    from ..core.distributed.communication.inmemory.broker import InMemoryBroker
+
+    run_id = str(getattr(args, "run_id", "split-run"))
+    args.run_id = run_id
+    InMemoryBroker.reset(run_id)
+    if not hasattr(args, "split_lr"):
+        args.split_lr = lr
+    w_client, w_server = split_model.cut_params(params, cut)
+    ranks = sorted(int(r) for r in data_by_rank)
+    server = SplitServerManager(
+        args, w_client, w_server, client_ranks=ranks, rounds=rounds, lr=lr)
+    clients = [
+        SplitClientManager(args, rank, len(ranks) + 1, tok, tgt,
+                           target_micro_batches=target_micro_batches)
+        for rank, (tok, tgt) in sorted(data_by_rank.items())
+    ]
+    threads = [threading.Thread(target=server.run, name="split-server", daemon=True)]
+    threads += [threading.Thread(target=c.run, name=f"split-client-run-{c.rank}",
+                                 daemon=True)
+                for c in clients]
+    for t in threads:
+        t.start()
+    if not server.finished.wait(timeout=join_timeout_s):
+        raise TimeoutError(
+            f"split run did not finish within {join_timeout_s}s "
+            f"(rounds closed: {server.rounds_closed})")
+    for t in threads:
+        t.join(timeout=10.0)
+    return server.w_client, server.w_server, server

@@ -1,0 +1,220 @@
+"""Split-learning model math: cut a tiny transformer at a layer boundary.
+
+The model is the repo's standard test transformer (tests/test_pipeline.py,
+``parallel/pipeline.py``): token embedding, a stack of pre-norm residual
+MLP blocks scanned over a ``[L, D, D]`` leading layer axis, and a CE head.
+``cut_params`` splits it at block boundary ``cut``: the client shard owns
+the embedding plus ``blocks[:cut]``; the server shard owns ``blocks[cut:]``
+plus the head.
+
+Everything on the wire protocol's math path lives here so the split run
+and its unsplit in-process reference call the SAME jitted functions —
+bit-exactness of the parity test (tests/test_split_learning.py) is by
+construction, the wire only adding an exact numpy round-trip:
+
+- :func:`client_forward` — embed + scan the client blocks -> activations
+- :func:`server_grads` — scan the server blocks + head loss, grads wrt
+  (server shard, activations) in one backward
+- :func:`client_backward` — recompute-vjp through the client shard
+  (activations are NOT stashed client-side between messages; PiPar's
+  memory argument)
+- :func:`accumulate_trees` / :func:`sgd_step` / :func:`fold_round` — the
+  fixed-order accumulation and the round-close fold both sides share
+
+Micro-batches must split the batch evenly
+(:func:`~fedml_tpu.core.pipeline.microbatch.even_micro_batches`): CE is a
+mean, so equal-sized chunks make mean-of-means equal the full-batch mean
+and the fused whole-model gradient agrees to float tolerance.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+def init_params(key: jax.Array, *, n_layers: int, d_model: int, vocab: int) -> Dict[str, Any]:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    scale = 0.5 / np.sqrt(d_model)
+    return {
+        "embed": {"table": jax.random.normal(k3, (vocab, d_model), jnp.float32)},
+        "blocks": {
+            "w1": jax.random.normal(k1, (n_layers, d_model, d_model), jnp.float32) * scale,
+            "w2": jax.random.normal(k2, (n_layers, d_model, d_model), jnp.float32) * scale,
+        },
+        "head": {"w": jax.random.normal(k4, (d_model, vocab), jnp.float32) * scale},
+    }
+
+
+def _block(blk: Dict[str, jax.Array], h: jax.Array) -> jax.Array:
+    hn = h - h.mean(-1, keepdims=True)
+    return h + jnp.tanh(hn @ blk["w1"]) @ blk["w2"]
+
+
+def _scan_blocks(blocks: Dict[str, jax.Array], h: jax.Array) -> jax.Array:
+    def body(carry, blk):
+        return _block(blk, carry), None
+
+    h, _ = jax.lax.scan(body, h, blocks)
+    return h
+
+
+def cut_params(params: Dict[str, Any], cut: int) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Split at block boundary ``cut`` (client owns blocks ``[:cut]``)."""
+    n_layers = int(params["blocks"]["w1"].shape[0])
+    if not 0 < int(cut) < n_layers:
+        raise ValueError(f"cut must be inside (0, {n_layers}), got {cut}")
+    p_client = {
+        "embed": params["embed"],
+        "blocks": jax.tree.map(lambda x: x[:cut], params["blocks"]),
+    }
+    p_server = {
+        "blocks": jax.tree.map(lambda x: x[cut:], params["blocks"]),
+        "head": params["head"],
+    }
+    return p_client, p_server
+
+
+def merge_params(p_client: Dict[str, Any], p_server: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        "embed": p_client["embed"],
+        "blocks": jax.tree.map(lambda a, b: jnp.concatenate([a, b], axis=0),
+                               p_client["blocks"], p_server["blocks"]),
+        "head": p_server["head"],
+    }
+
+
+@jax.jit
+def client_forward(p_client: Dict[str, Any], tokens: jax.Array) -> jax.Array:
+    h = p_client["embed"]["table"][tokens]
+    return _scan_blocks(p_client["blocks"], h)
+
+
+def _server_loss(p_server: Dict[str, Any], acts: jax.Array, targets: jax.Array) -> jax.Array:
+    h = _scan_blocks(p_server["blocks"], acts)
+    logits = h @ p_server["head"]["w"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, targets[..., None], axis=-1))
+
+
+@jax.jit
+def server_grads(p_server: Dict[str, Any], acts: jax.Array,
+                 targets: jax.Array) -> Tuple[jax.Array, Dict[str, Any], jax.Array]:
+    """(loss, d loss/d p_server, d loss/d acts) for one micro-batch."""
+    loss, (g_server, g_acts) = jax.value_and_grad(_server_loss, argnums=(0, 1))(
+        p_server, acts, targets)
+    return loss, g_server, g_acts
+
+
+@jax.jit
+def client_backward(p_client: Dict[str, Any], tokens: jax.Array,
+                    g_acts: jax.Array) -> Dict[str, Any]:
+    """Complete the backward through the client shard by recomputing the
+    forward and pulling ``g_acts`` back through its vjp."""
+    _, vjp = jax.vjp(lambda p: client_forward(p, tokens), p_client)
+    (g_client,) = vjp(g_acts)
+    return g_client
+
+
+@jax.jit
+def full_loss(params: Dict[str, Any], tokens: jax.Array, targets: jax.Array) -> jax.Array:
+    """Whole-model loss (no cut) — the mathematical cross-check target."""
+    h = params["embed"]["table"][tokens]
+    h = _scan_blocks(params["blocks"], h)
+    logits = h @ params["head"]["w"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, targets[..., None], axis=-1))
+
+
+def accumulate_trees(trees: Sequence[PyTree]) -> PyTree:
+    """Mean of grad trees in the given (fixed micro-batch) order — both the
+    split run and the in-process reference fold with exactly this."""
+    if not trees:
+        raise ValueError("nothing to accumulate")
+    acc = trees[0]
+    for t in trees[1:]:
+        acc = jax.tree.map(jnp.add, acc, t)
+    return jax.tree.map(lambda x: x / np.float32(len(trees)), acc)
+
+
+@partial(jax.jit, static_argnames=())
+def _sgd(params: PyTree, grads: PyTree, lr: jax.Array) -> PyTree:
+    return jax.tree.map(lambda p, g: p - lr * g, params, grads)
+
+
+def sgd_step(params: PyTree, grads: PyTree, lr: float) -> PyTree:
+    return _sgd(params, grads, jnp.float32(lr))
+
+
+def fold_round(
+    w_global_client: PyTree,
+    w_server: PyTree,
+    client_updates: Sequence[Tuple[float, PyTree]],
+    server_grad_means: Sequence[Tuple[float, PyTree]],
+    lr: float,
+) -> Tuple[PyTree, PyTree]:
+    """Round-close fold, shared verbatim by the split server and the
+    in-process reference (bit-exactness by construction).
+
+    ``client_updates`` are ``(num_samples, updated client shard)`` and
+    ``server_grad_means`` are ``(num_samples, mean server grad)``, both in
+    ascending-rank order — the server sorts arrivals before folding so the
+    broker's delivery order cannot perturb float summation. The client
+    shards FedAvg through the repo's bucketed engine
+    (``utils.pytree.weighted_average``); the server shard takes one SGD
+    step on the sample-weighted mean gradient.
+    """
+    from ..utils.pytree import weighted_average
+
+    if not client_updates:
+        return w_global_client, w_server
+    new_client = weighted_average(list(client_updates))
+    g_server = weighted_average(list(server_grad_means))
+    new_server = sgd_step(w_server, g_server, lr)
+    return new_client, new_server
+
+
+def reference_round(
+    w_client: PyTree,
+    w_server: PyTree,
+    data_by_rank: Dict[int, Tuple[np.ndarray, np.ndarray]],
+    *,
+    n_micro_batches: int,
+    lr: float,
+    ranks: Sequence[int] | None = None,
+) -> Tuple[PyTree, PyTree, List[float]]:
+    """One unsplit-in-process round: the same half functions, micro-batch
+    slicing, accumulation and fold the wire protocol runs — minus the wire.
+    ``ranks`` restricts participation (the chaos drill's partial round)."""
+    use = sorted(data_by_rank) if ranks is None else sorted(int(r) for r in ranks)
+    client_updates: List[Tuple[float, PyTree]] = []
+    server_grad_means: List[Tuple[float, PyTree]] = []
+    losses: List[float] = []
+    for rank in use:
+        tokens, targets = data_by_rank[rank]
+        m = int(n_micro_batches)
+        tok_mb = np.split(np.asarray(tokens), m)
+        tgt_mb = np.split(np.asarray(targets), m)
+        g_client_mbs, g_server_mbs = [], []
+        for i in range(m):
+            acts = client_forward(w_client, jnp.asarray(tok_mb[i]))
+            # numpy round-trip mirrors the wire exactly (device_get is exact)
+            acts = jnp.asarray(np.asarray(acts))
+            loss, g_srv, g_acts = server_grads(w_server, acts, jnp.asarray(tgt_mb[i]))
+            g_acts = jnp.asarray(np.asarray(g_acts))
+            g_client_mbs.append(client_backward(w_client, jnp.asarray(tok_mb[i]), g_acts))
+            g_server_mbs.append(g_srv)
+            losses.append(float(loss))
+        n = float(np.asarray(tokens).shape[0])
+        local_client = sgd_step(w_client, accumulate_trees(g_client_mbs), lr)
+        client_updates.append((n, local_client))
+        server_grad_means.append((n, accumulate_trees(g_server_mbs)))
+    new_client, new_server = fold_round(
+        w_client, w_server, client_updates, server_grad_means, lr)
+    return new_client, new_server, losses

@@ -236,6 +236,16 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
                           "alerts_fired": 1,
                           "slo_rounds": 600,
                           "slo_window_s": 1.21}, None),
+        "pipeline_overlap": ({"pipeline_overlap_frac": 0.88,
+                              "pipeline_overlap_frac_min": 0.86,
+                              "pipeline_speedup": 1.44,
+                              "pipeline_serial_wall_s": 0.87,
+                              "pipeline_wall_s": 0.6,
+                              "pipeline_micro_batches": 8,
+                              "pipeline_chunk_nbytes": 32768,
+                              "pipeline_plan_reason": "balanced",
+                              "pipeline_clients": 3,
+                              "pipeline_bottleneck": "train"}, None),
     })
     with pytest.raises(SystemExit) as exc:
         bench.main()
@@ -269,6 +279,8 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
     assert out["probe_overhead_pct"] == 0.36
     assert out["slo_overhead_pct"] == 0.38
     assert out["alerts_fired"] == 1
+    assert out["pipeline_overlap_frac"] == 0.88
+    assert out["pipeline_speedup"] == 1.44
     assert out["stages_failed"] == []
     # incremental artifacts landed (one per stage + final, same stamp file)
     arts = glob.glob(str(tmp_path / "BENCH_MEASURED_*.json"))

@@ -62,6 +62,16 @@ EXPORTED = {
     "fedml_engine_round_seconds": "histogram",
     "fedml_placement_probes_total": "counter",
     "fedml_placement_search_seconds": "histogram",
+    # pipelined round execution (core/pipeline/executor.py)
+    "fedml_pipeline_items_total": "counter",
+    "fedml_pipeline_stage_seconds": "histogram",
+    "fedml_pipeline_stage_stall_seconds": "histogram",
+    "fedml_pipeline_queue_depth": "histogram",
+    "fedml_pipeline_overlap_frac": "histogram",
+    # split learning front (fedml_tpu/split/api.py)
+    "fedml_split_mb_loss": "histogram",
+    "fedml_split_rounds_total": "counter",
+    "fedml_split_partial_rounds_total": "counter",
     # server / mesh
     "fedml_server_aggregate_seconds": "histogram",
     "fedml_server_shard_bytes": "gauge",

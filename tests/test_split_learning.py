@@ -1,0 +1,197 @@
+"""Split learning (fedml_tpu/split): wire run == in-process reference,
+bit-exactly — plus the mathematical cross-check against the fused
+whole-model gradient and the mid-micro-batch kill drill.
+
+Bit-exactness is by construction (the wire run and ``reference_round``
+call the same jitted half functions in the same micro-batch order, and
+the wire only adds exact numpy round-trips), so the test pins the whole
+chain: cut, forward streaming, fold-at-arrival server backward,
+recompute-vjp client backward, ordered round-close fold.
+"""
+
+import threading
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core.distributed.communication.inmemory.broker import InMemoryBroker
+from fedml_tpu.split import (
+    accumulate_trees,
+    client_backward,
+    client_forward,
+    cut_params,
+    full_loss,
+    init_params,
+    merge_params,
+    reference_round,
+    run_split_rounds,
+    server_grads,
+)
+
+L, D, V, T, B = 6, 8, 17, 6, 8
+CUT = 3
+
+
+def _params():
+    return init_params(jax.random.PRNGKey(0), n_layers=L, d_model=D, vocab=V)
+
+
+def _data(ranks, seed=42):
+    rng = np.random.RandomState(seed)
+    return {r: (rng.randint(0, V, (B, T)), rng.randint(0, V, (B, T)))
+            for r in ranks}
+
+
+def _maxdiff(a, b) -> float:
+    return max(float(jnp.max(jnp.abs(x - y)))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def _args(**over):
+    ns = types.SimpleNamespace(comm_retry_max_attempts=0)
+    for k, v in over.items():
+        setattr(ns, k, v)
+    return ns
+
+
+@pytest.fixture(autouse=True)
+def _clean_broker():
+    yield
+    # the drills leave per-run singletons behind; drop them
+    for run_id in ("split-parity", "split-chaos", "split-mb"):
+        InMemoryBroker.reset(run_id)
+
+
+# ---------------------------------------------------------------------------
+# model math
+# ---------------------------------------------------------------------------
+
+class TestSplitModelMath:
+    def test_cut_merge_roundtrip(self):
+        params = _params()
+        p_client, p_server = cut_params(params, CUT)
+        assert _maxdiff(merge_params(p_client, p_server), params) == 0.0
+
+    def test_cut_bounds_enforced(self):
+        params = _params()
+        for bad in (0, L, L + 1, -1):
+            with pytest.raises(ValueError):
+                cut_params(params, bad)
+
+    def test_split_grads_match_fused_whole_model_grad(self):
+        """client_forward + server_grads + client_backward over even
+        micro-batches must agree with jax.grad of the uncut model."""
+        params = _params()
+        p_client, p_server = cut_params(params, CUT)
+        tokens, targets = _data([1])[1]
+        m = 4
+        tok_mb, tgt_mb = np.split(tokens, m), np.split(targets, m)
+        g_client_mbs, g_server_mbs = [], []
+        for i in range(m):
+            acts = np.asarray(client_forward(p_client, tok_mb[i]))
+            _, g_srv, g_acts = server_grads(p_server, acts, tgt_mb[i])
+            g_client_mbs.append(client_backward(p_client, tok_mb[i],
+                                                np.asarray(g_acts)))
+            g_server_mbs.append(g_srv)
+        g_client = accumulate_trees(g_client_mbs)
+        g_server = accumulate_trees(g_server_mbs)
+        fused = jax.grad(full_loss)(params, jnp.asarray(tokens), jnp.asarray(targets))
+        f_client, f_server = cut_params(fused, CUT)
+        for got, want in ((g_client, f_client), (g_server, f_server)):
+            for x, y in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+                np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                           rtol=2e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# e2e parity
+# ---------------------------------------------------------------------------
+
+class TestSplitE2EParity:
+    def test_two_rounds_bit_exact_vs_unsplit_reference(self):
+        params = _params()
+        data = _data([1, 2])
+        args = _args(run_id="split-parity")
+        w_client, w_server, server = run_split_rounds(
+            args, params, data, cut=CUT, rounds=2, lr=0.1,
+            target_micro_batches=4)
+        assert [r["partial"] for r in server.rounds_closed] == [False, False]
+        assert [r["k"] for r in server.rounds_closed] == [2, 2]
+
+        rc, rs = cut_params(params, CUT)
+        for _ in range(2):
+            rc, rs, losses = reference_round(rc, rs, data,
+                                             n_micro_batches=4, lr=0.1)
+            assert all(np.isfinite(losses))
+        assert _maxdiff(w_client, rc) == 0.0, "client shard drifted"
+        assert _maxdiff(w_server, rs) == 0.0, "server shard drifted"
+
+    def test_planner_chosen_micro_batches_still_exact(self):
+        # no explicit m: the client asks the link-cost planner (cold model
+        # -> default chunks -> clamped to an even batch split) — whatever it
+        # picks, the server must fold to the same result as a reference run
+        # with that m
+        params = _params()
+        data = _data([1])
+        args = _args(run_id="split-mb")
+        w_client, w_server, server = run_split_rounds(
+            args, params, data, cut=CUT, rounds=1, lr=0.1)
+        assert server.rounds_closed[0]["k"] == 1
+        m = server._mb_counts.get(1) or 4
+        rc, rs, _ = reference_round(*cut_params(params, CUT), data,
+                                    n_micro_batches=m, lr=0.1)
+        assert _maxdiff(w_client, rc) == 0.0
+        assert _maxdiff(w_server, rs) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill a client shard mid-micro-batch
+# ---------------------------------------------------------------------------
+
+class TestSplitChaosDrill:
+    def test_kill_mid_micro_batch_quorum_recovers_round(self):
+        """Rank 3 dies between micro-batches; a flaky link on rank 2 makes
+        the retry path earn its keep; the deadline quorum closes both rounds
+        partial with ranks {1, 2} and the fold matches the partial
+        reference bit-exactly."""
+        params = _params()
+        data = _data([1, 2, 3])
+        args = _args(
+            run_id="split-chaos",
+            comm_retry_max_attempts=3, comm_retry_base_delay_s=0.05,
+            round_deadline_s=3.0, quorum_frac=0.6,
+            chaos_split_kill_rank=3, chaos_split_kill_round=0,
+            chaos_split_kill_mb=1,
+            chaos_split_send_fail_n=2, chaos_split_send_fail_rank=2,
+        )
+        w_client, w_server, server = run_split_rounds(
+            args, params, data, cut=CUT, rounds=2, lr=0.1,
+            target_micro_batches=4, join_timeout_s=60.0)
+
+        assert [r["partial"] for r in server.rounds_closed] == [True, True]
+        assert [r["arrived"] for r in server.rounds_closed] == [[1, 2], [1, 2]]
+
+        rc, rs = cut_params(params, CUT)
+        for _ in range(2):
+            rc, rs, _ = reference_round(rc, rs, data, n_micro_batches=4,
+                                        lr=0.1, ranks=[1, 2])
+        assert _maxdiff(w_client, rc) == 0.0
+        assert _maxdiff(w_server, rs) == 0.0
+
+    def test_killed_client_flags_itself(self):
+        params = _params()
+        data = _data([1, 2])
+        args = _args(
+            run_id="split-chaos",
+            round_deadline_s=2.0, quorum_frac=0.5,
+            chaos_split_kill_rank=2, chaos_split_kill_round=0,
+            chaos_split_kill_mb=1,
+        )
+        _, _, server = run_split_rounds(
+            args, params, data, cut=CUT, rounds=1, lr=0.1,
+            target_micro_batches=4, join_timeout_s=60.0)
+        assert server.rounds_closed[0]["arrived"] == [1]
+        assert server.rounds_closed[0]["partial"] is True

@@ -61,6 +61,8 @@ HEADLINES: Dict[str, str] = {
     "placement_speedup.*": "higher",
     "link_bw_error_pct": "lower",
     "probe_overhead_pct": "lower",
+    "pipeline_overlap_frac": "higher",       # ISSUE 15 stage executor
+    "pipeline_speedup": "higher",
     "slo_overhead_pct": "lower",             # ISSUE 14 evaluator guard
     "_llm_pallas.tokens_per_sec": "higher",
     "_llm_pallas.mfu": "higher",

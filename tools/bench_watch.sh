@@ -83,6 +83,7 @@ commit_artifacts() {
       surface_agg_sharded
       surface_async_rounds
       surface_wan_profile
+      surface_pipeline_overlap
       surface_placement
       surface_resilience
       surface_serving
@@ -198,6 +199,31 @@ if links:
 PYEOF
 ) || return 0
   [ -n "$wan" ] && log "$wan"
+}
+
+surface_pipeline_overlap() {
+  # one-line view of the pipelined round-execution stage: measured overlap
+  # fraction, pipelined-vs-serial speedup and the planner's micro-batch
+  # pick — so the watcher log answers "is uplink still hiding under
+  # compute" without opening BENCH_MEASURED_*.json
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1) || return 0
+  [ -n "$newest" ] || return 0
+  local pipe
+  pipe=$(python3 - "$newest" <<'PYEOF' 2>/dev/null
+import json, sys
+doc = json.load(open(sys.argv[1]))
+if doc.get("pipeline_overlap_frac") is not None:
+    print(f"pipeline_overlap: frac {doc['pipeline_overlap_frac']} "
+          f"(min {doc.get('pipeline_overlap_frac_min')}), "
+          f"speedup {doc.get('pipeline_speedup')}x "
+          f"({doc.get('pipeline_serial_wall_s')}s -> {doc.get('pipeline_wall_s')}s), "
+          f"m={doc.get('pipeline_micro_batches')} "
+          f"[{doc.get('pipeline_plan_reason')}], "
+          f"bottleneck {doc.get('pipeline_bottleneck')}")
+PYEOF
+) || return 0
+  [ -n "$pipe" ] && log "$pipe"
 }
 
 surface_placement() {
