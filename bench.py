@@ -2310,44 +2310,46 @@ def _bench_llm_serving(n_replicas: int = 2, clients: int = 4, reqs_per_client: i
                 os.environ[k] = v
 
 
-def _bench_llm_serving_load(streams: int | None = None):
-    """Load test: 1k+ CONCURRENT streams against ONE endpoint backed by the
-    slotted continuous-batching engine (serving/continuous_batching.py).
+def _serving_load_prompts(streams: int, tiny: bool, seed: int = 0):
+    """The load mix: RAGGED prompt/output lengths, and 70% of streams share
+    one of 4 system prompts (the production shape paged prefix sharing
+    exists for: few long system prompts, many short user tails)."""
+    import random
 
-    Topology: one in-process FedMLInferenceRunner (stdlib threading HTTP
-    server) over an LLMPredictor in continuous mode — requests join/leave a
-    single always-running chunked decode step at token boundaries instead
-    of barriering on the 10ms/max-4 window micro-batcher the `serving`
-    stage measures. In-process (no subprocess replicas) because the claim
-    under test is the ENGINE's ability to interleave 1k+ streams on one
-    chip; the `serving` stage keeps covering the multi-replica topology.
+    rng = random.Random(seed)
+    base = "federated benchmark serving endpoint throughput measure "
+    # tiny cfg has max_seq_len 64: one base rep keeps prompt+max_new inside
+    # the context while the shared system prefix still spans 2+ full pages
+    sys_reps = 1 if tiny else 8
+    system = [f"system prompt {w}: " + base * sys_reps
+              for w in ("alpha", "beta", "gamma", "delta")]
+    reqs = []
+    for i in range(streams):
+        tail = f"user {i % 97} asks question {i % 7} about topic {i % 13}"
+        if rng.random() < 0.70:
+            prompt = system[i % 4] + tail
+        else:
+            prompt = f"cold prompt {i}: " + base * rng.randint(1, sys_reps) + tail
+        max_new = rng.randint(2, 8) if tiny else rng.randint(4, 32)
+        reqs.append({"prompt": prompt, "max_new_tokens": max_new})
+    return reqs
 
-    Reports endpoint tokens/s plus the tail signals that matter at this
-    concurrency: TTFT p50/p99 (includes queue wait — admission is FIFO),
-    TPOT p50/p99, and slot occupancy. The merge step derives
-    `serving_load_vs_decode` = raw single-chip decode rate / this rate
-    (ISSUE 6 acceptance: within 10x)."""
+
+def _serving_load_once(reqs: list, paged: bool):
+    """One load run: `len(reqs)` concurrent HTTP streams against a fresh
+    in-process runner + engine (paged or fixed-slot, selected via the env
+    seam the predictor reads). Returns the metrics of this run."""
     import http.client
     import threading
 
-    tiny = os.environ.get("FEDML_BENCH_TINY") == "1"
-    if streams is None:
-        streams = int(os.environ.get("FEDML_SERVE_LOAD_STREAMS",
-                                     "64" if tiny else "1024"))
-    new_tokens = 8 if tiny else 32
-    saved_env = {k: os.environ.get(k) for k in
-                 ("FEDML_SERVE_CONTINUOUS", "FEDML_SERVE_SLOTS",
-                  "FEDML_SERVE_CHUNK", "FEDML_BENCH_FLAGSHIP")}
-    os.environ["FEDML_SERVE_CONTINUOUS"] = "1"
-    os.environ.setdefault("FEDML_SERVE_SLOTS", "8" if tiny else "64")
-    os.environ.setdefault("FEDML_SERVE_CHUNK", "4" if tiny else "16")
-    if not tiny:
-        os.environ["FEDML_BENCH_FLAGSHIP"] = "1"  # 268M predictor geometry
-    runner = None
-    try:
-        from fedml_tpu.serving.bench_predictors import llm_bench_predictor
-        from fedml_tpu.serving.fedml_inference_runner import FedMLInferenceRunner
+    from fedml_tpu.serving.bench_predictors import llm_bench_predictor
+    from fedml_tpu.serving.fedml_inference_runner import FedMLInferenceRunner
 
+    streams = len(reqs)
+    runner = None
+    os.environ["FEDML_SERVE_PAGED"] = "1" if paged else "0"
+    os.environ["FEDML_SERVE_CONTINUOUS"] = "0" if paged else "1"
+    try:
         pred = llm_bench_predictor()  # warmed (engine compiles in warmup)
         assert pred.engine is not None, "continuous engine did not come up"
         runner = FedMLInferenceRunner(pred, port=0)
@@ -2359,13 +2361,16 @@ def _bench_llm_serving_load(streams: int | None = None):
 
         def stream(i: int) -> None:
             # keep-alive connection per stream; one long-lived decode each,
-            # so `streams` requests really are concurrently in flight
+            # so `streams` requests really are concurrently in flight. The
+            # ramp (200 connects per 50ms tranche) keeps 10k near-simultaneous
+            # TCP connects from overflowing the server's accept backlog —
+            # every stream is still concurrently IN FLIGHT, admission just
+            # sees an arrival wave instead of a SYN flood.
             start_gate.wait()
+            time.sleep((i // 200) * 0.05)  # fedlint: disable=bare-sleep connect-ramp pacing, not a retry
             try:
                 conn = http.client.HTTPConnection("127.0.0.1", port, timeout=900)
-                body = json.dumps({"prompt": f"load stream {i % 10} of many",
-                                   "max_new_tokens": new_tokens})
-                conn.request("POST", "/predict", body,
+                conn.request("POST", "/predict", json.dumps(reqs[i]),
                              {"Content-Type": "application/json"})
                 resp = conn.getresponse()
                 data = resp.read()
@@ -2378,10 +2383,12 @@ def _bench_llm_serving_load(streams: int | None = None):
 
         base = pred.engine.stats()["tokens_out"]
         threads = [threading.Thread(target=stream, args=(i,)) for i in range(streams)]
-        # sample slot occupancy / queue depth DURING the load (stats() after
-        # join always reads 0 — the interesting number is mid-burst)
+        # sample slot occupancy / queue depth / KV pages DURING the load
+        # (stats() after join always reads 0 — the interesting number is
+        # mid-burst)
         occ_samples: list = []
         q_samples: list = []
+        ppt_samples: list = []  # kv pages per live token (paged only)
         done_gate = threading.Event()
 
         def sampler() -> None:
@@ -2390,6 +2397,8 @@ def _bench_llm_serving_load(streams: int | None = None):
                 s = pred.engine.stats()
                 occ_samples.append(s["slot_occupancy"])
                 q_samples.append(s["queue_depth"])
+                if paged and s.get("kv_tokens_live", 0) > 0:
+                    ppt_samples.append(s["kv_pages_per_token"])
 
         samp = threading.Thread(target=sampler, daemon=True)
         samp.start()
@@ -2408,38 +2417,148 @@ def _bench_llm_serving_load(streams: int | None = None):
             # acceptance is "without request failures": any failure is a
             # stage failure, with the first few causes in the record
             raise RuntimeError(
-                f"serving_load: {len(failures)}/{streams} streams failed: "
+                f"serving_load[{'paged' if paged else 'fixed'}]: "
+                f"{len(failures)}/{streams} streams failed: "
                 + "; ".join(failures[:3]))
         tokens = st["tokens_out"] - base
-        out = {
-            "serving_load_streams": streams,
-            "serving_load_tokens_per_sec": round(tokens / dt, 2),
-            "serving_load_tokens": tokens,
-            "serving_load_wall_s": round(dt, 2),
-            "serving_load_ttft_p50_s": pct["ttft_s"]["p50"],
-            "serving_load_ttft_p99_s": pct["ttft_s"]["p99"],
-            "serving_load_tpot_p50_s": pct["tpot_s"]["p50"],
-            "serving_load_tpot_p99_s": pct["tpot_s"]["p99"],
-            "serving_load_slots": st["slots_total"],
-            "serving_load_chunk": st["chunk"],
-            "serving_load_slot_occupancy_peak": (
-                round(max(occ_samples), 3) if occ_samples else None),
-            "serving_load_slot_occupancy_mean": (
-                round(sum(occ_samples) / len(occ_samples), 3)
-                if occ_samples else None),
-            "serving_load_queue_depth_peak": (
-                max(q_samples) if q_samples else None),
-            "serving_load_model": "tiny" if tiny else "llama-268M flagship proxy (bf16)",
-            "serving_load_engine": "continuous (slotted KV cache, prefill-disaggregated)",
+        cfg = pred._cfg
+        # KV bytes actually provisioned by this engine (k+v, all layers)
+        import numpy as _np
+
+        per_tok = (2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
+                   * _np.dtype(cfg.dtype).itemsize)
+        kv_tokens = (st["kv_pages_total"] * st["kv_page_size"] if paged
+                     else st["slots_total"] * cfg.max_seq_len)
+        return {
+            "tokens_per_sec": round(tokens / dt, 2),
+            "tokens": tokens,
+            "wall_s": round(dt, 2),
+            "ttft_p50_s": pct["ttft_s"]["p50"],
+            "ttft_p99_s": pct["ttft_s"]["p99"],
+            "tpot_p50_s": pct["tpot_s"]["p50"],
+            "tpot_p99_s": pct["tpot_s"]["p99"],
+            "slots": st["slots_total"],
+            "chunk": st["chunk"],
+            "occ_peak": round(max(occ_samples), 3) if occ_samples else None,
+            "occ_mean": (round(sum(occ_samples) / len(occ_samples), 3)
+                         if occ_samples else None),
+            "queue_peak": max(q_samples) if q_samples else None,
+            "kv_tokens": kv_tokens,
+            "kv_bytes": kv_tokens * per_tok,
+            "kv_pages_per_token": (
+                round(sum(ppt_samples) / len(ppt_samples), 4)
+                if ppt_samples else None),
+            "prefix_hits": st.get("kv_prefix_hits"),
+            "prefix_misses": st.get("kv_prefix_misses"),
+            "alloc_deferred": st.get("kv_alloc_deferred"),
         }
-        for k in ("serving_load_ttft_p50_s", "serving_load_ttft_p99_s",
-                  "serving_load_tpot_p50_s", "serving_load_tpot_p99_s"):
-            if out[k] is not None:
-                out[k] = round(out[k], 4)
-        return out
     finally:
         if runner is not None:
             runner.stop()
+
+
+def _bench_llm_serving_load(streams: int | None = None):
+    """Load test: 10k CONCURRENT streams against ONE endpoint, run TWICE —
+    paged KV engine vs fixed-slot engine — on the identical ragged
+    shared-prefix workload (serving/continuous_batching.py, paged_kv.py).
+
+    Topology: one in-process FedMLInferenceRunner (stdlib threading HTTP
+    server) over an LLMPredictor. In-process (no subprocess replicas)
+    because the claim under test is the ENGINE's ability to interleave the
+    streams on one chip; the `serving` stage keeps covering the
+    multi-replica topology.
+
+    The paged engine is deliberately given HALF the fixed engine's KV
+    provisioning (num_pages * page_size = slots * max_seq_len / 2): the
+    claim is that prefix sharing + token-granular paging beat worst-case
+    row allocation on BOTH axes at once — p99 TTFT (queue wait dominates
+    at this concurrency, and 70% of streams skip their system prompt's
+    prefill) AND total KV HBM. Both claims are integrity-GUARDED
+    (BenchIntegrityError) on the full-scale run; the tiny CPU harness
+    records but does not guard TTFT (8 slots of timing noise)."""
+    tiny = os.environ.get("FEDML_BENCH_TINY") == "1"
+    if streams is None:
+        streams = int(os.environ.get("FEDML_SERVE_LOAD_STREAMS",
+                                     "64" if tiny else "10240"))
+    saved_env = {k: os.environ.get(k) for k in
+                 ("FEDML_SERVE_CONTINUOUS", "FEDML_SERVE_PAGED",
+                  "FEDML_SERVE_SLOTS", "FEDML_SERVE_CHUNK",
+                  "FEDML_SERVE_PAGE_SIZE", "FEDML_SERVE_KV_PAGES",
+                  "FEDML_SERVE_MAX_QUEUE", "FEDML_BENCH_FLAGSHIP")}
+    slots = int(os.environ.setdefault("FEDML_SERVE_SLOTS",
+                                      "8" if tiny else "64"))
+    os.environ.setdefault("FEDML_SERVE_CHUNK", "4" if tiny else "16")
+    os.environ["FEDML_SERVE_MAX_QUEUE"] = str(streams + 64)
+    if not tiny:
+        os.environ["FEDML_BENCH_FLAGSHIP"] = "1"  # 268M predictor geometry
+    page_size = 16
+    os.environ["FEDML_SERVE_PAGE_SIZE"] = str(page_size)
+    max_seq = 64 if tiny else 256
+    # HALF the fixed-slot KV budget (+1 for the reserved trash page)
+    os.environ["FEDML_SERVE_KV_PAGES"] = str(
+        slots * max_seq // page_size // 2 + 1)
+    try:
+        reqs = _serving_load_prompts(streams, tiny)
+        paged = _serving_load_once(reqs, paged=True)
+        fixed = _serving_load_once(reqs, paged=False)
+        if paged["kv_bytes"] >= fixed["kv_bytes"]:
+            raise BenchIntegrityError(
+                f"paged engine provisioned {paged['kv_bytes']} KV bytes vs "
+                f"fixed {fixed['kv_bytes']} — the HBM claim is void")
+        if (not tiny and paged["ttft_p99_s"] is not None
+                and fixed["ttft_p99_s"] is not None
+                and paged["ttft_p99_s"] >= fixed["ttft_p99_s"]):
+            raise BenchIntegrityError(
+                f"paged p99 TTFT {paged['ttft_p99_s']:.3f}s did not beat "
+                f"fixed-slot {fixed['ttft_p99_s']:.3f}s at {streams} streams "
+                "— the latency claim is void")
+        out = {
+            "serving_load_streams": streams,
+            "serving_load_tokens_per_sec": paged["tokens_per_sec"],
+            "serving_load_tokens": paged["tokens"],
+            "serving_load_wall_s": paged["wall_s"],
+            "serving_load_ttft_p50_s": paged["ttft_p50_s"],
+            # headline keys (bench_regress HEADLINES): paged-engine tails
+            "serving_load_p99_ttft_s": paged["ttft_p99_s"],
+            "serving_load_p99_tpot_s": paged["tpot_p99_s"],
+            "kv_pages_per_token": paged["kv_pages_per_token"],
+            "serving_load_slots": paged["slots"],
+            "serving_load_chunk": paged["chunk"],
+            "serving_load_slot_occupancy_peak": paged["occ_peak"],
+            "serving_load_slot_occupancy_mean": paged["occ_mean"],
+            "serving_load_queue_depth_peak": paged["queue_peak"],
+            "serving_load_kv_bytes_paged": paged["kv_bytes"],
+            "serving_load_kv_bytes_fixed": fixed["kv_bytes"],
+            "serving_load_kv_hbm_ratio": round(
+                paged["kv_bytes"] / fixed["kv_bytes"], 3),
+            "serving_load_prefix_hits": paged["prefix_hits"],
+            "serving_load_prefix_misses": paged["prefix_misses"],
+            "serving_load_alloc_deferred": paged["alloc_deferred"],
+            "serving_load_fixed_tokens_per_sec": fixed["tokens_per_sec"],
+            "serving_load_fixed_ttft_p99_s": fixed["ttft_p99_s"],
+            "serving_load_fixed_tpot_p99_s": fixed["tpot_p99_s"],
+            "serving_load_model": "tiny" if tiny else "llama-268M flagship proxy (bf16)",
+            "serving_load_engine": ("paged KV (prefix-shared, "
+                                    "admission-pipelined) vs fixed-slot"),
+        }
+        for k in ("serving_load_ttft_p50_s", "serving_load_p99_ttft_s",
+                  "serving_load_p99_tpot_s", "serving_load_fixed_ttft_p99_s",
+                  "serving_load_fixed_tpot_p99_s"):
+            if out[k] is not None:
+                out[k] = round(out[k], 4)
+        # legacy aliases (dashboards pre-paged): same values, old names
+        out["serving_load_ttft_p99_s"] = out["serving_load_p99_ttft_s"]
+        out["serving_load_tpot_p50_s"] = (
+            round(paged["tpot_p50_s"], 4) if paged["tpot_p50_s"] is not None
+            else None)
+        out["serving_load_tpot_p99_s"] = out["serving_load_p99_tpot_s"]
+        return out
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
         for k, v in saved_env.items():
             if v is None:
                 os.environ.pop(k, None)

@@ -36,6 +36,33 @@ NAMESPACE = "fedml"
 # (name, labels, value) triple; labels may be None
 Gauge = Tuple[str, Optional[Dict[str, str]], float]
 
+# Registered counter prefix families: counters named "<prefix><v1>.<v2>..."
+# collapse into ONE labeled family fedml_<prefix>_total{l1="v1",l2="v2"}.
+# This generalizes the hard-wired jax.compiles./comm.retry. collapses so any
+# subsystem can mint a bounded-cardinality labeled counter without growing
+# this module (admission rejects were the forcing case: {tenant=,reason=}).
+# prefix -> (label names, help text); the LAST dot-separated fields map to
+# the labels right-to-left, so only the FIRST label's values may contain
+# dots (tenant ids do; reason vocabularies must not).
+_PREFIX_FAMILIES: Dict[str, Tuple[Tuple[str, ...], str]] = {}
+
+
+def register_prefix_family(prefix: str, labels: Tuple[str, ...],
+                           help_text: str) -> None:
+    """Idempotent: re-registering the same prefix overwrites in place."""
+    if not prefix.endswith("."):
+        raise ValueError(f"prefix family must end with '.', got {prefix!r}")
+    if not labels:
+        raise ValueError("prefix family needs at least one label")
+    _PREFIX_FAMILIES[prefix] = (tuple(labels), str(help_text))
+
+
+def _split_family_rest(rest: str, labels: Tuple[str, ...]) -> Dict[str, str]:
+    parts = rest.rsplit(".", len(labels) - 1)
+    while len(parts) < len(labels):
+        parts.append("unknown")  # malformed emission: surface, don't drop
+    return dict(zip(labels, parts))
+
 
 def escape_label_value(v: str) -> str:
     """Label values escape backslash, double-quote, and newline (spec order:
@@ -94,13 +121,20 @@ def render(telemetry: Optional[Telemetry] = None,
     compiles: Dict[str, int] = {}
     retries: Dict[str, int] = {}
     plain: Dict[str, int] = {}
+    families: Dict[str, List[Tuple[Dict[str, str], int]]] = {}
     for name, value in sorted(snap["counters"].items()):
         if name.startswith(COMPILE_COUNTER_PREFIX):
             compiles[name[len(COMPILE_COUNTER_PREFIX):]] = value
         elif name.startswith(RETRY_COUNTER_PREFIX):
             retries[name[len(RETRY_COUNTER_PREFIX):]] = value
         else:
-            plain[name] = value
+            for prefix, (labels, _help) in _PREFIX_FAMILIES.items():
+                if name.startswith(prefix) and len(name) > len(prefix):
+                    families.setdefault(prefix, []).append(
+                        (_split_family_rest(name[len(prefix):], labels), value))
+                    break
+            else:
+                plain[name] = value
     if compiles:
         fam = _fam("jax_compiles", "_total")
         lines.append(f"# HELP {fam} jit trace count per tracked function")
@@ -113,6 +147,13 @@ def render(telemetry: Optional[Telemetry] = None,
         lines.append(f"# TYPE {fam} counter")
         for backend, value in sorted(retries.items()):
             lines.append(f'{fam}{{backend="{escape_label_value(backend)}"}} {format_value(value)}')
+    for prefix in sorted(families):
+        labels, help_text = _PREFIX_FAMILIES[prefix]
+        fam = _fam(prefix[:-1], "_total")
+        lines.append(f"# HELP {fam} {escape_help(help_text)}")
+        lines.append(f"# TYPE {fam} counter")
+        for label_map, value in families[prefix]:
+            lines.append(f"{fam}{_labels_str(label_map)} {format_value(value)}")
     for name, value in plain.items():
         fam = _fam(name, "_total")
         lines.append(f"# HELP {fam} telemetry counter {escape_help(name)}")

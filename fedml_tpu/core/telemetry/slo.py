@@ -141,6 +141,15 @@ _SERVING_PACK: List[Dict[str, Any]] = [
          signal="quantile", q=0.99, comparator="<=", target=1.0),
     dict(name="request_error_rate", series="serving.request_errors",
          signal="rate", comparator="<=", target=1.0),
+    # admission is SUPPOSED to shed before the latency SLOs fire, but a
+    # sustained shed rate is its own incident: tenants are being turned
+    # away faster than operators would accept as transient backpressure
+    dict(name="admission_shed_rate", series="serving.admission.rejected.*",
+         signal="rate", comparator="<=", target=5.0),
+    # paged-KV pool pressure: deferred allocations mean admitted work is
+    # waiting on pages (raise num_pages or shrink budgets before TTFT tips)
+    dict(name="kv_alloc_deferred_rate", series="serving.kv.alloc_deferred",
+         signal="rate", comparator="<=", target=1.0),
 ]
 
 DEFAULT_PACKS: Dict[str, List[Dict[str, Any]]] = {

@@ -50,6 +50,14 @@ class TransformerConfig:
     moe_ep_axis: Any = None      # mesh axis name for expert parallelism
     moe_local_experts: Any = None  # shard_map pp path: experts per ep rank
     decode: bool = False         # KV-cache autoregressive decode mode (serving)
+    # Paged KV cache (serving/paged_kv.py): when kv_page_size > 0 the decode
+    # cache collection is a physical page pool [kv_num_pages, kv_page_size,
+    # kv, hd] per layer instead of per-row [B, max_seq_len, ...] slabs, and
+    # decode steps address it through per-row runtime block tables — the
+    # allocator refcounts pages so requests sharing a system-prompt prefix
+    # map the same physical pages. 0 = contiguous slots (PR-6 engine).
+    kv_page_size: int = 0
+    kv_num_pages: int = 0
     # int8 = weight-only quantized dense kernels (serving/quant.py transform
     # produces the kernel_q/kernel_scale layout). Decode is HBM-bandwidth
     # bound, so halving weight bytes is a direct tokens/sec lever; activations
@@ -194,7 +202,8 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, positions: jnp.ndarray,
                  attn_start: Optional[jnp.ndarray] = None,
-                 cache_idx: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 cache_idx: Optional[jnp.ndarray] = None,
+                 block_tables: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.cfg
         B, T, _ = x.shape
         hd = cfg.head_dim
@@ -204,6 +213,9 @@ class Attention(nn.Module):
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
         if cfg.decode:
+            if cfg.kv_page_size > 0:
+                return self._paged_decode_attention(q, k, v, B, T, cache_idx,
+                                                    block_tables)
             return self._decode_attention(q, k, v, B, T, attn_start, cache_idx)
         impl = cfg.attention_impl
         if impl == "auto":
@@ -287,6 +299,61 @@ class Attention(nn.Module):
         out = out.reshape(B, T, cfg.n_heads * hd)
         return LoRALinear(cfg.d_model, cfg, name="o_proj")(out)
 
+    def _paged_decode_attention(self, q, k, v, B: int, T: int,
+                                cache_idx: Optional[jnp.ndarray],
+                                block_tables: Optional[jnp.ndarray]) -> jnp.ndarray:
+        """Block-table KV attention over a physical page pool (the paged
+        serving engine's mode, serving/paged_kv.py). The cache collection is
+        [kv_num_pages, kv_page_size, kv, hd] per layer — one pool shared by
+        every in-flight request; row ``b``'s logical position ``l`` lives at
+        page ``block_tables[b, l // page]``, slot ``l % page``. Both the
+        block tables [B, max_blocks] and the per-row write index
+        ``cache_idx`` [B] are RUNTIME data, so one executable per (cfg, B)
+        serves every admission mix, exactly like the ``cache_idx`` slot
+        mode.
+
+        Write: the new k/v token scatters to (bt[b, idx//page], idx%page).
+        The allocator guarantees the page being written has refcount 1 (a
+        shared prefix page is never the write target — requests sharing a
+        prefix get fresh private pages from the first non-shared chunk on),
+        so copy-on-write never needs an actual copy.
+
+        Read: gather each row's pages back into logical order
+        (pool[bt[b]] → [max_blocks·page]) and mask positions > cache_idx[b].
+        Unallocated block-table entries point at the reserved trash page 0;
+        their positions are always beyond the row's index, so the mask makes
+        their garbage invisible by the same argument as ``_rewind_cache``."""
+        cfg = self.cfg
+        hd = cfg.head_dim
+        ps = cfg.kv_page_size
+        n_pages = cfg.kv_num_pages
+        if T != 1:
+            raise ValueError(f"paged decode requires T=1 steps, got T={T}")
+        if cache_idx is None or block_tables is None:
+            raise ValueError("paged decode requires cache_idx and block_tables")
+        if n_pages < 2:
+            raise ValueError("kv_num_pages must be >= 2 (page 0 is the trash page)")
+        ck = self.variable("cache", "k", jnp.zeros, (n_pages, ps, cfg.n_kv_heads, hd), q.dtype)
+        cv = self.variable("cache", "v", jnp.zeros, (n_pages, ps, cfg.n_kv_heads, hd), q.dtype)
+        # the contiguous modes' shared scalar write index, kept so the two
+        # cache pytrees stay congruent for gather/scatter; unused here
+        self.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
+        page = jnp.take_along_axis(
+            block_tables, (cache_idx // ps)[:, None], axis=1)[:, 0]  # [B]
+        off = cache_idx % ps
+        if self.is_mutable_collection("cache"):
+            ck.value = ck.value.at[page, off].set(k[:, 0].astype(ck.value.dtype))
+            cv.value = cv.value.at[page, off].set(v[:, 0].astype(cv.value.dtype))
+        S_l = block_tables.shape[1] * ps  # logical context length
+        k_rows = ck.value[block_tables].reshape(B, S_l, cfg.n_kv_heads, hd)
+        v_rows = cv.value[block_tables].reshape(B, S_l, cfg.n_kv_heads, hd)
+        k_all, v_all = repeat_kv(k_rows, v_rows, cfg.n_heads)
+        # [B, 1, 1, S_l]: row b sees exactly its own written prefix
+        valid = (jnp.arange(S_l)[None, :] <= cache_idx[:, None])[:, None, None]
+        out = xla_attention(q, k_all, v_all, mask=valid)
+        out = out.reshape(B, T, cfg.n_heads * hd)
+        return LoRALinear(cfg.d_model, cfg, name="o_proj")(out)
+
 
 class MLP(nn.Module):
     cfg: TransformerConfig
@@ -305,9 +372,10 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, positions: jnp.ndarray,
                  attn_start: Optional[jnp.ndarray] = None,
-                 cache_idx: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 cache_idx: Optional[jnp.ndarray] = None,
+                 block_tables: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.cfg
-        x = x + Attention(cfg, name="attn")(RMSNorm(name="attn_norm")(x), positions, attn_start, cache_idx)
+        x = x + Attention(cfg, name="attn")(RMSNorm(name="attn_norm")(x), positions, attn_start, cache_idx, block_tables)
         h = RMSNorm(name="mlp_norm")(x)
         if cfg.moe_experts > 0:
             from .moe import MoEConfig, MoEMLP
@@ -337,7 +405,8 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens: jnp.ndarray, train: bool = False,
                  positions: Optional[jnp.ndarray] = None,
                  attn_start: Optional[jnp.ndarray] = None,
-                 cache_idx: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 cache_idx: Optional[jnp.ndarray] = None,
+                 block_tables: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model, name="embed")(tokens).astype(cfg.dtype)
         if positions is None:
@@ -353,7 +422,7 @@ class TransformerLM(nn.Module):
                 policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
             block = nn.remat(Block, static_argnums=(), policy=policy)
         for i in range(cfg.n_layers):
-            x = block(cfg, name=f"layer_{i}")(x, positions, attn_start, cache_idx)
+            x = block(cfg, name=f"layer_{i}")(x, positions, attn_start, cache_idx, block_tables)
         x = RMSNorm(name="final_norm")(x)
         # tied-untied head: separate projection (llama style)
         logits = LoRALinear(cfg.vocab_size, cfg, name="lm_head")(x)

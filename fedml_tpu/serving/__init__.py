@@ -1,10 +1,21 @@
 """Model serving (reference: python/fedml/serving/ + model_scheduler/)."""
 
+from .admission import AdmissionController, AdmissionError, TenantPolicy
+from .continuous_batching import (
+    ContinuousBatchingEngine,
+    PagedContinuousBatchingEngine,
+)
 from .endpoint import Endpoint, EndpointManager, ModelCard, ModelDB
 from .fedml_inference_runner import FedMLInferenceRunner
 from .fedml_predictor import FedMLPredictor, JaxPredictor
+from .paged_kv import PagedKVAllocator
 
 __all__ = [
+    "AdmissionController",
+    "AdmissionError",
+    "TenantPolicy",
+    "ContinuousBatchingEngine",
+    "PagedContinuousBatchingEngine",
     "Endpoint",
     "EndpointManager",
     "ModelCard",
@@ -12,4 +23,5 @@ __all__ = [
     "FedMLInferenceRunner",
     "FedMLPredictor",
     "JaxPredictor",
+    "PagedKVAllocator",
 ]

@@ -46,13 +46,27 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import telemetry as tel
-from ..core.telemetry import track_compiles
+from ..core.pipeline.executor import PipelinedExecutor, PipelineError, StageSpec
+from ..core.telemetry import track_compiles, tsdb
 from ..models.transformer import TransformerConfig
 from ..train.llm.generation import (
     _lru_get,
     _prefill_fn,
     _sample,
     decode_model,
+)
+from .admission import DEFAULT_TENANT, AdmissionController, AdmissionError
+from .admission import REASON_QUEUE_FULL, count_reject
+from .paged_kv import (
+    TRASH_PAGE,
+    PagedKVAllocator,
+    _paged_admit_fn,
+    _paged_gather_fn,
+    _paged_step_fn,
+    _suffix_prefill_fn,
+    paged_config,
+    paged_pool_init,
+    row_config,
 )
 
 log = logging.getLogger(__name__)
@@ -168,6 +182,8 @@ class _Pending:
     eos_ids: Optional[Tuple[int, ...]]
     handle: RequestHandle
     t_submit: float
+    tenant: str = "default"
+    wfq_tag: float = 0.0  # weighted-fair-queueing virtual finish tag
 
 
 @dataclasses.dataclass
@@ -176,6 +192,7 @@ class _Active:
     budget: int  # max_new clamped to cache capacity at admit
     tokens: List[int] = dataclasses.field(default_factory=list)
     t_first: float = 0.0
+    generated: int = 0  # device tokens produced, kept OR discarded
 
 
 class ContinuousBatchingEngine:
@@ -205,18 +222,7 @@ class ContinuousBatchingEngine:
         self._C = int(chunk)
         self._max_queue = int(max_queue)
 
-        # slot pool cache: one eager single-token apply yields the exact
-        # pytree the decode step carries ([B, S, kv, hd] per layer + the
-        # scalar index the cache_idx mode ignores)
-        model = decode_model(cfg)
-        _, state = model.apply(
-            {"params": params},
-            jnp.zeros((self._B, 1), jnp.int32),
-            positions=jnp.zeros((self._B, 1), jnp.int32),
-            cache_idx=jnp.zeros((self._B,), jnp.int32),
-            mutable=["cache"],
-        )
-        self._cache = state["cache"]
+        self._cache = self._build_cache()
 
         # per-slot host mirrors (numpy: rebuilt into device arrays per chunk)
         self._slots: List[Optional[_Active]] = [None] * self._B
@@ -242,6 +248,21 @@ class ContinuousBatchingEngine:
         )
         self._worker.start()
 
+    def _build_cache(self):
+        """Slot pool cache: one eager single-token apply yields the exact
+        pytree the decode step carries ([B, S, kv, hd] per layer + the
+        scalar index the cache_idx mode ignores). The paged engine
+        overrides this with the page-pool pytree."""
+        model = decode_model(self._cfg)
+        _, state = model.apply(
+            {"params": self._params},
+            jnp.zeros((self._B, 1), jnp.int32),
+            positions=jnp.zeros((self._B, 1), jnp.int32),
+            cache_idx=jnp.zeros((self._B,), jnp.int32),
+            mutable=["cache"],
+        )
+        return state["cache"]
+
     # -- public API --------------------------------------------------------
 
     def submit(
@@ -252,6 +273,7 @@ class ContinuousBatchingEngine:
         temperature: float = 0.0,
         seed: int = 0,
         eos_id=None,
+        tenant: str = "default",
     ) -> RequestHandle:
         handle = RequestHandle()
         prompt = [int(t) for t in prompt]
@@ -280,19 +302,27 @@ class ContinuousBatchingEngine:
             return handle
         item = _Pending(
             prompt, int(max_new_tokens), float(temperature), int(seed),
-            eos_ids, handle, time.perf_counter(),
+            eos_ids, handle, time.perf_counter(), tenant=str(tenant),
         )
         with self._work:
             if self._stopping:
                 handle._fail(RuntimeError("engine is shutting down"))
                 return handle
             if len(self._queue) >= self._max_queue:
-                handle._fail(RuntimeError("admission queue full"))
+                self._reject_queue_full(item)
                 return handle
+            self._on_enqueue(item)
             self._queue.append(item)
             tel.counter("serving.cb.requests").add(1)
             self._work.notify()
         return handle
+
+    def _reject_queue_full(self, item: _Pending) -> None:
+        item.handle._fail(RuntimeError("admission queue full"))
+
+    def _on_enqueue(self, item: _Pending) -> None:
+        """Hook (called under the lock): the paged engine stamps the WFQ
+        virtual finish tag here."""
 
     def generate(
         self,
@@ -303,10 +333,11 @@ class ContinuousBatchingEngine:
         seed: int = 0,
         eos_id=None,
         timeout: Optional[float] = 600.0,
+        tenant: str = "default",
     ) -> List[int]:
         return self.submit(
             prompt, max_new_tokens, temperature=temperature, seed=seed,
-            eos_id=eos_id,
+            eos_id=eos_id, tenant=tenant,
         ).result(timeout=timeout)
 
     def stats(self) -> dict:
@@ -370,6 +401,7 @@ class ContinuousBatchingEngine:
                     for i, s in enumerate(self._slots):
                         if s is not None:
                             s.pending.handle._fail(err)
+                            self._release_slot(i, s)
                             self._slots[i] = None
                     return
             try:
@@ -384,6 +416,7 @@ class ContinuousBatchingEngine:
                     for i, s in enumerate(self._slots):
                         if s is not None:
                             s.pending.handle._fail(e)
+                            self._release_slot(i, s)
                             self._slots[i] = None
 
     def _admit_all(self) -> None:
@@ -430,7 +463,7 @@ class ContinuousBatchingEngine:
                 continue
             now = time.perf_counter()
             self._cache = cache
-            active = _Active(item, budget, [tok0], now)
+            active = _Active(item, budget, [tok0], now, generated=1)
             self._tok[free] = tok0
             self._lengths[free] = P
             self._temps[free] = item.temperature
@@ -445,16 +478,25 @@ class ContinuousBatchingEngine:
             if self._finish_if_done(free, now):
                 continue
 
+    def _step_fn(self):
+        return _cb_step_fn(self._cfg, self._B, self._C)
+
+    def _step_extra_args(self) -> tuple:
+        """Extra device args between the cache and the token mirrors (the
+        paged engine slips its block tables in here)."""
+        return ()
+
     def _step_chunk(self) -> None:
         with self._lock:
             active_mask = np.asarray(
                 [s is not None for s in self._slots], bool
             )
-        fn = _cb_step_fn(self._cfg, self._B, self._C)
+        fn = self._step_fn()
         with tel.timed("serving.cb.chunk", slots=int(active_mask.sum())):
             cache, tok, lengths, keys, toks = fn(
                 self._params,
                 self._cache,
+                *self._step_extra_args(),
                 jnp.asarray(self._tok),
                 jnp.asarray(self._lengths),
                 jnp.asarray(self._keys),
@@ -476,6 +518,7 @@ class ContinuousBatchingEngine:
                 s = self._slots[b]
             if s is None:
                 continue
+            s.generated += self._C
             for t in toks[b]:
                 t = int(t)
                 s.tokens.append(t)
@@ -506,9 +549,406 @@ class ContinuousBatchingEngine:
             s.pending.handle.tpot_s = tpot
             self._recent_tpot.append(tpot)
             tel.histogram("serving.cb.tpot_seconds").observe(tpot)
+        # EOS/budget mid-chunk waste, measured instead of silent: the slot
+        # kept burning decode FLOPs until the chunk boundary; the paged
+        # engine also reclaims the request's KV pages here (_release_slot)
+        wasted = s.generated - len(s.tokens)
+        if wasted > 0:
+            tel.counter("serving.wasted_tokens").add(wasted)
+        self._release_slot(b, s)
         with self._lock:
             self._slots[b] = None
             self._requests_done += 1
             self._tokens_out += len(s.tokens)
         s.pending.handle._finish(s.tokens)
         return True
+
+    def _release_slot(self, b: int, s: _Active) -> None:
+        """Hook: free per-slot resources at the chunk boundary where the
+        host learns the request is done. The contiguous engine has nothing
+        to free (the row is overwritten wholesale on re-admission)."""
+
+
+# ---------------------------------------------------------------------------
+# paged engine: block-table KV over a shared page pool (serving/paged_kv.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _AdmitWork:
+    """One request moving through the prefill -> transfer -> admit pipeline
+    (created by ``_collect_wave`` holding its slot + page reservations)."""
+
+    item: _Pending
+    slot: int
+    budget: int
+    n_shared: int             # leading blocks served from the prefix cache
+    shared_pages: List[int]   # one reference held per page
+    private_pages: List[int]  # one reference held per page
+    row_cache: object = None
+    first_vec: object = None  # [vocab] logits for the first sampled token
+    tok0: int = 0
+    key2: object = None
+    admitted: bool = False
+
+
+class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
+    """Continuous batching over a PAGED KV cache (see serving/paged_kv.py).
+
+    Same public surface as :class:`ContinuousBatchingEngine` plus:
+
+    - HBM scales with admitted tokens, not ``num_slots * max_seq_len``:
+      each request reserves ``ceil((prompt + budget) / page_size)`` pages
+      at admit (reservation up front means decode never OOMs mid-flight),
+      and requests sharing a hash-consed prompt prefix map the same
+      physical pages;
+    - admission runs as a prefill -> transfer -> admit
+      :class:`PipelinedExecutor` wave, so request i+1's prefill overlaps
+      request i's pool scatter — the PiPar overlap principle applied to
+      the serving front door (a long prompt never serializes admissions,
+      and in the disaggregated topology the transfer stage is the
+      prefill-pool -> decode-pool page handoff);
+    - a finished request's pages are reclaimed at the chunk boundary
+      where the host learns about EOS (``serving.wasted_tokens`` counts
+      the discarded mid-chunk tail);
+    - an optional :class:`AdmissionController` gates the front door:
+      submit-time token budgets + shed, dequeue-time weighted fair
+      queueing + SLO-pressure deferral (serving/admission.py).
+    """
+
+    def __init__(
+        self,
+        params,
+        cfg: TransformerConfig,
+        *,
+        num_slots: int = 8,
+        chunk: int = 8,
+        page_size: int = 16,
+        num_pages: Optional[int] = None,
+        watermark_frac: float = 0.05,
+        max_queue: int = 4096,
+        admission: Optional[AdmissionController] = None,
+    ):
+        base = row_config(cfg)
+        if num_pages is None:
+            # drop-in default: same KV capacity as the slot engine (+trash);
+            # deployments shrink this to realize the HBM win (bench does)
+            num_pages = num_slots * (base.max_seq_len // page_size) + 1
+        self._paged_cfg = paged_config(
+            base, page_size=page_size, num_pages=num_pages)
+        self._ps = int(page_size)
+        self._n_blocks = base.max_seq_len // self._ps
+        self._alloc = PagedKVAllocator(
+            num_pages, page_size, watermark_frac=watermark_frac)
+        self._admission = admission
+        self._tables = np.full((num_slots, self._n_blocks), TRASH_PAGE,
+                               np.int32)
+        self._tenant_ttft: dict = {}
+        super().__init__(params, base, num_slots=num_slots, chunk=chunk,
+                         max_queue=max_queue)
+
+    # -- cache + step wiring ------------------------------------------------
+
+    def _build_cache(self):
+        return paged_pool_init(self._params, self._paged_cfg, self._B)
+
+    def _step_fn(self):
+        return _paged_step_fn(self._paged_cfg, self._B, self._C)
+
+    def _step_extra_args(self) -> tuple:
+        return (jnp.asarray(self._tables),)
+
+    # -- admission-gated submit ---------------------------------------------
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        seed: int = 0,
+        eos_id=None,
+        tenant: str = DEFAULT_TENANT,
+    ) -> RequestHandle:
+        if self._admission is not None:
+            prompt = [int(t) for t in prompt]
+            reason = self._admission.check(
+                tenant, len(prompt) + int(max_new_tokens))
+            if reason is not None:
+                handle = RequestHandle()
+                handle._fail(AdmissionError(tenant, reason))
+                return handle
+        return super().submit(
+            prompt, max_new_tokens, temperature=temperature, seed=seed,
+            eos_id=eos_id, tenant=tenant)
+
+    def _on_enqueue(self, item: _Pending) -> None:
+        if self._admission is not None:
+            item.wfq_tag = self._admission.stamp(
+                item.tenant, len(item.prompt) + item.max_new)
+
+    def _reject_queue_full(self, item: _Pending) -> None:
+        count_reject(item.tenant, REASON_QUEUE_FULL)
+        item.handle._fail(AdmissionError(item.tenant, REASON_QUEUE_FULL))
+
+    # -- pipelined admission ------------------------------------------------
+
+    def _admit_all(self) -> None:
+        while True:
+            wave = self._collect_wave()
+            if not wave:
+                with self._lock:
+                    starved = (bool(self._queue)
+                               and all(s is None for s in self._slots))
+                if starved:
+                    # every queued tenant is deferred (or the pool is
+                    # draining) and nothing is in flight: don't spin the
+                    # worker loop hot while backpressure holds
+                    time.sleep(0.005)  # fedlint: disable=bare-sleep backpressure idle, not a retry
+                return
+            with tel.timed("serving.paged.admit_wave", n=len(wave)):
+                self._run_wave(wave)
+
+    def _pick_locked(self) -> Optional[_Pending]:
+        """Next request to admit (caller holds the engine lock): FIFO
+        without a controller, else the smallest WFQ virtual-finish tag
+        among tenants that are not deferred. O(queue) per admission — the
+        deep 10k-stream backlog lives in the bench driver, not here."""
+        if self._admission is None:
+            return self._queue.popleft() if self._queue else None
+        best = None
+        eligible_cache: dict = {}
+        for item in self._queue:
+            ok = eligible_cache.get(item.tenant)
+            if ok is None:
+                ok = self._admission.eligible(item.tenant)
+                eligible_cache[item.tenant] = ok
+            if ok and (best is None or item.wfq_tag < best.wfq_tag):
+                best = item
+        if best is None:
+            return None
+        self._queue.remove(best)
+        self._admission.on_dequeue(best.wfq_tag)
+        return best
+
+    def _collect_wave(self) -> List[_AdmitWork]:
+        cfg = self._cfg
+        wave: List[_AdmitWork] = []
+        taken: set = set()
+        while True:
+            with self._lock:
+                free = next((i for i, s in enumerate(self._slots)
+                             if s is None and i not in taken), None)
+                if free is None or not self._queue:
+                    return wave
+                item = self._pick_locked()
+            if item is None:  # every queued tenant is deferred right now
+                return wave
+            P = len(item.prompt)
+            budget = min(item.max_new, cfg.max_seq_len - P)
+            n_req = -(-(P + budget) // self._ps)
+            shared = self._alloc.match_prefix(item.prompt)
+            # never map the block holding the prompt's LAST token from the
+            # prefix cache: the suffix pass needs >= 1 real token for the
+            # first-logits read, and when P is page-aligned decode writes
+            # begin in exactly that block (shared pages are never written)
+            n_shared_max = (P - 1) // self._ps
+            if len(shared) > n_shared_max:
+                self._alloc.free(shared[n_shared_max:])
+                shared = shared[:n_shared_max]
+            private = self._alloc.alloc(n_req - len(shared))
+            if private is None:
+                self._alloc.free(shared)
+                with self._lock:
+                    busy = any(s is not None for s in self._slots)
+                    if busy or wave:
+                        # pages free as in-flight requests finish: defer
+                        self._queue.appendleft(item)
+                        return wave
+                # nothing in flight, nothing admitted, eviction already
+                # tried: this request can never fit — fail it, not the pool
+                item.handle._fail(RuntimeError(
+                    f"prompt {P} + budget {budget} needs "
+                    f"{n_req - len(shared)} KV pages; the pool cannot free "
+                    "enough (raise num_pages or lower max_new_tokens)"))
+                continue
+            wave.append(_AdmitWork(item, free, budget, len(shared),
+                                   shared, private))
+            taken.add(free)
+
+    def _run_wave(self, wave: List[_AdmitWork]) -> None:
+        pipe = PipelinedExecutor(
+            [StageSpec("prefill", self._stage_prefill, maxsize=2),
+             StageSpec("transfer", self._stage_transfer, maxsize=2),
+             StageSpec("admit", self._stage_admit, maxsize=2)],
+            name="paged_admit")
+        try:
+            pipe.run(wave)
+        except PipelineError as e:
+            # fail the riders that never reached the admit stage and return
+            # their reservations; admitted riders keep decoding untouched
+            log.exception("paged admission wave failed")
+            for w in wave:
+                if w.admitted:
+                    continue
+                self._alloc.free(w.shared_pages + w.private_pages)
+                w.item.handle._fail(e)
+
+    def _stage_prefill(self, w: _AdmitWork) -> _AdmitWork:
+        """Stage 1: produce a contiguous row cache + first-token logits —
+        a full bucketed prefill on a prefix MISS, or gather-shared-pages +
+        one suffix pass on a HIT (the prefix compute skip)."""
+        cfg = self._cfg
+        item = w.item
+        P = len(item.prompt)
+        prefix_len = w.n_shared * self._ps
+        with tel.timed("serving.cb.prefill", prompt_len=P, shared=prefix_len):
+            if w.n_shared == 0:
+                P_b = min(-(-P // 16) * 16, cfg.max_seq_len)
+                ids = jnp.asarray([item.prompt], jnp.int32)
+                padded = (jnp.pad(ids, ((0, 0), (0, P_b - P)))
+                          if P_b != P else ids)
+                row_cache, first = _prefill_fn(cfg, 1, P_b)(
+                    self._params, padded, jnp.int32(P))
+                w.first_vec = first[0]
+            else:
+                table = np.full((self._n_blocks,), TRASH_PAGE, np.int32)
+                table[:w.n_shared] = w.shared_pages
+                # the pool object is swapped functionally by the transfer
+                # stage; shared pages are never rewritten, so reading a
+                # one-wave-stale pool binding here is still exact
+                row_cache = _paged_gather_fn(self._paged_cfg)(
+                    self._cache, jnp.asarray(table), jnp.int32(prefix_len))
+                suffix = item.prompt[prefix_len:]
+                T_suf = len(suffix)
+                T_b = min(-(-T_suf // 16) * 16, cfg.max_seq_len - prefix_len)
+                ids = jnp.asarray([suffix + [0] * (T_b - T_suf)], jnp.int32)
+                row_cache, w.first_vec = _suffix_prefill_fn(
+                    self._paged_cfg, T_b)(
+                    self._params, row_cache, ids, jnp.int32(prefix_len),
+                    jnp.int32(P))
+        w.row_cache = row_cache
+        return w
+
+    def _stage_transfer(self, w: _AdmitWork) -> _AdmitWork:
+        """Stage 2: scatter the row's PROMPT blocks into the request's
+        private pages (shared blocks stay untouched behind TRASH write
+        ids) and sample the first token. This is the page handoff — in the
+        disaggregated topology it is the only stage that touches the
+        decode pool."""
+        item = w.item
+        P = len(item.prompt)
+        write_ids = np.full((self._n_blocks,), TRASH_PAGE, np.int32)
+        first_blk = w.n_shared
+        last_blk = -(-P // self._ps)  # exclusive: block of the last token
+        write_ids[first_blk:last_blk] = w.private_pages[:last_blk - first_blk]
+        pool, tok0, key2 = _paged_admit_fn(self._paged_cfg)(
+            self._cache, w.row_cache, jnp.asarray(write_ids), w.first_vec,
+            jax.random.PRNGKey(item.seed), jnp.float32(item.temperature))
+        self._cache = pool
+        w.tok0 = int(np.asarray(tok0))  # fedlint: disable=host-sync forces transfer completion: one sync per admission, not per decode step
+        w.key2 = np.asarray(key2, np.uint32)
+        return w
+
+    def _stage_admit(self, w: _AdmitWork) -> _AdmitWork:
+        """Stage 3: host bookkeeping — publish the block table, mirrors,
+        and the slot; register the prompt's full chunks in the prefix
+        cache so the NEXT request with this system prompt shares pages."""
+        item = w.item
+        b = w.slot
+        now = time.perf_counter()
+        table = np.full((self._n_blocks,), TRASH_PAGE, np.int32)
+        n_own = w.n_shared + len(w.private_pages)
+        table[:w.n_shared] = w.shared_pages
+        table[w.n_shared:n_own] = w.private_pages
+        self._tok[b] = w.tok0
+        self._lengths[b] = len(item.prompt)
+        self._temps[b] = item.temperature
+        self._keys[b] = w.key2
+        self._tables[b] = table
+        ttft = now - item.t_submit
+        item.handle.ttft_s = ttft
+        self._recent_ttft.append(ttft)
+        tel.histogram("serving.cb.ttft_seconds").observe(ttft)
+        tel.counter("serving.cb.admissions").add(1)
+        self._observe_tenant_ttft(item.tenant, ttft)
+        n_prompt_blocks = len(item.prompt) // self._ps  # FULL chunks only
+        self._alloc.register_prefix(
+            item.prompt, [int(p) for p in table[:n_prompt_blocks]])
+        with self._lock:
+            self._slots[b] = _Active(item, w.budget, [w.tok0], now,
+                                     generated=1)
+        w.admitted = True
+        self._finish_if_done(b, now)
+        return w
+
+    # -- page reclamation ---------------------------------------------------
+
+    def _release_slot(self, b: int, s: _Active) -> None:
+        """Chunk-boundary reclamation: drop the request's reference on
+        every page its table maps and point the row at the trash page so
+        the slot's remaining mid-chunk scatters can't touch reused pages."""
+        pages = [int(p) for p in self._tables[b] if p != TRASH_PAGE]
+        self._tables[b, :] = TRASH_PAGE
+        if pages:
+            self._alloc.free(pages)
+
+    def _observe_tenant_ttft(self, tenant: str, ttft: float) -> None:
+        dq = self._tenant_ttft.get(tenant)
+        if dq is None:
+            dq = self._tenant_ttft.setdefault(
+                tenant, collections.deque(maxlen=1024))
+        dq.append(ttft)
+        store = tsdb.active()
+        if store is not None:
+            # per-tenant TTFT history: the tenant-isolation drill pins a
+            # victim tenant's SLO to this series
+            store.record_observation(
+                "serving.tenant.ttft_seconds." + tenant, ttft)
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        out = super().stats()
+        a = self._alloc.stats()
+        with self._lock:
+            live = int(sum(int(self._lengths[i])
+                           for i, s in enumerate(self._slots)
+                           if s is not None))
+        pages_used = a["kv_pages_total"] - a["kv_pages_free"]
+        out.update(a)
+        out.update({
+            "kv_page_size": self._ps,
+            "kv_pages_in_use": pages_used,
+            "kv_tokens_live": live,
+            # pages per live token: the bench's HBM-efficiency headline
+            # (multiply by page bytes for bytes/token; the slot engine's
+            # analogue is slots*max_seq_len/live, always >= paged's)
+            "kv_pages_per_token": pages_used / live if live else 0.0,
+        })
+        if self._admission is not None:
+            out["admission"] = self._admission.stats()
+        return out
+
+    def prom_gauges(self) -> list:
+        """(name, labels, value) ride-along triples for /metrics."""
+        out = []
+        st = self._alloc.stats()
+        out.append(("serving_kv_pages", {"state": "free"},
+                    float(st["kv_pages_free"])))
+        out.append(("serving_kv_pages", {"state": "used"},
+                    float(st["kv_pages_total"] - st["kv_pages_free"])))
+        out.append(("serving_kv_pages", {"state": "watermark"},
+                    float(st["kv_watermark_pages"])))
+        out.append(("serving_kv_prefix_nodes", None,
+                    float(st["kv_prefix_nodes"])))
+        with self._lock:
+            tenants = [(t, sorted(dq)) for t, dq in self._tenant_ttft.items()
+                       if dq]
+        for t, xs in tenants:
+            p99 = xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+            out.append(("serving_tenant_ttft_p99_seconds", {"tenant": t},
+                        float(p99)))
+        if self._admission is not None:
+            out.extend(self._admission.prom_gauges())
+        return out

@@ -69,9 +69,10 @@ class _ReplicaClient:
     full TCP handshake on every predict, and at continuous-batching
     concurrency (hundreds of parked streams) ephemeral-port churn."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, pool: str = "decode"):
         self.host = host
         self.port = port
+        self.pool = pool  # "prefill" | "decode" routing class
         self.in_flight = 0  # mutated under the owning Endpoint's lock
         self._pool: List[http.client.HTTPConnection] = []
         self._lock = threading.Lock()
@@ -108,11 +109,24 @@ class _ReplicaClient:
 
 
 class Endpoint:
-    """N replicas + least-in-flight keep-alive gateway."""
+    """N replicas + least-in-flight keep-alive gateway.
 
-    def __init__(self, name: str, predictor_factory: Callable[[], FedMLPredictor], num_replicas: int = 1):
+    With ``prefill_replicas > 0`` the endpoint runs DISAGGREGATED: that
+    many replicas form the *prefill* pool and the rest the *decode* pool.
+    Long-prompt and cache-warming traffic routes to the prefill pool, so
+    a burst of cold multi-kilobyte prompts never queues ahead of decode
+    steps on the replicas serving interactive TPOT (the prefill pool's
+    page output reaches decode through the engine's transfer stage — see
+    ``PagedContinuousBatchingEngine``; a multi-chip deployment splices
+    the ICI/DCN page copy exactly there)."""
+
+    def __init__(self, name: str, predictor_factory: Callable[[], FedMLPredictor],
+                 num_replicas: int = 1, *, prefill_replicas: int = 0,
+                 prefill_cutoff_chars: int = 2048):
         self.name = name
         self.predictor_factory = predictor_factory
+        self.prefill_replicas = int(prefill_replicas)
+        self.prefill_cutoff_chars = int(prefill_cutoff_chars)
         self.replicas: List[FedMLInferenceRunner] = []
         self._clients: List[_ReplicaClient] = []
         self._rr = itertools.count()
@@ -122,11 +136,16 @@ class Endpoint:
     def scale_to(self, n: int) -> None:
         with self._lock:
             while len(self.replicas) < n:
+                # the first prefill_replicas replicas form the prefill pool
+                pool = ("prefill" if len(self.replicas) < self.prefill_replicas
+                        else "decode")
                 runner = FedMLInferenceRunner(self.predictor_factory(), port=0)
                 runner.start()
                 self.replicas.append(runner)
-                self._clients.append(_ReplicaClient(runner.host, runner.port))
-                log.info("endpoint %s: replica up on port %d", self.name, runner.port)
+                self._clients.append(
+                    _ReplicaClient(runner.host, runner.port, pool=pool))
+                log.info("endpoint %s: %s replica up on port %d",
+                         self.name, pool, runner.port)
             while len(self.replicas) > n:
                 runner = self.replicas.pop()
                 client = self._clients.pop()
@@ -146,6 +165,27 @@ class Endpoint:
         with self._lock:
             return [c.in_flight for c in self._clients]
 
+    def pools(self) -> Dict[str, List[int]]:
+        """Per-pool in-flight counts (observability/tests)."""
+        with self._lock:
+            out: Dict[str, List[int]] = {}
+            for c in self._clients:
+                out.setdefault(c.pool, []).append(c.in_flight)
+            return out
+
+    def _route_pool(self, payload: Dict[str, Any]) -> str:
+        """Which pool should serve this request? Explicit ``pool`` wins;
+        cache-warming (``prefill_only``) and prompts past the cutoff are
+        prefill-heavy work; everything else is decode-bound."""
+        pool = payload.get("pool")
+        if pool in ("prefill", "decode"):
+            return pool
+        if payload.get("prefill_only"):
+            return "prefill"
+        if len(str(payload.get("prompt", ""))) >= self.prefill_cutoff_chars:
+            return "prefill"
+        return "decode"
+
     def predict(self, payload: Dict[str, Any], timeout_s: float = 30.0) -> Dict[str, Any]:
         """Gateway: forward to the LEAST-IN-FLIGHT replica over a keep-alive
         connection (reference device_model_inference.py forwards to the
@@ -153,12 +193,16 @@ class Endpoint:
         replicas run continuous batching: a round-robin gateway keeps
         feeding a replica whose slots are saturated while another sits
         idle — queue depth, not arrival order, is the real load signal.
-        Ties rotate round-robin so idle replicas still share warm-up."""
+        Ties rotate round-robin so idle replicas still share warm-up.
+        Routing is POOL-AWARE: candidates come from the request's pool
+        (``_route_pool``); a pool with no replicas falls back to all."""
+        want = self._route_pool(payload)
         with self._lock:
             if not self.replicas:
                 raise RuntimeError(f"endpoint {self.name} has no replicas")
-            low = min(c.in_flight for c in self._clients)
-            candidates = [c for c in self._clients if c.in_flight == low]
+            pool = [c for c in self._clients if c.pool == want] or self._clients
+            low = min(c.in_flight for c in pool)
+            candidates = [c for c in pool if c.in_flight == low]
             client = candidates[next(self._rr) % len(candidates)]
             client.in_flight += 1
         try:

@@ -20,6 +20,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
 from ..core.telemetry import prom, slo, statusz
+from .admission import AdmissionError
 from .fedml_predictor import FedMLPredictor
 
 log = logging.getLogger(__name__)
@@ -190,6 +191,12 @@ class FedMLInferenceRunner:
                             ("serving_cb_slot_occupancy", None, float(st["slot_occupancy"])),
                             ("serving_cb_queue_depth", None, float(st["queue_depth"])),
                         ]
+                        # paged engines export more: KV page occupancy,
+                        # prefix-cache size, per-tenant TTFT p99, admission
+                        # burn/usage/budget (serving_kv_* / serving_tenant_*)
+                        extra = getattr(engine, "prom_gauges", None)
+                        if extra is not None:
+                            gauges += extra()
                     body = prom.render(gauges=gauges).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", prom.CONTENT_TYPE)
@@ -229,6 +236,14 @@ class FedMLInferenceRunner:
                     if asyncio.iscoroutine(resp):
                         resp = asyncio.run(resp)
                     self._send_json(resp)
+                except AdmissionError as e:
+                    # shed at the front door (budget / SLO pressure /
+                    # queue full): 429 tells the client to back off —
+                    # this is policy working, not a server fault, so no
+                    # error log and no 500
+                    self._send_json(
+                        {"error": "admission_rejected", "tenant": e.tenant,
+                         "reason": e.reason}, code=429)
                 except Exception as e:  # noqa: BLE001 - request boundary
                     log.exception("predict failed")
                     self._send_json({"error": repr(e)}, code=500)

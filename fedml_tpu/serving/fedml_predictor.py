@@ -75,7 +75,11 @@ class LLMPredictor(FedMLPredictor):
                  eos_id: "int | tuple | None" = None,
                  continuous: Optional[bool] = None,
                  num_slots: Optional[int] = None,
-                 decode_chunk: Optional[int] = None):
+                 decode_chunk: Optional[int] = None,
+                 paged: Optional[bool] = None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 admission=None):
         import os
 
         self._params = params
@@ -88,23 +92,43 @@ class LLMPredictor(FedMLPredictor):
             tokenizer, "special_tokens", {}
         ).get("</s>")
         self._ready = True  # flips False->True around warmup() when used
+        # pool role under disaggregated serving (DisaggregatedReplicaSet
+        # children get FEDML_SERVE_ROLE=prefill|decode): prefill replicas
+        # exist to absorb cold long prompts + cache warming
+        self.role = os.environ.get("FEDML_SERVE_ROLE", "mixed")
         # continuous batching (serving/continuous_batching.py): requests
         # stream through a slotted decode engine instead of the window
         # micro-batcher. Explicit arg wins; env seam lets subprocess
         # replicas opt in without code changes.
         if continuous is None:
             continuous = os.environ.get("FEDML_SERVE_CONTINUOUS", "0") not in ("0", "", "false")
+        if paged is None:
+            paged = os.environ.get("FEDML_SERVE_PAGED", "0") not in ("0", "", "false")
         self.engine = None
-        if continuous:
-            from .continuous_batching import ContinuousBatchingEngine
+        if continuous or paged:
+            slots = int(num_slots if num_slots is not None
+                        else os.environ.get("FEDML_SERVE_SLOTS", "8"))
+            chunk = int(decode_chunk if decode_chunk is not None
+                        else os.environ.get("FEDML_SERVE_CHUNK", "8"))
+            max_queue = int(os.environ.get("FEDML_SERVE_MAX_QUEUE", "4096"))
+            if paged:
+                from .continuous_batching import PagedContinuousBatchingEngine
 
-            self.engine = ContinuousBatchingEngine(
-                params, cfg,
-                num_slots=int(num_slots if num_slots is not None
-                              else os.environ.get("FEDML_SERVE_SLOTS", "8")),
-                chunk=int(decode_chunk if decode_chunk is not None
-                          else os.environ.get("FEDML_SERVE_CHUNK", "8")),
-            )
+                ps = int(page_size if page_size is not None
+                         else os.environ.get("FEDML_SERVE_PAGE_SIZE", "16"))
+                np_env = os.environ.get("FEDML_SERVE_KV_PAGES")
+                pages = (int(num_pages) if num_pages is not None
+                         else int(np_env) if np_env else None)
+                self.engine = PagedContinuousBatchingEngine(
+                    params, cfg, num_slots=slots, chunk=chunk,
+                    page_size=ps, num_pages=pages, max_queue=max_queue,
+                    admission=admission)
+            else:
+                from .continuous_batching import ContinuousBatchingEngine
+
+                self.engine = ContinuousBatchingEngine(
+                    params, cfg, num_slots=slots, chunk=chunk,
+                    max_queue=max_queue)
 
     @classmethod
     def from_checkpoint(cls, path: str, quantize: str = "none", **kw) -> "LLMPredictor":
@@ -157,16 +181,26 @@ class LLMPredictor(FedMLPredictor):
         from ..train.llm.generation import generate_text
 
         if self.engine is not None:
+            prompt_ids = self._tok.encode(str(request["prompt"]))
+            tenant = str(request.get("tenant", "default"))
+            if request.get("prefill_only"):
+                # cache warming (prefill-pool traffic): one decoded token
+                # forces the full prefill, and the paged engine registers
+                # the prompt's chunks in its prefix cache on admit — later
+                # requests sharing this prefix skip its compute + pages
+                self.engine.generate(prompt_ids, 1, tenant=tenant)
+                return {"warmed": True, "prompt_tokens": len(prompt_ids)}
             # continuous mode: this thread just parks on its future; the
             # engine's worker interleaves every in-flight request through
             # one always-running decode step (ThreadingHTTPServer gives a
             # thread per connection, so concurrency comes for free)
             toks = self.engine.generate(
-                self._tok.encode(str(request["prompt"])),
+                prompt_ids,
                 int(request.get("max_new_tokens", self._max_new)),
                 temperature=float(request.get("temperature", 0.0)),
                 seed=int(request.get("seed", 0)),
                 eos_id=self._eos_id,
+                tenant=tenant,
             )
             return {"text": self._tok.decode([int(t) for t in toks])}
         text = generate_text(

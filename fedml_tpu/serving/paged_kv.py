@@ -1,0 +1,428 @@
+"""Paged KV cache: a block-pool allocator + the paged decode executables.
+
+PR 6's engine provisions every slot a full [max_seq_len] KV row, so HBM is
+sized for the worst-case sequence times ``num_slots`` and common system
+prompts are stored once PER REQUEST. This module replaces the row pool
+with a vLLM-style page pool:
+
+- the physical cache is [kv_num_pages, kv_page_size, kv, hd] per layer —
+  ONE pool shared by every in-flight request; page 0 is a reserved trash
+  page (never allocated) so unowned block-table entries have a harmless
+  scatter/gather target;
+- each request owns a BLOCK TABLE (host list of page ids, padded with 0)
+  mapping logical position ``l`` to page ``table[l // page_size]``; the
+  tables ride the decode step as RUNTIME data (``_paged_step_fn``), so one
+  executable per (cfg, B, C) serves every admission mix — zero retrace,
+  pinned by ``track_compiles("paged_step")``;
+- pages are REFCOUNTED and prompt prefixes are hash-consed on token-chunk
+  (page) boundaries: requests sharing a system prompt map the same
+  physical pages. Shared pages are mapped copy-on-write in the degenerate
+  sense that a copy is never needed — only FULL prompt chunks are
+  registered, so the first writable position (the prompt tail / decode
+  stream) always lands in a page with refcount 1;
+- a free-list allocator with an admission watermark: when free pages run
+  low, LRU prefix retentions are evicted first, and admission defers (the
+  request stays queued) rather than corrupt in-flight decode. Occupancy,
+  watermark, and hit/eviction counts are exported to telemetry.
+
+Prefill reuses the contiguous executables (`generation._prefill_fn`) at
+B=1 and scatters the finished row into pages (``_paged_admit_fn``). A
+prefix HIT skips recomputing the shared prompt: gather the shared pages
+back into a contiguous row (``_paged_gather_fn``), rewind the write index
+to the shared length, and run one multi-token decode-mode pass over just
+the suffix (``_suffix_prefill_fn``) — the transformer's scalar-index
+branch already supports a runtime start position, so suffix lengths share
+16-token-bucketed executables exactly like fresh prefills.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import telemetry as tel
+from ..core.telemetry import track_compiles
+from ..models.transformer import TransformerConfig
+from ..train.llm.generation import _lru_get, _rewind_cache, _sample, decode_model
+
+#: reserved trash page: scatter target for every unowned block-table entry
+TRASH_PAGE = 0
+
+
+def paged_config(cfg: TransformerConfig, *, page_size: int,
+                 num_pages: int) -> TransformerConfig:
+    """The paged-decode twin of a config (same params)."""
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    if cfg.max_seq_len % page_size != 0:
+        raise ValueError(
+            f"max_seq_len {cfg.max_seq_len} must be a multiple of "
+            f"page_size {page_size} (block tables cover whole pages)")
+    if num_pages < 2:
+        raise ValueError(
+            f"num_pages must be >= 2 (page {TRASH_PAGE} is reserved trash), "
+            f"got {num_pages}")
+    return dataclasses.replace(
+        cfg, kv_page_size=int(page_size), kv_num_pages=int(num_pages))
+
+
+def row_config(cfg: TransformerConfig) -> TransformerConfig:
+    """The contiguous (per-row cache) twin of a paged config — prefill and
+    suffix-prefill run here, then scatter into the pool."""
+    return dataclasses.replace(cfg, kv_page_size=0, kv_num_pages=0)
+
+
+def _num_blocks(cfg: TransformerConfig) -> int:
+    return cfg.max_seq_len // cfg.kv_page_size
+
+
+def paged_pool_init(params, cfg: TransformerConfig, B: int):
+    """Materialize the empty page-pool cache pytree (one eager apply, the
+    same trick the slot engine uses for its row pool)."""
+    model = decode_model(cfg)
+    _, state = model.apply(
+        {"params": params},
+        jnp.zeros((B, 1), jnp.int32),
+        positions=jnp.zeros((B, 1), jnp.int32),
+        cache_idx=jnp.zeros((B,), jnp.int32),
+        block_tables=jnp.zeros((B, _num_blocks(cfg)), jnp.int32),
+        mutable=["cache"],
+    )
+    return state["cache"]
+
+
+def _paged_admit_fn(cfg: TransformerConfig):
+    """Scatter one finished contiguous row cache into the pool at runtime
+    page ids and sample the request's first token. ``write_ids`` has one
+    entry per logical block; blocks the request does NOT own (shared
+    prefix pages, unallocated tail) carry TRASH_PAGE, so duplicate scatter
+    indices only ever clobber the trash page."""
+    n_blocks = _num_blocks(cfg)
+    ps = cfg.kv_page_size
+
+    def build():
+        def run(pool, row_cache, write_ids, first_logits, key, temp):
+            def insert(dst, src):
+                if dst.ndim == 0:
+                    return dst  # scalar write index: meaningless for pools
+                pages = src[0].reshape((n_blocks, ps) + src.shape[2:])
+                return dst.at[write_ids].set(pages.astype(dst.dtype))
+
+            new_pool = jax.tree_util.tree_map(insert, pool, row_cache)
+            key2, sub = jax.random.split(key)
+            tok0 = _sample(first_logits, sub, temp)
+            return new_pool, tok0, key2
+
+        return jax.jit(track_compiles(run, name="paged_admit"))
+
+    return _lru_get(("paged_admit", cfg), build)
+
+
+def _paged_gather_fn(cfg: TransformerConfig):
+    """Gather one request's pages back into a contiguous [1, S, kv, hd] row
+    (the suffix-prefill staging buffer), write index rewound to the shared
+    prefix length. Blocks beyond the prefix point at the trash page; their
+    garbage is overwritten by the suffix pass before any query can attend
+    to it (the ``_rewind_cache`` argument)."""
+    ps = cfg.kv_page_size
+
+    def build():
+        def run(pool, block_table, prefix_len):
+            def gather(leaf):
+                if leaf.ndim == 0:
+                    return leaf
+                pages = leaf[block_table]  # [n_blocks, ps, kv, hd]
+                return pages.reshape((1, pages.shape[0] * ps) + leaf.shape[2:])
+
+            row = jax.tree_util.tree_map(gather, pool)
+            return _rewind_cache(row, prefix_len)
+
+        return jax.jit(track_compiles(run, name="paged_gather"))
+
+    return _lru_get(("paged_gather", cfg), build)
+
+
+def _suffix_prefill_fn(cfg: TransformerConfig, T_b: int):
+    """One multi-token decode-mode pass over just the SUFFIX of a prompt
+    whose prefix pages were served from the prefix cache — the compute
+    skip that makes prefix sharing a TTFT win, not only an HBM win.
+    Compiled per 16-token suffix bucket; the start position (shared
+    prefix length) is a runtime value via the cache's rewound index."""
+
+    def build():
+        model = decode_model(row_config(cfg))
+
+        def run(params, row_cache, suffix_padded, prefix_len, true_total):
+            positions = prefix_len + jnp.arange(T_b)[None, :]
+            logits, state = model.apply(
+                {"params": params, "cache": row_cache},
+                suffix_padded,
+                positions=positions,
+                mutable=["cache"],
+            )
+            first = logits[0, true_total - prefix_len - 1]
+            return _rewind_cache(state["cache"], true_total), first
+
+        return jax.jit(track_compiles(run, name="paged_suffix_prefill"))
+
+    return _lru_get(("paged_suffix", cfg, T_b), build)
+
+
+def _paged_step_fn(cfg: TransformerConfig, B: int, C: int):
+    """The paged engine's one hot executable: C single-token steps over all
+    B rows, addressing the shared page pool through runtime block tables.
+    Identical control structure to ``_cb_step_fn``; the cache argument is
+    the POOL (page-count-sized, not B-sized), so HBM scales with admitted
+    tokens instead of worst-case rows."""
+
+    def build():
+        model = decode_model(cfg)
+        S = cfg.max_seq_len
+
+        def run(params, pool, block_tables, tok, lengths, keys, temps, active):
+            def step(carry, _):
+                pool, tok, lengths, keys = carry
+                split = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+                keys2, subs = split[:, 0], split[:, 1]
+                # clamp: a row past its budget (mid-chunk EOS / inactive)
+                # scatters into whatever its table maps there — the trash
+                # page for unowned blocks — instead of out of bounds
+                idx = jnp.minimum(lengths, S - 1)
+                logits, state = model.apply(
+                    {"params": params, "cache": pool},
+                    tok[:, None],
+                    positions=idx[:, None],
+                    cache_idx=idx,
+                    block_tables=block_tables,
+                    mutable=["cache"],
+                )
+                nxt = jax.vmap(_sample)(logits[:, -1], subs, temps)
+                nxt = jnp.where(active, nxt, 0)
+                lengths = lengths + active.astype(jnp.int32)
+                return (state["cache"], nxt, lengths, keys2), nxt
+
+            (pool, tok, lengths, keys), toks = jax.lax.scan(
+                step, (pool, tok, lengths, keys), None, length=C
+            )
+            return pool, tok, lengths, keys, toks.swapaxes(0, 1)  # [B, C]
+
+        donate = (1,) if jax.default_backend() == "tpu" else ()
+        return jax.jit(track_compiles(run, name="paged_step"),
+                       donate_argnums=donate)
+
+    return _lru_get(("paged_step", cfg, B, C), build)
+
+
+# ---------------------------------------------------------------------------
+# host-side allocator: free list + refcounts + prefix trie
+# ---------------------------------------------------------------------------
+
+
+class _PrefixNode:
+    """One hash-consed prompt chunk: a trie edge labeled by ``chunk`` (a
+    full page of token ids) holding the physical page that stores it. The
+    node keeps one RETENTION reference on its page; live requests mapping
+    the page add their own."""
+
+    __slots__ = ("chunk", "page", "parent", "children", "tick")
+
+    def __init__(self, chunk: Tuple[int, ...], page: int,
+                 parent: Optional["_PrefixNode"]):
+        self.chunk = chunk
+        self.page = page
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_PrefixNode"] = {}
+        self.tick = 0
+
+
+class PagedKVAllocator:
+    """Free-list page allocator with refcounts, prefix hash-consing, and an
+    admission watermark (all host-side bookkeeping; the device never sees
+    anything but page-id arrays).
+
+    Thread-safe: the engine worker allocates/frees while HTTP threads read
+    ``stats()``. Page ``TRASH_PAGE`` is pinned out of circulation forever.
+    """
+
+    def __init__(self, num_pages: int, page_size: int, *,
+                 watermark_frac: float = 0.05):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is trash)")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # pages below this stay in reserve: admission defers instead of
+        # draining the pool to zero (in-flight decode never waits on alloc
+        # because every request reserves its full budget at admit)
+        self.watermark = max(1, int((num_pages - 1) * watermark_frac))
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(num_pages - 1, TRASH_PAGE, -1))
+        self._ref = [0] * num_pages
+        self._ref[TRASH_PAGE] = 1  # pinned
+        self._root: Dict[Tuple[int, ...], _PrefixNode] = {}
+        self._nodes: List[_PrefixNode] = []
+        self._tick = 0
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._evictions = 0
+        self._alloc_fail = 0
+
+    # -- page lifecycle ----------------------------------------------------
+
+    def alloc(self, n: int, *, reserve: bool = True) -> Optional[List[int]]:
+        """Take ``n`` fresh pages (refcount 1 each), evicting LRU prefix
+        retentions if the free list runs short. Returns None — admission
+        defers — when the pool cannot cover ``n`` plus the watermark
+        reserve without touching pages live requests still map."""
+        with self._lock:
+            floor = self.watermark if reserve else 0
+            if len(self._free) < n + floor:
+                self._evict_locked(n + floor - len(self._free))
+            if len(self._free) < n + floor:
+                self._alloc_fail += 1
+                tel.counter("serving.kv.alloc_deferred").add(1)
+                return None
+            pages = [self._free.pop() for _ in range(n)]
+            for p in pages:
+                self._ref[p] = 1
+            return pages
+
+    def incref(self, pages: Sequence[int]) -> None:
+        with self._lock:
+            for p in pages:
+                if p == TRASH_PAGE:
+                    continue
+                if self._ref[p] <= 0:
+                    raise RuntimeError(f"incref on dead page {p}")
+                self._ref[p] += 1
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page; pages reaching zero return to the
+        free list. Double-frees fail loudly — a silent one would hand the
+        same page to two requests and corrupt both caches."""
+        with self._lock:
+            for p in pages:
+                if p == TRASH_PAGE:
+                    continue
+                if self._ref[p] <= 0:
+                    raise RuntimeError(f"double-free of page {p}")
+                self._ref[p] -= 1
+                if self._ref[p] == 0:
+                    self._free.append(p)
+
+    # -- prefix hash-consing -----------------------------------------------
+
+    def _chunks(self, tokens: Sequence[int]) -> List[Tuple[int, ...]]:
+        ps = self.page_size
+        n_full = len(tokens) // ps
+        return [tuple(tokens[i * ps:(i + 1) * ps]) for i in range(n_full)]
+
+    def match_prefix(self, tokens: Sequence[int]) -> List[int]:
+        """Longest hash-consed prefix of ``tokens`` (full pages only).
+        Returns the shared page ids with one reference taken per page for
+        the caller (release via ``free`` with the rest of its table)."""
+        with self._lock:
+            pages: List[int] = []
+            level = self._root
+            for chunk in self._chunks(tokens):
+                node = level.get(chunk)
+                if node is None:
+                    break
+                self._tick += 1
+                node.tick = self._tick
+                self._ref[node.page] += 1
+                pages.append(node.page)
+                level = node.children
+            if pages:
+                self._prefix_hits += 1
+                tel.counter("serving.kv.prefix_hits").add(1)
+            else:
+                self._prefix_misses += 1
+                tel.counter("serving.kv.prefix_misses").add(1)
+            return pages
+
+    def register_prefix(self, tokens: Sequence[int],
+                        block_ids: Sequence[int]) -> None:
+        """Hash-cons the prompt's full chunks, retaining one reference on
+        each newly published page (already-registered chunks just refresh
+        their LRU tick — including ones this request matched at admit).
+        Only FULL chunks are registered, so a registered page is never a
+        write target (see module docstring)."""
+        with self._lock:
+            chunks = self._chunks(tokens)
+            level = self._root
+            parent: Optional[_PrefixNode] = None
+            for i, chunk in enumerate(chunks):
+                node = level.get(chunk)
+                if node is None:
+                    page = block_ids[i]
+                    if page == TRASH_PAGE or self._ref[page] <= 0:
+                        break  # caller's table disagrees; don't publish junk
+                    node = _PrefixNode(chunk, page, parent)
+                    self._ref[page] += 1  # retention reference
+                    level[chunk] = node
+                    self._nodes.append(node)
+                self._tick += 1
+                node.tick = self._tick
+                parent = node
+                level = node.children
+
+    def _evict_locked(self, need: int) -> None:
+        """Reclaim up to ``need`` pages by dropping LRU prefix retentions
+        whose pages no live request maps (refcount 1 = retention only).
+        Inner trie nodes are only evictable once their children are gone —
+        eviction order is leaves-first by last-use tick."""
+        reclaimed = 0
+        while reclaimed < need:
+            victim = None
+            for node in self._nodes:
+                if node.children or self._ref[node.page] != 1:
+                    continue
+                if victim is None or node.tick < victim.tick:
+                    victim = node
+            if victim is None:
+                return
+            self._nodes.remove(victim)
+            level = victim.parent.children if victim.parent else self._root
+            level.pop(victim.chunk, None)
+            self._ref[victim.page] -= 1
+            if self._ref[victim.page] == 0:
+                self._free.append(victim.page)
+                reclaimed += 1
+            self._evictions += 1
+            tel.counter("serving.kv.prefix_evictions").add(1)
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            shared = sum(1 for n in self._nodes if self._ref[n.page] > 1)
+            return {
+                "kv_pages_total": self.num_pages - 1,  # trash excluded
+                "kv_pages_free": len(self._free),
+                "kv_pages_shared": shared,
+                "kv_prefix_nodes": len(self._nodes),
+                "kv_watermark_pages": self.watermark,
+                "kv_prefix_hits": self._prefix_hits,
+                "kv_prefix_misses": self._prefix_misses,
+                "kv_prefix_evictions": self._evictions,
+                "kv_alloc_deferred": self._alloc_fail,
+            }
+
+    def check_leaks(self) -> dict:
+        """Test hook: with no live requests, every non-free page must be
+        either trash or a retained prefix page (refcount exactly 1)."""
+        with self._lock:
+            retained = {n.page for n in self._nodes}
+            leaked = [
+                p for p in range(1, self.num_pages)
+                if self._ref[p] > 0 and (p not in retained or self._ref[p] != 1)
+            ]
+            free_set = set(self._free)
+            double = [p for p in free_set if self._ref[p] != 0]
+            return {"leaked": leaked, "bad_free": double,
+                    "accounted": len(free_set) + len(retained) + 1
+                    == self.num_pages and not (free_set & retained)}

@@ -36,11 +36,16 @@ class SubprocessReplica:
     """One replica = one child python process serving /predict + /ready."""
 
     def __init__(self, predictor_spec: str, *, model_path: Optional[str] = None,
-                 startup_timeout_s: float = 60.0):
+                 startup_timeout_s: float = 60.0, role: Optional[str] = None):
         self.id = uuid.uuid4().hex[:8]
         self.predictor_spec = predictor_spec
+        self.role = role or "mixed"
         self._port_file = os.path.join(tempfile.gettempdir(), f"fedml_replica_{self.id}.port")
         env = dict(os.environ)
+        if role:
+            # pool role reaches the child predictor (LLMPredictor sizes its
+            # engine for prefill- vs decode-dominated traffic off this)
+            env["FEDML_SERVE_ROLE"] = role
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         # best-effort allocator cap for backends that honor it (the
@@ -108,9 +113,11 @@ class ReplicaSet:
     replace dead)."""
 
     def __init__(self, predictor_spec: str, desired: int = 1, *, model_path: Optional[str] = None,
-                 max_consecutive_failures: int = 3, startup_timeout_s: float = 60.0):
+                 max_consecutive_failures: int = 3, startup_timeout_s: float = 60.0,
+                 role: Optional[str] = None):
         self.predictor_spec = predictor_spec
         self.model_path = model_path
+        self.role = role
         self.desired = 0
         self.replicas: List[SubprocessReplica] = []
         self.max_consecutive_failures = max_consecutive_failures
@@ -154,7 +161,8 @@ class ReplicaSet:
             while len(self.replicas) < self.desired:
                 self.replicas.append(
                     SubprocessReplica(self.predictor_spec, model_path=self.model_path,
-                                      startup_timeout_s=self.startup_timeout_s)
+                                      startup_timeout_s=self.startup_timeout_s,
+                                      role=self.role)
                 )
                 log.info("replica set: started %s on %s", self.replicas[-1].id, self.replicas[-1].url)
             while len(self.replicas) > self.desired:
@@ -402,6 +410,124 @@ class AutoScaler:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5.0)
+
+
+class DisaggregatedReplicaSet:
+    """Prefill/decode pool pair (the ReplicaSet split the paged serving
+    stack routes over).
+
+    Prefill-dominated work (cold long prompts, cache warming) and
+    decode-dominated work (interactive token streams) have opposite
+    resource shapes — prefill is compute-bound and bursty, decode is
+    latency-bound and steady — so they get SEPARATE replica pools that
+    scale, health-check, and export gauges independently
+    (``fedml_serving_pool_replicas{pool=,state=}``). Each child learns its
+    role via ``FEDML_SERVE_ROLE``; within one paged replica, prefilled
+    pages reach the decode pool through the engine's transfer stage."""
+
+    POOLS = ("prefill", "decode")
+
+    def __init__(self, predictor_spec: str, *, prefill: int = 1, decode: int = 1,
+                 model_path: Optional[str] = None,
+                 startup_timeout_s: float = 60.0,
+                 max_consecutive_failures: int = 3):
+        self.pools: Dict[str, ReplicaSet] = {}
+        try:
+            for role, n in (("prefill", prefill), ("decode", decode)):
+                self.pools[role] = ReplicaSet(
+                    predictor_spec, n, model_path=model_path, role=role,
+                    startup_timeout_s=startup_timeout_s,
+                    max_consecutive_failures=max_consecutive_failures)
+        except Exception:
+            self.shutdown()  # don't orphan the pool that did come up
+            raise
+
+    def pool(self, role: str) -> ReplicaSet:
+        return self.pools[role]
+
+    def scale_to(self, role: str, n: int) -> None:
+        self.pools[role].scale_to(n)
+
+    def healthy(self, role: str) -> List[SubprocessReplica]:
+        return self.pools[role].healthy()
+
+    def reconcile(self) -> None:
+        for rs in self.pools.values():
+            rs.reconcile()
+
+    def prom_gauges(self, probe_ready: bool = True) -> List[tuple]:
+        out: List[tuple] = []
+        for role, rs in self.pools.items():
+            for name, labels, value in rs.prom_gauges(probe_ready=probe_ready):
+                out.append(("serving_pool_replicas",
+                            {"pool": role, **(labels or {})}, value))
+        return out
+
+    def statusz_section(self, probe_ready: bool = False) -> Dict[str, Any]:
+        return {role: rs.statusz_section(probe_ready=probe_ready)
+                for role, rs in self.pools.items()}
+
+    def shutdown(self) -> None:
+        for rs in self.pools.values():
+            rs.shutdown()
+
+
+class DisaggregatedGateway:
+    """Pool-aware front for a :class:`DisaggregatedReplicaSet`: one
+    :class:`InferenceGateway` per pool, requests routed by phase dominance
+    (explicit ``pool`` key > ``prefill_only`` > prompt length), with
+    fallback to the other pool when the preferred one has no healthy
+    replicas — disaggregation degrades to co-location, never to an
+    outage."""
+
+    def __init__(self, replica_set: DisaggregatedReplicaSet, *,
+                 prefill_cutoff_chars: int = 2048):
+        from ..core.telemetry import prom
+
+        # labeled family: "serving.pool.fallback.<pool>" collapses to
+        # fedml_serving_pool_fallback_total{pool=} (bounded cardinality:
+        # the pool vocabulary is POOLS)
+        prom.register_prefix_family(
+            "serving.pool.fallback.", ("pool",),
+            "requests rerouted because the preferred pool had no healthy replicas")
+        self.replica_set = replica_set
+        self.prefill_cutoff_chars = int(prefill_cutoff_chars)
+        self.gateways = {role: InferenceGateway(rs)
+                         for role, rs in replica_set.pools.items()}
+
+    def route(self, payload: Dict[str, Any]) -> str:
+        pool = payload.get("pool")
+        if pool in DisaggregatedReplicaSet.POOLS:
+            return pool
+        if payload.get("prefill_only"):
+            return "prefill"
+        if len(str(payload.get("prompt", ""))) >= self.prefill_cutoff_chars:
+            return "prefill"
+        return "decode"
+
+    def predict(self, payload: Dict[str, Any], *, timeout_s: float = 30.0,
+                retries: int = 3) -> Dict[str, Any]:
+        role = self.route(payload)
+        other = "decode" if role == "prefill" else "prefill"
+        if not self.replica_set.healthy(role) and self.replica_set.healthy(other):
+            tel.counter(f"serving.pool.fallback.{role}").add(1)
+            role = other
+        return self.gateways[role].predict(
+            payload, timeout_s=timeout_s, retries=retries)
+
+    def signals(self) -> Dict[str, Dict[str, float]]:
+        return {role: gw.signals() for role, gw in self.gateways.items()}
+
+    def prom_gauges(self) -> List[tuple]:
+        out: List[tuple] = []
+        for role, gw in self.gateways.items():
+            for name, labels, value in gw.prom_gauges():
+                out.append((name, {"pool": role, **(labels or {})}, value))
+        out.extend(self.replica_set.prom_gauges(probe_ready=False))
+        return out
+
+    def shutdown(self) -> None:
+        self.replica_set.shutdown()
 
 
 def create_echo_predictor(model_path: Optional[str] = None):

@@ -1,0 +1,269 @@
+"""Multi-tenant admission (serving/admission.py) and the disaggregated
+serving front: token budgets, WFQ ordering, SLO-tied backpressure off the
+tsdb, the labeled reject family, the HTTP 429 path, pool-aware routing,
+and the tenant-isolation chaos drill."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core import telemetry as tel
+from fedml_tpu.core.telemetry import Telemetry, prom, tsdb
+from fedml_tpu.models.transformer import TransformerConfig, TransformerLM
+from fedml_tpu.serving import admission
+from fedml_tpu.serving.admission import (
+    AdmissionController,
+    AdmissionError,
+    TenantPolicy,
+)
+from fedml_tpu.serving.continuous_batching import PagedContinuousBatchingEngine
+
+CFG = TransformerConfig(
+    vocab_size=89, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+    max_seq_len=64, dtype=jnp.float32, remat=False, lora_rank=0,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = TransformerLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"]
+
+
+@pytest.fixture()
+def store():
+    tsdb.reset()
+    s = tsdb.install()
+    yield s
+    tsdb.reset()
+
+
+def _prompt(length, seed):
+    return list(np.random.default_rng(seed).integers(1, CFG.vocab_size, length))
+
+
+# --- controller units --------------------------------------------------------
+
+
+def test_token_bucket_charges_and_refills():
+    now = [0.0]
+    ctrl = AdmissionController(
+        policies={"t": TenantPolicy(tokens_per_s=10.0, burst_tokens=20.0)},
+        clock=lambda: now[0])
+    assert ctrl.check("t", 20) is None      # burst covers it
+    assert ctrl.check("t", 1) == "budget"   # bucket empty
+    now[0] += 1.0                            # +10 tokens of refill
+    assert ctrl.check("t", 10) is None
+    assert ctrl.check("t", 1) == "budget"
+    assert ctrl.stats()["sheds"] == 2
+    assert tel.counter("serving.admission.rejected.t.budget").value >= 2
+
+
+def test_wfq_tags_put_flood_backlog_behind_fresh_arrivals():
+    ctrl = AdmissionController()
+    f1 = ctrl.stamp("flood", 100)
+    f2 = ctrl.stamp("flood", 100)
+    light = ctrl.stamp("light", 10)
+    assert f1 < f2
+    assert light < f2  # the light tenant's fresh work wins the dequeue
+    ctrl.on_dequeue(f2)
+    assert ctrl.stamp("light", 10) > f2  # vclock advanced past the flood
+    # weight scales the virtual cost: a weight-2 tenant's tag grows half
+    # as fast for the same token cost
+    heavy = AdmissionController(policies={"h": TenantPolicy(weight=2.0)})
+    assert heavy.stamp("h", 100) == pytest.approx(50.0)
+
+
+def test_slo_pressure_defers_and_sheds_only_over_share_tenants(store):
+    ctrl = AdmissionController(burn_ttl_s=0.0)
+    assert ctrl.check("abuser", 10_000) is None
+    assert ctrl.check("victim", 10) is None
+    # healthy tail: no backpressure for anyone
+    assert ctrl.eligible("abuser") and ctrl.eligible("victim")
+    for _ in range(20):  # p99 TTFT 10s against the 5s target: burn 2.0
+        store.record_observation("serving.cb.ttft_seconds", 10.0)
+    assert ctrl.burn_fraction() >= 2.0
+    assert ctrl.check("abuser", 10) == "slo_pressure"   # shed: over share
+    assert ctrl.check("victim", 10) is None             # under fair share
+    assert not ctrl.eligible("abuser")                  # deferred in queue
+    assert ctrl.eligible("victim")
+    assert ctrl.stats()["deferrals"] >= 1
+
+
+def test_single_tenant_is_never_over_fair_share(store):
+    ctrl = AdmissionController(burn_ttl_s=0.0)
+    assert ctrl.check("solo", 50_000) is None
+    for _ in range(20):
+        store.record_observation("serving.cb.ttft_seconds", 10.0)
+    # even at burn 2.0 there is nobody to be unfair to: no shed, no defer
+    assert ctrl.check("solo", 10) is None
+    assert ctrl.eligible("solo")
+
+
+def test_reject_family_renders_with_tenant_and_reason_labels():
+    admission._register_prom_family()
+    t = Telemetry(enabled=True)
+    t.counter("serving.admission.rejected.acme.budget").add(3)
+    lines = [ln for ln in prom.render(t).splitlines()
+             if ln.startswith("fedml_serving_admission_rejected_total{")]
+    assert lines, "labeled family line missing from exposition"
+    assert 'tenant="acme"' in lines[0] and 'reason="budget"' in lines[0]
+    assert lines[0].endswith(" 3")
+
+
+# --- engine integration ------------------------------------------------------
+
+
+def test_queue_full_reject_is_labeled_admission_error(params):
+    eng = PagedContinuousBatchingEngine(params, CFG, num_slots=2, chunk=4,
+                                        max_queue=0)
+    try:
+        h = eng.submit([1, 2, 3], 4, tenant="acme")
+        with pytest.raises(AdmissionError) as ei:
+            h.result(timeout=5)
+        assert ei.value.tenant == "acme"
+        assert ei.value.reason == "queue_full"
+        assert tel.counter("serving.admission.rejected.acme.queue_full").value >= 1
+    finally:
+        eng.shutdown()
+
+
+def test_tenant_isolation_chaos_drill(params, store):
+    """The drill the admission layer exists for: an abuser tenant floods
+    past its token budget and is shed AT ADMISSION (labeled rejects, no
+    pages or slots spent), while the victim's requests all complete and
+    its per-tenant TTFT p99 stays inside the serving SLO target."""
+    ctrl = AdmissionController(
+        policies={"abuser": TenantPolicy(tokens_per_s=0.0, burst_tokens=30.0)})
+    eng = PagedContinuousBatchingEngine(params, CFG, num_slots=2, chunk=4,
+                                        admission=ctrl)
+    try:
+        eng.generate(_prompt(4, 0), 4)  # warm the executables off-drill
+        victim_hs, abuser_hs = [], []
+        for i in range(8):
+            abuser_hs.append(eng.submit(_prompt(6, 100 + i), 6,
+                                        tenant="abuser"))
+            victim_hs.append(eng.submit(_prompt(6, 200 + i), 6,
+                                        tenant="victim"))
+        shed = 0
+        for h in abuser_hs:
+            try:
+                h.result(timeout=120)
+            except AdmissionError as e:
+                assert e.tenant == "abuser" and e.reason == "budget"
+                shed += 1
+        assert shed >= 6  # burst 30 covers at most 2 of the 12-token costs
+        for h in victim_hs:  # the victim never notices the flood
+            assert len(h.result(timeout=120)) == 6
+        assert tel.counter("serving.admission.rejected.abuser.budget").value >= shed
+        # victim SLO: per-tenant TTFT p99 inside the 5s serving target,
+        # both on the engine's gauge and the tsdb series the SLO pack reads
+        gauges = {(g[0], (g[1] or {}).get("tenant")): g[2]
+                  for g in eng.prom_gauges()}
+        p99 = gauges[("serving_tenant_ttft_p99_seconds", "victim")]
+        assert 0.0 < p99 < 5.0
+        q = store.quantile("serving.tenant.ttft_seconds.victim", 0.99, 300.0)
+        assert q is not None and q < 5.0
+        leaks = eng._alloc.check_leaks()
+        assert leaks["leaked"] == [] and leaks["accounted"]
+    finally:
+        eng.shutdown()
+
+
+def test_runner_maps_admission_error_to_429(params):
+    from fedml_tpu.serving.fedml_inference_runner import FedMLInferenceRunner
+    from fedml_tpu.serving.fedml_predictor import LLMPredictor
+
+    class _Tok:
+        special_tokens = {}
+
+        def encode(self, s):
+            return [1 + (ord(c) % (CFG.vocab_size - 1)) for c in s] or [1]
+
+        def decode(self, ids):
+            return " ".join(str(i) for i in ids)
+
+    ctrl = AdmissionController(
+        policies={"blocked": TenantPolicy(tokens_per_s=0.0, burst_tokens=0.0)})
+    pred = LLMPredictor(params, CFG, _Tok(), default_max_new_tokens=3,
+                        paged=True, num_slots=2, decode_chunk=4,
+                        admission=ctrl)
+    runner = FedMLInferenceRunner(pred, port=0)
+    port = runner.start()
+    try:
+        def post(body):
+            return urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"}), timeout=60)
+
+        with post({"prompt": "hi", "tenant": "anyone"}) as r:
+            assert json.loads(r.read())["text"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"prompt": "hi", "tenant": "blocked"})
+        assert ei.value.code == 429
+        doc = json.loads(ei.value.read())
+        assert doc["error"] == "admission_rejected"
+        assert doc["tenant"] == "blocked" and doc["reason"] == "budget"
+        # the runner's /metrics ride-along carries the kv + admission gauges
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            metrics = r.read().decode()
+        assert "fedml_serving_kv_pages" in metrics
+        assert "fedml_serving_admission_rejected_total" in metrics
+    finally:
+        runner.stop()
+
+
+# --- disaggregated routing ---------------------------------------------------
+
+
+def test_endpoint_pool_aware_routing():
+    from fedml_tpu.serving.endpoint import Endpoint
+    from fedml_tpu.serving.fedml_predictor import FedMLPredictor
+
+    class Marker(FedMLPredictor):
+        def __init__(self, idx):
+            self.idx = idx
+
+        def predict(self, request):
+            return {"idx": self.idx}
+
+    made = []
+
+    def factory():
+        made.append(Marker(len(made)))
+        return made[-1]
+
+    ep = Endpoint("disagg", factory, num_replicas=3, prefill_replicas=1,
+                  prefill_cutoff_chars=100)
+    try:
+        assert ep._route_pool({"prefill_only": True}) == "prefill"
+        assert ep._route_pool({"prompt": "x" * 200}) == "prefill"
+        assert ep._route_pool({"prompt": "hi"}) == "decode"
+        # explicit pool overrides the length heuristic
+        assert ep._route_pool({"pool": "decode", "prompt": "x" * 200}) == "decode"
+        assert set(ep.pools()) == {"prefill", "decode"}
+        # replica 0 is the prefill pool; long prompts land only there
+        assert ep.predict({"prompt": "x" * 200})["idx"] == 0
+        served = {ep.predict({"prompt": "hi"})["idx"] for _ in range(6)}
+        assert served and served <= {1, 2}  # decode traffic stays in-pool
+    finally:
+        ep.shutdown()
+
+
+def test_disaggregated_gateway_route_precedence():
+    from fedml_tpu.serving.replica_controller import DisaggregatedGateway
+
+    gw = object.__new__(DisaggregatedGateway)  # routing is stateless
+    gw.prefill_cutoff_chars = 100
+    assert gw.route({"pool": "prefill"}) == "prefill"
+    assert gw.route({"pool": "decode", "prefill_only": True}) == "decode"
+    assert gw.route({"prefill_only": True}) == "prefill"
+    assert gw.route({"prompt": "y" * 150}) == "prefill"
+    assert gw.route({"prompt": "hi"}) == "decode"

@@ -524,6 +524,52 @@ class TestLockDiscipline(_RuleCase):
         self.assert_suppressed({"m.py": sup})
 
 
+class TestAdmissionReject(_RuleCase):
+    """Every admission-path reject (AdmissionError construction) must emit
+    the labeled fedml_serving_admission_rejected_total family — via
+    count_reject() or AdmissionController.check() in the same function."""
+
+    rule_ids = ("admission-reject",)
+
+    _BAD = (
+        "def _reject(handle, tenant):\n"
+        "    handle._fail(AdmissionError(tenant, 'queue_full'))\n"
+    )
+
+    def test_uncounted_reject_fires(self):
+        res = self.assert_fires({"serving/m.py": self._BAD},
+                                rule="admission-reject", count=1)
+        self.assertIn("count_reject", res.findings[0].message)
+
+    def test_counted_reject_is_clean(self):
+        self.assert_clean({"serving/m.py": (
+            "def _reject(handle, tenant):\n"
+            "    count_reject(tenant, 'queue_full')\n"
+            "    handle._fail(AdmissionError(tenant, 'queue_full'))\n"
+        )})
+
+    def test_check_gated_reject_is_clean(self):
+        # AdmissionController.check() counts internally before returning
+        # the shed reason: the submit path carries no second emission
+        self.assert_clean({"serving/m.py": (
+            "def submit(self, tenant, cost):\n"
+            "    reason = self._admission.check(tenant, cost)\n"
+            "    if reason is not None:\n"
+            "        raise AdmissionError(tenant, reason)\n"
+        )})
+
+    def test_outside_serving_not_in_scope(self):
+        # catching/re-raising AdmissionError in non-serving layers (e.g. a
+        # client SDK) is not a reject site
+        self.assert_clean({"train/m.py": self._BAD})
+
+    def test_suppressed_with_reason(self):
+        sup = self._BAD.replace(
+            "handle._fail(AdmissionError(tenant, 'queue_full'))\n",
+            "handle._fail(AdmissionError(tenant, 'queue_full'))  # fedlint: disable=admission-reject counted by caller before dispatch\n")
+        self.assert_suppressed({"serving/m.py": sup})
+
+
 class TestShimParity(unittest.TestCase):
     """The five tools/check_*.py shims keep their historical contracts.
     (Deeper behavioral coverage lives with each subsystem's own tests —

@@ -88,6 +88,24 @@ EXPORTED = {
     "fedml_serving_cb_tokens_generated_total": "counter",
     "fedml_serving_cb_ttft_seconds": "histogram",
     "fedml_serving_cb_tpot_seconds": "histogram",
+    "fedml_serving_wasted_tokens_total": "counter",
+    # paged KV cache + prefix sharing (serving/paged_kv.py + engine gauges)
+    "fedml_serving_kv_pages": "gauge",               # {state=free|used|watermark}
+    "fedml_serving_kv_prefix_nodes": "gauge",
+    "fedml_serving_kv_prefix_hits_total": "counter",
+    "fedml_serving_kv_prefix_misses_total": "counter",
+    "fedml_serving_kv_prefix_evictions_total": "counter",
+    "fedml_serving_kv_alloc_deferred_total": "counter",
+    # multi-tenant admission (serving/admission.py; {tenant}/{tenant,reason})
+    "fedml_serving_admission_rejected_total": "counter",
+    "fedml_serving_admission_deferrals_total": "counter",
+    "fedml_serving_admission_burn_fraction": "gauge",
+    "fedml_serving_tenant_usage_share": "gauge",
+    "fedml_serving_tenant_budget_tokens": "gauge",
+    "fedml_serving_tenant_ttft_p99_seconds": "gauge",
+    # disaggregated prefill/decode pools (serving/replica_controller.py)
+    "fedml_serving_pool_replicas": "gauge",          # {pool, state}
+    "fedml_serving_pool_fallback_total": "counter",  # {pool}
     "fedml_serving_gateway_qps": "gauge",
     "fedml_serving_gateway_latency_ewma_seconds": "gauge",
     "fedml_serving_gateway_errors": "gauge",

@@ -1,0 +1,253 @@
+"""Paged KV cache (serving/paged_kv.py + PagedContinuousBatchingEngine):
+token-exactness vs the reference ``generate()`` path on ragged lengths
+(including through the prefix-sharing suffix-prefill), zero-recompile
+admission, refcount lifecycle under randomized workloads (no leak, no
+double-free), mid-chunk EOS page reclamation, and the allocator's
+watermark / eviction behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core import telemetry as tel
+from fedml_tpu.models.transformer import TransformerConfig, TransformerLM
+from fedml_tpu.serving.continuous_batching import PagedContinuousBatchingEngine
+from fedml_tpu.serving.paged_kv import TRASH_PAGE, PagedKVAllocator
+from fedml_tpu.train.llm.generation import generate
+
+CFG = TransformerConfig(
+    vocab_size=89, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+    max_seq_len=64, dtype=jnp.float32, remat=False, lora_rank=0,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = TransformerLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"]
+
+
+@pytest.fixture()
+def engine(params):
+    eng = PagedContinuousBatchingEngine(params, CFG, num_slots=2, chunk=4)
+    yield eng
+    eng.shutdown()
+
+
+def _prompt(length, seed):
+    return list(np.random.default_rng(seed).integers(1, CFG.vocab_size, length))
+
+
+def _ref(params, prompt, max_new):
+    return np.asarray(
+        generate(params, CFG, jnp.asarray([prompt], jnp.int32), max_new)
+    )[0].tolist()
+
+
+# --- allocator ---------------------------------------------------------------
+
+
+def test_allocator_alloc_free_and_watermark():
+    a = PagedKVAllocator(num_pages=9, page_size=16, watermark_frac=0.25)
+    # 8 usable pages, watermark 2: an alloc that would dip into the
+    # reserve defers (returns None) instead of draining the pool
+    assert a.watermark == 2
+    pages = a.alloc(6)
+    assert pages is not None and len(pages) == 6
+    assert TRASH_PAGE not in pages and len(set(pages)) == 6
+    assert a.alloc(1) is None  # 2 free == watermark: defer
+    assert a.stats()["kv_alloc_deferred"] == 1
+    a.free(pages)
+    assert a.stats()["kv_pages_free"] == 8
+    assert a.check_leaks()["accounted"]
+
+
+def test_allocator_double_free_and_dead_incref_raise():
+    a = PagedKVAllocator(num_pages=5, page_size=16)
+    (p,) = a.alloc(1)
+    a.free([p])
+    with pytest.raises(RuntimeError, match="double-free"):
+        a.free([p])
+    with pytest.raises(RuntimeError, match="dead page"):
+        a.incref([p])
+
+
+def test_prefix_register_match_and_eviction():
+    ps = 4
+    a = PagedKVAllocator(num_pages=10, page_size=ps, watermark_frac=0.0)
+    toks = list(range(1, 1 + 3 * ps))  # 3 full chunks
+    pages = a.alloc(3)
+    a.register_prefix(toks, pages)
+    assert a.stats()["kv_prefix_nodes"] == 3
+    # the registering request releases its references; retention keeps the
+    # pages alive for future matches
+    a.free(pages)
+    shared = a.match_prefix(toks + [7, 8])
+    assert shared == pages  # full-prefix hit, in chunk order
+    assert a.stats()["kv_prefix_hits"] == 1
+    a.free(shared)
+    # a diverging second chunk only matches the first
+    assert a.match_prefix(toks[:ps] + [88] * ps) == pages[:1]
+    a.free(pages[:1])
+    # allocation pressure evicts LRU retentions (leaves first) and the
+    # evicted chunks stop matching (9 usable pages, floor watermark 1:
+    # an 8-page grab must reclaim all 3 retained chunks)
+    big = a.alloc(8)
+    assert big is not None and len(big) == 8
+    assert a.stats()["kv_prefix_evictions"] >= 1
+    a.free(big)
+    assert a.check_leaks()["accounted"]
+
+
+def test_allocator_randomized_lifecycle_no_leaks():
+    """Randomized workload over the full allocator surface: every page is
+    accounted for at the end (leak or double-free would have raised or
+    shows in check_leaks)."""
+    rng = np.random.default_rng(0)
+    ps = 4
+    a = PagedKVAllocator(num_pages=33, page_size=ps, watermark_frac=0.05)
+    live = []  # (pages, tokens or None)
+    for step in range(400):
+        op = rng.integers(0, 3)
+        if op == 0 and len(live) < 8:
+            toks = list(rng.integers(1, 50, int(rng.integers(1, 4)) * ps))
+            shared = a.match_prefix(toks)
+            n_more = len(toks) // ps - len(shared)
+            fresh = a.alloc(n_more)
+            if fresh is None:
+                a.free(shared)
+                continue
+            table = list(shared) + fresh
+            a.register_prefix(toks, table)
+            live.append((table, toks))
+        elif op == 1 and live:
+            pages, _ = live.pop(int(rng.integers(0, len(live))))
+            a.free(pages)
+        elif op == 2:
+            extra = a.alloc(int(rng.integers(1, 4)))
+            if extra is not None:
+                a.free(extra)
+    for pages, _ in live:
+        a.free(pages)
+    leaks = a.check_leaks()
+    assert leaks["leaked"] == [] and leaks["bad_free"] == []
+    assert leaks["accounted"]
+
+
+# --- engine ------------------------------------------------------------------
+
+
+def test_paged_engine_greedy_matches_generate_ragged(engine, params):
+    """Keystone: the paged engine (block-table scatter/gather decode) is
+    token-exact vs the contiguous reference path across ragged prompt
+    lengths spanning page boundaries."""
+    prompts = [_prompt(n, i) for i, n in enumerate((3, 15, 16, 17, 31, 40))]
+    handles = [engine.submit(p, 12) for p in prompts]
+    for p, h in zip(prompts, handles):
+        assert h.result(timeout=120) == _ref(params, p, 12)
+    # all pages returned (no retention yet for <1-page prompts; longer
+    # prompts retain their full chunks at refcount exactly 1)
+    leaks = engine._alloc.check_leaks()
+    assert leaks["leaked"] == [] and leaks["accounted"]
+
+
+def test_prefix_sharing_is_token_exact_and_skips_prefill(engine, params):
+    """Two prompts sharing a 32-token system prefix: the second maps the
+    shared pages (prefix hit) and still decodes token-exactly through the
+    rewound suffix prefill."""
+    system = _prompt(32, 777)
+    a = system + _prompt(9, 1)
+    b = system + _prompt(5, 2)
+    assert engine.generate(a, 10) == _ref(params, a, 10)
+    hits0 = engine._alloc.stats()["kv_prefix_hits"]
+    assert engine.generate(b, 10) == _ref(params, b, 10)
+    st = engine.stats()
+    assert st["kv_prefix_hits"] == hits0 + 1
+    assert st["kv_prefix_nodes"] >= 2  # the system prefix stayed resident
+    leaks = engine._alloc.check_leaks()
+    assert leaks["leaked"] == [] and leaks["accounted"]
+
+
+def test_paged_executables_compile_once_across_mixed_admissions(params):
+    """Zero-recompile acceptance: one executable each for step / admit /
+    gather / suffix-prefill serves every mix of prompt lengths, sampling
+    settings, and prefix hit/miss — per-request state is runtime data
+    (block tables ride the jitted step as arguments)."""
+    eng = PagedContinuousBatchingEngine(params, CFG, num_slots=2, chunk=4)
+    try:
+        system = _prompt(16, 5)
+        eng.generate(system + _prompt(3, 0), 5)   # warm: miss path
+        eng.generate(system + _prompt(7, 1), 5)   # warm: hit path
+        counts0 = {k: tel.compile_count(k) for k in (
+            "paged_step", "paged_admit", "paged_gather",
+            "paged_suffix_prefill")}
+        assert all(v >= 1 for v in counts0.values()), counts0
+        hs = [
+            eng.submit(_prompt(3, 11), 6),
+            eng.submit(system + _prompt(4, 12), 7, temperature=0.7, seed=9),
+            eng.submit(_prompt(19, 13), 4, eos_id=1),
+            eng.submit(system + _prompt(9, 14), 5),
+        ]
+        for h in hs:
+            h.result(timeout=120)
+        counts1 = {k: tel.compile_count(k) for k in counts0}
+        assert counts1 == counts0, (counts0, counts1)
+    finally:
+        eng.shutdown()
+
+
+def test_eos_releases_pages_and_counts_waste(engine, params):
+    """Mid-chunk EOS: the slot's pages free at the chunk boundary and the
+    decoded-past-EOS overshoot lands in serving.wasted_tokens."""
+    prompt = _prompt(5, 7)
+    ref = _ref(params, prompt, 16)
+    eos = ref[3]
+    wasted0 = tel.counter("serving.wasted_tokens").value
+    got = engine.generate(prompt, 16, eos_id=eos)
+    assert got == ref[: ref.index(eos) + 1]
+    assert tel.counter("serving.wasted_tokens").value >= wasted0
+    st = engine.stats()
+    assert st["slots_active"] == 0
+    # nothing is live: every used page is a prefix retention, not a slot's
+    assert st["kv_tokens_live"] == 0 and st["kv_pages_per_token"] == 0.0
+    leaks = engine._alloc.check_leaks()
+    assert leaks["leaked"] == [] and leaks["accounted"]
+
+
+def test_stale_table_rows_cannot_corrupt_reused_pages(engine, params):
+    """After a request finishes, its slot's table row points at the trash
+    page — the next occupant of the SAME pages decodes exactly (a stale
+    row would keep scattering into reused pages every chunk)."""
+    outs = {}
+    for i in range(6):  # cycle pages through slots repeatedly
+        p = _prompt(10 + i, 50 + i)
+        outs[i] = (p, engine.generate(p, 8))
+    for i, (p, got) in outs.items():
+        assert got == _ref(params, p, 8), f"round {i} diverged"
+    assert np.all(engine._tables == TRASH_PAGE)
+
+
+def test_pool_exhaustion_defers_then_completes(params):
+    """A pool sized for ~one request at a time still completes a burst:
+    admission defers on alloc failure and resumes as decode frees pages."""
+    eng = PagedContinuousBatchingEngine(
+        params, CFG, num_slots=2, chunk=4, num_pages=4, watermark_frac=0.0)
+    try:
+        hs = [eng.submit(_prompt(17, 70 + i), 12) for i in range(4)]
+        outs = [h.result(timeout=120) for h in hs]
+        assert [len(o) for o in outs] == [12] * 4
+        assert eng.stats()["kv_alloc_deferred"] >= 1
+    finally:
+        eng.shutdown()
+
+
+def test_engine_stats_and_gauges_have_kv_series(engine):
+    engine.generate(_prompt(33, 90), 6)
+    st = engine.stats()
+    for k in ("kv_pages_total", "kv_pages_free", "kv_page_size",
+              "kv_pages_in_use", "kv_pages_per_token", "kv_watermark_pages",
+              "kv_prefix_nodes"):
+        assert k in st, k
+    names = {g[0] for g in engine.prom_gauges()}
+    assert {"serving_kv_pages", "serving_kv_prefix_nodes"} <= names

@@ -296,11 +296,19 @@ if doc.get("serving_load_tokens_per_sec") is not None:
         f"serving_load {doc['serving_load_tokens_per_sec']} tok/s "
         f"@{doc.get('serving_load_streams')} streams "
         f"(ttft p50/p99 {doc.get('serving_load_ttft_p50_s')}/"
-        f"{doc.get('serving_load_ttft_p99_s')}s, "
-        f"tpot p50/p99 {doc.get('serving_load_tpot_p50_s')}/"
-        f"{doc.get('serving_load_tpot_p99_s')}s, "
+        f"{doc.get('serving_load_p99_ttft_s', doc.get('serving_load_ttft_p99_s'))}s, "
+        f"tpot p99 {doc.get('serving_load_p99_tpot_s', doc.get('serving_load_tpot_p99_s'))}s, "
         f"occupancy peak {doc.get('serving_load_slot_occupancy_peak')} "
         f"mean {doc.get('serving_load_slot_occupancy_mean')})")
+if doc.get("kv_pages_per_token") is not None:
+    # paged-vs-fixed verdict: both claims in one line (tails + HBM)
+    parts.append(
+        f"paged KV: {doc['kv_pages_per_token']} pages/token, "
+        f"hbm_ratio {doc.get('serving_load_kv_hbm_ratio')} "
+        f"(paged ttft p99 {doc.get('serving_load_p99_ttft_s')}s vs "
+        f"fixed {doc.get('serving_load_fixed_ttft_p99_s')}s, "
+        f"prefix hits {doc.get('serving_load_prefix_hits')}/"
+        f"{doc.get('serving_load_prefix_hits', 0) and (doc.get('serving_load_prefix_hits') or 0) + (doc.get('serving_load_prefix_misses') or 0)})")
 if doc.get("serving_load_vs_decode") is not None:
     parts.append(f"vs raw decode {doc['serving_load_vs_decode']}x slower")
 if parts:

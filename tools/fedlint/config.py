@@ -24,6 +24,8 @@ DEFAULTS = {
     # multiplies into a bench collapse (r05: 985 tok/s int8 decode)
     "hot-modules": [
         "fedml_tpu/serving/continuous_batching.py",
+        "fedml_tpu/serving/paged_kv.py",
+        "fedml_tpu/serving/admission.py",
         "fedml_tpu/serving/replica_controller.py",
         "fedml_tpu/serving/endpoint.py",
         "fedml_tpu/core/aggregation/bucketed.py",

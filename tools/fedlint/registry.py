@@ -12,7 +12,7 @@ from .rules.metrics import MetricRegistryRule
 from .rules.protocol import ProtocolContractRule
 from .rules.resilience import BareSleepRule, OrbaxContainmentRule
 from .rules.retrace import RetraceRiskRule
-from .rules.serving import HotSpanRule
+from .rules.serving import AdmissionRejectRule, HotSpanRule
 from .rules.sharding import DeviceGetRule, ShardingContainmentRule
 from .rules.telemetry import ExcepthookRule, RecorderKindRule, ReservedKeyRule
 from .rules.timing import WallClockRule
@@ -26,6 +26,7 @@ _RULE_CLASSES = (
     BareSleepRule,
     OrbaxContainmentRule,
     HotSpanRule,
+    AdmissionRejectRule,
     ShardingContainmentRule,
     DeviceGetRule,
     # the JAX-aware rules none of the ad-hoc walkers could express (ISSUE 8)

@@ -24,6 +24,8 @@ from ._util import matches_file
 HOT_LOOPS: tuple = (
     ("continuous_batching.py", "ContinuousBatchingEngine._admit_all"),
     ("continuous_batching.py", "ContinuousBatchingEngine._step_chunk"),
+    ("continuous_batching.py", "PagedContinuousBatchingEngine._admit_all"),
+    ("continuous_batching.py", "PagedContinuousBatchingEngine._stage_prefill"),
     ("replica_controller.py", "InferenceGateway.predict"),
 )
 
@@ -98,3 +100,57 @@ class HotSpanRule(Rule):
         if not found:
             yield self.make(
                 ctx, 0, f"registry names missing function {fn_name}()")
+
+
+def _fn_calls(node: ast.AST):
+    """Callable names invoked anywhere inside ``node``: bare names and the
+    trailing attribute of method calls (``self._admission.check`` -> check)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            if isinstance(sub.func, ast.Name):
+                yield sub.func.id
+            elif isinstance(sub.func, ast.Attribute):
+                yield sub.func.attr
+
+
+class AdmissionRejectRule(Rule):
+    id = "admission-reject"
+    severity = "error"
+    description = ("admission-path reject does not emit the labeled "
+                   "fedml_serving_admission_rejected_total{tenant=,reason=} "
+                   "counter")
+
+    # A reject site is any construction of AdmissionError. The labeled
+    # family has exactly one emission helper — admission.count_reject() —
+    # and one indirect emitter: AdmissionController.check(), which counts
+    # internally before returning the shed reason. Every function that
+    # builds an AdmissionError must call one of the two; an uncounted
+    # reject is a request that vanished from the tenant's dashboard.
+    _EMITTERS = ("count_reject", "check")
+
+    def check_file(self, ctx):
+        if "serving" not in ctx.relpath.replace(os.sep, "/").split("/"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            rejects = [
+                sub for sub in ast.walk(node)
+                if isinstance(sub, ast.Call)
+                and ((isinstance(sub.func, ast.Name)
+                      and sub.func.id == "AdmissionError")
+                     or (isinstance(sub.func, ast.Attribute)
+                         and sub.func.attr == "AdmissionError"))
+            ]
+            if not rejects:
+                continue
+            if any(name in self._EMITTERS for name in _fn_calls(node)):
+                continue
+            for sub in rejects:
+                yield self.make(
+                    ctx, sub,
+                    f"{node.name}() sheds a request (AdmissionError) without "
+                    "emitting fedml_serving_admission_rejected_total — route "
+                    "the reject through admission.count_reject(tenant, "
+                    "reason) (or AdmissionController.check, which counts "
+                    "internally) so shed traffic stays visible per tenant")
