@@ -67,16 +67,10 @@ def _p(msg: str) -> None:
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 # --- chip peak table (dense TFLOPS; bf16, f32≈bf16/2) ------------------------
-_PEAK_BF16_TFLOPS = {
-    "v2": 45.0,
-    "v3": 123.0,
-    "v4": 275.0,
-    "v5 lite": 197.0,   # v5e
-    "v5e": 197.0,
-    "v5p": 459.0,
-    "v6 lite": 918.0,   # trillium
-    "v6e": 918.0,
-}
+# Promoted to fedml_tpu/core/distributed/device_specs.py (ISSUE 17) so the
+# live devperf registry, the placement cost model, and this bench share ONE
+# datasheet; imported lazily below because the orchestrator process never
+# imports fedml_tpu (module docstring).
 
 # flagship single-chip proxy geometry, shared by train/decode/serving stages
 _LLM_SHAPE = dict(d_model=1024, n_layers=16, n_heads=16, d_ff=2752,
@@ -93,13 +87,13 @@ def _llm_shape() -> dict:
 
 
 def _chip_peak_tflops(device, dtype_bits: int) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, bf16 in _PEAK_BF16_TFLOPS.items():
-        if key in kind:
-            return bf16 if dtype_bits == 16 else bf16 / 2.0
-    # unknown chip (CPU fallback runs in CI): assume a modest 2 TFLOPS so the
-    # MFU guard still triggers on absurd rates rather than dividing by peak=0
-    return 2.0
+    # unknown chip (CPU fallback runs in CI): device_specs assumes a modest
+    # 2 TFLOPS so the MFU guard still triggers on absurd rates rather than
+    # dividing by peak=0
+    from fedml_tpu.core.distributed import device_specs
+
+    return device_specs.peak_tflops(
+        getattr(device, "device_kind", ""), dtype_bits)
 
 
 def _cost_analysis_flops(lowered_compiled) -> float | None:
@@ -366,31 +360,14 @@ def _bench_llm_tpu(reps: int = 10, attention_impl: str = "pallas", remat: bool =
     }
 
 
-# Datasheet HBM per JAX *device* (not per chip), matched by substring
-# against device_kind (lowercased). Needed because some runtimes (the axon
-# tunnel backend, measured r5) expose no memory_stats()['bytes_limit'] —
-# without a capacity the memplan verdict silently degraded to null.
-# v2/v3 expose each core as a device (half the chip's HBM); v4+ megacore
-# and v5e/v6e single-core chips expose whole-chip HBM.
-_HBM_BYTES_BY_DEVICE_KIND: list[tuple[str, int]] = [
-    ("v5 lite", 16 * 2**30),   # v5e, 16 GiB/chip, 1 core/chip
-    ("v5litepod", 16 * 2**30),
-    ("v5e", 16 * 2**30),
-    ("v5p", 95 * 2**30),       # 95 GiB/chip
-    ("v6 lite", 32 * 2**30),   # v6e / trillium
-    ("v6e", 32 * 2**30),
-    ("v4", 32 * 2**30),        # megacore: device == chip
-    ("v3", 16 * 2**30),        # 32 GiB/chip, 2 devices/chip
-    ("v2", 8 * 2**30),
-]
-
-
 def _device_hbm_fallback(device_kind: str) -> int | None:
-    kind = str(device_kind).lower()
-    for sub, cap in _HBM_BYTES_BY_DEVICE_KIND:
-        if sub in kind:
-            return cap
-    return None
+    """Datasheet HBM per JAX *device* (device_specs table), needed because
+    some runtimes (the axon tunnel backend, measured r5) expose no
+    memory_stats()['bytes_limit'] — without a capacity the memplan verdict
+    silently degraded to null."""
+    from fedml_tpu.core.distributed import device_specs
+
+    return device_specs.device_hbm_bytes(device_kind)
 
 
 def _bench_memplan():
@@ -1994,6 +1971,172 @@ def _bench_slo_overhead():
     }
 
 
+def _bench_devperf_overhead(reps: int = 40):
+    """Devperf registry overhead + live-vs-analytic MFU parity (ISSUE 17).
+
+    Runs a real (tiny-aware) llama train step instrumented through
+    ``devperf.instrument`` with the SAME analytic FLOPs/token hint bench's
+    own MFU pipeline uses, folds each measured step via ``observe_step``,
+    and publishes:
+
+    - ``llm_mfu``: the registry's aggregate MFU — the number /statusz and
+      ``fedml_device_mfu`` would show for this run;
+    - ``llm_mfu_analytic``: bench's ``_mfu_from_rate`` on the same window —
+      the two must agree within 15% (integrity-guarded) or the live fold
+      arithmetic has drifted from the published pipeline;
+    - ``devperf_overhead_pct``: the registry's self-accounted cost (AOT
+      capture extraction + folds + HBM sampler sweeps) as a share of loop
+      wall — must stay under FEDML_DEVPERF_OVERHEAD_TOL_PCT (default 1%).
+
+    Zero-recompile is integrity-guarded: the instrumented step's AOT
+    capture must be the ONE trace (``jax.compiles.bench_devperf_step`` == 1
+    after the full loop)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from fedml_tpu.core import telemetry as tel
+    from fedml_tpu.core.telemetry import devperf
+    from fedml_tpu.parallel.fsdp import causal_lm_loss
+
+    if not devperf.enabled():
+        return {"skipped": "devperf_disabled"}
+    tiny = os.environ.get("FEDML_BENCH_TINY") == "1"
+    reps = 12 if tiny else reps
+
+    t = tel.get_telemetry()
+    tel_was_enabled = t.enabled
+    t.set_enabled(True)
+    t.reset()
+    devperf.reset()
+
+    model, cfg, params = _build_llm("xla", remat=False)
+    s = _llm_shape()
+    vocab, seq, bs = s["vocab"], s["seq"], s["bs"]
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    tokens_per_step = bs * seq
+    analytic_step_flops = _analytic_llm_step_flops(dict(s, bs=bs), n_params)
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(params)
+
+    def body(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: causal_lm_loss(model.apply({"params": p}, tokens), tokens)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    step = jax.jit(tel.track_compiles(body, name="bench_devperf_step"))
+    fn = devperf.instrument(
+        step, "bench_devperf",
+        flops_per_token_hint=analytic_step_flops / tokens_per_step)
+
+    rng = np.random.default_rng(0)
+    batches = [jnp.asarray(rng.integers(0, vocab, (bs, seq)).astype(np.int32))
+               for _ in range(reps + 1)]
+    try:
+        sampler = devperf.start_hbm_sampler(interval_s=0.05)
+        _p(f"devperf_overhead: capture + warmup ({n_params/1e6:.0f}M params, "
+           f">= {reps} reps)")
+        p, o, loss = fn(params, opt_state, batches[reps])  # AOT capture
+        float(loss)
+
+        # bill only overhead accrued DURING the measured window: the sampler
+        # also sweeps through compile/warmup above, and charging that against
+        # the loop's wall would indict time the loop never spent
+        overhead_ms0 = float(devperf.snapshot()["overhead_ms"])
+        wall0 = time.perf_counter()
+        dts = []
+        done = 0
+        # at least `reps` steps AND >= 1.5s of wall: a tiny-mode step is
+        # ~20ms, and a sub-second window makes the fixed-cadence sampler's
+        # handful of sweeps look like percent-scale overhead
+        while done < reps or time.perf_counter() - wall0 < 1.5:
+            r0 = time.perf_counter()
+            p, o, loss = fn(p, o, batches[done % len(batches)])
+            float(loss)  # scalar fetch: forces step completion
+            dt = time.perf_counter() - r0
+            dts.append(dt)
+            devperf.observe_step("bench_devperf", dt, tokens=tokens_per_step)
+            done += 1
+            if done >= reps * 200:                 # pathological-fast guard
+                break
+        wall_s = time.perf_counter() - wall0
+        reps = done
+        overhead_pct = 100.0 * (
+            (float(devperf.snapshot()["overhead_ms"]) - overhead_ms0)
+            / 1e3) / wall_s
+        if sampler is not None:
+            sampler.sample_once()  # >= 1 sweep even on a sub-interval run
+
+        compiles = tel.compile_count("bench_devperf_step")
+        snap = devperf.snapshot()
+        rec = snap["programs"].get("bench_devperf") or {}
+        hbm_samples = int(snap["sampler"]["samples"])
+    finally:
+        devperf.stop_hbm_sampler()
+        devperf.reset()
+        if not tel_was_enabled:
+            t.set_enabled(False)
+
+    if compiles != 1:
+        raise BenchIntegrityError(
+            f"devperf_overhead: instrumented step traced {compiles}x (want "
+            "exactly 1 — the AOT capture must BE the jit's one trace); "
+            "refusing to publish")
+    if not rec.get("captured") or int(rec.get("steps") or 0) != reps:
+        raise BenchIntegrityError(
+            f"devperf_overhead: registry never captured/folded the step "
+            f"(captured {rec.get('captured')}, steps {rec.get('steps')}); "
+            "overhead figure is meaningless; refusing to publish")
+
+    # registry aggregate MFU vs bench's published tokens/sec -> MFU pipeline
+    # on the SAME window: same FLOPs hint + same peak table, so disagreement
+    # means the fold arithmetic drifted
+    peak = float(rec["peak_flops_per_sec"])
+    mfu_registry = (analytic_step_flops * reps) / (
+        float(rec["device_seconds"]) * peak)
+    mean_dt = sum(dts) / len(dts)
+    mfu_analytic = _mfu_from_rate(
+        tokens_per_step / mean_dt, analytic_step_flops, tokens_per_step, peak)
+    rel_err = abs(mfu_registry / mfu_analytic - 1.0)
+    _check_mfu("devperf_overhead", mfu_registry)
+    xla_ratio = (float(rec["flops_xla"]) / analytic_step_flops
+                 if rec.get("flops_xla") else None)
+
+    _p(f"devperf_overhead: {reps} steps in {wall_s:.2f}s, registry MFU "
+       f"{mfu_registry:.4f} vs analytic {mfu_analytic:.4f} "
+       f"(rel err {100.0 * rel_err:.2f}%), overhead "
+       f"{overhead_pct:.4f}% of wall, {hbm_samples} hbm sweeps")
+
+    if rel_err > 0.15:
+        raise BenchIntegrityError(
+            f"devperf_overhead: registry MFU {mfu_registry:.4f} vs bench "
+            f"analytic {mfu_analytic:.4f} (rel err {100.0 * rel_err:.1f}% > "
+            "15%) — the live fold arithmetic disagrees with the published "
+            "MFU pipeline; refusing to publish")
+    tol_pct = float(os.environ.get("FEDML_DEVPERF_OVERHEAD_TOL_PCT", "1.0"))
+    if overhead_pct >= tol_pct:
+        raise BenchIntegrityError(
+            f"devperf_overhead: registry consumed {overhead_pct:.4f}% of the "
+            f"step-loop wall (>= {tol_pct}%); always-on observability must "
+            "be ~free; refusing to publish")
+
+    return {
+        "llm_mfu": round(mfu_registry, 6),
+        "llm_mfu_analytic": round(mfu_analytic, 6),
+        "llm_mfu_rel_err": round(rel_err, 6),
+        "devperf_overhead_pct": round(overhead_pct, 4),
+        "devperf_flops_source": rec.get("flops_source"),
+        "devperf_xla_vs_analytic_flops_ratio": (
+            round(xla_ratio, 4) if xla_ratio is not None else None),
+        "devperf_roofline_verdict": rec.get("roofline_verdict"),
+        "devperf_steps": reps,
+        "devperf_window_s": round(wall_s, 2),
+        "devperf_hbm_samples": hbm_samples,
+    }
+
+
 def _bench_placement_search(probe_publishes: int = 4, reps: int = 2):
     """Auto-placement search (ISSUE 11): cost-model-seeded, measurement-
     refined search (core/engine/placement_search.py) vs the hand-picked
@@ -3164,6 +3307,8 @@ def _stage_result(name: str) -> dict:
         out = _retry_transient(_bench_pipeline_overlap)
     elif name == "slo_overhead":
         out = _bench_slo_overhead()
+    elif name == "devperf_overhead":
+        out = _bench_devperf_overhead()
     elif name == "placement_search":
         out = _retry_transient(_bench_placement_search)
     elif name == "llm_pallas_tuned":
@@ -3236,6 +3381,11 @@ _STAGES: list[tuple[str, int]] = [
     # ticks must stay under 1% of loop wall (integrity-guarded). Pure
     # CPU/numpy — seconds of work; the budget covers interpreter start
     ("slo_overhead", 180),
+    # devperf registry overhead + live-vs-analytic MFU parity: a real
+    # (tiny-aware) instrumented llama step loop; registry MFU must match
+    # bench's _mfu_from_rate within 15% and the registry's self-accounted
+    # cost must stay under 1% of loop wall (both integrity-guarded)
+    ("devperf_overhead", 240),
     # auto-placement search: cost-model-seeded probes over (strategy x
     # publish_k x staleness exponent) on two workloads; default-vs-searched
     # speedup + the winning PlacementPlan JSON artifact (zero-retrace +
@@ -3919,6 +4069,21 @@ def main() -> None:
                 out[key] = slo_out[key]
     elif slo_out is not None:
         out["slo_overhead_skipped"] = slo_out["skipped"]
+
+    devperf_out = stage_out.get("devperf_overhead")
+    if devperf_out is not None and "skipped" not in devperf_out:
+        # devperf headline (tools/bench_watch.sh surfaces these): the live
+        # registry's MFU for the llama step (must track the analytic MFU —
+        # integrity-guarded in-stage) + the registry's cost share of wall
+        for key in ("llm_mfu", "llm_mfu_analytic", "llm_mfu_rel_err",
+                    "devperf_overhead_pct", "devperf_flops_source",
+                    "devperf_xla_vs_analytic_flops_ratio",
+                    "devperf_roofline_verdict", "devperf_steps",
+                    "devperf_window_s", "devperf_hbm_samples"):
+            if devperf_out.get(key) is not None:
+                out[key] = devperf_out[key]
+    elif devperf_out is not None:
+        out["devperf_overhead_skipped"] = devperf_out["skipped"]
 
     placement = stage_out.get("placement_search")
     if placement is not None and "skipped" not in placement:

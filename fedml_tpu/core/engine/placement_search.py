@@ -72,6 +72,33 @@ _DISPATCH_OVERHEAD_S = {
 _HOST_AGG_BYTES_PER_S = 4e9      # single-device fold throughput prior
 _PUBLISH_OVERHEAD_S = 1e-3       # buffer publish (finalize + install) prior
 
+_UNSET = object()
+_HBM_BUDGET_CACHE: Any = _UNSET
+
+
+def _default_hbm_budget_bytes() -> Optional[int]:
+    """Datasheet HBM of the attached accelerator (``core/distributed/
+    device_specs.py`` — the same table bench and devperf read): the cost
+    model's feasibility ceiling when the profile doesn't pin one. None on
+    hosts without a recognized device kind (CPU dev boxes), which keeps
+    feasibility pruning off there, exactly the pre-ISSUE-17 behavior."""
+    global _HBM_BUDGET_CACHE
+    if _HBM_BUDGET_CACHE is _UNSET:
+        budget: Optional[int] = None
+        try:
+            import jax
+
+            from ..distributed import device_specs
+
+            devices = jax.local_devices()
+            if devices:
+                budget = device_specs.device_hbm_bytes(
+                    getattr(devices[0], "device_kind", ""))
+        except Exception:  # noqa: BLE001 - no backend: prune nothing
+            budget = None
+        _HBM_BUDGET_CACHE = budget
+    return _HBM_BUDGET_CACHE
+
 
 @dataclass(frozen=True)
 class PlacementCandidate:
@@ -248,7 +275,10 @@ def cost_model(profile: WorkloadProfile, cand: PlacementCandidate) -> float:
     devices = cand.n_mesh_devices()
     shards = devices if cand.partition == PARTITION_VEC else 1
     hbm_high_water = 2.0 * profile.model_bytes / shards  # acc + incoming bucket
-    if profile.hbm_budget_bytes is not None and hbm_high_water > profile.hbm_budget_bytes:
+    budget = profile.hbm_budget_bytes
+    if budget is None:
+        budget = _default_hbm_budget_bytes()  # attached chip's datasheet HBM
+    if budget is not None and hbm_high_water > budget:
         return float("-inf")
     fold_s_per_client = profile.model_bytes / (_HOST_AGG_BYTES_PER_S * shards)
     if profile.is_async:

@@ -662,7 +662,7 @@ class RoundEngine:
 
     def run(self, w_global: PyTree) -> PyTree:
         from ..alg_frame.context import Context
-        from ..telemetry import slo
+        from ..telemetry import devperf, slo
 
         p = self.span_prefix
         comm_round = int(getattr(self.args, "comm_round", 10))
@@ -671,6 +671,7 @@ class RoundEngine:
             w_global, start_round = self.resume_fn(w_global)
         freq = int(getattr(self.args, "frequency_of_the_test", 5))
         slo_engine = slo.activate(self.args, front="engine")
+        devperf.start_hbm_sampler()
         try:
             for round_idx in range(start_round, comm_round):
                 log.info("================ Communication round : %d", round_idx)
@@ -697,6 +698,7 @@ class RoundEngine:
                 if self.log_summary:
                     mlops.log_telemetry_summary(round_idx)
         finally:
+            devperf.stop_hbm_sampler()
             slo.deactivate(slo_engine)
         if self.finalize_fn is not None:
             self.finalize_fn(w_global)

@@ -28,6 +28,8 @@ from .core import (
     summary,
     timed,
 )
+from . import devperf
+from .devperf import CompiledProgramRegistry, HbmSampler
 from .flight_recorder import FlightRecorder
 from .fleet import FleetTelemetry
 from .health import ClientHealth, HealthReport, HealthTracker
@@ -54,8 +56,11 @@ from .trace_context import (
 
 __all__ = [
     "Telemetry",
+    "CompiledProgramRegistry",
     "Counter",
+    "HbmSampler",
     "Histogram",
+    "devperf",
     "FleetTelemetry",
     "FlightRecorder",
     "ClientHealth",

@@ -242,6 +242,16 @@ def render(telemetry: Optional[Telemetry] = None,
         slo_gauges = []
     if slo_gauges:
         gauges = list(gauges) + slo_gauges if gauges else slo_gauges
+    # device-performance gauges (fedml_device_mfu{program=}, per-device HBM
+    # live/high-water bytes) ride along once any instrumented program ran
+    try:
+        from . import devperf as _devperf
+
+        devperf_gauges = _devperf.prom_gauges()
+    except Exception:  # noqa: BLE001 - metrics must render without devperf
+        devperf_gauges = []
+    if devperf_gauges:
+        gauges = list(gauges) + devperf_gauges if gauges else devperf_gauges
     if gauges:
         seen_fams = set()
         for name, labels, value in gauges:

@@ -121,6 +121,17 @@ _ENGINE_PACK: List[Dict[str, Any]] = [
     dict(name="pipeline_stage_stall_p99_seconds",
          series="pipeline.stage_stall_seconds", signal="quantile", q=0.99,
          comparator="<=", target=120.0),
+    # devperf: an instrumented step whose achieved-FLOPs/s collapses to
+    # ~zero of peak means the device is stalled (preempted, throttled, or
+    # host-bound), not merely slow — even CPU fallback runs against the
+    # unknown-chip peak sit orders of magnitude above this floor, so only
+    # a genuine collapse (or a chaos drill) trips it. No samples = no
+    # opinion, so un-instrumented runs never alert.
+    dict(name="mfu_collapse", series="devperf.mfu.*", signal="avg",
+         comparator=">=", target=1e-5),
+    # HBM high-water near the device limit: the next admission/rebatch OOMs
+    dict(name="hbm_high_water", series="devperf.hbm_high_water_frac",
+         signal="max", comparator="<=", target=0.95),
 ]
 
 _CROSS_SILO_PACK: List[Dict[str, Any]] = _ENGINE_PACK + [
@@ -150,6 +161,12 @@ _SERVING_PACK: List[Dict[str, Any]] = [
     # waiting on pages (raise num_pages or shrink budgets before TTFT tips)
     dict(name="kv_alloc_deferred_rate", series="serving.kv.alloc_deferred",
          signal="rate", comparator="<=", target=1.0),
+    # same devperf pair as the engine pack: decode-step MFU collapse and
+    # HBM high-water are serving incidents too (see _ENGINE_PACK notes)
+    dict(name="mfu_collapse", series="devperf.mfu.*", signal="avg",
+         comparator=">=", target=1e-5),
+    dict(name="hbm_high_water", series="devperf.hbm_high_water_frac",
+         signal="max", comparator="<=", target=0.95),
 ]
 
 DEFAULT_PACKS: Dict[str, List[Dict[str, Any]]] = {

@@ -123,6 +123,17 @@ def render(service: Optional[str] = None,
             doc["sections"]["alerts"] = alerts
     except Exception as e:  # noqa: BLE001 - status page must not throw
         doc["sections"]["alerts"] = {"error": repr(e)}
+    # the devperf section (per-program achieved FLOPs/s, MFU, roofline
+    # verdicts, HBM gauges) is always-on: any process that ran an
+    # instrumented step has programs to show
+    try:
+        from . import devperf as _devperf
+
+        dev = _devperf.statusz_snapshot()
+        if dev:
+            doc["sections"]["devperf"] = dev
+    except Exception as e:  # noqa: BLE001 - status page must not throw
+        doc["sections"]["devperf"] = {"error": repr(e)}
     with _sections_lock:
         providers = dict(_sections)
     for name, provider in sorted(providers.items()):

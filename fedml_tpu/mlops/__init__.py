@@ -280,6 +280,20 @@ def stop_profiler_trace() -> Optional[str]:
     jax.profiler.stop_trace()
     rt._trace_dir = None
     rt.append_record({"type": "event_ended", "name": "jax_profiler_trace", "value": logdir})
+    # drop the devperf registry snapshot (per-program FLOPs, MFU, roofline,
+    # HBM high-water) next to the XLA trace: XProf shows WHERE device time
+    # went, the snapshot says how far that was from peak
+    try:
+        import json as _json
+
+        from ..core.telemetry import devperf as _devperf
+
+        snap_path = os.path.join(logdir, "devperf_snapshot.json")
+        with open(snap_path, "w", encoding="utf-8") as f:
+            _json.dump(_devperf.snapshot(), f, indent=2, sort_keys=True, default=str)
+        rt.append_record({"type": "event_ended", "name": "devperf_snapshot", "value": snap_path})
+    except Exception:  # noqa: BLE001 - the trace itself must still be returned
+        log.exception("devperf snapshot dump failed")
     return logdir
 
 

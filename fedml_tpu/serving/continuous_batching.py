@@ -47,7 +47,7 @@ import numpy as np
 
 from ..core import telemetry as tel
 from ..core.pipeline.executor import PipelinedExecutor, PipelineError, StageSpec
-from ..core.telemetry import track_compiles, tsdb
+from ..core.telemetry import devperf, track_compiles, tsdb
 from ..models.transformer import TransformerConfig
 from ..train.llm.generation import (
     _lru_get,
@@ -136,7 +136,8 @@ def _cb_step_fn(cfg: TransformerConfig, B: int, C: int):
         # donate the cache pool (arg 1): halves peak HBM for the biggest
         # buffer in serving; CPU has no donation, so gate to avoid warnings
         donate = (1,) if jax.default_backend() == "tpu" else ()
-        return jax.jit(track_compiles(run, name="cb_step"), donate_argnums=donate)
+        fn = jax.jit(track_compiles(run, name="cb_step"), donate_argnums=donate)
+        return devperf.instrument(fn, "cb_step")
 
     return _lru_get(("cb_step", cfg, B, C), build)
 
@@ -202,6 +203,9 @@ class ContinuousBatchingEngine:
     slot frees); ``generate()`` is the blocking convenience. One engine
     owns one cache pool and one worker thread; model params are shared,
     read-only."""
+
+    #: devperf registry label for the decode executable this engine drives
+    _devperf_label = "cb_step"
 
     def __init__(
         self,
@@ -492,7 +496,7 @@ class ContinuousBatchingEngine:
                 [s is not None for s in self._slots], bool
             )
         fn = self._step_fn()
-        with tel.timed("serving.cb.chunk", slots=int(active_mask.sum())):
+        with tel.timed("serving.cb.chunk", slots=int(active_mask.sum())) as sp:
             cache, tok, lengths, keys, toks = fn(
                 self._params,
                 self._cache,
@@ -504,6 +508,8 @@ class ContinuousBatchingEngine:
                 jnp.asarray(active_mask),
             )
             toks = np.asarray(toks)  # [B, C]; forces chunk completion
+        devperf.observe_step(self._devperf_label, sp.duration_s,
+                             tokens=int(active_mask.sum()) * self._C)
         self._cache = cache
         # np.array (not asarray): device arrays view as READ-ONLY numpy;
         # these mirrors are mutated per-slot at admit time
@@ -648,6 +654,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                          max_queue=max_queue)
 
     # -- cache + step wiring ------------------------------------------------
+
+    _devperf_label = "paged_step"
 
     def _build_cache(self):
         return paged_pool_init(self._params, self._paged_cfg, self._B)

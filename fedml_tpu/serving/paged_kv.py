@@ -45,7 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import telemetry as tel
-from ..core.telemetry import track_compiles
+from ..core.telemetry import devperf, track_compiles
 from ..models.transformer import TransformerConfig
 from ..train.llm.generation import _lru_get, _rewind_cache, _sample, decode_model
 
@@ -211,8 +211,9 @@ def _paged_step_fn(cfg: TransformerConfig, B: int, C: int):
             return pool, tok, lengths, keys, toks.swapaxes(0, 1)  # [B, C]
 
         donate = (1,) if jax.default_backend() == "tpu" else ()
-        return jax.jit(track_compiles(run, name="paged_step"),
-                       donate_argnums=donate)
+        fn = jax.jit(track_compiles(run, name="paged_step"),
+                     donate_argnums=donate)
+        return devperf.instrument(fn, "paged_step")
 
     return _lru_get(("paged_step", cfg, B, C), build)
 

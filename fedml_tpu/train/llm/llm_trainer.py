@@ -21,6 +21,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...core import telemetry as tel
+from ...core.telemetry import devperf
 from ...models.lora import lora_mask
 from ...models.transformer import TransformerConfig, TransformerLM
 from ...parallel.fsdp import make_fsdp_train_step, param_shardings
@@ -162,7 +163,20 @@ class LLMTrainer:
             apply_fn, tx, self.mesh, seq_axis=seq_axis, batch_axes=batch_axes
         )
         self.params, self.opt_state = init_fn(params)
-        self._step_fn = compile_step(self.params, self.opt_state)
+        self._devperf_label = "llm_train"
+        self._step_fn = devperf.instrument(
+            compile_step(self.params, self.opt_state), self._devperf_label,
+            n_devices=self.mesh.devices.size,
+            flops_per_token_hint=self._flops_per_token_hint(self.params))
+
+    def _flops_per_token_hint(self, params) -> float:
+        """Analytic model FLOPs/token (6*N matmul + causal attention term,
+        bench.py's convention): the registry's MFU numerator, so live MFU
+        and bench's analytic MFU agree on the same run."""
+        n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+        n_matmul = n_params - self.cfg.vocab_size * self.cfg.d_model
+        return (6.0 * n_matmul
+                + 6.0 * self.cfg.n_layers * self.cfg.d_model * self.cfg.max_seq_len)
 
     def _build_pp(self, params):
         """GPipe pipeline mode (ExperimentArguments.pp > 1): params live in
@@ -201,7 +215,10 @@ class LLMTrainer:
 
         self.params = p3
         self.opt_state = opt_state
-        self._step_fn = step
+        self._devperf_label = "llm_train_pp"
+        self._step_fn = devperf.instrument(
+            step, self._devperf_label, n_devices=self.mesh.devices.size,
+            flops_per_token_hint=self._flops_per_token_hint(p3))
         self._pp_mode = True
 
     def named_params(self):
@@ -262,6 +279,11 @@ class LLMTrainer:
         final_loss = float(jax.device_get(losses[-1])) if losses else float("nan")
         tokens_per_sec = tokens_seen / dt if dt > 0 else 0.0
         tel.histogram("llm.tokens_per_sec").observe(tokens_per_sec)
+        # fold the window's measured wall into the devperf registry: live
+        # per-program MFU/roofline on the same numbers the span recorded
+        devperf.observe_window(
+            getattr(self, "_devperf_label", "llm_train"), dt,
+            steps=step + 1, tokens=tokens_seen)
         metrics = {
             "final_loss": final_loss,
             "steps": step + 1,

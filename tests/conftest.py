@@ -75,6 +75,12 @@ def _reset_singletons():
     from fedml_tpu.core.telemetry import slo as _slo
 
     _slo.reset()
+    # devperf registry + HBM sampler are process-wide ride-alongs too: a
+    # leaked program row or running sampler thread would surface in later
+    # tests' expositions
+    from fedml_tpu.core.telemetry import devperf as _devperf
+
+    _devperf.reset()
 
 
 def spawn_to_logs(cmds, tmp_path, env=None, timeout=600, names=None):

@@ -246,6 +246,16 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
                               "pipeline_plan_reason": "balanced",
                               "pipeline_clients": 3,
                               "pipeline_bottleneck": "train"}, None),
+        "devperf_overhead": ({"llm_mfu": 0.018,
+                              "llm_mfu_analytic": 0.018,
+                              "llm_mfu_rel_err": 0.0,
+                              "devperf_overhead_pct": 0.19,
+                              "devperf_flops_source": "caller_analytic",
+                              "devperf_xla_vs_analytic_flops_ratio": 1.16,
+                              "devperf_roofline_verdict": "bandwidth-bound",
+                              "devperf_steps": 83,
+                              "devperf_window_s": 1.5,
+                              "devperf_hbm_samples": 43}, None),
     })
     with pytest.raises(SystemExit) as exc:
         bench.main()
@@ -281,6 +291,9 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
     assert out["alerts_fired"] == 1
     assert out["pipeline_overlap_frac"] == 0.88
     assert out["pipeline_speedup"] == 1.44
+    assert out["llm_mfu"] == 0.018
+    assert out["devperf_overhead_pct"] == 0.19
+    assert out["devperf_roofline_verdict"] == "bandwidth-bound"
     assert out["stages_failed"] == []
     # incremental artifacts landed (one per stage + final, same stamp file)
     arts = glob.glob(str(tmp_path / "BENCH_MEASURED_*.json"))

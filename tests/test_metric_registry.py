@@ -76,6 +76,14 @@ EXPORTED = {
     "fedml_server_aggregate_seconds": "histogram",
     "fedml_server_shard_bytes": "gauge",
     "fedml_device_hbm_peak_bytes": "gauge",
+    # device-performance registry (core/telemetry/devperf.py; program gauges
+    # labeled {program}, HBM gauges labeled {device})
+    "fedml_device_mfu": "gauge",
+    "fedml_device_flops_per_sec": "gauge",
+    "fedml_device_hbm_bytes": "gauge",
+    "fedml_device_hbm_high_water_bytes": "gauge",
+    "fedml_program_flops_total": "counter",
+    "fedml_program_steps_total": "counter",
     # training
     "fedml_llm_tokens_per_sec": "histogram",
     # serving

@@ -68,6 +68,8 @@ HEADLINES: Dict[str, str] = {
     "pipeline_overlap_frac": "higher",       # ISSUE 15 stage executor
     "pipeline_speedup": "higher",
     "slo_overhead_pct": "lower",             # ISSUE 14 evaluator guard
+    "llm_mfu": "higher",                     # ISSUE 17 devperf registry MFU
+    "devperf_overhead_pct": "lower",         # ISSUE 17 registry cost guard
     "_llm_pallas.tokens_per_sec": "higher",
     "_llm_pallas.mfu": "higher",
 }

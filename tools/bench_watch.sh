@@ -84,6 +84,7 @@ commit_artifacts() {
       surface_async_rounds
       surface_wan_profile
       surface_pipeline_overlap
+      surface_devperf
       surface_placement
       surface_resilience
       surface_serving
@@ -224,6 +225,32 @@ if doc.get("pipeline_overlap_frac") is not None:
 PYEOF
 ) || return 0
   [ -n "$pipe" ] && log "$pipe"
+}
+
+surface_devperf() {
+  # one-line view of the devperf stage: the live registry's MFU vs bench's
+  # analytic MFU (parity is integrity-guarded in-stage) plus the registry's
+  # self-accounted overhead share — so the watcher log answers "is the
+  # always-on device-perf layer still honest and still free" without
+  # opening BENCH_MEASURED_*.json
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1) || return 0
+  [ -n "$newest" ] || return 0
+  local dp
+  dp=$(python3 - "$newest" <<'PYEOF' 2>/dev/null
+import json, sys
+doc = json.load(open(sys.argv[1]))
+if doc.get("llm_mfu") is not None:
+    print(f"devperf: llm_mfu {doc['llm_mfu']} "
+          f"(analytic {doc.get('llm_mfu_analytic')}, "
+          f"rel_err {doc.get('llm_mfu_rel_err')}), "
+          f"overhead {doc.get('devperf_overhead_pct')}% of wall, "
+          f"{doc.get('devperf_roofline_verdict')} "
+          f"[{doc.get('devperf_flops_source')}], "
+          f"hbm_samples {doc.get('devperf_hbm_samples')}")
+PYEOF
+) || return 0
+  [ -n "$dp" ] && log "$dp"
 }
 
 surface_placement() {

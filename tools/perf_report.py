@@ -1,0 +1,225 @@
+"""Round-time attribution: join span telemetry with the devperf registry.
+
+``/metrics`` says how many seconds each span family consumed and the
+devperf section of ``/statusz`` says how close each compiled program ran
+to peak — this tool joins the two into the question operators actually
+ask: *where did the round's wall time go, and which programs burned the
+device time?*
+
+Buckets (first-match, over a CURATED set of non-overlapping leaf spans so
+nested wrappers — ``pipeline.*`` around ``client.*``, ``agg.*`` inside
+``{prefix}.aggregate`` — never double-count):
+
+- **compute**: device-bound work (client/LLM train steps, aggregation,
+  serving decode/prefill, split-learning halves)
+- **comm**: model movement (compress/upload/decompress, broadcast,
+  receive, paged admit waves)
+- **host**: host-side orchestration (cohort sampling, eval, fold)
+- **idle**: round wall minus the sum of the above, clamped at zero —
+  scheduler gaps, stragglers, anything unspanned
+
+Usage::
+
+    python -m tools.perf_report --metrics http://localhost:9100/metrics \
+        --statusz http://localhost:8080/statusz
+    python -m tools.perf_report --metrics metrics.txt --snapshot devperf_snapshot.json
+
+Everything network-ish is stdlib urllib; file paths work wherever a URL
+does. Pure helpers (``parse_span_seconds``, ``classify_span``,
+``attribute``) are import-safe with no jax dependency — tests drive them
+on synthetic data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_SPAN_SECONDS_RE = re.compile(
+    r'^fedml_span_seconds_total\{span="([^"]+)"\}\s+([0-9eE+.\-]+)\s*$')
+_SPAN_COUNT_RE = re.compile(
+    r'^fedml_span_count_total\{span="([^"]+)"\}\s+([0-9eE+.\-]+)\s*$')
+
+#: curated leaf spans per bucket; ``{p}`` expands to the engine span prefix
+_COMPUTE_SPANS = (
+    "client.train", "{p}.client_train", "{p}.aggregate", "llm.train",
+    "serving.cb.chunk", "serving.cb.prefill",
+    "split.client_backward", "split.server_grads",
+)
+_COMM_SPANS = (
+    "client.compress", "client.upload", "server.decompress",
+    "server.receive_model", "server.broadcast", "serving.paged.admit_wave",
+)
+_HOST_SPANS = (
+    "{p}.sample", "{p}.eval", "server.eval", "split.fold",
+)
+
+
+def parse_span_seconds(prom_text: str) -> Dict[str, float]:
+    """``fedml_span_seconds_total{span=...}`` lines -> {span: seconds}."""
+    out: Dict[str, float] = {}
+    for line in prom_text.splitlines():
+        m = _SPAN_SECONDS_RE.match(line)
+        if m:
+            out[m.group(1)] = float(m.group(2))
+    return out
+
+
+def parse_span_counts(prom_text: str) -> Dict[str, float]:
+    """``fedml_span_count_total{span=...}`` lines -> {span: count}."""
+    out: Dict[str, float] = {}
+    for line in prom_text.splitlines():
+        m = _SPAN_COUNT_RE.match(line)
+        if m:
+            out[m.group(1)] = float(m.group(2))
+    return out
+
+
+def _bucket_sets(prefix: str) -> List[Tuple[str, frozenset]]:
+    def expand(names):
+        return frozenset(n.format(p=prefix) for n in names)
+
+    return [("compute", expand(_COMPUTE_SPANS)),
+            ("comm", expand(_COMM_SPANS)),
+            ("host", expand(_HOST_SPANS))]
+
+
+def classify_span(name: str, prefix: str = "fedavg") -> Optional[str]:
+    """Bucket for a span name, or None when it is a wrapper/detail span
+    deliberately left out of attribution (first match wins)."""
+    for bucket, names in _bucket_sets(prefix):
+        if name in names:
+            return bucket
+    return None
+
+
+def attribute(span_seconds: Dict[str, float],
+              devperf_snapshot: Optional[Dict[str, Any]] = None,
+              *, prefix: str = "fedavg",
+              span_counts: Optional[Dict[str, float]] = None,
+              top_k: int = 5) -> Dict[str, Any]:
+    """Bucket total round wall time and name the top-k programs by device
+    time. ``devperf_snapshot`` is ``devperf.snapshot()`` (or the
+    ``devperf`` section of /statusz / the profiler-trace JSON dump)."""
+    round_span = f"{prefix}.round"
+    round_wall = float(span_seconds.get(round_span, 0.0))
+    buckets = {"compute": 0.0, "comm": 0.0, "host": 0.0}
+    unattributed: Dict[str, float] = {}
+    for name, secs in span_seconds.items():
+        if name == round_span:
+            continue
+        bucket = classify_span(name, prefix)
+        if bucket is None:
+            unattributed[name] = float(secs)
+        else:
+            buckets[bucket] += float(secs)
+    accounted = sum(buckets.values())
+    buckets["idle"] = max(0.0, round_wall - accounted)
+    rounds = float((span_counts or {}).get(round_span, 0.0))
+    report: Dict[str, Any] = {
+        "round_span": round_span,
+        "round_wall_s": round_wall,
+        "rounds": rounds,
+        "buckets_s": buckets,
+        "buckets_frac": {
+            k: (v / round_wall if round_wall > 0 else 0.0)
+            for k, v in buckets.items()
+        },
+        "unattributed_spans": dict(
+            sorted(unattributed.items(), key=lambda kv: -kv[1])),
+    }
+    programs = (devperf_snapshot or {}).get("programs", {})
+    ranked = sorted(programs.values(),
+                    key=lambda p: -float(p.get("device_seconds", 0.0)))
+    report["top_programs"] = [
+        {k: p.get(k) for k in ("label", "device_seconds", "mfu",
+                               "achieved_flops_per_sec", "flops_source",
+                               "roofline_verdict", "steps")}
+        for p in ranked[:max(0, int(top_k))]
+    ]
+    hbm = (devperf_snapshot or {}).get("hbm", {})
+    if hbm:
+        report["hbm"] = hbm
+    return report
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    lines = [f"round span: {report['round_span']}  "
+             f"wall={report['round_wall_s']:.3f}s  "
+             f"rounds={report['rounds']:.0f}"]
+    lines.append("-- wall-time attribution --")
+    for bucket in ("compute", "comm", "host", "idle"):
+        secs = report["buckets_s"][bucket]
+        frac = report["buckets_frac"][bucket]
+        lines.append(f"  {bucket:<8} {secs:>10.3f}s  {100.0 * frac:5.1f}%")
+    if report.get("top_programs"):
+        lines.append("-- top programs by device time --")
+        for p in report["top_programs"]:
+            mfu = p.get("mfu")
+            mfu_s = f"{100.0 * mfu:.2f}%" if isinstance(mfu, (int, float)) else "n/a"
+            lines.append(
+                f"  {p.get('label', '?'):<16} {float(p.get('device_seconds') or 0.0):>9.3f}s"
+                f"  mfu={mfu_s}  {p.get('roofline_verdict') or '?'}"
+                f"  [{p.get('flops_source') or '?'}]")
+    if report.get("unattributed_spans"):
+        lines.append("-- unattributed spans (wrappers/detail, not bucketed) --")
+        for name, secs in list(report["unattributed_spans"].items())[:10]:
+            lines.append(f"  {name:<32} {secs:>9.3f}s")
+    return "\n".join(lines)
+
+
+def _fetch(source: str) -> str:
+    """Read a URL (http/https) or a file path."""
+    if source.startswith("http://") or source.startswith("https://"):
+        from urllib.request import urlopen
+
+        with urlopen(source, timeout=10) as resp:  # noqa: S310 - operator-supplied
+            return resp.read().decode("utf-8", "replace")
+    with open(source, encoding="utf-8") as f:
+        return f.read()
+
+
+def _load_devperf(args) -> Optional[Dict[str, Any]]:
+    if args.snapshot:
+        return json.loads(_fetch(args.snapshot))
+    if args.statusz:
+        doc = json.loads(_fetch(args.statusz))
+        return doc.get("sections", {}).get("devperf")
+    return None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="attribute round wall time across compute/comm/host/idle "
+                    "and rank programs by device time")
+    ap.add_argument("--metrics", required=True,
+                    help="/metrics URL or a saved prometheus text file")
+    ap.add_argument("--statusz", help="/statusz URL or saved JSON (devperf section)")
+    ap.add_argument("--snapshot", help="devperf_snapshot.json path/URL "
+                                       "(overrides --statusz)")
+    ap.add_argument("--prefix", default="fedavg",
+                    help="engine span prefix (default: fedavg)")
+    ap.add_argument("--top-k", type=int, default=5)
+    ap.add_argument("--json", action="store_true", help="emit the report as JSON")
+    args = ap.parse_args(argv)
+
+    prom_text = _fetch(args.metrics)
+    report = attribute(
+        parse_span_seconds(prom_text),
+        _load_devperf(args),
+        prefix=args.prefix,
+        span_counts=parse_span_counts(prom_text),
+        top_k=args.top_k,
+    )
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(render_text(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
