@@ -1971,6 +1971,183 @@ def _bench_slo_overhead():
     }
 
 
+def _bench_modelwatch_overhead():
+    """Modelwatch fold-boundary stats overhead (ISSUE 18): per-client delta
+    statistics (norms, NaN/Inf counts, cosine drift) fused into the bucketed
+    fold plus the once-per-round publish-time ``finish``. Observability that
+    slows the round loop it watches is a bug — but the guard is a ratio, and
+    a fold-only denominator would be dishonest the other way: no real front
+    folds without having trained first (local training dominates every round
+    by orders of magnitude). So, like slo_overhead, this drives a
+    round-SHAPED loop — calibrated numpy work standing in for local
+    training, then the bucketed fold + publish — once plain and once
+    watched, and bills the difference in median round walls.
+
+    Integrity guards (BenchIntegrityError, refusing to publish):
+    - overhead: watched-vs-plain round wall delta must stay under
+      FEDML_MODELWATCH_OVERHEAD_TOL_PCT (default 1%);
+    - zero added recompiles: the fused watch variant and the stats programs
+      must be fully traced during warmup — any trace-counter growth inside
+      the timed loops fails the stage;
+    - parity: the watched fold must be bit-exact vs the plain fold on the
+      same cohort (stats must not change the math);
+    - detection: a NaN client and a 50x-scaled client injected after the
+      timed window must both be caught by the quarantine screen (an
+      overhead figure for a watcher that watches nothing is meaningless)."""
+    import numpy as np
+
+    import jax
+
+    from fedml_tpu.core import telemetry as tel
+    from fedml_tpu.core.aggregation.bucketed import BucketedAggregator
+    from fedml_tpu.core.telemetry import modelwatch
+    from fedml_tpu.core.telemetry.jax_hooks import compile_count
+
+    tiny = os.environ.get("FEDML_BENCH_TINY") == "1"
+    dim = 128 if tiny else 512
+    clients = 8 if tiny else 16
+    rounds = 8 if tiny else 16
+    work_ratio = 120.0 if tiny else 200.0  # train:fold wall ratio (see above)
+
+    t = tel.get_telemetry()
+    tel_was_enabled = t.enabled
+    t.set_enabled(True)
+    try:
+        rng = np.random.default_rng(0)
+
+        def _tree(scale=1.0):
+            return {"w": (rng.standard_normal((dim, dim)) * scale).astype(np.float32),
+                    "b": (rng.standard_normal((dim,)) * scale).astype(np.float32)}
+
+        # device-resident like a real server front: the global params never
+        # live host-side between rounds
+        ref = jax.tree.map(jax.numpy.asarray, _tree())
+        cohort = [(1.0, _tree()) for _ in range(clients)]
+        eng = BucketedAggregator(bucket_size=8)
+
+        def _fold_plain():
+            out = eng.aggregate(cohort)
+            jax.block_until_ready(jax.tree.leaves(out))
+            return out
+
+        def _fold_watched(prev_update):
+            sess = modelwatch.WatchSession(ref, prev_update=prev_update)
+            out = eng.aggregate(cohort, watch=sess)
+            stats = sess.finish(out)  # the one publish-time host fetch
+            return out, stats
+
+        # warmup compiles BOTH variants (+ the stats programs) and proves
+        # the fused fold is bit-exact vs the plain one on the same cohort
+        plain_out = _fold_plain()
+        watched_out, stats = _fold_watched(None)
+        prev_update = stats.update_tree
+        for x, y in zip(jax.tree.leaves(plain_out), jax.tree.leaves(watched_out)):
+            if not np.array_equal(np.asarray(x), np.asarray(y)):
+                raise BenchIntegrityError(
+                    "modelwatch_overhead: watched fold diverged from the "
+                    "plain fold bit pattern; stats must not change the math")
+        watched_out, stats = _fold_watched(prev_update)  # steady-state trace
+        prev_update = stats.update_tree
+
+        traces0 = (eng.accum_traces, eng.watch_traces,
+                   compile_count("agg_accum"), compile_count("modelwatch"))
+
+        # calibrate round-shaped work off the plain fold wall
+        fold_samples = []
+        for _ in range(5):
+            f0 = time.perf_counter()
+            _fold_plain()
+            fold_samples.append(time.perf_counter() - f0)
+        fold_s = max(float(np.median(fold_samples)), 1e-5)
+        # the work unit is round-shaped (ms-scale) regardless of model size:
+        # against a microsecond round even free stats look expensive, and
+        # the per-round floor keeps the fixed dispatch cost of the watch
+        # session honest at tiny model sizes too
+        work_elems = 512
+        a = rng.standard_normal((work_elems, work_elems))
+        b = rng.standard_normal((work_elems, work_elems))
+        w0 = time.perf_counter()
+        a = a @ b / float(work_elems)
+        unit_s = max(time.perf_counter() - w0, 1e-7)
+        round_s = max(work_ratio * fold_s, 1.5)
+        work_reps = max(1, min(4000, int(round_s / unit_s)))
+
+        # interleave plain/watched rounds so machine drift hits both arms of
+        # each pair equally; the guard compares paired-difference medians
+        plain_walls, watched_walls = [], []
+        for _ in range(rounds):
+            r0 = time.perf_counter()
+            for _ in range(work_reps):       # the "local training" itself
+                a = a @ b / float(work_elems)
+            _fold_plain()
+            t1 = time.perf_counter()
+            for _ in range(work_reps):
+                a = a @ b / float(work_elems)
+            _, stats = _fold_watched(prev_update)
+            prev_update = stats.update_tree
+            t2 = time.perf_counter()
+            plain_walls.append(t1 - r0)
+            watched_walls.append(t2 - t1)
+        if not np.isfinite(a).all():           # keep the matmul live
+            raise BenchIntegrityError("modelwatch_overhead: workload diverged")
+
+        traces1 = (eng.accum_traces, eng.watch_traces,
+                   compile_count("agg_accum"), compile_count("modelwatch"))
+        med_plain = float(np.median(plain_walls))
+        med_watched = float(np.median(watched_walls))
+        delta_s = float(np.median(np.asarray(watched_walls) -
+                                  np.asarray(plain_walls)))
+        overhead_pct = 100.0 * delta_s / med_plain
+
+        # detection liveness: the quarantine screen must catch an injected
+        # NaN client AND a 50x-scaled client on a fresh cohort
+        poisoned = list(cohort) + [(1.0, _tree(scale=50.0))]
+        nan_tree = _tree()
+        nan_tree["w"].flat[0] = np.nan
+        poisoned.append((1.0, nan_tree))
+        sess = modelwatch.WatchSession(ref)
+        kept = modelwatch.screen_cohort(sess, poisoned,
+                                        list(range(len(poisoned))),
+                                        quarantine=True)
+        caught = len(poisoned) - len(kept)
+    finally:
+        if not tel_was_enabled:
+            t.set_enabled(False)
+
+    _p(f"modelwatch_overhead: {rounds}+{rounds} rounds (work x{work_reps}, "
+       f"fold {fold_s * 1e3:.2f}ms), plain {med_plain * 1e3:.1f}ms vs "
+       f"watched {med_watched * 1e3:.1f}ms per round "
+       f"({overhead_pct:+.4f}%), detection caught {caught}/2")
+
+    if traces1 != traces0:
+        raise BenchIntegrityError(
+            f"modelwatch_overhead: trace counters moved during the timed "
+            f"loops ({traces0} -> {traces1}) — the fused watch fold "
+            "recompiled; refusing to publish")
+    if caught != 2:
+        raise BenchIntegrityError(
+            f"modelwatch_overhead: quarantine screen caught {caught}/2 "
+            "injected divergent clients — the watcher is not watching; "
+            "refusing to publish")
+    tol_pct = float(os.environ.get("FEDML_MODELWATCH_OVERHEAD_TOL_PCT", "1.0"))
+    if overhead_pct >= tol_pct:
+        raise BenchIntegrityError(
+            f"modelwatch_overhead: fold-boundary stats consumed "
+            f"{overhead_pct:.4f}% of the round wall (>= {tol_pct}%); "
+            "always-on observability must be ~free; refusing to publish")
+
+    return {
+        "modelwatch_overhead_pct": round(max(overhead_pct, 0.0), 4),
+        "modelwatch_plain_round_ms": round(med_plain * 1e3, 3),
+        "modelwatch_watched_round_ms": round(med_watched * 1e3, 3),
+        "modelwatch_fold_ms": round(fold_s * 1e3, 3),
+        "modelwatch_rounds": rounds,
+        "modelwatch_clients": clients,
+        "modelwatch_work_reps": work_reps,
+        "modelwatch_detection_caught": caught,
+    }
+
+
 def _bench_devperf_overhead(reps: int = 40):
     """Devperf registry overhead + live-vs-analytic MFU parity (ISSUE 17).
 
@@ -3309,6 +3486,8 @@ def _stage_result(name: str) -> dict:
         out = _bench_slo_overhead()
     elif name == "devperf_overhead":
         out = _bench_devperf_overhead()
+    elif name == "modelwatch_overhead":
+        out = _bench_modelwatch_overhead()
     elif name == "placement_search":
         out = _retry_transient(_bench_placement_search)
     elif name == "llm_pallas_tuned":
@@ -3381,6 +3560,11 @@ _STAGES: list[tuple[str, int]] = [
     # ticks must stay under 1% of loop wall (integrity-guarded). Pure
     # CPU/numpy — seconds of work; the budget covers interpreter start
     ("slo_overhead", 180),
+    # modelwatch fold-boundary stats overhead: plain vs watched bucketed
+    # fold inside a round-shaped loop; watched-vs-plain round wall delta
+    # < 1%, zero added recompiles, bit-exact parity, and injected
+    # NaN/scaled clients must be caught (all integrity-guarded)
+    ("modelwatch_overhead", 240),
     # devperf registry overhead + live-vs-analytic MFU parity: a real
     # (tiny-aware) instrumented llama step loop; registry MFU must match
     # bench's _mfu_from_rate within 15% and the registry's self-accounted
@@ -4069,6 +4253,20 @@ def main() -> None:
                 out[key] = slo_out[key]
     elif slo_out is not None:
         out["slo_overhead_skipped"] = slo_out["skipped"]
+
+    mw_out = stage_out.get("modelwatch_overhead")
+    if mw_out is not None and "skipped" not in mw_out:
+        # modelwatch headline (tools/bench_watch.sh surfaces these): the
+        # fold-boundary stats' cost share of a round-shaped loop + the
+        # detection liveness count, both integrity-guarded in-stage
+        for key in ("modelwatch_overhead_pct", "modelwatch_plain_round_ms",
+                    "modelwatch_watched_round_ms", "modelwatch_fold_ms",
+                    "modelwatch_rounds", "modelwatch_clients",
+                    "modelwatch_work_reps", "modelwatch_detection_caught"):
+            if mw_out.get(key) is not None:
+                out[key] = mw_out[key]
+    elif mw_out is not None:
+        out["modelwatch_overhead_skipped"] = mw_out["skipped"]
 
     devperf_out = stage_out.get("devperf_overhead")
     if devperf_out is not None and "skipped" not in devperf_out:

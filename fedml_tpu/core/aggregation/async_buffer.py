@@ -188,6 +188,13 @@ class AsyncAggBuffer:
         self.policy = policy or StalenessPolicy()
         self.engine = engine or get_engine()
         self._lock = threading.Lock()
+        # modelwatch: per-publish-window stat session riding the fused fold
+        # (enable_watch). None = stats off, the default path is untouched.
+        self._watch = None
+        self._watch_ranks: List[Any] = []
+        self._ledger = None
+        self._quarantine = False
+        self.quarantined_total = 0
         self._pending: List[Tuple[float, PyTree]] = []
         self._pending_meta: List[Dict[str, Any]] = []  # rank/staleness per pending
         self._acc: Optional[PyTree] = None
@@ -212,19 +219,70 @@ class AsyncAggBuffer:
         self.publish_interval_ewma_s: Optional[float] = None
         self._last_publish_mono: Optional[float] = None
 
+    # --- modelwatch --------------------------------------------------------
+    def enable_watch(self, ref: PyTree, ledger: Any = None,
+                     quarantine: bool = False) -> bool:
+        """Attach a modelwatch session: per-client delta stats vs ``ref``
+        (the current global model) ride the fused fold, fetched at each
+        publish and folded into ``ledger``. With ``quarantine``, arriving
+        outliers (streaming robust-z vs the ledger's recent-norm window, or
+        any NaN delta) get the ``outlier_rejected`` verdict instead of
+        folding. No-op (returns False) on engines without a fused watch
+        variant (sharded)."""
+        if not getattr(self.engine, "supports_watch", False) or not self._streaming():
+            return False
+        from ..telemetry import modelwatch
+
+        with self._lock:
+            self._watch = modelwatch.WatchSession(ref)
+            self._watch_ranks = []
+            self._ledger = ledger
+            self._quarantine = bool(quarantine)
+        return True
+
+    def _screen_arrival(self, rank: int, tree: PyTree) -> Optional[str]:
+        """Quarantine-mode admission: stat one arriving tree (single fused
+        dispatch + a tiny sync — the opt-in path pays it, the default path
+        never runs this) and refuse NaN deltas / robust-z outliers."""
+        watch = self._watch
+        if watch is None:
+            return None
+        from ..telemetry import modelwatch
+
+        row = np.asarray(modelwatch.client_stat(tree, watch))  # fedlint: disable=host-sync opt-in quarantine screen syncs one stat row pre-fold
+        sq = float(row[modelwatch.COL_SQ])
+        bad = float(row[modelwatch.COL_NAN]) + float(row[modelwatch.COL_INF])
+        norm = math.sqrt(sq) if sq >= 0.0 else float("nan")
+        z = self._ledger.streaming_z(norm) if self._ledger is not None else 0.0
+        if bad > 0 or not math.isfinite(norm) or z >= modelwatch.z_threshold():
+            with self._lock:
+                self.quarantined_total += 1
+            tel.get_telemetry().counter("modelwatch.quarantined").add(1)
+            if self._ledger is not None:
+                self._ledger.note_quarantined(rank, norm, z)
+            return quorum_mod.OUTLIER_REJECTED
+        if self._ledger is not None:
+            self._ledger.observe_stream_norm(norm)
+        return None
+
     # --- submit (receive-loop thread) --------------------------------------
     def submit(self, rank: int, model_params: PyTree, sample_num: float,
                client_version: Optional[int]) -> str:
         """Fold one arrival. Returns a quorum-vocabulary verdict:
         ``accept`` (fresh), ``stale_accepted`` (admitted with decayed
-        weight), or ``stale_rejected`` (beyond the admission cut — the
-        arrival is discarded, never folded)."""
+        weight), ``stale_rejected`` (beyond the admission cut), or
+        ``outlier_rejected`` (modelwatch quarantine) — rejected arrivals are
+        discarded, never folded."""
         staleness = 0 if client_version is None else max(0, self.version - int(client_version))
         if not self.policy.admit(staleness, rank):
             with self._lock:
                 self.stale_rejected_total += 1
             tel.get_telemetry().counter(quorum_mod.STALE_REJECTED_COUNTER).add(1)
             return quorum_mod.STALE_REJECTED
+        if self._quarantine:
+            verdict = self._screen_arrival(rank, model_params)
+            if verdict is not None:
+                return verdict
         weight = float(sample_num) * self.policy.weight(staleness)
         with tel.span("async.merge", rank=int(rank), staleness=int(staleness)):
             with self._lock:
@@ -276,7 +334,10 @@ class AsyncAggBuffer:
         while len(self._pending) >= b:
             chunk = [t for _, t in self._pending[:b]]
             w = np.asarray([w for w, _ in self._pending[:b]], dtype=np.float32)  # fedlint: disable=host-sync python-float weights per folded bucket, no device readback
-            self._acc = self.engine.accumulate_bucket(self._acc, chunk, w)
+            self._acc = self.engine.accumulate_bucket(self._acc, chunk, w,
+                                                      watch=self._watch)
+            if self._watch is not None:
+                self._watch_ranks.extend(m["rank"] for m in self._pending_meta[:b])
             self._weight_sum += float(w.sum())
             del self._pending[:b]
             del self._pending_meta[:b]
@@ -289,10 +350,35 @@ class AsyncAggBuffer:
     def publish(self) -> Optional[PyTree]:
         """Fold the ragged pending tail, normalize, advance the model
         version, and return the new global model (None when nothing was
-        merged since the last publish)."""
+        merged since the last publish).
+
+        With a watch session attached, the window's stat blocks are fetched
+        HERE — on the same host transfer that materializes the published
+        aggregate — folded into the ledger, and a fresh session (ref = the
+        new model, prev = this window's update direction) is installed."""
         with tel.span("async.publish", version=self.version):
             with self._lock:
-                return self._publish_locked()
+                out = self._publish_locked()
+                watch, ranks = self._watch, self._watch_ranks
+                version = self.version
+                if watch is not None and out is not None:
+                    # detach while finishing: concurrent submits fold unwatched
+                    # for the instants between publish and the fresh session
+                    self._watch = None
+                    self._watch_ranks = []
+            if watch is None or out is None:
+                return out
+        from ..telemetry import modelwatch
+
+        watch.ranks = ranks
+        stats = watch.finish(out)
+        if self._ledger is not None:
+            self._ledger.observe_round(version, stats)
+        fresh = modelwatch.WatchSession(out, prev_update=stats.update_tree)
+        with self._lock:
+            if self._watch is None:  # a concurrent enable_watch wins otherwise
+                self._watch = fresh
+        return out
 
     def _publish_locked(self) -> Optional[PyTree]:
         if self._merges_since_publish == 0:
@@ -302,17 +388,24 @@ class AsyncAggBuffer:
             # through the engine's own normalized aggregate — BIT-IDENTICAL
             # to the synchronous path, which is the parity guard's anchor
             self.last_publish_weight = float(sum(w for w, _ in self._pending))
-            out = self.engine.aggregate(list(self._pending))
+            if self._watch is not None:
+                self._watch_ranks.extend(m["rank"] for m in self._pending_meta)
+            out = self.engine.aggregate(list(self._pending), watch=self._watch)
         else:
             if self._pending:
                 b = self.engine.bucket_size
                 chunk = [t for _, t in self._pending]
                 w = np.asarray([w for w, _ in self._pending], dtype=np.float32)
-                pad = b - len(chunk)
+                real = len(chunk)
+                pad = b - real
                 if pad > 0:
                     chunk = chunk + [chunk[-1]] * pad
                     w = np.concatenate([w, np.zeros((pad,), np.float32)])
-                self._acc = self.engine.accumulate_bucket(self._acc, chunk, w)
+                if self._watch is not None:
+                    self._watch_ranks.extend(m["rank"] for m in self._pending_meta)
+                self._acc = self.engine.accumulate_bucket(self._acc, chunk, w,
+                                                          watch=self._watch,
+                                                          watch_real=real)
                 self._weight_sum += float(w.sum())
             self.last_publish_weight = float(self._weight_sum)
             scaled = self._scale_fn()(self._acc, np.float32(1.0 / self._weight_sum))
@@ -358,6 +451,9 @@ class AsyncAggBuffer:
                 "stale_rejected_total": self.stale_rejected_total,
                 "mean_staleness": (self._staleness_sum / n) if n else 0.0,
                 "publish_interval_ewma_s": self.publish_interval_ewma_s,
+                "modelwatch": self._watch is not None,
+                "modelwatch_quarantine": self._quarantine,
+                "quarantined_total": self.quarantined_total,
                 "policy": self.policy.as_dict(),
                 "client_versions": dict(self._client_versions),
             }

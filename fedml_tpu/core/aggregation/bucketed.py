@@ -59,8 +59,14 @@ class BucketedAggregator:
 
     ``accum_traces`` / ``stacked_traces`` count jit *traces* (they only
     advance when XLA actually recompiles) — the compile-count regression
-    test pins them.
+    test pins them. ``watch_traces`` counts the fused watch-variant's traces
+    separately (mirrored into ``jax.compiles.modelwatch``): a watched fold
+    never touches the plain accumulator's cache, so ``agg_accum`` stays
+    pinned whether modelwatch is on or off.
     """
+
+    # modelwatch can fuse stats into this engine's fold (sharded overrides)
+    supports_watch = True
 
     def __init__(self, bucket_size: int = DEFAULT_BUCKET_SIZE):
         if bucket_size < 1:
@@ -68,12 +74,20 @@ class BucketedAggregator:
         self.bucket_size = int(bucket_size)
         self.accum_traces = 0
         self.stacked_traces = 0
+        self.watch_traces = 0
         # first bucket has no accumulator yet: a separate executable avoids a
         # zeros-alloc + add per aggregate; the steady-state step donates acc.
         # track_compiles mirrors accum_traces/stacked_traces into the
         # process-wide telemetry counters (jax.compiles.agg_accum / agg_stacked)
         self._accum_first = jax.jit(tel.track_compiles(self._accum_first_impl, name="agg_accum"))
         self._accum = jax.jit(tel.track_compiles(self._accum_impl, name="agg_accum"), donate_argnums=(0,))
+        # watch variants fuse the per-client stat block into the SAME
+        # executable as the weighted sum: XLA shares the chunk loads, so a
+        # watched bucket still costs one dispatch and zero extra host syncs
+        self._accum_watch_first = jax.jit(
+            tel.track_compiles(self._accum_watch_first_impl, name="modelwatch"))
+        self._accum_watch = jax.jit(
+            tel.track_compiles(self._accum_watch_impl, name="modelwatch"), donate_argnums=(0,))
         self._scan_reduce = jax.jit(tel.track_compiles(self._scan_reduce_impl, name="agg_stacked"))
         self._finalize_cache: Dict[Any, Any] = {}
 
@@ -95,6 +109,19 @@ class BucketedAggregator:
     def _accum_impl(self, acc, chunk, weights):
         self.accum_traces += 1
         return jax.tree.map(jnp.add, acc, self._bucket_sum(chunk, weights))
+
+    def _accum_watch_first_impl(self, chunk, weights, ref):
+        self.watch_traces += 1
+        from ..telemetry import modelwatch
+
+        return self._bucket_sum(chunk, weights), modelwatch.block_stat_math(chunk, ref)
+
+    def _accum_watch_impl(self, acc, chunk, weights, ref):
+        self.watch_traces += 1
+        from ..telemetry import modelwatch
+
+        return (jax.tree.map(jnp.add, acc, self._bucket_sum(chunk, weights)),
+                modelwatch.block_stat_math(chunk, ref))
 
     def _scan_reduce_impl(self, stacked, weights):
         # already-stacked [nb*b, ...] leaves: scan over buckets so the f32
@@ -135,12 +162,19 @@ class BucketedAggregator:
         return fn
 
     # --- raw step API (bench + power users) -------------------------------
-    def accumulate_bucket(self, acc, chunk: Sequence[PyTree], weights) -> PyTree:
+    def accumulate_bucket(self, acc, chunk: Sequence[PyTree], weights,
+                          watch=None, watch_real=None) -> PyTree:
         """One bucket step: ``acc + sum_i weights[i] * chunk[i]`` in f32.
 
         ``chunk`` must hold exactly ``bucket_size`` trees (pad ragged tails
         with weight 0.0). ``acc`` of None starts a fresh accumulator; a
         non-None ``acc`` is DONATED — the caller must not reuse it.
+
+        With a ``watch`` (:class:`telemetry.modelwatch.WatchSession`) the
+        fused watch executable also emits the bucket's per-client stat block
+        (delta norms vs ``watch.ref``, NaN/Inf counts) in the SAME dispatch;
+        the block stays on device in the session until its publish-time
+        fetch. ``watch_real`` tells the session how many rows are non-pad.
         """
         chunk = tuple(chunk)
         if len(chunk) != self.bucket_size:
@@ -150,10 +184,9 @@ class BucketedAggregator:
             tel.record_transfer("host_to_device", weights.nbytes)
         else:
             weights = weights.astype(jnp.float32)
-        with tel.span("agg.bucket", bucket_size=self.bucket_size, first=acc is None):
-            if acc is None:
-                return self._accum_first(chunk, weights)
-            if any(isinstance(l, np.ndarray) for l in jax.tree.leaves(acc)):
+        with tel.span("agg.bucket", bucket_size=self.bucket_size, first=acc is None,
+                      watched=watch is not None):
+            if acc is not None and any(isinstance(l, np.ndarray) for l in jax.tree.leaves(acc)):
                 # a donated buffer must be jax-OWNED: CPU device_put aliases
                 # numpy memory zero-copy, so donating a host array (e.g. an
                 # accumulator restored from a checkpoint snapshot) lets XLA
@@ -161,7 +194,17 @@ class BucketedAggregator:
                 # buffer — silent host-state corruption. Copy once here.
                 acc = jax.tree.map(
                     lambda l: jnp.array(l) if isinstance(l, np.ndarray) else l, acc)
-            return self._accum(acc, chunk, weights)
+            if acc is None:
+                if watch is not None:
+                    out, block = self._accum_watch_first(chunk, weights, watch.ref)
+                    watch.add_block(block, len(chunk) if watch_real is None else watch_real)
+                    return out
+                return self._accum_first(chunk, weights)
+            if watch is not None:
+                out, block = self._accum_watch(acc, chunk, weights, watch.ref)
+                watch.add_block(block, len(chunk) if watch_real is None else watch_real)
+                return out
+            return self._accum(acc, chunk, weights)  # fedlint: disable=donation-misuse exclusive branch: the watch arm above returns, acc was never donated on this path
 
     def finalize(self, acc: PyTree, template: PyTree) -> PyTree:
         """Cast the f32 accumulator back to ``template``'s leaf dtypes."""
@@ -169,8 +212,11 @@ class BucketedAggregator:
             return self._finalize_fn(template)(acc)
 
     # --- public entry points ----------------------------------------------
-    def aggregate(self, pairs: Sequence[Tuple[float, PyTree]]) -> PyTree:
-        """Weighted average of ``(weight, tree)`` pairs; weights normalized."""
+    def aggregate(self, pairs: Sequence[Tuple[float, PyTree]], watch=None) -> PyTree:
+        """Weighted average of ``(weight, tree)`` pairs; weights normalized.
+
+        An optional ``watch`` session rides the fold through the fused
+        watch-accumulate (object-leaf cohorts skip stats: no XLA algebra)."""
         if not pairs:
             raise ValueError("aggregate() needs at least one (weight, tree) pair")
         weights = np.asarray([float(w) for w, _ in pairs], dtype=np.float32)
@@ -184,12 +230,13 @@ class BucketedAggregator:
             for start in range(0, len(trees), b):
                 chunk = trees[start : start + b]
                 w = weights[start : start + b]
-                if len(chunk) < b:  # ragged tail: zero-weight pad to bucket shape
-                    pad = b - len(chunk)
-                    with tel.span("agg.pad_tail", pad=pad, real=len(chunk)):
+                real = len(chunk)
+                if real < b:  # ragged tail: zero-weight pad to bucket shape
+                    pad = b - real
+                    with tel.span("agg.pad_tail", pad=pad, real=real):
                         chunk = list(chunk) + [chunk[-1]] * pad
                         w = np.concatenate([w, np.zeros((pad,), np.float32)])
-                acc = self.accumulate_bucket(acc, chunk, w)
+                acc = self.accumulate_bucket(acc, chunk, w, watch=watch, watch_real=real)
             return self.finalize(acc, trees[0])
 
     def aggregate_stacked(self, stacked: PyTree, weights) -> PyTree:

@@ -133,6 +133,10 @@ class ShardedBucketedAggregator(BucketedAggregator):
     chunks, and finalized model laid out over ``mesh``. Falls back to the
     object-leaf host fold exactly like the base engine."""
 
+    # the sharded fold has no fused watch variant yet (stats would need a
+    # per-shard reduction); callers gate on this and skip modelwatch here
+    supports_watch = False
+
     def __init__(self, bucket_size: int, mesh):
         super().__init__(bucket_size)
         self.mesh = mesh

@@ -490,8 +490,12 @@ def run_local_round(train_fn: Callable[[], Any], args: Any, round_idx: int, *, r
     ``client.train`` span plus the chaos knobs — ``chaos_train_delay_s``
     (inflates measured train time for straggler drills; scoped to rounds
     below ``chaos_train_delay_rounds`` when that is set, so recovery drills
-    can watch an alert resolve) and
-    ``chaos_raise_at_round`` (scheduled failure exercising the crash path)."""
+    can watch an alert resolve),
+    ``chaos_raise_at_round`` (scheduled failure exercising the crash path),
+    ``chaos_nan_at_round`` (NaN-poisons the trained weights at one round —
+    the modelwatch ``nan_storm`` drill), and ``chaos_scale_delta``
+    (multiplies the trained weights by a factor, every round or only at
+    ``chaos_scale_at_round`` — the norm-outlier drill)."""
     chaos_delay = float(getattr(args, "chaos_train_delay_s", 0) or 0)
     chaos_delay_rounds = getattr(args, "chaos_train_delay_rounds", None)
     if chaos_delay_rounds is not None and int(round_idx) >= int(chaos_delay_rounds):
@@ -502,7 +506,43 @@ def run_local_round(train_fn: Callable[[], Any], args: Any, round_idx: int, *, r
             time.sleep(chaos_delay)  # fedlint: disable=bare-sleep chaos straggler injection, not a poll loop
         if chaos_raise_at is not None and int(chaos_raise_at) == int(round_idx):
             raise RuntimeError(f"chaos: injected failure at round {round_idx} on rank {rank}")
-        return train_fn()
+        out = train_fn()
+    return _apply_delta_chaos(out, args, round_idx, rank)
+
+
+def _apply_delta_chaos(out: Any, args: Any, round_idx: int, rank: Any) -> Any:
+    """Poison/scale a trained-weights payload per the modelwatch chaos knobs.
+    Handles both return conventions (bare tree, or ``(tree, n_samples)``)."""
+    nan_at = getattr(args, "chaos_nan_at_round", None)
+    scale = float(getattr(args, "chaos_scale_delta", 0) or 0)
+    scale_at = getattr(args, "chaos_scale_at_round", None)
+    poison = nan_at is not None and int(nan_at) == int(round_idx)
+    do_scale = scale not in (0.0, 1.0) and (
+        scale_at is None or int(scale_at) == int(round_idx))
+    if not poison and not do_scale:
+        return out
+
+    import jax
+
+    def _mutate(leaf):
+        arr = np.asarray(leaf)
+        if not np.issubdtype(arr.dtype, np.floating):
+            return leaf
+        if poison:
+            arr = arr.copy()
+            arr.flat[0] = np.nan
+            return arr
+        return arr * np.asarray(scale, dtype=arr.dtype)
+
+    if isinstance(out, tuple) and len(out) == 2:
+        weights, n = out
+        mutated = jax.tree_util.tree_map(_mutate, weights)
+        result = (mutated, n)
+    else:
+        result = jax.tree_util.tree_map(_mutate, out)
+    log.warning("chaos: %s trained weights at round %d on rank %s",
+                "NaN-poisoned" if poison else f"scaled x{scale:g}", int(round_idx), rank)
+    return result
 
 
 def decompress_arrival(model_params: Any, sender_id: Any) -> Any:

@@ -52,6 +52,10 @@ DUPLICATE = "duplicate"
 # because there is no round barrier to be late for.
 STALE_ACCEPTED = "stale_accepted"
 STALE_REJECTED = "stale_rejected"
+# modelwatch quarantine (core/telemetry/modelwatch.py, opt-in via
+# args.modelwatch_quarantine): a robust-z delta-norm outlier or NaN delta is
+# refused — counted and flight-recorded, never silently folded
+OUTLIER_REJECTED = "outlier_rejected"
 
 
 def overprovisioned_cohort_size(k: int, frac: float, stragglers_flagged: bool,

@@ -33,6 +33,14 @@ MAX_FLEET_SPANS_PER_CLIENT = 50_000
 # the client span whose duration is the health model's round-time signal
 TRAIN_SPAN_NAME = "client.train"
 
+# every top-level delta key this version understands; anything else is a
+# newer client's stat block — skipped and counted, never a crash (mixed
+# fleets upgrade one party at a time)
+_KNOWN_DELTA_KEYS = frozenset({
+    "spans", "counters", "histograms", "span_stats", "thread_names",
+    "epoch_unix_ns", "dropped", "link",
+})
+
 
 class FleetTelemetry:
     """Server-side accumulator of client telemetry deltas, keyed by rank."""
@@ -45,8 +53,24 @@ class FleetTelemetry:
         # a delta from a rank outside the expected cohort (late upload after
         # a reshuffle) is logged + skipped, never raised mid-aggregation
         self.stale = 0
+        # unknown top-level delta keys skipped (forward-compat with newer
+        # clients); the key names are kept so /statusz shows WHAT was dropped
+        self.unknown_dropped = 0
+        self.unknown_keys: Set[str] = set()
         self.expected_ranks: Optional[Set[int]] = None
         self.health = HealthTracker()
+        self._ledger = None  # modelwatch ContributionLedger, lazily built
+
+    @property
+    def ledger(self):
+        """Per-client contribution ledger (``telemetry.modelwatch``), built
+        on first use so the fleet merge path stays import-light."""
+        led = self._ledger
+        if led is None:
+            from .modelwatch import ContributionLedger
+
+            led = self._ledger = ContributionLedger()
+        return led
 
     def set_expected_ranks(self, ranks) -> None:
         """Declare this round's cohort; ``None`` accepts any rank."""
@@ -100,6 +124,16 @@ class FleetTelemetry:
         if isinstance(delta.get("dropped"), int):
             # client-side Telemetry.dropped is cumulative: latest wins
             ent["client_dropped"] = delta["dropped"]
+        unknown = set(delta) - _KNOWN_DELTA_KEYS
+        if unknown:
+            self.unknown_dropped += len(unknown)
+            new = unknown - self.unknown_keys
+            if new:
+                self.unknown_keys.update(new)
+                log.warning(
+                    "fleet: skipping unknown delta key(s) %s from rank %d "
+                    "(newer client version? merge continues without them)",
+                    sorted(new), rank)
         link = delta.get("link")
         if isinstance(link, dict) and link:
             # client-observed per-pair link estimates: fold into the server's
@@ -147,7 +181,9 @@ class FleetTelemetry:
                 "dropped": ent["dropped"] + ent["client_dropped"],
             }
         return {"clients": per_client, "merges": self.merges,
-                "rejected": self.rejected, "stale": self.stale}
+                "rejected": self.rejected, "stale": self.stale,
+                "unknown_dropped": self.unknown_dropped,
+                "unknown_keys": sorted(self.unknown_keys)}
 
     # --- export ----------------------------------------------------------
     def export_fleet_trace(self, path: str, server: Optional[Telemetry] = None) -> str:

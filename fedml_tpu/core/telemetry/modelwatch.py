@@ -1,0 +1,765 @@
+"""Training-dynamics observability at the aggregation fold boundary.
+
+Every *system* dimension is already observed — spans, links, burn rates,
+MFU — but the model itself was invisible: a NaN storm, a diverging cohort,
+or one client scaling its delta 50x only surfaced when eval quality
+cratered. This module computes streaming statistics about the model
+updates at the exact place they are folded:
+
+- **per-client**: global and per-dtype-group L2 norm of the delta
+  (``client params - running aggregate``), NaN/Inf counts, cosine
+  similarity to the running aggregate, and the update-to-weight ratio
+  ``|delta| / |w|``;
+- **per-publish**: the published aggregate's update norm, NaN/Inf count,
+  and cosine drift against the previous published update direction.
+
+The math rides the fold. ``BucketedAggregator`` owns a fused
+watch-variant of its accumulate step (one executable computes the
+weighted sum AND the stat block from the same chunk loads, its traces
+pinned under ``jax.compiles.modelwatch``), so stats add **zero host
+syncs and zero extra dispatches** to the bucketed/async fold; the tiny
+per-bucket stat blocks stay on device until :meth:`WatchSession.finish`
+fetches them on the same host transfer that materializes the published
+aggregate. Fronts that fold through optimizer middleware (sp FedOpt
+etc.) use the stats-only block program via :func:`screen_cohort`.
+
+Three consumers:
+
+1. the per-client **contribution ledger** (:class:`ContributionLedger`,
+   owned by ``FleetTelemetry``): EWMA norm share + robust-z outlier
+   score reusing the health tracker's MAD machinery, surfaced on
+   ``/statusz``, the ``fedml_client_{delta_norm,contribution,
+   outlier_score}`` prom gauges, and the per-round ``HealthReport``;
+2. tsdb series (``modelwatch.nan_count``, ``modelwatch.agg_update_norm``,
+   ``modelwatch.divergence_ratio``, ``modelwatch.cosine_drift``,
+   ``modelwatch.outlier_rate``) driving the engine SLO pack's
+   ``nan_storm`` / ``divergence`` / ``client_outlier_rate`` rows — each
+   auto-captured flight-recorder snapshot carries the offending clients'
+   stat rows via the SLO engine's alert-context hook;
+3. an opt-in quarantine (``args.modelwatch_quarantine``) that routes
+   outlier deltas to a rejected-verdict path — counted
+   (``fedml_modelwatch_quarantined_total``), never silently folded —
+   without changing default aggregation math.
+
+Kill switch: ``FEDML_MODELWATCH=0`` or ``args.modelwatch_disable``.
+jax is imported lazily — the telemetry package stays import-light.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import tsdb
+from .core import get_telemetry
+from .health import DEFAULT_MAD_Z, MAD_TO_SIGMA, MIN_COHORT, robust_zscores
+
+log = logging.getLogger(__name__)
+
+PyTree = Any
+
+__all__ = [
+    "ContributionLedger",
+    "RoundStats",
+    "WatchSession",
+    "block_stat_math",
+    "enabled",
+    "get_active",
+    "outlier_verdicts",
+    "prom_gauges",
+    "quarantine_enabled",
+    "screen_cohort",
+    "set_active",
+    "statusz_snapshot",
+    "train_guard",
+]
+
+# the compile counter every modelwatch program is pinned under: a climbing
+# jax.compiles.modelwatch in a steady-state run is a recompile bug, exactly
+# like agg_accum (tests + the bench stage pin it)
+COMPILE_COUNTER = "modelwatch"
+
+_ENV_ENABLE = "FEDML_MODELWATCH"
+_ENV_Z = "FEDML_MODELWATCH_Z"
+
+# fixed stat-block column layout ([B, 4 + G] per bucket; G dtype groups)
+COL_SQ = 0      # global squared L2 norm of the client delta
+COL_DOT = 1     # dot(delta, running aggregate)
+COL_NAN = 2     # NaN count over the client's tree
+COL_INF = 3     # Inf count over the client's tree
+N_FIXED_COLS = 4
+
+# aggregate-stat vector layout (finish program)
+_AGG_SQ, _AGG_NAN, _AGG_INF, _AGG_DOT_PREV, _AGG_PREV_SQ, _AGG_REF_SQ = range(6)
+
+
+def enabled(args: Any = None) -> bool:
+    """Modelwatch is on unless the env or run args kill it."""
+    if os.environ.get(_ENV_ENABLE, "1") == "0":
+        return False
+    return not bool(getattr(args, "modelwatch_disable", False))
+
+
+def quarantine_enabled(args: Any = None) -> bool:
+    return bool(getattr(args, "modelwatch_quarantine", False))
+
+
+def z_threshold() -> float:
+    try:
+        return float(os.environ.get(_ENV_Z, DEFAULT_MAD_Z))
+    except ValueError:
+        return DEFAULT_MAD_Z
+
+
+def group_labels(tree: PyTree) -> List[str]:
+    """Sorted distinct leaf dtype names — the per-dtype-group norm axes.
+
+    Must agree with the trace-time grouping in :func:`block_stat_math`
+    (both sort ``str(leaf.dtype)``), so host rows label device columns."""
+    import jax
+    import numpy as np
+
+    names = set()
+    for leaf in jax.tree.leaves(tree):
+        # np.result_type handles python scalars without materializing leaves
+        names.add(str(leaf.dtype) if hasattr(leaf, "dtype")
+                  else str(np.result_type(leaf)))
+    return sorted(names)
+
+
+# ---------------------------------------------------------------------------
+# jitted stat programs (built lazily; all traces pinned under
+# jax.compiles.modelwatch)
+# ---------------------------------------------------------------------------
+
+def block_stat_math(chunk: Sequence[PyTree], ref: PyTree):
+    """Trace-time stat math for one bucket: ``[B, 4 + G]`` per-client rows.
+
+    Called INSIDE a jit (either the stats-only block program below or the
+    bucketed engine's fused watch-accumulate), so the per-leaf Python loop
+    unrolls at trace time and XLA shares the chunk loads with the fold."""
+    import jax
+    import jax.numpy as jnp
+
+    ref_leaves = jax.tree.leaves(ref)
+    labels = group_labels(ref)
+    gidx = {g: i for i, g in enumerate(labels)}
+    b = len(chunk)
+    chunk_leaves = [jax.tree.leaves(t) for t in chunk]
+    sq_g = jnp.zeros((b, len(labels)), jnp.float32)
+    dot = jnp.zeros((b,), jnp.float32)
+    nan = jnp.zeros((b,), jnp.float32)
+    inf = jnp.zeros((b,), jnp.float32)
+    for j, rl in enumerate(ref_leaves):
+        g = gidx[str(rl.dtype)]
+        rl32 = jnp.asarray(rl, jnp.float32)
+        xs = jnp.stack([jnp.asarray(cl[j], jnp.float32) for cl in chunk_leaves])
+        axes = tuple(range(1, xs.ndim))
+        d = xs - rl32[None]
+        sq_g = sq_g.at[:, g].add(jnp.sum(d * d, axis=axes))
+        dot = dot + jnp.sum(d * rl32[None], axis=axes)
+        nan = nan + jnp.sum(jnp.isnan(xs), axis=axes).astype(jnp.float32)
+        inf = inf + jnp.sum(jnp.isinf(xs), axis=axes).astype(jnp.float32)
+    sq = jnp.sum(sq_g, axis=1)
+    return jnp.concatenate(
+        [sq[:, None], dot[:, None], nan[:, None], inf[:, None], sq_g], axis=1)
+
+
+_PROG_LOCK = threading.Lock()
+_PROGS: Dict[str, Any] = {}
+
+
+def _programs() -> Dict[str, Any]:
+    """Lazily build the module-level jitted programs (one trace per input
+    structure each; jit's own cache keys on treedef/shape/dtype)."""
+    with _PROG_LOCK:
+        if _PROGS:
+            return _PROGS
+        import jax
+        import jax.numpy as jnp
+
+        from .jax_hooks import track_compiles
+
+        def _block_impl(chunk, ref):
+            return block_stat_math(chunk, ref)
+
+        def _tree_sums(tree):
+            sq = jnp.float32(0.0)
+            nan = jnp.float32(0.0)
+            inf = jnp.float32(0.0)
+            for leaf in jax.tree.leaves(tree):
+                x = jnp.asarray(leaf, jnp.float32)
+                sq = sq + jnp.sum(x * x)
+                nan = nan + jnp.sum(jnp.isnan(x)).astype(jnp.float32)
+                inf = inf + jnp.sum(jnp.isinf(x)).astype(jnp.float32)
+            return sq, nan, inf
+
+        def _agg_impl(published, ref, prev_update):
+            upd = jax.tree.map(
+                lambda p, r: jnp.asarray(p, jnp.float32) - jnp.asarray(r, jnp.float32),
+                published, ref)
+            upd_sq, nan, inf = _tree_sums(published)
+            u_sq = jnp.float32(0.0)
+            dot_prev = jnp.float32(0.0)
+            prev_sq = jnp.float32(0.0)
+            ref_sq = jnp.float32(0.0)
+            for ul, pl, rl in zip(jax.tree.leaves(upd), jax.tree.leaves(prev_update),
+                                  jax.tree.leaves(ref)):
+                p32 = jnp.asarray(pl, jnp.float32)
+                r32 = jnp.asarray(rl, jnp.float32)
+                u_sq = u_sq + jnp.sum(ul * ul)
+                dot_prev = dot_prev + jnp.sum(ul * p32)
+                prev_sq = prev_sq + jnp.sum(p32 * p32)
+                ref_sq = ref_sq + jnp.sum(r32 * r32)
+            del upd_sq
+            vec = jnp.stack([u_sq, nan, inf, dot_prev, prev_sq, ref_sq])
+            return vec, upd
+
+        def _guard_impl(params):
+            sq, nan, inf = _tree_sums(params)
+            return jnp.stack([sq, nan, inf])
+
+        _PROGS["block"] = jax.jit(track_compiles(_block_impl, name=COMPILE_COUNTER))
+        _PROGS["agg"] = jax.jit(track_compiles(_agg_impl, name=COMPILE_COUNTER))
+        _PROGS["guard"] = jax.jit(track_compiles(_guard_impl, name=COMPILE_COUNTER))
+        return _PROGS
+
+
+def client_stat(tree: PyTree, session: "WatchSession"):
+    """One arriving tree's device stat row ``[4 + G]`` vs the session ref —
+    the async quarantine screen (single fused dispatch, chunk of one)."""
+    return _programs()["block"]((tree,), session.ref)[0]
+
+
+def train_guard(params: PyTree) -> "np.ndarray":
+    """NaN guard + global param norm for the llama trainer's window end.
+
+    Returns the device ``[sq_norm, nan, inf]`` vector from ONE jitted pass
+    (pinned under ``jax.compiles.modelwatch``); the caller fetches it at an
+    existing sync point."""
+    return _programs()["guard"](params)
+
+
+# ---------------------------------------------------------------------------
+# watch session: device-side stat collection for one fold window
+# ---------------------------------------------------------------------------
+
+class RoundStats:
+    """Host-side result of one watched fold window."""
+
+    def __init__(self, rows: List[Dict[str, Any]], agg: Dict[str, Any],
+                 update_tree: Any, groups: List[str]):
+        self.rows = rows          # one dict per client, aligned to fold order
+        self.agg = agg            # published-aggregate stats
+        self.update_tree = update_tree  # device (published - ref): next prev
+        self.groups = groups
+
+    def by_rank(self) -> Dict[Any, Dict[str, Any]]:
+        return {r["rank"]: r for r in self.rows}
+
+
+class WatchSession:
+    """Collects per-bucket stat blocks for one fold window, fetched once.
+
+    ``ref`` is the running aggregate (the current global params) the client
+    deltas are measured against; ``prev_update`` is the previous window's
+    published update direction (device tree from the last
+    :meth:`finish`), used for the aggregate cosine-drift series."""
+
+    def __init__(self, ref: PyTree, prev_update: Any = None):
+        import jax
+        import jax.numpy as jnp
+
+        # device-resident once: numpy leaves would re-device_put per bucket
+        self.ref = jax.tree.map(jnp.asarray, ref)
+        self.prev_update = prev_update
+        self.groups = group_labels(ref)
+        self._blocks: List[Any] = []   # [bucket, 4+G] device arrays
+        self._real: List[int] = []     # non-pad rows per block
+        self.ranks: Optional[List[Any]] = None
+        self.quarantined: Dict[Any, Dict[str, Any]] = {}
+
+    def add_block(self, block: Any, real: int) -> None:
+        self._blocks.append(block)
+        self._real.append(int(real))
+
+    def watch_block(self, chunk: Sequence[PyTree], real: Optional[int] = None) -> None:
+        """Stats-only path (no fused fold): one dispatch per bucket."""
+        block = _programs()["block"](tuple(chunk), self.ref)
+        self.add_block(block, len(chunk) if real is None else real)
+
+    @property
+    def n_clients(self) -> int:
+        return sum(self._real)
+
+    def peek_norms(self) -> "np.ndarray":
+        """Host-fetch ONLY the delta norms (quarantine needs them pre-fold).
+        The full rows still ride the publish-time fetch."""
+        import numpy as np
+
+        if not self._blocks:
+            return np.zeros((0,), np.float32)
+        # fedlint: disable=host-sync quarantine screening is an explicit pre-fold sync (opt-in path)
+        sq = np.concatenate([np.asarray(b)[:r, COL_SQ]
+                             for b, r in zip(self._blocks, self._real)])
+        with np.errstate(invalid="ignore"):
+            return np.sqrt(np.maximum(sq, 0.0))
+
+    def finish(self, published: PyTree) -> RoundStats:
+        """Fetch all stats on the publish-time host transfer and derive the
+        per-client rows + aggregate stats."""
+        import numpy as np
+
+        has_prev = self.prev_update is not None
+        prev = self.prev_update if has_prev else self.ref
+        vec_dev, upd_tree = _programs()["agg"](published, self.ref, prev)
+        vec = np.asarray(vec_dev, np.float64)
+        rows_np = (np.concatenate([np.asarray(b)[:r]
+                                   for b, r in zip(self._blocks, self._real)])
+                   if self._blocks else
+                   np.zeros((0, N_FIXED_COLS + len(self.groups)), np.float32))
+        ref_norm = math.sqrt(max(float(vec[_AGG_REF_SQ]), 0.0))
+        ranks = self.ranks if self.ranks is not None else list(range(len(rows_np)))
+        rows: List[Dict[str, Any]] = []
+        with np.errstate(invalid="ignore", divide="ignore"):
+            for i, raw in enumerate(rows_np):
+                sq = float(raw[COL_SQ])
+                norm = math.sqrt(sq) if sq >= 0.0 else float("nan")
+                denom = norm * ref_norm
+                cosine = float(raw[COL_DOT]) / denom if denom > 0.0 and math.isfinite(denom) else 0.0
+                rows.append({
+                    "rank": ranks[i] if i < len(ranks) else i,
+                    "norm": norm,
+                    "cosine": cosine if math.isfinite(cosine) else 0.0,
+                    "update_ratio": (norm / ref_norm) if ref_norm > 0.0 else 0.0,
+                    "nan": int(raw[COL_NAN]),
+                    "inf": int(raw[COL_INF]),
+                    "group_norms": {
+                        g: math.sqrt(max(float(raw[N_FIXED_COLS + k]), 0.0))
+                        for k, g in enumerate(self.groups)},
+                    "quarantined": False,
+                })
+        # sync screening watches the WHOLE cohort before dropping outliers,
+        # so a quarantined rank usually already has a stat row — mark it in
+        # place; only async-style quarantines (no watch row) append one
+        by_rank = {r["rank"]: r for r in rows}
+        for rank, qrow in self.quarantined.items():
+            existing = by_rank.get(rank)
+            if existing is not None:
+                existing["quarantined"] = True
+                existing["z"] = qrow.get("z")
+            else:
+                rows.append(dict(qrow, rank=rank, quarantined=True))
+        upd_norm = math.sqrt(max(float(vec[_AGG_SQ]), 0.0))
+        prev_norm = math.sqrt(max(float(vec[_AGG_PREV_SQ]), 0.0))
+        cos_prev: Optional[float] = None
+        if has_prev and upd_norm > 0.0 and prev_norm > 0.0:
+            c = float(vec[_AGG_DOT_PREV]) / (upd_norm * prev_norm)
+            cos_prev = c if math.isfinite(c) else None
+        agg = {
+            "update_norm": upd_norm,
+            "nan": int(vec[_AGG_NAN]) if math.isfinite(vec[_AGG_NAN]) else 0,
+            "inf": int(vec[_AGG_INF]) if math.isfinite(vec[_AGG_INF]) else 0,
+            "cosine_prev": cos_prev,
+            "ref_norm": ref_norm,
+            "update_ratio": (upd_norm / ref_norm) if ref_norm > 0.0 else 0.0,
+        }
+        return RoundStats(rows, agg, upd_tree, self.groups)
+
+
+def outlier_verdicts(norms: Sequence[float],
+                     threshold: Optional[float] = None,
+                     min_cohort: int = MIN_COHORT) -> Tuple[List[float], List[bool]]:
+    """Robust z-scores + one-sided outlier flags over a cohort's delta norms.
+
+    Reuses the health tracker's MAD machinery: ``z = 0.6745 (x - med)/MAD``,
+    flagged at ``z >= threshold`` AND above the median (a small update is
+    not hostile). Non-finite norms (a NaN delta) always flag. Cohorts under
+    ``min_cohort`` finite members never flag on z alone."""
+    thr = z_threshold() if threshold is None else float(threshold)
+    finite = [float(n) for n in norms if math.isfinite(n)]
+    zs: List[float] = []
+    flags: List[bool] = []
+    med = mad = 0.0
+    if len(finite) >= min_cohort:
+        med, mad, _ = robust_zscores(finite)
+    for n in norms:
+        n = float(n)
+        if not math.isfinite(n):
+            zs.append(float("inf"))
+            flags.append(True)
+            continue
+        z = MAD_TO_SIGMA * (n - med) / mad if mad > 0.0 else 0.0
+        zs.append(z)
+        flags.append(len(finite) >= min_cohort and z >= thr and n > med)
+    return zs, flags
+
+
+def screen_cohort(session: WatchSession,
+                  pairs: Sequence[Tuple[float, PyTree]],
+                  ranks: Optional[Sequence[Any]] = None,
+                  *,
+                  ledger: Optional["ContributionLedger"] = None,
+                  quarantine: bool = False,
+                  bucket_size: int = 16) -> List[Tuple[float, PyTree]]:
+    """Compute per-client stats for a sync cohort; optionally quarantine.
+
+    Stats-only block program over zero-pad buckets (stats stay on device —
+    no sync unless ``quarantine``). With ``quarantine``, delta norms are
+    fetched pre-fold, robust-z outliers (and NaN deltas) are dropped from
+    the returned pairs — counted, recorded on the session/ledger, never
+    silently folded. Default math is untouched: quarantine off returns
+    ``pairs`` unchanged."""
+    import jax
+
+    pairs = list(pairs)
+    ranks = list(ranks) if ranks is not None else list(range(len(pairs)))
+    if not pairs:
+        return pairs
+    trees = [t for _, t in pairs]
+    if any(not hasattr(l, "dtype") and not isinstance(l, (float, int))
+           for l in jax.tree.leaves(trees[0])):
+        return pairs  # object leaves (FHE ciphertexts): no XLA stats
+    b = max(1, int(bucket_size))
+    for start in range(0, len(trees), b):
+        chunk = trees[start:start + b]
+        real = len(chunk)
+        if real < b:
+            chunk = list(chunk) + [chunk[-1]] * (b - real)
+        session.watch_block(chunk, real=real)
+    session.ranks = ranks
+    if not quarantine:
+        return pairs
+    norms = session.peek_norms()
+    zs, flags = outlier_verdicts(list(norms))
+    kept: List[Tuple[float, PyTree]] = []
+    for i, pair in enumerate(pairs):
+        if flags[i]:
+            row = {"norm": float(norms[i]), "z": float(zs[i])}
+            session.quarantined[ranks[i]] = row
+            if ledger is not None:
+                ledger.note_quarantined(ranks[i], float(norms[i]), float(zs[i]))
+        else:
+            kept.append(pair)
+    if session.quarantined and not kept:
+        # an all-outlier cohort (degenerate) must still publish something:
+        # refuse to quarantine everyone, fold the original cohort instead
+        log.warning("modelwatch: quarantine would drop the ENTIRE cohort; folding all")
+        session.quarantined.clear()
+        return pairs
+    return kept
+
+
+# ---------------------------------------------------------------------------
+# contribution ledger
+# ---------------------------------------------------------------------------
+
+class ContributionLedger:
+    """Per-client contribution + outlier state fed from fold-boundary stats.
+
+    EWMA-smoothed delta norms give each rank a *contribution share*
+    (its EWMA norm over the cohort sum); per-round robust z-scores over the
+    raw norms give the *outlier score*. Thread-safe leaf lock (taken after
+    any caller locks, never the reverse)."""
+
+    EWMA_ALPHA = 0.3  # same smoothing as the health tracker / netlink
+
+    def __init__(self, alpha: float = EWMA_ALPHA):
+        self.alpha = float(alpha)
+        self._lock = threading.Lock()
+        self._clients: Dict[Any, Dict[str, Any]] = {}
+        self._recent_norms: deque = deque(maxlen=64)
+        self._agg: Dict[str, Any] = {}
+        self._baseline_norm: Optional[float] = None  # trailing EWMA of agg update norm
+        self.rounds = 0
+        self.quarantined_total = 0
+        self._quarantined_since_round = 0
+        self.nan_rounds = 0
+        self.last_outlier_rate = 0.0
+
+    def _row(self, rank: Any) -> Dict[str, Any]:
+        return self._clients.setdefault(rank, {
+            "norm": 0.0, "ewma_norm": None, "share": 0.0, "z": 0.0,
+            "outlier": False, "cosine": 0.0, "update_ratio": 0.0,
+            "nan": 0, "inf": 0, "rounds": 0, "quarantined": 0,
+        })
+
+    # --- streaming (async submit) path -----------------------------------
+    def streaming_z(self, norm: float) -> float:
+        """Robust z of one arriving delta norm against the recent window —
+        the async front's quarantine signal (no cohort barrier to wait on)."""
+        if not math.isfinite(norm):
+            return float("inf")
+        with self._lock:
+            window = [n for n in self._recent_norms if math.isfinite(n)]
+        if len(window) < MIN_COHORT:
+            return 0.0
+        med, mad, _ = robust_zscores(window)
+        if mad <= 0.0:
+            return 0.0
+        return MAD_TO_SIGMA * (float(norm) - med) / mad
+
+    def observe_stream_norm(self, norm: float) -> None:
+        """Admit one accepted arrival's norm into the streaming-z window."""
+        if math.isfinite(norm):
+            with self._lock:
+                self._recent_norms.append(float(norm))
+
+    def note_quarantined(self, rank: Any, norm: float, z: float) -> None:
+        with self._lock:
+            row = self._row(rank)
+            row["quarantined"] += 1
+            row["norm"] = float(norm)
+            row["z"] = float(z)
+            row["outlier"] = True
+            self.quarantined_total += 1
+            self._quarantined_since_round += 1
+        get_telemetry().counter("modelwatch.quarantined").add(1)
+        try:
+            from . import flight_recorder
+
+            flight_recorder.mark("modelwatch_quarantine", rank=rank,
+                                 norm=float(norm), z=float(z))
+        except Exception:  # noqa: BLE001 - observability must not break the fold
+            pass
+
+    # --- round close ------------------------------------------------------
+    def observe_round(self, round_idx: Any, stats: RoundStats) -> Dict[str, Any]:
+        """Fold one window's stats in: update the ledger, feed the tsdb
+        series the SLO pack watches, and drop a flight-recorder breadcrumb
+        when anything anomalous showed up."""
+        folded = [r for r in stats.rows if not r.get("quarantined")]
+        norms = [r.get("norm", float("nan")) for r in folded]
+        zs, flags = outlier_verdicts(norms)
+        n_out = sum(1 for f in flags if f)
+        q_rows = len(stats.rows) - len(folded)
+        agg = stats.agg
+        nan_total = int(agg.get("nan", 0)) + int(agg.get("inf", 0))
+        with self._lock:
+            # async quarantines happen at submit and never reach the session
+            # rows; the sync screen marks them IN the rows — count whichever
+            # view is larger, never both
+            q_extra = max(0, self._quarantined_since_round - q_rows)
+            self._quarantined_since_round = 0
+            total = len(stats.rows) + q_extra
+            rate = (n_out + q_rows + q_extra) / total if total else 0.0
+            self.rounds += 1
+            for i, r in enumerate(folded):
+                row = self._row(r["rank"])
+                row["rounds"] += 1
+                norm = r.get("norm", 0.0)
+                row["norm"] = norm
+                row["cosine"] = r.get("cosine", 0.0)
+                row["update_ratio"] = r.get("update_ratio", 0.0)
+                row["nan"] = r.get("nan", 0)
+                row["inf"] = r.get("inf", 0)
+                row["z"] = zs[i]
+                row["outlier"] = flags[i]
+                if math.isfinite(norm):
+                    prev = row["ewma_norm"]
+                    row["ewma_norm"] = (norm if prev is None
+                                        else (1 - self.alpha) * prev + self.alpha * norm)
+                    self._recent_norms.append(norm)
+            total_ewma = sum(row["ewma_norm"] for row in self._clients.values()
+                             if row["ewma_norm"] is not None)
+            for row in self._clients.values():
+                row["share"] = (row["ewma_norm"] / total_ewma
+                                if row["ewma_norm"] is not None and total_ewma > 0.0
+                                else 0.0)
+            self.last_outlier_rate = rate
+            upd_norm = float(agg.get("update_norm", 0.0))
+            ratio = None
+            if math.isfinite(upd_norm) and nan_total == 0:
+                if self._baseline_norm is not None and self._baseline_norm > 0.0:
+                    ratio = upd_norm / self._baseline_norm
+                self._baseline_norm = (upd_norm if self._baseline_norm is None
+                                       else (1 - self.alpha) * self._baseline_norm
+                                       + self.alpha * upd_norm)
+            cos_prev = agg.get("cosine_prev")
+            self._agg = {
+                "round": round_idx,
+                "update_norm": upd_norm,
+                "nan": int(agg.get("nan", 0)),
+                "inf": int(agg.get("inf", 0)),
+                "divergence_ratio": ratio,
+                "cosine_prev": cos_prev,
+                "update_ratio": agg.get("update_ratio", 0.0),
+                "outliers": [folded[i]["rank"] for i, f in enumerate(flags) if f],
+            }
+            if nan_total:
+                self.nan_rounds += 1
+        if nan_total:
+            get_telemetry().counter("modelwatch.nan_rounds").add(1)
+        store = tsdb.active()
+        if store is not None:
+            store.record_gauge("modelwatch.nan_count", float(nan_total))
+            if math.isfinite(upd_norm):
+                store.record_gauge("modelwatch.agg_update_norm", upd_norm)
+            if ratio is not None:
+                store.record_gauge("modelwatch.divergence_ratio", float(ratio))
+            if cos_prev is not None:
+                store.record_gauge("modelwatch.cosine_drift", 1.0 - float(cos_prev))
+            store.record_gauge("modelwatch.outlier_rate", float(rate))
+        anomalies = self._agg.get("outliers") or nan_total
+        if anomalies:
+            try:
+                from . import flight_recorder
+
+                flight_recorder.mark(
+                    "modelwatch", round=round_idx, nan=int(agg.get("nan", 0)),
+                    inf=int(agg.get("inf", 0)),
+                    outliers=list(self._agg.get("outliers") or []),
+                    quarantined=sorted(stats.quarantined_ranks()
+                                       if hasattr(stats, "quarantined_ranks") else
+                                       [r["rank"] for r in stats.rows
+                                        if r.get("quarantined")]),
+                    update_norm=upd_norm)
+            except Exception:  # noqa: BLE001 - observability must not break the round
+                pass
+        return dict(self._agg)
+
+    # --- surfaces ---------------------------------------------------------
+    def prom_gauges(self) -> List[Tuple[str, Dict[str, str], float]]:
+        """Same triple shape as ``HealthTracker.prom_gauges``."""
+        out: List[Tuple[str, Dict[str, str], float]] = []
+        with self._lock:
+            for rank, row in sorted(self._clients.items(), key=lambda kv: str(kv[0])):
+                labels = {"rank": str(rank)}
+                norm = row["norm"]
+                out.append(("client_delta_norm", labels,
+                            float(norm) if math.isfinite(norm) else -1.0))
+                out.append(("client_contribution", labels, float(row["share"])))
+                z = row["z"]
+                out.append(("client_outlier_score", labels,
+                            float(z) if math.isfinite(z) else -1.0))
+        return out
+
+    def statusz_snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            clients = {}
+            for rank, row in self._clients.items():
+                clients[str(rank)] = {
+                    "norm": _safe(row["norm"]),
+                    "ewma_norm": _safe(row["ewma_norm"]),
+                    "share": round(row["share"], 6),
+                    "z": _safe(row["z"]),
+                    "outlier": row["outlier"],
+                    "cosine": _safe(row["cosine"]),
+                    "update_ratio": _safe(row["update_ratio"]),
+                    "nan": row["nan"], "inf": row["inf"],
+                    "rounds": row["rounds"], "quarantined": row["quarantined"],
+                }
+            return {
+                "rounds": self.rounds,
+                "clients": clients,
+                "aggregate": {k: _safe(v) if isinstance(v, float) else v
+                              for k, v in self._agg.items()},
+                "outlier_rate": self.last_outlier_rate,
+                "quarantined_total": self.quarantined_total,
+                "nan_rounds": self.nan_rounds,
+                "z_threshold": z_threshold(),
+            }
+
+    def annotate_report(self, report: Dict[str, Any]) -> Dict[str, Any]:
+        """Ride the per-round ``HealthReport`` with the ledger's view."""
+        with self._lock:
+            report["modelwatch"] = {
+                "aggregate": {k: _safe(v) if isinstance(v, float) else v
+                              for k, v in self._agg.items()},
+                "outlier_rate": self.last_outlier_rate,
+                "clients": {str(r): {"norm": _safe(row["norm"]),
+                                     "share": round(row["share"], 6),
+                                     "z": _safe(row["z"]),
+                                     "outlier": row["outlier"]}
+                            for r, row in self._clients.items()},
+            }
+        return report
+
+    def alert_context(self, spec: Any) -> Optional[Dict[str, Any]]:
+        """SLO alert-context provider: the offending clients' stat rows ride
+        the auto-captured flight-recorder snapshot for modelwatch alerts."""
+        series = getattr(spec, "series", "")
+        if not str(series).startswith("modelwatch."):
+            return None
+        with self._lock:
+            rows = []
+            for rank, row in sorted(self._clients.items(),
+                                    key=lambda kv: -(kv[1]["z"] if math.isfinite(kv[1]["z"]) else 1e18)):
+                rows.append({"rank": str(rank), "norm": _safe(row["norm"]),
+                             "z": _safe(row["z"]), "outlier": row["outlier"],
+                             "nan": row["nan"], "inf": row["inf"],
+                             "quarantined": row["quarantined"],
+                             "verdict": ("quarantined" if row["quarantined"]
+                                         else "outlier" if row["outlier"] else "ok")})
+            return {"clients": rows[:16],
+                    "aggregate": {k: _safe(v) if isinstance(v, float) else v
+                                  for k, v in self._agg.items()}}
+
+
+def _safe(v: Any) -> Any:
+    """JSON-safe float: NaN/Inf become strings, None passes through."""
+    if v is None:
+        return None
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return v
+    if math.isfinite(f):
+        return round(f, 6)
+    return repr(f)
+
+
+# ---------------------------------------------------------------------------
+# active-ledger registry (the slo.py _ENGINE pattern): statusz/prom surfaces
+# render whatever ledger the running front registered
+# ---------------------------------------------------------------------------
+
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE: Optional[ContributionLedger] = None
+
+
+def set_active(ledger: Optional[ContributionLedger]) -> None:
+    """Register the process's live ledger (and its SLO alert-context hook)."""
+    global _ACTIVE
+    from . import slo
+
+    with _ACTIVE_LOCK:
+        prev = _ACTIVE
+        _ACTIVE = ledger
+    if prev is not None:
+        slo.unregister_alert_context(prev.alert_context)
+    if ledger is not None:
+        slo.register_alert_context(ledger.alert_context)
+
+
+def clear_active(ledger: Optional[ContributionLedger] = None) -> None:
+    """Deactivate (only if ``ledger`` is the active one, when given)."""
+    global _ACTIVE
+    from . import slo
+
+    with _ACTIVE_LOCK:
+        if ledger is not None and _ACTIVE is not ledger:
+            return
+        prev = _ACTIVE
+        _ACTIVE = None
+    if prev is not None:
+        slo.unregister_alert_context(prev.alert_context)
+
+
+def get_active() -> Optional[ContributionLedger]:
+    with _ACTIVE_LOCK:
+        return _ACTIVE
+
+
+def statusz_snapshot() -> Dict[str, Any]:
+    ledger = get_active()
+    return ledger.statusz_snapshot() if ledger is not None and ledger.rounds else {}
+
+
+def prom_gauges() -> List[Tuple[str, Dict[str, str], float]]:
+    ledger = get_active()
+    return ledger.prom_gauges() if ledger is not None else []

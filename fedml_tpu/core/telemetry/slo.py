@@ -42,7 +42,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional
 
 from . import flight_recorder, tsdb
 from .core import get_telemetry
@@ -56,6 +56,8 @@ __all__ = [
     "activate",
     "deactivate",
     "get_engine",
+    "register_alert_context",
+    "unregister_alert_context",
     "statusz_snapshot",
     "prom_gauges",
     "reset",
@@ -75,6 +77,28 @@ _SIGNALS = ("rate", "quantile", "avg", "max", "delta", "last")
 _COMPARATORS = ("<=", ">=")
 
 MAX_TRANSITIONS = 32  # bounded per-alert + engine-wide history
+
+# --- alert-context providers -------------------------------------------------
+# A provider is ``fn(spec) -> Optional[dict]``: extra evidence merged into the
+# one-shot flight-recorder snapshot's alert record on first firing (e.g. the
+# modelwatch contribution ledger attaches the offending clients' stat rows to
+# modelwatch.* alerts). Providers must be cheap and must never raise.
+_ALERT_CONTEXT: List[Callable[[SLOSpec], Optional[Dict[str, Any]]]] = []
+_alert_context_lock = threading.Lock()
+
+
+def register_alert_context(fn: Callable[["SLOSpec"], Optional[Dict[str, Any]]]) -> None:
+    with _alert_context_lock:
+        if fn not in _ALERT_CONTEXT:
+            _ALERT_CONTEXT.append(fn)
+
+
+def unregister_alert_context(fn: Callable[["SLOSpec"], Optional[Dict[str, Any]]]) -> None:
+    with _alert_context_lock:
+        try:
+            _ALERT_CONTEXT.remove(fn)
+        except ValueError:
+            pass
 
 
 @dataclass(frozen=True)
@@ -132,6 +156,21 @@ _ENGINE_PACK: List[Dict[str, Any]] = [
     # HBM high-water near the device limit: the next admission/rebatch OOMs
     dict(name="hbm_high_water", series="devperf.hbm_high_water_frac",
          signal="max", comparator="<=", target=0.95),
+    # modelwatch (telemetry/modelwatch.py): training-dynamics objectives fed
+    # from fold-boundary delta statistics. nan_storm: ANY NaN/Inf in a
+    # published aggregate burns a zero target to infinity — firing in 1 tick
+    # (one breached tick arms pending, the next confirms). No modelwatch
+    # data (feature off, sharded engine) = no opinion, so it never alerts.
+    dict(name="nan_storm", series="modelwatch.nan_count", signal="last",
+         comparator="<=", target=0.0, firing_for_ticks=1),
+    # the contribution ledger publishes update_norm / trailing-EWMA-baseline
+    # as divergence_ratio: a 10x jump in published update magnitude over the
+    # run's own history is divergence, not noise (SLOSpec targets are fixed,
+    # so the trailing-baseline burn lives ledger-side)
+    dict(name="divergence", series="modelwatch.divergence_ratio",
+         signal="max", comparator="<=", target=10.0),
+    dict(name="client_outlier_rate", series="modelwatch.outlier_rate",
+         signal="last", comparator="<=", target=0.25, firing_for_ticks=1),
 ]
 
 _CROSS_SILO_PACK: List[Dict[str, Any]] = _ENGINE_PACK + [
@@ -417,19 +456,31 @@ class SLOEngine:
             st.snapshot_done = True
             rec = flight_recorder.active()
             if rec is not None:
-                st.snapshot_path = rec.dump(
-                    reason=f"slo_alert:{tr['slo']}",
-                    alert={
-                        "slo": tr["slo"],
-                        "series": spec.series,
-                        "signal": spec.signal,
-                        "window_s": tr["window_s"],
-                        "observed": tr["observed"],
-                        "target": tr["target"],
-                        "comparator": tr["comparator"],
-                        "burn_rate": tr["burn_rate"],
-                        "transition": f"{tr['from']}->{tr['to']}",
-                    })
+                alert = {
+                    "slo": tr["slo"],
+                    "series": spec.series,
+                    "signal": spec.signal,
+                    "window_s": tr["window_s"],
+                    "observed": tr["observed"],
+                    "target": tr["target"],
+                    "comparator": tr["comparator"],
+                    "burn_rate": tr["burn_rate"],
+                    "transition": f"{tr['from']}->{tr['to']}",
+                }
+                with _alert_context_lock:
+                    providers = list(_ALERT_CONTEXT)
+                for fn in providers:
+                    try:
+                        extra = fn(spec)
+                        if extra:
+                            # base keys win: providers add evidence, they
+                            # cannot rewrite the alert's own record
+                            alert.update({k: v for k, v in extra.items()
+                                          if k not in alert})
+                    except Exception:  # noqa: BLE001 - evidence must not break fan-out
+                        log.debug("alert-context provider failed", exc_info=True)
+                st.snapshot_path = rec.dump(reason=f"slo_alert:{tr['slo']}",
+                                            alert=alert)
         self._maybe_capture_profile()
 
     def _maybe_capture_profile(self) -> None:
@@ -572,13 +623,16 @@ def deactivate(engine: Optional[SLOEngine]) -> None:
 
 
 def reset() -> None:
-    """Tests: drop the active engine and the tsdb hook unconditionally."""
+    """Tests: drop the active engine, the tsdb hook, and any registered
+    alert-context providers unconditionally."""
     global _ENGINE
     with _engine_lock:
         engine = _ENGINE
         _ENGINE = None
     if engine is not None:
         engine.stop()
+    with _alert_context_lock:
+        del _ALERT_CONTEXT[:]
     tsdb.reset()
 
 

@@ -134,6 +134,16 @@ def render(service: Optional[str] = None,
             doc["sections"]["devperf"] = dev
     except Exception as e:  # noqa: BLE001 - status page must not throw
         doc["sections"]["devperf"] = {"error": repr(e)}
+    # modelwatch (per-client contribution ledger + divergence stats): shows
+    # whenever an active ledger is registered by a server/simulator front
+    try:
+        from . import modelwatch as _modelwatch
+
+        mw = _modelwatch.statusz_snapshot()
+        if mw:
+            doc["sections"]["modelwatch"] = mw
+    except Exception as e:  # noqa: BLE001 - status page must not throw
+        doc["sections"]["modelwatch"] = {"error": repr(e)}
     with _sections_lock:
         providers = dict(_sections)
     for name, provider in sorted(providers.items()):

@@ -91,6 +91,26 @@ class FedMLAggregator:
 
             self.async_buffer = buffer_from_args(
                 args, health=self.fleet.health, engine=get_engine())
+        # modelwatch: fold-boundary delta statistics feeding the fleet's
+        # contribution ledger (+ optional quarantine). The sync path screens
+        # cohorts in aggregate(); the async path rides the buffer's fused
+        # fold. Off via FEDML_MODELWATCH=0 / args.modelwatch_disable.
+        from ...core.telemetry import modelwatch
+
+        self._modelwatch = modelwatch.enabled(args)
+        self._mw_prev_update = None  # device tree: last published update direction
+        self._mw_round = 0
+        if self._modelwatch:
+            modelwatch.set_active(self.fleet.ledger)
+            if self.async_buffer is not None:
+                try:
+                    self.async_buffer.enable_watch(
+                        self.get_global_model_params(),
+                        ledger=self.fleet.ledger,
+                        quarantine=modelwatch.quarantine_enabled(args))
+                except Exception:  # noqa: BLE001 - e.g. object-leaf models: stats off
+                    log.warning("modelwatch: async watch unavailable for this "
+                                "model; stats disabled", exc_info=True)
         Context().add(Context.KEY_TEST_DATA, test_global)
 
     def _sharded_ingest_engine(self):
@@ -181,6 +201,21 @@ class FedMLAggregator:
             return True
         return False
 
+    def _modelwatch_session(self):
+        """A fresh watch session against the CURRENT global params (the model
+        this round's deltas trained from), or None when stats are off or the
+        model can't ride XLA (object leaves)."""
+        if not self._modelwatch:
+            return None
+        from ...core.telemetry import modelwatch
+
+        try:
+            return modelwatch.WatchSession(self.get_global_model_params(),
+                                           prev_update=self._mw_prev_update)
+        except Exception:  # noqa: BLE001 - stats are optional, the fold is not
+            log.debug("modelwatch: session unavailable", exc_info=True)
+            return None
+
     def aggregate(self):
         # perf_counter, not the wall clock: NTP steps / slew must not corrupt
         # the duration series the autoscaling + PiPar-style phase analysis
@@ -188,14 +223,32 @@ class FedMLAggregator:
         start = time.perf_counter()
         with tel.span("server.aggregate", k=len(self.model_dict)):
             Context().add("client_indexes_of_round", sorted(self.model_dict))
+            ranks = [i + 1 for i in sorted(self.model_dict)]  # sender ranks
             model_list = [(self.sample_num_dict[i], self.model_dict[i]) for i in sorted(self.model_dict)]
             model_list = self.aggregator.on_before_aggregation(model_list)
+            watch = self._modelwatch_session()
+            if watch is not None:
+                from ...core.telemetry import modelwatch
+
+                if len(ranks) != len(model_list):  # a hook reshaped the cohort
+                    ranks = list(range(len(model_list)))
+                model_list = modelwatch.screen_cohort(
+                    watch, model_list, ranks, ledger=self.fleet.ledger,
+                    quarantine=modelwatch.quarantine_enabled(self.args))
             Context().add(Context.KEY_CLIENT_MODEL_LIST, model_list)
             averaged = self.aggregator.aggregate(model_list)
             averaged = self.aggregator.on_after_aggregation(averaged)
             self.set_global_model_params(averaged)
             self.aggregator.assess_contribution()
             self.model_dict.clear()
+            if watch is not None:
+                try:
+                    stats = watch.finish(averaged)
+                    self._mw_prev_update = stats.update_tree
+                    self.fleet.ledger.observe_round(self._mw_round, stats)
+                except Exception:  # noqa: BLE001 - stats must never fail the round
+                    log.debug("modelwatch: round stats failed", exc_info=True)
+                self._mw_round += 1
         dt = time.perf_counter() - start
         tel.histogram("server.aggregate_seconds").observe(dt)
         log.info("aggregate time cost: %.3fs", dt)

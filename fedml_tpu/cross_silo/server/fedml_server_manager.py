@@ -164,6 +164,9 @@ class FedMLServerManager(FedMLCommManager):
                 self._stop_statusz()
                 slo.deactivate(self._slo)
                 self._slo = None
+                from ...core.telemetry import modelwatch
+
+                modelwatch.clear_active()
 
     # --- statusz ----------------------------------------------------------
     def _start_statusz_if_configured(self) -> None:
@@ -185,6 +188,10 @@ class FedMLServerManager(FedMLCommManager):
             out = list(fleet.health.prom_gauges()) if fleet is not None else []
             if buf is not None:
                 out.extend(buf.prom_gauges())
+            # contribution ledger (modelwatch): only if one was actually built
+            led = getattr(fleet, "_ledger", None) if fleet is not None else None
+            if led is not None:
+                out.extend(led.prom_gauges())
             return out
 
         port_file = getattr(self.args, "statusz_port_file", None)
@@ -558,6 +565,9 @@ class FedMLServerManager(FedMLCommManager):
         fleet = getattr(self.aggregator, "fleet", None)
         if fleet is not None and fleet.merges:
             report = fleet.health.end_round(round_idx)
+            led = getattr(fleet, "_ledger", None)
+            if led is not None:
+                led.annotate_report(report)
             self._slo_tick()
             mlops.log_health_report(round_idx, report)
         else:
@@ -605,6 +615,11 @@ class FedMLServerManager(FedMLCommManager):
             # client.train durations, shipped through the uplink like the
             # fleet summary (and readable live on /statusz + /metrics)
             report = fleet.health.end_round(round_idx)
+            # ride the per-round health report with the contribution ledger's
+            # view (per-rank norm/share/z + the aggregate's update stats)
+            led = getattr(fleet, "_ledger", None)
+            if led is not None:
+                led.annotate_report(report)
             # evaluator tick AFTER end_round (fresh straggler ratio) and
             # BEFORE the uplink, so anything observing log_health_report
             # sees this round's alert state already applied

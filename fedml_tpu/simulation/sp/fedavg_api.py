@@ -90,6 +90,19 @@ class FedAvgAPI:
         self._mime_s = tree_zeros_like(self.model_trainer.get_model_params())
         self.metrics_history: List[Dict[str, float]] = []
 
+        # modelwatch (core.telemetry.modelwatch): fold-boundary delta stats
+        # + contribution ledger for the default weight-space server rule.
+        # Structured payloads (FedNova/SCAFFOLD/MIME) skip stats — their
+        # uploads are not weight trees.
+        self._mw_ledger = None
+        self._mw_prev_update = None
+        self._mw_round = 0
+        from ...core.telemetry import modelwatch
+
+        if modelwatch.enabled(args):
+            self._mw_ledger = modelwatch.ContributionLedger()
+            modelwatch.set_active(self._mw_ledger)
+
         # durable round state (core.resilience): every round boundary is
         # checkpointed async; --resume restarts from the last complete round
         self._round_store = None
@@ -213,7 +226,13 @@ class FedAvgAPI:
             round_span_attrs={"optimizer": self.fed_opt},
             metrics_history=self.metrics_history,
         )
-        engine.run(self.model_trainer.get_model_params())
+        try:
+            engine.run(self.model_trainer.get_model_params())
+        finally:
+            if self._mw_ledger is not None:
+                from ...core.telemetry import modelwatch
+
+                modelwatch.clear_active(self._mw_ledger)
         return self.metrics_history[-1] if self.metrics_history else {}
 
     def _install_global(self, w_global) -> None:
@@ -267,12 +286,40 @@ class FedAvgAPI:
             new_w = agg.on_after_aggregation(new_w)
         else:
             lst = agg.on_before_aggregation(w_locals)
+            watch = self._mw_session(w_global)
+            if watch is not None:
+                from ...core.telemetry import modelwatch
+
+                lst = modelwatch.screen_cohort(
+                    watch, lst, list(range(len(lst))),
+                    ledger=self._mw_ledger,
+                    quarantine=modelwatch.quarantine_enabled(self.args))
             new_w = agg.aggregate(lst)
             if self._fedopt_server is not None:
                 new_w = self._fedopt_server.apply(w_global, new_w)
             new_w = agg.on_after_aggregation(new_w)
+            if watch is not None:
+                try:
+                    stats = watch.finish(new_w)
+                    self._mw_prev_update = stats.update_tree
+                    self._mw_ledger.observe_round(self._mw_round, stats)
+                except Exception:  # noqa: BLE001 - stats must never break the fold
+                    log.debug("modelwatch: round stats failed", exc_info=True)
+                self._mw_round += 1
         agg.assess_contribution()
         return new_w
+
+    def _mw_session(self, w_global):
+        """A per-round modelwatch session over the current global params, or
+        None when disabled (or the tree has non-array leaves)."""
+        if self._mw_ledger is None:
+            return None
+        from ...core.telemetry import modelwatch
+
+        try:
+            return modelwatch.WatchSession(w_global, prev_update=self._mw_prev_update)
+        except Exception:  # noqa: BLE001 - object leaves (FHE ciphertexts) etc.
+            return None
 
     # ------------------------------------------------------------------
     def _test_global(self, round_idx: int) -> Dict[str, float]:

@@ -274,6 +274,16 @@ class LLMTrainer:
                     self.save(step + 1, wait=False)  # fedlint: disable=interproc-host-sync amortized: fires every save_steps, and the device_get feeds the async orbax writer that runs behind the next train steps
                 if step + 1 >= exp.max_steps:
                     break
+            # modelwatch NaN guard + param norm: one jitted pass whose fetch
+            # rides the window-end sync below (no extra device round-trip)
+            guard = None
+            try:
+                from ...core.telemetry import modelwatch
+
+                if modelwatch.enabled(exp):
+                    guard = modelwatch.train_guard(self.params)
+            except Exception:  # noqa: BLE001 - the guard must never break training
+                guard = None
             jax.block_until_ready(self.params)
         dt = sp.duration_s
         final_loss = float(jax.device_get(losses[-1])) if losses else float("nan")
@@ -289,6 +299,17 @@ class LLMTrainer:
             "steps": step + 1,
             "tokens_per_sec": tokens_per_sec,
         }
+        if guard is not None:
+            g = np.asarray(guard, np.float64)  # fedlint: disable=host-sync rides the window-end block_until_ready above
+            metrics["param_norm"] = float(np.sqrt(max(g[0], 0.0)))
+            bad = int(g[1]) + int(g[2])
+            if bad > 0 or not np.isfinite(final_loss):
+                from ...core.telemetry import flight_recorder
+
+                log.warning("modelwatch: non-finite training window (nan=%d inf=%d loss=%s)",
+                            int(g[1]), int(g[2]), final_loss)
+                flight_recorder.mark("modelwatch_train_guard", nan=int(g[1]),
+                                     inf=int(g[2]), final_loss=float(final_loss))
         log.info("LLM train done: %s", metrics)
         self.save(step + 1)
         # drain any async mid-training save still in flight before returning:

@@ -246,6 +246,14 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
                               "pipeline_plan_reason": "balanced",
                               "pipeline_clients": 3,
                               "pipeline_bottleneck": "train"}, None),
+        "modelwatch_overhead": ({"modelwatch_overhead_pct": 0.46,
+                                 "modelwatch_plain_round_ms": 1501.2,
+                                 "modelwatch_watched_round_ms": 1508.1,
+                                 "modelwatch_fold_ms": 12.4,
+                                 "modelwatch_rounds": 16,
+                                 "modelwatch_clients": 16,
+                                 "modelwatch_work_reps": 160,
+                                 "modelwatch_detection_caught": 2}, None),
         "devperf_overhead": ({"llm_mfu": 0.018,
                               "llm_mfu_analytic": 0.018,
                               "llm_mfu_rel_err": 0.0,
@@ -292,6 +300,8 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
     assert out["pipeline_overlap_frac"] == 0.88
     assert out["pipeline_speedup"] == 1.44
     assert out["llm_mfu"] == 0.018
+    assert out["modelwatch_overhead_pct"] == 0.46
+    assert out["modelwatch_detection_caught"] == 2
     assert out["devperf_overhead_pct"] == 0.19
     assert out["devperf_roofline_verdict"] == "bandwidth-bound"
     assert out["stages_failed"] == []

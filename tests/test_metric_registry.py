@@ -84,6 +84,13 @@ EXPORTED = {
     "fedml_device_hbm_high_water_bytes": "gauge",
     "fedml_program_flops_total": "counter",
     "fedml_program_steps_total": "counter",
+    # training-dynamics observability (core/telemetry/modelwatch.py; client
+    # gauges labeled {rank})
+    "fedml_client_delta_norm": "gauge",
+    "fedml_client_contribution": "gauge",
+    "fedml_client_outlier_score": "gauge",
+    "fedml_modelwatch_quarantined_total": "counter",
+    "fedml_modelwatch_nan_rounds_total": "counter",
     # training
     "fedml_llm_tokens_per_sec": "histogram",
     # serving

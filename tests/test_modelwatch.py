@@ -1,0 +1,533 @@
+"""Modelwatch tests: device-side delta statistics at the fold boundary.
+
+Covers the stat math against a numpy reference (per-dtype-group norms,
+NaN/Inf counts, cosine-to-ref), the zero-recompile contract (fused
+watch-fold bit-exact with the plain fold; ``jax.compiles.modelwatch`` and
+``agg_accum`` both pinned across windows), the contribution ledger
+(EWMA share, robust-z outliers, divergence baseline), sync quarantine
+(bit-exact vs the honest-only cohort; all-outlier refusal), the async
+buffer's ``outlier_rejected`` verdict, the fleet's forward-compat
+unknown-key skip, the modelwatch SLO pack rows (``nan_storm`` firing in one
+tick with exactly one flight-recorder snapshot carrying the ledger's client
+rows), and the 3-client cross-silo chaos e2e (``chaos_nan_at_round`` +
+``chaos_scale_delta``; ISSUE 18 acceptance)."""
+
+import json
+import math
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.core import telemetry as tel
+from fedml_tpu.core.aggregation.async_buffer import AsyncAggBuffer, StalenessPolicy
+from fedml_tpu.core.aggregation.bucketed import BucketedAggregator
+from fedml_tpu.core.resilience import quorum
+from fedml_tpu.core.telemetry import flight_recorder, modelwatch, slo, tsdb
+from fedml_tpu.core.telemetry.modelwatch import ContributionLedger, WatchSession
+from fedml_tpu.core.telemetry.slo import SLOEngine, SLOSpec
+from fedml_tpu.core.telemetry.tsdb import TimeSeriesStore
+
+
+def _tree(rng, scale=1.0, nan=False):
+    t = {
+        "w": np.asarray(rng.normal(size=(4, 3)), np.float32) * scale,
+        "b": np.asarray(rng.normal(size=(3,)), np.float32) * scale,
+        "step": np.asarray(rng.integers(0, 5), np.int32),
+    }
+    if nan:
+        t["w"] = t["w"].copy()
+        t["w"][0, 0] = np.nan
+    return t
+
+
+def _flat(tree):
+    return np.concatenate([np.asarray(l, np.float64).ravel()
+                           for l in jax.tree.leaves(tree)])
+
+
+class TestBlockStats:
+    def test_rows_match_numpy_including_dtype_groups(self):
+        rng = np.random.default_rng(0)
+        ref = _tree(rng)
+        clients = [_tree(rng, scale=s) for s in (1.0, 2.0, 0.5)]
+        clients.append(_tree(rng, nan=True))
+        sess = WatchSession(ref)
+        sess.watch_block(clients)
+        stats = sess.finish(ref)  # published == ref: update_norm 0
+        assert len(stats.rows) == 4
+        assert stats.groups == sorted({"float32", "int32"})
+        ref_flat = _flat(ref)
+        for row, c in zip(stats.rows, clients):
+            d = _flat(c) - ref_flat
+            if np.isnan(d).any():
+                assert row["nan"] == 1
+                assert math.isnan(row["norm"]) or not math.isfinite(row["norm"])
+                continue
+            assert row["nan"] == 0 and row["inf"] == 0
+            assert row["norm"] == pytest.approx(float(np.linalg.norm(d)), rel=1e-5)
+            cos = float(np.dot(d, ref_flat) /
+                        (np.linalg.norm(d) * np.linalg.norm(ref_flat)))
+            assert row["cosine"] == pytest.approx(cos, rel=1e-4)
+            # per-dtype groups: int leaves vs float leaves partition the norm
+            f32 = np.concatenate([
+                (np.asarray(c[k], np.float64) - np.asarray(ref[k], np.float64)).ravel()
+                for k in ("w", "b")])
+            assert row["group_norms"]["float32"] == pytest.approx(
+                float(np.linalg.norm(f32)), rel=1e-5)
+        agg = stats.agg
+        assert agg["update_norm"] == pytest.approx(0.0, abs=1e-6)
+        assert agg["cosine_prev"] is None  # first window has no prev update
+
+    def test_fused_fold_bit_exact_and_traces_pinned(self):
+        rng = np.random.default_rng(1)
+        ref = _tree(rng)
+        pairs = [(float(i + 1), _tree(rng)) for i in range(7)]
+        plain = BucketedAggregator(bucket_size=4)
+        watched = BucketedAggregator(bucket_size=4)
+        baseline = plain.aggregate(list(pairs))
+        sess = WatchSession(ref)
+        out = watched.aggregate(list(pairs), watch=sess)
+        for a, b in zip(jax.tree.leaves(baseline), jax.tree.leaves(out)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        stats = sess.finish(out)
+        assert len(stats.rows) == 7  # pad rows truncated
+        first_traces = watched.watch_traces
+        assert first_traces == 2  # first-bucket + steady-state executables
+        assert watched.accum_traces == 0  # plain accumulator untouched
+        # more windows, same shapes: zero recompiles
+        for _ in range(3):
+            s2 = WatchSession(ref, prev_update=stats.update_tree)
+            out2 = watched.aggregate(list(pairs), watch=s2)
+            stats = s2.finish(out2)
+        assert watched.watch_traces == first_traces
+        assert stats.agg["cosine_prev"] == pytest.approx(1.0, abs=1e-5)
+
+    def test_train_guard_counts_bad_values(self):
+        rng = np.random.default_rng(2)
+        clean = _tree(rng)
+        g = np.asarray(modelwatch.train_guard(clean), np.float64)
+        assert g[1] == 0 and g[2] == 0
+        assert math.sqrt(max(g[0], 0.0)) == pytest.approx(
+            float(np.linalg.norm(_flat(clean))), rel=1e-5)
+        bad = dict(clean, w=np.asarray([[np.nan, np.inf], [1.0, 2.0]], np.float32))
+        g = np.asarray(modelwatch.train_guard(bad), np.float64)
+        assert g[1] == 1 and g[2] == 1
+
+
+class TestLedger:
+    def _stats(self, norms, update_norm=1.0, nan=0):
+        rows = [{"rank": i, "norm": float(n), "cosine": 0.5, "update_ratio": 0.1,
+                 "nan": 0, "inf": 0, "group_norms": {}, "quarantined": False}
+                for i, n in enumerate(norms)]
+        agg = {"update_norm": float(update_norm), "nan": int(nan), "inf": 0,
+               "cosine_prev": 0.9, "ref_norm": 10.0, "update_ratio": 0.1}
+        return modelwatch.RoundStats(rows, agg, None, [])
+
+    def test_ewma_share_and_outlier_z(self):
+        led = ContributionLedger()
+        led.observe_round(0, self._stats([1.0, 1.1, 0.9, 50.0]))
+        snap = led.statusz_snapshot()
+        assert snap["rounds"] == 1
+        assert snap["clients"]["3"]["outlier"] is True
+        assert snap["clients"]["3"]["z"] >= modelwatch.z_threshold()
+        assert snap["clients"]["0"]["outlier"] is False
+        shares = [snap["clients"][str(i)]["share"] for i in range(4)]
+        assert sum(shares) == pytest.approx(1.0)
+        assert shares[3] == max(shares)
+        assert snap["outlier_rate"] == pytest.approx(0.25)
+
+    def test_divergence_ratio_vs_trailing_baseline(self):
+        led = ContributionLedger()
+        for r in range(3):
+            out = led.observe_round(r, self._stats([1.0, 1.0, 1.0], update_norm=2.0))
+        assert out["divergence_ratio"] == pytest.approx(1.0)
+        out = led.observe_round(3, self._stats([1.0, 1.0, 1.0], update_norm=40.0))
+        assert out["divergence_ratio"] == pytest.approx(20.0)
+        # NaN rounds never move the baseline
+        base = led._baseline_norm
+        led.observe_round(4, self._stats([1.0], update_norm=float("nan"), nan=3))
+        assert led._baseline_norm == base
+        assert led.nan_rounds == 1
+
+    def test_prom_gauge_triples(self):
+        led = ContributionLedger()
+        led.observe_round(0, self._stats([1.0, float("nan"), 2.0]))
+        gauges = {(n, l["rank"]): v for n, l, v in led.prom_gauges()}
+        assert gauges[("client_delta_norm", "0")] == pytest.approx(1.0)
+        assert gauges[("client_delta_norm", "1")] == -1.0  # non-finite sentinel
+        assert ("client_contribution", "2") in gauges
+        assert ("client_outlier_score", "2") in gauges
+
+
+class TestSyncQuarantine:
+    def test_quarantine_drop_is_bit_exact_vs_honest_only(self):
+        rng = np.random.default_rng(3)
+        ref = _tree(rng)
+        honest = [(1.0, _tree(rng)) for _ in range(5)]
+        evil = (1.0, _tree(rng, scale=80.0))
+        led = ContributionLedger()
+        sess = WatchSession(ref)
+        kept = modelwatch.screen_cohort(sess, honest + [evil],
+                                        list(range(6)), ledger=led,
+                                        quarantine=True)
+        assert len(kept) == 5
+        eng = BucketedAggregator(bucket_size=4)
+        a = eng.aggregate(kept)
+        b = eng.aggregate(list(honest))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        # the quarantined rank still shows up in the finished stats + ledger
+        stats = sess.finish(a)
+        qrows = [r for r in stats.rows if r["quarantined"]]
+        assert [r["rank"] for r in qrows] == [5]
+        assert led.quarantined_total == 1
+        led.observe_round(0, stats)
+        assert led.statusz_snapshot()["clients"]["5"]["quarantined"] == 1
+        assert led.last_outlier_rate == pytest.approx(1 / 6)
+
+    def test_nan_delta_always_quarantined(self):
+        rng = np.random.default_rng(4)
+        ref = _tree(rng)
+        pairs = [(1.0, _tree(rng)) for _ in range(3)] + [(1.0, _tree(rng, nan=True))]
+        sess = WatchSession(ref)
+        kept = modelwatch.screen_cohort(sess, pairs, list(range(4)),
+                                        ledger=None, quarantine=True)
+        assert len(kept) == 3
+        assert list(sess.quarantined) == [3]
+
+    def test_all_outlier_cohort_refuses_total_quarantine(self):
+        rng = np.random.default_rng(5)
+        ref = _tree(rng)
+        pairs = [(1.0, _tree(rng, nan=True)) for _ in range(3)]
+        sess = WatchSession(ref)
+        kept = modelwatch.screen_cohort(sess, pairs, list(range(3)),
+                                        ledger=None, quarantine=True)
+        assert len(kept) == 3  # folding all beats publishing nothing
+        assert not sess.quarantined
+
+    def test_quarantine_off_returns_pairs_unchanged(self):
+        rng = np.random.default_rng(6)
+        ref = _tree(rng)
+        pairs = [(1.0, _tree(rng, scale=99.0))]
+        sess = WatchSession(ref)
+        assert modelwatch.screen_cohort(sess, pairs, [0]) is not None
+        assert len(modelwatch.screen_cohort(WatchSession(ref), pairs, [0])) == 1
+
+
+class TestAsyncWatch:
+    def test_streaming_outlier_and_nan_get_outlier_rejected(self):
+        rng = np.random.default_rng(7)
+        ref = _tree(rng)
+        led = ContributionLedger()
+        buf = AsyncAggBuffer(publish_k=4, policy=StalenessPolicy(exponent=0.0),
+                             engine=BucketedAggregator(bucket_size=4))
+        assert buf.enable_watch(ref, ledger=led, quarantine=True)
+        for rank in range(6):  # fill the streaming-z window with honest norms
+            assert buf.submit(rank, _tree(rng), 1.0, None) == quorum.ACCEPT
+        assert buf.submit(90, _tree(rng, scale=500.0), 1.0, None) == \
+            quorum.OUTLIER_REJECTED
+        assert buf.submit(91, _tree(rng, nan=True), 1.0, None) == \
+            quorum.OUTLIER_REJECTED
+        assert buf.quarantined_total == 2
+        assert led.quarantined_total == 2
+        out = buf.publish()
+        assert out is not None
+        assert led.rounds == 1
+        snap = led.statusz_snapshot()
+        assert snap["clients"]["90"]["quarantined"] == 1
+        # async quarantines count into the rate exactly once
+        assert led.last_outlier_rate == pytest.approx(2 / 8)
+        st = buf.statusz()
+        assert st["modelwatch"] and st["modelwatch_quarantine"]
+        assert st["quarantined_total"] == 2
+
+    def test_sharded_engine_declines_watch(self):
+        class FakeSharded:
+            supports_watch = False
+            bucket_size = 4
+
+        buf = AsyncAggBuffer(publish_k=4, engine=FakeSharded())
+        assert buf.enable_watch({"w": np.zeros(2, np.float32)}) is False
+
+
+class TestFleetForwardCompat:
+    def test_unknown_delta_keys_skipped_and_counted(self, caplog):
+        from fedml_tpu.core.telemetry.fleet import FleetTelemetry
+
+        fleet = FleetTelemetry()
+        delta = {"counters": {"x": 1.0}, "epoch_unix_ns": 1,
+                 "modelwatch_v9_stats": {"future": True}, "other_new": 1}
+        assert fleet.merge_client_delta(1, delta) is True
+        assert fleet.merges == 1
+        summary = fleet.summary()
+        assert summary["unknown_dropped"] == 2
+        assert summary["unknown_keys"] == ["modelwatch_v9_stats", "other_new"]
+        # repeat deltas keep counting but only warn once per new key
+        assert fleet.merge_client_delta(1, delta) is True
+        assert fleet.summary()["unknown_dropped"] == 4
+
+    def test_ledger_property_is_lazy(self):
+        from fedml_tpu.core.telemetry.fleet import FleetTelemetry
+
+        fleet = FleetTelemetry()
+        assert fleet._ledger is None
+        assert isinstance(fleet.ledger, ContributionLedger)
+        assert fleet.ledger is fleet._ledger
+
+
+class TestModelwatchSLOs:
+    def test_pack_rows_present_in_engine_and_cross_silo(self):
+        for front in ("engine", "cross_silo"):
+            specs = {s.name: s for s in slo.build_specs(front)}
+            assert specs["nan_storm"].series == "modelwatch.nan_count"
+            assert specs["nan_storm"].firing_for_ticks == 1
+            assert specs["divergence"].series == "modelwatch.divergence_ratio"
+            assert specs["client_outlier_rate"].series == "modelwatch.outlier_rate"
+
+    def test_nan_storm_fires_with_one_snapshot_carrying_client_rows(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FEDML_FR_DIR", str(tmp_path / "fr"))
+        store = TimeSeriesStore(capacity=64, resolution_s=0.0)
+        specs = [s for s in slo.build_specs("engine") if s.name == "nan_storm"]
+        eng = SLOEngine(specs, store=store, front="test")
+        led = ContributionLedger()
+        modelwatch.set_active(led)
+        try:
+            rows = [{"rank": r, "norm": 1.0 + 0.1 * r, "cosine": 0.5,
+                     "update_ratio": 0.1, "nan": (4 if r == 2 else 0), "inf": 0,
+                     "group_norms": {}, "quarantined": False} for r in range(3)]
+            stats = modelwatch.RoundStats(
+                rows, {"update_norm": 1.0, "nan": 4, "inf": 0,
+                       "cosine_prev": None, "ref_norm": 10.0,
+                       "update_ratio": 0.1}, None, [])
+            with flight_recorder.installed(role="test"):
+                tsdb.install(store)
+                try:
+                    led.observe_round(0, stats)  # feeds modelwatch.nan_count
+                finally:
+                    tsdb.uninstall()
+                eng.tick()   # breach -> pending
+                eng.tick()   # firing_for_ticks=1 confirms on the next tick
+                assert eng.statusz()["slos"]["nan_storm"]["state"] == "firing"
+                dumps = sorted((tmp_path / "fr").glob("fr_*.jsonl"))
+                assert len(dumps) == 1
+                recs = [json.loads(line) for line in
+                        dumps[0].read_text().splitlines()]
+                assert recs[0]["reason"] == "slo_alert:nan_storm"
+                (alert,) = [r for r in recs if r["type"] == "alert"]
+                # the ledger's alert-context rows rode the snapshot
+                assert alert["clients"], "no modelwatch client rows in alert"
+                assert alert["clients"][0]["verdict"] in ("ok", "outlier",
+                                                          "quarantined")
+                assert {c["rank"] for c in alert["clients"]} == {"0", "1", "2"}
+                assert alert["aggregate"]["nan"] == 4
+                # modelwatch breadcrumb made the event ring too
+                assert any(r.get("kind") == "mark" and r.get("name") == "modelwatch"
+                           for r in recs)
+        finally:
+            modelwatch.clear_active(led)
+            slo.reset()
+
+    def test_divergence_slo_watches_ledger_ratio(self):
+        store = TimeSeriesStore(capacity=64, resolution_s=0.0)
+        specs = [s for s in slo.build_specs("engine") if s.name == "divergence"]
+        eng = SLOEngine(specs, store=store, front="test")
+        store.record_gauge("modelwatch.divergence_ratio", 50.0)
+        eng.tick()
+        assert eng.statusz()["slos"]["divergence"]["state"] == "pending"
+
+    def test_alert_context_only_answers_modelwatch_series(self):
+        led = ContributionLedger()
+        assert led.alert_context(SLOSpec(name="x", series="health.straggler_ratio",
+                                         signal="last", target=1.0)) is None
+        ctx = led.alert_context(SLOSpec(name="x", series="modelwatch.nan_count",
+                                        signal="last", target=0.0))
+        assert ctx is not None and "clients" in ctx and "aggregate" in ctx
+
+
+class TestChaosKnobs:
+    def test_nan_and_scale_chaos_poison_the_trained_weights(self):
+        from fedml_tpu.core.engine.round_engine import run_local_round
+
+        class Args:
+            chaos_nan_at_round = 2
+
+        w = {"w": np.ones((2, 2), np.float32), "n": np.asarray(3, np.int32)}
+        out, n = run_local_round(lambda: (w, 10), Args(), 2, rank=1)
+        assert n == 10
+        assert np.isnan(np.asarray(out["w"])).sum() == 1
+        assert np.asarray(out["n"]) == 3  # int leaves never poisoned
+        # other rounds untouched
+        out, _ = run_local_round(lambda: (w, 10), Args(), 1, rank=1)
+        assert not np.isnan(np.asarray(out["w"])).any()
+
+        class ScaleArgs:
+            chaos_scale_delta = 50.0
+            chaos_scale_at_round = 4
+
+        out = run_local_round(lambda: w, ScaleArgs(), 4, rank=2)
+        np.testing.assert_allclose(np.asarray(out["w"]), 50.0 * np.ones((2, 2)))
+        out = run_local_round(lambda: w, ScaleArgs(), 3, rank=2)
+        np.testing.assert_allclose(np.asarray(out["w"]), np.ones((2, 2)))
+
+
+# ---------------------------------------------------------------------------
+# 3-client cross-silo chaos e2e (ISSUE 18 acceptance)
+# ---------------------------------------------------------------------------
+
+class TestModelwatchEndToEnd:
+    def test_chaos_nan_and_scale_trip_modelwatch_slos(self, tmp_path, monkeypatch):
+        """Client 2 NaN-poisons its round-2 upload (``chaos_nan_at_round``),
+        client 3 uploads 50x-scaled weights every round
+        (``chaos_scale_delta``). ``client_outlier_rate`` fires first (the
+        scaled client is an outlier from round 0), then the NaN poisons the
+        published aggregate and — since NaN propagates through the next local
+        round — ``nan_storm`` confirms one tick later. Each firing SLO
+        captures exactly ONE flight-recorder snapshot; the outlier snapshot's
+        ledger rows show client 3 over the z threshold while honest client 1
+        is clean."""
+        import fedml_tpu as fedml
+        from fedml_tpu import mlops
+        from fedml_tpu.arguments import default_config
+        from fedml_tpu.core.distributed.communication.inmemory.broker import (
+            InMemoryBroker,
+        )
+
+        fr_dir = tmp_path / "fr"
+        monkeypatch.setenv("FEDML_FR_DIR", str(fr_dir))
+        n_clients, rounds = 3, 4
+        port_file = tmp_path / "statusz.port"
+
+        firing_seen = threading.Event()
+        release = threading.Event()
+        engines = []
+        orig_report = mlops.log_health_report
+
+        def capture_report(round_idx, report):
+            orig_report(round_idx, report)
+            eng = slo.get_engine()
+            if eng is not None and not firing_seen.is_set():
+                engines.append(eng)
+                if eng.statusz()["slos"]["nan_storm"]["state"] == "firing":
+                    firing_seen.set()
+                    release.wait(timeout=120)
+
+        monkeypatch.setattr(mlops, "log_health_report", capture_report)
+
+        def make_args(rank, role):
+            over = dict(
+                run_id="test_modelwatch", rank=rank, role=role,
+                backend="INMEMORY", scenario="horizontal",
+                client_num_in_total=n_clients, client_num_per_round=n_clients,
+                comm_round=rounds, epochs=1, batch_size=16,
+                frequency_of_the_test=1, dataset="synthetic", model="lr",
+                random_seed=0,
+            )
+            if role == "server":
+                over["statusz_port"] = 0
+                over["statusz_port_file"] = str(port_file)
+            if role == "client" and rank == 2:
+                over["chaos_nan_at_round"] = 2
+            if role == "client" and rank == 3:
+                over["chaos_scale_delta"] = 50.0
+            return default_config("cross_silo", **over)
+
+        def run_party(args, results, key):
+            args = fedml.init(args)
+            device = fedml.device.get_device(args)
+            dataset, output_dim = fedml.data.load(args)
+            model = fedml.model.create(args, output_dim)
+            results[key] = fedml.FedMLRunner(args, device, dataset, model).run()
+
+        t = tel.get_telemetry()
+        was = t.enabled
+        t.set_enabled(True)
+        t.reset()
+        try:
+            InMemoryBroker.reset()
+            results = {}
+            threads = [threading.Thread(
+                target=run_party, args=(make_args(0, "server"), results, "server"),
+                daemon=True)]
+            for rank in range(1, n_clients + 1):
+                threads.append(threading.Thread(
+                    target=run_party,
+                    args=(make_args(rank, "client"), results, f"c{rank}"),
+                    daemon=True))
+            for th in threads:
+                th.start()
+            try:
+                assert firing_seen.wait(timeout=300), \
+                    "nan_storm SLO never reached firing"
+                deadline = 60.0
+                import time as _time
+                end = _time.monotonic() + deadline
+                while not port_file.exists() and _time.monotonic() < end:
+                    _time.sleep(0.01)
+                port = int(port_file.read_text())
+
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/statusz", timeout=10) as resp:
+                    doc = json.loads(resp.read())
+                alerts = doc["sections"]["alerts"]
+                assert alerts["slos"]["nan_storm"]["state"] == "firing"
+                assert alerts["slos"]["nan_storm"]["snapshot_path"]
+
+                mw = doc["sections"]["modelwatch"]
+                assert mw["rounds"] >= 1
+                assert mw["nan_rounds"] >= 1
+                assert set(mw["clients"]) == {"1", "2", "3"}
+
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+                    metrics = resp.read().decode()
+                assert 'fedml_alert_active{slo="nan_storm"} 1' in metrics
+                assert 'fedml_client_delta_norm{rank="1"}' in metrics
+                assert 'fedml_client_contribution{rank="3"}' in metrics
+                assert 'fedml_client_outlier_score{rank="3"}' in metrics
+
+                # exactly one snapshot per fired SLO (per-spec one-shot)
+                by_reason = {}
+                for d in sorted(fr_dir.glob("fr_*.jsonl")):
+                    recs = [json.loads(line) for line in
+                            d.read_text().splitlines()]
+                    by_reason.setdefault(recs[0]["reason"], []).append(recs)
+                assert len(by_reason.get("slo_alert:nan_storm", [])) == 1
+                assert len(by_reason.get("slo_alert:client_outlier_rate", [])) == 1
+
+                (nan_recs,) = by_reason["slo_alert:nan_storm"]
+                (alert,) = [r for r in nan_recs if r["type"] == "alert"]
+                assert alert["transition"] == "pending->firing"
+                assert alert["clients"], "ledger rows missing from the snapshot"
+
+                # the outlier snapshot fired BEFORE the NaN storm: its ledger
+                # rows prove client 3 was over threshold while 1 stayed clean
+                (out_recs,) = by_reason["slo_alert:client_outlier_rate"]
+                (out_alert,) = [r for r in out_recs if r["type"] == "alert"]
+                rows = {c["rank"]: c for c in out_alert["clients"]}
+                assert rows["3"]["verdict"] == "outlier"
+                z3 = rows["3"]["z"]
+                assert z3 == "inf" or float(z3) >= modelwatch.z_threshold()
+                assert rows["1"]["verdict"] == "ok"
+                assert rows["1"]["nan"] == 0
+            finally:
+                release.set()
+
+            for th in threads:
+                th.join(timeout=300)
+                assert not th.is_alive(), "modelwatch chaos cluster deadlocked"
+            assert results["server"] is not None
+            (eng,) = set(engines)
+            assert any(tr["slo"] == "nan_storm" and tr["to"] == "firing"
+                       for tr in eng.history)
+            assert eng.statusz()["slos"]["nan_storm"]["snapshot_path"] is not None
+            # the run ended: active ledger + engine must be torn down
+            assert slo.get_engine() is None
+            assert modelwatch.get_active() is None
+        finally:
+            release.set()
+            t.reset()
+            t.set_enabled(was)

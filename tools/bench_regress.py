@@ -70,6 +70,7 @@ HEADLINES: Dict[str, str] = {
     "slo_overhead_pct": "lower",             # ISSUE 14 evaluator guard
     "llm_mfu": "higher",                     # ISSUE 17 devperf registry MFU
     "devperf_overhead_pct": "lower",         # ISSUE 17 registry cost guard
+    "modelwatch_overhead_pct": "lower",      # ISSUE 18 fold-stats cost guard
     "_llm_pallas.tokens_per_sec": "higher",
     "_llm_pallas.mfu": "higher",
 }

@@ -85,6 +85,7 @@ commit_artifacts() {
       surface_wan_profile
       surface_pipeline_overlap
       surface_devperf
+      surface_modelwatch
       surface_placement
       surface_resilience
       surface_serving
@@ -251,6 +252,30 @@ if doc.get("llm_mfu") is not None:
 PYEOF
 ) || return 0
   [ -n "$dp" ] && log "$dp"
+}
+
+surface_modelwatch() {
+  # one-line view of the modelwatch stage: the fold-boundary stats' cost
+  # share of a round-shaped loop (watched-vs-plain, integrity-guarded
+  # in-stage) plus the detection liveness count — so the watcher log
+  # answers "is training-dynamics observability still free and still
+  # catching divergent clients" without opening BENCH_MEASURED_*.json
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1) || return 0
+  [ -n "$newest" ] || return 0
+  local mw
+  mw=$(python3 - "$newest" <<'PYEOF' 2>/dev/null
+import json, sys
+doc = json.load(open(sys.argv[1]))
+if doc.get("modelwatch_overhead_pct") is not None:
+    print(f"modelwatch: overhead {doc['modelwatch_overhead_pct']}% of round "
+          f"(plain {doc.get('modelwatch_plain_round_ms')}ms vs watched "
+          f"{doc.get('modelwatch_watched_round_ms')}ms, fold "
+          f"{doc.get('modelwatch_fold_ms')}ms), detection "
+          f"{doc.get('modelwatch_detection_caught')}/2 caught")
+PYEOF
+) || return 0
+  [ -n "$mw" ] && log "$mw"
 }
 
 surface_placement() {

@@ -121,6 +121,34 @@ def _fmt_alert_mark(ev: Dict[str, Any]) -> str:
     return out + _fmt_fields(fields)
 
 
+def _fmt_modelwatch_mark(ev: Dict[str, Any]) -> str:
+    """modelwatch / modelwatch_quarantine breadcrumbs: the offending ranks
+    and norms inline, so the timeline reads "who diverged, when"."""
+    fields = dict(ev.get("fields") or {})
+    if ev.get("name") == "modelwatch_quarantine":
+        rank = fields.pop("rank", "?")
+        norm = fields.pop("norm", None)
+        z = fields.pop("z", None)
+        out = f" rank {rank} quarantined"
+        if norm is not None:
+            out += f" (norm {norm}, z {z})"
+        return out + _fmt_fields(fields)
+    rnd = fields.pop("round", None)
+    parts = [] if rnd is None else [f"round {rnd}"]
+    for key in ("nan", "inf"):
+        v = fields.pop(key, 0)
+        if v:
+            parts.append(f"{key}={v}")
+    for key in ("outliers", "quarantined"):
+        v = fields.pop(key, None)
+        if v:
+            parts.append(f"{key}: {','.join(str(r) for r in v)}")
+    upd = fields.pop("update_norm", None)
+    if upd is not None:
+        parts.append(f"|update|={upd}")
+    return (" " + " ".join(parts) if parts else "") + _fmt_fields(fields)
+
+
 def render(doc: Dict[str, Any], out=sys.stdout) -> None:
     meta = doc["meta"]
     w = out.write
@@ -144,6 +172,17 @@ def render(doc: Dict[str, Any], out=sys.stdout) -> None:
         w(f"    observed: {alert.get('observed')} {alert.get('comparator')} "
           f"target {alert.get('target')} over {_fmt_window(alert.get('window_s'))}\n")
         w(f"    burn rate: {alert.get('burn_rate')}x\n")
+        # modelwatch alert context (ledger rows merged by the SLO engine):
+        # who was diverging when the alert captured this snapshot
+        clients = alert.get("clients")
+        if clients:
+            w("    clients (by |z|, worst first):\n")
+            for row in clients:
+                w(f"      rank {row.get('rank'):>4}  norm {str(row.get('norm')):>12}  "
+                  f"z {str(row.get('z')):>10}  {row.get('verdict', '?')}\n")
+        agg = alert.get("aggregate")
+        if agg:
+            w(f"    aggregate:{_fmt_fields(agg)}\n")
 
     trace = doc.get("trace", {}).get("context")
     if trace:
@@ -186,6 +225,8 @@ def render(doc: Dict[str, Any], out=sys.stdout) -> None:
                 detail = _fmt_comm(ev)
             elif ev.get("kind") == "mark" and ev.get("name") == "slo_alert":  # fedlint: disable=recorder-kind stdlib-only dump reader: matches EVENT_MARK without importing fedml_tpu
                 detail = _fmt_alert_mark(ev)
+            elif ev.get("kind") == "mark" and str(ev.get("name", "")).startswith("modelwatch"):  # fedlint: disable=recorder-kind stdlib-only dump reader: matches EVENT_MARK without importing fedml_tpu
+                detail = _fmt_modelwatch_mark(ev)
             else:
                 detail = _fmt_fields(ev.get("fields"))
             w(f"  +{rel_s:9.4f}s  {ev.get('kind'):<10} {ev.get('name')}{detail}\n")
