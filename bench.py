@@ -1437,6 +1437,284 @@ def _bench_async_rounds(publishes: int = 8, reps: int = 3):
     }
 
 
+def _bench_fleet_scale():
+    """Sketch-based fleet telemetry at million-client scale (ISSUE 19).
+
+    1M synthetic clients (heavy-tail lognormal round times with planted
+    40x stragglers, outlier-spiked delta norms, geometric staleness) are
+    ingested edge-locally into a 3-tier HierarchyTree's mergeable sketches
+    (DDSketch-style quantiles + count-min top-k offenders + HLL distinct
+    clients), flushed edge->regional->root, and the ROOT's merged view is
+    judged against numpy ground truth computed from the raw arrays. A
+    second slice runs the vmapped event-clock driver through a real tree so
+    the per-submit staleness sketch feed is exercised on the production
+    path, not just the vectorized bulk one.
+
+    Integrity guards (BenchIntegrityError, refusing to publish):
+    - accuracy: root-view p50/p90/p99/p999 within 2% relative error of
+      np.quantile on every family (the sketch promises <= 1% by
+      construction; 2% leaves room for interpolation differences).
+    - associativity: root view == flat single-sketch ingest — quantile
+      buckets and HLL registers BIT-EXACT, count-min tables to float
+      round-off — i.e. edge-merged == flat-merged.
+    - memory: total resident sketch bytes across ALL nodes within 1.5x of
+      a 100x-smaller reference run (O(sketch-bytes x nodes), NOT
+      O(clients)), and < 64 bytes amortized per client.
+    - overhead: on the driver slice (the production submit path, where
+      sketch ingest rides real buffer folds) the self-accounted sketch
+      ingest + merge time must stay < 1% of the slice wall. The bulk
+      vectorized 1M-client feed is the harness computing ground truth —
+      its absolute cost is reported (fleet_scale_ingest_seconds) but the
+      overhead claim is about what telemetry adds to real server work."""
+    import jax
+
+    from fedml_tpu.core.aggregation.bucketed import BucketedAggregator
+    from fedml_tpu.core.distributed.hierarchy import HierarchyTree
+    from fedml_tpu.core.telemetry import sketches as fsk
+    from fedml_tpu.simulation.vmapped.async_driver import (
+        AsyncEventSim,
+        DelayModel,
+        make_synthetic_delta_fn,
+    )
+
+    t0 = time.monotonic()
+    dev = jax.devices()[0]
+    tiny = os.environ.get("FEDML_BENCH_TINY") == "1"
+    n_clients = 20_000 if tiny else 1_000_000
+    n_edges = 16 if tiny else 64
+    fanout = 4 if tiny else 8
+    n_ref = n_clients // 100  # the memory-independence reference cohort
+    n_planted = 12
+
+    rng = np.random.default_rng(19)
+    ranks = np.arange(n_clients, dtype=np.uint64)
+    round_times = rng.lognormal(mean=1.0, sigma=0.6, size=n_clients)
+    # stragglers are PERSISTENTLY slow, not slow once: each planted rank
+    # recurs across many rounds at 40x — one lone slow observation is (by
+    # design) below the count-min noise floor at 1M clients
+    planted = rng.choice(n_clients, size=n_planted, replace=False)
+    rep = max(8, n_clients // 2000)
+    straggler_ranks = np.repeat(planted.astype(np.uint64), rep)
+    straggler_times = 40.0 * rng.lognormal(1.0, 0.6, straggler_ranks.size)
+    rt_ranks = np.concatenate([ranks, straggler_ranks])
+    rt_vals = np.concatenate([round_times, straggler_times])
+    delta_norms = np.abs(rng.normal(1.0, 0.25, size=n_clients)) + 1e-6
+    out_mask = rng.random(n_clients) < 0.01
+    delta_norms[out_mask] *= 25.0
+    staleness = (rng.geometric(0.5, size=n_clients) - 1).astype(np.float64)
+
+    def ingest(n: int, edges: int, reg_fanout: int):
+        """Edge-local vectorized ingest + one flush; returns the tree, the
+        root's merged view, and the flush wall seconds. ``n == n_clients``
+        ingests the full arrays (straggler repeats included); the reference
+        run takes the first ``n`` clients only."""
+        tree = HierarchyTree.build(edges, regional_fanout=reg_fanout)
+        rr = rt_ranks if n == n_clients else ranks[:n]
+        rv = rt_vals if n == n_clients else round_times[:n]
+        r = ranks[:n]
+        rt_edge = (rr % np.uint64(edges)).astype(np.int64)
+        edge_of = (r % np.uint64(edges)).astype(np.int64)
+        for e_idx, edge in enumerate(tree.edges):
+            rsel = rt_edge == e_idx
+            sel = edge_of == e_idx
+            sk = edge.fleet.sketches
+            sk.observe_round_times(rr[rsel], rv[rsel])
+            sk.observe_delta_norms(r[sel], delta_norms[:n][sel],
+                                   n_outliers=int(out_mask[:n][sel].sum()))
+            sk.observe_stalenesses(r[sel], staleness[:n][sel])
+        tf = time.perf_counter()
+        tree.flush_sketches()
+        view = tree.root.fleet.sketch_view()
+        return tree, view, time.perf_counter() - tf
+
+    _p(f"fleet_scale: ingest {n_clients} clients across {n_edges} edges")
+    tree, view, flush_s = ingest(n_clients, n_edges, fanout)
+
+    # --- associativity: edge-merged == flat-merged -------------------------
+    flat = fsk.FleetSketches()
+    flat.observe_round_times(rt_ranks, rt_vals)
+    flat.observe_delta_norms(ranks, delta_norms, n_outliers=int(out_mask.sum()))
+    flat.observe_stalenesses(ranks, staleness)
+    for fam in fsk.FLEET_FAMILIES:
+        if view.quantiles[fam] != flat.quantiles[fam]:
+            raise BenchIntegrityError(
+                f"fleet_scale associativity failed: {fam} quantile buckets "
+                "differ between edge-merged and flat ingest; refusing to "
+                "publish")
+    if not np.array_equal(view.clients.registers, flat.clients.registers):
+        raise BenchIntegrityError(
+            "fleet_scale associativity failed: HLL registers differ between "
+            "edge-merged and flat ingest; refusing to publish")
+    cms_drift = float(np.max(np.abs(view.offenders.table - flat.offenders.table))
+                      / (np.max(np.abs(flat.offenders.table)) + 1e-12))
+    if cms_drift > 1e-9:
+        raise BenchIntegrityError(
+            f"fleet_scale associativity failed: count-min tables drifted "
+            f"{cms_drift:.3e} (> 1e-9 of table scale); refusing to publish")
+
+    # wire roundtrip must preserve the merged view exactly
+    rt_view = fsk.FleetSketches.from_wire(view.to_wire())
+    if any(rt_view.quantiles[f] != view.quantiles[f] for f in fsk.FLEET_FAMILIES):
+        raise BenchIntegrityError(
+            "fleet_scale wire roundtrip changed quantile buckets; refusing "
+            "to publish")
+
+    # --- accuracy vs numpy ground truth ------------------------------------
+    exact_arrays = {"round_time_s": rt_vals, "delta_norm": delta_norms,
+                    "staleness": staleness}
+    err_pct = 0.0
+    quantile_rows: dict = {}
+    for fam, arr in exact_arrays.items():
+        row = {}
+        for q in fsk.FLEET_QUANTILES:
+            est = view.quantiles[fam].quantile(q)
+            exact = float(np.quantile(arr, q))
+            rel = abs(est - exact) / max(abs(exact), 1e-9)
+            err_pct = max(err_pct, 100.0 * rel)
+            row[str(q)] = round(est, 6)
+        quantile_rows[fam] = row
+    if err_pct > 2.0:
+        raise BenchIntegrityError(
+            f"fleet_scale quantile error {err_pct:.3f}% > 2% vs numpy exact; "
+            "refusing to publish")
+
+    # planted stragglers must surface in the root's top-k offender heap
+    top_keys = {ki for ki, _ in view.offenders.topk()}
+    recovered = sum(1 for p in planted if int(p) in top_keys)
+    if recovered < n_planted - 2:
+        raise BenchIntegrityError(
+            f"fleet_scale top-k missed planted stragglers: {recovered}/"
+            f"{n_planted} recovered; refusing to publish")
+
+    hll_err_pct = 100.0 * abs(view.clients.estimate() - n_clients) / n_clients
+
+    # --- memory: O(sketch-bytes x nodes), not O(clients) --------------------
+    def resident_bytes(t: HierarchyTree) -> int:
+        total = 0
+        for node in [t.root, *t.regionals, *t.edges]:
+            total += node.fleet.sketches.nbytes()
+            total += sum(cs.nbytes() for cs in node.fleet._child_sketches.values())
+        return total
+
+    big_bytes = resident_bytes(tree)
+    _p(f"fleet_scale: reference ingest {n_ref} clients")
+    ref_tree, _, _ = ingest(n_ref, n_edges, fanout)
+    ref_bytes = resident_bytes(ref_tree)
+    mem_ratio = big_bytes / max(ref_bytes, 1)
+    bytes_per_client = big_bytes / n_clients
+    n_bundles = 0  # one sketch bundle per node + per forwarded child slot
+    for node in [tree.root, *tree.regionals, *tree.edges]:
+        n_bundles += 1 + len(node.fleet._child_sketches)
+    if mem_ratio > 1.5:
+        raise BenchIntegrityError(
+            f"fleet_scale telemetry memory scaled with cohort: {big_bytes}B "
+            f"at {n_clients} clients vs {ref_bytes}B at {n_ref} "
+            f"({mem_ratio:.2f}x > 1.5x); refusing to publish")
+    if big_bytes > n_bundles * 262_144:
+        raise BenchIntegrityError(
+            f"fleet_scale sketch bundles average {big_bytes // n_bundles}B "
+            "(> 256KiB each): footprint is no longer topology-bounded; "
+            "refusing to publish")
+    if n_clients >= 500_000 and bytes_per_client > 64.0:
+        raise BenchIntegrityError(
+            f"fleet_scale telemetry costs {bytes_per_client:.1f}B/client at "
+            "full scale (> 64B amortized); refusing to publish")
+
+    # --- event-clock driver slice: the production submit path --------------
+    _p("fleet_scale: event-clock driver slice")
+    eng = BucketedAggregator(16)
+    key = np.random.default_rng(23)
+    # ~100k-param MLP proxy (the async_rounds pytree): hop + observe costs
+    # are judged against folds of a realistically-sized model, not a toy
+    template = jax.device_put({
+        "dense1": {"kernel": np.asarray(key.standard_normal((128, 256)), np.float32),
+                   "bias": np.zeros((256,), np.float32)},
+        "dense2": {"kernel": np.asarray(key.standard_normal((256, 256)), np.float32),
+                   "bias": np.zeros((256,), np.float32)},
+        "head": {"kernel": np.asarray(key.standard_normal((256, 64)), np.float32),
+                 "bias": np.zeros((64,), np.float32)}})
+    gen = make_synthetic_delta_fn(seed=3)
+    sim_tree = HierarchyTree.build(8 if tiny else 16, publish_k=8, engine=eng,
+                                   initial_model=template)
+    sim = AsyncEventSim(sim_tree, gen, n_clients, initial_model=template,
+                        delay=DelayModel(n_clients, seed=7), gen_batch=512)
+    sim.run(1)  # warmup: compiles the fold/publish chain off the clock
+    sim_nodes = [sim_tree.root, *sim_tree.regionals, *sim_tree.edges]
+    obs_before = sum(n.fleet.sketches.quantiles["staleness"].count
+                     for n in sim_nodes)
+    fwd_before = sum(n.forwards for n in sim_nodes)
+    sim_t0 = time.perf_counter()
+    sim_stats = sim.run(4 if tiny else 8)
+    sim_tree.flush_sketches()
+    sim_wall = time.perf_counter() - sim_t0
+    n_obs = sum(n.fleet.sketches.quantiles["staleness"].count
+                for n in sim_nodes) - obs_before
+    # each forward (and each end-of-run flush) ships one sketch wire hop:
+    # child view copy+serialize at the sender, parse at the receiver
+    n_hops = (sum(n.forwards for n in sim_nodes) - fwd_before
+              + len(sim_tree.regionals) + len(sim_tree.edges))
+    sim_view = sim_tree.root.fleet.sketch_view()
+    if sim_view.quantiles["staleness"].count == 0:
+        raise BenchIntegrityError(
+            "fleet_scale driver slice fed ZERO staleness observations into "
+            "the sketches; the submit path is not wired; refusing to publish")
+
+    # --- overhead: sketch time riding the production submit path ------------
+    # Attribution is CALIBRATED, not self-timed in-loop: perf_counter windows
+    # inside the sim absorb GIL waits on jax's async fold threads and bill
+    # telemetry for the server's own compute. Calibrate each per-event cost
+    # standalone, then charge events x unit cost against the slice wall.
+    cal_scratch = fsk.FleetSketches()
+    cal_n = 20_000
+    cal_t0 = time.perf_counter()
+    for i in range(cal_n):
+        cal_scratch.observe_staleness(i & 1023, float(i & 7))
+    per_obs_s = (time.perf_counter() - cal_t0) / cal_n
+    cal_edge = sim_tree.edges[0].fleet
+    cal_t0 = time.perf_counter()
+    for _ in range(64):
+        fsk.FleetSketches.from_wire(cal_edge.wire_view())
+    per_hop_s = (time.perf_counter() - cal_t0) / 64
+    ingest_s = sum(e.fleet.sketches.observe_ns for e in tree.edges) / 1e9
+    merge_s = flush_s + view.merge_ns / 1e9
+    sim_sketch_s = n_obs * per_obs_s + n_hops * per_hop_s
+    overhead_pct = 100.0 * sim_sketch_s / max(sim_wall, 1e-9)
+    if overhead_pct > 1.0:
+        raise BenchIntegrityError(
+            f"fleet_scale sketch ingest+merge took {overhead_pct:.2f}% of "
+            f"the driver-slice wall (> 1%: {n_obs} observes x "
+            f"{per_obs_s * 1e6:.1f}us + {n_hops} hops x "
+            f"{per_hop_s * 1e6:.0f}us vs {sim_wall:.2f}s); refusing to "
+            "publish")
+    stage_wall = time.monotonic() - t0
+
+    return {
+        "fleet_scale_clients": n_clients,
+        "fleet_scale_nodes": 1 + len(tree.regionals) + len(tree.edges),
+        "fleet_scale_quantile_err_pct": round(err_pct, 4),
+        "fleet_telemetry_bytes_per_client": round(bytes_per_client, 3),
+        "fleet_scale_total_sketch_bytes": int(big_bytes),
+        "fleet_scale_mem_ratio_vs_ref": round(mem_ratio, 4),
+        "fleet_scale_ingest_overhead_pct": round(overhead_pct, 4),
+        "fleet_scale_ingest_seconds": round(ingest_s + merge_s, 4),
+        "fleet_scale_driver_slice_seconds": round(sim_wall, 4),
+        "fleet_scale_stage_wall_seconds": round(stage_wall, 2),
+        "fleet_scale_edge_eq_flat": True,
+        "fleet_scale_cms_table_drift": float(f"{cms_drift:.3e}"),
+        "fleet_scale_offenders_recovered": f"{recovered}/{n_planted}",
+        "fleet_scale_hll_err_pct": round(hll_err_pct, 3),
+        "fleet_scale_straggler_ratio": round(view.straggler_ratio(), 5),
+        "fleet_scale_outlier_rate": round(view.outlier_rate(), 5),
+        "fleet_scale_quantiles": quantile_rows,
+        "fleet_scale_sim": {
+            "publishes": sim_stats["publishes"],
+            "merges": sim_stats["merges"],
+            "staleness_observations": int(sim_view.quantiles["staleness"].count),
+        },
+        "device": getattr(dev, "device_kind", str(dev)),
+    }
+
+
 def _bench_wan_profile():
     """Per-link WAN observability (ISSUE 12): a heterogeneous-throttle
     in-memory fleet must be MEASURABLE by the netlink estimators. One
@@ -3478,6 +3756,8 @@ def _stage_result(name: str) -> dict:
         out = _retry_transient(_bench_agg_sharded)
     elif name == "async_rounds":
         out = _retry_transient(_bench_async_rounds)
+    elif name == "fleet_scale":
+        out = _retry_transient(_bench_fleet_scale)
     elif name == "wan_profile":
         out = _retry_transient(_bench_wan_profile)
     elif name == "pipeline_overlap":
@@ -3544,6 +3824,11 @@ _STAGES: list[tuple[str, int]] = [
     # async buffered federation: rounds/hr at 1k/10k/100k simulated clients
     # (flatness + bit-exact sync parity + zero-retrace integrity guards)
     ("async_rounds", 600),
+    # sketch-based fleet telemetry at 1M simulated clients: root-view
+    # quantiles within 2% of numpy exact, edge-merged == flat-merged,
+    # memory O(sketch-bytes x nodes), ingest+merge < 1% of the stage wall
+    # (all integrity-guarded)
+    ("fleet_scale", 600),
     # per-link WAN observability: heterogeneous chaos-throttle fleet, the
     # netlink estimators must recover every injected bandwidth within 20%
     # with probe overhead < 1% of the window (both integrity-guarded). The
@@ -4282,6 +4567,25 @@ def main() -> None:
                 out[key] = devperf_out[key]
     elif devperf_out is not None:
         out["devperf_overhead_skipped"] = devperf_out["skipped"]
+
+    fleet_out = stage_out.get("fleet_scale")
+    if fleet_out is not None and "skipped" not in fleet_out:
+        # fleet-sketch headline (tools/bench_watch.sh surfaces these):
+        # sketch quantile accuracy vs exact + telemetry memory per client at
+        # the million-client ingest, both integrity-guarded in-stage
+        for key in ("fleet_scale_clients", "fleet_scale_nodes",
+                    "fleet_scale_quantile_err_pct",
+                    "fleet_telemetry_bytes_per_client",
+                    "fleet_scale_total_sketch_bytes",
+                    "fleet_scale_mem_ratio_vs_ref",
+                    "fleet_scale_ingest_overhead_pct",
+                    "fleet_scale_edge_eq_flat",
+                    "fleet_scale_offenders_recovered",
+                    "fleet_scale_hll_err_pct"):
+            if fleet_out.get(key) is not None:
+                out[key] = fleet_out[key]
+    elif fleet_out is not None:
+        out["fleet_scale_skipped"] = fleet_out["skipped"]
 
     placement = stage_out.get("placement_search")
     if placement is not None and "skipped" not in placement:

@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from .. import telemetry as tel
 from ..aggregation.async_buffer import AsyncAggBuffer, StalenessPolicy
 from ..telemetry import FleetTelemetry, TraceContext, new_trace_id
+from ..telemetry import sketches as _sketches
 
 PyTree = Any
 
@@ -72,16 +73,28 @@ class HierarchyNode:
     def submit(self, rank: int, model_params: PyTree, sample_num: float,
                client_version: Optional[int],
                telemetry_delta: Optional[dict] = None) -> str:
-        """One client (or child-tier) arrival. Merges telemetry up the whole
-        ancestor chain, folds the model into this node's buffer, and cascades
-        a publish upward when the window fills."""
+        """One client (or child-tier) arrival. Merges telemetry into this
+        node (and, below the sketch threshold, replays the delta up the
+        ancestor chain for exact per-rank fidelity), folds the model into
+        this node's buffer, and cascades a publish upward when the window
+        fills. Above the threshold ancestors see only the sketch summaries
+        this node forwards one hop per publish."""
         if telemetry_delta is not None:
-            node: Optional[HierarchyNode] = self
-            while node is not None:
-                with node._lock:
-                    node.fleet.merge_client_delta(rank, telemetry_delta)
-                node = node.parent
+            with self._lock:
+                self.fleet.merge_client_delta(rank, telemetry_delta)
+                replay_up = not self.fleet.sketch_mode
+            if replay_up:
+                node: Optional[HierarchyNode] = self.parent
+                while node is not None:
+                    with node._lock:
+                        node.fleet.merge_client_delta(rank, telemetry_delta,
+                                                      direct=False)
+                    node = node.parent
         verdict = self.buffer.submit(rank, model_params, sample_num, client_version)
+        if client_version is not None:
+            staleness = max(0, self.buffer.version - int(client_version))
+            with self._lock:
+                self.fleet.sketches.observe_staleness(rank, float(staleness))
         self._maybe_publish()
         return verdict
 
@@ -105,11 +118,30 @@ class HierarchyNode:
                            model: PyTree) -> None:
         with self._lock:
             rank = self._child_ranks.setdefault(child.name, len(self._child_ranks))
+        # the child's merged sketch view rides the publish (ONE hop, no new
+        # round trip): the parent replaces that child's slot, so the root's
+        # sketch_view always equals the flat merge of every edge's sketches
+        with child._lock:
+            wire = child.fleet.wire_view()
+        with self._lock:
+            self.fleet.merge_client_delta(rank, {"sketches": wire})
         # a child's publish is already the freshest model its subtree has:
         # forward at the child's current (synced) version so the staleness
         # decay never double-penalizes the extra tier hop
         self.buffer.submit(rank, model, weight, client_version=self.buffer.version)
         self._maybe_publish()
+
+    def flush_sketches(self) -> None:
+        """Force one sketch forward to the parent outside the publish cycle
+        (end-of-run exposition: the last partial window still counts)."""
+        if self.parent is None:
+            return
+        with self._lock:
+            wire = self.fleet.wire_view()
+        with self.parent._lock:
+            rank = self.parent._child_ranks.setdefault(
+                self.name, len(self.parent._child_ranks))
+            self.parent.fleet.merge_client_delta(rank, {"sketches": wire})
 
     # --- introspection -----------------------------------------------------
     def statusz(self) -> Dict[str, Any]:
@@ -120,6 +152,7 @@ class HierarchyNode:
                 "children": [c.name for c in self.children],
                 "forwards": self.forwards,
                 "fleet_merges": self.fleet.merges,
+                "sketch_observations": self.fleet.sketch_view().observations,
             }
         doc["buffer"] = self.buffer.statusz()
         return doc
@@ -149,6 +182,10 @@ class HierarchyTree:
         self._model = initial_model
         self._trace = TraceContext(new_trace_id(), round_idx=root.buffer.version)
         root._on_publish = self._on_root_publish
+        # the root's merged sketch view is THE fleet summary for this
+        # process: /metrics, /statusz, tsdb, and flight-recorder riders all
+        # read the active provider (last-built tree wins; tests reset)
+        _sketches.set_active_provider(self._root_sketch_view)
 
     @classmethod
     def build(cls, n_edges: int, regional_fanout: int = 4,
@@ -209,6 +246,17 @@ class HierarchyTree:
                 # against the newest GLOBAL model version
                 with node.buffer._lock:  # fedlint: disable=lock-discipline version stamp only, never folds under a foreign lock
                     node.buffer.version = version
+
+    def _root_sketch_view(self):
+        with self.root._lock:
+            return self.root.fleet.sketch_view()
+
+    def flush_sketches(self) -> None:
+        """Propagate every node's current sketch view up one tier per hop
+        (edges → regionals → root), so end-of-run exposition includes the
+        windows that never filled a publish."""
+        for node in self.edges + self.regionals:
+            node.flush_sketches()
 
     # --- introspection -----------------------------------------------------
     def nodes(self) -> List[HierarchyNode]:

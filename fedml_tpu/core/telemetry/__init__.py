@@ -29,10 +29,18 @@ from .core import (
     timed,
 )
 from . import devperf
+from . import sketches
 from .devperf import CompiledProgramRegistry, HbmSampler
 from .flight_recorder import FlightRecorder
 from .fleet import FleetTelemetry
 from .health import ClientHealth, HealthReport, HealthTracker
+from .sketches import (
+    CardinalitySketch,
+    FleetSketches,
+    QuantileSketch,
+    TelemetryCardinalityBudget,
+    TopK,
+)
 from .slo import SLOEngine, SLOSpec
 from .statusz import StatuszServer
 from .tsdb import TimeSeriesStore
@@ -61,6 +69,12 @@ __all__ = [
     "HbmSampler",
     "Histogram",
     "devperf",
+    "sketches",
+    "CardinalitySketch",
+    "FleetSketches",
+    "QuantileSketch",
+    "TelemetryCardinalityBudget",
+    "TopK",
     "FleetTelemetry",
     "FlightRecorder",
     "ClientHealth",

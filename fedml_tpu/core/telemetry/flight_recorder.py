@@ -74,7 +74,8 @@ EVENT_KINDS = frozenset(
      EVENT_EXCEPTION, EVENT_MARK)
 )
 
-DUMP_SCHEMA_VERSION = 1
+# v2: added the optional {"type": "fleet"} sketch-summary record
+DUMP_SCHEMA_VERSION = 2
 
 _ENV_DISABLE = "FEDML_FLIGHT_RECORDER"  # "0" disables recording entirely
 _ENV_CAPACITY = "FEDML_FR_EVENTS"       # ring size (default below)
@@ -273,6 +274,17 @@ class FlightRecorder:
                 lines.append({"type": "mesh", "meshes": topos,
                               "configured_spec": _dmesh.configured_spec(),
                               "shard_bytes_by_device": _dmesh.shard_bytes_by_device()})
+            # fleet sketch summary (quantile table, top-k offenders, budget
+            # state) whenever a fleet view is active — the bounded stand-in
+            # for per-rank state a million-client dump can't carry
+            try:
+                from . import sketches as _fleet_sketches
+
+                fleet_snap = _fleet_sketches.statusz_snapshot()
+            except Exception:  # noqa: BLE001 - diagnostics must not throw
+                fleet_snap = None
+            if fleet_snap:
+                lines.append(dict({"type": "fleet"}, **fleet_snap))
             lines.append({"type": "env", "env": redact_env()})
             for t_ns, kind, name, fields, tid in evs:
                 rec = {"type": "event", "t_ns": t_ns, "kind": kind, "name": name,

@@ -329,10 +329,22 @@ class HealthTracker:
 
     def prom_gauges(self) -> List[tuple]:
         """``(name, labels, value)`` triples for ``prom.render(gauges=...)``:
-        per-rank ``client_health`` score and ``client_straggler`` 0/1."""
+        per-rank ``client_health`` score and ``client_straggler`` 0/1.
+
+        Cardinality-bounded: the family consults the telemetry series budget
+        and degrades to NOTHING per-rank when a fleet-scale cohort would blow
+        the exposition (the ``fedml_fleet_*`` sketch gauges carry the signal
+        instead). Below the budget the output is bit-identical to the
+        un-budgeted code."""
+        from . import sketches as _sketches
+
+        with self._lock:
+            clients = sorted(self._clients.items())
+        if not _sketches.get_budget().admit("client_health", 2 * len(clients)):
+            return []
         with self._lock:
             out: List[tuple] = []
-            for r, c in sorted(self._clients.items()):
+            for r, c in clients:
                 labels = {"rank": str(r)}
                 out.append(("client_health", labels, c.score(self.silence_threshold_s)))
                 out.append(("client_straggler", labels, 1.0 if c.flagged else 0.0))

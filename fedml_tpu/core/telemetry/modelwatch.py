@@ -480,6 +480,10 @@ class ContributionLedger:
         self._quarantined_since_round = 0
         self.nan_rounds = 0
         self.last_outlier_rate = 0.0
+        # FleetSketches the owning FleetTelemetry shares in: delta norms +
+        # outlier flags fold into the fleet quantile/rate sketches so the
+        # signal survives the per-rank families degrading above threshold
+        self.sketches = None
 
     def _row(self, rank: Any) -> Dict[str, Any]:
         return self._clients.setdefault(rank, {
@@ -594,6 +598,11 @@ class ContributionLedger:
                 self.nan_rounds += 1
         if nan_total:
             get_telemetry().counter("modelwatch.nan_rounds").add(1)
+        if self.sketches is not None:
+            for i, r in enumerate(folded):
+                self.sketches.observe_delta_norm(
+                    r["rank"], r.get("norm", float("nan")),
+                    outlier=bool(flags[i]))
         store = tsdb.active()
         if store is not None:
             store.record_gauge("modelwatch.nan_count", float(nan_total))
@@ -624,8 +633,16 @@ class ContributionLedger:
 
     # --- surfaces ---------------------------------------------------------
     def prom_gauges(self) -> List[Tuple[str, Dict[str, str], float]]:
-        """Same triple shape as ``HealthTracker.prom_gauges``."""
+        """Same triple shape as ``HealthTracker.prom_gauges``. The three
+        per-rank families consult the telemetry cardinality budget as one
+        unit and degrade to the fleet sketch summaries when it trips."""
+        from . import sketches as _sketches
+
         out: List[Tuple[str, Dict[str, str], float]] = []
+        with self._lock:
+            n_ranks = len(self._clients)
+        if not _sketches.get_budget().admit("client_ledger", 3 * n_ranks):
+            return out
         with self._lock:
             for rank, row in sorted(self._clients.items(), key=lambda kv: str(kv[0])):
                 labels = {"rank": str(rank)}

@@ -252,6 +252,18 @@ def render(telemetry: Optional[Telemetry] = None,
         devperf_gauges = []
     if devperf_gauges:
         gauges = list(gauges) + devperf_gauges if gauges else devperf_gauges
+    # fleet sketch gauges (fedml_fleet_*{q=} quantiles, top-k offenders,
+    # distinct-clients estimate) + the cardinality budget's live-series
+    # accounting (fedml_telemetry_series_live{family=,state=}) ride along
+    # whenever a fleet view is active — O(1) rows regardless of fleet size
+    try:
+        from . import sketches as _fleet_sketches
+
+        fleet_gauges = _fleet_sketches.prom_gauges()
+    except Exception:  # noqa: BLE001 - metrics must render without the sketches
+        fleet_gauges = []
+    if fleet_gauges:
+        gauges = list(gauges) + fleet_gauges if gauges else fleet_gauges
     if gauges:
         seen_fams = set()
         for name, labels, value in gauges:

@@ -171,6 +171,17 @@ _ENGINE_PACK: List[Dict[str, Any]] = [
          signal="max", comparator="<=", target=10.0),
     dict(name="client_outlier_rate", series="modelwatch.outlier_rate",
          signal="last", comparator="<=", target=0.25, firing_for_ticks=1),
+    # fleet sketch rows (telemetry/sketches.py collector): above the
+    # exact-mode threshold the per-rank health/ledger feeds go quiet for new
+    # ranks and these sketch-derived fleet series carry the straggler-rate /
+    # outlier-rate objectives instead — cardinality-bounded at any cohort
+    # size. No active fleet view = no data = no opinion.
+    dict(name="fleet_round_p99_seconds", series="fleet.round_time_p99",
+         signal="last", comparator="<=", target=600.0),
+    dict(name="fleet_straggler_ratio", series="fleet.straggler_ratio",
+         signal="last", comparator="<=", target=0.5),
+    dict(name="fleet_outlier_rate", series="fleet.outlier_rate",
+         signal="last", comparator="<=", target=0.25),
 ]
 
 _CROSS_SILO_PACK: List[Dict[str, Any]] = _ENGINE_PACK + [

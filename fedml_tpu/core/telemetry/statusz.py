@@ -144,6 +144,17 @@ def render(service: Optional[str] = None,
             doc["sections"]["modelwatch"] = mw
     except Exception as e:  # noqa: BLE001 - status page must not throw
         doc["sections"]["modelwatch"] = {"error": repr(e)}
+    # fleet sketches (bounded quantile/offender/cardinality summary + the
+    # series budget's live/degraded accounting): shows whenever a fleet view
+    # is active — the million-client replacement for per-rank sections
+    try:
+        from . import sketches as _fleet_sketches
+
+        fleet = _fleet_sketches.statusz_snapshot()
+        if fleet:
+            doc["sections"]["fleet_sketches"] = fleet
+    except Exception as e:  # noqa: BLE001 - status page must not throw
+        doc["sections"]["fleet_sketches"] = {"error": repr(e)}
     with _sections_lock:
         providers = dict(_sections)
     for name, provider in sorted(providers.items()):

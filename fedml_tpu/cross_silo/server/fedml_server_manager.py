@@ -154,8 +154,17 @@ class FedMLServerManager(FedMLCommManager):
         # in any handler produces one crash dump with the open round span
         with flight_recorded(role="cross_silo_server"):
             self._slo = slo.activate(self.args, front="cross_silo")
+            from ...core.telemetry import sketches as fleet_sketches
+
+            fleet = getattr(self.aggregator, "fleet", None)
+            if fleet is not None:
+                # the fleet's merged sketch view feeds /metrics, /statusz,
+                # crash dumps, and (below) the tsdb series the fleet SLO
+                # rows watch — cardinality-bounded at any cohort size
+                fleet_sketches.set_active_provider(fleet.sketch_view)
             if self._slo is not None:
                 self._slo.store.add_collector(self._slo_health_collector)
+                self._slo.store.add_collector(fleet_sketches.tsdb_collector)
             self._start_statusz_if_configured()
             try:
                 super().run()
@@ -167,6 +176,7 @@ class FedMLServerManager(FedMLCommManager):
                 from ...core.telemetry import modelwatch
 
                 modelwatch.clear_active()
+                fleet_sketches.set_active_provider(None)
 
     # --- statusz ----------------------------------------------------------
     def _start_statusz_if_configured(self) -> None:

@@ -62,7 +62,7 @@ def _register_prom_family() -> None:
         return
     from ..core.telemetry import prom
 
-    prom.register_prefix_family(
+    prom.register_prefix_family(  # fedlint: disable=label-cardinality tenant set is the statically-configured TenantBudget table, not the client population
         REJECT_PREFIX, ("tenant", "reason"),
         "admission-path rejects by tenant and reason")
     _PROM_REGISTERED = True
@@ -288,10 +288,10 @@ class AdmissionController:
                         for t in list(self._usage))
             for t in sorted(self._usage):
                 share = self._usage[t] / total if total > 0 else 0.0
-                out.append(("serving_tenant_usage_share", {"tenant": t},
+                out.append(("serving_tenant_usage_share", {"tenant": t},  # fedlint: disable=label-cardinality tenant set is the statically-configured TenantBudget table, not the client population
                             float(share)))
                 level = self._bucket.get(t)
                 if level is not None and math.isfinite(level):
-                    out.append(("serving_tenant_budget_tokens", {"tenant": t},
+                    out.append(("serving_tenant_budget_tokens", {"tenant": t},  # fedlint: disable=label-cardinality tenant set is the statically-configured TenantBudget table, not the client population
                                 float(level)))
             return out

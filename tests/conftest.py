@@ -81,6 +81,11 @@ def _reset_singletons():
     from fedml_tpu.core.telemetry import devperf as _devperf
 
     _devperf.reset()
+    # fleet sketches hold a process-wide active provider + cardinality
+    # budget; a leaked provider would surface in later tests' expositions
+    from fedml_tpu.core.telemetry import sketches as _sketches
+
+    _sketches.reset()
 
 
 def spawn_to_logs(cmds, tmp_path, env=None, timeout=600, names=None):

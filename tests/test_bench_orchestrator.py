@@ -254,6 +254,16 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
                                  "modelwatch_clients": 16,
                                  "modelwatch_work_reps": 160,
                                  "modelwatch_detection_caught": 2}, None),
+        "fleet_scale": ({"fleet_scale_clients": 1_000_000,
+                         "fleet_scale_nodes": 73,
+                         "fleet_scale_quantile_err_pct": 0.86,
+                         "fleet_telemetry_bytes_per_client": 6.2,
+                         "fleet_scale_total_sketch_bytes": 6_190_000,
+                         "fleet_scale_mem_ratio_vs_ref": 1.08,
+                         "fleet_scale_ingest_overhead_pct": 0.44,
+                         "fleet_scale_edge_eq_flat": True,
+                         "fleet_scale_offenders_recovered": "12/12",
+                         "fleet_scale_hll_err_pct": 1.49}, None),
         "devperf_overhead": ({"llm_mfu": 0.018,
                               "llm_mfu_analytic": 0.018,
                               "llm_mfu_rel_err": 0.0,
@@ -304,6 +314,9 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
     assert out["modelwatch_detection_caught"] == 2
     assert out["devperf_overhead_pct"] == 0.19
     assert out["devperf_roofline_verdict"] == "bandwidth-bound"
+    assert out["fleet_scale_quantile_err_pct"] == 0.86
+    assert out["fleet_telemetry_bytes_per_client"] == 6.2
+    assert out["fleet_scale_edge_eq_flat"] is True
     assert out["stages_failed"] == []
     # incremental artifacts landed (one per stage + final, same stamp file)
     arts = glob.glob(str(tmp_path / "BENCH_MEASURED_*.json"))

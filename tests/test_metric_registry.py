@@ -91,6 +91,18 @@ EXPORTED = {
     "fedml_client_outlier_score": "gauge",
     "fedml_modelwatch_quarantined_total": "counter",
     "fedml_modelwatch_nan_rounds_total": "counter",
+    # fleet-scale sketch telemetry (core/telemetry/sketches.py; quantile
+    # gauges labeled {q}, offenders {rank} behind the cardinality budget,
+    # series accounting labeled {family, state})
+    "fedml_fleet_round_time_seconds": "gauge",
+    "fedml_fleet_delta_norm": "gauge",
+    "fedml_fleet_staleness": "gauge",
+    "fedml_fleet_offender_round_seconds": "gauge",
+    "fedml_fleet_clients_seen": "gauge",
+    "fedml_fleet_straggler_ratio": "gauge",
+    "fedml_fleet_outlier_rate": "gauge",
+    "fedml_fleet_sketch_bytes": "gauge",
+    "fedml_telemetry_series_live": "gauge",
     # training
     "fedml_llm_tokens_per_sec": "histogram",
     # serving

@@ -1,0 +1,476 @@
+"""Fleet sketch tests (ISSUE 19): merge associativity/commutativity for every
+sketch, the DDSketch quantile error guarantee across distributions, count-min
+heavy-hitter recovery, HLL accuracy, wire roundtrips, the cardinality budget's
+admit/degrade semantics, exact-mode fidelity below the cohort threshold,
+sketch-only mode above it, the 3-tier hierarchy end-to-end (root view ≡ flat
+merge, bit-for-bit), and the bounded Perfetto summary lane."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core import telemetry as tel
+from fedml_tpu.core.distributed.hierarchy import HierarchyTree
+from fedml_tpu.core.telemetry import sketches
+from fedml_tpu.core.telemetry.fleet import FleetTelemetry
+from fedml_tpu.core.telemetry.sketches import (
+    CardinalitySketch,
+    FleetSketches,
+    QuantileSketch,
+    TelemetryCardinalityBudget,
+    TopK,
+)
+
+
+def _train_delta(dur_s, round_idx=0, error=False):
+    rec = {"name": "client.train", "t0_ns": 0, "dur_ns": int(dur_s * 1e9),
+           "attrs": {"round": round_idx}}
+    if error:
+        rec["error"] = True
+    return {"spans": [rec]}
+
+
+def _random_qsketch(rng, n=500, alpha=0.01):
+    sk = QuantileSketch(alpha=alpha)
+    sk.add_many(rng.lognormal(0.0, 1.5, size=n))
+    return sk
+
+
+# --- QuantileSketch ----------------------------------------------------------
+class TestQuantileSketch:
+    @pytest.mark.parametrize("name,draw", [
+        ("heavy_tail", lambda rng, n: rng.lognormal(1.0, 1.2, size=n)),
+        ("bimodal", lambda rng, n: np.concatenate([
+            rng.normal(1.0, 0.05, size=n // 2),
+            rng.normal(100.0, 5.0, size=n - n // 2)]).clip(1e-6)),
+        ("uniform", lambda rng, n: rng.uniform(0.5, 50.0, size=n)),
+    ])
+    def test_error_bound_per_distribution(self, name, draw):
+        rng = np.random.default_rng(7)
+        xs = draw(rng, 20_000)
+        sk = QuantileSketch(alpha=0.01)
+        sk.add_many(xs)
+        xs_sorted = np.sort(xs)
+        for q in sketches.FLEET_QUANTILES:
+            # sketch rank convention: the ceil(q*n)-th smallest item
+            exact = float(xs_sorted[max(0, math.ceil(q * xs.size) - 1)])
+            est = sk.quantile(q)
+            assert abs(est - exact) / exact <= sk.alpha + 1e-9, (name, q)
+
+    def test_constant_distribution(self):
+        sk = QuantileSketch(alpha=0.01)
+        for _ in range(100):
+            sk.add(3.25)
+        for q in sketches.FLEET_QUANTILES:
+            assert sk.quantile(q) == pytest.approx(3.25, rel=0.01)
+
+    def test_scalar_and_vectorized_ingest_agree(self):
+        rng = np.random.default_rng(3)
+        xs = rng.lognormal(0.0, 1.0, size=300)
+        a, b = QuantileSketch(), QuantileSketch()
+        a.add_many(xs)
+        for x in xs:
+            b.add(float(x))
+        assert a == b and a.count == b.count and a.sum == pytest.approx(b.sum)
+
+    def test_merge_associative_commutative_bit_exact(self):
+        rng = np.random.default_rng(11)
+        a, b, c = (_random_qsketch(rng) for _ in range(3))
+        left = a.copy().merge(b).merge(c)
+        right = a.copy().merge(b.copy().merge(c))
+        assert left == right
+        assert a.copy().merge(b) == b.copy().merge(a)
+        # merged == flat fold of the union
+        assert left.count == a.count + b.count + c.count
+
+    def test_merge_alpha_mismatch_raises(self):
+        with pytest.raises(ValueError, match="alpha mismatch"):
+            QuantileSketch(alpha=0.01).merge(QuantileSketch(alpha=0.02))
+
+    def test_wire_roundtrip_bucket_exact(self):
+        rng = np.random.default_rng(5)
+        sk = _random_qsketch(rng, n=1000)
+        sk.add(0.0)  # exercise zero_count
+        back = QuantileSketch.from_bytes(sk.to_bytes())
+        assert back == sk
+        assert back.min == sk.min and back.max == sk.max
+        assert back.sum == sk.sum and back.zero_count == sk.zero_count
+
+    def test_small_values_fold_into_zero_bucket(self):
+        sk = QuantileSketch(min_value=1e-9)
+        sk.add(0.0)
+        sk.add(float("nan"))
+        assert sk.count == 2 and sk.zero_count == 2
+        assert sk.quantile(0.5) == 0.0
+
+
+# --- TopK --------------------------------------------------------------------
+class TestTopK:
+    def test_planted_offenders_recovered(self):
+        rng = np.random.default_rng(13)
+        n = 20_000
+        ranks = np.arange(n, dtype=np.uint64)
+        times = rng.lognormal(0.0, 0.5, size=n)
+        sk = TopK(k=16)
+        sk.add_many(ranks, times)
+        planted = [77, 4242, 19_999]
+        for r in planted:
+            for _ in range(20):  # persistent straggler: repeated 50s rounds
+                sk.add(r, 50.0)
+        top = dict(sk.topk())
+        for r in planted:
+            assert r in top, f"planted offender {r} missing from topk"
+            assert top[r] >= 1000.0  # count-min never under-estimates
+
+    def test_overestimate_only(self):
+        sk = TopK()
+        for i in range(500):
+            sk.add(i, 1.0)
+        sk.add(7, 100.0)
+        assert sk.estimate(7) >= 101.0
+
+    def test_merge_commutative_and_table_exact(self):
+        rng = np.random.default_rng(17)
+        a, b = TopK(), TopK()
+        a.add_many(np.arange(100, dtype=np.uint64), rng.uniform(1, 5, 100))
+        b.add_many(np.arange(50, 150, dtype=np.uint64), rng.uniform(1, 5, 100))
+        ab = a.copy().merge(b)
+        ba = b.copy().merge(a)
+        assert np.array_equal(ab.table, ba.table)
+        assert ab.total == pytest.approx(ba.total)
+        assert dict(ab.topk()) == dict(ba.topk())
+
+    def test_merge_geometry_mismatch_raises(self):
+        with pytest.raises(ValueError, match="geometry mismatch"):
+            TopK(width=1024).merge(TopK(width=512))
+
+    def test_wire_roundtrip(self):
+        sk = TopK()
+        for i in range(40):
+            sk.add(i, float(i + 1))
+        back = TopK.from_bytes(sk.to_bytes())
+        assert np.array_equal(back.table, sk.table)
+        assert back.topk() == sk.topk()
+        assert back.total == pytest.approx(sk.total)
+
+
+# --- CardinalitySketch -------------------------------------------------------
+class TestCardinalitySketch:
+    def test_accuracy(self):
+        sk = CardinalitySketch()
+        n = 50_000
+        sk.add_many(np.arange(n, dtype=np.uint64))
+        assert abs(sk.estimate() - n) / n <= 0.05  # p=12 -> ~1.6% std err
+
+    def test_scalar_and_vectorized_agree(self):
+        keys = np.arange(1000, dtype=np.uint64)
+        a, b = CardinalitySketch(), CardinalitySketch()
+        a.add_many(keys)
+        for k in keys.tolist():
+            b.add(k)
+        assert np.array_equal(a.registers, b.registers)
+
+    def test_merge_is_union_and_idempotent(self):
+        a, b = CardinalitySketch(), CardinalitySketch()
+        a.add_many(np.arange(0, 2000, dtype=np.uint64))
+        b.add_many(np.arange(1000, 3000, dtype=np.uint64))
+        merged = a.copy().merge(b)
+        flat = CardinalitySketch()
+        flat.add_many(np.arange(0, 3000, dtype=np.uint64))
+        assert np.array_equal(merged.registers, flat.registers)
+        # idempotent: merging the same sketch twice changes nothing
+        again = merged.copy().merge(b)
+        assert np.array_equal(again.registers, merged.registers)
+
+    def test_wire_roundtrip(self):
+        sk = CardinalitySketch()
+        sk.add_many(np.arange(5000, dtype=np.uint64))
+        back = CardinalitySketch.from_bytes(sk.to_bytes())
+        assert np.array_equal(back.registers, sk.registers)
+        assert back.estimate() == pytest.approx(sk.estimate())
+
+
+# --- FleetSketches bundle ----------------------------------------------------
+def _random_fleet(rng, n=400):
+    fs = FleetSketches()
+    ranks = rng.integers(0, 10_000, size=n).astype(np.uint64)
+    fs.observe_round_times(ranks, rng.lognormal(1.0, 0.5, size=n))
+    fs.observe_delta_norms(ranks, rng.uniform(0.5, 2.0, size=n), n_outliers=3)
+    fs.observe_stalenesses(ranks, rng.integers(0, 5, size=n).astype(np.float64))
+    return fs
+
+
+def _assert_fleet_equal(a: FleetSketches, b: FleetSketches):
+    for fam in sketches.FLEET_FAMILIES:
+        assert a.quantiles[fam] == b.quantiles[fam], fam
+    assert np.allclose(a.offenders.table, b.offenders.table, atol=1e-9)
+    assert np.array_equal(a.clients.registers, b.clients.registers)
+    assert a.observations == b.observations and a.outliers == b.outliers
+
+
+class TestFleetSketches:
+    def test_merge_associative_commutative(self):
+        rng = np.random.default_rng(23)
+        a, b, c = (_random_fleet(rng) for _ in range(3))
+        left = a.copy().merge(b).merge(c)
+        right = a.copy().merge(b.copy().merge(c))
+        _assert_fleet_equal(left, right)
+        _assert_fleet_equal(a.copy().merge(b), b.copy().merge(a))
+
+    def test_wire_roundtrip(self):
+        rng = np.random.default_rng(29)
+        fs = _random_fleet(rng)
+        back = FleetSketches.from_wire(fs.to_wire())
+        _assert_fleet_equal(back, fs)
+        # wire survives JSON (it rides the telemetry-delta message)
+        back2 = FleetSketches.from_wire(json.loads(json.dumps(fs.to_wire())))
+        _assert_fleet_equal(back2, fs)
+
+    def test_from_wire_rejects_junk(self):
+        with pytest.raises(ValueError):
+            FleetSketches.from_wire({"v": 99})
+        with pytest.raises(ValueError):
+            FleetSketches.from_wire("nope")
+
+    def test_rates_and_snapshot(self):
+        fs = FleetSketches()
+        for r in range(20):
+            fs.observe_round_time(r, 1.0)
+        fs.observe_round_time(99, 50.0)  # >3x median
+        fs.observe_delta_norm(0, 1.0, outlier=True)
+        fs.observe_delta_norm(1, 1.0)
+        assert 0.0 < fs.straggler_ratio() < 0.2
+        assert fs.outlier_rate() == pytest.approx(0.5)
+        snap = fs.snapshot()
+        assert snap["clients_seen"] == pytest.approx(21, abs=2)
+        assert snap["top_offenders"][0]["rank"] == 99
+        assert snap["sketch_bytes"] == fs.nbytes() > 0
+
+    def test_prom_gauges_cardinality_bounded(self):
+        rng = np.random.default_rng(31)
+        fs = _random_fleet(rng, n=5000)
+        rows = fs.prom_gauges()
+        # 3 families x 4 quantiles + <=16 offenders + 4 scalars, O(1) in n
+        assert len(rows) <= 3 * 4 + 16 + 4
+        names = {r[0] for r in rows}
+        assert "fleet_round_time_seconds" in names
+        offender_rows = [r for r in rows if r[0] == "fleet_offender_round_seconds"]
+        assert 0 < len(offender_rows) <= 16
+        # offender emission registered with the process budget
+        assert "fleet_offenders" in sketches.get_budget().live()
+
+
+# --- TelemetryCardinalityBudget ----------------------------------------------
+class TestBudget:
+    def test_admit_within_caps(self):
+        b = TelemetryCardinalityBudget(max_series=100, per_family=10)
+        assert b.admit("health", 8)
+        assert b.live() == {"health": 8}
+        assert b.degraded() == {}
+
+    def test_admit_is_idempotent_per_family(self):
+        b = TelemetryCardinalityBudget(max_series=100, per_family=10)
+        assert b.admit("health", 8) and b.admit("health", 9)
+        assert b.live() == {"health": 9}  # replaced, not summed
+
+    def test_per_family_cap_degrades(self):
+        b = TelemetryCardinalityBudget(max_series=1000, per_family=16)
+        assert not b.admit("lanes", 200)
+        assert b.degraded() == {"lanes": 200} and b.live() == {}
+        # shrinking back under the cap re-admits
+        assert b.admit("lanes", 16)
+        assert b.live() == {"lanes": 16} and b.degraded() == {}
+
+    def test_total_cap_across_families(self):
+        b = TelemetryCardinalityBudget(max_series=20, per_family=15)
+        assert b.admit("a", 15)
+        assert not b.admit("b", 10)  # 15 + 10 > 20
+        assert b.degraded() == {"b": 10}
+        b.release("a")
+        assert b.admit("b", 10)
+
+    def test_prom_gauges_expose_live_and_degraded(self):
+        b = TelemetryCardinalityBudget(max_series=10, per_family=5)
+        b.admit("ok", 3)
+        b.admit("big", 50)
+        rows = {(r[1]["family"], r[1]["state"]): r[2] for r in b.prom_gauges()}
+        assert rows[("ok", "live")] == 3.0
+        assert rows[("big", "degraded")] == 50.0
+
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("FEDML_TELEMETRY_SERIES_BUDGET", "123")
+        monkeypatch.setenv("FEDML_TELEMETRY_SERIES_PER_FAMILY", "7")
+        b = TelemetryCardinalityBudget()
+        assert b.max_series == 123 and b.per_family == 7
+
+
+# --- fleet path: exact mode vs sketch-only mode ------------------------------
+class TestFleetModes:
+    def test_exact_mode_below_threshold(self):
+        """Small cohorts keep the full per-rank exact path: every rank has a
+        per-client entry, nothing is sketch-only, and the summary carries the
+        same per-client rows as before sketches existed."""
+        fleet = FleetTelemetry()
+        for r in range(8):
+            assert fleet.merge_client_delta(r, _train_delta(1.0 + r * 0.1))
+        assert not fleet.sketch_mode
+        assert fleet.ranks == list(range(8))
+        assert fleet.sketch_only_merges == 0
+        doc = fleet.summary()
+        assert set(doc["clients"]) == {str(r) for r in range(8)}
+        assert "sketch_only_merges" not in doc
+        # sketches ride along additively (same observations, exact rows kept)
+        assert doc["sketches"]["observations"] == 8
+
+    def test_sketch_only_mode_above_threshold(self, monkeypatch):
+        monkeypatch.setenv("FEDML_FLEET_SKETCH_THRESHOLD", "4")
+        fleet = FleetTelemetry()
+        for r in range(10):
+            assert fleet.merge_client_delta(r, _train_delta(1.0))
+        assert fleet.sketch_mode
+        assert fleet.ranks == list(range(4))  # only pre-threshold ranks exact
+        assert fleet.sketch_only_merges == 6
+        view = fleet.sketch_view()
+        assert view.quantiles["round_time_s"].count == 10  # nobody dropped
+        assert fleet.summary()["sketch_only_merges"] == 6
+
+    def test_child_wire_replaces_slot_no_double_count(self):
+        """A child tier's wire is cumulative: re-forwarding the same (grown)
+        view must REPLACE the slot, never add to it."""
+        child = FleetSketches()
+        child.observe_round_time(1, 2.0)
+        parent = FleetTelemetry()
+        assert parent.merge_client_delta(0, {"sketches": child.to_wire()})
+        child.observe_round_time(2, 3.0)
+        assert parent.merge_client_delta(0, {"sketches": child.to_wire()})
+        view = parent.sketch_view()
+        assert view.quantiles["round_time_s"].count == 2  # not 3
+        assert view.observations == 2
+        # sketches-only deltas never create a per-rank client entry
+        assert parent.ranks == []
+
+    def test_unusable_wire_tolerated(self):
+        parent = FleetTelemetry()
+        assert parent.merge_client_delta(0, {"sketches": {"v": 1, "q": {}}})
+        assert parent.sketch_view().observations == 0
+
+    def test_indirect_merge_does_not_feed_sketches(self):
+        fleet = FleetTelemetry()
+        fleet.merge_client_delta(1, _train_delta(2.0), direct=False)
+        assert fleet.sketches.observations == 0  # exact row only
+        assert 1 in fleet.ranks
+
+
+# --- 3-tier hierarchy end-to-end ---------------------------------------------
+class TestHierarchyEndToEnd:
+    @pytest.mark.parametrize("threshold", ["2", "100000"])
+    def test_root_view_equals_flat_merge(self, monkeypatch, threshold):
+        """Edge-merged ≡ flat-merged, in sketch mode AND exact mode: fold
+        clients through 4 edges -> 2 regionals -> root, then compare the
+        root's sketch view bit-for-bit against one flat FleetSketches fed
+        the same observations."""
+        monkeypatch.setenv("FEDML_FLEET_SKETCH_THRESHOLD", threshold)
+        rng = np.random.default_rng(37)
+        tree = HierarchyTree.build(n_edges=4, regional_fanout=2, publish_k=64)
+        model = {"w": np.ones(4, dtype=np.float32)}
+        flat = FleetSketches()
+        for rank in range(60):
+            dur = float(rng.lognormal(0.5, 0.4))
+            tree.submit(rank, model, 1.0, None, telemetry_delta=_train_delta(dur))
+            flat.observe_round_time(rank, dur)
+        tree.flush_sketches()
+        root = tree._root_sketch_view()
+        assert root.quantiles["round_time_s"] == flat.quantiles["round_time_s"]
+        assert np.array_equal(root.clients.registers, flat.clients.registers)
+        assert np.allclose(root.offenders.table, flat.offenders.table, atol=1e-9)
+        assert root.observations == flat.observations == 60
+
+    def test_flush_is_idempotent(self, monkeypatch):
+        monkeypatch.setenv("FEDML_FLEET_SKETCH_THRESHOLD", "2")
+        tree = HierarchyTree.build(n_edges=2, regional_fanout=2, publish_k=64)
+        model = {"w": np.ones(2, dtype=np.float32)}
+        for rank in range(10):
+            tree.submit(rank, model, 1.0, None, telemetry_delta=_train_delta(1.0))
+        tree.flush_sketches()
+        tree.flush_sketches()  # cumulative wires replace slots: no growth
+        assert tree._root_sketch_view().quantiles["round_time_s"].count == 10
+
+
+# --- Perfetto export: bounded summary lane -----------------------------------
+class TestPerfettoSummaryLane:
+    def _fleet_with_clients(self, n):
+        fleet = FleetTelemetry()
+        for r in range(n):
+            fleet.merge_client_delta(r, _train_delta(1.0 + r))
+        return fleet
+
+    def test_lane_cap_keeps_worst_offenders(self, tmp_path):
+        fleet = self._fleet_with_clients(12)
+        path = fleet.export_fleet_trace(
+            str(tmp_path / "fleet.json"),
+            server=tel.Telemetry(enabled=True), max_client_lanes=4)
+        doc = json.load(open(path))
+        names = [e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e.get("name") == "process_name"]
+        client_lanes = {n for n in names if n.startswith("client-")}
+        assert any(n.startswith("fleet-summary") for n in names)
+        # the 4 kept lanes are the slowest ranks (durations grow with rank)
+        assert client_lanes == {f"client-{r}" for r in (8, 9, 10, 11)}
+        summary = [e for e in doc["traceEvents"]
+                   if e.get("name") == "fleet.sketch_summary"]
+        assert summary and "families" in summary[0]["args"]
+
+    def test_no_summary_lane_below_cap(self, tmp_path):
+        fleet = self._fleet_with_clients(3)
+        path = fleet.export_fleet_trace(
+            str(tmp_path / "fleet.json"),
+            server=tel.Telemetry(enabled=True), max_client_lanes=4)
+        doc = json.load(open(path))
+        names = [e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e.get("name") == "process_name"]
+        assert not any(n.startswith("fleet-summary") for n in names)
+        assert {n for n in names if n.startswith("client-")} == {
+            "client-0", "client-1", "client-2"}
+
+
+# --- process-wide riders -----------------------------------------------------
+class TestModuleRiders:
+    def test_prom_and_tsdb_and_statusz_riders(self):
+        fs = FleetSketches()
+        for r in range(5):
+            fs.observe_round_time(r, 1.0 + r)
+        sketches.set_active_provider(lambda: fs)
+        rows = sketches.prom_gauges()
+        fams = {r[0] for r in rows}
+        assert "fleet_round_time_seconds" in fams
+        assert "telemetry_series_live" in fams  # offender admit registered
+
+        class _Store:
+            def __init__(self):
+                self.gauges = {}
+
+            def record_gauge(self, name, value):
+                self.gauges[name] = value
+
+        store = _Store()
+        sketches.tsdb_collector(store)
+        assert set(store.gauges) >= {"fleet.round_time_p50", "fleet.round_time_p99",
+                                     "fleet.straggler_ratio", "fleet.clients_seen"}
+        snap = sketches.statusz_snapshot()
+        assert snap and snap["observations"] == 5 and "budget" in snap
+
+    def test_riders_are_quiet_when_idle(self):
+        assert sketches.get_active() is None
+        assert sketches.active_snapshot() is None
+        assert sketches.prom_gauges() == []
+        assert sketches.statusz_snapshot() is None
+
+    def test_broken_provider_degrades_to_none(self):
+        def boom():
+            raise RuntimeError("provider died")
+
+        sketches.set_active_provider(boom)
+        assert sketches.get_active() is None
+        assert sketches.prom_gauges() == []

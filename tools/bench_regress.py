@@ -71,6 +71,8 @@ HEADLINES: Dict[str, str] = {
     "llm_mfu": "higher",                     # ISSUE 17 devperf registry MFU
     "devperf_overhead_pct": "lower",         # ISSUE 17 registry cost guard
     "modelwatch_overhead_pct": "lower",      # ISSUE 18 fold-stats cost guard
+    "fleet_scale_quantile_err_pct": "lower",  # ISSUE 19 sketch accuracy
+    "fleet_telemetry_bytes_per_client": "lower",  # ISSUE 19 memory bound
     "_llm_pallas.tokens_per_sec": "higher",
     "_llm_pallas.mfu": "higher",
 }

@@ -86,6 +86,7 @@ commit_artifacts() {
       surface_pipeline_overlap
       surface_devperf
       surface_modelwatch
+      surface_fleet_scale
       surface_placement
       surface_resilience
       surface_serving
@@ -276,6 +277,32 @@ if doc.get("modelwatch_overhead_pct") is not None:
 PYEOF
 ) || return 0
   [ -n "$mw" ] && log "$mw"
+}
+
+surface_fleet_scale() {
+  # one-line view of the fleet_scale stage: sketch quantile accuracy vs
+  # numpy exact, amortized telemetry bytes per client, and the ingest
+  # overhead share of the driver slice (all integrity-guarded in-stage) —
+  # so the watcher log answers "is million-client telemetry still accurate
+  # and still O(nodes)" without opening BENCH_MEASURED_*.json
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1) || return 0
+  [ -n "$newest" ] || return 0
+  local fs
+  fs=$(python3 - "$newest" <<'PYEOF' 2>/dev/null
+import json, sys
+doc = json.load(open(sys.argv[1]))
+if doc.get("fleet_scale_quantile_err_pct") is not None:
+    print(f"fleet_scale: {doc.get('fleet_scale_clients')} clients, "
+          f"quantile_err {doc['fleet_scale_quantile_err_pct']}% vs exact, "
+          f"{doc.get('fleet_telemetry_bytes_per_client')}B/client across "
+          f"{doc.get('fleet_scale_nodes')} nodes, ingest "
+          f"{doc.get('fleet_scale_ingest_overhead_pct')}% of driver wall, "
+          f"offenders {doc.get('fleet_scale_offenders_recovered')}, "
+          f"edge==flat {doc.get('fleet_scale_edge_eq_flat')}")
+PYEOF
+) || return 0
+  [ -n "$fs" ] && log "$fs"
 }
 
 surface_placement() {

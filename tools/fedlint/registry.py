@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+from .rules.cardinality import LabelCardinalityRule
 from .rules.donation import DonationMisuseRule
 from .rules.host_sync import HostSyncRule
 from .rules.interproc import (InterprocDonationRule, InterprocHostSyncRule,
@@ -41,6 +42,8 @@ _RULE_CLASSES = (
     InterprocHostSyncRule,
     InterprocRetraceRule,
     MetricRegistryRule,
+    # per-rank/tenant label-cardinality budget enforcement (ISSUE 19)
+    LabelCardinalityRule,
 )
 
 

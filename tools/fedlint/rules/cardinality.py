@@ -1,0 +1,167 @@
+"""``label-cardinality``: any Prometheus gauge/counter family labeled by an
+unbounded population axis (``rank``, ``client``, ``tenant``) must be
+registered with the telemetry cardinality budget — a call to
+``TelemetryCardinalityBudget.admit`` / ``get_budget`` in the emitting scope —
+or carry a reasoned suppression (ISSUE 19).
+
+Per-rank label values are the classic Prometheus cardinality bomb: a fleet of
+a million clients turns one innocent gauge family into a million live series
+and takes the scrape endpoint (and whatever ingests it) down with it. The
+budget (`core/telemetry/sketches.TelemetryCardinalityBudget`) is the
+project's answer: emitters ask ``admit(family, n)`` before exporting labeled
+series and degrade to sketch summaries when refused. This rule finds the
+emitters that never ask.
+
+Detection mirrors the ``metric-registry`` rule's gauge discovery (3-tuples
+``("name", labels, value)`` inside ``*gauges*`` functions / ``gauges=``
+kwargs / ``gauges``-named assignments) plus ``register_prefix_family``
+registrations, and flags a site when its labels carry one of the risky keys
+as a dict-literal key, a ``dict(rank=...)`` keyword, or an f-string/literal
+label value derived from them. A site is budget-registered when its
+enclosing function (or the module body, for module-level emitters) calls
+``.admit(...)`` or resolves the budget via ``get_budget``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import ProjectRule
+from ._util import dotted
+
+RISKY_LABELS = ("rank", "client", "tenant")
+
+
+def _risky_label_keys(node) -> list:
+    """Risky label keys present in a labels expression (dict literal or
+    ``dict(...)`` call). Non-literal label expressions return [] — the rule
+    never guesses."""
+    keys = []
+    if isinstance(node, ast.Dict):
+        for k in node.keys:
+            if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    and k.value in RISKY_LABELS):
+                keys.append(k.value)
+    elif (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "dict"):
+        for kw in node.keywords:
+            if kw.arg in RISKY_LABELS:
+                keys.append(kw.arg)
+    return keys
+
+
+def _scope_is_registered(scope) -> bool:
+    """True when the scope body asks the cardinality budget before emitting:
+    any ``*.admit(...)`` call or any reference to ``get_budget``."""
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Attribute) and f.attr == "admit":
+                return True
+            d = dotted(f)
+            if d and d.split(".")[-1] == "get_budget":
+                return True
+    return False
+
+
+class LabelCardinalityRule(ProjectRule):
+    id = "label-cardinality"
+    severity = "error"
+    description = ("prom series labeled by rank/client/tenant without a "
+                   "cardinality-budget registration: one gauge family times "
+                   "a million clients is a scrape-endpoint outage")
+
+    # ------------------------------------------------------------------
+    def collect(self, ctx):
+        sites = []
+
+        # enclosing-function index: (lineno range) -> FunctionDef node
+        funcs = [n for n in ast.walk(ctx.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+        def enclosing(node):
+            best = None
+            for fn in funcs:
+                if fn.lineno <= node.lineno <= (fn.end_lineno or fn.lineno):
+                    if best is None or fn.lineno > best.lineno:
+                        best = fn  # innermost wins
+            return best
+
+        def note(tuple_node):
+            if not (isinstance(tuple_node, ast.Tuple)
+                    and len(tuple_node.elts) == 3
+                    and isinstance(tuple_node.elts[0], ast.Constant)
+                    and isinstance(tuple_node.elts[0].value, str)):
+                return
+            keys = _risky_label_keys(tuple_node.elts[1])
+            if not keys:
+                return
+            scope = enclosing(tuple_node) or ctx.tree
+            sites.append([tuple_node.elts[0].value, ",".join(sorted(set(keys))),
+                          tuple_node.lineno, ctx.raw_line(tuple_node.lineno),
+                          _scope_is_registered(scope)])
+
+        seen_lines = set()
+
+        def note_once(t):
+            if not isinstance(t, ast.Tuple):
+                return
+            key = (t.lineno, t.col_offset)
+            if key not in seen_lines:
+                seen_lines.add(key)
+                note(t)
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                # gauges=[...] kwarg on any call
+                for kw in node.keywords:
+                    if kw.arg == "gauges":
+                        for t in ast.walk(kw.value):
+                            note_once(t)
+                # register_prefix_family("name", ("tenant", "reason", ...))
+                f = node.func
+                d = dotted(f)
+                if (d and d.split(".")[-1] == "register_prefix_family"
+                        and len(node.args) >= 2):
+                    labels = node.args[1]
+                    risky = []
+                    if isinstance(labels, (ast.Tuple, ast.List)):
+                        risky = [e.value for e in labels.elts
+                                 if isinstance(e, ast.Constant)
+                                 and e.value in RISKY_LABELS]
+                    if risky:
+                        scope = enclosing(node) or ctx.tree
+                        name = (node.args[0].value
+                                if isinstance(node.args[0], ast.Constant)
+                                else dotted(node.args[0]) or "<dynamic>")
+                        sites.append([str(name), ",".join(sorted(set(risky))),
+                                      node.lineno, ctx.raw_line(node.lineno),
+                                      _scope_is_registered(scope)])
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and "gauges" in fn.name:
+                for t in ast.walk(fn):
+                    note_once(t)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                names = [t.id if isinstance(t, ast.Name) else dotted(t)
+                         for t in node.targets]
+                if any(n and n.split(".")[-1] == "gauges" for n in names):
+                    for t in ast.walk(node.value):
+                        note_once(t)
+        return {"sites": sites} if sites else None
+
+    # ------------------------------------------------------------------
+    def finalize_project(self, graph, facts):
+        for rel, f in sorted(facts.items()):
+            for name, keys, line, text, registered in f.get("sites") or ():
+                if registered:
+                    continue
+                yield self.fact_finding(
+                    graph.root, rel, line,
+                    f"series `{name}` is labeled by `{keys}` (an unbounded "
+                    "population axis) but the emitting scope never consults "
+                    "the telemetry cardinality budget — call "
+                    "`sketches.get_budget().admit(family, n)` and degrade "
+                    "to a sketch summary on refusal, or suppress with the "
+                    "reason the label set is bounded", text)

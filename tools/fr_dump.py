@@ -92,6 +92,13 @@ def _fmt_comm(ev: Dict[str, Any]) -> str:
     return (" " + " ".join(parts) if parts else "") + _fmt_fields(fields)
 
 
+def _fmt_q(v: Any) -> str:
+    try:
+        return f"{float(v):.4g}"
+    except (TypeError, ValueError):
+        return str(v)
+
+
 def _fmt_window(window_s: Any) -> str:
     try:
         w = float(window_s)
@@ -200,6 +207,39 @@ def render(doc: Dict[str, Any], out=sys.stdout) -> None:
         shard = mesh.get("shard_bytes_by_device", {})
         if shard:
             w(f"  shard bytes/device: {min(shard.values())}..{max(shard.values())}\n")
+
+    # fleet sketch summary (schema v2+): quantile table + top-k offenders.
+    # Older dumps simply predate the section — note it and move on.
+    fleet = doc.get("fleet")
+    if fleet:
+        w(f"\n--- fleet sketches ({fleet.get('observations')} observations, "
+          f"~{fleet.get('clients_seen')} distinct clients, "
+          f"{_fmt_bytes(fleet.get('sketch_bytes'))}):\n")
+        fams = fleet.get("families") or {}
+        if fams:
+            w(f"  {'family':<16} {'count':>10} {'p50':>10} {'p90':>10} "
+              f"{'p99':>10} {'p999':>10}\n")
+            for name in sorted(fams):
+                row = fams[name]
+                w(f"  {name:<16} {row.get('count', 0):>10}"
+                  + "".join(f" {_fmt_q(row.get(q)):>10}"
+                            for q in ("0.5", "0.9", "0.99", "0.999")) + "\n")
+        for key in ("straggler_ratio", "outlier_rate"):
+            v = fleet.get(key)
+            if v is not None:
+                w(f"  {key}: {float(v):.4f}\n")
+        offenders = fleet.get("top_offenders") or []
+        if offenders:
+            w("  top offenders (by cumulative round time):\n")
+            for row in offenders:
+                w(f"    rank {row.get('rank'):>8}  "
+                  f"{_fmt_q(row.get('round_seconds'))}s\n")
+        budget = fleet.get("budget")
+        if budget:
+            w(f"  series budget: {budget.get('live_total')}/{budget.get('max_series')}"
+              f" live; degraded: {sorted(budget.get('degraded') or {}) or 'none'}\n")
+    elif int(doc.get("meta", {}).get("schema") or 0) < 2:
+        w("\n--- fleet sketches: (dump predates the section — schema v1)\n")
 
     spans = doc.get("span_stack", {}).get("spans", [])
     if spans:
