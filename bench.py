@@ -2426,6 +2426,180 @@ def _bench_modelwatch_overhead():
     }
 
 
+def _bench_secagg_overhead():
+    """Windowed SecAgg + accounted-DP fold overhead (ISSUE 20): per publish
+    window the cohort runs key exchange + Shamir share dealing, each client
+    quantizes and masks its update into the ring, and the server's publish
+    unmasks, dequantizes, and DP-noises through the fused kernel. Privacy
+    that makes the async buffer unaffordable would never be switched on —
+    so, like modelwatch_overhead, this drives a round-SHAPED loop
+    (calibrated numpy work standing in for local training, then the fold)
+    once plain and once masked+noised, and bills the paired difference in
+    round walls.
+
+    Integrity guards (BenchIntegrityError, refusing to publish):
+    - overhead: masked-vs-plain round wall delta must stay under
+      FEDML_SECAGG_OVERHEAD_TOL_PCT (default 5%);
+    - mask-off parity: with no privacy session attached the buffer's
+      publish must stay bit-identical before and after the masked rounds
+      (the subsystem must not perturb the plain path in-process);
+    - masked parity: a zero-dropout window (no DP) must unmask to the
+      honest quantized fold bit-exactly — masks that do not cancel make
+      the overhead figure meaningless;
+    - accountant liveness: the DP accountant must have stepped once per
+      noised publish with epsilon_spent > 0."""
+    import numpy as np
+
+    from fedml_tpu.core.aggregation.async_buffer import (AsyncAggBuffer,
+                                                         StalenessPolicy)
+    from fedml_tpu.core.privacy import (DPFold, QuantSpec, WindowCoordinator,
+                                        ring_bits_for)
+    from fedml_tpu.core.privacy.masking import dequantize_sum, quantize_vector
+    from fedml_tpu.utils.pytree import tree_flatten_to_vector
+
+    tiny = os.environ.get("FEDML_BENCH_TINY") == "1"
+    dim = 64 if tiny else 192
+    clients = 6 if tiny else 10
+    rounds = 6 if tiny else 12
+    work_ratio = 30.0  # train:fold wall ratio — local training dominates
+
+    rng = np.random.default_rng(0)
+
+    def _tree():
+        return {"w": rng.standard_normal((dim, dim)).astype(np.float32),
+                "b": rng.standard_normal((dim,)).astype(np.float32)}
+
+    def _flat(tr):
+        return np.asarray(tree_flatten_to_vector(tr)[0])
+
+    template = {"w": np.zeros((dim, dim), np.float32),
+                "b": np.zeros((dim,), np.float32)}
+    spec = QuantSpec(ring_bits=ring_bits_for(clients, clients))
+    deltas = [_tree() for _ in range(clients)]
+
+    def _plain_buffer():
+        return AsyncAggBuffer(publish_k=clients,
+                              policy=StalenessPolicy(exponent=0.0))
+
+    def _fold_plain(buf):
+        for r in range(clients):
+            buf.submit(r, deltas[r], 1.0, client_version=buf.version)
+        return buf.publish()
+
+    def _fold_masked(co, buf):
+        _, members = co.open_window(range(clients))
+        for r in range(clients):
+            co.submit(r, members[r].mask(_flat(deltas[r])),
+                      client_version=buf.version)
+        return buf.publish()
+
+    # mask-off parity reference + plain-arm warmup (compiles the fold)
+    plain_before = _flat(_fold_plain(_plain_buffer()))
+
+    # masked parity (no DP, zero dropout): masks must cancel bit-exactly
+    pbuf = _plain_buffer()
+    pco = WindowCoordinator(pbuf, template, spec=spec,
+                            rng=np.random.default_rng(1))
+    masked_out = _flat(_fold_masked(pco, pbuf))
+    honest = dequantize_sum(
+        sum(quantize_vector(_flat(d), spec) for d in deltas), clients, spec)
+    if not np.array_equal(masked_out, honest):
+        raise BenchIntegrityError(
+            "secagg_overhead: zero-dropout window did not unmask to the "
+            "honest quantized fold bit-exactly — masks are not cancelling; "
+            "the overhead figure would be meaningless; refusing to publish")
+
+    # the timed masked arm: secagg + accounted DP, one coordinator reused
+    # across windows like a real server front
+    mbuf = _plain_buffer()
+    dp = DPFold(noise_multiplier=0.8, l2_clip=1.0, seed=0)
+    mco = WindowCoordinator(mbuf, template, spec=spec, dp=dp,
+                            rng=np.random.default_rng(2))
+    tbuf = _plain_buffer()
+    _fold_masked(mco, mbuf)  # warmup: compiles the fused noise kernel
+
+    # calibrate round-shaped work off the plain fold wall
+    fold_samples = []
+    for _ in range(3):
+        f0 = time.perf_counter()
+        _fold_plain(_plain_buffer())
+        fold_samples.append(time.perf_counter() - f0)
+    fold_s = max(float(np.median(fold_samples)), 1e-5)
+    work_elems = 512
+    a = rng.standard_normal((work_elems, work_elems))
+    b = rng.standard_normal((work_elems, work_elems))
+    w0 = time.perf_counter()
+    a = a @ b / float(work_elems)
+    unit_s = max(time.perf_counter() - w0, 1e-7)
+    round_s = max(work_ratio * fold_s, 0.8)
+    work_reps = max(1, min(4000, int(round_s / unit_s)))
+
+    # interleave plain/masked rounds so machine drift hits both arms of
+    # each pair equally; the guard compares paired-difference medians
+    steps0 = dp.accountant.steps
+    plain_walls, masked_walls = [], []
+    for _ in range(rounds):
+        r0 = time.perf_counter()
+        for _ in range(work_reps):       # the "local training" itself
+            a = a @ b / float(work_elems)
+        _fold_plain(tbuf)
+        t1 = time.perf_counter()
+        for _ in range(work_reps):
+            a = a @ b / float(work_elems)
+        _fold_masked(mco, mbuf)
+        t2 = time.perf_counter()
+        plain_walls.append(t1 - r0)
+        masked_walls.append(t2 - t1)
+    if not np.isfinite(a).all():           # keep the matmul live
+        raise BenchIntegrityError("secagg_overhead: workload diverged")
+
+    med_plain = float(np.median(plain_walls))
+    med_masked = float(np.median(masked_walls))
+    delta_s = float(np.median(np.asarray(masked_walls) -
+                              np.asarray(plain_walls)))
+    overhead_pct = 100.0 * delta_s / med_plain
+
+    # mask-off parity: the plain path must be bit-identical after all the
+    # masked windows ran in-process
+    plain_after = _flat(_fold_plain(_plain_buffer()))
+    if not np.array_equal(plain_before, plain_after):
+        raise BenchIntegrityError(
+            "secagg_overhead: the mask-off fold changed bit pattern after "
+            "masked windows ran — the privacy subsystem perturbed the "
+            "plain path; refusing to publish")
+
+    eps = float(dp.accountant.epsilon_spent)
+    noised = dp.accountant.steps - steps0
+    _p(f"secagg_overhead: {rounds}+{rounds} rounds (work x{work_reps}, "
+       f"fold {fold_s * 1e3:.2f}ms, d={dim * dim + dim}), plain "
+       f"{med_plain * 1e3:.1f}ms vs masked+dp {med_masked * 1e3:.1f}ms per "
+       f"round ({overhead_pct:+.4f}%), eps_spent {eps:.3f}")
+
+    if noised != rounds or eps <= 0.0:
+        raise BenchIntegrityError(
+            f"secagg_overhead: accountant stepped {noised}x for {rounds} "
+            f"noised publishes (eps {eps}) — DP is not being accounted; "
+            "refusing to publish")
+    tol_pct = float(os.environ.get("FEDML_SECAGG_OVERHEAD_TOL_PCT", "5.0"))
+    if overhead_pct >= tol_pct:
+        raise BenchIntegrityError(
+            f"secagg_overhead: masking+DP consumed {overhead_pct:.4f}% of "
+            f"the round wall (>= {tol_pct}%); privacy this expensive would "
+            "never be switched on; refusing to publish")
+
+    return {
+        "secagg_overhead_pct": round(max(overhead_pct, 0.0), 4),
+        "secagg_plain_round_ms": round(med_plain * 1e3, 3),
+        "secagg_masked_round_ms": round(med_masked * 1e3, 3),
+        "secagg_fold_ms": round(fold_s * 1e3, 3),
+        "secagg_rounds": rounds,
+        "secagg_clients": clients,
+        "secagg_model_dim": dim * dim + dim,
+        "dp_epsilon_spent": round(eps, 4),
+        "dp_noise_multiplier": dp.noise_multiplier,
+    }
+
+
 def _bench_devperf_overhead(reps: int = 40):
     """Devperf registry overhead + live-vs-analytic MFU parity (ISSUE 17).
 
@@ -3768,6 +3942,8 @@ def _stage_result(name: str) -> dict:
         out = _bench_devperf_overhead()
     elif name == "modelwatch_overhead":
         out = _bench_modelwatch_overhead()
+    elif name == "secagg_overhead":
+        out = _bench_secagg_overhead()
     elif name == "placement_search":
         out = _retry_transient(_bench_placement_search)
     elif name == "llm_pallas_tuned":
@@ -3850,6 +4026,13 @@ _STAGES: list[tuple[str, int]] = [
     # < 1%, zero added recompiles, bit-exact parity, and injected
     # NaN/scaled clients must be caught (all integrity-guarded)
     ("modelwatch_overhead", 240),
+    # windowed SecAgg + accounted-DP fold overhead: masked+noised vs plain
+    # round walls in a round-shaped loop; masked-vs-plain delta < 5%,
+    # zero-dropout unmask bit-exact vs the honest quantized fold, mask-off
+    # path bit-identical, accountant stepped per noised publish (all
+    # integrity-guarded). Host-side numpy + one fused kernel — seconds of
+    # work; the budget covers interpreter start + retry
+    ("secagg_overhead", 240),
     # devperf registry overhead + live-vs-analytic MFU parity: a real
     # (tiny-aware) instrumented llama step loop; registry MFU must match
     # bench's _mfu_from_rate within 15% and the registry's self-accounted
@@ -4552,6 +4735,21 @@ def main() -> None:
                 out[key] = mw_out[key]
     elif mw_out is not None:
         out["modelwatch_overhead_skipped"] = mw_out["skipped"]
+
+    sa_out = stage_out.get("secagg_overhead")
+    if sa_out is not None and "skipped" not in sa_out:
+        # secagg+DP headline (tools/bench_watch.sh surfaces these): the
+        # masking+noised-fold cost share of a round-shaped loop + the
+        # epsilon the measurement itself spent, both integrity-guarded
+        # in-stage (parity, mask-off bit-identity, accountant liveness)
+        for key in ("secagg_overhead_pct", "secagg_plain_round_ms",
+                    "secagg_masked_round_ms", "secagg_fold_ms",
+                    "secagg_rounds", "secagg_clients", "secagg_model_dim",
+                    "dp_epsilon_spent", "dp_noise_multiplier"):
+            if sa_out.get(key) is not None:
+                out[key] = sa_out[key]
+    elif sa_out is not None:
+        out["secagg_overhead_skipped"] = sa_out["skipped"]
 
     devperf_out = stage_out.get("devperf_overhead")
     if devperf_out is not None and "skipped" not in devperf_out:

@@ -188,6 +188,10 @@ class AsyncAggBuffer:
         self.policy = policy or StalenessPolicy()
         self.engine = engine or get_engine()
         self._lock = threading.Lock()
+        # privacy session (core/privacy): when attached, publishes hand the
+        # RAW streamed sum to session.on_publish (secagg unmask / fused DP
+        # noise) instead of plain 1/W scaling. None = untouched default path.
+        self._privacy = None
         # modelwatch: per-publish-window stat session riding the fused fold
         # (enable_watch). None = stats off, the default path is untouched.
         self._watch = None
@@ -218,6 +222,22 @@ class AsyncAggBuffer:
         # seconds->versions conversion rate (None until two publishes)
         self.publish_interval_ewma_s: Optional[float] = None
         self._last_publish_mono: Optional[float] = None
+
+    # --- privacy (core/privacy sessions) ------------------------------------
+    def enable_privacy(self, session: Any) -> None:
+        """Attach a privacy session (WindowCoordinator / DPFold / tier
+        pass-through). Publishes then fold ALL pending arrivals into the
+        accumulator and route the raw weighted sum through
+        ``session.on_publish(acc, weight_sum, merges, template, engine)``
+        — the session owns unmasking/noising AND normalization. Requires a
+        streaming engine (the sharded engine's per-shard handles never
+        materialize a host-visible sum to unmask)."""
+        if not self._streaming():
+            raise ValueError(
+                "privacy sessions need the streaming bucketed engine; the "
+                "mesh-sharded engine folds per-shard at publish")
+        with self._lock:
+            self._privacy = session
 
     # --- modelwatch --------------------------------------------------------
     def enable_watch(self, ref: PyTree, ledger: Any = None,
@@ -383,7 +403,7 @@ class AsyncAggBuffer:
     def _publish_locked(self) -> Optional[PyTree]:
         if self._merges_since_publish == 0:
             return None
-        if self._acc is None and self._pending:
+        if self._privacy is None and self._acc is None and self._pending:
             # nothing folded eagerly yet (buffer fit one bucket): route
             # through the engine's own normalized aggregate — BIT-IDENTICAL
             # to the synchronous path, which is the parity guard's anchor
@@ -408,8 +428,17 @@ class AsyncAggBuffer:
                                                           watch_real=real)
                 self._weight_sum += float(w.sum())
             self.last_publish_weight = float(self._weight_sum)
-            scaled = self._scale_fn()(self._acc, np.float32(1.0 / self._weight_sum))
-            out = self.engine.finalize(scaled, self._template)
+            if self._privacy is not None:
+                # privacy mode: the session consumes the RAW streamed sum —
+                # secagg reduces it mod 2^b (masks cancel exactly), the DP
+                # session fuses scale+noise into one dispatch; either way
+                # the session owns normalization
+                out = self._privacy.on_publish(
+                    self._acc, self._weight_sum, self._merges_since_publish,
+                    self._template, self.engine)
+            else:
+                scaled = self._scale_fn()(self._acc, np.float32(1.0 / self._weight_sum))
+                out = self.engine.finalize(scaled, self._template)
         self.last_publish_merges = self._merges_since_publish
         self._acc = None
         self._weight_sum = 0.0
@@ -451,6 +480,7 @@ class AsyncAggBuffer:
                 "stale_rejected_total": self.stale_rejected_total,
                 "mean_staleness": (self._staleness_sum / n) if n else 0.0,
                 "publish_interval_ewma_s": self.publish_interval_ewma_s,
+                "privacy": self._privacy is not None,
                 "modelwatch": self._watch is not None,
                 "modelwatch_quarantine": self._quarantine,
                 "quarantined_total": self.quarantined_total,

@@ -60,6 +60,12 @@ class HierarchyNode:
         self.parent = parent
         self.children: List["HierarchyNode"] = []
         self.fleet = FleetTelemetry()
+        # set by core.privacy.HierarchyPrivacy: the node's privacy session
+        # (edge WindowCoordinator / regional ring pass-through / root
+        # unmasker). When set, child forwards fold at weight 1.0 — the tiers
+        # are carrying ring-masked integer vectors and a weighted fold would
+        # scale the masks out of exact cancellation.
+        self.privacy = None
         self.forwards = 0
         self._lock = threading.Lock()
         # child submissions need a stable integer rank for the buffer's
@@ -128,6 +134,8 @@ class HierarchyNode:
         # a child's publish is already the freshest model its subtree has:
         # forward at the child's current (synced) version so the staleness
         # decay never double-penalizes the extra tier hop
+        if self.privacy is not None:
+            weight = 1.0
         self.buffer.submit(rank, model, weight, client_version=self.buffer.version)
         self._maybe_publish()
 

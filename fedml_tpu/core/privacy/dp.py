@@ -1,0 +1,219 @@
+"""Accounted differential privacy at the server fold.
+
+Central-DP FedAvg: each published aggregate carries Gaussian noise
+calibrated to ``noise_multiplier * l2_clip`` on the *sum* (so
+``z * C / n`` on the mean), injected **inside the fold** — the DP-only
+buffer session replaces the publish's ``acc * (1/W)`` scale with ONE fused
+jitted ``acc * s + sigma * normal`` dispatch (module-level jit like
+``async_buffer._scale_fn``: same executable for every buffer/publish, the
+scalars and the PRNG key ride as traced arguments, zero extra recompiles —
+the PR-18 modelwatch discipline). The secagg+dp composition noises the
+already-unmasked mean through the same kernel with ``s = 1``.
+
+Every noised publish steps the RDP accountant
+(``core/dp/budget_accountant``): spent ε at the configured δ surfaces as
+``fedml_dp_epsilon_spent`` / ``fedml_dp_budget_frac`` gauges, a `/statusz`
+``privacy`` section entry, the ``privacy.dp_epsilon_spent`` /
+``privacy.dp_budget_frac`` tsdb series (behind the ``dp_budget_exhaustion``
+SLO row, which fires while budget_frac is still below 1.0), and a
+flight-recorder breadcrumb per accountant step.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import telemetry as tel
+from ..dp.budget_accountant.rdp_accountant import RDPAccountant
+from ..telemetry import flight_recorder
+
+PyTree = Any
+
+NOISED_PUBLISH_COUNTER = "dp.noised_publishes"  # fedml_dp_noised_publishes_total
+
+DEFAULT_NOISE_MULTIPLIER = 0.8
+DEFAULT_L2_CLIP = 1.0
+DEFAULT_DELTA = 1e-5
+DEFAULT_EPSILON_BUDGET = 8.0
+#: the SLO row's firing line: alert BEFORE the budget is actually crossed
+BUDGET_ALERT_FRAC = 0.85
+
+_SCALE_NOISE_FN = None
+
+
+def _scale_noise_fn():
+    """One fused executable per (treedef, shapes): scale + per-leaf Gaussian
+    noise in a single dispatch. Module-level like async_buffer._scale_fn so
+    every buffer and every publish share the jit cache; ``s``/``sigma``/
+    ``key`` are traced, so new scales and keys never retrace."""
+    global _SCALE_NOISE_FN
+    if _SCALE_NOISE_FN is None:
+        import jax
+        import jax.numpy as jnp
+
+        def f(acc, s, key, sigma):
+            leaves, treedef = jax.tree.flatten(acc)
+            keys = jax.random.split(key, len(leaves))
+            out = [x * s + sigma * jax.random.normal(k, jnp.shape(x), jnp.float32)
+                   for x, k in zip(leaves, keys)]
+            return jax.tree.unflatten(treedef, out)
+
+        _SCALE_NOISE_FN = jax.jit(tel.track_compiles(f, name="dp_noised_scale"))
+    return _SCALE_NOISE_FN
+
+
+def clip_update(tree: PyTree, l2_clip: float) -> PyTree:
+    """Project a client update onto the L2 ball of radius ``l2_clip`` — the
+    sensitivity bound the Gaussian sigma is calibrated against. Host-side
+    numpy: runs client-side at the comm boundary, not in the fold."""
+    import jax
+
+    leaves = jax.tree.leaves(tree)
+    sq = float(sum(float(np.sum(np.square(np.asarray(l, np.float64))))
+                   for l in leaves))
+    norm = float(np.sqrt(sq))
+    if norm <= float(l2_clip) or norm == 0.0:
+        return tree
+    scale = float(l2_clip) / norm
+    return jax.tree.map(lambda x: (np.asarray(x, np.float32) * np.float32(scale)), tree)
+
+
+class DPAccountant:
+    """RDP/moments accounting for the fold's Gaussian mechanism, plus every
+    observability surface the budget must reach."""
+
+    def __init__(self, noise_multiplier: float = DEFAULT_NOISE_MULTIPLIER,
+                 delta: float = DEFAULT_DELTA,
+                 epsilon_budget: float = DEFAULT_EPSILON_BUDGET,
+                 sample_rate: float = 1.0):
+        if noise_multiplier <= 0:
+            raise ValueError(f"noise_multiplier must be > 0, got {noise_multiplier}")
+        self.noise_multiplier = float(noise_multiplier)
+        self.delta = float(delta)
+        self.epsilon_budget = float(epsilon_budget)
+        self.sample_rate = float(sample_rate)
+        self._rdp = RDPAccountant()
+        self._lock = threading.Lock()
+        self.steps = 0
+        self.epsilon_spent = 0.0
+
+    def step(self, steps: int = 1) -> float:
+        """Account ``steps`` more releases of the mechanism and publish the
+        new spent ε to every surface. Returns ε at the configured δ."""
+        with self._lock:
+            self._rdp.step(noise_multiplier=self.noise_multiplier,
+                           sample_rate=self.sample_rate, steps=int(steps))
+            self.steps += int(steps)
+            self.epsilon_spent = float(self._rdp.get_epsilon(self.delta))
+            eps, frac = self.epsilon_spent, self.budget_frac_locked()
+        flight_recorder.mark("dp.accountant_step", steps=self.steps,
+                             epsilon=round(eps, 6), budget_frac=round(frac, 6),
+                             noise_multiplier=self.noise_multiplier)
+        return eps
+
+    def budget_frac_locked(self) -> float:
+        return self.epsilon_spent / self.epsilon_budget if self.epsilon_budget > 0 else 0.0
+
+    def budget_frac(self) -> float:
+        with self._lock:
+            return self.budget_frac_locked()
+
+    def exhausted(self) -> bool:
+        return self.budget_frac() >= 1.0
+
+    def statusz(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "noise_multiplier": self.noise_multiplier,
+                "delta": self.delta,
+                "sample_rate": self.sample_rate,
+                "steps": self.steps,
+                "epsilon_spent": self.epsilon_spent,
+                "epsilon_budget": self.epsilon_budget,
+                "budget_frac": self.budget_frac_locked(),
+            }
+
+    def prom_gauges(self) -> List[tuple]:
+        with self._lock:
+            return [
+                ("dp_epsilon_spent", {}, float(self.epsilon_spent)),
+                ("dp_budget_frac", {}, float(self.budget_frac_locked())),
+            ]
+
+    def tsdb_collector(self, store) -> None:
+        """Gauge feed for ``store.add_collector`` — the series the
+        ``dp_budget_exhaustion`` SLO row watches."""
+        with self._lock:
+            eps, frac = self.epsilon_spent, self.budget_frac_locked()
+        store.record_gauge("privacy.dp_epsilon_spent", float(eps))
+        store.record_gauge("privacy.dp_budget_frac", float(frac))
+
+
+class DPFold:
+    """The fold-side mechanism: either the buffer's privacy session itself
+    (dp-only mode — fused scale+noise replaces the publish scale) or the
+    noise stage the secagg unmask hands its dequantized mean to."""
+
+    def __init__(self, noise_multiplier: float = DEFAULT_NOISE_MULTIPLIER,
+                 l2_clip: float = DEFAULT_L2_CLIP,
+                 delta: float = DEFAULT_DELTA,
+                 epsilon_budget: float = DEFAULT_EPSILON_BUDGET,
+                 sample_rate: float = 1.0, seed: int = 0,
+                 accountant: Optional[DPAccountant] = None):
+        import jax
+
+        self.noise_multiplier = float(noise_multiplier)
+        self.l2_clip = float(l2_clip)
+        self.accountant = accountant or DPAccountant(
+            noise_multiplier=noise_multiplier, delta=delta,
+            epsilon_budget=epsilon_budget, sample_rate=sample_rate)
+        self._key = jax.random.PRNGKey(int(seed))
+        self._lock = threading.Lock()
+
+    def _next_key(self):
+        import jax
+
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _sigma_mean(self, n: float) -> float:
+        """Noise std on the MEAN: z * C on the sum, / n after normalize."""
+        return self.noise_multiplier * self.l2_clip / float(max(1.0, n))
+
+    def attach(self, buffer: Any) -> "DPFold":
+        """dp-only mode: become the buffer's privacy session."""
+        buffer.enable_privacy(self)
+        return self
+
+    # --- buffer hook (dp-only mode) -----------------------------------------
+    def on_publish(self, acc: PyTree, weight_sum: float, merges: int,
+                   template: PyTree, engine: Any) -> PyTree:
+        sigma = np.float32(self._sigma_mean(weight_sum))
+        scaled = _scale_noise_fn()(acc, np.float32(1.0 / weight_sum),
+                                   self._next_key(), sigma)
+        out = engine.finalize(scaled, template)
+        self.accountant.step()
+        tel.get_telemetry().counter(NOISED_PUBLISH_COUNTER).add(1)
+        return out
+
+    # --- secagg+dp composition ----------------------------------------------
+    def noise_tree(self, tree: PyTree, n_members: int) -> PyTree:
+        """Noise an already-normalized mean (the unmasked window sum / n):
+        same fused kernel with s = 1, same accountant step."""
+        sigma = np.float32(self._sigma_mean(n_members))
+        out = _scale_noise_fn()(tree, np.float32(1.0), self._next_key(), sigma)
+        self.accountant.step()
+        tel.get_telemetry().counter(NOISED_PUBLISH_COUNTER).add(1)
+        return out
+
+    def statusz(self) -> Dict[str, Any]:
+        doc = self.accountant.statusz()
+        doc["l2_clip"] = self.l2_clip
+        return doc
+
+    def prom_gauges(self) -> List[tuple]:
+        return self.accountant.prom_gauges()

@@ -187,6 +187,12 @@ _ENGINE_PACK: List[Dict[str, Any]] = [
 _CROSS_SILO_PACK: List[Dict[str, Any]] = _ENGINE_PACK + [
     dict(name="straggler_ratio", series="health.straggler_ratio",
          signal="last", comparator="<=", target=0.5),
+    # accounted DP (core/privacy/dp.py): the accountant publishes spent-ε /
+    # budget each noised publish; alerting on budget_frac at 0.85 fires
+    # BEFORE ε crosses the configured budget — the operator still has runway
+    # to stop the run or renegotiate the budget. No DP = no data = no opinion.
+    dict(name="dp_budget_exhaustion", series="privacy.dp_budget_frac",
+         signal="last", comparator="<=", target=0.85, firing_for_ticks=1),
     dict(name="link_loss_ratio", series="link.loss_ratio",
          signal="max", comparator="<=", target=0.5),
     dict(name="comm_retry_rate", series="comm.retry.*", signal="rate",

@@ -44,6 +44,16 @@ class ClientMasterManager(FedMLCommManager):
         from ...utils.compression import make_comm_compressor
 
         self._comm_compressor = make_comm_compressor(args)
+        # privacy (args.privacy=secagg|dp|secagg+dp): with secagg on, uploads
+        # leave this process ONLY as masked ring payloads — the window member
+        # is built per server ANNOUNCE, uploads queue until its key directory
+        # completes, and core.privacy.outbound_delta gates the send
+        from ...core.privacy import privacy_from_args
+
+        self._privacy = privacy_from_args(args)
+        self._secagg_member = None
+        self._secagg_support_ratio: Optional[float] = None
+        self._pending_upload: Optional[tuple] = None
 
     def run(self) -> None:
         # an exception anywhere in the client's receive loop (trainer bug,
@@ -62,6 +72,14 @@ class ClientMasterManager(FedMLCommManager):
         )
         self.register_message_receive_handler(MyMessage.MSG_TYPE_S2C_FINISH, self.handle_message_finish)
         self.register_message_receive_handler(MyMessage.MSG_TYPE_LINK_PROBE, self.handle_message_link_probe)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SECAGG_ANNOUNCE, self.handle_message_secagg_announce)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SECAGG_DIRECTORY, self.handle_message_secagg_directory)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SECAGG_SHARE_RELAY, self.handle_message_secagg_share_relay)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SECAGG_REVEAL_REQUEST, self.handle_message_secagg_reveal_request)
 
     def handle_message_connection_ready(self, msg_params: Message) -> None:
         if not self.has_sent_online_msg:
@@ -155,6 +173,83 @@ class ClientMasterManager(FedMLCommManager):
                             pad if pad is not None else np.zeros(nbytes, dtype=np.uint8))
         self.send_message(echo)
 
+    # --- windowed SecAgg (client side of core/privacy) ---------------------
+    def handle_message_secagg_announce(self, msg_params: Message) -> None:
+        """A masking window opened for a cohort containing this rank: build
+        the window member (fresh DH keypair) and answer with its public key.
+        The member replaces any previous one — windows are single-use."""
+        from ...core.privacy import QuantSpec, WindowMember
+
+        spec_doc = dict(msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_SPEC) or {})
+        self._secagg_support_ratio = spec_doc.pop("support_ratio", None)
+        self._secagg_member = WindowMember(
+            int(self.client_real_id),
+            int(msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_WINDOW_ID)),
+            int(msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_NONCE)),
+            [int(r) for r in msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_COHORT)],
+            QuantSpec(**spec_doc),
+            int(msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_THRESHOLD)),
+        )
+        reply = Message(MyMessage.MSG_TYPE_C2S_SECAGG_PUBKEY,
+                        self.client_real_id, msg_params.get_sender_id())
+        reply.add_params(MyMessage.MSG_ARG_KEY_SECAGG_WINDOW_ID,
+                         self._secagg_member.window_id)
+        reply.add_params(MyMessage.MSG_ARG_KEY_SECAGG_PUBKEY,
+                         int(self._secagg_member.public_key))
+        self.send_message(reply)
+
+    def handle_message_secagg_directory(self, msg_params: Message) -> None:
+        """Every cohort member's public key arrived: derive the pair seeds,
+        deal Shamir shares of this member's window key through the server's
+        relay, and flush any upload that was waiting on the directory."""
+        import numpy as np
+
+        member = self._secagg_member
+        if member is None:
+            return
+        directory = {int(r): int(pk) for r, pk in
+                     dict(msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_PUBKEY)).items()}
+        member.install_directory(directory)
+        shares = {int(peer): [int(v) for v in np.asarray(share).ravel()]
+                  for peer, share in member.deal_shares().items()
+                  if int(peer) != member.rank}
+        relay = Message(MyMessage.MSG_TYPE_C2S_SECAGG_SHARES,
+                        self.client_real_id, msg_params.get_sender_id())
+        relay.add_params(MyMessage.MSG_ARG_KEY_SECAGG_WINDOW_ID, member.window_id)
+        relay.add_params(MyMessage.MSG_ARG_KEY_SECAGG_SHARES, shares)
+        self.send_message(relay)
+        if self._pending_upload is not None:
+            receive_id, weights, n = self._pending_upload
+            self._pending_upload = None
+            self.send_model_to_server(receive_id, weights, n)
+
+    def handle_message_secagg_share_relay(self, msg_params: Message) -> None:
+        import numpy as np
+
+        member = self._secagg_member
+        if member is None:
+            return
+        member.receive_share(
+            int(msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_DEALER)),
+            np.asarray(list(msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_SHARE)),
+                       np.int64))
+
+    def handle_message_secagg_reveal_request(self, msg_params: Message) -> None:
+        """Mask-share reveal for a partial window close: hand the server this
+        survivor's shares of each DROPPED member's window key (never a rank
+        this member saw submit — WindowMember refuses the double reveal)."""
+        member = self._secagg_member
+        if member is None:
+            return
+        dropped = [int(r) for r in
+                   msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_DROPPED)]
+        reply = Message(MyMessage.MSG_TYPE_C2S_SECAGG_REVEAL,
+                        self.client_real_id, msg_params.get_sender_id())
+        reply.add_params(MyMessage.MSG_ARG_KEY_SECAGG_WINDOW_ID, member.window_id)
+        reply.add_params(MyMessage.MSG_ARG_KEY_SECAGG_REVEALS,
+                         member.reveal_shares(dropped))
+        self.send_message(reply)
+
     def _adopt_model_version(self, msg_params: Message) -> None:
         v = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_VERSION)
         if v is not None:
@@ -169,9 +264,17 @@ class ClientMasterManager(FedMLCommManager):
         self.send_message(message)
 
     def send_model_to_server(self, receive_id: int, weights, local_sample_num) -> None:
+        if self._privacy.secagg:
+            # masked uplink replaces the plain compressor: sparsification is
+            # the window's shared rand-k support (mask-in-quantized-domain),
+            # and the payload dict must reach the wire as-is
+            weights = self._mask_upload(receive_id, weights, local_sample_num)
+            if weights is None:
+                return  # queued: window directory not ready — flushed later
         mlops.event("comm_c2s", event_started=True, event_value=str(self.args.round_idx))
         with tel.span("client.upload", round=int(self.args.round_idx)):
-            weights = compress_upload(self._comm_compressor, weights)
+            if not self._privacy.secagg:
+                weights = compress_upload(self._comm_compressor, weights)
             message = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.client_real_id, receive_id)
             message.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
             message.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, int(local_sample_num))
@@ -182,6 +285,32 @@ class ClientMasterManager(FedMLCommManager):
                 message.add_params(MyMessage.MSG_ARG_KEY_MODEL_VERSION, int(self._model_version))
             self._attach_telemetry_delta(message)
             self.send_message(message)
+
+    def _mask_upload(self, receive_id: int, weights, local_sample_num):
+        """Quantize + mask the upload into its window's ring, or queue it
+        when the window's key directory has not completed yet. Returns the
+        masked payload dict (the ONLY form a secagg upload takes on the
+        wire: ``outbound_delta`` raises on anything else), or None if
+        queued. A member masks exactly once — the nonce-derived masks are
+        one-time pads — so the member retires with its upload and the next
+        upload waits for the next ANNOUNCE."""
+        from ...core.privacy import masked_uplink_payload, outbound_delta
+        from ...utils.compression import secagg_support
+        from ...utils.pytree import tree_flatten_to_vector
+
+        member = self._secagg_member
+        if member is None or member.submitted or not member._pair_seeds:
+            self._pending_upload = (receive_id, weights, local_sample_num)
+            return None
+        support = None
+        if self._secagg_support_ratio:
+            d = int(tree_flatten_to_vector(weights)[0].size)
+            support = secagg_support(member.nonce, d,
+                                     float(self._secagg_support_ratio))
+        with tel.span("client.secagg_mask", window=member.window_id):
+            payload = masked_uplink_payload(member, weights, support=support)
+        self._secagg_member = None
+        return outbound_delta(payload, cfg=self._privacy)
 
     def _attach_telemetry_delta(self, message: Message) -> None:
         """Ship spans/counters accumulated since the last upload under the

@@ -18,6 +18,7 @@ import numpy as np
 from ... import mlops
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ...core.engine import flight_recorded
 from ...core.mpc.finite_field import DEFAULT_PRIME, flatten_finite, quantize
 from ...core.mpc.lightsecagg import (
     ClientMaskState,
@@ -58,6 +59,13 @@ class LightSecAggClientManager(FedMLCommManager):
     @property
     def my_id(self) -> int:
         return self.rank - 1  # 0-based mpc id
+
+    def run(self) -> None:
+        # same crash-forensics wrapper as the main cross-silo client: a
+        # handler exception mid-exchange dumps the last-N spans + comm
+        # breadcrumbs instead of dying silently in the receive loop
+        with flight_recorded(role="lightsecagg_client"):
+            super().run()
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(MyMessage.MSG_TYPE_CONNECTION_IS_READY, self.handle_message_connection_ready)

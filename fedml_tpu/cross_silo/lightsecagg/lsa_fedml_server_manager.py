@@ -13,6 +13,7 @@ from typing import Any, Dict, Optional
 from ... import mlops
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ...core.engine import flight_recorded
 from .lsa_message_define import MyMessage
 
 log = logging.getLogger(__name__)
@@ -28,6 +29,13 @@ class LightSecAggServerManager(FedMLCommManager):
         self.is_initialized = False
         self.mask_request_sent = False
         self.final_metrics: Optional[Dict[str, float]] = None
+
+    def run(self) -> None:
+        # crash-forensics parity with the main cross-silo server: a handler
+        # exception (mid share-routing, mid reconstruction) produces one
+        # flight-recorder dump with the comm breadcrumbs attached
+        with flight_recorded(role="lightsecagg_server"):
+            super().run()
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.handle_message_client_status)

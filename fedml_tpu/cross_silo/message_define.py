@@ -37,6 +37,26 @@ class MyMessage:
     MSG_TYPE_S2C_SPLIT_GRAD = 12
     MSG_TYPE_C2S_SPLIT_DONE = 13
 
+    # windowed async SecAgg (core/privacy): the server ANNOUNCEs a masking
+    # window (id, nonce, cohort, grid spec) per async publish cohort; members
+    # answer with their window DH public key; the server broadcasts the full
+    # DIRECTORY once every key is in; members deal Shamir shares of their
+    # window secret key through the server's SHARE_RELAY (a production
+    # deployment encrypts each share under the recipient's pair key — the
+    # relay never needs to read it). Masked uploads ride the normal
+    # C2S_SEND_MODEL_TO_SERVER as a SECAGG payload dict. When the window
+    # deadline fires with members missing, the server asks survivors to
+    # REVEAL their shares of the dropped members' keys (mask-share reveal),
+    # reconstructs, subtracts the stray masks, and publishes the partial
+    # window under the quorum's partial-close discipline.
+    MSG_TYPE_S2C_SECAGG_ANNOUNCE = 14
+    MSG_TYPE_C2S_SECAGG_PUBKEY = 15
+    MSG_TYPE_S2C_SECAGG_DIRECTORY = 16
+    MSG_TYPE_C2S_SECAGG_SHARES = 17
+    MSG_TYPE_S2C_SECAGG_SHARE_RELAY = 18
+    MSG_TYPE_S2C_SECAGG_REVEAL_REQUEST = 19
+    MSG_TYPE_C2S_SECAGG_REVEAL = 20
+
     # arg keys (routing lives in Message's own envelope fields; the old
     # TYPE/SENDER/RECEIVER duplicates were dead vocabulary and are gone)
     MSG_ARG_KEY_MODEL_PARAMS = "model_params"
@@ -58,6 +78,22 @@ class MyMessage:
     # split learning: activations / targets travel C2S per micro-batch, the
     # activation gradient travels S2C; mb_idx keys reassembly (the broker's
     # throttle timers may reorder deliveries) and mb_count closes the window
+    # windowed SecAgg: window identity + key-agreement material + the
+    # mask-share reveal. COHORT/SPEC/THRESHOLD ride the ANNOUNCE; DIRECTORY
+    # carries {rank: pk}; SHARES carries {peer_rank: share_ints} (dealer =
+    # sender); SHARE_RELAY carries one (dealer, share) pair; REVEAL carries
+    # {dropped_rank: share_ints}
+    MSG_ARG_KEY_SECAGG_WINDOW_ID = "secagg_window_id"
+    MSG_ARG_KEY_SECAGG_NONCE = "secagg_nonce"
+    MSG_ARG_KEY_SECAGG_COHORT = "secagg_cohort"
+    MSG_ARG_KEY_SECAGG_SPEC = "secagg_spec"
+    MSG_ARG_KEY_SECAGG_THRESHOLD = "secagg_threshold"
+    MSG_ARG_KEY_SECAGG_PUBKEY = "secagg_pubkey"
+    MSG_ARG_KEY_SECAGG_SHARES = "secagg_shares"
+    MSG_ARG_KEY_SECAGG_DEALER = "secagg_dealer"
+    MSG_ARG_KEY_SECAGG_SHARE = "secagg_share"
+    MSG_ARG_KEY_SECAGG_DROPPED = "secagg_dropped"
+    MSG_ARG_KEY_SECAGG_REVEALS = "secagg_reveals"
     MSG_ARG_KEY_SPLIT_ACTS = "split_acts"
     MSG_ARG_KEY_SPLIT_TARGETS = "split_targets"
     MSG_ARG_KEY_SPLIT_GRADS = "split_grads"

@@ -12,6 +12,7 @@ from typing import Any, Dict, Optional
 
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ...core.engine import flight_recorded
 from .sa_message_define import MyMessage
 
 log = logging.getLogger(__name__)
@@ -28,6 +29,13 @@ class SecAggServerManager(FedMLCommManager):
         self.directory_sent = False
         self.unmask_requested = False
         self.final_metrics: Optional[Dict[str, float]] = None
+
+    def run(self) -> None:
+        # crash-forensics parity with the main cross-silo server: an
+        # exception in any handler (mid key-directory, mid reveal) produces
+        # one flight-recorder dump with the comm breadcrumbs still attached
+        with flight_recorded(role="secagg_server"):
+            super().run()
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.handle_message_client_status)

@@ -91,6 +91,36 @@ class FedMLAggregator:
 
             self.async_buffer = buffer_from_args(
                 args, health=self.fleet.health, engine=get_engine())
+        # privacy (core/privacy, args.privacy=secagg|dp|secagg+dp): masked
+        # windows attach to the async buffer as its privacy session; DP
+        # noise rides the publish (async) or the aggregate tail (sync). The
+        # server manager drives the window protocol over the message plane.
+        from ...core.privacy import privacy_from_args
+
+        self.privacy_cfg = privacy_from_args(args)
+        self.dp_fold = self.privacy_cfg.build_dp()
+        self.secagg_coordinator = None
+        if self.privacy_cfg.secagg:
+            if self.async_buffer is None:
+                raise ValueError(
+                    "privacy=secagg masks per async publish window: set "
+                    "args.async_rounds (the synchronous fronts have their own "
+                    "round-barrier SecAgg under cross_silo/secagg)")
+            from ...core.privacy import WindowCoordinator
+
+            n = int(getattr(args, "client_num_per_round", client_num) or client_num)
+            ratio = None
+            if str(getattr(args, "comm_compressor", "") or "") in ("topk", "eftopk"):
+                # compose with the sparse uplink: the window's shared rand-k
+                # support carries the configured ratio into the masked domain
+                ratio = float(getattr(args, "comm_compressor_ratio", 0.05))
+            self.secagg_coordinator = WindowCoordinator(
+                self.async_buffer, self.get_global_model_params(),
+                spec=self.privacy_cfg.quant_spec(n, n),
+                threshold=self.privacy_cfg.threshold,
+                dp=self.dp_fold, support_ratio=ratio)
+        elif self.dp_fold is not None and self.async_buffer is not None:
+            self.dp_fold.attach(self.async_buffer)
         # modelwatch: fold-boundary delta statistics feeding the fleet's
         # contribution ledger (+ optional quarantine). The sync path screens
         # cohorts in aggregate(); the async path rides the buffer's fused
@@ -100,6 +130,10 @@ class FedMLAggregator:
         self._modelwatch = modelwatch.enabled(args)
         self._mw_prev_update = None  # device tree: last published update direction
         self._mw_round = 0
+        if self._modelwatch and self.secagg_coordinator is not None:
+            # masked ring vectors are opaque by design — fold-boundary delta
+            # stats would read one-time-pad noise, so the watch stays off
+            self._modelwatch = False
         if self._modelwatch:
             modelwatch.set_active(self.fleet.ledger)
             if self.async_buffer is not None:
@@ -238,6 +272,11 @@ class FedMLAggregator:
             Context().add(Context.KEY_CLIENT_MODEL_LIST, model_list)
             averaged = self.aggregator.aggregate(model_list)
             averaged = self.aggregator.on_after_aggregation(averaged)
+            if self.dp_fold is not None and self.async_buffer is None:
+                # central DP on the synchronous round: noise the round mean
+                # with sigma calibrated to the cohort size, account the
+                # release (async mode noises inside the buffer publish)
+                averaged = self.dp_fold.noise_tree(averaged, len(model_list))
             self.set_global_model_params(averaged)
             self.aggregator.assess_contribution()
             self.model_dict.clear()

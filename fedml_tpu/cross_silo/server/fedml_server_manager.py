@@ -66,6 +66,12 @@ class FedMLServerManager(FedMLCommManager):
         self._deadline_timer: Optional[threading.Timer] = None
         self._round_store: Optional[RoundStateStore] = None
         self._checkpointer: Optional[RoundCheckpointer] = None
+        # --- privacy (core/privacy) ----------------------------------------
+        # the aggregator owns the window coordinator / DP fold; this manager
+        # drives the window protocol over the message plane: ANNOUNCE ->
+        # PUBKEY -> DIRECTORY -> SHARES relay -> masked uploads -> (deadline)
+        # REVEAL -> partial close
+        self._secagg_deadline_timer: Optional[threading.Timer] = None
         # --- link telemetry -------------------------------------------------
         # active probing is opt-in (args.link_probe_interval_s > 0); passive
         # per-pair accounting in FedMLCommManager is always on
@@ -165,6 +171,11 @@ class FedMLServerManager(FedMLCommManager):
             if self._slo is not None:
                 self._slo.store.add_collector(self._slo_health_collector)
                 self._slo.store.add_collector(fleet_sketches.tsdb_collector)
+                if self._dp_accountant is not None:
+                    # privacy.dp_epsilon_spent / dp_budget_frac series — the
+                    # dp_budget_exhaustion SLO row watches the latter and
+                    # fires BEFORE the budget is crossed
+                    self._slo.store.add_collector(self._dp_accountant.tsdb_collector)
             self._start_statusz_if_configured()
             try:
                 super().run()
@@ -193,11 +204,20 @@ class FedMLServerManager(FedMLCommManager):
             statusz.register_section("health", fleet.health.statusz)
         if buf is not None:
             statusz.register_section("async", buf.statusz)
+        if getattr(self.aggregator, "privacy_cfg", None) is not None \
+                and self.aggregator.privacy_cfg.enabled:
+            statusz.register_section("privacy", self._statusz_privacy_section)
 
         def gauges():
             out = list(fleet.health.prom_gauges()) if fleet is not None else []
             if buf is not None:
                 out.extend(buf.prom_gauges())
+            co = self._secagg
+            if co is not None:
+                out.extend(co.prom_gauges())
+            dp = getattr(self.aggregator, "dp_fold", None)
+            if dp is not None:
+                out.extend(dp.prom_gauges())
             # contribution ledger (modelwatch): only if one was actually built
             led = getattr(fleet, "_ledger", None) if fleet is not None else None
             if led is not None:
@@ -220,8 +240,19 @@ class FedMLServerManager(FedMLCommManager):
         statusz.unregister_section("round")
         statusz.unregister_section("health")
         statusz.unregister_section("async")
+        statusz.unregister_section("privacy")
         self._statusz_server.stop()
         self._statusz_server = None
+
+    def _statusz_privacy_section(self) -> dict:
+        doc: Dict[str, Any] = {"mode": self.aggregator.privacy_cfg.mode}
+        co = self._secagg
+        if co is not None:
+            doc["secagg"] = co.statusz()
+        dp = getattr(self.aggregator, "dp_fold", None)
+        if dp is not None:
+            doc["dp"] = dp.statusz()
+        return doc
 
     def _statusz_round_section(self) -> dict:
         doc = {
@@ -286,6 +317,143 @@ class FedMLServerManager(FedMLCommManager):
                 msg_params.get(MyMessage.MSG_ARG_KEY_PROBE_T_SEND_NS),
             )
 
+    # --- windowed SecAgg driver (server side of core/privacy) --------------
+    @property
+    def _secagg(self):
+        return getattr(self.aggregator, "secagg_coordinator", None)
+
+    @property
+    def _dp_accountant(self):
+        dp = getattr(self.aggregator, "dp_fold", None)
+        return dp.accountant if dp is not None else None
+
+    def _secagg_open_window(self) -> None:
+        """Open the next masking window over the current cohort and ANNOUNCE
+        it (id, nonce, shared grid spec, threshold) to every member. Key
+        exchange runs over the message plane, not in-process."""
+        co = self._secagg
+        if co is None or not self.client_id_list_in_this_round:
+            return
+        cohort = [int(c) for c in self.client_id_list_in_this_round]
+        window, _ = co.open_window(cohort, run_key_exchange=False)
+        spec_doc = dict(co.spec.as_dict())
+        if co.support_ratio is not None:
+            spec_doc["support_ratio"] = float(co.support_ratio)
+        for cid in cohort:
+            msg = Message(MyMessage.MSG_TYPE_S2C_SECAGG_ANNOUNCE,
+                          self.get_sender_id(), cid)
+            msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_WINDOW_ID, window.window_id)
+            msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_NONCE, window.nonce)
+            msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_COHORT, cohort)
+            msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_SPEC, spec_doc)
+            msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_THRESHOLD, window.threshold)
+            self.send_message(msg)
+        self._arm_secagg_deadline(window.window_id)
+
+    def handle_message_secagg_pubkey(self, msg_params: Message) -> None:
+        co = self._secagg
+        window = co.window if co is not None else None
+        if window is None or int(msg_params.get(
+                MyMessage.MSG_ARG_KEY_SECAGG_WINDOW_ID)) != window.window_id:
+            return
+        window.register_public_key(
+            msg_params.get_sender_id(),
+            int(msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_PUBKEY)))
+        if len(window.public_keys) == len(window.cohort):
+            directory = {int(r): int(pk) for r, pk in window.public_keys.items()}
+            for cid in window.cohort:
+                msg = Message(MyMessage.MSG_TYPE_S2C_SECAGG_DIRECTORY,
+                              self.get_sender_id(), cid)
+                msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_WINDOW_ID,
+                               window.window_id)
+                msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_PUBKEY, directory)
+                self.send_message(msg)
+
+    def handle_message_secagg_shares(self, msg_params: Message) -> None:
+        """Relay each dealt Shamir share to its holder. The relay is opaque
+        routing — a production deployment additionally encrypts each share
+        under the recipient's pair key so this hop cannot read it."""
+        dealer = msg_params.get_sender_id()
+        wid = int(msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_WINDOW_ID))
+        shares = dict(msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_SHARES) or {})
+        for peer, share in shares.items():
+            msg = Message(MyMessage.MSG_TYPE_S2C_SECAGG_SHARE_RELAY,
+                          self.get_sender_id(), int(peer))
+            msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_WINDOW_ID, wid)
+            msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_DEALER, int(dealer))
+            msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_SHARE,
+                           [int(v) for v in share])
+            self.send_message(msg)
+
+    def _arm_secagg_deadline(self, window_id: int) -> None:
+        self._cancel_secagg_deadline()
+        deadline_s = float(getattr(self.aggregator.privacy_cfg,
+                                   "window_deadline_s", 30.0))
+        if deadline_s <= 0:
+            return
+        t = threading.Timer(deadline_s, self._on_secagg_deadline, args=(window_id,))
+        t.daemon = True
+        t.start()
+        self._secagg_deadline_timer = t
+
+    def _cancel_secagg_deadline(self) -> None:
+        if self._secagg_deadline_timer is not None:
+            self._secagg_deadline_timer.cancel()
+            self._secagg_deadline_timer = None
+
+    def _on_secagg_deadline(self, window_id: int) -> None:
+        """Timer thread: the masking window's deadline fired with members
+        missing. Start the mask-share reveal against the survivors; the
+        reveal handler closes the window once the quorum of shares is in."""
+        with self._round_lock:
+            co = self._secagg
+            window = co.window if co is not None else None
+            if window is None or window.window_id != window_id or window.closed:
+                return
+            dropped = window.missing()
+            if not dropped:
+                return
+            if len(window.arrived) < window.threshold + 1:
+                log.warning("secagg window %d: only %d arrivals (< reveal "
+                            "quorum %d) — extending deadline", window_id,
+                            len(window.arrived), window.threshold + 1)
+                self._arm_secagg_deadline(window_id)
+                return
+            mlops.log_resilience_event("secagg_dropout", round_idx=window_id,
+                                       missing=dropped, arrived=window.arrived)
+            for cid in window.arrived:
+                msg = Message(MyMessage.MSG_TYPE_S2C_SECAGG_REVEAL_REQUEST,
+                              self.get_sender_id(), int(cid))
+                msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_WINDOW_ID, window_id)
+                msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_DROPPED,
+                               [int(r) for r in dropped])
+                self.send_message(msg)
+
+    def handle_message_secagg_reveal(self, msg_params: Message) -> None:
+        """One survivor's share bundle. When every dropped rank has its
+        reveal quorum, reconstruct + subtract the stray masks and publish
+        the partial window (PR-5 partial-close discipline, booked on
+        ``quorum.partial`` by the coordinator)."""
+        with self._round_lock:
+            co = self._secagg
+            window = co.window if co is not None else None
+            if window is None or int(msg_params.get(
+                    MyMessage.MSG_ARG_KEY_SECAGG_WINDOW_ID)) != window.window_id:
+                return
+            reveals = {int(dr): [int(v) for v in share] for dr, share in
+                       dict(msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_REVEALS)
+                            or {}).items()}
+            window.add_reveal(msg_params.get_sender_id(), reveals)
+            if not window.reveals_complete():
+                return
+            co.recover()  # shares already delivered: validates + books dropout
+            self._cancel_secagg_deadline()
+            model = co.close_window()
+            if model is None:
+                return
+            self.aggregator.set_global_model_params(model)
+            self._after_async_publish()
+
     # --- round trace lifecycle --------------------------------------------
     # All handlers run on the one receive-loop thread, so the round span can
     # stay open across handler invocations: entered when the round's configs
@@ -321,6 +489,8 @@ class FedMLServerManager(FedMLCommManager):
                 client_id, global_model_params, self.data_silo_index_list[idx]
             )
         self._begin_quorum_round()
+        # first masking window: over the initial cohort, before any upload
+        self._secagg_open_window()
         mlops.event("server.wait", event_started=True, event_value=str(self.args.round_idx))
 
     def register_message_receive_handlers(self) -> None:
@@ -331,6 +501,15 @@ class FedMLServerManager(FedMLCommManager):
         )
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_LINK_PROBE_ECHO, self.handle_message_link_probe_echo
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SECAGG_PUBKEY, self.handle_message_secagg_pubkey
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SECAGG_SHARES, self.handle_message_secagg_shares
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SECAGG_REVEAL, self.handle_message_secagg_reveal
         )
 
     # --- cohort selection -------------------------------------------------
@@ -529,9 +708,23 @@ class FedMLServerManager(FedMLCommManager):
         with self._round_lock:
             with tel.span("server.async_receive", sender=int(sender_id),
                           version=buf.version):
-                verdict = self.aggregator.submit_async_result(
-                    sender_id - 1, model_params, local_sample_number,
-                    None if client_version is None else int(client_version))
+                co = self._secagg
+                if co is not None:
+                    # masked ring payload: fold through the window session
+                    # (weight 1.0) — the raw tree path never sees it
+                    from ...core.privacy import is_masked_payload, submit_masked_payload
+
+                    if not is_masked_payload(model_params):
+                        log.warning("privacy=secagg: dropping unmasked upload "
+                                    "from rank %d", int(sender_id))
+                        return
+                    verdict = submit_masked_payload(
+                        co, model_params,
+                        None if client_version is None else int(client_version))
+                else:
+                    verdict = self.aggregator.submit_async_result(
+                        sender_id - 1, model_params, local_sample_number,
+                        None if client_version is None else int(client_version))
             fleet = getattr(self.aggregator, "fleet", None)
             if fleet is not None:
                 fleet.health.heartbeat(sender_id)
@@ -540,6 +733,21 @@ class FedMLServerManager(FedMLCommManager):
                     "stale_rejected", round_idx=buf.version, rank=int(sender_id))
             note(last_async=buf.statusz())
             ckpt_every = int(getattr(self.args, "async_checkpoint_every_merges", 0) or 0)
+            co = self._secagg
+            if co is not None:
+                # masked windows publish when the COHORT completes (every
+                # member's masks must be in the sum before they can cancel),
+                # not at the buffer's merge count
+                window = co.window
+                if window is not None and not window.closed and window.complete():
+                    self._cancel_secagg_deadline()
+                    self._complete_async_publish()
+                    if self.args.round_idx >= self.round_num:
+                        return  # finished: S2C_FINISH already sent
+                self.send_message_sync_model_to_client(
+                    sender_id, self.aggregator.get_global_model_params(),
+                    self._silo_of.get(int(sender_id), sender_id - 1))
+                return
             if buf.ready():
                 self._complete_async_publish()
                 if self.args.round_idx >= self.round_num:
@@ -560,6 +768,13 @@ class FedMLServerManager(FedMLCommManager):
         global_model_params = self.aggregator.publish_async()
         if global_model_params is None:
             return
+        self._after_async_publish()
+
+    def _after_async_publish(self) -> None:
+        """Post-publish bookkeeping shared by the full-window path and the
+        secagg partial close (which publishes through the coordinator).
+        Caller holds ``_round_lock``; the fresh global model is installed."""
+        global_model_params = self.aggregator.get_global_model_params()
         buf = self.aggregator.async_buffer
         round_idx = buf.version - 1  # the generation just published
         self.args.round_idx = buf.version
@@ -586,12 +801,15 @@ class FedMLServerManager(FedMLCommManager):
         self._save_round_state(round_idx, global_model_params, final=final)
         if final:
             mlops.log_aggregation_status("FINISHED", str(getattr(self.args, "run_id", "0")))
+            self._cancel_secagg_deadline()
             self.send_finish_to_all()
             self._end_round_trace()
             self._export_fleet_trace_if_configured()
             self.finish()
             return
         self._begin_round_trace()
+        # next masking cohort: one window per publish generation
+        self._secagg_open_window()
 
     def _complete_round(self) -> None:
         """Aggregate (all arrivals, or the quorum's partial set), evaluate,

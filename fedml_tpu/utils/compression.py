@@ -292,6 +292,22 @@ def decompress_comm_payload(payload: Dict[str, Any]) -> PyTree:
     return tree_unflatten_from_vector(flat, payload["spec"])
 
 
+def secagg_support(nonce: int, size: int, ratio: float) -> np.ndarray:
+    """Window-seeded shared sparse support for masked uplinks.
+
+    SecAgg masking cancels coordinate-wise, so a sparsified masked cohort
+    must agree on ONE support: data-dependent supports (top-k per client)
+    would leave every member's masks straddling different coordinates and
+    nothing would cancel. Rand-k seeded by the window nonce gives every
+    cohort member (and the server) the same k coordinates with no index
+    array on the wire, keeping the compression ratio while the values ride
+    the masking ring. Error feedback still applies client-side: the dropped
+    coordinates' mass re-enters on the next window's support."""
+    k = max(1, min(int(size), int(np.ceil(int(size) * float(ratio)))))
+    rng = np.random.default_rng(int(nonce) & 0xFFFFFFFFFFFFFFFF)
+    return np.sort(rng.choice(int(size), size=k, replace=False))
+
+
 def make_comm_compressor(args: Any) -> Optional[CommCompressor]:
     """Build the upload compressor from args (None when not configured)."""
     kind = getattr(args, "comm_compressor", None)

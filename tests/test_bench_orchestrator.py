@@ -264,6 +264,15 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
                          "fleet_scale_edge_eq_flat": True,
                          "fleet_scale_offenders_recovered": "12/12",
                          "fleet_scale_hll_err_pct": 1.49}, None),
+        "secagg_overhead": ({"secagg_overhead_pct": 0.81,
+                             "secagg_plain_round_ms": 42.0,
+                             "secagg_masked_round_ms": 42.3,
+                             "secagg_fold_ms": 3.1,
+                             "secagg_rounds": 12,
+                             "secagg_clients": 10,
+                             "secagg_model_dim": 192,
+                             "dp_epsilon_spent": 21.35,
+                             "dp_noise_multiplier": 0.8}, None),
         "devperf_overhead": ({"llm_mfu": 0.018,
                               "llm_mfu_analytic": 0.018,
                               "llm_mfu_rel_err": 0.0,
@@ -317,6 +326,8 @@ def test_main_happy_path_merges_and_exits_zero(monkeypatch, tmp_path, capsys, _r
     assert out["fleet_scale_quantile_err_pct"] == 0.86
     assert out["fleet_telemetry_bytes_per_client"] == 6.2
     assert out["fleet_scale_edge_eq_flat"] is True
+    assert out["secagg_overhead_pct"] == 0.81
+    assert out["dp_epsilon_spent"] == 21.35
     assert out["stages_failed"] == []
     # incremental artifacts landed (one per stage + final, same stamp file)
     arts = glob.glob(str(tmp_path / "BENCH_MEASURED_*.json"))

@@ -540,6 +540,108 @@ class TestMetricRegistryRule(unittest.TestCase):
             self.assertEqual([f.render() for f in res.findings], [])
 
 
+class TestRawDeltaEscapeRule(unittest.TestCase):
+    """The ISSUE-20 privacy boundary: bad (raw name payload on a
+    model_params uplink), good (masking call / sanctioning helper /
+    sanctioned rebind), the two scope-outs (S2C downlink, transport
+    modules), and the reasoned suppression the split front carries."""
+
+    def test_raw_name_payload_is_flagged(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "client.py":
+                    "KEY = 'model_params'\n"
+                    "def send(trainer, Message):\n"
+                    "    delta = trainer.get_update()\n"
+                    "    m = Message(3)\n"
+                    "    m.add_params(KEY, delta)\n",
+            }, ["raw-delta-escape"])
+            self.assertEqual(len(res.findings), 1,
+                             [f.render() for f in res.findings])
+            self.assertIn("`delta`", res.findings[0].message)
+            self.assertIn("outbound_delta", res.findings[0].message)
+
+    def test_masked_and_helper_and_rebind_are_clean(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "client.py":
+                    "from fedml_tpu.core.privacy import masked_uplink_payload\n"
+                    "from fedml_tpu.core.privacy import outbound_delta\n"
+                    "def _sanitize(tree, args):\n"
+                    "    return outbound_delta(tree, args)\n"
+                    "def send_masked(member, tree, Message):\n"
+                    "    m = Message(3)\n"
+                    "    m.add_params('model_params',\n"
+                    "                 masked_uplink_payload(member, tree))\n"
+                    "def send_helper(tree, args, Message):\n"
+                    "    m = Message(3)\n"
+                    "    p = _sanitize(tree, args)\n"
+                    "    m.add_params('model_params', p)\n"
+                    "def send_rebound(trainer, args, Message):\n"
+                    "    p = trainer.get_update()\n"
+                    "    p = outbound_delta(p, args)\n"
+                    "    m = Message(3)\n"
+                    "    m.add_params('model_params', p)\n",
+            }, ["raw-delta-escape"])
+            self.assertEqual([f.render() for f in res.findings], [])
+
+    def test_unsanctioned_rebind_retaints(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "client.py":
+                    "from fedml_tpu.core.privacy import outbound_delta\n"
+                    "def send(trainer, args, Message):\n"
+                    "    p = outbound_delta(trainer.get_update(), args)\n"
+                    "    p = trainer.raw_weights()\n"
+                    "    m = Message(3)\n"
+                    "    m.add_params('model_params', p)\n",
+            }, ["raw-delta-escape"])
+            self.assertEqual(len(res.findings), 1,
+                             [f.render() for f in res.findings])
+
+    def test_s2c_downlink_broadcast_is_skipped(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "server.py":
+                    "MSG_TYPE_S2C_SYNC_MODEL = 1\n"
+                    "def broadcast(agg, Message):\n"
+                    "    g = agg.current_model()\n"
+                    "    m = Message(MSG_TYPE_S2C_SYNC_MODEL)\n"
+                    "    m.add_params('model_params', g)\n",
+            }, ["raw-delta-escape"])
+            self.assertEqual([f.render() for f in res.findings], [])
+
+    def test_transport_modules_are_below_the_boundary(self):
+        src = ("def reassemble(chunks, Message):\n"
+               "    blob = join(chunks)\n"
+               "    m = Message(9)\n"
+               "    m.add_params('model_params', blob)\n")
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {"transport/backend.py": src},
+                         ["raw-delta-escape"],
+                         options={"delta-transport-modules": ["transport/*"]})
+            self.assertEqual([f.render() for f in res.findings], [])
+        with tempfile.TemporaryDirectory() as d:
+            # same send OUTSIDE the transport scope is a finding
+            res = _pscan(d, {"app/backend.py": src}, ["raw-delta-escape"],
+                         options={"delta-transport-modules": ["transport/*"]})
+            self.assertEqual(len(res.findings), 1,
+                             [f.render() for f in res.findings])
+
+    def test_reasoned_suppression(self):
+        with tempfile.TemporaryDirectory() as d:
+            res = _pscan(d, {
+                "split.py":
+                    "def upload(shard, Message):\n"
+                    "    m = Message(3)\n"
+                    "    m.add_params('model_params', shard)  "
+                    "# fedlint: disable=raw-delta-escape split shard "
+                    "travels raw by design, no SecAgg on this front\n",
+            }, ["raw-delta-escape"])
+            self.assertEqual([f.render() for f in res.findings], [])
+            self.assertEqual(len(res.suppressed), 1)
+
+
 class TestIncrementalCache(unittest.TestCase):
 
     _TREE = {

@@ -103,6 +103,18 @@ EXPORTED = {
     "fedml_fleet_outlier_rate": "gauge",
     "fedml_fleet_sketch_bytes": "gauge",
     "fedml_telemetry_series_live": "gauge",
+    # privacy subsystem (core/privacy): windowed async SecAgg + accounted DP
+    # (window gauges labeled {window, tier} when tier-scoped)
+    "fedml_secagg_windows_total": "counter",
+    "fedml_secagg_masked_merges_total": "counter",
+    "fedml_secagg_dropouts_total": "counter",
+    "fedml_secagg_recovered_total": "counter",
+    "fedml_secagg_reveals_total": "counter",
+    "fedml_secagg_window_depth": "gauge",
+    "fedml_secagg_windows": "gauge",
+    "fedml_dp_noised_publishes_total": "counter",
+    "fedml_dp_epsilon_spent": "gauge",
+    "fedml_dp_budget_frac": "gauge",
     # training
     "fedml_llm_tokens_per_sec": "histogram",
     # serving

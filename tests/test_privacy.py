@@ -1,0 +1,590 @@
+"""Privacy subsystem acceptance tests (ISSUE 20): windowed async SecAgg
+parity (masked zero-dropout window == bit-exact honest quantized fold),
+dropout recovery via the Shamir mask-share reveal, 3-tier hierarchical
+masking == flat, composition with the shared-support sparse uplink, the
+accounted-DP fold (noise calibration, single fused compile across buffers,
+accountant vs the analytic RDP bound over its own order grid), the
+``dp_budget_exhaustion`` SLO chaos drill, the ``outbound_delta`` comm-
+boundary gate, and the secagg/lightsecagg manager crash-forensics parity
+(flight-recorder run wrappers + armed comm retry)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from fedml_tpu.core import telemetry as tel
+from fedml_tpu.core.aggregation.async_buffer import (AsyncAggBuffer,
+                                                     StalenessPolicy)
+from fedml_tpu.core.dp.budget_accountant.rdp_accountant import (
+    DEFAULT_ORDERS, compute_rdp, get_privacy_spent)
+from fedml_tpu.core.privacy import (
+    DPAccountant,
+    DPFold,
+    HierarchyPrivacy,
+    PrivacyConfig,
+    PrivacyError,
+    QuantSpec,
+    WindowCoordinator,
+    clip_update,
+    is_masked_payload,
+    masked_uplink_payload,
+    outbound_delta,
+    privacy_from_args,
+    ring_bits_for,
+    submit_masked_payload,
+)
+from fedml_tpu.core.privacy.masking import dequantize_sum, quantize_vector
+from fedml_tpu.core.privacy.secagg_window import (
+    DROPOUT_COUNTER,
+    MASKED_MERGE_COUNTER,
+    RECOVERED_COUNTER,
+    REVEAL_COUNTER,
+    WINDOW_CLOSED,
+    WINDOWS_COUNTER,
+)
+from fedml_tpu.core.telemetry import slo, tsdb
+from fedml_tpu.core.telemetry.jax_hooks import compile_count
+from fedml_tpu.utils.pytree import tree_flatten_to_vector
+
+
+TEMPLATE = {"w": jnp.zeros((5, 3)), "b": jnp.zeros((4,))}
+D = 19  # total template elements
+
+
+def _deltas(n, rng_seed=0, scale=1.0):
+    rng = np.random.default_rng(rng_seed)
+    return [{"w": jnp.asarray(rng.normal(0, scale, (5, 3)), jnp.float32),
+             "b": jnp.asarray(rng.normal(0, scale, (4,)), jnp.float32)}
+            for _ in range(n)]
+
+
+def _flat(tree):
+    return np.asarray(tree_flatten_to_vector(tree)[0])
+
+
+def _honest_quantized_mean(deltas, spec, n=None):
+    """The reference fold: quantize each update, sum in the ring's signed
+    integers, dequantize the mean — what a masked window must equal
+    bit-exactly once the masks cancel."""
+    n = n if n is not None else len(deltas)
+    qsum = sum(quantize_vector(_flat(d), spec) for d in deltas)
+    return dequantize_sum(qsum, n, spec)
+
+
+def _privacy_buffer(publish_k):
+    return AsyncAggBuffer(publish_k=publish_k,
+                          policy=StalenessPolicy(exponent=0.0))
+
+
+class _Args:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+# ---------------------------------------------------------------------------
+# flat masked window: zero dropout == honest quantized fold, bit-exact
+# ---------------------------------------------------------------------------
+
+class TestMaskedWindowParity:
+    def test_masks_cancel_bit_exact(self):
+        n = 4
+        spec = QuantSpec(ring_bits=ring_bits_for(n, n))
+        deltas = _deltas(n, rng_seed=7)
+        buf = _privacy_buffer(n)
+        co = WindowCoordinator(buf, TEMPLATE, spec=spec,
+                               rng=np.random.default_rng(1))
+        window, members = co.open_window(range(n))
+        for r in range(n):
+            v = co.submit(r, members[r].mask(_flat(deltas[r])),
+                          client_version=buf.version)
+            assert v == "accept"
+        out = buf.publish()
+        assert out is not None
+        honest = _honest_quantized_mean(deltas, spec)
+        assert np.array_equal(_flat(out), honest)
+        # shapes restored, not just the flat vector
+        assert out["w"].shape == (5, 3) and out["b"].shape == (4,)
+
+    def test_masked_submission_is_not_the_delta(self):
+        """The server-visible ring vector must not be the raw update (or a
+        recognisable quantization of it)."""
+        n = 3
+        spec = QuantSpec(ring_bits=ring_bits_for(n, n))
+        d = _deltas(1, rng_seed=3)[0]
+        buf = _privacy_buffer(n)
+        co = WindowCoordinator(buf, TEMPLATE, spec=spec,
+                               rng=np.random.default_rng(2))
+        _, members = co.open_window(range(n))
+        masked = members[0].mask(_flat(d))
+        q = quantize_vector(_flat(d), spec)
+        # ring residues are uniform-ish; equality with the bare quantized
+        # vector would mean the pairwise masks were zero
+        assert not np.array_equal(masked, np.mod(q, spec.ring))
+
+    def test_counters_and_gauges(self):
+        n = 3
+        spec = QuantSpec(ring_bits=ring_bits_for(n, n))
+        t = tel.get_telemetry()
+        w0 = t.counter(WINDOWS_COUNTER).value
+        m0 = t.counter(MASKED_MERGE_COUNTER).value
+        deltas = _deltas(n)
+        buf = _privacy_buffer(n)
+        co = WindowCoordinator(buf, TEMPLATE, spec=spec,
+                               rng=np.random.default_rng(5))
+        _, members = co.open_window(range(n))
+        for r in range(n):
+            co.submit(r, members[r].mask(_flat(deltas[r])),
+                      client_version=buf.version)
+        assert buf.publish() is not None
+        assert t.counter(WINDOWS_COUNTER).value == w0 + 1
+        assert t.counter(MASKED_MERGE_COUNTER).value == m0 + n
+        names = {g[0] for g in co.prom_gauges()}
+        assert {"secagg_window_depth", "secagg_windows"} <= names
+
+    def test_nonzero_staleness_exponent_rejected(self):
+        buf = AsyncAggBuffer(publish_k=2)  # default policy decays weights
+        with pytest.raises(ValueError):
+            WindowCoordinator(buf, TEMPLATE)
+
+
+# ---------------------------------------------------------------------------
+# dropout drill: rank dies mid-window, reveal recovers the partial bit-exact
+# ---------------------------------------------------------------------------
+
+class TestDropoutRecovery:
+    def test_reveal_unmasks_survivor_partial(self):
+        n, dead = 5, 3
+        spec = QuantSpec(ring_bits=ring_bits_for(n, n))
+        deltas = _deltas(n, rng_seed=11)
+        t = tel.get_telemetry()
+        d0 = t.counter(DROPOUT_COUNTER).value
+        r0 = t.counter(RECOVERED_COUNTER).value
+        v0 = t.counter(REVEAL_COUNTER).value
+        buf = _privacy_buffer(n)
+        co = WindowCoordinator(buf, TEMPLATE, spec=spec, threshold=2,
+                               rng=np.random.default_rng(9))
+        window, members = co.open_window(range(n))
+        survivors = [r for r in range(n) if r != dead]
+        for r in survivors:
+            assert co.submit(r, members[r].mask(_flat(deltas[r])),
+                             client_version=buf.version) == "accept"
+        # deadline passes with rank 3 missing: reveal + stray-mask subtract
+        dropped = co.recover(members=members)
+        assert dropped == [dead]
+        out = co.close_window()
+        assert out is not None
+        honest = _honest_quantized_mean([deltas[r] for r in survivors], spec)
+        assert np.array_equal(_flat(out), honest)
+        assert window.recovered
+        assert t.counter(DROPOUT_COUNTER).value == d0 + 1
+        assert t.counter(RECOVERED_COUNTER).value == r0 + 1
+        # each survivor revealed its share of the dead rank's key
+        assert t.counter(REVEAL_COUNTER).value == v0 + len(survivors)
+
+    def test_late_submit_after_close_is_refused(self):
+        """The dead rank's stray masks were already subtracted; folding its
+        masked vector now would corrupt the sum AND void its privacy."""
+        n, dead = 4, 2
+        spec = QuantSpec(ring_bits=ring_bits_for(n, n))
+        deltas = _deltas(n, rng_seed=13)
+        buf = _privacy_buffer(n)
+        co = WindowCoordinator(buf, TEMPLATE, spec=spec, threshold=2,
+                               rng=np.random.default_rng(4))
+        _, members = co.open_window(range(n))
+        for r in range(n):
+            if r != dead:
+                co.submit(r, members[r].mask(_flat(deltas[r])),
+                          client_version=buf.version)
+        co.recover(members=members)
+        assert co.close_window() is not None
+        late = co.submit(dead, members[dead].mask(_flat(deltas[dead])),
+                         client_version=buf.version)
+        assert late == WINDOW_CLOSED
+
+    def test_below_threshold_reveal_fails(self):
+        """Fewer surviving shareholders than the Shamir quorum must not
+        silently reconstruct a wrong key: threshold=2 needs 3 reveals per
+        dropped rank, and only 2 survivors remain."""
+        n = 4
+        spec = QuantSpec(ring_bits=ring_bits_for(n, n))
+        deltas = _deltas(n, rng_seed=17)
+        buf = _privacy_buffer(n)
+        co = WindowCoordinator(buf, TEMPLATE, spec=spec, threshold=2,
+                               rng=np.random.default_rng(6))
+        _, members = co.open_window(range(n))
+        for r in (0, 1):
+            co.submit(r, members[r].mask(_flat(deltas[r])),
+                      client_version=buf.version)
+        with pytest.raises(RuntimeError, match="reveal quorum"):
+            co.recover(members={r: members[r] for r in (0, 1)})
+
+
+# ---------------------------------------------------------------------------
+# hierarchy: 3-tier masked fold == flat fold; intermediate tiers blind
+# ---------------------------------------------------------------------------
+
+class TestHierarchyPrivacy:
+    def _drive(self, tree, hp, cohorts, deltas):
+        opened = hp.open_edge_windows(cohorts)
+        for e in tree.edges:
+            members = opened[e.name][1]
+            for r in cohorts[e.name]:
+                v = e.privacy.submit(
+                    r, members[r].mask(_flat(deltas[r])),
+                    client_version=e.buffer.version)
+                assert v == "accept"
+            e._maybe_publish()
+
+    def test_three_tier_equals_flat(self):
+        from fedml_tpu.core.distributed.hierarchy import HierarchyTree
+
+        n_edges, per_edge = 4, 3
+        total = n_edges * per_edge
+        deltas = _deltas(total, rng_seed=23)
+        tree = HierarchyTree.build(n_edges=n_edges, regional_fanout=2,
+                                   publish_k=per_edge,
+                                   policy=StalenessPolicy(exponent=0.0))
+        hp = HierarchyPrivacy(tree, TEMPLATE, rng=np.random.default_rng(11))
+        cohorts = {e.name: list(range(i * per_edge, (i + 1) * per_edge))
+                   for i, e in enumerate(tree.edges)}
+        v_before = tree.version
+        self._drive(tree, hp, cohorts, deltas)
+        out = tree.latest_model()
+        assert out is not None
+        assert tree.version == v_before + 1
+        honest = _honest_quantized_mean(deltas, hp.spec)
+        assert np.array_equal(_flat(out), honest)
+        # the publish cascade drained every ledger entry to the root
+        assert len(hp.ledger) == 0
+
+    def test_intermediate_tiers_never_see_plaintext(self):
+        """What an edge buffer publishes upward stays in the tier ring
+        until the root's keyring strips it: the regional pass-through must
+        not equal (or closely track) the cohort's honest partial mean."""
+        from fedml_tpu.core.distributed.hierarchy import HierarchyTree
+
+        n_edges, per_edge = 2, 3
+        deltas = _deltas(n_edges * per_edge, rng_seed=29, scale=0.5)
+        tree = HierarchyTree.build(n_edges=n_edges, regional_fanout=2,
+                                   publish_k=per_edge,
+                                   policy=StalenessPolicy(exponent=0.0))
+        hp = HierarchyPrivacy(tree, TEMPLATE, rng=np.random.default_rng(31))
+        cohorts = {e.name: list(range(i * per_edge, (i + 1) * per_edge))
+                   for i, e in enumerate(tree.edges)}
+        seen = {}
+        for e in tree.edges:
+            orig = e.parent._submit_from_child
+
+            def spy(child, weight, model, _orig=orig, _name=e.name):
+                seen[_name] = _flat(model).copy()
+                return _orig(child, weight, model)
+
+            e.parent._submit_from_child = spy
+        self._drive(tree, hp, cohorts, deltas)
+        assert set(seen) == {e.name for e in tree.edges}
+        for i, e in enumerate(tree.edges):
+            honest = _honest_quantized_mean(
+                [deltas[r] for r in cohorts[e.name]], hp.spec)
+            up = seen[e.name]
+            # tier-masked ring residues: nonnegative ring domain, and far
+            # from the honest partial (the tier key has not been stripped)
+            assert np.all(up >= 0)
+            assert not np.allclose(up, honest, atol=hp.spec.clip)
+
+
+# ---------------------------------------------------------------------------
+# composition with the sparse shared-support uplink
+# ---------------------------------------------------------------------------
+
+class TestSparseCompose:
+    def test_shared_support_masks_cancel_on_support(self):
+        n = 4
+        spec = QuantSpec(ring_bits=ring_bits_for(n, n))
+        deltas = _deltas(n, rng_seed=37)
+        buf = _privacy_buffer(n)
+        co = WindowCoordinator(buf, TEMPLATE, spec=spec, support_ratio=0.25,
+                               rng=np.random.default_rng(41))
+        _, members = co.open_window(range(n))
+        assert co.support is not None
+        k = len(co.support)
+        assert k == max(1, int(round(0.25 * D)))
+        assert co.d == k and co.full_d == D
+        for r in range(n):
+            p = masked_uplink_payload(members[r], deltas[r],
+                                      support=co.support)
+            assert is_masked_payload(p)
+            assert p["masked"].shape == (k,)
+            assert submit_masked_payload(co, p,
+                                         client_version=buf.version) == "accept"
+        out = buf.publish()
+        flat_out = _flat(out)
+        dense = np.stack([_flat(d) for d in deltas])
+        sup = np.asarray(co.support, np.int64)
+        honest_sup = dequantize_sum(
+            sum(quantize_vector(row[sup], spec) for row in dense), n, spec)
+        assert np.array_equal(flat_out[sup], honest_sup)
+        off = np.setdiff1d(np.arange(D), sup)
+        assert np.all(flat_out[off] == 0.0)
+        assert int(np.count_nonzero(flat_out)) <= k
+
+    def test_support_derived_from_window_nonce(self):
+        """Two coordinators with the same rng seed but different window
+        nonces draw different supports — the coordinates are per-window,
+        not a static sparsity pattern an observer could accumulate."""
+        n = 3
+        supports = []
+        for seed in (1, 2):
+            buf = _privacy_buffer(n)
+            co = WindowCoordinator(buf, TEMPLATE, support_ratio=0.5,
+                                   rng=np.random.default_rng(seed))
+            co.open_window(range(n))
+            supports.append(tuple(np.asarray(co.support).tolist()))
+        assert supports[0] != supports[1]
+
+
+# ---------------------------------------------------------------------------
+# accounted DP at the fold
+# ---------------------------------------------------------------------------
+
+class TestDPFold:
+    def test_noise_calibrated_on_mean_and_accounted(self):
+        n, trials = 4, 200
+        z, clip = 0.8, 1.0
+        sigma_mean = z * clip / n
+        zero = [{"w": jnp.zeros((5, 3)), "b": jnp.zeros((4,))}
+                for _ in range(n)]
+        samples = []
+        dp = None
+        for trial in range(trials):
+            buf = _privacy_buffer(n)
+            dp = DPFold(noise_multiplier=z, l2_clip=clip,
+                        seed=trial).attach(buf)
+            for r in range(n):
+                buf.submit(r, zero[r], 1.0, client_version=buf.version)
+            samples.append(_flat(buf.publish()))
+        noise = np.concatenate(samples)
+        # all-zero updates: the published model IS the noise
+        est = float(np.std(noise))
+        assert est == pytest.approx(sigma_mean, rel=0.05)
+        assert dp.accountant.steps == 1  # one release per publish
+        assert dp.accountant.epsilon_spent > 0
+
+    def test_fused_noise_fn_compiles_once_across_buffers(self):
+        n = 2
+        zero = [{"w": jnp.zeros((5, 3)), "b": jnp.zeros((4,))}
+                for _ in range(n)]
+        for seed in (100, 101):
+            buf = _privacy_buffer(n)
+            DPFold(noise_multiplier=0.5, seed=seed).attach(buf)
+            for r in range(n):
+                buf.submit(r, zero[r], 1.0, client_version=buf.version)
+            buf.publish()
+            if seed == 100:
+                base = compile_count("dp_noised_scale")
+        # second buffer, new scale, new key: the fused kernel must NOT
+        # retrace (s/sigma/key are traced operands)
+        assert compile_count("dp_noised_scale") == base
+
+    def test_secagg_plus_dp_noises_unmasked_mean(self):
+        n = 3
+        spec = QuantSpec(ring_bits=ring_bits_for(n, n))
+        deltas = _deltas(n, rng_seed=43)
+        buf = _privacy_buffer(n)
+        dp = DPFold(noise_multiplier=0.8, l2_clip=1.0, seed=7)
+        co = WindowCoordinator(buf, TEMPLATE, spec=spec, dp=dp,
+                               rng=np.random.default_rng(3))
+        _, members = co.open_window(range(n))
+        for r in range(n):
+            co.submit(r, members[r].mask(_flat(deltas[r])),
+                      client_version=buf.version)
+        out = buf.publish()
+        honest = _honest_quantized_mean(deltas, spec)
+        diff = _flat(out) - honest
+        # noised: not bit-exact, but calibrated around the honest mean
+        assert not np.array_equal(_flat(out), honest)
+        assert float(np.abs(diff).max()) < 6 * (0.8 * 1.0 / n) + 1e-6
+        assert dp.accountant.steps == 1
+
+    def test_clip_update_projects_to_l2_ball(self):
+        big = {"w": jnp.ones((5, 3)) * 10.0, "b": jnp.ones((4,)) * 10.0}
+        clipped = clip_update(big, l2_clip=1.0)
+        norm = float(np.linalg.norm(_flat(clipped)))
+        assert norm == pytest.approx(1.0, rel=1e-5)
+        small = {"w": jnp.ones((5, 3)) * 0.01, "b": jnp.zeros((4,))}
+        same = clip_update(small, l2_clip=1.0)
+        assert np.array_equal(_flat(same), _flat(small))
+
+
+class TestDPAccountant:
+    def test_epsilon_matches_analytic_rdp_bound(self):
+        """Accountant ε after T steps at q=1 must equal the analytic
+        min over its own order grid of T·α/(2z²) − log(δ)/(α−1)."""
+        z, delta, T = 0.8, 1e-5, 10
+        acc = DPAccountant(noise_multiplier=z, delta=delta,
+                           epsilon_budget=100.0)
+        eps = 0.0
+        for _ in range(T):
+            eps = acc.step()
+        orders = np.asarray(DEFAULT_ORDERS, np.float64)
+        analytic = float(np.min(
+            T * orders / (2.0 * z * z) - np.log(delta) / (orders - 1.0)))
+        assert abs(eps - analytic) <= 1e-6
+        assert acc.epsilon_spent == pytest.approx(analytic, abs=1e-6)
+
+    def test_subsampled_rdp_helpers_agree(self):
+        rdp = compute_rdp(q=1.0, noise_multiplier=1.2, steps=5,
+                          orders=DEFAULT_ORDERS)
+        eps, order = get_privacy_spent(DEFAULT_ORDERS, rdp, target_delta=1e-6)
+        assert eps > 0 and order in DEFAULT_ORDERS
+
+    def test_budget_frac_and_exhaustion(self):
+        acc = DPAccountant(noise_multiplier=0.5, delta=1e-5,
+                           epsilon_budget=2.0)
+        assert acc.budget_frac() == 0.0 and not acc.exhausted()
+        while not acc.exhausted():
+            acc.step()
+        assert acc.budget_frac() >= 1.0
+        doc = acc.statusz()
+        assert doc["epsilon_spent"] >= 2.0
+        assert doc["budget_frac"] >= 1.0
+        names = {g[0] for g in acc.prom_gauges()}
+        assert names == {"dp_epsilon_spent", "dp_budget_frac"}
+
+    def test_invalid_noise_multiplier(self):
+        with pytest.raises(ValueError):
+            DPAccountant(noise_multiplier=0.0)
+
+
+# ---------------------------------------------------------------------------
+# SLO chaos drill: dp_budget_exhaustion fires BEFORE epsilon crosses budget
+# ---------------------------------------------------------------------------
+
+class TestBudgetExhaustionSLO:
+    def test_alert_fires_before_budget_crossed(self):
+        row = next(r for r in slo.DEFAULT_PACKS["cross_silo"]
+                   if r["name"] == "dp_budget_exhaustion")
+        assert row["series"] == "privacy.dp_budget_frac"
+        assert row["target"] < 1.0  # the whole point: alert with runway left
+        store = tsdb.install()
+        try:
+            eng = slo.SLOEngine([slo.SLOSpec(**row)], store=store,
+                                front="test")
+            # high noise so epsilon climbs in small increments: the drill is
+            # about the alert lead time, not the mechanism's strength
+            acc = DPAccountant(noise_multiplier=2.0, delta=1e-5,
+                               epsilon_budget=23.0)
+            store.add_collector(acc.tsdb_collector)
+            fired_at_frac = None
+            for step in range(200):
+                acc.step()
+                eng.tick(now=float(step))
+                st = eng.statusz()["slos"]["dp_budget_exhaustion"]
+                if st["state"] == slo.STATE_FIRING and fired_at_frac is None:
+                    fired_at_frac = acc.budget_frac()
+                if acc.budget_frac() >= 1.0:
+                    break
+            assert fired_at_frac is not None, "SLO never fired"
+            assert fired_at_frac < 1.0, (
+                "dp_budget_exhaustion fired only AFTER the budget was spent")
+        finally:
+            tsdb.reset()
+
+
+# ---------------------------------------------------------------------------
+# config parsing + the outbound_delta comm gate
+# ---------------------------------------------------------------------------
+
+class TestPrivacyConfig:
+    def test_off_by_default(self):
+        cfg = privacy_from_args(_Args())
+        assert not cfg.enabled and cfg.mode == ""
+        assert cfg.build_dp() is None
+
+    @pytest.mark.parametrize("raw,secagg,dp", [
+        ("secagg", True, False),
+        ("dp", False, True),
+        ("secagg+dp", True, True),
+        ("SecAgg+DP", True, True),
+    ])
+    def test_mode_parsing(self, raw, secagg, dp):
+        cfg = privacy_from_args(_Args(privacy=raw))
+        assert cfg.secagg is secagg and cfg.dp is dp
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError):
+            privacy_from_args(_Args(privacy="secagg+homomorphic"))
+
+    def test_knobs_flow_from_args(self):
+        cfg = privacy_from_args(_Args(privacy="secagg+dp", secagg_qbits=10,
+                                      dp_noise_multiplier=1.5,
+                                      dp_epsilon_budget=3.0))
+        assert cfg.qbits == 10
+        spec = cfg.quant_spec(max_fanin=8, total_members=8)
+        assert spec.qbits == 10
+        assert spec.ring_bits == ring_bits_for(8, 8, 10)
+        dp = cfg.build_dp()
+        assert dp.noise_multiplier == 1.5
+        assert dp.accountant.epsilon_budget == 3.0
+
+    def test_outbound_delta_passthrough_when_off(self):
+        tree = {"w": np.ones(3)}
+        assert outbound_delta(tree, _Args()) is tree
+
+    def test_outbound_delta_raises_on_raw_under_secagg(self):
+        with pytest.raises(PrivacyError):
+            outbound_delta({"w": np.ones(3)}, _Args(privacy="secagg"))
+
+    def test_outbound_delta_accepts_masked_payload(self):
+        n = 2
+        buf = _privacy_buffer(n)
+        co = WindowCoordinator(buf, TEMPLATE,
+                               rng=np.random.default_rng(8))
+        _, members = co.open_window(range(n))
+        p = masked_uplink_payload(members[0], _deltas(1)[0])
+        assert outbound_delta(p, _Args(privacy="secagg")) is p
+
+    def test_privacy_off_buffer_path_untouched(self):
+        """privacy off == bit-exact plain FedAvg through the same buffer."""
+        n = 3
+        deltas = _deltas(n, rng_seed=47)
+        buf = AsyncAggBuffer(publish_k=n,
+                             policy=StalenessPolicy(exponent=0.0))
+        for r in range(n):
+            buf.submit(r, deltas[r], 1.0, client_version=buf.version)
+        out = buf.publish()
+        mean = np.mean(np.stack([_flat(d) for d in deltas]), axis=0)
+        assert np.allclose(_flat(out), mean, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# satellite: secagg/lightsecagg managers share the main front's forensics
+# ---------------------------------------------------------------------------
+
+class TestSecAggManagerForensics:
+    def test_run_wrappers_present(self):
+        """Each sa/lsa manager overrides run() so a handler exception dumps
+        the flight recorder instead of dying silently in the receive loop."""
+        from fedml_tpu.cross_silo.lightsecagg.lsa_fedml_client_manager import (
+            LightSecAggClientManager)
+        from fedml_tpu.cross_silo.lightsecagg.lsa_fedml_server_manager import (
+            LightSecAggServerManager)
+        from fedml_tpu.cross_silo.secagg.sa_fedml_client_manager import (
+            SecAggClientManager)
+        from fedml_tpu.cross_silo.secagg.sa_fedml_server_manager import (
+            SecAggServerManager)
+
+        for cls in (SecAggClientManager, SecAggServerManager,
+                    LightSecAggClientManager, LightSecAggServerManager):
+            assert "run" in vars(cls), f"{cls.__name__} lacks a run override"
+            import inspect
+            src = inspect.getsource(cls.run)
+            assert "flight_recorded" in src
+
+    def test_comm_retry_armed_by_default(self):
+        from fedml_tpu.core.resilience.retry import RetryPolicy
+
+        pol = RetryPolicy.from_args(_Args())
+        assert pol is not None and pol.max_attempts > 1
+        # and explicitly disabled when the operator turns it off
+        assert RetryPolicy.from_args(_Args(comm_retry_max_attempts=1)) is None

@@ -73,6 +73,8 @@ HEADLINES: Dict[str, str] = {
     "modelwatch_overhead_pct": "lower",      # ISSUE 18 fold-stats cost guard
     "fleet_scale_quantile_err_pct": "lower",  # ISSUE 19 sketch accuracy
     "fleet_telemetry_bytes_per_client": "lower",  # ISSUE 19 memory bound
+    "secagg_overhead_pct": "lower",          # ISSUE 20 masking+DP cost guard
+    "dp_epsilon_spent": "lower",             # ISSUE 20 budget per bench run
     "_llm_pallas.tokens_per_sec": "higher",
     "_llm_pallas.mfu": "higher",
 }

@@ -22,13 +22,13 @@ LOCK="$REPO/.bench_runtime/bench.lock"
 
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-90}
 SMOKE_TIMEOUT=${SMOKE_TIMEOUT:-1200}  # may run BOTH stats layouts (narrow+wide)
-# must exceed the sum of bench.py's per-stage budgets (_STAGES: 13800s with
+# must exceed the sum of bench.py's per-stage budgets (_STAGES: 15360s with
 # attn_micro, the tuned re-run, the agg + agg_sharded microbenches, the
 # placement search, the wan_profile link-observability stage and the
-# slo_overhead evaluator guard; banked CPU baselines usually shave 600s)
-# plus the 180s probe, or the outer timeout kills a run whose stages are
-# all within their own contracts
-BENCH_TIMEOUT=${BENCH_TIMEOUT:-14400}
+# slo/modelwatch/devperf/secagg overhead guards; banked CPU baselines
+# usually shave 600s) plus the 180s probe, or the outer timeout kills a
+# run whose stages are all within their own contracts
+BENCH_TIMEOUT=${BENCH_TIMEOUT:-16200}
 SLEEP_DOWN=${SLEEP_DOWN:-120}     # tunnel down: re-probe every 2 min (short
                                   # up-windows are the norm; 10 min missed them)
 SLEEP_UP=${SLEEP_UP:-3600}        # after a good measurement: hourly is plenty
@@ -86,6 +86,7 @@ commit_artifacts() {
       surface_pipeline_overlap
       surface_devperf
       surface_modelwatch
+      surface_secagg
       surface_fleet_scale
       surface_placement
       surface_resilience
@@ -277,6 +278,30 @@ if doc.get("modelwatch_overhead_pct") is not None:
 PYEOF
 ) || return 0
   [ -n "$mw" ] && log "$mw"
+}
+
+surface_secagg() {
+  # one-line view of the secagg_overhead stage: the masking+DP fold's cost
+  # share of a round-shaped loop (masked-vs-plain, integrity-guarded
+  # in-stage, incl. bit-exact unmask parity) plus the accountant's spent
+  # epsilon — so the watcher log answers "is the privacy subsystem still
+  # ~free and still accounted" without opening BENCH_MEASURED_*.json
+  local newest
+  newest=$(ls -1t BENCH_MEASURED_*.json 2>/dev/null | head -1) || return 0
+  [ -n "$newest" ] || return 0
+  local sa
+  sa=$(python3 - "$newest" <<'PYEOF' 2>/dev/null
+import json, sys
+doc = json.load(open(sys.argv[1]))
+if doc.get("secagg_overhead_pct") is not None:
+    print(f"secagg: overhead {doc['secagg_overhead_pct']}% of round "
+          f"(plain {doc.get('secagg_plain_round_ms')}ms vs masked+dp "
+          f"{doc.get('secagg_masked_round_ms')}ms, d="
+          f"{doc.get('secagg_model_dim')}), eps_spent "
+          f"{doc.get('dp_epsilon_spent')} at z={doc.get('dp_noise_multiplier')}")
+PYEOF
+) || return 0
+  [ -n "$sa" ] && log "$sa"
 }
 
 surface_fleet_scale() {

@@ -10,6 +10,7 @@ from .rules.interproc import (InterprocDonationRule, InterprocHostSyncRule,
 from .rules.lock_graph import LockGraphRule
 from .rules.locking import LockDisciplineRule
 from .rules.metrics import MetricRegistryRule
+from .rules.privacy import RawDeltaEscapeRule
 from .rules.protocol import ProtocolContractRule
 from .rules.resilience import BareSleepRule, OrbaxContainmentRule
 from .rules.retrace import RetraceRiskRule
@@ -44,6 +45,8 @@ _RULE_CLASSES = (
     MetricRegistryRule,
     # per-rank/tenant label-cardinality budget enforcement (ISSUE 19)
     LabelCardinalityRule,
+    # privacy boundary: no raw client delta on the uplink (ISSUE 20)
+    RawDeltaEscapeRule,
 )
 
 

@@ -1,0 +1,264 @@
+"""``raw-delta-escape``: a comm-boundary send whose payload reaches an
+unmasked client delta (ISSUE 20).
+
+The privacy subsystem's contract is that once ``args.privacy`` enables
+SecAgg, a client's trained weights leave the process only *sanctioned* —
+masked into a window's ring (``core/privacy``), run through the comm
+compressor (whose payload the server-side fold treats as opaque), or
+explicitly routed through ``outbound_delta`` (which raises under
+``privacy=secagg`` when handed a raw tree). A new uplink site that attaches
+raw trained weights to a ``MODEL_PARAMS`` message would silently bypass all
+of it — the mask-off path still trains, so nothing functional catches the
+leak.
+
+This rule mirrors the interproc walk (``rules/interproc.py``): per-file
+fact collection over ``msg.add_params(<model-params-key>, payload)`` sites
+plus the function-local dataflow feeding them, then a finalize pass that
+resolves helpers through the project call graph. A payload is *sanctioned*
+when it flows through
+
+* a sanctioner by name (``outbound_delta``, ``compress_upload``,
+  ``masked_uplink_payload``, anything matching ``*mask*`` / ``*quantize*``
+  — the masking entry points), or
+* a project helper **all** of whose returns are themselves sanctioned
+  (e.g. a ``_mask_upload`` that returns ``outbound_delta(...)`` or None) —
+  the one-hop call-graph propagation, so renaming the helper does not blind
+  the rule, or
+* ``get_global_model_params`` — the *published* global model is
+  post-aggregation output, not any client's delta.
+
+Downlink broadcasts (message-type constants named ``*S2C*``) are out of
+scope: the server sending the global model toward clients is not a client
+delta escaping. So is the transport layer
+(``core/distributed/communication``, the ``delta-transport-modules``
+option): backends reassemble/echo whatever Message they were handed —
+chunk reassembly, S3 rehydration, the comm bench's echo server — which the
+*origination* site already sanctioned; flagging the re-attachment would
+just bury the real boundary in pragmas. Everything else that attaches the
+model-params key —
+including sends whose message type the rule cannot resolve — must justify
+itself with a reasoned suppression, which is how the split-learning shard
+upload (unmasked by design; no SecAgg integration on that front) is
+carried.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+
+from ..core import ProjectRule
+from ._util import dotted
+
+_DEFAULT_SANCTIONERS = (
+    "outbound_delta",
+    "compress_upload",
+    "masked_uplink_payload",
+    "*mask*",
+    "*quantize*",
+    "get_global_model_params",
+)
+
+
+def _key_arg(node):
+    """("lit", s) or ("ref", dotted) for an add_params key argument."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return ("lit", node.value)
+    d = dotted(node)
+    if d:
+        return ("ref", d)
+    return None
+
+
+def _payload_arg(node):
+    if isinstance(node, ast.Name):
+        return ("name", node.id)
+    if isinstance(node, ast.Call):
+        d = dotted(node.func)
+        return ("call", d) if d else ("other", None)
+    if isinstance(node, ast.Attribute):
+        d = dotted(node)
+        return ("attr", d) if d else ("other", None)
+    return ("other", None)
+
+
+def _return_desc(node):
+    v = node.value
+    if v is None or (isinstance(v, ast.Constant) and v.value is None):
+        return ("none", None)
+    if isinstance(v, ast.Call):
+        d = dotted(v.func)
+        return ("call", d) if d else ("other", None)
+    if isinstance(v, ast.Name):
+        return ("name", v.id)
+    return ("other", None)
+
+
+class RawDeltaEscapeRule(ProjectRule):
+    id = "raw-delta-escape"
+    severity = "error"
+    description = ("comm-boundary send attaches a client delta that never "
+                   "passed through masking/compression/outbound_delta — a "
+                   "raw update would leave the process unprotected even "
+                   "under privacy=secagg")
+
+    def __init__(self):
+        self.sanctioners: tuple = _DEFAULT_SANCTIONERS
+        self.delta_key = "model_params"
+        self.transport_modules: tuple = (
+            "fedml_tpu/core/distributed/communication/*",)
+
+    def configure(self, options):
+        pats = options.get("delta-sanctioners")
+        if pats:
+            self.sanctioners = tuple(pats)
+        self.delta_key = options.get("delta-key", self.delta_key)
+        transport = options.get("delta-transport-modules")
+        if transport is not None:
+            self.transport_modules = tuple(transport)
+
+    def _sanctioned_name(self, name):
+        if not name:
+            return False
+        tail = name.split(".")[-1]
+        return any(fnmatch.fnmatch(name, p) or fnmatch.fnmatch(tail, p)
+                   for p in self.sanctioners)
+
+    # ------------------------------------------------------------------
+    def collect(self, ctx):
+        sends = []
+        assigns = {}     # qual -> [[tgt, callee_dotted|None, line], ...]
+        returns = {}     # qual -> [[kind, value], ...]
+
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            qual = ctx.qualname(fn)
+            msg_types = {}   # local var -> message-type ref string
+            fn_assigns = []
+            fn_returns = []
+            fn_sends = []
+            for node in ast.walk(fn):
+                if ctx.enclosing_function(node) is not fn:
+                    continue
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    tgt = node.targets[0].id
+                    callee = None
+                    if isinstance(node.value, ast.Call):
+                        callee = dotted(node.value.func)
+                        if callee and callee.split(".")[-1] == "Message" \
+                                and node.value.args:
+                            tref = dotted(node.value.args[0])
+                            if tref:
+                                msg_types[tgt] = tref
+                    fn_assigns.append([tgt, callee, node.lineno])
+                elif isinstance(node, ast.Return):
+                    fn_returns.append(list(_return_desc(node)))
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "add_params" \
+                        and len(node.args) >= 2:
+                    key = _key_arg(node.args[0])
+                    if key is None:
+                        continue
+                    p_kind, p_val = _payload_arg(node.args[1])
+                    recv = node.func.value
+                    tref = msg_types.get(
+                        recv.id if isinstance(recv, ast.Name) else "", "")
+                    fn_sends.append([qual, key[0], key[1], p_kind, p_val,
+                                     tref, node.lineno,
+                                     ctx.raw_line(node.lineno)])
+            if fn_sends:
+                sends.extend(fn_sends)
+                # dataflow facts only matter for functions that send
+                assigns[qual] = fn_assigns
+                returns[qual] = fn_returns
+            elif fn_returns:
+                # every function contributes its returns: it may be the
+                # sanctioning helper a send in another file resolves to
+                returns[qual] = fn_returns
+                if fn_assigns:
+                    assigns[qual] = fn_assigns
+
+        if not sends and not returns:
+            return None
+        return {"sends": sends, "assigns": assigns, "returns": returns}
+
+    # ------------------------------------------------------------------
+    def _helper_quals(self, graph, facts):
+        """(rel, qual) of every function all of whose returns are
+        sanctioned: None, a sanctioner call, or a name assigned from one."""
+        helpers = set()
+        for rel, f in facts.items():
+            for qual, rets in (f.get("returns") or {}).items():
+                if not rets:
+                    continue
+                clean_names = {
+                    tgt for tgt, callee, _line
+                    in (f.get("assigns") or {}).get(qual) or ()
+                    if callee and self._sanctioned_name(callee)}
+                ok = True
+                saw_sanctioned = False
+                for kind, value in rets:
+                    if kind == "none":
+                        continue
+                    if kind == "call" and self._sanctioned_name(value):
+                        saw_sanctioned = True
+                    elif kind == "name" and value in clean_names:
+                        saw_sanctioned = True
+                    else:
+                        ok = False
+                        break
+                if ok and saw_sanctioned:
+                    helpers.add((rel, qual))
+        return helpers
+
+    def _payload_clean(self, graph, rel, qual, f, helpers,
+                       p_kind, p_val, line):
+        def call_clean(callee):
+            if self._sanctioned_name(callee):
+                return True
+            target = graph.resolve_call(rel, qual, callee)
+            return target in helpers if target else False
+
+        if p_kind == "call":
+            return call_clean(p_val)
+        if p_kind != "name":
+            return False
+        clean = False
+        for tgt, callee, aline in (f.get("assigns") or {}).get(qual) or ():
+            if aline >= line or tgt != p_val:
+                continue
+            # later assignment wins: a sanctioned rebind cleans the name,
+            # an unsanctioned one re-taints it
+            clean = bool(callee) and call_clean(callee)
+        return clean
+
+    def finalize_project(self, graph, facts):
+        helpers = self._helper_quals(graph, facts)
+        for rel, f in sorted(facts.items()):
+            if any(fnmatch.fnmatch(rel, p) for p in self.transport_modules):
+                continue   # below the boundary: reassembles sanctioned sends
+            for (qual, key_how, key_val, p_kind, p_val, tref, line,
+                 text) in f.get("sends") or ():
+                key = key_val if key_how == "lit" \
+                    else graph.constant(rel, key_val)
+                if key != self.delta_key:
+                    continue
+                if tref and "S2C" in tref.split(".")[-1]:
+                    continue   # downlink broadcast, not an uplink escape
+                if self._payload_clean(graph, rel, qual, f, helpers,
+                                       p_kind, p_val, line):
+                    continue
+                shown = p_val or p_kind
+                yield self.fact_finding(
+                    graph.root, rel, line,
+                    f"`{shown}` is attached to a {self.delta_key!r} uplink "
+                    "without passing through a sanctioned path (masking, "
+                    "compress_upload, outbound_delta, or a helper that "
+                    "returns one) — under privacy=secagg this would leak "
+                    "the raw client delta; route it through "
+                    "core.privacy.outbound_delta or suppress with the "
+                    "reason it is safe", text)
