@@ -400,6 +400,23 @@ class AsyncAggBuffer:
                 self._watch = fresh
         return out
 
+    def discard(self) -> int:
+        """Throw away the accumulated epoch WITHOUT publishing: no version
+        bump, no publish counter, no privacy hook. The escalation path for
+        an unrecoverable secagg window — its streamed sum still carries
+        un-cancellable stray masks, so normalizing it would emit garbage.
+        Returns how many merges were dropped."""
+        with self._lock:
+            dropped = self._merges_since_publish
+            self._acc = None
+            self._weight_sum = 0.0
+            self._pending = []
+            self._pending_meta = []
+            self._merges_since_publish = 0
+            self._staleness_sum = 0
+            self._watch_ranks = []
+        return dropped
+
     def _publish_locked(self) -> Optional[PyTree]:
         if self._merges_since_publish == 0:
             return None

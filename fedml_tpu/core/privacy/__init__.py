@@ -31,6 +31,7 @@ from .dp import (
     DEFAULT_NOISE_MULTIPLIER,
     DPAccountant,
     DPFold,
+    clip_to_reference,
     clip_update,
 )
 from .masking import (
@@ -47,6 +48,7 @@ from .secagg_window import (
     REVEAL_COUNTER,
     WINDOW_CLOSED,
     WINDOWS_COUNTER,
+    WINDOWS_FAILED_COUNTER,
     HierarchyPrivacy,
     SecAggWindow,
     WindowCoordinator,
@@ -72,8 +74,10 @@ __all__ = [
     "DPFold",
     "DPAccountant",
     "clip_update",
+    "clip_to_reference",
     "WINDOW_CLOSED",
     "WINDOWS_COUNTER",
+    "WINDOWS_FAILED_COUNTER",
     "MASKED_MERGE_COUNTER",
     "DROPOUT_COUNTER",
     "RECOVERED_COUNTER",
@@ -105,6 +109,9 @@ class PrivacyConfig:
     clip: float = DEFAULT_CLIP
     threshold: Optional[int] = None
     window_deadline_s: float = 30.0
+    #: how many times the server may extend a below-quorum window deadline
+    #: before aborting the window (discard epoch, reopen over the live cohort)
+    window_max_extensions: int = 3
     # dp knobs
     noise_multiplier: float = DEFAULT_NOISE_MULTIPLIER
     l2_clip: float = DEFAULT_L2_CLIP
@@ -138,6 +145,7 @@ class PrivacyConfig:
             clip=float(getattr(args, "secagg_clip", DEFAULT_CLIP)),
             threshold=getattr(args, "secagg_threshold", None),
             window_deadline_s=float(getattr(args, "secagg_window_deadline_s", 30.0)),
+            window_max_extensions=int(getattr(args, "secagg_window_max_extensions", 3)),
             noise_multiplier=float(getattr(args, "dp_noise_multiplier",
                                            DEFAULT_NOISE_MULTIPLIER)),
             l2_clip=float(getattr(args, "dp_l2_clip", DEFAULT_L2_CLIP)),
@@ -217,5 +225,7 @@ def submit_masked_payload(coordinator: WindowCoordinator,
     """Server-side routing: a masked uplink payload into the open window."""
     if not is_masked_payload(payload):
         raise PrivacyError("not a masked secagg uplink payload")
+    window_id = payload.get("window_id")
     return coordinator.submit(int(payload["rank"]), payload["masked"],
-                              client_version=client_version)
+                              client_version=client_version,
+                              window_id=None if window_id is None else int(window_id))

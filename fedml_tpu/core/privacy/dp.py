@@ -81,6 +81,31 @@ def clip_update(tree: PyTree, l2_clip: float) -> PyTree:
     return jax.tree.map(lambda x: (np.asarray(x, np.float32) * np.float32(scale)), tree)
 
 
+def clip_to_reference(tree: PyTree, reference: PyTree, l2_clip: float) -> PyTree:
+    """Clip the UPDATE ``tree - reference`` onto the L2 ball of radius
+    ``l2_clip`` and return ``reference + clipped_update`` — the enforcement
+    point of the sensitivity bound DPFold's sigma is calibrated against.
+    Clients upload full trained weights, not deltas, so the projection has
+    to happen relative to the model they trained from (client-side: the
+    last received global; server-side: the current global). Within the
+    ball this is a bit-exact no-op (the input tree is returned untouched);
+    f64 delta arithmetic keeps the clipped reconstruction exact for f32
+    leaves."""
+    import jax
+
+    delta = jax.tree.map(
+        lambda x, r: np.asarray(x, np.float64) - np.asarray(r, np.float64),
+        tree, reference)
+    sq = float(sum(float(np.sum(np.square(l))) for l in jax.tree.leaves(delta)))
+    norm = float(np.sqrt(sq))
+    if norm <= float(l2_clip) or norm == 0.0:
+        return tree
+    scale = float(l2_clip) / norm
+    return jax.tree.map(
+        lambda r, d: (np.asarray(r, np.float64) + d * scale).astype(np.float32),
+        reference, delta)
+
+
 class DPAccountant:
     """RDP/moments accounting for the fold's Gaussian mechanism, plus every
     observability surface the budget must reach."""

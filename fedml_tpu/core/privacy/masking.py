@@ -70,6 +70,31 @@ def ring_bits_for(max_fanin: int, total_members: int,
     return b
 
 
+def validate_ring_bits(spec: "QuantSpec", max_fanin: int,
+                       total_members: int) -> None:
+    """Check the spec ACTUALLY in use against the domain contract — not the
+    width :func:`ring_bits_for` would have picked. A coordinator built with
+    a hand-rolled (or default) :class:`QuantSpec` can carry a ring that is
+    too small for recoverability (``n·2^qbits >= 2^(b-1)``) or too large
+    for f32-exact folds (``fanin·2^b > 2^24``); either silently corrupts
+    the unmasked aggregate, so both sides raise here instead."""
+    if max_fanin < 1 or total_members < 1:
+        raise ValueError("cohort must have at least one member")
+    need = spec.qbits + math.ceil(math.log2(max(2, total_members))) + 1
+    cap = F32_EXACT_BITS - max(1, math.ceil(math.log2(max(2, max_fanin))))
+    if spec.ring_bits < need:
+        raise ValueError(
+            f"ring_bits={spec.ring_bits} too small: {total_members} members "
+            f"at {spec.qbits} qbits need >= {need} for the signed window sum "
+            "to be recoverable from its mod-2^b residue; reduce secagg_qbits "
+            "or the window cohort (or widen the ring)")
+    if spec.ring_bits > cap:
+        raise ValueError(
+            f"ring_bits={spec.ring_bits} too large: a fold of {max_fanin} "
+            f"ring values is only f32-exact up to {cap} bits; shrink the "
+            "ring or the fan-in")
+
+
 @dataclass(frozen=True)
 class QuantSpec:
     """Shared fixed-point grid: every cohort member quantizes onto the SAME

@@ -55,9 +55,9 @@ from .masking import (
     mask_quantized,
     pair_seed,
     quantize_vector,
-    ring_bits_for,
     ring_mod,
     stray_mask_correction,
+    validate_ring_bits,
 )
 
 PyTree = Any
@@ -67,6 +67,7 @@ MASKED_MERGE_COUNTER = "secagg.masked_merges"  # fedml_secagg_masked_merges_tota
 DROPOUT_COUNTER = "secagg.dropouts"           # fedml_secagg_dropouts_total
 RECOVERED_COUNTER = "secagg.recovered"        # fedml_secagg_recovered_total
 REVEAL_COUNTER = "secagg.reveals"             # fedml_secagg_reveals_total
+WINDOWS_FAILED_COUNTER = "secagg.windows_failed"  # fedml_secagg_windows_failed_total
 
 #: verdict for a masked arrival addressed to an already-closed window — the
 #: stray masks it carries were already revealed and subtracted, so folding
@@ -145,8 +146,14 @@ class WindowMember:
     # --- recovery -----------------------------------------------------------
     def reveal_shares(self, dropped: Sequence[int]) -> Dict[int, List[int]]:
         """The mask-share reveal: this survivor's held shares of each
-        dropped member's window key. Refuses to reveal a rank that this
-        member saw submit — the double-reveal would unmask a live client."""
+        dropped member's window key. The only client-side refusal is this
+        member's OWN rank — a client cannot observe whether a *peer*
+        submitted, so it cannot police the server's dropped set. A server
+        that equivocates (lists a submitted client as dropped) collects
+        enough shares to unmask that client's individual update; this
+        design has no Bonawitz-style self-mask, so the server is TRUSTED
+        not to equivocate on the dropped set (docs/privacy.md §threat
+        model)."""
         out: Dict[int, List[int]] = {}
         for dr in dropped:
             dr = int(dr)
@@ -292,6 +299,7 @@ class WindowCoordinator:
         self.windows_total = 0
         self.recovered_total = 0
         self.dropouts_total = 0
+        self.failed_total = 0
         self._max_fanin = max_fanin
         self._lock = threading.Lock()
         if getattr(buffer.policy, "exponent", 0.0) != 0.0:
@@ -311,7 +319,7 @@ class WindowCoordinator:
         message plane."""
         cohort = sorted(int(r) for r in cohort)
         n = len(cohort)
-        ring_bits_for(self._max_fanin or n, n, self.spec.qbits)  # bound check
+        validate_ring_bits(self.spec, self._max_fanin or n, n)
         threshold = self.threshold if self.threshold is not None else n // 2
         if threshold + 1 > n:
             raise ValueError(f"threshold {threshold} unreachable with {n} members")
@@ -347,13 +355,20 @@ class WindowCoordinator:
         return window, members
 
     def submit(self, rank: int, masked_vec: np.ndarray,
-               client_version: Optional[int] = None) -> str:
+               client_version: Optional[int] = None,
+               window_id: Optional[int] = None) -> str:
         """Fold one masked arrival (weight 1.0 — the mask-cancellation
         invariant) and book it against the open window. Arrivals for a
-        closed window are refused: their stray masks were already revealed."""
+        closed window are refused: their stray masks were already revealed.
+        Arrivals carrying a ``window_id`` that is not the open window's are
+        refused too — a straggler masked under a stale window's seeds would
+        fold un-cancellable masks into the new window's sum."""
         with self._lock:
             window = self.window
         if window is None or window.closed:
+            tel.get_telemetry().counter(quorum_mod.LATE_COUNTER).add(1)
+            return WINDOW_CLOSED
+        if window_id is not None and int(window_id) != window.window_id:
             tel.get_telemetry().counter(quorum_mod.LATE_COUNTER).add(1)
             return WINDOW_CLOSED
         if int(rank) not in window.cohort:
@@ -392,6 +407,31 @@ class WindowCoordinator:
                 f"window {window.window_id}: reveal quorum not met for "
                 f"dropped ranks {dropped}")
         return dropped
+
+    def abort_window(self) -> List[int]:
+        """Give up on the open window: too many cohort members are gone to
+        ever meet the reveal quorum (the bounded-deadline escalation path).
+        The buffer's accumulated epoch is DISCARDED — it still carries the
+        survivors' un-cancellable stray masks, so publishing it would emit
+        masked garbage — and the window is marked closed so any straggler
+        arrival gets the ``window_closed`` refusal. Returns the missing
+        ranks; booked on ``secagg.windows_failed``."""
+        with self._lock:
+            window = self.window
+            self.window = None
+            if window is None:
+                return []
+            window.closed = True
+            self.closed_windows.add(window.window_id)
+            self.failed_total += 1
+        missing = window.missing()
+        if hasattr(self.buffer, "discard"):
+            self.buffer.discard()
+        tel.get_telemetry().counter(WINDOWS_FAILED_COUNTER).add(1)
+        flight_recorder.mark("secagg.window_failed", window=window.window_id,
+                             arrived=len(window.arrived),
+                             missing=list(missing))
+        return missing
 
     def close_window(self) -> Optional[PyTree]:
         """Force-publish a partial window after recovery (the quorum
@@ -464,6 +504,7 @@ class WindowCoordinator:
                 "windows_total": self.windows_total,
                 "recovered_total": self.recovered_total,
                 "dropouts_total": self.dropouts_total,
+                "failed_total": self.failed_total,
                 "open_window": self.window.statusz() if self.window else None,
             }
         return doc

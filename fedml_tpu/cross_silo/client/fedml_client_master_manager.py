@@ -54,6 +54,10 @@ class ClientMasterManager(FedMLCommManager):
         self._secagg_member = None
         self._secagg_support_ratio: Optional[float] = None
         self._pending_upload: Optional[tuple] = None
+        # DP sensitivity enforcement: the last global model received, kept as
+        # the anchor the upload's delta is clipped against (clients ship full
+        # weights, so the L2 projection must be delta-vs-anchor)
+        self._dp_anchor = None
 
     def run(self) -> None:
         # an exception anywhere in the client's receive loop (trainer bug,
@@ -103,6 +107,8 @@ class ClientMasterManager(FedMLCommManager):
         self.client_index = int(data_silo_index)
         self.trainer_dist_adapter.update_dataset(int(data_silo_index))
         self.trainer_dist_adapter.update_model(global_model_params)
+        if self._privacy.dp:
+            self._dp_anchor = global_model_params
         # a resumed server's first round is not 0 — adopt its round index so
         # local-training seeds replay exactly (crash-resume bit-identity)
         self.args.round_idx = int(msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX) or 0)
@@ -115,6 +121,8 @@ class ClientMasterManager(FedMLCommManager):
         self.client_index = int(client_index)
         self.trainer_dist_adapter.update_dataset(int(client_index))
         self.trainer_dist_adapter.update_model(model_params)
+        if self._privacy.dp:
+            self._dp_anchor = model_params
         self._adopt_model_version(msg_params)
         ridx = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
         if ridx is not None:
@@ -236,10 +244,17 @@ class ClientMasterManager(FedMLCommManager):
 
     def handle_message_secagg_reveal_request(self, msg_params: Message) -> None:
         """Mask-share reveal for a partial window close: hand the server this
-        survivor's shares of each DROPPED member's window key (never a rank
-        this member saw submit — WindowMember refuses the double reveal)."""
+        survivor's shares of each dropped member's window key. The client
+        only refuses its OWN rank — it cannot observe peer submissions, so
+        the server is trusted not to equivocate on the dropped set
+        (docs/privacy.md §threat model). Requests for a window other than
+        the member's are ignored: stale reveals would be reconstructed
+        against the wrong nonce's masks."""
         member = self._secagg_member
         if member is None:
+            return
+        req_window = msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_WINDOW_ID)
+        if req_window is not None and int(req_window) != member.window_id:
             return
         dropped = [int(r) for r in
                    msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_DROPPED)]
@@ -264,6 +279,15 @@ class ClientMasterManager(FedMLCommManager):
         self.send_message(message)
 
     def send_model_to_server(self, receive_id: int, weights, local_sample_num) -> None:
+        if self._privacy.dp and self._dp_anchor is not None:
+            # enforce the sensitivity bound the server's sigma is calibrated
+            # against: project the delta-vs-anchor onto the L2 ball BEFORE
+            # any masking/compression (bit-exact no-op within the ball).
+            # Idempotent, so the queued-upload replay re-clipping is safe.
+            from ...core.privacy import clip_to_reference
+
+            weights = clip_to_reference(weights, self._dp_anchor,
+                                        self._privacy.l2_clip)
         if self._privacy.secagg:
             # masked uplink replaces the plain compressor: sparsification is
             # the window's shared rand-k support (mask-in-quantized-domain),
@@ -292,8 +316,12 @@ class ClientMasterManager(FedMLCommManager):
         masked payload dict (the ONLY form a secagg upload takes on the
         wire: ``outbound_delta`` raises on anything else), or None if
         queued. A member masks exactly once — the nonce-derived masks are
-        one-time pads — so the member retires with its upload and the next
-        upload waits for the next ANNOUNCE."""
+        one-time pads — so ``member.submitted`` guards re-masking and the
+        next upload queues for the next ANNOUNCE. The member itself is KEPT
+        after masking: the window stays open server-side until every cohort
+        member arrives or the deadline reveal runs, and the reveal handler
+        needs this member's held shares to answer a REVEAL_REQUEST for a
+        dropped peer. It retires when the next ANNOUNCE replaces it."""
         from ...core.privacy import masked_uplink_payload, outbound_delta
         from ...utils.compression import secagg_support
         from ...utils.pytree import tree_flatten_to_vector
@@ -302,6 +330,14 @@ class ClientMasterManager(FedMLCommManager):
         if member is None or member.submitted or not member._pair_seeds:
             self._pending_upload = (receive_id, weights, local_sample_num)
             return None
+        drop_at = getattr(self.args, "chaos_secagg_drop_upload_at_round", None)
+        if drop_at is not None and int(self.args.round_idx) == int(drop_at):
+            # chaos drill: vanish mid-window AFTER key exchange — the server
+            # sees this rank in missing() while survivors hold its shares,
+            # which is exactly the mask-share-reveal recovery path
+            log.warning("chaos: dropping secagg upload at round %d (window %d)",
+                        int(self.args.round_idx), member.window_id)
+            return None
         support = None
         if self._secagg_support_ratio:
             d = int(tree_flatten_to_vector(weights)[0].size)
@@ -309,7 +345,6 @@ class ClientMasterManager(FedMLCommManager):
                                      float(self._secagg_support_ratio))
         with tel.span("client.secagg_mask", window=member.window_id):
             payload = masked_uplink_payload(member, weights, support=support)
-        self._secagg_member = None
         return outbound_delta(payload, cfg=self._privacy)
 
     def _attach_telemetry_delta(self, message: Message) -> None:

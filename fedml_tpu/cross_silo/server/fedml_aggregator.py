@@ -167,6 +167,8 @@ class FedMLAggregator:
                 or FedMLDefender.get_instance().is_defense_enabled()
                 or FedMLDifferentialPrivacy.get_instance().is_dp_enabled()):
             return None
+        if self.dp_fold is not None:
+            return None  # server-side clip reads raw trees before the fold
         return self._sharded_engine
 
     def get_global_model_params(self):
@@ -204,6 +206,18 @@ class FedMLAggregator:
         Returns the staleness verdict. The buffer itself handles sharded
         ingestion; float trees take the same one-transfer-per-dtype-group
         upload as the synchronous path."""
+        if self.dp_fold is not None and self.secagg_coordinator is None:
+            # DP sensitivity is a server-enforced bound, not a client
+            # promise: re-clip this arrival's delta against the current
+            # global before it folds (bit-exact no-op when the client
+            # already clipped). The secagg path cannot clip here — masked
+            # payloads are opaque — so there epsilon is conditional on the
+            # client-side clip (docs/privacy.md).
+            from ...core.privacy import clip_to_reference
+
+            model_params = clip_to_reference(
+                model_params, self.get_global_model_params(),
+                self.dp_fold.l2_clip)
         if _float_array_leaves_only(model_params) and self._sharded_engine is None:
             model_params = tree_from_numpy(model_params)
         return self.async_buffer.submit(
@@ -269,6 +283,16 @@ class FedMLAggregator:
                 model_list = modelwatch.screen_cohort(
                     watch, model_list, ranks, ledger=self.fleet.ledger,
                     quarantine=modelwatch.quarantine_enabled(self.args))
+            if self.dp_fold is not None and self.async_buffer is None:
+                # enforce the sensitivity bound sigma is calibrated against:
+                # clip each arrival's delta vs the model this round trained
+                # from, server-side, whether or not the client already did
+                from ...core.privacy import clip_to_reference
+
+                ref = self.get_global_model_params()
+                model_list = [
+                    (n, clip_to_reference(m, ref, self.dp_fold.l2_clip))
+                    for n, m in model_list]
             Context().add(Context.KEY_CLIENT_MODEL_LIST, model_list)
             averaged = self.aggregator.aggregate(model_list)
             averaged = self.aggregator.on_after_aggregation(averaged)

@@ -72,6 +72,7 @@ class FedMLServerManager(FedMLCommManager):
         # PUBKEY -> DIRECTORY -> SHARES relay -> masked uploads -> (deadline)
         # REVEAL -> partial close
         self._secagg_deadline_timer: Optional[threading.Timer] = None
+        self._secagg_deadline_attempts = 0
         # --- link telemetry -------------------------------------------------
         # active probing is opt-in (args.link_probe_interval_s > 0); passive
         # per-pair accounting in FedMLCommManager is always on
@@ -327,14 +328,18 @@ class FedMLServerManager(FedMLCommManager):
         dp = getattr(self.aggregator, "dp_fold", None)
         return dp.accountant if dp is not None else None
 
-    def _secagg_open_window(self) -> None:
-        """Open the next masking window over the current cohort and ANNOUNCE
-        it (id, nonce, shared grid spec, threshold) to every member. Key
-        exchange runs over the message plane, not in-process."""
+    def _secagg_open_window(self, cohort=None) -> None:
+        """Open the next masking window over the current cohort (or an
+        explicit override — the post-abort reopen passes the survivors) and
+        ANNOUNCE it (id, nonce, shared grid spec, threshold) to every
+        member. Key exchange runs over the message plane, not in-process."""
         co = self._secagg
-        if co is None or not self.client_id_list_in_this_round:
+        if cohort is None:
+            cohort = self.client_id_list_in_this_round
+        if co is None or not cohort:
             return
-        cohort = [int(c) for c in self.client_id_list_in_this_round]
+        self._secagg_deadline_attempts = 0
+        cohort = [int(c) for c in cohort]
         window, _ = co.open_window(cohort, run_key_exchange=False)
         spec_doc = dict(co.spec.as_dict())
         if co.support_ratio is not None:
@@ -404,7 +409,13 @@ class FedMLServerManager(FedMLCommManager):
     def _on_secagg_deadline(self, window_id: int) -> None:
         """Timer thread: the masking window's deadline fired with members
         missing. Start the mask-share reveal against the survivors; the
-        reveal handler closes the window once the quorum of shares is in."""
+        reveal handler closes the window once the quorum of shares is in.
+        The deadline is RE-ARMED after sending reveal requests (a starving
+        reveal phase refires instead of hanging), and the total number of
+        deadline firings per window is bounded by ``window_max_extensions``
+        — past that the window is aborted: the buffer epoch is discarded
+        (it still carries un-cancellable stray masks) and a fresh window
+        opens over the currently-live cohort."""
         with self._round_lock:
             co = self._secagg
             window = co.window if co is not None else None
@@ -413,10 +424,18 @@ class FedMLServerManager(FedMLCommManager):
             dropped = window.missing()
             if not dropped:
                 return
+            self._secagg_deadline_attempts += 1
+            max_ext = int(getattr(self.aggregator.privacy_cfg,
+                                  "window_max_extensions", 3))
+            if self._secagg_deadline_attempts > max_ext:
+                self._secagg_abort_window(co, window, window_id)
+                return
             if len(window.arrived) < window.threshold + 1:
                 log.warning("secagg window %d: only %d arrivals (< reveal "
-                            "quorum %d) — extending deadline", window_id,
-                            len(window.arrived), window.threshold + 1)
+                            "quorum %d) — extending deadline (%d/%d)",
+                            window_id, len(window.arrived),
+                            window.threshold + 1,
+                            self._secagg_deadline_attempts, max_ext)
                 self._arm_secagg_deadline(window_id)
                 return
             mlops.log_resilience_event("secagg_dropout", round_idx=window_id,
@@ -428,6 +447,40 @@ class FedMLServerManager(FedMLCommManager):
                 msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_DROPPED,
                                [int(r) for r in dropped])
                 self.send_message(msg)
+            # survivors may themselves vanish before revealing — refire
+            # (bounded by the same attempts counter) rather than hang
+            self._arm_secagg_deadline(window_id)
+
+    def _secagg_abort_window(self, co, window, window_id: int) -> None:
+        """Escalation past the extension budget: too few live members to
+        ever meet the reveal quorum. Abort (discard the poisoned buffer
+        epoch, book ``secagg.windows_failed``) and reopen over the members
+        that proved live this window — falling back to the full round
+        cohort when the survivor set is too small to ever reach its own
+        reveal quorum. Caller holds ``_round_lock``; runs on the timer
+        thread, so every reopen is exception-guarded."""
+        arrived = [int(c) for c in window.arrived]
+        missing = co.abort_window()
+        log.error("secagg window %d: aborted after %d deadline attempts "
+                  "(arrived=%s missing=%s) — discarding epoch and reopening",
+                  window_id, self._secagg_deadline_attempts, arrived, missing)
+        mlops.log_resilience_event("secagg_window_failed", round_idx=window_id,
+                                   missing=missing, arrived=arrived)
+        cohort = arrived if len(arrived) >= 2 else None
+        try:
+            self._secagg_open_window(cohort=cohort)
+        except Exception:
+            if cohort is None:
+                log.exception("secagg window %d: reopen after abort failed",
+                              window_id)
+                return
+            # survivor cohort not viable (e.g. configured threshold above
+            # its size): fall back to the full round cohort
+            try:
+                self._secagg_open_window()
+            except Exception:
+                log.exception("secagg window %d: reopen after abort failed",
+                              window_id)
 
     def handle_message_secagg_reveal(self, msg_params: Message) -> None:
         """One survivor's share bundle. When every dropped rank has its

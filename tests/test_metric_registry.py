@@ -110,6 +110,7 @@ EXPORTED = {
     "fedml_secagg_dropouts_total": "counter",
     "fedml_secagg_recovered_total": "counter",
     "fedml_secagg_reveals_total": "counter",
+    "fedml_secagg_windows_failed_total": "counter",
     "fedml_secagg_window_depth": "gauge",
     "fedml_secagg_windows": "gauge",
     "fedml_dp_noised_publishes_total": "counter",

@@ -26,6 +26,7 @@ from fedml_tpu.core.privacy import (
     PrivacyError,
     QuantSpec,
     WindowCoordinator,
+    clip_to_reference,
     clip_update,
     is_masked_payload,
     masked_uplink_payload,
@@ -42,6 +43,7 @@ from fedml_tpu.core.privacy.secagg_window import (
     REVEAL_COUNTER,
     WINDOW_CLOSED,
     WINDOWS_COUNTER,
+    WINDOWS_FAILED_COUNTER,
 )
 from fedml_tpu.core.telemetry import slo, tsdb
 from fedml_tpu.core.telemetry.jax_hooks import compile_count
@@ -149,6 +151,39 @@ class TestMaskedWindowParity:
 
 
 # ---------------------------------------------------------------------------
+# the ring spec ACTUALLY in use is validated at open, not a hypothetical one
+# ---------------------------------------------------------------------------
+
+class TestRingSpecValidation:
+    def test_too_small_ring_rejected_at_open(self):
+        """QuantSpec(ring_bits=15) with 4 members at 13 qbits: the signed
+        window sum is not recoverable from its mod-2^15 residue (needs 16
+        bits) — must raise instead of silently corrupting the aggregate."""
+        buf = _privacy_buffer(4)
+        co = WindowCoordinator(buf, TEMPLATE, spec=QuantSpec(ring_bits=15),
+                               rng=np.random.default_rng(1))
+        with pytest.raises(ValueError, match="too small"):
+            co.open_window(range(4))
+
+    def test_too_wide_ring_rejected_at_open(self):
+        """QuantSpec(ring_bits=23) with fan-in 4: a fold of 4 ring values
+        can exceed 2^24, where f32 addition stops being exact integer
+        arithmetic — masks would no longer cancel bit-exactly."""
+        buf = _privacy_buffer(4)
+        co = WindowCoordinator(buf, TEMPLATE, spec=QuantSpec(ring_bits=23),
+                               rng=np.random.default_rng(1))
+        with pytest.raises(ValueError, match="too large"):
+            co.open_window(range(4))
+
+    def test_default_spec_valid_for_small_cohorts(self):
+        buf = _privacy_buffer(4)
+        co = WindowCoordinator(buf, TEMPLATE,
+                               rng=np.random.default_rng(1))
+        window, _ = co.open_window(range(4))  # 16 <= 20 <= 22: fine
+        assert window is not None
+
+
+# ---------------------------------------------------------------------------
 # dropout drill: rank dies mid-window, reveal recovers the partial bit-exact
 # ---------------------------------------------------------------------------
 
@@ -201,6 +236,57 @@ class TestDropoutRecovery:
         late = co.submit(dead, members[dead].mask(_flat(deltas[dead])),
                          client_version=buf.version)
         assert late == WINDOW_CLOSED
+
+    def test_stale_window_id_submission_refused(self):
+        """A straggler masked under an earlier window's nonce cannot cancel
+        in the open window — the coordinator must refuse it, not fold it."""
+        n = 3
+        spec = QuantSpec(ring_bits=ring_bits_for(n, n))
+        deltas = _deltas(n, rng_seed=19)
+        buf = _privacy_buffer(n)
+        co = WindowCoordinator(buf, TEMPLATE, spec=spec,
+                               rng=np.random.default_rng(12))
+        window, members = co.open_window(range(n))
+        stale = co.submit(0, members[0].mask(_flat(deltas[0])),
+                          client_version=buf.version,
+                          window_id=window.window_id + 1)
+        assert stale == WINDOW_CLOSED
+        assert co.submit(1, members[1].mask(_flat(deltas[1])),
+                         client_version=buf.version,
+                         window_id=window.window_id) == "accept"
+        assert window.arrived == [1]
+
+    def test_abort_window_discards_epoch_without_publishing(self):
+        """Escalation past the deadline budget: the buffer's accumulated
+        epoch still carries un-cancellable stray masks, so abort must drop
+        it (no version bump, no publish) and book the failure."""
+        n = 3
+        spec = QuantSpec(ring_bits=ring_bits_for(n, n))
+        deltas = _deltas(n, rng_seed=21)
+        t = tel.get_telemetry()
+        f0 = t.counter(WINDOWS_FAILED_COUNTER).value
+        buf = _privacy_buffer(n)
+        co = WindowCoordinator(buf, TEMPLATE, spec=spec,
+                               rng=np.random.default_rng(14))
+        window, members = co.open_window(range(n))
+        assert co.submit(0, members[0].mask(_flat(deltas[0])),
+                         client_version=buf.version) == "accept"
+        v0 = buf.version
+        missing = co.abort_window()
+        assert sorted(missing) == [1, 2]
+        assert co.window is None and window.closed
+        assert buf.version == v0        # no publish happened
+        assert buf.publish() is None    # the poisoned epoch is gone
+        assert co.failed_total == 1
+        assert co.statusz()["failed_total"] == 1
+        assert t.counter(WINDOWS_FAILED_COUNTER).value == f0 + 1
+        # stragglers of the aborted window get the closed-window refusal
+        late = co.submit(1, members[1].mask(_flat(deltas[1])),
+                         client_version=v0)
+        assert late == WINDOW_CLOSED
+        # and a fresh window opens cleanly afterwards
+        window2, _ = co.open_window(range(n))
+        assert window2 is not None and not window2.closed
 
     def test_below_threshold_reveal_fails(self):
         """Fewer surviving shareholders than the Shamir quorum must not
@@ -415,6 +501,31 @@ class TestDPFold:
         same = clip_update(small, l2_clip=1.0)
         assert np.array_equal(_flat(same), _flat(small))
 
+    def test_clip_to_reference_noop_within_ball_is_bit_exact(self):
+        """Clients ship full weights, so enforcement clips delta-vs-anchor;
+        inside the ball the INPUT TREE comes back untouched (the enforced
+        path must not perturb an honest update by a single ulp)."""
+        rng = np.random.default_rng(0)
+        ref = {"w": rng.normal(size=(5, 3)).astype(np.float32),
+               "b": rng.normal(size=(4,)).astype(np.float32)}
+        near = {"w": ref["w"] + np.float32(0.01), "b": ref["b"].copy()}
+        out = clip_to_reference(near, ref, 1.0)
+        assert out is near  # identity, not a reconstruction
+
+    def test_clip_to_reference_projects_delta_not_weights(self):
+        rng = np.random.default_rng(1)
+        ref = {"w": (rng.normal(size=(5, 3)) * 10).astype(np.float32),
+               "b": (rng.normal(size=(4,)) * 10).astype(np.float32)}
+        far = {"w": ref["w"] + np.float32(5.0),
+               "b": ref["b"] - np.float32(5.0)}
+        clipped = clip_to_reference(far, ref, 1.0)
+        delta = np.concatenate([
+            (np.asarray(clipped["w"], np.float64) - np.asarray(ref["w"], np.float64)).ravel(),
+            (np.asarray(clipped["b"], np.float64) - np.asarray(ref["b"], np.float64)).ravel()])
+        # the DELTA lands on the ball; the weights themselves stay large
+        assert float(np.linalg.norm(delta)) == pytest.approx(1.0, rel=1e-4)
+        assert float(np.linalg.norm(_flat(clipped))) > 1.0
+
 
 class TestDPAccountant:
     def test_epsilon_matches_analytic_rdp_bound(self):
@@ -555,6 +666,81 @@ class TestPrivacyConfig:
         out = buf.publish()
         mean = np.mean(np.stack([_flat(d) for d in deltas]), axis=0)
         assert np.allclose(_flat(out), mean, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# message-plane dropout drill: the in-process recover(members=...) tests
+# bypass the REVEAL_REQUEST/REVEAL exchange entirely — this one runs the
+# whole cross-silo protocol with a client that vanishes mid-window
+# ---------------------------------------------------------------------------
+
+class TestMessagePlaneDropoutDrill:
+    def test_client_dropout_recovers_over_message_plane(self):
+        """Regression for the reveal deadlock: survivors must still hold
+        their window member after submitting, so the REVEAL_REQUESTs the
+        server sends to ``window.arrived`` can actually be answered. A
+        client drops its masked upload AFTER key exchange (the chaos knob),
+        the deadline fires, survivors reveal their shares of the dead
+        rank's key over the wire, and every window publishes partial —
+        the run completes instead of hanging."""
+        import threading
+
+        import fedml_tpu as fedml
+        from fedml_tpu.arguments import default_config
+        from fedml_tpu.core.distributed.communication.inmemory.broker import (
+            InMemoryBroker)
+
+        InMemoryBroker.reset()
+        t = tel.get_telemetry()
+        d0 = t.counter(DROPOUT_COUNTER).value
+        r0 = t.counter(RECOVERED_COUNTER).value
+
+        n_clients, rounds = 3, 2
+        common = dict(
+            run_id="test_secagg_drill",
+            backend="INMEMORY", scenario="horizontal",
+            client_num_in_total=n_clients, client_num_per_round=n_clients,
+            comm_round=rounds, epochs=1, batch_size=16,
+            frequency_of_the_test=1, dataset="synthetic", model="lr",
+            random_seed=0,
+            async_rounds=True, async_publish_k=n_clients,
+            async_staleness_exponent=0.0,  # masks only cancel at unit weight
+            privacy="secagg", secagg_window_deadline_s=1.5,
+        )
+
+        def party(rank, role, key, **extra):
+            args = default_config("cross_silo", rank=rank, role=role,
+                                  **common, **extra)
+            args = fedml.init(args)
+            device = fedml.device.get_device(args)
+            dataset, output_dim = fedml.data.load(args)
+            model = fedml.model.create(args, output_dim)
+            results[key] = fedml.FedMLRunner(args, device, dataset,
+                                             model).run()
+
+        results = {}
+        threads = [threading.Thread(target=party, args=(0, "server", "server"),
+                                    daemon=True)]
+        for rank in (1, 2):
+            threads.append(threading.Thread(
+                target=party, args=(rank, "client", f"client{rank}"),
+                daemon=True))
+        # rank 3 completes key exchange, then never sends its masked upload
+        threads.append(threading.Thread(
+            target=party, args=(3, "client", "client3"),
+            kwargs={"chaos_secagg_drop_upload_at_round": 0}, daemon=True))
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=240)
+            assert not th.is_alive(), (
+                "secagg dropout drill deadlocked: a survivor could not "
+                "answer the reveal request (or the window never closed)")
+        metrics = results["server"]
+        assert metrics is not None and np.isfinite(metrics["test_loss"])
+        # the drill recovered at least one window over the message plane
+        assert t.counter(DROPOUT_COUNTER).value > d0
+        assert t.counter(RECOVERED_COUNTER).value > r0
 
 
 # ---------------------------------------------------------------------------
